@@ -1,0 +1,30 @@
+"""Test harness config: force CPU JAX with 8 virtual devices.
+
+Tests exercise multi-chip sharding semantics on a virtual CPU mesh
+(SURVEY.md §4's rebuild mapping); the single real TPU chip is reserved for
+bench.py and explicit @tpu-marked tests.  Must set flags before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "tpu: needs the real TPU chip (excluded by default)")
+    config.addinivalue_line("markers", "slow: long-running e2e test")
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("RUN_TPU_TESTS"):
+        return
+    skip_tpu = pytest.mark.skip(reason="real-TPU test; set RUN_TPU_TESTS=1")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip_tpu)

@@ -1,0 +1,258 @@
+"""Spec-layer tests: types, defaults, validation, serde round-trip.
+
+Mirrors the reference's colocated API unit tests (SURVEY.md §4 tier 1).
+"""
+
+import pytest
+
+from tf_operator_tpu.api.defaults import (
+    DEFAULT_CLEAN_POD_POLICY,
+    DEFAULT_RESTART_POLICY,
+    set_defaults,
+)
+from tf_operator_tpu.api.serde import job_from_dict, job_to_dict
+from tf_operator_tpu.api.types import (
+    DEFAULT_COORDINATOR_PORT,
+    DEFAULT_PORT,
+    DEFAULT_PORT_NAME,
+    CleanPodPolicy,
+    Container,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    SuccessPolicy,
+    TPUJob,
+    TPUJobSpec,
+    replica_name,
+)
+from tf_operator_tpu.api.validation import ValidationError, parse_tpu_topology, validate
+
+
+def make_job(name="mnist", **replica_counts) -> TPUJob:
+    """Builder mirroring the reference testutil's NewTFJob(worker, ps)."""
+
+    specs = {}
+    for tname, n in replica_counts.items():
+        rtype = ReplicaType.from_str(tname)
+        specs[rtype] = ReplicaSpec(
+            replicas=n,
+            template=PodTemplateSpec(
+                containers=[Container(command=["python", "train.py"])]
+            ),
+        )
+    return TPUJob(metadata=ObjectMeta(name=name, uid=f"uid-{name}"), spec=TPUJobSpec(replica_specs=specs))
+
+
+class TestTypes:
+    def test_replica_name_contract(self):
+        assert replica_name("mnist", ReplicaType.WORKER, 2) == "mnist-worker-2"
+        assert replica_name("j", ReplicaType.PS, 0) == "j-ps-0"
+        assert replica_name("j", ReplicaType.TPU_SLICE, 1) == "j-tpuslice-1"
+
+    def test_replica_type_from_str(self):
+        assert ReplicaType.from_str("worker") is ReplicaType.WORKER
+        assert ReplicaType.from_str("Chief") is ReplicaType.CHIEF
+        assert ReplicaType.from_str("TPUSlice") is ReplicaType.TPU_SLICE
+        with pytest.raises(ValueError):
+            ReplicaType.from_str("gpu")
+
+    def test_ordered_types_deterministic(self):
+        job = make_job(worker=2, chief=1, ps=1)
+        assert job.spec.ordered_types() == [
+            ReplicaType.CHIEF,
+            ReplicaType.PS,
+            ReplicaType.WORKER,
+        ]
+
+    def test_total_replicas(self):
+        assert make_job(worker=4, ps=2, chief=1).spec.total_replicas() == 7
+
+
+class TestDefaults:
+    def test_fills_replicas_restart_policy_port(self):
+        job = make_job(worker=None)  # replicas unset
+        set_defaults(job)
+        rs = job.spec.replica_specs[ReplicaType.WORKER]
+        assert rs.replicas == 1
+        assert rs.restart_policy is DEFAULT_RESTART_POLICY
+        port = rs.template.main_container().port_named(DEFAULT_PORT_NAME)
+        assert port is not None and port.container_port == DEFAULT_PORT
+
+    def test_existing_port_untouched(self):
+        job = make_job(worker=1)
+        main = job.spec.replica_specs[ReplicaType.WORKER].template.main_container()
+        from tf_operator_tpu.api.types import Port
+
+        main.ports.append(Port(name=DEFAULT_PORT_NAME, container_port=5000))
+        set_defaults(job)
+        assert main.port_named(DEFAULT_PORT_NAME).container_port == 5000
+        assert len(main.ports) == 1
+
+    def test_clean_pod_policy_default(self):
+        job = set_defaults(make_job(worker=1))
+        assert job.spec.run_policy.clean_pod_policy is DEFAULT_CLEAN_POD_POLICY
+
+    def test_tpu_slice_forces_gang_and_coordinator_port(self):
+        job = make_job(tpuslice=2)
+        job.spec.replica_specs[ReplicaType.TPU_SLICE].tpu_topology = "v5e-16"
+        set_defaults(job)
+        assert job.spec.enable_gang_scheduling
+        assert job.spec.run_policy.scheduling_policy.min_member == 2
+        port = (
+            job.spec.replica_specs[ReplicaType.TPU_SLICE]
+            .template.main_container()
+            .port_named(DEFAULT_PORT_NAME)
+        )
+        assert port.container_port == DEFAULT_COORDINATOR_PORT
+
+    def test_gang_min_member_defaults_to_total(self):
+        job = make_job(worker=4, chief=1)
+        job.spec.enable_gang_scheduling = True
+        set_defaults(job)
+        assert job.spec.run_policy.scheduling_policy.min_member == 5
+
+
+class TestValidation:
+    def test_valid_job_passes(self):
+        validate(set_defaults(make_job(worker=2, chief=1, ps=1)))
+
+    def test_empty_replica_specs_rejected(self):
+        with pytest.raises(ValidationError, match="at least one replica"):
+            validate(TPUJob(metadata=ObjectMeta(name="x")))
+
+    def test_missing_name_rejected(self):
+        job = make_job(worker=1)
+        job.metadata.name = ""
+        with pytest.raises(ValidationError, match="metadata.name"):
+            validate(job)
+
+    def test_missing_main_container_rejected(self):
+        job = make_job(worker=1)
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].name = "other"
+        with pytest.raises(ValidationError, match="container named"):
+            validate(job)
+
+    def test_two_chiefs_rejected(self):
+        job = make_job(chief=2)
+        with pytest.raises(ValidationError, match="chief/master"):
+            validate(job)
+
+    def test_chief_and_master_rejected(self):
+        job = make_job(chief=1, master=1)
+        with pytest.raises(ValidationError, match="both Chief and Master"):
+            validate(job)
+
+    def test_negative_replicas_rejected(self):
+        job = make_job(worker=-1)
+        with pytest.raises(ValidationError, match=">= 0"):
+            validate(job)
+
+    def test_tpu_slice_needs_topology(self):
+        job = make_job(tpuslice=1)
+        with pytest.raises(ValidationError, match="tpuTopology"):
+            validate(job)
+
+    def test_tpu_slice_plus_ps_rejected(self):
+        job = make_job(tpuslice=1, ps=1)
+        job.spec.replica_specs[ReplicaType.TPU_SLICE].tpu_topology = "v5e-16"
+        with pytest.raises(ValidationError, match="PS"):
+            validate(job)
+
+    def test_all_problems_reported(self):
+        job = make_job(chief=2, tpuslice=1)
+        with pytest.raises(ValidationError) as ei:
+            validate(job)
+        assert len(ei.value.problems) == 2
+
+
+class TestTopologyParse:
+    @pytest.mark.parametrize(
+        "s,n",
+        [("v5e-16", 16), ("v5p-8", 8), ("2x4", 8), ("4x4x4", 64), ("v5litepod-4", 4)],
+    )
+    def test_ok(self, s, n):
+        assert parse_tpu_topology(s) == n
+
+    @pytest.mark.parametrize("s", ["", "v5e", "axb", "16"])
+    def test_bad(self, s):
+        with pytest.raises(ValueError):
+            parse_tpu_topology(s)
+
+
+class TestSerde:
+    def test_round_trip(self):
+        job = make_job(worker=2, chief=1)
+        job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+        job.spec.run_policy.clean_pod_policy = CleanPodPolicy.ALL
+        job.spec.run_policy.backoff_limit = 3
+        set_defaults(job)
+        d = job_to_dict(job)
+        job2 = job_from_dict(d)
+        assert job2.metadata.name == job.metadata.name
+        assert set(job2.spec.replica_specs) == set(job.spec.replica_specs)
+        assert job2.spec.success_policy is SuccessPolicy.ALL_WORKERS
+        assert job2.spec.run_policy.backoff_limit == 3
+        assert (
+            job2.spec.replica_specs[ReplicaType.WORKER].restart_policy
+            is job.spec.replica_specs[ReplicaType.WORKER].restart_policy
+        )
+        assert job_to_dict(job2) == d
+
+    def test_manifest_shape(self):
+        d = job_to_dict(set_defaults(make_job(worker=1)))
+        assert d["apiVersion"] == "tpujob.dist/v1"
+        assert d["kind"] == "TPUJob"
+        assert "Worker" in d["spec"]["tpuReplicaSpecs"]
+
+    def test_status_round_trip(self):
+        from tf_operator_tpu.api.types import (
+            JobCondition,
+            JobConditionType,
+            ReplicaStatus,
+        )
+
+        job = set_defaults(make_job(worker=2))
+        job.metadata.annotations["scheduling.tpujob.dist/group-name"] = "g1"
+        job.status.conditions.append(
+            JobCondition(type=JobConditionType.RUNNING, status=True, reason="JobRunning")
+        )
+        job.status.replica_statuses[ReplicaType.WORKER] = ReplicaStatus(active=2)
+        job.status.restart_count = 3
+        job.status.start_time = 123.0
+        job2 = job_from_dict(job_to_dict(job))
+        assert job2.status.has_condition(JobConditionType.RUNNING)
+        assert job2.status.replica_statuses[ReplicaType.WORKER].active == 2
+        assert job2.status.restart_count == 3
+        assert job2.status.start_time == 123.0
+        assert job2.metadata.uid == job.metadata.uid
+        assert job2.metadata.annotations == job.metadata.annotations
+
+    def test_accepts_tf_replica_specs_key(self):
+        """TFJob-manifest compatibility: tfReplicaSpecs is accepted."""
+
+        d = {
+            "metadata": {"name": "legacy"},
+            "spec": {
+                "tfReplicaSpecs": {
+                    "Worker": {
+                        "replicas": 2,
+                        "restartPolicy": "OnFailure",
+                        "template": {
+                            "spec": {
+                                "containers": [
+                                    {"name": "tensorflow", "command": ["python", "x.py"]}
+                                ]
+                            }
+                        },
+                    }
+                }
+            },
+        }
+        job = job_from_dict(d)
+        assert job.spec.replica_specs[ReplicaType.WORKER].replicas == 2
+        assert (
+            job.spec.replica_specs[ReplicaType.WORKER].restart_policy
+            is RestartPolicy.ON_FAILURE
+        )

@@ -1,0 +1,1 @@
+"""Spec layer: job API types, defaults, validation (SURVEY.md §2, L4)."""

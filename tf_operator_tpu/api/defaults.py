@@ -1,0 +1,80 @@
+"""Defaulting pass over a TPUJobSpec.
+
+Parity: the reference's ``SetDefaults_TFJob`` (SURVEY.md §2 "Defaults",
+expected upstream ``pkg/apis/tensorflow/v1/defaults.go``): fill replicas=1,
+default port 2222 on the main container, default restart policy, default
+clean-pod policy, and normalise replica-type spelling.
+
+TPU additions: default the job port for TPU_SLICE replicas to the
+jax.distributed coordinator port, and force gang scheduling on for any job
+with a TPU_SLICE replica (a slice is atomic hardware — partial grants do
+not exist).
+"""
+
+from __future__ import annotations
+
+from tf_operator_tpu.api.types import (
+    DEFAULT_CONTAINER_NAME,
+    DEFAULT_COORDINATOR_PORT,
+    DEFAULT_PORT,
+    DEFAULT_PORT_NAME,
+    CleanPodPolicy,
+    Container,
+    Port,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    SchedulingPolicy,
+    TPUJob,
+)
+
+#: Reference default ([U] in SURVEY.md §2: "default RestartPolicy").
+DEFAULT_RESTART_POLICY = RestartPolicy.NEVER
+#: The reference's v1 default clean-pod policy is Running (kills lingering
+#: PS replicas once the chief finishes) — SURVEY.md §2 "Common API types".
+DEFAULT_CLEAN_POD_POLICY = CleanPodPolicy.RUNNING
+
+
+def set_default_port(container: Container, port: int) -> None:
+    if container.port_named(DEFAULT_PORT_NAME) is None:
+        container.ports.append(Port(name=DEFAULT_PORT_NAME, container_port=port))
+
+
+def set_defaults_replica(rtype: ReplicaType, spec: ReplicaSpec) -> None:
+    if spec.replicas is None:
+        spec.replicas = 1
+    if spec.restart_policy is None:
+        spec.restart_policy = DEFAULT_RESTART_POLICY
+    if not spec.template.containers:
+        spec.template.containers.append(Container(name=DEFAULT_CONTAINER_NAME))
+    main = spec.template.main_container(DEFAULT_CONTAINER_NAME)
+    if main is None:
+        # Validation will reject; nothing to default onto.
+        return
+    port = DEFAULT_COORDINATOR_PORT if rtype is ReplicaType.TPU_SLICE else DEFAULT_PORT
+    set_default_port(main, port)
+
+
+def set_defaults(job: TPUJob) -> TPUJob:
+    """Mutate ``job`` in place applying all defaults; returns it for chaining."""
+
+    spec = job.spec
+    for rtype, rspec in list(spec.replica_specs.items()):
+        set_defaults_replica(rtype, rspec)
+
+    rp = spec.run_policy
+    if rp.clean_pod_policy is None:
+        rp.clean_pod_policy = DEFAULT_CLEAN_POD_POLICY
+    # backoff_limit stays None when unset: the reconciler treats None as
+    # "unlimited restarts" (reference semantics for an absent backoffLimit).
+
+    if ReplicaType.TPU_SLICE in spec.replica_specs:
+        spec.enable_gang_scheduling = True
+
+    if spec.enable_gang_scheduling:
+        if rp.scheduling_policy is None:
+            rp.scheduling_policy = SchedulingPolicy()
+        if rp.scheduling_policy.min_member is None:
+            rp.scheduling_policy.min_member = spec.total_replicas()
+
+    return job

@@ -1,0 +1,261 @@
+"""Dict (JSON/YAML) serialisation for TPUJob — the CRD-manifest surface.
+
+Parity: in the reference, the CRD schema *is* the Go struct via k8s
+codegen (SURVEY.md §2 "Generated clients"); users author YAML manifests.
+Here ``job_from_dict``/``job_to_dict`` play that role: a camelCase dict
+matching the TFJob manifest shape (apiVersion/kind/metadata/spec) round-
+trips through the typed objects.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    Container,
+    JobCondition,
+    JobConditionType,
+    ObjectMeta,
+    PodTemplateSpec,
+    Port,
+    ReplicaSpec,
+    ReplicaStatus,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    SuccessPolicy,
+    TPUJob,
+    TPUJobSpec,
+    TPUJobStatus,
+)
+
+API_VERSION = "tpujob.dist/v1"
+KIND = "TPUJob"
+
+
+def _container_from_dict(d: Dict[str, Any]) -> Container:
+    return Container(
+        name=d.get("name", "tensorflow"),
+        image=d.get("image", ""),
+        command=list(d.get("command", [])),
+        args=list(d.get("args", [])),
+        env={e["name"]: e["value"] for e in d.get("env", [])}
+        if isinstance(d.get("env"), list)
+        else dict(d.get("env", {})),
+        ports=[
+            Port(name=p.get("name", ""), container_port=int(p["containerPort"]))
+            for p in d.get("ports", [])
+        ],
+        resources=dict(d.get("resources", {})),
+        working_dir=d.get("workingDir", ""),
+    )
+
+
+def _container_to_dict(c: Container) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"name": c.name}
+    if c.image:
+        out["image"] = c.image
+    if c.command:
+        out["command"] = list(c.command)
+    if c.args:
+        out["args"] = list(c.args)
+    if c.env:
+        out["env"] = [{"name": k, "value": v} for k, v in sorted(c.env.items())]
+    if c.ports:
+        out["ports"] = [p.to_dict() for p in c.ports]
+    if c.resources:
+        out["resources"] = dict(c.resources)
+    if c.working_dir:
+        out["workingDir"] = c.working_dir
+    return out
+
+
+def _template_from_dict(d: Dict[str, Any]) -> PodTemplateSpec:
+    spec = d.get("spec", d)
+    meta = d.get("metadata", {})
+    return PodTemplateSpec(
+        containers=[_container_from_dict(c) for c in spec.get("containers", [])],
+        labels=dict(meta.get("labels", {})),
+        annotations=dict(meta.get("annotations", {})),
+        scheduler_name=spec.get("schedulerName", ""),
+        node_selector=dict(spec.get("nodeSelector", {})),
+    )
+
+
+def _template_to_dict(t: PodTemplateSpec) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"spec": {"containers": [_container_to_dict(c) for c in t.containers]}}
+    if t.labels or t.annotations:
+        out["metadata"] = {}
+        if t.labels:
+            out["metadata"]["labels"] = dict(t.labels)
+        if t.annotations:
+            out["metadata"]["annotations"] = dict(t.annotations)
+    if t.scheduler_name:
+        out["spec"]["schedulerName"] = t.scheduler_name
+    if t.node_selector:
+        out["spec"]["nodeSelector"] = t.node_selector
+    return out
+
+
+def job_from_dict(d: Dict[str, Any]) -> TPUJob:
+    meta_d = d.get("metadata", {})
+    spec_d = d.get("spec", {})
+    rp_d = spec_d.get("runPolicy", {})
+    sp_d = rp_d.get("schedulingPolicy")
+
+    replica_specs: Dict[ReplicaType, ReplicaSpec] = {}
+    for tname, rs in spec_d.get("tpuReplicaSpecs", spec_d.get("tfReplicaSpecs", {})).items():
+        rtype = ReplicaType.from_str(tname)
+        replica_specs[rtype] = ReplicaSpec(
+            replicas=rs.get("replicas"),
+            template=_template_from_dict(rs.get("template", {})),
+            restart_policy=RestartPolicy(rs["restartPolicy"]) if rs.get("restartPolicy") else None,
+            tpu_topology=rs.get("tpuTopology", ""),
+        )
+
+    run_policy = RunPolicy(
+        clean_pod_policy=CleanPodPolicy(rp_d["cleanPodPolicy"]) if rp_d.get("cleanPodPolicy") else None,
+        ttl_seconds_after_finished=rp_d.get("ttlSecondsAfterFinished"),
+        active_deadline_seconds=rp_d.get("activeDeadlineSeconds"),
+        backoff_limit=rp_d.get("backoffLimit"),
+        scheduling_policy=SchedulingPolicy(
+            min_member=sp_d.get("minMember"),
+            queue=sp_d.get("queue", ""),
+            priority_class=sp_d.get("priorityClass", ""),
+        )
+        if sp_d is not None
+        else None,
+    )
+
+    return TPUJob(
+        metadata=ObjectMeta(
+            name=meta_d.get("name", ""),
+            namespace=meta_d.get("namespace", "default"),
+            uid=meta_d.get("uid", ""),
+            labels=dict(meta_d.get("labels", {})),
+            annotations=dict(meta_d.get("annotations", {})),
+        ),
+        spec=TPUJobSpec(
+            replica_specs=replica_specs,
+            run_policy=run_policy,
+            success_policy=SuccessPolicy(spec_d.get("successPolicy", "")),
+            enable_gang_scheduling=bool(spec_d.get("enableGangScheduling", False)),
+            enable_dynamic_worker=bool(spec_d.get("enableDynamicWorker", False)),
+        ),
+        status=status_from_dict(d["status"]) if "status" in d else TPUJobStatus(),
+    )
+
+
+def job_to_dict(job: TPUJob) -> Dict[str, Any]:
+    spec = job.spec
+    rp = spec.run_policy
+    spec_d: Dict[str, Any] = {
+        "tpuReplicaSpecs": {
+            rtype.value: _replica_spec_to_dict(rs)
+            for rtype, rs in ((t, spec.replica_specs[t]) for t in spec.ordered_types())
+        }
+    }
+    rp_d: Dict[str, Any] = {}
+    if rp.clean_pod_policy is not None:
+        rp_d["cleanPodPolicy"] = rp.clean_pod_policy.value
+    if rp.ttl_seconds_after_finished is not None:
+        rp_d["ttlSecondsAfterFinished"] = rp.ttl_seconds_after_finished
+    if rp.active_deadline_seconds is not None:
+        rp_d["activeDeadlineSeconds"] = rp.active_deadline_seconds
+    if rp.backoff_limit is not None:
+        rp_d["backoffLimit"] = rp.backoff_limit
+    if rp.scheduling_policy is not None:
+        sp: Dict[str, Any] = {}
+        if rp.scheduling_policy.min_member is not None:
+            sp["minMember"] = rp.scheduling_policy.min_member
+        if rp.scheduling_policy.queue:
+            sp["queue"] = rp.scheduling_policy.queue
+        if rp.scheduling_policy.priority_class:
+            sp["priorityClass"] = rp.scheduling_policy.priority_class
+        rp_d["schedulingPolicy"] = sp
+    if rp_d:
+        spec_d["runPolicy"] = rp_d
+    if spec.success_policy is not SuccessPolicy.DEFAULT:
+        spec_d["successPolicy"] = spec.success_policy.value
+    if spec.enable_gang_scheduling:
+        spec_d["enableGangScheduling"] = True
+    if spec.enable_dynamic_worker:
+        spec_d["enableDynamicWorker"] = True
+
+    out: Dict[str, Any] = {
+        "apiVersion": API_VERSION,
+        "kind": KIND,
+        "metadata": {"name": job.metadata.name, "namespace": job.metadata.namespace},
+        "spec": spec_d,
+    }
+    if job.metadata.labels:
+        out["metadata"]["labels"] = dict(job.metadata.labels)
+    if job.metadata.annotations:
+        out["metadata"]["annotations"] = dict(job.metadata.annotations)
+    if job.metadata.uid:
+        out["metadata"]["uid"] = job.metadata.uid
+    if job.status.conditions or job.status.replica_statuses:
+        out["status"] = status_to_dict(job.status)
+    return out
+
+
+def _replica_spec_to_dict(rs: ReplicaSpec) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"template": _template_to_dict(rs.template)}
+    if rs.replicas is not None:
+        out["replicas"] = rs.replicas
+    if rs.restart_policy is not None:
+        out["restartPolicy"] = rs.restart_policy.value
+    if rs.tpu_topology:
+        out["tpuTopology"] = rs.tpu_topology
+    return out
+
+
+def status_to_dict(st: TPUJobStatus) -> Dict[str, Any]:
+    return {
+        "conditions": [
+            {
+                "type": c.type.value,
+                "status": "True" if c.status else "False",
+                "reason": c.reason,
+                "message": c.message,
+                "lastUpdateTime": c.last_update_time,
+                "lastTransitionTime": c.last_transition_time,
+            }
+            for c in st.conditions
+        ],
+        "replicaStatuses": {
+            rt.value: {"active": rs.active, "succeeded": rs.succeeded, "failed": rs.failed}
+            for rt, rs in st.replica_statuses.items()
+        },
+        "startTime": st.start_time,
+        "completionTime": st.completion_time,
+        "restartCount": st.restart_count,
+    }
+
+
+def status_from_dict(d: Dict[str, Any]) -> TPUJobStatus:
+    st = TPUJobStatus(
+        start_time=d.get("startTime"),
+        completion_time=d.get("completionTime"),
+        restart_count=d.get("restartCount", 0),
+    )
+    for c in d.get("conditions", []):
+        st.conditions.append(
+            JobCondition(
+                type=JobConditionType(c["type"]),
+                status=c.get("status") in (True, "True"),
+                reason=c.get("reason", ""),
+                message=c.get("message", ""),
+                last_update_time=c.get("lastUpdateTime", 0.0),
+                last_transition_time=c.get("lastTransitionTime", 0.0),
+            )
+        )
+    for tname, rs in d.get("replicaStatuses", {}).items():
+        st.replica_statuses[ReplicaType.from_str(tname)] = ReplicaStatus(
+            active=rs.get("active", 0),
+            succeeded=rs.get("succeeded", 0),
+            failed=rs.get("failed", 0),
+        )
+    return st

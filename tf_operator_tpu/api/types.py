@@ -1,0 +1,331 @@
+"""TPUJob API types — the declarative job schema.
+
+Parity: the reference's CRD schema (SURVEY.md §2 "TFJob API types",
+expected upstream ``pkg/apis/tensorflow/v1/types.go`` and the shared
+``pkg/apis/common/v1/types.go``).  The reference expresses these as Go
+structs consumed by Kubernetes API machinery; here they are frozen-ish
+dataclasses consumed by the reconciler and serialisable to/from plain
+dicts (the CRD-yaml equivalent).
+
+TPU-first addition: ``ReplicaType.TPU_SLICE`` — a replica type whose unit
+of allocation is an *atomic TPU slice* (e.g. v5e-16): it either exists
+whole or not at all, which is the TPU-native generalisation of the
+reference's gang-scheduled pod groups (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class ReplicaType(str, enum.Enum):
+    """Roles a replica can play in a distributed training job.
+
+    Mirrors the reference's TFReplicaType consts (chief/master/ps/worker/
+    evaluator, SURVEY.md §2) plus the TPU-native ``TPU_SLICE``.
+    """
+
+    CHIEF = "Chief"
+    MASTER = "Master"  # legacy alias for CHIEF in the reference API
+    PS = "PS"
+    WORKER = "Worker"
+    EVALUATOR = "Evaluator"
+    TPU_SLICE = "TPUSlice"
+
+    @property
+    def lower_name(self) -> str:
+        """Lowercased role name for DNS-safe pod/service names."""
+        return self.value.lower()
+
+    @classmethod
+    def from_str(cls, s: str) -> "ReplicaType":
+        for t in cls:
+            if t.value.lower() == s.lower():
+                return t
+        raise ValueError(f"unknown replica type: {s!r}")
+
+
+#: Replica types that count as "the chief" for success-policy purposes.
+CHIEF_LIKE: Tuple[ReplicaType, ...] = (ReplicaType.CHIEF, ReplicaType.MASTER)
+
+#: Deterministic ordering for reconcile loops and cluster-spec generation
+#: (the reference iterates replica types sorted; SURVEY.md §3.2).
+REPLICA_TYPE_ORDER: Tuple[ReplicaType, ...] = (
+    ReplicaType.CHIEF,
+    ReplicaType.MASTER,
+    ReplicaType.PS,
+    ReplicaType.WORKER,
+    ReplicaType.EVALUATOR,
+    ReplicaType.TPU_SLICE,
+)
+
+
+class RestartPolicy(str, enum.Enum):
+    """Per-replica restart policy (SURVEY.md §2 "Common API types")."""
+
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    EXIT_CODE = "ExitCode"
+    NEVER = "Never"
+
+
+class CleanPodPolicy(str, enum.Enum):
+    """What to delete when the job reaches a terminal state."""
+
+    RUNNING = "Running"  # delete only still-running replicas (kills PS)
+    ALL = "All"
+    NONE = "None"
+
+
+class SuccessPolicy(str, enum.Enum):
+    """When a job counts as Succeeded (SURVEY.md §2 "TFJob API types").
+
+    DEFAULT: the chief (or worker-0 if no chief) exiting 0 ends the job.
+    ALL_WORKERS: every worker must succeed.
+    """
+
+    DEFAULT = ""
+    ALL_WORKERS = "AllWorkers"
+
+
+class JobConditionType(str, enum.Enum):
+    """Job condition types (SURVEY.md §2 "Common API types")."""
+
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class PodPhase(str, enum.Enum):
+    """Replica ("pod") lifecycle phases, as surfaced by cluster backends."""
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+# ---------------------------------------------------------------------------
+# Spec objects
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Port:
+    name: str
+    container_port: int
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "containerPort": self.container_port}
+
+
+@dataclass
+class Container:
+    """The command a replica runs — the pod-template core.
+
+    The reference requires a container literally named ``tensorflow``
+    (SURVEY.md §2 "Validation"); we keep that as the default name for
+    spec-level compatibility while accepting any name the validator is
+    configured for.
+    """
+
+    name: str = "tensorflow"
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    ports: List[Port] = field(default_factory=list)
+    resources: Dict[str, Any] = field(default_factory=dict)
+    working_dir: str = ""
+
+    def port_named(self, name: str) -> Optional[Port]:
+        for p in self.ports:
+            if p.name == name:
+                return p
+        return None
+
+
+@dataclass
+class PodTemplateSpec:
+    """Template stamped out once per replica index."""
+
+    containers: List[Container] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    scheduler_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+
+    def main_container(self, name: str = "tensorflow") -> Optional[Container]:
+        for c in self.containers:
+            if c.name == name:
+                return c
+        return None
+
+
+@dataclass
+class SchedulingPolicy:
+    """Gang-scheduling knobs (SURVEY.md §2 "Generic job-controller runtime").
+
+    ``min_member`` defaults to the job's total replica count at defaulting
+    time.  For TPU_SLICE replicas, gang admission is mandatory: a slice is
+    atomic hardware.
+    """
+
+    min_member: Optional[int] = None
+    queue: str = ""
+    priority_class: str = ""
+
+
+@dataclass
+class RunPolicy:
+    clean_pod_policy: Optional[CleanPodPolicy] = None
+    ttl_seconds_after_finished: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: Optional[int] = None
+    scheduling_policy: Optional[SchedulingPolicy] = None
+
+
+@dataclass
+class ReplicaSpec:
+    replicas: Optional[int] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    restart_policy: Optional[RestartPolicy] = None
+    #: TPU_SLICE only: accelerator topology of the atomic slice, e.g.
+    #: "v5e-16".  Informs the gang allocator's chip accounting.
+    tpu_topology: str = ""
+
+
+@dataclass
+class TPUJobSpec:
+    replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    success_policy: SuccessPolicy = SuccessPolicy.DEFAULT
+    #: enable gang (all-or-nothing) scheduling for this job
+    enable_gang_scheduling: bool = False
+    #: later v1.x scale-in/out for workers (SURVEY.md §2b "Elastic")
+    enable_dynamic_worker: bool = False
+
+    def total_replicas(self) -> int:
+        return sum(int(rs.replicas or 0) for rs in self.replica_specs.values())
+
+    def ordered_types(self) -> List[ReplicaType]:
+        return [t for t in REPLICA_TYPE_ORDER if t in self.replica_specs]
+
+
+# ---------------------------------------------------------------------------
+# Status objects
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class JobCondition:
+    type: JobConditionType
+    status: bool
+    reason: str = ""
+    message: str = ""
+    last_update_time: float = 0.0
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class ReplicaStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class TPUJobStatus:
+    conditions: List[JobCondition] = field(default_factory=list)
+    replica_statuses: Dict[ReplicaType, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    #: operator-side restart count, compared against backoff_limit
+    restart_count: int = 0
+
+    def condition(self, ctype: JobConditionType) -> Optional[JobCondition]:
+        for c in self.conditions:
+            if c.type == ctype:
+                return c
+        return None
+
+    def has_condition(self, ctype: JobConditionType, status: bool = True) -> bool:
+        c = self.condition(ctype)
+        return c is not None and c.status == status
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    creation_time: float = field(default_factory=time.time)
+    deletion_time: Optional[float] = None
+    resource_version: int = 0
+    owner_uid: str = ""  # ownerRef equivalent: the owning job's uid
+
+
+@dataclass
+class TPUJob:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TPUJobSpec = field(default_factory=TPUJobSpec)
+    status: TPUJobStatus = field(default_factory=TPUJobStatus)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def deepcopy(self) -> "TPUJob":
+        return copy.deepcopy(self)
+
+    def is_terminal(self) -> bool:
+        return self.status.has_condition(JobConditionType.SUCCEEDED) or self.status.has_condition(
+            JobConditionType.FAILED
+        )
+
+
+# ---------------------------------------------------------------------------
+# Constants (SURVEY.md §2: default port 2222, container name "tensorflow")
+# ---------------------------------------------------------------------------
+
+DEFAULT_CONTAINER_NAME = "tensorflow"
+DEFAULT_PORT_NAME = "tfjob-port"
+DEFAULT_PORT = 2222
+#: jax.distributed default coordinator port (SURVEY.md §2c)
+DEFAULT_COORDINATOR_PORT = 8476
+
+#: Label keys stamped on every replica pod (SURVEY.md §3.2).  The reference
+#: used group-prefixed keys; these are our canonical equivalents.
+LABEL_JOB_NAME = "tpujob.dist/job-name"
+LABEL_REPLICA_TYPE = "tpujob.dist/replica-type"
+LABEL_REPLICA_INDEX = "tpujob.dist/replica-index"
+LABEL_GROUP_NAME = "tpujob.dist/group-name"
+#: Annotation marking gang membership (reference: scheduling.k8s.io/group-name)
+ANNOTATION_GANG_GROUP = "scheduling.tpujob.dist/group-name"
+
+
+def replica_name(job_name: str, rtype: ReplicaType, index: int) -> str:
+    """Stable replica/pod/service name ``<job>-<type>-<idx>``.
+
+    This is the naming contract the cluster-spec generator relies on for
+    peer discovery (SURVEY.md §2 "TF_CONFIG generation").
+    """
+
+    return f"{job_name}-{rtype.lower_name}-{index}"
+
+
+def replica_labels(job_name: str, rtype: ReplicaType, index: int) -> Dict[str, str]:
+    return {
+        LABEL_JOB_NAME: job_name,
+        LABEL_REPLICA_TYPE: rtype.lower_name,
+        LABEL_REPLICA_INDEX: str(index),
+    }

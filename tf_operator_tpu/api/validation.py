@@ -1,0 +1,106 @@
+"""Spec validation.
+
+Parity: the reference's ``ValidateV1TFJobSpec`` (SURVEY.md §2 "Validation",
+expected upstream ``pkg/apis/tensorflow/validation/validation.go``):
+reject specs with no replicas, unknown replica types, a missing main
+container, or more than one chief/master.
+
+TPU additions: TPU_SLICE replicas must carry a parseable topology and may
+not coexist with PS replicas (parameter-server traffic has no ICI analogue;
+SURVEY.md §2b row "Parameter-server").
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tf_operator_tpu.api.types import (
+    CHIEF_LIKE,
+    DEFAULT_CONTAINER_NAME,
+    ReplicaType,
+    TPUJob,
+)
+
+
+class ValidationError(ValueError):
+    """Raised when a TPUJob spec is rejected.  Carries every problem found."""
+
+    def __init__(self, problems: List[str]):
+        self.problems = problems
+        super().__init__("; ".join(problems))
+
+
+def parse_tpu_topology(topology: str) -> int:
+    """Return the chip count of a slice topology string.
+
+    Accepts "v5e-16" / "v5p-8" style (generation-chips) and "2x4" /
+    "4x4x4" style (mesh dims).  Raises ValueError otherwise.
+    """
+
+    t = topology.strip().lower()
+    if not t:
+        raise ValueError("empty topology")
+    if "x" in t and all(p.isdigit() for p in t.split("x")):
+        n = 1
+        for p in t.split("x"):
+            n *= int(p)
+        return n
+    if "-" in t:
+        gen, _, chips = t.rpartition("-")
+        if gen and chips.isdigit():
+            return int(chips)
+    raise ValueError(f"unparseable TPU topology {topology!r}")
+
+
+def validate(job: TPUJob) -> None:
+    """Raise ValidationError if the spec is invalid.  No-op otherwise."""
+
+    problems: List[str] = []
+    spec = job.spec
+
+    if not job.metadata.name:
+        problems.append("metadata.name is required")
+
+    if not spec.replica_specs:
+        problems.append("spec.replicaSpecs must contain at least one replica type")
+
+    for rtype, rspec in spec.replica_specs.items():
+        if not isinstance(rtype, ReplicaType):
+            problems.append(f"unknown replica type {rtype!r}")
+            continue
+        prefix = f"replicaSpecs[{rtype.value}]"
+        if rspec.replicas is not None and rspec.replicas < 0:
+            problems.append(f"{prefix}.replicas must be >= 0")
+        main = rspec.template.main_container(DEFAULT_CONTAINER_NAME)
+        if main is None:
+            problems.append(
+                f"{prefix}: template must contain a container named "
+                f"{DEFAULT_CONTAINER_NAME!r}"
+            )
+        elif not (main.command or main.args or main.image):
+            problems.append(f"{prefix}: main container needs a command, args, or image")
+        if rtype in CHIEF_LIKE:
+            count = 1 if rspec.replicas is None else rspec.replicas
+            if count > 1:
+                problems.append(f"{prefix}.replicas must be <= 1 for chief/master")
+        if rtype is ReplicaType.TPU_SLICE:
+            try:
+                parse_tpu_topology(rspec.tpu_topology)
+            except ValueError as e:
+                problems.append(f"{prefix}.tpuTopology: {e}")
+
+    if ReplicaType.CHIEF in spec.replica_specs and ReplicaType.MASTER in spec.replica_specs:
+        problems.append("spec may not contain both Chief and Master replicas")
+
+    if (
+        ReplicaType.TPU_SLICE in spec.replica_specs
+        and ReplicaType.PS in spec.replica_specs
+    ):
+        problems.append(
+            "TPUSlice replicas cannot be combined with PS replicas: "
+            "parameter-server traffic has no ICI analogue (use FSDP-style "
+            "sharding instead; SURVEY.md §2b)"
+        )
+
+    if problems:
+        raise ValidationError(problems)
