@@ -99,7 +99,8 @@ class TestDefaults:
         job.spec.replica_specs[ReplicaType.TPU_SLICE].tpu_topology = "v5e-16"
         set_defaults(job)
         assert job.spec.enable_gang_scheduling
-        assert job.spec.run_policy.scheduling_policy.min_member == 2
+        # min_member stays None (resolved to current totals at sync time)
+        assert job.spec.run_policy.scheduling_policy.min_member is None
         port = (
             job.spec.replica_specs[ReplicaType.TPU_SLICE]
             .template.main_container()
@@ -107,11 +108,12 @@ class TestDefaults:
         )
         assert port.container_port == DEFAULT_COORDINATOR_PORT
 
-    def test_gang_min_member_defaults_to_total(self):
+    def test_gang_scheduling_policy_created(self):
         job = make_job(worker=4, chief=1)
         job.spec.enable_gang_scheduling = True
         set_defaults(job)
-        assert job.spec.run_policy.scheduling_policy.min_member == 5
+        assert job.spec.run_policy.scheduling_policy is not None
+        assert job.spec.run_policy.scheduling_policy.min_member is None
 
 
 class TestValidation:

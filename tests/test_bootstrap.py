@@ -1,0 +1,130 @@
+"""Golden tests for bootstrap env generation (SURVEY.md §7 step 4):
+hand-written expected TF_CONFIG JSON / TPU env compared byte-for-byte —
+the crown-jewel semantics."""
+
+import json
+
+from tests.testutil import new_job
+from tf_operator_tpu.api.defaults import set_defaults
+from tf_operator_tpu.api.types import ReplicaType
+from tf_operator_tpu.bootstrap.cluster_spec import (
+    coordinator_replica,
+    gen_cluster_spec,
+    gen_tf_config,
+)
+from tf_operator_tpu.bootstrap.tpu_env import gen_tpu_env, worker_env
+
+
+def mkjob(**kw):
+    return set_defaults(new_job(**kw))
+
+
+class TestTFConfig:
+    def test_golden_ps_worker_chief(self):
+        job = mkjob(chief=1, ps=2, worker=2)
+        got = json.loads(gen_tf_config(job, ReplicaType.WORKER, 1))
+        expected = {
+            "cluster": {
+                "chief": ["job-chief-0.default.svc:2222"],
+                "ps": ["job-ps-0.default.svc:2222", "job-ps-1.default.svc:2222"],
+                "worker": [
+                    "job-worker-0.default.svc:2222",
+                    "job-worker-1.default.svc:2222",
+                ],
+            },
+            "task": {"type": "worker", "index": 1},
+            "environment": "cloud",
+        }
+        assert got == expected
+
+    def test_golden_sparse_worker(self):
+        job = mkjob(ps=1, worker=3)
+        got = json.loads(gen_tf_config(job, ReplicaType.WORKER, 2, sparse=True))
+        assert got["cluster"]["worker"] == ["job-worker-2.default.svc:2222"]
+        assert got["cluster"]["ps"] == ["job-ps-0.default.svc:2222"]
+        assert got["task"] == {"type": "worker", "index": 0}
+
+    def test_deterministic_serialisation(self):
+        job = mkjob(chief=1, worker=1)
+        assert gen_tf_config(job, ReplicaType.WORKER, 0) == gen_tf_config(
+            job, ReplicaType.WORKER, 0
+        )
+
+    def test_custom_port_respected(self):
+        from tf_operator_tpu.api.types import DEFAULT_PORT_NAME
+
+        job = new_job(worker=2)
+        main = job.spec.replica_specs[ReplicaType.WORKER].template.containers[0]
+        from tf_operator_tpu.api.types import Port
+
+        main.ports.append(Port(name=DEFAULT_PORT_NAME, container_port=7777))
+        set_defaults(job)
+        spec = gen_cluster_spec(job)
+        assert spec["worker"] == [
+            "job-worker-0.default.svc:7777",
+            "job-worker-1.default.svc:7777",
+        ]
+
+
+class TestCoordinatorSelection:
+    def test_chief_wins(self):
+        assert coordinator_replica(mkjob(chief=1, worker=4)) is ReplicaType.CHIEF
+
+    def test_slice_beats_worker(self):
+        job = mkjob(worker=2, tpu_slice=1)
+        assert coordinator_replica(job) is ReplicaType.TPU_SLICE
+
+    def test_worker_fallback(self):
+        assert coordinator_replica(mkjob(worker=2)) is ReplicaType.WORKER
+
+
+class TestTPUEnv:
+    def test_golden_worker_only_job(self):
+        job = mkjob(worker=2)
+        env = gen_tpu_env(job, ReplicaType.WORKER, 1)
+        assert env == {
+            "TPUJOB_NAME": "job",
+            "TPUJOB_COORDINATOR_ADDRESS": "job-worker-0.default.svc:8476",
+            "TPUJOB_NUM_PROCESSES": "2",
+            "TPUJOB_PROCESS_ID": "1",
+            "TPUJOB_REPLICA_TYPE": "worker",
+            "TPUJOB_REPLICA_INDEX": "1",
+        }
+
+    def test_process_ids_stable_and_coordinator_first(self):
+        job = mkjob(chief=1, ps=1, worker=2)
+        ids = {}
+        for rtype, idx in [
+            (ReplicaType.CHIEF, 0),
+            (ReplicaType.PS, 0),
+            (ReplicaType.WORKER, 0),
+            (ReplicaType.WORKER, 1),
+        ]:
+            ids[(rtype, idx)] = int(gen_tpu_env(job, rtype, idx)["TPUJOB_PROCESS_ID"])
+        assert ids[(ReplicaType.CHIEF, 0)] == 0
+        assert len(set(ids.values())) == 4  # all distinct
+        assert gen_tpu_env(job, ReplicaType.CHIEF, 0)["TPUJOB_NUM_PROCESSES"] == "4"
+
+    def test_single_slice_has_no_megascale(self):
+        job = mkjob(tpu_slice=1, tpu_topology="v5e-16")
+        env = gen_tpu_env(job, ReplicaType.TPU_SLICE, 0)
+        assert "MEGASCALE_NUM_SLICES" not in env
+        assert env["TPU_WORKER_ID"] == "0"
+
+    def test_multislice_golden(self):
+        job = mkjob(tpu_slice=2, tpu_topology="v5e-16")
+        env = gen_tpu_env(job, ReplicaType.TPU_SLICE, 1)
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "job-tpuslice-0.default.svc"
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+        # intra-slice vars must describe only THIS slice's hosts — naming
+        # other slices would contradict the MEGASCALE topology
+        assert env["TPU_WORKER_ID"] == "0"
+        assert env["TPU_WORKER_HOSTNAMES"] == "job-tpuslice-1.default.svc"
+
+    def test_worker_env_combines_both(self):
+        job = mkjob(chief=1, worker=1)
+        env = worker_env(job, ReplicaType.WORKER, 0)
+        assert "TF_CONFIG" in env and "TPUJOB_PROCESS_ID" in env
+        env2 = worker_env(job, ReplicaType.WORKER, 0, tf_config=False)
+        assert "TF_CONFIG" not in env2

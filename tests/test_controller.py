@@ -1,0 +1,436 @@
+"""Tier-1 reconciler tests against the fake cluster (SURVEY.md §4).
+
+The cluster is a data structure: jobs are submitted, the queue is drained
+inline, pod phases are fabricated, and assertions check created/deleted
+pods, injected env, and condition transitions — mirroring the reference's
+fake-clientset controller tests.
+"""
+
+import json
+
+import pytest
+
+from tests.testutil import harness, new_job, pod_name
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    JobConditionType,
+    PodPhase,
+    ReplicaType,
+    RestartPolicy,
+    SuccessPolicy,
+)
+from tf_operator_tpu.controller.reconciler import ReconcilerConfig
+
+
+def submit(store, controller, job):
+    stored = store.create(job)
+    controller.sync_until_quiet()
+    return stored
+
+
+def get_status(store, job):
+    return store.get(job.metadata.namespace, job.metadata.name).status
+
+
+class TestHappyPath:
+    def test_pods_and_services_created(self):
+        store, backend, c = harness()
+        job = submit(store, c, new_job(chief=1, ps=2, worker=4))
+        assert len(backend.created_pods) == 7
+        assert len(backend.created_services) == 7
+        pod = backend.get_pod("default", "job-worker-2")
+        assert pod is not None
+        assert pod.replica_type is ReplicaType.WORKER
+        assert pod.replica_index == 2
+        assert pod.metadata.owner_uid == job.metadata.uid
+
+    def test_created_condition_and_start_time(self):
+        store, backend, c = harness()
+        job = submit(store, c, new_job(worker=1))
+        st = get_status(store, job)
+        assert st.has_condition(JobConditionType.CREATED)
+        assert st.start_time is not None
+
+    def test_running_then_succeeded_with_chief(self):
+        store, backend, c = harness()
+        job = submit(store, c, new_job(chief=1, worker=2))
+        backend.run_all("default")
+        c.sync_until_quiet()
+        st = get_status(store, job)
+        assert st.has_condition(JobConditionType.RUNNING)
+        assert st.replica_statuses[ReplicaType.WORKER].active == 2
+
+        backend.succeed_pod("default", "job-chief-0")
+        c.sync_until_quiet()
+        st = get_status(store, job)
+        assert st.has_condition(JobConditionType.SUCCEEDED)
+        assert not st.has_condition(JobConditionType.RUNNING)
+        assert st.completion_time is not None
+
+    def test_clean_pod_policy_running_deletes_workers(self):
+        store, backend, c = harness()
+        submit(store, c, new_job(chief=1, worker=2))
+        backend.run_all("default")
+        c.sync_until_quiet()
+        backend.succeed_pod("default", "job-chief-0")
+        c.sync_until_quiet()
+        # default CleanPodPolicy=Running: still-running workers deleted
+        assert "default/job-worker-0" in backend.deleted_pods
+        assert "default/job-worker-1" in backend.deleted_pods
+        # chief already terminal: kept
+        assert "default/job-chief-0" not in backend.deleted_pods
+
+    def test_clean_pod_policy_none_keeps_everything(self):
+        store, backend, c = harness()
+        job = new_job(chief=1, worker=1)
+        job.spec.run_policy.clean_pod_policy = CleanPodPolicy.NONE
+        submit(store, c, job)
+        backend.run_all("default")
+        backend.succeed_pod("default", "job-chief-0")
+        c.sync_until_quiet()
+        assert backend.deleted_pods == []
+
+    def test_clean_pod_policy_all(self):
+        store, backend, c = harness()
+        job = new_job(chief=1, worker=1)
+        job.spec.run_policy.clean_pod_policy = CleanPodPolicy.ALL
+        submit(store, c, job)
+        backend.run_all("default")
+        backend.succeed_pod("default", "job-worker-0")
+        backend.succeed_pod("default", "job-chief-0")
+        c.sync_until_quiet()
+        assert "default/job-chief-0" in backend.deleted_pods
+        assert "default/job-worker-0" in backend.deleted_pods
+
+
+class TestEnvInjection:
+    def test_tf_config_content(self):
+        store, backend, c = harness()
+        submit(store, c, new_job(chief=1, ps=1, worker=2))
+        pod = backend.get_pod("default", "job-worker-1")
+        cfg = json.loads(pod.main_container().env["TF_CONFIG"])
+        assert cfg["task"] == {"type": "worker", "index": 1}
+        assert cfg["cluster"]["chief"] == ["job-chief-0.default.svc:2222"]
+        assert cfg["cluster"]["ps"] == ["job-ps-0.default.svc:2222"]
+        assert cfg["cluster"]["worker"] == [
+            "job-worker-0.default.svc:2222",
+            "job-worker-1.default.svc:2222",
+        ]
+        assert cfg["environment"] == "cloud"
+
+    def test_tpu_env_coordinator_and_process_ids(self):
+        store, backend, c = harness()
+        submit(store, c, new_job(chief=1, worker=2))
+        # chief is process 0; workers follow
+        env0 = backend.get_pod("default", "job-chief-0").main_container().env
+        env2 = backend.get_pod("default", "job-worker-1").main_container().env
+        assert env0["TPUJOB_PROCESS_ID"] == "0"
+        assert env2["TPUJOB_PROCESS_ID"] == "2"
+        assert env0["TPUJOB_NUM_PROCESSES"] == "3"
+        assert env2["TPUJOB_COORDINATOR_ADDRESS"] == "job-chief-0.default.svc:8476"
+
+    def test_user_env_wins(self):
+        store, backend, c = harness()
+        job = new_job(worker=1)
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].env = {
+            "TF_CONFIG": "user-override"
+        }
+        submit(store, c, job)
+        pod = backend.get_pod("default", "job-worker-0")
+        assert pod.main_container().env["TF_CONFIG"] == "user-override"
+
+    def test_multislice_megascale_env(self):
+        store, backend, c = harness()
+        submit(store, c, new_job(tpu_slice=2, tpu_topology="v5e-16"))
+        env = backend.get_pod("default", "job-tpuslice-1").main_container().env
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+        assert env["TPU_WORKER_ID"] == "0"
+        assert env["TPU_WORKER_HOSTNAMES"] == "job-tpuslice-1.default.svc"
+
+
+class TestSuccessPolicies:
+    def test_worker0_success_default_policy(self):
+        store, backend, c = harness()
+        job = submit(store, c, new_job(worker=3))
+        backend.run_all("default")
+        backend.succeed_pod("default", "job-worker-0")
+        c.sync_until_quiet()
+        assert get_status(store, job).has_condition(JobConditionType.SUCCEEDED)
+
+    def test_worker1_success_does_not_finish_default_policy(self):
+        store, backend, c = harness()
+        job = submit(store, c, new_job(worker=3))
+        backend.run_all("default")
+        backend.succeed_pod("default", "job-worker-1")
+        c.sync_until_quiet()
+        assert not get_status(store, job).has_condition(JobConditionType.SUCCEEDED)
+
+    def test_all_workers_policy(self):
+        store, backend, c = harness()
+        job = new_job(worker=2)
+        job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+        submit(store, c, job)
+        backend.run_all("default")
+        backend.succeed_pod("default", "job-worker-0")
+        c.sync_until_quiet()
+        assert not get_status(store, job).has_condition(JobConditionType.SUCCEEDED)
+        backend.succeed_pod("default", "job-worker-1")
+        c.sync_until_quiet()
+        assert get_status(store, job).has_condition(JobConditionType.SUCCEEDED)
+
+
+class TestRestartPolicies:
+    def test_never_policy_fails_job(self):
+        store, backend, c = harness()
+        job = submit(store, c, new_job(worker=2, restart_policy=RestartPolicy.NEVER))
+        backend.run_all("default")
+        backend.fail_pod("default", "job-worker-1", exit_code=1)
+        c.sync_until_quiet()
+        st = get_status(store, job)
+        assert st.has_condition(JobConditionType.FAILED)
+        assert st.condition(JobConditionType.FAILED).reason == "ReplicaFailed"
+
+    def test_on_failure_restarts(self):
+        store, backend, c = harness()
+        job = submit(store, c, new_job(worker=1, restart_policy=RestartPolicy.ON_FAILURE))
+        backend.run_all("default")
+        backend.fail_pod("default", "job-worker-0", exit_code=1)
+        c.sync_until_quiet()
+        st = get_status(store, job)
+        assert not st.has_condition(JobConditionType.FAILED)
+        assert st.restart_count == 1
+        # pod was deleted and recreated with the same name
+        assert backend.deleted_pods.count("default/job-worker-0") == 1
+        assert backend.created_pods.count("default/job-worker-0") == 2
+
+    def test_exit_code_retryable(self):
+        store, backend, c = harness()
+        job = submit(store, c, new_job(worker=1, restart_policy=RestartPolicy.EXIT_CODE))
+        backend.run_all("default")
+        backend.fail_pod("default", "job-worker-0", exit_code=137)  # SIGKILL
+        c.sync_until_quiet()
+        st = get_status(store, job)
+        assert not st.has_condition(JobConditionType.FAILED)
+        assert st.restart_count == 1
+
+    def test_exit_code_permanent(self):
+        store, backend, c = harness()
+        job = submit(store, c, new_job(worker=1, restart_policy=RestartPolicy.EXIT_CODE))
+        backend.run_all("default")
+        backend.fail_pod("default", "job-worker-0", exit_code=1)
+        c.sync_until_quiet()
+        assert get_status(store, job).has_condition(JobConditionType.FAILED)
+
+    def test_backoff_limit_exceeded(self):
+        store, backend, c = harness()
+        job = new_job(worker=1, restart_policy=RestartPolicy.ON_FAILURE)
+        job.spec.run_policy.backoff_limit = 2
+        job = submit(store, c, job)
+        for _ in range(2):
+            backend.run_all("default")
+            backend.fail_pod("default", "job-worker-0", exit_code=1)
+            c.sync_until_quiet()
+        st = get_status(store, job)
+        assert not st.has_condition(JobConditionType.FAILED)
+        assert st.restart_count == 2
+        backend.run_all("default")
+        backend.fail_pod("default", "job-worker-0", exit_code=1)
+        c.sync_until_quiet()
+        st = get_status(store, job)
+        assert st.has_condition(JobConditionType.FAILED)
+        assert st.condition(JobConditionType.FAILED).reason == "BackoffLimitExceeded"
+
+    def test_restarting_condition_set(self):
+        store, backend, c = harness()
+        job = submit(store, c, new_job(worker=2, restart_policy=RestartPolicy.ON_FAILURE))
+        backend.run_all("default")
+        backend.fail_pod("default", "job-worker-0", exit_code=1)
+        c.sync_until_quiet()
+        st = get_status(store, job)
+        # Restarting was set at some point during the chain; after the
+        # replacement pod lands the job may be Running again
+        types = [cond.type for cond in st.conditions]
+        assert JobConditionType.RESTARTING in types
+
+
+class TestDeadline:
+    def test_active_deadline_fails_job(self, monkeypatch):
+        store, backend, c = harness()
+        job = new_job(worker=1)
+        job.spec.run_policy.active_deadline_seconds = 60
+        job = submit(store, c, job)
+        # time-travel: pretend the job started 61s ago
+        st = get_status(store, job)
+        st.start_time -= 61
+        store.update_status("default", "job", st)
+        c.sync_until_quiet()
+        st = get_status(store, job)
+        assert st.has_condition(JobConditionType.FAILED)
+        assert st.condition(JobConditionType.FAILED).reason == "DeadlineExceeded"
+
+
+class TestTTL:
+    def test_ttl_deletes_job_after_finish(self):
+        store, backend, c = harness()
+        job = new_job(worker=1)
+        job.spec.run_policy.ttl_seconds_after_finished = 0
+        submit(store, c, job)
+        backend.run_all("default")
+        backend.succeed_pod("default", "job-worker-0")
+        c.sync_until_quiet()
+        assert store.get("default", "job") is None
+        # owner GC removed the pod too
+        assert backend.get_pod("default", "job-worker-0") is None
+
+
+class TestJobDeletion:
+    def test_delete_gcs_pods_and_services(self):
+        store, backend, c = harness()
+        submit(store, c, new_job(worker=2))
+        store.delete("default", "job")
+        c.sync_until_quiet()
+        assert backend.list_pods("default") == []
+        assert backend.list_services("default") == []
+
+
+class TestDynamicWorkers:
+    def test_scale_in_deletes_high_indices(self):
+        store, backend, c = harness()
+        stored = submit(store, c, new_job(worker=4))
+        stored.spec.replica_specs[ReplicaType.WORKER].replicas = 2
+        store.update_spec(stored)
+        c.sync_until_quiet()
+        assert "default/job-worker-3" in backend.deleted_pods
+        assert "default/job-worker-2" in backend.deleted_pods
+        assert backend.get_pod("default", "job-worker-1") is not None
+
+    def test_scale_out_creates_new_indices(self):
+        store, backend, c = harness()
+        stored = submit(store, c, new_job(worker=1))
+        stored.spec.replica_specs[ReplicaType.WORKER].replicas = 3
+        store.update_spec(stored)
+        c.sync_until_quiet()
+        assert backend.get_pod("default", "job-worker-2") is not None
+
+
+class TestScaleRegression:
+    def test_scale_to_zero_resets_replica_status(self):
+        store, backend, c = harness()
+        stored = submit(store, c, new_job(worker=4))
+        backend.run_all("default")
+        c.sync_until_quiet()
+        assert get_status(store, stored).replica_statuses[ReplicaType.WORKER].active == 4
+        stored = store.get("default", "job")
+        stored.spec.replica_specs[ReplicaType.WORKER].replicas = 0
+        store.update_spec(stored)
+        c.sync_until_quiet()
+        assert get_status(store, stored).replica_statuses[ReplicaType.WORKER].active == 0
+        assert backend.list_pods("default") == []
+
+    def test_scale_in_deletes_services_too(self):
+        store, backend, c = harness()
+        stored = submit(store, c, new_job(worker=4))
+        stored.spec.replica_specs[ReplicaType.WORKER].replicas = 2
+        store.update_spec(stored)
+        c.sync_until_quiet()
+        names = {s.metadata.name for s in backend.list_services("default")}
+        assert names == {"job-worker-0", "job-worker-1"}
+
+    def test_gang_group_resized_on_scale(self):
+        store, backend, c = harness()
+        job = new_job(worker=2)
+        job.spec.enable_gang_scheduling = True
+        stored = submit(store, c, job)
+        assert backend.get_pod_group("default", "job").min_member == 2
+        stored = store.get("default", "job")
+        stored.spec.replica_specs[ReplicaType.WORKER].replicas = 8
+        store.update_spec(stored)
+        c.sync_until_quiet()
+        assert backend.get_pod_group("default", "job").min_member == 8
+
+
+class TestMixedSliceWorkerSuccess:
+    def test_worker0_alone_is_not_enough_with_slices(self):
+        store, backend, c = harness()
+        job = submit(store, c, new_job(worker=1, tpu_slice=2, tpu_topology="v5e-8"))
+        backend.run_all("default")
+        backend.succeed_pod("default", "job-worker-0")
+        c.sync_until_quiet()
+        st = get_status(store, job)
+        assert not st.has_condition(JobConditionType.SUCCEEDED)
+        backend.succeed_pod("default", "job-tpuslice-0")
+        backend.succeed_pod("default", "job-tpuslice-1")
+        c.sync_until_quiet()
+        assert get_status(store, job).has_condition(JobConditionType.SUCCEEDED)
+
+
+class TestExpectationsRace:
+    """The informer-lag race (SURVEY.md §5 "Race detection"): with manual
+    watch delivery the cache lags writes; a second sync before delivery
+    must not double-create."""
+
+    def test_no_double_create_while_cache_lags(self):
+        store, backend, c = harness(delivery="manual")
+        store.create(new_job(worker=2))
+        c.sync_until_quiet()  # first sync: creates 2 pods, 0 events delivered
+        assert len(backend.created_pods) == 2
+        # adversarial second sync with stale (empty) cache
+        c.reconciler.sync("default/job")
+        assert len(backend.created_pods) == 2  # expectations blocked it
+        # deliver events; sync again; still exactly 2
+        backend.pump()
+        c.sync_until_quiet()
+        assert len(backend.created_pods) == 2
+        assert c.pod_exp.satisfied("default/job")
+
+    def test_partial_delivery_still_blocks(self):
+        store, backend, c = harness(delivery="manual")
+        store.create(new_job(worker=3))
+        c.sync_until_quiet()
+        assert len(backend.created_pods) == 3
+        backend.pump(1)  # only one ADDED event arrives
+        c.reconciler.sync("default/job")
+        assert len(backend.created_pods) == 3
+        backend.pump()
+        c.sync_until_quiet()
+        assert len(backend.created_pods) == 3
+
+    def test_services_share_the_guard(self):
+        store, backend, c = harness(delivery="manual")
+        store.create(new_job(worker=1))
+        c.sync_until_quiet()
+        assert len(backend.created_services) == 1
+        c.reconciler.sync("default/job")
+        assert len(backend.created_services) == 1
+
+    def test_phase_change_invisible_until_pumped(self):
+        """Watch events snapshot objects: a phase mutation in the backend
+        must not leak into the informer cache through aliasing."""
+
+        store, backend, c = harness(delivery="manual")
+        store.create(new_job(worker=1))
+        c.sync_until_quiet()
+        backend.pump()  # deliver ADDED events
+        c.sync_until_quiet()
+        backend.run_all("default")
+        backend.fail_pod("default", "job-worker-0", exit_code=1)
+        # events NOT pumped: cache must still see the pod as Pending
+        cached = c.cache.list_pods("default")[0]
+        assert cached.phase is PodPhase.PENDING
+        backend.pump()
+        cached = c.cache.list_pods("default")[0]
+        assert cached.phase is PodPhase.FAILED
+
+
+class TestEvents:
+    def test_audit_trail(self):
+        store, backend, c = harness()
+        submit(store, c, new_job(worker=1))
+        backend.run_all("default")
+        backend.succeed_pod("default", "job-worker-0")
+        c.sync_until_quiet()
+        reasons = [e.reason for e in c.recorder.for_object("default/job")]
+        assert "JobCreated" in reasons
+        assert "SuccessfulCreatePod" in reasons
+        assert "JobSucceeded" in reasons

@@ -1,0 +1,101 @@
+"""Gang scheduling / atomic slice admission (SURVEY.md §3.4).
+
+The reference's volcano PodGroup semantics generalised: a TPU slice is
+whole-or-nothing; contending jobs queue; capacity freed by completion
+re-admits pending gangs.
+"""
+
+from tests.testutil import harness, new_job
+from tf_operator_tpu.api.types import JobConditionType, PodPhase, ReplicaType
+from tf_operator_tpu.backend.objects import PodGroupPhase
+
+
+def submit(store, controller, job):
+    stored = store.create(job)
+    controller.sync_until_quiet()
+    return stored
+
+
+class TestGangAdmission:
+    def test_pod_group_created_with_min_member(self):
+        store, backend, c = harness()
+        job = new_job(chief=1, worker=3)
+        job.spec.enable_gang_scheduling = True
+        submit(store, c, job)
+        group = backend.get_pod_group("default", "job")
+        assert group is not None
+        assert group.min_member == 4
+        assert group.phase is PodGroupPhase.GRANTED  # unlimited capacity
+
+    def test_pods_carry_gang_annotation_and_scheduler(self):
+        store, backend, c = harness()
+        job = new_job(worker=2)
+        job.spec.enable_gang_scheduling = True
+        submit(store, c, job)
+        pod = backend.get_pod("default", "job-worker-0")
+        from tf_operator_tpu.api.types import ANNOTATION_GANG_GROUP
+
+        assert pod.metadata.annotations[ANNOTATION_GANG_GROUP] == "job"
+        assert pod.scheduler_name == "tpu-gang"
+
+    def test_all_or_nothing_over_capacity(self):
+        store, backend, c = harness(total_chips=16)
+        # 2 slices × 16 chips = 32 > 16: must NOT be partially granted
+        job = new_job(tpu_slice=2, tpu_topology="v5e-16")
+        submit(store, c, job)
+        group = backend.get_pod_group("default", "job")
+        assert group.phase is PodGroupPhase.PENDING
+        # scheduler refuses to run gang-blocked pods
+        assert backend.run_all("default") == 0
+        pod = backend.get_pod("default", "job-tpuslice-0")
+        assert pod.phase is PodPhase.PENDING
+
+    def test_contending_jobs_queue_and_release(self):
+        store, backend, c = harness(total_chips=16)
+        a = new_job(name="job-a", tpu_slice=1, tpu_topology="v5e-16")
+        b = new_job(name="job-b", tpu_slice=1, tpu_topology="v5e-16")
+        submit(store, c, a)
+        submit(store, c, b)
+        assert backend.get_pod_group("default", "job-a").phase is PodGroupPhase.GRANTED
+        assert backend.get_pod_group("default", "job-b").phase is PodGroupPhase.PENDING
+
+        # only job-a's slice can run
+        backend.run_all("default")
+        assert backend.get_pod("default", "job-a-tpuslice-0").phase is PodPhase.RUNNING
+        assert backend.get_pod("default", "job-b-tpuslice-0").phase is PodPhase.PENDING
+
+        # job-a finishes; terminal cleanup releases its gang group
+        backend.succeed_pod("default", "job-a-tpuslice-0")
+        c.sync_until_quiet()
+        assert store.get("default", "job-a").status.has_condition(JobConditionType.SUCCEEDED)
+        assert backend.get_pod_group("default", "job-a") is None
+
+        # job-b now granted and runnable
+        assert backend.get_pod_group("default", "job-b").phase is PodGroupPhase.GRANTED
+        backend.run_all("default")
+        assert backend.get_pod("default", "job-b-tpuslice-0").phase is PodPhase.RUNNING
+
+    def test_tpu_slice_success_requires_all_members(self):
+        store, backend, c = harness()
+        job = submit(store, c, new_job(tpu_slice=2, tpu_topology="v5e-8"))
+        backend.run_all("default")
+        backend.succeed_pod("default", "job-tpuslice-0")
+        c.sync_until_quiet()
+        st = store.get("default", "job").status
+        assert not st.has_condition(JobConditionType.SUCCEEDED)
+        backend.succeed_pod("default", "job-tpuslice-1")
+        c.sync_until_quiet()
+        st = store.get("default", "job").status
+        assert st.has_condition(JobConditionType.SUCCEEDED)
+
+    def test_chip_accounting_frees_on_group_delete(self):
+        store, backend, c = harness(total_chips=32)
+        a = new_job(name="a", tpu_slice=2, tpu_topology="v5e-16")
+        submit(store, c, a)
+        assert backend.get_pod_group("default", "a").phase is PodGroupPhase.GRANTED
+        b = new_job(name="b", tpu_slice=1, tpu_topology="v5e-16")
+        submit(store, c, b)
+        assert backend.get_pod_group("default", "b").phase is PodGroupPhase.PENDING
+        store.delete("default", "a")
+        c.sync_until_quiet()
+        assert backend.get_pod_group("default", "b").phase is PodGroupPhase.GRANTED

@@ -1,0 +1,121 @@
+"""Unit tests for the job-controller runtime primitives: work queue,
+expectations, metrics (SURVEY.md §2 "Generic job-controller runtime")."""
+
+import threading
+import time
+
+from tf_operator_tpu.controller.expectations import Expectations
+from tf_operator_tpu.controller.workqueue import WorkQueue
+from tf_operator_tpu.utils.metrics import Metrics
+
+
+class TestWorkQueue:
+    def test_dedup(self):
+        q = WorkQueue()
+        q.add("a")
+        q.add("a")
+        q.add("b")
+        assert q.get(0) == "a"
+        assert q.get(0) == "b"
+        assert q.get(0) is None
+
+    def test_dirty_reprocess(self):
+        q = WorkQueue()
+        q.add("a")
+        key = q.get(0)
+        q.add("a")  # re-added while processing → dirty
+        assert q.get(0) is None  # not yet
+        q.done(key)
+        assert q.get(0) == "a"  # reprocessed exactly once
+        q.done("a")
+        assert q.get(0) is None
+
+    def test_add_after(self):
+        q = WorkQueue()
+        q.add_after("a", 0.05)
+        assert q.get(0) is None
+        assert q.get(0.5) == "a"
+
+    def test_rate_limited_backoff_grows(self):
+        q = WorkQueue(base_delay=0.01, max_delay=1.0)
+        d1 = q.add_rate_limited("a")
+        d2 = q.add_rate_limited("a")
+        d3 = q.add_rate_limited("a")
+        assert d1 < d2 < d3
+        q.forget("a")
+        assert q.num_requeues("a") == 0
+
+    def test_get_blocks_until_add(self):
+        q = WorkQueue()
+        got = []
+
+        def worker():
+            got.append(q.get(2.0))
+
+        t = threading.Thread(target=worker)
+        t.start()
+        time.sleep(0.05)
+        q.add("x")
+        t.join(timeout=2.0)
+        assert got == ["x"]
+
+    def test_shutdown_unblocks(self):
+        q = WorkQueue()
+        got = []
+        t = threading.Thread(target=lambda: got.append(q.get(None)))
+        t.start()
+        time.sleep(0.05)
+        q.shutdown()
+        t.join(timeout=2.0)
+        assert got == [None]
+
+
+class TestExpectations:
+    def test_satisfied_lifecycle(self):
+        e = Expectations()
+        assert e.satisfied("k")
+        e.expect_creations("k", 2)
+        assert not e.satisfied("k")
+        e.creation_observed("k")
+        assert not e.satisfied("k")
+        e.creation_observed("k")
+        assert e.satisfied("k")
+
+    def test_deletions_tracked_separately(self):
+        e = Expectations()
+        e.expect_creations("k", 1)
+        e.expect_deletions("k", 1)
+        e.creation_observed("k")
+        assert not e.satisfied("k")
+        e.deletion_observed("k")
+        assert e.satisfied("k")
+
+    def test_timeout_expires(self):
+        e = Expectations(timeout_s=0.01)
+        e.expect_creations("k", 5)
+        assert not e.satisfied("k")
+        time.sleep(0.02)
+        assert e.satisfied("k")  # assume events lost; self-heal
+
+    def test_extra_observations_ignored(self):
+        e = Expectations()
+        e.creation_observed("k")  # no expectation registered
+        assert e.satisfied("k")
+        assert e.pending("k") == (0, 0)
+
+
+class TestMetrics:
+    def test_counters_and_summary(self):
+        m = Metrics()
+        m.inc("jobs_total")
+        m.inc("jobs_total")
+        m.inc("pods_total", replica_type="worker")
+        assert m.counter("jobs_total") == 2
+        assert m.counter("pods_total", replica_type="worker") == 1
+        for v in (1.0, 2.0, 3.0):
+            m.observe("latency", v)
+        s = m.summary("latency")
+        assert s["count"] == 3 and s["mean"] == 2.0
+        text = m.exposition()
+        assert "jobs_total 2" in text
+        assert 'pods_total{replica_type="worker"} 1' in text

@@ -1,0 +1,82 @@
+"""Test fixture builders.
+
+Parity: the reference's ``pkg/common/util/v1/testutil`` builder library
+(SURVEY.md §4 tier 2): NewTFJob-style constructors + pod-phase fabricators
+that make status-engine tests cheap and exhaustive.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from tf_operator_tpu.api.types import (
+    Container,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+    TPUJobSpec,
+    replica_name,
+)
+from tf_operator_tpu.backend.fake import FakeCluster
+from tf_operator_tpu.backend.jobstore import JobStore
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.controller.reconciler import ReconcilerConfig
+
+
+def new_job(
+    name: str = "job",
+    namespace: str = "default",
+    chief: int = 0,
+    master: int = 0,
+    ps: int = 0,
+    worker: int = 0,
+    evaluator: int = 0,
+    tpu_slice: int = 0,
+    tpu_topology: str = "v5e-16",
+    restart_policy: Optional[RestartPolicy] = None,
+    command=("python", "train.py"),
+) -> TPUJob:
+    counts = {
+        ReplicaType.CHIEF: chief,
+        ReplicaType.MASTER: master,
+        ReplicaType.PS: ps,
+        ReplicaType.WORKER: worker,
+        ReplicaType.EVALUATOR: evaluator,
+        ReplicaType.TPU_SLICE: tpu_slice,
+    }
+    specs = {}
+    for rtype, n in counts.items():
+        if n <= 0:
+            continue
+        specs[rtype] = ReplicaSpec(
+            replicas=n,
+            template=PodTemplateSpec(containers=[Container(command=list(command))]),
+            restart_policy=restart_policy,
+            tpu_topology=tpu_topology if rtype is ReplicaType.TPU_SLICE else "",
+        )
+    return TPUJob(metadata=ObjectMeta(name=name, namespace=namespace), spec=TPUJobSpec(replica_specs=specs))
+
+
+def harness(
+    delivery: str = "sync",
+    total_chips: Optional[int] = None,
+    config: Optional[ReconcilerConfig] = None,
+) -> Tuple[JobStore, FakeCluster, TPUJobController]:
+    store = JobStore()
+    backend = FakeCluster(delivery=delivery, total_chips=total_chips)
+    controller = TPUJobController(store, backend, config=config)
+    return store, backend, controller
+
+
+def pod_name(job: TPUJob, rtype: ReplicaType, idx: int) -> str:
+    return replica_name(job.metadata.name, rtype, idx)
+
+
+def run_and_succeed_all(backend: FakeCluster, namespace: str = "default") -> None:
+    backend.run_all(namespace)
+    for pod in list(backend._pods.values()):
+        if pod.metadata.namespace == namespace:
+            backend.succeed_pod(namespace, pod.metadata.name)

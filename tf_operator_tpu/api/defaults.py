@@ -71,10 +71,10 @@ def set_defaults(job: TPUJob) -> TPUJob:
     if ReplicaType.TPU_SLICE in spec.replica_specs:
         spec.enable_gang_scheduling = True
 
-    if spec.enable_gang_scheduling:
-        if rp.scheduling_policy is None:
-            rp.scheduling_policy = SchedulingPolicy()
-        if rp.scheduling_policy.min_member is None:
-            rp.scheduling_policy.min_member = spec.total_replicas()
+    if spec.enable_gang_scheduling and rp.scheduling_policy is None:
+        # min_member stays None unless the user pinned it: the reconciler
+        # resolves None to the job's *current* total replicas each sync,
+        # so dynamic scaling keeps gang accounting in step
+        rp.scheduling_policy = SchedulingPolicy()
 
     return job

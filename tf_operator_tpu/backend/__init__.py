@@ -1,0 +1,18 @@
+"""Cluster backends: where replicas actually run (SURVEY.md §7 step 2/7).
+
+The reference talks to exactly one backend — the Kubernetes API server via
+client-go.  Here the backend is pluggable behind a small interface
+(``ClusterBackend``): an in-proc fake for tests, a local-subprocess backend
+for real multi-process runs on one host, and (interface-only) a real
+TPU-GKE backend.
+"""
+
+from tf_operator_tpu.backend.base import ClusterBackend  # noqa: F401
+from tf_operator_tpu.backend.objects import (  # noqa: F401
+    Pod,
+    PodGroup,
+    PodGroupPhase,
+    Service,
+    WatchEvent,
+    WatchEventType,
+)

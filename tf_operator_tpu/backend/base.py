@@ -1,0 +1,83 @@
+"""The cluster-backend interface: "5 verbs + watch" (SURVEY.md §7).
+
+Parity: the slice of the Kubernetes API the reference's job controller
+uses through client-go / PodControl / ServiceControl (SURVEY.md §2
+"Generic job-controller runtime").  Kept deliberately tiny so the native
+engine ↔ backend boundary stays manageable (SURVEY.md §7 hard part 5).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.backend.objects import Pod, PodGroup, Service, WatchHandler
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class AlreadyExistsError(ValueError):
+    pass
+
+
+class ClusterBackend(abc.ABC):
+    """Where pods/services/pod-groups live.
+
+    Writes are requests to the cluster; observed state comes back
+    asynchronously through the watch stream (level-triggered, like the
+    reference's informers).  Reconcilers must NOT assume a create is
+    visible in list results immediately — that gap is exactly what the
+    Expectations mechanism guards (SURVEY.md §5 "Race detection").
+    """
+
+    # -- pods ---------------------------------------------------------------
+    @abc.abstractmethod
+    def create_pod(self, pod: Pod) -> None: ...
+
+    @abc.abstractmethod
+    def delete_pod(self, namespace: str, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def list_pods(self, namespace: str, selector: Optional[Dict[str, str]] = None) -> List[Pod]: ...
+
+    # -- services -----------------------------------------------------------
+    @abc.abstractmethod
+    def create_service(self, svc: Service) -> None: ...
+
+    @abc.abstractmethod
+    def delete_service(self, namespace: str, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def list_services(
+        self, namespace: str, selector: Optional[Dict[str, str]] = None
+    ) -> List[Service]: ...
+
+    # -- gang groups --------------------------------------------------------
+    @abc.abstractmethod
+    def create_pod_group(self, group: PodGroup) -> None: ...
+
+    @abc.abstractmethod
+    def delete_pod_group(self, namespace: str, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def update_pod_group(self, namespace: str, name: str, min_member: int, chip_request: int) -> None:
+        """Resize a gang (dynamic scale); admission is re-evaluated."""
+
+    @abc.abstractmethod
+    def get_pod_group(self, namespace: str, name: str) -> Optional[PodGroup]: ...
+
+    # -- watch --------------------------------------------------------------
+    @abc.abstractmethod
+    def subscribe(self, handler: WatchHandler) -> None:
+        """Register a watch handler for all object kinds this backend owns."""
+
+    def close(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+
+def match_selector(labels: Dict[str, str], selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())

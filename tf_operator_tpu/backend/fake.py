@@ -1,0 +1,271 @@
+"""In-proc fake cluster — the test substrate (SURVEY.md §4 tier 1, §7 step 2).
+
+Parity: the role played by ``client-go``'s fake clientsets + FakePodControl
+in the reference's unit tests: "the cluster is a data structure"
+(SURVEY.md §4).  Additions the reference gets from a real cluster and we
+must simulate:
+
+- **watch latency**: ``delivery="manual"`` buffers watch events until
+  ``pump()`` — the informer-cache lag that the Expectations mechanism
+  exists to survive; tests can interleave syncs and deliveries
+  adversarially.
+- **scheduler + kubelet sim**: pods whose gang group is not yet Granted
+  stay Pending; test helpers transition phases and set exit codes.
+- **atomic capacity**: ``total_chips`` with all-or-nothing PodGroup
+  admission (the TPU-slice generalisation of volcano gang scheduling).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from tf_operator_tpu.api.types import ANNOTATION_GANG_GROUP, ObjectMeta, PodPhase
+from tf_operator_tpu.backend.base import (
+    AlreadyExistsError,
+    ClusterBackend,
+    NotFoundError,
+    match_selector,
+)
+from tf_operator_tpu.backend.objects import (
+    Pod,
+    PodGroup,
+    PodGroupPhase,
+    Service,
+    WatchEvent,
+    WatchEventType,
+    WatchHandler,
+)
+
+
+class FakeCluster(ClusterBackend):
+    def __init__(self, delivery: str = "sync", total_chips: Optional[int] = None):
+        assert delivery in ("sync", "manual")
+        self.delivery = delivery
+        self.total_chips = total_chips  # None = unlimited
+        self._lock = threading.RLock()
+        self._pods: Dict[str, Pod] = {}
+        self._services: Dict[str, Service] = {}
+        self._groups: Dict[str, PodGroup] = {}
+        self._handlers: List[WatchHandler] = []
+        self._pending_events: Deque[WatchEvent] = deque()
+        self._uid_counter = itertools.count(1)
+        # write-call journal, FakePodControl-style assertion surface
+        self.created_pods: List[str] = []
+        self.deleted_pods: List[str] = []
+        self.created_services: List[str] = []
+        self.deleted_services: List[str] = []
+
+    # -- event plumbing -----------------------------------------------------
+
+    def _emit(self, etype: WatchEventType, kind: str, obj) -> None:
+        # snapshot: watchers must never alias live store objects, or the
+        # manual-delivery lag simulation (and cache/store isolation)
+        # breaks for in-place mutations like phase transitions
+        ev = WatchEvent(type=etype, kind=kind, obj=copy.deepcopy(obj))
+        if self.delivery == "sync":
+            self._dispatch(ev)
+        else:
+            self._pending_events.append(ev)
+
+    def _dispatch(self, ev: WatchEvent) -> None:
+        for h in list(self._handlers):
+            h(ev)
+
+    def pump(self, n: Optional[int] = None) -> int:
+        """Deliver up to ``n`` buffered watch events (all if None).
+
+        Only meaningful with delivery="manual"; returns events delivered.
+        """
+
+        delivered = 0
+        while self._pending_events and (n is None or delivered < n):
+            self._dispatch(self._pending_events.popleft())
+            delivered += 1
+        return delivered
+
+    def subscribe(self, handler: WatchHandler) -> None:
+        with self._lock:
+            self._handlers.append(handler)
+
+    # -- pods ---------------------------------------------------------------
+
+    def create_pod(self, pod: Pod) -> None:
+        with self._lock:
+            if pod.key in self._pods:
+                raise AlreadyExistsError(pod.key)
+            if not pod.metadata.uid:
+                pod.metadata.uid = f"pod-uid-{next(self._uid_counter)}"
+            pod.phase = PodPhase.PENDING
+            self._pods[pod.key] = pod
+            self.created_pods.append(pod.key)
+            self._emit(WatchEventType.ADDED, "Pod", pod)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
+        with self._lock:
+            pod = self._pods.pop(key, None)
+            if pod is None:
+                raise NotFoundError(key)
+            self.deleted_pods.append(key)
+            self._emit(WatchEventType.DELETED, "Pod", pod)
+            self._regrant_pending_groups()
+
+    def list_pods(self, namespace: str, selector=None) -> List[Pod]:
+        with self._lock:
+            return [
+                p
+                for p in self._pods.values()
+                if p.metadata.namespace == namespace
+                and match_selector(p.metadata.labels, selector)
+            ]
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        return self._pods.get(f"{namespace}/{name}")
+
+    # -- services -----------------------------------------------------------
+
+    def create_service(self, svc: Service) -> None:
+        with self._lock:
+            if svc.key in self._services:
+                raise AlreadyExistsError(svc.key)
+            if not svc.metadata.uid:
+                svc.metadata.uid = f"svc-uid-{next(self._uid_counter)}"
+            self._services[svc.key] = svc
+            self.created_services.append(svc.key)
+            self._emit(WatchEventType.ADDED, "Service", svc)
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
+        with self._lock:
+            svc = self._services.pop(key, None)
+            if svc is None:
+                raise NotFoundError(key)
+            self.deleted_services.append(key)
+            self._emit(WatchEventType.DELETED, "Service", svc)
+
+    def list_services(self, namespace: str, selector=None) -> List[Service]:
+        with self._lock:
+            return [
+                s
+                for s in self._services.values()
+                if s.metadata.namespace == namespace
+                and match_selector(s.metadata.labels, selector)
+            ]
+
+    # -- gang groups (the scheduler sim) ------------------------------------
+
+    def create_pod_group(self, group: PodGroup) -> None:
+        with self._lock:
+            if group.key in self._groups:
+                raise AlreadyExistsError(group.key)
+            group.phase = (
+                PodGroupPhase.GRANTED if self._can_grant(group) else PodGroupPhase.PENDING
+            )
+            self._groups[group.key] = group
+            self._emit(WatchEventType.ADDED, "PodGroup", group)
+
+    def delete_pod_group(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
+        with self._lock:
+            group = self._groups.pop(key, None)
+            if group is None:
+                raise NotFoundError(key)
+            group.phase = PodGroupPhase.RELEASED
+            self._emit(WatchEventType.DELETED, "PodGroup", group)
+            self._regrant_pending_groups()
+
+    def get_pod_group(self, namespace: str, name: str) -> Optional[PodGroup]:
+        return self._groups.get(f"{namespace}/{name}")
+
+    def update_pod_group(self, namespace: str, name: str, min_member: int, chip_request: int) -> None:
+        key = f"{namespace}/{name}"
+        with self._lock:
+            group = self._groups.get(key)
+            if group is None:
+                raise NotFoundError(key)
+            if group.min_member == min_member and group.chip_request == chip_request:
+                return
+            group.min_member = min_member
+            group.chip_request = chip_request
+            # re-evaluate admission with the new size (a grown granted
+            # gang may no longer fit; a shrunk pending one may now fit)
+            group.phase = (
+                PodGroupPhase.GRANTED
+                if self._can_grant(group, exclude=group)
+                else PodGroupPhase.PENDING
+            )
+            self._emit(WatchEventType.MODIFIED, "PodGroup", group)
+            self._regrant_pending_groups()
+
+    def _chips_in_use(self, exclude: Optional[PodGroup] = None) -> int:
+        return sum(
+            g.chip_request
+            for g in self._groups.values()
+            if g.phase is PodGroupPhase.GRANTED and g is not exclude
+        )
+
+    def _can_grant(self, group: PodGroup, exclude: Optional[PodGroup] = None) -> bool:
+        if self.total_chips is None:
+            return True
+        return self._chips_in_use(exclude) + group.chip_request <= self.total_chips
+
+    def _regrant_pending_groups(self) -> None:
+        """Capacity freed — retry pending gangs in creation order."""
+
+        for g in self._groups.values():
+            if g.phase is PodGroupPhase.PENDING and self._can_grant(g):
+                g.phase = PodGroupPhase.GRANTED
+                self._emit(WatchEventType.MODIFIED, "PodGroup", g)
+
+    # -- kubelet/scheduler simulation helpers (test-facing) -----------------
+
+    def _gang_blocked(self, pod: Pod) -> bool:
+        gname = pod.metadata.annotations.get(ANNOTATION_GANG_GROUP)
+        if not gname:
+            return False
+        group = self._groups.get(f"{pod.metadata.namespace}/{gname}")
+        return group is None or group.phase is not PodGroupPhase.GRANTED
+
+    def set_pod_phase(
+        self, namespace: str, name: str, phase: PodPhase, exit_code: Optional[int] = None
+    ) -> None:
+        key = f"{namespace}/{name}"
+        with self._lock:
+            pod = self._pods.get(key)
+            if pod is None:
+                raise NotFoundError(key)
+            if phase is PodPhase.RUNNING and self._gang_blocked(pod):
+                raise RuntimeError(f"pod {key} is gang-blocked; group not granted")
+            pod.phase = phase
+            pod.exit_code = exit_code
+            self._emit(WatchEventType.MODIFIED, "Pod", pod)
+
+    def run_all(self, namespace: str) -> int:
+        """Scheduler tick: move every schedulable Pending pod to Running."""
+
+        moved = 0
+        with self._lock:
+            for pod in self._pods.values():
+                if (
+                    pod.metadata.namespace == namespace
+                    and pod.phase is PodPhase.PENDING
+                    and not self._gang_blocked(pod)
+                ):
+                    pod.phase = PodPhase.RUNNING
+                    self._emit(WatchEventType.MODIFIED, "Pod", pod)
+                    moved += 1
+        return moved
+
+    def succeed_pod(self, namespace: str, name: str) -> None:
+        self.set_pod_phase(namespace, name, PodPhase.SUCCEEDED, exit_code=0)
+
+    def fail_pod(self, namespace: str, name: str, exit_code: int = 1) -> None:
+        self.set_pod_phase(namespace, name, PodPhase.FAILED, exit_code=exit_code)
+
+
+def make_meta(name: str, namespace: str = "default", **labels) -> ObjectMeta:
+    return ObjectMeta(name=name, namespace=namespace, labels=dict(labels))

@@ -1,0 +1,102 @@
+"""TPUJob store — the "API server" surface for job objects.
+
+Parity: the reference's TFJob CRD storage + admission path (SURVEY.md §1
+L1/L4): create runs defaulting and validation (the CRD admission
+equivalent), status updates go through a dedicated method (the status
+subresource), and watchers receive job events that the controller's
+informer handlers consume (SURVEY.md §2 "Job lifecycle hooks").
+
+In-proc for both the fake and local-process backends; a real-cluster
+backend would implement the same surface over its control plane.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.api.defaults import set_defaults
+from tf_operator_tpu.api.types import TPUJob, TPUJobStatus
+from tf_operator_tpu.api.validation import validate
+from tf_operator_tpu.backend.base import AlreadyExistsError, NotFoundError
+from tf_operator_tpu.backend.objects import WatchEvent, WatchEventType, WatchHandler
+
+
+class JobStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._jobs: Dict[str, TPUJob] = {}
+        self._handlers: List[WatchHandler] = []
+        self._uid_counter = itertools.count(1)
+
+    def subscribe(self, handler: WatchHandler) -> None:
+        with self._lock:
+            self._handlers.append(handler)
+
+    def _emit(self, etype: WatchEventType, job: TPUJob) -> None:
+        ev = WatchEvent(type=etype, kind="TPUJob", obj=job)
+        for h in list(self._handlers):
+            h(ev)
+
+    def create(self, job: TPUJob) -> TPUJob:
+        """Admission: default, validate, assign uid, store, notify."""
+
+        with self._lock:
+            if job.key in self._jobs:
+                raise AlreadyExistsError(job.key)
+            set_defaults(job)
+            validate(job)
+            if not job.metadata.uid:
+                job.metadata.uid = f"job-uid-{next(self._uid_counter)}"
+            stored = job.deepcopy()
+            self._jobs[stored.key] = stored
+            self._emit(WatchEventType.ADDED, stored)
+            return stored.deepcopy()
+
+    def get(self, namespace: str, name: str) -> Optional[TPUJob]:
+        with self._lock:
+            job = self._jobs.get(f"{namespace}/{name}")
+            return job.deepcopy() if job else None
+
+    def list(self, namespace: Optional[str] = None) -> List[TPUJob]:
+        with self._lock:
+            return [
+                j.deepcopy()
+                for j in self._jobs.values()
+                if namespace is None or j.metadata.namespace == namespace
+            ]
+
+    def update_status(self, namespace: str, name: str, status: TPUJobStatus) -> TPUJob:
+        """The status-subresource write (SURVEY.md §3.2 final step)."""
+
+        with self._lock:
+            job = self._jobs.get(f"{namespace}/{name}")
+            if job is None:
+                raise NotFoundError(f"{namespace}/{name}")
+            job.status = copy.deepcopy(status)  # never alias caller state
+            job.metadata.resource_version += 1
+            self._emit(WatchEventType.MODIFIED, job.deepcopy())
+            return job.deepcopy()
+
+    def update_spec(self, job: TPUJob) -> TPUJob:
+        """Spec edits (e.g. scaling Replicas for dynamic workers)."""
+
+        with self._lock:
+            stored = self._jobs.get(job.key)
+            if stored is None:
+                raise NotFoundError(job.key)
+            set_defaults(job)
+            validate(job)
+            stored.spec = job.deepcopy().spec
+            stored.metadata.resource_version += 1
+            self._emit(WatchEventType.MODIFIED, stored.deepcopy())
+            return stored.deepcopy()
+
+    def delete(self, namespace: str, name: str) -> None:
+        with self._lock:
+            job = self._jobs.pop(f"{namespace}/{name}", None)
+            if job is None:
+                raise NotFoundError(f"{namespace}/{name}")
+            self._emit(WatchEventType.DELETED, job)

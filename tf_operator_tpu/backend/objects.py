@@ -1,0 +1,115 @@
+"""Runtime cluster objects: Pod, Service, PodGroup, watch events.
+
+Parity: the k8s core objects the reference manipulates (SURVEY.md §3.2) —
+reduced to the fields the reconciler actually uses.  ``PodGroup`` is the
+gang-scheduling unit (reference: volcano/kube-batch PodGroup CRs,
+SURVEY.md §3.4), generalised here to an atomic chip grant so a TPU slice
+allocation is all-or-nothing.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+from tf_operator_tpu.api.types import (
+    LABEL_JOB_NAME,
+    LABEL_REPLICA_INDEX,
+    LABEL_REPLICA_TYPE,
+    Container,
+    ObjectMeta,
+    PodPhase,
+    ReplicaType,
+)
+
+
+class WatchEventType(str, enum.Enum):
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: WatchEventType
+    kind: str  # "Pod" | "Service" | "PodGroup" | "TPUJob"
+    obj: Any
+
+
+WatchHandler = Callable[[WatchEvent], None]
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    containers: List[Container] = field(default_factory=list)
+    scheduler_name: str = ""
+    node_selector: dict = field(default_factory=dict)
+    phase: PodPhase = PodPhase.PENDING
+    #: main-container exit code once terminal (None while running)
+    exit_code: Optional[int] = None
+    #: number of kubelet-level container restarts (RestartPolicy ALWAYS /
+    #: ON_FAILURE restart in place rather than via operator delete+recreate)
+    restart_count: int = 0
+    #: chips this pod occupies (gang/capacity accounting; 0 = CPU-only)
+    chip_request: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @property
+    def job_name(self) -> str:
+        return self.metadata.labels.get(LABEL_JOB_NAME, "")
+
+    @property
+    def replica_type(self) -> Optional[ReplicaType]:
+        t = self.metadata.labels.get(LABEL_REPLICA_TYPE)
+        return ReplicaType.from_str(t) if t else None
+
+    @property
+    def replica_index(self) -> Optional[int]:
+        i = self.metadata.labels.get(LABEL_REPLICA_INDEX)
+        return int(i) if i is not None and i.isdigit() else None
+
+    def is_terminal(self) -> bool:
+        return self.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+
+    def main_container(self, name: str = "tensorflow") -> Optional[Container]:
+        for c in self.containers:
+            if c.name == name:
+                return c
+        return None
+
+
+@dataclass
+class Service:
+    """Headless-service equivalent: a stable DNS name for one replica."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: dict = field(default_factory=dict)
+    port: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+class PodGroupPhase(str, enum.Enum):
+    PENDING = "Pending"  # capacity not yet available — no member may run
+    GRANTED = "Granted"  # all-or-nothing admission succeeded
+    RELEASED = "Released"
+
+
+@dataclass
+class PodGroup:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    min_member: int = 0
+    #: total chips the gang needs, all-or-nothing (0 = member-count only)
+    chip_request: int = 0
+    phase: PodGroupPhase = PodGroupPhase.PENDING
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"

@@ -1,0 +1,13 @@
+"""Cluster-bootstrap env injection (SURVEY.md §2 "TF_CONFIG generation").
+
+Two payloads, one injection point (the reconciler's createNewPod):
+
+- ``cluster_spec``: the reference-compatible ``TF_CONFIG`` JSON for
+  TensorFlow distribution strategies.
+- ``tpu_env``: the TPU-native twin — jax.distributed coordinator vars +
+  megascale/libtpu multi-host vars so workloads bootstrap XLA collectives
+  over ICI/DCN (SURVEY.md §2c).
+"""
+
+from tf_operator_tpu.bootstrap.cluster_spec import gen_cluster_spec, gen_tf_config  # noqa: F401
+from tf_operator_tpu.bootstrap.tpu_env import gen_tpu_env  # noqa: F401

@@ -1,0 +1,109 @@
+"""TF_CONFIG generation — the reference's semantic crown jewel.
+
+Parity: ``SetClusterSpec`` / ``genTFConfigJSONStr`` / ``genClusterSpec``
+(SURVEY.md §2 "TF_CONFIG generation", expected upstream
+``pkg/controller.v1/tensorflow/tensorflow.go``).  Produces per-pod JSON:
+
+    {"cluster": {"chief": ["<job>-chief-0.<ns>.svc:2222"],
+                 "ps": [...], "worker": [...]},
+     "task": {"type": "worker", "index": 2},
+     "environment": "cloud"}
+
+Hostnames are the headless-service DNS names ``<job>-<type>-<idx>`` —
+the naming contract shared with the service reconciler.  Address
+resolution is pluggable so the local-process backend can substitute
+``127.0.0.1:<port>`` for DNS names.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional
+
+from tf_operator_tpu.api.types import (
+    DEFAULT_PORT,
+    DEFAULT_PORT_NAME,
+    ReplicaType,
+    TPUJob,
+    replica_name,
+)
+
+#: maps (job, rtype, index, port) -> "host:port"
+AddressResolver = Callable[[TPUJob, ReplicaType, int, int], str]
+
+
+def dns_resolver(job: TPUJob, rtype: ReplicaType, index: int, port: int) -> str:
+    """Cluster-DNS form: ``<job>-<type>-<idx>.<namespace>.svc:<port>``."""
+
+    return f"{replica_name(job.metadata.name, rtype, index)}.{job.metadata.namespace}.svc:{port}"
+
+
+def _replica_port(job: TPUJob, rtype: ReplicaType) -> int:
+    spec = job.spec.replica_specs[rtype]
+    main = spec.template.main_container()
+    if main is not None:
+        port = main.port_named(DEFAULT_PORT_NAME)
+        if port is not None:
+            return port.container_port
+    return DEFAULT_PORT
+
+
+def gen_cluster_spec(
+    job: TPUJob, resolve: AddressResolver = dns_resolver
+) -> Dict[str, List[str]]:
+    """The ``cluster`` dict: every replica's stable address, by role."""
+
+    cluster: Dict[str, List[str]] = {}
+    for rtype in job.spec.ordered_types():
+        spec = job.spec.replica_specs[rtype]
+        port = _replica_port(job, rtype)
+        cluster[rtype.lower_name] = [
+            resolve(job, rtype, i, port) for i in range(int(spec.replicas or 0))
+        ]
+    return cluster
+
+
+def gen_tf_config(
+    job: TPUJob,
+    rtype: ReplicaType,
+    index: int,
+    resolve: AddressResolver = dns_resolver,
+    sparse: bool = False,
+) -> str:
+    """The TF_CONFIG JSON string for one replica.
+
+    ``sparse``: PS-style jobs don't need every worker to know every other
+    worker — the sparse variant keeps the full PS/chief lists but trims
+    the task's own role list to just this task (SURVEY.md §2 notes this
+    as a reference variant for PS-style jobs; [U] detail).
+    """
+
+    cluster = gen_cluster_spec(job, resolve)
+    if sparse and rtype in (ReplicaType.WORKER, ReplicaType.EVALUATOR):
+        own = cluster[rtype.lower_name][index]
+        cluster[rtype.lower_name] = [own]
+        task_index = 0
+    else:
+        task_index = index
+    config = {
+        "cluster": cluster,
+        "task": {"type": rtype.lower_name, "index": task_index},
+        "environment": "cloud",
+    }
+    return json.dumps(config, sort_keys=True)
+
+
+def coordinator_replica(job: TPUJob) -> Optional[ReplicaType]:
+    """Which replica type hosts the coordinator: chief-like if present,
+    else TPU slice, else worker (index 0 of whichever wins)."""
+
+    for rtype in (
+        ReplicaType.CHIEF,
+        ReplicaType.MASTER,
+        ReplicaType.TPU_SLICE,
+        ReplicaType.WORKER,
+    ):
+        spec = job.spec.replica_specs.get(rtype)
+        if spec is not None and int(spec.replicas or 0) > 0:
+            return rtype
+    return None

@@ -1,0 +1,132 @@
+"""TPU/JAX bootstrap env — the TF_CONFIG twin for the ICI/DCN world.
+
+Parity target (SURVEY.md §2c, §5 "Distributed communication backend"):
+where the reference injects TF_CONFIG so TF strategies bootstrap
+gRPC/NCCL, we inject the env that lets a JAX process join the job:
+
+- ``TPUJOB_*``: this framework's canonical vars, consumed by
+  ``tf_operator_tpu.runtime.initialize()`` →
+  ``jax.distributed.initialize(coordinator_address, num_processes,
+  process_id)``.
+- ``MEGASCALE_*``: multi-slice (DCN) topology for libtpu/XLA when a job
+  spans multiple TPU_SLICE replicas.
+- ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES``: libtpu multi-host
+  discovery within a slice.
+
+Process-id assignment is deterministic: replicas are numbered in
+REPLICA_TYPE_ORDER, then by index — the same ordering the cluster spec
+uses, so process 0 is always the coordinator replica's index 0.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from tf_operator_tpu.api.types import (
+    DEFAULT_COORDINATOR_PORT,
+    ReplicaType,
+    TPUJob,
+    replica_name,
+)
+from tf_operator_tpu.bootstrap.cluster_spec import (
+    AddressResolver,
+    _replica_port,
+    coordinator_replica,
+    dns_resolver,
+)
+
+ENV_COORDINATOR = "TPUJOB_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "TPUJOB_NUM_PROCESSES"
+ENV_PROCESS_ID = "TPUJOB_PROCESS_ID"
+ENV_REPLICA_TYPE = "TPUJOB_REPLICA_TYPE"
+ENV_REPLICA_INDEX = "TPUJOB_REPLICA_INDEX"
+ENV_JOB_NAME = "TPUJOB_NAME"
+
+
+def _process_table(job: TPUJob) -> List[Tuple[ReplicaType, int]]:
+    """Global process numbering: coordinator replica type first (its index
+    0 must be process 0), then the remaining types in canonical order."""
+
+    coord = coordinator_replica(job)
+    ordered = job.spec.ordered_types()
+    if coord in ordered:
+        ordered = [coord] + [t for t in ordered if t is not coord]
+    table: List[Tuple[ReplicaType, int]] = []
+    for rtype in ordered:
+        # PS/evaluator replicas are not JAX collective participants; they
+        # still get entries so every replica has a stable process id.
+        n = int(job.spec.replica_specs[rtype].replicas or 0)
+        table.extend((rtype, i) for i in range(n))
+    return table
+
+
+def gen_tpu_env(
+    job: TPUJob,
+    rtype: ReplicaType,
+    index: int,
+    resolve: AddressResolver = dns_resolver,
+) -> Dict[str, str]:
+    """Env block for one replica — injected next to TF_CONFIG."""
+
+    coord_type = coordinator_replica(job)
+    if coord_type is None:
+        return {}
+    coord_port = _replica_port(job, coord_type)
+    # the coordinator port must be the jax.distributed one, not the TF
+    # gRPC port, when the coordinator replica kept the default 2222
+    if coord_port == 2222:
+        coord_port = DEFAULT_COORDINATOR_PORT
+    coord_addr = resolve(job, coord_type, 0, coord_port)
+
+    table = _process_table(job)
+    process_id = table.index((rtype, index))
+    env = {
+        ENV_JOB_NAME: job.metadata.name,
+        ENV_COORDINATOR: coord_addr,
+        ENV_NUM_PROCESSES: str(len(table)),
+        ENV_PROCESS_ID: str(process_id),
+        ENV_REPLICA_TYPE: rtype.lower_name,
+        ENV_REPLICA_INDEX: str(index),
+    }
+
+    # Multi-slice (DCN) topology: each TPU_SLICE replica is one slice.
+    slice_spec = job.spec.replica_specs.get(ReplicaType.TPU_SLICE)
+    if slice_spec is not None and int(slice_spec.replicas or 0) > 1:
+        env["MEGASCALE_COORDINATOR_ADDRESS"] = coord_addr.rsplit(":", 1)[0]
+        env["MEGASCALE_NUM_SLICES"] = str(int(slice_spec.replicas or 0))
+        if rtype is ReplicaType.TPU_SLICE:
+            env["MEGASCALE_SLICE_ID"] = str(index)
+
+    # Intra-slice libtpu discovery.  In this framework's model each
+    # TPU_SLICE replica IS one atomic slice (replicas = number of slices;
+    # MEGASCALE_* above carries the inter-slice topology), so from
+    # libtpu's perspective each replica is a single-host worker group:
+    # TPU_WORKER_ID is always 0 and the hostnames list names only this
+    # replica.  A real multi-host-VM backend expands one slice replica
+    # into per-host workers and rewrites these two vars with the real
+    # host list — they must NOT name other slices (that would declare a
+    # contradictory topology to the MEGASCALE vars).
+    if rtype is ReplicaType.TPU_SLICE:
+        own_host = resolve(job, ReplicaType.TPU_SLICE, index, 0).rsplit(":", 1)[0]
+        env["TPU_WORKER_ID"] = "0"
+        env["TPU_WORKER_HOSTNAMES"] = own_host
+
+    return env
+
+
+def worker_env(
+    job: TPUJob,
+    rtype: ReplicaType,
+    index: int,
+    resolve: AddressResolver = dns_resolver,
+    tf_config: bool = True,
+) -> Dict[str, str]:
+    """Everything createNewPod injects: TF_CONFIG + the TPU twin."""
+
+    from tf_operator_tpu.bootstrap.cluster_spec import gen_tf_config
+
+    env: Dict[str, str] = {}
+    if tf_config:
+        env["TF_CONFIG"] = gen_tf_config(job, rtype, index, resolve)
+    env.update(gen_tpu_env(job, rtype, index, resolve))
+    return env

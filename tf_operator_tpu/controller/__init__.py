@@ -1,0 +1,2 @@
+"""The reconcile engine (SURVEY.md §1 L2/L3): work queue, expectations,
+informer caches, pod/service reconcilers, status engine, controller loop."""

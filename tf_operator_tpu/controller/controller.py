@@ -1,0 +1,114 @@
+"""Controller assembly + worker loop.
+
+Parity: ``NewTFController`` + ``Controller.Run(threadiness, stopCh)``
+(SURVEY.md §2 "TFJob controller core", §3.1): wires informer handlers to
+the work queue, spawns N worker threads draining it, applies per-key
+rate-limited retries on sync errors.
+
+Deterministic test mode: with a sync-delivery fake backend,
+``sync_until_quiet()`` drains the queue inline — no threads — which is
+how the tier-1 tests run "multi-node" scenarios as pure data
+(SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from tf_operator_tpu.backend.base import ClusterBackend
+from tf_operator_tpu.backend.jobstore import JobStore
+from tf_operator_tpu.controller.expectations import Expectations
+from tf_operator_tpu.controller.informer import InformerCache
+from tf_operator_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+from tf_operator_tpu.controller.workqueue import WorkQueue
+from tf_operator_tpu.utils.events import EventRecorder
+from tf_operator_tpu.utils.logging import logger_for_job
+from tf_operator_tpu.utils.metrics import Metrics, default_metrics
+
+
+class TPUJobController:
+    def __init__(
+        self,
+        job_store: JobStore,
+        backend: ClusterBackend,
+        config: Optional[ReconcilerConfig] = None,
+        metrics: Optional[Metrics] = None,
+        max_sync_retries: int = 20,
+    ):
+        self.jobs = job_store
+        self.backend = backend
+        self.queue = WorkQueue()
+        self.pod_exp = Expectations()
+        self.svc_exp = Expectations()
+        self.recorder = EventRecorder()
+        self.metrics = metrics or default_metrics
+        self.cache = InformerCache(self.queue.add, self.pod_exp, self.svc_exp)
+        self.reconciler = Reconciler(
+            job_store,
+            backend,
+            self.cache,
+            self.pod_exp,
+            self.svc_exp,
+            recorder=self.recorder,
+            metrics=self.metrics,
+            config=config,
+            requeue_after=self.queue.add_after,
+        )
+        self.max_sync_retries = max_sync_retries
+        self._threads: list = []
+        self._stop = threading.Event()
+        backend.subscribe(self.cache.handle_event)
+        job_store.subscribe(self.cache.handle_event)
+
+    # ---------------------------------------------------------------- loops
+
+    def process_next(self, timeout: Optional[float] = 0.0) -> bool:
+        """One queue item; returns False when nothing was processed."""
+
+        key = self.queue.get(timeout=timeout)
+        if key is None:
+            return False
+        try:
+            self.reconciler.sync(key)
+        except Exception as e:  # noqa: BLE001 - retry-with-backoff path
+            ns, _, name = key.partition("/")
+            logger_for_job(ns, name).error("sync error: %s", e)
+            self.metrics.inc("tpujob_sync_errors_total")
+            if self.queue.num_requeues(key) < self.max_sync_retries:
+                self.queue.add_rate_limited(key)
+            else:
+                self.queue.forget(key)
+        else:
+            self.queue.forget(key)
+        finally:
+            self.queue.done(key)
+        return True
+
+    def sync_until_quiet(self, max_iters: int = 10_000) -> int:
+        """Drain the queue inline until empty; returns syncs performed."""
+
+        n = 0
+        while n < max_iters and self.process_next(timeout=0.0):
+            n += 1
+        return n
+
+    def run(self, threadiness: int = 1) -> None:
+        """Spawn worker threads (Controller.Run parity)."""
+
+        self._stop.clear()
+        for _ in range(threadiness):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            self.process_next(timeout=0.2)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shutdown()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()

@@ -1,0 +1,82 @@
+"""Expectations: informer-race bookkeeping.
+
+Parity: ``ControllerExpectations`` from the reference's job-controller
+runtime (SURVEY.md §2 "Generic job-controller runtime", §5 "Race
+detection") — *the* race-correctness core.  After the controller issues N
+creates / M deletes for a job, the informer cache won't reflect them until
+watch events arrive; syncing again in that window would double-create.
+The controller therefore records "I expect N adds and M deletes for key
+K"; observed watch events lower the counters; a sync only trusts the cache
+once expectations are satisfied (or expired).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+#: Parity with client-go's ExpectationsTimeout (5 min): after this long an
+#: unsatisfied expectation is assumed lost (dropped watch) and the sync
+#: proceeds from observed state — the self-healing path.
+EXPECTATION_TIMEOUT_S = 300.0
+
+
+@dataclass
+class _Expectation:
+    adds: int = 0
+    deletes: int = 0
+    timestamp: float = field(default_factory=time.monotonic)
+
+
+class Expectations:
+    def __init__(self, timeout_s: float = EXPECTATION_TIMEOUT_S):
+        self._lock = threading.Lock()
+        self._by_key: dict = {}
+        self.timeout_s = timeout_s
+
+    def expect_creations(self, key: str, n: int) -> None:
+        with self._lock:
+            e = self._by_key.setdefault(key, _Expectation())
+            e.adds += n
+            e.timestamp = time.monotonic()
+
+    def expect_deletions(self, key: str, n: int) -> None:
+        with self._lock:
+            e = self._by_key.setdefault(key, _Expectation())
+            e.deletes += n
+            e.timestamp = time.monotonic()
+
+    def creation_observed(self, key: str) -> None:
+        with self._lock:
+            e = self._by_key.get(key)
+            if e is not None and e.adds > 0:
+                e.adds -= 1
+
+    def deletion_observed(self, key: str) -> None:
+        with self._lock:
+            e = self._by_key.get(key)
+            if e is not None and e.deletes > 0:
+                e.deletes -= 1
+
+    def satisfied(self, key: str) -> bool:
+        """True when the cache can be trusted for this key."""
+
+        with self._lock:
+            e = self._by_key.get(key)
+            if e is None:
+                return True
+            if e.adds <= 0 and e.deletes <= 0:
+                return True
+            if time.monotonic() - e.timestamp > self.timeout_s:
+                return True  # expired: assume events lost, resync from state
+            return False
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._by_key.pop(key, None)
+
+    def pending(self, key: str):
+        with self._lock:
+            e = self._by_key.get(key)
+            return (0, 0) if e is None else (e.adds, e.deletes)

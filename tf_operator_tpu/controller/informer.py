@@ -1,0 +1,149 @@
+"""Informer caches: local read models fed by watch events.
+
+Parity: client-go SharedInformer caches + the reference's event handlers
+(SURVEY.md §2 "Job lifecycle hooks": addTFJob/updateTFJob/enqueueTFJob and
+pod/service handlers routed via owner refs).  The reconciler reads ONLY
+from these caches (never the backend directly), exactly like the
+reference reads listers — which is what makes the Expectations race real
+and testable with the fake backend's manual delivery mode.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from tf_operator_tpu.api.types import LABEL_JOB_NAME, TPUJob
+from tf_operator_tpu.backend.base import match_selector
+from tf_operator_tpu.backend.objects import (
+    Pod,
+    PodGroup,
+    Service,
+    WatchEvent,
+    WatchEventType,
+)
+from tf_operator_tpu.controller.expectations import Expectations
+
+
+class InformerCache:
+    """Caches for every kind + enqueue/expectation hooks.
+
+    Wire it to a backend and a job store with ``subscribe``; hand
+    ``enqueue`` a callable taking a job key.
+    """
+
+    def __init__(
+        self,
+        enqueue: Callable[[str], None],
+        pod_expectations: Expectations,
+        service_expectations: Expectations,
+    ):
+        self._lock = threading.RLock()
+        self._enqueue = enqueue
+        self._pod_exp = pod_expectations
+        self._svc_exp = service_expectations
+        self.pods: Dict[str, Pod] = {}
+        self.services: Dict[str, Service] = {}
+        self.groups: Dict[str, PodGroup] = {}
+        self.jobs: Dict[str, TPUJob] = {}
+
+    # -- wiring -------------------------------------------------------------
+
+    def handle_event(self, ev: WatchEvent) -> None:
+        handler = {
+            "Pod": self._on_pod,
+            "Service": self._on_service,
+            "PodGroup": self._on_group,
+            "TPUJob": self._on_job,
+        }.get(ev.kind)
+        if handler:
+            handler(ev)
+
+    # -- reads (the "listers") ----------------------------------------------
+
+    def get_job(self, key: str) -> Optional[TPUJob]:
+        with self._lock:
+            job = self.jobs.get(key)
+            return job.deepcopy() if job else None
+
+    def list_pods(self, namespace: str, selector: Optional[Dict[str, str]] = None) -> List[Pod]:
+        with self._lock:
+            return [
+                p
+                for p in self.pods.values()
+                if p.metadata.namespace == namespace
+                and match_selector(p.metadata.labels, selector)
+            ]
+
+    def list_services(
+        self, namespace: str, selector: Optional[Dict[str, str]] = None
+    ) -> List[Service]:
+        with self._lock:
+            return [
+                s
+                for s in self.services.values()
+                if s.metadata.namespace == namespace
+                and match_selector(s.metadata.labels, selector)
+            ]
+
+    def get_group(self, key: str) -> Optional[PodGroup]:
+        with self._lock:
+            return self.groups.get(key)
+
+    # -- handlers -----------------------------------------------------------
+
+    def _job_key_for(self, obj) -> Optional[str]:
+        jname = obj.metadata.labels.get(LABEL_JOB_NAME)
+        if not jname:
+            return None
+        return f"{obj.metadata.namespace}/{jname}"
+
+    def _on_pod(self, ev: WatchEvent) -> None:
+        pod: Pod = ev.obj
+        with self._lock:
+            if ev.type is WatchEventType.DELETED:
+                self.pods.pop(pod.key, None)
+            else:
+                self.pods[pod.key] = pod
+        key = self._job_key_for(pod)
+        if key:
+            if ev.type is WatchEventType.ADDED:
+                self._pod_exp.creation_observed(key)
+            elif ev.type is WatchEventType.DELETED:
+                self._pod_exp.deletion_observed(key)
+            self._enqueue(key)
+
+    def _on_service(self, ev: WatchEvent) -> None:
+        svc: Service = ev.obj
+        with self._lock:
+            if ev.type is WatchEventType.DELETED:
+                self.services.pop(svc.key, None)
+            else:
+                self.services[svc.key] = svc
+        key = self._job_key_for(svc)
+        if key:
+            if ev.type is WatchEventType.ADDED:
+                self._svc_exp.creation_observed(key)
+            elif ev.type is WatchEventType.DELETED:
+                self._svc_exp.deletion_observed(key)
+            self._enqueue(key)
+
+    def _on_group(self, ev: WatchEvent) -> None:
+        group: PodGroup = ev.obj
+        with self._lock:
+            if ev.type is WatchEventType.DELETED:
+                self.groups.pop(group.key, None)
+            else:
+                self.groups[group.key] = group
+        key = self._job_key_for(group)
+        if key:
+            self._enqueue(key)
+
+    def _on_job(self, ev: WatchEvent) -> None:
+        job: TPUJob = ev.obj
+        with self._lock:
+            if ev.type is WatchEventType.DELETED:
+                self.jobs.pop(job.key, None)
+            else:
+                self.jobs[job.key] = job
+        self._enqueue(job.key)

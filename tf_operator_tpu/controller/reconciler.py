@@ -1,0 +1,523 @@
+"""The TPUJob reconciler — syncTPUJob and friends.
+
+Parity: the reference's reconcile path (SURVEY.md §3.2): work-queue key →
+job lookup → terminal short-circuit → expectations guard → backoff/deadline
+enforcement → per-replica-type pod+service reconcile (create missing
+indices, apply restart policies, inject bootstrap env, gang annotations) →
+status update through the status engine.
+
+Level-triggered: every sync recomputes desired state from the cache and
+diffs against observed pods; no step depends on remembering a previous
+sync (informer resync heals missed events, SURVEY.md §5).
+
+Restart-policy translation (no kubelet in our backends): ALWAYS and
+ON_FAILURE are emulated operator-side — a failed pod is deleted and its
+index recreated on the next sync (restart budget = RunPolicy.backoff_limit);
+EXIT_CODE consults is_retryable_exit_code; NEVER leaves the failure on the
+books.  The reference delegates ALWAYS/ON_FAILURE to kubelet in-place
+restarts; semantics at the job level are identical (the replica comes
+back with the same name/index/env; SURVEY.md §5 "Checkpoint / resume").
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from tf_operator_tpu.api.types import (
+    ANNOTATION_GANG_GROUP,
+    LABEL_JOB_NAME,
+    JobConditionType,
+    PodPhase,
+    ReplicaType,
+    RestartPolicy,
+    CleanPodPolicy,
+    TPUJob,
+    replica_labels,
+    replica_name,
+)
+from tf_operator_tpu.api.validation import parse_tpu_topology
+from tf_operator_tpu.backend.base import AlreadyExistsError, ClusterBackend, NotFoundError
+from tf_operator_tpu.backend.jobstore import JobStore
+from tf_operator_tpu.backend.objects import Pod, PodGroup, PodGroupPhase, Service
+from tf_operator_tpu.bootstrap.cluster_spec import AddressResolver, dns_resolver
+from tf_operator_tpu.bootstrap.tpu_env import worker_env
+from tf_operator_tpu.controller.expectations import Expectations
+from tf_operator_tpu.controller.informer import InformerCache
+from tf_operator_tpu.controller.status import (
+    evaluate_success,
+    initialize_replica_statuses,
+    is_running,
+    set_condition,
+    update_replica_statuses,
+)
+from tf_operator_tpu.utils.events import EventRecorder
+from tf_operator_tpu.utils.logging import logger_for_job
+from tf_operator_tpu.utils.metrics import Metrics, default_metrics
+from tf_operator_tpu.utils.train_util import is_retryable_exit_code
+
+
+@dataclass
+class ReconcilerConfig:
+    #: global --enable-gang-scheduling flag (per-job spec can also enable)
+    enable_gang_scheduling: bool = False
+    #: inject reference-compatible TF_CONFIG next to the TPU env
+    inject_tf_config: bool = True
+    #: scheduler name stamped on gang pods (reference: volcano)
+    gang_scheduler_name: str = "tpu-gang"
+    resolver: AddressResolver = field(default=dns_resolver)
+
+
+class Reconciler:
+    def __init__(
+        self,
+        job_store: JobStore,
+        backend: ClusterBackend,
+        cache: InformerCache,
+        pod_expectations: Expectations,
+        service_expectations: Expectations,
+        recorder: Optional[EventRecorder] = None,
+        metrics: Optional[Metrics] = None,
+        config: Optional[ReconcilerConfig] = None,
+        requeue_after: Optional[Callable[[str, float], None]] = None,
+    ):
+        self.jobs = job_store
+        self.backend = backend
+        self.cache = cache
+        self.pod_exp = pod_expectations
+        self.svc_exp = service_expectations
+        self.recorder = recorder or EventRecorder()
+        self.metrics = metrics or default_metrics
+        self.config = config or ReconcilerConfig()
+        self.requeue_after = requeue_after or (lambda key, delay: None)
+        #: job key -> absolute deadline wakeup already scheduled
+        self._deadline_scheduled: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------ sync
+
+    def sync(self, key: str) -> None:
+        """One level-triggered reconcile of ``key`` ("<ns>/<name>")."""
+
+        job = self.cache.get_job(key)
+        if job is None:
+            # job deleted: expectations cleanup; owner-based GC of pods
+            self.pod_exp.delete(key)
+            self.svc_exp.delete(key)
+            self._deadline_scheduled.pop(key, None)
+            self._gc_orphans(key)
+            return
+        log = logger_for_job(job.metadata.namespace, job.metadata.name)
+
+        if job.is_terminal():
+            self._deadline_scheduled.pop(key, None)
+            self._cleanup_terminal(job)
+            return
+
+        if not (self.pod_exp.satisfied(key) and self.svc_exp.satisfied(key)):
+            # cache can't be trusted yet; watch events will re-enqueue
+            return
+
+        old_status = copy.deepcopy(job.status)
+        if not job.status.replica_statuses:
+            initialize_replica_statuses(job)
+        if job.status.start_time is None:
+            job.status.start_time = time.time()
+            set_condition(
+                job, JobConditionType.CREATED, "JobCreated", f"TPUJob {key} is created."
+            )
+            self.recorder.event(key, "Normal", "JobCreated", "job accepted by reconciler")
+
+        pods_by_type = self._claim_pods(job)
+
+        # ---- deadline / backoff enforcement (before creating anything)
+        if self._past_active_deadline(job):
+            self._fail_job(job, "DeadlineExceeded", "job ran past activeDeadlineSeconds")
+            self._update_status(job, old_status)
+            return
+        self._schedule_deadline_wakeup(job)
+
+        # ---- terminal evaluation from observed pods
+        succeeded, reason = evaluate_success(job, pods_by_type)
+        if succeeded:
+            update_replica_statuses(job, pods_by_type)
+            job.status.completion_time = time.time()
+            set_condition(job, JobConditionType.SUCCEEDED, "JobSucceeded", reason)
+            self.recorder.event(key, "Normal", "JobSucceeded", reason)
+            self.metrics.inc("tpujob_jobs_succeeded_total")
+            self._observe_completion(job)
+            self._update_status(job, old_status)
+            return
+
+        # ---- gang group before any pod (all-or-nothing admission)
+        gang = self.config.enable_gang_scheduling or job.spec.enable_gang_scheduling
+        if gang:
+            self._sync_pod_group(job)
+
+        # ---- per-replica-type reconcile
+        failed_fatal: Optional[str] = None
+        restarting = False
+        for rtype in job.spec.ordered_types():
+            spec = job.spec.replica_specs[rtype]
+            pods = pods_by_type.get(rtype, [])
+            outcome = self._reconcile_pods(job, rtype, spec, pods, gang)
+            self._reconcile_services(job, rtype, spec)
+            if outcome == "fatal" and failed_fatal is None:
+                failed_fatal = f"{rtype.value} replica failed permanently"
+            restarting = restarting or outcome == "restarting"
+
+        update_replica_statuses(job, pods_by_type)
+
+        if failed_fatal:
+            # _reconcile_pods may already have set FAILED with a more
+            # specific reason (BackoffLimitExceeded); don't overwrite it
+            if not job.status.has_condition(JobConditionType.FAILED):
+                self._fail_job(job, "ReplicaFailed", failed_fatal)
+        elif restarting:
+            set_condition(
+                job, JobConditionType.RESTARTING, "ReplicaRestarting", "replica restart in flight"
+            )
+            self.metrics.inc("tpujob_jobs_restarted_total")
+        elif is_running(job, pods_by_type):
+            if not job.status.has_condition(JobConditionType.RUNNING):
+                self._observe_startup_latency(job)
+            set_condition(job, JobConditionType.RUNNING, "JobRunning", f"TPUJob {key} is running.")
+
+        self._update_status(job, old_status)
+        log.debug("sync complete")
+
+    # ----------------------------------------------------------- pod claims
+
+    def _claim_pods(self, job: TPUJob) -> Dict[ReplicaType, List[Pod]]:
+        """Label-selected, owner-filtered pods bucketed by replica type.
+
+        Adoption-lite vs the reference's ControllerRefManager: pods with
+        our job label but a different owner uid are ignored (never
+        adopted/orphaned) — the label+uid pair is authoritative here
+        because only the reconciler creates replica pods.
+        """
+
+        pods = self.cache.list_pods(
+            job.metadata.namespace, {LABEL_JOB_NAME: job.metadata.name}
+        )
+        out: Dict[ReplicaType, List[Pod]] = {}
+        for pod in pods:
+            if pod.metadata.owner_uid and pod.metadata.owner_uid != job.metadata.uid:
+                continue
+            rtype = pod.replica_type
+            if rtype is None:
+                continue
+            out.setdefault(rtype, []).append(pod)
+        return out
+
+    # ------------------------------------------------------- pod reconcile
+
+    def _reconcile_pods(
+        self,
+        job: TPUJob,
+        rtype: ReplicaType,
+        spec,
+        pods: List[Pod],
+        gang: bool,
+    ) -> str:
+        """Returns "ok" | "restarting" | "fatal"."""
+
+        key = job.key
+        want = int(spec.replicas or 0)
+        by_index: Dict[int, List[Pod]] = {}
+        for p in pods:
+            idx = p.replica_index
+            if idx is not None:
+                by_index.setdefault(idx, []).append(p)
+
+        outcome = "ok"
+        # scale-in (dynamic workers): drop indices beyond the want count
+        for idx in sorted(by_index):
+            if idx >= want:
+                for p in by_index[idx]:
+                    self._delete_pod(key, p)
+
+        for idx in range(want):
+            slot = by_index.get(idx, [])
+            if not slot:
+                self._create_pod(job, rtype, idx, gang)
+                continue
+            pod = slot[0]
+            if pod.phase is not PodPhase.FAILED:
+                continue
+            exit_code = pod.exit_code if pod.exit_code is not None else 1
+            policy = spec.restart_policy or RestartPolicy.NEVER
+            should_restart = policy in (RestartPolicy.ALWAYS, RestartPolicy.ON_FAILURE) or (
+                policy is RestartPolicy.EXIT_CODE and is_retryable_exit_code(exit_code)
+            )
+            if not should_restart:
+                outcome = "fatal"
+                continue
+            limit = job.spec.run_policy.backoff_limit
+            if limit is not None and job.status.restart_count >= limit:
+                self._fail_job(
+                    job,
+                    "BackoffLimitExceeded",
+                    f"restart budget exhausted ({limit})",
+                )
+                return "fatal"
+            job.status.restart_count += 1
+            self.recorder.event(
+                key,
+                "Warning",
+                "RestartingReplica",
+                f"{rtype.value}-{idx} exited {exit_code}; restarting "
+                f"({job.status.restart_count} restarts)",
+            )
+            self._delete_pod(key, pod)
+            if outcome == "ok":
+                outcome = "restarting"
+        return outcome
+
+    def _create_pod(self, job: TPUJob, rtype: ReplicaType, index: int, gang: bool) -> None:
+        key = job.key
+        name = replica_name(job.metadata.name, rtype, index)
+        template = job.spec.replica_specs[rtype].template
+        containers = copy.deepcopy(template.containers)
+        env = worker_env(
+            job, rtype, index, self.config.resolver, tf_config=self.config.inject_tf_config
+        )
+        for c in containers:
+            merged = dict(env)
+            merged.update(c.env)  # user-specified env wins, like the reference
+            c.env = merged
+
+        pod = Pod(containers=containers)
+        pod.metadata.name = name
+        pod.metadata.namespace = job.metadata.namespace
+        pod.metadata.owner_uid = job.metadata.uid
+        pod.metadata.labels = {**template.labels, **replica_labels(job.metadata.name, rtype, index)}
+        pod.metadata.annotations = dict(template.annotations)
+        pod.scheduler_name = template.scheduler_name
+        pod.node_selector = dict(template.node_selector)
+        if rtype is ReplicaType.TPU_SLICE:
+            pod.chip_request = parse_tpu_topology(job.spec.replica_specs[rtype].tpu_topology)
+        if gang:
+            pod.metadata.annotations[ANNOTATION_GANG_GROUP] = job.metadata.name
+            pod.scheduler_name = pod.scheduler_name or self.config.gang_scheduler_name
+
+        self.pod_exp.expect_creations(key, 1)
+        try:
+            self.backend.create_pod(pod)
+        except AlreadyExistsError:
+            # stale cache (expired expectation / informer lag): reconcile
+            # again once the watch catches up
+            self.pod_exp.creation_observed(key)
+            return
+        except Exception:
+            self.pod_exp.creation_observed(key)
+            raise
+        self.metrics.inc("tpujob_pods_created_total", replica_type=rtype.value)
+        self.recorder.event(key, "Normal", "SuccessfulCreatePod", f"created pod {name}")
+
+    def _delete_pod(self, key: str, pod: Pod) -> None:
+        self.pod_exp.expect_deletions(key, 1)
+        try:
+            self.backend.delete_pod(pod.metadata.namespace, pod.metadata.name)
+        except NotFoundError:
+            self.pod_exp.deletion_observed(key)
+            return
+        except Exception:
+            self.pod_exp.deletion_observed(key)
+            raise
+        self.metrics.inc("tpujob_pods_deleted_total")
+        self.recorder.event(key, "Normal", "SuccessfulDeletePod", f"deleted pod {pod.metadata.name}")
+
+    # --------------------------------------------------- service reconcile
+
+    def _reconcile_services(self, job: TPUJob, rtype: ReplicaType, spec) -> None:
+        """One headless service per replica index (SURVEY.md §2 "Service
+        reconciler") — the stable DNS names the cluster spec points at."""
+
+        key = job.key
+        want = int(spec.replicas or 0)
+        prefix = f"{job.metadata.name}-{rtype.lower_name}-"
+        existing = {
+            s.metadata.name
+            for s in self.cache.list_services(
+                job.metadata.namespace, {LABEL_JOB_NAME: job.metadata.name}
+            )
+        }
+        # scale-in: drop services for indices beyond the want count,
+        # symmetric with the pod scale-in loop
+        for name in existing:
+            idx_s = name[len(prefix):] if name.startswith(prefix) else ""
+            if idx_s.isdigit() and int(idx_s) >= want:
+                self.svc_exp.expect_deletions(key, 1)
+                try:
+                    self.backend.delete_service(job.metadata.namespace, name)
+                except NotFoundError:
+                    self.svc_exp.deletion_observed(key)
+
+        from tf_operator_tpu.bootstrap.cluster_spec import _replica_port
+
+        port = _replica_port(job, rtype)
+        for idx in range(want):
+            name = replica_name(job.metadata.name, rtype, idx)
+            if name in existing:
+                continue
+            svc = Service(selector=replica_labels(job.metadata.name, rtype, idx), port=port)
+            svc.metadata.name = name
+            svc.metadata.namespace = job.metadata.namespace
+            svc.metadata.owner_uid = job.metadata.uid
+            svc.metadata.labels = replica_labels(job.metadata.name, rtype, idx)
+            self.svc_exp.expect_creations(key, 1)
+            try:
+                self.backend.create_service(svc)
+            except AlreadyExistsError:
+                self.svc_exp.creation_observed(key)
+            except Exception:
+                self.svc_exp.creation_observed(key)
+                raise
+
+    # ------------------------------------------------------------- gang
+
+    def _sync_pod_group(self, job: TPUJob) -> None:
+        """SyncPodGroup parity (SURVEY.md §3.4): one group per job,
+        min_member = total replicas, chip_request = Σ slice chips."""
+
+        chips = 0
+        slice_spec = job.spec.replica_specs.get(ReplicaType.TPU_SLICE)
+        if slice_spec is not None:
+            chips = parse_tpu_topology(slice_spec.tpu_topology) * int(slice_spec.replicas or 0)
+        sp = job.spec.run_policy.scheduling_policy
+        min_member = sp.min_member if sp and sp.min_member else job.spec.total_replicas()
+        existing = self.backend.get_pod_group(job.metadata.namespace, job.metadata.name)
+        if existing is not None:
+            # dynamic scale: keep gang size/chip accounting in step
+            if existing.min_member != min_member or existing.chip_request != chips:
+                self.backend.update_pod_group(
+                    job.metadata.namespace, job.metadata.name, min_member, chips
+                )
+            return
+        group = PodGroup(min_member=min_member, chip_request=chips)
+        group.metadata.name = job.metadata.name
+        group.metadata.namespace = job.metadata.namespace
+        group.metadata.owner_uid = job.metadata.uid
+        group.metadata.labels = {LABEL_JOB_NAME: job.metadata.name}
+        try:
+            self.backend.create_pod_group(group)
+        except AlreadyExistsError:
+            return
+        self.recorder.event(
+            job.key,
+            "Normal",
+            "CreatedPodGroup",
+            f"gang group min_member={group.min_member} chips={chips}",
+        )
+
+    # ------------------------------------------------------ terminal paths
+
+    def _fail_job(self, job: TPUJob, reason: str, message: str) -> None:
+        job.status.completion_time = job.status.completion_time or time.time()
+        set_condition(job, JobConditionType.FAILED, reason, message)
+        self.recorder.event(job.key, "Warning", "JobFailed", message)
+        self.metrics.inc("tpujob_jobs_failed_total")
+
+    def _cleanup_terminal(self, job: TPUJob) -> None:
+        """CleanPodPolicy + TTL (SURVEY.md §3.5)."""
+
+        policy = job.spec.run_policy.clean_pod_policy or CleanPodPolicy.RUNNING
+        key = job.key
+        pods = self.cache.list_pods(job.metadata.namespace, {LABEL_JOB_NAME: job.metadata.name})
+        if policy is not CleanPodPolicy.NONE:
+            for pod in pods:
+                if policy is CleanPodPolicy.ALL or pod.phase in (
+                    PodPhase.RUNNING,
+                    PodPhase.PENDING,
+                ):
+                    self._delete_pod(key, pod)
+            for svc in self.cache.list_services(
+                job.metadata.namespace, {LABEL_JOB_NAME: job.metadata.name}
+            ):
+                self.svc_exp.expect_deletions(key, 1)
+                try:
+                    self.backend.delete_service(svc.metadata.namespace, svc.metadata.name)
+                except NotFoundError:
+                    self.svc_exp.deletion_observed(key)
+        try:
+            if self.backend.get_pod_group(job.metadata.namespace, job.metadata.name):
+                self.backend.delete_pod_group(job.metadata.namespace, job.metadata.name)
+        except NotFoundError:
+            pass
+
+        ttl = job.spec.run_policy.ttl_seconds_after_finished
+        if ttl is not None and job.status.completion_time is not None:
+            remaining = job.status.completion_time + ttl - time.time()
+            if remaining <= 0:
+                try:
+                    self.jobs.delete(job.metadata.namespace, job.metadata.name)
+                except NotFoundError:
+                    pass
+            else:
+                self.requeue_after(key, remaining)
+
+    def _gc_orphans(self, key: str) -> None:
+        """Owner-GC parity: job object gone → its pods/services go too."""
+
+        ns, _, name = key.partition("/")
+        for pod in self.cache.list_pods(ns, {LABEL_JOB_NAME: name}):
+            try:
+                self.backend.delete_pod(ns, pod.metadata.name)
+            except NotFoundError:
+                pass
+        for svc in self.cache.list_services(ns, {LABEL_JOB_NAME: name}):
+            try:
+                self.backend.delete_service(ns, svc.metadata.name)
+            except NotFoundError:
+                pass
+        try:
+            if self.backend.get_pod_group(ns, name):
+                self.backend.delete_pod_group(ns, name)
+        except NotFoundError:
+            pass
+
+    # --------------------------------------------------------- time limits
+
+    def _past_active_deadline(self, job: TPUJob) -> bool:
+        deadline = job.spec.run_policy.active_deadline_seconds
+        if deadline is None or job.status.start_time is None:
+            return False
+        return time.time() - job.status.start_time >= deadline
+
+    def _schedule_deadline_wakeup(self, job: TPUJob) -> None:
+        deadline = job.spec.run_policy.active_deadline_seconds
+        if deadline is None or job.status.start_time is None:
+            return
+        due = job.status.start_time + deadline
+        # schedule at most once per (job, due-time): a busy job syncs
+        # constantly and must not pile one heap entry per sync
+        if self._deadline_scheduled.get(job.key) == due:
+            return
+        remaining = due - time.time()
+        if remaining > 0:
+            self._deadline_scheduled[job.key] = due
+            self.requeue_after(job.key, remaining + 0.01)
+
+    # -------------------------------------------------------------- status
+
+    def _update_status(self, job: TPUJob, old_status) -> None:
+        if job.status != old_status:
+            try:
+                self.jobs.update_status(job.metadata.namespace, job.metadata.name, job.status)
+            except NotFoundError:
+                pass
+
+    def _observe_startup_latency(self, job: TPUJob) -> None:
+        if job.status.start_time is not None:
+            self.metrics.observe(
+                "tpujob_startup_latency_seconds", time.time() - job.status.start_time
+            )
+
+    def _observe_completion(self, job: TPUJob) -> None:
+        if job.status.start_time and job.status.completion_time:
+            self.metrics.observe(
+                "tpujob_completion_seconds",
+                job.status.completion_time - job.status.start_time,
+            )

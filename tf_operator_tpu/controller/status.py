@@ -1,0 +1,181 @@
+"""Status engine: pod phases → replica statuses → job conditions.
+
+Parity: ``updateStatusSingle`` / ``updateTFJobConditions`` /
+``initializeReplicaStatuses`` / ``updateJobReplicaStatuses``
+(SURVEY.md §2 "Status engine", §3.2 tail).  Rules encoded:
+
+- conditions are a list of typed entries; setting a condition appends or
+  updates it, and setting Running/Succeeded/Failed/Restarting flips the
+  mutually-exclusive peers to False (Created stays True forever once set).
+- job Running when the coordinator-bearing replica has an active pod (or,
+  with no chief, when any worker runs).
+- success policy (SURVEY.md §2 "TFJob API types"): with a chief, chief
+  success ends the job; without, DEFAULT = worker-0 success ends it,
+  ALL_WORKERS = every worker must succeed.  TPU_SLICE replicas are
+  treated as workers for success purposes, except gang semantics make
+  ALL members required under DEFAULT too — a slice is whole or nothing.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tf_operator_tpu.api.types import (
+    CHIEF_LIKE,
+    JobCondition,
+    JobConditionType,
+    PodPhase,
+    ReplicaStatus,
+    ReplicaType,
+    SuccessPolicy,
+    TPUJob,
+)
+from tf_operator_tpu.backend.objects import Pod
+
+#: condition types that are mutually exclusive "current state" markers
+_EXCLUSIVE = {
+    JobConditionType.RUNNING,
+    JobConditionType.RESTARTING,
+    JobConditionType.SUCCEEDED,
+    JobConditionType.FAILED,
+}
+
+
+def set_condition(job: TPUJob, ctype: JobConditionType, reason: str, message: str) -> bool:
+    """Append/update a condition; returns True if anything changed."""
+
+    now = time.time()
+    changed = False
+    if ctype in _EXCLUSIVE:
+        for c in job.status.conditions:
+            if c.type in _EXCLUSIVE and c.type is not ctype and c.status:
+                c.status = False
+                c.last_transition_time = now
+                c.last_update_time = now
+                changed = True
+    existing = job.status.condition(ctype)
+    if existing is None:
+        job.status.conditions.append(
+            JobCondition(
+                type=ctype,
+                status=True,
+                reason=reason,
+                message=message,
+                last_update_time=now,
+                last_transition_time=now,
+            )
+        )
+        return True
+    if not existing.status or existing.reason != reason:
+        existing.status = True
+        existing.reason = reason
+        existing.message = message
+        existing.last_update_time = now
+        existing.last_transition_time = now
+        return True
+    return changed
+
+
+def initialize_replica_statuses(job: TPUJob) -> None:
+    for rtype in job.spec.replica_specs:
+        job.status.replica_statuses[rtype] = ReplicaStatus()
+
+
+def update_replica_statuses(job: TPUJob, pods_by_type: Dict[ReplicaType, List[Pod]]) -> None:
+    # iterate spec types (not just types with pods) so a type scaled to
+    # zero pods gets its counts reset instead of going permanently stale
+    for rtype in set(job.spec.replica_specs) | set(pods_by_type):
+        pods = pods_by_type.get(rtype, [])
+        rs = job.status.replica_statuses.setdefault(rtype, ReplicaStatus())
+        rs.active = sum(1 for p in pods if p.phase in (PodPhase.PENDING, PodPhase.RUNNING))
+        rs.succeeded = sum(1 for p in pods if p.phase is PodPhase.SUCCEEDED)
+        rs.failed = sum(1 for p in pods if p.phase is PodPhase.FAILED)
+
+
+def _find(pods: List[Pod], index: int) -> Optional[Pod]:
+    for p in pods:
+        if p.replica_index == index:
+            return p
+    return None
+
+
+def chief_type(job: TPUJob) -> Optional[ReplicaType]:
+    for rtype in CHIEF_LIKE:
+        if rtype in job.spec.replica_specs:
+            return rtype
+    return None
+
+
+def _worker_like(job: TPUJob) -> List[ReplicaType]:
+    return [
+        t
+        for t in (ReplicaType.WORKER, ReplicaType.TPU_SLICE)
+        if t in job.spec.replica_specs and int(job.spec.replica_specs[t].replicas or 0) > 0
+    ]
+
+
+def evaluate_success(
+    job: TPUJob, pods_by_type: Dict[ReplicaType, List[Pod]]
+) -> Tuple[bool, str]:
+    """(job_succeeded, reason).  The success-policy truth table."""
+
+    chief = chief_type(job)
+    if chief is not None:
+        pods = pods_by_type.get(chief, [])
+        pod0 = _find(pods, 0)
+        if pod0 is not None and pod0.phase is PodPhase.SUCCEEDED:
+            return True, f"{chief.value} replica succeeded"
+        return False, ""
+
+    workers = _worker_like(job)
+    if not workers:
+        # evaluator/ps-only jobs: all replicas succeeding ends the job
+        all_pods = [p for ps in pods_by_type.values() for p in ps]
+        if all_pods and all(p.phase is PodPhase.SUCCEEDED for p in all_pods):
+            return True, "all replicas succeeded"
+        return False, ""
+
+    if job.spec.success_policy is SuccessPolicy.ALL_WORKERS:
+        for rtype in workers:
+            want = int(job.spec.replica_specs[rtype].replicas or 0)
+            rs = [p for p in pods_by_type.get(rtype, []) if p.phase is PodPhase.SUCCEEDED]
+            if len(rs) < want:
+                return False, ""
+        return True, "all workers succeeded"
+
+    # DEFAULT policy.  TPU_SLICE gangs: every slice member must finish
+    # (an atomic slice has no meaningful "member 0 finished early") —
+    # including when ordinary workers coexist with slices, where BOTH
+    # the slice gang and worker-0 must succeed before the job is done.
+    if ReplicaType.TPU_SLICE in workers:
+        want = int(job.spec.replica_specs[ReplicaType.TPU_SLICE].replicas or 0)
+        done = sum(
+            1
+            for p in pods_by_type.get(ReplicaType.TPU_SLICE, [])
+            if p.phase is PodPhase.SUCCEEDED
+        )
+        if done < want:
+            return False, ""
+        if ReplicaType.WORKER not in workers:
+            return True, "all slice members succeeded"
+        worker0 = _find(pods_by_type.get(ReplicaType.WORKER, []), 0)
+        if worker0 is not None and worker0.phase is PodPhase.SUCCEEDED:
+            return True, "all slice members and worker 0 succeeded"
+        return False, ""
+
+    worker0 = _find(pods_by_type.get(ReplicaType.WORKER, []), 0)
+    if worker0 is not None and worker0.phase is PodPhase.SUCCEEDED:
+        return True, "worker 0 succeeded"
+    return False, ""
+
+
+def is_running(job: TPUJob, pods_by_type: Dict[ReplicaType, List[Pod]]) -> bool:
+    chief = chief_type(job)
+    if chief is not None:
+        pods = pods_by_type.get(chief, [])
+        pod0 = _find(pods, 0)
+        return pod0 is not None and pod0.phase is PodPhase.RUNNING
+    return any(
+        p.phase is PodPhase.RUNNING for ps in pods_by_type.values() for p in ps
+    )

@@ -1,0 +1,148 @@
+"""Rate-limited, deduplicating work queue.
+
+Parity: ``k8s.io/client-go/util/workqueue``'s rate-limiting queue as used
+by the reference's controller (SURVEY.md §2 "TFJob controller core",
+§3.1 hot loop #1).  Semantics reproduced:
+
+- **dedup**: adding a key already queued (or dirty while processing) does
+  not duplicate work; a key re-added mid-processing is reprocessed once.
+- **per-item exponential backoff** via ``add_rate_limited``; ``forget``
+  resets the failure count after a clean sync.
+- **delayed adds** (``add_after``) for TTL/deadline re-enqueues.
+
+Pure Python here; the C++ native engine provides the same surface
+(tf_operator_tpu/native) and either can back the controller.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class WorkQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 60.0):
+        self._lock = threading.Condition()
+        self._queue: List[str] = []
+        self._queued: Set[str] = set()
+        self._processing: Set[str] = set()
+        self._dirty: Set[str] = set()
+        self._failures: Dict[str, int] = {}
+        self._delayed: List[Tuple[float, int, str]] = []  # heap (when, seq, key)
+        self._seq = 0
+        self._shutdown = False
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+
+    # -- core ---------------------------------------------------------------
+
+    def add(self, key: str) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            if key in self._processing:
+                self._dirty.add(key)
+                return
+            if key in self._queued:
+                return
+            self._queued.add(key)
+            self._queue.append(key)
+            self._lock.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        """Block for the next key; None on timeout or shutdown."""
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                self._drain_delayed_locked()
+                if self._queue:
+                    key = self._queue.pop(0)
+                    self._queued.discard(key)
+                    self._processing.add(key)
+                    return key
+                if self._shutdown:
+                    return None
+                wait = self._next_wait_locked(deadline)
+                if wait is not None and wait <= 0:
+                    if deadline is not None and time.monotonic() >= deadline:
+                        return None
+                    continue
+                self._lock.wait(wait)
+                if deadline is not None and time.monotonic() >= deadline and not self._queue:
+                    self._drain_delayed_locked()
+                    if not self._queue:
+                        return None
+
+    def done(self, key: str) -> None:
+        with self._lock:
+            self._processing.discard(key)
+            if key in self._dirty:
+                self._dirty.discard(key)
+                if key not in self._queued:
+                    self._queued.add(key)
+                    self._queue.append(key)
+                    self._lock.notify()
+
+    # -- rate limiting ------------------------------------------------------
+
+    def add_rate_limited(self, key: str) -> float:
+        """Re-add after exponential backoff; returns the delay applied."""
+
+        with self._lock:
+            failures = self._failures.get(key, 0)
+            self._failures[key] = failures + 1
+        delay = min(self.base_delay * (2**failures), self.max_delay)
+        self.add_after(key, delay)
+        return delay
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._failures.pop(key, None)
+
+    def num_requeues(self, key: str) -> int:
+        return self._failures.get(key, 0)
+
+    # -- delayed ------------------------------------------------------------
+
+    def add_after(self, key: str, delay: float) -> None:
+        if delay <= 0:
+            self.add(key)
+            return
+        with self._lock:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, key))
+            self._lock.notify()
+
+    def _drain_delayed_locked(self) -> None:
+        now = time.monotonic()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, key = heapq.heappop(self._delayed)
+            if key in self._processing:
+                self._dirty.add(key)
+            elif key not in self._queued:
+                self._queued.add(key)
+                self._queue.append(key)
+
+    def _next_wait_locked(self, deadline: Optional[float]) -> Optional[float]:
+        candidates = []
+        if self._delayed:
+            candidates.append(self._delayed[0][0] - time.monotonic())
+        if deadline is not None:
+            candidates.append(deadline - time.monotonic())
+        return min(candidates) if candidates else None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue) + len(self._delayed)

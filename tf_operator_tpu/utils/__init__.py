@@ -1,0 +1,1 @@
+"""Observability + helpers (SURVEY.md §5): structured logs, events, metrics."""

@@ -1,0 +1,42 @@
+"""Event recorder — the user-visible audit trail.
+
+Parity: Kubernetes Events emitted on the TFJob (SURVEY.md §5
+"Metrics / logging / observability": created/succeeded/failed/restarted).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class Event:
+    object_key: str  # "<ns>/<job>"
+    type: str  # "Normal" | "Warning"
+    reason: str  # e.g. "SuccessfulCreatePod", "JobFailed"
+    message: str
+    timestamp: float = field(default_factory=time.time)
+
+
+class EventRecorder:
+    def __init__(self, max_events: int = 10_000):
+        self._lock = threading.Lock()
+        self._events: List[Event] = []
+        self._max = max_events
+
+    def event(self, object_key: str, etype: str, reason: str, message: str) -> None:
+        with self._lock:
+            self._events.append(Event(object_key, etype, reason, message))
+            if len(self._events) > self._max:
+                del self._events[: len(self._events) - self._max]
+
+    def for_object(self, object_key: str) -> List[Event]:
+        with self._lock:
+            return [e for e in self._events if e.object_key == object_key]
+
+    def all(self) -> List[Event]:
+        with self._lock:
+            return list(self._events)

@@ -1,0 +1,65 @@
+"""Metrics registry.
+
+Parity: the reference's Prometheus counters (jobs created/succeeded/
+failed/restarted) + the driver-defined job-startup-latency metric
+(SURVEY.md §5, §6).  In-proc counters/histograms with a Prometheus-style
+text exposition (servable later; no network dependency here).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
+        self._observations: Dict[str, List[float]] = defaultdict(list)
+
+    def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters[key] += value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            self._observations[name].append(value)
+
+    def counter(self, name: str, **labels: str) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._counters.get(key, 0.0)
+
+    def summary(self, name: str) -> Dict[str, float]:
+        with self._lock:
+            vals = sorted(self._observations.get(name, []))
+        if not vals:
+            return {"count": 0}
+        return {
+            "count": len(vals),
+            "min": vals[0],
+            "max": vals[-1],
+            "mean": sum(vals) / len(vals),
+            "p50": vals[len(vals) // 2],
+            "p99": vals[min(len(vals) - 1, int(len(vals) * 0.99))],
+        }
+
+    def exposition(self) -> str:
+        """Prometheus text format."""
+
+        lines = []
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                label_s = ",".join(f'{k}="{v2}"' for k, v2 in labels)
+                lines.append(f"{name}{{{label_s}}} {v}" if label_s else f"{name} {v}")
+            for name, vals in sorted(self._observations.items()):
+                lines.append(f"{name}_count {len(vals)}")
+                lines.append(f"{name}_sum {sum(vals)}")
+        return "\n".join(lines) + "\n"
+
+
+#: process-global default registry (controller accepts an override)
+default_metrics = Metrics()

@@ -1,0 +1,13 @@
+"""Exit-code semantics for RestartPolicy.EXIT_CODE.
+
+Parity: ``IsRetryableExitCode`` (SURVEY.md §2 "Exit-code semantics",
+expected upstream ``pkg/util/train/train_util.go``): exit codes 1–127 are
+permanent (user error — bad flags, assertion, OOM-killed python), 128+
+are retryable (signal-terminated: 130 SIGINT, 137 SIGKILL/OOM-score kill,
+143 SIGTERM — typically infrastructure, e.g. preemption).  SURVEY flags
+the exact split as [U]; this convention is encoded here and in the tests.
+"""
+
+
+def is_retryable_exit_code(exit_code: int) -> bool:
+    return exit_code > 127
