@@ -1,0 +1,33 @@
+"""Minimal distributed workload: proves collective bootstrap end-to-end.
+
+The smallest TPU-native analogue of the reference's dist-mnist smoke
+(SURVEY.md §3.3): each replica joins via the injected env, runs a global
+allgather + psum across processes, asserts the result, exits 0.
+"""
+
+import sys
+
+from tf_operator_tpu.runtime import initialize
+
+
+def main() -> int:
+    ctx = initialize()
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.multihost_utils import process_allgather
+
+    n = jax.process_count()
+    pid = jax.process_index()
+    if ctx is not None:
+        assert pid == ctx.process_id, (pid, ctx.process_id)
+        assert n == ctx.num_processes, (n, ctx.num_processes)
+
+    gathered = process_allgather(jnp.array([float(pid)]))
+    expected = [[float(i)] for i in range(n)]
+    assert gathered.tolist() == expected, (gathered.tolist(), expected)
+    print(f"process {pid}/{n}: allgather ok -> {gathered.ravel().tolist()}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,16 +2,26 @@
 
 Tests exercise multi-chip sharding semantics on a virtual CPU mesh
 (SURVEY.md §4's rebuild mapping); the single real TPU chip is reserved for
-bench.py and explicit @tpu-marked tests.  Must set flags before jax import.
+bench.py and explicit @tpu-marked tests.
+
+Note: this box pins `JAX_PLATFORMS=axon` (TPU) via a sitecustomize that
+overrides env-level platform selection, so the override must go through
+jax.config *before* any backend initialisation — hence the eager jax
+import here.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# RUN_TPU_TESTS=1 runs the @tpu-marked tests in a separate pytest
+# invocation against the real chip — don't pin CPU there.
+if not os.environ.get("RUN_TPU_TESTS"):
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,146 @@
+"""Tier-3 e2e against the local-process backend (SURVEY.md §4, §7 step 7):
+real subprocesses, real jax.distributed over localhost, CPU collectives.
+
+This is the "minimum end-to-end slice": spec → reconcile → subprocess
+launch → collective bootstrap → exit 0 → Succeeded → cleanup.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from tests.testutil import new_job
+from tf_operator_tpu.api.types import JobConditionType, ReplicaType, SuccessPolicy
+from tf_operator_tpu.backend.jobstore import JobStore
+from tf_operator_tpu.backend.local import LocalProcessBackend
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.controller.reconciler import ReconcilerConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "dist_psum.py")
+
+
+@pytest.fixture
+def local_harness():
+    store = JobStore()
+    backend = LocalProcessBackend()
+    controller = TPUJobController(
+        store, backend, config=ReconcilerConfig(resolver=backend.resolver)
+    )
+    controller.run(threadiness=2)
+    yield store, backend, controller
+    controller.stop()
+    backend.close()
+
+
+def wait_for(store, ns, name, predicate, timeout=90.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        job = store.get(ns, name)
+        if job is not None and predicate(job):
+            return job
+        time.sleep(0.1)
+    job = store.get(ns, name)
+    raise TimeoutError(f"condition not reached; status={job.status if job else None}")
+
+
+def cpu_env():
+    return {"JAX_PLATFORMS": "cpu"}
+
+
+@pytest.mark.slow
+class TestLocalE2E:
+    def test_single_worker_succeeds(self, local_harness):
+        store, backend, c = local_harness
+        job = new_job(name="solo", worker=1, command=[sys.executable, EXAMPLE])
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].env = cpu_env()
+        store.create(job)
+        done = wait_for(
+            store, "default", "solo",
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED),
+        )
+        assert done.status.replica_statuses[ReplicaType.WORKER].succeeded == 1
+        log = backend.pod_log("default", "solo-worker-0")
+        assert "allgather ok" in log
+
+    def test_two_workers_real_collectives(self, local_harness):
+        """Two real processes form a jax.distributed world and allgather."""
+
+        store, backend, c = local_harness
+        job = new_job(name="pair", worker=2, command=[sys.executable, EXAMPLE])
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].env = cpu_env()
+        job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+        store.create(job)
+        done = wait_for(
+            store, "default", "pair",
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED),
+        )
+        assert done.status.replica_statuses[ReplicaType.WORKER].succeeded == 2
+        log0 = backend.pod_log("default", "pair-worker-0")
+        log1 = backend.pod_log("default", "pair-worker-1")
+        assert "process 0/2: allgather ok -> [0.0, 1.0]" in log0
+        assert "process 1/2: allgather ok -> [0.0, 1.0]" in log1
+
+    def test_failing_worker_fails_job(self, local_harness):
+        store, backend, c = local_harness
+        job = new_job(
+            name="boom", worker=1, command=[sys.executable, "-c", "raise SystemExit(3)"]
+        )
+        store.create(job)
+        done = wait_for(
+            store, "default", "boom",
+            lambda j: j.status.has_condition(JobConditionType.FAILED), timeout=30.0,
+        )
+        assert done.status.condition(JobConditionType.FAILED).reason == "ReplicaFailed"
+
+    def test_restart_then_succeed(self, local_harness, tmp_path):
+        """First attempt exits 137 (retryable); the restarted replica sees
+        the marker file and exits 0 — checkpoint-resume contract shape."""
+
+        from tf_operator_tpu.api.types import RestartPolicy
+
+        marker = tmp_path / "attempted"
+        script = (
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').close()\n"
+            "    sys.exit(137)\n"
+            "sys.exit(0)\n"
+        )
+        job = new_job(
+            name="retry",
+            worker=1,
+            command=[sys.executable, "-c", script],
+            restart_policy=RestartPolicy.EXIT_CODE,
+        )
+        store, backend, c = local_harness
+        store.create(job)
+        done = wait_for(
+            store, "default", "retry",
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED), timeout=30.0,
+        )
+        assert done.status.restart_count == 1
+
+    def test_delete_running_job_kills_processes(self, local_harness):
+        store, backend, c = local_harness
+        job = new_job(
+            name="sleeper", worker=1,
+            command=[sys.executable, "-c", "import time; time.sleep(600)"],
+        )
+        store.create(job)
+        wait_for(
+            store, "default", "sleeper",
+            lambda j: j.status.has_condition(JobConditionType.RUNNING), timeout=30.0,
+        )
+        pid = backend._procs["default/sleeper-worker-0"].pid
+        store.delete("default", "sleeper")
+        deadline = time.time() + 15
+        while time.time() < deadline and backend.list_pods("default"):
+            time.sleep(0.1)
+        assert backend.list_pods("default") == []
+        # the subprocess is really gone
+        with pytest.raises(ProcessLookupError):
+            os.kill(pid, 0)

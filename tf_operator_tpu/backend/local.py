@@ -1,0 +1,298 @@
+"""Local-process backend: "pods" are real subprocesses on this host.
+
+Parity: SURVEY.md §7 step 7 — the tier-3 e2e substrate.  Where the
+reference's e2e suite runs against a real GKE cluster, this backend runs
+each replica as a subprocess with the injected bootstrap env, so real
+multi-process ``jax.distributed`` collectives over localhost prove the
+whole chain (spec → reconcile → launch → bootstrap → status → cleanup)
+without a cluster.
+
+Address resolution: DNS names don't exist locally, so ``LocalResolver``
+hands out deterministic ``127.0.0.1:<port>`` addresses per (job, replica,
+port-kind) — the same resolver instance must be shared by the reconciler
+config (env generation) and any observer.
+
+Environment hygiene: this box pins the TPU platform through a
+sitecustomize on PYTHONPATH; worker processes get PYTHONPATH reset to the
+repo root so CPU workers are really CPU (tests) and platform selection is
+the job spec's business (container env), not inherited ambience.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.api.types import ObjectMeta, PodPhase, ReplicaType, TPUJob
+from tf_operator_tpu.backend.base import (
+    AlreadyExistsError,
+    ClusterBackend,
+    NotFoundError,
+    match_selector,
+)
+from tf_operator_tpu.backend.objects import (
+    Pod,
+    PodGroup,
+    PodGroupPhase,
+    Service,
+    WatchEvent,
+    WatchEventType,
+    WatchHandler,
+)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class LocalResolver:
+    """Deterministic 127.0.0.1:<port> addresses for local replicas."""
+
+    def __init__(self, base_port: int = 42000):
+        self._lock = threading.Lock()
+        self._ports: Dict[tuple, int] = {}
+        self._next = base_port
+
+    def __call__(self, job: TPUJob, rtype: ReplicaType, index: int, port: int) -> str:
+        key = (job.metadata.namespace, job.metadata.name, rtype.value, index, port)
+        with self._lock:
+            if key not in self._ports:
+                self._ports[key] = self._next
+                self._next += 1
+            return f"127.0.0.1:{self._ports[key]}"
+
+
+class LocalProcessBackend(ClusterBackend):
+    def __init__(self, log_dir: Optional[str] = None, poll_interval: float = 0.05):
+        self.resolver = LocalResolver()
+        self.log_dir = log_dir or tempfile.mkdtemp(prefix="tpujob-local-")
+        self._lock = threading.RLock()
+        self._pods: Dict[str, Pod] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._services: Dict[str, Service] = {}
+        self._groups: Dict[str, PodGroup] = {}
+        self._handlers: List[WatchHandler] = []
+        self._stop = threading.Event()
+        self.poll_interval = poll_interval
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._monitor.start()
+
+    # -- watch --------------------------------------------------------------
+
+    def subscribe(self, handler: WatchHandler) -> None:
+        with self._lock:
+            self._handlers.append(handler)
+
+    def _emit(self, etype: WatchEventType, kind: str, obj) -> None:
+        import copy
+
+        ev = WatchEvent(type=etype, kind=kind, obj=copy.deepcopy(obj))
+        for h in list(self._handlers):
+            h(ev)
+
+    # -- pods ---------------------------------------------------------------
+
+    def _build_env(self, pod: Pod) -> Dict[str, str]:
+        env = dict(os.environ)
+        # strip the box's TPU-pinning ambience; replicas opt back in via
+        # their container env (JAX_PLATFORMS/PYTHONPATH) if they want TPU
+        env["PYTHONPATH"] = _REPO_ROOT
+        env.pop("JAX_PLATFORMS", None)
+        main = pod.main_container()
+        if main is not None:
+            env.update(main.env)
+        return env
+
+    def _log_path(self, namespace: str, name: str) -> str:
+        d = os.path.join(self.log_dir, namespace)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"{name}.log")
+
+    def create_pod(self, pod: Pod) -> None:
+        with self._lock:
+            if pod.key in self._pods:
+                raise AlreadyExistsError(pod.key)
+            main = pod.main_container()
+            if main is None or not (main.command or main.args):
+                raise ValueError(f"pod {pod.key}: no runnable command")
+            pod.phase = PodPhase.PENDING
+            self._pods[pod.key] = pod
+            self._emit(WatchEventType.ADDED, "Pod", pod)
+            cmd = list(main.command) + list(main.args)
+            env = self._build_env(pod)
+
+        # fork+exec happens outside the backend lock so spawns don't
+        # serialize each other or stall the exit-monitor loop
+        logf = open(self._log_path(pod.metadata.namespace, pod.metadata.name), "ab")
+        try:
+            proc = subprocess.Popen(
+                cmd,
+                env=env,
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                cwd=main.working_dir or None,
+                start_new_session=True,  # isolate signals per replica
+            )
+        except OSError as e:
+            logf.write(f"spawn failed: {e}\n".encode())
+            logf.close()
+            with self._lock:
+                pod.phase = PodPhase.FAILED
+                pod.exit_code = 127
+                self._emit(WatchEventType.MODIFIED, "Pod", pod)
+            return
+        logf.close()  # child holds its own fd now
+        with self._lock:
+            if pod.key not in self._pods:
+                # deleted while we were spawning: kill the straggler
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                proc.wait()
+                return
+            self._procs[pod.key] = proc
+            pod.phase = PodPhase.RUNNING
+            self._emit(WatchEventType.MODIFIED, "Pod", pod)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
+        with self._lock:
+            pod = self._pods.pop(key, None)
+            if pod is None:
+                raise NotFoundError(key)
+            proc = self._procs.pop(key, None)
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                proc.wait(timeout=5.0)
+        self._emit(WatchEventType.DELETED, "Pod", pod)
+
+    def list_pods(self, namespace: str, selector=None) -> List[Pod]:
+        with self._lock:
+            return [
+                p
+                for p in self._pods.values()
+                if p.metadata.namespace == namespace
+                and match_selector(p.metadata.labels, selector)
+            ]
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        return self._pods.get(f"{namespace}/{name}")
+
+    def pod_log(self, namespace: str, name: str) -> str:
+        path = self._log_path(namespace, name)
+        try:
+            with open(path, "r", errors="replace") as f:
+                return f.read()
+        except FileNotFoundError:
+            return ""
+
+    def _monitor_loop(self) -> None:
+        """kubelet-equivalent: surface process exits as pod phases."""
+
+        while not self._stop.is_set():
+            with self._lock:
+                items = list(self._procs.items())
+            for key, proc in items:
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                with self._lock:
+                    pod = self._pods.get(key)
+                    self._procs.pop(key, None)
+                    if pod is None or pod.is_terminal():
+                        continue
+                    pod.exit_code = rc if rc >= 0 else 128 - rc  # signal death → 128+N
+                    pod.phase = PodPhase.SUCCEEDED if rc == 0 else PodPhase.FAILED
+                    self._emit(WatchEventType.MODIFIED, "Pod", pod)
+            self._stop.wait(self.poll_interval)
+
+    # -- services (record-only: localhost needs no DNS) ---------------------
+
+    def create_service(self, svc: Service) -> None:
+        with self._lock:
+            if svc.key in self._services:
+                raise AlreadyExistsError(svc.key)
+            self._services[svc.key] = svc
+            self._emit(WatchEventType.ADDED, "Service", svc)
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
+        with self._lock:
+            svc = self._services.pop(key, None)
+            if svc is None:
+                raise NotFoundError(key)
+            self._emit(WatchEventType.DELETED, "Service", svc)
+
+    def list_services(self, namespace: str, selector=None) -> List[Service]:
+        with self._lock:
+            return [
+                s
+                for s in self._services.values()
+                if s.metadata.namespace == namespace
+                and match_selector(s.metadata.labels, selector)
+            ]
+
+    # -- gang (single host: grants are immediate) ---------------------------
+
+    def create_pod_group(self, group: PodGroup) -> None:
+        with self._lock:
+            if group.key in self._groups:
+                raise AlreadyExistsError(group.key)
+            group.phase = PodGroupPhase.GRANTED
+            self._groups[group.key] = group
+            self._emit(WatchEventType.ADDED, "PodGroup", group)
+
+    def delete_pod_group(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
+        with self._lock:
+            group = self._groups.pop(key, None)
+            if group is None:
+                raise NotFoundError(key)
+            self._emit(WatchEventType.DELETED, "PodGroup", group)
+
+    def update_pod_group(self, namespace: str, name: str, min_member: int, chip_request: int) -> None:
+        with self._lock:
+            group = self._groups.get(f"{namespace}/{name}")
+            if group is None:
+                raise NotFoundError(f"{namespace}/{name}")
+            group.min_member = min_member
+            group.chip_request = chip_request
+            self._emit(WatchEventType.MODIFIED, "PodGroup", group)
+
+    def get_pod_group(self, namespace: str, name: str) -> Optional[PodGroup]:
+        return self._groups.get(f"{namespace}/{name}")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        for proc in procs:  # reap: no zombies in the parent's table
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass
+        self._monitor.join(timeout=2.0)

@@ -1,0 +1,12 @@
+"""Worker-side runtime: what training processes call to join the job.
+
+Parity: the user-side bootstrap of the reference's examples
+(SURVEY.md §3.3): where dist-mnist parses TF_CONFIG and builds
+tf.train.Server, a TPU-native workload calls
+``tf_operator_tpu.runtime.initialize()`` which reads the injected
+``TPUJOB_*`` env (SURVEY.md §2c: coordinator bootstrap) and brings up
+``jax.distributed`` so every process sees the global device set and XLA
+collectives ride ICI (TPU) or gloo (CPU testing).
+"""
+
+from tf_operator_tpu.runtime.bootstrap import JobContext, initialize  # noqa: F401

@@ -1,0 +1,81 @@
+"""jax.distributed bootstrap from injected TPUJOB_* env."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from tf_operator_tpu.bootstrap.tpu_env import (
+    ENV_COORDINATOR,
+    ENV_JOB_NAME,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    ENV_REPLICA_INDEX,
+    ENV_REPLICA_TYPE,
+)
+
+
+@dataclass
+class JobContext:
+    job_name: str
+    replica_type: str
+    replica_index: int
+    process_id: int
+    num_processes: int
+    coordinator_address: str
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def from_env(environ=None) -> Optional[JobContext]:
+    """Parse the injected env; None when not running under the operator."""
+
+    e = environ if environ is not None else os.environ
+    if ENV_COORDINATOR not in e:
+        return None
+    return JobContext(
+        job_name=e.get(ENV_JOB_NAME, ""),
+        replica_type=e.get(ENV_REPLICA_TYPE, ""),
+        replica_index=int(e.get(ENV_REPLICA_INDEX, "0")),
+        process_id=int(e.get(ENV_PROCESS_ID, "0")),
+        num_processes=int(e.get(ENV_NUM_PROCESSES, "1")),
+        coordinator_address=e[ENV_COORDINATOR],
+    )
+
+
+def initialize(platform: Optional[str] = None) -> Optional[JobContext]:
+    """Join the job's collective world.  Call before any jax device use.
+
+    - single-process jobs (or no operator env): no-op, returns context
+      (or None) without touching jax.distributed.
+    - multi-process: ``jax.distributed.initialize(coordinator, n, pid)``;
+      on CPU the gloo collectives implementation is selected so
+      cross-process psum/allgather work in tests (the ICI-equivalent
+      path during local development; SURVEY.md §4 tier 3).
+    """
+
+    ctx = from_env()
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if ctx is None or ctx.num_processes <= 1:
+        return ctx
+
+    # Select gloo for the CPU client whenever we're multi-process.  The
+    # CPU backend exists even alongside TPU, and which platform wins is
+    # resolved inside jax (env/config/plugins) — keying off our own env
+    # would miss hosts that default to CPU without declaring it.  gloo
+    # only activates for cross-process CPU arrays, so this is a no-op on
+    # TPU-resolved jobs.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    jax.distributed.initialize(
+        coordinator_address=ctx.coordinator_address,
+        num_processes=ctx.num_processes,
+        process_id=ctx.process_id,
+    )
+    return ctx
