@@ -1,0 +1,74 @@
+"""Benchmark entry point — run by the driver on real TPU hardware.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: ResNet-50 training throughput per chip (examples/sec/chip), the
+BASELINE.md headline workload.  The reference publishes no numbers
+(BASELINE.json "published": {}), so vs_baseline compares against the
+locally recorded first-build number in BASELINE.md once it exists
+(stored in BENCH_BASELINE.json); until then vs_baseline=1.0 by
+definition.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.models import resnet50
+    from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+    from tf_operator_tpu.parallel.trainer import batchnorm_cross_entropy_loss
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"dp": n_dev})
+
+    batch_per_chip = int(os.environ.get("BENCH_BATCH_PER_CHIP", "64"))
+    global_batch = batch_per_chip * n_dev
+    rng = np.random.RandomState(0)
+    batch = {
+        "image": jnp.asarray(
+            rng.rand(global_batch, 224, 224, 3).astype(np.float32)
+        ),
+        "label": jnp.asarray(rng.randint(0, 1000, size=(global_batch,))),
+    }
+    trainer = Trainer(
+        resnet50(),
+        TrainerConfig(optimizer="sgd", learning_rate=0.1, momentum=0.9),
+        mesh,
+        batchnorm_cross_entropy_loss,
+        batch,
+    )
+    stats = trainer.benchmark(batch, steps=20, warmup=5)
+    per_chip = stats["examples_per_sec"] / n_dev
+
+    baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    vs = 1.0
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f).get("resnet50_examples_per_sec_per_chip")
+        if base:
+            vs = per_chip / base
+
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_examples_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "examples/sec/chip",
+                "vs_baseline": round(vs, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

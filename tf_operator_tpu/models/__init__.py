@@ -1,0 +1,13 @@
+"""Model zoo for the framework's examples and benchmarks.
+
+Covers the reference's target workloads (BASELINE.md configs): the
+dist-mnist CNN, ResNet-50 (MultiWorkerMirrored / Horovod configs), and
+the transformer family (BERT-base pretrain, T5-base) — all flax.linen,
+bfloat16 compute / float32 params, written for pjit sharding over the
+named mesh in tf_operator_tpu.parallel.
+"""
+
+from tf_operator_tpu.models.mnist import MnistCNN
+from tf_operator_tpu.models.resnet import ResNet, resnet18, resnet50
+
+__all__ = ["MnistCNN", "ResNet", "resnet18", "resnet50"]

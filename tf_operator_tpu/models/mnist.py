@@ -1,0 +1,37 @@
+"""MNIST CNN — the dist-mnist workload, TPU-native.
+
+Parity: the reference ships `examples/v1/dist-mnist/dist_mnist.py` (TF1
+between-graph replication over TF_CONFIG; SURVEY.md §2 "Examples:
+dist-mnist", §3.3) as its canonical e2e workload.  This is the same-size
+model as a flax module; data parallelism comes from the mesh sharding in
+parallel/trainer.py instead of PS/worker gRPC.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    """conv32-pool-conv64-pool-dense1024-dropout-dense10 (the classic
+    dist_mnist topology)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(1024, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,53 @@
+"""Parallelism layer: device meshes, sharding rules, sharded training.
+
+This is the TPU-native replacement for what the reference *enables* via
+cluster wiring (SURVEY.md §2b): data parallelism (MultiWorkerMirrored /
+Horovod+NCCL all-reduce) and parameter-server sharding become explicit
+`jax.sharding` layouts over a named device Mesh, with XLA inserting the
+collectives (all-reduce over ICI within a slice, DCN across slices).
+
+Axes convention (scaling-book style):
+  dp    — pure data parallelism (batch)
+  fsdp  — data parallelism with fully-sharded params/optimizer state
+          (the TPU-native translation of the reference's PS topology)
+  tp    — tensor parallelism (megatron-style sharded matmuls)
+  sp    — sequence/context parallelism (ring attention)
+  ep    — expert parallelism (MoE)
+"""
+
+from tf_operator_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_FSDP,
+    AXIS_SP,
+    AXIS_TP,
+    BATCH_AXES,
+    batch_sharding,
+    batch_spec,
+    make_mesh,
+    replicated,
+)
+from tf_operator_tpu.parallel.sharding import (
+    LOGICAL_RULES,
+    fsdp_shardings,
+    logical_shardings,
+)
+from tf_operator_tpu.parallel.trainer import Trainer, TrainerConfig
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_EP",
+    "AXIS_FSDP",
+    "AXIS_SP",
+    "AXIS_TP",
+    "BATCH_AXES",
+    "batch_sharding",
+    "batch_spec",
+    "make_mesh",
+    "replicated",
+    "LOGICAL_RULES",
+    "fsdp_shardings",
+    "logical_shardings",
+    "Trainer",
+    "TrainerConfig",
+]

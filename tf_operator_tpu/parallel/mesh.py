@@ -1,0 +1,109 @@
+"""Named device meshes.
+
+The reference scales by creating more pods and letting TF/NCCL discover
+peers (SURVEY.md §2c); here scale is a `jax.sharding.Mesh` whose axes
+name the parallelism dimensions, and every collective is an XLA op laid
+out over ICI/DCN.  One mesh serves single-chip, single-slice multi-chip,
+and (via `jax.distributed` + megascale env from the operator's bootstrap
+injection, bootstrap/tpu_env.py) multi-slice jobs unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+
+#: Canonical axis order.  Data-parallel-ish axes go first so that
+#: neighbouring devices (fastest-varying, best ICI locality) end up on
+#: the model axes (tp/sp) where collectives are in the critical path.
+AXIS_ORDER = (AXIS_DP, AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_TP)
+
+#: The global batch is sharded over every data-ish axis.
+BATCH_AXES = (AXIS_DP, AXIS_FSDP)
+
+
+def make_mesh(
+    shape: Optional[Mapping[str, int]] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh with the canonical named axes.
+
+    `shape` maps axis name → size; exactly one size may be -1 ("use all
+    remaining devices").  Missing axes get size 1, so downstream
+    PartitionSpecs can always name any canonical axis.  Default: all
+    devices on `dp`.
+    """
+
+    if devices is None:
+        devices = jax.devices()
+    ndev = len(devices)
+    shape = dict(shape or {AXIS_DP: ndev})
+    unknown = set(shape) - set(AXIS_ORDER)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {AXIS_ORDER}")
+
+    sizes: Dict[str, int] = {ax: int(shape.get(ax, 1)) for ax in AXIS_ORDER}
+    wild = [ax for ax, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one axis may be -1")
+    if wild:
+        known = math.prod(s for s in sizes.values() if s != -1)
+        if ndev % known:
+            raise ValueError(f"{ndev} devices not divisible by {known}")
+        sizes[wild[0]] = ndev // known
+    if math.prod(sizes.values()) != ndev:
+        raise ValueError(f"mesh shape {sizes} != {ndev} devices")
+
+    dims = tuple(sizes[ax] for ax in AXIS_ORDER)
+    if ndev == 1:
+        dev_array = np.array(devices).reshape(dims)
+    else:
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(
+                dims, devices=np.asarray(devices, dtype=object)
+            )
+        except Exception:
+            # On TPU a topology-aware layout is correctness-adjacent
+            # (tp/sp collectives must ride neighbouring ICI links) —
+            # never silently degrade there.
+            if devices[0].platform == "tpu":
+                raise
+            dev_array = np.array(devices).reshape(dims)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def batch_spec(extra: Sequence[Optional[str]] = ()) -> PartitionSpec:
+    """PartitionSpec for a [batch, ...] array: batch over dp+fsdp."""
+    return PartitionSpec(BATCH_AXES, *extra)
+
+
+def batch_sharding(mesh: Mesh, extra: Sequence[Optional[str]] = ()) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(extra))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    return mesh.shape[AXIS_DP] * mesh.shape[AXIS_FSDP]
+
+
+def local_batch_size(mesh: Mesh, global_batch: int) -> int:
+    n = data_parallel_size(mesh)
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by dp size {n}")
+    return global_batch // n
