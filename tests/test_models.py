@@ -1,0 +1,143 @@
+"""Transformer family (BERT / GPT / T5) on the virtual mesh: logical
+shardings resolve, train steps run, losses decrease, tp/sp really shard."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tf_operator_tpu.models import (
+    bert_tiny,
+    gpt_tiny,
+    mlm_loss,
+    lm_loss,
+    seq2seq_loss,
+    t5_tiny,
+)
+from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+
+VOCAB = 128
+
+
+def _ids(rng, b, s, vocab=VOCAB):
+    return jnp.asarray(rng.randint(0, vocab, size=(b, s)))
+
+
+def _spec_axes(sharding):
+    return [a for a in sharding.spec if a is not None]
+
+
+def test_bert_logical_sharding_and_training():
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    rng = np.random.RandomState(0)
+    ids = _ids(rng, 8, 16)
+    labels = jnp.where(jnp.asarray(rng.rand(8, 16)) < 0.15, ids, -100)
+    batch = {"input_ids": ids, "labels": labels}
+    model = bert_tiny(vocab_size=VOCAB, max_len=32)
+    tr = Trainer(
+        model,
+        TrainerConfig(learning_rate=1e-3),
+        mesh,
+        mlm_loss,
+        batch,
+        init_args=(ids,),
+        shardings="logical",
+    )
+    # tp really shards the MLP wi kernel (embed, mlp) -> (fsdp?, tp)
+    wi = tr.state.params["bert"]["layer_0"]["mlp"]["wi"]["kernel"]
+    leaf = getattr(wi, "value", wi)
+    assert "tp" in _spec_axes(leaf.sharding)
+    first = tr.train_step(tr.shard_batch(batch))
+    for _ in range(4):
+        last = tr.train_step(tr.shard_batch(batch))
+    assert float(last["loss"]) < float(first["loss"])
+
+
+def test_gpt_ring_attention_sp_training():
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    rng = np.random.RandomState(1)
+    ids = _ids(rng, 4, 64)
+    batch = {"input_ids": ids}
+    model = gpt_tiny(vocab_size=VOCAB, max_len=64, mesh=mesh)
+    assert model.cfg.sp_enabled
+    tr = Trainer(
+        model,
+        TrainerConfig(learning_rate=1e-3),
+        mesh,
+        lm_loss,
+        batch,
+        init_args=(ids,),
+        shardings="logical",
+    )
+    first = tr.train_step(tr.shard_batch(batch))
+    for _ in range(4):
+        last = tr.train_step(tr.shard_batch(batch))
+    assert float(last["loss"]) < float(first["loss"])
+
+
+def test_gpt_sp_matches_no_sp():
+    """Ring-attention training (sp=4) must match plain attention (sp=1)
+    numerically — same model, same data, same init."""
+
+    rng = np.random.RandomState(2)
+    ids = _ids(rng, 8, 32)
+    batch = {"input_ids": ids}
+    losses = {}
+    for label, shape in {"nosp": {"dp": 8}, "sp": {"dp": 2, "sp": 4}}.items():
+        mesh = make_mesh(shape)
+        model = gpt_tiny(vocab_size=VOCAB, max_len=32, mesh=mesh, dropout=0.0)
+        tr = Trainer(
+            model,
+            TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+            mesh,
+            lm_loss,
+            batch,
+            init_args=(ids,),
+            shardings="logical",
+            seed=7,
+        )
+        ms = [float(tr.train_step(tr.shard_batch(batch))["loss"]) for _ in range(3)]
+        losses[label] = ms
+    np.testing.assert_allclose(losses["nosp"], losses["sp"], rtol=2e-4, atol=2e-4)
+
+
+def test_t5_training_step():
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    rng = np.random.RandomState(3)
+    enc = _ids(rng, 8, 12)
+    dec = _ids(rng, 8, 10)
+    tgt = _ids(rng, 8, 10)
+    batch = {"encoder_ids": enc, "decoder_ids": dec, "targets": tgt}
+    model = t5_tiny(vocab_size=VOCAB)
+    tr = Trainer(
+        model,
+        TrainerConfig(learning_rate=1e-3),
+        mesh,
+        seq2seq_loss,
+        batch,
+        init_args=(enc, dec),
+        shardings="logical",
+    )
+    first = tr.train_step(tr.shard_batch(batch))
+    for _ in range(4):
+        last = tr.train_step(tr.shard_batch(batch))
+    assert float(last["loss"]) < float(first["loss"])
+    assert np.isfinite(float(last["loss"]))
+
+
+def test_bert_attention_mask_respected():
+    """Padding positions must not change unmasked positions' hidden
+    states (pre-LN encoder, mask broadcast check)."""
+
+    rng = np.random.RandomState(4)
+    ids = _ids(rng, 8, 16)
+    m = jnp.ones((8, 16), jnp.int32).at[:, 12:].set(0)
+    model = bert_tiny(vocab_size=VOCAB, max_len=32, dropout=0.0)
+    variables = model.init(jax.random.PRNGKey(0), ids, train=False)
+    a = model.apply(variables, ids, attention_mask=m, train=False)
+    ids2 = ids.at[:, 12:].set(7)  # change padded tokens
+    b = model.apply(variables, ids2, attention_mask=m, train=False)
+    np.testing.assert_allclose(
+        np.asarray(a[:, :12], np.float32), np.asarray(b[:, :12], np.float32), atol=1e-5
+    )

@@ -7,7 +7,30 @@ bfloat16 compute / float32 params, written for pjit sharding over the
 named mesh in tf_operator_tpu.parallel.
 """
 
+from tf_operator_tpu.models.bert import Bert, BertForPretraining, bert_base, bert_tiny, mlm_loss
+from tf_operator_tpu.models.gpt import CausalLM, gpt_small, gpt_tiny, lm_loss
 from tf_operator_tpu.models.mnist import MnistCNN
 from tf_operator_tpu.models.resnet import ResNet, resnet18, resnet50
+from tf_operator_tpu.models.t5 import T5, seq2seq_loss, t5_base, t5_tiny
+from tf_operator_tpu.models.transformer import TransformerConfig
 
-__all__ = ["MnistCNN", "ResNet", "resnet18", "resnet50"]
+__all__ = [
+    "Bert",
+    "BertForPretraining",
+    "bert_base",
+    "bert_tiny",
+    "mlm_loss",
+    "CausalLM",
+    "gpt_small",
+    "gpt_tiny",
+    "lm_loss",
+    "MnistCNN",
+    "ResNet",
+    "resnet18",
+    "resnet50",
+    "T5",
+    "seq2seq_loss",
+    "t5_base",
+    "t5_tiny",
+    "TransformerConfig",
+]

@@ -1,0 +1,154 @@
+"""BERT — masked-LM pretraining model.
+
+Parity target: BASELINE.md config 3, "BERT-base pretrain,
+ParameterServerStrategy, 2 PS + 4 workers".  The TPU-native translation
+(SURVEY.md §2b): PS-style sharded parameters become fsdp-sharded params
++ tp-sharded attention/MLP over the mesh; no parameter servers exist —
+XLA collectives move the shards.
+
+`bert_base()` matches the BERT-base shape (110M params).  The MLM loss
+helper masks tokens the standard way (15% positions, loss on masked
+positions only) but takes pre-masked batches — data pipelines own
+masking.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from tf_operator_tpu.models.transformer import (
+    ACT_HIDDEN,
+    Embed,
+    EncoderLayer,
+    LayerNorm,
+    TransformerConfig,
+    dense,
+    logical_constraint,
+    param_with_axes,
+)
+
+
+class Bert(nn.Module):
+    cfg: TransformerConfig
+    n_segments: int = 2
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,  # [B, S]
+        *,
+        segment_ids=None,
+        attention_mask=None,  # [B, S] 1 = real token
+        train: bool = False,
+    ):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        x = Embed(cfg, name="tok_embed")(input_ids)
+        pos = self.param(
+            "pos_embed",
+            param_with_axes(nn.initializers.normal(0.02), ("seq", "embed")),
+            (cfg.max_len, cfg.hidden),
+            jnp.float32,
+        )
+        x = x + pos[None, :s].astype(cfg.dtype)
+        if segment_ids is not None:
+            seg = self.param(
+                "seg_embed",
+                param_with_axes(nn.initializers.normal(0.02), ("stack", "embed")),
+                (self.n_segments, cfg.hidden),
+                jnp.float32,
+            )
+            x = x + jnp.take(seg, segment_ids, axis=0).astype(cfg.dtype)
+        x = LayerNorm(cfg, name="ln_embed")(x)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        x = logical_constraint(x, ACT_HIDDEN)
+
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        for i in range(cfg.n_layers):
+            x = EncoderLayer(cfg, name=f"layer_{i}")(x, mask=mask, train=train)
+        x = LayerNorm(cfg, name="ln_final")(x)
+        return x  # [B, S, hidden]
+
+class MlmHead(nn.Module):
+    """MLM head: transform + decode to vocab."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.cfg
+        y = dense(cfg.hidden, cfg, ("embed", "embed2"), name="mlm_transform")(hidden)
+        y = nn.gelu(y)
+        y = LayerNorm(cfg, name="mlm_ln")(y)
+        logits = dense(cfg.vocab_size, cfg, ("embed", "vocab"), name="mlm_decoder")(y)
+        return logits.astype(jnp.float32)
+
+
+class BertForPretraining(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, attention_mask=None, train: bool = False):
+        hidden = Bert(self.cfg, name="bert")(
+            input_ids, attention_mask=attention_mask, train=train
+        )
+        return MlmHead(self.cfg, name="mlm")(hidden)
+
+
+def bert_base(vocab_size: int = 30522, max_len: int = 512, mesh=None) -> BertForPretraining:
+    return BertForPretraining(
+        TransformerConfig(
+            vocab_size=vocab_size,
+            hidden=768,
+            n_heads=12,
+            head_dim=64,
+            n_layers=12,
+            mlp_dim=3072,
+            max_len=max_len,
+            mesh=mesh,
+        )
+    )
+
+
+def bert_tiny(vocab_size: int = 1024, max_len: int = 128, mesh=None, **kw) -> BertForPretraining:
+    return BertForPretraining(
+        TransformerConfig(
+            vocab_size=vocab_size,
+            hidden=128,
+            n_heads=4,
+            head_dim=32,
+            n_layers=2,
+            mlp_dim=512,
+            max_len=max_len,
+            mesh=mesh,
+            **kw,
+        )
+    )
+
+
+def mlm_loss(params, state, batch: Dict, rng) -> Tuple[jax.Array, Dict]:
+    """batch: input_ids (pre-masked), labels (-100 = unmasked position),
+    optional attention_mask."""
+
+    logits = state.apply_fn(
+        {"params": params},
+        batch["input_ids"],
+        attention_mask=batch.get("attention_mask"),
+        train=True,
+        rngs={"dropout": rng},
+    )
+    labels = batch["labels"]
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+    denom = jnp.maximum(valid.sum(), 1)
+    loss = jnp.where(valid, per_tok, 0.0).sum() / denom
+    acc = jnp.where(valid, logits.argmax(-1) == safe, False).sum() / denom
+    return loss, {"metrics": {"mlm_accuracy": acc}}

@@ -1,0 +1,98 @@
+"""Decoder-only causal LM — the long-context flagship.
+
+The reference has no long-context story (SURVEY.md §2b: SP/CP "absent");
+this framework makes it first-class: when the config's mesh has sp > 1,
+self-attention runs as exact ring attention over the sequence shards
+(ops/ring_attention.py), so context length scales with the sp axis while
+per-chip KV memory stays O(S/sp).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from tf_operator_tpu.models.transformer import (
+    ACT_HIDDEN,
+    DecoderLayer,
+    Embed,
+    LayerNorm,
+    TransformerConfig,
+    logical_constraint,
+    param_with_axes,
+)
+
+
+class CausalLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, train: bool = False):
+        cfg = self.cfg
+        _, s = input_ids.shape
+        embed = Embed(cfg, name="tok_embed")
+        x = embed(input_ids)
+        pos = self.param(
+            "pos_embed",
+            param_with_axes(nn.initializers.normal(0.02), ("seq", "embed")),
+            (cfg.max_len, cfg.hidden),
+            jnp.float32,
+        )
+        x = x + pos[None, :s].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        x = logical_constraint(x, ACT_HIDDEN)
+        for i in range(cfg.n_layers):
+            x = DecoderLayer(cfg, cross=False, name=f"layer_{i}")(x, train=train)
+        x = LayerNorm(cfg, rms=True, name="ln_final")(x)
+        # tied LM head: decode with the embedding table
+        logits = embed.attend(x)
+        return logits.astype(jnp.float32)
+
+
+def gpt_small(vocab_size: int = 50257, max_len: int = 1024, mesh=None) -> CausalLM:
+    """GPT-2 small shape (124M)."""
+    return CausalLM(
+        TransformerConfig(
+            vocab_size=vocab_size,
+            hidden=768,
+            n_heads=12,
+            head_dim=64,
+            n_layers=12,
+            mlp_dim=3072,
+            max_len=max_len,
+            mesh=mesh,
+        )
+    )
+
+
+def gpt_tiny(vocab_size: int = 1024, max_len: int = 256, mesh=None, **kw) -> CausalLM:
+    return CausalLM(
+        TransformerConfig(
+            vocab_size=vocab_size,
+            hidden=128,
+            n_heads=4,
+            head_dim=32,
+            n_layers=2,
+            mlp_dim=512,
+            max_len=max_len,
+            mesh=mesh,
+            **kw,
+        )
+    )
+
+
+def lm_loss(params, state, batch: Dict, rng) -> Tuple[jax.Array, Dict]:
+    """Next-token loss; batch: input_ids [B, S]."""
+
+    logits = state.apply_fn(
+        {"params": params}, batch["input_ids"], train=True, rngs={"dropout": rng}
+    )
+    targets = batch["input_ids"][:, 1:]
+    logits = logits[:, :-1]
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+    acc = (logits.argmax(-1) == targets).mean()
+    return loss, {"metrics": {"token_accuracy": acc}}

@@ -1,0 +1,175 @@
+"""T5 encoder-decoder.
+
+Parity target: BASELINE.md config 5, "T5-base JAX/Flax multi-host via
+jax.distributed on a v5e-16 slice" — the one reference config that was
+already JAX-shaped.  Standard T5 architecture: RMSNorm pre-LN blocks,
+relative position bias (shared across layers, per T5), ReLU MLP, tied
+embedding/LM head with 1/sqrt(hidden) logit scaling.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from tf_operator_tpu.models.transformer import (
+    ACT_HIDDEN,
+    DecoderLayer,
+    Embed,
+    EncoderLayer,
+    LayerNorm,
+    TransformerConfig,
+    logical_constraint,
+    param_with_axes,
+)
+
+
+def _relative_position_bucket(rel_pos, bidirectional: bool, num_buckets: int, max_distance: int):
+    """T5's log-bucketed relative positions (public algorithm)."""
+
+    ret = 0
+    n = -rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret += (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class RelativePositionBias(nn.Module):
+    cfg: TransformerConfig
+    bidirectional: bool = True
+    num_buckets: int = 32
+    max_distance: int = 128
+
+    @nn.compact
+    def __call__(self, sq: int, sk: int):
+        table = self.param(
+            "rel_embedding",
+            param_with_axes(nn.initializers.normal(0.02), ("relpos_buckets", "heads")),
+            (self.num_buckets, self.cfg.n_heads),
+            jnp.float32,
+        )
+        ctx = jnp.arange(sq)[:, None]
+        mem = jnp.arange(sk)[None, :]
+        buckets = _relative_position_bucket(
+            mem - ctx, self.bidirectional, self.num_buckets, self.max_distance
+        )
+        bias = jnp.take(table, buckets, axis=0)  # [Sq, Sk, H]
+        return jnp.transpose(bias, (2, 0, 1))[None]  # [1, H, Sq, Sk]
+
+
+class T5(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        encoder_ids,  # [B, Se]
+        decoder_ids,  # [B, Sd]
+        *,
+        encoder_mask: Optional[jax.Array] = None,  # [B, Se] 1 = real
+        train: bool = False,
+    ):
+        cfg = self.cfg
+        embed = Embed(cfg, name="shared_embed")
+        enc_bias = RelativePositionBias(cfg, bidirectional=True, name="enc_relpos")(
+            encoder_ids.shape[1], encoder_ids.shape[1]
+        )
+        dec_bias = RelativePositionBias(cfg, bidirectional=False, name="dec_relpos")(
+            decoder_ids.shape[1], decoder_ids.shape[1]
+        )
+
+        # encoder
+        x = embed(encoder_ids)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        x = logical_constraint(x, ACT_HIDDEN)
+        mask = None
+        if encoder_mask is not None:
+            mask = encoder_mask[:, None, None, :].astype(bool)
+        for i in range(cfg.n_layers):
+            x = EncoderLayer(cfg, rms=True, activation="relu", name=f"enc_{i}")(
+                x, mask=mask, bias=enc_bias, train=train
+            )
+        enc = LayerNorm(cfg, rms=True, name="enc_ln_final")(x)
+
+        # decoder
+        y = embed(decoder_ids)
+        y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
+        y = logical_constraint(y, ACT_HIDDEN)
+        cross_mask = mask
+        for i in range(cfg.n_layers):
+            y = DecoderLayer(cfg, cross=True, name=f"dec_{i}")(
+                y, enc=enc, self_bias=dec_bias, enc_mask=cross_mask, train=train
+            )
+        y = LayerNorm(cfg, rms=True, name="dec_ln_final")(y)
+        logits = embed.attend(y) / jnp.sqrt(jnp.asarray(cfg.hidden, y.dtype))
+        return logits.astype(jnp.float32)
+
+
+def t5_base(vocab_size: int = 32128, mesh=None) -> T5:
+    return T5(
+        TransformerConfig(
+            vocab_size=vocab_size,
+            hidden=768,
+            n_heads=12,
+            head_dim=64,
+            n_layers=12,
+            mlp_dim=3072,
+            max_len=512,
+            mesh=mesh,
+        )
+    )
+
+
+def t5_tiny(vocab_size: int = 1024, mesh=None, **kw) -> T5:
+    return T5(
+        TransformerConfig(
+            vocab_size=vocab_size,
+            hidden=128,
+            n_heads=4,
+            head_dim=32,
+            n_layers=2,
+            mlp_dim=512,
+            max_len=128,
+            mesh=mesh,
+            **kw,
+        )
+    )
+
+
+def seq2seq_loss(params, state, batch: Dict, rng) -> Tuple[jax.Array, Dict]:
+    """batch: encoder_ids, decoder_ids (shifted right), targets,
+    optional encoder_mask, target_mask (1 = count in loss)."""
+
+    logits = state.apply_fn(
+        {"params": params},
+        batch["encoder_ids"],
+        batch["decoder_ids"],
+        encoder_mask=batch.get("encoder_mask"),
+        train=True,
+        rngs={"dropout": rng},
+    )
+    targets = batch["targets"]
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    tmask = batch.get("target_mask")
+    if tmask is None:
+        tmask = jnp.ones_like(targets)
+    denom = jnp.maximum(tmask.sum(), 1)
+    loss = (per_tok * tmask).sum() / denom
+    acc = ((logits.argmax(-1) == targets) * tmask).sum() / denom
+    return loss, {"metrics": {"token_accuracy": acc}}

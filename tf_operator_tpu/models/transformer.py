@@ -1,0 +1,203 @@
+"""Shared transformer components, written for GSPMD sharding.
+
+Every parameter carries logical-axis metadata
+(`nn.with_logical_partitioning`) so the trainer can lay the model out
+over the named mesh via parallel/sharding.py's LOGICAL_RULES:
+megatron-style tensor parallelism (heads/mlp/vocab → tp), ZeRO-style
+param sharding (embed → fsdp), sequence parallelism (seq → sp, with
+exact ring attention from ops/ring_attention.py).
+
+These components back the BERT (models/bert.py), T5 (models/t5.py) and
+causal-LM (models/gpt.py) families — the reference's BERT/T5 target
+workloads (BASELINE.md configs 3 and 5) plus the long-context flagship.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from tf_operator_tpu.ops import dot_product_attention, ring_attention
+
+param_with_axes = nn.with_logical_partitioning
+logical_constraint = nn.with_logical_constraint
+
+ACT_HIDDEN = ("batch", "seq", "act_embed")
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32128
+    hidden: int = 768
+    n_heads: int = 12
+    head_dim: int = 64
+    n_layers: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dropout: float = 0.1
+    dtype: Any = jnp.bfloat16
+    # sequence parallelism: mesh to run ring attention over (None or
+    # sp=1 → plain fused attention)
+    mesh: Optional[Mesh] = None
+
+    @property
+    def sp_enabled(self) -> bool:
+        return self.mesh is not None and self.mesh.shape.get("sp", 1) > 1
+
+
+def dense(features, cfg: TransformerConfig, axes, name=None, use_bias=True):
+    n_feature_dims = len(features) if isinstance(features, (tuple, list)) else 1
+    return nn.DenseGeneral(
+        features,
+        dtype=cfg.dtype,
+        use_bias=use_bias,
+        kernel_init=param_with_axes(nn.initializers.lecun_normal(), axes),
+        bias_init=param_with_axes(nn.initializers.zeros_init(), axes[-n_feature_dims:]),
+        name=name,
+    )
+
+
+class LayerNorm(nn.Module):
+    cfg: TransformerConfig
+    use_bias: bool = True  # False → RMSNorm-ish (T5 uses RMSNorm)
+    rms: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        if self.rms:
+            return nn.RMSNorm(
+                dtype=self.cfg.dtype,
+                scale_init=param_with_axes(nn.initializers.ones_init(), ("norm",)),
+            )(x)
+        return nn.LayerNorm(
+            dtype=self.cfg.dtype,
+            use_bias=self.use_bias,
+            scale_init=param_with_axes(nn.initializers.ones_init(), ("norm",)),
+            bias_init=param_with_axes(nn.initializers.zeros_init(), ("norm",)),
+        )(x)
+
+
+class Embed(nn.Module):
+    """Token embedding with optional logit-tying (attend method)."""
+
+    cfg: TransformerConfig
+    features: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, ids):
+        cfg = self.cfg
+        table = self.param(
+            "embedding",
+            param_with_axes(nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, self.features or cfg.hidden),
+            jnp.float32,
+        )
+        return jnp.take(table, ids, axis=0).astype(cfg.dtype)
+
+    def attend(self, x):
+        table = self.get_variable("params", "embedding")
+        value = getattr(table, "value", table)  # unbox nn.Partitioned
+        return jnp.einsum("bse,ve->bsv", x, value.astype(x.dtype))
+
+
+class MultiHeadAttention(nn.Module):
+    """Self- or cross-attention; ring attention when the config's mesh
+    has sp > 1 (self-attention only — KV rotate around the ring)."""
+
+    cfg: TransformerConfig
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, kv: Optional[jax.Array] = None, mask=None, bias=None, train=False):
+        cfg = self.cfg
+        is_self = kv is None
+        kv_in = x if is_self else kv
+        h, d = cfg.n_heads, cfg.head_dim
+        q = dense((h, d), cfg, ("embed", "heads", "kv"), name="query", use_bias=True)(x)
+        k = dense((h, d), cfg, ("embed", "heads", "kv"), name="key", use_bias=True)(kv_in)
+        v = dense((h, d), cfg, ("embed", "heads", "kv"), name="value", use_bias=True)(kv_in)
+        # [B,S,H,D] -> [B,H,S,D]; heads over tp, seq over sp
+        q, k, v = (jnp.transpose(a, (0, 2, 1, 3)) for a in (q, k, v))
+        q, k, v = (
+            logical_constraint(a, ("batch", "act_heads", "seq", "act_kv")) for a in (q, k, v)
+        )
+        use_ring = cfg.sp_enabled and is_self and bias is None and mask is None
+        if use_ring:
+            out = ring_attention(q, k, v, cfg.mesh, causal=self.causal)
+        else:
+            out = dot_product_attention(q, k, v, causal=self.causal, bias=bias, mask=mask)
+        out = jnp.transpose(out, (0, 2, 1, 3))  # [B,S,H,D]
+        out = nn.DenseGeneral(
+            cfg.hidden,
+            axis=(-2, -1),
+            dtype=cfg.dtype,
+            kernel_init=param_with_axes(nn.initializers.lecun_normal(), ("heads", "kv", "embed")),
+            bias_init=param_with_axes(nn.initializers.zeros_init(), ("embed",)),
+            name="out",
+        )(out)
+        out = nn.Dropout(cfg.dropout, deterministic=not train)(out)
+        return logical_constraint(out, ACT_HIDDEN)
+
+
+class MlpBlock(nn.Module):
+    cfg: TransformerConfig
+    activation: str = "gelu"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        cfg = self.cfg
+        y = dense(cfg.mlp_dim, cfg, ("embed", "mlp"), name="wi")(x)
+        y = logical_constraint(y, ("batch", "seq", "act_mlp"))
+        y = nn.gelu(y) if self.activation == "gelu" else nn.relu(y)
+        y = dense(cfg.hidden, cfg, ("mlp", "embed"), name="wo")(y)
+        y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
+        return logical_constraint(y, ACT_HIDDEN)
+
+
+class EncoderLayer(nn.Module):
+    """Pre-LN encoder block (BERT here is pre-LN — a deliberate
+    TPU-era modernisation over the original post-LN; trains stably in
+    bf16 without warmup gymnastics).  `rms`/`activation` give the T5
+    flavour (RMSNorm + relu)."""
+
+    cfg: TransformerConfig
+    rms: bool = False
+    activation: str = "gelu"
+
+    @nn.compact
+    def __call__(self, x, mask=None, bias=None, train=False):
+        cfg = self.cfg
+        y = LayerNorm(cfg, rms=self.rms, name="ln_attn")(x)
+        x = x + MultiHeadAttention(cfg, name="attn")(y, mask=mask, bias=bias, train=train)
+        y = LayerNorm(cfg, rms=self.rms, name="ln_mlp")(x)
+        x = x + MlpBlock(cfg, activation=self.activation, name="mlp")(y, train=train)
+        return logical_constraint(x, ACT_HIDDEN)
+
+
+class DecoderLayer(nn.Module):
+    """Pre-LN decoder block: causal self-attention (+ optional
+    cross-attention for encoder-decoder models)."""
+
+    cfg: TransformerConfig
+    cross: bool = False
+
+    @nn.compact
+    def __call__(self, x, enc=None, self_bias=None, enc_mask=None, train=False):
+        cfg = self.cfg
+        y = LayerNorm(cfg, rms=True, name="ln_self")(x)
+        x = x + MultiHeadAttention(cfg, causal=True, name="self_attn")(
+            y, bias=self_bias, train=train
+        )
+        if self.cross:
+            y = LayerNorm(cfg, rms=True, name="ln_cross")(x)
+            x = x + MultiHeadAttention(cfg, name="cross_attn")(
+                y, kv=enc, mask=enc_mask, train=train
+            )
+        y = LayerNorm(cfg, rms=True, name="ln_mlp")(x)
+        x = x + MlpBlock(cfg, activation="relu", name="mlp")(y, train=train)
+        return logical_constraint(x, ACT_HIDDEN)

@@ -1,0 +1,12 @@
+"""TPU-native ops: distributed attention and (later) pallas kernels.
+
+The reference contains no kernels (it is a control plane; SURVEY.md §0)
+— this package is where the rebuild's first-class long-context and
+distributed compute path lives (ring attention over the sp mesh axis,
+fused attention for single-chip hot paths).
+"""
+
+from tf_operator_tpu.ops.attention import dot_product_attention
+from tf_operator_tpu.ops.ring_attention import ring_attention
+
+__all__ = ["dot_product_attention", "ring_attention"]

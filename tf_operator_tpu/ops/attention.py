@@ -1,0 +1,52 @@
+"""Plain (single-device / XLA-fused) scaled dot-product attention.
+
+Reference semantics for ring_attention and the fallback path when the
+mesh's sp axis is 1.  float32 softmax accumulation regardless of input
+dtype (bf16-safe), additive-mask + causal support, no data-dependent
+shapes — XLA fuses this whole block into the surrounding matmuls.
+
+Layout contract (all attention in this framework): [batch, heads, seq,
+head_dim].
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    bias: Optional[jax.Array] = None,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """q,k,v: [B, H, S, D] (k/v seq may differ for cross-attention).
+
+    `bias`: broadcastable to [B, H, Sq, Sk], added to logits (T5 relative
+    position bias).  `mask`: broadcastable boolean, True = attend.
+    """
+
+    *_, sq, d = q.shape
+    sk = k.shape[-2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, neg)
+    if causal:
+        qpos = jnp.arange(sq)[:, None]
+        kpos = jnp.arange(sk)[None, :]
+        logits = jnp.where(qpos >= kpos, logits, neg)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", weights.astype(v.dtype), v, preferred_element_type=jnp.float32
+    ).astype(v.dtype)

@@ -1,0 +1,141 @@
+"""Ring attention: exact attention over a sequence sharded on the `sp`
+mesh axis.
+
+Long-context is first-class in this framework (the reference has no
+sequence-length story at all — SURVEY.md §2b calls it absent).  Design
+is the standard ring schedule (Liu et al.-style, re-derived here):
+
+- Each sp shard holds Q/K/V for its contiguous sequence chunk.
+- K/V blocks rotate around the ring via `lax.ppermute` (neighbour
+  ICI hops only — no all-gather, so KV memory stays O(S/n) per chip).
+- Each hop combines the local block with a *streaming softmax*
+  (flash-attention-style running max / normaliser in float32), so the
+  result is exact attention, not an approximation.
+- Causal masking is computed from global chunk offsets; fully-masked
+  blocks still flow through the ring (uniform control flow — XLA needs
+  every device to execute the same program) but contribute zero weight.
+
+Communication pattern: n-1 ppermute hops of the K/V block, overlapping
+with compute under XLA's async collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tf_operator_tpu.ops.attention import dot_product_attention
+
+_NEG = float(jnp.finfo(jnp.float32).min)
+
+
+def _ring_block(
+    q: jax.Array,  # [B,H,Sq,D] local queries (f32 scores below)
+    k: jax.Array,
+    v: jax.Array,
+    m: jax.Array,  # [B,H,Sq,1] running max
+    l: jax.Array,  # [B,H,Sq,1] running normaliser
+    o: jax.Array,  # [B,H,Sq,D] running (unnormalised) output, f32
+    q_off: jax.Array,  # scalar: global offset of the local Q chunk
+    k_off: jax.Array,  # scalar: global offset of the current K/V block
+    causal: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_off + jnp.arange(q.shape[-2])[:, None]
+        kpos = k_off + jnp.arange(k.shape[-2])[None, :]
+        s = jnp.where(qpos >= kpos, s, _NEG)
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    # guard: a fully-masked row has m_new == _NEG; exp(_NEG - _NEG)=1
+    # would pollute l, so clamp the shift for masked rows
+    p = jnp.exp(s - m_new)
+    p = jnp.where(s <= _NEG / 2, 0.0, p)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + p.sum(axis=-1, keepdims=True)
+    o = o * corr + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+    return m_new, l, o
+
+
+def _ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool,
+) -> jax.Array:
+    """Runs inside shard_map: q,k,v are the local [B,H,Sq,D] shards."""
+
+    my = lax.axis_index(axis_name)
+    sq = q.shape[-2]
+    qf = q  # keep native dtype for the MXU; scores accumulate f32
+    # carries derived from q so they inherit its varying manual axes
+    # (shard_map VMA checking rejects unvarying scan carries)
+    m0 = jnp.full_like(q[..., :1], _NEG, dtype=jnp.float32)
+    l0 = jnp.zeros_like(q[..., :1], dtype=jnp.float32)
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)
+    q_off = my * sq
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def body(carry, i):
+        k_blk, v_blk, m, l, o = carry
+        # after i hops we hold the block that started (my - i) shards back
+        src = (my - i) % axis_size
+        m, l, o = _ring_block(qf, k_blk, v_blk, m, l, o, q_off, src * sq, causal)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m, l, o), None
+
+    # n-1 hops inside the scan; the last block needs no onward permute
+    (k_blk, v_blk, m, l, o), _ = lax.scan(
+        body, (k, v, m0, l0, o0), jnp.arange(axis_size - 1)
+    )
+    last_src = (my - (axis_size - 1)) % axis_size
+    m, l, o = _ring_block(qf, k_blk, v_blk, m, l, o, q_off, last_src * sq, causal)
+    # causal rows always attend to at least themselves, so l > 0; the
+    # maximum guards the (non-causal, all-masked) degenerate case
+    out = o / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    axis_name: str = "sp",
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+    heads_axis: Optional[str] = "tp",
+) -> jax.Array:
+    """Exact attention with sequence sharded over `axis_name`.
+
+    q,k,v: GLOBAL [B, H, S, D] arrays (jit-traced values are fine —
+    shard_map re-shards per the specs).  When the sp axis is 1 this
+    degrades to plain fused attention with identical semantics.
+    """
+
+    if mesh.shape[axis_name] <= 1:
+        return dot_product_attention(q, k, v, causal=causal)
+
+    spec = P(batch_axes, heads_axis, axis_name, None)
+    local = functools.partial(
+        _ring_attention_local,
+        axis_name=axis_name,
+        axis_size=mesh.shape[axis_name],
+        causal=causal,
+    )
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

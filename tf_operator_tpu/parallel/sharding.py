@@ -28,8 +28,10 @@ from tf_operator_tpu.parallel.mesh import AXIS_EP, AXIS_FSDP, AXIS_SP, AXIS_TP
 #: batch rides dp+fsdp; embed shards over fsdp (ZeRO-3 style); heads/mlp
 #: shard over tp (megatron); sequence over sp; experts over ep.
 LOGICAL_RULES: Tuple[Tuple[str, Any], ...] = (
+    # -- parameter axes ----------------------------------------------------
     ("batch", ("dp", "fsdp")),
     ("embed", AXIS_FSDP),
+    ("embed2", None),  # second dim of square hidden-to-hidden kernels
     ("mlp", AXIS_TP),
     ("heads", AXIS_TP),
     ("kv", None),
@@ -38,6 +40,13 @@ LOGICAL_RULES: Tuple[Tuple[str, Any], ...] = (
     ("expert", AXIS_EP),
     ("stack", None),
     ("norm", None),
+    ("relpos_buckets", None),
+    # -- activation axes (distinct names: activations never shard their
+    # feature dim over fsdp — that axis is for *param* ZeRO-sharding) ------
+    ("act_embed", None),
+    ("act_heads", AXIS_TP),
+    ("act_kv", None),
+    ("act_mlp", AXIS_TP),
 )
 
 #: Params smaller than this stay replicated under the FSDP auto-rule
@@ -97,4 +106,4 @@ def logical_shardings(
     import flax.linen as nn
 
     specs = nn.get_partition_spec(abstract_tree)
-    return nn.logical_to_mesh_sharding(specs, mesh, dict(rules))
+    return nn.logical_to_mesh_sharding(specs, mesh, list(rules))

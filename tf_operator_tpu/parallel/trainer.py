@@ -27,7 +27,7 @@ from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from tf_operator_tpu.parallel.mesh import batch_sharding
-from tf_operator_tpu.parallel.sharding import fsdp_shardings
+from tf_operator_tpu.parallel.sharding import LOGICAL_RULES, fsdp_shardings
 
 Batch = Dict[str, jax.Array]
 #: loss_fn(params, state, batch, rng) -> (loss, aux); aux: {"metrics":
@@ -65,7 +65,12 @@ def make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
     if cfg.optimizer == "sgd":
         opt = optax.sgd(sched, momentum=cfg.momentum)
     else:
-        opt = optax.adamw(sched, weight_decay=cfg.weight_decay)
+        # decay only matmul kernels — never norm scales/biases/embeddings'
+        # 1-d params (standard transformer pretraining practice)
+        def decay_mask(params):
+            return jax.tree_util.tree_map(lambda p: jnp.ndim(p) > 1, params)
+
+        opt = optax.adamw(sched, weight_decay=cfg.weight_decay, mask=decay_mask)
     return optax.chain(optax.clip_by_global_norm(cfg.grad_clip), opt)
 
 
@@ -73,8 +78,10 @@ class Trainer:
     """Builds a sharded TrainState and a jitted, donated train step.
 
     `shardings="fsdp"` applies the auto-rule to params and opt state;
+    `shardings="logical"` reads the model's logical-axis annotations
+    (transformer family) and maps them through LOGICAL_RULES;
     `shardings=tree` uses an explicit NamedSharding tree for the whole
-    TrainState (e.g. from logical rules, parallel/sharding.py).
+    TrainState.
     """
 
     def __init__(
@@ -113,6 +120,9 @@ class Trainer:
                 model_state=dict(variables),
             )
 
+        import flax.linen as nn
+
+        self._rules = list(LOGICAL_RULES)
         abstract = jax.eval_shape(init_state)
         if shardings == "fsdp":
             replicated_tree = jax.tree_util.tree_map(
@@ -122,10 +132,14 @@ class Trainer:
                 params=fsdp_shardings(abstract.params, mesh),
                 opt_state=fsdp_shardings(abstract.opt_state, mesh),
             )
+        elif shardings == "logical":
+            from tf_operator_tpu.parallel.sharding import logical_shardings
+
+            self.state_sharding = logical_shardings(abstract, mesh)
         else:
             self.state_sharding = shardings
 
-        with mesh:
+        with mesh, nn.logical_axis_rules(self._rules):
             self.state: TrainState = jax.jit(init_state, out_shardings=self.state_sharding)()
 
         self._step = self._build_step()
@@ -159,7 +173,9 @@ class Trainer:
         )
 
     def train_step(self, batch: Batch) -> Dict[str, jax.Array]:
-        with self.mesh:
+        import flax.linen as nn
+
+        with self.mesh, nn.logical_axis_rules(self._rules):
             self.state, metrics = self._step(self.state, batch)
         return metrics
 
