@@ -1,17 +1,51 @@
 """Unit tests for the job-controller runtime primitives: work queue,
-expectations, metrics (SURVEY.md §2 "Generic job-controller runtime")."""
+expectations, metrics (SURVEY.md §2 "Generic job-controller runtime").
+
+One contract suite runs against BOTH implementations — the Python twins
+and the native C++ runtime (tf_operator_tpu/native) — keeping them in
+lockstep; the controller can be backed by either.
+"""
 
 import threading
 import time
 
+import pytest
+
+from tf_operator_tpu import native
 from tf_operator_tpu.controller.expectations import Expectations
 from tf_operator_tpu.controller.workqueue import WorkQueue
 from tf_operator_tpu.utils.metrics import Metrics
 
+_HAVE_NATIVE = native.available()
+_skip_native = pytest.mark.skipif(
+    not _HAVE_NATIVE, reason=f"native runtime unavailable: {native.load_error()}"
+)
+
+WQ_IMPLS = [
+    pytest.param(WorkQueue, id="python"),
+    pytest.param(native.NativeWorkQueue if _HAVE_NATIVE else None,
+                 id="native", marks=_skip_native),
+]
+EXP_IMPLS = [
+    pytest.param(Expectations, id="python"),
+    pytest.param(native.NativeExpectations if _HAVE_NATIVE else None,
+                 id="native", marks=_skip_native),
+]
+
+
+@pytest.fixture(params=WQ_IMPLS)
+def WQ(request):
+    return request.param
+
+
+@pytest.fixture(params=EXP_IMPLS)
+def EXP(request):
+    return request.param
+
 
 class TestWorkQueue:
-    def test_dedup(self):
-        q = WorkQueue()
+    def test_dedup(self, WQ):
+        q = WQ()
         q.add("a")
         q.add("a")
         q.add("b")
@@ -19,8 +53,8 @@ class TestWorkQueue:
         assert q.get(0) == "b"
         assert q.get(0) is None
 
-    def test_dirty_reprocess(self):
-        q = WorkQueue()
+    def test_dirty_reprocess(self, WQ):
+        q = WQ()
         q.add("a")
         key = q.get(0)
         q.add("a")  # re-added while processing → dirty
@@ -30,14 +64,14 @@ class TestWorkQueue:
         q.done("a")
         assert q.get(0) is None
 
-    def test_add_after(self):
-        q = WorkQueue()
+    def test_add_after(self, WQ):
+        q = WQ()
         q.add_after("a", 0.05)
         assert q.get(0) is None
         assert q.get(0.5) == "a"
 
-    def test_rate_limited_backoff_grows(self):
-        q = WorkQueue(base_delay=0.01, max_delay=1.0)
+    def test_rate_limited_backoff_grows(self, WQ):
+        q = WQ(base_delay=0.01, max_delay=1.0)
         d1 = q.add_rate_limited("a")
         d2 = q.add_rate_limited("a")
         d3 = q.add_rate_limited("a")
@@ -45,8 +79,8 @@ class TestWorkQueue:
         q.forget("a")
         assert q.num_requeues("a") == 0
 
-    def test_get_blocks_until_add(self):
-        q = WorkQueue()
+    def test_get_blocks_until_add(self, WQ):
+        q = WQ()
         got = []
 
         def worker():
@@ -59,8 +93,8 @@ class TestWorkQueue:
         t.join(timeout=2.0)
         assert got == ["x"]
 
-    def test_shutdown_unblocks(self):
-        q = WorkQueue()
+    def test_shutdown_unblocks(self, WQ):
+        q = WQ()
         got = []
         t = threading.Thread(target=lambda: got.append(q.get(None)))
         t.start()
@@ -71,8 +105,8 @@ class TestWorkQueue:
 
 
 class TestExpectations:
-    def test_satisfied_lifecycle(self):
-        e = Expectations()
+    def test_satisfied_lifecycle(self, EXP):
+        e = EXP()
         assert e.satisfied("k")
         e.expect_creations("k", 2)
         assert not e.satisfied("k")
@@ -81,8 +115,8 @@ class TestExpectations:
         e.creation_observed("k")
         assert e.satisfied("k")
 
-    def test_deletions_tracked_separately(self):
-        e = Expectations()
+    def test_deletions_tracked_separately(self, EXP):
+        e = EXP()
         e.expect_creations("k", 1)
         e.expect_deletions("k", 1)
         e.creation_observed("k")
@@ -90,15 +124,15 @@ class TestExpectations:
         e.deletion_observed("k")
         assert e.satisfied("k")
 
-    def test_timeout_expires(self):
-        e = Expectations(timeout_s=0.01)
+    def test_timeout_expires(self, EXP):
+        e = EXP(timeout_s=0.01)
         e.expect_creations("k", 5)
         assert not e.satisfied("k")
         time.sleep(0.02)
         assert e.satisfied("k")  # assume events lost; self-heal
 
-    def test_extra_observations_ignored(self):
-        e = Expectations()
+    def test_extra_observations_ignored(self, EXP):
+        e = EXP()
         e.creation_observed("k")  # no expectation registered
         assert e.satisfied("k")
         assert e.pending("k") == (0, 0)

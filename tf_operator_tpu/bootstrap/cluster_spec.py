@@ -78,6 +78,9 @@ def gen_tf_config(
     as a reference variant for PS-style jobs; [U] detail).
     """
 
+    native_cfg = _gen_tf_config_native(job, rtype, index, resolve, sparse)
+    if native_cfg is not None:
+        return native_cfg
     cluster = gen_cluster_spec(job, resolve)
     if sparse and rtype in (ReplicaType.WORKER, ReplicaType.EVALUATOR):
         own = cluster[rtype.lower_name][index]
@@ -91,6 +94,42 @@ def gen_tf_config(
         "environment": "cloud",
     }
     return json.dumps(config, sort_keys=True)
+
+
+def _gen_tf_config_native(
+    job: TPUJob,
+    rtype: ReplicaType,
+    index: int,
+    resolve: AddressResolver,
+    sparse: bool,
+) -> Optional[str]:
+    """Native (C++) fast path: only for the DNS resolver, whose address
+    format the native generator reproduces.  Returns None to fall back."""
+
+    if resolve is not dns_resolver:
+        return None
+    try:
+        from tf_operator_tpu.native import available, gen_tf_config_native
+    except Exception:  # noqa: BLE001 - import cycle / build issues
+        return None
+    if not available():
+        return None
+    desc = ",".join(
+        f"{t.lower_name}={int(job.spec.replica_specs[t].replicas or 0)}"
+        f":{_replica_port(job, t)}"
+        for t in job.spec.ordered_types()
+    )
+    try:
+        return gen_tf_config_native(
+            job.metadata.name,
+            job.metadata.namespace,
+            desc,
+            rtype.lower_name,
+            index,
+            sparse,
+        )
+    except ValueError:
+        return None
 
 
 def coordinator_replica(job: TPUJob) -> Optional[ReplicaType]:

@@ -35,12 +35,28 @@ class TPUJobController:
         config: Optional[ReconcilerConfig] = None,
         metrics: Optional[Metrics] = None,
         max_sync_retries: int = 20,
+        use_native: Optional[bool] = None,
     ):
         self.jobs = job_store
         self.backend = backend
-        self.queue = WorkQueue()
-        self.pod_exp = Expectations()
-        self.svc_exp = Expectations()
+        # native (C++) runtime by default when buildable — the reference's
+        # queue/expectations tier is native (SURVEY.md §2a); the Python
+        # twins back it on boxes without a toolchain.
+        if use_native is None:
+            from tf_operator_tpu import native
+
+            use_native = native.available()
+        self.native = bool(use_native)
+        if self.native:
+            from tf_operator_tpu.native import NativeExpectations, NativeWorkQueue
+
+            self.queue = NativeWorkQueue()
+            self.pod_exp = NativeExpectations()
+            self.svc_exp = NativeExpectations()
+        else:
+            self.queue = WorkQueue()
+            self.pod_exp = Expectations()
+            self.svc_exp = Expectations()
         self.recorder = EventRecorder()
         self.metrics = metrics or default_metrics
         self.cache = InformerCache(self.queue.add, self.pod_exp, self.svc_exp)

@@ -1,0 +1,243 @@
+"""ctypes bindings for the native (C++) job-controller runtime.
+
+`NativeWorkQueue` / `NativeExpectations` are drop-in replacements for
+`controller.workqueue.WorkQueue` / `controller.expectations.Expectations`
+(same method surface; tests/test_native.py runs both through one contract
+suite).  `gen_tf_config_native` is the native twin of
+`bootstrap.cluster_spec.gen_tf_config` for the DNS-resolver path.
+
+`available()` reports whether the library could be built/loaded on this
+box; callers fall back to the Python twins when it can't (the contract
+suites keep the two in lockstep, so either backs the controller).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+from tf_operator_tpu.native import build as _build
+
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[Exception] = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return _lib
+    if os.environ.get("TPU_OPERATOR_NO_NATIVE") == "1":
+        _load_error = RuntimeError("disabled via TPU_OPERATOR_NO_NATIVE=1")
+        return None
+    try:
+        path = _build.build()
+        lib = ctypes.CDLL(path)
+    except Exception as e:  # noqa: BLE001 - any failure => Python fallback
+        _load_error = e
+        return None
+    # -- signatures --------------------------------------------------------
+    lib.tpuop_wq_new.restype = ctypes.c_void_p
+    lib.tpuop_wq_new.argtypes = [ctypes.c_double, ctypes.c_double]
+    lib.tpuop_wq_free.argtypes = [ctypes.c_void_p]
+    lib.tpuop_wq_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tpuop_wq_get.restype = ctypes.c_int
+    lib.tpuop_wq_get.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_double,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    lib.tpuop_wq_done.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tpuop_wq_add_after.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_double,
+    ]
+    lib.tpuop_wq_add_rate_limited.restype = ctypes.c_double
+    lib.tpuop_wq_add_rate_limited.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tpuop_wq_forget.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tpuop_wq_num_requeues.restype = ctypes.c_int
+    lib.tpuop_wq_num_requeues.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tpuop_wq_len.restype = ctypes.c_int
+    lib.tpuop_wq_len.argtypes = [ctypes.c_void_p]
+    lib.tpuop_wq_shutdown.argtypes = [ctypes.c_void_p]
+
+    lib.tpuop_exp_new.restype = ctypes.c_void_p
+    lib.tpuop_exp_new.argtypes = [ctypes.c_double]
+    lib.tpuop_exp_free.argtypes = [ctypes.c_void_p]
+    for fn in (lib.tpuop_exp_expect_creations, lib.tpuop_exp_expect_deletions):
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    for fn in (
+        lib.tpuop_exp_creation_observed,
+        lib.tpuop_exp_deletion_observed,
+        lib.tpuop_exp_delete,
+    ):
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tpuop_exp_satisfied.restype = ctypes.c_int
+    lib.tpuop_exp_satisfied.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tpuop_exp_pending.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+    ]
+
+    lib.tpuop_gen_tf_config.restype = ctypes.c_int
+    lib.tpuop_gen_tf_config.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def load_error() -> Optional[Exception]:
+    _load()
+    return _load_error
+
+
+class NativeWorkQueue:
+    """Drop-in twin of controller.workqueue.WorkQueue backed by C++."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 60.0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native runtime unavailable: {_load_error}")
+        self._lib = lib
+        self._h = lib.tpuop_wq_new(base_delay, max_delay)
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+
+    def add(self, key: str) -> None:
+        self._lib.tpuop_wq_add(self._h, key.encode())
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        buf = ctypes.create_string_buffer(4096)
+        t = -1.0 if timeout is None else float(timeout)
+        n = self._lib.tpuop_wq_get(self._h, t, buf, len(buf))
+        if n == -2:
+            # next key exceeds the buffer (still queued, never lost);
+            # keys are "<ns>/<name>" so this means corrupt input upstream
+            raise ValueError("work-queue key exceeds 4095 bytes")
+        return None if n < 0 else buf.value.decode()
+
+    def done(self, key: str) -> None:
+        self._lib.tpuop_wq_done(self._h, key.encode())
+
+    def add_after(self, key: str, delay: float) -> None:
+        self._lib.tpuop_wq_add_after(self._h, key.encode(), float(delay))
+
+    def add_rate_limited(self, key: str) -> float:
+        return self._lib.tpuop_wq_add_rate_limited(self._h, key.encode())
+
+    def forget(self, key: str) -> None:
+        self._lib.tpuop_wq_forget(self._h, key.encode())
+
+    def num_requeues(self, key: str) -> int:
+        return self._lib.tpuop_wq_num_requeues(self._h, key.encode())
+
+    def shutdown(self) -> None:
+        self._lib.tpuop_wq_shutdown(self._h)
+
+    def __len__(self) -> int:
+        return self._lib.tpuop_wq_len(self._h)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.tpuop_wq_free(h)
+
+
+class NativeExpectations:
+    """Drop-in twin of controller.expectations.Expectations backed by C++."""
+
+    def __init__(self, timeout_s: float = 300.0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native runtime unavailable: {_load_error}")
+        self._lib = lib
+        self._h = lib.tpuop_exp_new(timeout_s)
+        self.timeout_s = timeout_s
+
+    def expect_creations(self, key: str, n: int) -> None:
+        self._lib.tpuop_exp_expect_creations(self._h, key.encode(), n)
+
+    def expect_deletions(self, key: str, n: int) -> None:
+        self._lib.tpuop_exp_expect_deletions(self._h, key.encode(), n)
+
+    def creation_observed(self, key: str) -> None:
+        self._lib.tpuop_exp_creation_observed(self._h, key.encode())
+
+    def deletion_observed(self, key: str) -> None:
+        self._lib.tpuop_exp_deletion_observed(self._h, key.encode())
+
+    def satisfied(self, key: str) -> bool:
+        return bool(self._lib.tpuop_exp_satisfied(self._h, key.encode()))
+
+    def delete(self, key: str) -> None:
+        self._lib.tpuop_exp_delete(self._h, key.encode())
+
+    def pending(self, key: str) -> Tuple[int, int]:
+        adds = ctypes.c_int()
+        dels = ctypes.c_int()
+        self._lib.tpuop_exp_pending(
+            self._h, key.encode(), ctypes.byref(adds), ctypes.byref(dels)
+        )
+        return adds.value, dels.value
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.tpuop_exp_free(h)
+
+
+def gen_tf_config_native(
+    job_name: str,
+    namespace: str,
+    replicas: str,
+    task_type: str,
+    index: int,
+    sparse: bool = False,
+) -> str:
+    """Native TF_CONFIG; ``replicas`` is "type=count:port,..." ordered."""
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native runtime unavailable: {_load_error}")
+    # size from the input: one "<job>-<role>-<idx>.<ns>.svc:<port>" per
+    # replica plus JSON framing — avoids a giant zero-filled buffer on
+    # the per-pod bootstrap path
+    est = 256
+    for item in replicas.split(","):
+        if "=" in item and ":" in item:
+            role, _, rest = item.partition("=")
+            count = rest.partition(":")[0]
+            n_rep = int(count) if count.isdigit() else 0
+            est += n_rep * (len(job_name) + len(role) + len(namespace) + 32)
+    buf = ctypes.create_string_buffer(est)
+    n = lib.tpuop_gen_tf_config(
+        job_name.encode(),
+        namespace.encode(),
+        replicas.encode(),
+        task_type.encode(),
+        index,
+        1 if sparse else 0,
+        buf,
+        len(buf),
+    )
+    if n < 0:
+        raise ValueError(
+            f"native tf_config generation failed for {job_name}/{task_type}[{index}]"
+        )
+    return buf.value.decode()

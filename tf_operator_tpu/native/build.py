@@ -1,0 +1,64 @@
+"""Build the native runtime (_libtpuop.so) from the C++ sources.
+
+The library is compiled on demand (first import) and cached; a rebuild
+triggers whenever any source is newer than the .so.  Kept as a plain
+g++ invocation — the native tier is deliberately dependency-free
+(no pybind11 in this image; the ABI is C, consumed via ctypes).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "_libtpuop.so")
+_SOURCES = ("workqueue.cc", "expectations.cc", "clusterspec.cc")
+_lock = threading.Lock()
+
+
+def lib_path() -> str:
+    return _LIB_PATH
+
+
+def needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    paths = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    paths.append(os.path.join(_SRC_DIR, "tpuop.h"))
+    return any(os.path.getmtime(p) > lib_mtime for p in paths)
+
+
+def build(force: bool = False) -> str:
+    """Compile (if stale) and return the .so path; raises on failure."""
+
+    with _lock:
+        if not force and not needs_build():
+            return _LIB_PATH
+        # PID-suffixed tmp: concurrent builds from separate processes each
+        # write their own file; os.replace makes the install atomic
+        tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+        cmd = [
+            "g++",
+            "-std=c++17",
+            "-O2",
+            "-fPIC",
+            "-shared",
+            "-pthread",
+            "-Wall",
+            "-o",
+            tmp,
+        ] + [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(tmp, _LIB_PATH)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return _LIB_PATH
+
+
+if __name__ == "__main__":
+    print(build(force=True))

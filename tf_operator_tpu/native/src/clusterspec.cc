@@ -1,0 +1,118 @@
+// TF_CONFIG generation — native twin of bootstrap/cluster_spec.py
+// (reference crown jewel: genTFConfigJSONStr/genClusterSpec, SURVEY.md §2).
+// Emits byte-identical JSON to Python's json.dumps(..., sort_keys=True)
+// for the DNS-resolver path; tests/test_native.py golden-checks equality.
+
+#include "tpuop.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Role {
+  std::string name;  // lowercased role, e.g. "worker"
+  int count = 0;
+  int port = 0;
+};
+
+// parse "chief=1:2222,worker=4:2222"; returns false on malformed input
+bool parse_replicas(const char *s, std::vector<Role> *out) {
+  std::string in(s ? s : "");
+  size_t pos = 0;
+  while (pos < in.size()) {
+    size_t comma = in.find(',', pos);
+    if (comma == std::string::npos) comma = in.size();
+    std::string item = in.substr(pos, comma - pos);
+    pos = comma + 1;
+    if (item.empty()) continue;
+    size_t eq = item.find('=');
+    size_t colon = item.find(':', eq == std::string::npos ? 0 : eq);
+    if (eq == std::string::npos || colon == std::string::npos) return false;
+    Role r;
+    r.name = item.substr(0, eq);
+    try {
+      r.count = std::stoi(item.substr(eq + 1, colon - eq - 1));
+      r.port = std::stoi(item.substr(colon + 1));
+    } catch (...) {
+      return false;
+    }
+    if (r.count < 0 || r.port <= 0 || r.name.empty()) return false;
+    out->push_back(std::move(r));
+  }
+  return true;
+}
+
+// JSON is built by concatenation with no escaping, so every interpolated
+// string must be JSON-safe; names outside the DNS-safe set are rejected
+// (the caller falls back to the Python generator, which escapes).
+bool dns_safe(const std::string &s) {
+  if (s.empty()) return false;
+  for (char c : s) {
+    if (!((c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+          (c >= '0' && c <= '9') || c == '-' || c == '.'))
+      return false;
+  }
+  return true;
+}
+
+std::string address(const std::string &job, const std::string &ns,
+                    const std::string &role, int idx, int port) {
+  // <job>-<type>-<idx>.<namespace>.svc:<port> — the naming contract
+  // shared with the service reconciler (api.types.replica_name)
+  return job + "-" + role + "-" + std::to_string(idx) + "." + ns +
+         ".svc:" + std::to_string(port);
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpuop_gen_tf_config(const char *job, const char *ns, const char *replicas,
+                        const char *task_type, int index, int sparse,
+                        char *buf, int cap) {
+  if (!job || !ns || !task_type || index < 0) return -1;
+  if (!dns_safe(job) || !dns_safe(ns) || !dns_safe(task_type)) return -1;
+  std::vector<Role> roles;
+  if (!parse_replicas(replicas, &roles)) return -1;
+  for (const Role &r : roles)
+    if (!dns_safe(r.name)) return -1;
+  // json.dumps(sort_keys=True): cluster roles alphabetical
+  std::sort(roles.begin(), roles.end(),
+            [](const Role &a, const Role &b) { return a.name < b.name; });
+
+  const std::string ttype(task_type);
+  const bool sparse_role =
+      sparse && (ttype == "worker" || ttype == "evaluator");
+  int task_index = sparse_role ? 0 : index;
+
+  std::string out = "{\"cluster\": {";
+  bool first = true;
+  for (const Role &r : roles) {
+    if (!first) out += ", ";
+    first = false;
+    out += "\"" + r.name + "\": [";
+    if (sparse_role && r.name == ttype) {
+      if (index >= r.count) return -1;
+      out += "\"" + address(job, ns, r.name, index, r.port) + "\"";
+    } else {
+      for (int i = 0; i < r.count; ++i) {
+        if (i) out += ", ";
+        out += "\"" + address(job, ns, r.name, i, r.port) + "\"";
+      }
+    }
+    out += "]";
+  }
+  out += "}, \"environment\": \"cloud\", \"task\": {\"index\": " +
+         std::to_string(task_index) + ", \"type\": \"" + ttype + "\"}}";
+
+  const int n = static_cast<int>(out.size());
+  if (n + 1 > cap) return -1;
+  std::memcpy(buf, out.c_str(), n + 1);
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,106 @@
+// ControllerExpectations parity (SURVEY.md §2 "Generic job-controller
+// runtime", §5 "Race detection") — the informer-race bookkeeping that
+// prevents duplicate creates while the cache lags a just-issued write.
+// Mirrors controller/expectations.py.
+
+#include "tpuop.h"
+
+#include <chrono>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Entry {
+  int adds = 0;
+  int deletes = 0;
+  Clock::time_point ts = Clock::now();
+};
+
+struct Expectations {
+  std::mutex mu;
+  std::unordered_map<std::string, Entry> by_key;
+  double timeout_s;
+};
+
+Expectations *as_exp(void *p) { return static_cast<Expectations *>(p); }
+
+}  // namespace
+
+extern "C" {
+
+void *tpuop_exp_new(double timeout_s) {
+  auto *e = new Expectations();
+  e->timeout_s = timeout_s;
+  return e;
+}
+
+void tpuop_exp_free(void *e) { delete as_exp(e); }
+
+void tpuop_exp_expect_creations(void *e, const char *key, int n) {
+  auto *x = as_exp(e);
+  std::lock_guard<std::mutex> lk(x->mu);
+  auto &ent = x->by_key[key];
+  ent.adds += n;
+  ent.ts = Clock::now();
+}
+
+void tpuop_exp_expect_deletions(void *e, const char *key, int n) {
+  auto *x = as_exp(e);
+  std::lock_guard<std::mutex> lk(x->mu);
+  auto &ent = x->by_key[key];
+  ent.deletes += n;
+  ent.ts = Clock::now();
+}
+
+void tpuop_exp_creation_observed(void *e, const char *key) {
+  auto *x = as_exp(e);
+  std::lock_guard<std::mutex> lk(x->mu);
+  auto it = x->by_key.find(key);
+  if (it != x->by_key.end() && it->second.adds > 0) it->second.adds--;
+}
+
+void tpuop_exp_deletion_observed(void *e, const char *key) {
+  auto *x = as_exp(e);
+  std::lock_guard<std::mutex> lk(x->mu);
+  auto it = x->by_key.find(key);
+  if (it != x->by_key.end() && it->second.deletes > 0) it->second.deletes--;
+}
+
+int tpuop_exp_satisfied(void *e, const char *key) {
+  auto *x = as_exp(e);
+  std::lock_guard<std::mutex> lk(x->mu);
+  auto it = x->by_key.find(key);
+  if (it == x->by_key.end()) return 1;
+  const Entry &ent = it->second;
+  if (ent.adds <= 0 && ent.deletes <= 0) return 1;
+  const double age =
+      std::chrono::duration<double>(Clock::now() - ent.ts).count();
+  // expired: assume the watch events were lost; resync from observed state
+  if (age > x->timeout_s) return 1;
+  return 0;
+}
+
+void tpuop_exp_delete(void *e, const char *key) {
+  auto *x = as_exp(e);
+  std::lock_guard<std::mutex> lk(x->mu);
+  x->by_key.erase(key);
+}
+
+void tpuop_exp_pending(void *e, const char *key, int *adds, int *deletes) {
+  auto *x = as_exp(e);
+  std::lock_guard<std::mutex> lk(x->mu);
+  auto it = x->by_key.find(key);
+  if (it == x->by_key.end()) {
+    *adds = 0;
+    *deletes = 0;
+  } else {
+    *adds = it->second.adds;
+    *deletes = it->second.deletes;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,66 @@
+/* tpuop — native (C++) job-controller runtime for tf_operator_tpu.
+ *
+ * Parity target: the reference operator's native tier (SURVEY.md §2a).
+ * The reference is a single Go binary whose hot path is client-go's
+ * rate-limited workqueue + ControllerExpectations + the reconcile loop;
+ * Go is absent from this toolchain so the native tier is C++ (task rule).
+ *
+ * Exposed as a tiny C ABI consumed from Python via ctypes
+ * (tf_operator_tpu/native/__init__.py).  Each family mirrors a Python
+ * twin behind the same pytest contract (tests/test_native.py):
+ *
+ *   tpuop_wq_*   <->  controller/workqueue.py  (client-go workqueue parity)
+ *   tpuop_exp_*  <->  controller/expectations.py (ControllerExpectations)
+ *   tpuop_gen_*  <->  bootstrap/cluster_spec.py (genTFConfigJSONStr)
+ */
+#ifndef TPUOP_H_
+#define TPUOP_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- rate-limited deduplicating work queue ---- */
+
+void *tpuop_wq_new(double base_delay, double max_delay);
+void tpuop_wq_free(void *wq);
+void tpuop_wq_add(void *wq, const char *key);
+/* Blocks up to timeout seconds (timeout < 0: wait forever).  Writes the
+ * next key into buf; returns its length, or -1 on timeout/shutdown. */
+int tpuop_wq_get(void *wq, double timeout, char *buf, int cap);
+void tpuop_wq_done(void *wq, const char *key);
+void tpuop_wq_add_after(void *wq, const char *key, double delay);
+double tpuop_wq_add_rate_limited(void *wq, const char *key);
+void tpuop_wq_forget(void *wq, const char *key);
+int tpuop_wq_num_requeues(void *wq, const char *key);
+int tpuop_wq_len(void *wq);
+void tpuop_wq_shutdown(void *wq);
+
+/* ---- expectations (informer-race bookkeeping) ---- */
+
+void *tpuop_exp_new(double timeout_s);
+void tpuop_exp_free(void *e);
+void tpuop_exp_expect_creations(void *e, const char *key, int n);
+void tpuop_exp_expect_deletions(void *e, const char *key, int n);
+void tpuop_exp_creation_observed(void *e, const char *key);
+void tpuop_exp_deletion_observed(void *e, const char *key);
+int tpuop_exp_satisfied(void *e, const char *key);
+void tpuop_exp_delete(void *e, const char *key);
+void tpuop_exp_pending(void *e, const char *key, int *adds, int *deletes);
+
+/* ---- TF_CONFIG / cluster-spec generation ----
+ *
+ * replicas: ordered "type=count:port" pairs joined by ',', e.g.
+ *   "chief=1:2222,ps=2:2222,worker=4:2222"
+ * Emits byte-identical JSON to bootstrap.cluster_spec.gen_tf_config
+ * with the DNS resolver (json.dumps sort_keys=True formatting).
+ * Returns output length, or -1 if cap is too small / inputs invalid. */
+int tpuop_gen_tf_config(const char *job, const char *ns,
+                        const char *replicas, const char *task_type,
+                        int index, int sparse, char *buf, int cap);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUOP_H_ */

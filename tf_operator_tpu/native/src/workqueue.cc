@@ -1,0 +1,210 @@
+// Rate-limited deduplicating work queue — client-go workqueue parity
+// (SURVEY.md §2 "TFJob controller core" hot loop).  Semantics mirror
+// controller/workqueue.py exactly; tests/test_native.py runs both
+// implementations through one contract suite.
+
+#include "tpuop.h"
+
+#include <algorithm>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Delayed {
+  Clock::time_point when;
+  long seq;
+  std::string key;
+  bool operator>(const Delayed &o) const {
+    if (when != o.when) return when > o.when;
+    return seq > o.seq;
+  }
+};
+
+struct WorkQueue {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<std::string> queue;
+  std::unordered_set<std::string> queued;
+  std::unordered_set<std::string> processing;
+  std::unordered_set<std::string> dirty;
+  std::unordered_map<std::string, int> failures;
+  std::priority_queue<Delayed, std::vector<Delayed>, std::greater<Delayed>> delayed;
+  long seq = 0;
+  bool shutdown = false;
+  double base_delay;
+  double max_delay;
+
+  // requires mu held
+  void enqueue_locked(const std::string &key) {
+    if (queued.insert(key).second) {
+      queue.push_back(key);
+      cv.notify_one();
+    }
+  }
+
+  // requires mu held
+  void drain_delayed_locked() {
+    const auto now = Clock::now();
+    while (!delayed.empty() && delayed.top().when <= now) {
+      std::string key = delayed.top().key;
+      delayed.pop();
+      if (processing.count(key)) {
+        dirty.insert(key);
+      } else {
+        enqueue_locked(key);
+      }
+    }
+  }
+
+  void add(const std::string &key) {
+    std::lock_guard<std::mutex> lk(mu);
+    if (shutdown) return;
+    if (processing.count(key)) {
+      dirty.insert(key);
+      return;
+    }
+    enqueue_locked(key);
+  }
+
+  // timeout < 0 => wait forever.  Returns 0 on success, -1 on
+  // timeout/shutdown, -2 when the next key exceeds max_len (the key is
+  // left queued so it is never silently lost).
+  int get(double timeout, size_t max_len, std::string *out) {
+    const bool bounded = timeout >= 0;
+    const auto deadline =
+        Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                           std::chrono::duration<double>(bounded ? timeout : 0));
+    std::unique_lock<std::mutex> lk(mu);
+    for (;;) {
+      drain_delayed_locked();
+      if (!queue.empty()) {
+        if (queue.front().size() > max_len) return -2;
+        *out = queue.front();
+        queue.pop_front();
+        queued.erase(*out);
+        processing.insert(*out);
+        return 0;
+      }
+      if (shutdown) return -1;
+      if (bounded && Clock::now() >= deadline) return -1;
+      // wake at the earliest of: next delayed item, caller deadline
+      auto until = Clock::time_point::max();
+      if (!delayed.empty()) until = delayed.top().when;
+      if (bounded) until = std::min(until, deadline);
+      if (until == Clock::time_point::max()) {
+        cv.wait(lk);
+      } else {
+        cv.wait_until(lk, until);
+      }
+    }
+  }
+
+  void done(const std::string &key) {
+    std::lock_guard<std::mutex> lk(mu);
+    processing.erase(key);
+    if (dirty.erase(key)) enqueue_locked(key);
+  }
+
+  void add_after(const std::string &key, double delay_s) {
+    if (delay_s <= 0) {
+      add(key);
+      return;
+    }
+    std::lock_guard<std::mutex> lk(mu);
+    if (shutdown) return;
+    delayed.push({Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                                     std::chrono::duration<double>(delay_s)),
+                  ++seq, key});
+    cv.notify_one();
+  }
+
+  double add_rate_limited(const std::string &key) {
+    int n;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      n = failures[key]++;
+    }
+    double delay = base_delay;
+    for (int i = 0; i < n && delay < max_delay; ++i) delay *= 2;
+    delay = std::min(delay, max_delay);
+    add_after(key, delay);
+    return delay;
+  }
+
+  int size() {
+    std::lock_guard<std::mutex> lk(mu);
+    return static_cast<int>(queue.size() + delayed.size());
+  }
+
+  void stop() {
+    std::lock_guard<std::mutex> lk(mu);
+    shutdown = true;
+    cv.notify_all();
+  }
+};
+
+WorkQueue *as_wq(void *p) { return static_cast<WorkQueue *>(p); }
+
+}  // namespace
+
+extern "C" {
+
+void *tpuop_wq_new(double base_delay, double max_delay) {
+  auto *wq = new WorkQueue();
+  wq->base_delay = base_delay;
+  wq->max_delay = max_delay;
+  return wq;
+}
+
+void tpuop_wq_free(void *wq) { delete as_wq(wq); }
+
+void tpuop_wq_add(void *wq, const char *key) { as_wq(wq)->add(key); }
+
+int tpuop_wq_get(void *wq, double timeout, char *buf, int cap) {
+  std::string out;
+  if (cap <= 0) return -2;
+  const int rc = as_wq(wq)->get(timeout, static_cast<size_t>(cap) - 1, &out);
+  if (rc < 0) return rc;
+  std::memcpy(buf, out.c_str(), out.size() + 1);
+  return static_cast<int>(out.size());
+}
+
+void tpuop_wq_done(void *wq, const char *key) { as_wq(wq)->done(key); }
+
+void tpuop_wq_add_after(void *wq, const char *key, double delay) {
+  as_wq(wq)->add_after(key, delay);
+}
+
+double tpuop_wq_add_rate_limited(void *wq, const char *key) {
+  return as_wq(wq)->add_rate_limited(key);
+}
+
+void tpuop_wq_forget(void *wq, const char *key) {
+  auto *q = as_wq(wq);
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->failures.erase(key);
+}
+
+int tpuop_wq_num_requeues(void *wq, const char *key) {
+  auto *q = as_wq(wq);
+  std::lock_guard<std::mutex> lk(q->mu);
+  auto it = q->failures.find(key);
+  return it == q->failures.end() ? 0 : it->second;
+}
+
+int tpuop_wq_len(void *wq) { return as_wq(wq)->size(); }
+
+void tpuop_wq_shutdown(void *wq) { as_wq(wq)->stop(); }
+
+}  // extern "C"
