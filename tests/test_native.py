@@ -86,6 +86,23 @@ class TestNativeTFConfig:
             native.gen_tf_config_native("j", "ns", "worker=oops", "worker", 0)
         with pytest.raises(ValueError):
             native.gen_tf_config_native("j", "ns", "worker=2:0", "worker", 0)
+        # partial-parse garbage must be rejected, not silently truncated
+        with pytest.raises(ValueError):
+            native.gen_tf_config_native("j", "ns", "worker=2x:2222", "worker", 0)
+        with pytest.raises(ValueError):
+            native.gen_tf_config_native("j", "ns", "worker=2:2222zz", "worker", 0)
+        # JSON-unsafe names must fall back (no escaping in the native path)
+        with pytest.raises(ValueError):
+            native.gen_tf_config_native('a"b', "ns", "worker=1:2222", "worker", 0)
+
+    def test_huge_delay_parks_not_fires(self):
+        # seconds→ticks overflow must clamp, not fire immediately
+        q = native.NativeWorkQueue()
+        q.add_after("never", 1e18)
+        assert q.get(0) is None
+        assert len(q) == 1
+        q.add("now")
+        assert q.get(1e18) == "now"
 
 
 class TestNativeQueueStress:

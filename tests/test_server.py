@@ -1,0 +1,178 @@
+"""Operator binary surface tests: HTTP job API, metrics/health endpoints,
+client CLI, and leader election (SURVEY.md §2 "Operator entrypoint",
+"Metrics"; §1 L5/L9)."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from tests.testutil import harness, new_job
+from tf_operator_tpu.api.serde import job_to_dict
+from tf_operator_tpu.cmd.leader import FileLease
+from tf_operator_tpu.server.api import ApiServer
+
+
+@pytest.fixture
+def api():
+    store, backend, controller = harness()
+    server = ApiServer(store, backend, controller.metrics, controller.recorder)
+    server.start()
+    yield store, backend, controller, f"http://127.0.0.1:{server.port}"
+    server.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+    try:
+        return json.loads(body)
+    except ValueError:
+        return body
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+class TestApiServer:
+    def test_healthz_and_metrics(self, api):
+        _, _, _, base = api
+        assert _get(f"{base}/healthz").startswith("ok")
+        assert isinstance(_get(f"{base}/metrics"), str)
+
+    def test_submit_reconcile_status_roundtrip(self, api):
+        store, backend, controller, base = api
+        manifest = job_to_dict(new_job("web", chief=1, worker=2))
+        created = _post(f"{base}/apis/v1/namespaces/default/tpujobs", manifest)
+        assert created["metadata"]["name"] == "web"
+
+        controller.sync_until_quiet()
+        pods = _get(f"{base}/apis/v1/namespaces/default/tpujobs/web/pods")["items"]
+        assert len(pods) == 3
+
+        backend.run_all("default")
+        controller.sync_until_quiet()
+        backend.succeed_pod("default", "web-chief-0")
+        controller.sync_until_quiet()
+
+        job = _get(f"{base}/apis/v1/namespaces/default/tpujobs/web")
+        types = [c["type"] for c in job["status"]["conditions"] if c["status"]]
+        assert "Succeeded" in types
+
+        events = _get(f"{base}/apis/v1/namespaces/default/tpujobs/web/events")
+        assert any(e["reason"] == "JobSucceeded" for e in events["items"])
+
+        listing = _get(f"{base}/apis/v1/tpujobs")["items"]
+        assert [j["metadata"]["name"] for j in listing] == ["web"]
+
+    def test_invalid_manifest_rejected_422(self, api):
+        _, _, _, base = api
+        bad = {"apiVersion": "tpujob.dist/v1", "kind": "TPUJob",
+               "metadata": {"name": "bad"}, "spec": {"replicaSpecs": {}}}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{base}/apis/v1/namespaces/default/tpujobs", bad)
+        assert ei.value.code == 422
+
+    def test_duplicate_409_and_missing_404(self, api):
+        store, _, _, base = api
+        manifest = job_to_dict(new_job("dup", worker=1))
+        _post(f"{base}/apis/v1/namespaces/default/tpujobs", manifest)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{base}/apis/v1/namespaces/default/tpujobs", manifest)
+        assert ei.value.code == 409
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/apis/v1/namespaces/default/tpujobs/ghost")
+        assert ei.value.code == 404
+
+    def test_delete(self, api):
+        store, _, controller, base = api
+        manifest = job_to_dict(new_job("gone", worker=1))
+        _post(f"{base}/apis/v1/namespaces/default/tpujobs", manifest)
+        req = urllib.request.Request(
+            f"{base}/apis/v1/namespaces/default/tpujobs/gone", method="DELETE"
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        assert store.get("default", "gone") is None
+
+
+class TestTpujobCli:
+    def test_submit_list_describe_delete(self, api, tmp_path, capsys):
+        store, backend, controller, base = api
+        from tf_operator_tpu.cmd import tpujob
+
+        manifest = job_to_dict(new_job("cli", chief=1, worker=1))
+        path = tmp_path / "job.yaml"
+        import yaml
+
+        path.write_text(yaml.safe_dump(manifest))
+
+        assert tpujob.main(["--server", base, "submit", "-f", str(path)]) == 0
+        controller.sync_until_quiet()
+        backend.run_all("default")
+        controller.sync_until_quiet()
+        backend.succeed_pod("default", "cli-chief-0")
+        controller.sync_until_quiet()
+
+        assert tpujob.main(["--server", base, "list"]) == 0
+        out = capsys.readouterr().out
+        assert "cli" in out and "Succeeded" in out
+
+        assert tpujob.main(["--server", base, "describe", "cli"]) == 0
+        out = capsys.readouterr().out
+        assert "JobSucceeded" in out
+
+        assert tpujob.main(["--server", base, "delete", "cli"]) == 0
+        assert store.get("default", "cli") is None
+
+
+class TestLeaderElection:
+    def test_single_holder(self, tmp_path):
+        path = str(tmp_path / "lease.lock")
+        a = FileLease(path, "a")
+        b = FileLease(path, "b")
+        assert a.try_acquire()
+        assert a.is_leader
+        assert not b.try_acquire()
+        assert b.holder() == "a"
+        a.release()
+        assert b.try_acquire()
+        assert b.holder() == "b"
+        b.release()
+
+    def test_lock_released_on_process_death(self, tmp_path):
+        import subprocess
+
+        path = str(tmp_path / "lease.lock")
+        code = (
+            "import sys; sys.path.insert(0, %r); "
+            "from tf_operator_tpu.cmd.leader import FileLease; "
+            "l = FileLease(%r, 'child'); assert l.try_acquire(); print('held', flush=True)"
+            % (os.getcwd(), path)
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=30
+        )
+        assert "held" in proc.stdout
+        # child exited: kernel released the flock; we can acquire now
+        me = FileLease(path, "parent")
+        assert me.try_acquire()
+        me.release()
+
+
+class TestOperatorBinary:
+    def test_version_flag(self, capsys):
+        from tf_operator_tpu.cmd import operator
+
+        assert operator.main(["--version"]) == 0
+        assert "tpu-operator" in capsys.readouterr().out

@@ -1,0 +1,70 @@
+"""File-lock leader election.
+
+Parity: the reference gates the controller behind resourcelock-based
+leader election so N operator replicas yield one active controller
+(SURVEY.md §3.1).  Without a kube-apiserver the shared medium on one
+host is the filesystem: an ``fcntl.flock``-held lease file.  Lock
+ownership is kernel-managed, so a crashed leader's lease releases
+immediately — no TTL renewal loop is needed for the local backends.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import time
+from typing import Optional
+
+
+class FileLease:
+    def __init__(self, path: str, identity: str):
+        self.path = path
+        self.identity = identity
+        self._fd: Optional[int] = None
+
+    def try_acquire(self) -> bool:
+        """Non-blocking acquisition attempt; True when this process leads."""
+
+        if self._fd is not None:
+            return True
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False
+        os.ftruncate(fd, 0)
+        os.write(
+            fd,
+            json.dumps(
+                {"holderIdentity": self.identity, "acquireTime": time.time()}
+            ).encode(),
+        )
+        self._fd = fd
+        return True
+
+    def acquire(self, poll_interval: float = 0.5) -> None:
+        """Block until leadership is acquired."""
+
+        while not self.try_acquire():
+            time.sleep(poll_interval)
+
+    def holder(self) -> Optional[str]:
+        """Identity of the current leader, if the lease file is readable."""
+
+        try:
+            with open(self.path) as f:
+                return json.load(f).get("holderIdentity")
+        except (OSError, ValueError):
+            return None
+
+    def release(self) -> None:
+        if self._fd is not None:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._fd is not None

@@ -1,0 +1,164 @@
+"""The operator binary — process entry point.
+
+Parity: ``cmd/tf-operator.v1/main.go`` + ``app/server.go`` +
+``app/options/options.go`` (SURVEY.md §2 "Operator entrypoint", §3.1):
+flag parsing, backend/client setup, leader election, controller start
+with ``--threadiness`` workers, monitoring/API port, graceful signal
+shutdown.  The reference's flag set is mirrored where it still makes
+sense without a kube-apiserver.
+
+Run:  python -m tf_operator_tpu.cmd.operator --backend local --port 8080
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+from tf_operator_tpu.backend.fake import FakeCluster
+from tf_operator_tpu.backend.jobstore import JobStore
+from tf_operator_tpu.backend.local import LocalProcessBackend
+from tf_operator_tpu.cmd.leader import FileLease
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.controller.reconciler import ReconcilerConfig
+from tf_operator_tpu.server.api import ApiServer
+from tf_operator_tpu.utils import logging as oplog
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-operator",
+        description="TPU-native distributed training job operator",
+    )
+    p.add_argument(
+        "--backend",
+        choices=["local", "fake"],
+        default="local",
+        help="cluster backend: local subprocesses or in-memory fake",
+    )
+    p.add_argument(
+        "--namespace",
+        default="",
+        help="restrict the API surface to one namespace ('' = all)",
+    )
+    p.add_argument("--threadiness", type=int, default=4, help="reconcile workers")
+    p.add_argument(
+        "--enable-gang-scheduling",
+        action="store_true",
+        help="create gang groups and require all-or-nothing admission",
+    )
+    p.add_argument(
+        "--monitoring-port",
+        type=int,
+        default=8080,
+        help="port for /healthz /metrics and the job API (0 = ephemeral)",
+    )
+    p.add_argument("--host", default="127.0.0.1", help="bind address")
+    p.add_argument(
+        "--json-log", action="store_true", help="structured JSON log lines"
+    )
+    p.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="gate the controller behind a file-lease leader election",
+    )
+    p.add_argument(
+        "--lease-file",
+        default="/tmp/tpu-operator-leader.lock",
+        help="lease path for --leader-elect",
+    )
+    p.add_argument(
+        "--log-dir", default=None, help="pod log directory (local backend)"
+    )
+    p.add_argument(
+        "--total-chips",
+        type=int,
+        default=None,
+        help="fake backend: chip capacity for gang admission tests",
+    )
+    p.add_argument("--version", action="store_true", help="print version and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        from tf_operator_tpu import __version__
+
+        print(f"tpu-operator {__version__}")
+        return 0
+
+    oplog.configure(json_log=args.json_log)
+    log = oplog.logger_for_job("-", "operator")
+
+    lease = None
+    if args.leader_elect:
+        lease = FileLease(args.lease_file, identity=f"pid-{os.getpid()}")
+        log.info("waiting for leader lease at %s", args.lease_file)
+        lease.acquire()
+        log.info("acquired leadership")
+
+    store = JobStore()
+    if args.backend == "local":
+        backend = LocalProcessBackend(log_dir=args.log_dir)
+        config = ReconcilerConfig(
+            enable_gang_scheduling=args.enable_gang_scheduling,
+            resolver=backend.resolver,
+        )
+    else:
+        backend = FakeCluster(delivery="async", total_chips=args.total_chips)
+        config = ReconcilerConfig(
+            enable_gang_scheduling=args.enable_gang_scheduling
+        )
+
+    controller = TPUJobController(store, backend, config=config)
+    api = ApiServer(
+        store,
+        backend,
+        controller.metrics,
+        controller.recorder,
+        host=args.host,
+        port=args.monitoring_port,
+    )
+
+    stop = threading.Event()
+
+    def handle_signal(signum, frame):
+        log.info("signal %d: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, handle_signal)
+    signal.signal(signal.SIGINT, handle_signal)
+
+    api.start()
+    controller.run(threadiness=args.threadiness)
+    log.info(
+        "operator up: backend=%s api=%s:%d threadiness=%d native=%s",
+        args.backend,
+        args.host,
+        api.port,
+        args.threadiness,
+        controller.native,
+    )
+    print(f"tpu-operator listening on {args.host}:{api.port}", flush=True)
+
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        controller.stop()
+        api.stop()
+        close = getattr(backend, "close", None)
+        if close:
+            close()
+        if lease:
+            lease.release()
+        log.info("operator stopped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

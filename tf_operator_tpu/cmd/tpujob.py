@@ -1,0 +1,202 @@
+"""``tpujob`` — the user-facing client CLI.
+
+Parity: the reference's user flow is ``kubectl apply/get/describe/delete``
+against the TFJob CRD plus the dashboard's list view (SURVEY.md §1 L6/L9).
+This client speaks the operator's HTTP job API instead:
+
+    tpujob submit -f job.yaml            # kubectl apply
+    tpujob list [-n ns]                  # kubectl get tfjobs
+    tpujob get NAME [-n ns]              # kubectl get tfjob NAME -o json
+    tpujob describe NAME [-n ns]         # kubectl describe (status + events)
+    tpujob delete NAME [-n ns]           # kubectl delete
+    tpujob logs NAME POD [-n ns]         # kubectl logs (local backend)
+
+Manifests are the serde camelCase shape, YAML or JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import yaml
+
+
+def _request(method: str, url: str, payload=None):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = resp.read().decode()
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode()
+        try:
+            detail = json.loads(detail).get("error", detail)
+        except ValueError:
+            pass
+        raise SystemExit(f"error: {e.code} {detail}")
+    except urllib.error.URLError as e:
+        raise SystemExit(f"error: cannot reach operator at {url}: {e.reason}")
+    try:
+        return json.loads(body)
+    except ValueError:
+        return body
+
+
+def _jobs_url(server: str, ns: str, name: str = "", sub: str = "") -> str:
+    url = f"{server}/apis/v1/namespaces/{ns}/tpujobs"
+    if name:
+        url += f"/{name}"
+    if sub:
+        url += f"/{sub}"
+    return url
+
+
+def _condition_summary(job: dict) -> str:
+    conds = job.get("status", {}).get("conditions", [])
+    active = [c["type"] for c in conds if c.get("status")]
+    for terminal in ("Succeeded", "Failed"):
+        if terminal in active:
+            return terminal
+    for c in reversed(conds):
+        if c.get("status"):
+            return c["type"]
+    return "Pending"
+
+
+def cmd_submit(args) -> int:
+    with open(args.filename) as f:
+        manifest = yaml.safe_load(f)
+    ns = manifest.get("metadata", {}).get("namespace", args.namespace)
+    job = _request("POST", _jobs_url(args.server, ns), manifest)
+    name = job["metadata"]["name"]
+    print(f"tpujob.dist/{name} created")
+    if not args.wait:
+        return 0
+    deadline = time.time() + args.timeout
+    while time.time() < deadline:
+        job = _request("GET", _jobs_url(args.server, ns, name))
+        phase = _condition_summary(job)
+        if phase in ("Succeeded", "Failed"):
+            print(f"tpujob.dist/{name} {phase}")
+            return 0 if phase == "Succeeded" else 1
+        time.sleep(1.0)
+    print(f"tpujob.dist/{name} timed out after {args.timeout}s", file=sys.stderr)
+    return 2
+
+
+def cmd_list(args) -> int:
+    if args.namespace == "":
+        jobs = _request("GET", f"{args.server}/apis/v1/tpujobs")["items"]
+    else:
+        jobs = _request("GET", _jobs_url(args.server, args.namespace))["items"]
+    fmt = "{:<12} {:<24} {:<12} {:<10}"
+    print(fmt.format("NAMESPACE", "NAME", "STATE", "RESTARTS"))
+    for j in jobs:
+        print(
+            fmt.format(
+                j["metadata"].get("namespace", ""),
+                j["metadata"].get("name", ""),
+                _condition_summary(j),
+                str(j.get("status", {}).get("restartCount", 0)),
+            )
+        )
+    return 0
+
+
+def cmd_get(args) -> int:
+    job = _request("GET", _jobs_url(args.server, args.namespace, args.name))
+    print(json.dumps(job, indent=2))
+    return 0
+
+
+def cmd_describe(args) -> int:
+    job = _request("GET", _jobs_url(args.server, args.namespace, args.name))
+    print(f"Name:      {job['metadata']['name']}")
+    print(f"Namespace: {job['metadata'].get('namespace', '')}")
+    print(f"State:     {_condition_summary(job)}")
+    st = job.get("status", {})
+    print("Replica statuses:")
+    for rtype, rs in st.get("replicaStatuses", {}).items():
+        print(
+            f"  {rtype}: active={rs.get('active', 0)} "
+            f"succeeded={rs.get('succeeded', 0)} failed={rs.get('failed', 0)}"
+        )
+    print("Conditions:")
+    for c in st.get("conditions", []):
+        print(
+            f"  {c['type']:<12} {str(c.get('status')):<6} "
+            f"{c.get('reason', ''):<24} {c.get('message', '')}"
+        )
+    events = _request(
+        "GET", _jobs_url(args.server, args.namespace, args.name, "events")
+    )["items"]
+    print("Events:")
+    for e in events:
+        print(f"  {e['type']:<8} {e['reason']:<24} {e['message']}")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    _request("DELETE", _jobs_url(args.server, args.namespace, args.name))
+    print(f"tpujob.dist/{args.name} deleted")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    out = _request(
+        "GET",
+        _jobs_url(args.server, args.namespace, args.name, f"pods/{args.pod}/log"),
+    )
+    print(out if isinstance(out, str) else json.dumps(out))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpujob", description=__doc__.split("\n")[0])
+    p.add_argument(
+        "--server",
+        default="http://127.0.0.1:8080",
+        help="operator API address",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("submit", help="create a TPUJob from a manifest")
+    sp.add_argument("-f", "--filename", required=True)
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("--wait", action="store_true", help="block until terminal")
+    sp.add_argument("--timeout", type=float, default=600.0)
+    sp.set_defaults(fn=cmd_submit)
+
+    lp = sub.add_parser("list", help="list TPUJobs")
+    lp.add_argument("-n", "--namespace", default="")
+    lp.set_defaults(fn=cmd_list)
+
+    for name, fn, extra in (
+        ("get", cmd_get, []),
+        ("describe", cmd_describe, []),
+        ("delete", cmd_delete, []),
+        ("logs", cmd_logs, ["pod"]),
+    ):
+        cp = sub.add_parser(name)
+        cp.add_argument("name")
+        for a in extra:
+            cp.add_argument(a)
+        cp.add_argument("-n", "--namespace", default="default")
+        cp.set_defaults(fn=fn)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,9 +34,16 @@ bool parse_replicas(const char *s, std::vector<Role> *out) {
     if (eq == std::string::npos || colon == std::string::npos) return false;
     Role r;
     r.name = item.substr(0, eq);
+    // strict integer parse: stoi's partial parsing would silently accept
+    // garbage like "2x"; require the whole token to be consumed
+    const std::string count_s = item.substr(eq + 1, colon - eq - 1);
+    const std::string port_s = item.substr(colon + 1);
     try {
-      r.count = std::stoi(item.substr(eq + 1, colon - eq - 1));
-      r.port = std::stoi(item.substr(colon + 1));
+      size_t pos = 0;
+      r.count = std::stoi(count_s, &pos);
+      if (pos != count_s.size()) return false;
+      r.port = std::stoi(port_s, &pos);
+      if (pos != port_s.size()) return false;
     } catch (...) {
       return false;
     }

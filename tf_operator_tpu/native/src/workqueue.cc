@@ -21,6 +21,17 @@ namespace {
 
 using Clock = std::chrono::steady_clock;
 
+// Seconds-to-ticks conversion overflows steady_clock's range for huge
+// finite delays (e.g. a job spec's ttl of 1e10 s); clamp to ~31 years,
+// which is "forever" for a queue wakeup but converts safely.
+constexpr double kMaxDelayS = 1e9;
+
+Clock::time_point after(double seconds) {
+  const double s = std::min(seconds, kMaxDelayS);
+  return Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                            std::chrono::duration<double>(s));
+}
+
 struct Delayed {
   Clock::time_point when;
   long seq;
@@ -82,9 +93,7 @@ struct WorkQueue {
   // left queued so it is never silently lost).
   int get(double timeout, size_t max_len, std::string *out) {
     const bool bounded = timeout >= 0;
-    const auto deadline =
-        Clock::now() + std::chrono::duration_cast<Clock::duration>(
-                           std::chrono::duration<double>(bounded ? timeout : 0));
+    const auto deadline = after(bounded ? timeout : 0);
     std::unique_lock<std::mutex> lk(mu);
     for (;;) {
       drain_delayed_locked();
@@ -123,9 +132,7 @@ struct WorkQueue {
     }
     std::lock_guard<std::mutex> lk(mu);
     if (shutdown) return;
-    delayed.push({Clock::now() + std::chrono::duration_cast<Clock::duration>(
-                                     std::chrono::duration<double>(delay_s)),
-                  ++seq, key});
+    delayed.push({after(delay_s), ++seq, key});
     cv.notify_one();
   }
 

@@ -1,0 +1,3 @@
+from tf_operator_tpu.server.api import ApiServer
+
+__all__ = ["ApiServer"]

@@ -1,0 +1,227 @@
+"""HTTP API for the operator process.
+
+Parity: in the reference the Kubernetes API server *is* the job API and
+the operator only serves metrics/health on a monitoring port (SURVEY.md
+§2 "Operator entrypoint", "Metrics"); the dashboard's Go backend proxies
+the API server (§1 L9).  Our local backends have no kube-apiserver, so
+the operator binary carries the equivalent surface itself:
+
+    GET  /healthz                                     liveness
+    GET  /metrics                                     Prometheus text
+    GET  /apis/v1/tpujobs                             list (all ns)
+    GET  /apis/v1/namespaces/{ns}/tpujobs             list
+    POST /apis/v1/namespaces/{ns}/tpujobs             create (manifest)
+    GET  /apis/v1/namespaces/{ns}/tpujobs/{name}      get
+    DEL  /apis/v1/namespaces/{ns}/tpujobs/{name}      delete
+    GET  /apis/v1/namespaces/{ns}/tpujobs/{name}/events
+    GET  /apis/v1/namespaces/{ns}/tpujobs/{name}/pods
+    GET  /apis/v1/namespaces/{ns}/tpujobs/{name}/pods/{pod}/log
+
+Everything is JSON; manifests use the serde camelCase shape.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tf_operator_tpu.api.serde import job_from_dict, job_to_dict
+from tf_operator_tpu.api.types import LABEL_JOB_NAME
+from tf_operator_tpu.backend.base import AlreadyExistsError, ClusterBackend, NotFoundError
+from tf_operator_tpu.backend.jobstore import JobStore
+from tf_operator_tpu.utils.events import EventRecorder
+from tf_operator_tpu.utils.metrics import Metrics
+
+
+def _pod_to_dict(pod) -> dict:
+    return {
+        "name": pod.metadata.name,
+        "namespace": pod.metadata.namespace,
+        "labels": dict(pod.metadata.labels),
+        "phase": pod.phase.value,
+        "exitCode": pod.exit_code,
+        "replicaType": pod.replica_type.value if pod.replica_type else None,
+        "replicaIndex": pod.replica_index,
+    }
+
+
+class ApiServer:
+    """Threaded HTTP server over a JobStore + ClusterBackend pair."""
+
+    def __init__(
+        self,
+        job_store: JobStore,
+        backend: ClusterBackend,
+        metrics: Metrics,
+        recorder: EventRecorder,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.jobs = job_store
+        self.backend = backend
+        self.metrics = metrics
+        self.recorder = recorder
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            server_version = "tpu-operator/1"
+
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            # -- helpers ---------------------------------------------------
+            def _send(self, code: int, payload, content_type="application/json"):
+                body = (
+                    payload.encode()
+                    if isinstance(payload, str)
+                    else json.dumps(payload, indent=1).encode()
+                )
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, message: str):
+                self._send(code, {"error": message})
+
+            def _route(self):
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                return parts
+
+            # -- verbs -----------------------------------------------------
+            def do_GET(self):
+                p = self._route()
+                try:
+                    if p == ["healthz"]:
+                        return self._send(200, "ok\n", "text/plain")
+                    if p == ["metrics"]:
+                        return self._send(
+                            200, outer.metrics.exposition(), "text/plain"
+                        )
+                    if p == ["apis", "v1", "tpujobs"]:
+                        return self._send(
+                            200,
+                            {"items": [job_to_dict(j) for j in outer.jobs.list()]},
+                        )
+                    if len(p) >= 5 and p[:3] == ["apis", "v1", "namespaces"]:
+                        ns = p[3]
+                        if p[4] != "tpujobs":
+                            return self._error(404, "unknown resource")
+                        if len(p) == 5:
+                            return self._send(
+                                200,
+                                {
+                                    "items": [
+                                        job_to_dict(j)
+                                        for j in outer.jobs.list(ns)
+                                    ]
+                                },
+                            )
+                        name = p[5]
+                        job = outer.jobs.get(ns, name)
+                        if job is None:
+                            return self._error(404, f"tpujob {ns}/{name} not found")
+                        if len(p) == 6:
+                            return self._send(200, job_to_dict(job))
+                        if p[6] == "events":
+                            evs = outer.recorder.for_object(f"{ns}/{name}")
+                            return self._send(
+                                200,
+                                {
+                                    "items": [
+                                        {
+                                            "type": e.type,
+                                            "reason": e.reason,
+                                            "message": e.message,
+                                            "timestamp": e.timestamp,
+                                        }
+                                        for e in evs
+                                    ]
+                                },
+                            )
+                        if p[6] == "pods":
+                            pods = outer.backend.list_pods(
+                                ns, {LABEL_JOB_NAME: name}
+                            )
+                            if len(p) == 7:
+                                return self._send(
+                                    200,
+                                    {"items": [_pod_to_dict(x) for x in pods]},
+                                )
+                            pod_name, tail = p[7], p[8] if len(p) > 8 else ""
+                            if tail == "log":
+                                log_fn = getattr(outer.backend, "pod_log", None)
+                                if log_fn is None:
+                                    return self._error(
+                                        501, "backend does not serve logs"
+                                    )
+                                return self._send(
+                                    200, log_fn(ns, pod_name), "text/plain"
+                                )
+                    return self._error(404, "not found")
+                except NotFoundError as e:
+                    return self._error(404, str(e))
+                except Exception as e:  # noqa: BLE001 - HTTP boundary
+                    return self._error(500, f"{type(e).__name__}: {e}")
+
+            def do_POST(self):
+                p = self._route()
+                try:
+                    if (
+                        len(p) == 5
+                        and p[:3] == ["apis", "v1", "namespaces"]
+                        and p[4] == "tpujobs"
+                    ):
+                        length = int(self.headers.get("Content-Length", 0))
+                        raw = self.rfile.read(length)
+                        manifest = json.loads(raw)
+                        job = job_from_dict(manifest)
+                        job.metadata.namespace = p[3]
+                        stored = outer.jobs.create(job)
+                        return self._send(201, job_to_dict(stored))
+                    return self._error(404, "not found")
+                except AlreadyExistsError as e:
+                    return self._error(409, str(e))
+                except (ValueError, KeyError, TypeError) as e:
+                    # admission failure: bad manifest or validation error
+                    return self._error(422, f"{type(e).__name__}: {e}")
+                except Exception as e:  # noqa: BLE001 - HTTP boundary
+                    return self._error(500, f"{type(e).__name__}: {e}")
+
+            def do_DELETE(self):
+                p = self._route()
+                try:
+                    if (
+                        len(p) == 6
+                        and p[:3] == ["apis", "v1", "namespaces"]
+                        and p[4] == "tpujobs"
+                    ):
+                        outer.jobs.delete(p[3], p[5])
+                        return self._send(200, {"deleted": f"{p[3]}/{p[5]}"})
+                    return self._error(404, "not found")
+                except NotFoundError as e:
+                    return self._error(404, str(e))
+                except Exception as e:  # noqa: BLE001 - HTTP boundary
+                    return self._error(500, f"{type(e).__name__}: {e}")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2.0)
