@@ -1,0 +1,145 @@
+"""dist-mnist — the canonical e2e training workload (BASELINE.md config 1).
+
+Parity: the reference's ``examples/v1/dist-mnist/dist_mnist.py`` (TF1
+between-graph replication: parse TF_CONFIG, tf.train.Server, PS/worker
+roles, MonitoredTrainingSession; SURVEY.md §3.3).  The TPU-native shape
+is SPMD instead of PS/worker: every replica joins one jax.distributed
+world (bootstrapped from the operator-injected env), a global ``dp``
+mesh shards the batch across all devices of all processes, and jit
+inserts the gradient all-reduce that PS round-trips used to do.
+
+Checkpoint/resume (SURVEY.md §5 "Checkpoint / resume"): with
+``--checkpoint-dir``, training resumes from the latest orbax step —
+restart-with-same-env then continues rather than starting over, which
+is the operator's restart contract.
+
+Runs anywhere: single process (CPU or the real TPU chip) or
+multi-process under the operator's local backend (CPU collectives).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from functools import partial
+
+from tf_operator_tpu.runtime import initialize
+
+
+def synthetic_mnist(rng, n: int):
+    """Deterministic fake MNIST (same on every process)."""
+
+    import numpy as np
+
+    r = np.random.RandomState(rng)
+    images = r.rand(n, 28, 28, 1).astype("float32")
+    labels = r.randint(0, 10, size=(n,)).astype("int32")
+    return images, labels
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--batch-size", type=int, default=64, help="global")
+    parser.add_argument("--learning-rate", type=float, default=0.05)
+    parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("--checkpoint-every", type=int, default=10)
+    args = parser.parse_args()
+
+    ctx = initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tf_operator_tpu.models import MnistCNN
+    from tf_operator_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"dp": len(jax.devices())})
+    repl = NamedSharding(mesh, P())
+    data_sharding = NamedSharding(mesh, P("dp", None, None, None))
+    label_sharding = NamedSharding(mesh, P("dp"))
+
+    model = MnistCNN()
+    tx = optax.sgd(args.learning_rate, momentum=0.9)
+
+    dummy = jnp.zeros((1, 28, 28, 1), jnp.float32)
+    params = jax.jit(
+        lambda rng: model.init(rng, dummy, train=False)["params"],
+        out_shardings=repl,
+    )(jax.random.PRNGKey(0))
+    opt_state = jax.jit(tx.init, out_shardings=repl)(params)
+    start_step = 0
+
+    ckpt = None
+    if args.checkpoint_dir:
+        import orbax.checkpoint as ocp
+
+        ckpt = ocp.CheckpointManager(
+            args.checkpoint_dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=2),
+        )
+        latest = ckpt.latest_step()
+        if latest is not None:
+            restored = ckpt.restore(
+                latest,
+                args=ocp.args.StandardRestore({"params": params, "opt": opt_state}),
+            )
+            params, opt_state = restored["params"], restored["opt"]
+            start_step = latest + 1
+            print(f"resumed from checkpoint step {latest}", flush=True)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, images, labels):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, images, train=False)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    n_proc = jax.process_count()
+    per_proc = max(args.batch_size // n_proc, 1)
+    losses = []
+    for step in range(start_step, args.steps):
+        images, labels = synthetic_mnist(step % 7, per_proc * n_proc)
+        lo = jax.process_index() * per_proc
+        x = jax.make_array_from_process_local_data(
+            data_sharding, images[lo : lo + per_proc]
+        )
+        y = jax.make_array_from_process_local_data(
+            label_sharding, labels[lo : lo + per_proc]
+        )
+        params, opt_state, loss = train_step(params, opt_state, x, y)
+        losses.append(float(loss))
+        if ckpt and (step % args.checkpoint_every == 0 or step == args.steps - 1):
+            import orbax.checkpoint as ocp
+
+            ckpt.save(
+                step,
+                args=ocp.args.StandardSave({"params": params, "opt": opt_state}),
+            )
+    if ckpt:
+        ckpt.wait_until_finished()
+        ckpt.close()
+
+    if losses:
+        first, last = losses[0], float(np.mean(losses[-5:]))
+        print(
+            f"process {jax.process_index()}/{n_proc}: "
+            f"steps {start_step}..{args.steps} loss {first:.4f} -> {last:.4f}",
+            flush=True,
+        )
+        if start_step == 0 and args.steps >= 20 and not last < first:
+            print("loss did not decrease", file=sys.stderr, flush=True)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

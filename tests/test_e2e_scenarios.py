@@ -1,0 +1,233 @@
+"""Tier-3 e2e scenario suite against the local-process backend.
+
+Parity: the reference's Python e2e harness scenario list (SURVEY.md §4
+tier 3: simple/shutdown/cleanpod/restart/invalid/pod-names/runconfig/
+distributed-training), run 1:1 against real subprocesses instead of a
+GKE cluster.  test_e2e_local.py covers simple + restart; this file adds
+the rest.
+"""
+
+import sys
+import time
+
+import pytest
+
+from tests.testutil import new_job
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    JobConditionType,
+    PodPhase,
+    ReplicaType,
+    SuccessPolicy,
+)
+from tf_operator_tpu.backend.jobstore import JobStore
+from tf_operator_tpu.backend.local import LocalProcessBackend
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.controller.reconciler import ReconcilerConfig
+
+from tests.test_e2e_local import EXAMPLE, cpu_env, wait_for  # noqa: F401
+
+import os
+
+DIST_MNIST = os.path.join(os.path.dirname(EXAMPLE), "dist_mnist.py")
+
+SLEEP = [sys.executable, "-c", "import time; time.sleep(600)"]
+EXIT0 = [sys.executable, "-c", "raise SystemExit(0)"]
+
+RUNCONFIG_CHECK = [
+    sys.executable,
+    "-c",
+    (
+        "import os, json\n"
+        "cfg = json.loads(os.environ['TF_CONFIG'])\n"
+        "assert len(cfg['cluster']['chief']) == 1, cfg\n"
+        "assert len(cfg['cluster']['worker']) == 2, cfg\n"
+        "assert cfg['task']['type'] in ('chief', 'worker'), cfg\n"
+        "assert cfg['environment'] == 'cloud'\n"
+        "assert int(os.environ['TPUJOB_NUM_PROCESSES']) == 3\n"
+        "assert 'TPUJOB_COORDINATOR_ADDRESS' in os.environ\n"
+        "print('runconfig ok', cfg['task'], flush=True)\n"
+    ),
+]
+
+
+@pytest.fixture
+def local_harness():
+    store = JobStore()
+    backend = LocalProcessBackend()
+    controller = TPUJobController(
+        store, backend, config=ReconcilerConfig(resolver=backend.resolver)
+    )
+    controller.run(threadiness=2)
+    yield store, backend, controller
+    controller.stop()
+    backend.close()
+
+
+def wait_no_pods(backend, ns="default", timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if not backend.list_pods(ns):
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"pods remain: {[p.metadata.name for p in backend.list_pods(ns)]}")
+
+
+@pytest.mark.slow
+class TestShutdownPolicy:
+    """shutdown_policy_tests parity: which replica's exit finishes the job."""
+
+    def test_chief_exit_succeeds_while_workers_run(self, local_harness):
+        store, backend, c = local_harness
+        job = new_job(name="sd-chief", chief=1, worker=2, command=EXIT0)
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].command = list(SLEEP)
+        store.create(job)
+        done = wait_for(
+            store, "default", "sd-chief",
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED), timeout=30.0,
+        )
+        assert done.status.condition(JobConditionType.SUCCEEDED).reason == "JobSucceeded"
+        # CleanPodPolicy default (Running): sleeping workers get killed;
+        # the already-terminal chief pod is kept for inspection
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            names = {p.metadata.name for p in backend.list_pods("default")}
+            if names == {"sd-chief-chief-0"}:
+                break
+            time.sleep(0.1)
+        names = {p.metadata.name for p in backend.list_pods("default")}
+        assert names == {"sd-chief-chief-0"}
+        assert backend.get_pod("default", "sd-chief-chief-0").phase is PodPhase.SUCCEEDED
+
+    def test_all_workers_policy_waits_for_every_worker(self, local_harness):
+        store, backend, c = local_harness
+        job = new_job(name="sd-all", worker=2, command=EXIT0)
+        job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+        # worker-1 sleeps briefly so success requires more than worker-0
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].command = [
+            sys.executable,
+            "-c",
+            "import os, time; time.sleep(1.5 * int(os.environ['TPUJOB_REPLICA_INDEX'])); raise SystemExit(0)",
+        ]
+        store.create(job)
+        done = wait_for(
+            store, "default", "sd-all",
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED), timeout=30.0,
+        )
+        assert done.status.replica_statuses[ReplicaType.WORKER].succeeded == 2
+
+
+@pytest.mark.slow
+class TestCleanPodPolicy:
+    """cleanpod_policy_tests parity on real processes."""
+
+    def test_none_keeps_running_pods(self, local_harness):
+        store, backend, c = local_harness
+        job = new_job(name="cp-none", chief=1, worker=1, command=EXIT0)
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].command = list(SLEEP)
+        job.spec.run_policy.clean_pod_policy = CleanPodPolicy.NONE
+        store.create(job)
+        wait_for(
+            store, "default", "cp-none",
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED), timeout=30.0,
+        )
+        time.sleep(0.5)
+        names = {p.metadata.name for p in backend.list_pods("default")}
+        assert "cp-none-worker-0" in names  # still alive
+        store.delete("default", "cp-none")  # owner GC still collects
+        wait_no_pods(backend)
+
+    def test_all_removes_terminal_pods_too(self, local_harness):
+        store, backend, c = local_harness
+        job = new_job(name="cp-all", chief=1, worker=1, command=EXIT0)
+        job.spec.run_policy.clean_pod_policy = CleanPodPolicy.ALL
+        store.create(job)
+        wait_for(
+            store, "default", "cp-all",
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED), timeout=30.0,
+        )
+        wait_no_pods(backend)
+
+
+@pytest.mark.slow
+class TestPodNames:
+    """pod_names_validation_tests parity: the naming contract."""
+
+    def test_expected_pod_and_service_names(self, local_harness):
+        store, backend, c = local_harness
+        job = new_job(name="names", chief=1, ps=2, worker=2, command=SLEEP)
+        store.create(job)
+        wait_for(
+            store, "default", "names",
+            lambda j: j.status.has_condition(JobConditionType.RUNNING), timeout=30.0,
+        )
+        pods = {p.metadata.name for p in backend.list_pods("default")}
+        assert pods == {
+            "names-chief-0",
+            "names-ps-0",
+            "names-ps-1",
+            "names-worker-0",
+            "names-worker-1",
+        }
+        svcs = {s.metadata.name for s in backend.list_services("default")}
+        assert svcs == pods
+        store.delete("default", "names")
+        wait_no_pods(backend)
+
+
+@pytest.mark.slow
+class TestRunConfig:
+    """estimator_runconfig_tests parity: training code sees a coherent
+    TF_CONFIG + TPUJOB_* env."""
+
+    def test_tf_config_visible_and_consistent(self, local_harness):
+        store, backend, c = local_harness
+        job = new_job(name="runcfg", chief=1, worker=2, command=RUNCONFIG_CHECK)
+        job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+        store.create(job)
+        done = wait_for(
+            store, "default", "runcfg",
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED), timeout=30.0,
+        )
+        for pod in ("runcfg-chief-0", "runcfg-worker-0", "runcfg-worker-1"):
+            assert "runconfig ok" in backend.pod_log("default", pod)
+
+
+@pytest.mark.slow
+class TestInvalidJobs:
+    """invalid_tfjob_tests parity: admission rejects bad specs."""
+
+    def test_rejected_at_admission(self, local_harness):
+        store, _, _ = local_harness
+        bad = new_job(name="inv", worker=1)
+        bad.spec.replica_specs[ReplicaType.WORKER].template.containers = []
+        with pytest.raises(ValueError):
+            store.create(bad)
+        bad2 = new_job(name="inv2", chief=1, master=1, worker=1)
+        with pytest.raises(ValueError):
+            store.create(bad2)
+        assert store.list() == []
+
+
+@pytest.mark.slow
+class TestDistributedTraining:
+    """distributed_training_tests parity: a real multi-process training
+    run (dist-mnist, BASELINE config 1: 1 chief + 2 workers, CPU)."""
+
+    def test_dist_mnist_1chief_2workers(self, local_harness):
+        store, backend, c = local_harness
+        cmd = [sys.executable, DIST_MNIST, "--steps", "8", "--batch-size", "24"]
+        job = new_job(name="mnist", chief=1, worker=2, command=cmd)
+        for rt in (ReplicaType.CHIEF, ReplicaType.WORKER):
+            job.spec.replica_specs[rt].template.containers[0].env = cpu_env()
+        store.create(job)
+        # chief-decides semantics (reference parity): the chief's exit 0
+        # marks the job Succeeded even if workers are a beat behind
+        done = wait_for(
+            store, "default", "mnist",
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED), timeout=120.0,
+        )
+        st = done.status.replica_statuses
+        assert st[ReplicaType.CHIEF].succeeded == 1
+        log = backend.pod_log("default", "mnist-chief-0")
+        assert "loss" in log and "0/3" in log

@@ -66,7 +66,9 @@ class TestApiServer:
         controller.sync_until_quiet()
 
         job = _get(f"{base}/apis/v1/namespaces/default/tpujobs/web")
-        types = [c["type"] for c in job["status"]["conditions"] if c["status"]]
+        types = [
+            c["type"] for c in job["status"]["conditions"] if c["status"] == "True"
+        ]
         assert "Succeeded" in types
 
         events = _get(f"{base}/apis/v1/namespaces/default/tpujobs/web/events")
@@ -170,9 +172,84 @@ class TestLeaderElection:
         me.release()
 
 
+class TestNamespaceScoping:
+    def test_scoped_server_rejects_other_namespaces(self):
+        store, backend, controller = harness()
+        server = ApiServer(
+            store, backend, controller.metrics, controller.recorder,
+            namespace="team-a",
+        )
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            manifest = job_to_dict(new_job("scoped", worker=1))
+            _post(f"{base}/apis/v1/namespaces/team-a/tpujobs", manifest)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"{base}/apis/v1/namespaces/team-b/tpujobs", manifest)
+            assert ei.value.code == 403
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{base}/apis/v1/namespaces/team-b/tpujobs")
+            assert ei.value.code == 403
+            # the cross-namespace listing is scoped too
+            items = _get(f"{base}/apis/v1/tpujobs")["items"]
+            assert [j["metadata"]["namespace"] for j in items] == ["team-a"]
+        finally:
+            server.stop()
+
+
 class TestOperatorBinary:
     def test_version_flag(self, capsys):
         from tf_operator_tpu.cmd import operator
 
         assert operator.main(["--version"]) == 0
         assert "tpu-operator" in capsys.readouterr().out
+
+    @pytest.mark.parametrize("backend", ["fake", "local"])
+    def test_boots_serves_and_stops(self, backend, tmp_path):
+        import subprocess
+
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "tf_operator_tpu.cmd.operator",
+                "--backend", backend, "--monitoring-port", "0",
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=os.getcwd(),
+        )
+        try:
+            line = proc.stdout.readline()
+            assert "listening on" in line
+            port = int(line.rsplit(":", 1)[1])
+            assert _get(f"http://127.0.0.1:{port}/healthz").startswith("ok")
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_standby_serves_health_without_leadership(self, tmp_path):
+        """--leader-elect gates only the controller; /healthz serves on
+        the standby (liveness probes must not kill it)."""
+
+        import subprocess
+
+        lease_path = str(tmp_path / "lease.lock")
+        holder = FileLease(lease_path, "test-holder")
+        assert holder.try_acquire()
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "tf_operator_tpu.cmd.operator",
+                "--backend", "fake", "--monitoring-port", "0",
+                "--leader-elect", "--lease-file", lease_path,
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=os.getcwd(),
+        )
+        try:
+            line = proc.stdout.readline()
+            port = int(line.rsplit(":", 1)[1])
+            # standby (we hold the lease) still serves health + metrics
+            assert _get(f"http://127.0.0.1:{port}/healthz").startswith("ok")
+            assert holder.holder() == "test-holder"
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+            holder.release()

@@ -94,13 +94,6 @@ def main(argv=None) -> int:
     oplog.configure(json_log=args.json_log)
     log = oplog.logger_for_job("-", "operator")
 
-    lease = None
-    if args.leader_elect:
-        lease = FileLease(args.lease_file, identity=f"pid-{os.getpid()}")
-        log.info("waiting for leader lease at %s", args.lease_file)
-        lease.acquire()
-        log.info("acquired leadership")
-
     store = JobStore()
     if args.backend == "local":
         backend = LocalProcessBackend(log_dir=args.log_dir)
@@ -109,7 +102,7 @@ def main(argv=None) -> int:
             resolver=backend.resolver,
         )
     else:
-        backend = FakeCluster(delivery="async", total_chips=args.total_chips)
+        backend = FakeCluster(delivery="sync", total_chips=args.total_chips)
         config = ReconcilerConfig(
             enable_gang_scheduling=args.enable_gang_scheduling
         )
@@ -122,6 +115,7 @@ def main(argv=None) -> int:
         controller.recorder,
         host=args.host,
         port=args.monitoring_port,
+        namespace=args.namespace,
     )
 
     stop = threading.Event()
@@ -133,23 +127,34 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, handle_signal)
     signal.signal(signal.SIGINT, handle_signal)
 
+    # monitoring/API surface is up regardless of leadership (reference
+    # parity: the monitoring port serves on standbys too); only the
+    # controller is gated behind the lease
     api.start()
-    controller.run(threadiness=args.threadiness)
-    log.info(
-        "operator up: backend=%s api=%s:%d threadiness=%d native=%s",
-        args.backend,
-        args.host,
-        api.port,
-        args.threadiness,
-        controller.native,
-    )
     print(f"tpu-operator listening on {args.host}:{api.port}", flush=True)
 
+    lease = None
+    controller_started = False
+    if args.leader_elect:
+        lease = FileLease(args.lease_file, identity=f"pid-{os.getpid()}")
+        log.info("waiting for leader lease at %s", args.lease_file)
+
     try:
-        while not stop.wait(0.5):
-            pass
+        while not stop.is_set():
+            if not controller_started and (lease is None or lease.try_acquire()):
+                controller.run(threadiness=args.threadiness)
+                controller_started = True
+                log.info(
+                    "controller up: backend=%s threadiness=%d native=%s leader=%s",
+                    args.backend,
+                    args.threadiness,
+                    controller.native,
+                    "yes" if lease else "n/a",
+                )
+            stop.wait(0.5)
     finally:
-        controller.stop()
+        if controller_started:
+            controller.stop()
         api.stop()
         close = getattr(backend, "close", None)
         if close:

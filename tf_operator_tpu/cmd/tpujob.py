@@ -58,14 +58,19 @@ def _jobs_url(server: str, ns: str, name: str = "", sub: str = "") -> str:
     return url
 
 
+def _is_true(cond: dict) -> bool:
+    # the wire format is the k8s-style string "True"/"False"
+    return cond.get("status") in (True, "True")
+
+
 def _condition_summary(job: dict) -> str:
     conds = job.get("status", {}).get("conditions", [])
-    active = [c["type"] for c in conds if c.get("status")]
+    active = [c["type"] for c in conds if _is_true(c)]
     for terminal in ("Succeeded", "Failed"):
         if terminal in active:
             return terminal
     for c in reversed(conds):
-        if c.get("status"):
+        if _is_true(c):
             return c["type"]
     return "Pending"
 

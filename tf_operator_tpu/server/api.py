@@ -58,11 +58,14 @@ class ApiServer:
         recorder: EventRecorder,
         host: str = "127.0.0.1",
         port: int = 0,
+        namespace: str = "",
     ):
         self.jobs = job_store
         self.backend = backend
         self.metrics = metrics
         self.recorder = recorder
+        #: when set, the job API serves only this namespace (--namespace)
+        self.namespace = namespace
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -91,6 +94,15 @@ class ApiServer:
                 parts = [p for p in self.path.split("?")[0].split("/") if p]
                 return parts
 
+            def _ns_forbidden(self, ns: str) -> bool:
+                if outer.namespace and ns != outer.namespace:
+                    self._error(
+                        403,
+                        f"operator is scoped to namespace {outer.namespace!r}",
+                    )
+                    return True
+                return False
+
             # -- verbs -----------------------------------------------------
             def do_GET(self):
                 p = self._route()
@@ -104,10 +116,19 @@ class ApiServer:
                     if p == ["apis", "v1", "tpujobs"]:
                         return self._send(
                             200,
-                            {"items": [job_to_dict(j) for j in outer.jobs.list()]},
+                            {
+                                "items": [
+                                    job_to_dict(j)
+                                    for j in outer.jobs.list(
+                                        outer.namespace or None
+                                    )
+                                ]
+                            },
                         )
                     if len(p) >= 5 and p[:3] == ["apis", "v1", "namespaces"]:
                         ns = p[3]
+                        if self._ns_forbidden(ns):
+                            return None
                         if p[4] != "tpujobs":
                             return self._error(404, "unknown resource")
                         if len(p) == 5:
@@ -175,6 +196,8 @@ class ApiServer:
                         and p[:3] == ["apis", "v1", "namespaces"]
                         and p[4] == "tpujobs"
                     ):
+                        if self._ns_forbidden(p[3]):
+                            return None
                         length = int(self.headers.get("Content-Length", 0))
                         raw = self.rfile.read(length)
                         manifest = json.loads(raw)
@@ -199,6 +222,8 @@ class ApiServer:
                         and p[:3] == ["apis", "v1", "namespaces"]
                         and p[4] == "tpujobs"
                     ):
+                        if self._ns_forbidden(p[3]):
+                            return None
                         outer.jobs.delete(p[3], p[5])
                         return self._send(200, {"deleted": f"{p[3]}/{p[5]}"})
                     return self._error(404, "not found")
