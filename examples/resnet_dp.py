@@ -1,0 +1,92 @@
+"""ResNet data-parallel training — BASELINE.md configs 2 and 4.
+
+Parity: the reference's ResNet-50/ImageNet examples — config 2
+(MultiWorkerMirroredStrategy, 4 GPU workers) and config 4 (Horovod+NCCL
+all-reduce, 8 workers, volcano gang-sched).  Both are the same
+computation: synchronous data-parallel SGD with gradient all-reduce.
+The TPU-native shape is one jitted SPMD train step over a global ``dp``
+(optionally ``fsdp``) mesh; XLA inserts the all-reduce over ICI where
+MultiWorkerMirrored/Horovod issued NCCL calls (SURVEY.md §2b/§2c).
+Gang scheduling is the operator's job (enableGangScheduling in the
+manifest), not this script's.
+
+Runs single-process (the real chip) or multi-process under the
+operator's local backend (CPU collectives); model size and batch are
+flags so the same script is the TPU benchmark and the CPU e2e workload.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tf_operator_tpu.runtime import initialize
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--model", choices=["resnet50", "resnet18"], default="resnet50")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--batch-per-device", type=int, default=32)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--learning-rate", type=float, default=0.1)
+    parser.add_argument("--fsdp", type=int, default=1, help="fsdp axis size")
+    args = parser.parse_args()
+
+    initialize()
+
+    import jax
+    import numpy as np
+
+    from tf_operator_tpu.models import resnet18, resnet50
+    from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+    from tf_operator_tpu.parallel.trainer import batchnorm_cross_entropy_loss
+
+    n_dev = len(jax.devices())
+    assert n_dev % args.fsdp == 0, (n_dev, args.fsdp)
+    mesh = make_mesh({"dp": n_dev // args.fsdp, "fsdp": args.fsdp})
+
+    global_batch = args.batch_per_device * n_dev
+    local_batch = global_batch // jax.process_count()
+    rng = np.random.RandomState(jax.process_index())
+    batch = {
+        "image": rng.rand(local_batch, args.image_size, args.image_size, 3).astype(
+            np.float32
+        ),
+        "label": rng.randint(0, args.num_classes, size=(local_batch,)).astype(
+            np.int32
+        ),
+    }
+
+    model_fn = resnet50 if args.model == "resnet50" else resnet18
+    trainer = Trainer(
+        model_fn(num_classes=args.num_classes),
+        TrainerConfig(optimizer="sgd", learning_rate=args.learning_rate),
+        mesh,
+        batchnorm_cross_entropy_loss,
+        batch,
+    )
+    sharded = trainer.shard_batch(batch)
+
+    losses = []
+    for _ in range(args.steps):
+        metrics = trainer.train_step(sharded)
+        losses.append(float(metrics["loss"]))
+    stats = trainer.benchmark(batch, steps=max(args.steps // 2, 5), warmup=0)
+
+    print(
+        f"process {jax.process_index()}/{jax.process_count()}: "
+        f"{args.model} dp={mesh.shape['dp']} fsdp={mesh.shape['fsdp']} "
+        f"loss {losses[0]:.4f} -> {losses[-1]:.4f} "
+        f"({stats['examples_per_sec']:.1f} ex/s global)",
+        flush=True,
+    )
+    if args.steps >= 20 and not losses[-1] < losses[0]:
+        print("loss did not decrease", file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

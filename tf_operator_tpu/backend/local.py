@@ -134,7 +134,9 @@ class LocalProcessBackend(ClusterBackend):
                 env=env,
                 stdout=logf,
                 stderr=subprocess.STDOUT,
-                cwd=main.working_dir or None,
+                # the repo root plays the container image's WORKDIR, so
+                # manifest commands can use repo-relative paths
+                cwd=main.working_dir or _REPO_ROOT,
                 start_new_session=True,  # isolate signals per replica
             )
         except OSError as e:

@@ -180,8 +180,42 @@ class Trainer:
         return metrics
 
     def shard_batch(self, batch: Batch) -> Batch:
+        """Lay the batch out on the mesh.
+
+        Single-process: ``batch`` is the global batch.  Multi-process
+        (jax.distributed): each process passes its *local shard* (its
+        rows of the batch axis) and the returned arrays are global —
+        the multi-host path the operator's examples use.
+        """
+
         with self.mesh:
-            return jax.device_put(batch, self.batch_sharding)
+            if jax.process_count() == 1:
+                return jax.device_put(batch, self.batch_sharding)
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.make_array_from_process_local_data(s, x),
+                batch,
+                self.batch_sharding,
+            )
+
+    def shard_global_batch(self, batch: Batch) -> Batch:
+        """Multi-process-safe layout from an *identical global* batch.
+
+        Use instead of shard_batch when the mesh has replicating axes
+        for the batch (e.g. tp): every process passes the same global
+        batch and each device receives exactly its shard — replicas end
+        up bit-identical, as XLA's collectives require.
+        """
+
+        with self.mesh:
+            if jax.process_count() == 1:
+                return jax.device_put(batch, self.batch_sharding)
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.make_array_from_callback(
+                    x.shape, s, lambda idx: x[idx]
+                ),
+                batch,
+                self.batch_sharding,
+            )
 
     # -- measurement --------------------------------------------------------
     def benchmark(self, batch: Batch, steps: int = 20, warmup: int = 3) -> Dict[str, float]:
