@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from tf_operator_tpu.ops import dot_product_attention, ring_attention
+from tf_operator_tpu.ops import attention, ring_attention
 
 param_with_axes = nn.with_logical_partitioning
 logical_constraint = nn.with_logical_constraint
@@ -130,7 +130,12 @@ class MultiHeadAttention(nn.Module):
         if use_ring:
             out = ring_attention(q, k, v, cfg.mesh, causal=self.causal)
         else:
-            out = dot_product_attention(q, k, v, causal=self.causal, bias=bias, mask=mask)
+            # dispatcher: pallas flash kernel on TPU when it applies,
+            # XLA-fused reference otherwise; the mesh routes multi-device
+            # calls through the shard_map wrapper
+            out = attention(
+                q, k, v, causal=self.causal, bias=bias, mask=mask, mesh=cfg.mesh
+            )
         out = jnp.transpose(out, (0, 2, 1, 3))  # [B,S,H,D]
         out = nn.DenseGeneral(
             cfg.hidden,

@@ -1,12 +1,19 @@
-"""TPU-native ops: distributed attention and (later) pallas kernels.
+"""TPU-native ops: distributed attention and pallas kernels.
 
 The reference contains no kernels (it is a control plane; SURVEY.md §0)
 — this package is where the rebuild's first-class long-context and
-distributed compute path lives (ring attention over the sp mesh axis,
-fused attention for single-chip hot paths).
+distributed compute path lives: exact ring attention over the sp mesh
+axis, a pallas flash-attention kernel for the single-chip hot path, and
+the XLA-fused reference both fall back to.
 """
 
 from tf_operator_tpu.ops.attention import dot_product_attention
+from tf_operator_tpu.ops.flash_attention import attention, flash_attention
 from tf_operator_tpu.ops.ring_attention import ring_attention
 
-__all__ = ["dot_product_attention", "ring_attention"]
+__all__ = [
+    "attention",
+    "dot_product_attention",
+    "flash_attention",
+    "ring_attention",
+]

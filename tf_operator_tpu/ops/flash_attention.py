@@ -1,0 +1,285 @@
+"""Pallas flash attention — the hot-op TPU kernel.
+
+The reference's compute tier lives in the CUDA kernels inside the TF/
+Horovod images its examples run (SURVEY.md §2a); the TPU-native
+equivalent of that tier is a pallas kernel feeding the MXU.  This is
+classic flash attention (online softmax, never materialising the
+[Sq, Sk] score matrix):
+
+- grid (batch, heads, Sq/block_q, Sk/block_k): pallas streams one
+  (block_k, d) k/v block from HBM into VMEM per step (double-buffered
+  by the pipeline), so VMEM use is O(block), not O(S);
+- the running (max, denominator, accumulator) carry lives in VMEM
+  scratch, persisted across the innermost k grid dimension, in fp32;
+- causal: k blocks fully above the diagonal skip their compute via
+  @pl.when (partially-masked diagonal blocks mask per element);
+- bf16-friendly: matmuls run with preferred_element_type=float32.
+
+Forward-only kernel: the VJP recomputes attention with the XLA fallback
+(flash-style recompute — O(S) memory in the forward where it matters;
+the backward matches ops.attention numerics exactly).
+
+Dispatch: `attention()` picks flash when it applies (TPU backend, no
+bias/mask, tile-aligned shapes) and falls back to
+ops.attention.dot_product_attention otherwise.  pallas_call has no
+GSPMD partitioning rule, so on a multi-device mesh the dispatcher wraps
+the kernel in shard_map over (dp/fsdp → batch, tp → heads); meshes that
+shard other attention dims fall back.  TPU_OPERATOR_FLASH=0 disables
+the kernel globally.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tf_operator_tpu.ops.attention import dot_product_attention
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+#: lane width — scratch carries are padded to full lanes
+_LANES = 128
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    scale: float,
+    causal: bool,
+):
+    qi = pl.program_id(2)
+    ji = pl.program_id(3)
+    nk = pl.num_programs(3)
+    block_q = q_ref.shape[2]
+    block_k = k_ref.shape[2]
+
+    @pl.when(ji == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: blocks fully above the diagonal contribute nothing for
+    # every row of this q block — skip their compute entirely
+    needed = (ji * block_k < (qi + 1) * block_q) if causal else (ji >= 0)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ji * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            logits = jnp.where(qpos >= kpos, logits, _NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(logits - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ji == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-37)  # fully-masked rows divide safely
+        o_ref[0, 0, :, :] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jax.Array:
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / (d**0.5)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(b, h, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bi, hi, qi, ji: (bi, hi, ji, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bi, hi, qi, ji: (bi, hi, ji, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0)
+        ),
+        scratch_shapes=[
+            # carries persist across the innermost (k) grid dimension
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _compiler_params(interpret: bool):
+    if interpret:
+        return None
+    # batch/head/q-block programs are independent; only the k dimension
+    # carries state and must stay sequential
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention over [B, H, S, D].  Sq % block_q == Sk % block_k
+    == 0 required (dispatch checks this; call `attention` instead)."""
+
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    # flash-style recompute: no [Sq, Sk] scores saved from the forward;
+    # the backward re-derives them through the XLA reference (numerics
+    # identical to ops.attention)
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: dot_product_attention(q, k, v, causal=causal), q, k, v
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def flash_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash over a multi-device mesh: shard_map over batch (dp, fsdp)
+    and heads (tp) — attention is independent per (batch, head), so the
+    per-shard kernel is exact.  Requires sp == ep == 1 (ring attention
+    owns sp > 1)."""
+
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(("dp", "fsdp"), "tp", None, None)
+    fn = shard_map(
+        functools.partial(
+            flash_attention,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            interpret=interpret,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn(q, k, v)
+
+
+def _mesh_flash_applicable(mesh: Optional[Mesh], q, k) -> Optional[str]:
+    """"single" | "sharded" | None (= fall back to the XLA path)."""
+
+    if mesh is None or all(s == 1 for s in mesh.shape.values()):
+        return "single"
+    shape = dict(mesh.shape)
+    if shape.get("sp", 1) != 1 or shape.get("ep", 1) != 1:
+        return None  # seq/expert sharding: not this kernel's job
+    batch_shards = shape.get("dp", 1) * shape.get("fsdp", 1)
+    head_shards = shape.get("tp", 1)
+    if q.shape[0] % batch_shards or q.shape[1] % head_shards:
+        return None
+    return "sharded"
+
+
+def _flash_applicable(q, k, bias, mask, block_q, block_k) -> bool:
+    if os.environ.get("TPU_OPERATOR_FLASH", "1") == "0":
+        return False
+    if bias is not None or mask is not None:
+        return False
+    if q.shape[-2] % block_q or k.shape[-2] % block_k:
+        return False
+    # the kernel targets the TPU backend; everything else takes the
+    # XLA-fused reference path (the interpreter is for tests)
+    return jax.default_backend() == "tpu"
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    bias: Optional[jax.Array] = None,
+    mask: Optional[jax.Array] = None,
+    mesh: Optional[Mesh] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Dispatching attention: pallas flash kernel when it applies, the
+    XLA-fused reference otherwise.  Drop-in for dot_product_attention;
+    pass the mesh so multi-device calls get the shard_map wrapper."""
+
+    if _flash_applicable(q, k, bias, mask, block_q, block_k):
+        mode = _mesh_flash_applicable(mesh, q, k)
+        if mode == "single":
+            return flash_attention(q, k, v, causal, block_q, block_k)
+        if mode == "sharded":
+            return flash_attention_sharded(
+                q, k, v, mesh, causal, block_q, block_k
+            )
+    return dot_product_attention(q, k, v, causal=causal, bias=bias, mask=mask)
