@@ -1,0 +1,188 @@
+"""Local performance baselines (BASELINE.md "Locally measurable now").
+
+Measures, on this box:
+  1. fake-backend reconcile throughput (jobs/sec to Succeeded), for the
+     native (C++) and Python controller runtimes;
+  2. job-startup latency on the local-process backend (create →
+     Running), the driver-defined control-plane metric;
+  3. training steps/sec/chip for mnist CNN and BERT-base on the default
+     backend (the real chip when present; bench.py owns ResNet-50).
+
+Usage: python benchmarks/measure.py [--section all|reconcile|startup|train]
+Prints one JSON object; paste results into BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_reconcile(n_jobs: int = 200) -> dict:
+    from tests.testutil import new_job
+    from tf_operator_tpu.api.types import JobConditionType
+
+    out = {}
+    for native in (True, False):
+        from tf_operator_tpu.backend.fake import FakeCluster
+        from tf_operator_tpu.backend.jobstore import JobStore
+        from tf_operator_tpu.controller.controller import TPUJobController
+
+        store = JobStore()
+        backend = FakeCluster(delivery="sync")
+        c = TPUJobController(store, backend, use_native=native)
+        t0 = time.perf_counter()
+        for i in range(n_jobs):
+            store.create(new_job(f"job-{i}", chief=1, worker=2))
+        c.sync_until_quiet()
+        backend.run_all("default")
+        c.sync_until_quiet()
+        for i in range(n_jobs):
+            backend.succeed_pod("default", f"job-{i}-chief-0")
+        c.sync_until_quiet()
+        dt = time.perf_counter() - t0
+        done = sum(
+            1
+            for i in range(n_jobs)
+            if store.get("default", f"job-{i}").status.has_condition(
+                JobConditionType.SUCCEEDED
+            )
+        )
+        assert done == n_jobs, f"{done}/{n_jobs} succeeded"
+        key = "native" if native else "python"
+        out[f"reconcile_jobs_per_sec_{key}"] = round(n_jobs / dt, 1)
+    return out
+
+
+def bench_startup_latency(n_jobs: int = 8) -> dict:
+    from tests.testutil import new_job
+    from tf_operator_tpu.api.types import JobConditionType
+    from tf_operator_tpu.backend.jobstore import JobStore
+    from tf_operator_tpu.backend.local import LocalProcessBackend
+    from tf_operator_tpu.controller.controller import TPUJobController
+    from tf_operator_tpu.controller.reconciler import ReconcilerConfig
+
+    store = JobStore()
+    backend = LocalProcessBackend()
+    c = TPUJobController(
+        store, backend, config=ReconcilerConfig(resolver=backend.resolver)
+    )
+    c.run(threadiness=4)
+    lat = []
+    try:
+        for i in range(n_jobs):
+            name = f"lat-{i}"
+            job = new_job(
+                name, worker=1, command=[sys.executable, "-c", "import time; time.sleep(3)"]
+            )
+            t0 = time.perf_counter()
+            store.create(job)
+            while True:
+                j = store.get("default", name)
+                if j and j.status.has_condition(JobConditionType.RUNNING):
+                    lat.append(time.perf_counter() - t0)
+                    break
+                if time.perf_counter() - t0 > 30:
+                    raise TimeoutError(name)
+                time.sleep(0.002)
+            store.delete("default", name)
+    finally:
+        c.stop()
+        backend.close()
+    lat.sort()
+    return {
+        "startup_latency_ms_p50": round(lat[len(lat) // 2] * 1e3, 1),
+        "startup_latency_ms_max": round(lat[-1] * 1e3, 1),
+    }
+
+
+def bench_training() -> dict:
+    import jax
+    import numpy as np
+
+    from tf_operator_tpu.models import MnistCNN, bert_base, mlm_loss
+    from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+
+    out = {"train_backend": jax.default_backend()}
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"dp": n_dev})
+    r = np.random.RandomState(0)
+
+    # mnist CNN, batch 256
+    import jax.numpy as jnp
+    import optax
+
+    def mnist_loss(params, state, batch, rng):
+        logits = state.apply_fn({"params": params}, batch["image"], train=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+        return loss, {}
+
+    batch = {
+        "image": jnp.asarray(r.rand(256 * n_dev, 28, 28, 1), jnp.float32),
+        "label": jnp.asarray(r.randint(0, 10, size=(256 * n_dev,))),
+    }
+    trainer = Trainer(
+        MnistCNN(),
+        TrainerConfig(optimizer="sgd", learning_rate=0.05),
+        mesh,
+        mnist_loss,
+        batch,
+    )
+    stats = trainer.benchmark(batch, steps=30, warmup=5)
+    out["mnist_steps_per_sec_per_chip"] = round(stats["steps_per_sec"] / n_dev, 1)
+    out["mnist_examples_per_sec_per_chip"] = round(
+        stats["examples_per_sec"] / n_dev, 1
+    )
+
+    # BERT-base MLM, seq 128, batch 32/chip
+    from examples.bert_pretrain import synthetic_mlm_batch
+
+    mlm = {
+        k: jnp.asarray(v)
+        for k, v in synthetic_mlm_batch(0, 32 * n_dev, 128, 30522).items()
+    }
+    bert_trainer = Trainer(
+        bert_base(max_len=128),
+        TrainerConfig(learning_rate=1e-4),
+        make_mesh({"fsdp": n_dev}),
+        mlm_loss,
+        mlm,
+        init_args=(mlm["input_ids"],),
+        shardings="logical",
+    )
+    stats = bert_trainer.benchmark(mlm, steps=20, warmup=5)
+    out["bert_base_steps_per_sec_per_chip"] = round(
+        stats["steps_per_sec"] / n_dev, 2
+    )
+    out["bert_base_examples_per_sec_per_chip"] = round(
+        stats["examples_per_sec"] / n_dev, 1
+    )
+    return out
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--section", choices=["all", "reconcile", "startup", "train"], default="all"
+    )
+    args = parser.parse_args()
+    out = {}
+    if args.section in ("all", "reconcile"):
+        out.update(bench_reconcile())
+    if args.section in ("all", "startup"):
+        out.update(bench_startup_latency())
+    if args.section in ("all", "train"):
+        out.update(bench_training())
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

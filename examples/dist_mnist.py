@@ -44,6 +44,12 @@ def main() -> int:
     parser.add_argument("--learning-rate", type=float, default=0.05)
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=10)
+    parser.add_argument(
+        "--profile-dir",
+        default="",
+        help="write a JAX profiler trace here (the mnist_with_summaries"
+        " observability analogue; view with tensorboard/xprof)",
+    )
     args = parser.parse_args()
 
     ctx = initialize()
@@ -103,27 +109,48 @@ def main() -> int:
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    import contextlib
+
+    @contextlib.contextmanager
+    def maybe_trace():
+        if args.profile_dir and jax.process_index() == 0:
+            jax.profiler.start_trace(args.profile_dir)
+            try:
+                yield
+            finally:
+                # flush the trace even when a step raises — that's the
+                # run you most want the profile of
+                jax.profiler.stop_trace()
+                print(f"profiler trace written to {args.profile_dir}", flush=True)
+        else:
+            yield
+
     n_proc = jax.process_count()
     per_proc = max(args.batch_size // n_proc, 1)
     losses = []
-    for step in range(start_step, args.steps):
-        images, labels = synthetic_mnist(step % 7, per_proc * n_proc)
-        lo = jax.process_index() * per_proc
-        x = jax.make_array_from_process_local_data(
-            data_sharding, images[lo : lo + per_proc]
-        )
-        y = jax.make_array_from_process_local_data(
-            label_sharding, labels[lo : lo + per_proc]
-        )
-        params, opt_state, loss = train_step(params, opt_state, x, y)
-        losses.append(float(loss))
-        if ckpt and (step % args.checkpoint_every == 0 or step == args.steps - 1):
-            import orbax.checkpoint as ocp
-
-            ckpt.save(
-                step,
-                args=ocp.args.StandardSave({"params": params, "opt": opt_state}),
+    with maybe_trace():
+        for step in range(start_step, args.steps):
+            images, labels = synthetic_mnist(step % 7, per_proc * n_proc)
+            lo = jax.process_index() * per_proc
+            x = jax.make_array_from_process_local_data(
+                data_sharding, images[lo : lo + per_proc]
             )
+            y = jax.make_array_from_process_local_data(
+                label_sharding, labels[lo : lo + per_proc]
+            )
+            params, opt_state, loss = train_step(params, opt_state, x, y)
+            losses.append(float(loss))
+            if ckpt and (
+                step % args.checkpoint_every == 0 or step == args.steps - 1
+            ):
+                import orbax.checkpoint as ocp
+
+                ckpt.save(
+                    step,
+                    args=ocp.args.StandardSave(
+                        {"params": params, "opt": opt_state}
+                    ),
+                )
     if ckpt:
         ckpt.wait_until_finished()
         ckpt.close()

@@ -50,6 +50,13 @@ class TestApiServer:
         assert _get(f"{base}/healthz").startswith("ok")
         assert isinstance(_get(f"{base}/metrics"), str)
 
+    def test_dashboard_served_at_root(self, api):
+        _, _, _, base = api
+        page = _get(f"{base}/")
+        assert "<title>tpu-operator</title>" in page
+        # the page drives the same API the CLI uses
+        assert "/apis/v1/tpujobs" in page
+
     def test_submit_reconcile_status_roundtrip(self, api):
         store, backend, controller, base = api
         manifest = job_to_dict(new_job("web", chief=1, worker=2))

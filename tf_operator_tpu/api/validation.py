@@ -12,6 +12,7 @@ SURVEY.md §2b row "Parameter-server").
 
 from __future__ import annotations
 
+import re
 from typing import List
 
 from tf_operator_tpu.api.types import (
@@ -20,6 +21,11 @@ from tf_operator_tpu.api.types import (
     ReplicaType,
     TPUJob,
 )
+
+#: DNS-1123 subdomain, as Kubernetes enforces for object names — the
+#: name feeds pod/service DNS names and TF_CONFIG hostnames, so this is
+#: a correctness (and HTML/JSON-safety) constraint, not cosmetics
+_DNS1123 = re.compile(r"^[a-z0-9]([a-z0-9-]{0,51}[a-z0-9])?$")
 
 
 class ValidationError(ValueError):
@@ -60,6 +66,13 @@ def validate(job: TPUJob) -> None:
 
     if not job.metadata.name:
         problems.append("metadata.name is required")
+    elif not _DNS1123.match(job.metadata.name):
+        problems.append(
+            "metadata.name must be a DNS-1123 label (lowercase alphanumerics"
+            " and '-', at most 52 chars, to leave room for replica suffixes)"
+        )
+    if job.metadata.namespace and not _DNS1123.match(job.metadata.namespace):
+        problems.append("metadata.namespace must be a DNS-1123 label")
 
     if not spec.replica_specs:
         problems.append("spec.replicaSpecs must contain at least one replica type")

@@ -107,6 +107,10 @@ class ApiServer:
             def do_GET(self):
                 p = self._route()
                 try:
+                    if not p:
+                        from tf_operator_tpu.server.dashboard import DASHBOARD_HTML
+
+                        return self._send(200, DASHBOARD_HTML, "text/html")
                     if p == ["healthz"]:
                         return self._send(200, "ok\n", "text/plain")
                     if p == ["metrics"]:

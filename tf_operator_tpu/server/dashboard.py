@@ -1,0 +1,128 @@
+"""Single-page web dashboard served at GET /.
+
+Parity: the reference's older trees shipped a dashboard (Go REST
+backend + React frontend) listing TFJobs (SURVEY.md §1 L9).  The
+equivalent here is one dependency-free HTML page over the operator's
+own job API: job table with replica/condition state, per-job detail
+with conditions + events, auto-refresh.
+"""
+
+DASHBOARD_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>tpu-operator</title>
+<style>
+  body { font-family: ui-monospace, SFMono-Regular, Menlo, monospace;
+         margin: 2rem; background: #fafafa; color: #1a1a1a; }
+  h1 { font-size: 1.2rem; } h2 { font-size: 1rem; margin-top: 1.5rem; }
+  table { border-collapse: collapse; width: 100%; background: #fff; }
+  th, td { text-align: left; padding: .4rem .8rem;
+           border-bottom: 1px solid #e5e5e5; font-size: .85rem; }
+  th { background: #f0f0f0; }
+  tr.sel { background: #eef6ff; } tr[data-key] { cursor: pointer; }
+  .Succeeded { color: #0a7d32; } .Failed { color: #b3261e; }
+  .Running { color: #0b57d0; } .Pending, .Created { color: #666; }
+  .Restarting { color: #a86500; }
+  #detail { white-space: pre-wrap; background: #fff; padding: 1rem;
+            border: 1px solid #e5e5e5; font-size: .8rem; }
+  .muted { color: #888; font-size: .75rem; }
+</style>
+</head>
+<body>
+<h1>tpu-operator <span class="muted" id="refreshed"></span></h1>
+<table id="jobs">
+  <thead><tr><th>namespace</th><th>name</th><th>replicas</th>
+  <th>state</th><th>restarts</th></tr></thead>
+  <tbody></tbody>
+</table>
+<h2 id="detail-title" style="display:none"></h2>
+<div id="detail" style="display:none"></div>
+<script>
+let selected = null;
+
+function state(job) {
+  const conds = (job.status && job.status.conditions) || [];
+  const active = conds.filter(c => c.status === "True").map(c => c.type);
+  for (const t of ["Succeeded", "Failed"]) if (active.includes(t)) return t;
+  return active.length ? active[active.length - 1] : "Pending";
+}
+
+function replicas(job) {
+  const specs = (job.spec && job.spec.tpuReplicaSpecs) || {};
+  return Object.entries(specs)
+    .map(([t, s]) => `${t}:${s.replicas ?? 1}`).join(" ");
+}
+
+async function refresh() {
+  const res = await fetch("/apis/v1/tpujobs");
+  const items = (await res.json()).items || [];
+  const tbody = document.querySelector("#jobs tbody");
+  tbody.innerHTML = "";
+  for (const job of items) {
+    const key = `${job.metadata.namespace}/${job.metadata.name}`;
+    const tr = document.createElement("tr");
+    tr.dataset.key = key;
+    const st = state(job);
+    // textContent only — job names are user input
+    const cells = [
+      job.metadata.namespace, job.metadata.name, replicas(job), st,
+      String((job.status && job.status.restartCount) || 0),
+    ];
+    for (const [i, text] of cells.entries()) {
+      const td = document.createElement("td");
+      td.textContent = text;
+      if (i === 3) td.className = st;
+      tr.appendChild(td);
+    }
+    tr.onclick = () => { selected = key; detail(); highlight(); };
+    if (key === selected) tr.classList.add("sel");
+    tbody.appendChild(tr);
+  }
+  document.getElementById("refreshed").textContent =
+    "refreshed " + new Date().toLocaleTimeString();
+  if (selected) detail();
+}
+
+function highlight() {
+  for (const tr of document.querySelectorAll("#jobs tbody tr"))
+    tr.classList.toggle("sel", tr.dataset.key === selected);
+}
+
+async function detail() {
+  const [ns, name] = selected.split("/");
+  const base = `/apis/v1/namespaces/${ns}/tpujobs/${name}`;
+  const jobRes = await fetch(base);
+  if (!jobRes.ok) {
+    selected = null;
+    document.getElementById("detail-title").style.display = "none";
+    document.getElementById("detail").style.display = "none";
+    return;
+  }
+  const job = await jobRes.json();
+  const events = (await (await fetch(base + "/events")).json()).items || [];
+  const pods = (await (await fetch(base + "/pods")).json()).items || [];
+  let text = "";
+  text += "conditions:\\n";
+  for (const c of (job.status && job.status.conditions) || [])
+    text += `  ${c.type.padEnd(12)} ${String(c.status).padEnd(6)} ` +
+            `${(c.reason || "").padEnd(24)} ${c.message || ""}\\n`;
+  text += "\\npods:\\n";
+  for (const p of pods)
+    text += `  ${p.name.padEnd(28)} ${p.phase}` +
+            (p.exitCode != null ? ` (exit ${p.exitCode})` : "") + "\\n";
+  text += "\\nevents:\\n";
+  for (const e of events)
+    text += `  ${e.type.padEnd(8)} ${e.reason.padEnd(24)} ${e.message}\\n`;
+  document.getElementById("detail-title").textContent = selected;
+  document.getElementById("detail-title").style.display = "";
+  const el = document.getElementById("detail");
+  el.style.display = ""; el.textContent = text;
+}
+
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""
