@@ -1,0 +1,235 @@
+"""The reconcile decision core as pure functions, with native dispatch.
+
+Parity: the decision half of the reference's pod reconciler and status
+engine (SURVEY.md §2 "Pod reconciler", "Status engine") — given observed
+pod state, decide creates / scale-in deletes / restarts (with restart
+budget) / fatals, and evaluate the success-policy truth table.  The
+reconciler executes these decisions against the backend.
+
+Two implementations behind one interface: this Python twin and the
+native C++ core (native/src/planner.cc), which is used whenever the
+native library loads (SURVEY.md §2a item 1 — the reference's hot path
+is native).  tests/test_plan.py property-tests their equivalence.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tf_operator_tpu.api.types import (
+    CHIEF_LIKE,
+    PodPhase,
+    ReplicaType,
+    RestartPolicy,
+    SuccessPolicy,
+    TPUJob,
+)
+from tf_operator_tpu.backend.objects import Pod
+from tf_operator_tpu.utils.train_util import is_retryable_exit_code
+
+#: observation of one pod: (replica_index, phase, exit_code or None)
+PodObs = Tuple[int, PodPhase, Optional[int]]
+
+_PHASE_CHAR = {
+    PodPhase.PENDING: "P",
+    PodPhase.RUNNING: "R",
+    PodPhase.SUCCEEDED: "S",
+    PodPhase.FAILED: "F",
+    PodPhase.UNKNOWN: "U",
+}
+
+
+@dataclass
+class ReplicaPlan:
+    """Decisions for one replica type, one sync."""
+
+    create: List[int] = field(default_factory=list)
+    scale_in: List[int] = field(default_factory=list)
+    #: (index, exit_code): delete the pod, count one restart
+    restart: List[Tuple[int, int]] = field(default_factory=list)
+    #: (index, exit_code): permanent failure
+    fatal: List[Tuple[int, int]] = field(default_factory=list)
+    backoff_exceeded: bool = False
+
+
+def plan_replica_py(
+    want: int,
+    policy: RestartPolicy,
+    backoff_limit: Optional[int],
+    restart_count: int,
+    observed: List[PodObs],
+) -> ReplicaPlan:
+    """Pure-Python twin of tpuop_plan_replica."""
+
+    plan = ReplicaPlan()
+    by_index: Dict[int, PodObs] = {}
+    seen_scale_in = set()
+    for obs in observed:
+        idx = obs[0]
+        if idx >= want:
+            if idx not in seen_scale_in:
+                seen_scale_in.add(idx)
+            plan.scale_in.append(idx)
+        elif idx not in by_index:
+            by_index[idx] = obs  # first pod per index wins (slot[0])
+
+    count = restart_count
+    for idx in range(want):
+        obs = by_index.get(idx)
+        if obs is None:
+            plan.create.append(idx)
+            continue
+        _, phase, exit_code = obs
+        if phase is not PodPhase.FAILED:
+            continue
+        code = exit_code if exit_code is not None else 1
+        should_restart = policy in (
+            RestartPolicy.ALWAYS,
+            RestartPolicy.ON_FAILURE,
+        ) or (policy is RestartPolicy.EXIT_CODE and is_retryable_exit_code(code))
+        if not should_restart:
+            plan.fatal.append((idx, code))
+            continue
+        if backoff_limit is not None and count >= backoff_limit:
+            # budget exhausted: abort the remaining indices (reference
+            # parity — the job fails before touching later replicas)
+            plan.backoff_exceeded = True
+            break
+        count += 1
+        plan.restart.append((idx, code))
+    return plan
+
+
+def plan_replica(
+    want: int,
+    policy: RestartPolicy,
+    backoff_limit: Optional[int],
+    restart_count: int,
+    observed: List[PodObs],
+) -> ReplicaPlan:
+    """Native core when available; Python twin otherwise."""
+
+    native = _native()
+    if native is None:
+        return plan_replica_py(want, policy, backoff_limit, restart_count, observed)
+    desc = (
+        f"want={want};policy={policy.value};"
+        f"limit={'-' if backoff_limit is None else backoff_limit};"
+        f"restarts={restart_count};pods="
+        + ",".join(
+            f"{idx}:{_PHASE_CHAR[phase]}:{'-' if code is None else code}"
+            for idx, phase, code in observed
+        )
+    )
+    return _parse_plan(native.plan_replica(desc))
+
+
+def _parse_plan(out: str) -> ReplicaPlan:
+    fields = dict(item.split("=", 1) for item in out.split(";"))
+    plan = ReplicaPlan()
+    if fields.get("create"):
+        plan.create = [int(x) for x in fields["create"].split(",")]
+    if fields.get("scalein"):
+        plan.scale_in = [int(x) for x in fields["scalein"].split(",")]
+    for key, dest in (("restart", plan.restart), ("fatal", plan.fatal)):
+        if fields.get(key):
+            for item in fields[key].split(","):
+                idx, _, code = item.partition(":")
+                dest.append((int(idx), int(code)))
+    plan.backoff_exceeded = fields.get("backoff") == "1"
+    return plan
+
+
+# ---------------------------------------------------------------- success
+
+
+def evaluate_success_py(
+    job: TPUJob, pods_by_type: Dict[ReplicaType, List[Pod]]
+) -> Tuple[bool, str]:
+    """Pure-Python twin — delegates to the existing status-engine
+    implementation (the original source of truth)."""
+
+    from tf_operator_tpu.controller import status
+
+    return status._evaluate_success_py(job, pods_by_type)
+
+
+def evaluate_success(
+    job: TPUJob, pods_by_type: Dict[ReplicaType, List[Pod]]
+) -> Tuple[bool, str]:
+    """Native success-policy truth table when available."""
+
+    native = _native()
+    if native is None:
+        return evaluate_success_py(job, pods_by_type)
+    parts = []
+    for rtype, spec in job.spec.replica_specs.items():
+        pods = pods_by_type.get(rtype, [])
+        nsucc = sum(1 for p in pods if p.phase is PodPhase.SUCCEEDED)
+        pod0 = next((p for p in pods if p.replica_index == 0), None)
+        p0s = 1 if pod0 is not None and pod0.phase is PodPhase.SUCCEEDED else 0
+        parts.append(
+            f"{rtype.value}:{int(spec.replicas or 0)}:{len(pods)}:{nsucc}:{p0s}"
+        )
+    desc = (
+        f"policy={job.spec.success_policy.value or 'Default'};types="
+        + ",".join(parts)
+    )
+    out = native.eval_success(desc)
+    flag, _, reason = out.partition(":")
+    return flag == "1", reason
+
+
+# ---------------------------------------------------------------- native
+
+
+class _NativePlanner:
+    def __init__(self, lib):
+        import ctypes
+
+        self._lib = lib
+        self._ctypes = ctypes
+        lib.tpuop_plan_replica.restype = ctypes.c_int
+        lib.tpuop_plan_replica.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.tpuop_eval_success.restype = ctypes.c_int
+        lib.tpuop_eval_success.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+
+    def _call(self, fn, desc: str) -> str:
+        buf = self._ctypes.create_string_buffer(max(4096, 32 * len(desc)))
+        n = fn(desc.encode(), buf, len(buf))
+        if n < 0:
+            raise ValueError(f"native planner rejected {desc!r}")
+        return buf.value.decode()
+
+    def plan_replica(self, desc: str) -> str:
+        return self._call(self._lib.tpuop_plan_replica, desc)
+
+    def eval_success(self, desc: str) -> str:
+        return self._call(self._lib.tpuop_eval_success, desc)
+
+
+_planner: Optional[_NativePlanner] = None
+_planner_checked = False
+
+
+def _native() -> Optional[_NativePlanner]:
+    global _planner, _planner_checked
+    if not _planner_checked:
+        _planner_checked = True
+        try:
+            from tf_operator_tpu import native
+
+            if native.available():
+                _planner = _NativePlanner(native._load())
+        except Exception:  # noqa: BLE001 - fall back to Python twin
+            _planner = None
+    return _planner

@@ -56,7 +56,6 @@ from tf_operator_tpu.controller.status import (
 from tf_operator_tpu.utils.events import EventRecorder
 from tf_operator_tpu.utils.logging import logger_for_job
 from tf_operator_tpu.utils.metrics import Metrics, default_metrics
-from tf_operator_tpu.utils.train_util import is_retryable_exit_code
 
 
 @dataclass
@@ -221,47 +220,38 @@ class Reconciler:
         pods: List[Pod],
         gang: bool,
     ) -> str:
-        """Returns "ok" | "restarting" | "fatal"."""
+        """Returns "ok" | "restarting" | "fatal".
+
+        Decisions come from the decision core (controller/plan.py —
+        native C++ when available, Python twin otherwise); this method
+        executes them against the backend and records events/metrics.
+        """
+
+        from tf_operator_tpu.controller.plan import plan_replica
 
         key = job.key
         want = int(spec.replicas or 0)
         by_index: Dict[int, List[Pod]] = {}
+        observed = []
         for p in pods:
             idx = p.replica_index
             if idx is not None:
                 by_index.setdefault(idx, []).append(p)
+                observed.append((idx, p.phase, p.exit_code))
 
-        outcome = "ok"
+        policy = spec.restart_policy or RestartPolicy.NEVER
+        limit = job.spec.run_policy.backoff_limit
+        plan = plan_replica(want, policy, limit, job.status.restart_count, observed)
+
         # scale-in (dynamic workers): drop indices beyond the want count
-        for idx in sorted(by_index):
-            if idx >= want:
-                for p in by_index[idx]:
-                    self._delete_pod(key, p)
+        for idx in sorted(set(plan.scale_in)):
+            for p in by_index.get(idx, []):
+                self._delete_pod(key, p)
+        for idx in plan.create:
+            self._create_pod(job, rtype, idx, gang)
 
-        for idx in range(want):
-            slot = by_index.get(idx, [])
-            if not slot:
-                self._create_pod(job, rtype, idx, gang)
-                continue
-            pod = slot[0]
-            if pod.phase is not PodPhase.FAILED:
-                continue
-            exit_code = pod.exit_code if pod.exit_code is not None else 1
-            policy = spec.restart_policy or RestartPolicy.NEVER
-            should_restart = policy in (RestartPolicy.ALWAYS, RestartPolicy.ON_FAILURE) or (
-                policy is RestartPolicy.EXIT_CODE and is_retryable_exit_code(exit_code)
-            )
-            if not should_restart:
-                outcome = "fatal"
-                continue
-            limit = job.spec.run_policy.backoff_limit
-            if limit is not None and job.status.restart_count >= limit:
-                self._fail_job(
-                    job,
-                    "BackoffLimitExceeded",
-                    f"restart budget exhausted ({limit})",
-                )
-                return "fatal"
+        outcome = "fatal" if plan.fatal else "ok"
+        for idx, exit_code in plan.restart:
             job.status.restart_count += 1
             self.recorder.event(
                 key,
@@ -270,9 +260,16 @@ class Reconciler:
                 f"{rtype.value}-{idx} exited {exit_code}; restarting "
                 f"({job.status.restart_count} restarts)",
             )
-            self._delete_pod(key, pod)
+            self._delete_pod(key, by_index[idx][0])
             if outcome == "ok":
                 outcome = "restarting"
+        if plan.backoff_exceeded:
+            self._fail_job(
+                job,
+                "BackoffLimitExceeded",
+                f"restart budget exhausted ({limit})",
+            )
+            return "fatal"
         return outcome
 
     def _create_pod(self, job: TPUJob, rtype: ReplicaType, index: int, gang: bool) -> None:

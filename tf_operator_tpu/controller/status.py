@@ -118,6 +118,18 @@ def _worker_like(job: TPUJob) -> List[ReplicaType]:
 def evaluate_success(
     job: TPUJob, pods_by_type: Dict[ReplicaType, List[Pod]]
 ) -> Tuple[bool, str]:
+    """(job_succeeded, reason) — dispatches to the native decision core
+    when available (controller/plan.py); the Python truth table below
+    remains the reference implementation and the fallback."""
+
+    from tf_operator_tpu.controller.plan import evaluate_success as _dispatch
+
+    return _dispatch(job, pods_by_type)
+
+
+def _evaluate_success_py(
+    job: TPUJob, pods_by_type: Dict[ReplicaType, List[Pod]]
+) -> Tuple[bool, str]:
     """(job_succeeded, reason).  The success-policy truth table."""
 
     chief = chief_type(job)

@@ -14,7 +14,7 @@ import threading
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "_libtpuop.so")
-_SOURCES = ("workqueue.cc", "expectations.cc", "clusterspec.cc")
+_SOURCES = ("workqueue.cc", "expectations.cc", "clusterspec.cc", "planner.cc")
 _lock = threading.Lock()
 
 

@@ -1,0 +1,244 @@
+// Native reconcile decision core (SURVEY.md §2a item 1: "the reconcile
+// engine ... the single binary's hot path").  Two pure functions mirror
+// the Python twins in controller/plan.py behind one contract test suite
+// (tests/test_plan.py, incl. property-based equivalence):
+//
+//   tpuop_plan_replica — the per-replica-type pod diff: which indices
+//     to create, scale in, restart (with restart budget), or declare
+//     fatal.  Mirrors Reconciler._reconcile_pods' decisions.
+//   tpuop_eval_success — the success-policy truth table.  Mirrors
+//     controller/status.evaluate_success.
+//
+// String ABI (no JSON dependency):
+//   plan:  "want=N;policy=Never|Always|OnFailure|ExitCode;limit=N|-;
+//           restarts=N;pods=idx:phase:exit,..."   phase in {P,R,S,F,U},
+//           exit "-" when unknown.
+//   out:   "create=i,..;scalein=i,..;restart=i:exit,..;fatal=i:exit,..;
+//           backoff=0|1"
+//
+//   eval:  "policy=Default|AllWorkers;types=Name:want:npods:nsucc:p0s,.."
+//           Name is the ReplicaType value (Chief/Master/PS/Worker/
+//           Evaluator/TPUSlice); p0s = 1 iff the index-0 pod SUCCEEDED.
+//   out:   "1:<reason>" or "0:"
+
+#include "tpuop.h"
+
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::vector<std::string> split(const std::string &s, char sep) {
+  std::vector<std::string> out;
+  size_t pos = 0;
+  while (pos <= s.size()) {
+    size_t next = s.find(sep, pos);
+    if (next == std::string::npos) next = s.size();
+    out.push_back(s.substr(pos, next - pos));
+    pos = next + 1;
+  }
+  return out;
+}
+
+// "k=v;k=v" -> map (value may contain ':' and ',')
+bool parse_fields(const std::string &s, std::map<std::string, std::string> *out) {
+  for (const std::string &item : split(s, ';')) {
+    if (item.empty()) continue;
+    size_t eq = item.find('=');
+    if (eq == std::string::npos) return false;
+    (*out)[item.substr(0, eq)] = item.substr(eq + 1);
+  }
+  return true;
+}
+
+bool to_int(const std::string &s, long *out) {
+  if (s.empty()) return false;
+  try {
+    size_t pos = 0;
+    *out = std::stol(s, &pos);
+    return pos == s.size();
+  } catch (...) {
+    return false;
+  }
+}
+
+int write_out(const std::string &s, char *buf, int cap) {
+  const int n = static_cast<int>(s.size());
+  if (n + 1 > cap) return -1;
+  std::memcpy(buf, s.c_str(), n + 1);
+  return n;
+}
+
+// exit-code semantics parity: utils/train_util.is_retryable_exit_code
+bool retryable(long exit_code) { return exit_code > 127; }
+
+struct PodObs {
+  long index;
+  char phase;  // P R S F U
+  long exit_code;  // -1 = unknown
+};
+
+}  // namespace
+
+extern "C" {
+
+int tpuop_plan_replica(const char *desc, char *buf, int cap) {
+  if (!desc) return -1;
+  std::map<std::string, std::string> f;
+  if (!parse_fields(desc, &f)) return -1;
+  long want = 0, restarts = 0, limit = -1;
+  if (!to_int(f["want"], &want) || want < 0) return -1;
+  if (!to_int(f["restarts"], &restarts) || restarts < 0) return -1;
+  const std::string limit_s = f.count("limit") ? f["limit"] : "-";
+  const bool has_limit = limit_s != "-";
+  if (has_limit && (!to_int(limit_s, &limit) || limit < 0)) return -1;
+  const std::string policy = f.count("policy") ? f["policy"] : "Never";
+  if (policy != "Never" && policy != "Always" && policy != "OnFailure" &&
+      policy != "ExitCode")
+    return -1;
+
+  // bucket: first pod per index wins (Python slot[0] semantics)
+  std::map<long, PodObs> by_index;
+  std::vector<long> scale_in;  // every observed index >= want, in order
+  if (!f["pods"].empty()) {
+    for (const std::string &p : split(f["pods"], ',')) {
+      if (p.empty()) continue;
+      std::vector<std::string> parts = split(p, ':');
+      if (parts.size() != 3) return -1;
+      PodObs obs;
+      if (!to_int(parts[0], &obs.index) || obs.index < 0) return -1;
+      if (parts[1].size() != 1 || !strchr("PRSFU", parts[1][0])) return -1;
+      obs.phase = parts[1][0];
+      obs.exit_code = -1;
+      if (parts[2] != "-" && !to_int(parts[2], &obs.exit_code)) return -1;
+      if (obs.index >= want) {
+        scale_in.push_back(obs.index);
+      } else if (!by_index.count(obs.index)) {
+        by_index[obs.index] = obs;
+      }
+    }
+  }
+
+  std::string create, restart, fatal;
+  bool backoff = false;
+  long count = restarts;
+  for (long idx = 0; idx < want; ++idx) {
+    auto it = by_index.find(idx);
+    if (it == by_index.end()) {
+      if (!create.empty()) create += ",";
+      create += std::to_string(idx);
+      continue;
+    }
+    if (it->second.phase != 'F') continue;
+    const long exit_code = it->second.exit_code >= 0 ? it->second.exit_code : 1;
+    const bool should_restart =
+        policy == "Always" || policy == "OnFailure" ||
+        (policy == "ExitCode" && retryable(exit_code));
+    if (!should_restart) {
+      if (!fatal.empty()) fatal += ",";
+      fatal += std::to_string(idx) + ":" + std::to_string(exit_code);
+      continue;
+    }
+    // restart budget check precedes the increment (Python parity:
+    // backoff exhaustion aborts the sync's remaining indices)
+    if (has_limit && count >= limit) {
+      backoff = true;
+      break;
+    }
+    ++count;
+    if (!restart.empty()) restart += ",";
+    restart += std::to_string(idx) + ":" + std::to_string(exit_code);
+  }
+
+  std::string si;
+  for (size_t i = 0; i < scale_in.size(); ++i) {
+    if (i) si += ",";
+    si += std::to_string(scale_in[i]);
+  }
+  std::string out = "create=" + create + ";scalein=" + si + ";restart=" +
+                    restart + ";fatal=" + fatal +
+                    ";backoff=" + (backoff ? "1" : "0");
+  return write_out(out, buf, cap);
+}
+
+int tpuop_eval_success(const char *desc, char *buf, int cap) {
+  if (!desc) return -1;
+  std::map<std::string, std::string> f;
+  if (!parse_fields(desc, &f)) return -1;
+  const std::string policy = f.count("policy") ? f["policy"] : "Default";
+  if (policy != "Default" && policy != "AllWorkers") return -1;
+
+  struct TypeObs {
+    long want = 0, npods = 0, nsucc = 0;
+    bool pod0succ = false;
+    bool present = false;
+  };
+  std::map<std::string, TypeObs> types;
+  if (!f["types"].empty()) {
+    for (const std::string &t : split(f["types"], ',')) {
+      if (t.empty()) continue;
+      std::vector<std::string> parts = split(t, ':');
+      if (parts.size() != 5) return -1;
+      TypeObs obs;
+      long p0;
+      if (!to_int(parts[1], &obs.want) || !to_int(parts[2], &obs.npods) ||
+          !to_int(parts[3], &obs.nsucc) || !to_int(parts[4], &p0))
+        return -1;
+      obs.pod0succ = p0 != 0;
+      obs.present = true;
+      types[parts[0]] = obs;
+    }
+  }
+
+  auto fail = [&]() { return write_out("0:", buf, cap); };
+  auto ok = [&](const std::string &reason) {
+    return write_out("1:" + reason, buf, cap);
+  };
+
+  // chief-like decides alone (CHIEF_LIKE order: Chief, Master)
+  for (const char *name : {"Chief", "Master"}) {
+    if (types.count(name)) {
+      if (types[name].pod0succ)
+        return ok(std::string(name) + " replica succeeded");
+      return fail();
+    }
+  }
+
+  // worker-like = Worker, TPUSlice with want > 0 (status._worker_like)
+  const bool has_worker = types.count("Worker") && types["Worker"].want > 0;
+  const bool has_slice = types.count("TPUSlice") && types["TPUSlice"].want > 0;
+
+  if (!has_worker && !has_slice) {
+    long npods = 0, nsucc = 0;
+    for (const auto &kv : types) {
+      npods += kv.second.npods;
+      nsucc += kv.second.nsucc;
+    }
+    if (npods > 0 && nsucc == npods) return ok("all replicas succeeded");
+    return fail();
+  }
+
+  if (policy == "AllWorkers") {
+    if (has_worker && types["Worker"].nsucc < types["Worker"].want)
+      return fail();
+    if (has_slice && types["TPUSlice"].nsucc < types["TPUSlice"].want)
+      return fail();
+    return ok("all workers succeeded");
+  }
+
+  if (has_slice) {
+    if (types["TPUSlice"].nsucc < types["TPUSlice"].want) return fail();
+    if (!has_worker) return ok("all slice members succeeded");
+    if (types["Worker"].pod0succ)
+      return ok("all slice members and worker 0 succeeded");
+    return fail();
+  }
+
+  if (types.count("Worker") && types["Worker"].pod0succ)
+    return ok("worker 0 succeeded");
+  return fail();
+}
+
+}  // extern "C"

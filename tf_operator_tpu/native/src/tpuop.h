@@ -59,6 +59,13 @@ int tpuop_gen_tf_config(const char *job, const char *ns,
                         const char *replicas, const char *task_type,
                         int index, int sparse, char *buf, int cap);
 
+/* ---- reconcile decision core (planner.cc) ----
+ * String protocols documented at the top of planner.cc.  Both return
+ * output length, or -1 on malformed input / small buffer. */
+
+int tpuop_plan_replica(const char *desc, char *buf, int cap);
+int tpuop_eval_success(const char *desc, char *buf, int cap);
+
 #ifdef __cplusplus
 }
 #endif
