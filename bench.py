@@ -1,25 +1,85 @@
 """Benchmark entry point — run by the driver on real TPU hardware.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": ...}
 
 Metric: ResNet-50 training throughput per chip (examples/sec/chip), the
 BASELINE.md headline workload.  The reference publishes no numbers
 (BASELINE.json "published": {}), so vs_baseline compares against the
-locally recorded first-build number in BASELINE.md once it exists
-(stored in BENCH_BASELINE.json); until then vs_baseline=1.0 by
-definition.
+round-1 locally recorded number pinned in BENCH_BASELINE.json.
+
+Robustness contract (VERDICT round 1, item 1): TPU backend init on this
+box can fail transiently (UNAVAILABLE) or hang.  The measurement
+therefore runs in a CHILD process — retried with backoff on failure,
+killed on hang — and an unrecoverable environment failure still emits
+the single JSON line (with an "error" field) instead of a traceback.
+
+Env knobs: BENCH_BATCH_PER_CHIP (default: autotune over 256/128/64),
+BENCH_STEPS, BENCH_RETRIES, BENCH_CHILD_TIMEOUT, BENCH_PLATFORM
+(e.g. cpu for a smoke run), BENCH_PEAK_TFLOPS (MFU denominator
+override).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import time
+
+METRIC = "resnet50_train_examples_per_sec_per_chip"
+UNIT = "examples/sec/chip"
 
 
-def main() -> int:
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def _peak_flops(device) -> float:
+    """Per-chip bf16 peak for MFU; overridable via BENCH_PEAK_TFLOPS."""
+
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in (
+        ("v6", 918e12),
+        ("trillium", 918e12),
+        ("v5p", 459e12),
+        ("v5 lite", 197e12),
+        ("v5e", 197e12),
+        ("v5lite", 197e12),
+        ("v4", 275e12),
+    ):
+        if key in kind:
+            return peak
+    return 197e12  # this box: v5 lite
+
+
+def _step_flops(trainer, batch) -> float:
+    """XLA's own flop count for the compiled train step (fwd+bwd+opt)."""
+
+    try:
+        import flax.linen as nn
+
+        with trainer.mesh, nn.logical_axis_rules(trainer._rules):
+            compiled = trainer._step.lower(trainer.state, batch).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def run_bench() -> dict:
     import jax
+
+    platform = os.environ.get("BENCH_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
     import jax.numpy as jnp
     import numpy as np
 
@@ -27,45 +87,126 @@ def main() -> int:
     from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
     from tf_operator_tpu.parallel.trainer import batchnorm_cross_entropy_loss
 
-    n_dev = len(jax.devices())
+    devices = jax.devices()
+    n_dev = len(devices)
     mesh = make_mesh({"dp": n_dev})
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
 
-    batch_per_chip = int(os.environ.get("BENCH_BATCH_PER_CHIP", "64"))
-    global_batch = batch_per_chip * n_dev
+    env_batch = os.environ.get("BENCH_BATCH_PER_CHIP")
+    candidates = [int(env_batch)] if env_batch else [256, 128, 64]
+
     rng = np.random.RandomState(0)
-    batch = {
-        "image": jnp.asarray(
-            rng.rand(global_batch, 224, 224, 3).astype(np.float32)
-        ),
-        "label": jnp.asarray(rng.randint(0, 1000, size=(global_batch,))),
-    }
-    trainer = Trainer(
-        resnet50(),
-        TrainerConfig(optimizer="sgd", learning_rate=0.1, momentum=0.9),
-        mesh,
-        batchnorm_cross_entropy_loss,
-        batch,
-    )
-    stats = trainer.benchmark(batch, steps=20, warmup=5)
-    per_chip = stats["examples_per_sec"] / n_dev
+    last_err: Exception | None = None
+    for batch_per_chip in candidates:
+        global_batch = batch_per_chip * n_dev
+        # bf16 input pipeline: halves input HBM traffic vs the round-1
+        # fp32 images; the model computes in bf16 anyway
+        batch = {
+            "image": jnp.asarray(
+                rng.rand(global_batch, 224, 224, 3).astype(np.float32),
+                dtype=jnp.bfloat16,
+            ),
+            "label": jnp.asarray(rng.randint(0, 1000, size=(global_batch,))),
+        }
+        try:
+            trainer = Trainer(
+                resnet50(),
+                TrainerConfig(optimizer="sgd", learning_rate=0.1, momentum=0.9),
+                mesh,
+                batchnorm_cross_entropy_loss,
+                batch,
+            )
+            sharded = trainer.shard_batch(batch)
+            flops_per_step = _step_flops(trainer, sharded)
+            stats = trainer.benchmark(batch, steps=steps, warmup=5)
+        except Exception as e:  # OOM at this batch size → try smaller
+            last_err = e
+            if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+                continue
+            raise
+        per_chip = stats["examples_per_sec"] / n_dev
+        result = {
+            "metric": METRIC,
+            "value": round(per_chip, 2),
+            "unit": UNIT,
+            "vs_baseline": 1.0,
+            "batch_per_chip": batch_per_chip,
+            "step_ms": round(stats["step_ms"], 2),
+            "platform": devices[0].platform,
+            "device_kind": getattr(devices[0], "device_kind", "?"),
+            "n_devices": n_dev,
+        }
+        if flops_per_step:
+            # XLA cost_analysis reports the post-GSPMD per-device module,
+            # so flops_per_step is already per-chip (verified empirically:
+            # an 8-way dp-sharded matmul reports 1/8 the 1-device flops)
+            achieved = flops_per_step * stats["steps_per_sec"]
+            result["achieved_tflops_per_chip"] = round(achieved / 1e12, 1)
+            result["mfu"] = round(achieved / _peak_flops(devices[0]), 4)
+        return result
+    raise RuntimeError(f"all batch sizes OOMed: {last_err}")
 
-    baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
-    vs = 1.0
-    if os.path.exists(baseline_path):
-        with open(baseline_path) as f:
+
+def _vs_baseline(value: float) -> float:
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
+    try:
+        with open(path) as f:
             base = json.load(f).get("resnet50_examples_per_sec_per_chip")
-        if base:
-            vs = per_chip / base
+        return round(value / base, 4) if base else 1.0
+    except Exception:
+        return 1.0
 
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_examples_per_sec_per_chip",
-                "value": round(per_chip, 2),
-                "unit": "examples/sec/chip",
-                "vs_baseline": round(vs, 4),
-            }
-        )
+
+def main() -> int:
+    if os.environ.get("_BENCH_CHILD") == "1":
+        result = run_bench()
+        _emit(result)
+        return 0
+
+    retries = int(os.environ.get("BENCH_RETRIES", "3"))
+    child_timeout = float(os.environ.get("BENCH_CHILD_TIMEOUT", "1500"))
+    delay = 10.0
+    last_err = "unknown"
+    for attempt in range(retries):
+        env = dict(os.environ)
+        env["_BENCH_CHILD"] = "1"
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=child_timeout,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"bench child hung >{child_timeout:.0f}s (TPU init stall?)"
+            continue
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    result = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "value" in result:
+                    result["vs_baseline"] = _vs_baseline(result["value"])
+                    _emit(result)
+                    return 0
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        last_err = (tail[-1] if tail else f"rc={proc.returncode}")[:300]
+        if attempt < retries - 1:
+            time.sleep(delay)
+            delay *= 3
+    # unrecoverable environment failure: still ONE parseable JSON line
+    _emit(
+        {
+            "metric": METRIC,
+            "value": 0.0,
+            "unit": UNIT,
+            "vs_baseline": 0.0,
+            "error": last_err,
+        }
     )
     return 0
 

@@ -1,0 +1,242 @@
+"""Mixture-of-Experts causal LM — expert parallelism over the ep axis.
+
+The reference has no MoE story (SURVEY.md §2b: EP "absent"); this
+framework makes the ep mesh axis real.  The design is the TPU-idiomatic
+dense-dispatch MoE (Switch/Mesh-TF style): expert FFN weights are
+stacked on a leading logical ``expert`` axis (→ ep via
+parallel/sharding.py LOGICAL_RULES), tokens are routed top-2 into fixed
+per-expert capacity buckets with einsum dispatch/combine tensors, and
+XLA turns the resharding between token layout ([batch, seq, ...]) and
+expert layout ([expert, ...]) into all-to-alls over ICI.  Everything is
+static-shaped — no gather/scatter with data-dependent sizes — so the
+whole block jits and tiles onto the MXU.
+
+Load-balance + router-z auxiliary losses are sowed into the ``losses``
+collection; use `moe_lm_loss` (exported) instead of plain lm_loss so
+they reach the optimizer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from tf_operator_tpu.models.transformer import (
+    ACT_HIDDEN,
+    Embed,
+    LayerNorm,
+    MultiHeadAttention,
+    TransformerConfig,
+    logical_constraint,
+    param_with_axes,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    base: TransformerConfig
+    num_experts: int = 8
+    capacity_factor: float = 2.0
+    aux_loss_weight: float = 0.01
+    z_loss_weight: float = 1e-3
+
+
+class MoeMlp(nn.Module):
+    """Top-2 routed expert FFNs with fixed capacity buckets.
+
+    Token layout [B, S, H] → dispatch einsum → expert layout
+    [E, B, C, H] (E sharded over ep) → stacked FFN → combine einsum
+    back.  Dropped tokens (over capacity) pass through the residual
+    only, as in Switch Transformer.
+    """
+
+    moe: MoeConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.moe.base
+        n_exp = self.moe.num_experts
+        b, s, h = x.shape
+        capacity = max(int(2 * s * self.moe.capacity_factor / n_exp), 4)
+
+        # router runs in float32 — routing decisions are precision-sensitive
+        router_logits = nn.DenseGeneral(
+            n_exp,
+            dtype=jnp.float32,
+            use_bias=False,
+            kernel_init=param_with_axes(nn.initializers.lecun_normal(), ("embed", "expert")),
+            name="router",
+        )(x.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits, axis=-1)  # [B,S,E]
+
+        gate1 = jnp.max(probs, axis=-1)
+        idx1 = jnp.argmax(probs, axis=-1)
+        mask1 = jax.nn.one_hot(idx1, n_exp, dtype=probs.dtype)  # [B,S,E]
+        probs_wo1 = probs * (1.0 - mask1)
+        gate2 = jnp.max(probs_wo1, axis=-1)
+        idx2 = jnp.argmax(probs_wo1, axis=-1)
+        mask2 = jax.nn.one_hot(idx2, n_exp, dtype=probs.dtype)
+
+        # auxiliary losses: load balance (Switch eq. 4) over the top-1
+        # route, router z-loss for logit stability
+        frac_tokens = jnp.mean(mask1, axis=(0, 1))  # [E]
+        frac_probs = jnp.mean(probs, axis=(0, 1))  # [E]
+        aux = n_exp * jnp.sum(frac_tokens * frac_probs)
+        z = jnp.mean(jax.scipy.special.logsumexp(router_logits, axis=-1) ** 2)
+        self.sow(
+            "losses",
+            "moe_aux",
+            self.moe.aux_loss_weight * aux + self.moe.z_loss_weight * z,
+            reduce_fn=lambda a, b: a + b,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
+        )
+
+        # capacity bucketing: position of each token within its expert,
+        # scanning the sequence; second route queues behind the first
+        pos1 = jnp.cumsum(mask1, axis=1) * mask1 - mask1  # [B,S,E]
+        count1 = jnp.sum(mask1, axis=1, keepdims=True)  # [B,1,E]
+        pos2 = (jnp.cumsum(mask2, axis=1) + count1) * mask2 - mask2
+        keep1 = mask1 * (pos1 < capacity)
+        keep2 = mask2 * (pos2 < capacity)
+
+        # renormalise surviving gates so combine weights sum to <=1
+        denom = gate1 * jnp.sum(keep1, -1) + gate2 * jnp.sum(keep2, -1) + 1e-9
+        gate1 = gate1 / denom
+        gate2 = gate2 / denom
+
+        onehot_pos1 = jax.nn.one_hot(pos1, capacity, dtype=probs.dtype)  # [B,S,E,C]
+        onehot_pos2 = jax.nn.one_hot(pos2, capacity, dtype=probs.dtype)
+        combine = (
+            gate1[..., None, None] * keep1[..., None] * onehot_pos1
+            + gate2[..., None, None] * keep2[..., None] * onehot_pos2
+        )  # [B,S,E,C]
+        dispatch = (combine > 0.0).astype(cfg.dtype)
+
+        # token layout -> expert layout (all-to-all over ep under GSPMD)
+        expert_in = jnp.einsum("bsec,bsh->ebch", dispatch, x.astype(cfg.dtype))
+        expert_in = logical_constraint(
+            expert_in, ("expert", "batch", "cap", "act_embed")
+        )
+
+        wi = self.param(
+            "wi",
+            param_with_axes(nn.initializers.lecun_normal(), ("expert", "embed", "mlp")),
+            (n_exp, h, cfg.mlp_dim),
+            jnp.float32,
+        )
+        wo = self.param(
+            "wo",
+            param_with_axes(nn.initializers.lecun_normal(), ("expert", "mlp", "embed")),
+            (n_exp, cfg.mlp_dim, h),
+            jnp.float32,
+        )
+        hdn = jnp.einsum("ebch,ehm->ebcm", expert_in, wi.astype(cfg.dtype))
+        hdn = logical_constraint(hdn, ("expert", "batch", "cap", "act_mlp"))
+        hdn = nn.gelu(hdn)
+        expert_out = jnp.einsum("ebcm,emh->ebch", hdn, wo.astype(cfg.dtype))
+        expert_out = logical_constraint(
+            expert_out, ("expert", "batch", "cap", "act_embed")
+        )
+
+        # expert layout -> token layout (second all-to-all)
+        out = jnp.einsum("bsec,ebch->bsh", combine.astype(cfg.dtype), expert_out)
+        out = nn.Dropout(cfg.dropout, deterministic=not train)(out)
+        return logical_constraint(out, ACT_HIDDEN)
+
+
+class MoeDecoderLayer(nn.Module):
+    """Pre-LN decoder block with a routed-MoE FFN."""
+
+    moe: MoeConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.moe.base
+        y = LayerNorm(cfg, rms=True, name="ln_self")(x)
+        x = x + MultiHeadAttention(cfg, causal=True, name="self_attn")(y, train=train)
+        y = LayerNorm(cfg, rms=True, name="ln_mlp")(x)
+        x = x + MoeMlp(self.moe, name="moe")(y, train=train)
+        return logical_constraint(x, ACT_HIDDEN)
+
+
+class MoeLM(nn.Module):
+    """Decoder-only LM with MoE FFN layers (every layer routed)."""
+
+    moe: MoeConfig
+
+    @property
+    def cfg(self) -> TransformerConfig:
+        return self.moe.base
+
+    @nn.compact
+    def __call__(self, input_ids, *, train: bool = False):
+        cfg = self.moe.base
+        _, s = input_ids.shape
+        embed = Embed(cfg, name="tok_embed")
+        x = embed(input_ids)
+        pos = self.param(
+            "pos_embed",
+            param_with_axes(nn.initializers.normal(0.02), ("seq", "embed")),
+            (cfg.max_len, cfg.hidden),
+            jnp.float32,
+        )
+        x = x + pos[None, :s].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        x = logical_constraint(x, ACT_HIDDEN)
+        for i in range(cfg.n_layers):
+            x = MoeDecoderLayer(self.moe, name=f"layer_{i}")(x, train=train)
+        x = LayerNorm(cfg, rms=True, name="ln_final")(x)
+        logits = embed.attend(x)
+        return logits.astype(jnp.float32)
+
+
+def moe_tiny(
+    vocab_size: int = 1024,
+    max_len: int = 256,
+    num_experts: int = 4,
+    mesh=None,
+    **kw,
+) -> MoeLM:
+    return MoeLM(
+        MoeConfig(
+            base=TransformerConfig(
+                vocab_size=vocab_size,
+                hidden=128,
+                n_heads=4,
+                head_dim=32,
+                n_layers=2,
+                mlp_dim=256,
+                max_len=max_len,
+                mesh=mesh,
+            ),
+            num_experts=num_experts,
+            **kw,
+        )
+    )
+
+
+def moe_lm_loss(params, state, batch: Dict, rng) -> Tuple[jax.Array, Dict]:
+    """Next-token loss + sowed MoE auxiliary losses."""
+
+    logits, mutated = state.apply_fn(
+        {"params": params},
+        batch["input_ids"],
+        train=True,
+        rngs={"dropout": rng},
+        mutable=["losses"],
+    )
+    targets = batch["input_ids"][:, 1:]
+    logits = logits[:, :-1]
+    xent = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+    aux = sum(
+        jnp.sum(v) for v in jax.tree_util.tree_leaves(mutated.get("losses", {}))
+    )
+    acc = (logits.argmax(-1) == targets).mean()
+    return xent + aux, {
+        "metrics": {"token_accuracy": acc, "moe_aux_loss": aux, "xent": xent}
+    }

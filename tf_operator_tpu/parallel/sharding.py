@@ -38,6 +38,7 @@ LOGICAL_RULES: Tuple[Tuple[str, Any], ...] = (
     ("vocab", AXIS_TP),
     ("seq", AXIS_SP),
     ("expert", AXIS_EP),
+    ("cap", None),  # MoE per-expert capacity buckets (models/moe.py)
     ("stack", None),
     ("norm", None),
     ("relpos_buckets", None),
