@@ -184,3 +184,31 @@ class TestControllerOnNativeEngine:
         c.sync_until_quiet()
         st = store.get("default", "native-e2e").status
         assert st.has_condition(JobConditionType.SUCCEEDED)
+
+
+class TestOversizedKey:
+    def test_oversized_key_dropped_not_wedged(self):
+        """A >4095-byte key is dropped (logged, not raised — an
+        exception would kill the controller worker thread) and the next
+        valid key is served in the same call (round-1 advisor finding:
+        the queue must never livelock on a corrupt head)."""
+
+        from tf_operator_tpu.native import NativeWorkQueue
+
+        wq = NativeWorkQueue()
+        wq.add("x" * 5000)
+        wq.add("ns/ok")
+        assert wq.get(timeout=0.0) == "ns/ok"
+        wq.done("ns/ok")
+        assert wq.get(timeout=0.0) is None
+
+    def test_drop_front_guarded_against_valid_keys(self):
+        """drop_front only pops a genuinely oversized front: a worker
+        that lost the -2 race must not discard a valid key."""
+
+        from tf_operator_tpu.native import NativeWorkQueue
+
+        wq = NativeWorkQueue()
+        wq.add("ns/valid")
+        assert wq._lib.tpuop_wq_drop_front(wq._h, 4095) == 0
+        assert wq.get(timeout=0.0) == "ns/valid"

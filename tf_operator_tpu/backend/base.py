@@ -42,6 +42,18 @@ class ClusterBackend(abc.ABC):
     @abc.abstractmethod
     def list_pods(self, namespace: str, selector: Optional[Dict[str, str]] = None) -> List[Pod]: ...
 
+    def update_pod_owner(self, namespace: str, name: str, owner_uid: Optional[str]) -> None:
+        """Set (adopt) or clear (orphan) a pod's controller owner uid.
+
+        ControllerRefManager parity (SURVEY.md §2 "Generic job-controller
+        runtime"): the reconciler adopts label-matching ownerless pods and
+        releases owned pods whose labels stopped matching.  Backends that
+        cannot patch ownership may leave this unimplemented; the
+        reconciler then skips adoption for them.
+        """
+
+        raise NotImplementedError
+
     # -- services -----------------------------------------------------------
     @abc.abstractmethod
     def create_service(self, svc: Service) -> None: ...

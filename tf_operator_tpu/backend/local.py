@@ -195,6 +195,17 @@ class LocalProcessBackend(ClusterBackend):
     def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
         return self._pods.get(f"{namespace}/{name}")
 
+    def update_pod_owner(self, namespace: str, name: str, owner_uid: Optional[str]) -> None:
+        key = f"{namespace}/{name}"
+        with self._lock:
+            pod = self._pods.get(key)
+            if pod is None:
+                raise NotFoundError(key)
+            if pod.metadata.owner_uid == (owner_uid or ""):
+                return
+            pod.metadata.owner_uid = owner_uid or ""
+            self._emit(WatchEventType.MODIFIED, "Pod", pod)
+
     def pod_log(self, namespace: str, name: str) -> str:
         path = self._log_path(namespace, name)
         try:

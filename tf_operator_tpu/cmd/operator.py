@@ -56,7 +56,14 @@ def build_parser() -> argparse.ArgumentParser:
         default=8080,
         help="port for /healthz /metrics and the job API (0 = ephemeral)",
     )
-    p.add_argument("--host", default="127.0.0.1", help="bind address")
+    p.add_argument(
+        "--host",
+        default="127.0.0.1",
+        help="bind address.  TRUST MODEL: the job API is unauthenticated "
+        "and POSTed manifests run as subprocesses on this host (local "
+        "backend) — binding a non-loopback address exposes remote "
+        "command execution to anyone who can reach the port",
+    )
     p.add_argument(
         "--json-log", action="store_true", help="structured JSON log lines"
     )
@@ -107,6 +114,18 @@ def main(argv=None) -> int:
             enable_gang_scheduling=args.enable_gang_scheduling
         )
 
+    if args.host not in ("127.0.0.1", "localhost", "::1"):
+        log.warning(
+            "binding %s: the job API is UNAUTHENTICATED and job manifests "
+            "execute as local subprocesses — anyone who can reach this "
+            "port can run commands as this user (see --host help)",
+            args.host,
+        )
+
+    lease = None
+    if args.leader_elect:
+        lease = FileLease(args.lease_file, identity=f"pid-{os.getpid()}")
+
     controller = TPUJobController(store, backend, config=config)
     api = ApiServer(
         store,
@@ -116,6 +135,9 @@ def main(argv=None) -> int:
         host=args.host,
         port=args.monitoring_port,
         namespace=args.namespace,
+        leadership=(
+            None if lease is None else (lambda: (lease.is_leader, lease.holder()))
+        ),
     )
 
     stop = threading.Event()
@@ -133,10 +155,8 @@ def main(argv=None) -> int:
     api.start()
     print(f"tpu-operator listening on {args.host}:{api.port}", flush=True)
 
-    lease = None
     controller_started = False
-    if args.leader_elect:
-        lease = FileLease(args.lease_file, identity=f"pid-{os.getpid()}")
+    if lease is not None:
         log.info("waiting for leader lease at %s", args.lease_file)
 
     try:

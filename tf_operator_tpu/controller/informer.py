@@ -100,12 +100,21 @@ class InformerCache:
 
     def _on_pod(self, ev: WatchEvent) -> None:
         pod: Pod = ev.obj
+        old_key: Optional[str] = None
         with self._lock:
+            prev = self.pods.get(pod.key)
+            if prev is not None:
+                old_key = self._job_key_for(prev)
             if ev.type is WatchEventType.DELETED:
                 self.pods.pop(pod.key, None)
             else:
                 self.pods[pod.key] = pod
         key = self._job_key_for(pod)
+        if old_key and old_key != key:
+            # label change moved the pod to another controller: the old
+            # one must re-sync to release/recreate (reference updatePod
+            # parity — both old and new owners are enqueued)
+            self._enqueue(old_key)
         if key:
             if ev.type is WatchEventType.ADDED:
                 self._pod_exp.creation_observed(key)

@@ -64,12 +64,11 @@ def plan_replica_py(
 
     plan = ReplicaPlan()
     by_index: Dict[int, PodObs] = {}
-    seen_scale_in = set()
     for obs in observed:
         idx = obs[0]
         if idx >= want:
-            if idx not in seen_scale_in:
-                seen_scale_in.add(idx)
+            # duplicates appended as observed — matching the C++ twin;
+            # the reconciler dedups with sorted(set(...)) before acting
             plan.scale_in.append(idx)
         elif idx not in by_index:
             by_index[idx] = obs  # first pod per index wins (slot[0])

@@ -39,7 +39,12 @@ from tf_operator_tpu.api.types import (
     replica_name,
 )
 from tf_operator_tpu.api.validation import parse_tpu_topology
-from tf_operator_tpu.backend.base import AlreadyExistsError, ClusterBackend, NotFoundError
+from tf_operator_tpu.backend.base import (
+    AlreadyExistsError,
+    ClusterBackend,
+    NotFoundError,
+    match_selector,
+)
 from tf_operator_tpu.backend.jobstore import JobStore
 from tf_operator_tpu.backend.objects import Pod, PodGroup, PodGroupPhase, Service
 from tf_operator_tpu.bootstrap.cluster_spec import AddressResolver, dns_resolver
@@ -189,25 +194,57 @@ class Reconciler:
     # ----------------------------------------------------------- pod claims
 
     def _claim_pods(self, job: TPUJob) -> Dict[ReplicaType, List[Pod]]:
-        """Label-selected, owner-filtered pods bucketed by replica type.
+        """ControllerRefManager parity (SURVEY.md §3.2 ClaimPods):
 
-        Adoption-lite vs the reference's ControllerRefManager: pods with
-        our job label but a different owner uid are ignored (never
-        adopted/orphaned) — the label+uid pair is authoritative here
-        because only the reconciler creates replica pods.
+        - label-matching pod owned by us → claimed;
+        - label-matching pod with NO owner → **adopted** (ownership
+          patched through the backend) — an operator restart that minted
+          a new job uid, or a manually created pod, re-enters management;
+        - pod owned by us whose labels no longer match the selector →
+          **orphaned** (ownership released; the pod stops being ours);
+        - label-matching pod owned by a *different* controller → ignored.
         """
 
-        pods = self.cache.list_pods(
-            job.metadata.namespace, {LABEL_JOB_NAME: job.metadata.name}
-        )
+        ns = job.metadata.namespace
+        selector = {LABEL_JOB_NAME: job.metadata.name}
         out: Dict[ReplicaType, List[Pod]] = {}
-        for pod in pods:
-            if pod.metadata.owner_uid and pod.metadata.owner_uid != job.metadata.uid:
-                continue
-            rtype = pod.replica_type
-            if rtype is None:
-                continue
-            out.setdefault(rtype, []).append(pod)
+        for pod in self.cache.list_pods(ns):
+            matches = match_selector(pod.metadata.labels, selector)
+            owner = pod.metadata.owner_uid
+            owned = bool(owner) and owner == job.metadata.uid
+            if matches:
+                if owner and not owned:
+                    continue  # another controller's pod
+                if not owner:
+                    try:
+                        self.backend.update_pod_owner(
+                            ns, pod.metadata.name, job.metadata.uid
+                        )
+                    except NotFoundError:
+                        continue  # deleted under us: watch will re-sync
+                    except NotImplementedError:
+                        pass  # backend can't patch: manage by label alone
+                    # never mutate the cached object in place — the cache
+                    # copy is shared and must only change via watch events
+                    pod = copy.deepcopy(pod)
+                    pod.metadata.owner_uid = job.metadata.uid
+                    self.recorder.event(
+                        job.key, "Normal", "AdoptedPod",
+                        f"adopted ownerless pod {pod.metadata.name}",
+                    )
+                rtype = pod.replica_type
+                if rtype is None:
+                    continue
+                out.setdefault(rtype, []).append(pod)
+            elif owned:
+                try:
+                    self.backend.update_pod_owner(ns, pod.metadata.name, None)
+                except (NotFoundError, NotImplementedError):
+                    continue
+                self.recorder.event(
+                    job.key, "Normal", "OrphanedPod",
+                    f"released pod {pod.metadata.name} (selector no longer matches)",
+                )
         return out
 
     # ------------------------------------------------------- pod reconcile
@@ -351,6 +388,12 @@ class Reconciler:
                     self.backend.delete_service(job.metadata.namespace, name)
                 except NotFoundError:
                     self.svc_exp.deletion_observed(key)
+                except Exception:
+                    # balance the expectation on ANY failure (symmetric
+                    # with _delete_pod) or the leaked expected-deletion
+                    # stalls the job until the expectations timeout
+                    self.svc_exp.deletion_observed(key)
+                    raise
 
         from tf_operator_tpu.bootstrap.cluster_spec import _replica_port
 

@@ -109,8 +109,11 @@ class MoeMlp(nn.Module):
         gate1 = gate1 / denom
         gate2 = gate2 / denom
 
-        onehot_pos1 = jax.nn.one_hot(pos1, capacity, dtype=probs.dtype)  # [B,S,E,C]
-        onehot_pos2 = jax.nn.one_hot(pos2, capacity, dtype=probs.dtype)
+        # positions are float cumsums; -1 (unrouted) one-hots to all-zero
+        onehot_pos1 = jax.nn.one_hot(
+            pos1.astype(jnp.int32), capacity, dtype=probs.dtype
+        )  # [B,S,E,C]
+        onehot_pos2 = jax.nn.one_hot(pos2.astype(jnp.int32), capacity, dtype=probs.dtype)
         combine = (
             gate1[..., None, None] * keep1[..., None] * onehot_pos1
             + gate2[..., None, None] * keep2[..., None] * onehot_pos2

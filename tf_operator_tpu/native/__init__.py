@@ -61,6 +61,8 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.tpuop_wq_num_requeues.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.tpuop_wq_len.restype = ctypes.c_int
     lib.tpuop_wq_len.argtypes = [ctypes.c_void_p]
+    lib.tpuop_wq_drop_front.restype = ctypes.c_int
+    lib.tpuop_wq_drop_front.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.tpuop_wq_shutdown.argtypes = [ctypes.c_void_p]
 
     lib.tpuop_exp_new.restype = ctypes.c_void_p
@@ -125,12 +127,23 @@ class NativeWorkQueue:
     def get(self, timeout: Optional[float] = None) -> Optional[str]:
         buf = ctypes.create_string_buffer(4096)
         t = -1.0 if timeout is None else float(timeout)
-        n = self._lib.tpuop_wq_get(self._h, t, buf, len(buf))
-        if n == -2:
-            # next key exceeds the buffer (still queued, never lost);
-            # keys are "<ns>/<name>" so this means corrupt input upstream
-            raise ValueError("work-queue key exceeds 4095 bytes")
-        return None if n < 0 else buf.value.decode()
+        while True:
+            n = self._lib.tpuop_wq_get(self._h, t, buf, len(buf))
+            if n != -2:
+                return None if n < 0 else buf.value.decode()
+            # front key exceeds the buffer — keys are "<ns>/<name>" so
+            # this is corrupt input upstream.  Drop it (guarded: only if
+            # still oversized, so concurrent workers can't race a valid
+            # key off) and keep serving — raising here would kill the
+            # caller's worker thread (controller.py gets outside its
+            # try block).
+            dropped = self._lib.tpuop_wq_drop_front(self._h, len(buf) - 1)
+            if dropped > 0:
+                import logging
+
+                logging.getLogger("tpu_operator.native").error(
+                    "dropped corrupt %d-byte work-queue key (max 4095)", dropped
+                )
 
     def done(self, key: str) -> None:
         self._lib.tpuop_wq_done(self._h, key.encode())

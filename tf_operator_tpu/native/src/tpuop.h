@@ -33,6 +33,7 @@ void tpuop_wq_add_after(void *wq, const char *key, double delay);
 double tpuop_wq_add_rate_limited(void *wq, const char *key);
 void tpuop_wq_forget(void *wq, const char *key);
 int tpuop_wq_num_requeues(void *wq, const char *key);
+int tpuop_wq_drop_front(void *wq, int max_len);
 int tpuop_wq_len(void *wq);
 void tpuop_wq_shutdown(void *wq);
 

@@ -210,6 +210,25 @@ int tpuop_wq_num_requeues(void *wq, const char *key) {
   return it == q->failures.end() ? 0 : it->second;
 }
 
+// get() returning -2 leaves the oversized key at the queue head; this
+// discards it so the queue cannot livelock on a corrupt key.  Pops ONLY
+// when the front actually exceeds max_len — two workers that both saw
+// -2 must not race a valid key off the queue.  Returns the dropped
+// key's length, 0 if the front was valid (someone else already dropped),
+// or -1 if the queue was empty.
+int tpuop_wq_drop_front(void *wq, int max_len) {
+  auto *q = as_wq(wq);
+  std::lock_guard<std::mutex> lk(q->mu);
+  if (q->queue.empty()) return -1;
+  if (max_len >= 0 &&
+      q->queue.front().size() <= static_cast<size_t>(max_len))
+    return 0;
+  const std::string key = q->queue.front();
+  q->queue.pop_front();
+  q->queued.erase(key);
+  return static_cast<int>(key.size());
+}
+
 int tpuop_wq_len(void *wq) { return as_wq(wq)->size(); }
 
 void tpuop_wq_shutdown(void *wq) { as_wq(wq)->stop(); }

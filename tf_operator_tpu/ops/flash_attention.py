@@ -212,7 +212,14 @@ def flash_attention_sharded(
     per-shard kernel is exact.  Requires sp == ep == 1 (ring attention
     owns sp > 1)."""
 
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8
+
+        check_kw = {"check_vma": False}
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+        check_kw = {"check_rep": False}
 
     spec = P(("dp", "fsdp"), "tp", None, None)
     fn = shard_map(
@@ -226,7 +233,7 @@ def flash_attention_sharded(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
+        **check_kw,
     )
     return fn(q, k, v)
 
@@ -234,7 +241,12 @@ def flash_attention_sharded(
 def _mesh_flash_applicable(mesh: Optional[Mesh], q, k) -> Optional[str]:
     """"single" | "sharded" | None (= fall back to the XLA path)."""
 
-    if mesh is None or all(s == 1 for s in mesh.shape.values()):
+    if mesh is None:
+        # no mesh in a multi-device program: inputs may carry GSPMD
+        # shardings pallas_call has no partitioning rule for — only the
+        # XLA fallback is safe there
+        return "single" if jax.device_count() == 1 else None
+    if all(s == 1 for s in mesh.shape.values()):
         return "single"
     shape = dict(mesh.shape)
     if shape.get("sp", 1) != 1 or shape.get("ep", 1) != 1:

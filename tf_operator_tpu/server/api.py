@@ -25,7 +25,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional, Tuple
 
 from tf_operator_tpu.api.serde import job_from_dict, job_to_dict
 from tf_operator_tpu.api.types import LABEL_JOB_NAME
@@ -59,6 +59,7 @@ class ApiServer:
         host: str = "127.0.0.1",
         port: int = 0,
         namespace: str = "",
+        leadership: Optional[Callable[[], Tuple[bool, Optional[str]]]] = None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -66,6 +67,12 @@ class ApiServer:
         self.recorder = recorder
         #: when set, the job API serves only this namespace (--namespace)
         self.namespace = namespace
+        #: () -> (is_leader, holder_identity).  With --leader-elect each
+        #: standby has its OWN in-memory JobStore and no running
+        #: controller — a create accepted there would 201 but never
+        #: reconcile, so mutating verbs are refused with 503 + the
+        #: current holder until this process leads.
+        self.leadership = leadership
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -93,6 +100,22 @@ class ApiServer:
             def _route(self):
                 parts = [p for p in self.path.split("?")[0].split("/") if p]
                 return parts
+
+            def _not_leader(self) -> bool:
+                if outer.leadership is None:
+                    return False
+                is_leader, holder = outer.leadership()
+                if is_leader:
+                    return False
+                self._send(
+                    503,
+                    {
+                        "error": "this operator replica is not the leader; "
+                        "mutating verbs are served by the leader only",
+                        "leader": holder or "unknown",
+                    },
+                )
+                return True
 
             def _ns_forbidden(self, ns: str) -> bool:
                 if outer.namespace and ns != outer.namespace:
@@ -195,6 +218,8 @@ class ApiServer:
             def do_POST(self):
                 p = self._route()
                 try:
+                    if self._not_leader():
+                        return None
                     if (
                         len(p) == 5
                         and p[:3] == ["apis", "v1", "namespaces"]
@@ -221,6 +246,8 @@ class ApiServer:
             def do_DELETE(self):
                 p = self._route()
                 try:
+                    if self._not_leader():
+                        return None
                     if (
                         len(p) == 6
                         and p[:3] == ["apis", "v1", "namespaces"]
