@@ -217,7 +217,7 @@ class TestStandbyRejectsMutations:
         body = json.loads(ei.value.read())
         assert body["leader"] == "pid-leader-42"
 
-    def test_delete_rejected_reads_allowed(self, standby):
+    def test_delete_and_reads_rejected_health_open(self, standby):
         req = urllib.request.Request(
             f"http://127.0.0.1:{standby.port}/apis/v1/namespaces/default/tpujobs/x",
             method="DELETE",
@@ -225,7 +225,19 @@ class TestStandbyRejectsMutations:
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(req, timeout=10)
         assert ei.value.code == 503
+        # job-API reads 503 too: the standby's own store is EMPTY, so a
+        # 200 would report running jobs as deleted (wrong, not stale)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{standby.port}/apis/v1/tpujobs", timeout=10
+            )
+        assert ei.value.code == 503
+        # liveness surfaces stay open on standbys
         with urllib.request.urlopen(
-            f"http://127.0.0.1:{standby.port}/apis/v1/tpujobs", timeout=10
+            f"http://127.0.0.1:{standby.port}/healthz", timeout=10
+        ) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{standby.port}/metrics", timeout=10
         ) as r:
             assert r.status == 200

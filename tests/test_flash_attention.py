@@ -63,19 +63,60 @@ class TestFlashForward:
 
 
 class TestFlashGrad:
-    def test_vjp_matches_reference(self):
-        q, k, v = rand_qkv(4, 1, 2, 128, 64)
+    """The VJP is backed by the pallas backward kernels (dq + dk/dv with
+    in-kernel softmax recompute from the saved logsumexp); every case
+    checks dq, dk, dv against the XLA backward."""
+
+    def _check(self, q, k, v, causal, block_q=128, block_k=128, tol=None):
+        # weighted sum => non-trivial dO, unlike .sum() whose dO is ones
+        w = jnp.asarray(
+            np.random.RandomState(99).normal(size=q.shape), jnp.float32
+        )
 
         def f_flash(q, k, v):
-            return flash_attention(q, k, v, True, 128, 128, INTERPRET).sum()
+            return (
+                flash_attention(q, k, v, causal, block_q, block_k, INTERPRET)
+                .astype(jnp.float32) * w
+            ).sum()
 
         def f_ref(q, k, v):
-            return dot_product_attention(q, k, v, causal=True).sum()
+            return (
+                dot_product_attention(q, k, v, causal=causal).astype(jnp.float32)
+                * w
+            ).sum()
 
         g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
         g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
-        for a, b in zip(g_flash, g_ref):
-            np.testing.assert_allclose(a, b, **TOL)
+        for name, a, b in zip("dq dk dv".split(), g_flash, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32),
+                np.asarray(b, np.float32),
+                err_msg=name,
+                **(tol or TOL),
+            )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("s", [128, 256])
+    def test_vjp_matches_reference(self, causal, s):
+        q, k, v = rand_qkv(4, 1, 2, s, 64)
+        self._check(q, k, v, causal)
+
+    def test_uneven_blocks(self):
+        q, k, v = rand_qkv(11, 1, 2, 256, 64)
+        self._check(q, k, v, True, block_q=64, block_k=128)
+
+    def test_cross_attention_lengths(self):
+        q, k, v = rand_qkv(12, 1, 2, 128, 64, sk=256)
+        self._check(q, k, v, False)
+
+    def test_bfloat16_grads(self):
+        q, k, v = rand_qkv(13, 1, 2, 128, 64, dtype=jnp.bfloat16)
+        self._check(q, k, v, True, tol=dict(atol=3e-2, rtol=3e-2))
+
+    def test_xla_recompute_fallback_env(self, monkeypatch):
+        monkeypatch.setenv("TPU_OPERATOR_FLASH_BWD", "0")
+        q, k, v = rand_qkv(14, 1, 2, 128, 64)
+        self._check(q, k, v, True)
 
 
 class TestDispatch:

@@ -1,0 +1,167 @@
+"""Real-TPU tier (SURVEY.md §4 tier 4): compiled-kernel and on-chip
+training checks.  Excluded by default; run with
+
+    RUN_TPU_TESTS=1 python -m pytest tests/test_tpu_chip.py -m tpu -q
+
+(VERDICT round 1 item 3: the pallas kernels' real-MXU behavior must be
+validated by something reproducible, not only the CPU interpreter.)
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = [
+    pytest.mark.tpu,
+    pytest.mark.skipif(
+        os.environ.get("RUN_TPU_TESTS") != "1", reason="set RUN_TPU_TESTS=1"
+    ),
+]
+
+TOL = dict(atol=5e-3, rtol=5e-3)  # MXU f32 matmul precision ~1e-3
+
+
+@pytest.fixture(scope="module")
+def tpu():
+    devs = jax.devices()
+    if devs[0].platform != "tpu":
+        pytest.skip(f"default backend is {devs[0].platform}, not tpu")
+    return devs[0]
+
+
+def rand_qkv(rng, b, h, s, d, dtype=jnp.bfloat16):
+    r = np.random.RandomState(rng)
+    mk = lambda: jnp.asarray(r.normal(size=(b, h, s, d)), dtype)
+    return mk(), mk(), mk()
+
+
+class TestFlashKernelOnChip:
+    def test_forward_matches_xla(self, tpu):
+        from tf_operator_tpu.ops import dot_product_attention, flash_attention
+
+        q, k, v = rand_qkv(0, 2, 4, 1024, 128)
+        got = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))(q, k, v)
+        want = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            atol=2e-2, rtol=2e-2,
+        )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_backward_matches_xla(self, tpu, causal):
+        from tf_operator_tpu.ops import dot_product_attention, flash_attention
+
+        q, k, v = rand_qkv(1, 1, 2, 512, 128)
+        w = jnp.asarray(
+            np.random.RandomState(9).normal(size=q.shape), jnp.float32
+        )
+
+        def f_flash(q, k, v):
+            return (flash_attention(q, k, v, causal).astype(jnp.float32) * w).sum()
+
+        def f_ref(q, k, v):
+            return (
+                dot_product_attention(q, k, v, causal=causal).astype(jnp.float32) * w
+            ).sum()
+
+        g_flash = jax.jit(jax.grad(f_flash, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.jit(jax.grad(f_ref, argnums=(0, 1, 2)))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), g_flash, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                err_msg=name, atol=3e-2, rtol=3e-2,
+            )
+
+    def test_flash_beats_xla_at_long_seq(self, tpu):
+        """Training step (fwd+bwd) with the flash kernel must beat the
+        XLA path at seq >= 4k (VERDICT round 1 item 4 done-criterion)."""
+
+        import time
+
+        from tf_operator_tpu.ops import dot_product_attention, flash_attention
+
+        q, k, v = rand_qkv(2, 2, 8, 4096, 128)
+
+        def train_flash(q, k, v):
+            return flash_attention(q, k, v, True).astype(jnp.float32).sum()
+
+        def train_xla(q, k, v):
+            return dot_product_attention(q, k, v, causal=True).astype(jnp.float32).sum()
+
+        def bench(f):
+            g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+            jax.block_until_ready(g(q, k, v))  # compile
+            t0 = time.perf_counter()
+            for _ in range(10):
+                out = g(q, k, v)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / 10
+
+    # generous margin: the win must be real, not noise
+        t_flash, t_xla = bench(train_flash), bench(train_xla)
+        assert t_flash < t_xla, f"flash {t_flash*1e3:.1f}ms !< xla {t_xla*1e3:.1f}ms"
+
+
+class TestTrainerOnChip:
+    def test_one_resnet_step(self, tpu):
+        from tf_operator_tpu.models import resnet18
+        from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+        from tf_operator_tpu.parallel.trainer import batchnorm_cross_entropy_loss
+
+        mesh = make_mesh({"dp": 1}, devices=[tpu])
+        rng = np.random.RandomState(0)
+        batch = {
+            "image": jnp.asarray(rng.rand(8, 64, 64, 3), jnp.bfloat16),
+            "label": jnp.asarray(rng.randint(0, 10, size=(8,))),
+        }
+        trainer = Trainer(
+            resnet18(num_classes=10),
+            TrainerConfig(optimizer="sgd", learning_rate=0.1),
+            mesh,
+            batchnorm_cross_entropy_loss,
+            batch,
+        )
+        m = trainer.train_step(trainer.shard_batch(batch))
+        assert np.isfinite(float(m["loss"]))
+
+    def test_one_gpt_step_with_flash(self, tpu):
+        from tf_operator_tpu.models import gpt_tiny, lm_loss
+        from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+
+        mesh = make_mesh({"dp": 1}, devices=[tpu])
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, size=(2, 256)))
+        trainer = Trainer(
+            gpt_tiny(vocab_size=128, max_len=256, mesh=mesh),
+            TrainerConfig(learning_rate=1e-3),
+            mesh,
+            lm_loss,
+            {"input_ids": ids},
+            init_args=(ids,),
+            shardings="logical",
+        )
+        m = trainer.train_step(trainer.shard_batch({"input_ids": ids}))
+        assert np.isfinite(float(m["loss"]))
+
+
+class TestBenchSmoke:
+    def test_bench_emits_number(self, tpu):
+        """bench-shaped smoke: tiny config through the same code path the
+        driver runs."""
+
+        import json
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        env.update(BENCH_BATCH_PER_CHIP="16", BENCH_STEPS="3", BENCH_RETRIES="1")
+        out = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(__file__), "..", "bench.py")],
+            env=env, capture_output=True, text=True, timeout=1200,
+        )
+        line = [l for l in out.stdout.splitlines() if l.strip().startswith("{")][-1]
+        result = json.loads(line)
+        assert "error" not in result, result
+        assert result["value"] > 0

@@ -125,9 +125,18 @@ class NativeWorkQueue:
         self._lib.tpuop_wq_add(self._h, key.encode())
 
     def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        import time
+
         buf = ctypes.create_string_buffer(4096)
-        t = -1.0 if timeout is None else float(timeout)
+        # deadline once, remaining time per retry: corrupt-key drops
+        # must not restart the caller's timeout window
+        deadline = None if timeout is None else time.monotonic() + float(timeout)
         while True:
+            t = (
+                -1.0
+                if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            )
             n = self._lib.tpuop_wq_get(self._h, t, buf, len(buf))
             if n != -2:
                 return None if n < 0 else buf.value.decode()

@@ -15,9 +15,12 @@ classic flash attention (online softmax, never materialising the
   @pl.when (partially-masked diagonal blocks mask per element);
 - bf16-friendly: matmuls run with preferred_element_type=float32.
 
-Forward-only kernel: the VJP recomputes attention with the XLA fallback
-(flash-style recompute — O(S) memory in the forward where it matters;
-the backward matches ops.attention numerics exactly).
+Training-complete: the custom VJP is backed by pallas backward kernels
+(_flash_bwd_dq_kernel / _flash_bwd_dkv_kernel) that recompute the
+softmax from the forward's saved row-logsumexp block by block — the
+[Sq, Sk] score matrix never exists in either direction.  The primal
+forward skips the lse write entirely; TPU_OPERATOR_FLASH_BWD=0 falls
+back to an XLA-recompute VJP.
 
 Dispatch: `attention()` picks flash when it applies (TPU backend, no
 bias/mask, tile-aligned shapes) and falls back to
@@ -47,18 +50,30 @@ _NEG_INF = float(jnp.finfo(jnp.float32).min)
 _LANES = 128
 
 
+def _causal_mask(logits, qi, ji, block_q, block_k):
+    qpos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    kpos = ji * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(qpos >= kpos, logits, _NEG_INF)
+
+
 def _flash_kernel(
     q_ref,
     k_ref,
     v_ref,
     o_ref,
-    m_ref,
-    l_ref,
-    acc_ref,
-    *,
+    *rest,
     scale: float,
     causal: bool,
+    with_lse: bool,
 ):
+    if with_lse:
+        lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        m_ref, l_ref, acc_ref = rest
     qi = pl.program_id(2)
     ji = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -84,13 +99,7 @@ def _flash_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = ji * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            logits = jnp.where(qpos >= kpos, logits, _NEG_INF)
+            logits = _causal_mask(logits, qi, ji, block_q, block_k)
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(logits, axis=-1, keepdims=True)
@@ -108,6 +117,11 @@ def _flash_kernel(
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-37)  # fully-masked rows divide safely
         o_ref[0, 0, :, :] = (acc_ref[:] / l).astype(o_ref.dtype)
+        if with_lse:
+            # logsumexp per row, broadcast across the lane dim (the
+            # public TPU flash kernels use the same 128-lane padding —
+            # sublane→lane reshapes are not a TPU-friendly op)
+            lse_ref[0, 0, :, :] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-37))
 
 
 def _flash_forward(
@@ -118,29 +132,33 @@ def _flash_forward(
     block_q: int,
     block_k: int,
     interpret: bool,
-) -> jax.Array:
+    with_lse: bool = False,
+):
+    """Forward kernel.  with_lse=True additionally returns the row
+    logsumexp [B, H, Sq, LANES] (lane-broadcast) for the backward; the
+    primal-only variant skips that HBM write entirely."""
+
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = 1.0 / (d**0.5)
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal)
-    return pl.pallas_call(
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, with_lse=with_lse
+    )
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ji: (bi, hi, ji, 0))
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    out_specs = [q_spec]
+    if with_lse:
+        out_shape.append(jax.ShapeDtypeStruct((b, h, sq, _LANES), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda bi, hi, qi, ji: (bi, hi, qi, 0))
+        )
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=out_shape,
         grid=(b, h, sq // block_q, sk // block_k),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda bi, hi, qi, ji: (bi, hi, ji, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda bi, hi, qi, ji: (bi, hi, ji, 0)
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0)
-        ),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=out_specs,
         scratch_shapes=[
             # carries persist across the innermost (k) grid dimension
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -150,6 +168,156 @@ def _flash_forward(
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
     )(q, k, v)
+    return tuple(res) if with_lse else res[0]
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, scale: float, causal: bool,
+):
+    qi = pl.program_id(2)
+    ji = pl.program_id(3)
+    nk = pl.num_programs(3)
+    block_q = q_ref.shape[2]
+    block_k = k_ref.shape[2]
+
+    @pl.when(ji == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    needed = (ji * block_k < (qi + 1) * block_q) if causal else (ji >= 0)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            logits = _causal_mask(logits, qi, ji, block_q, block_k)
+        # p is the exact softmax (lse folds max+denominator): masked
+        # entries give exp(-inf - lse) = 0
+        p = jnp.exp(logits - lse_ref[0, 0, :, :1])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        ds = p * (dp - delta_ref[0, 0, :, :1])
+        dq_acc[:] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ji == nk - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, scale: float, causal: bool,
+):
+    # grid (b, h, KV block, Q block): the q dimension is innermost and
+    # sequential; dk/dv accumulate across it in VMEM scratch
+    ji = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+    block_q = q_ref.shape[2]
+    block_k = k_ref.shape[2]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # causal: q blocks strictly above the diagonal see none of this kv
+    # block (all their positions < every kv position) — skip
+    needed = ((qi + 1) * block_q > ji * block_k) if causal else (qi >= 0)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            logits = _causal_mask(logits, qi, ji, block_q, block_k)
+        p = jnp.exp(logits - lse_ref[0, 0, :, :1])  # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # p^T @ do -> [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0, 0, :, :1])
+        # dk = scale * ds^T @ q_raw — q was loaded pre-scaled, so the
+        # factor is already in the operand
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, out, lse, g, causal: bool, block_q: int, block_k: int, interpret: bool
+):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / (d**0.5)
+    # lane-broadcast the [B,H,Sq] row stats for the kernels (transient —
+    # freed when the two pallas calls complete)
+    lse = jnp.broadcast_to(lse[..., None], (b, h, sq, _LANES))
+    # delta_i = rowsum(dO_i * O_i)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (b, h, sq, _LANES))
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ji: (bi, hi, ji, 0))
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, _LANES), lambda bi, hi, qi, ji: (bi, hi, qi, 0)
+    )
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(b, h, sq // block_q, sk // block_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # kv-major grid: every spec indexes with (bi, hi, ji, qi)
+    q_spec_t = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ji, qi: (bi, hi, qi, 0))
+    kv_spec_t = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ji, qi: (bi, hi, ji, 0))
+    row_spec_t = pl.BlockSpec(
+        (1, 1, block_q, _LANES), lambda bi, hi, ji, qi: (bi, hi, qi, 0)
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal),
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        grid=(b, h, sk // block_k, sq // block_q),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t, row_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 def _compiler_params(interpret: bool):
@@ -178,20 +346,40 @@ def flash_attention(
     return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
 
 
+def _use_pallas_bwd() -> bool:
+    # escape hatch back to the XLA-recompute VJP
+    return os.environ.get("TPU_OPERATOR_FLASH_BWD", "1") != "0"
+
+
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    if not _use_pallas_bwd():
+        out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+        return out, (q, k, v, None, None)
+    out, lse = _flash_forward(
+        q, k, v, causal, block_q, block_k, interpret, with_lse=True
+    )
+    # residuals persist across the whole fwd→bwd window (× n_layers in
+    # a stacked model): keep only one lane of the lane-broadcast lse;
+    # the backward re-broadcasts transiently
+    return out, (q, k, v, out, lse[..., 0])
 
 
 def _bwd(causal, block_q, block_k, interpret, res, g):
-    # flash-style recompute: no [Sq, Sk] scores saved from the forward;
-    # the backward re-derives them through the XLA reference (numerics
-    # identical to ops.attention)
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: dot_product_attention(q, k, v, causal=causal), q, k, v
+    q, k, v, out, lse = res
+    if lse is None:
+        # XLA-recompute fallback (TPU_OPERATOR_FLASH_BWD=0): re-derives
+        # the scores through the reference path — numerics identical to
+        # ops.attention
+        _, vjp = jax.vjp(
+            lambda q, k, v: dot_product_attention(q, k, v, causal=causal), q, k, v
+        )
+        return vjp(g)
+    # pallas backward: dq then dk/dv, each streaming blocks and
+    # recomputing p from (q, k, lse) in-kernel — O(block) memory, the
+    # [Sq, Sk] score matrix never exists
+    return _flash_backward(
+        q, k, v, out, lse, g, causal, block_q, block_k, interpret
     )
-    return vjp(g)
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -70,8 +70,10 @@ class ApiServer:
         #: () -> (is_leader, holder_identity).  With --leader-elect each
         #: standby has its OWN in-memory JobStore and no running
         #: controller — a create accepted there would 201 but never
-        #: reconcile, so mutating verbs are refused with 503 + the
-        #: current holder until this process leads.
+        #: reconcile, and a read would serve the standby's EMPTY store
+        #: (wrong, not just stale).  So the whole job API is refused
+        #: with 503 + the current holder until this process leads; only
+        #: /healthz, /metrics and the dashboard shell stay open.
         self.leadership = leadership
         outer = self
 
@@ -140,6 +142,8 @@ class ApiServer:
                         return self._send(
                             200, outer.metrics.exposition(), "text/plain"
                         )
+                    if p[0] == "apis" and self._not_leader():
+                        return None
                     if p == ["apis", "v1", "tpujobs"]:
                         return self._send(
                             200,
