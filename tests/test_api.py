@@ -258,3 +258,35 @@ class TestSerde:
             job.spec.replica_specs[ReplicaType.WORKER].restart_policy
             is RestartPolicy.ON_FAILURE
         )
+
+
+class TestHostsPerReplicaValidation:
+    def test_bad_hosts_per_replica_rejected(self):
+        from tests.testutil import new_job
+        from tf_operator_tpu.api.types import ReplicaType
+        from tf_operator_tpu.api.validation import ValidationError, validate
+
+        for bad in ("abc", 0, -1, 2.5, True):
+            job = new_job(tpu_slice=1, tpu_topology="v5e-16")
+            job.spec.replica_specs[ReplicaType.TPU_SLICE].hosts_per_replica = bad
+            with pytest.raises(ValidationError, match="hostsPerReplica"):
+                validate(job)
+
+    def test_hosts_per_replica_wrong_type_rejected(self):
+        from tests.testutil import new_job
+        from tf_operator_tpu.api.types import ReplicaType
+        from tf_operator_tpu.api.validation import ValidationError, validate
+
+        job = new_job(worker=1)
+        job.spec.replica_specs[ReplicaType.WORKER].hosts_per_replica = 2
+        with pytest.raises(ValidationError, match="only valid for TPUSlice"):
+            validate(job)
+
+    def test_valid_hosts_per_replica_accepted(self):
+        from tests.testutil import new_job
+        from tf_operator_tpu.api.types import ReplicaType
+        from tf_operator_tpu.api.validation import validate
+
+        job = new_job(tpu_slice=1, tpu_topology="v5e-16")
+        job.spec.replica_specs[ReplicaType.TPU_SLICE].hosts_per_replica = 2
+        validate(job)

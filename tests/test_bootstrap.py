@@ -112,7 +112,8 @@ class TestTPUEnv:
         assert env["TPU_WORKER_ID"] == "0"
 
     def test_multislice_golden(self):
-        job = mkjob(tpu_slice=2, tpu_topology="v5e-16")
+        # v5e-4 = single host per slice: pod index == slice id
+        job = mkjob(tpu_slice=2, tpu_topology="v5e-4")
         env = gen_tpu_env(job, ReplicaType.TPU_SLICE, 1)
         assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "job-tpuslice-0.default.svc"
         assert env["MEGASCALE_NUM_SLICES"] == "2"
@@ -121,6 +122,34 @@ class TestTPUEnv:
         # other slices would contradict the MEGASCALE topology
         assert env["TPU_WORKER_ID"] == "0"
         assert env["TPU_WORKER_HOSTNAMES"] == "job-tpuslice-1.default.svc"
+
+    def test_multihost_slice_expansion_golden(self):
+        """The multi-host expansion contract (bootstrap/tpu_env.py):
+        v5e-16 = 4 host VMs per slice → 4 pods per slice.  Pod s*4+h is
+        host h of slice s; its worker id is h and its hostname list
+        covers exactly its own slice's 4 pods."""
+
+        job = mkjob(tpu_slice=2, tpu_topology="v5e-16")
+        assert job.spec.pod_count(ReplicaType.TPU_SLICE) == 8
+        # pod 5 = slice 1, host 1
+        env = gen_tpu_env(job, ReplicaType.TPU_SLICE, 5)
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+        assert env["TPU_WORKER_ID"] == "1"
+        assert env["TPU_WORKER_HOSTNAMES"] == ",".join(
+            f"job-tpuslice-{p}.default.svc" for p in (4, 5, 6, 7)
+        )
+        # every pod is its own JAX process: 8 distinct ids, 8 processes
+        assert env["TPUJOB_NUM_PROCESSES"] == "8"
+        ids = {
+            int(gen_tpu_env(job, ReplicaType.TPU_SLICE, p)["TPUJOB_PROCESS_ID"])
+            for p in range(8)
+        }
+        assert ids == set(range(8))
+        # explicit override beats the topology-derived host count
+        job2 = mkjob(tpu_slice=1, tpu_topology="v5e-16")
+        job2.spec.replica_specs[ReplicaType.TPU_SLICE].hosts_per_replica = 2
+        assert job2.spec.pod_count(ReplicaType.TPU_SLICE) == 2
 
     def test_worker_env_combines_both(self):
         job = mkjob(chief=1, worker=1)

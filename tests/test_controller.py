@@ -141,7 +141,7 @@ class TestEnvInjection:
 
     def test_multislice_megascale_env(self):
         store, backend, c = harness()
-        submit(store, c, new_job(tpu_slice=2, tpu_topology="v5e-16"))
+        submit(store, c, new_job(tpu_slice=2, tpu_topology="v5e-4"))
         env = backend.get_pod("default", "job-tpuslice-1").main_container().env
         assert env["MEGASCALE_NUM_SLICES"] == "2"
         assert env["MEGASCALE_SLICE_ID"] == "1"
@@ -353,7 +353,7 @@ class TestScaleRegression:
 class TestMixedSliceWorkerSuccess:
     def test_worker0_alone_is_not_enough_with_slices(self):
         store, backend, c = harness()
-        job = submit(store, c, new_job(worker=1, tpu_slice=2, tpu_topology="v5e-8"))
+        job = submit(store, c, new_job(worker=1, tpu_slice=2, tpu_topology="v5e-4"))
         backend.run_all("default")
         backend.succeed_pod("default", "job-worker-0")
         c.sync_until_quiet()

@@ -51,9 +51,9 @@ class TestGangAdmission:
         assert pod.phase is PodPhase.PENDING
 
     def test_contending_jobs_queue_and_release(self):
-        store, backend, c = harness(total_chips=16)
-        a = new_job(name="job-a", tpu_slice=1, tpu_topology="v5e-16")
-        b = new_job(name="job-b", tpu_slice=1, tpu_topology="v5e-16")
+        store, backend, c = harness(total_chips=4)
+        a = new_job(name="job-a", tpu_slice=1, tpu_topology="v5e-4")
+        b = new_job(name="job-b", tpu_slice=1, tpu_topology="v5e-4")
         submit(store, c, a)
         submit(store, c, b)
         assert backend.get_pod_group("default", "job-a").phase is PodGroupPhase.GRANTED
@@ -77,7 +77,7 @@ class TestGangAdmission:
 
     def test_tpu_slice_success_requires_all_members(self):
         store, backend, c = harness()
-        job = submit(store, c, new_job(tpu_slice=2, tpu_topology="v5e-8"))
+        job = submit(store, c, new_job(tpu_slice=2, tpu_topology="v5e-4"))
         backend.run_all("default")
         backend.succeed_pod("default", "job-tpuslice-0")
         c.sync_until_quiet()

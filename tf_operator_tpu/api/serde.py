@@ -113,6 +113,7 @@ def job_from_dict(d: Dict[str, Any]) -> TPUJob:
             template=_template_from_dict(rs.get("template", {})),
             restart_policy=RestartPolicy(rs["restartPolicy"]) if rs.get("restartPolicy") else None,
             tpu_topology=rs.get("tpuTopology", ""),
+            hosts_per_replica=rs.get("hostsPerReplica"),
         )
 
     run_policy = RunPolicy(
@@ -209,6 +210,8 @@ def _replica_spec_to_dict(rs: ReplicaSpec) -> Dict[str, Any]:
         out["restartPolicy"] = rs.restart_policy.value
     if rs.tpu_topology:
         out["tpuTopology"] = rs.tpu_topology
+    if rs.hosts_per_replica is not None:
+        out["hostsPerReplica"] = rs.hosts_per_replica
     return out
 
 

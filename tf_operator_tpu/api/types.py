@@ -173,9 +173,11 @@ class PodTemplateSpec:
 class SchedulingPolicy:
     """Gang-scheduling knobs (SURVEY.md §2 "Generic job-controller runtime").
 
-    ``min_member`` defaults to the job's total replica count at defaulting
-    time.  For TPU_SLICE replicas, gang admission is mandatory: a slice is
-    atomic hardware.
+    ``min_member`` is a POD count (volcano semantics): a multi-host
+    TPU_SLICE replica contributes one member per host VM.  Unset, it
+    defaults to the job's total pod count — which keeps multi-host
+    slices atomic; pinning it below that deliberately permits partial
+    gangs (not recommended with TPU_SLICE: a slice is atomic hardware).
     """
 
     min_member: Optional[int] = None
@@ -200,6 +202,22 @@ class ReplicaSpec:
     #: TPU_SLICE only: accelerator topology of the atomic slice, e.g.
     #: "v5e-16".  Informs the gang allocator's chip accounting.
     tpu_topology: str = ""
+    #: TPU_SLICE only: host VMs per slice.  None = derive from the
+    #: topology (4 chips/host); a multi-host slice expands into one pod
+    #: per host (bootstrap/tpu_env.py expansion contract).
+    hosts_per_replica: Optional[int] = None
+
+    def slice_host_count(self) -> int:
+        if self.hosts_per_replica is not None:
+            return max(1, int(self.hosts_per_replica))
+        if not self.tpu_topology:
+            return 1
+        from tf_operator_tpu.api.validation import slice_hosts
+
+        try:
+            return slice_hosts(self.tpu_topology)
+        except ValueError:
+            return 1
 
 
 @dataclass
@@ -214,6 +232,22 @@ class TPUJobSpec:
 
     def total_replicas(self) -> int:
         return sum(int(rs.replicas or 0) for rs in self.replica_specs.values())
+
+    def pod_count(self, rtype: "ReplicaType") -> int:
+        """Pods backing one replica type.  A multi-host TPU_SLICE
+        replica expands into one pod per host VM (slice s, host h →
+        pod index s*H + h); every other type is 1:1."""
+
+        spec = self.replica_specs.get(rtype)
+        if spec is None:
+            return 0
+        n = int(spec.replicas or 0)
+        if rtype is ReplicaType.TPU_SLICE:
+            return n * spec.slice_host_count()
+        return n
+
+    def total_pods(self) -> int:
+        return sum(self.pod_count(t) for t in self.replica_specs)
 
     def ordered_types(self) -> List[ReplicaType]:
         return [t for t in REPLICA_TYPE_ORDER if t in self.replica_specs]

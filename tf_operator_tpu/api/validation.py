@@ -58,6 +58,24 @@ def parse_tpu_topology(topology: str) -> int:
     raise ValueError(f"unparseable TPU topology {topology!r}")
 
 
+#: chips per TPU host VM — 4 across v4/v5e/v5p/v6e pod slices (public
+#: GKE topology: v5litepod-16 = 4 VMs x 4 chips).
+CHIPS_PER_HOST = 4
+
+
+def slice_hosts(topology: str) -> int:
+    """Number of host VMs backing one slice of this topology.
+
+    The multi-host expansion contract (bootstrap/tpu_env.py): a slice
+    whose topology spans H > 1 hosts runs as H pods — one per host VM,
+    exactly as GKE schedules one pod per TPU VM — each with
+    TPU_WORKER_ID = host and the full slice host list.
+    """
+
+    chips = parse_tpu_topology(topology)
+    return max(1, -(-chips // CHIPS_PER_HOST))
+
+
 def validate(job: TPUJob) -> None:
     """Raise ValidationError if the spec is invalid.  No-op otherwise."""
 
@@ -84,6 +102,20 @@ def validate(job: TPUJob) -> None:
         prefix = f"replicaSpecs[{rtype.value}]"
         if rspec.replicas is not None and rspec.replicas < 0:
             problems.append(f"{prefix}.replicas must be >= 0")
+        if rspec.hosts_per_replica is not None:
+            # admission must reject what pod_count() would crash on
+            if (
+                not isinstance(rspec.hosts_per_replica, int)
+                or isinstance(rspec.hosts_per_replica, bool)
+                or rspec.hosts_per_replica < 1
+            ):
+                problems.append(
+                    f"{prefix}.hostsPerReplica must be an integer >= 1"
+                )
+            elif rtype is not ReplicaType.TPU_SLICE:
+                problems.append(
+                    f"{prefix}.hostsPerReplica is only valid for TPUSlice replicas"
+                )
         main = rspec.template.main_container(DEFAULT_CONTAINER_NAME)
         if main is None:
             problems.append(

@@ -55,10 +55,12 @@ def gen_cluster_spec(
 
     cluster: Dict[str, List[str]] = {}
     for rtype in job.spec.ordered_types():
-        spec = job.spec.replica_specs[rtype]
         port = _replica_port(job, rtype)
         cluster[rtype.lower_name] = [
-            resolve(job, rtype, i, port) for i in range(int(spec.replicas or 0))
+            # pod_count: one entry per pod — multi-host slices list every
+            # host (they each run one pod with a stable service name)
+            resolve(job, rtype, i, port)
+            for i in range(job.spec.pod_count(rtype))
         ]
     return cluster
 
@@ -115,7 +117,7 @@ def _gen_tf_config_native(
     if not available():
         return None
     desc = ",".join(
-        f"{t.lower_name}={int(job.spec.replica_specs[t].replicas or 0)}"
+        f"{t.lower_name}={job.spec.pod_count(t)}"
         f":{_replica_port(job, t)}"
         for t in job.spec.ordered_types()
     )

@@ -169,7 +169,7 @@ def evaluate_success(
         pod0 = next((p for p in pods if p.replica_index == 0), None)
         p0s = 1 if pod0 is not None and pod0.phase is PodPhase.SUCCEEDED else 0
         parts.append(
-            f"{rtype.value}:{int(spec.replicas or 0)}:{len(pods)}:{nsucc}:{p0s}"
+            f"{rtype.value}:{job.spec.pod_count(rtype)}:{len(pods)}:{nsucc}:{p0s}"
         )
     desc = (
         f"policy={job.spec.success_policy.value or 'Default'};types="

@@ -267,7 +267,7 @@ class Reconciler:
         from tf_operator_tpu.controller.plan import plan_replica
 
         key = job.key
-        want = int(spec.replicas or 0)
+        want = job.spec.pod_count(rtype)  # multi-host slices expand
         by_index: Dict[int, List[Pod]] = {}
         observed = []
         for p in pods:
@@ -331,7 +331,13 @@ class Reconciler:
         pod.scheduler_name = template.scheduler_name
         pod.node_selector = dict(template.node_selector)
         if rtype is ReplicaType.TPU_SLICE:
-            pod.chip_request = parse_tpu_topology(job.spec.replica_specs[rtype].tpu_topology)
+            # per-POD chips = per-host share of the slice (a multi-host
+            # slice runs one pod per host VM); ceil so Σ per-pod chips
+            # never under-counts the gang group's whole-slice accounting
+            spec_ts = job.spec.replica_specs[rtype]
+            chips = parse_tpu_topology(spec_ts.tpu_topology)
+            hosts = spec_ts.slice_host_count()
+            pod.chip_request = max(1, -(-chips // hosts))
         if gang:
             pod.metadata.annotations[ANNOTATION_GANG_GROUP] = job.metadata.name
             pod.scheduler_name = pod.scheduler_name or self.config.gang_scheduler_name
@@ -370,7 +376,7 @@ class Reconciler:
         reconciler") — the stable DNS names the cluster spec points at."""
 
         key = job.key
-        want = int(spec.replicas or 0)
+        want = job.spec.pod_count(rtype)
         prefix = f"{job.metadata.name}-{rtype.lower_name}-"
         existing = {
             s.metadata.name
@@ -427,7 +433,7 @@ class Reconciler:
         if slice_spec is not None:
             chips = parse_tpu_topology(slice_spec.tpu_topology) * int(slice_spec.replicas or 0)
         sp = job.spec.run_policy.scheduling_policy
-        min_member = sp.min_member if sp and sp.min_member else job.spec.total_replicas()
+        min_member = sp.min_member if sp and sp.min_member else job.spec.total_pods()
         existing = self.backend.get_pod_group(job.metadata.namespace, job.metadata.name)
         if existing is not None:
             # dynamic scale: keep gang size/chip accounting in step

@@ -150,7 +150,7 @@ def _evaluate_success_py(
 
     if job.spec.success_policy is SuccessPolicy.ALL_WORKERS:
         for rtype in workers:
-            want = int(job.spec.replica_specs[rtype].replicas or 0)
+            want = job.spec.pod_count(rtype)
             rs = [p for p in pods_by_type.get(rtype, []) if p.phase is PodPhase.SUCCEEDED]
             if len(rs) < want:
                 return False, ""
@@ -161,7 +161,8 @@ def _evaluate_success_py(
     # including when ordinary workers coexist with slices, where BOTH
     # the slice gang and worker-0 must succeed before the job is done.
     if ReplicaType.TPU_SLICE in workers:
-        want = int(job.spec.replica_specs[ReplicaType.TPU_SLICE].replicas or 0)
+        # every pod of every slice (all hosts) must finish
+        want = job.spec.pod_count(ReplicaType.TPU_SLICE)
         done = sum(
             1
             for p in pods_by_type.get(ReplicaType.TPU_SLICE, [])
