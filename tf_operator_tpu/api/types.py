@@ -151,6 +151,22 @@ class Container:
                 return p
         return None
 
+    def clone(self) -> "Container":
+        """Fast deep copy — generic copy.deepcopy dominated the sync
+        hot path (watch-event snapshots happen per write, cache reads
+        per sync), so every object clones by hand."""
+
+        return Container(
+            name=self.name,
+            image=self.image,
+            command=list(self.command),
+            args=list(self.args),
+            env=dict(self.env),
+            ports=[Port(p.name, p.container_port) for p in self.ports],
+            resources=copy.deepcopy(self.resources) if self.resources else {},
+            working_dir=self.working_dir,
+        )
+
 
 @dataclass
 class PodTemplateSpec:
@@ -168,6 +184,15 @@ class PodTemplateSpec:
                 return c
         return None
 
+    def clone(self) -> "PodTemplateSpec":
+        return PodTemplateSpec(
+            containers=[c.clone() for c in self.containers],
+            labels=dict(self.labels),
+            annotations=dict(self.annotations),
+            scheduler_name=self.scheduler_name,
+            node_selector=dict(self.node_selector),
+        )
+
 
 @dataclass
 class SchedulingPolicy:
@@ -184,6 +209,9 @@ class SchedulingPolicy:
     queue: str = ""
     priority_class: str = ""
 
+    def clone(self) -> "SchedulingPolicy":
+        return SchedulingPolicy(self.min_member, self.queue, self.priority_class)
+
 
 @dataclass
 class RunPolicy:
@@ -192,6 +220,17 @@ class RunPolicy:
     active_deadline_seconds: Optional[int] = None
     backoff_limit: Optional[int] = None
     scheduling_policy: Optional[SchedulingPolicy] = None
+
+    def clone(self) -> "RunPolicy":
+        return RunPolicy(
+            clean_pod_policy=self.clean_pod_policy,
+            ttl_seconds_after_finished=self.ttl_seconds_after_finished,
+            active_deadline_seconds=self.active_deadline_seconds,
+            backoff_limit=self.backoff_limit,
+            scheduling_policy=(
+                self.scheduling_policy.clone() if self.scheduling_policy else None
+            ),
+        )
 
 
 @dataclass
@@ -206,6 +245,15 @@ class ReplicaSpec:
     #: topology (4 chips/host); a multi-host slice expands into one pod
     #: per host (bootstrap/tpu_env.py expansion contract).
     hosts_per_replica: Optional[int] = None
+
+    def clone(self) -> "ReplicaSpec":
+        return ReplicaSpec(
+            replicas=self.replicas,
+            template=self.template.clone(),
+            restart_policy=self.restart_policy,
+            tpu_topology=self.tpu_topology,
+            hosts_per_replica=self.hosts_per_replica,
+        )
 
     def slice_host_count(self) -> int:
         if self.hosts_per_replica is not None:
@@ -252,6 +300,15 @@ class TPUJobSpec:
     def ordered_types(self) -> List[ReplicaType]:
         return [t for t in REPLICA_TYPE_ORDER if t in self.replica_specs]
 
+    def clone(self) -> "TPUJobSpec":
+        return TPUJobSpec(
+            replica_specs={t: rs.clone() for t, rs in self.replica_specs.items()},
+            run_policy=self.run_policy.clone(),
+            success_policy=self.success_policy,
+            enable_gang_scheduling=self.enable_gang_scheduling,
+            enable_dynamic_worker=self.enable_dynamic_worker,
+        )
+
 
 # ---------------------------------------------------------------------------
 # Status objects
@@ -290,6 +347,24 @@ class TPUJobStatus:
                 return c
         return None
 
+    def clone(self) -> "TPUJobStatus":
+        return TPUJobStatus(
+            conditions=[
+                JobCondition(
+                    c.type, c.status, c.reason, c.message,
+                    c.last_update_time, c.last_transition_time,
+                )
+                for c in self.conditions
+            ],
+            replica_statuses={
+                t: ReplicaStatus(r.active, r.succeeded, r.failed)
+                for t, r in self.replica_statuses.items()
+            },
+            start_time=self.start_time,
+            completion_time=self.completion_time,
+            restart_count=self.restart_count,
+        )
+
     def has_condition(self, ctype: JobConditionType, status: bool = True) -> bool:
         c = self.condition(ctype)
         return c is not None and c.status == status
@@ -307,6 +382,19 @@ class ObjectMeta:
     resource_version: int = 0
     owner_uid: str = ""  # ownerRef equivalent: the owning job's uid
 
+    def clone(self) -> "ObjectMeta":
+        return ObjectMeta(
+            name=self.name,
+            namespace=self.namespace,
+            uid=self.uid,
+            labels=dict(self.labels),
+            annotations=dict(self.annotations),
+            creation_time=self.creation_time,
+            deletion_time=self.deletion_time,
+            resource_version=self.resource_version,
+            owner_uid=self.owner_uid,
+        )
+
 
 @dataclass
 class TPUJob:
@@ -319,7 +407,13 @@ class TPUJob:
         return f"{self.metadata.namespace}/{self.metadata.name}"
 
     def deepcopy(self) -> "TPUJob":
-        return copy.deepcopy(self)
+        return TPUJob(
+            metadata=self.metadata.clone(),
+            spec=self.spec.clone(),
+            status=self.status.clone(),
+        )
+
+    clone = deepcopy
 
     def is_terminal(self) -> bool:
         return self.status.has_condition(JobConditionType.SUCCEEDED) or self.status.has_condition(

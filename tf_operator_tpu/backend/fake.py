@@ -65,7 +65,7 @@ class FakeCluster(ClusterBackend):
         # snapshot: watchers must never alias live store objects, or the
         # manual-delivery lag simulation (and cache/store isolation)
         # breaks for in-place mutations like phase transitions
-        ev = WatchEvent(type=etype, kind=kind, obj=copy.deepcopy(obj))
+        ev = WatchEvent(type=etype, kind=kind, obj=obj.clone())
         if self.delivery == "sync":
             self._dispatch(ev)
         else:

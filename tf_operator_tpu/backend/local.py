@@ -88,9 +88,7 @@ class LocalProcessBackend(ClusterBackend):
             self._handlers.append(handler)
 
     def _emit(self, etype: WatchEventType, kind: str, obj) -> None:
-        import copy
-
-        ev = WatchEvent(type=etype, kind=kind, obj=copy.deepcopy(obj))
+        ev = WatchEvent(type=etype, kind=kind, obj=obj.clone())
         for h in list(self._handlers):
             h(ev)
 

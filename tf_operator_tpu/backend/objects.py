@@ -82,6 +82,18 @@ class Pod:
                 return c
         return None
 
+    def clone(self) -> "Pod":
+        return Pod(
+            metadata=self.metadata.clone(),
+            containers=[c.clone() for c in self.containers],
+            scheduler_name=self.scheduler_name,
+            node_selector=dict(self.node_selector),
+            phase=self.phase,
+            exit_code=self.exit_code,
+            restart_count=self.restart_count,
+            chip_request=self.chip_request,
+        )
+
 
 @dataclass
 class Service:
@@ -94,6 +106,13 @@ class Service:
     @property
     def key(self) -> str:
         return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def clone(self) -> "Service":
+        return Service(
+            metadata=self.metadata.clone(),
+            selector=dict(self.selector),
+            port=self.port,
+        )
 
 
 class PodGroupPhase(str, enum.Enum):
@@ -113,3 +132,11 @@ class PodGroup:
     @property
     def key(self) -> str:
         return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def clone(self) -> "PodGroup":
+        return PodGroup(
+            metadata=self.metadata.clone(),
+            min_member=self.min_member,
+            chip_request=self.chip_request,
+            phase=self.phase,
+        )

@@ -46,6 +46,13 @@ class InformerCache:
         self.services: Dict[str, Service] = {}
         self.groups: Dict[str, PodGroup] = {}
         self.jobs: Dict[str, TPUJob] = {}
+        # client-go Indexer parity: list_* with the job-label selector is
+        # the reconciler's hot read — O(own objects), not O(cluster).
+        # key: "<ns>/<job-label>" → {object key, ...}
+        self._pods_by_job: Dict[str, set] = {}
+        self._svcs_by_job: Dict[str, set] = {}
+        # owner index for the orphan pass: owner uid → {pod key, ...}
+        self._pods_by_owner: Dict[str, set] = {}
 
     # -- wiring -------------------------------------------------------------
 
@@ -68,6 +75,13 @@ class InformerCache:
 
     def list_pods(self, namespace: str, selector: Optional[Dict[str, str]] = None) -> List[Pod]:
         with self._lock:
+            keys = self._index_keys(self._pods_by_job, namespace, selector)
+            if keys is not None:
+                return [
+                    p
+                    for p in (self.pods.get(k) for k in keys)
+                    if p is not None and match_selector(p.metadata.labels, selector)
+                ]
             return [
                 p
                 for p in self.pods.values()
@@ -79,12 +93,34 @@ class InformerCache:
         self, namespace: str, selector: Optional[Dict[str, str]] = None
     ) -> List[Service]:
         with self._lock:
+            keys = self._index_keys(self._svcs_by_job, namespace, selector)
+            if keys is not None:
+                return [
+                    s
+                    for s in (self.services.get(k) for k in keys)
+                    if s is not None and match_selector(s.metadata.labels, selector)
+                ]
             return [
                 s
                 for s in self.services.values()
                 if s.metadata.namespace == namespace
                 and match_selector(s.metadata.labels, selector)
             ]
+
+    @staticmethod
+    def _index_keys(index, namespace, selector):
+        """Index bucket for a job-label selector; None = full scan."""
+
+        if not selector or LABEL_JOB_NAME not in selector:
+            return None
+        return index.get(f"{namespace}/{selector[LABEL_JOB_NAME]}", ())
+
+    def list_pods_owned(self, owner_uid: str) -> List[Pod]:
+        """Pods whose controller owner is ``owner_uid`` (owner index)."""
+
+        with self._lock:
+            keys = self._pods_by_owner.get(owner_uid, ())
+            return [p for p in (self.pods.get(k) for k in keys) if p is not None]
 
     def get_group(self, key: str) -> Optional[PodGroup]:
         with self._lock:
@@ -98,18 +134,41 @@ class InformerCache:
             return None
         return f"{obj.metadata.namespace}/{jname}"
 
+    @staticmethod
+    def _index_update(index, obj, job_key: Optional[str], old_key: Optional[str], deleted: bool):
+        # requires self._lock held
+        if old_key is not None and (deleted or old_key != job_key):
+            bucket = index.get(old_key)
+            if bucket is not None:
+                bucket.discard(obj.key)
+                if not bucket:
+                    del index[old_key]
+        if not deleted and job_key is not None:
+            index.setdefault(job_key, set()).add(obj.key)
+
     def _on_pod(self, ev: WatchEvent) -> None:
         pod: Pod = ev.obj
         old_key: Optional[str] = None
+        deleted = ev.type is WatchEventType.DELETED
+        key = self._job_key_for(pod)
         with self._lock:
             prev = self.pods.get(pod.key)
             if prev is not None:
                 old_key = self._job_key_for(prev)
-            if ev.type is WatchEventType.DELETED:
+            if deleted:
                 self.pods.pop(pod.key, None)
             else:
                 self.pods[pod.key] = pod
-        key = self._job_key_for(pod)
+            self._index_update(
+                self._pods_by_job, pod, key, old_key if prev else None, deleted
+            )
+            self._index_update(
+                self._pods_by_owner,
+                pod,
+                pod.metadata.owner_uid or None,
+                (prev.metadata.owner_uid or None) if prev is not None else None,
+                deleted,
+            )
         if old_key and old_key != key:
             # label change moved the pod to another controller: the old
             # one must re-sync to release/recreate (reference updatePod
@@ -124,12 +183,21 @@ class InformerCache:
 
     def _on_service(self, ev: WatchEvent) -> None:
         svc: Service = ev.obj
+        deleted = ev.type is WatchEventType.DELETED
+        key = self._job_key_for(svc)
         with self._lock:
-            if ev.type is WatchEventType.DELETED:
+            prev = self.services.get(svc.key)
+            if deleted:
                 self.services.pop(svc.key, None)
             else:
                 self.services[svc.key] = svc
-        key = self._job_key_for(svc)
+            self._index_update(
+                self._svcs_by_job,
+                svc,
+                key,
+                self._job_key_for(prev) if prev is not None else None,
+                deleted,
+            )
         if key:
             if ev.type is WatchEventType.ADDED:
                 self._svc_exp.creation_observed(key)

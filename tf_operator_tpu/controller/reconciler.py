@@ -123,7 +123,7 @@ class Reconciler:
             # cache can't be trusted yet; watch events will re-enqueue
             return
 
-        old_status = copy.deepcopy(job.status)
+        old_status = job.status.clone()
         if not job.status.replica_statuses:
             initialize_replica_statuses(job)
         if job.status.start_time is None:
@@ -208,43 +208,47 @@ class Reconciler:
         ns = job.metadata.namespace
         selector = {LABEL_JOB_NAME: job.metadata.name}
         out: Dict[ReplicaType, List[Pod]] = {}
-        for pod in self.cache.list_pods(ns):
-            matches = match_selector(pod.metadata.labels, selector)
+        # label-indexed read (client-go Indexer parity): O(own pods)
+        for pod in self.cache.list_pods(ns, selector):
             owner = pod.metadata.owner_uid
-            owned = bool(owner) and owner == job.metadata.uid
-            if matches:
-                if owner and not owned:
-                    continue  # another controller's pod
-                if not owner:
-                    try:
-                        self.backend.update_pod_owner(
-                            ns, pod.metadata.name, job.metadata.uid
-                        )
-                    except NotFoundError:
-                        continue  # deleted under us: watch will re-sync
-                    except NotImplementedError:
-                        pass  # backend can't patch: manage by label alone
-                    # never mutate the cached object in place — the cache
-                    # copy is shared and must only change via watch events
-                    pod = copy.deepcopy(pod)
-                    pod.metadata.owner_uid = job.metadata.uid
-                    self.recorder.event(
-                        job.key, "Normal", "AdoptedPod",
-                        f"adopted ownerless pod {pod.metadata.name}",
-                    )
-                rtype = pod.replica_type
-                if rtype is None:
-                    continue
-                out.setdefault(rtype, []).append(pod)
-            elif owned:
+            if owner and owner != job.metadata.uid:
+                continue  # another controller's pod
+            if not owner:
                 try:
-                    self.backend.update_pod_owner(ns, pod.metadata.name, None)
-                except (NotFoundError, NotImplementedError):
-                    continue
+                    self.backend.update_pod_owner(
+                        ns, pod.metadata.name, job.metadata.uid
+                    )
+                except NotFoundError:
+                    continue  # deleted under us: watch will re-sync
+                except NotImplementedError:
+                    pass  # backend can't patch: manage by label alone
+                # never mutate the cached object in place — the cache
+                # copy is shared and must only change via watch events
+                pod = pod.clone()
+                pod.metadata.owner_uid = job.metadata.uid
                 self.recorder.event(
-                    job.key, "Normal", "OrphanedPod",
-                    f"released pod {pod.metadata.name} (selector no longer matches)",
+                    job.key, "Normal", "AdoptedPod",
+                    f"adopted ownerless pod {pod.metadata.name}",
                 )
+            rtype = pod.replica_type
+            if rtype is None:
+                continue
+            out.setdefault(rtype, []).append(pod)
+        # orphan pass over the owner index: pods we own whose labels no
+        # longer select them
+        for pod in self.cache.list_pods_owned(job.metadata.uid):
+            if pod.metadata.namespace != ns or match_selector(
+                pod.metadata.labels, selector
+            ):
+                continue
+            try:
+                self.backend.update_pod_owner(ns, pod.metadata.name, None)
+            except (NotFoundError, NotImplementedError):
+                continue
+            self.recorder.event(
+                job.key, "Normal", "OrphanedPod",
+                f"released pod {pod.metadata.name} (selector no longer matches)",
+            )
         return out
 
     # ------------------------------------------------------- pod reconcile
@@ -313,7 +317,7 @@ class Reconciler:
         key = job.key
         name = replica_name(job.metadata.name, rtype, index)
         template = job.spec.replica_specs[rtype].template
-        containers = copy.deepcopy(template.containers)
+        containers = [c.clone() for c in template.containers]
         env = worker_env(
             job, rtype, index, self.config.resolver, tf_config=self.config.inject_tf_config
         )

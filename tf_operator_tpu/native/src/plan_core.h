@@ -1,0 +1,142 @@
+// Shared decision core — ONE implementation of the replica plan and the
+// success-policy truth table, consumed by two ABIs:
+//   planner.cc     — string ABI (kept for the per-call contract tests)
+//   syncdecide.cc  — packed-int32 batch ABI (one call per reconcile sync)
+//
+// Mirrors the Python twins in controller/plan.py and controller/status.py;
+// tests/test_plan.py property-tests the equivalence.
+
+#pragma once
+
+#include <map>
+#include <utility>
+#include <vector>
+
+namespace tpuop {
+
+// phase codes: 0=Pending 1=Running 2=Succeeded 3=Failed 4=Unknown
+enum Phase { kPending = 0, kRunning, kSucceeded, kFailed, kUnknown };
+// restart policies: 0=Never 1=Always 2=OnFailure 3=ExitCode
+enum Policy { kNever = 0, kAlways, kOnFailure, kExitCode };
+// replica types (api.types.REPLICA_TYPE_ORDER ids, fixed ABI)
+enum TypeId { kChief = 0, kMaster, kPS, kWorker, kEvaluator, kTPUSlice };
+// success policy: 0=Default 1=AllWorkers
+enum SuccessPolicy { kDefault = 0, kAllWorkers };
+// success reason codes (Python side maps back to strings)
+enum Reason {
+  kNotDone = 0,
+  kChiefSucceeded,
+  kMasterSucceeded,
+  kAllReplicasSucceeded,
+  kAllWorkersSucceeded,
+  kAllSliceSucceeded,
+  kSliceAndWorker0Succeeded,
+  kWorker0Succeeded,
+};
+
+// exit-code semantics parity: utils/train_util.is_retryable_exit_code
+inline bool retryable(long exit_code) { return exit_code > 127; }
+
+struct PodObs {
+  long index;
+  int phase;
+  long exit_code;  // -1 = unknown
+};
+
+struct Plan {
+  std::vector<long> create;
+  std::vector<long> scale_in;  // duplicates preserved, as observed
+  std::vector<std::pair<long, long>> restart;  // (index, exit_code)
+  std::vector<std::pair<long, long>> fatal;
+  bool backoff = false;
+};
+
+inline Plan plan_replica(long want, int policy, bool has_limit, long limit,
+                         long restarts, const std::vector<PodObs> &observed) {
+  Plan plan;
+  std::map<long, PodObs> by_index;  // first pod per index wins (slot[0])
+  for (const PodObs &obs : observed) {
+    if (obs.index >= want) {
+      plan.scale_in.push_back(obs.index);
+    } else if (!by_index.count(obs.index)) {
+      by_index[obs.index] = obs;
+    }
+  }
+  long count = restarts;
+  for (long idx = 0; idx < want; ++idx) {
+    auto it = by_index.find(idx);
+    if (it == by_index.end()) {
+      plan.create.push_back(idx);
+      continue;
+    }
+    if (it->second.phase != kFailed) continue;
+    const long exit_code = it->second.exit_code >= 0 ? it->second.exit_code : 1;
+    const bool should_restart =
+        policy == kAlways || policy == kOnFailure ||
+        (policy == kExitCode && retryable(exit_code));
+    if (!should_restart) {
+      plan.fatal.emplace_back(idx, exit_code);
+      continue;
+    }
+    // budget check precedes the increment (Python parity: exhaustion
+    // aborts the remaining indices of this sync)
+    if (has_limit && count >= limit) {
+      plan.backoff = true;
+      break;
+    }
+    ++count;
+    plan.restart.emplace_back(idx, exit_code);
+  }
+  return plan;
+}
+
+struct TypeObs {
+  long want = 0, npods = 0, nsucc = 0;
+  bool pod0succ = false;
+};
+
+// Returns a Reason code; kNotDone = job not (yet) succeeded.
+inline int eval_success(int policy, const std::map<int, TypeObs> &types) {
+  // chief-like decides alone (CHIEF_LIKE order: Chief, Master)
+  for (int chief : {kChief, kMaster}) {
+    auto it = types.find(chief);
+    if (it != types.end()) {
+      if (it->second.pod0succ)
+        return chief == kChief ? kChiefSucceeded : kMasterSucceeded;
+      return kNotDone;
+    }
+  }
+
+  const auto worker = types.find(kWorker);
+  const auto slice = types.find(kTPUSlice);
+  const bool has_worker = worker != types.end() && worker->second.want > 0;
+  const bool has_slice = slice != types.end() && slice->second.want > 0;
+
+  if (!has_worker && !has_slice) {
+    long npods = 0, nsucc = 0;
+    for (const auto &kv : types) {
+      npods += kv.second.npods;
+      nsucc += kv.second.nsucc;
+    }
+    return (npods > 0 && nsucc == npods) ? kAllReplicasSucceeded : kNotDone;
+  }
+
+  if (policy == kAllWorkers) {
+    if (has_worker && worker->second.nsucc < worker->second.want)
+      return kNotDone;
+    if (has_slice && slice->second.nsucc < slice->second.want) return kNotDone;
+    return kAllWorkersSucceeded;
+  }
+
+  if (has_slice) {
+    if (slice->second.nsucc < slice->second.want) return kNotDone;
+    if (!has_worker) return kAllSliceSucceeded;
+    return worker->second.pod0succ ? kSliceAndWorker0Succeeded : kNotDone;
+  }
+
+  if (worker != types.end() && worker->second.pod0succ)
+    return kWorker0Succeeded;
+  return kNotDone;
+}
+
+}  // namespace tpuop
