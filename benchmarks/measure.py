@@ -59,6 +59,55 @@ def bench_reconcile(n_jobs: int = 200) -> dict:
     return out
 
 
+def bench_decision_core(iters: int = 20_000) -> dict:
+    """The decision core in isolation: one batch sync_decide call
+    (success evaluation + all replica plans) — native packed-int32 ABI
+    vs the pure-Python twin.  This is the component SURVEY.md §2a calls
+    the native hot path; the end-to-end reconcile bench above is
+    executor-bound (pod/service writes, cache reads, status updates are
+    Python), so the native win shows here, diluted there."""
+
+    from tests.testutil import new_job
+    from tf_operator_tpu.api.types import PodPhase, ReplicaType
+    from tf_operator_tpu.backend.objects import Pod
+    from tf_operator_tpu.controller import plan as planmod
+
+    job = new_job("bench", chief=1, ps=2, worker=4)
+    pods_by_type = {}
+    phase_cycle = [
+        PodPhase.RUNNING,
+        PodPhase.PENDING,
+        PodPhase.FAILED,
+        PodPhase.SUCCEEDED,
+    ]
+    for rtype, n in ((ReplicaType.CHIEF, 1), (ReplicaType.PS, 2), (ReplicaType.WORKER, 4)):
+        pods = []
+        for i in range(n):
+            pod = Pod()
+            pod.metadata.name = f"bench-{rtype.lower_name}-{i}"
+            pod.metadata.labels = {"tpujob.dist/replica-index": str(i)}
+            pod.phase = phase_cycle[i % len(phase_cycle)]
+            if pod.phase is PodPhase.FAILED:
+                pod.exit_code = 137
+            pods.append(pod)
+        pods_by_type[rtype] = pods
+
+    out = {}
+    for label, use_native in (("native", True), ("python", False)):
+        if use_native and planmod._native() is None:
+            continue
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            planmod.sync_decide(job, pods_by_type, use_native=use_native)
+        dt = time.perf_counter() - t0
+        out[f"sync_decide_per_sec_{label}"] = round(iters / dt)
+    if "sync_decide_per_sec_native" in out:
+        out["sync_decide_native_speedup"] = round(
+            out["sync_decide_per_sec_native"] / out["sync_decide_per_sec_python"], 2
+        )
+    return out
+
+
 def bench_startup_latency(n_jobs: int = 8) -> dict:
     from tests.testutil import new_job
     from tf_operator_tpu.api.types import JobConditionType
@@ -176,6 +225,7 @@ def main() -> int:
     out = {}
     if args.section in ("all", "reconcile"):
         out.update(bench_reconcile())
+        out.update(bench_decision_core())
     if args.section in ("all", "startup"):
         out.update(bench_startup_latency())
     if args.section in ("all", "train"):

@@ -1,0 +1,152 @@
+"""Profile-driven ResNet-50 step-time experiments (VERDICT r2 item 1).
+
+Ad-hoc runner for the single-chip MFU push.  Measures step time / MFU
+for bench variants and can capture a perfetto trace of the hot step and
+aggregate the top device ops (tensorboard_plugin_profile is not in the
+image, so we parse the perfetto JSON ourselves).
+
+Usage (on the TPU box):
+  python benchmarks/profile_resnet.py --variant baseline --batch 256
+  python benchmarks/profile_resnet.py --variant s2d --batch 512
+  python benchmarks/profile_resnet.py --variant s2d --batch 256 --trace /tmp/rn50-trace
+
+Findings are written up in benchmarks/PROFILE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_trainer(variant: str, batch_per_chip: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.models import resnet50
+    from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+    from tf_operator_tpu.parallel.trainer import batchnorm_cross_entropy_loss
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"dp": n_dev})
+    rng = np.random.RandomState(0)
+    global_batch = batch_per_chip * n_dev
+    batch = {
+        "image": jnp.asarray(
+            rng.rand(global_batch, 224, 224, 3).astype(np.float32), dtype=jnp.bfloat16
+        ),
+        "label": jnp.asarray(rng.randint(0, 1000, size=(global_batch,))),
+    }
+    kw = {}
+    if variant == "s2d":
+        kw["stem"] = "space_to_depth"
+    model = resnet50(**kw)
+    cfg = TrainerConfig(optimizer="sgd", learning_rate=0.1, momentum=0.9)
+    if variant == "noclip":
+        cfg.grad_clip = 0.0
+    trainer = Trainer(model, cfg, mesh, batchnorm_cross_entropy_loss, batch)
+    return trainer, batch
+
+
+def step_flops(trainer, batch) -> float:
+    import flax.linen as nn
+
+    with trainer.mesh, nn.logical_axis_rules(trainer._rules):
+        compiled = trainer._step.lower(trainer.state, batch).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return float(ca.get("flops", 0.0))
+
+
+def run_variant(variant: str, batch_per_chip: int, steps: int, trace_dir: str | None):
+    import jax
+
+    trainer, batch = build_trainer(variant, batch_per_chip)
+    sharded = trainer.shard_batch(batch)
+    flops = step_flops(trainer, sharded)
+    stats = trainer.benchmark(batch, steps=steps, warmup=5)
+    peak = 197e12  # v5e bf16
+    achieved = flops * stats["steps_per_sec"]
+    out = {
+        "variant": variant,
+        "batch_per_chip": batch_per_chip,
+        "step_ms": round(stats["step_ms"], 2),
+        "examples_per_sec": round(stats["examples_per_sec"], 1),
+        "tflops": round(achieved / 1e12, 1),
+        "mfu": round(achieved / peak, 4),
+    }
+    print(json.dumps(out), flush=True)
+    if trace_dir:
+        with jax.profiler.trace(trace_dir, create_perfetto_trace=True):
+            for _ in range(3):
+                trainer.train_step(batch)
+            jax.effects_barrier()
+        summarize_trace(trace_dir)
+    return out
+
+
+def summarize_trace(trace_dir: str, top: int = 30):
+    """Aggregate device-op durations from the perfetto trace JSON."""
+
+    paths = glob.glob(os.path.join(trace_dir, "**", "perfetto_trace.json.gz"), recursive=True)
+    if not paths:
+        print("no perfetto trace found under", trace_dir)
+        return
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data["traceEvents"] if isinstance(data, dict) else data
+    # find TPU device-op track pids (names like "/device:TPU:0" or "TPU core")
+    tid_names = {}
+    pid_names = {}
+    for ev in events:
+        if ev.get("ph") == "M":
+            if ev.get("name") == "process_name":
+                pid_names[ev["pid"]] = ev["args"].get("name", "")
+            if ev.get("name") == "thread_name":
+                tid_names[(ev["pid"], ev["tid"])] = ev["args"].get("name", "")
+    dur_by_name = defaultdict(float)
+    cnt_by_name = defaultdict(int)
+    total = 0.0
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        pname = pid_names.get(ev.get("pid"), "")
+        tname = tid_names.get((ev.get("pid"), ev.get("tid")), "")
+        if "TPU" not in pname and "TPU" not in tname and "tpu" not in pname.lower():
+            continue
+        # XLA op tracks: skip steps/trace frames
+        name = ev.get("name", "?")
+        dur_by_name[name] += ev["dur"]
+        cnt_by_name[name] += 1
+        total += ev["dur"]
+    print(f"\n== trace {os.path.basename(path)}: total device-op time {total/1e3:.1f} ms ==")
+    for name, dur in sorted(dur_by_name.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"{dur/1e3:10.2f} ms  x{cnt_by_name[name]:<4d} {name[:110]}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variant", default="baseline", choices=["baseline", "s2d", "noclip"])
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--trace", default=None)
+    ap.add_argument("--summarize-only", default=None, help="just parse an existing trace dir")
+    args = ap.parse_args()
+    if args.summarize_only:
+        summarize_trace(args.summarize_only)
+        return
+    run_variant(args.variant, args.batch, args.steps, args.trace)
+
+
+if __name__ == "__main__":
+    main()

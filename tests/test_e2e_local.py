@@ -143,7 +143,17 @@ class TestLocalE2E:
         while time.time() < deadline and backend.list_pods("default"):
             time.sleep(0.1)
         assert backend.list_pods("default") == []
-        # the subprocess is really gone
+        # the subprocess is really gone.  The pod leaves list_pods before
+        # the worker thread finishes the SIGTERM->wait reap, so the pid
+        # can linger as a zombie briefly (os.kill(pid, 0) succeeds on a
+        # zombie) — poll until the reap lands.
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.05)
         with pytest.raises(ProcessLookupError):
             os.kill(pid, 0)
 

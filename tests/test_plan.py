@@ -140,3 +140,126 @@ class TestNativeEquivalence:
             p.plan_replica("want=x;policy=Never;limit=-;restarts=0;pods=")
         with pytest.raises(ValueError):
             p.eval_success("policy=Bogus;types=")
+
+    def test_sync_decide_rejects_garbage(self):
+        p = planmod._native()
+        assert p is not None
+        with pytest.raises(ValueError):
+            p.sync_decide([2, 0, 0, 0, 0, 0], 16)  # bad version
+        with pytest.raises(ValueError):
+            p.sync_decide([1, 0, 0, 0, 0, 1, 99, 1, 0, 0], 32)  # bad type id
+        with pytest.raises(ValueError):
+            p.sync_decide([1, 0, 0, 0, 0, 1], 32)  # truncated type block
+
+
+def _draw_pods_by_type(data, counts):
+    pods_by_type = {}
+    for rtype, n in counts.items():
+        if n <= 0:
+            continue
+        pods = []
+        npods = data.draw(
+            st.integers(min_value=0, max_value=n + 1), label=f"npods-{rtype.value}"
+        )
+        for i in range(npods):
+            pod = Pod()
+            pod.metadata.name = f"prop-{rtype.lower_name}-{i}"
+            # some pods unindexed, some beyond want (scale-in candidates)
+            idx = data.draw(
+                st.one_of(st.none(), st.integers(min_value=0, max_value=n + 1)),
+                label=f"idx-{rtype.value}-{i}",
+            )
+            if idx is not None:
+                pod.metadata.labels = {"tpujob.dist/replica-index": str(idx)}
+            pod.phase = data.draw(phases, label=f"phase-{rtype.value}-{i}")
+            if pod.phase is PodPhase.FAILED:
+                pod.exit_code = data.draw(
+                    st.one_of(st.none(), st.integers(min_value=0, max_value=255)),
+                    label=f"exit-{rtype.value}-{i}",
+                )
+            pods.append(pod)
+        pods_by_type[rtype] = pods
+    return pods_by_type
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native planner unavailable")
+class TestSyncDecideEquivalence:
+    """The ONE-call batch ABI (syncdecide.cc) must be indistinguishable
+    from the sequential Python twin — success verdict, every type's
+    plan, and the restart budget threaded across types in spec order."""
+
+    @settings(max_examples=300, deadline=None)
+    @given(
+        data=st.data(),
+        success=st.sampled_from(list(SuccessPolicy)),
+        policy=policies,
+        limit=st.one_of(st.none(), st.integers(min_value=0, max_value=4)),
+        restarts=st.integers(min_value=0, max_value=5),
+    )
+    def test_matches_python(self, data, success, policy, limit, restarts):
+        counts = {
+            rt: data.draw(st.integers(min_value=0, max_value=3), label=rt.value)
+            for rt in (
+                ReplicaType.CHIEF,
+                ReplicaType.PS,
+                ReplicaType.WORKER,
+                ReplicaType.EVALUATOR,
+                ReplicaType.TPU_SLICE,
+            )
+        }
+        if not any(counts.values()):
+            counts[ReplicaType.WORKER] = 1
+        job = new_job(
+            "prop",
+            chief=counts[ReplicaType.CHIEF],
+            ps=counts[ReplicaType.PS],
+            worker=counts[ReplicaType.WORKER],
+            evaluator=counts[ReplicaType.EVALUATOR],
+            tpu_slice=counts[ReplicaType.TPU_SLICE],
+        )
+        job.spec.success_policy = success
+        for spec in job.spec.replica_specs.values():
+            spec.restart_policy = policy
+        job.spec.run_policy.backoff_limit = limit
+        job.status.restart_count = restarts
+        pods_by_type = _draw_pods_by_type(data, counts)
+
+        py = planmod.sync_decide_py(job, pods_by_type)
+        nat = planmod.sync_decide(job, pods_by_type)
+        assert planmod._native() is not None
+        assert (py.succeeded, py.reason) == (nat.succeeded, nat.reason)
+        assert set(py.plans) == set(nat.plans)
+        for rtype, pplan in py.plans.items():
+            nplan = nat.plans[rtype]
+            assert sorted(set(pplan.scale_in)) == sorted(set(nplan.scale_in))
+            pplan.scale_in = nplan.scale_in = []
+            assert pplan == nplan, rtype
+
+    def test_budget_threads_across_types(self):
+        """A restart consumed by an earlier type exhausts the budget for
+        a later type — exactly like the sequential executor."""
+
+        job = new_job("thread", ps=1, worker=1)
+        for spec in job.spec.replica_specs.values():
+            spec.restart_policy = RestartPolicy.ALWAYS
+        job.spec.run_policy.backoff_limit = 1
+        pods_by_type = {}
+        for rtype, name in (
+            (ReplicaType.PS, "thread-ps-0"),
+            (ReplicaType.WORKER, "thread-worker-0"),
+        ):
+            pod = Pod()
+            pod.metadata.name = name
+            pod.metadata.labels = {"tpujob.dist/replica-index": "0"}
+            pod.phase = PodPhase.FAILED
+            pod.exit_code = 137
+            pods_by_type[rtype] = [pod]
+
+        for decide in (planmod.sync_decide_py, planmod.sync_decide):
+            d = decide(job, pods_by_type)
+            # PS reconciles first (spec order) and takes the one restart
+            assert d.plans[ReplicaType.PS].restart == [(0, 137)]
+            assert not d.plans[ReplicaType.PS].backoff_exceeded
+            # worker then finds the budget gone
+            assert d.plans[ReplicaType.WORKER].restart == []
+            assert d.plans[ReplicaType.WORKER].backoff_exceeded

@@ -43,10 +43,13 @@ class ReplicaType(str, enum.Enum):
 
     @classmethod
     def from_str(cls, s: str) -> "ReplicaType":
-        for t in cls:
-            if t.value.lower() == s.lower():
-                return t
-        raise ValueError(f"unknown replica type: {s!r}")
+        t = _REPLICA_TYPE_BY_LOWER.get(s.lower())
+        if t is None:
+            raise ValueError(f"unknown replica type: {s!r}")
+        return t
+
+
+_REPLICA_TYPE_BY_LOWER = {t.value.lower(): t for t in ReplicaType}
 
 
 #: Replica types that count as "the chief" for success-policy purposes.

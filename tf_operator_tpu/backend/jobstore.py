@@ -12,7 +12,6 @@ backend would implement the same surface over its control plane.
 
 from __future__ import annotations
 
-import copy
 import itertools
 import threading
 from typing import Dict, List, Optional
@@ -75,7 +74,7 @@ class JobStore:
             job = self._jobs.get(f"{namespace}/{name}")
             if job is None:
                 raise NotFoundError(f"{namespace}/{name}")
-            job.status = copy.deepcopy(status)  # never alias caller state
+            job.status = status.clone()  # never alias caller state
             job.metadata.resource_version += 1
             self._emit(WatchEventType.MODIFIED, job.deepcopy())
             return job.deepcopy()
@@ -89,7 +88,7 @@ class JobStore:
                 raise NotFoundError(job.key)
             set_defaults(job)
             validate(job)
-            stored.spec = job.deepcopy().spec
+            stored.spec = job.spec.clone()
             stored.metadata.resource_version += 1
             self._emit(WatchEventType.MODIFIED, stored.deepcopy())
             return stored.deepcopy()

@@ -59,6 +59,13 @@ class TPUJobController:
             self.svc_exp = Expectations()
         self.recorder = EventRecorder()
         self.metrics = metrics or default_metrics
+        if config is None:
+            config = ReconcilerConfig(use_native_decisions=self.native)
+        elif config.use_native_decisions is None:
+            # never mutate the caller's config object — it may be shared
+            import dataclasses
+
+            config = dataclasses.replace(config, use_native_decisions=self.native)
         self.cache = InformerCache(self.queue.add, self.pod_exp, self.svc_exp)
         self.reconciler = Reconciler(
             job_store,

@@ -140,6 +140,144 @@ def _parse_plan(out: str) -> ReplicaPlan:
     return plan
 
 
+# ------------------------------------------------------------- batch sync
+
+_TYPE_ID = {
+    ReplicaType.CHIEF: 0,
+    ReplicaType.MASTER: 1,
+    ReplicaType.PS: 2,
+    ReplicaType.WORKER: 3,
+    ReplicaType.EVALUATOR: 4,
+    ReplicaType.TPU_SLICE: 5,
+}
+_TYPE_FROM_ID = {v: k for k, v in _TYPE_ID.items()}
+_PHASE_ID = {
+    PodPhase.PENDING: 0,
+    PodPhase.RUNNING: 1,
+    PodPhase.SUCCEEDED: 2,
+    PodPhase.FAILED: 3,
+    PodPhase.UNKNOWN: 4,
+}
+_POLICY_ID = {
+    RestartPolicy.NEVER: 0,
+    RestartPolicy.ALWAYS: 1,
+    RestartPolicy.ON_FAILURE: 2,
+    RestartPolicy.EXIT_CODE: 3,
+}
+#: Reason-code → string table (tpuop::Reason in plan_core.h)
+_REASON_TEXT = (
+    "",
+    "Chief replica succeeded",
+    "Master replica succeeded",
+    "all replicas succeeded",
+    "all workers succeeded",
+    "all slice members succeeded",
+    "all slice members and worker 0 succeeded",
+    "worker 0 succeeded",
+)
+
+
+@dataclass
+class SyncDecision:
+    """Everything one reconcile sync decides, computed in one shot."""
+
+    succeeded: bool
+    reason: str
+    plans: Dict[ReplicaType, ReplicaPlan]
+
+
+def sync_decide_py(job: TPUJob, pods_by_type: Dict[ReplicaType, "list"]) -> SyncDecision:
+    """Pure-Python twin of tpuop_sync_decide: success evaluation plus
+    per-type plans with the job-global restart budget threaded across
+    types in spec order (matching the executor's sequential behavior)."""
+
+    succeeded, reason = evaluate_success_py(job, pods_by_type)
+    limit = job.spec.run_policy.backoff_limit
+    count = job.status.restart_count
+    plans: Dict[ReplicaType, ReplicaPlan] = {}
+    for rtype in job.spec.ordered_types():
+        spec = job.spec.replica_specs[rtype]
+        pods = pods_by_type.get(rtype, [])
+        observed = [
+            (p.replica_index, p.phase, p.exit_code)
+            for p in pods
+            if p.replica_index is not None
+        ]
+        policy = spec.restart_policy or RestartPolicy.NEVER
+        plan = plan_replica_py(job.spec.pod_count(rtype), policy, limit, count, observed)
+        count += len(plan.restart)
+        plans[rtype] = plan
+    return SyncDecision(succeeded, reason, plans)
+
+
+def sync_decide(
+    job: TPUJob,
+    pods_by_type: Dict[ReplicaType, "list"],
+    use_native: Optional[bool] = None,
+) -> SyncDecision:
+    """ONE native call per sync (packed int32, syncdecide.cc) when the
+    native runtime is available; Python twin otherwise.  ``use_native``
+    forces one implementation (False = Python twin even when the native
+    library loads — the controller's use_native flag threads through
+    here so a python-runtime controller is python end to end)."""
+
+    native = _native() if use_native in (None, True) else None
+    if native is None:
+        if use_native is True:
+            raise RuntimeError(
+                "use_native=True but the native planner is unavailable"
+            )
+        return sync_decide_py(job, pods_by_type)
+
+    limit = job.spec.run_policy.backoff_limit
+    ordered = job.spec.ordered_types()
+    arr = [
+        1,
+        1 if job.spec.success_policy is SuccessPolicy.ALL_WORKERS else 0,
+        job.status.restart_count,
+        0 if limit is None else 1,
+        0 if limit is None else limit,
+        len(ordered),
+    ]
+    out_cap = 3
+    for rtype in ordered:
+        spec = job.spec.replica_specs[rtype]
+        pods = pods_by_type.get(rtype, [])
+        want = job.spec.pod_count(rtype)
+        policy = spec.restart_policy or RestartPolicy.NEVER
+        arr += (_TYPE_ID[rtype], want, _POLICY_ID[policy], len(pods))
+        for p in pods:
+            idx = p.replica_index
+            code = p.exit_code
+            arr += (
+                -1 if idx is None else idx,
+                _PHASE_ID[p.phase],
+                -1 if code is None else code,
+            )
+        out_cap += 6 + 3 * want + 3 * len(pods)
+    out = native.sync_decide(arr, out_cap)
+
+    succeeded = bool(out[0])
+    reason = _REASON_TEXT[out[1]]
+    plans: Dict[ReplicaType, ReplicaPlan] = {}
+    pos = 3
+    for _ in range(out[2]):
+        tid, backoff, nc, ns, nr, nf = out[pos : pos + 6]
+        pos += 6
+        plan = ReplicaPlan()
+        plan.create = list(out[pos : pos + nc])
+        pos += nc
+        plan.scale_in = list(out[pos : pos + ns])
+        pos += ns
+        plan.restart = [(out[pos + 2 * i], out[pos + 2 * i + 1]) for i in range(nr)]
+        pos += 2 * nr
+        plan.fatal = [(out[pos + 2 * i], out[pos + 2 * i + 1]) for i in range(nf)]
+        pos += 2 * nf
+        plan.backoff_exceeded = bool(backoff)
+        plans[_TYPE_FROM_ID[tid]] = plan
+    return SyncDecision(succeeded, reason, plans)
+
+
 # ---------------------------------------------------------------- success
 
 
@@ -201,6 +339,13 @@ class _NativePlanner:
             ctypes.c_char_p,
             ctypes.c_int,
         ]
+        lib.tpuop_sync_decide.restype = ctypes.c_int
+        lib.tpuop_sync_decide.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int,
+        ]
 
     def _call(self, fn, desc: str) -> str:
         buf = self._ctypes.create_string_buffer(max(4096, 32 * len(desc)))
@@ -214,6 +359,21 @@ class _NativePlanner:
 
     def eval_success(self, desc: str) -> str:
         return self._call(self._lib.tpuop_eval_success, desc)
+
+    def sync_decide(self, values: "list", out_cap: int):
+        import array
+
+        ct = self._ctypes
+        # array('i') ingests the list at C speed; from_buffer avoids the
+        # per-element ctypes conversion of (c_int32 * n)(*values)
+        buf = array.array("i", values)
+        in_arr = (ct.c_int32 * len(buf)).from_buffer(buf)
+        out_buf = array.array("i", bytes(4 * out_cap))
+        out_arr = (ct.c_int32 * out_cap).from_buffer(out_buf)
+        n = self._lib.tpuop_sync_decide(in_arr, len(buf), out_arr, out_cap)
+        if n < 0:
+            raise ValueError(f"native sync_decide rejected input (rc={n})")
+        return out_buf[:n]
 
 
 _planner: Optional[_NativePlanner] = None

@@ -32,7 +32,6 @@ from tf_operator_tpu.api.types import (
     JobConditionType,
     PodPhase,
     ReplicaType,
-    RestartPolicy,
     CleanPodPolicy,
     TPUJob,
     replica_labels,
@@ -51,8 +50,8 @@ from tf_operator_tpu.bootstrap.cluster_spec import AddressResolver, dns_resolver
 from tf_operator_tpu.bootstrap.tpu_env import worker_env
 from tf_operator_tpu.controller.expectations import Expectations
 from tf_operator_tpu.controller.informer import InformerCache
+from tf_operator_tpu.controller.plan import sync_decide
 from tf_operator_tpu.controller.status import (
-    evaluate_success,
     initialize_replica_statuses,
     is_running,
     set_condition,
@@ -72,6 +71,10 @@ class ReconcilerConfig:
     #: scheduler name stamped on gang pods (reference: volcano)
     gang_scheduler_name: str = "tpu-gang"
     resolver: AddressResolver = field(default=dns_resolver)
+    #: decision core dispatch: None = native when available; False =
+    #: Python twin (set by python-runtime controllers so use_native
+    #: selects one stack end to end)
+    use_native_decisions: Optional[bool] = None
 
 
 class Reconciler:
@@ -142,8 +145,12 @@ class Reconciler:
             return
         self._schedule_deadline_wakeup(job)
 
-        # ---- terminal evaluation from observed pods
-        succeeded, reason = evaluate_success(job, pods_by_type)
+        # ---- ONE batch decision call: success evaluation + every
+        # replica type's plan (native syncdecide.cc when available)
+        decision = sync_decide(
+            job, pods_by_type, use_native=self.config.use_native_decisions
+        )
+        succeeded, reason = decision.succeeded, decision.reason
         if succeeded:
             update_replica_statuses(job, pods_by_type)
             job.status.completion_time = time.time()
@@ -165,7 +172,7 @@ class Reconciler:
         for rtype in job.spec.ordered_types():
             spec = job.spec.replica_specs[rtype]
             pods = pods_by_type.get(rtype, [])
-            outcome = self._reconcile_pods(job, rtype, spec, pods, gang)
+            outcome = self._reconcile_pods(job, rtype, pods, gang, decision.plans[rtype])
             self._reconcile_services(job, rtype, spec)
             if outcome == "fatal" and failed_fatal is None:
                 failed_fatal = f"{rtype.value} replica failed permanently"
@@ -257,32 +264,24 @@ class Reconciler:
         self,
         job: TPUJob,
         rtype: ReplicaType,
-        spec,
         pods: List[Pod],
         gang: bool,
+        plan,
     ) -> str:
         """Returns "ok" | "restarting" | "fatal".
 
-        Decisions come from the decision core (controller/plan.py —
-        native C++ when available, Python twin otherwise); this method
-        executes them against the backend and records events/metrics.
+        ``plan`` is this type's slice of the sync's one batch decision
+        (controller/plan.sync_decide — native C++ when available); this
+        method executes it against the backend and records events/metrics.
         """
 
-        from tf_operator_tpu.controller.plan import plan_replica
-
         key = job.key
-        want = job.spec.pod_count(rtype)  # multi-host slices expand
         by_index: Dict[int, List[Pod]] = {}
-        observed = []
         for p in pods:
             idx = p.replica_index
             if idx is not None:
                 by_index.setdefault(idx, []).append(p)
-                observed.append((idx, p.phase, p.exit_code))
-
-        policy = spec.restart_policy or RestartPolicy.NEVER
         limit = job.spec.run_policy.backoff_limit
-        plan = plan_replica(want, policy, limit, job.status.restart_count, observed)
 
         # scale-in (dynamic workers): drop indices beyond the want count
         for idx in sorted(set(plan.scale_in)):

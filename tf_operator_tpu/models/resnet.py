@@ -15,9 +15,64 @@ from functools import partial
 from typing import Any, Callable, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Any
+
+
+def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
+    """NHWC space-to-depth: [N,H,W,C] -> [N,H/b,W/b,b*b*C].
+
+    Channel order is (row-in-block, col-in-block, C)-major, matching the
+    kernel transform in `_SpaceToDepthStem`.
+    """
+
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, block * block * c)
+
+
+class _SpaceToDepthStem(nn.Module):
+    """The ResNet 7x7/stride-2 stem, computed on space-to-depth input.
+
+    The canonical stem conv (7x7, stride 2, 3 input channels) is
+    MXU-hostile: 3 channels against a 128-wide systolic array, and the
+    spatial stride defeats XLA's window tiling.  The standard TPU fix
+    (used by MLPerf ResNet submissions) is to transform the input
+    [N,224,224,3] -> [N,112,112,12] and convolve with an equivalent
+    4x4/stride-1 kernel.  The parameter keeps the canonical [7,7,3,F]
+    layout so checkpoints are interchangeable with the conv7 stem; the
+    kernel transform below is exact (zero-padded tap -1), so outputs are
+    bit-comparable to the plain conv up to reduction order.
+    """
+
+    features: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (7, 7, 3, self.features),
+            jnp.float32,
+        )
+        # pad taps 7->8 so tap index t = 2p+s splits into cell offset
+        # p (0..3) and subpixel s (0..1); original tap d = t-1
+        k = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        k = k.reshape(4, 2, 4, 2, 3, self.features)
+        k = k.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 12, self.features)
+        x = space_to_depth(x, 2)
+        return jax.lax.conv_general_dilated(
+            x,
+            k.astype(self.dtype),
+            window_strides=(1, 1),
+            padding=((2, 1), (2, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=self.dtype,
+        )
 
 
 class BottleneckBlock(nn.Module):
@@ -73,6 +128,7 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     dtype: jnp.dtype = jnp.bfloat16
+    stem: str = "conv7"  # conv7 | space_to_depth
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -86,7 +142,10 @@ class ResNet(nn.Module):
             param_dtype=jnp.float32,
         )
         x = x.astype(self.dtype)
-        x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            x = _SpaceToDepthStem(self.width, dtype=self.dtype, name="conv_init")(x)
+        else:
+            x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -14,7 +14,14 @@ import threading
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "_libtpuop.so")
-_SOURCES = ("workqueue.cc", "expectations.cc", "clusterspec.cc", "planner.cc")
+_SOURCES = (
+    "workqueue.cc",
+    "expectations.cc",
+    "clusterspec.cc",
+    "planner.cc",
+    "syncdecide.cc",
+)
+_HEADERS = ("tpuop.h", "plan_core.h")
 _lock = threading.Lock()
 
 
@@ -26,8 +33,7 @@ def needs_build() -> bool:
     if not os.path.exists(_LIB_PATH):
         return True
     lib_mtime = os.path.getmtime(_LIB_PATH)
-    paths = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
-    paths.append(os.path.join(_SRC_DIR, "tpuop.h"))
+    paths = [os.path.join(_SRC_DIR, s) for s in _SOURCES + _HEADERS]
     return any(os.path.getmtime(p) > lib_mtime for p in paths)
 
 

@@ -28,6 +28,8 @@
 #include <string>
 #include <vector>
 
+#include "plan_core.h"
+
 namespace {
 
 std::vector<std::string> split(const std::string &s, char sep) {
@@ -71,14 +73,16 @@ int write_out(const std::string &s, char *buf, int cap) {
   return n;
 }
 
-// exit-code semantics parity: utils/train_util.is_retryable_exit_code
-bool retryable(long exit_code) { return exit_code > 127; }
-
-struct PodObs {
-  long index;
-  char phase;  // P R S F U
-  long exit_code;  // -1 = unknown
-};
+int phase_code(char c) {
+  switch (c) {
+    case 'P': return tpuop::kPending;
+    case 'R': return tpuop::kRunning;
+    case 'S': return tpuop::kSucceeded;
+    case 'F': return tpuop::kFailed;
+    case 'U': return tpuop::kUnknown;
+    default: return -1;
+  }
+}
 
 }  // namespace
 
@@ -94,72 +98,57 @@ int tpuop_plan_replica(const char *desc, char *buf, int cap) {
   const std::string limit_s = f.count("limit") ? f["limit"] : "-";
   const bool has_limit = limit_s != "-";
   if (has_limit && (!to_int(limit_s, &limit) || limit < 0)) return -1;
-  const std::string policy = f.count("policy") ? f["policy"] : "Never";
-  if (policy != "Never" && policy != "Always" && policy != "OnFailure" &&
-      policy != "ExitCode")
-    return -1;
+  const std::string policy_s = f.count("policy") ? f["policy"] : "Never";
+  int policy;
+  if (policy_s == "Never") policy = tpuop::kNever;
+  else if (policy_s == "Always") policy = tpuop::kAlways;
+  else if (policy_s == "OnFailure") policy = tpuop::kOnFailure;
+  else if (policy_s == "ExitCode") policy = tpuop::kExitCode;
+  else return -1;
 
-  // bucket: first pod per index wins (Python slot[0] semantics)
-  std::map<long, PodObs> by_index;
-  std::vector<long> scale_in;  // every observed index >= want, in order
+  std::vector<tpuop::PodObs> observed;
   if (!f["pods"].empty()) {
     for (const std::string &p : split(f["pods"], ',')) {
       if (p.empty()) continue;
       std::vector<std::string> parts = split(p, ':');
       if (parts.size() != 3) return -1;
-      PodObs obs;
+      tpuop::PodObs obs;
       if (!to_int(parts[0], &obs.index) || obs.index < 0) return -1;
-      if (parts[1].size() != 1 || !strchr("PRSFU", parts[1][0])) return -1;
-      obs.phase = parts[1][0];
+      if (parts[1].size() != 1) return -1;
+      obs.phase = phase_code(parts[1][0]);
+      if (obs.phase < 0) return -1;
       obs.exit_code = -1;
       if (parts[2] != "-" && !to_int(parts[2], &obs.exit_code)) return -1;
-      if (obs.index >= want) {
-        scale_in.push_back(obs.index);
-      } else if (!by_index.count(obs.index)) {
-        by_index[obs.index] = obs;
-      }
+      observed.push_back(obs);
     }
   }
 
-  std::string create, restart, fatal;
-  bool backoff = false;
-  long count = restarts;
-  for (long idx = 0; idx < want; ++idx) {
-    auto it = by_index.find(idx);
-    if (it == by_index.end()) {
-      if (!create.empty()) create += ",";
-      create += std::to_string(idx);
-      continue;
-    }
-    if (it->second.phase != 'F') continue;
-    const long exit_code = it->second.exit_code >= 0 ? it->second.exit_code : 1;
-    const bool should_restart =
-        policy == "Always" || policy == "OnFailure" ||
-        (policy == "ExitCode" && retryable(exit_code));
-    if (!should_restart) {
-      if (!fatal.empty()) fatal += ",";
-      fatal += std::to_string(idx) + ":" + std::to_string(exit_code);
-      continue;
-    }
-    // restart budget check precedes the increment (Python parity:
-    // backoff exhaustion aborts the sync's remaining indices)
-    if (has_limit && count >= limit) {
-      backoff = true;
-      break;
-    }
-    ++count;
-    if (!restart.empty()) restart += ",";
-    restart += std::to_string(idx) + ":" + std::to_string(exit_code);
-  }
+  // decision logic lives in plan_core.h (shared with syncdecide.cc)
+  tpuop::Plan plan =
+      tpuop::plan_replica(want, policy, has_limit, limit, restarts, observed);
 
-  std::string si;
-  for (size_t i = 0; i < scale_in.size(); ++i) {
+  std::string create, si, restart, fatal;
+  for (size_t i = 0; i < plan.create.size(); ++i) {
+    if (i) create += ",";
+    create += std::to_string(plan.create[i]);
+  }
+  for (size_t i = 0; i < plan.scale_in.size(); ++i) {
     if (i) si += ",";
-    si += std::to_string(scale_in[i]);
+    si += std::to_string(plan.scale_in[i]);
+  }
+  for (size_t i = 0; i < plan.restart.size(); ++i) {
+    if (i) restart += ",";
+    restart += std::to_string(plan.restart[i].first) + ":" +
+               std::to_string(plan.restart[i].second);
+  }
+  for (size_t i = 0; i < plan.fatal.size(); ++i) {
+    if (i) fatal += ",";
+    fatal += std::to_string(plan.fatal[i].first) + ":" +
+             std::to_string(plan.fatal[i].second);
   }
   std::string out = "create=" + create + ";scalein=" + si + ";restart=" +
                     restart + ";fatal=" + fatal +
-                    ";backoff=" + (backoff ? "1" : "0");
+                    ";backoff=" + (plan.backoff ? "1" : "0");
   return write_out(out, buf, cap);
 }
 
@@ -167,78 +156,58 @@ int tpuop_eval_success(const char *desc, char *buf, int cap) {
   if (!desc) return -1;
   std::map<std::string, std::string> f;
   if (!parse_fields(desc, &f)) return -1;
-  const std::string policy = f.count("policy") ? f["policy"] : "Default";
-  if (policy != "Default" && policy != "AllWorkers") return -1;
+  const std::string policy_s = f.count("policy") ? f["policy"] : "Default";
+  int policy;
+  if (policy_s == "Default") policy = tpuop::kDefault;
+  else if (policy_s == "AllWorkers") policy = tpuop::kAllWorkers;
+  else return -1;
 
-  struct TypeObs {
-    long want = 0, npods = 0, nsucc = 0;
-    bool pod0succ = false;
-    bool present = false;
+  // map type names onto plan_core ids; unknown names get fresh negative
+  // ids so they still participate in the all-replicas-succeeded sums
+  // without colliding with a known role
+  auto type_id = [](const std::string &name) {
+    if (name == "Chief") return static_cast<int>(tpuop::kChief);
+    if (name == "Master") return static_cast<int>(tpuop::kMaster);
+    if (name == "PS") return static_cast<int>(tpuop::kPS);
+    if (name == "Worker") return static_cast<int>(tpuop::kWorker);
+    if (name == "Evaluator") return static_cast<int>(tpuop::kEvaluator);
+    if (name == "TPUSlice") return static_cast<int>(tpuop::kTPUSlice);
+    return -1;
   };
-  std::map<std::string, TypeObs> types;
+
+  std::map<int, tpuop::TypeObs> types;
+  int next_unknown = -1;
   if (!f["types"].empty()) {
     for (const std::string &t : split(f["types"], ',')) {
       if (t.empty()) continue;
       std::vector<std::string> parts = split(t, ':');
       if (parts.size() != 5) return -1;
-      TypeObs obs;
+      tpuop::TypeObs obs;
       long p0;
       if (!to_int(parts[1], &obs.want) || !to_int(parts[2], &obs.npods) ||
           !to_int(parts[3], &obs.nsucc) || !to_int(parts[4], &p0))
         return -1;
       obs.pod0succ = p0 != 0;
-      obs.present = true;
-      types[parts[0]] = obs;
+      int id = type_id(parts[0]);
+      if (id < 0) id = --next_unknown;
+      types[id] = obs;
     }
   }
 
-  auto fail = [&]() { return write_out("0:", buf, cap); };
-  auto ok = [&](const std::string &reason) {
-    return write_out("1:" + reason, buf, cap);
+  // truth table lives in plan_core.h (shared with syncdecide.cc)
+  const int reason = tpuop::eval_success(policy, types);
+  static const char *kReasonText[] = {
+      "",                                        // kNotDone
+      "Chief replica succeeded",                 // kChiefSucceeded
+      "Master replica succeeded",                // kMasterSucceeded
+      "all replicas succeeded",                  // kAllReplicasSucceeded
+      "all workers succeeded",                   // kAllWorkersSucceeded
+      "all slice members succeeded",             // kAllSliceSucceeded
+      "all slice members and worker 0 succeeded",// kSliceAndWorker0Succeeded
+      "worker 0 succeeded",                      // kWorker0Succeeded
   };
-
-  // chief-like decides alone (CHIEF_LIKE order: Chief, Master)
-  for (const char *name : {"Chief", "Master"}) {
-    if (types.count(name)) {
-      if (types[name].pod0succ)
-        return ok(std::string(name) + " replica succeeded");
-      return fail();
-    }
-  }
-
-  // worker-like = Worker, TPUSlice with want > 0 (status._worker_like)
-  const bool has_worker = types.count("Worker") && types["Worker"].want > 0;
-  const bool has_slice = types.count("TPUSlice") && types["TPUSlice"].want > 0;
-
-  if (!has_worker && !has_slice) {
-    long npods = 0, nsucc = 0;
-    for (const auto &kv : types) {
-      npods += kv.second.npods;
-      nsucc += kv.second.nsucc;
-    }
-    if (npods > 0 && nsucc == npods) return ok("all replicas succeeded");
-    return fail();
-  }
-
-  if (policy == "AllWorkers") {
-    if (has_worker && types["Worker"].nsucc < types["Worker"].want)
-      return fail();
-    if (has_slice && types["TPUSlice"].nsucc < types["TPUSlice"].want)
-      return fail();
-    return ok("all workers succeeded");
-  }
-
-  if (has_slice) {
-    if (types["TPUSlice"].nsucc < types["TPUSlice"].want) return fail();
-    if (!has_worker) return ok("all slice members succeeded");
-    if (types["Worker"].pod0succ)
-      return ok("all slice members and worker 0 succeeded");
-    return fail();
-  }
-
-  if (types.count("Worker") && types["Worker"].pod0succ)
-    return ok("worker 0 succeeded");
-  return fail();
+  if (reason == tpuop::kNotDone) return write_out("0:", buf, cap);
+  return write_out(std::string("1:") + kReasonText[reason], buf, cap);
 }
 
 }  // extern "C"

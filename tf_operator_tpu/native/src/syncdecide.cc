@@ -1,0 +1,147 @@
+// One-call batch decision ABI — the per-sync native hot path promised
+// by plan_core.h.  A reconcile sync makes exactly ONE call here: the
+// success-policy evaluation plus the replica plans for every replica
+// type, with the job-global restart budget threaded across types in
+// spec order (matching the Python executor's sequential semantics).
+//
+// Packed-int32 protocol (no strings, no parsing on the hot path):
+//
+// Input:
+//   [0] version           must be 1
+//   [1] success_policy    0=Default 1=AllWorkers
+//   [2] restart_count     restarts already consumed (job-global)
+//   [3] has_limit         0/1
+//   [4] limit             backoff limit (ignored when has_limit=0)
+//   [5] n_types
+//   then per type, in job.spec.ordered_types() order:
+//     [type_id, want, policy, n_pods]
+//     then per pod: [index (-1 = unindexed), phase, exit_code (-1 = unknown)]
+//
+// Output (returns int32s written; -1 malformed input; -2 cap too small):
+//   [0] succeeded 0/1
+//   [1] reason    tpuop::Reason code (Python maps back to strings)
+//   [2] n_types
+//   then per type:
+//     [type_id, backoff 0/1, n_create, n_scale_in, n_restart, n_fatal]
+//     create idx..., scale_in idx..., (restart idx,exit)..., (fatal idx,exit)...
+//
+// Unindexed pods (index -1) are excluded from planning but count toward
+// the success evaluation's npods/nsucc, mirroring controller/plan.py.
+
+#include <cstdint>
+#include <map>
+#include <vector>
+
+#include "plan_core.h"
+#include "tpuop.h"
+
+namespace {
+
+struct Writer {
+  int32_t *out;
+  int cap;
+  int n = 0;
+  bool overflow = false;
+
+  void put(int32_t v) {
+    if (n >= cap) {
+      overflow = true;
+      return;
+    }
+    out[n++] = v;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+int tpuop_sync_decide(const int32_t *in, int in_len, int32_t *out, int cap) {
+  if (!in || !out || in_len < 6) return -1;
+  if (in[0] != 1) return -1;
+  const int success_policy = in[1];
+  if (success_policy != tpuop::kDefault && success_policy != tpuop::kAllWorkers)
+    return -1;
+  long count = in[2];
+  const bool has_limit = in[3] != 0;
+  const long limit = in[4];
+  const int n_types = in[5];
+  if (count < 0 || n_types < 0 || (has_limit && limit < 0)) return -1;
+
+  int pos = 6;
+  std::map<int, tpuop::TypeObs> type_obs;
+  std::vector<int> type_ids;
+  std::vector<tpuop::Plan> plans;
+  type_ids.reserve(n_types);
+  plans.reserve(n_types);
+
+  for (int t = 0; t < n_types; ++t) {
+    if (pos + 4 > in_len) return -1;
+    const int type_id = in[pos];
+    const long want = in[pos + 1];
+    const int policy = in[pos + 2];
+    const int n_pods = in[pos + 3];
+    pos += 4;
+    if (type_id < tpuop::kChief || type_id > tpuop::kTPUSlice) return -1;
+    if (want < 0 || n_pods < 0 || policy < tpuop::kNever ||
+        policy > tpuop::kExitCode)
+      return -1;
+    if (pos + 3 * n_pods > in_len) return -1;
+
+    std::vector<tpuop::PodObs> observed;
+    observed.reserve(n_pods);
+    tpuop::TypeObs obs;
+    obs.want = want;
+    obs.npods = n_pods;
+    bool pod0_seen = false;
+    for (int p = 0; p < n_pods; ++p) {
+      const long index = in[pos];
+      const int phase = in[pos + 1];
+      const long exit_code = in[pos + 2];
+      pos += 3;
+      if (phase < tpuop::kPending || phase > tpuop::kUnknown) return -1;
+      if (phase == tpuop::kSucceeded) ++obs.nsucc;
+      if (index == 0 && !pod0_seen) {
+        pod0_seen = true;  // first index-0 pod wins (Python _find parity)
+        obs.pod0succ = phase == tpuop::kSucceeded;
+      }
+      if (index >= 0) observed.push_back({index, phase, exit_code});
+    }
+    type_obs[type_id] = obs;
+    type_ids.push_back(type_id);
+    plans.push_back(
+        tpuop::plan_replica(want, policy, has_limit, limit, count, observed));
+    count += static_cast<long>(plans.back().restart.size());
+  }
+  if (pos != in_len) return -1;
+
+  const int reason = tpuop::eval_success(success_policy, type_obs);
+
+  Writer w{out, cap};
+  w.put(reason != tpuop::kNotDone ? 1 : 0);
+  w.put(reason);
+  w.put(n_types);
+  for (int t = 0; t < n_types; ++t) {
+    const tpuop::Plan &plan = plans[t];
+    w.put(type_ids[t]);
+    w.put(plan.backoff ? 1 : 0);
+    w.put(static_cast<int32_t>(plan.create.size()));
+    w.put(static_cast<int32_t>(plan.scale_in.size()));
+    w.put(static_cast<int32_t>(plan.restart.size()));
+    w.put(static_cast<int32_t>(plan.fatal.size()));
+    for (long idx : plan.create) w.put(static_cast<int32_t>(idx));
+    for (long idx : plan.scale_in) w.put(static_cast<int32_t>(idx));
+    for (const auto &r : plan.restart) {
+      w.put(static_cast<int32_t>(r.first));
+      w.put(static_cast<int32_t>(r.second));
+    }
+    for (const auto &f : plan.fatal) {
+      w.put(static_cast<int32_t>(f.first));
+      w.put(static_cast<int32_t>(f.second));
+    }
+  }
+  if (w.overflow) return -2;
+  return w.n;
+}
+
+}  // extern "C"

@@ -16,6 +16,8 @@
 #ifndef TPUOP_H_
 #define TPUOP_H_
 
+#include <stdint.h>
+
 #ifdef __cplusplus
 extern "C" {
 #endif
@@ -66,6 +68,14 @@ int tpuop_gen_tf_config(const char *job, const char *ns,
 
 int tpuop_plan_replica(const char *desc, char *buf, int cap);
 int tpuop_eval_success(const char *desc, char *buf, int cap);
+
+/* ---- batch sync decision (syncdecide.cc) ----
+ * ONE call per reconcile sync: success evaluation + replica plans for
+ * every replica type, packed-int32 protocol documented at the top of
+ * syncdecide.cc.  Returns int32s written, -1 on malformed input, -2 if
+ * cap is too small. */
+
+int tpuop_sync_decide(const int32_t *in, int in_len, int32_t *out, int cap);
 
 #ifdef __cplusplus
 }
