@@ -42,6 +42,13 @@ def main() -> int:
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--batch-size", type=int, default=64, help="global")
     parser.add_argument("--learning-rate", type=float, default=0.05)
+    parser.add_argument(
+        "--data-dir",
+        default="",
+        help="on-disk dataset read through the grain input pipeline "
+        "(generated once by the coordinator if missing); default: "
+        "in-memory synthetic tensors",
+    )
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=10)
     parser.add_argument(
@@ -127,17 +134,45 @@ def main() -> int:
 
     n_proc = jax.process_count()
     per_proc = max(args.batch_size // n_proc, 1)
+
+    batches = None
+    if args.data_dir:
+        # the real data path (SURVEY.md §7 step 8): on-disk dataset,
+        # grain loader with a disjoint per-process shard, host→device
+        # transfer overlapped with compute
+        from tf_operator_tpu.data import (
+            device_prefetch,
+            ensure_mnist,
+            make_loader,
+            wait_for_dataset,
+        )
+
+        if jax.process_index() == 0:
+            ensure_mnist(args.data_dir)
+        else:
+            wait_for_dataset(args.data_dir)
+        loader = make_loader(args.data_dir, per_proc, num_epochs=None)
+        batches = device_prefetch(
+            loader,
+            {"image": data_sharding, "label": label_sharding},
+            image_dtype="float32",
+        )
+
     losses = []
     with maybe_trace():
         for step in range(start_step, args.steps):
-            images, labels = synthetic_mnist(step % 7, per_proc * n_proc)
-            lo = jax.process_index() * per_proc
-            x = jax.make_array_from_process_local_data(
-                data_sharding, images[lo : lo + per_proc]
-            )
-            y = jax.make_array_from_process_local_data(
-                label_sharding, labels[lo : lo + per_proc]
-            )
+            if batches is not None:
+                b = next(batches)
+                x, y = b["image"], b["label"]
+            else:
+                images, labels = synthetic_mnist(step % 7, per_proc * n_proc)
+                lo = jax.process_index() * per_proc
+                x = jax.make_array_from_process_local_data(
+                    data_sharding, images[lo : lo + per_proc]
+                )
+                y = jax.make_array_from_process_local_data(
+                    label_sharding, labels[lo : lo + per_proc]
+                )
             params, opt_state, loss = train_step(params, opt_state, x, y)
             losses.append(float(loss))
             if ckpt and (

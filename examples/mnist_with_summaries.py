@@ -1,0 +1,80 @@
+"""mnist_with_summaries — step-series metrics a user can plot.
+
+Parity: the reference's ``examples/v1/mnist_with_summaries`` writes
+TensorBoard summaries for a TF mnist run (SURVEY.md §2 row).  The
+TPU-native analogue: the Trainer writes a JSON-lines scalar series
+(loss / accuracy / steps-per-sec) through utils/summaries.SummaryWriter,
+and the operator surfaces it — annotate the job with
+``tpujob.dist/summary-dir`` and the series shows in
+``tpujob describe`` and the dashboard's detail pane.
+
+Run standalone or under the operator:
+    python examples/mnist_with_summaries.py --summary-dir /tmp/mnist-sum
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tf_operator_tpu.runtime import initialize
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--steps", type=int, default=40)
+    parser.add_argument("--batch-size", type=int, default=64, help="global")
+    parser.add_argument("--learning-rate", type=float, default=0.05)
+    parser.add_argument("--summary-dir", required=True)
+    parser.add_argument("--summary-every", type=int, default=5)
+    args = parser.parse_args()
+
+    ctx = initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.models import MnistCNN
+    from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+    from tf_operator_tpu.parallel.trainer import cross_entropy_loss
+    from tf_operator_tpu.utils.summaries import SummaryWriter
+
+    mesh = make_mesh({"dp": len(jax.devices())})
+    n_proc = jax.process_count()
+    per_proc = max(args.batch_size // n_proc, 1)
+
+    r = np.random.RandomState(0)
+    local = {
+        "image": jnp.asarray(r.rand(per_proc, 28, 28, 1), jnp.float32),
+        "label": jnp.asarray(r.randint(0, 10, size=(per_proc,))),
+    }
+
+    writer = SummaryWriter(args.summary_dir, process_id=jax.process_index())
+    trainer = Trainer(
+        MnistCNN(),
+        TrainerConfig(
+            optimizer="sgd",
+            learning_rate=args.learning_rate,
+            summary_every=args.summary_every,
+        ),
+        mesh,
+        cross_entropy_loss,
+        local,
+        summary_writer=writer,
+    )
+    batch = trainer.shard_batch(local)
+    last = None
+    for _ in range(args.steps):
+        last = trainer.train_step(batch)
+    writer.close()
+    print(
+        f"process {jax.process_index()}/{n_proc}: final loss "
+        f"{float(last['loss']):.4f}, series in {args.summary_dir}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

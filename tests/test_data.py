@@ -1,0 +1,180 @@
+"""Input pipeline tests (VERDICT r2 item 3): on-disk datasets, grain
+loaders with disjoint per-process shards, device prefetch, and a
+learnability check proving the procedural data is real signal."""
+
+import numpy as np
+import pytest
+
+from tf_operator_tpu.data import (
+    NpySource,
+    device_prefetch,
+    ensure_imagenet_like,
+    ensure_mnist,
+    make_loader,
+)
+
+
+@pytest.fixture(scope="module")
+def mnist_dir(tmp_path_factory):
+    return ensure_mnist(str(tmp_path_factory.mktemp("data") / "mnist"), n=512)
+
+
+class TestDataset:
+    def test_generation_idempotent(self, mnist_dir):
+        src = NpySource(mnist_dir)
+        first = np.asarray(src[3]["image"])
+        # second ensure with same meta is a no-op (same bytes)
+        ensure_mnist(mnist_dir, n=512)
+        assert np.array_equal(np.asarray(NpySource(mnist_dir)[3]["image"]), first)
+
+    def test_shapes_and_dtypes(self, mnist_dir):
+        src = NpySource(mnist_dir)
+        assert len(src) == 512
+        rec = src[0]
+        assert rec["image"].shape == (28, 28, 1) and rec["image"].dtype == np.uint8
+        assert rec["label"].dtype == np.int32
+
+    def test_imagenet_like_shape(self, tmp_path):
+        d = ensure_imagenet_like(str(tmp_path / "inet"), n=4, size=64)
+        rec = NpySource(d)[0]
+        assert rec["image"].shape == (64, 64, 3)
+
+
+class TestSharding:
+    def test_process_shards_disjoint_and_covering(self, mnist_dir):
+        """The per-process shards must partition the dataset — this is
+        what makes the global batch a true sample (no duplication)."""
+
+        n_proc = 4
+        seen = {}
+        for pid in range(n_proc):
+            loader = make_loader(
+                mnist_dir, 8, process_id=pid, process_count=n_proc,
+                shuffle=False, num_epochs=1,
+            )
+            # with shuffle off the records come in index order; identify
+            # them by position via the sequential record count per shard
+            count = sum(len(b["label"]) for b in loader)
+            seen[pid] = count
+        assert all(c == 512 // n_proc for c in seen.values())
+
+        # identify actual record identity via a labels fingerprint:
+        # different shards must not all be identical streams
+        streams = []
+        for pid in range(n_proc):
+            loader = make_loader(
+                mnist_dir, 8, process_id=pid, process_count=n_proc,
+                shuffle=False, num_epochs=1,
+            )
+            streams.append(tuple(int(x) for b in loader for x in b["label"]))
+        assert len(set(streams)) == n_proc
+
+    def test_deterministic_with_seed(self, mnist_dir):
+        def stream(seed):
+            loader = make_loader(
+                mnist_dir, 8, process_id=0, process_count=2, seed=seed,
+                num_epochs=1,
+            )
+            return [tuple(int(x) for x in b["label"]) for b in loader]
+
+        assert stream(7) == stream(7)
+        assert stream(7) != stream(8)
+
+
+class TestDevicePrefetch:
+    def test_prefetch_yields_sharded_normalized(self, mnist_dir):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tf_operator_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"dp": min(2, len(jax.devices()))},
+                         devices=jax.devices()[: min(2, len(jax.devices()))])
+        sh = {
+            "image": NamedSharding(mesh, P(("dp", "fsdp"), None, None, None)),
+            "label": NamedSharding(mesh, P(("dp", "fsdp"))),
+        }
+        loader = make_loader(
+            mnist_dir, 16, process_id=0, process_count=1, num_epochs=1
+        )
+        n = 0
+        for b in device_prefetch(loader, sh, image_dtype=np.float32):
+            assert b["image"].dtype == np.float32
+            assert float(b["image"].max()) <= 1.0
+            n += 1
+        assert n == 512 // 16
+
+    def test_normalize_on_device(self, mnist_dir):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tf_operator_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        sh = {
+            "image": NamedSharding(mesh, P(("dp", "fsdp"), None, None, None)),
+            "label": NamedSharding(mesh, P(("dp", "fsdp"))),
+        }
+        loader = make_loader(
+            mnist_dir, 16, process_id=0, process_count=1, num_epochs=1
+        )
+        b = next(
+            iter(
+                device_prefetch(
+                    loader, sh, image_dtype=jnp.float32, normalize_on_device=True
+                )
+            )
+        )
+        assert b["image"].dtype == jnp.float32
+        assert float(b["image"].max()) <= 1.0
+
+
+class TestLearnability:
+    def test_mnist_accuracy_climbs(self, mnist_dir):
+        """The procedural dataset carries real class signal: a CNN
+        reaches far-above-chance accuracy within a few dozen steps of
+        the real input pipeline."""
+
+        import jax
+
+        from tf_operator_tpu.models import MnistCNN
+        from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+        from tf_operator_tpu.parallel.trainer import cross_entropy_loss
+
+        mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        loader = make_loader(
+            mnist_dir, 64, process_id=0, process_count=1, num_epochs=None
+        )
+        example = None
+        trainer = None
+        accs = []
+        for i, b in enumerate(
+            device_prefetch(loader, _shardings(mesh), image_dtype=np.float32)
+        ):
+            if trainer is None:
+                host = {
+                    "image": np.asarray(b["image"]),
+                    "label": np.asarray(b["label"]),
+                }
+                trainer = Trainer(
+                    MnistCNN(),
+                    TrainerConfig(optimizer="sgd", learning_rate=0.2),
+                    mesh,
+                    cross_entropy_loss,
+                    host,
+                )
+            m = trainer.train_step(dict(b))
+            accs.append(float(m["accuracy"]))
+            if i >= 60:
+                break
+        assert np.mean(accs[-10:]) > 0.5, accs[-10:]  # chance = 0.1
+
+
+def _shardings(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return {
+        "image": NamedSharding(mesh, P(("dp", "fsdp"), None, None, None)),
+        "label": NamedSharding(mesh, P(("dp", "fsdp"))),
+    }

@@ -1,0 +1,137 @@
+"""Pipeline parallelism on the virtual mesh: the GPipe schedule must be
+indistinguishable from running the stages sequentially — forward, grads,
+and a training loop on a pp×dp mesh (SURVEY.md §2b PP row)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tf_operator_tpu.parallel import make_mesh, pipeline_apply, stack_stage_params
+
+D = 16
+
+
+def stage_fn(p, h):
+    return h + jax.nn.relu(h @ p["w"] + p["b"])
+
+
+def make_stages(n, seed=0):
+    r = np.random.RandomState(seed)
+    return [
+        {
+            "w": jnp.asarray(r.randn(D, D) * 0.3, jnp.float32),
+            "b": jnp.asarray(r.randn(D) * 0.1, jnp.float32),
+        }
+        for _ in range(n)
+    ]
+
+
+def sequential(stages, x):
+    for p in stages:
+        x = stage_fn(p, x)
+    return x
+
+
+class TestPipelineCorrectness:
+    @pytest.mark.parametrize("microbatches", [2, 4, 8])
+    def test_forward_matches_sequential(self, microbatches):
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        stages = make_stages(4)
+        x = jnp.asarray(np.random.RandomState(1).randn(16, D), jnp.float32)
+        with mesh:
+            y = jax.jit(
+                lambda sp, xx: pipeline_apply(
+                    stage_fn, sp, xx, mesh,
+                    microbatches=microbatches, batch_axes=("dp", "fsdp"),
+                )
+            )(stack_stage_params(stages), x)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(sequential(stages, x)), rtol=1e-5, atol=1e-5
+        )
+
+    def test_grads_match_sequential(self):
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        stages = make_stages(4, seed=3)
+        x = jnp.asarray(np.random.RandomState(2).randn(8, D), jnp.float32)
+
+        def loss_pp(sp, xx):
+            y = pipeline_apply(
+                stage_fn, sp, xx, mesh, microbatches=4, batch_axes=("dp", "fsdp")
+            )
+            return (y**2).mean()
+
+        def loss_seq(ps, xx):
+            return (sequential(ps, xx) ** 2).mean()
+
+        with mesh:
+            g_pp = jax.jit(jax.grad(loss_pp))(stack_stage_params(stages), x)
+        g_seq = stack_stage_params(jax.grad(loss_seq)(stages, x))
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            ),
+            g_pp,
+            g_seq,
+        )
+
+    def test_pp_only_mesh(self):
+        """Works without a dp axis (batch replicated)."""
+
+        mesh = make_mesh({"pp": 8})
+        stages = make_stages(8, seed=5)
+        x = jnp.asarray(np.random.RandomState(4).randn(4, D), jnp.float32)
+        with mesh:
+            y = jax.jit(
+                lambda sp, xx: pipeline_apply(stage_fn, sp, xx, mesh, microbatches=2)
+            )(stack_stage_params(stages), x)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(sequential(stages, x)), rtol=1e-5, atol=1e-5
+        )
+
+    def test_batch_must_divide(self):
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        stages = make_stages(4)
+        x = jnp.zeros((10, D))
+        with pytest.raises(ValueError, match="divisible"):
+            pipeline_apply(
+                stage_fn, stack_stage_params(stages), x, mesh, microbatches=3
+            )
+
+
+class TestPipelineTraining:
+    def test_loss_decreases_on_pp_dp_mesh(self):
+        """End-to-end training step over pp×dp: pipelined forward,
+        grads through the schedule, sgd — loss goes down."""
+
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        stages = stack_stage_params(make_stages(4, seed=7))
+        head = jnp.asarray(np.random.RandomState(8).randn(D, 4) * 0.1, jnp.float32)
+        r = np.random.RandomState(9)
+        x = jnp.asarray(r.randn(32, D), jnp.float32)
+        labels = jnp.asarray(r.randint(0, 4, size=(32,)))
+        tx = optax.sgd(0.1)
+        params = {"stages": stages, "head": head}
+        opt = tx.init(params)
+
+        def loss_fn(p, xx, yy):
+            h = pipeline_apply(
+                stage_fn, p["stages"], xx, mesh,
+                microbatches=4, batch_axes=("dp", "fsdp"),
+            )
+            logits = h @ p["head"]
+            return optax.softmax_cross_entropy_with_integer_labels(logits, yy).mean()
+
+        @jax.jit
+        def step(p, o, xx, yy):
+            loss, grads = jax.value_and_grad(loss_fn)(p, xx, yy)
+            updates, o = tx.update(grads, o, p)
+            return optax.apply_updates(p, updates), o, loss
+
+        losses = []
+        with mesh:
+            for _ in range(20):
+                params, opt, loss = step(params, opt, x, labels)
+                losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, losses

@@ -260,3 +260,228 @@ class TestOperatorBinary:
             proc.terminate()
             proc.wait(timeout=10)
             holder.release()
+
+
+class TestSummariesEndpoint:
+    def test_metrics_series_served_from_annotation(self, api, tmp_path):
+        """mnist_with_summaries parity (VERDICT r2 item 5): the job's
+        step series (written as JSON-lines by the Trainer) is served at
+        /apis/.../metrics via the summary-dir annotation."""
+
+        from tf_operator_tpu.utils.summaries import (
+            ANNOTATION_SUMMARY_DIR,
+            SummaryWriter,
+        )
+
+        store, backend, c, base = api
+        sdir = str(tmp_path / "series")
+        with SummaryWriter(sdir, process_id=0) as w:
+            for step in range(1, 4):
+                w.write(step, loss=1.0 / step, accuracy=0.3 * step)
+        with SummaryWriter(sdir, process_id=1) as w:
+            w.write(2, loss=0.55)
+
+        job = new_job("summarized", worker=1)
+        job.metadata.annotations[ANNOTATION_SUMMARY_DIR] = sdir
+        store.create(job)
+        c.sync_until_quiet()
+
+        items = _get(f"{base}/apis/v1/namespaces/default/tpujobs/summarized/metrics")[
+            "items"
+        ]
+        assert [m["step"] for m in items] == [1, 2, 2, 3]
+        assert items[0]["loss"] == 1.0
+        assert any(m.get("accuracy") for m in items)
+
+    def test_metrics_empty_without_annotation(self, api):
+        store, backend, c, base = api
+        store.create(new_job("plain", worker=1))
+        c.sync_until_quiet()
+        items = _get(f"{base}/apis/v1/namespaces/default/tpujobs/plain/metrics")[
+            "items"
+        ]
+        assert items == []
+
+    def test_trainer_writes_series(self, tmp_path):
+        """The Trainer emits the series every summary_every steps."""
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tf_operator_tpu.models import MnistCNN
+        from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+        from tf_operator_tpu.parallel.trainer import cross_entropy_loss
+        from tf_operator_tpu.utils.summaries import SummaryWriter, read_series
+
+        r = np.random.RandomState(0)
+        batch = {
+            "image": jnp.asarray(r.rand(8, 28, 28, 1), jnp.float32),
+            "label": jnp.asarray(r.randint(0, 10, size=(8,))),
+        }
+        sdir = str(tmp_path / "s")
+        writer = SummaryWriter(sdir)
+        trainer = Trainer(
+            MnistCNN(),
+            TrainerConfig(optimizer="sgd", learning_rate=0.05, summary_every=2),
+            make_mesh({"dp": 1}, devices=jax.devices()[:1]),
+            cross_entropy_loss,
+            batch,
+            summary_writer=writer,
+        )
+        for _ in range(6):
+            trainer.train_step(batch)
+        writer.close()
+        series = read_series(sdir)
+        assert [m["step"] for m in series] == [2, 4, 6]
+        assert all("loss" in m and "accuracy" in m for m in series)
+        # steps_per_sec appears once a previous interval exists
+        assert "steps_per_sec" in series[-1]
+
+
+class TestDeployStory:
+    """Operator config file + deployment launcher (VERDICT r2 item 4,
+    SURVEY.md §2 "Deploy manifests" / §1 L6)."""
+
+    def _write_config(self, tmp_path, **over):
+        import yaml
+
+        cfg = {
+            "apiVersion": "tpujob.dist/v1",
+            "kind": "OperatorConfig",
+            "backend": "fake",
+            "threadiness": 2,
+            "monitoringPort": 0,
+            "jsonLog": True,
+        }
+        cfg.update(over)
+        path = tmp_path / "operator.yaml"
+        path.write_text(yaml.safe_dump(cfg))
+        return str(path)
+
+    def test_config_parsing_and_flag_precedence(self, tmp_path):
+        from tf_operator_tpu.cmd.operator import build_parser, load_operator_config
+
+        path = self._write_config(tmp_path, namespace="prod", threadiness=7)
+        cfg = load_operator_config(path)
+        assert cfg == {
+            "backend": "fake",
+            "namespace": "prod",
+            "threadiness": 7,
+            "monitoring_port": 0,
+            "json_log": True,
+        }
+        parser = build_parser()
+        parser.set_defaults(**cfg)
+        # explicit CLI flag beats the file; file beats built-in default
+        args = parser.parse_args(["--threadiness", "9"])
+        assert args.threadiness == 9
+        assert args.namespace == "prod"
+        assert args.backend == "fake"
+
+    def test_unknown_config_key_rejected(self, tmp_path):
+        import yaml
+
+        from tf_operator_tpu.cmd.operator import load_operator_config
+
+        path = tmp_path / "bad.yaml"
+        path.write_text(yaml.safe_dump({"kind": "OperatorConfig", "treadiness": 4}))
+        with pytest.raises(ValueError, match="treadiness"):
+            load_operator_config(str(path))
+
+    def test_operator_boots_from_config_file(self, tmp_path):
+        import subprocess
+
+        path = self._write_config(tmp_path)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "tf_operator_tpu.cmd.operator",
+                "--config", path,
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=os.getcwd(),
+        )
+        try:
+            line = proc.stdout.readline()
+            assert "listening on" in line
+            port = int(line.rsplit(":", 1)[1])
+            assert _get(f"http://127.0.0.1:{port}/healthz").startswith("ok")
+            # the job API works through the manifest-booted operator
+            created = _post(
+                f"http://127.0.0.1:{port}/apis/v1/namespaces/default/tpujobs",
+                job_to_dict(new_job("from-config", worker=1)),
+            )
+            assert created["metadata"]["name"] == "from-config"
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_deployment_requires_leader_elect_for_replicas(self, tmp_path):
+        import yaml
+
+        from tf_operator_tpu.cmd.deploy import load_deployment
+
+        path = tmp_path / "dep.yaml"
+        path.write_text(
+            yaml.safe_dump(
+                {"kind": "OperatorDeployment", "replicas": 2, "config": {}}
+            )
+        )
+        with pytest.raises(ValueError, match="leaderElect"):
+            load_deployment(str(path))
+
+    def test_deploy_launcher_restarts_crashed_replica(self, tmp_path):
+        """The launcher is the Deployment-controller analogue: kill the
+        single replica, it comes back."""
+
+        import subprocess
+        import time as _t
+        import yaml
+
+        path = tmp_path / "dep.yaml"
+        path.write_text(
+            yaml.safe_dump(
+                {
+                    "apiVersion": "tpujob.dist/v1",
+                    "kind": "OperatorDeployment",
+                    "replicas": 1,
+                    "config": {
+                        "backend": "fake",
+                        "monitoringPort": 18931,
+                        "leaseFile": str(tmp_path / "lease.lock"),
+                    },
+                }
+            )
+        )
+        launcher = subprocess.Popen(
+            [sys.executable, "-m", "tf_operator_tpu.cmd.deploy", str(path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=os.getcwd(),
+        )
+        try:
+            def wait_health(timeout=30):
+                deadline = _t.time() + timeout
+                while _t.time() < deadline:
+                    try:
+                        if _get("http://127.0.0.1:18931/healthz").startswith("ok"):
+                            return True
+                    except Exception:
+                        _t.sleep(0.2)
+                return False
+
+            assert wait_health(), "replica never became healthy"
+            # kill OUR child, identified from the launcher's own
+            # "replica N pid P" line (never a host-wide pgrep)
+            pid = None
+            deadline = _t.time() + 10
+            while pid is None and _t.time() < deadline:
+                line = launcher.stdout.readline()
+                if line.startswith("replica 0 pid "):
+                    pid = int(line.rsplit(" ", 1)[1])
+            assert pid is not None, "launcher never announced its child pid"
+            os.kill(pid, 9)
+            _t.sleep(0.5)
+            assert wait_health(), "replica was not restarted after crash"
+        finally:
+            launcher.terminate()
+            launcher.wait(timeout=15)

@@ -100,9 +100,16 @@ class TestFlashKernelOnChip:
             jax.block_until_ready(out)
             return (time.perf_counter() - t0) / 10
 
-    # generous margin: the win must be real, not noise
+        # real margin, not noise (VERDICT r2 item 8): the flash path
+        # must win by >=10%.  Measured ratio printed for BASELINE.md.
         t_flash, t_xla = bench(train_flash), bench(train_xla)
-        assert t_flash < t_xla, f"flash {t_flash*1e3:.1f}ms !< xla {t_xla*1e3:.1f}ms"
+        print(
+            f"\nflash fwd+bwd @4k: {t_flash*1e3:.1f}ms  xla: {t_xla*1e3:.1f}ms  "
+            f"speedup {t_xla/t_flash:.2f}x"
+        )
+        assert t_flash < 0.9 * t_xla, (
+            f"flash {t_flash*1e3:.1f}ms !< 0.9*xla {t_xla*1e3:.1f}ms"
+        )
 
 
 class TestTrainerOnChip:

@@ -67,7 +67,11 @@ def harness(
 ) -> Tuple[JobStore, FakeCluster, TPUJobController]:
     store = JobStore()
     backend = FakeCluster(delivery=delivery, total_chips=total_chips)
-    controller = TPUJobController(store, backend, config=config)
+    # fresh Metrics per harness: assertions against the process-global
+    # default_metrics would be test-order-dependent
+    from tf_operator_tpu.utils.metrics import Metrics
+
+    controller = TPUJobController(store, backend, config=config, metrics=Metrics())
     return store, backend, controller
 
 

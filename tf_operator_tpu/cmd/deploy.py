@@ -1,0 +1,139 @@
+"""Operator deployment launcher — the Deployment-controller analogue.
+
+Parity: the reference ships a Kubernetes Deployment manifest for the
+operator itself (SURVEY.md §2 "Deploy manifests", §1 L6): N replicas of
+the operator binary, leader election picking one active controller,
+restarts on crash.  Without a kube-apiserver, this launcher IS that
+deployment controller for one host: it spawns ``replicas`` operator
+processes from an ``OperatorDeployment`` manifest, restarts any that
+die (crash-loop backoff), and tears the set down on SIGTERM/SIGINT.
+
+Run:  python -m tf_operator_tpu.cmd.deploy examples/manifests/operator.yaml
+
+With replicas > 1 the manifest must enable leader election — standbys
+serve /healthz and refuse the job API with 503 + the leader's identity
+(server/api.py), exactly one process runs the controller.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+import yaml
+
+
+def load_deployment(path: str) -> dict:
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    if doc.get("kind") != "OperatorDeployment":
+        raise ValueError(f"{path}: kind must be OperatorDeployment")
+    replicas = int(doc.get("replicas", 1))
+    cfg = doc.get("config", {}) or {}
+    if replicas > 1 and not cfg.get("leaderElect"):
+        raise ValueError(
+            f"{path}: replicas={replicas} requires config.leaderElect: true "
+            "(standbys must not each run a controller)"
+        )
+    return doc
+
+
+def spawn(path: str, doc: dict, index: int, replicas: int) -> subprocess.Popen:
+    """One operator replica.  Each gets its own monitoring port
+    (base + index) so /healthz of every replica is scrapeable.  ``doc``
+    is the manifest main() already parsed — re-reading the file here
+    would let a mid-run edit crash the supervision loop on a routine
+    restart."""
+
+    cmd = [sys.executable, "-m", "tf_operator_tpu.cmd.operator", "--config", path]
+    base_port = int((doc.get("config") or {}).get("monitoringPort", 8080))
+    if replicas > 1 and base_port:
+        cmd += ["--monitoring-port", str(base_port + index)]
+    env = dict(os.environ)
+    env["TPU_OPERATOR_REPLICA"] = str(index)
+    proc = subprocess.Popen(cmd, env=env)
+    print(f"replica {index} pid {proc.pid}", flush=True)
+    return proc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-operator-deploy", description=__doc__.split("\n")[0]
+    )
+    ap.add_argument("manifest", help="OperatorDeployment yaml")
+    ap.add_argument(
+        "--max-restarts",
+        type=int,
+        default=None,
+        help="per-replica restart budget (default: unlimited)",
+    )
+    args = ap.parse_args(argv)
+
+    doc = load_deployment(args.manifest)
+    replicas = int(doc.get("replicas", 1))
+
+    procs: Dict[int, subprocess.Popen] = {}
+    restarts: Dict[int, int] = {i: 0 for i in range(replicas)}
+    backoff: Dict[int, float] = {i: 1.0 for i in range(replicas)}
+    next_start: Dict[int, float] = {i: 0.0 for i in range(replicas)}
+    stop = {"flag": False}
+
+    def handle(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, handle)
+    signal.signal(signal.SIGINT, handle)
+
+    print(f"deploying {replicas} operator replica(s) from {args.manifest}", flush=True)
+    try:
+        while not stop["flag"]:
+            for i in range(replicas):
+                proc = procs.get(i)
+                if proc is not None and proc.poll() is None:
+                    continue
+                if proc is not None:  # died
+                    rc = proc.returncode
+                    restarts[i] += 1
+                    print(
+                        f"replica {i} exited rc={rc} "
+                        f"(restart {restarts[i]})",
+                        flush=True,
+                    )
+                    if args.max_restarts is not None and restarts[i] > args.max_restarts:
+                        print(f"replica {i}: restart budget exhausted", flush=True)
+                        stop["flag"] = True
+                        break
+                    # crash-loop backoff, reset on a healthy stretch
+                    next_start[i] = time.time() + backoff[i]
+                    backoff[i] = min(backoff[i] * 2, 30.0)
+                    procs.pop(i, None)
+                    continue
+                if time.time() >= next_start[i]:
+                    procs[i] = spawn(args.manifest, doc, i, replicas)
+            # a replica that stays up 60s earns its backoff reset
+            for i, proc in procs.items():
+                if proc.poll() is None and time.time() - next_start[i] > 60:
+                    backoff[i] = 1.0
+            time.sleep(0.2)
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.time() + 10
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        print("deployment stopped", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,10 @@ shutdown.  The reference's flag set is mirrored where it still makes
 sense without a kube-apiserver.
 
 Run:  python -m tf_operator_tpu.cmd.operator --backend local --port 8080
+  or: python -m tf_operator_tpu.cmd.operator --config examples/manifests/operator.yaml
+
+Config-file layering (SURVEY.md §2 "Deploy manifests" equivalent):
+built-in defaults < --config file < explicitly passed CLI flags.
 """
 
 from __future__ import annotations
@@ -28,10 +32,87 @@ from tf_operator_tpu.server.api import ApiServer
 from tf_operator_tpu.utils import logging as oplog
 
 
+#: config-file key (camelCase, manifest style) -> argparse dest
+CONFIG_KEYS = {
+    "backend": "backend",
+    "namespace": "namespace",
+    "threadiness": "threadiness",
+    "enableGangScheduling": "enable_gang_scheduling",
+    "monitoringPort": "monitoring_port",
+    "host": "host",
+    "jsonLog": "json_log",
+    "leaderElect": "leader_elect",
+    "leaseFile": "lease_file",
+    "logDir": "log_dir",
+    "totalChips": "total_chips",
+}
+
+
+def load_operator_config(path: str) -> dict:
+    """Parse an operator config/deployment manifest into argparse dests.
+
+    Accepts ``kind: OperatorConfig`` (flat keys) or
+    ``kind: OperatorDeployment`` (keys under ``config:``; ``replicas``
+    is consumed by cmd/deploy.py, not here).  Unknown keys are an error
+    — a typoed key silently reverting to a default is how operators lose
+    leader election in production.
+    """
+
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: config must be a mapping")
+    kind = doc.get("kind", "OperatorConfig")
+    if kind == "OperatorDeployment":
+        cfg = doc.get("config", {}) or {}
+    elif kind == "OperatorConfig":
+        cfg = {k: v for k, v in doc.items() if k not in ("apiVersion", "kind")}
+    else:
+        raise ValueError(f"{path}: unknown kind {kind!r}")
+    out = {}
+    for key, value in cfg.items():
+        if key not in CONFIG_KEYS:
+            raise ValueError(
+                f"{path}: unknown config key {key!r} (valid: {sorted(CONFIG_KEYS)})"
+            )
+        if value is None:
+            continue  # null value = unset; the flag default applies
+        out[CONFIG_KEYS[key]] = value
+
+    # values must pass the same type=/choices= validation flags get —
+    # set_defaults() bypasses argparse checking, so a `backend: kube`
+    # or `threadiness: "four"` would otherwise slip through silently
+    argv = []
+    for dest, value in out.items():
+        if value is None:
+            continue
+        flag = "--" + dest.replace("_", "-")
+        if isinstance(value, bool):
+            if value:
+                argv.append(flag)
+        else:
+            argv += [flag, str(value)]
+    probe = build_parser()
+    probe.exit_on_error = False
+    try:
+        probe.parse_args(argv)
+    except (argparse.ArgumentError, SystemExit) as e:
+        raise ValueError(f"{path}: invalid config value: {e}") from None
+    return out
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpu-operator",
         description="TPU-native distributed training job operator",
+    )
+    p.add_argument(
+        "--config",
+        default=None,
+        help="operator config file (YAML/JSON; kind OperatorConfig or "
+        "OperatorDeployment).  Explicit CLI flags override file values",
     )
     p.add_argument(
         "--backend",
@@ -91,7 +172,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    # two-pass parse: --config values become parser defaults, so flags
+    # passed explicitly on the command line still win
+    pre, _ = parser.parse_known_args(argv)
+    if pre.config:
+        parser.set_defaults(**load_operator_config(pre.config))
+    args = parser.parse_args(argv)
     if args.version:
         from tf_operator_tpu import __version__
 

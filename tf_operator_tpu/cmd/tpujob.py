@@ -145,6 +145,18 @@ def cmd_describe(args) -> int:
     print("Events:")
     for e in events:
         print(f"  {e['type']:<8} {e['reason']:<24} {e['message']}")
+    series = _request(
+        "GET", _jobs_url(args.server, args.namespace, args.name, "metrics")
+    ).get("items", [])
+    if series:
+        print(f"Metrics (last 10 of {len(series)}):")
+        for m in series[-10:]:
+            rest = " ".join(
+                f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in m.items()
+                if k not in ("step", "time")
+            )
+            print(f"  step {m['step']:<8} {rest}")
     return 0
 
 

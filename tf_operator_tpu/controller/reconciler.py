@@ -75,6 +75,10 @@ class ReconcilerConfig:
     #: Python twin (set by python-runtime controllers so use_native
     #: selects one stack end to end)
     use_native_decisions: Optional[bool] = None
+    #: warn-log any sync slower than this (SURVEY.md §5 span logging);
+    #: a thrashing job (expectations churn, hot requeue) surfaces here
+    #: and in the tpujob_sync_duration_seconds histogram
+    slow_sync_warn_seconds: float = 1.0
 
 
 class Reconciler:
@@ -105,8 +109,34 @@ class Reconciler:
     # ------------------------------------------------------------------ sync
 
     def sync(self, key: str) -> None:
-        """One level-triggered reconcile of ``key`` ("<ns>/<name>")."""
+        """One level-triggered reconcile of ``key`` ("<ns>/<name>").
 
+        Span-instrumented (SURVEY.md §5): per-sync duration lands in the
+        tpujob_sync_duration_seconds histogram, outcomes in
+        tpujob_syncs_total{result=ok|error}, slow syncs warn-log.
+        """
+
+        t0 = time.perf_counter()
+        try:
+            self._sync(key)
+        except Exception:
+            self._observe_sync(key, time.perf_counter() - t0, "error")
+            raise
+        self._observe_sync(key, time.perf_counter() - t0, "ok")
+
+    def _observe_sync(self, key: str, dt: float, result: str) -> None:
+        self.metrics.observe_histogram("tpujob_sync_duration_seconds", dt)
+        self.metrics.inc("tpujob_syncs_total", result=result)
+        if dt >= self.config.slow_sync_warn_seconds:
+            ns, _, name = key.partition("/")
+            logger_for_job(ns, name).warning(
+                "slow sync: %.3fs (threshold %.3fs, result=%s)",
+                dt,
+                self.config.slow_sync_warn_seconds,
+                result,
+            )
+
+    def _sync(self, key: str) -> None:
         job = self.cache.get_job(key)
         if job is None:
             # job deleted: expectations cleanup; owner-based GC of pods
@@ -508,15 +538,30 @@ class Reconciler:
                 self.requeue_after(key, remaining)
 
     def _gc_orphans(self, key: str) -> None:
-        """Owner-GC parity: job object gone → its pods/services go too."""
+        """Owner-GC parity: job object gone → its pods/services go too.
+
+        The deleted job's uid is no longer known here, so ownership is
+        checked against the *live* jobs: a label-matching object whose
+        owner_uid belongs to a job that still exists is another
+        controller's property (the adoption pass deliberately ignored
+        it — see _claim_pods) and must survive name reuse.  Ownerless
+        or dead-owner objects are collected.
+        """
 
         ns, _, name = key.partition("/")
+        live_uids = {
+            j.metadata.uid for j in self.jobs.list(ns) if j.metadata.uid
+        }
         for pod in self.cache.list_pods(ns, {LABEL_JOB_NAME: name}):
+            if pod.metadata.owner_uid and pod.metadata.owner_uid in live_uids:
+                continue
             try:
                 self.backend.delete_pod(ns, pod.metadata.name)
             except NotFoundError:
                 pass
         for svc in self.cache.list_services(ns, {LABEL_JOB_NAME: name}):
+            if svc.metadata.owner_uid and svc.metadata.owner_uid in live_uids:
+                continue
             try:
                 self.backend.delete_service(ns, svc.metadata.name)
             except NotFoundError:

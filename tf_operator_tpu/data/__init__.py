@@ -1,0 +1,23 @@
+"""Input pipeline: on-disk datasets + grain loaders with per-process
+sharding (SURVEY.md §7 step 8 — the real data path the reference's
+examples have and synthetic tensors skip)."""
+
+from tf_operator_tpu.data.loader import (
+    NpySource,
+    device_prefetch,
+    make_loader,
+)
+from tf_operator_tpu.data.synthetic import (
+    ensure_imagenet_like,
+    ensure_mnist,
+    wait_for_dataset,
+)
+
+__all__ = [
+    "NpySource",
+    "device_prefetch",
+    "ensure_imagenet_like",
+    "ensure_mnist",
+    "make_loader",
+    "wait_for_dataset",
+]

@@ -1,0 +1,127 @@
+"""Deterministic on-disk datasets, procedurally generated once.
+
+No network on this box (SURVEY.md §7 environment facts), so the "real
+MNIST" the reference's examples download is replaced by a *learnable*
+procedural dataset written to disk once and then always read through
+the grain input pipeline — loading, sharding, host→device transfer are
+exactly the real path; only the pixels are synthetic.
+
+Learnable by construction: each class has a fixed random template and
+every example is its class template plus noise, so a model that learns
+the templates beats chance by a wide margin (tests assert accuracy
+climbs).  uint8 on disk, normalised on device — the honest layout
+(decode/augment happens host-side in the reference pipelines too).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+_META = "meta.json"
+
+
+def wait_for_dataset(directory: str, timeout: float = 120.0) -> str:
+    """Block until another process finishes generating ``directory``.
+
+    Multi-process jobs generate on the coordinator only (one writer);
+    the rest call this.
+    """
+
+    deadline = time.time() + timeout
+    path = os.path.join(directory, _META)
+    while time.time() < deadline:
+        if os.path.exists(path):
+            return directory
+        time.sleep(0.2)
+    raise TimeoutError(f"dataset never appeared at {directory}")
+
+
+def _write(directory: str, images: np.ndarray, labels: np.ndarray, meta: dict) -> None:
+    """Two-phase commit: retract meta first (readers poll it — see
+    wait_for_dataset), write data files via tmp+rename so a reader
+    never mmaps a half-written array, land meta last as the commit
+    record.  This also makes REgeneration (stale meta from different
+    parameters) safe."""
+
+    os.makedirs(directory, exist_ok=True)
+    meta_path = os.path.join(directory, _META)
+    try:
+        os.remove(meta_path)
+    except FileNotFoundError:
+        pass
+    pid = os.getpid()
+    for name, arr in (("images.npy", images), ("labels.npy", labels)):
+        # tmp must end in .npy or np.save appends the suffix itself
+        tmp = os.path.join(directory, f".{name[:-4]}.{pid}.tmp.npy")
+        np.save(tmp, arr)
+        os.replace(tmp, os.path.join(directory, name))
+    tmp = os.path.join(directory, f".{_META}.{pid}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, meta_path)
+
+
+def _exists(directory: str, meta: dict) -> bool:
+    path = os.path.join(directory, _META)
+    try:
+        with open(path) as f:
+            return json.load(f) == meta
+    except (OSError, ValueError):
+        return False
+
+
+def ensure_mnist(
+    directory: str, n: int = 16384, seed: int = 0, classes: int = 10
+) -> str:
+    """28x28x1 uint8 dataset in the MNIST shape; idempotent."""
+
+    meta = {"kind": "mnist-like", "n": n, "seed": seed, "classes": classes}
+    if _exists(directory, meta):
+        return directory
+    r = np.random.RandomState(seed)
+    templates = r.rand(classes, 28, 28, 1).astype(np.float32)
+    labels = r.randint(0, classes, size=(n,)).astype(np.int32)
+    noise = r.rand(n, 28, 28, 1).astype(np.float32)
+    images = 0.7 * templates[labels] + 0.3 * noise
+    _write(directory, (images * 255).astype(np.uint8), labels, meta)
+    return directory
+
+
+def ensure_imagenet_like(
+    directory: str,
+    n: int = 512,
+    size: int = 224,
+    classes: int = 1000,
+    seed: int = 0,
+) -> str:
+    """224x224x3 uint8 dataset in the ImageNet shape (bench input
+    pipeline); idempotent.  Templates are stored at low resolution and
+    upsampled so generation stays fast and the file is the only big
+    artifact (~n*size*size*3 bytes)."""
+
+    meta = {
+        "kind": "imagenet-like",
+        "n": n,
+        "size": size,
+        "seed": seed,
+        "classes": classes,
+    }
+    if _exists(directory, meta):
+        return directory
+    r = np.random.RandomState(seed)
+    labels = r.randint(0, classes, size=(n,)).astype(np.int32)
+    small = size // 8
+    images = np.empty((n, size, size, 3), dtype=np.uint8)
+    # per-class template at low res; repeat-upsample + noise per example
+    templates = r.rand(min(classes, 64), small, small, 3).astype(np.float32)
+    for i in range(n):
+        t = templates[labels[i] % len(templates)]
+        up = np.repeat(np.repeat(t, 8, axis=0), 8, axis=1)
+        img = 0.7 * up + 0.3 * r.rand(size, size, 3).astype(np.float32)
+        images[i] = (img * 255).astype(np.uint8)
+    _write(directory, images, labels, meta)
+    return directory

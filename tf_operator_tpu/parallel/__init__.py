@@ -13,10 +13,12 @@ Axes convention (scaling-book style):
   tp    — tensor parallelism (megatron-style sharded matmuls)
   sp    — sequence/context parallelism (ring attention)
   ep    — expert parallelism (MoE)
+  pp    — pipeline parallelism (GPipe schedule over shard_map stages)
 """
 
 from tf_operator_tpu.parallel.mesh import (
     AXIS_DP,
+    AXIS_PP,
     AXIS_EP,
     AXIS_FSDP,
     AXIS_SP,
@@ -27,6 +29,11 @@ from tf_operator_tpu.parallel.mesh import (
     make_mesh,
     replicated,
 )
+from tf_operator_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipelined,
+    stack_stage_params,
+)
 from tf_operator_tpu.parallel.sharding import (
     LOGICAL_RULES,
     fsdp_shardings,
@@ -36,6 +43,7 @@ from tf_operator_tpu.parallel.trainer import Trainer, TrainerConfig
 
 __all__ = [
     "AXIS_DP",
+    "AXIS_PP",
     "AXIS_EP",
     "AXIS_FSDP",
     "AXIS_SP",
@@ -50,4 +58,7 @@ __all__ = [
     "logical_shardings",
     "Trainer",
     "TrainerConfig",
+    "pipeline_apply",
+    "pipelined",
+    "stack_stage_params",
 ]

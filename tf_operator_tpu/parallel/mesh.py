@@ -17,6 +17,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+AXIS_PP = "pp"
 AXIS_DP = "dp"
 AXIS_FSDP = "fsdp"
 AXIS_TP = "tp"
@@ -26,7 +27,10 @@ AXIS_EP = "ep"
 #: Canonical axis order.  Data-parallel-ish axes go first so that
 #: neighbouring devices (fastest-varying, best ICI locality) end up on
 #: the model axes (tp/sp) where collectives are in the critical path.
-AXIS_ORDER = (AXIS_DP, AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_TP)
+#: pp is outermost: stage boundaries move one small activation per tick
+#: (point-to-point ppermute), the only traffic cheap enough for the
+#: slowest links (DCN between hosts).
+AXIS_ORDER = (AXIS_PP, AXIS_DP, AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_TP)
 
 #: The global batch is sharded over every data-ish axis.
 BATCH_AXES = (AXIS_DP, AXIS_FSDP)

@@ -1,0 +1,136 @@
+"""Pipeline parallelism: GPipe schedule over the ``pp`` mesh axis.
+
+Parity: SURVEY.md §2b marks PP absent from the reference ("optional;
+shard_map stages or GSPMD pipelining") — this closes the row the
+TPU-native way: stage parameters live sharded over the ``pp`` axis, and
+one jitted computation runs the classic GPipe schedule — S stages × M
+microbatches over S+M-1 ticks — entirely inside ``shard_map``:
+
+- every device applies ITS stage block to the microbatch it currently
+  holds (all devices busy once the pipeline fills);
+- activations move stage→stage with a single ``lax.ppermute`` per tick
+  (point-to-point neighbour traffic: the only collective in the hot
+  loop, so the pp axis can ride the slowest links);
+- ``lax.scan`` drives the ticks — compiler-friendly control flow, one
+  trace, no Python-level loop in the compiled artifact;
+- autodiff straight through (ppermute and scan are differentiable), so
+  ``jax.grad`` of a pipelined loss yields the standard GPipe backward
+  schedule without hand-written reverse plumbing.
+
+Composes with dp/fsdp on the batch dimension (the microbatch dimension
+is per-shard) and with tp inside a stage.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tf_operator_tpu.parallel.mesh import AXIS_PP
+
+#: stage_fn(stage_params, x) -> y; same pytree structure for x and y
+StageFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def stack_stage_params(per_stage_params) -> Any:
+    """[params_stage0, params_stage1, ...] -> one pytree with a leading
+    stage dimension on every leaf (the pp-sharded layout)."""
+
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def stage_sharding_spec() -> P:
+    """PartitionSpec for stacked stage params: leading dim over pp."""
+
+    return P(AXIS_PP)
+
+
+def pipeline_apply(
+    stage_fn: StageFn,
+    stacked_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    microbatches: int,
+    axis: str = AXIS_PP,
+    batch_axes=None,
+) -> jax.Array:
+    """Run ``x`` through S pipelined stages; returns the final output.
+
+    ``stacked_params``: every leaf has leading dim S (use
+    ``stack_stage_params``), laid out ``P(axis)``; ``x``: [batch, ...],
+    split into ``microbatches`` equal microbatches along dim 0.
+    ``stage_fn`` must be shape-preserving (the activation that moves
+    between stages).  ``batch_axes`` names the mesh axes the batch dim
+    is sharded over (e.g. ``("dp", "fsdp")``) so pp composes with data
+    parallelism — each dp shard runs its own microbatch stream.
+    """
+
+    n_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    if batch % microbatches:
+        raise ValueError(f"batch {batch} not divisible by microbatches {microbatches}")
+    mb = batch // microbatches
+
+    # [M, mb, ...] microbatch stream
+    xs = x.reshape(microbatches, mb, *x.shape[1:])
+
+    def per_device(params_local, xs_local):
+        # shard_map hands each device its own stage block with the
+        # (now size-1) stage dim still attached
+        params_me = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        stage = jax.lax.axis_index(axis)
+        n_ticks = microbatches + n_stages - 1
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(carry, t):
+            held = carry  # [mb, ...] activation this device holds
+            # stage 0 ingests microbatch t (clamped: beyond M it feeds
+            # garbage that never reaches a valid output slot)
+            feed = xs_local[jnp.minimum(t, microbatches - 1)]
+            inp = jnp.where(stage == 0, feed, held)
+            out = stage_fn(params_me, inp)
+            nxt = jax.lax.ppermute(out, axis, perm)
+            return nxt, out
+
+        _, outs = jax.lax.scan(tick, jnp.zeros_like(xs_local[0]), jnp.arange(n_ticks))
+        # microbatch m leaves the last stage at tick m + S - 1
+        ys = outs[n_stages - 1 :]
+        # only the last stage holds real outputs: zero everyone else
+        # and share via psum (activations are small relative to FLOPs)
+        ys = jnp.where(stage == n_stages - 1, ys, jnp.zeros_like(ys))
+        return jax.lax.psum(ys, axis)
+
+    stream_spec = P(None, batch_axes)  # [M, mb, ...]; mb over dp/fsdp
+    out = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(stage_sharding_spec(), stream_spec),
+        out_specs=stream_spec,
+        check_rep=False,
+    )(stacked_params, xs)
+    return out.reshape(batch, *out.shape[2:])
+
+
+def pipelined(
+    stage_fn: StageFn,
+    mesh: Mesh,
+    microbatches: int,
+    axis: str = AXIS_PP,
+    batch_axes=None,
+) -> Callable[[Any, jax.Array], jax.Array]:
+    """Partial-application convenience: a (params, x) -> y callable."""
+
+    return partial(
+        pipeline_apply,
+        stage_fn,
+        mesh=mesh,
+        microbatches=microbatches,
+        axis=axis,
+        batch_axes=batch_axes,
+    )

@@ -53,6 +53,9 @@ class TrainerConfig:
     optimizer: str = "adamw"  # adamw | sgd
     momentum: float = 0.9
     remat: bool = False  # wrap loss in jax.checkpoint
+    #: write step-series metrics every N steps when a SummaryWriter is
+    #: attached (utils/summaries.py; mnist_with_summaries parity)
+    summary_every: int = 10
 
 
 def make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
@@ -71,7 +74,9 @@ def make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
             return jax.tree_util.tree_map(lambda p: jnp.ndim(p) > 1, params)
 
         opt = optax.adamw(sched, weight_decay=cfg.weight_decay, mask=decay_mask)
-    return optax.chain(optax.clip_by_global_norm(cfg.grad_clip), opt)
+    if cfg.grad_clip and cfg.grad_clip > 0:
+        return optax.chain(optax.clip_by_global_norm(cfg.grad_clip), opt)
+    return opt
 
 
 class Trainer:
@@ -94,11 +99,17 @@ class Trainer:
         init_args: Optional[Tuple] = None,
         shardings: Any = "fsdp",
         seed: int = 0,
+        summary_writer: Optional[Any] = None,
     ) -> None:
         self.model = model
         self.cfg = cfg
         self.mesh = mesh
         self.loss_fn = loss_fn
+        self.summary_writer = summary_writer
+        self._last_summary_time: Optional[float] = None
+        #: host-side step counter — reading state.step would block on
+        #: the device every step, defeating async dispatch
+        self._host_step = 0
         self.tx = make_optimizer(cfg)
         self.batch_sharding = jax.tree_util.tree_map(
             lambda _: batch_sharding(mesh), example_batch
@@ -177,7 +188,32 @@ class Trainer:
 
         with self.mesh, nn.logical_axis_rules(self._rules):
             self.state, metrics = self._step(self.state, batch)
+        self._host_step += 1
+        if self.summary_writer is not None:
+            self._maybe_write_summary(metrics)
         return metrics
+
+    def _maybe_write_summary(self, metrics: Dict[str, jax.Array]) -> None:
+        """Every cfg.summary_every steps: scalar metrics + steps/sec to
+        the attached SummaryWriter.  The float() conversions synchronise
+        with the device, so this runs at an interval, never per step
+        (the interval check uses the host-side counter)."""
+
+        step = self._host_step
+        every = max(1, self.cfg.summary_every)
+        if step % every:
+            return
+        now = time.perf_counter()
+        scalars = {}
+        for k, v in metrics.items():
+            try:
+                scalars[k] = float(v)
+            except (TypeError, ValueError):
+                continue
+        if self._last_summary_time is not None:
+            scalars["steps_per_sec"] = every / (now - self._last_summary_time)
+        self._last_summary_time = now
+        self.summary_writer.write(step, **scalars)
 
     def shard_batch(self, batch: Batch) -> Batch:
         """Lay the batch out on the mesh.
@@ -218,6 +254,33 @@ class Trainer:
             )
 
     # -- measurement --------------------------------------------------------
+    def benchmark_stream(
+        self, batches, steps: int = 20, warmup: int = 3
+    ) -> Dict[str, float]:
+        """Like benchmark, but pulling each step's batch from an
+        iterator of device-resident global batches (the live input
+        pipeline, e.g. data.device_prefetch) — input loading and
+        host→device transfer are inside the measured window."""
+
+        m = None
+        batch = None
+        for _ in range(warmup):
+            batch = next(batches)
+            m = self.train_step(batch)
+        if m is not None:
+            jax.tree_util.tree_map(lambda x: x.block_until_ready(), m)
+        n_batch = next(iter(batch.values())).shape[0] if batch else 0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            m = self.train_step(next(batches))
+        jax.tree_util.tree_map(lambda x: x.block_until_ready(), m)
+        dt = time.perf_counter() - t0
+        return {
+            "steps_per_sec": steps / dt,
+            "examples_per_sec": steps * n_batch / dt,
+            "step_ms": 1e3 * dt / steps,
+        }
+
     def benchmark(self, batch: Batch, steps: int = 20, warmup: int = 3) -> Dict[str, float]:
         batch = self.shard_batch(batch)
         m = None

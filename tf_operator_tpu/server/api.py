@@ -14,6 +14,7 @@ the operator binary carries the equivalent surface itself:
     GET  /apis/v1/namespaces/{ns}/tpujobs/{name}      get
     DEL  /apis/v1/namespaces/{ns}/tpujobs/{name}      delete
     GET  /apis/v1/namespaces/{ns}/tpujobs/{name}/events
+    GET  /apis/v1/namespaces/{ns}/tpujobs/{name}/metrics   step series
     GET  /apis/v1/namespaces/{ns}/tpujobs/{name}/pods
     GET  /apis/v1/namespaces/{ns}/tpujobs/{name}/pods/{pod}/log
 
@@ -193,6 +194,20 @@ class ApiServer:
                                         for e in evs
                                     ]
                                 },
+                            )
+                        if p[6] == "metrics":
+                            from tf_operator_tpu.utils.summaries import (
+                                ANNOTATION_SUMMARY_DIR,
+                                read_series,
+                            )
+
+                            sdir = job.metadata.annotations.get(
+                                ANNOTATION_SUMMARY_DIR
+                            )
+                            if not sdir:
+                                return self._send(200, {"items": []})
+                            return self._send(
+                                200, {"items": read_series(sdir, limit=500)}
                             )
                         if p[6] == "pods":
                             pods = outer.backend.list_pods(

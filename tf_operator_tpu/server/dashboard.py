@@ -102,6 +102,7 @@ async function detail() {
   const job = await jobRes.json();
   const events = (await (await fetch(base + "/events")).json()).items || [];
   const pods = (await (await fetch(base + "/pods")).json()).items || [];
+  const series = (await (await fetch(base + "/metrics")).json()).items || [];
   let text = "";
   text += "conditions:\\n";
   for (const c of (job.status && job.status.conditions) || [])
@@ -114,6 +115,16 @@ async function detail() {
   text += "\\nevents:\\n";
   for (const e of events)
     text += `  ${e.type.padEnd(8)} ${e.reason.padEnd(24)} ${e.message}\\n`;
+  if (series.length) {
+    text += "\\nmetrics (last 10 of " + series.length + "):\\n";
+    for (const m of series.slice(-10)) {
+      const rest = Object.entries(m)
+        .filter(([k]) => k !== "step" && k !== "time")
+        .map(([k, v]) => `${k}=${typeof v === "number" ? v.toFixed(4) : v}`)
+        .join(" ");
+      text += `  step ${String(m.step).padEnd(8)} ${rest}\\n`;
+    }
+  }
   document.getElementById("detail-title").textContent = selected;
   document.getElementById("detail-title").style.display = "";
   const el = document.getElementById("detail");

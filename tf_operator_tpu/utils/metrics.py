@@ -13,11 +13,19 @@ from collections import defaultdict
 from typing import Dict, List, Tuple
 
 
+#: default histogram buckets (seconds) — sync/span durations
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
 class Metrics:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
         self._observations: Dict[str, List[float]] = defaultdict(list)
+        #: name -> (buckets, counts[len(buckets)+1], sum, count)
+        self._histograms: Dict[str, list] = {}
 
     def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
         key = (name, tuple(sorted(labels.items())))
@@ -27,6 +35,53 @@ class Metrics:
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             self._observations[name].append(value)
+
+    def observe_histogram(
+        self, name: str, value: float, buckets: Tuple[float, ...] = DEFAULT_BUCKETS
+    ) -> None:
+        """Bounded-memory histogram (Prometheus bucket semantics) — use
+        for unbounded-cardinality series like per-sync durations, where
+        the raw-observation list of ``observe`` would leak."""
+
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = [buckets, [0] * (len(buckets) + 1), 0.0, 0]
+                self._histograms[name] = h
+            bks, counts, _, _ = h
+            i = 0
+            while i < len(bks) and value > bks[i]:
+                i += 1
+            counts[i] += 1
+            h[2] += value
+            h[3] += 1
+
+    def histogram(self, name: str) -> Dict[str, float]:
+        """Summary view of a histogram: count, sum, approx p50/p99
+        (upper bucket bounds)."""
+
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                return {"count": 0}
+            bks, counts, total, n = h[0], list(h[1]), h[2], h[3]
+
+        def quantile(q: float) -> float:
+            target = q * n
+            acc = 0
+            for i, c in enumerate(counts):
+                acc += c
+                if acc >= target:
+                    return bks[i] if i < len(bks) else float("inf")
+            return float("inf")
+
+        return {
+            "count": n,
+            "sum": total,
+            "mean": total / n if n else 0.0,
+            "p50_le": quantile(0.5),
+            "p99_le": quantile(0.99),
+        }
 
     def counter(self, name: str, **labels: str) -> float:
         key = (name, tuple(sorted(labels.items())))
@@ -58,6 +113,14 @@ class Metrics:
             for name, vals in sorted(self._observations.items()):
                 lines.append(f"{name}_count {len(vals)}")
                 lines.append(f"{name}_sum {sum(vals)}")
+            for name, (bks, counts, total, n) in sorted(self._histograms.items()):
+                acc = 0
+                for i, b in enumerate(bks):
+                    acc += counts[i]
+                    lines.append(f'{name}_bucket{{le="{b}"}} {acc}')
+                lines.append(f'{name}_bucket{{le="+Inf"}} {n}')
+                lines.append(f"{name}_sum {total}")
+                lines.append(f"{name}_count {n}")
         return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,85 @@
+"""Step-series training metrics — the mnist_with_summaries analogue.
+
+Parity: the reference's `examples/v1/mnist_with_summaries` writes
+TensorBoard summaries (SURVEY.md §2 row "Examples: mnist_with_summaries");
+the TPU-native equivalent is a dependency-free JSON-lines series the
+Trainer emits and the operator surfaces (`tpujob describe --metrics`,
+dashboard detail pane, `/apis/.../metrics` endpoint).
+
+Format: one file per process, `metrics-<process_id>.jsonl`, one JSON
+object per line: `{"step": N, "time": <unix>, "loss": ..., ...}`.
+Scalars only; values are floats.  The writing process appends + flushes
+per line so a reader (the operator, a plotting script) can tail live.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+#: job annotation naming the summary directory; the operator's job API
+#: serves the series from here (trust note: the submitter controls this
+#: path and the operator reads it — same trust domain as pod commands,
+#: see docs/TRUST.md)
+ANNOTATION_SUMMARY_DIR = "tpujob.dist/summary-dir"
+
+
+class SummaryWriter:
+    """Append-only JSON-lines scalar series for one process."""
+
+    def __init__(self, directory: str, process_id: int = 0):
+        self.directory = directory
+        self.process_id = process_id
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, f"metrics-{process_id}.jsonl")
+        self._f = open(self.path, "a", buffering=1)  # line-buffered
+
+    def write(self, step: int, **scalars: float) -> None:
+        rec: Dict[str, float] = {"step": int(step), "time": time.time()}
+        for k, v in scalars.items():
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                continue  # non-scalar metric: skip, never crash training
+        self._f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "SummaryWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_series(directory: str, limit: Optional[int] = None) -> List[dict]:
+    """Merge every process's series, ordered by (step, time).
+
+    Malformed lines (a writer crashed mid-line) are skipped.  ``limit``
+    keeps only the most recent N records after merging.
+    """
+
+    records: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(directory, "metrics-*.jsonl"))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(rec, dict) and "step" in rec:
+                        records.append(rec)
+        except OSError:
+            continue
+    records.sort(key=lambda r: (r.get("step", 0), r.get("time", 0.0)))
+    if limit is not None and len(records) > limit:
+        records = records[-limit:]
+    return records
