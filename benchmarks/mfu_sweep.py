@@ -1,0 +1,95 @@
+"""MFU sweep: run a matrix of ResNet-50 step-time experiments, each in
+its own child process (fresh XLA_FLAGS per run; a hung run cannot kill
+the sweep — TPU tunnel stalls are a fact of life on this box).
+
+Usage:  python benchmarks/mfu_sweep.py [--quick] [--timeout 900]
+Findings go to benchmarks/PROFILE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+#: (label, variant, batch, extra XLA flags)
+MATRIX = [
+    ("baseline-b256", "baseline", 256, ""),
+    ("baseline-b512", "baseline", 512, ""),
+    ("s2d-b256", "s2d", 256, ""),
+    ("noclip-b256", "noclip", 256, ""),
+    ("vmem64m-b256", "baseline", 256, "--xla_tpu_scoped_vmem_limit_kib=65536"),
+    ("lhs-b256", "baseline", 256, "--xla_tpu_enable_latency_hiding_scheduler=true"),
+    (
+        "vmem64m-s2d-b512",
+        "s2d",
+        512,
+        "--xla_tpu_scoped_vmem_limit_kib=65536",
+    ),
+]
+
+QUICK = MATRIX[:3]
+
+
+def run_one(label, variant, batch, flags, timeout, steps):
+    env = dict(os.environ)
+    if flags:
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flags).strip()
+    cmd = [
+        sys.executable,
+        os.path.join(HERE, "profile_resnet.py"),
+        "--variant", variant,
+        "--batch", str(batch),
+        "--steps", str(steps),
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, env=env, capture_output=True, text=True, timeout=timeout
+        )
+    except subprocess.TimeoutExpired:
+        return {"label": label, "error": f"timeout >{timeout}s"}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                out = json.loads(line)
+                out["label"] = label
+                return out
+            except json.JSONDecodeError:
+                continue
+    tail = (proc.stderr or "").strip().splitlines()
+    return {"label": label, "error": (tail[-1] if tail else f"rc={proc.returncode}")[:160]}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="first 3 rows only")
+    ap.add_argument("--timeout", type=int, default=900)
+    ap.add_argument("--steps", type=int, default=20)
+    args = ap.parse_args()
+
+    rows = QUICK if args.quick else MATRIX
+    results = []
+    for label, variant, batch, flags in rows:
+        print(f"--- {label} ...", flush=True)
+        res = run_one(label, variant, batch, flags, args.timeout, args.steps)
+        results.append(res)
+        print(json.dumps(res), flush=True)
+
+    print("\n== sweep summary (sorted by MFU) ==")
+    ok = [r for r in results if "mfu" in r]
+    for r in sorted(ok, key=lambda r: -r["mfu"]):
+        print(
+            f"{r['label']:<20} mfu={r['mfu']:.4f}  step={r['step_ms']:.1f}ms  "
+            f"ex/s={r['examples_per_sec']:.0f}  b={r['batch_per_chip']}"
+        )
+    for r in results:
+        if "error" in r:
+            print(f"{r['label']:<20} ERROR: {r['error']}")
+
+
+if __name__ == "__main__":
+    main()

@@ -86,12 +86,59 @@ def run_variant(variant: str, batch_per_chip: int, steps: int, trace_dir: str | 
     }
     print(json.dumps(out), flush=True)
     if trace_dir:
-        with jax.profiler.trace(trace_dir, create_perfetto_trace=True):
+        with jax.profiler.trace(trace_dir):
             for _ in range(3):
                 trainer.train_step(batch)
             jax.effects_barrier()
-        summarize_trace(trace_dir)
+        summarize_xplane(trace_dir)
     return out
+
+
+def summarize_xplane(trace_dir: str, top: int = 30):
+    """Aggregate device-op durations from the .xplane.pb the profiler
+    always writes (no tensorboard plugin needed — TF ships the proto)."""
+
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True)
+    if not paths:
+        print("no xplane found under", trace_dir)
+        return
+    path = max(paths, key=os.path.getmtime)
+    space = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        space.ParseFromString(f.read())
+    for plane in space.planes:
+        # device planes: "/device:TPU:0" (tpu) / "/host:CPU" XLA client
+        # lines (cpu smoke).  Skip the pure-python host plane lines.
+        interesting = (
+            "TPU" in plane.name
+            or "/device:" in plane.name
+            or plane.name == "/host:CPU"
+        )
+        if not interesting:
+            continue
+        dur_by_name = defaultdict(float)
+        cnt_by_name = defaultdict(int)
+        total = 0.0
+        for line in plane.lines:
+            # skip host-side python callstack / step-marker lines; keep
+            # XLA op/module lines (TPU planes) and XLA client lines
+            # (/host:CPU smoke)
+            if line.name in ("python", "Steps"):
+                continue
+            for ev in line.events:
+                meta = plane.event_metadata.get(ev.metadata_id)
+                name = meta.name if meta else "?"
+                dur = ev.duration_ps / 1e12
+                dur_by_name[(line.name, name)] += dur
+                cnt_by_name[(line.name, name)] += 1
+                total += dur
+        if not dur_by_name:
+            continue
+        print(f"\n== plane {plane.name}: total event time {total*1e3:.1f} ms ==")
+        for (lname, name), dur in sorted(dur_by_name.items(), key=lambda kv: -kv[1])[:top]:
+            print(f"{dur*1e3:10.2f} ms  x{cnt_by_name[(lname, name)]:<5d} [{lname[:16]}] {name[:100]}")
 
 
 def summarize_trace(trace_dir: str, top: int = 30):
@@ -143,6 +190,7 @@ def main():
     ap.add_argument("--summarize-only", default=None, help="just parse an existing trace dir")
     args = ap.parse_args()
     if args.summarize_only:
+        summarize_xplane(args.summarize_only)
         summarize_trace(args.summarize_only)
         return
     run_variant(args.variant, args.batch, args.steps, args.trace)

@@ -14,10 +14,10 @@ bert_tiny for CPU e2e runs under the operator.
 
 from __future__ import annotations
 
-import argparse
 import sys
 
 from tf_operator_tpu.runtime import initialize
+from tf_operator_tpu.runtime.harness import standard_parser, train_loop
 
 
 def synthetic_mlm_batch(rng, n: int, seq: int, vocab: int, mask_id: int = 4):
@@ -33,12 +33,11 @@ def synthetic_mlm_batch(rng, n: int, seq: int, vocab: int, mask_id: int = 4):
 
 
 def main() -> int:
-    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser = standard_parser(
+        __doc__.split("\n")[0], batch_per_device=8, learning_rate=1e-4
+    )
     parser.add_argument("--model", choices=["bert_base", "bert_tiny"], default="bert_base")
-    parser.add_argument("--steps", type=int, default=30)
-    parser.add_argument("--batch-per-device", type=int, default=8)
     parser.add_argument("--seq-len", type=int, default=128)
-    parser.add_argument("--learning-rate", type=float, default=1e-4)
     args = parser.parse_args()
 
     initialize()
@@ -57,7 +56,9 @@ def main() -> int:
     else:
         model, vocab, seq = bert_tiny(max_len=args.seq_len), 1024, args.seq_len
 
-    local_batch = args.batch_per_device * n_dev // jax.process_count()
+    from tf_operator_tpu.runtime.harness import batch_sizes
+
+    _, local_batch = batch_sizes(args.batch_per_device)
     batch = synthetic_mlm_batch(jax.process_index(), local_batch, seq, vocab)
 
     trainer = Trainer(
@@ -70,20 +71,9 @@ def main() -> int:
         shardings="logical",
     )
     sharded = trainer.shard_batch(batch)
-    losses = []
-    for _ in range(args.steps):
-        metrics = trainer.train_step(sharded)
-        losses.append(float(metrics["loss"]))
-
-    print(
-        f"process {jax.process_index()}/{jax.process_count()}: "
-        f"{args.model} fsdp={mesh.shape['fsdp']} "
-        f"mlm loss {losses[0]:.4f} -> {losses[-1]:.4f}",
-        flush=True,
+    train_loop(
+        trainer, sharded, args.steps, tag=f"{args.model} fsdp={mesh.shape['fsdp']}"
     )
-    if args.steps >= 20 and not losses[-1] < losses[0]:
-        print("loss did not decrease", file=sys.stderr, flush=True)
-        return 1
     return 0
 
 

@@ -146,11 +146,14 @@ def main() -> int:
             make_loader,
             wait_for_dataset,
         )
+        from tf_operator_tpu.data.synthetic import mnist_meta
 
         if jax.process_index() == 0:
             ensure_mnist(args.data_dir)
         else:
-            wait_for_dataset(args.data_dir)
+            # wait for THESE parameters: a stale dataset mid-rewrite by
+            # the coordinator must not satisfy the wait
+            wait_for_dataset(args.data_dir, meta=mnist_meta())
         loader = make_loader(args.data_dir, per_proc, num_epochs=None)
         batches = device_prefetch(
             loader,

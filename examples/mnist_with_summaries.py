@@ -27,6 +27,12 @@ def main() -> int:
     parser.add_argument("--learning-rate", type=float, default=0.05)
     parser.add_argument("--summary-dir", required=True)
     parser.add_argument("--summary-every", type=int, default=5)
+    parser.add_argument(
+        "--checkpoint-dir",
+        default="",
+        help="resume via the framework TrainerCheckpointer (restart "
+        "contract: same env ⇒ training continues)",
+    )
     args = parser.parse_args()
 
     ctx = initialize()
@@ -63,14 +69,32 @@ def main() -> int:
         local,
         summary_writer=writer,
     )
+    ck = None
+    start = 0
+    if args.checkpoint_dir:
+        from tf_operator_tpu.parallel import TrainerCheckpointer
+
+        ck = TrainerCheckpointer(args.checkpoint_dir)
+        restored = ck.restore_latest(trainer)
+        if restored is not None:
+            start = restored
+            print(f"resumed from checkpoint step {restored}", flush=True)
+
     batch = trainer.shard_batch(local)
     last = None
-    for _ in range(args.steps):
+    for _ in range(start, args.steps):
         last = trainer.train_step(batch)
+    if ck is not None:
+        if last is not None:  # trained this run: persist the new step
+            ck.save(trainer, wait=True)
+        ck.close()
     writer.close()
+    final = f"final loss {float(last['loss']):.4f}" if last is not None else (
+        f"already complete at step {start}"
+    )
     print(
-        f"process {jax.process_index()}/{n_proc}: final loss "
-        f"{float(last['loss']):.4f}, series in {args.summary_dir}",
+        f"process {jax.process_index()}/{n_proc}: {final}, "
+        f"series in {args.summary_dir}",
         flush=True,
     )
     return 0

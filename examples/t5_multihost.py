@@ -13,10 +13,10 @@ parallelism on dp), with XLA collectives over ICI within a slice.
 
 from __future__ import annotations
 
-import argparse
 import sys
 
 from tf_operator_tpu.runtime import initialize
+from tf_operator_tpu.runtime.harness import standard_parser, train_loop
 
 
 def synthetic_seq2seq_batch(rng, n: int, enc_len: int, dec_len: int, vocab: int):
@@ -31,14 +31,13 @@ def synthetic_seq2seq_batch(rng, n: int, enc_len: int, dec_len: int, vocab: int)
 
 
 def main() -> int:
-    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser = standard_parser(
+        __doc__.split("\n")[0], batch_per_device=4, learning_rate=1e-4
+    )
     parser.add_argument("--model", choices=["t5_base", "t5_tiny"], default="t5_base")
-    parser.add_argument("--steps", type=int, default=30)
-    parser.add_argument("--batch-per-device", type=int, default=4)
     parser.add_argument("--enc-len", type=int, default=64)
     parser.add_argument("--dec-len", type=int, default=32)
     parser.add_argument("--tp", type=int, default=1, help="tensor-parallel axis size")
-    parser.add_argument("--learning-rate", type=float, default=1e-4)
     args = parser.parse_args()
 
     initialize()
@@ -77,20 +76,10 @@ def main() -> int:
         shardings="logical",
     )
     sharded = trainer.shard_global_batch(batch)
-    losses = []
-    for _ in range(args.steps):
-        metrics = trainer.train_step(sharded)
-        losses.append(float(metrics["loss"]))
-
-    print(
-        f"process {jax.process_index()}/{jax.process_count()}: "
-        f"{args.model} dp={mesh.shape['dp']} tp={mesh.shape['tp']} "
-        f"loss {losses[0]:.4f} -> {losses[-1]:.4f}",
-        flush=True,
+    train_loop(
+        trainer, sharded, args.steps,
+        tag=f"{args.model} dp={mesh.shape['dp']} tp={mesh.shape['tp']}",
     )
-    if args.steps >= 20 and not losses[-1] < losses[0]:
-        print("loss did not decrease", file=sys.stderr, flush=True)
-        return 1
     return 0
 
 

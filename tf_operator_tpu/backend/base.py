@@ -85,6 +85,16 @@ class ClusterBackend(abc.ABC):
     def subscribe(self, handler: WatchHandler) -> None:
         """Register a watch handler for all object kinds this backend owns."""
 
+    def snapshot(self):
+        """Full re-list for informer resync (SharedInformer parity,
+        SURVEY.md §5: "periodic full re-list heals missed events").
+
+        Returns (pods, services, pod_groups) — cloned, all namespaces —
+        or None if this backend cannot re-list (resync then covers jobs
+        only)."""
+
+        return None
+
     def close(self) -> None:  # pragma: no cover - default no-op
         pass
 

@@ -75,6 +75,16 @@ class FakeCluster(ClusterBackend):
         for h in list(self._handlers):
             h(ev)
 
+    def snapshot(self):
+        """Re-list for informer resync: cloned pods/services/groups."""
+
+        with self._lock:
+            return (
+                [p.clone() for p in self._pods.values()],
+                [s.clone() for s in self._services.values()],
+                [g.clone() for g in self._groups.values()],
+            )
+
     def pump(self, n: Optional[int] = None) -> int:
         """Deliver up to ``n`` buffered watch events (all if None).
 

@@ -190,6 +190,16 @@ class LocalProcessBackend(ClusterBackend):
                 and match_selector(p.metadata.labels, selector)
             ]
 
+    def snapshot(self):
+        """Re-list for informer resync: cloned pods/services/groups."""
+
+        with self._lock:
+            return (
+                [p.clone() for p in self._pods.values()],
+                [s.clone() for s in self._services.values()],
+                [g.clone() for g in self._groups.values()],
+            )
+
     def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
         return self._pods.get(f"{namespace}/{name}")
 

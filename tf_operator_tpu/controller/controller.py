@@ -36,6 +36,7 @@ class TPUJobController:
         metrics: Optional[Metrics] = None,
         max_sync_retries: int = 20,
         use_native: Optional[bool] = None,
+        resync_period: float = 30.0,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -79,10 +80,37 @@ class TPUJobController:
             requeue_after=self.queue.add_after,
         )
         self.max_sync_retries = max_sync_retries
+        self.resync_period = resync_period
         self._threads: list = []
         self._stop = threading.Event()
         backend.subscribe(self.cache.handle_event)
         job_store.subscribe(self.cache.handle_event)
+
+    def resync(self) -> int:
+        """One full informer resync: authoritative re-list of jobs from
+        the store and pods/services/groups from the backend, cache
+        replacement, and an enqueue of every affected job (SURVEY.md §5
+        "informer resync (periodic full re-list heals missed events)").
+        Returns the number of jobs enqueued."""
+
+        before = self.cache.event_count
+        jobs = self.jobs.list(None)
+        snap = self.backend.snapshot()
+        if snap is None:
+            # backend can't re-list: no cache swap, just re-enqueue every
+            # known job so level-triggered syncs re-examine them
+            with self.cache._lock:
+                keys = set(self.cache.jobs) | {j.key for j in jobs}
+            for key in keys:
+                self.queue.add(key)
+            self.metrics.inc("tpujob_resyncs_total")
+            return len(keys)
+        pods, services, groups = snap
+        affected = self.cache.resync(
+            jobs, pods, services, groups, expected_event_count=before
+        )
+        self.metrics.inc("tpujob_resyncs_total")
+        return len(affected)
 
     # ---------------------------------------------------------------- loops
 
@@ -117,13 +145,25 @@ class TPUJobController:
         return n
 
     def run(self, threadiness: int = 1) -> None:
-        """Spawn worker threads (Controller.Run parity)."""
+        """Spawn worker threads (Controller.Run parity) plus the
+        periodic resync loop (resync_period <= 0 disables)."""
 
         self._stop.clear()
         for _ in range(threadiness):
             t = threading.Thread(target=self._worker, daemon=True)
             t.start()
             self._threads.append(t)
+        if self.resync_period > 0:
+            t = threading.Thread(target=self._resync_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _resync_loop(self) -> None:
+        while not self._stop.wait(self.resync_period):
+            try:
+                self.resync()
+            except Exception as e:  # noqa: BLE001 - keep the loop alive
+                logger_for_job("-", "resync").error("resync failed: %s", e)
 
     def _worker(self) -> None:
         while not self._stop.is_set():

@@ -42,6 +42,9 @@ class InformerCache:
         self._enqueue = enqueue
         self._pod_exp = pod_expectations
         self._svc_exp = service_expectations
+        #: monotonic count of watch events applied — resync uses it to
+        #: detect (and abort on) events that interleaved with its re-list
+        self.event_count = 0
         self.pods: Dict[str, Pod] = {}
         self.services: Dict[str, Service] = {}
         self.groups: Dict[str, PodGroup] = {}
@@ -64,6 +67,8 @@ class InformerCache:
             "TPUJob": self._on_job,
         }.get(ev.kind)
         if handler:
+            with self._lock:
+                self.event_count += 1
             handler(ev)
 
     # -- reads (the "listers") ----------------------------------------------
@@ -125,6 +130,59 @@ class InformerCache:
     def get_group(self, key: str) -> Optional[PodGroup]:
         with self._lock:
             return self.groups.get(key)
+
+    # -- resync -------------------------------------------------------------
+
+    def resync(self, jobs, pods, services, groups, expected_event_count=None) -> set:
+        """Full state replacement (SharedInformer resync parity,
+        SURVEY.md §5): swap in authoritative listings, rebuild the
+        indexes, enqueue every job that exists now OR existed before OR
+        is referenced by an object's label — lost watch events (adds,
+        deletes, phase changes) are healed on the next sync.
+
+        ``expected_event_count``: the caller's ``event_count`` read
+        BEFORE taking the listings.  If any watch event landed since,
+        the listings may be older than the cache — the swap is aborted
+        (returns an empty set) and the next periodic resync tries again;
+        resyncs matter precisely when events are NOT flowing, so an
+        abort under churn costs nothing.
+
+        Expectations are deliberately untouched (reference semantics:
+        resync re-delivers state, expectation imbalances heal via their
+        own timeout)."""
+
+        with self._lock:
+            if (
+                expected_event_count is not None
+                and self.event_count != expected_event_count
+            ):
+                return set()
+            affected = set(self.jobs)
+            self.jobs = {j.key: j for j in jobs}
+            self.pods = {p.key: p for p in pods}
+            self.services = {s.key: s for s in services}
+            self.groups = {g.key: g for g in groups}
+            self._pods_by_job = {}
+            self._svcs_by_job = {}
+            self._pods_by_owner = {}
+            for p in pods:
+                jk = self._job_key_for(p)
+                if jk:
+                    self._pods_by_job.setdefault(jk, set()).add(p.key)
+                    affected.add(jk)
+                if p.metadata.owner_uid:
+                    self._pods_by_owner.setdefault(
+                        p.metadata.owner_uid, set()
+                    ).add(p.key)
+            for s in services:
+                jk = self._job_key_for(s)
+                if jk:
+                    self._svcs_by_job.setdefault(jk, set()).add(s.key)
+                    affected.add(jk)
+            affected |= set(self.jobs)
+        for key in affected:
+            self._enqueue(key)
+        return affected
 
     # -- handlers -----------------------------------------------------------
 

@@ -18,26 +18,38 @@ from __future__ import annotations
 import json
 import os
 import time
+from typing import Optional
 
 import numpy as np
 
 _META = "meta.json"
 
 
-def wait_for_dataset(directory: str, timeout: float = 120.0) -> str:
+def wait_for_dataset(
+    directory: str, timeout: float = 120.0, meta: Optional[dict] = None
+) -> str:
     """Block until another process finishes generating ``directory``.
 
     Multi-process jobs generate on the coordinator only (one writer);
-    the rest call this.
+    the rest call this.  Pass ``meta`` (the exact parameter dict the
+    coordinator generates with — ``mnist_meta()`` etc.) so a STALE
+    dataset from different parameters doesn't satisfy the wait while
+    the coordinator is mid-rewrite.
     """
 
     deadline = time.time() + timeout
-    path = os.path.join(directory, _META)
     while time.time() < deadline:
-        if os.path.exists(path):
+        if meta is not None:
+            if _exists(directory, meta):
+                return directory
+        elif os.path.exists(os.path.join(directory, _META)):
             return directory
         time.sleep(0.2)
     raise TimeoutError(f"dataset never appeared at {directory}")
+
+
+def mnist_meta(n: int = 16384, seed: int = 0, classes: int = 10) -> dict:
+    return {"kind": "mnist-like", "n": n, "seed": seed, "classes": classes}
 
 
 def _write(directory: str, images: np.ndarray, labels: np.ndarray, meta: dict) -> None:
@@ -79,7 +91,7 @@ def ensure_mnist(
 ) -> str:
     """28x28x1 uint8 dataset in the MNIST shape; idempotent."""
 
-    meta = {"kind": "mnist-like", "n": n, "seed": seed, "classes": classes}
+    meta = mnist_meta(n, seed, classes)
     if _exists(directory, meta):
         return directory
     r = np.random.RandomState(seed)

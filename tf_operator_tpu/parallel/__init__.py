@@ -29,6 +29,7 @@ from tf_operator_tpu.parallel.mesh import (
     make_mesh,
     replicated,
 )
+from tf_operator_tpu.parallel.checkpoint import TrainerCheckpointer
 from tf_operator_tpu.parallel.pipeline import (
     pipeline_apply,
     pipelined,
@@ -57,6 +58,7 @@ __all__ = [
     "fsdp_shardings",
     "logical_shardings",
     "Trainer",
+    "TrainerCheckpointer",
     "TrainerConfig",
     "pipeline_apply",
     "pipelined",

@@ -1,0 +1,72 @@
+"""Sharding-aware checkpoint/restore for the Trainer (orbax).
+
+Parity: checkpointing is "not an operator feature" in the reference —
+its examples checkpoint via TF MonitoredTrainingSession to shared
+storage so the operator's restart contract (same replica index, same
+env ⇒ resume) works (SURVEY.md §5 "Checkpoint / resume").  Here the
+framework ships the equivalent as a first-class component: save the
+full sharded TrainState (params, optimizer state, step, rng, mutable
+collections), restore it INTO the trainer's shardings — every process
+of a multi-host job calls save/restore collectively, and arrays come
+back laid out exactly as the mesh expects (no gather through host 0).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+class TrainerCheckpointer:
+    """Thin orbax CheckpointManager wrapper bound to a Trainer."""
+
+    def __init__(self, directory: str, max_to_keep: int = 2):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.manager = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def save(self, trainer, step: Optional[int] = None, wait: bool = False) -> int:
+        """Persist the trainer's full TrainState at ``step`` (default:
+        the state's own step counter).  Async by default; ``wait``
+        blocks until durable."""
+
+        if step is None:
+            step = int(trainer.state.step)
+        self.manager.save(
+            step, args=self._ocp.args.StandardSave({"state": trainer.state})
+        )
+        if wait:
+            self.manager.wait_until_finished()
+        return step
+
+    def restore_latest(self, trainer) -> Optional[int]:
+        """Restore the newest checkpoint into ``trainer.state`` with the
+        trainer's shardings; returns the restored step or None if the
+        directory is empty (fresh start)."""
+
+        latest = self.manager.latest_step()
+        if latest is None:
+            return None
+        # abstract target: shapes/dtypes from the live state, layouts
+        # from the trainer's sharding tree — orbax then loads each shard
+        # directly onto its devices
+        abstract = jax.tree_util.tree_map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            trainer.state,
+            trainer.state_sharding,
+        )
+        restored = self.manager.restore(
+            latest, args=self._ocp.args.StandardRestore({"state": abstract})
+        )
+        trainer.state = restored["state"]
+        trainer._host_step = int(trainer.state.step)
+        return latest
+
+    def close(self) -> None:
+        self.manager.wait_until_finished()
+        self.manager.close()

@@ -263,16 +263,18 @@ class Trainer:
         host→device transfer are inside the measured window."""
 
         m = None
-        batch = None
+        n_batch = 0
         for _ in range(warmup):
             batch = next(batches)
+            n_batch = next(iter(batch.values())).shape[0]
             m = self.train_step(batch)
         if m is not None:
             jax.tree_util.tree_map(lambda x: x.block_until_ready(), m)
-        n_batch = next(iter(batch.values())).shape[0] if batch else 0
         t0 = time.perf_counter()
         for _ in range(steps):
-            m = self.train_step(next(batches))
+            batch = next(batches)
+            n_batch = next(iter(batch.values())).shape[0]
+            m = self.train_step(batch)
         jax.tree_util.tree_map(lambda x: x.block_until_ready(), m)
         dt = time.perf_counter() - t0
         return {

@@ -66,17 +66,31 @@ def read_series(directory: str, limit: Optional[int] = None) -> List[dict]:
     records: List[dict] = []
     for path in sorted(glob.glob(os.path.join(directory, "metrics-*.jsonl"))):
         try:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
-                    if isinstance(rec, dict) and "step" in rec:
-                        records.append(rec)
+            with open(path, "rb") as f:
+                if limit is not None:
+                    # bounded read: tail enough bytes for `limit` records
+                    # (~300 B/record) instead of parsing the whole file
+                    # on every dashboard poll
+                    budget = max(4096, 400 * limit)
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - budget))
+                    data = f.read()
+                    if size > budget:
+                        # drop the first, possibly partial, line
+                        data = data.split(b"\n", 1)[-1]
+                else:
+                    data = f.read()
+            for line in data.decode(errors="replace").splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "step" in rec:
+                    records.append(rec)
         except OSError:
             continue
     records.sort(key=lambda r: (r.get("step", 0), r.get("time", 0.0)))
