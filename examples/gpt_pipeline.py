@@ -1,0 +1,99 @@
+"""Pipeline-parallel GPT training — the pp axis end to end.
+
+Parity note: the reference has no pipeline parallelism (SURVEY.md §2b
+marks PP absent/optional); this example goes beyond parity: a causal LM
+whose decoder stack is split into pp stages (models/pipelined_lm.py),
+parameters stage-sharded over the pp mesh axis, activations flowing
+stage-to-stage by ppermute under the GPipe schedule, composed with data
+parallelism on the remaining devices.
+
+Runs anywhere with >= pp devices: virtual CPU mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=8), a TPU slice, or
+multi-process under the operator.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tf_operator_tpu.runtime import initialize
+from tf_operator_tpu.runtime.harness import standard_parser
+
+
+def main() -> int:
+    parser = standard_parser(__doc__.split("\n")[0], learning_rate=1e-3)
+    parser.add_argument("--pp", type=int, default=2, help="pipeline stages")
+    parser.add_argument("--microbatches", type=int, default=4)
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument("--n-layers", type=int, default=4)
+    parser.add_argument("--hidden", type=int, default=128)
+    args = parser.parse_args()
+
+    initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tf_operator_tpu.models import PipelinedLM
+    from tf_operator_tpu.models.transformer import TransformerConfig
+    from tf_operator_tpu.parallel import make_mesh
+
+    n_dev = len(jax.devices())
+    if n_dev % args.pp:
+        print(f"{n_dev} devices not divisible by pp={args.pp}", file=sys.stderr)
+        return 2
+    mesh = make_mesh({"pp": args.pp, "dp": n_dev // args.pp})
+
+    cfg = TransformerConfig(
+        vocab_size=512,
+        hidden=args.hidden,
+        n_heads=4,
+        head_dim=args.hidden // 4,
+        n_layers=args.n_layers,
+        mlp_dim=4 * args.hidden,
+        max_len=args.seq_len,
+    )
+    model = PipelinedLM(cfg, mesh, microbatches=args.microbatches)
+    params = model.shard_params(model.init(jax.random.PRNGKey(0)))
+
+    dp = mesh.shape["dp"]
+    # batch-per-device keeps its usual meaning (rows per dp shard); it
+    # is rounded UP to a multiple of microbatches so each microbatch's
+    # rows still shard evenly over dp
+    m = args.microbatches
+    bpd = -(-max(args.batch_per_device, 1) // m) * m
+    batch = bpd * dp
+    r = np.random.RandomState(0)
+    ids = jnp.asarray(r.randint(0, cfg.vocab_size, size=(batch, args.seq_len)))
+
+    tx = optax.adamw(args.learning_rate)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o, b):
+        loss, grads = jax.value_and_grad(model.loss)(p, b)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    losses = []
+    with mesh:
+        for _ in range(args.steps):
+            params, opt, loss = step(params, opt, ids)
+            losses.append(float(loss))
+
+    print(
+        f"process {jax.process_index()}/{jax.process_count()} "
+        f"[gpt pp={args.pp} dp={dp} mb={args.microbatches}]: "
+        f"loss {losses[0]:.4f} -> {losses[-1]:.4f}",
+        flush=True,
+    )
+    if args.steps >= 20 and not losses[-1] < losses[0]:
+        print("loss did not decrease", file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

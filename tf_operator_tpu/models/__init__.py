@@ -10,6 +10,7 @@ named mesh in tf_operator_tpu.parallel.
 from tf_operator_tpu.models.bert import Bert, BertForPretraining, bert_base, bert_tiny, mlm_loss
 from tf_operator_tpu.models.gpt import CausalLM, gpt_small, gpt_tiny, lm_loss
 from tf_operator_tpu.models.mnist import MnistCNN
+from tf_operator_tpu.models.pipelined_lm import PipelinedLM, lm_reference_apply
 from tf_operator_tpu.models.moe import MoeConfig, MoeLM, moe_lm_loss, moe_tiny
 from tf_operator_tpu.models.resnet import ResNet, resnet18, resnet50
 from tf_operator_tpu.models.t5 import T5, seq2seq_loss, t5_base, t5_tiny
@@ -25,8 +26,10 @@ __all__ = [
     "gpt_small",
     "gpt_tiny",
     "lm_loss",
+    "lm_reference_apply",
     "MnistCNN",
     "MoeConfig",
+    "PipelinedLM",
     "MoeLM",
     "moe_lm_loss",
     "moe_tiny",

@@ -1,0 +1,157 @@
+"""Pipeline-parallel causal LM: the transformer family over the pp axis.
+
+Composes parallel/pipeline.py's GPipe schedule with the CausalLM block
+stack (SURVEY.md §2b PP row): the decoder layers are split into
+``pp`` stages, each stage's layer parameters stacked with leading dims
+[pp, layers_per_stage, ...] and laid out ``P("pp")``; within a stage a
+``lax.scan`` applies the stage's layers, between stages activations
+move by ppermute.  Embedding, position table, final norm and the tied
+LM head stay outside the pipeline (replicated — they are small next to
+the block stack), exactly like the usual embedding-outside-PP layout.
+
+Function-style (init/apply) rather than an nn.Module: the pipeline
+schedule needs direct control of parameter layout and shard_map specs,
+which flax's lifted transforms would obscure.  Dropout is disabled
+inside the pipelined stages (deterministic apply) — the standard
+simplification for GPipe-style schedules.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tf_operator_tpu.models.transformer import (
+    DecoderLayer,
+    Embed,
+    LayerNorm,
+    TransformerConfig,
+)
+from tf_operator_tpu.parallel.mesh import AXIS_PP, BATCH_AXES
+from tf_operator_tpu.parallel.pipeline import pipeline_apply
+
+
+class PipelinedLM:
+    """init/apply/loss bundle for a pp-staged CausalLM."""
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        mesh: Mesh,
+        *,
+        microbatches: int = 4,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.pp = mesh.shape[AXIS_PP]
+        if cfg.n_layers % self.pp:
+            raise ValueError(
+                f"n_layers {cfg.n_layers} not divisible by pp {self.pp}"
+            )
+        self.layers_per_stage = cfg.n_layers // self.pp
+        self.microbatches = microbatches
+        self._layer = DecoderLayer(cfg, cross=False)
+        self._embed = Embed(cfg)
+        self._ln = LayerNorm(cfg, rms=True)
+
+    # -- params -------------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        cfg = self.cfg
+        dummy_ids = jnp.zeros((1, min(8, cfg.max_len)), jnp.int32)
+        dummy_x = jnp.zeros((1, min(8, cfg.max_len), cfg.hidden), cfg.dtype)
+        r_embed, r_pos, r_ln, r_layers = jax.random.split(rng, 4)
+
+        embed = self._embed.init(r_embed, dummy_ids)["params"]
+        pos = jax.random.normal(r_pos, (cfg.max_len, cfg.hidden), jnp.float32) * 0.02
+        ln = self._ln.init(r_ln, dummy_x)["params"]
+
+        # one init per layer, stacked [pp, layers_per_stage, ...]
+        layer_params = []
+        for i in range(cfg.n_layers):
+            layer_params.append(
+                self._layer.init(jax.random.fold_in(r_layers, i), dummy_x)["params"]
+            )
+        per_stage = []
+        for s in range(self.pp):
+            chunk = layer_params[
+                s * self.layers_per_stage : (s + 1) * self.layers_per_stage
+            ]
+            per_stage.append(jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *chunk))
+        stages = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage)
+        return {"embed": embed, "pos": pos, "ln": ln, "stages": stages}
+
+    def shard_params(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        repl = NamedSharding(self.mesh, P())
+        stage = NamedSharding(self.mesh, P(AXIS_PP))
+        return {
+            "embed": jax.device_put(params["embed"], repl),
+            "pos": jax.device_put(params["pos"], repl),
+            "ln": jax.device_put(params["ln"], repl),
+            "stages": jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, stage), params["stages"]
+            ),
+        }
+
+    # -- forward ------------------------------------------------------------
+
+    def apply(self, params: Dict[str, Any], input_ids: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        _, s = input_ids.shape
+        x = self._embed.apply({"params": params["embed"]}, input_ids)
+        x = x + params["pos"][None, :s].astype(cfg.dtype)
+
+        layer = self._layer
+
+        def stage_fn(stage_params, h):
+            # scan this stage's layers (leading dim layers_per_stage)
+            def body(carry, lp):
+                return layer.apply({"params": lp}, carry, train=False), None
+
+            h, _ = jax.lax.scan(body, h, stage_params)
+            return h
+
+        x = pipeline_apply(
+            stage_fn,
+            params["stages"],
+            x,
+            self.mesh,
+            microbatches=self.microbatches,
+            batch_axes=BATCH_AXES,
+        )
+        x = self._ln.apply({"params": params["ln"]}, x)
+        logits = self._embed.apply(
+            {"params": params["embed"]}, x, method=self._embed.attend
+        )
+        return logits.astype(jnp.float32)
+
+    def loss(self, params: Dict[str, Any], input_ids: jax.Array) -> jax.Array:
+        logits = self.apply(params, input_ids)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], input_ids[:, 1:]
+        ).mean()
+
+
+def lm_reference_apply(model: PipelinedLM, params: Dict[str, Any], input_ids):
+    """Same computation WITHOUT the pipeline (sequential layers) — the
+    equivalence oracle for tests."""
+
+    cfg = model.cfg
+    _, s = input_ids.shape
+    x = model._embed.apply({"params": params["embed"]}, input_ids)
+    x = x + params["pos"][None, :s].astype(cfg.dtype)
+    flat = jax.tree_util.tree_map(
+        lambda p: p.reshape(cfg.n_layers, *p.shape[2:]), params["stages"]
+    )
+    for i in range(cfg.n_layers):
+        lp = jax.tree_util.tree_map(lambda p: p[i], flat)
+        x = model._layer.apply({"params": lp}, x, train=False)
+    x = model._ln.apply({"params": params["ln"]}, x)
+    logits = model._embed.apply(
+        {"params": params["embed"]}, x, method=model._embed.attend
+    )
+    return logits.astype(jnp.float32)

@@ -400,17 +400,10 @@ def flash_attention_sharded(
     per-shard kernel is exact.  Requires sp == ep == 1 (ring attention
     owns sp > 1)."""
 
-    try:
-        from jax import shard_map  # jax >= 0.8
-
-        check_kw = {"check_vma": False}
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
-
-        check_kw = {"check_rep": False}
+    from tf_operator_tpu.utils.jax_compat import shard_map_unchecked
 
     spec = P(("dp", "fsdp"), "tp", None, None)
-    fn = shard_map(
+    fn = shard_map_unchecked(
         functools.partial(
             flash_attention,
             causal=causal,
@@ -421,7 +414,6 @@ def flash_attention_sharded(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        **check_kw,
     )
     return fn(q, k, v)
 

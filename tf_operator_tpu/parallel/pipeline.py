@@ -28,8 +28,9 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from tf_operator_tpu.utils.jax_compat import shard_map_unchecked as shard_map
 
 from tf_operator_tpu.parallel.mesh import AXIS_PP
 
@@ -76,6 +77,17 @@ def pipeline_apply(
     if batch % microbatches:
         raise ValueError(f"batch {batch} not divisible by microbatches {microbatches}")
     mb = batch // microbatches
+    if batch_axes:
+        axes = (batch_axes,) if isinstance(batch_axes, str) else tuple(batch_axes)
+        dp_size = 1
+        for a in axes:
+            dp_size *= mesh.shape[a]
+        if mb % dp_size:
+            raise ValueError(
+                f"microbatch rows ({mb}) not divisible by the batch-axis "
+                f"mesh size ({dp_size}); batch must be a multiple of "
+                f"microbatches x {'x'.join(axes)}"
+            )
 
     # [M, mb, ...] microbatch stream
     xs = x.reshape(microbatches, mb, *x.shape[1:])
@@ -112,7 +124,6 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(stage_sharding_spec(), stream_spec),
         out_specs=stream_spec,
-        check_rep=False,
     )(stacked_params, xs)
     return out.reshape(batch, *out.shape[2:])
 

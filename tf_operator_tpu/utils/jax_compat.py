@@ -1,0 +1,27 @@
+"""Version-compat shims for jax API churn — ONE copy, shared.
+
+jax >= 0.8 moved shard_map to the top level and renamed the
+replication-check kwarg (check_rep -> check_vma); older jax has the
+experimental path.  Every shard_map call site in the repo goes through
+``shard_map_unchecked`` so the next rename is a one-line fix.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.8
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = {"check_vma": False}
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = {"check_rep": False}
+
+
+def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
+    """shard_map with replication checking off (collective outputs the
+    checker cannot prove replicated — psum-broadcast results etc.)."""
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **_CHECK_KW
+    )
