@@ -56,6 +56,8 @@ def main() -> int:
         max_len=args.seq_len,
     )
     model = PipelinedLM(cfg, mesh, microbatches=args.microbatches)
+    # every process inits identically (same seed); shard_params lays the
+    # stages onto the pp axis — across processes when the mesh spans them
     params = model.shard_params(model.init(jax.random.PRNGKey(0)))
 
     dp = mesh.shape["dp"]
@@ -65,11 +67,21 @@ def main() -> int:
     m = args.microbatches
     bpd = -(-max(args.batch_per_device, 1) // m) * m
     batch = bpd * dp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     r = np.random.RandomState(0)
-    ids = jnp.asarray(r.randint(0, cfg.vocab_size, size=(batch, args.seq_len)))
+    ids_np = r.randint(0, cfg.vocab_size, size=(batch, args.seq_len)).astype(np.int32)
+    if jax.process_count() == 1:
+        ids = jnp.asarray(ids_np)
+    else:
+        # identical global batch on every process, laid out replicated
+        ids = jax.make_array_from_callback(
+            ids_np.shape, NamedSharding(mesh, P()), lambda idx: ids_np[idx]
+        )
 
     tx = optax.adamw(args.learning_rate)
-    opt = tx.init(params)
+    with mesh:
+        opt = jax.jit(tx.init)(params)
 
     @jax.jit
     def step(p, o, b):
@@ -77,21 +89,28 @@ def main() -> int:
         updates, o = tx.update(grads, o, p)
         return optax.apply_updates(p, updates), o, loss
 
-    losses = []
-    with mesh:
-        for _ in range(args.steps):
-            params, opt, loss = step(params, opt, ids)
-            losses.append(float(loss))
+    class _Loop:
+        """Adapts the functional (params, opt) step to the harness's
+        trainer protocol, so the loop/summary/exit contract stays in
+        ONE place (runtime/harness.py)."""
 
-    print(
-        f"process {jax.process_index()}/{jax.process_count()} "
-        f"[gpt pp={args.pp} dp={dp} mb={args.microbatches}]: "
-        f"loss {losses[0]:.4f} -> {losses[-1]:.4f}",
-        flush=True,
-    )
-    if args.steps >= 20 and not losses[-1] < losses[0]:
-        print("loss did not decrease", file=sys.stderr, flush=True)
-        return 1
+        def __init__(self, params, opt):
+            self.params, self.opt = params, opt
+
+        def train_step(self, batch):
+            self.params, self.opt, loss = step(self.params, self.opt, batch)
+            return {"loss": loss}
+
+    from tf_operator_tpu.runtime.harness import train_loop
+
+    loop = _Loop(params, opt)
+    with mesh:
+        train_loop(
+            loop,
+            ids,
+            args.steps,
+            tag=f"gpt pp={args.pp} dp={dp} mb={args.microbatches}",
+        )
     return 0
 
 

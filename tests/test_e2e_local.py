@@ -242,3 +242,34 @@ class TestLocalE2E:
         assert os.path.exists(os.path.join(data_dir, "meta.json"))
         log0 = backend.pod_log("default", "mnist-data-worker-0")
         assert "loss" in log0
+
+    def test_pipeline_stages_across_two_processes(self, local_harness):
+        """Pipeline parallelism over the PROCESS boundary: 2 workers,
+        1 device each, pp=2 — each process hosts one transformer stage
+        and activations cross processes via the collective backend
+        (gloo on CPU, ICI/DCN on TPU)."""
+
+        gpt_pp = os.path.join(REPO, "examples", "gpt_pipeline.py")
+        store, backend, c = local_harness
+        job = new_job(
+            name="ppx", worker=2,
+            command=[
+                sys.executable, gpt_pp, "--pp", "2", "--steps", "20",
+                "--batch-per-device", "2", "--seq-len", "16",
+                "--hidden", "32", "--n-layers", "2", "--microbatches", "2",
+            ],
+        )
+        job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].env = {
+            **cpu_env(),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        }
+        store.create(job)
+        done = wait_for(
+            store, "default", "ppx",
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED),
+            timeout=150.0,
+        )
+        assert done.status.replica_statuses[ReplicaType.WORKER].succeeded == 2
+        log = backend.pod_log("default", "ppx-worker-0")
+        assert "pp=2 dp=1" in log and "loss" in log

@@ -86,14 +86,30 @@ class PipelinedLM:
         return {"embed": embed, "pos": pos, "ln": ln, "stages": stages}
 
     def shard_params(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Lay params on the mesh: stages over pp, the rest replicated.
+
+        Multi-process safe: every process calls this with the SAME host
+        params (same init seed) and each device receives exactly its
+        shard — the multi-host layout a pp mesh spanning processes
+        needs (each host holding only its stages)."""
+
         repl = NamedSharding(self.mesh, P())
         stage = NamedSharding(self.mesh, P(AXIS_PP))
+
+        def put(x, sharding):
+            x = jnp.asarray(x)
+            if jax.process_count() == 1:
+                return jax.device_put(x, sharding)
+            return jax.make_array_from_callback(
+                x.shape, sharding, lambda idx: x[idx]
+            )
+
         return {
-            "embed": jax.device_put(params["embed"], repl),
-            "pos": jax.device_put(params["pos"], repl),
-            "ln": jax.device_put(params["ln"], repl),
+            "embed": jax.tree_util.tree_map(lambda x: put(x, repl), params["embed"]),
+            "pos": put(params["pos"], repl),
+            "ln": jax.tree_util.tree_map(lambda x: put(x, repl), params["ln"]),
             "stages": jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, stage), params["stages"]
+                lambda x: put(x, stage), params["stages"]
             ),
         }
 

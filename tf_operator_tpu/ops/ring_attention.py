@@ -136,6 +136,8 @@ def ring_attention(
         axis_size=mesh.shape[axis_name],
         causal=causal,
     )
-    return jax.shard_map(
+    from tf_operator_tpu.utils.jax_compat import shard_map_unchecked
+
+    return shard_map_unchecked(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
