@@ -18,7 +18,10 @@ from typing import Optional
 
 from tf_operator_tpu.backend.base import ClusterBackend
 from tf_operator_tpu.backend.jobstore import JobStore
-from tf_operator_tpu.controller.expectations import Expectations
+from tf_operator_tpu.controller.expectations import (
+    EXPECTATION_TIMEOUT_S,
+    Expectations,
+)
 from tf_operator_tpu.controller.informer import InformerCache
 from tf_operator_tpu.controller.reconciler import Reconciler, ReconcilerConfig
 from tf_operator_tpu.controller.workqueue import WorkQueue
@@ -37,6 +40,7 @@ class TPUJobController:
         max_sync_retries: int = 20,
         use_native: Optional[bool] = None,
         resync_period: float = 30.0,
+        expectations_timeout: float = EXPECTATION_TIMEOUT_S,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -52,12 +56,12 @@ class TPUJobController:
             from tf_operator_tpu.native import NativeExpectations, NativeWorkQueue
 
             self.queue = NativeWorkQueue()
-            self.pod_exp = NativeExpectations()
-            self.svc_exp = NativeExpectations()
+            self.pod_exp = NativeExpectations(expectations_timeout)
+            self.svc_exp = NativeExpectations(expectations_timeout)
         else:
             self.queue = WorkQueue()
-            self.pod_exp = Expectations()
-            self.svc_exp = Expectations()
+            self.pod_exp = Expectations(expectations_timeout)
+            self.svc_exp = Expectations(expectations_timeout)
         self.recorder = EventRecorder()
         self.metrics = metrics or default_metrics
         if config is None:
