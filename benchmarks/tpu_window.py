@@ -1,0 +1,130 @@
+"""One-shot TPU measurement window: run everything the round needs the
+chip for, in priority order, each step in a child process with a
+timeout so one hang can't burn the window.
+
+    python benchmarks/tpu_window.py [--log benchmarks/tpu_window.log]
+
+Steps (priority order; later steps only run if earlier ones prove the
+chip is answering):
+  1. probe      — 512x512 matmul (is the tunnel back at all?)
+  2. bench      — bench.py headline (incl. the live input pipeline)
+  3. sweep      — the MFU variant x flag matrix (mfu_sweep.py)
+  4. trace      — xplane trace of the hot step + top-op summary
+  5. flash      — the fwd+bwd flash-vs-XLA perf gate (records ratio)
+  6. train      — measure.py --section train (mnist/BERT rows)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+PROBE = (
+    "import jax, jax.numpy as jnp; "
+    "x = jnp.ones((512,512), jnp.bfloat16); "
+    "print('probe ok', float((x@x).sum()))"
+)
+
+STEPS = [
+    ("probe", [sys.executable, "-c", PROBE], 120),
+    ("bench", [sys.executable, os.path.join(REPO, "bench.py")], 3600),
+    (
+        "sweep",
+        [sys.executable, os.path.join(HERE, "mfu_sweep.py"), "--timeout", "600"],
+        4200,
+    ),
+    (
+        "trace",
+        [
+            sys.executable, os.path.join(HERE, "profile_resnet.py"),
+            "--variant", "baseline", "--batch", "256", "--steps", "5",
+            "--trace", "/tmp/rn50-xplane",
+        ],
+        900,
+    ),
+    (
+        "flash",
+        [
+            sys.executable, "-m", "pytest",
+            "tests/test_tpu_chip.py::TestFlashKernelOnChip::test_flash_beats_xla_at_long_seq",
+            "-q", "-s",
+        ],
+        900,
+    ),
+    (
+        "train",
+        [sys.executable, os.path.join(HERE, "measure.py"), "--section", "train"],
+        1800,
+    ),
+]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log", default=os.path.join(HERE, "tpu_window.log"))
+    args = ap.parse_args()
+
+    env = dict(os.environ)
+    env["RUN_TPU_TESTS"] = "1"
+    with open(args.log, "a") as log:
+        def emit(msg):
+            line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+            print(line, flush=True)
+            log.write(line + "\n")
+            log.flush()
+
+        def tail_lines(text, n, prefix):
+            for line in (text or "").strip().splitlines()[-n:]:
+                emit(f"   {prefix}{line}")
+
+        def reprobe() -> bool:
+            try:
+                p = subprocess.run(
+                    [sys.executable, "-c", PROBE], env=env, cwd=REPO,
+                    capture_output=True, text=True, timeout=120,
+                )
+                return p.returncode == 0
+            except subprocess.TimeoutExpired:
+                return False
+
+        emit("== tpu window start ==")
+        for name, cmd, timeout in STEPS:
+            emit(f"-- {name}: {' '.join(os.path.basename(c) for c in cmd[:3])} ...")
+            t0 = time.time()
+            try:
+                proc = subprocess.run(
+                    cmd, env=env, cwd=REPO, capture_output=True, text=True,
+                    timeout=timeout,
+                )
+            except subprocess.TimeoutExpired as exc:
+                emit(f"   {name}: TIMEOUT >{timeout}s")
+                # postmortem: keep whatever the step printed before dying
+                out = exc.stdout
+                tail_lines(
+                    out.decode(errors="replace") if isinstance(out, bytes) else out,
+                    20, "",
+                )
+                if name == "probe" or not reprobe():
+                    emit("   chip not answering; aborting window")
+                    return 1
+                continue
+            dt = time.time() - t0
+            tail_lines(proc.stdout, 12, "")
+            if proc.returncode != 0:
+                tail_lines(proc.stderr, 12, "stderr: ")
+            emit(f"   {name}: rc={proc.returncode} in {dt:.0f}s")
+            if name == "probe" and proc.returncode != 0:
+                emit("   chip not answering; aborting window")
+                return 1
+        emit("== tpu window complete ==")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

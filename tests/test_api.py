@@ -4,6 +4,7 @@ Mirrors the reference's colocated API unit tests (SURVEY.md §4 tier 1).
 """
 
 import pytest
+from hypothesis import given, settings, strategies as st
 
 from tf_operator_tpu.api.defaults import (
     DEFAULT_CLEAN_POD_POLICY,
@@ -290,3 +291,85 @@ class TestHostsPerReplicaValidation:
         job = new_job(tpu_slice=1, tpu_topology="v5e-16")
         job.spec.replica_specs[ReplicaType.TPU_SLICE].hosts_per_replica = 2
         validate(job)
+
+
+class TestSerdeRoundTripProperty:
+    """Manifest serde must be lossless for every representable job:
+    job -> dict -> job -> dict fixes to the same dict (the CRD
+    round-trip contract the reference gets from codegen)."""
+
+    @settings(max_examples=150, deadline=None)
+    @given(data=st.data())
+    def test_round_trip_fixpoint(self, data):
+        from tests.testutil import new_job
+        from tf_operator_tpu.api.serde import job_from_dict, job_to_dict
+        from tf_operator_tpu.api.types import (
+            CleanPodPolicy,
+            RestartPolicy,
+            SuccessPolicy,
+        )
+
+        name = data.draw(
+            st.from_regex(r"[a-z]([a-z0-9-]{0,10}[a-z0-9])?", fullmatch=True),
+            label="name",
+        )
+        counts = {
+            "chief": data.draw(st.integers(0, 1), label="chief"),
+            "ps": data.draw(st.integers(0, 3), label="ps"),
+            "worker": data.draw(st.integers(0, 4), label="worker"),
+            "tpu_slice": data.draw(st.integers(0, 2), label="slice"),
+        }
+        if not any(counts.values()):
+            counts["worker"] = 1
+        job = new_job(
+            name,
+            chief=counts["chief"],
+            ps=counts["ps"],
+            worker=counts["worker"],
+            tpu_slice=counts["tpu_slice"],
+            tpu_topology="v5e-8" if counts["tpu_slice"] else "",
+        )
+        job.spec.success_policy = data.draw(
+            st.sampled_from(list(SuccessPolicy)), label="succ"
+        )
+        job.spec.run_policy.clean_pod_policy = data.draw(
+            st.one_of(st.none(), st.sampled_from(list(CleanPodPolicy))), label="cpp"
+        )
+        job.spec.run_policy.backoff_limit = data.draw(
+            st.one_of(st.none(), st.integers(0, 10)), label="backoff"
+        )
+        job.spec.run_policy.ttl_seconds_after_finished = data.draw(
+            st.one_of(st.none(), st.integers(0, 3600)), label="ttl"
+        )
+        job.spec.enable_gang_scheduling = data.draw(st.booleans(), label="gang")
+        for spec in job.spec.replica_specs.values():
+            spec.restart_policy = data.draw(
+                st.sampled_from(list(RestartPolicy)), label="rp"
+            )
+            c = spec.template.containers[0]
+            c.env = data.draw(
+                st.dictionaries(
+                    st.from_regex(r"[A-Z][A-Z0-9_]{0,8}", fullmatch=True),
+                    st.text(
+                        alphabet=st.characters(
+                            min_codepoint=32, max_codepoint=126
+                        ),
+                        max_size=12,
+                    ),
+                    max_size=3,
+                ),
+                label="env",
+            )
+        job.metadata.annotations = data.draw(
+            st.dictionaries(
+                st.from_regex(r"[a-z][a-z./-]{0,16}", fullmatch=True),
+                st.text(max_size=10),
+                max_size=2,
+            ),
+            label="ann",
+        )
+
+        d1 = job_to_dict(job)
+        job2 = job_from_dict(d1)
+        d2 = job_to_dict(job2)
+        assert d1 == d2

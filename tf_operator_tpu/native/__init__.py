@@ -17,6 +17,7 @@ import ctypes
 import os
 from typing import Optional, Tuple
 
+from tf_operator_tpu.controller.expectations import EXPECTATION_TIMEOUT_S
 from tf_operator_tpu.native import build as _build
 
 _lib: Optional[ctypes.CDLL] = None
@@ -184,7 +185,7 @@ class NativeWorkQueue:
 class NativeExpectations:
     """Drop-in twin of controller.expectations.Expectations backed by C++."""
 
-    def __init__(self, timeout_s: float = 300.0):
+    def __init__(self, timeout_s: float = EXPECTATION_TIMEOUT_S):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native runtime unavailable: {_load_error}")

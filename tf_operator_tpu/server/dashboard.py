@@ -37,6 +37,7 @@ DASHBOARD_HTML = """<!doctype html>
   <tbody></tbody>
 </table>
 <h2 id="detail-title" style="display:none"></h2>
+<div id="spark" style="display:none"></div>
 <div id="detail" style="display:none"></div>
 <script>
 let selected = null;
@@ -97,6 +98,7 @@ async function detail() {
     selected = null;
     document.getElementById("detail-title").style.display = "none";
     document.getElementById("detail").style.display = "none";
+    document.getElementById("spark").style.display = "none";
     return;
   }
   const job = await jobRes.json();
@@ -125,10 +127,42 @@ async function detail() {
       text += `  step ${String(m.step).padEnd(8)} ${rest}\\n`;
     }
   }
+  drawSpark(series);
   document.getElementById("detail-title").textContent = selected;
   document.getElementById("detail-title").style.display = "";
   const el = document.getElementById("detail");
   el.style.display = ""; el.textContent = text;
+}
+
+function drawSpark(series) {
+  const el = document.getElementById("spark");
+  const pts = series.filter(m => typeof m.loss === "number");
+  if (pts.length < 2) { el.style.display = "none"; return; }
+  const w = 420, h = 64, pad = 4;
+  const losses = pts.map(m => m.loss);
+  const lo = Math.min(...losses), hi = Math.max(...losses);
+  const span = hi - lo || 1;
+  const xy = losses.map((v, i) => {
+    const x = pad + (w - 2 * pad) * i / (losses.length - 1);
+    const y = pad + (h - 2 * pad) * (1 - (v - lo) / span);
+    return `${x.toFixed(1)},${y.toFixed(1)}`;
+  }).join(" ");
+  el.style.display = "";
+  el.innerHTML = "";
+  const svg = document.createElementNS("http://www.w3.org/2000/svg", "svg");
+  svg.setAttribute("width", w); svg.setAttribute("height", h);
+  const line = document.createElementNS("http://www.w3.org/2000/svg", "polyline");
+  line.setAttribute("points", xy);
+  line.setAttribute("fill", "none");
+  line.setAttribute("stroke", "#0b57d0");
+  line.setAttribute("stroke-width", "1.5");
+  svg.appendChild(line);
+  const label = document.createElement("div");
+  label.className = "muted";
+  label.textContent =
+    `loss ${losses[0].toFixed(4)} → ${losses[losses.length-1].toFixed(4)} ` +
+    `(${pts.length} points)`;
+  el.appendChild(svg); el.appendChild(label);
 }
 
 refresh();
