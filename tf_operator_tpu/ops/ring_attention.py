@@ -17,6 +17,18 @@ is the standard ring schedule (Liu et al.-style, re-derived here):
 
 Communication pattern: n-1 ppermute hops of the K/V block, overlapping
 with compute under XLA's async collectives.
+
+flash x sp: with ``use_flash`` (auto on the TPU backend when shapes
+tile), each block is computed by the pallas flash kernel
+(ops/flash_attention.py, with_lse=True) and block results merge by
+logsumexp — so the forward never materialises a score matrix even per
+block, and causally-masked blocks skip their FLOPs entirely via
+lax.cond.  The backward recomputes through the XLA ring graph (same
+exact-attention math; per-block score matrices DO exist there, so
+training memory matches the plain ring path).  Verified block-exact
+against full attention, forward and grads, in interpret mode — real
+multi-chip sp validation awaits multi-chip hardware (this box has one
+chip).
 """
 
 from __future__ import annotations
@@ -65,6 +77,142 @@ def _ring_block(
     return m_new, l, o
 
 
+def _ring_attention_local_flash(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jax.Array:
+    """Ring schedule with the pallas flash kernel computing each block.
+
+    flash x sp — the long-context composition: within a shard each
+    K/V block is consumed by the flash forward (with_lse=True), and the
+    normalised block results merge by logsumexp:
+
+        lse' = logaddexp(lse, blk_lse)
+        out' = out * e^(lse - lse') + blk_out * e^(blk_lse - lse')
+
+    Causality by block position: the diagonal block (hop 0, own shard)
+    runs the kernel's causal path; earlier-sequence blocks run full
+    (non-causal) attention; later-sequence blocks are skipped entirely
+    via lax.cond — unlike the XLA ring path, masked blocks cost no
+    FLOPs here.
+    """
+
+    from tf_operator_tpu.ops.flash_attention import _flash_forward
+
+    my = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    flash = functools.partial(
+        _flash_forward,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+        with_lse=True,
+    )
+
+    # hop 0: the local (diagonal) block — causal iff the caller is.
+    # The kernel emits lse lane-broadcast [..., LANES]; one lane is the
+    # truth, so the carry keeps [..., :1] (128x less state per hop)
+    out0, lse0 = flash(q, k, v, causal=causal)
+    o = out0.astype(jnp.float32)
+    lse = lse0[..., :1]
+
+    def merge(o, lse, blk_out, blk_lse):
+        new_lse = jnp.logaddexp(lse, blk_lse)
+        w_old = jnp.exp(lse - new_lse)
+        w_new = jnp.exp(blk_lse - new_lse)
+        return o * w_old + blk_out.astype(jnp.float32) * w_new, new_lse
+
+    def body(carry, i):
+        k_blk, v_blk, o, lse = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        # after i+1 permutes this device holds the block that started
+        # (my - (i+1)) shards back
+        src = (my - (i + 1)) % axis_size
+
+        def visible(operands):
+            qq, kk, vv = operands
+            bo, bl = flash(qq, kk, vv, causal=False)
+            return bo.astype(jnp.float32), bl[..., :1]
+
+        def masked(operands):
+            return (
+                jnp.zeros(q.shape, jnp.float32),
+                jnp.full(lse.shape, _NEG, jnp.float32),
+            )
+
+        if causal:
+            bo, bl = lax.cond(src < my, visible, masked, (q, k_blk, v_blk))
+        else:
+            bo, bl = visible((q, k_blk, v_blk))
+        o, lse = merge(o, lse, bo, bl)
+        return (k_blk, v_blk, o, lse), None
+
+    (k, v, o, lse), _ = lax.scan(body, (k, v, o, lse), jnp.arange(axis_size - 1))
+    return o.astype(q.dtype)
+
+
+def _make_flash_ring_local(
+    axis_name: str,
+    axis_size: int,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+):
+    """The flash-ring local fn with a training-complete VJP.
+
+    Forward: flash kernels per block (no [Sq,Sk] matrix, masked blocks
+    skipped).  Backward: recomputes the gradient through the XLA ring
+    path — the same exact-attention math, so gradients agree with full
+    attention to f32 rounding (tested), though not bit-identically with
+    the pallas forward's rounding order.  NOTE the backward therefore
+    materialises per-block [S/n, S/n] score matrices: training memory
+    matches the plain ring path; the flash win in this composition is
+    forward speed + skipped masked blocks.  A pallas ring-backward is
+    the future optimisation.
+    """
+
+    flash_impl = functools.partial(
+        _ring_attention_local_flash,
+        axis_name=axis_name,
+        axis_size=axis_size,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    xla_impl = functools.partial(
+        _ring_attention_local,
+        axis_name=axis_name,
+        axis_size=axis_size,
+        causal=causal,
+    )
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        return flash_impl(q, k, v)
+
+    def fwd(q, k, v):
+        return flash_impl(q, k, v), (q, k, v)
+
+    def bwd(residuals, g):
+        q, k, v = residuals
+        _, vjp = jax.vjp(xla_impl, q, k, v)
+        return vjp(g)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
 def _ring_attention_local(
     q: jax.Array,
     k: jax.Array,
@@ -108,6 +256,18 @@ def _ring_attention_local(
     return out.astype(q.dtype)
 
 
+def _flash_ring_applicable(
+    q: jax.Array, axis_size: int, block_q: int, block_k: int
+) -> bool:
+    """Per-shard shapes must tile the flash kernel's blocks."""
+
+    s, d = q.shape[-2], q.shape[-1]
+    if s % axis_size:
+        return False
+    local = s // axis_size
+    return local % block_q == 0 and local % block_k == 0 and d % 8 == 0
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -118,24 +278,55 @@ def ring_attention(
     axis_name: str = "sp",
     batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
     heads_axis: Optional[str] = "tp",
+    use_flash: Optional[bool] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
 ) -> jax.Array:
     """Exact attention with sequence sharded over `axis_name`.
 
     q,k,v: GLOBAL [B, H, S, D] arrays (jit-traced values are fine —
     shard_map re-shards per the specs).  When the sp axis is 1 this
     degrades to plain fused attention with identical semantics.
+
+    ``use_flash``: compute each ring block with the pallas flash kernel
+    (flash x sp).  None = auto: on the TPU backend when the per-shard
+    shapes tile the kernel blocks (TPU_OPERATOR_FLASH=0 disables).
     """
 
     if mesh.shape[axis_name] <= 1:
         return dot_product_attention(q, k, v, causal=causal)
 
+    n = mesh.shape[axis_name]
+    if use_flash is None:
+        import os
+
+        # same knob semantics as flash_attention's dispatcher: only an
+        # explicit "0" disables
+        use_flash = (
+            os.environ.get("TPU_OPERATOR_FLASH", "1") != "0"
+            and jax.default_backend() == "tpu"
+            and _flash_ring_applicable(q, n, block_q, block_k)
+        )
+    elif use_flash and not _flash_ring_applicable(q, n, block_q, block_k):
+        raise ValueError(
+            f"use_flash=True but per-shard shapes don't tile the kernel: "
+            f"seq {q.shape[-2]} over {n} shards with blocks "
+            f"({block_q},{block_k})"
+        )
+
     spec = P(batch_axes, heads_axis, axis_name, None)
-    local = functools.partial(
-        _ring_attention_local,
-        axis_name=axis_name,
-        axis_size=mesh.shape[axis_name],
-        causal=causal,
-    )
+    if use_flash:
+        local = _make_flash_ring_local(
+            axis_name, n, causal, block_q, block_k, interpret
+        )
+    else:
+        local = functools.partial(
+            _ring_attention_local,
+            axis_name=axis_name,
+            axis_size=n,
+            causal=causal,
+        )
     from tf_operator_tpu.utils.jax_compat import shard_map_unchecked
 
     return shard_map_unchecked(
