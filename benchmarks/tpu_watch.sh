@@ -1,0 +1,18 @@
+#!/bin/bash
+# Probe the TPU tunnel every 10 min; the moment it answers, run the
+# one-shot measurement window (benchmarks/tpu_window.py) and exit.
+# Launch detached:  nohup bash benchmarks/tpu_watch.sh &> benchmarks/tpu_watch.log &
+cd "$(dirname "$0")/.." || exit 1
+while true; do
+  echo "[$(date +%H:%M:%S)] probing tpu..."
+  # PROBE is shared with tpu_window.py so the two can't drift
+  if timeout 120 python -c "import runpy; exec(runpy.run_path('benchmarks/tpu_window.py')['PROBE'])"; then
+    echo "[$(date +%H:%M:%S)] TPU IS BACK — starting measurement window"
+    python benchmarks/tpu_window.py
+    rc=$?
+    echo "[$(date +%H:%M:%S)] window done rc=$rc"
+    exit 0
+  fi
+  echo "[$(date +%H:%M:%S)] tunnel still down; sleeping 600s"
+  sleep 600
+done

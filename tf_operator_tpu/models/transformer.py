@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from tf_operator_tpu.ops import attention, ring_attention
+from tf_operator_tpu.ops import attention, ring_attention, ulysses_attention
 
 param_with_axes = nn.with_logical_partitioning
 logical_constraint = nn.with_logical_constraint
@@ -41,9 +41,18 @@ class TransformerConfig:
     max_len: int = 512
     dropout: float = 0.1
     dtype: Any = jnp.bfloat16
-    # sequence parallelism: mesh to run ring attention over (None or
-    # sp=1 → plain fused attention)
+    # sequence parallelism: mesh to run sharded attention over (None or
+    # sp=1 → plain fused attention); sp_impl picks the schedule —
+    # "ring" (ppermute K/V hops, S scales unbounded) or "ulysses"
+    # (all-to-all head re-shard; needs heads-per-shard % sp == 0)
     mesh: Optional[Mesh] = None
+    sp_impl: str = "ring"
+
+    def __post_init__(self):
+        if self.sp_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_impl must be 'ring' or 'ulysses', got {self.sp_impl!r}"
+            )
 
     @property
     def sp_enabled(self) -> bool:
@@ -106,8 +115,9 @@ class Embed(nn.Module):
 
 
 class MultiHeadAttention(nn.Module):
-    """Self- or cross-attention; ring attention when the config's mesh
-    has sp > 1 (self-attention only — KV rotate around the ring)."""
+    """Self- or cross-attention; sequence-parallel attention (ring or
+    ulysses per cfg.sp_impl) when the config's mesh has sp > 1
+    (self-attention only)."""
 
     cfg: TransformerConfig
     causal: bool = False
@@ -126,9 +136,10 @@ class MultiHeadAttention(nn.Module):
         q, k, v = (
             logical_constraint(a, ("batch", "act_heads", "seq", "act_kv")) for a in (q, k, v)
         )
-        use_ring = cfg.sp_enabled and is_self and bias is None and mask is None
-        if use_ring:
-            out = ring_attention(q, k, v, cfg.mesh, causal=self.causal)
+        use_sp = cfg.sp_enabled and is_self and bias is None and mask is None
+        if use_sp:
+            sp_attn = ulysses_attention if cfg.sp_impl == "ulysses" else ring_attention
+            out = sp_attn(q, k, v, cfg.mesh, causal=self.causal)
         else:
             # dispatcher: pallas flash kernel on TPU when it applies,
             # XLA-fused reference otherwise; the mesh routes multi-device

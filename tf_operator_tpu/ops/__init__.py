@@ -10,10 +10,12 @@ the XLA-fused reference both fall back to.
 from tf_operator_tpu.ops.attention import dot_product_attention
 from tf_operator_tpu.ops.flash_attention import attention, flash_attention
 from tf_operator_tpu.ops.ring_attention import ring_attention
+from tf_operator_tpu.ops.ulysses_attention import ulysses_attention
 
 __all__ = [
     "attention",
     "dot_product_attention",
     "flash_attention",
     "ring_attention",
+    "ulysses_attention",
 ]

@@ -346,6 +346,22 @@ def flash_attention(
     return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
 
 
+def resolve_use_flash(use_flash, applicable: bool, why_not: str) -> bool:
+    """Shared use_flash knob semantics for the sp attention schedules
+    (ring/ulysses): None = auto (on the TPU backend, when the shapes
+    tile, unless TPU_OPERATOR_FLASH=0); True validates applicability."""
+
+    if use_flash is None:
+        return (
+            os.environ.get("TPU_OPERATOR_FLASH", "1") != "0"
+            and jax.default_backend() == "tpu"
+            and applicable
+        )
+    if use_flash and not applicable:
+        raise ValueError(why_not)
+    return use_flash
+
+
 def _use_pallas_bwd() -> bool:
     # escape hatch back to the XLA-recompute VJP
     return os.environ.get("TPU_OPERATOR_FLASH_BWD", "1") != "0"

@@ -298,22 +298,15 @@ def ring_attention(
         return dot_product_attention(q, k, v, causal=causal)
 
     n = mesh.shape[axis_name]
-    if use_flash is None:
-        import os
+    from tf_operator_tpu.ops.flash_attention import resolve_use_flash
 
-        # same knob semantics as flash_attention's dispatcher: only an
-        # explicit "0" disables
-        use_flash = (
-            os.environ.get("TPU_OPERATOR_FLASH", "1") != "0"
-            and jax.default_backend() == "tpu"
-            and _flash_ring_applicable(q, n, block_q, block_k)
-        )
-    elif use_flash and not _flash_ring_applicable(q, n, block_q, block_k):
-        raise ValueError(
-            f"use_flash=True but per-shard shapes don't tile the kernel: "
-            f"seq {q.shape[-2]} over {n} shards with blocks "
-            f"({block_q},{block_k})"
-        )
+    use_flash = resolve_use_flash(
+        use_flash,
+        _flash_ring_applicable(q, n, block_q, block_k),
+        f"use_flash=True but per-shard shapes don't tile the kernel: "
+        f"seq {q.shape[-2]} over {n} shards with blocks "
+        f"({block_q},{block_k})",
+    )
 
     spec = P(batch_axes, heads_axis, axis_name, None)
     if use_flash:

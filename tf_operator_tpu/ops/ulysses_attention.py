@@ -1,0 +1,144 @@
+"""Ulysses attention: all-to-all sequence parallelism over the `sp` axis.
+
+The second of the two standard long-context schedules (the reference has
+no sequence-length story at all — SURVEY.md §2b calls it absent; ring
+attention in ops/ring_attention.py is the first).  Where the ring keeps
+the sequence sharded and rotates K/V blocks around the ICI ring, the
+Ulysses schedule (DeepSpeed-Ulysses-style, re-derived here) re-shards
+*heads* instead:
+
+- Each sp shard holds Q/K/V for its contiguous sequence chunk, all
+  (local) heads: ``[B, H, S/n, D]``.
+- One ``lax.all_to_all`` per tensor switches the sharded dim from
+  sequence to heads: every device ends up with the FULL sequence for
+  ``H/n`` of the heads — attention is then embarrassingly parallel per
+  head and runs locally (pallas flash kernel when shapes tile), with
+  exact causal masking for free since the whole sequence is resident.
+- One all-to-all on the output switches back to sequence sharding.
+
+Trade-off vs the ring (why the framework ships both): Ulysses moves
+4 fixed all-to-alls of O(B·H·S·D/n) per device regardless of the ring
+size, while the ring pays n-1 neighbour hops of the K/V shard; Ulysses
+wins when the interconnect does fast all-to-all (ICI within a slice)
+and H ≥ n, but caps the sp degree at the head count and holds full-S
+score rows per head, whereas the ring scales S without bound at O(S/n)
+memory.  Gradients flow through plain autodiff: all_to_all is linear
+(its transpose is the reverse all-to-all) and the local attention is
+either the XLA reference or the pallas kernel with its custom VJP.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tf_operator_tpu.ops.attention import dot_product_attention
+from tf_operator_tpu.ops.flash_attention import flash_attention, resolve_use_flash
+
+
+def _ulysses_local(
+    q: jax.Array,  # [B, Hl, Sl, D] — local heads, local seq chunk
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool,
+    use_flash: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jax.Array:
+    """Runs inside shard_map.  heads→seq re-shard, local attention,
+    seq→heads re-shard back."""
+
+    a2a = functools.partial(lax.all_to_all, axis_name=axis_name, tiled=True)
+    # [B, Hl, Sl, D] -> [B, Hl/n, S, D]: give away head groups, collect
+    # the full sequence for the heads we keep
+    q, k, v = (a2a(t, split_axis=1, concat_axis=2) for t in (q, k, v))
+    if use_flash:
+        o = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    else:
+        o = dot_product_attention(q, k, v, causal=causal)
+    # [B, Hl/n, S, D] -> [B, Hl, Sl, D]
+    return a2a(o, split_axis=2, concat_axis=1)
+
+
+def _ulysses_applicable(heads_local: int, axis_size: int) -> bool:
+    """The head dim per shard must split across the sp axis (at least
+    one head per device — heads_local 0 means tp already over-shards)."""
+
+    return heads_local >= axis_size and heads_local % axis_size == 0
+
+
+def _flash_local_applicable(q: jax.Array, block_q: int, block_k: int) -> bool:
+    # post-all-to-all the local view is the FULL sequence
+    s, d = q.shape[-2], q.shape[-1]
+    return s % block_q == 0 and s % block_k == 0 and d % 8 == 0
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    axis_name: str = "sp",
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+    heads_axis: Optional[str] = "tp",
+    use_flash: Optional[bool] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Exact attention with sequence sharded over `axis_name`, computed
+    by the all-to-all (Ulysses) schedule.  Drop-in for `ring_attention`
+    — same signature, same global [B, H, S, D] contract, same result.
+
+    Constraint the ring does not have: the per-device head count
+    (H / mesh[heads_axis]) must be divisible by mesh[axis_name].
+
+    ``use_flash``: compute the local full-sequence attention with the
+    pallas flash kernel.  None = auto: on the TPU backend when the
+    full-sequence shapes tile the kernel blocks (TPU_OPERATOR_FLASH=0
+    disables).
+    """
+
+    if mesh.shape[axis_name] <= 1:
+        return dot_product_attention(q, k, v, causal=causal)
+
+    n = mesh.shape[axis_name]
+    heads_local = q.shape[1] // (mesh.shape.get(heads_axis, 1) if heads_axis else 1)
+    if not _ulysses_applicable(heads_local, n):
+        raise ValueError(
+            f"ulysses_attention needs heads-per-shard divisible by the sp "
+            f"axis: {heads_local} local heads over sp={n}; use "
+            f"ring_attention for head counts that don't split"
+        )
+
+    use_flash = resolve_use_flash(
+        use_flash,
+        _flash_local_applicable(q, block_q, block_k),
+        f"use_flash=True but the full sequence {q.shape[-2]} / head dim "
+        f"{q.shape[-1]} don't tile the kernel blocks ({block_q},{block_k})",
+    )
+
+    spec = P(batch_axes, heads_axis, axis_name, None)
+    local = functools.partial(
+        _ulysses_local,
+        axis_name=axis_name,
+        causal=causal,
+        use_flash=use_flash,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    from tf_operator_tpu.utils.jax_compat import shard_map_unchecked
+
+    return shard_map_unchecked(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
