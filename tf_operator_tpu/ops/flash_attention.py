@@ -270,14 +270,36 @@ def _flash_backward(
     q, k, v, out, lse, g, causal: bool, block_q: int, block_k: int, interpret: bool
 ):
     b, h, sq, d = q.shape
-    sk = k.shape[2]
-    scale = 1.0 / (d**0.5)
     # lane-broadcast the [B,H,Sq] row stats for the kernels (transient —
     # freed when the two pallas calls complete)
     lse = jnp.broadcast_to(lse[..., None], (b, h, sq, _LANES))
     # delta_i = rowsum(dO_i * O_i)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (b, h, sq, _LANES))
+    return _flash_backward_blocks(
+        q, k, v, g, lse, delta, causal, block_q, block_k, interpret
+    )
+
+
+def _flash_backward_blocks(
+    q, k, v, g, lse, delta, causal: bool, block_q: int, block_k: int, interpret: bool,
+    grad_dtype=None,
+):
+    """dq/dk/dv kernels against precomputed lane-broadcast row stats
+    (lse, delta = rowsum(dO*O), both [B,H,Sq,LANES]).  Split out from
+    `_flash_backward` so the ring backward can reuse the kernels with
+    the GLOBAL row stats while feeding per-hop K/V blocks.
+
+    grad_dtype: output dtype for the partials (default: input dtypes).
+    The ring backward passes float32 so per-hop partials aren't
+    quantized to bf16 before its cross-hop accumulation."""
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / (d**0.5)
+    dq_dt = grad_dtype or q.dtype
+    dk_dt = grad_dtype or k.dtype
+    dv_dt = grad_dtype or v.dtype
 
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ji: (bi, hi, ji, 0))
@@ -286,7 +308,7 @@ def _flash_backward(
     )
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, dq_dt),
         grid=(b, h, sq // block_q, sk // block_k),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
@@ -304,8 +326,8 @@ def _flash_backward(
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal),
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct(k.shape, dk_dt),
+            jax.ShapeDtypeStruct(v.shape, dv_dt),
         ],
         grid=(b, h, sk // block_k, sq // block_q),
         in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t, row_spec_t],

@@ -23,12 +23,13 @@ tile), each block is computed by the pallas flash kernel
 (ops/flash_attention.py, with_lse=True) and block results merge by
 logsumexp — so the forward never materialises a score matrix even per
 block, and causally-masked blocks skip their FLOPs entirely via
-lax.cond.  The backward recomputes through the XLA ring graph (same
-exact-attention math; per-block score matrices DO exist there, so
-training memory matches the plain ring path).  Verified block-exact
-against full attention, forward and grads, in interpret mode — real
-multi-chip sp validation awaits multi-chip hardware (this box has one
-chip).
+lax.cond.  The backward is a pallas ring too (`_ring_flash_backward`):
+the dq/dkv kernels run per hop against the forward's GLOBAL lse, with
+dk/dv accumulators riding the ring back to their owners — training
+memory is O(S/n · block) end to end (TPU_OPERATOR_FLASH_BWD=0 falls
+back to XLA recompute).  Verified block-exact against full attention,
+forward and grads, in interpret mode — real multi-chip sp validation
+awaits multi-chip hardware (this box has one chip).
 """
 
 from __future__ import annotations
@@ -88,8 +89,11 @@ def _ring_attention_local_flash(
     block_q: int,
     block_k: int,
     interpret: bool,
-) -> jax.Array:
+    with_residuals: bool = False,
+):
     """Ring schedule with the pallas flash kernel computing each block.
+    Returns the output array; with_residuals=True returns (out, lse)
+    where lse is the global [B,H,Sq,1] f32 row logsumexp.
 
     flash x sp — the long-context composition: within a shard each
     K/V block is consumed by the flash forward (with_lse=True), and the
@@ -157,7 +161,100 @@ def _ring_attention_local_flash(
         return (k_blk, v_blk, o, lse), None
 
     (k, v, o, lse), _ = lax.scan(body, (k, v, o, lse), jnp.arange(axis_size - 1))
+    if with_residuals:
+        # lse here is the GLOBAL row logsumexp (merged over every hop),
+        # [B,H,Sq,1] f32 — exactly what the backward kernels need
+        return o.astype(q.dtype), lse
     return o.astype(q.dtype)
+
+
+def _ring_flash_backward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,  # [B,H,Sq,1] GLOBAL row logsumexp from the forward
+    g: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Ring backward with the pallas flash backward kernels per block.
+
+    The key identity: with the GLOBAL lse and delta = rowsum(dO·O), the
+    backward contribution of each (q-chunk, kv-block) pair is
+    independent — exactly what `_flash_backward_blocks` computes.  So
+    the schedule mirrors the forward ring: K/V blocks rotate via
+    ppermute, each hop runs the dq/dkv kernels for the visiting block
+    (O(block) memory — no [S/n, S/n] score matrix ever exists), dq
+    accumulates locally, and dk/dv accumulators TRAVEL with their block
+    around the ring, arriving home after the final hop.  Causally
+    masked hops (src > my) skip their kernels entirely via lax.cond.
+    """
+
+    from tf_operator_tpu.ops.flash_attention import _LANES, _flash_backward_blocks
+
+    my = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    b, h, sq, d = q.shape
+    lse_b = jnp.broadcast_to(lse, (b, h, sq, _LANES))
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )
+    delta_b = jnp.broadcast_to(delta, (b, h, sq, _LANES))
+    blocks = functools.partial(
+        _flash_backward_blocks,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+        # partials come out f32 so bf16 inputs aren't re-quantized per
+        # hop — one rounding at the very end, like the single-chip path
+        grad_dtype=jnp.float32,
+    )
+
+    # hop 0: the local (diagonal) block — causal iff the caller is
+    dq, dk, dv = blocks(q, k, v, g, lse_b, delta_b, causal=causal)
+
+    def body(carry, i):
+        k_blk, v_blk, dk_blk, dv_blk, dq = carry
+        # the gradient accumulators rotate WITH their block
+        k_blk, v_blk, dk_blk, dv_blk = (
+            lax.ppermute(t, axis_name, perm) for t in (k_blk, v_blk, dk_blk, dv_blk)
+        )
+        src = (my - (i + 1)) % axis_size
+
+        def visible(operands):
+            kk, vv = operands
+            return blocks(q, kk, vv, g, lse_b, delta_b, causal=False)
+
+        def masked(operands):
+            return (
+                jnp.zeros(q.shape, jnp.float32),
+                jnp.zeros(k.shape, jnp.float32),
+                jnp.zeros(v.shape, jnp.float32),
+            )
+
+        if causal:
+            dqi, dki, dvi = lax.cond(src < my, visible, masked, (k_blk, v_blk))
+        else:
+            dqi, dki, dvi = visible((k_blk, v_blk))
+        dq = dq + dqi
+        dk_blk = dk_blk + dki
+        dv_blk = dv_blk + dvi
+        return (k_blk, v_blk, dk_blk, dv_blk, dq), None
+
+    (k_blk, v_blk, dk, dv, dq), _ = lax.scan(
+        body, (k, v, dk, dv, dq), jnp.arange(axis_size - 1)
+    )
+    # after n-1 hops each accumulator sits one hop short of its owner —
+    # one final forward hop brings dk/dv home
+    dk = lax.ppermute(dk, axis_name, perm)
+    dv = lax.ppermute(dv, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def _make_flash_ring_local(
@@ -171,15 +268,16 @@ def _make_flash_ring_local(
     """The flash-ring local fn with a training-complete VJP.
 
     Forward: flash kernels per block (no [Sq,Sk] matrix, masked blocks
-    skipped).  Backward: recomputes the gradient through the XLA ring
-    path — the same exact-attention math, so gradients agree with full
-    attention to f32 rounding (tested), though not bit-identically with
-    the pallas forward's rounding order.  NOTE the backward therefore
-    materialises per-block [S/n, S/n] score matrices: training memory
-    matches the plain ring path; the flash win in this composition is
-    forward speed + skipped masked blocks.  A pallas ring-backward is
-    the future optimisation.
+    skipped), saving (out, global lse) as residuals.  Backward: the
+    pallas ring backward (`_ring_flash_backward`) — flash dq/dkv
+    kernels per hop with gradient accumulators riding the ring, so
+    training memory is O(S/n · block) end to end.  TPU_OPERATOR_FLASH_BWD=0
+    falls back to recomputing the gradient through the XLA ring graph
+    (same exact-attention math, materialises per-block score matrices)
+    — the same escape hatch the single-chip kernel honours.
     """
+
+    from tf_operator_tpu.ops.flash_attention import _use_pallas_bwd
 
     flash_impl = functools.partial(
         _ring_attention_local_flash,
@@ -196,15 +294,30 @@ def _make_flash_ring_local(
         axis_size=axis_size,
         causal=causal,
     )
+    pallas_bwd = _use_pallas_bwd()
 
     @jax.custom_vjp
     def f(q, k, v):
         return flash_impl(q, k, v)
 
     def fwd(q, k, v):
+        if pallas_bwd:
+            out, lse = flash_impl(q, k, v, with_residuals=True)
+            return out, (q, k, v, out, lse)
         return flash_impl(q, k, v), (q, k, v)
 
     def bwd(residuals, g):
+        if pallas_bwd:
+            q, k, v, out, lse = residuals
+            return _ring_flash_backward(
+                q, k, v, out, lse, g,
+                axis_name=axis_name,
+                axis_size=axis_size,
+                causal=causal,
+                block_q=block_q,
+                block_k=block_k,
+                interpret=interpret,
+            )
         q, k, v = residuals
         _, vjp = jax.vjp(xla_impl, q, k, v)
         return vjp(g)
