@@ -1,0 +1,156 @@
+"""Llama family: RoPE, GQA, SwiGLU — training + sp equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models import llama_tiny, llama_loss
+from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+
+VOCAB = 256
+
+
+def _ids(rng, b, s):
+    return rng.randint(0, VOCAB, size=(b, s)).astype(np.int32)
+
+
+def test_rope_reference():
+    """apply_rope matches a direct complex-multiplication reference."""
+
+    from tf_operator_tpu.ops.rotary import apply_rope
+
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.randn(1, 2, 8, 16), jnp.float32)
+    k = jnp.asarray(r.randn(1, 2, 8, 16), jnp.float32)
+    qr, kr = apply_rope(q, k)
+
+    # reference: view as complex pairs (x[:d/2] + i*x[d/2:]) and
+    # multiply by e^{i * pos * theta^{-2j/d}}
+    d, half = 16, 8
+    freq = 10000.0 ** (-np.arange(half) / half)
+    ang = np.arange(8)[:, None] * freq[None, :]
+    rotor = np.exp(1j * ang)  # [S, d/2]
+    qc = np.asarray(q[..., :half]) + 1j * np.asarray(q[..., half:])
+    qc = qc * rotor
+    expect = np.concatenate([qc.real, qc.imag], axis=-1)
+    np.testing.assert_allclose(np.asarray(qr), expect, atol=1e-5, rtol=1e-5)
+
+    # norms preserved (rotation), relative-position property: scores
+    # depend only on distance
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(qr), axis=-1),
+        np.linalg.norm(np.asarray(q), axis=-1),
+        rtol=1e-5,
+    )
+
+
+def test_rope_relative_position_invariance():
+    """q·k after RoPE depends only on the position *difference*."""
+
+    from tf_operator_tpu.ops.rotary import apply_rope
+
+    r = np.random.RandomState(1)
+    q = jnp.asarray(r.randn(1, 1, 1, 32), jnp.float32)
+    k = jnp.asarray(r.randn(1, 1, 1, 32), jnp.float32)
+
+    def score(pq, pk):
+        qq, _ = apply_rope(q, q, positions=jnp.array([pq]))
+        _, kk = apply_rope(k, k, positions=jnp.array([pk]))
+        return float(jnp.einsum("bhqd,bhkd->bhqk", qq, kk)[0, 0, 0, 0])
+
+    np.testing.assert_allclose(score(3, 1), score(10, 8), rtol=1e-4)
+    np.testing.assert_allclose(score(7, 7), score(0, 0), rtol=1e-4)
+
+
+def test_llama_gqa_param_shapes():
+    model = llama_tiny(vocab_size=VOCAB, n_kv_heads=2)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    attn = params["layer_0"]["self_attn"]
+    q_kernel = attn["query"]["kernel"]
+    k_kernel = attn["key"]["kernel"]
+    qv = getattr(q_kernel, "value", q_kernel)
+    kv_ = getattr(k_kernel, "value", k_kernel)
+    assert qv.shape == (128, 4, 32)  # n_heads
+    assert kv_.shape == (128, 2, 32)  # n_kv_heads
+    # no biases anywhere in the network (llama convention)
+    for proj in ("query", "key", "value", "out"):
+        assert "bias" not in attn[proj], proj
+    mlp = params["layer_0"]["mlp"]
+    assert set(mlp) == {"wi_gate", "wi_up", "wo"}  # swiglu
+
+
+def test_llama_training_step():
+    mesh = make_mesh({"dp": 8})
+    rng = np.random.RandomState(0)
+    ids = _ids(rng, 8, 32)
+    batch = {"input_ids": ids}
+    model = llama_tiny(vocab_size=VOCAB, max_len=32, mesh=mesh)
+    tr = Trainer(
+        model,
+        TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+        mesh,
+        llama_loss,
+        batch,
+        init_args=(ids,),
+        shardings="logical",
+    )
+    first = tr.train_step(tr.shard_batch(batch))
+    for _ in range(5):
+        last = tr.train_step(tr.shard_batch(batch))
+    assert float(last["loss"]) < float(first["loss"])
+
+
+@pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
+def test_llama_sp_matches_no_sp(sp_impl):
+    """RoPE + GQA must compose exactly with both sp schedules."""
+
+    rng = np.random.RandomState(2)
+    ids = _ids(rng, 8, 32)
+    batch = {"input_ids": ids}
+    losses = {}
+    for label, shape in [("nosp", {"dp": 8}), ("sp", {"dp": 2, "sp": 4})]:
+        mesh = make_mesh(shape)
+        # ulysses needs heads_local % sp == 0 -> 4 heads over sp=4; GQA
+        # k/v are repeated to n_heads before dispatch so this holds
+        model = llama_tiny(
+            vocab_size=VOCAB, max_len=32, mesh=mesh, sp_impl=sp_impl
+        )
+        tr = Trainer(
+            model,
+            TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+            mesh,
+            llama_loss,
+            batch,
+            init_args=(ids,),
+            shardings="logical",
+            seed=7,
+        )
+        losses[label] = [
+            float(tr.train_step(tr.shard_batch(batch))["loss"]) for _ in range(3)
+        ]
+    np.testing.assert_allclose(losses["nosp"], losses["sp"], rtol=2e-4, atol=2e-4)
+
+
+def test_llama_tp_fsdp_training():
+    """The 7B sharding config at tiny scale: fsdp x tp mesh."""
+
+    mesh = make_mesh({"fsdp": 2, "tp": 2, "dp": 2})
+    rng = np.random.RandomState(3)
+    ids = _ids(rng, 4, 16)
+    batch = {"input_ids": ids}
+    model = llama_tiny(vocab_size=VOCAB, max_len=16, mesh=mesh)
+    tr = Trainer(
+        model,
+        TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+        mesh,
+        llama_loss,
+        batch,
+        init_args=(ids,),
+        shardings="logical",
+    )
+    first = tr.train_step(tr.shard_batch(batch))
+    for _ in range(4):
+        last = tr.train_step(tr.shard_batch(batch))
+    assert float(last["loss"]) < float(first["loss"])

@@ -1,0 +1,127 @@
+"""Llama-style decoder family: RoPE + RMSNorm + SwiGLU + GQA.
+
+The reference ships no model code (SURVEY.md §0 — it is a control
+plane); its *examples* cover the 2019-era TF families.  This module is
+the framework's modern-decoder representative: the architecture every
+current open-weights LM (llama/mistral/qwen-class) uses, built from the
+same transformer blocks and logical sharding rules as the rest of the
+zoo, so dp/fsdp/tp/sp(ring|ulysses) all apply unchanged.
+
+Differences from `models/gpt.py` (GPT-2 class):
+- rotary position embeddings inside attention (no learned pos table)
+- RMSNorm everywhere (no biases anywhere in the network)
+- SwiGLU gated MLP
+- optional grouped-query attention (n_kv_heads < n_heads)
+- untied LM head (separate output projection)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from tf_operator_tpu.models.transformer import (
+    ACT_HIDDEN,
+    DecoderLayer,
+    Embed,
+    LayerNorm,
+    TransformerConfig,
+    logical_constraint,
+    param_with_axes,
+)
+
+
+class LlamaLM(nn.Module):
+    """Decoder-only LM over a TransformerConfig with rope=True."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, train: bool = False):
+        cfg = self.cfg
+        x = Embed(cfg, name="tok_embed")(input_ids)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        x = logical_constraint(x, ACT_HIDDEN)
+        for i in range(cfg.n_layers):
+            x = DecoderLayer(cfg, cross=False, activation="swiglu", name=f"layer_{i}")(
+                x, train=train
+            )
+        x = LayerNorm(cfg, rms=True, name="ln_final")(x)
+        # untied head (llama convention), vocab on the tp axis
+        logits = nn.DenseGeneral(
+            cfg.vocab_size,
+            use_bias=False,
+            dtype=cfg.dtype,
+            kernel_init=param_with_axes(
+                nn.initializers.normal(0.02), ("embed", "vocab")
+            ),
+            name="lm_head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+def llama_tiny(
+    vocab_size: int = 1024,
+    max_len: int = 256,
+    mesh=None,
+    n_kv_heads: Optional[int] = 2,
+    **kw,
+) -> LlamaLM:
+    """Test-scale shape (GQA 4q:2kv by default)."""
+
+    return LlamaLM(
+        TransformerConfig(
+            vocab_size=vocab_size,
+            hidden=128,
+            n_heads=4,
+            head_dim=32,
+            n_layers=2,
+            mlp_dim=352,  # ~8/3 * hidden, llama convention
+            max_len=max_len,
+            dropout=0.0,
+            mesh=mesh,
+            rope=True,
+            attn_bias=False,
+            n_kv_heads=n_kv_heads,
+            **kw,
+        )
+    )
+
+
+def llama_7b_shape(vocab_size: int = 32000, max_len: int = 4096, mesh=None, **kw) -> LlamaLM:
+    """The canonical 7B shape (for sharding/bench configs; init it on a
+    mesh with fsdp/tp or it will not fit one chip)."""
+
+    return LlamaLM(
+        TransformerConfig(
+            vocab_size=vocab_size,
+            hidden=4096,
+            n_heads=32,
+            head_dim=128,
+            n_layers=32,
+            mlp_dim=11008,
+            max_len=max_len,
+            dropout=0.0,
+            mesh=mesh,
+            rope=True,
+            attn_bias=False,
+            **kw,
+        )
+    )
+
+
+def llama_loss(params, state, batch: Dict, rng) -> Tuple[jax.Array, Dict]:
+    """Next-token cross-entropy (same contract as models.gpt.lm_loss)."""
+
+    ids = batch["input_ids"]
+    logits = state.apply_fn(
+        {"params": params}, ids, train=True, rngs={"dropout": rng}
+    )
+    targets = ids[:, 1:]
+    logits = logits[:, :-1]
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+    return loss, {"loss": loss}

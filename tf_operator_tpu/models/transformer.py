@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from tf_operator_tpu.ops import attention, ring_attention, ulysses_attention
+from tf_operator_tpu.ops.rotary import apply_rope
 
 param_with_axes = nn.with_logical_partitioning
 logical_constraint = nn.with_logical_constraint
@@ -47,11 +48,28 @@ class TransformerConfig:
     # (all-to-all head re-shard; needs heads-per-shard % sp == 0)
     mesh: Optional[Mesh] = None
     sp_impl: str = "ring"
+    # grouped-query attention: number of K/V heads (None = MHA). K/V
+    # are repeated to n_heads before attention dispatch, so GQA
+    # composes with ring/ulysses/flash unchanged.
+    n_kv_heads: Optional[int] = None
+    # rotary position embeddings (llama-style) applied to q/k inside
+    # attention; models that set this skip learned position embeddings
+    rope: bool = False
+    rope_theta: float = 10000.0
+    # biases on the attention projections (q/k/v/out).  True = GPT/BERT
+    # convention; llama-class models set False; qwen-class would keep
+    # True with rope=True — the two knobs are independent.
+    attn_bias: bool = True
 
     def __post_init__(self):
         if self.sp_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"sp_impl must be 'ring' or 'ulysses', got {self.sp_impl!r}"
+            )
+        if self.n_kv_heads is not None and self.n_heads % self.n_kv_heads:
+            raise ValueError(
+                f"n_heads ({self.n_heads}) must be a multiple of "
+                f"n_kv_heads ({self.n_kv_heads})"
             )
 
     @property
@@ -128,11 +146,24 @@ class MultiHeadAttention(nn.Module):
         is_self = kv is None
         kv_in = x if is_self else kv
         h, d = cfg.n_heads, cfg.head_dim
-        q = dense((h, d), cfg, ("embed", "heads", "kv"), name="query", use_bias=True)(x)
-        k = dense((h, d), cfg, ("embed", "heads", "kv"), name="key", use_bias=True)(kv_in)
-        v = dense((h, d), cfg, ("embed", "heads", "kv"), name="value", use_bias=True)(kv_in)
+        hkv = cfg.n_kv_heads or h
+        bias_p = cfg.attn_bias
+        q = dense((h, d), cfg, ("embed", "heads", "kv"), name="query", use_bias=bias_p)(x)
+        k = dense((hkv, d), cfg, ("embed", "heads", "kv"), name="key", use_bias=bias_p)(kv_in)
+        v = dense((hkv, d), cfg, ("embed", "heads", "kv"), name="value", use_bias=bias_p)(kv_in)
         # [B,S,H,D] -> [B,H,S,D]; heads over tp, seq over sp
         q, k, v = (jnp.transpose(a, (0, 2, 1, 3)) for a in (q, k, v))
+        if cfg.rope and is_self:
+            q, k = apply_rope(q, k, theta=cfg.rope_theta)
+        if hkv != h:
+            # GQA: replicate each K/V head across its query group so
+            # every downstream schedule sees plain MHA shapes.  NOTE
+            # this trades away GQA's KV bandwidth saving under sp (ring
+            # hops / all-to-alls carry h/hkv more KV bytes than they
+            # strictly need); pushing hkv-width K/V through the
+            # schedules and broadcasting inside the local block is the
+            # planned kernel-level optimisation.
+            k, v = (jnp.repeat(a, h // hkv, axis=1) for a in (k, v))
         q, k, v = (
             logical_constraint(a, ("batch", "act_heads", "seq", "act_kv")) for a in (q, k, v)
         )
@@ -152,6 +183,7 @@ class MultiHeadAttention(nn.Module):
             cfg.hidden,
             axis=(-2, -1),
             dtype=cfg.dtype,
+            use_bias=cfg.attn_bias,
             kernel_init=param_with_axes(nn.initializers.lecun_normal(), ("heads", "kv", "embed")),
             bias_init=param_with_axes(nn.initializers.zeros_init(), ("embed",)),
             name="out",
@@ -162,15 +194,23 @@ class MultiHeadAttention(nn.Module):
 
 class MlpBlock(nn.Module):
     cfg: TransformerConfig
-    activation: str = "gelu"
+    activation: str = "gelu"  # "gelu" | "relu" | "swiglu"
 
     @nn.compact
     def __call__(self, x, train=False):
         cfg = self.cfg
-        y = dense(cfg.mlp_dim, cfg, ("embed", "mlp"), name="wi")(x)
-        y = logical_constraint(y, ("batch", "seq", "act_mlp"))
-        y = nn.gelu(y) if self.activation == "gelu" else nn.relu(y)
-        y = dense(cfg.hidden, cfg, ("mlp", "embed"), name="wo")(y)
+        if self.activation == "swiglu":
+            # llama-style gated MLP: silu(gate) * up, no biases
+            gate = dense(cfg.mlp_dim, cfg, ("embed", "mlp"), name="wi_gate", use_bias=False)(x)
+            up = dense(cfg.mlp_dim, cfg, ("embed", "mlp"), name="wi_up", use_bias=False)(x)
+            y = nn.silu(gate) * up
+            y = logical_constraint(y, ("batch", "seq", "act_mlp"))
+            y = dense(cfg.hidden, cfg, ("mlp", "embed"), name="wo", use_bias=False)(y)
+        else:
+            y = dense(cfg.mlp_dim, cfg, ("embed", "mlp"), name="wi")(x)
+            y = logical_constraint(y, ("batch", "seq", "act_mlp"))
+            y = nn.gelu(y) if self.activation == "gelu" else nn.relu(y)
+            y = dense(cfg.hidden, cfg, ("mlp", "embed"), name="wo")(y)
         y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
         return logical_constraint(y, ACT_HIDDEN)
 
@@ -201,6 +241,7 @@ class DecoderLayer(nn.Module):
 
     cfg: TransformerConfig
     cross: bool = False
+    activation: str = "relu"
 
     @nn.compact
     def __call__(self, x, enc=None, self_bias=None, enc_mask=None, train=False):
@@ -215,5 +256,5 @@ class DecoderLayer(nn.Module):
                 y, kv=enc, mask=enc_mask, train=train
             )
         y = LayerNorm(cfg, rms=True, name="ln_mlp")(x)
-        x = x + MlpBlock(cfg, activation="relu", name="mlp")(y, train=train)
+        x = x + MlpBlock(cfg, activation=self.activation, name="mlp")(y, train=train)
         return logical_constraint(x, ACT_HIDDEN)

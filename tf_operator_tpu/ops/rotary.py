@@ -1,0 +1,58 @@
+"""Rotary position embeddings (RoPE), llama-style half-split rotation.
+
+The reference has no model code at all (SURVEY.md §0 — it is a control
+plane); this belongs to the framework's model zoo, where the modern
+decoder families (llama-style) encode position by rotating q/k in the
+complex plane instead of adding learned vectors.
+
+Composition with sequence parallelism is free: RoPE is applied to the
+GLOBAL [B, H, S, D] q/k right after projection, before attention
+dispatches to ring/ulysses — positions are absolute indices, so XLA
+simply shards the elementwise rotation along with the seq axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_sin_cos(
+    positions: jax.Array,  # [S] (or any shape) absolute positions
+    head_dim: int,
+    theta: float = 10000.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """sin/cos tables [*positions.shape, head_dim // 2], float32."""
+
+    half = head_dim // 2
+    freq = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[..., None] * freq  # [..., half]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(
+    q: jax.Array,  # [B, H, S, D]
+    k: jax.Array,
+    positions: Optional[jax.Array] = None,  # [S] absolute; default arange
+    theta: float = 10000.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Rotate q and k by their positions (half-split convention: the
+    vector is viewed as D/2 complex pairs (x[:D/2], x[D/2:]))."""
+
+    d = q.shape[-1]
+    if d % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {d}")
+    if positions is None:
+        positions = jnp.arange(q.shape[-2])
+    sin, cos = rope_sin_cos(positions, d, theta)  # [S, D/2]
+
+    def rot(x):
+        x1, x2 = x[..., : d // 2], x[..., d // 2 :]
+        xr = jnp.concatenate(
+            (x1 * cos - x2 * sin, x2 * cos + x1 * sin), axis=-1
+        )
+        return xr.astype(x.dtype)
+
+    return rot(q), rot(k)
