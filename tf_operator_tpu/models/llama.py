@@ -17,12 +17,10 @@ Differences from `models/gpt.py` (GPT-2 class):
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
-import optax
 
 from tf_operator_tpu.models.transformer import (
     ACT_HIDDEN,
@@ -114,14 +112,6 @@ def llama_7b_shape(vocab_size: int = 32000, max_len: int = 4096, mesh=None, **kw
     )
 
 
-def llama_loss(params, state, batch: Dict, rng) -> Tuple[jax.Array, Dict]:
-    """Next-token cross-entropy (same contract as models.gpt.lm_loss)."""
-
-    ids = batch["input_ids"]
-    logits = state.apply_fn(
-        {"params": params}, ids, train=True, rngs={"dropout": rng}
-    )
-    targets = ids[:, 1:]
-    logits = logits[:, :-1]
-    loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
-    return loss, {"loss": loss}
+# next-token cross-entropy: identical contract and math to the GPT
+# family's loss — one implementation, re-exported under the family name
+from tf_operator_tpu.models.gpt import lm_loss as llama_loss  # noqa: E402

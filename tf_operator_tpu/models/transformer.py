@@ -155,23 +155,21 @@ class MultiHeadAttention(nn.Module):
         q, k, v = (jnp.transpose(a, (0, 2, 1, 3)) for a in (q, k, v))
         if cfg.rope and is_self:
             q, k = apply_rope(q, k, theta=cfg.rope_theta)
-        if hkv != h:
-            # GQA: replicate each K/V head across its query group so
-            # every downstream schedule sees plain MHA shapes.  NOTE
-            # this trades away GQA's KV bandwidth saving under sp (ring
-            # hops / all-to-alls carry h/hkv more KV bytes than they
-            # strictly need); pushing hkv-width K/V through the
-            # schedules and broadcasting inside the local block is the
-            # planned kernel-level optimisation.
-            k, v = (jnp.repeat(a, h // hkv, axis=1) for a in (k, v))
         q, k, v = (
             logical_constraint(a, ("batch", "act_heads", "seq", "act_kv")) for a in (q, k, v)
         )
         use_sp = cfg.sp_enabled and is_self and bias is None and mask is None
         if use_sp:
+            # GQA-aware schedules: K/V enter at Hkv width and travel
+            # the ring / all-to-all that way (the h/hkv bandwidth
+            # saving), expanding only inside the local block compute
             sp_attn = ulysses_attention if cfg.sp_impl == "ulysses" else ring_attention
             out = sp_attn(q, k, v, cfg.mesh, causal=self.causal)
         else:
+            if hkv != h:
+                # the plain dispatcher sees MHA shapes (XLA fuses the
+                # broadcast into the matmuls on a single device)
+                k, v = (jnp.repeat(a, h // hkv, axis=1) for a in (k, v))
             # dispatcher: pallas flash kernel on TPU when it applies,
             # XLA-fused reference otherwise; the mesh routes multi-device
             # calls through the shard_map wrapper

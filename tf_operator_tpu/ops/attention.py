@@ -50,3 +50,21 @@ def dot_product_attention(
     return jnp.einsum(
         "bhqk,bhkd->bhqd", weights.astype(v.dtype), v, preferred_element_type=jnp.float32
     ).astype(v.dtype)
+
+
+def repeat_kv_heads(a: jax.Array, group: int) -> jax.Array:
+    """GQA: expand [B,Hkv,S,D] K/V to the full query-head width.  The
+    sp schedules (ring/ulysses) call this just before a local block
+    compute so K/V travel the interconnect at Hkv width."""
+
+    return a if group == 1 else jnp.repeat(a, group, axis=1)
+
+
+def sum_kv_head_groups(a: jax.Array, group: int) -> jax.Array:
+    """Transpose of `repeat_kv_heads` for gradients: sum each
+    query-head group back onto its shared K/V head."""
+
+    if group == 1:
+        return a
+    b, h, s, d = a.shape
+    return a.reshape(b, h // group, group, s, d).sum(axis=2)

@@ -42,7 +42,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tf_operator_tpu.ops.attention import dot_product_attention
+from tf_operator_tpu.ops.attention import (
+    dot_product_attention,
+    repeat_kv_heads as _rep_kv,
+    sum_kv_head_groups as _red_kv,
+)
 
 _NEG = float(jnp.finfo(jnp.float32).min)
 
@@ -89,6 +93,7 @@ def _ring_attention_local_flash(
     block_q: int,
     block_k: int,
     interpret: bool,
+    group: int = 1,
     with_residuals: bool = False,
 ):
     """Ring schedule with the pallas flash kernel computing each block.
@@ -124,7 +129,7 @@ def _ring_attention_local_flash(
     # hop 0: the local (diagonal) block — causal iff the caller is.
     # The kernel emits lse lane-broadcast [..., LANES]; one lane is the
     # truth, so the carry keeps [..., :1] (128x less state per hop)
-    out0, lse0 = flash(q, k, v, causal=causal)
+    out0, lse0 = flash(q, _rep_kv(k, group), _rep_kv(v, group), causal=causal)
     o = out0.astype(jnp.float32)
     lse = lse0[..., :1]
 
@@ -144,7 +149,7 @@ def _ring_attention_local_flash(
 
         def visible(operands):
             qq, kk, vv = operands
-            bo, bl = flash(qq, kk, vv, causal=False)
+            bo, bl = flash(qq, _rep_kv(kk, group), _rep_kv(vv, group), causal=False)
             return bo.astype(jnp.float32), bl[..., :1]
 
         def masked(operands):
@@ -182,6 +187,7 @@ def _ring_flash_backward(
     block_q: int,
     block_k: int,
     interpret: bool,
+    group: int = 1,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Ring backward with the pallas flash backward kernels per block.
 
@@ -216,8 +222,14 @@ def _ring_flash_backward(
         grad_dtype=jnp.float32,
     )
 
-    # hop 0: the local (diagonal) block — causal iff the caller is
-    dq, dk, dv = blocks(q, k, v, g, lse_b, delta_b, causal=causal)
+    # hop 0: the local (diagonal) block — causal iff the caller is.
+    # GQA: kernels see full-width K/V; the group-sum afterwards is the
+    # exact transpose of the forward's repeat, and dk/dv then travel
+    # the ring at Hkv width
+    dq, dk, dv = blocks(
+        q, _rep_kv(k, group), _rep_kv(v, group), g, lse_b, delta_b, causal=causal
+    )
+    dk, dv = _red_kv(dk, group), _red_kv(dv, group)
 
     def body(carry, i):
         k_blk, v_blk, dk_blk, dv_blk, dq = carry
@@ -229,7 +241,11 @@ def _ring_flash_backward(
 
         def visible(operands):
             kk, vv = operands
-            return blocks(q, kk, vv, g, lse_b, delta_b, causal=False)
+            dqi, dki, dvi = blocks(
+                q, _rep_kv(kk, group), _rep_kv(vv, group), g, lse_b, delta_b,
+                causal=False,
+            )
+            return dqi, _red_kv(dki, group), _red_kv(dvi, group)
 
         def masked(operands):
             return (
@@ -264,6 +280,7 @@ def _make_flash_ring_local(
     block_q: int,
     block_k: int,
     interpret: bool,
+    group: int = 1,
 ):
     """The flash-ring local fn with a training-complete VJP.
 
@@ -287,12 +304,14 @@ def _make_flash_ring_local(
         block_q=block_q,
         block_k=block_k,
         interpret=interpret,
+        group=group,
     )
     xla_impl = functools.partial(
         _ring_attention_local,
         axis_name=axis_name,
         axis_size=axis_size,
         causal=causal,
+        group=group,
     )
     pallas_bwd = _use_pallas_bwd()
 
@@ -317,6 +336,7 @@ def _make_flash_ring_local(
                 block_q=block_q,
                 block_k=block_k,
                 interpret=interpret,
+                group=group,
             )
         q, k, v = residuals
         _, vjp = jax.vjp(xla_impl, q, k, v)
@@ -334,8 +354,11 @@ def _ring_attention_local(
     axis_name: str,
     axis_size: int,
     causal: bool,
+    group: int = 1,
 ) -> jax.Array:
-    """Runs inside shard_map: q,k,v are the local [B,H,Sq,D] shards."""
+    """Runs inside shard_map: q is the local [B,H,Sq,D] shard; k/v are
+    [B,H/group,Sq,D] (GQA) and expand per block compute.  Gradients of
+    the repeat (autodiff through the scan) are the group-sum."""
 
     my = lax.axis_index(axis_name)
     sq = q.shape[-2]
@@ -352,7 +375,10 @@ def _ring_attention_local(
         k_blk, v_blk, m, l, o = carry
         # after i hops we hold the block that started (my - i) shards back
         src = (my - i) % axis_size
-        m, l, o = _ring_block(qf, k_blk, v_blk, m, l, o, q_off, src * sq, causal)
+        m, l, o = _ring_block(
+            qf, _rep_kv(k_blk, group), _rep_kv(v_blk, group), m, l, o,
+            q_off, src * sq, causal,
+        )
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return (k_blk, v_blk, m, l, o), None
@@ -362,7 +388,10 @@ def _ring_attention_local(
         body, (k, v, m0, l0, o0), jnp.arange(axis_size - 1)
     )
     last_src = (my - (axis_size - 1)) % axis_size
-    m, l, o = _ring_block(qf, k_blk, v_blk, m, l, o, q_off, last_src * sq, causal)
+    m, l, o = _ring_block(
+        qf, _rep_kv(k_blk, group), _rep_kv(v_blk, group), m, l, o,
+        q_off, last_src * sq, causal,
+    )
     # causal rows always attend to at least themselves, so l > 0; the
     # maximum guards the (non-causal, all-masked) degenerate case
     out = o / jnp.maximum(l, 1e-30)
@@ -398,19 +427,33 @@ def ring_attention(
 ) -> jax.Array:
     """Exact attention with sequence sharded over `axis_name`.
 
-    q,k,v: GLOBAL [B, H, S, D] arrays (jit-traced values are fine —
-    shard_map re-shards per the specs).  When the sp axis is 1 this
-    degrades to plain fused attention with identical semantics.
+    q: GLOBAL [B, H, S, D]; k/v: [B, H, S, D] or [B, Hkv, S, D] with
+    H % Hkv == 0 (GQA — K/V travel the ring at Hkv width and expand
+    only inside each block compute, so ICI traffic and KV residency
+    keep the h/hkv saving).  jit-traced values are fine — shard_map
+    re-shards per the specs.  When the sp axis is 1 this degrades to
+    plain fused attention with identical semantics.
 
     ``use_flash``: compute each ring block with the pallas flash kernel
     (flash x sp).  None = auto: on the TPU backend when the per-shard
     shapes tile the kernel blocks (TPU_OPERATOR_FLASH=0 disables).
     """
 
+    h, hkv = q.shape[1], k.shape[1]
+    if h % hkv:
+        raise ValueError(f"q heads ({h}) must be a multiple of kv heads ({hkv})")
+    group = h // hkv
+
     if mesh.shape[axis_name] <= 1:
+        k, v = _rep_kv(k, group), _rep_kv(v, group)
         return dot_product_attention(q, k, v, causal=causal)
 
     n = mesh.shape[axis_name]
+    if group > 1 and heads_axis and hkv % mesh.shape.get(heads_axis, 1):
+        # kv heads don't divide the tp axis: fall back to full width
+        k, v = _rep_kv(k, group), _rep_kv(v, group)
+        group = 1
+
     from tf_operator_tpu.ops.flash_attention import resolve_use_flash
 
     use_flash = resolve_use_flash(
@@ -424,7 +467,7 @@ def ring_attention(
     spec = P(batch_axes, heads_axis, axis_name, None)
     if use_flash:
         local = _make_flash_ring_local(
-            axis_name, n, causal, block_q, block_k, interpret
+            axis_name, n, causal, block_q, block_k, interpret, group=group
         )
     else:
         local = functools.partial(
@@ -432,6 +475,7 @@ def ring_attention(
             axis_name=axis_name,
             axis_size=n,
             causal=causal,
+            group=group,
         )
     from tf_operator_tpu.utils.jax_compat import shard_map_unchecked
 

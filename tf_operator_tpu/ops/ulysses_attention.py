@@ -36,13 +36,16 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tf_operator_tpu.ops.attention import dot_product_attention
+from tf_operator_tpu.ops.attention import (
+    dot_product_attention,
+    repeat_kv_heads as _rep_kv,
+)
 from tf_operator_tpu.ops.flash_attention import flash_attention, resolve_use_flash
 
 
 def _ulysses_local(
     q: jax.Array,  # [B, Hl, Sl, D] — local heads, local seq chunk
-    k: jax.Array,
+    k: jax.Array,  # [B, Hkvl, Sl, D] (GQA: Hkvl may be Hl/group)
     v: jax.Array,
     *,
     axis_name: str,
@@ -51,14 +54,28 @@ def _ulysses_local(
     block_q: int,
     block_k: int,
     interpret: bool,
+    group: int = 1,
+    kv_native_a2a: bool = True,
 ) -> jax.Array:
     """Runs inside shard_map.  heads→seq re-shard, local attention,
-    seq→heads re-shard back."""
+    seq→heads re-shard back.
+
+    GQA: when the kv head count splits across the axis
+    (kv_native_a2a), K/V ride the all-to-all at Hkv width — the
+    h/hkv bandwidth saving — and expand after; otherwise they expand
+    first (correct, no saving).  Autodiff handles both (the repeat's
+    transpose is the group-sum)."""
 
     a2a = functools.partial(lax.all_to_all, axis_name=axis_name, tiled=True)
     # [B, Hl, Sl, D] -> [B, Hl/n, S, D]: give away head groups, collect
     # the full sequence for the heads we keep
-    q, k, v = (a2a(t, split_axis=1, concat_axis=2) for t in (q, k, v))
+    q = a2a(q, split_axis=1, concat_axis=2)
+    if kv_native_a2a:
+        k, v = (a2a(t, split_axis=1, concat_axis=2) for t in (k, v))
+        k, v = _rep_kv(k, group), _rep_kv(v, group)
+    else:
+        k, v = _rep_kv(k, group), _rep_kv(v, group)
+        k, v = (a2a(t, split_axis=1, concat_axis=2) for t in (k, v))
     if use_flash:
         o = flash_attention(q, k, v, causal, block_q, block_k, interpret)
     else:
@@ -108,17 +125,30 @@ def ulysses_attention(
     disables).
     """
 
+    h, hkv = q.shape[1], k.shape[1]
+    if h % hkv:
+        raise ValueError(f"q heads ({h}) must be a multiple of kv heads ({hkv})")
+    group = h // hkv
+
     if mesh.shape[axis_name] <= 1:
-        return dot_product_attention(q, k, v, causal=causal)
+        return dot_product_attention(q, _rep_kv(k, group), _rep_kv(v, group), causal=causal)
 
     n = mesh.shape[axis_name]
-    heads_local = q.shape[1] // (mesh.shape.get(heads_axis, 1) if heads_axis else 1)
+    tp_size = mesh.shape.get(heads_axis, 1) if heads_axis else 1
+    heads_local = h // tp_size
     if not _ulysses_applicable(heads_local, n):
         raise ValueError(
             f"ulysses_attention needs heads-per-shard divisible by the sp "
             f"axis: {heads_local} local heads over sp={n}; use "
             f"ring_attention for head counts that don't split"
         )
+    if group > 1 and hkv % tp_size:
+        # kv heads don't divide the tp axis: fall back to full width
+        k, v = _rep_kv(k, group), _rep_kv(v, group)
+        group, hkv = 1, h
+    # K/V can ride the all-to-all at Hkv width only if their local
+    # head count splits across the axis too
+    kv_native_a2a = group == 1 or (hkv // tp_size) % n == 0
 
     use_flash = resolve_use_flash(
         use_flash,
@@ -136,6 +166,8 @@ def ulysses_attention(
         block_q=block_q,
         block_k=block_k,
         interpret=interpret,
+        group=group,
+        kv_native_a2a=kv_native_a2a,
     )
     from tf_operator_tpu.utils.jax_compat import shard_map_unchecked
 
