@@ -1,0 +1,111 @@
+"""Autoregressive generation with a KV cache — the serving/eval path.
+
+The reference has no inference story (it is a control plane, SURVEY.md
+§0); a training framework's users still need to sample from what they
+trained.  TPU-first design choices:
+
+- **Static shapes end to end.**  The cache is [B, Hkv, max_len, D]
+  allocated once; each step writes one slot via dynamic_update_slice
+  and masks unfilled positions.  Nothing reshapes, so the whole
+  generate loop compiles to ONE XLA program.
+- **lax.scan over steps** — no Python loop per token, no retraces.
+- **GQA-width cache**: Hkv heads, h/hkv smaller than the naive cache.
+- Prefill and decode share one code path (the MHA cache branch handles
+  s_new = prompt_len and s_new = 1 uniformly).
+
+Works with every decoder family built on models/transformer.py
+(CausalLM/GPT with learned positions, LlamaLM with RoPE).  The MoE and
+pipelined families don't support decode yet (their routing/stage
+schedules are training-shaped); guard is the absent cache collection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _decode_variant(model):
+    """The same architecture with decode=True (frozen-config swap)."""
+
+    return type(model)(dataclasses.replace(model.cfg, decode=True, dropout=0.0))
+
+
+def init_cache(model, batch_size: int):
+    """Zero-initialised KV cache for `batch_size` rows (no FLOPs —
+    shapes come from eval_shape, zeros from the shape tree)."""
+
+    dmodel = _decode_variant(model)
+    dummy = jnp.zeros((batch_size, 1), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda: dmodel.init(jax.random.PRNGKey(0), dummy)
+    )["cache"]
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+def generate(
+    model,
+    params,
+    prompt_ids: jax.Array,  # [B, P] int32
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Sample `max_new_tokens` continuations.  Returns [B, P + N] ids.
+
+    temperature 0.0 = greedy (argmax); otherwise categorical over
+    logits/temperature, optionally truncated to the top_k logits.
+    jit-compatible: wrap in jax.jit with static max_new_tokens for the
+    single-program path.
+    """
+
+    cfg = model.cfg
+    b, p = prompt_ids.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if p + max_new_tokens > cfg.max_len:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"the cache length max_len={cfg.max_len}"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    dmodel = _decode_variant(model)
+    cache = init_cache(model, b)
+
+    def sample(logits, r):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(r, logits).astype(jnp.int32)
+
+    # prefill: the whole prompt in one pass primes every layer's cache
+    logits, vars_ = dmodel.apply(
+        {"params": params, "cache": cache}, prompt_ids, mutable=["cache"]
+    )
+    rng, r0 = jax.random.split(rng)
+    tok = sample(logits[:, -1], r0)
+
+    def body(carry, _):
+        cache, tok, rng = carry
+        logits, vars_ = dmodel.apply(
+            {"params": params, "cache": cache}, tok[:, None], mutable=["cache"]
+        )
+        rng, r = jax.random.split(rng)
+        nxt = sample(logits[:, 0], r)
+        return (vars_["cache"], nxt, rng), tok
+
+    (cache, last, _), toks = lax.scan(
+        body, (vars_["cache"], tok, rng), None, length=max_new_tokens - 1
+    )
+    gen = jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1)
+    return jnp.concatenate([prompt_ids, gen], axis=1)

@@ -42,7 +42,16 @@ class CausalLM(nn.Module):
             (cfg.max_len, cfg.hidden),
             jnp.float32,
         )
-        x = x + pos[None, :s].astype(cfg.dtype)
+        if cfg.decode:
+            # decode mode: the position slice starts at the running
+            # index (the MHA layers keep the authoritative K/V cache;
+            # this mirrors their index for the learned table)
+            pos_idx = self.variable("cache", "pos_index", lambda: jnp.array(0, jnp.int32))
+            i = pos_idx.value
+            x = x + jax.lax.dynamic_slice(pos, (i, 0), (s, pos.shape[1]))[None].astype(cfg.dtype)
+            pos_idx.value = i + s
+        else:
+            x = x + pos[None, :s].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         x = logical_constraint(x, ACT_HIDDEN)
         for i in range(cfg.n_layers):

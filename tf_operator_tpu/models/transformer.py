@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from tf_operator_tpu.ops import attention, ring_attention, ulysses_attention
+from tf_operator_tpu.ops.attention import repeat_kv_heads
 from tf_operator_tpu.ops.rotary import apply_rope
 
 param_with_axes = nn.with_logical_partitioning
@@ -60,6 +61,11 @@ class TransformerConfig:
     # convention; llama-class models set False; qwen-class would keep
     # True with rope=True — the two knobs are independent.
     attn_bias: bool = True
+    # autoregressive decode mode: self-attention layers maintain a
+    # [B, Hkv, max_len, D] K/V cache ("cache" collection) written at
+    # the running index — static shapes throughout, so the whole
+    # generate loop jits into one XLA program (models/decode.py)
+    decode: bool = False
 
     def __post_init__(self):
         if self.sp_impl not in ("ring", "ulysses"):
@@ -153,6 +159,43 @@ class MultiHeadAttention(nn.Module):
         v = dense((hkv, d), cfg, ("embed", "heads", "kv"), name="value", use_bias=bias_p)(kv_in)
         # [B,S,H,D] -> [B,H,S,D]; heads over tp, seq over sp
         q, k, v = (jnp.transpose(a, (0, 2, 1, 3)) for a in (q, k, v))
+
+        if cfg.decode and is_self:
+            if mask is not None or bias is not None:
+                raise ValueError(
+                    "decode mode builds its own causal/fill mask; "
+                    "caller-supplied mask/bias (e.g. ragged-prompt "
+                    "padding) is not supported — left-align prompts"
+                )
+            # autoregressive cache: new K/V written at the running
+            # index (hkv width — GQA cache stays small), q attends to
+            # every filled slot.  Works uniformly for prefill
+            # (s_new = prompt len) and decode steps (s_new = 1).
+            b, _, s_new, _ = q.shape
+            cached_k = self.variable(
+                "cache", "cached_key", jnp.zeros, (b, hkv, cfg.max_len, d), k.dtype
+            )
+            cached_v = self.variable(
+                "cache", "cached_value", jnp.zeros, (b, hkv, cfg.max_len, d), v.dtype
+            )
+            cache_idx = self.variable(
+                "cache", "cache_index", lambda: jnp.array(0, jnp.int32)
+            )
+            idx = cache_idx.value
+            row_pos = idx + jnp.arange(s_new)
+            if cfg.rope:
+                q, k = apply_rope(q, k, positions=row_pos, theta=cfg.rope_theta)
+            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, 0, idx, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, idx, 0))
+            cache_idx.value = idx + s_new
+            k = repeat_kv_heads(cached_k.value, h // hkv)
+            v = repeat_kv_heads(cached_v.value, h // hkv)
+            # causal over absolute positions; unfilled slots masked
+            dec_mask = (jnp.arange(cfg.max_len)[None, :] <= row_pos[:, None])[None, None]
+            out = attention(q, k, v, mask=dec_mask, mesh=cfg.mesh)
+            out = jnp.transpose(out, (0, 2, 1, 3))
+            return self._project_out(out, train)
+
         if cfg.rope and is_self:
             q, k = apply_rope(q, k, theta=cfg.rope_theta)
         q, k, v = (
@@ -177,6 +220,10 @@ class MultiHeadAttention(nn.Module):
                 q, k, v, causal=self.causal, bias=bias, mask=mask, mesh=cfg.mesh
             )
         out = jnp.transpose(out, (0, 2, 1, 3))  # [B,S,H,D]
+        return self._project_out(out, train)
+
+    def _project_out(self, out, train):
+        cfg = self.cfg
         out = nn.DenseGeneral(
             cfg.hidden,
             axis=(-2, -1),
