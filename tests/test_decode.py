@@ -87,3 +87,19 @@ def test_gqa_cache_is_kv_width():
     cache = init_cache(model, batch_size=3)
     ck = cache["layer_0"]["self_attn"]["cached_key"]
     assert ck.shape == (3, 2, 32, 32)  # [B, Hkv, max_len, D]
+
+
+def test_unsupported_family_rejected_cleanly():
+    from tf_operator_tpu.models import moe_tiny
+
+    model = moe_tiny(vocab_size=VOCAB, max_len=16)
+    with pytest.raises(NotImplementedError, match="decode is supported"):
+        generate(model, {}, jnp.zeros((1, 2), jnp.int32), max_new_tokens=2)
+
+
+def test_temperature_without_rng_rejected():
+    model = gpt_tiny(vocab_size=VOCAB, max_len=16)
+    prompt = jnp.zeros((1, 2), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    with pytest.raises(ValueError, match="rng"):
+        generate(model, params, prompt, max_new_tokens=2, temperature=0.7)

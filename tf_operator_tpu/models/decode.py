@@ -16,7 +16,8 @@ trained.  TPU-first design choices:
 Works with every decoder family built on models/transformer.py
 (CausalLM/GPT with learned positions, LlamaLM with RoPE).  The MoE and
 pipelined families don't support decode yet (their routing/stage
-schedules are training-shaped); guard is the absent cache collection.
+schedules are training-shaped); `_decode_variant` rejects them with a
+clear NotImplementedError.
 """
 
 from __future__ import annotations
@@ -28,11 +29,25 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tf_operator_tpu.models.transformer import TransformerConfig
+
 
 def _decode_variant(model):
     """The same architecture with decode=True (frozen-config swap)."""
 
-    return type(model)(dataclasses.replace(model.cfg, decode=True, dropout=0.0))
+    # the family must be constructible from a bare TransformerConfig —
+    # i.e. `cfg` is its dataclass field, not a convenience property
+    # (MoeLM exposes a cfg property over its own MoeConfig)
+    fields = getattr(type(model), "__dataclass_fields__", {})
+    cfg = getattr(model, "cfg", None) if "cfg" in fields else None
+    if not isinstance(cfg, TransformerConfig):
+        raise NotImplementedError(
+            f"decode is supported for the TransformerConfig decoder "
+            f"families (CausalLM, LlamaLM); got {type(model).__name__} "
+            f"(MoE routing and pipeline stage schedules are "
+            f"training-shaped)"
+        )
+    return type(model)(dataclasses.replace(cfg, decode=True, dropout=0.0))
 
 
 def init_cache(model, batch_size: int):
@@ -65,7 +80,8 @@ def generate(
     single-program path.
     """
 
-    cfg = model.cfg
+    dmodel = _decode_variant(model)  # also the supported-family guard
+    cfg = dmodel.cfg
     b, p = prompt_ids.shape
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -75,8 +91,12 @@ def generate(
             f"the cache length max_len={cfg.max_len}"
         )
     if rng is None:
-        rng = jax.random.PRNGKey(0)
-    dmodel = _decode_variant(model)
+        if temperature != 0.0:
+            raise ValueError(
+                "temperature sampling needs an explicit rng key — "
+                "otherwise every call returns identical tokens"
+            )
+        rng = jax.random.PRNGKey(0)  # greedy: key is never consumed meaningfully
     cache = init_cache(model, b)
 
     def sample(logits, r):
@@ -84,7 +104,7 @@ def generate(
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         logits = logits / temperature
         if top_k is not None:
-            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            kth = lax.top_k(logits, top_k)[0][..., -1:]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
         return jax.random.categorical(r, logits).astype(jnp.int32)
 
