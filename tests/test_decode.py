@@ -90,11 +90,16 @@ def test_gqa_cache_is_kv_width():
 
 
 def test_unsupported_family_rejected_cleanly():
-    from tf_operator_tpu.models import moe_tiny
+    from tf_operator_tpu.models import bert_tiny, moe_tiny, t5_tiny
 
-    model = moe_tiny(vocab_size=VOCAB, max_len=16)
-    with pytest.raises(NotImplementedError, match="decode is supported"):
-        generate(model, {}, jnp.zeros((1, 2), jnp.int32), max_new_tokens=2)
+    prompt = jnp.zeros((1, 2), jnp.int32)
+    for model in (
+        moe_tiny(vocab_size=VOCAB, max_len=16),  # routing is training-shaped
+        t5_tiny(vocab_size=VOCAB),  # needs encoder ids
+        bert_tiny(vocab_size=VOCAB),  # bidirectional encoder
+    ):
+        with pytest.raises(NotImplementedError, match="decode is supported"):
+            generate(model, {}, prompt, max_new_tokens=2)
 
 
 def test_temperature_without_rng_rejected():

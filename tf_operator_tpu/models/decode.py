@@ -35,31 +35,34 @@ from tf_operator_tpu.models.transformer import TransformerConfig
 def _decode_variant(model):
     """The same architecture with decode=True (frozen-config swap)."""
 
-    # the family must be constructible from a bare TransformerConfig —
-    # i.e. `cfg` is its dataclass field, not a convenience property
-    # (MoeLM exposes a cfg property over its own MoeConfig)
-    fields = getattr(type(model), "__dataclass_fields__", {})
-    cfg = getattr(model, "cfg", None) if "cfg" in fields else None
-    if not isinstance(cfg, TransformerConfig):
+    # families opt in via SUPPORTS_DECODE (CausalLM, LlamaLM): rules
+    # out MoE/pipelined (training-shaped schedules) AND the non-decoder
+    # TransformerConfig families (T5 needs encoder ids; BERT would
+    # "generate" from a bidirectional encoder)
+    if not getattr(type(model), "SUPPORTS_DECODE", False):
         raise NotImplementedError(
-            f"decode is supported for the TransformerConfig decoder "
-            f"families (CausalLM, LlamaLM); got {type(model).__name__} "
-            f"(MoE routing and pipeline stage schedules are "
-            f"training-shaped)"
+            f"decode is supported for the autoregressive decoder "
+            f"families (CausalLM, LlamaLM — classes with "
+            f"SUPPORTS_DECODE=True); got {type(model).__name__}"
         )
+    cfg = model.cfg
+    assert isinstance(cfg, TransformerConfig)
     return type(model)(dataclasses.replace(cfg, decode=True, dropout=0.0))
+
+
+def _init_cache_for(dmodel, batch_size: int):
+    dummy = jnp.zeros((batch_size, 1), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda: dmodel.init(jax.random.PRNGKey(0), dummy)
+    )["cache"]
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
 def init_cache(model, batch_size: int):
     """Zero-initialised KV cache for `batch_size` rows (no FLOPs —
     shapes come from eval_shape, zeros from the shape tree)."""
 
-    dmodel = _decode_variant(model)
-    dummy = jnp.zeros((batch_size, 1), jnp.int32)
-    shapes = jax.eval_shape(
-        lambda: dmodel.init(jax.random.PRNGKey(0), dummy)
-    )["cache"]
-    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    return _init_cache_for(_decode_variant(model), batch_size)
 
 
 def generate(
@@ -97,7 +100,7 @@ def generate(
                 "otherwise every call returns identical tokens"
             )
         rng = jax.random.PRNGKey(0)  # greedy: key is never consumed meaningfully
-    cache = init_cache(model, b)
+    cache = _init_cache_for(dmodel, b)
 
     def sample(logits, r):
         if temperature == 0.0:

@@ -28,6 +28,8 @@ from tf_operator_tpu.models.transformer import (
 
 
 class CausalLM(nn.Module):
+    SUPPORTS_DECODE = True  # autoregressive: models/decode.py can drive it
+
     cfg: TransformerConfig
 
     @nn.compact

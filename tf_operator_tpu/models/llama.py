@@ -36,6 +36,8 @@ from tf_operator_tpu.models.transformer import (
 class LlamaLM(nn.Module):
     """Decoder-only LM over a TransformerConfig with rope=True."""
 
+    SUPPORTS_DECODE = True  # autoregressive: models/decode.py can drive it
+
     cfg: TransformerConfig
 
     @nn.compact

@@ -23,7 +23,6 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from tf_operator_tpu.ops import attention, ring_attention, ulysses_attention
-from tf_operator_tpu.ops.attention import repeat_kv_heads
 from tf_operator_tpu.ops.rotary import apply_rope
 
 param_with_axes = nn.with_logical_partitioning
@@ -188,8 +187,9 @@ class MultiHeadAttention(nn.Module):
             cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, 0, idx, 0))
             cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, idx, 0))
             cache_idx.value = idx + s_new
-            k = repeat_kv_heads(cached_k.value, h // hkv)
-            v = repeat_kv_heads(cached_v.value, h // hkv)
+            # the dispatcher's attention impls are GQA-native — the
+            # Hkv-width cache is consumed directly, never expanded
+            k, v = cached_k.value, cached_v.value
             # causal over absolute positions; unfilled slots masked
             dec_mask = (jnp.arange(cfg.max_len)[None, :] <= row_pos[:, None])[None, None]
             out = attention(q, k, v, mask=dec_mask, mesh=cfg.mesh)
@@ -209,13 +209,10 @@ class MultiHeadAttention(nn.Module):
             sp_attn = ulysses_attention if cfg.sp_impl == "ulysses" else ring_attention
             out = sp_attn(q, k, v, cfg.mesh, causal=self.causal)
         else:
-            if hkv != h:
-                # the plain dispatcher sees MHA shapes (XLA fuses the
-                # broadcast into the matmuls on a single device)
-                k, v = (jnp.repeat(a, h // hkv, axis=1) for a in (k, v))
             # dispatcher: pallas flash kernel on TPU when it applies,
             # XLA-fused reference otherwise; the mesh routes multi-device
-            # calls through the shard_map wrapper
+            # calls through the shard_map wrapper.  All impls are
+            # GQA-native, so Hkv-width K/V pass straight through.
             out = attention(
                 q, k, v, causal=self.causal, bias=bias, mask=mask, mesh=cfg.mesh
             )

@@ -26,16 +26,30 @@ def dot_product_attention(
     bias: Optional[jax.Array] = None,
     mask: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """q,k,v: [B, H, S, D] (k/v seq may differ for cross-attention).
+    """q,k,v: [B, H, S, D] (k/v seq may differ for cross-attention;
+    k/v heads may be H/group for GQA — handled by a grouped einsum, no
+    materialised repeat).
 
     `bias`: broadcastable to [B, H, Sq, Sk], added to logits (T5 relative
     position bias).  `mask`: broadcastable boolean, True = attend.
     """
 
-    *_, sq, d = q.shape
+    b, h, sq, d = q.shape
+    hkv = k.shape[1]
+    if h != hkv:
+        if h % hkv:
+            raise ValueError(f"q heads ({h}) must be a multiple of kv heads ({hkv})")
+        group = h // hkv
+        qg = q.reshape(b, hkv, group, sq, d)
+        logits = jnp.einsum(
+            "bhgqd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32
+        ).reshape(b, h, sq, k.shape[-2])
+    else:
+        logits = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+        )
     sk = k.shape[-2]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
@@ -47,15 +61,23 @@ def dot_product_attention(
         kpos = jnp.arange(sk)[None, :]
         logits = jnp.where(qpos >= kpos, logits, neg)
     weights = jax.nn.softmax(logits, axis=-1)
+    if h != hkv:
+        wg = weights.reshape(b, hkv, h // hkv, sq, sk)
+        out = jnp.einsum(
+            "bhgqk,bhkd->bhgqd", wg.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        ).reshape(b, h, sq, d)
+        return out.astype(v.dtype)
     return jnp.einsum(
         "bhqk,bhkd->bhqd", weights.astype(v.dtype), v, preferred_element_type=jnp.float32
     ).astype(v.dtype)
 
 
 def repeat_kv_heads(a: jax.Array, group: int) -> jax.Array:
-    """GQA: expand [B,Hkv,S,D] K/V to the full query-head width.  The
-    sp schedules (ring/ulysses) call this just before a local block
-    compute so K/V travel the interconnect at Hkv width."""
+    """GQA: expand [B,Hkv,S,D] K/V to the full query-head width.  Only
+    the fallback paths use this (kv heads not divisible by the tp axis;
+    ulysses when kv heads don't split the sp axis) — the attention
+    impls themselves are GQA-native and consume Hkv width directly."""
 
     return a if group == 1 else jnp.repeat(a, group, axis=1)
 

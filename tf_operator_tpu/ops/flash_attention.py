@@ -141,11 +141,19 @@ def _flash_forward(
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = 1.0 / (d**0.5)
+    # GQA: k/v may carry H/group heads — the BlockSpec index map points
+    # every query head at its shared K/V head, so the repeat never
+    # materialises anywhere (not even in VMEM: same block, re-fetched)
+    if h % k.shape[1]:
+        raise ValueError(f"q heads ({h}) must be a multiple of kv heads ({k.shape[1]})")
+    group = h // k.shape[1]
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, with_lse=with_lse
     )
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0))
-    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ji: (bi, hi, ji, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, d), lambda bi, hi, qi, ji: (bi, hi // group, ji, 0)
+    )
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
     out_specs = [q_spec]
     if with_lse:
@@ -216,24 +224,28 @@ def _flash_bwd_dq_kernel(
 
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *, scale: float, causal: bool,
+    dk_acc, dv_acc, *, scale: float, causal: bool, nq: int,
 ):
-    # grid (b, h, KV block, Q block): the q dimension is innermost and
-    # sequential; dk/dv accumulate across it in VMEM scratch
+    # grid (b, hkv, KV block, T): the innermost T dimension is
+    # sequential and flattens (query-head-in-group, q block) — for MHA
+    # T == n_q_blocks and this is the plain q loop; for GQA every query
+    # head sharing this K/V head streams through before finalize.
+    # dk/dv accumulate across all of T in VMEM scratch.
     ji = pl.program_id(2)
-    qi = pl.program_id(3)
-    nq = pl.num_programs(3)
+    t = pl.program_id(3)
+    nt = pl.num_programs(3)
+    qi = t % nq  # q-block index within the current query head
     block_q = q_ref.shape[2]
     block_k = k_ref.shape[2]
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     # causal: q blocks strictly above the diagonal see none of this kv
     # block (all their positions < every kv position) — skip
-    needed = ((qi + 1) * block_q > ji * block_k) if causal else (qi >= 0)
+    needed = ((qi + 1) * block_q > ji * block_k) if causal else (t >= 0)
 
     @pl.when(needed)
     def _compute():
@@ -260,7 +272,7 @@ def _flash_bwd_dkv_kernel(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(qi == nq - 1)
+    @pl.when(t == nt - 1)
     def _finalize():
         dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
@@ -292,9 +304,17 @@ def _flash_backward_blocks(
 
     grad_dtype: output dtype for the partials (default: input dtypes).
     The ring backward passes float32 so per-hop partials aren't
-    quantized to bf16 before its cross-hop accumulation."""
+    quantized to bf16 before its cross-hop accumulation.
+
+    GQA: k/v may carry H/group heads.  dq reads the shared K/V head via
+    the BlockSpec index map; dk/dv come out at Hkv width natively — the
+    kv-major grid's innermost dimension flattens (head-in-group,
+    q-block) so every query head sharing a K/V head accumulates into
+    the same VMEM scratch before finalize.  No repeat, no group-sum."""
 
     b, h, sq, d = q.shape
+    hkv = k.shape[1]
+    group = h // hkv
     sk = k.shape[2]
     scale = 1.0 / (d**0.5)
     dq_dt = grad_dtype or q.dtype
@@ -302,7 +322,9 @@ def _flash_backward_blocks(
     dv_dt = grad_dtype or v.dtype
 
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0))
-    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ji: (bi, hi, ji, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, d), lambda bi, hi, qi, ji: (bi, hi // group, ji, 0)
+    )
     row_spec = pl.BlockSpec(
         (1, 1, block_q, _LANES), lambda bi, hi, qi, ji: (bi, hi, qi, 0)
     )
@@ -317,19 +339,25 @@ def _flash_backward_blocks(
         interpret=interpret,
     )(q, k, v, g, lse, delta)
 
-    # kv-major grid: every spec indexes with (bi, hi, ji, qi)
-    q_spec_t = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ji, qi: (bi, hi, qi, 0))
-    kv_spec_t = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ji, qi: (bi, hi, ji, 0))
+    # kv-major grid over the Hkv heads; innermost dimension t flattens
+    # (query-head-in-group, q-block): head = hi*group + t//nq, qi = t%nq
+    nq = sq // block_q
+    q_spec_t = pl.BlockSpec(
+        (1, 1, block_q, d),
+        lambda bi, hi, ji, t: (bi, hi * group + t // nq, t % nq, 0),
+    )
+    kv_spec_t = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ji, t: (bi, hi, ji, 0))
     row_spec_t = pl.BlockSpec(
-        (1, 1, block_q, _LANES), lambda bi, hi, ji, qi: (bi, hi, qi, 0)
+        (1, 1, block_q, _LANES),
+        lambda bi, hi, ji, t: (bi, hi * group + t // nq, t % nq, 0),
     )
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal),
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal, nq=nq),
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, dk_dt),
             jax.ShapeDtypeStruct(v.shape, dv_dt),
         ],
-        grid=(b, h, sk // block_k, sq // block_q),
+        grid=(b, hkv, sk // block_k, group * nq),
         in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t, row_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
         scratch_shapes=[
@@ -471,7 +499,7 @@ def _mesh_flash_applicable(mesh: Optional[Mesh], q, k) -> Optional[str]:
         return None  # seq/expert sharding: not this kernel's job
     batch_shards = shape.get("dp", 1) * shape.get("fsdp", 1)
     head_shards = shape.get("tp", 1)
-    if q.shape[0] % batch_shards or q.shape[1] % head_shards:
+    if q.shape[0] % batch_shards or q.shape[1] % head_shards or k.shape[1] % head_shards:
         return None
     return "sharded"
 
@@ -481,7 +509,7 @@ def _flash_applicable(q, k, bias, mask, block_q, block_k) -> bool:
         return False
     if bias is not None or mask is not None:
         return False
-    if q.shape[-2] % block_q or k.shape[-2] % block_k:
+    if q.shape[-2] % block_q or k.shape[-2] % block_k or q.shape[1] % k.shape[1]:
         return False
     # the kernel targets the TPU backend; everything else takes the
     # XLA-fused reference path (the interpreter is for tests)

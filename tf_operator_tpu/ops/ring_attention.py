@@ -45,7 +45,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tf_operator_tpu.ops.attention import (
     dot_product_attention,
     repeat_kv_heads as _rep_kv,
-    sum_kv_head_groups as _red_kv,
 )
 
 _NEG = float(jnp.finfo(jnp.float32).min)
@@ -93,7 +92,6 @@ def _ring_attention_local_flash(
     block_q: int,
     block_k: int,
     interpret: bool,
-    group: int = 1,
     with_residuals: bool = False,
 ):
     """Ring schedule with the pallas flash kernel computing each block.
@@ -129,7 +127,9 @@ def _ring_attention_local_flash(
     # hop 0: the local (diagonal) block — causal iff the caller is.
     # The kernel emits lse lane-broadcast [..., LANES]; one lane is the
     # truth, so the carry keeps [..., :1] (128x less state per hop)
-    out0, lse0 = flash(q, _rep_kv(k, group), _rep_kv(v, group), causal=causal)
+    # flash kernels are GQA-native (index-mapped K/V heads) — hkv-width
+    # blocks go straight in, no repeat anywhere
+    out0, lse0 = flash(q, k, v, causal=causal)
     o = out0.astype(jnp.float32)
     lse = lse0[..., :1]
 
@@ -149,7 +149,7 @@ def _ring_attention_local_flash(
 
         def visible(operands):
             qq, kk, vv = operands
-            bo, bl = flash(qq, _rep_kv(kk, group), _rep_kv(vv, group), causal=False)
+            bo, bl = flash(qq, kk, vv, causal=False)
             return bo.astype(jnp.float32), bl[..., :1]
 
         def masked(operands):
@@ -187,7 +187,6 @@ def _ring_flash_backward(
     block_q: int,
     block_k: int,
     interpret: bool,
-    group: int = 1,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Ring backward with the pallas flash backward kernels per block.
 
@@ -223,13 +222,10 @@ def _ring_flash_backward(
     )
 
     # hop 0: the local (diagonal) block — causal iff the caller is.
-    # GQA: kernels see full-width K/V; the group-sum afterwards is the
-    # exact transpose of the forward's repeat, and dk/dv then travel
-    # the ring at Hkv width
-    dq, dk, dv = blocks(
-        q, _rep_kv(k, group), _rep_kv(v, group), g, lse_b, delta_b, causal=causal
-    )
-    dk, dv = _red_kv(dk, group), _red_kv(dv, group)
+    # GQA: the backward kernels are GQA-native (dk/dv come out at Hkv
+    # width from the grouped kv-major grid), so the traveling
+    # accumulators stay at Hkv width with no repeat or group-sum here
+    dq, dk, dv = blocks(q, k, v, g, lse_b, delta_b, causal=causal)
 
     def body(carry, i):
         k_blk, v_blk, dk_blk, dv_blk, dq = carry
@@ -241,11 +237,7 @@ def _ring_flash_backward(
 
         def visible(operands):
             kk, vv = operands
-            dqi, dki, dvi = blocks(
-                q, _rep_kv(kk, group), _rep_kv(vv, group), g, lse_b, delta_b,
-                causal=False,
-            )
-            return dqi, _red_kv(dki, group), _red_kv(dvi, group)
+            return blocks(q, kk, vv, g, lse_b, delta_b, causal=False)
 
         def masked(operands):
             return (
@@ -304,7 +296,6 @@ def _make_flash_ring_local(
         block_q=block_q,
         block_k=block_k,
         interpret=interpret,
-        group=group,
     )
     xla_impl = functools.partial(
         _ring_attention_local,
@@ -336,7 +327,6 @@ def _make_flash_ring_local(
                 block_q=block_q,
                 block_k=block_k,
                 interpret=interpret,
-                group=group,
             )
         q, k, v = residuals
         _, vjp = jax.vjp(xla_impl, q, k, v)
@@ -445,7 +435,6 @@ def ring_attention(
     group = h // hkv
 
     if mesh.shape[axis_name] <= 1:
-        k, v = _rep_kv(k, group), _rep_kv(v, group)
         return dot_product_attention(q, k, v, causal=causal)
 
     n = mesh.shape[axis_name]

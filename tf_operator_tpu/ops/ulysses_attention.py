@@ -70,12 +70,12 @@ def _ulysses_local(
     # [B, Hl, Sl, D] -> [B, Hl/n, S, D]: give away head groups, collect
     # the full sequence for the heads we keep
     q = a2a(q, split_axis=1, concat_axis=2)
-    if kv_native_a2a:
-        k, v = (a2a(t, split_axis=1, concat_axis=2) for t in (k, v))
+    if not kv_native_a2a:
+        # kv heads don't split the axis: expand before the re-shard
         k, v = _rep_kv(k, group), _rep_kv(v, group)
-    else:
-        k, v = _rep_kv(k, group), _rep_kv(v, group)
-        k, v = (a2a(t, split_axis=1, concat_axis=2) for t in (k, v))
+    k, v = (a2a(t, split_axis=1, concat_axis=2) for t in (k, v))
+    # both local attentions are GQA-native (grouped einsum / kernel
+    # index maps), so native-width K/V go straight in
     if use_flash:
         o = flash_attention(q, k, v, causal, block_q, block_k, interpret)
     else:
@@ -131,7 +131,7 @@ def ulysses_attention(
     group = h // hkv
 
     if mesh.shape[axis_name] <= 1:
-        return dot_product_attention(q, _rep_kv(k, group), _rep_kv(v, group), causal=causal)
+        return dot_product_attention(q, k, v, causal=causal)
 
     n = mesh.shape[axis_name]
     tp_size = mesh.shape.get(heads_axis, 1) if heads_axis else 1
