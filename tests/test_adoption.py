@@ -291,3 +291,35 @@ class TestGcOwnerCheck:
         names = {p.metadata.name for p in backend.list_pods("default")}
         assert "gone-worker-0" not in names
         assert "gone-extra" not in names
+
+
+class TestAdoptionReentrancy:
+    """Round-2 review note: `update_pod_owner` emits MODIFIED
+    synchronously under the reconcile call stack, so adoption
+    re-enqueues the job mid-sync.  Pin that this is benign: the queue
+    dedupes, the follow-up sync is a no-op, and nothing duplicates."""
+
+    def test_sync_reentrant_enqueue_is_benign(self):
+        store, backend, c = harness()
+        # two ownerless pods so adoption fires twice in one sync
+        for i in range(2):
+            backend.create_pod(
+                make_pod(
+                    f"job-worker-{i}", replica_labels("job", ReplicaType.WORKER, i)
+                )
+            )
+        job = submit(store, c, new_job(worker=2))
+        # both adopted, nothing re-created by the re-entrant syncs
+        pods = backend.list_pods("default")
+        assert len(pods) == 2
+        assert all(p.metadata.owner_uid == job.metadata.uid for p in pods)
+        # the queue fully drained (sync_until_quiet returned) and the
+        # next manual sync is a no-op: same pods, same resource state
+        before = sorted(p.metadata.name for p in pods)
+        c.sync_until_quiet()
+        after = sorted(p.metadata.name for p in backend.list_pods("default"))
+        assert before == after
+        # adoption produced exactly one event per pod — the re-entrant
+        # passes did not re-adopt
+        events = [e.reason for e in c.recorder.for_object(job.key)]
+        assert events.count("AdoptedPod") == 2

@@ -227,3 +227,125 @@ class TestTrainerCheckpointer:
             MnistCNN(), TrainerConfig(optimizer="sgd"), mesh, cross_entropy_loss, batch
         )
         assert TrainerCheckpointer(str(tmp_path / "empty")).restore_latest(t) is None
+
+
+def test_eval_step_and_evaluate():
+    """Forward-only eval: no state mutation, deterministic, mean over
+    batches."""
+
+    import numpy as np
+
+    from tf_operator_tpu.models import gpt_tiny, lm_loss
+    from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+
+    mesh = make_mesh({"dp": 8})
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, size=(8, 16)).astype(np.int32)
+    batch = {"input_ids": ids}
+    tr = Trainer(
+        gpt_tiny(vocab_size=64, max_len=16, dropout=0.0),
+        TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+        mesh,
+        lm_loss,
+        batch,
+        init_args=(ids,),
+        shardings="logical",
+    )
+    step_before = int(tr.state.step)
+    m1 = tr.eval_step(tr.shard_batch(batch))
+    m2 = tr.eval_step(tr.shard_batch(batch))
+    assert int(tr.state.step) == step_before  # no update
+    assert float(m1["loss"]) == float(m2["loss"])  # deterministic
+    # evaluate() means over batches; single batch == eval_step
+    mean = tr.evaluate([batch])
+    np.testing.assert_allclose(mean["loss"], float(m1["loss"]), rtol=1e-6)
+    # train loss on the same batch matches eval loss at the same params
+    tm = tr.train_step(tr.shard_batch(batch))
+    np.testing.assert_allclose(float(tm["loss"]), float(m1["loss"]), rtol=1e-5)
+
+
+def test_adafactor_optimizer_trains():
+    import numpy as np
+
+    from tf_operator_tpu.models import gpt_tiny, lm_loss
+    from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+
+    mesh = make_mesh({"dp": 8})
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, 64, size=(8, 16)).astype(np.int32)
+    batch = {"input_ids": ids}
+    tr = Trainer(
+        gpt_tiny(vocab_size=64, max_len=16, dropout=0.0),
+        TrainerConfig(learning_rate=3e-2, optimizer="adafactor", grad_clip=0.0),
+        mesh,
+        lm_loss,
+        batch,
+        init_args=(ids,),
+        shardings="logical",
+    )
+    first = float(tr.train_step(tr.shard_batch(batch))["loss"])
+    for _ in range(5):
+        last = float(tr.train_step(tr.shard_batch(batch))["loss"])
+    assert last < first
+
+
+def test_clamp_preserves_param_sharding_with_adafactor():
+    """clamp_overranked must replicate only the over-ranked factored
+    optimizer stats — never the (boxed) 2-d kernels themselves."""
+
+    import numpy as np
+
+    from tf_operator_tpu.models import gpt_tiny, lm_loss
+    from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+
+    mesh = make_mesh({"fsdp": 2, "tp": 2, "dp": 2})
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, size=(4, 16)).astype(np.int32)
+    tr = Trainer(
+        gpt_tiny(vocab_size=64, max_len=16, dropout=0.0),
+        TrainerConfig(learning_rate=1e-2, optimizer="adafactor", grad_clip=0.0),
+        mesh,
+        lm_loss,
+        {"input_ids": ids},
+        init_args=(ids,),
+        shardings="logical",
+    )
+    wi = tr.state.params["layer_0"]["mlp"]["wi"]["kernel"]
+    leaf = getattr(wi, "value", wi)
+    axes = {ax for axs in leaf.sharding.spec if axs for ax in (axs if isinstance(axs, tuple) else (axs,))}
+    assert "tp" in axes, leaf.sharding  # kernel sharding survived the clamp
+    first = float(tr.train_step(tr.shard_batch({"input_ids": ids}))["loss"])
+    last = first
+    for _ in range(4):
+        last = float(tr.train_step(tr.shard_batch({"input_ids": ids}))["loss"])
+    assert last < first
+
+
+def test_eval_runs_inference_mode():
+    """With dropout active, eval_step (train=False) must differ from the
+    train-mode loss and stay deterministic."""
+
+    import numpy as np
+
+    from tf_operator_tpu.models import gpt_tiny, lm_loss
+    from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+
+    mesh = make_mesh({"dp": 8})
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, size=(8, 16)).astype(np.int32)
+    batch = {"input_ids": ids}
+    tr = Trainer(
+        gpt_tiny(vocab_size=64, max_len=16, dropout=0.3),
+        TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+        mesh,
+        lm_loss,
+        batch,
+        init_args=(ids,),
+        shardings="logical",
+    )
+    e1 = float(tr.eval_step(tr.shard_batch(batch))["loss"])
+    e2 = float(tr.eval_step(tr.shard_batch(batch))["loss"])
+    assert e1 == e2  # deterministic
+    t1 = float(tr.train_step(tr.shard_batch(batch))["loss"])
+    # dropout noise puts the train-mode loss away from the clean loss
+    assert abs(t1 - e1) > 1e-4

@@ -252,6 +252,16 @@ class Reconciler:
                 continue  # another controller's pod
             if not owner:
                 try:
+                    # NOTE re-entrancy: the fake/local backends emit the
+                    # resulting MODIFIED event *synchronously under this
+                    # call stack*, so the informer re-enqueues this job
+                    # while its sync is still running.  That is safe —
+                    # the workqueue dedupes and the follow-up sync is a
+                    # no-op (tests/test_adoption.py pins it) — but a
+                    # future backend that dispatches watch events on
+                    # another thread must still deliver them through the
+                    # informer (never mutate the cache directly), or the
+                    # cloned-pod bookkeeping below goes stale.
                     self.backend.update_pod_owner(
                         ns, pod.metadata.name, job.metadata.uid
                     )

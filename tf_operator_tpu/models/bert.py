@@ -133,7 +133,9 @@ def bert_tiny(vocab_size: int = 1024, max_len: int = 128, mesh=None, **kw) -> Be
     )
 
 
-def mlm_loss(params, state, batch: Dict, rng) -> Tuple[jax.Array, Dict]:
+def mlm_loss(
+    params, state, batch: Dict, rng, train: bool = True
+) -> Tuple[jax.Array, Dict]:
     """batch: input_ids (pre-masked), labels (-100 = unmasked position),
     optional attention_mask."""
 
@@ -141,7 +143,7 @@ def mlm_loss(params, state, batch: Dict, rng) -> Tuple[jax.Array, Dict]:
         {"params": params},
         batch["input_ids"],
         attention_mask=batch.get("attention_mask"),
-        train=True,
+        train=train,
         rngs={"dropout": rng},
     )
     labels = batch["labels"]

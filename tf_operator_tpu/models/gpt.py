@@ -96,11 +96,14 @@ def gpt_tiny(vocab_size: int = 1024, max_len: int = 256, mesh=None, **kw) -> Cau
     )
 
 
-def lm_loss(params, state, batch: Dict, rng) -> Tuple[jax.Array, Dict]:
-    """Next-token loss; batch: input_ids [B, S]."""
+def lm_loss(
+    params, state, batch: Dict, rng, train: bool = True
+) -> Tuple[jax.Array, Dict]:
+    """Next-token loss; batch: input_ids [B, S].  train=False gives the
+    inference-mode (no dropout) loss for Trainer.eval_step."""
 
     logits = state.apply_fn(
-        {"params": params}, batch["input_ids"], train=True, rngs={"dropout": rng}
+        {"params": params}, batch["input_ids"], train=train, rngs={"dropout": rng}
     )
     targets = batch["input_ids"][:, 1:]
     logits = logits[:, :-1]

@@ -223,13 +223,15 @@ def moe_tiny(
     )
 
 
-def moe_lm_loss(params, state, batch: Dict, rng) -> Tuple[jax.Array, Dict]:
+def moe_lm_loss(
+    params, state, batch: Dict, rng, train: bool = True
+) -> Tuple[jax.Array, Dict]:
     """Next-token loss + sowed MoE auxiliary losses."""
 
     logits, mutated = state.apply_fn(
         {"params": params},
         batch["input_ids"],
-        train=True,
+        train=train,
         rngs={"dropout": rng},
         mutable=["losses"],
     )

@@ -152,7 +152,9 @@ def t5_tiny(vocab_size: int = 1024, mesh=None, **kw) -> T5:
     )
 
 
-def seq2seq_loss(params, state, batch: Dict, rng) -> Tuple[jax.Array, Dict]:
+def seq2seq_loss(
+    params, state, batch: Dict, rng, train: bool = True
+) -> Tuple[jax.Array, Dict]:
     """batch: encoder_ids, decoder_ids (shifted right), targets,
     optional encoder_mask, target_mask (1 = count in loss)."""
 
@@ -161,7 +163,7 @@ def seq2seq_loss(params, state, batch: Dict, rng) -> Tuple[jax.Array, Dict]:
         batch["encoder_ids"],
         batch["decoder_ids"],
         encoder_mask=batch.get("encoder_mask"),
-        train=True,
+        train=train,
         rngs={"dropout": rng},
     )
     targets = batch["targets"]

@@ -82,11 +82,3 @@ def repeat_kv_heads(a: jax.Array, group: int) -> jax.Array:
     return a if group == 1 else jnp.repeat(a, group, axis=1)
 
 
-def sum_kv_head_groups(a: jax.Array, group: int) -> jax.Array:
-    """Transpose of `repeat_kv_heads` for gradients: sum each
-    query-head group back onto its shared K/V head."""
-
-    if group == 1:
-        return a
-    b, h, s, d = a.shape
-    return a.reshape(b, h // group, group, s, d).sum(axis=2)

@@ -62,9 +62,9 @@ def _ulysses_local(
 
     GQA: when the kv head count splits across the axis
     (kv_native_a2a), K/V ride the all-to-all at Hkv width — the
-    h/hkv bandwidth saving — and expand after; otherwise they expand
-    first (correct, no saving).  Autodiff handles both (the repeat's
-    transpose is the group-sum)."""
+    h/hkv bandwidth saving — and feed the GQA-native local attention
+    directly (no expansion anywhere); otherwise they expand before the
+    re-shard (correct, no saving).  Autodiff handles both."""
 
     a2a = functools.partial(lax.all_to_all, axis_name=axis_name, tiled=True)
     # [B, Hl, Sl, D] -> [B, Hl/n, S, D]: give away head groups, collect

@@ -107,4 +107,38 @@ def logical_shardings(
     import flax.linen as nn
 
     specs = nn.get_partition_spec(abstract_tree)
-    return nn.logical_to_mesh_sharding(specs, mesh, list(rules))
+    shardings = nn.logical_to_mesh_sharding(specs, mesh, list(rules))
+    return clamp_overranked(shardings, abstract_tree)
+
+
+def clamp_overranked(shardings: Any, abstract_tree: Any) -> Any:
+    """Replicate any leaf whose inferred spec cannot legally apply to
+    the value: more spec axes than dims, or a dim not divisible by its
+    mesh axes.  Factored optimizers (adafactor) keep a kernel's logical
+    axis names on RANK-1 row/col statistics and shape-(1,) placeholder
+    stats for vectors — replicating that O(rows + cols) state is
+    exactly adafactor's memory contract anyway.  Real params are
+    untouched (their annotated dims divide the mesh by design)."""
+
+    def fix(sh, ab):
+        if not isinstance(sh, NamedSharding):
+            return sh
+        # the abstract tree holds flax meta.Partitioned boxes at the
+        # annotated positions — unbox before reading the shape, or every
+        # annotated leaf reads as rank 0 and gets wrongly clamped
+        ab = getattr(ab, "value", ab)
+        shape = tuple(getattr(ab, "shape", ()) or ())
+        if len(sh.spec) > len(shape):
+            return NamedSharding(sh.mesh, PartitionSpec())
+        for dim, axes in zip(shape, sh.spec):
+            if not axes:
+                continue
+            axes_t = axes if isinstance(axes, tuple) else (axes,)
+            n = 1
+            for ax in axes_t:
+                n *= sh.mesh.shape[ax]
+            if n > 1 and dim % n:
+                return NamedSharding(sh.mesh, PartitionSpec())
+        return sh
+
+    return jax.tree_util.tree_map(fix, shardings, abstract_tree)

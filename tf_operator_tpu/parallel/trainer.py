@@ -50,7 +50,7 @@ class TrainerConfig:
     warmup_steps: int = 0
     total_steps: int = 10_000
     grad_clip: float = 1.0
-    optimizer: str = "adamw"  # adamw | sgd
+    optimizer: str = "adamw"  # adamw | sgd | adafactor
     momentum: float = 0.9
     remat: bool = False  # wrap loss in jax.checkpoint
     #: write step-series metrics every N steps when a SummaryWriter is
@@ -59,6 +59,10 @@ class TrainerConfig:
 
 
 def make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
+    if cfg.optimizer not in ("adamw", "sgd", "adafactor"):
+        raise ValueError(
+            f"optimizer must be one of adamw|sgd|adafactor, got {cfg.optimizer!r}"
+        )
     if cfg.warmup_steps > 0:
         sched = optax.warmup_cosine_decay_schedule(
             0.0, cfg.learning_rate, cfg.warmup_steps, max(cfg.total_steps, cfg.warmup_steps + 1)
@@ -67,6 +71,11 @@ def make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
         sched = optax.constant_schedule(cfg.learning_rate)
     if cfg.optimizer == "sgd":
         opt = optax.sgd(sched, momentum=cfg.momentum)
+    elif cfg.optimizer == "adafactor":
+        # the TPU-era classic: factored second moments — optimizer
+        # state is O(rows + cols) per matrix instead of O(rows * cols),
+        # the memory-side win that made large T5-class pretraining fit
+        opt = optax.adafactor(sched)
     else:
         # decay only matmul kernels — never norm scales/biases/embeddings'
         # 1-d params (standard transformer pretraining practice)
@@ -193,6 +202,57 @@ class Trainer:
             self._maybe_write_summary(metrics)
         return metrics
 
+    def _build_eval_step(self):
+        import inspect
+
+        loss_fn = self.loss_fn
+        # inference mode when the loss supports it (all shipped losses
+        # take train=; user losses without the kwarg run as written)
+        try:
+            takes_train = "train" in inspect.signature(loss_fn).parameters
+        except (TypeError, ValueError):
+            takes_train = False
+
+        def step(state: TrainState, batch: Batch) -> Dict[str, jax.Array]:
+            # fixed rng: deterministic; with takes_train the model runs
+            # deterministic anyway (no dropout, BN running stats)
+            kw = {"train": False} if takes_train else {}
+            loss, aux = loss_fn(state.params, state, batch, jax.random.PRNGKey(0), **kw)
+            metrics = dict(aux.get("metrics", {}))
+            metrics["loss"] = loss
+            return metrics
+
+        return jax.jit(
+            step,
+            in_shardings=(self.state_sharding, self.batch_sharding),
+            out_shardings=None,
+        )
+
+    def eval_step(self, batch: Batch) -> Dict[str, jax.Array]:
+        """Forward-only metrics on a held-out batch: no grads, no state
+        update, deterministic.  Same sharding as train_step."""
+
+        import flax.linen as nn
+
+        if not hasattr(self, "_eval_step_fn"):
+            self._eval_step_fn = self._build_eval_step()
+        with self.mesh, nn.logical_axis_rules(self._rules):
+            return self._eval_step_fn(self.state, batch)
+
+    def evaluate(self, batches) -> Dict[str, float]:
+        """Mean metrics over an iterable of (already host-side) batches."""
+
+        totals: Dict[str, float] = {}
+        n = 0
+        for batch in batches:
+            m = self.eval_step(self.shard_batch(batch))
+            for k, v in m.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+            n += 1
+        if not n:
+            raise ValueError("evaluate() got an empty batch iterable")
+        return {k: v / n for k, v in totals.items()}
+
     def _maybe_write_summary(self, metrics: Dict[str, jax.Array]) -> None:
         """Every cfg.summary_every steps: scalar metrics + steps/sec to
         the attached SummaryWriter.  The float() conversions synchronise
@@ -303,12 +363,14 @@ class Trainer:
         }
 
 
-def cross_entropy_loss(params, state: TrainState, batch: Batch, rng) -> Tuple[jax.Array, Dict]:
+def cross_entropy_loss(
+    params, state: TrainState, batch: Batch, rng, train: bool = True
+) -> Tuple[jax.Array, Dict]:
     """Supervised classification loss for models without mutable state
     (mnist CNN)."""
 
     logits = state.apply_fn(
-        {"params": params}, batch["image"], train=True, rngs={"dropout": rng}
+        {"params": params}, batch["image"], train=train, rngs={"dropout": rng}
     )
     loss = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), batch["label"]
@@ -318,18 +380,25 @@ def cross_entropy_loss(params, state: TrainState, batch: Batch, rng) -> Tuple[ja
 
 
 def batchnorm_cross_entropy_loss(
-    params, state: TrainState, batch: Batch, rng
+    params, state: TrainState, batch: Batch, rng, train: bool = True
 ) -> Tuple[jax.Array, Dict]:
     """Classification loss for BatchNorm models (ResNet): threads the
-    batch_stats collection through the step."""
+    batch_stats collection through the step.  train=False evaluates
+    with the RUNNING statistics and mutates nothing."""
 
-    logits, new_model_state = state.apply_fn(
-        {"params": params, **state.model_state},
-        batch["image"],
-        train=True,
-        mutable=["batch_stats"],
-        rngs={"dropout": rng},
-    )
+    if train:
+        logits, new_model_state = state.apply_fn(
+            {"params": params, **state.model_state},
+            batch["image"],
+            train=True,
+            mutable=["batch_stats"],
+            rngs={"dropout": rng},
+        )
+    else:
+        logits = state.apply_fn(
+            {"params": params, **state.model_state}, batch["image"], train=False
+        )
+        new_model_state = None
     loss = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), batch["label"]
     ).mean()
