@@ -178,3 +178,78 @@ def _shardings(mesh):
         "image": NamedSharding(mesh, P(("dp", "fsdp"), None, None, None)),
         "label": NamedSharding(mesh, P(("dp", "fsdp"))),
     }
+
+
+class TestTextCorpus:
+    """Byte-level text data path (data/text.py): idempotent generation,
+    disjoint shards, decode round-trip, learnable signal."""
+
+    @pytest.fixture(scope="class")
+    def text_dir(self, tmp_path_factory):
+        from tf_operator_tpu.data import ensure_text
+
+        return ensure_text(
+            str(tmp_path_factory.mktemp("data") / "text"),
+            n_chars=1 << 16, seq_len=64,
+        )
+
+    def test_idempotent_and_decodable(self, text_dir):
+        import os
+
+        from tf_operator_tpu.data import decode_bytes, ensure_text
+        from tf_operator_tpu.data.text import TextWindowSource
+
+        mtime = os.path.getmtime(os.path.join(text_dir, "tokens.npy"))
+        ensure_text(text_dir, n_chars=1 << 16, seq_len=64)  # no rewrite
+        assert os.path.getmtime(os.path.join(text_dir, "tokens.npy")) == mtime
+        src = TextWindowSource(text_dir)
+        assert len(src) == (1 << 16) // 64
+        txt = decode_bytes(src[0]["input_ids"])
+        assert " the " in txt  # grammar text, not noise
+
+    def test_shards_disjoint(self, text_dir):
+        from tf_operator_tpu.data import as_lm_batches, make_text_loader
+        from tf_operator_tpu.data.text import TextWindowSource
+
+        n_proc, per = 4, 8
+        seen = set()
+        for pid in range(n_proc):
+            loader = make_text_loader(
+                text_dir, per, process_id=pid, process_count=n_proc,
+                shuffle=False, num_epochs=1,
+            )
+            for batch in as_lm_batches(loader):
+                assert batch["input_ids"].dtype == np.int32
+                for row in batch["input_ids"]:
+                    key = row.tobytes()
+                    assert key not in seen  # no duplication across shards
+                    seen.add(key)
+        # shards cover most of the dataset (drop_remainder trims tails)
+        assert len(seen) >= (len(TextWindowSource(text_dir)) // per // n_proc) * per * n_proc * 0.9
+
+    def test_byte_lm_learns(self, text_dir):
+        """Loss must fall far below the uniform-bytes floor ln(256)."""
+
+        from tf_operator_tpu.data import as_lm_batches, make_text_loader
+        from tf_operator_tpu.models import llama_tiny, llama_loss
+        from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+
+        mesh = make_mesh({"dp": 8})
+        loader = make_text_loader(
+            text_dir, 16, process_id=0, process_count=1, num_epochs=None
+        )
+        batches = as_lm_batches(loader)
+        first = next(batches)
+        tr = Trainer(
+            llama_tiny(vocab_size=256, max_len=64, mesh=mesh),
+            TrainerConfig(learning_rate=3e-3, warmup_steps=5),
+            mesh,
+            llama_loss,
+            first,
+            init_args=(first["input_ids"],),
+            shardings="logical",
+        )
+        loss = None
+        for _ in range(40):
+            loss = float(tr.train_step(tr.shard_batch(next(batches)))["loss"])
+        assert loss < 3.0, loss  # uniform floor is ~5.55

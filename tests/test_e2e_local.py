@@ -273,3 +273,39 @@ class TestLocalE2E:
         assert done.status.replica_statuses[ReplicaType.WORKER].succeeded == 2
         log = backend.pod_log("default", "ppx-worker-0")
         assert "pp=2 dp=1" in log and "loss" in log
+
+    def test_llama_pretrain_two_workers_with_generation(self, local_harness, tmp_path):
+        """The modern-decoder example end to end under the operator:
+        2 processes train byte-level llama (RoPE+GQA+SwiGLU) on the
+        shared on-disk corpus (coordinator generates, worker 1 waits on
+        the commit record), then the collective params allgather feeds
+        cached generation on process 0."""
+
+        script = os.path.join(REPO, "examples", "llama_pretrain.py")
+        data_dir = str(tmp_path / "text-data")
+        store, backend, c = local_harness
+        job = new_job(
+            name="llama-pt", worker=2,
+            command=[
+                sys.executable, script, "--steps", "25",
+                "--batch-per-device", "8", "--seq-len", "64",
+                "--data-dir", data_dir, "--generate", "16",
+            ],
+        )
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].env = {
+            **cpu_env(),
+            # one device per worker (a real single-chip host) — without
+            # this the workers inherit the test runner's 8-virtual-device
+            # XLA_FLAGS and form a needlessly slow 16-rank gloo world
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        }
+        job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+        store.create(job)
+        done = wait_for(
+            store, "default", "llama-pt",
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED),
+            timeout=180.0,
+        )
+        assert done.status.replica_statuses[ReplicaType.WORKER].succeeded == 2
+        log0 = backend.pod_log("default", "llama-pt-worker-0")
+        assert "loss" in log0 and "sample:" in log0

@@ -12,12 +12,22 @@ from tf_operator_tpu.data.synthetic import (
     ensure_mnist,
     wait_for_dataset,
 )
+from tf_operator_tpu.data.text import (
+    as_lm_batches,
+    decode_bytes,
+    ensure_text,
+    make_text_loader,
+)
 
 __all__ = [
     "NpySource",
+    "as_lm_batches",
+    "decode_bytes",
     "device_prefetch",
     "ensure_imagenet_like",
     "ensure_mnist",
+    "ensure_text",
     "make_loader",
+    "make_text_loader",
     "wait_for_dataset",
 ]

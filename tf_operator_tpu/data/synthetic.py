@@ -52,12 +52,12 @@ def mnist_meta(n: int = 16384, seed: int = 0, classes: int = 10) -> dict:
     return {"kind": "mnist-like", "n": n, "seed": seed, "classes": classes}
 
 
-def _write(directory: str, images: np.ndarray, labels: np.ndarray, meta: dict) -> None:
-    """Two-phase commit: retract meta first (readers poll it — see
-    wait_for_dataset), write data files via tmp+rename so a reader
-    never mmaps a half-written array, land meta last as the commit
-    record.  This also makes REgeneration (stale meta from different
-    parameters) safe."""
+def commit_arrays(directory: str, arrays: dict, meta: dict) -> None:
+    """Two-phase commit for any name→array dataset layout: retract meta
+    first (readers poll it — see wait_for_dataset), write data files
+    via tmp+rename so a reader never mmaps a half-written array, land
+    meta last as the commit record.  This also makes REgeneration
+    (stale meta from different parameters) safe."""
 
     os.makedirs(directory, exist_ok=True)
     meta_path = os.path.join(directory, _META)
@@ -66,7 +66,7 @@ def _write(directory: str, images: np.ndarray, labels: np.ndarray, meta: dict) -
     except FileNotFoundError:
         pass
     pid = os.getpid()
-    for name, arr in (("images.npy", images), ("labels.npy", labels)):
+    for name, arr in arrays.items():
         # tmp must end in .npy or np.save appends the suffix itself
         tmp = os.path.join(directory, f".{name[:-4]}.{pid}.tmp.npy")
         np.save(tmp, arr)
@@ -75,6 +75,10 @@ def _write(directory: str, images: np.ndarray, labels: np.ndarray, meta: dict) -
     with open(tmp, "w") as f:
         json.dump(meta, f)
     os.replace(tmp, meta_path)
+
+
+def _write(directory: str, images: np.ndarray, labels: np.ndarray, meta: dict) -> None:
+    commit_arrays(directory, {"images.npy": images, "labels.npy": labels}, meta)
 
 
 def _exists(directory: str, meta: dict) -> bool:

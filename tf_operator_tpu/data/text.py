@@ -1,0 +1,148 @@
+"""Deterministic on-disk text corpus for byte-level LM training.
+
+Same contract as the image datasets in data/synthetic.py (no network on
+this box — SURVEY.md §7): a *learnable* procedural corpus generated
+once to disk, then always read through the grain pipeline with
+per-process disjoint shards.
+
+Learnable by construction: sentences come from a small templated
+grammar over a fixed word list, so a byte-level model can learn word
+spellings, spaces, and template structure — loss drops far below the
+uniform-bytes ln(256) ≈ 5.55 floor within tens of steps (tested).
+Byte-level means the tokenizer is the identity on uint8: vocab 256, no
+vocabulary files, fully deterministic.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from tf_operator_tpu.data.synthetic import _exists, commit_arrays
+
+_NOUNS = (
+    "operator worker slice tensor kernel gradient token shard mesh ring "
+    "queue batch buffer device compiler schedule"
+).split()
+_VERBS = (
+    "schedules reduces shards rotates compiles streams permutes gathers "
+    "fuses drains adopts restarts"
+).split()
+_ADJS = (
+    "sharded fused causal atomic idle hot replicated factored lazy strict"
+).split()
+
+
+def text_meta(n_chars: int = 1 << 20, seq_len: int = 256, seed: int = 0) -> dict:
+    return {
+        "kind": "grammar_bytes",
+        "n_chars": n_chars,
+        "seq_len": seq_len,
+        "seed": seed,
+    }
+
+
+def _generate_corpus(n_chars: int, seed: int) -> str:
+    r = np.random.RandomState(seed)
+    parts = []
+    total = 0
+    while total < n_chars:
+        s = (
+            f"the {r.choice(_ADJS)} {r.choice(_NOUNS)} "
+            f"{r.choice(_VERBS)} the {r.choice(_NOUNS)}. "
+        )
+        parts.append(s)
+        total += len(s)
+    return "".join(parts)[:n_chars]
+
+
+def ensure_text(
+    directory: str, n_chars: int = 1 << 20, seq_len: int = 256, seed: int = 0
+) -> str:
+    """Generate (idempotent) and return the corpus directory.
+
+    Layout: ``tokens.npy`` [n_windows, seq_len] uint8 (non-overlapping
+    windows of the byte stream) + the meta commit record.
+    """
+
+    meta = text_meta(n_chars, seq_len, seed)
+    if _exists(directory, meta):
+        return directory
+    text = _generate_corpus(n_chars, seed)
+    tokens = np.frombuffer(text.encode("ascii"), dtype=np.uint8)
+    n_windows = len(tokens) // seq_len
+    windows = tokens[: n_windows * seq_len].reshape(n_windows, seq_len)
+    commit_arrays(directory, {"tokens.npy": windows}, meta)
+    return directory
+
+
+def decode_bytes(arr) -> str:
+    """uint8/int token array → printable string (the 'detokenizer')."""
+
+    b = bytes(int(x) & 0xFF for x in np.asarray(arr).reshape(-1))
+    return b.decode("ascii", errors="replace")
+
+
+class TextWindowSource:
+    """grain RandomAccessDataSource over the tokens.npy layout
+    (memory-mapped — workers share page cache)."""
+
+    def __init__(self, directory: str):
+        self.tokens = np.load(os.path.join(directory, "tokens.npy"), mmap_mode="r")
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    def __getitem__(self, idx: int) -> dict:
+        return {"input_ids": np.asarray(self.tokens[idx])}
+
+
+def make_text_loader(
+    directory: str,
+    per_process_batch: int,
+    *,
+    process_id: Optional[int] = None,
+    process_count: Optional[int] = None,
+    seed: int = 0,
+    shuffle: bool = True,
+    num_epochs: Optional[int] = None,
+    worker_count: int = 0,
+):
+    """Sharded grain DataLoader yielding {"input_ids": [B, S] uint8}
+    per-process batches from DISJOINT window shards (same sharding
+    contract as data/loader.py's image loader)."""
+
+    import grain.python as grain
+
+    if process_id is None or process_count is None:
+        import jax
+
+        process_id = jax.process_index() if process_id is None else process_id
+        process_count = jax.process_count() if process_count is None else process_count
+
+    source = TextWindowSource(directory)
+    sampler = grain.IndexSampler(
+        num_records=len(source),
+        shard_options=grain.ShardOptions(
+            shard_index=process_id, shard_count=process_count, drop_remainder=True
+        ),
+        shuffle=shuffle,
+        num_epochs=num_epochs,
+        seed=seed,
+    )
+    return grain.DataLoader(
+        data_source=source,
+        sampler=sampler,
+        operations=[grain.Batch(per_process_batch, drop_remainder=True)],
+        worker_count=worker_count,
+    )
+
+
+def as_lm_batches(loader):
+    """Loader dicts → int32 model batches (the byte 'tokenizer' is a
+    cast; vocab is 256)."""
+
+    for batch in loader:
+        yield {"input_ids": batch["input_ids"].astype(np.int32)}

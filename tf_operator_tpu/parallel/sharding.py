@@ -108,7 +108,18 @@ def logical_shardings(
 
     specs = nn.get_partition_spec(abstract_tree)
     shardings = nn.logical_to_mesh_sharding(specs, mesh, list(rules))
-    return clamp_overranked(shardings, abstract_tree)
+    # clamp ONLY the optimizer-state subtree: factored optimizers
+    # (adafactor) put a kernel's axis names on mis-shaped statistics
+    # there, and replicating those is their memory contract.  Params
+    # themselves stay unclamped so a genuinely indivisible annotated
+    # dim still fails loudly at jit time instead of silently
+    # replicating the model.
+    opt = getattr(abstract_tree, "opt_state", None)
+    if opt is not None:
+        shardings = shardings.replace(
+            opt_state=clamp_overranked(shardings.opt_state, opt)
+        )
+    return shardings
 
 
 def clamp_overranked(shardings: Any, abstract_tree: Any) -> Any:
@@ -117,8 +128,9 @@ def clamp_overranked(shardings: Any, abstract_tree: Any) -> Any:
     mesh axes.  Factored optimizers (adafactor) keep a kernel's logical
     axis names on RANK-1 row/col statistics and shape-(1,) placeholder
     stats for vectors — replicating that O(rows + cols) state is
-    exactly adafactor's memory contract anyway.  Real params are
-    untouched (their annotated dims divide the mesh by design)."""
+    exactly adafactor's memory contract anyway.  Applied to
+    optimizer-state subtrees only (see logical_shardings) so model
+    params keep loud jit-time errors for real misconfigurations."""
 
     def fix(sh, ab):
         if not isinstance(sh, NamedSharding):

@@ -34,6 +34,26 @@ def standard_parser(description: str, **defaults) -> argparse.ArgumentParser:
     return p
 
 
+def gather_params(trainer):
+    """Host-local copy of the (possibly globally-sharded) params.
+
+    COLLECTIVE: every process must call this.  A jitted identity with
+    fully-replicated out_shardings makes XLA all-gather the shards
+    (ICI/DCN — or gloo on CPU worlds); afterwards each process holds an
+    addressable replica that device_get can fetch.  This is the right
+    primitive for post-training single-host work (generation, export) —
+    `process_allgather` would stack a bogus leading process axis on
+    already-global arrays.
+    """
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    replicated = NamedSharding(trainer.mesh, PartitionSpec())
+    rep = jax.jit(lambda t: t, out_shardings=replicated)(trainer.state.params)
+    return jax.device_get(rep)
+
+
 def batch_sizes(batch_per_device: int):
     """(global, per-process) batch sizes for the current world."""
 
