@@ -221,6 +221,32 @@ def bench_training() -> dict:
     out["bert_base_examples_per_sec_per_chip"] = round(
         stats["examples_per_sec"] / n_dev, 1
     )
+
+    # llama-mini (~120M: RoPE + GQA 16q:4kv + SwiGLU), seq 1024, bf16 —
+    # exercises the flash fwd+bwd kernels at a realistic long-ish seq
+    from tf_operator_tpu.models import LlamaLM, llama_loss
+    from tf_operator_tpu.models.transformer import TransformerConfig
+
+    seq, per_chip = 1024, 8
+    cfg = TransformerConfig(
+        vocab_size=32000, hidden=1024, n_heads=16, head_dim=64,
+        n_layers=8, mlp_dim=2816, max_len=seq, dropout=0.0,
+        rope=True, attn_bias=False, n_kv_heads=4,
+    )
+    lm = {"input_ids": jnp.asarray(r.randint(0, 32000, size=(per_chip * n_dev, seq)), jnp.int32)}
+    lm_trainer = Trainer(
+        LlamaLM(cfg),
+        TrainerConfig(learning_rate=1e-3),
+        make_mesh({"fsdp": n_dev}),
+        llama_loss,
+        lm,
+        init_args=(lm["input_ids"],),
+        shardings="logical",
+    )
+    stats = lm_trainer.benchmark(lm, steps=10, warmup=3)
+    out["llama_mini_tokens_per_sec_per_chip"] = round(
+        stats["steps_per_sec"] * per_chip * seq, 1
+    )
     return out
 
 

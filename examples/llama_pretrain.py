@@ -89,7 +89,10 @@ def main() -> int:
             gen_model = llama_tiny(vocab_size=256, max_len=args.seq_len)
             prompt_txt = "the sharded "
             prompt = np.frombuffer(prompt_txt.encode(), np.uint8)[None].astype(np.int32)
-            out = generate(gen_model, params, prompt, max_new_tokens=args.generate)
+            # the KV cache is max_len slots: cap the ask so a short
+            # --seq-len can't fail the job after training succeeded
+            n_new = min(args.generate, args.seq_len - prompt.shape[1])
+            out = generate(gen_model, params, prompt, max_new_tokens=n_new)
             print(f"prompt: {prompt_txt!r}")
             print(f"sample: {decode_bytes(out[0, prompt.shape[1]:])!r}", flush=True)
     return 0

@@ -349,3 +349,58 @@ def test_eval_runs_inference_mode():
     t1 = float(tr.train_step(tr.shard_batch(batch))["loss"])
     # dropout noise puts the train-mode loss away from the clean loss
     assert abs(t1 - e1) > 1e-4
+
+
+def test_gradient_accumulation_matches_big_batch():
+    """accum_steps=2 over two half-batches must equal one SGD step on
+    the averaged gradient (i.e. the full batch, since loss is a mean)."""
+
+    import numpy as np
+
+    from tf_operator_tpu.models import gpt_tiny, lm_loss
+    from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+
+    mesh = make_mesh({"dp": 8})
+    rng = np.random.RandomState(0)
+    full = rng.randint(0, 64, size=(16, 16)).astype(np.int32)
+    halves = [full[:8], full[8:]]
+
+    def build(accum):
+        return Trainer(
+            gpt_tiny(vocab_size=64, max_len=16, dropout=0.0),
+            TrainerConfig(
+                learning_rate=1e-1, optimizer="sgd", momentum=0.0,
+                grad_clip=0.0, accum_steps=accum,
+            ),
+            mesh,
+            lm_loss,
+            {"input_ids": halves[0]},
+            init_args=(halves[0],),
+            shardings="logical",
+            seed=3,
+        )
+
+    import jax
+
+    tr_acc = build(2)
+    p0 = jax.device_get(tr_acc.state.params)
+    tr_acc.train_step(tr_acc.shard_batch({"input_ids": np.ascontiguousarray(halves[0])}))
+    # mid-window: gradients accumulated, NO update applied yet
+    p_mid = jax.device_get(tr_acc.state.params)
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p_mid)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    tr_acc.train_step(tr_acc.shard_batch({"input_ids": np.ascontiguousarray(halves[1])}))
+
+    tr_big = build(1)
+    tr_big.train_step(tr_big.shard_batch({"input_ids": full}))
+
+    pa = jax.device_get(tr_acc.state.params)
+    pb = jax.device_get(tr_big.state.params)
+    moved = False
+    for a, b, z in zip(jax.tree.leaves(pa), jax.tree.leaves(pb), jax.tree.leaves(p0)):
+        # the update itself must match the big-batch step; bf16
+        # activations round differently per batch composition, so
+        # near-equal (rounding scale), not bit-equal
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+        moved = moved or not np.array_equal(np.asarray(a), np.asarray(z))
+    assert moved  # the end-of-window step really applied an update

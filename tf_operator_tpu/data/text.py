@@ -81,7 +81,7 @@ def ensure_text(
 def decode_bytes(arr) -> str:
     """uint8/int token array → printable string (the 'detokenizer')."""
 
-    b = bytes(int(x) & 0xFF for x in np.asarray(arr).reshape(-1))
+    b = np.asarray(arr).reshape(-1).astype(np.uint8).tobytes()
     return b.decode("ascii", errors="replace")
 
 

@@ -53,6 +53,10 @@ class TrainerConfig:
     optimizer: str = "adamw"  # adamw | sgd | adafactor
     momentum: float = 0.9
     remat: bool = False  # wrap loss in jax.checkpoint
+    #: gradient accumulation: apply the optimizer every k train_steps,
+    #: averaging grads over the window (optax.MultiSteps) — the
+    #: effective batch is k x the device batch at the same HBM footprint
+    accum_steps: int = 1
     #: write step-series metrics every N steps when a SummaryWriter is
     #: attached (utils/summaries.py; mnist_with_summaries parity)
     summary_every: int = 10
@@ -84,7 +88,9 @@ def make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
 
         opt = optax.adamw(sched, weight_decay=cfg.weight_decay, mask=decay_mask)
     if cfg.grad_clip and cfg.grad_clip > 0:
-        return optax.chain(optax.clip_by_global_norm(cfg.grad_clip), opt)
+        opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), opt)
+    if cfg.accum_steps > 1:
+        opt = optax.MultiSteps(opt, every_k_schedule=cfg.accum_steps)
     return opt
 
 
