@@ -27,6 +27,11 @@ def main() -> int:
     parser.add_argument("--seq-len", type=int, default=64)
     parser.add_argument("--n-layers", type=int, default=4)
     parser.add_argument("--hidden", type=int, default=128)
+    parser.add_argument(
+        "--family", choices=["gpt", "llama"], default="gpt",
+        help="block family for the stages: gpt (learned pos, relu) or "
+             "llama (RoPE + GQA + SwiGLU, no biases)",
+    )
     args = parser.parse_args()
 
     initialize()
@@ -46,16 +51,23 @@ def main() -> int:
         return 2
     mesh = make_mesh({"pp": args.pp, "dp": n_dev // args.pp})
 
+    llama = args.family == "llama"
     cfg = TransformerConfig(
         vocab_size=512,
         hidden=args.hidden,
         n_heads=4,
         head_dim=args.hidden // 4,
         n_layers=args.n_layers,
-        mlp_dim=4 * args.hidden,
+        mlp_dim=(11 * args.hidden // 4) if llama else 4 * args.hidden,
         max_len=args.seq_len,
+        rope=llama,
+        attn_bias=not llama,
+        n_kv_heads=2 if llama else None,
     )
-    model = PipelinedLM(cfg, mesh, microbatches=args.microbatches)
+    model = PipelinedLM(
+        cfg, mesh, microbatches=args.microbatches,
+        activation="swiglu" if llama else "relu",
+    )
     # every process inits identically (same seed); shard_params lays the
     # stages onto the pp axis — across processes when the mesh spans them
     params = model.shard_params(model.init(jax.random.PRNGKey(0)))
@@ -109,7 +121,7 @@ def main() -> int:
             loop,
             ids,
             args.steps,
-            tag=f"gpt pp={args.pp} dp={dp} mb={args.microbatches}",
+            tag=f"{args.family} pp={args.pp} dp={dp} mb={args.microbatches}",
         )
     return 0
 

@@ -92,9 +92,13 @@ def main() -> int:
             # the KV cache is max_len slots: cap the ask so a short
             # --seq-len can't fail the job after training succeeded
             n_new = min(args.generate, args.seq_len - prompt.shape[1])
-            out = generate(gen_model, params, prompt, max_new_tokens=n_new)
-            print(f"prompt: {prompt_txt!r}")
-            print(f"sample: {decode_bytes(out[0, prompt.shape[1]:])!r}", flush=True)
+            if n_new < 1:
+                print(f"seq-len {args.seq_len} leaves no room after the "
+                      f"{prompt.shape[1]}-byte prompt; skipping generation")
+            else:
+                out = generate(gen_model, params, prompt, max_new_tokens=n_new)
+                print(f"prompt: {prompt_txt!r}")
+                print(f"sample: {decode_bytes(out[0, prompt.shape[1]:])!r}", flush=True)
     return 0
 
 

@@ -204,3 +204,50 @@ class TestPipelinedLM:
         )
         with pytest.raises(ValueError, match="divisible"):
             PipelinedLM(cfg, mesh)
+
+
+def test_pipelined_llama_blocks_train():
+    """PP x modern blocks: rope + GQA + swiglu stages over pp=4, loss
+    decreases and matches the sequential reference."""
+
+    import numpy as np
+    import optax
+
+    from tf_operator_tpu.models import PipelinedLM, lm_reference_apply
+    from tf_operator_tpu.models.transformer import TransformerConfig
+    from tf_operator_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"pp": 4, "dp": 2})
+    cfg = TransformerConfig(
+        vocab_size=64, hidden=32, n_heads=4, head_dim=8,
+        n_layers=4, mlp_dim=88, max_len=16,
+        rope=True, attn_bias=False, n_kv_heads=2,
+    )
+    model = PipelinedLM(cfg, mesh, microbatches=2, activation="swiglu")
+    params = model.shard_params(model.init(jax.random.PRNGKey(0)))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, size=(8, 16)))
+
+    with mesh:
+        logits_pp = jax.jit(model.apply)(params, ids)
+    logits_ref = lm_reference_apply(model, params, ids)
+    np.testing.assert_allclose(
+        np.asarray(logits_pp), np.asarray(logits_ref), atol=2e-2, rtol=2e-2
+    )
+    # swiglu params really exist in the stage stacks
+    assert "wi_gate" in str(jax.tree_util.tree_structure(params["stages"]))
+
+    tx = optax.sgd(0.3)
+
+    @jax.jit
+    def step(p, o, batch):
+        loss, grads = jax.value_and_grad(model.loss)(p, batch)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    with mesh:
+        opt = tx.init(params)
+        first = None
+        for _ in range(8):
+            params, opt, loss = step(params, opt, ids)
+            first = float(loss) if first is None else first
+    assert float(loss) < first

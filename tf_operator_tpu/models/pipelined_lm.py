@@ -44,7 +44,12 @@ class PipelinedLM:
         mesh: Mesh,
         *,
         microbatches: int = 4,
+        activation: str = "relu",
     ):
+        # the cfg carries the family knobs (rope/GQA/attn_bias), so a
+        # pipelined LLAMA is cfg(rope=True, attn_bias=False,
+        # n_kv_heads=...) + activation="swiglu" — same stages, modern
+        # blocks
         self.cfg = cfg
         self.mesh = mesh
         self.pp = mesh.shape[AXIS_PP]
@@ -54,7 +59,7 @@ class PipelinedLM:
             )
         self.layers_per_stage = cfg.n_layers // self.pp
         self.microbatches = microbatches
-        self._layer = DecoderLayer(cfg, cross=False)
+        self._layer = DecoderLayer(cfg, cross=False, activation=activation)
         self._embed = Embed(cfg)
         self._ln = LayerNorm(cfg, rms=True)
 
@@ -67,7 +72,12 @@ class PipelinedLM:
         r_embed, r_pos, r_ln, r_layers = jax.random.split(rng, 4)
 
         embed = self._embed.init(r_embed, dummy_ids)["params"]
-        pos = jax.random.normal(r_pos, (cfg.max_len, cfg.hidden), jnp.float32) * 0.02
+        # rope families encode position inside attention — no table
+        pos = (
+            None
+            if cfg.rope
+            else jax.random.normal(r_pos, (cfg.max_len, cfg.hidden), jnp.float32) * 0.02
+        )
         ln = self._ln.init(r_ln, dummy_x)["params"]
 
         # one init per layer, stacked [pp, layers_per_stage, ...]
@@ -83,7 +93,10 @@ class PipelinedLM:
             ]
             per_stage.append(jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *chunk))
         stages = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage)
-        return {"embed": embed, "pos": pos, "ln": ln, "stages": stages}
+        out = {"embed": embed, "ln": ln, "stages": stages}
+        if pos is not None:
+            out["pos"] = pos
+        return out
 
     def shard_params(self, params: Dict[str, Any]) -> Dict[str, Any]:
         """Lay params on the mesh: stages over pp, the rest replicated.
@@ -104,14 +117,16 @@ class PipelinedLM:
                 x.shape, sharding, lambda idx: x[idx]
             )
 
-        return {
+        out = {
             "embed": jax.tree_util.tree_map(lambda x: put(x, repl), params["embed"]),
-            "pos": put(params["pos"], repl),
             "ln": jax.tree_util.tree_map(lambda x: put(x, repl), params["ln"]),
             "stages": jax.tree_util.tree_map(
                 lambda x: put(x, stage), params["stages"]
             ),
         }
+        if "pos" in params:
+            out["pos"] = put(params["pos"], repl)
+        return out
 
     # -- forward ------------------------------------------------------------
 
@@ -119,7 +134,8 @@ class PipelinedLM:
         cfg = self.cfg
         _, s = input_ids.shape
         x = self._embed.apply({"params": params["embed"]}, input_ids)
-        x = x + params["pos"][None, :s].astype(cfg.dtype)
+        if "pos" in params:
+            x = x + params["pos"][None, :s].astype(cfg.dtype)
 
         layer = self._layer
 
@@ -159,7 +175,8 @@ def lm_reference_apply(model: PipelinedLM, params: Dict[str, Any], input_ids):
     cfg = model.cfg
     _, s = input_ids.shape
     x = model._embed.apply({"params": params["embed"]}, input_ids)
-    x = x + params["pos"][None, :s].astype(cfg.dtype)
+    if "pos" in params:
+        x = x + params["pos"][None, :s].astype(cfg.dtype)
     flat = jax.tree_util.tree_map(
         lambda p: p.reshape(cfg.n_layers, *p.shape[2:]), params["stages"]
     )

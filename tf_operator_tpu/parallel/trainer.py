@@ -47,6 +47,9 @@ class TrainState(train_state.TrainState):
 class TrainerConfig:
     learning_rate: float = 1e-3
     weight_decay: float = 1e-4
+    #: schedule horizons count OPTIMIZER UPDATES — with accum_steps=k
+    #: that is one per k train_steps, so express warmup/total in update
+    #: units (train steps / k) when accumulating
     warmup_steps: int = 0
     total_steps: int = 10_000
     grad_clip: float = 1.0
