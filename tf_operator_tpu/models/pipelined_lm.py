@@ -134,7 +134,10 @@ class PipelinedLM:
         cfg = self.cfg
         _, s = input_ids.shape
         x = self._embed.apply({"params": params["embed"]}, input_ids)
-        if "pos" in params:
+        if not cfg.rope:
+            # gate on the config (init's source of truth): a params
+            # dict missing "pos" here should KeyError, not silently
+            # train position-blind
             x = x + params["pos"][None, :s].astype(cfg.dtype)
 
         layer = self._layer
@@ -175,7 +178,7 @@ def lm_reference_apply(model: PipelinedLM, params: Dict[str, Any], input_ids):
     cfg = model.cfg
     _, s = input_ids.shape
     x = model._embed.apply({"params": params["embed"]}, input_ids)
-    if "pos" in params:
+    if not cfg.rope:
         x = x + params["pos"][None, :s].astype(cfg.dtype)
     flat = jax.tree_util.tree_map(
         lambda p: p.reshape(cfg.n_layers, *p.shape[2:]), params["stages"]

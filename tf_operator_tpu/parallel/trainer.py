@@ -248,6 +248,23 @@ class Trainer:
         with self.mesh, nn.logical_axis_rules(self._rules):
             return self._eval_step_fn(self.state, batch)
 
+    def generate(self, prompt_ids, max_new_tokens: int, **kw):
+        """Sharded autoregressive generation with the LIVE TrainState
+        params — no host gather, no replication.  The decode graph runs
+        under the mesh + logical rules, so tp-sharded projections stay
+        sharded and XLA inserts the collectives (the scalable story:
+        params that never fit one host still decode).  kw passes
+        through to models.decode.generate (temperature/top_k/rng)."""
+
+        import flax.linen as nn
+
+        from tf_operator_tpu.models.decode import generate
+
+        with self.mesh, nn.logical_axis_rules(self._rules):
+            return generate(
+                self.model, self.state.params, prompt_ids, max_new_tokens, **kw
+            )
+
     def evaluate(self, batches) -> Dict[str, float]:
         """Mean metrics over an iterable of (already host-side) batches."""
 
