@@ -140,3 +140,30 @@ class TestRoutingMath:
         _, mutated = block.apply(variables, x, mutable=["losses"])
         aux = float(jax.tree_util.tree_leaves(mutated["losses"])[0])
         assert aux > 0.0
+
+
+def test_moe_with_sequence_parallelism_matches_no_sp():
+    """ep x sp composition: expert-parallel FFNs + ring attention over
+    sequence shards must train identically to the unsharded layout
+    (routing is a global dense dispatch — sharding cannot change it)."""
+
+    ids = np.random.RandomState(5).randint(0, 128, size=(8, 32)).astype(np.int32)
+    losses = {}
+    for label, shape in [("nosp", {"dp": 4, "ep": 2}), ("sp", {"dp": 2, "ep": 2, "sp": 2})]:
+        mesh = make_mesh(shape)
+        model = moe_tiny(vocab_size=128, max_len=32, num_experts=4, mesh=mesh)
+        tr = Trainer(
+            model,
+            TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+            mesh,
+            moe_lm_loss,
+            {"input_ids": ids},
+            init_args=(ids,),
+            shardings="logical",
+            seed=9,
+        )
+        losses[label] = [
+            float(tr.train_step(tr.shard_batch({"input_ids": ids}))["loss"])
+            for _ in range(3)
+        ]
+    np.testing.assert_allclose(losses["nosp"], losses["sp"], rtol=2e-4, atol=2e-4)

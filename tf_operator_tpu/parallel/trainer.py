@@ -248,22 +248,43 @@ class Trainer:
         with self.mesh, nn.logical_axis_rules(self._rules):
             return self._eval_step_fn(self.state, batch)
 
-    def generate(self, prompt_ids, max_new_tokens: int, **kw):
+    def generate(
+        self,
+        prompt_ids,
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        rng: Optional[Any] = None,
+    ):
         """Sharded autoregressive generation with the LIVE TrainState
         params — no host gather, no replication.  The decode graph runs
         under the mesh + logical rules, so tp-sharded projections stay
         sharded and XLA inserts the collectives (the scalable story:
-        params that never fit one host still decode).  kw passes
-        through to models.decode.generate (temperature/top_k/rng)."""
+        params that never fit one host still decode).  The whole call
+        is jitted once per (prompt shape, max_new_tokens, sampling
+        config) and cached — repeat calls are a single XLA program."""
 
         import flax.linen as nn
 
         from tf_operator_tpu.models.decode import generate
 
-        with self.mesh, nn.logical_axis_rules(self._rules):
-            return generate(
-                self.model, self.state.params, prompt_ids, max_new_tokens, **kw
+        if temperature != 0.0 and rng is None:
+            raise ValueError("temperature sampling needs an explicit rng key")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)  # greedy: never consumed meaningfully
+        if not hasattr(self, "_gen_cache"):
+            self._gen_cache = {}
+        key = (tuple(prompt_ids.shape), max_new_tokens, temperature, top_k)
+        if key not in self._gen_cache:
+            self._gen_cache[key] = jax.jit(
+                lambda params, prompt, r: generate(
+                    self.model, params, prompt, max_new_tokens,
+                    temperature=temperature, top_k=top_k, rng=r,
+                )
             )
+        with self.mesh, nn.logical_axis_rules(self._rules):
+            return self._gen_cache[key](self.state.params, prompt_ids, rng)
 
     def evaluate(self, batches) -> Dict[str, float]:
         """Mean metrics over an iterable of (already host-side) batches."""
