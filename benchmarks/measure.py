@@ -247,6 +247,16 @@ def bench_training() -> dict:
     out["llama_mini_tokens_per_sec_per_chip"] = round(
         stats["steps_per_sec"] * per_chip * seq, 1
     )
+
+    # serving-side: greedy decode throughput with the live sharded
+    # params (jitted once; second call is the steady-state number)
+    prompt = lm["input_ids"][:8, :16]
+    n_new = 64
+    np.asarray(lm_trainer.generate(prompt, max_new_tokens=n_new))  # compile
+    t0 = time.perf_counter()
+    np.asarray(lm_trainer.generate(prompt, max_new_tokens=n_new))
+    dt = time.perf_counter() - t0
+    out["llama_mini_decode_tokens_per_sec"] = round(8 * n_new / dt, 1)
     return out
 
 

@@ -29,7 +29,11 @@ from tf_operator_tpu.parallel.mesh import (
     make_mesh,
     replicated,
 )
-from tf_operator_tpu.parallel.checkpoint import TrainerCheckpointer
+from tf_operator_tpu.parallel.checkpoint import (
+    TrainerCheckpointer,
+    export_params,
+    load_params,
+)
 from tf_operator_tpu.parallel.pipeline import (
     pipeline_apply,
     pipelined,
@@ -60,6 +64,8 @@ __all__ = [
     "Trainer",
     "TrainerCheckpointer",
     "TrainerConfig",
+    "export_params",
+    "load_params",
     "pipeline_apply",
     "pipelined",
     "stack_stage_params",

@@ -70,3 +70,35 @@ class TrainerCheckpointer:
     def close(self) -> None:
         self.manager.wait_until_finished()
         self.manager.close()
+
+
+def export_params(trainer, directory: str) -> None:
+    """Params-only export for serving — the train→checkpoint→serve leg.
+
+    COLLECTIVE on multi-host meshes (orbax writes each process's shards
+    directly; nothing funnels through host 0).  Partitioned metadata is
+    unboxed first so the artifact is a plain array tree any consumer can
+    load without flax sharding annotations."""
+
+    import orbax.checkpoint as ocp
+    from flax.core import meta
+
+    params = meta.unbox(trainer.state.params)
+    ckptr = ocp.StandardCheckpointer()
+    # force: re-exporting to a stable serving path ("latest/") must
+    # overwrite, not raise
+    ckptr.save(directory, params, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+
+
+def load_params(directory: str):
+    """Load an `export_params` artifact host-local (single-process
+    serving); pass the result straight to models.decode.generate."""
+
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    out = ckptr.restore(directory)
+    ckptr.close()
+    return out
