@@ -33,6 +33,11 @@ def main() -> int:
     parser.add_argument("--sp", type=int, default=1, help="sequence-parallel axis size")
     parser.add_argument("--sp-impl", choices=["ring", "ulysses"], default="ring")
     parser.add_argument("--generate", type=int, default=48, help="tokens to sample after training")
+    parser.add_argument(
+        "--export-dir", default="",
+        help="write a params-only serving artifact here after training "
+             "(consume with examples/serve_lm.py)",
+    )
     args = parser.parse_args()
 
     initialize()
@@ -78,6 +83,16 @@ def main() -> int:
         trainer, sharded, args.steps,
         tag=f"llama bytes fsdp={shape['fsdp']} sp={args.sp}({args.sp_impl})",
     )
+
+    if args.export_dir:
+        # collective: every process writes its shards directly
+        import os
+
+        from tf_operator_tpu.parallel import export_params
+
+        export_params(trainer, os.path.abspath(args.export_dir))
+        if jax.process_index() == 0:
+            print(f"exported serving artifact to {args.export_dir}", flush=True)
 
     if args.generate:
         # params are globally sharded; the gather is COLLECTIVE — every

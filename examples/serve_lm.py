@@ -1,0 +1,139 @@
+"""Minimal LM serving: load an exported artifact, answer /generate.
+
+The last leg of the train → export → serve journey
+(examples/llama_pretrain.py trains; parallel/checkpoint.py's
+export_params writes the artifact this loads).  Deliberately tiny —
+stdlib HTTP in front of the jitted KV-cache decoder — because the
+framework's serving primitives (models/decode.py, GQA-width cache, one
+XLA program per shape) do the actual work.
+
+    python examples/serve_lm.py --artifact /path/to/export --port 8600
+    curl -s localhost:8600/generate -d '{"prompt": "the sharded ", "max_new_tokens": 32}'
+
+Requests with the same (batch=1, prompt length, token budget, sampling
+config) reuse the compiled program; new shapes compile once.
+Temperature is quantized to a 0.05 grid so an adversarial temperature
+sweep cannot force a fresh XLA compile per request.  Byte-level vocab
+(256) to match the llama_pretrain artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def build_handler(model, params, max_len: int):
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.data.text import decode_bytes
+    from tf_operator_tpu.models.decode import generate
+
+    @functools.lru_cache(maxsize=32)
+    def compiled(prompt_len: int, n_new: int, temperature: float, top_k):
+        return jax.jit(
+            lambda p, prompt, r: generate(
+                model, p, prompt, n_new, temperature=temperature, top_k=top_k, rng=r
+            )
+        )
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                return self._reply(200, {"ok": True})
+            return self._reply(404, {"error": "try POST /generate"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._reply(404, {"error": "unknown path"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                text = req.get("prompt", "")
+                n_new = int(req.get("max_new_tokens", 32))
+                # quantize: bounds the jit-cache cardinality under
+                # arbitrary client temperature values
+                temperature = round(float(req.get("temperature", 0.0)) * 20) / 20
+                top_k = req.get("top_k")
+                seed = req.get("seed")
+                if seed is None:
+                    # fresh entropy per request — a fixed default would
+                    # return identical "samples" every time
+                    seed = int.from_bytes(os.urandom(4), "little")
+                seed = int(seed)
+                if not text:
+                    return self._reply(400, {"error": "empty prompt"})
+                if n_new < 1:
+                    return self._reply(400, {"error": "max_new_tokens must be >= 1"})
+                ids = np.frombuffer(text.encode("ascii", "replace"), np.uint8)
+                if len(ids) + n_new > max_len:
+                    return self._reply(400, {
+                        "error": f"prompt({len(ids)}) + max_new_tokens({n_new}) "
+                                 f"> max_len({max_len})"})
+                if temperature != 0.0 and top_k is not None:
+                    top_k = int(top_k)
+                prompt = jnp.asarray(ids, jnp.int32)[None]
+                fn = compiled(prompt.shape[1], n_new, temperature, top_k)
+                out = fn(params, prompt, jax.random.PRNGKey(seed))
+                sample = decode_bytes(np.asarray(out[0, prompt.shape[1]:]))
+                return self._reply(
+                    200, {"prompt": text, "sample": sample, "seed": seed}
+                )
+            except (ValueError, TypeError, KeyError, json.JSONDecodeError) as exc:
+                return self._reply(400, {"error": repr(exc)})  # client's fault
+            except Exception as exc:  # serving must not die on bad input
+                return self._reply(500, {"error": repr(exc)})
+
+    return Handler
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--artifact", required=True, help="export_params directory")
+    ap.add_argument("--port", type=int, default=8600)
+    ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. cpu) — goes through jax.config, "
+             "which beats env-level pins like this box's sitecustomize",
+    )
+    args = ap.parse_args()
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from tf_operator_tpu.models import llama_tiny
+    from tf_operator_tpu.parallel import load_params
+
+    params = load_params(args.artifact)
+    model = llama_tiny(vocab_size=256, max_len=args.max_len)
+    server = ThreadingHTTPServer(
+        ("127.0.0.1", args.port), build_handler(model, params, args.max_len)
+    )
+    print(f"serving on 127.0.0.1:{args.port} (artifact: {args.artifact})", flush=True)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

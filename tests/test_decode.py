@@ -186,3 +186,75 @@ def test_export_then_serve(tmp_path):
     plain = llama_tiny(vocab_size=VOCAB, max_len=32)
     from_artifact = generate(plain, served, prompt, max_new_tokens=6)
     np.testing.assert_array_equal(np.asarray(live), np.asarray(from_artifact))
+
+
+def test_serve_lm_end_to_end(tmp_path):
+    """train -> export -> serve over HTTP: the examples/serve_lm.py
+    handler answers /generate with decoded text from the artifact."""
+
+    import json
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from tf_operator_tpu.models import llama_loss, llama_tiny
+    from tf_operator_tpu.parallel import (
+        Trainer, TrainerConfig, export_params, load_params, make_mesh,
+    )
+
+    mesh = make_mesh({"dp": 8})
+    ids = np.random.RandomState(2).randint(0, 256, size=(8, 24)).astype(np.int32)
+    tr = Trainer(
+        llama_tiny(vocab_size=256, max_len=64, mesh=mesh),
+        TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+        mesh,
+        llama_loss,
+        {"input_ids": ids},
+        init_args=(ids,),
+        shardings="logical",
+    )
+    for _ in range(3):
+        tr.train_step(tr.shard_batch({"input_ids": ids}))
+    art = str(tmp_path / "artifact")
+    export_params(tr, art)
+
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_lm", os.path.join(os.path.dirname(__file__), "..", "examples", "serve_lm.py")
+    )
+    serve_lm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(serve_lm)
+
+    model = llama_tiny(vocab_size=256, max_len=64)
+    handler = serve_lm.build_handler(model, load_params(art), max_len=64)
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt": "the worker ", "max_new_tokens": 8}).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+        assert out["prompt"] == "the worker "
+        assert isinstance(out["sample"], str) and len(out["sample"]) == 8
+        # health + error paths
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert json.loads(r.read())["ok"]
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt": "x" * 100, "max_new_tokens": 100}).encode(),
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(bad, timeout=10)
+            raise AssertionError("overlong request not rejected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        server.shutdown()

@@ -94,11 +94,22 @@ def export_params(trainer, directory: str) -> None:
 
 def load_params(directory: str):
     """Load an `export_params` artifact host-local (single-process
-    serving); pass the result straight to models.decode.generate."""
+    serving); pass the result straight to models.decode.generate.
+
+    Restores against an UNSHARDED abstract target built from the
+    checkpoint's own metadata — a serving host with any device count
+    (typically 1) can consume an artifact exported from any mesh;
+    restoring with the saved shardings would demand the training
+    topology."""
 
     import orbax.checkpoint as ocp
 
     ckptr = ocp.StandardCheckpointer()
-    out = ckptr.restore(directory)
+    meta = ckptr.metadata(directory).item_metadata
+    dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    abstract = jax.tree_util.tree_map(
+        lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=dev), meta
+    )
+    out = ckptr.restore(directory, abstract)
     ckptr.close()
     return out
