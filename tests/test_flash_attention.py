@@ -252,3 +252,81 @@ class TestFlashGQA:
         q, k, v = self._qkv(H=4, HKV=3)
         with pytest.raises(ValueError, match="multiple"):
             flash_attention(q, k, v, False, 16, 16, True)
+
+
+class TestSlidingWindow:
+    """Mistral-style local attention: banded mask in the reference,
+    block-skipped in the kernels, consistent in decode."""
+
+    def _qkv(self, B=2, H=2, S=128, D=32, seed=51):
+        r = np.random.RandomState(seed)
+        mk = lambda s: jnp.asarray(r.randn(B, H, S, D), jnp.float32) * s
+        return mk(0.3), mk(0.3), mk(1.0)
+
+    @staticmethod
+    def _banded_ref(q, k, v, w):
+        s = q.shape[-2]
+        qpos = jnp.arange(s)[:, None]
+        kpos = jnp.arange(s)[None, :]
+        mask = (qpos >= kpos) & (qpos - kpos < w)
+        return dot_product_attention(q, k, v, mask=mask[None, None])
+
+    @pytest.mark.parametrize("w", [1, 16, 40, 128])
+    def test_reference_matches_banded_mask(self, w):
+        q, k, v = self._qkv()
+        out = dot_product_attention(q, k, v, causal=True, window=w)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._banded_ref(q, k, v, w)),
+            atol=1e-6,
+        )
+
+    @pytest.mark.parametrize("w", [16, 40, 128])
+    def test_flash_fwd_and_grads_match_reference(self, w):
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = self._qkv()
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, True, 16, 16, True, window=w) ** 2).mean()
+
+        def loss_ref(q, k, v):
+            return (dot_product_attention(q, k, v, causal=True, window=w) ** 2).mean()
+
+        out = flash_attention(q, k, v, True, 16, 16, True, window=w)
+        ref = dot_product_attention(q, k, v, causal=True, window=w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5, err_msg=name
+            )
+
+    def test_window_with_gqa(self):
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+
+        r = np.random.RandomState(52)
+        q = jnp.asarray(r.randn(2, 4, 64, 32), jnp.float32) * 0.3
+        k = jnp.asarray(r.randn(2, 2, 64, 32), jnp.float32) * 0.3
+        v = jnp.asarray(r.randn(2, 2, 64, 32), jnp.float32)
+        out = flash_attention(q, k, v, True, 16, 16, True, window=24)
+        ref = dot_product_attention(q, k, v, causal=True, window=24)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_window_requires_causal(self):
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = self._qkv(S=32)
+        with pytest.raises(ValueError, match="causal"):
+            dot_product_attention(q, k, v, window=8)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, False, 16, 16, True, window=8)
+
+    def test_window_below_one_rejected(self):
+        q, k, v = self._qkv(S=32)
+        with pytest.raises(ValueError, match=">= 1"):
+            dot_product_attention(q, k, v, causal=True, window=0)
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+
+        with pytest.raises(ValueError, match=">= 1"):
+            flash_attention(q, k, v, True, 16, 16, True, window=0)

@@ -154,3 +154,68 @@ def test_llama_tp_fsdp_training():
     for _ in range(4):
         last = tr.train_step(tr.shard_batch(batch))
     assert float(last["loss"]) < float(first["loss"])
+
+
+class TestSlidingWindowModels:
+    """Window attention at the model level: train, decode-equivalence,
+    sp guard."""
+
+    def test_windowed_llama_trains(self):
+        mesh = make_mesh({"dp": 8})
+        rng = np.random.RandomState(4)
+        ids = _ids(rng, 8, 32)
+        batch = {"input_ids": ids}
+        model = llama_tiny(vocab_size=VOCAB, max_len=32, mesh=mesh, window=8)
+        tr = Trainer(
+            model,
+            TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+            mesh,
+            llama_loss,
+            batch,
+            init_args=(ids,),
+            shardings="logical",
+        )
+        first = tr.train_step(tr.shard_batch(batch))
+        for _ in range(4):
+            last = tr.train_step(tr.shard_batch(batch))
+        assert float(last["loss"]) < float(first["loss"])
+
+    def test_windowed_decode_matches_full_recompute(self):
+        """The decode cache's banded mask must agree with the training
+        forward's windowed attention."""
+
+        from tf_operator_tpu.models import generate
+
+        model = llama_tiny(vocab_size=VOCAB, max_len=48, window=6)
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(0, VOCAB, size=(2, 10)), jnp.int32
+        )
+        params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+        out = generate(model, params, prompt, max_new_tokens=12)
+
+        ids = prompt
+        for _ in range(12):
+            logits = model.apply({"params": params}, ids)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ids))
+
+    def test_window_with_sp_rejected(self):
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        ids = _ids(np.random.RandomState(0), 8, 32)
+        model = llama_tiny(vocab_size=VOCAB, max_len=32, mesh=mesh, window=8)
+        with pytest.raises(NotImplementedError, match="window"):
+            model.init(jax.random.PRNGKey(0), ids)
+
+    def test_bad_window_rejected(self):
+        with pytest.raises(ValueError, match="window"):
+            llama_tiny(vocab_size=VOCAB, window=0)
+
+
+def test_window_on_encoder_rejected():
+    from tf_operator_tpu.models import bert_tiny
+
+    model = bert_tiny(vocab_size=VOCAB, window=8)
+    ids = _ids(np.random.RandomState(0), 2, 16)
+    with pytest.raises(NotImplementedError, match="causal"):
+        model.init(jax.random.PRNGKey(0), ids)

@@ -60,6 +60,12 @@ class TransformerConfig:
     # convention; llama-class models set False; qwen-class would keep
     # True with rope=True — the two knobs are independent.
     attn_bias: bool = True
+    # sliding-window (mistral-style) local attention: position i sees
+    # [i - window + 1, i].  Causal self-attention only (encoder
+    # self-attention raises; cross-attention ignores it); the flash
+    # kernels skip out-of-band COMPUTE so FLOPs are O(S * window).
+    # Not yet composed with sp (ring/ulysses) — MHA raises there.
+    window: Optional[int] = None
     # autoregressive decode mode: self-attention layers maintain a
     # [B, Hkv, max_len, D] K/V cache ("cache" collection) written at
     # the running index — static shapes throughout, so the whole
@@ -76,6 +82,8 @@ class TransformerConfig:
                 f"n_heads ({self.n_heads}) must be a multiple of "
                 f"n_kv_heads ({self.n_kv_heads})"
             )
+        if self.window is not None and self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
 
     @property
     def sp_enabled(self) -> bool:
@@ -190,8 +198,13 @@ class MultiHeadAttention(nn.Module):
             # the dispatcher's attention impls are GQA-native — the
             # Hkv-width cache is consumed directly, never expanded
             k, v = cached_k.value, cached_v.value
-            # causal over absolute positions; unfilled slots masked
-            dec_mask = (jnp.arange(cfg.max_len)[None, :] <= row_pos[:, None])[None, None]
+            # causal over absolute positions; unfilled slots masked;
+            # sliding window drops slots behind the band
+            cols = jnp.arange(cfg.max_len)[None, :]
+            vis = cols <= row_pos[:, None]
+            if cfg.window is not None:
+                vis &= row_pos[:, None] - cols < cfg.window
+            dec_mask = vis[None, None]
             out = attention(q, k, v, mask=dec_mask, mesh=cfg.mesh)
             out = jnp.transpose(out, (0, 2, 1, 3))
             return self._project_out(out, train)
@@ -201,7 +214,18 @@ class MultiHeadAttention(nn.Module):
         q, k, v = (
             logical_constraint(a, ("batch", "act_heads", "seq", "act_kv")) for a in (q, k, v)
         )
+        if cfg.window is not None and is_self and not self.causal:
+            raise NotImplementedError(
+                "sliding-window attention is defined for causal "
+                "self-attention; encoder self-attention does not "
+                "support it (cross-attention layers ignore it)"
+            )
         use_sp = cfg.sp_enabled and is_self and bias is None and mask is None
+        if use_sp and cfg.window is not None:
+            raise NotImplementedError(
+                "sliding-window attention is not composed with the sp "
+                "schedules yet — use window on non-sp meshes"
+            )
         if use_sp:
             # GQA-aware schedules: K/V enter at Hkv width and travel
             # the ring / all-to-all that way (the h/hkv bandwidth
@@ -214,7 +238,8 @@ class MultiHeadAttention(nn.Module):
             # calls through the shard_map wrapper.  All impls are
             # GQA-native, so Hkv-width K/V pass straight through.
             out = attention(
-                q, k, v, causal=self.causal, bias=bias, mask=mask, mesh=cfg.mesh
+                q, k, v, causal=self.causal, bias=bias, mask=mask, mesh=cfg.mesh,
+                window=cfg.window if (self.causal and is_self) else None,
             )
         out = jnp.transpose(out, (0, 2, 1, 3))  # [B,S,H,D]
         return self._project_out(out, train)

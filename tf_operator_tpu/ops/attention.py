@@ -25,6 +25,7 @@ def dot_product_attention(
     causal: bool = False,
     bias: Optional[jax.Array] = None,
     mask: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """q,k,v: [B, H, S, D] (k/v seq may differ for cross-attention;
     k/v heads may be H/group for GQA — handled by a grouped einsum, no
@@ -32,7 +33,15 @@ def dot_product_attention(
 
     `bias`: broadcastable to [B, H, Sq, Sk], added to logits (T5 relative
     position bias).  `mask`: broadcastable boolean, True = attend.
+    `window`: sliding-window (mistral-style) local attention — position
+    i attends to [i - window + 1, i]; requires causal=True.
     """
+
+    if window is not None:
+        if not causal:
+            raise ValueError("window attention requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
 
     b, h, sq, d = q.shape
     hkv = k.shape[1]
@@ -59,7 +68,10 @@ def dot_product_attention(
     if causal:
         qpos = jnp.arange(sq)[:, None]
         kpos = jnp.arange(sk)[None, :]
-        logits = jnp.where(qpos >= kpos, logits, neg)
+        visible = qpos >= kpos
+        if window is not None:
+            visible &= qpos - kpos < window
+        logits = jnp.where(visible, logits, neg)
     weights = jax.nn.softmax(logits, axis=-1)
     if h != hkv:
         wg = weights.reshape(b, hkv, h // hkv, sq, sk)

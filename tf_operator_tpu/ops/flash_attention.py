@@ -50,14 +50,33 @@ _NEG_INF = float(jnp.finfo(jnp.float32).min)
 _LANES = 128
 
 
-def _causal_mask(logits, qi, ji, block_q, block_k):
+def _causal_mask(logits, qi, ji, block_q, block_k, window=None):
     qpos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     kpos = ji * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    return jnp.where(qpos >= kpos, logits, _NEG_INF)
+    visible = qpos >= kpos
+    if window is not None:
+        # sliding window: row i sees [i - window + 1, i]
+        visible = jnp.logical_and(visible, qpos - kpos < window)
+    return jnp.where(visible, logits, _NEG_INF)
+
+
+def _block_needed(qi, ji, block_q, block_k, causal, window):
+    """Whole-block visibility: skip blocks fully above the diagonal
+    (causal) and, with a sliding window, blocks fully below the band —
+    windowed attention COMPUTE is O(S * window), not O(S^2) (K/V DMA
+    still visits every block; see flash_attention's docstring)."""
+
+    if not causal:
+        return ji >= 0
+    upper = ji * block_k < (qi + 1) * block_q
+    if window is None:
+        return upper
+    lower = (ji + 1) * block_k - 1 >= qi * block_q - (window - 1)
+    return jnp.logical_and(upper, lower)
 
 
 def _flash_kernel(
@@ -69,6 +88,7 @@ def _flash_kernel(
     scale: float,
     causal: bool,
     with_lse: bool,
+    window=None,
 ):
     if with_lse:
         lse_ref, m_ref, l_ref, acc_ref = rest
@@ -86,9 +106,9 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # causal: blocks fully above the diagonal contribute nothing for
-    # every row of this q block — skip their compute entirely
-    needed = (ji * block_k < (qi + 1) * block_q) if causal else (ji >= 0)
+    # causal: blocks fully above the diagonal (and, with a window,
+    # fully below the band) contribute nothing — skip their compute
+    needed = _block_needed(qi, ji, block_q, block_k, causal, window)
 
     @pl.when(needed)
     def _compute():
@@ -99,7 +119,7 @@ def _flash_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
         if causal:
-            logits = _causal_mask(logits, qi, ji, block_q, block_k)
+            logits = _causal_mask(logits, qi, ji, block_q, block_k, window)
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(logits, axis=-1, keepdims=True)
@@ -133,6 +153,7 @@ def _flash_forward(
     block_k: int,
     interpret: bool,
     with_lse: bool = False,
+    window=None,
 ):
     """Forward kernel.  with_lse=True additionally returns the row
     logsumexp [B, H, Sq, LANES] (lane-broadcast) for the backward; the
@@ -148,7 +169,8 @@ def _flash_forward(
         raise ValueError(f"q heads ({h}) must be a multiple of kv heads ({k.shape[1]})")
     group = h // k.shape[1]
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal, with_lse=with_lse
+        _flash_kernel, scale=scale, causal=causal, with_lse=with_lse,
+        window=window,
     )
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0))
     kv_spec = pl.BlockSpec(
@@ -181,7 +203,7 @@ def _flash_forward(
 
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, scale: float, causal: bool,
+    *, scale: float, causal: bool, window=None,
 ):
     qi = pl.program_id(2)
     ji = pl.program_id(3)
@@ -193,7 +215,7 @@ def _flash_bwd_dq_kernel(
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    needed = (ji * block_k < (qi + 1) * block_q) if causal else (ji >= 0)
+    needed = _block_needed(qi, ji, block_q, block_k, causal, window)
 
     @pl.when(needed)
     def _compute():
@@ -205,7 +227,7 @@ def _flash_bwd_dq_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if causal:
-            logits = _causal_mask(logits, qi, ji, block_q, block_k)
+            logits = _causal_mask(logits, qi, ji, block_q, block_k, window)
         # p is the exact softmax (lse folds max+denominator): masked
         # entries give exp(-inf - lse) = 0
         p = jnp.exp(logits - lse_ref[0, 0, :, :1])
@@ -224,7 +246,7 @@ def _flash_bwd_dq_kernel(
 
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *, scale: float, causal: bool, nq: int,
+    dk_acc, dv_acc, *, scale: float, causal: bool, nq: int, window=None,
 ):
     # grid (b, hkv, KV block, T): the innermost T dimension is
     # sequential and flattens (query-head-in-group, q block) — for MHA
@@ -243,9 +265,9 @@ def _flash_bwd_dkv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    # causal: q blocks strictly above the diagonal see none of this kv
-    # block (all their positions < every kv position) — skip
-    needed = ((qi + 1) * block_q > ji * block_k) if causal else (t >= 0)
+    # causal: q blocks strictly above the diagonal (and, windowed,
+    # fully below the band) see none of this kv block — skip
+    needed = _block_needed(qi, ji, block_q, block_k, causal, window) if causal else (t >= 0)
 
     @pl.when(needed)
     def _compute():
@@ -257,7 +279,7 @@ def _flash_bwd_dkv_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if causal:
-            logits = _causal_mask(logits, qi, ji, block_q, block_k)
+            logits = _causal_mask(logits, qi, ji, block_q, block_k, window)
         p = jnp.exp(logits - lse_ref[0, 0, :, :1])  # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -279,7 +301,8 @@ def _flash_bwd_dkv_kernel(
 
 
 def _flash_backward(
-    q, k, v, out, lse, g, causal: bool, block_q: int, block_k: int, interpret: bool
+    q, k, v, out, lse, g, causal: bool, block_q: int, block_k: int, interpret: bool,
+    window=None,
 ):
     b, h, sq, d = q.shape
     # lane-broadcast the [B,H,Sq] row stats for the kernels (transient —
@@ -289,13 +312,14 @@ def _flash_backward(
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (b, h, sq, _LANES))
     return _flash_backward_blocks(
-        q, k, v, g, lse, delta, causal, block_q, block_k, interpret
+        q, k, v, g, lse, delta, causal, block_q, block_k, interpret, window=window
     )
 
 
 def _flash_backward_blocks(
     q, k, v, g, lse, delta, causal: bool, block_q: int, block_k: int, interpret: bool,
     grad_dtype=None,
+    window=None,
 ):
     """dq/dk/dv kernels against precomputed lane-broadcast row stats
     (lse, delta = rowsum(dO*O), both [B,H,Sq,LANES]).  Split out from
@@ -329,7 +353,9 @@ def _flash_backward_blocks(
         (1, 1, block_q, _LANES), lambda bi, hi, qi, ji: (bi, hi, qi, 0)
     )
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal),
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal, window=window
+        ),
         out_shape=jax.ShapeDtypeStruct(q.shape, dq_dt),
         grid=(b, h, sq // block_q, sk // block_k),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
@@ -352,7 +378,9 @@ def _flash_backward_blocks(
         lambda bi, hi, ji, t: (bi, hi * group + t // nq, t % nq, 0),
     )
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal, nq=nq),
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal, nq=nq, window=window
+        ),
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, dk_dt),
             jax.ShapeDtypeStruct(v.shape, dv_dt),
@@ -380,7 +408,7 @@ def _compiler_params(interpret: bool):
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -389,11 +417,22 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention over [B, H, S, D].  Sq % block_q == Sk % block_k
-    == 0 required (dispatch checks this; call `attention` instead)."""
+    == 0 required (dispatch checks this; call `attention` instead).
+    ``window``: sliding-window local attention (requires causal) —
+    out-of-band blocks skip their COMPUTE entirely, so FLOPs are
+    O(S * window); the pipeline still streams every K/V block, so HBM
+    traffic stays O(S^2/block) (banded grid indexing is the follow-up
+    optimisation)."""
 
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    if window is not None:
+        if not causal:
+            raise ValueError("window attention requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret, window=window)
 
 
 def resolve_use_flash(use_flash, applicable: bool, why_not: str) -> bool:
@@ -417,12 +456,14 @@ def _use_pallas_bwd() -> bool:
     return os.environ.get("TPU_OPERATOR_FLASH_BWD", "1") != "0"
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, window):
+    if window is not None and not causal:
+        raise ValueError("window attention requires causal=True")
     if not _use_pallas_bwd():
-        out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+        out = _flash_forward(q, k, v, causal, block_q, block_k, interpret, window=window)
         return out, (q, k, v, None, None)
     out, lse = _flash_forward(
-        q, k, v, causal, block_q, block_k, interpret, with_lse=True
+        q, k, v, causal, block_q, block_k, interpret, with_lse=True, window=window
     )
     # residuals persist across the whole fwd→bwd window (× n_layers in
     # a stacked model): keep only one lane of the lane-broadcast lse;
@@ -430,21 +471,23 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
     return out, (q, k, v, out, lse[..., 0])
 
 
-def _bwd(causal, block_q, block_k, interpret, res, g):
+def _bwd(causal, block_q, block_k, interpret, window, res, g):
     q, k, v, out, lse = res
     if lse is None:
         # XLA-recompute fallback (TPU_OPERATOR_FLASH_BWD=0): re-derives
         # the scores through the reference path — numerics identical to
         # ops.attention
         _, vjp = jax.vjp(
-            lambda q, k, v: dot_product_attention(q, k, v, causal=causal), q, k, v
+            lambda q, k, v: dot_product_attention(
+                q, k, v, causal=causal, window=window
+            ), q, k, v
         )
         return vjp(g)
     # pallas backward: dq then dk/dv, each streaming blocks and
     # recomputing p from (q, k, lse) in-kernel — O(block) memory, the
     # [Sq, Sk] score matrix never exists
     return _flash_backward(
-        q, k, v, out, lse, g, causal, block_q, block_k, interpret
+        q, k, v, out, lse, g, causal, block_q, block_k, interpret, window=window
     )
 
 
@@ -460,6 +503,7 @@ def flash_attention_sharded(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Flash over a multi-device mesh: shard_map over batch (dp, fsdp)
     and heads (tp) — attention is independent per (batch, head), so the
@@ -476,6 +520,7 @@ def flash_attention_sharded(
             block_q=block_q,
             block_k=block_k,
             interpret=interpret,
+            window=window,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -527,6 +572,7 @@ def attention(
     mesh: Optional[Mesh] = None,
     block_q: int = 128,
     block_k: int = 128,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Dispatching attention: pallas flash kernel when it applies, the
     XLA-fused reference otherwise.  Drop-in for dot_product_attention;
@@ -535,9 +581,11 @@ def attention(
     if _flash_applicable(q, k, bias, mask, block_q, block_k):
         mode = _mesh_flash_applicable(mesh, q, k)
         if mode == "single":
-            return flash_attention(q, k, v, causal, block_q, block_k)
+            return flash_attention(q, k, v, causal, block_q, block_k, window=window)
         if mode == "sharded":
             return flash_attention_sharded(
-                q, k, v, mesh, causal, block_q, block_k
+                q, k, v, mesh, causal, block_q, block_k, window=window
             )
-    return dot_product_attention(q, k, v, causal=causal, bias=bias, mask=mask)
+    return dot_product_attention(
+        q, k, v, causal=causal, bias=bias, mask=mask, window=window
+    )
