@@ -330,3 +330,39 @@ class TestSlidingWindow:
 
         with pytest.raises(ValueError, match=">= 1"):
             flash_attention(q, k, v, True, 16, 16, True, window=0)
+
+    @pytest.mark.parametrize("bq,bk", [(16, 32), (32, 16), (16, 16)])
+    @pytest.mark.parametrize("w", [16, 40])
+    def test_banded_grids_unequal_blocks(self, bq, bk, w):
+        """Band width/remap math must hold for block_q != block_k."""
+
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = self._qkv(S=128)
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, True, bq, bk, True, window=w) ** 2).mean()
+
+        def loss_ref(q, k, v):
+            return (dot_product_attention(q, k, v, causal=True, window=w) ** 2).mean()
+
+        out = flash_attention(q, k, v, True, bq, bk, True, window=w)
+        ref = dot_product_attention(q, k, v, causal=True, window=w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5, err_msg=name
+            )
+
+    def test_band_width_tight_when_aligned(self):
+        from tf_operator_tpu.ops.flash_attention import _kv_band_width, _q_band_width
+
+        # block_q == block_k == window: exactly the diagonal + previous
+        assert _kv_band_width(128, 128, 128, 64) == 2
+        assert _q_band_width(128, 128, 128, 64) == 2
+        # w=1: diagonal only
+        assert _kv_band_width(128, 128, 1, 64) == 1
+        # misaligned blocks get the +1 slack
+        assert _kv_band_width(16, 32, 16, 64) == 3

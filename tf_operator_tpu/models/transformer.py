@@ -63,7 +63,7 @@ class TransformerConfig:
     # sliding-window (mistral-style) local attention: position i sees
     # [i - window + 1, i].  Causal self-attention only (encoder
     # self-attention raises; cross-attention ignores it); the flash
-    # kernels skip out-of-band COMPUTE so FLOPs are O(S * window).
+    # kernels band their grids so FLOPs AND K/V DMA are O(S * window).
     # Not yet composed with sp (ring/ulysses) — MHA raises there.
     window: Optional[int] = None
     # autoregressive decode mode: self-attention layers maintain a

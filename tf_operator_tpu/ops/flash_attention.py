@@ -66,9 +66,9 @@ def _causal_mask(logits, qi, ji, block_q, block_k, window=None):
 
 def _block_needed(qi, ji, block_q, block_k, causal, window):
     """Whole-block visibility: skip blocks fully above the diagonal
-    (causal) and, with a sliding window, blocks fully below the band —
-    windowed attention COMPUTE is O(S * window), not O(S^2) (K/V DMA
-    still visits every block; see flash_attention's docstring)."""
+    (causal) and, with a sliding window, blocks fully below the band.
+    With banding the grid itself only spans the band; this predicate
+    then just trims the clamped / overshooting edge blocks."""
 
     if not causal:
         return ji >= 0
@@ -77,6 +77,41 @@ def _block_needed(qi, ji, block_q, block_k, causal, window):
         return upper
     lower = (ji + 1) * block_k - 1 >= qi * block_q - (window - 1)
     return jnp.logical_and(upper, lower)
+
+
+def _kv_band_width(block_q: int, block_k: int, window: int, nk: int) -> int:
+    """#k blocks a q block's window band can intersect (q-major grids).
+    Tight when block_q % block_k == 0 (band alignment is then fixed);
+    +1 slack otherwise."""
+
+    n = (block_q - 1) // block_k + -(-(window - 1) // block_k) + 1
+    if block_q % block_k:
+        n += 1
+    return min(nk, n)
+
+
+def _q_band_width(block_q: int, block_k: int, window: int, nq: int) -> int:
+    """#q blocks that can see a kv block (kv-major grid twin)."""
+
+    n = (block_k + window - 2) // block_q + 1
+    if block_k % block_q:
+        n += 1
+    return min(nq, n)
+
+
+def _fwd_band_ji(qi, j, nj, block_q: int, block_k: int):
+    """Banded j → absolute k-block index: the band ends at the q
+    block's diagonal; early slots may undershoot 0 (caller masks)."""
+
+    hi_blk = ((qi + 1) * block_q - 1) // block_k
+    return hi_blk - (nj - 1) + j
+
+
+def _dkv_band_qi(ji, qb, block_q: int, block_k: int):
+    """Banded per-head q slot → absolute q-block index (may overshoot
+    nq-1; caller masks)."""
+
+    return (ji * block_k) // block_q + qb
 
 
 def _flash_kernel(
@@ -89,18 +124,22 @@ def _flash_kernel(
     causal: bool,
     with_lse: bool,
     window=None,
+    banded: bool = False,
 ):
     if with_lse:
         lse_ref, m_ref, l_ref, acc_ref = rest
     else:
         m_ref, l_ref, acc_ref = rest
     qi = pl.program_id(2)
-    ji = pl.program_id(3)
-    nk = pl.num_programs(3)
+    j = pl.program_id(3)
+    nj = pl.num_programs(3)
     block_q = q_ref.shape[2]
     block_k = k_ref.shape[2]
+    # banded window grid: j indexes the band, ending at the diagonal
+    # block — may undershoot 0 (masked out below)
+    ji = _fwd_band_ji(qi, j, nj, block_q, block_k) if banded else j
 
-    @pl.when(ji == 0)
+    @pl.when(j == 0)
     def _init():
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
@@ -109,6 +148,8 @@ def _flash_kernel(
     # causal: blocks fully above the diagonal (and, with a window,
     # fully below the band) contribute nothing — skip their compute
     needed = _block_needed(qi, ji, block_q, block_k, causal, window)
+    if banded:
+        needed = jnp.logical_and(needed, ji >= 0)
 
     @pl.when(needed)
     def _compute():
@@ -133,7 +174,7 @@ def _flash_kernel(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(ji == nk - 1)
+    @pl.when(j == nj - 1)
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-37)  # fully-masked rows divide safely
         o_ref[0, 0, :, :] = (acc_ref[:] / l).astype(o_ref.dtype)
@@ -168,14 +209,28 @@ def _flash_forward(
     if h % k.shape[1]:
         raise ValueError(f"q heads ({h}) must be a multiple of kv heads ({k.shape[1]})")
     group = h // k.shape[1]
+    nk = sk // block_k
+    # banded grid: with a window (and causal) only the blocks that can
+    # intersect a q block's band get DMA'd — k-dim grid shrinks from
+    # S/block_k to O(window/block_k)
+    n_band = (
+        _kv_band_width(block_q, block_k, window, nk)
+        if (window is not None and causal)
+        else nk
+    )
+    banded = n_band < nk
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, with_lse=with_lse,
-        window=window,
+        window=window, banded=banded,
     )
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0))
-    kv_spec = pl.BlockSpec(
-        (1, 1, block_k, d), lambda bi, hi, qi, ji: (bi, hi // group, ji, 0)
-    )
+
+    def kv_idx(bi, hi, qi, j):
+        if banded:
+            j = jnp.maximum(_fwd_band_ji(qi, j, n_band, block_q, block_k), 0)
+        return (bi, hi // group, j, 0)
+
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), kv_idx)
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
     out_specs = [q_spec]
     if with_lse:
@@ -186,7 +241,7 @@ def _flash_forward(
     res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
-        grid=(b, h, sq // block_q, sk // block_k),
+        grid=(b, h, sq // block_q, n_band),
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=out_specs,
         scratch_shapes=[
@@ -203,19 +258,22 @@ def _flash_forward(
 
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, scale: float, causal: bool, window=None,
+    *, scale: float, causal: bool, window=None, banded: bool = False,
 ):
     qi = pl.program_id(2)
-    ji = pl.program_id(3)
-    nk = pl.num_programs(3)
+    j = pl.program_id(3)
+    nj = pl.num_programs(3)
     block_q = q_ref.shape[2]
     block_k = k_ref.shape[2]
+    ji = _fwd_band_ji(qi, j, nj, block_q, block_k) if banded else j
 
-    @pl.when(ji == 0)
+    @pl.when(j == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     needed = _block_needed(qi, ji, block_q, block_k, causal, window)
+    if banded:
+        needed = jnp.logical_and(needed, ji >= 0)
 
     @pl.when(needed)
     def _compute():
@@ -239,7 +297,7 @@ def _flash_bwd_dq_kernel(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(ji == nk - 1)
+    @pl.when(j == nj - 1)
     def _finalize():
         dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
 
@@ -247,18 +305,22 @@ def _flash_bwd_dq_kernel(
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc, dv_acc, *, scale: float, causal: bool, nq: int, window=None,
+    banded: bool = False, nq_total: int = 0,
 ):
     # grid (b, hkv, KV block, T): the innermost T dimension is
     # sequential and flattens (query-head-in-group, q block) — for MHA
     # T == n_q_blocks and this is the plain q loop; for GQA every query
     # head sharing this K/V head streams through before finalize.
-    # dk/dv accumulate across all of T in VMEM scratch.
+    # dk/dv accumulate across all of T in VMEM scratch.  With a banded
+    # window, the per-head q index spans only the blocks that can see
+    # this kv block, offset from the block's own position.
     ji = pl.program_id(2)
     t = pl.program_id(3)
     nt = pl.num_programs(3)
-    qi = t % nq  # q-block index within the current query head
+    qb = t % nq  # banded (or plain) q index within the current head
     block_q = q_ref.shape[2]
     block_k = k_ref.shape[2]
+    qi = _dkv_band_qi(ji, qb, block_q, block_k) if banded else qb
 
     @pl.when(t == 0)
     def _init():
@@ -268,6 +330,8 @@ def _flash_bwd_dkv_kernel(
     # causal: q blocks strictly above the diagonal (and, windowed,
     # fully below the band) see none of this kv block — skip
     needed = _block_needed(qi, ji, block_q, block_k, causal, window) if causal else (t >= 0)
+    if banded:
+        needed = jnp.logical_and(needed, qi <= nq_total - 1)
 
     @pl.when(needed)
     def _compute():
@@ -345,19 +409,31 @@ def _flash_backward_blocks(
     dk_dt = grad_dtype or k.dtype
     dv_dt = grad_dtype or v.dtype
 
-    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0))
-    kv_spec = pl.BlockSpec(
-        (1, 1, block_k, d), lambda bi, hi, qi, ji: (bi, hi // group, ji, 0)
+    nk = sk // block_k
+    n_band = (
+        _kv_band_width(block_q, block_k, window, nk)
+        if (window is not None and causal)
+        else nk
     )
+    banded = n_band < nk
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0))
+
+    def kv_idx(bi, hi, qi, j):
+        if banded:
+            j = jnp.maximum(_fwd_band_ji(qi, j, n_band, block_q, block_k), 0)
+        return (bi, hi // group, j, 0)
+
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), kv_idx)
     row_spec = pl.BlockSpec(
         (1, 1, block_q, _LANES), lambda bi, hi, qi, ji: (bi, hi, qi, 0)
     )
     dq = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dq_kernel, scale=scale, causal=causal, window=window
+            _flash_bwd_dq_kernel, scale=scale, causal=causal, window=window,
+            banded=banded,
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, dq_dt),
-        grid=(b, h, sq // block_q, sk // block_k),
+        grid=(b, h, sq // block_q, n_band),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
@@ -366,20 +442,32 @@ def _flash_backward_blocks(
     )(q, k, v, g, lse, delta)
 
     # kv-major grid over the Hkv heads; innermost dimension t flattens
-    # (query-head-in-group, q-block): head = hi*group + t//nq, qi = t%nq
-    nq = sq // block_q
-    q_spec_t = pl.BlockSpec(
-        (1, 1, block_q, d),
-        lambda bi, hi, ji, t: (bi, hi * group + t // nq, t % nq, 0),
+    # (query-head-in-group, q-block): head = hi*group + t//nq, qi = t%nq.
+    # With a banded window the per-head span shrinks to the q blocks
+    # that can see this kv block.
+    nq_total = sq // block_q
+    nq_band = (
+        _q_band_width(block_q, block_k, window, nq_total)
+        if (window is not None and causal)
+        else nq_total
     )
+    banded_t = nq_band < nq_total
+    nq = nq_band
+
+    def q_idx(bi, hi, ji, t):
+        head = hi * group + t // nq
+        qb = t % nq
+        if banded_t:
+            qb = jnp.minimum(_dkv_band_qi(ji, qb, block_q, block_k), nq_total - 1)
+        return (bi, head, qb, 0)
+
+    q_spec_t = pl.BlockSpec((1, 1, block_q, d), q_idx)
     kv_spec_t = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ji, t: (bi, hi, ji, 0))
-    row_spec_t = pl.BlockSpec(
-        (1, 1, block_q, _LANES),
-        lambda bi, hi, ji, t: (bi, hi * group + t // nq, t % nq, 0),
-    )
+    row_spec_t = pl.BlockSpec((1, 1, block_q, _LANES), q_idx)
     dk, dv = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dkv_kernel, scale=scale, causal=causal, nq=nq, window=window
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal, nq=nq, window=window,
+            banded=banded_t, nq_total=nq_total,
         ),
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, dk_dt),
@@ -422,10 +510,9 @@ def flash_attention(
     """Flash attention over [B, H, S, D].  Sq % block_q == Sk % block_k
     == 0 required (dispatch checks this; call `attention` instead).
     ``window``: sliding-window local attention (requires causal) —
-    out-of-band blocks skip their COMPUTE entirely, so FLOPs are
-    O(S * window); the pipeline still streams every K/V block, so HBM
-    traffic stays O(S^2/block) (banded grid indexing is the follow-up
-    optimisation)."""
+    the k grid dimension shrinks to the band (O(window/block_k) blocks
+    per q block), so both FLOPs AND K/V DMA are O(S * window), not
+    O(S^2).  Same banding in the backward kernels."""
 
     if window is not None:
         if not causal:
