@@ -366,3 +366,12 @@ class TestSlidingWindow:
         assert _kv_band_width(128, 128, 1, 64) == 1
         # misaligned blocks get the +1 slack
         assert _kv_band_width(16, 32, 16, 64) == 3
+
+    def test_window_cross_lengths_rejected(self):
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+
+        r = np.random.RandomState(60)
+        q = jnp.asarray(r.randn(1, 2, 64, 32), jnp.float32)
+        k = jnp.asarray(r.randn(1, 2, 32, 32), jnp.float32)
+        with pytest.raises(ValueError, match="Sq == Sk"):
+            flash_attention(q, k, k, True, 16, 16, True, window=16)

@@ -172,3 +172,57 @@ class TestBenchSmoke:
         result = json.loads(line)
         assert "error" not in result, result
         assert result["value"] > 0
+
+
+@pytest.mark.tpu
+class TestWindowAttentionOnChip:
+    """Banded sliding-window kernels on the real chip: correctness vs
+    the banded XLA reference, and the O(S*window) banding must beat
+    full-attention flash at long seq."""
+
+    def test_windowed_forward_matches_xla(self, tpu):
+        from tf_operator_tpu.ops import dot_product_attention
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = rand_qkv(7, 2, 4, 4096, 128)
+        got = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, True, window=512)
+        )(q, k, v)
+        want = dot_product_attention(q, k, v, causal=True, window=512)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            atol=2e-2, rtol=2e-2,
+        )
+
+    def test_banded_window_beats_full_flash(self, tpu):
+        """seq 8k, window 1k: the banded grid does ~1/4 the work of
+        full causal flash — demand a real wall-clock win."""
+
+        import time
+
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = rand_qkv(8, 2, 8, 8192, 128)
+
+        def bench(f):
+            g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+            jax.block_until_ready(g(q, k, v))
+            t0 = time.perf_counter()
+            for _ in range(10):
+                out = g(q, k, v)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / 10
+
+        t_win = bench(
+            lambda q, k, v: flash_attention(q, k, v, True, window=1024)
+            .astype(jnp.float32).sum()
+        )
+        t_full = bench(
+            lambda q, k, v: flash_attention(q, k, v, True)
+            .astype(jnp.float32).sum()
+        )
+        print(
+            f"\nwindowed fwd+bwd @8k/w1k: {t_win*1e3:.1f}ms  "
+            f"full: {t_full*1e3:.1f}ms  speedup {t_full/t_win:.2f}x"
+        )
+        assert t_win < 0.7 * t_full  # the banding must actually pay

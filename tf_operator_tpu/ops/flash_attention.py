@@ -99,6 +99,35 @@ def _q_band_width(block_q: int, block_k: int, window: int, nq: int) -> int:
     return min(nq, n)
 
 
+def _banded_kv_setup(sq: int, sk: int, block_q: int, block_k: int,
+                     causal: bool, window, group: int):
+    """Shared banding setup for the q-major grids (forward and dq):
+    (n_band, banded, kv index map).  Forward and backward MUST use this
+    one helper or their banding silently diverges."""
+
+    nk = sk // block_k
+    n_band = (
+        _kv_band_width(block_q, block_k, window, nk)
+        if (window is not None and causal)
+        else nk
+    )
+    banded = n_band < nk
+    if window is not None and causal and sq != sk:
+        # banding derives k-block indices from q-block positions —
+        # only meaningful for self-attention (and windowed
+        # cross-attention has no defined semantics here anyway)
+        raise ValueError(
+            f"window attention requires Sq == Sk, got {sq} vs {sk}"
+        )
+
+    def kv_idx(bi, hi, qi, j):
+        if banded:
+            j = jnp.maximum(_fwd_band_ji(qi, j, n_band, block_q, block_k), 0)
+        return (bi, hi // group, j, 0)
+
+    return n_band, banded, kv_idx
+
+
 def _fwd_band_ji(qi, j, nj, block_q: int, block_k: int):
     """Banded j → absolute k-block index: the band ends at the q
     block's diagonal; early slots may undershoot 0 (caller masks)."""
@@ -209,27 +238,17 @@ def _flash_forward(
     if h % k.shape[1]:
         raise ValueError(f"q heads ({h}) must be a multiple of kv heads ({k.shape[1]})")
     group = h // k.shape[1]
-    nk = sk // block_k
     # banded grid: with a window (and causal) only the blocks that can
     # intersect a q block's band get DMA'd — k-dim grid shrinks from
     # S/block_k to O(window/block_k)
-    n_band = (
-        _kv_band_width(block_q, block_k, window, nk)
-        if (window is not None and causal)
-        else nk
+    n_band, banded, kv_idx = _banded_kv_setup(
+        sq, sk, block_q, block_k, causal, window, group
     )
-    banded = n_band < nk
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, with_lse=with_lse,
         window=window, banded=banded,
     )
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0))
-
-    def kv_idx(bi, hi, qi, j):
-        if banded:
-            j = jnp.maximum(_fwd_band_ji(qi, j, n_band, block_q, block_k), 0)
-        return (bi, hi // group, j, 0)
-
     kv_spec = pl.BlockSpec((1, 1, block_k, d), kv_idx)
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
     out_specs = [q_spec]
@@ -409,20 +428,10 @@ def _flash_backward_blocks(
     dk_dt = grad_dtype or k.dtype
     dv_dt = grad_dtype or v.dtype
 
-    nk = sk // block_k
-    n_band = (
-        _kv_band_width(block_q, block_k, window, nk)
-        if (window is not None and causal)
-        else nk
+    n_band, banded, kv_idx = _banded_kv_setup(
+        sq, sk, block_q, block_k, causal, window, group
     )
-    banded = n_band < nk
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ji: (bi, hi, qi, 0))
-
-    def kv_idx(bi, hi, qi, j):
-        if banded:
-            j = jnp.maximum(_fwd_band_ji(qi, j, n_band, block_q, block_k), 0)
-        return (bi, hi // group, j, 0)
-
     kv_spec = pl.BlockSpec((1, 1, block_k, d), kv_idx)
     row_spec = pl.BlockSpec(
         (1, 1, block_q, _LANES), lambda bi, hi, qi, ji: (bi, hi, qi, 0)
