@@ -53,6 +53,7 @@ STEPS = [
         [
             sys.executable, "-m", "pytest",
             "tests/test_tpu_chip.py::TestFlashKernelOnChip::test_flash_beats_xla_at_long_seq",
+            "tests/test_tpu_chip.py::TestWindowAttentionOnChip",
             "-q", "-s",
         ],
         900,

@@ -645,12 +645,16 @@ def _mesh_flash_applicable(mesh: Optional[Mesh], q, k) -> Optional[str]:
     return "sharded"
 
 
-def _flash_applicable(q, k, bias, mask, block_q, block_k) -> bool:
+def _flash_applicable(q, k, bias, mask, block_q, block_k, window=None) -> bool:
     if os.environ.get("TPU_OPERATOR_FLASH", "1") == "0":
         return False
     if bias is not None or mask is not None:
         return False
     if q.shape[-2] % block_q or k.shape[-2] % block_k or q.shape[1] % k.shape[1]:
+        return False
+    if window is not None and q.shape[-2] != k.shape[-2]:
+        # banded grids need Sq == Sk; the XLA reference's position-based
+        # window mask handles the cross-length case — route it there
         return False
     # the kernel targets the TPU backend; everything else takes the
     # XLA-fused reference path (the interpreter is for tests)
@@ -674,7 +678,7 @@ def attention(
     XLA-fused reference otherwise.  Drop-in for dot_product_attention;
     pass the mesh so multi-device calls get the shard_map wrapper."""
 
-    if _flash_applicable(q, k, bias, mask, block_q, block_k):
+    if _flash_applicable(q, k, bias, mask, block_q, block_k, window):
         mode = _mesh_flash_applicable(mesh, q, k)
         if mode == "single":
             return flash_attention(q, k, v, causal, block_q, block_k, window=window)
