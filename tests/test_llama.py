@@ -200,12 +200,38 @@ class TestSlidingWindowModels:
             ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ids))
 
-    def test_window_with_sp_rejected(self):
-        mesh = make_mesh({"dp": 2, "sp": 4})
-        ids = _ids(np.random.RandomState(0), 8, 32)
-        model = llama_tiny(vocab_size=VOCAB, max_len=32, mesh=mesh, window=8)
-        with pytest.raises(NotImplementedError, match="window"):
-            model.init(jax.random.PRNGKey(0), ids)
+    @pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
+    def test_windowed_sp_matches_no_sp(self, sp_impl):
+        """window x sequence parallelism: both schedules must train
+        identically to the unsharded windowed model."""
+
+        rng = np.random.RandomState(6)
+        ids = _ids(rng, 8, 32)
+        batch = {"input_ids": ids}
+        losses = {}
+        for label, shape in [("nosp", {"dp": 8}), ("sp", {"dp": 2, "sp": 4})]:
+            mesh = make_mesh(shape)
+            model = llama_tiny(
+                vocab_size=VOCAB, max_len=32, mesh=mesh,
+                sp_impl=sp_impl, window=8,
+            )
+            tr = Trainer(
+                model,
+                TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+                mesh,
+                llama_loss,
+                batch,
+                init_args=(ids,),
+                shardings="logical",
+                seed=11,
+            )
+            losses[label] = [
+                float(tr.train_step(tr.shard_batch(batch))["loss"])
+                for _ in range(3)
+            ]
+        np.testing.assert_allclose(
+            losses["nosp"], losses["sp"], rtol=2e-4, atol=2e-4
+        )
 
     def test_bad_window_rejected(self):
         with pytest.raises(ValueError, match="window"):

@@ -166,3 +166,18 @@ class TestUlyssesGQA:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5, err_msg=name
             )
+
+
+@pytest.mark.parametrize("w", [4, 12, 32])
+def test_ulysses_window_matches_banded_reference(w):
+    """window is free under ulysses: full sequence locally, banded mask
+    applies unchanged."""
+
+    mesh = make_mesh({"sp": 4, "dp": -1})
+    q, k, v = _qkv()
+    ref = dot_product_attention(q, k, v, causal=True, window=w)
+    with mesh:
+        out = jax.jit(
+            lambda a, b, c: ulysses_attention(a, b, c, mesh, causal=True, window=w)
+        )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)

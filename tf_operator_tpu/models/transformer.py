@@ -64,7 +64,8 @@ class TransformerConfig:
     # [i - window + 1, i].  Causal self-attention only (encoder
     # self-attention raises; cross-attention ignores it); the flash
     # kernels band their grids so FLOPs AND K/V DMA are O(S * window).
-    # Not yet composed with sp (ring/ulysses) — MHA raises there.
+    # Composes with sp: ulysses runs the banded kernels on its full
+    # local sequence; the ring masks by global offsets (XLA path).
     window: Optional[int] = None
     # autoregressive decode mode: self-attention layers maintain a
     # [B, Hkv, max_len, D] K/V cache ("cache" collection) written at
@@ -221,17 +222,15 @@ class MultiHeadAttention(nn.Module):
                 "support it (cross-attention layers ignore it)"
             )
         use_sp = cfg.sp_enabled and is_self and bias is None and mask is None
-        if use_sp and cfg.window is not None:
-            raise NotImplementedError(
-                "sliding-window attention is not composed with the sp "
-                "schedules yet — use window on non-sp meshes"
-            )
         if use_sp:
             # GQA-aware schedules: K/V enter at Hkv width and travel
             # the ring / all-to-all that way (the h/hkv bandwidth
-            # saving), expanding only inside the local block compute
+            # saving), expanding only inside the local block compute.
+            # window composes: ulysses applies the banded kernels to
+            # its full local sequence; the ring masks by global offsets
+            # on its XLA path
             sp_attn = ulysses_attention if cfg.sp_impl == "ulysses" else ring_attention
-            out = sp_attn(q, k, v, cfg.mesh, causal=self.causal)
+            out = sp_attn(q, k, v, cfg.mesh, causal=self.causal, window=cfg.window)
         else:
             # dispatcher: pallas flash kernel on TPU when it applies,
             # XLA-fused reference otherwise; the mesh routes multi-device

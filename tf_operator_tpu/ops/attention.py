@@ -17,6 +17,18 @@ import jax
 import jax.numpy as jnp
 
 
+def validate_window(window, causal: bool) -> None:
+    """Shared precondition for every attention entry point that takes
+    ``window`` (reference, flash, ring, ulysses)."""
+
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("window attention requires causal=True")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,
@@ -37,11 +49,7 @@ def dot_product_attention(
     i attends to [i - window + 1, i]; requires causal=True.
     """
 
-    if window is not None:
-        if not causal:
-            raise ValueError("window attention requires causal=True")
-        if window < 1:
-            raise ValueError(f"window must be >= 1, got {window}")
+    validate_window(window, causal)
 
     b, h, sq, d = q.shape
     hkv = k.shape[1]

@@ -43,7 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tf_operator_tpu.ops.attention import dot_product_attention
+from tf_operator_tpu.ops.attention import dot_product_attention, validate_window
 
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 #: lane width — scratch carries are padded to full lanes
@@ -523,11 +523,7 @@ def flash_attention(
     per q block), so both FLOPs AND K/V DMA are O(S * window), not
     O(S^2).  Same banding in the backward kernels."""
 
-    if window is not None:
-        if not causal:
-            raise ValueError("window attention requires causal=True")
-        if window < 1:
-            raise ValueError(f"window must be >= 1, got {window}")
+    validate_window(window, causal)
     return _flash_forward(q, k, v, causal, block_q, block_k, interpret, window=window)
 
 
@@ -553,8 +549,7 @@ def _use_pallas_bwd() -> bool:
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret, window):
-    if window is not None and not causal:
-        raise ValueError("window attention requires causal=True")
+    validate_window(window, causal)
     if not _use_pallas_bwd():
         out = _flash_forward(q, k, v, causal, block_q, block_k, interpret, window=window)
         return out, (q, k, v, None, None)

@@ -45,6 +45,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tf_operator_tpu.ops.attention import (
     dot_product_attention,
     repeat_kv_heads as _rep_kv,
+    validate_window,
 )
 
 _NEG = float(jnp.finfo(jnp.float32).min)
@@ -60,6 +61,7 @@ def _ring_block(
     q_off: jax.Array,  # scalar: global offset of the local Q chunk
     k_off: jax.Array,  # scalar: global offset of the current K/V block
     causal: bool,
+    window=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
@@ -67,7 +69,11 @@ def _ring_block(
     if causal:
         qpos = q_off + jnp.arange(q.shape[-2])[:, None]
         kpos = k_off + jnp.arange(k.shape[-2])[None, :]
-        s = jnp.where(qpos >= kpos, s, _NEG)
+        visible = qpos >= kpos
+        if window is not None:
+            # global offsets make the sliding band exact across chunks
+            visible = jnp.logical_and(visible, qpos - kpos < window)
+        s = jnp.where(visible, s, _NEG)
     m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
     # guard: a fully-masked row has m_new == _NEG; exp(_NEG - _NEG)=1
     # would pollute l, so clamp the shift for masked rows
@@ -345,10 +351,14 @@ def _ring_attention_local(
     axis_size: int,
     causal: bool,
     group: int = 1,
+    window=None,
 ) -> jax.Array:
     """Runs inside shard_map: q is the local [B,H,Sq,D] shard; k/v are
     [B,H/group,Sq,D] (GQA) and expand per block compute.  Gradients of
-    the repeat (autodiff through the scan) are the group-sum."""
+    the repeat (autodiff through the scan) are the group-sum.  With a
+    sliding window the per-block mask uses global offsets, so the band
+    is exact across chunk boundaries (out-of-band hops contribute
+    zeros; they still flow through the ring for uniform control flow)."""
 
     my = lax.axis_index(axis_name)
     sq = q.shape[-2]
@@ -367,7 +377,7 @@ def _ring_attention_local(
         src = (my - i) % axis_size
         m, l, o = _ring_block(
             qf, _rep_kv(k_blk, group), _rep_kv(v_blk, group), m, l, o,
-            q_off, src * sq, causal,
+            q_off, src * sq, causal, window,
         )
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
@@ -380,7 +390,7 @@ def _ring_attention_local(
     last_src = (my - (axis_size - 1)) % axis_size
     m, l, o = _ring_block(
         qf, _rep_kv(k_blk, group), _rep_kv(v_blk, group), m, l, o,
-        q_off, last_src * sq, causal,
+        q_off, last_src * sq, causal, window,
     )
     # causal rows always attend to at least themselves, so l > 0; the
     # maximum guards the (non-causal, all-masked) degenerate case
@@ -414,6 +424,7 @@ def ring_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Exact attention with sequence sharded over `axis_name`.
 
@@ -433,9 +444,10 @@ def ring_attention(
     if h % hkv:
         raise ValueError(f"q heads ({h}) must be a multiple of kv heads ({hkv})")
     group = h // hkv
+    validate_window(window, causal)
 
     if mesh.shape[axis_name] <= 1:
-        return dot_product_attention(q, k, v, causal=causal)
+        return dot_product_attention(q, k, v, causal=causal, window=window)
 
     n = mesh.shape[axis_name]
     if group > 1 and heads_axis and hkv % mesh.shape.get(heads_axis, 1):
@@ -445,6 +457,16 @@ def ring_attention(
 
     from tf_operator_tpu.ops.flash_attention import resolve_use_flash
 
+    if window is not None:
+        # the flash-ring hop kernels mask in LOCAL coordinates; the
+        # sliding band needs global offsets, which only the XLA ring
+        # blocks carry — window rides the XLA path for now
+        if use_flash:
+            raise NotImplementedError(
+                "window attention is not composed with the flash-ring "
+                "kernels yet — it runs on the XLA ring path (use_flash=False)"
+            )
+        use_flash = False
     use_flash = resolve_use_flash(
         use_flash,
         _flash_ring_applicable(q, n, block_q, block_k),
@@ -465,6 +487,7 @@ def ring_attention(
             axis_size=n,
             causal=causal,
             group=group,
+            window=window,
         )
     from tf_operator_tpu.utils.jax_compat import shard_map_unchecked
 

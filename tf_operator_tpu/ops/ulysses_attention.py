@@ -39,6 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tf_operator_tpu.ops.attention import (
     dot_product_attention,
     repeat_kv_heads as _rep_kv,
+    validate_window,
 )
 from tf_operator_tpu.ops.flash_attention import flash_attention, resolve_use_flash
 
@@ -56,9 +57,12 @@ def _ulysses_local(
     interpret: bool,
     group: int = 1,
     kv_native_a2a: bool = True,
+    window=None,
 ) -> jax.Array:
     """Runs inside shard_map.  heads→seq re-shard, local attention,
-    seq→heads re-shard back.
+    seq→heads re-shard back.  Window attention is free here: after the
+    all-to-all every device holds the FULL sequence for its heads, so
+    the banded kernels/mask apply unchanged.
 
     GQA: when the kv head count splits across the axis
     (kv_native_a2a), K/V ride the all-to-all at Hkv width — the
@@ -77,9 +81,9 @@ def _ulysses_local(
     # both local attentions are GQA-native (grouped einsum / kernel
     # index maps), so native-width K/V go straight in
     if use_flash:
-        o = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+        o = flash_attention(q, k, v, causal, block_q, block_k, interpret, window=window)
     else:
-        o = dot_product_attention(q, k, v, causal=causal)
+        o = dot_product_attention(q, k, v, causal=causal, window=window)
     # [B, Hl/n, S, D] -> [B, Hl, Sl, D]
     return a2a(o, split_axis=2, concat_axis=1)
 
@@ -111,6 +115,7 @@ def ulysses_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Exact attention with sequence sharded over `axis_name`, computed
     by the all-to-all (Ulysses) schedule.  Drop-in for `ring_attention`
@@ -129,9 +134,10 @@ def ulysses_attention(
     if h % hkv:
         raise ValueError(f"q heads ({h}) must be a multiple of kv heads ({hkv})")
     group = h // hkv
+    validate_window(window, causal)
 
     if mesh.shape[axis_name] <= 1:
-        return dot_product_attention(q, k, v, causal=causal)
+        return dot_product_attention(q, k, v, causal=causal, window=window)
 
     n = mesh.shape[axis_name]
     tp_size = mesh.shape.get(heads_axis, 1) if heads_axis else 1
@@ -168,6 +174,7 @@ def ulysses_attention(
         interpret=interpret,
         group=group,
         kv_native_a2a=kv_native_a2a,
+        window=window,
     )
     from tf_operator_tpu.utils.jax_compat import shard_map_unchecked
 
