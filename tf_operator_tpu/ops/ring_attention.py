@@ -357,8 +357,13 @@ def _ring_attention_local(
     [B,H/group,Sq,D] (GQA) and expand per block compute.  Gradients of
     the repeat (autodiff through the scan) are the group-sum.  With a
     sliding window the per-block mask uses global offsets, so the band
-    is exact across chunk boundaries (out-of-band hops contribute
-    zeros; they still flow through the ring for uniform control flow)."""
+    is exact across chunk boundaries.
+
+    Hop skipping (causal): a visiting chunk that is entirely in the
+    future — or, with a window, entirely behind the band — contributes
+    zero weight; `lax.cond` skips its matmuls outright while the block
+    still rides the ring (the ppermute stays outside the cond, so
+    every device keeps the same collective schedule)."""
 
     my = lax.axis_index(axis_name)
     sq = q.shape[-2]
@@ -371,14 +376,31 @@ def _ring_attention_local(
     q_off = my * sq
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
+    def chunk_visible(src):
+        vis = src <= my
+        if window is not None:
+            # chunks more than ceil((window-1)/sq) behind hold only
+            # keys with qpos - kpos >= window for every local q row
+            vis = jnp.logical_and(vis, (my - src - 1) * sq <= window - 2)
+        return vis
+
+    def hop(k_blk, v_blk, m, l, o, src):
+        def visible(args):
+            m, l, o = args
+            return _ring_block(
+                qf, _rep_kv(k_blk, group), _rep_kv(v_blk, group), m, l, o,
+                q_off, src * sq, causal, window,
+            )
+
+        if not causal:  # every chunk visible: no conditional staged
+            return visible((m, l, o))
+        return lax.cond(chunk_visible(src), visible, lambda args: args, (m, l, o))
+
     def body(carry, i):
         k_blk, v_blk, m, l, o = carry
         # after i hops we hold the block that started (my - i) shards back
         src = (my - i) % axis_size
-        m, l, o = _ring_block(
-            qf, _rep_kv(k_blk, group), _rep_kv(v_blk, group), m, l, o,
-            q_off, src * sq, causal, window,
-        )
+        m, l, o = hop(k_blk, v_blk, m, l, o, src)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return (k_blk, v_blk, m, l, o), None
@@ -388,10 +410,7 @@ def _ring_attention_local(
         body, (k, v, m0, l0, o0), jnp.arange(axis_size - 1)
     )
     last_src = (my - (axis_size - 1)) % axis_size
-    m, l, o = _ring_block(
-        qf, _rep_kv(k_blk, group), _rep_kv(v_blk, group), m, l, o,
-        q_off, last_src * sq, causal, window,
-    )
+    m, l, o = hop(k_blk, v_blk, m, l, o, last_src)
     # causal rows always attend to at least themselves, so l > 0; the
     # maximum guards the (non-causal, all-masked) degenerate case
     out = o / jnp.maximum(l, 1e-30)
