@@ -375,3 +375,55 @@ class TestSlidingWindow:
         k = jnp.asarray(r.randn(1, 2, 32, 32), jnp.float32)
         with pytest.raises(ValueError, match="Sq == Sk"):
             flash_attention(q, k, k, True, 16, 16, True, window=16)
+
+
+class TestWindowProperty:
+    """Property sweep: any legal (seq, window, block) combination must
+    match the banded reference, forward and gradients."""
+
+    def test_random_configs(self):
+        from hypothesis import given, settings, strategies as st
+
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+
+        @settings(max_examples=20, deadline=None)
+        @given(
+            s_blocks=st.integers(2, 8),
+            bq=st.sampled_from([8, 16, 32]),
+            bk=st.sampled_from([8, 16, 32]),
+            w=st.integers(1, 96),
+            seed=st.integers(0, 2**16),
+        )
+        def run(s_blocks, bq, bk, w, seed):
+            import math
+
+            s = s_blocks * (bq * bk // math.gcd(bq, bk))
+            r = np.random.RandomState(seed)
+            q = jnp.asarray(r.randn(1, 2, s, 16), jnp.float32) * 0.3
+            k = jnp.asarray(r.randn(1, 2, s, 16), jnp.float32) * 0.3
+            v = jnp.asarray(r.randn(1, 2, s, 16), jnp.float32)
+            out = flash_attention(q, k, v, True, bq, bk, True, window=w)
+            ref = dot_product_attention(q, k, v, causal=True, window=w)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5,
+                err_msg=f"s={s} bq={bq} bk={bk} w={w}",
+            )
+            gf = jax.grad(
+                lambda a, b, c: (
+                    flash_attention(a, b, c, True, bq, bk, True, window=w) ** 2
+                ).mean(),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            gr = jax.grad(
+                lambda a, b, c: (
+                    dot_product_attention(a, b, c, causal=True, window=w) ** 2
+                ).mean(),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            for name, a, b in zip("dq dk dv".split(), gf, gr):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-5,
+                    err_msg=f"{name} s={s} bq={bq} bk={bk} w={w}",
+                )
+
+        run()

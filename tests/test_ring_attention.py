@@ -369,3 +369,32 @@ def test_ring_window_zero_rejected():
     q, k, v = _qkv()
     with pytest.raises(ValueError, match=">= 1"):
         ring_attention(q, k, v, mesh, causal=True, window=0)
+
+
+@pytest.mark.parametrize("w", [8, 9, 10, 16, 17, 18])
+def test_ring_window_hop_skip_boundaries(w):
+    """Band edges landing exactly on chunk boundaries (sq=8 per shard):
+    w=9 puts the chunk 2 hops back at min qpos-kpos = 8 = w-1 (exactly
+    one visible diagonal), w=17 likewise 3 hops back — an off-by-one in
+    the hop-skip threshold corrupts these and nothing else."""
+
+    mesh = make_mesh({"sp": 4, "dp": -1})
+    q, k, v = _qkv()  # s=32 -> 8 per shard
+    ref = dot_product_attention(q, k, v, causal=True, window=w)
+    with mesh:
+        out = jax.jit(
+            lambda a, b, c: ring_attention(a, b, c, mesh, causal=True, window=w)
+        )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def loss_ring(a, b, c):
+        with mesh:
+            return (ring_attention(a, b, c, mesh, causal=True, window=w) ** 2).sum()
+
+    def loss_ref(a, b, c):
+        return (dot_product_attention(a, b, c, causal=True, window=w) ** 2).sum()
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5)
