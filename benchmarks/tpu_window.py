@@ -34,6 +34,9 @@ PROBE = (
 STEPS = [
     ("probe", [sys.executable, "-c", PROBE], 120),
     ("bench", [sys.executable, os.path.join(REPO, "bench.py")], 3600),
+    # TPU-lowering confirmation of the FLOPS.md accounting table
+    # (compile-only, cheap — see benchmarks/FLOPS.md)
+    ("flops", [sys.executable, os.path.join(HERE, "flops_audit.py")], 600),
     (
         "sweep",
         [sys.executable, os.path.join(HERE, "mfu_sweep.py"), "--timeout", "600"],

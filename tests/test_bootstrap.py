@@ -157,3 +157,26 @@ class TestTPUEnv:
         assert "TF_CONFIG" in env and "TPUJOB_PROCESS_ID" in env
         env2 = worker_env(job, ReplicaType.WORKER, 0, tf_config=False)
         assert "TF_CONFIG" not in env2
+
+    def test_worker_env_ps_topology_injects_sparse(self):
+        """PS jobs inject the sparse variant for workers: full chief/ps
+        lists, own-entry-only worker list as index 0 (the TF
+        sparse-cluster convention); chief and PS keep the full view."""
+
+        import json
+
+        job = mkjob(chief=1, ps=2, worker=3)
+        cfg = json.loads(worker_env(job, ReplicaType.WORKER, 2)["TF_CONFIG"])
+        assert len(cfg["cluster"]["ps"]) == 2
+        assert len(cfg["cluster"]["chief"]) == 1
+        assert cfg["cluster"]["worker"] == ["job-worker-2.default.svc:2222"]
+        assert cfg["task"] == {"type": "worker", "index": 0}
+        ps_cfg = json.loads(worker_env(job, ReplicaType.PS, 1)["TF_CONFIG"])
+        assert len(ps_cfg["cluster"]["worker"]) == 3
+        assert ps_cfg["task"] == {"type": "ps", "index": 1}
+        # no PS replicas → dense config, true index
+        dense = json.loads(
+            worker_env(mkjob(chief=1, worker=3), ReplicaType.WORKER, 2)["TF_CONFIG"]
+        )
+        assert len(dense["cluster"]["worker"]) == 3
+        assert dense["task"] == {"type": "worker", "index": 2}

@@ -128,12 +128,28 @@ def worker_env(
     resolve: AddressResolver = dns_resolver,
     tf_config: bool = True,
 ) -> Dict[str, str]:
-    """Everything createNewPod injects: TF_CONFIG + the TPU twin."""
+    """Everything createNewPod injects: TF_CONFIG + the TPU twin.
 
+    PS-topology jobs get the *sparse* cluster-spec variant for
+    worker/evaluator replicas (SURVEY.md §2 "TF_CONFIG generation":
+    the reference's sparse variant for PS-style jobs): parameter-server
+    training never opens worker↔worker channels, so each worker sees
+    the full chief/ps lists but only its own worker entry (as index 0,
+    the TF sparse-cluster convention).  Chief and PS replicas keep the
+    full view either way.
+    """
+
+    from tf_operator_tpu.api.types import ReplicaType
     from tf_operator_tpu.bootstrap.cluster_spec import gen_tf_config
 
     env: Dict[str, str] = {}
     if tf_config:
-        env["TF_CONFIG"] = gen_tf_config(job, rtype, index, resolve)
+        has_ps = (
+            ReplicaType.PS in job.spec.replica_specs
+            and job.spec.pod_count(ReplicaType.PS) > 0
+        )
+        env["TF_CONFIG"] = gen_tf_config(
+            job, rtype, index, resolve, sparse=has_ps
+        )
     env.update(gen_tpu_env(job, rtype, index, resolve))
     return env

@@ -8,6 +8,7 @@ the operator binary carries the equivalent surface itself:
 
     GET  /healthz                                     liveness
     GET  /metrics                                     Prometheus text
+    GET  /debug/stacks                                all-thread stack dump
     GET  /apis/v1/tpujobs                             list (all ns)
     GET  /apis/v1/namespaces/{ns}/tpujobs             list
     POST /apis/v1/namespaces/{ns}/tpujobs             create (manifest)
@@ -18,7 +19,13 @@ the operator binary carries the equivalent surface itself:
     GET  /apis/v1/namespaces/{ns}/tpujobs/{name}/pods
     GET  /apis/v1/namespaces/{ns}/tpujobs/{name}/pods/{pod}/log
 
-Everything is JSON; manifests use the serde camelCase shape.
+Everything is JSON; manifests use the serde camelCase shape (POST also
+accepts YAML — the dashboard's submit box and `tpujob submit -f` both
+speak it).  `/debug/stacks` is the pprof-equivalent debug surface the
+reference exposes on its monitoring port (SURVEY.md §5 "optional Go
+pprof"): a plain-text dump of every thread's current stack, served on
+every replica (leader or not) because its job is diagnosing a hung
+control plane.
 """
 
 from __future__ import annotations
@@ -143,6 +150,20 @@ class ApiServer:
                         return self._send(
                             200, outer.metrics.exposition(), "text/plain"
                         )
+                    if p == ["debug", "stacks"]:
+                        import sys
+                        import traceback
+
+                        names = {
+                            t.ident: t.name for t in threading.enumerate()
+                        }
+                        chunks = []
+                        for tid, frame in sys._current_frames().items():
+                            chunks.append(
+                                f"--- thread {names.get(tid, '?')} (id {tid}) ---\n"
+                                + "".join(traceback.format_stack(frame))
+                            )
+                        return self._send(200, "\n".join(chunks), "text/plain")
                     if p[0] == "apis" and self._not_leader():
                         return None
                     if p == ["apis", "v1", "tpujobs"]:
@@ -248,7 +269,21 @@ class ApiServer:
                             return None
                         length = int(self.headers.get("Content-Length", 0))
                         raw = self.rfile.read(length)
-                        manifest = json.loads(raw)
+                        try:
+                            manifest = json.loads(raw)
+                        except json.JSONDecodeError:
+                            import yaml
+
+                            try:
+                                manifest = yaml.safe_load(raw)
+                            except yaml.YAMLError as e:
+                                return self._error(
+                                    422, f"manifest parse error: {e}"
+                                )
+                        if not isinstance(manifest, dict):
+                            return self._error(
+                                422, "manifest must be a JSON/YAML mapping"
+                            )
                         job = job_from_dict(manifest)
                         job.metadata.namespace = p[3]
                         stored = outer.jobs.create(job)
