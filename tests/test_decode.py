@@ -22,9 +22,14 @@ def _reference_greedy(model, params, prompt, n):
     return ids
 
 
-@pytest.mark.parametrize("family", ["gpt", "llama"])
+@pytest.mark.parametrize("family", ["gpt", "llama", "moe"])
 def test_cached_greedy_matches_full_recompute(family):
-    make = gpt_tiny if family == "gpt" else llama_tiny
+    # moe_tiny's defaults (cf=2.0, E=4) make the training capacity
+    # s-dropless, so the full-context reference routes identically to
+    # the dropless cached-decode path and parity is exact
+    from tf_operator_tpu.models import moe_tiny
+
+    make = {"gpt": gpt_tiny, "llama": llama_tiny, "moe": moe_tiny}[family]
     model = make(vocab_size=VOCAB, max_len=64)
     prompt = jnp.asarray(
         np.random.RandomState(0).randint(0, VOCAB, size=(2, 5)), jnp.int32
@@ -90,16 +95,230 @@ def test_gqa_cache_is_kv_width():
 
 
 def test_unsupported_family_rejected_cleanly():
-    from tf_operator_tpu.models import bert_tiny, moe_tiny, t5_tiny
+    from tf_operator_tpu.models import bert_tiny, t5_tiny
 
     prompt = jnp.zeros((1, 2), jnp.int32)
     for model in (
-        moe_tiny(vocab_size=VOCAB, max_len=16),  # routing is training-shaped
         t5_tiny(vocab_size=VOCAB),  # needs encoder ids
         bert_tiny(vocab_size=VOCAB),  # bidirectional encoder
     ):
         with pytest.raises(NotImplementedError, match="decode is supported"):
             generate(model, {}, prompt, max_new_tokens=2)
+
+
+class TestChunkedServingDecoder:
+    """Compile-bounded serving decode (VERDICT r3 next #9): exact
+    parity with generate() at a logarithmic compile budget."""
+
+    def _setup(self, max_len=128):
+        from tf_operator_tpu.models.decode import ChunkedServingDecoder
+
+        model = llama_tiny(vocab_size=VOCAB, max_len=max_len)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        return model, params, ChunkedServingDecoder(model, params)
+
+    def test_chunked_prefill_matches_one_shot(self):
+        """Binary-decomposed prefill through the cache equals
+        generate()'s one-shot prefill — for awkward prompt lengths
+        (37 = 32+4+1).  Trains briefly first: different chunk shapes
+        compile to different XLA programs whose fp reassociation can
+        flip greedy argmax on near-tied INIT logits (benign, but an
+        exact-token compare needs real margins — same discipline as
+        test_trainer_sharded_generate_matches_gathered)."""
+
+        from tf_operator_tpu.models import llama_loss
+        from tf_operator_tpu.models.decode import ChunkedServingDecoder
+        from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+        from tf_operator_tpu.runtime.harness import gather_params
+
+        mesh = make_mesh({"dp": 8})
+        r = np.random.RandomState(0)
+        ids = r.randint(0, VOCAB, size=(8, 80)).astype(np.int32)
+        tr = Trainer(
+            llama_tiny(vocab_size=VOCAB, max_len=128, mesh=mesh),
+            TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+            mesh,
+            llama_loss,
+            {"input_ids": ids},
+            init_args=(ids,),
+            shardings="logical",
+        )
+        for _ in range(12):
+            tr.train_step(tr.shard_batch({"input_ids": ids}))
+        params = gather_params(tr)
+        model = llama_tiny(vocab_size=VOCAB, max_len=128)
+        dec = ChunkedServingDecoder(model, params)
+        for p_len, n_new in ((1, 7), (5, 7), (37, 7), (64, 7)):
+            prompt = jnp.asarray(r.randint(0, VOCAB, size=(2, p_len)), jnp.int32)
+            a = dec.generate(prompt, max_new_tokens=n_new)
+            b = generate(model, params, prompt, max_new_tokens=n_new)
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # longer/awkward prompts: assert the MATH (chunked prefill's
+        # last-position logits vs one-shot) with bf16 tolerance — exact
+        # greedy-token chains over many steps amplify benign program-
+        # level fp reassociation into tie-flips and say nothing extra
+        from tf_operator_tpu.models.decode import _init_cache_for
+
+        for p_len in (65, 127):
+            prompt = jnp.asarray(r.randint(0, VOCAB, size=(1, p_len)), jnp.int32)
+            cache, off, last = _init_cache_for(dec.dmodel, 1), 0, None
+            for w in dec._chunks(p_len):
+                cache, last = dec._prefill_fn(w)(
+                    params, cache, prompt[:, off : off + w]
+                )
+                off += w
+            _, one_shot = dec._prefill_fn(p_len)(
+                params, _init_cache_for(dec.dmodel, 1), prompt
+            )
+            np.testing.assert_allclose(
+                np.asarray(last), np.asarray(one_shot), rtol=0.02, atol=0.1
+            )
+
+    def test_overrun_budget_keeps_prefix_exact(self):
+        """When the power-of-two budget overruns the cache (p + budget >
+        max_len), the clamped tail writes must not corrupt the kept
+        tokens: a request whose budget overruns and one whose budget
+        doesn't produce the SAME leading tokens (the per-step decode
+        program is identical; only discarded steps differ)."""
+
+        model, params, dec = self._setup(max_len=128)
+        prompt = jnp.asarray(
+            np.random.RandomState(2).randint(0, VOCAB, size=(1, 66)), jnp.int32
+        )
+        a = dec.generate(prompt, 62)  # budget 64: write stream clamps at the edge
+        b = dec.generate(prompt, 30)  # budget 32: no overrun
+        np.testing.assert_array_equal(np.asarray(a[:, : 66 + 30]), np.asarray(b))
+
+    def test_sampling_deterministic_and_in_range(self):
+        model, params, dec = self._setup(max_len=64)
+        prompt = jnp.asarray(
+            np.random.RandomState(1).randint(0, VOCAB, size=(1, 13)), jnp.int32
+        )
+        key = jax.random.PRNGKey(3)
+        a = dec.generate(prompt, 6, temperature=0.8, top_k=8, rng=key)
+        b = dec.generate(prompt, 6, temperature=0.8, top_k=8, rng=key)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        gen = np.asarray(a[:, 13:])
+        assert gen.shape == (1, 6)
+        assert gen.min() >= 0 and gen.max() < VOCAB
+
+    def test_compile_count_bounded_across_random_lengths(self):
+        """50 random-length requests stay within the logarithmic
+        program budget: <= log2(max_len)+1 prefill chunks plus one
+        decode loop per (budget bucket, sampling config)."""
+
+        _, _, dec = self._setup(max_len=128)
+        r = np.random.RandomState(7)
+        budgets = set()
+        for _ in range(50):
+            # full valid range INCLUDING p near max_len, where the
+            # budget overruns the cache — keys must stay powers of two
+            p_len = int(r.randint(1, 120))
+            n_new = int(r.randint(1, 128 - p_len + 1))
+            prompt = jnp.asarray(r.randint(0, VOCAB, size=(1, p_len)), jnp.int32)
+            out = dec.generate(prompt, n_new)
+            assert out.shape == (1, p_len + n_new)
+            budgets.add(1 << (n_new - 1).bit_length())
+        # greedy requests with different top_k normalise onto ONE key
+        prompt = jnp.asarray(r.randint(0, VOCAB, size=(1, 8)), jnp.int32)
+        before = dec.compile_count
+        dec.generate(prompt, 4, top_k=4)
+        dec.generate(prompt, 4, top_k=9)
+        assert dec.compile_count <= before + 1
+        bound = 8 + len(budgets) + 1  # prefill chunks (2^0..2^7) + loops
+        assert dec.compile_count <= bound, (dec.compile_count, bound)
+        assert dec.compile_count < 50  # emphatically not one-per-request
+
+    def test_validation(self):
+        _, _, dec = self._setup(max_len=32)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="temperature"):
+            dec.generate(prompt, 4, temperature=-0.5)
+        with pytest.raises(ValueError, match="max_len"):
+            dec.generate(prompt, 40)
+        with pytest.raises(ValueError, match="at least one token"):
+            dec.generate(jnp.zeros((1, 0), jnp.int32), 4)
+
+
+class TestMoeDecode:
+    """Routed-expert serving (VERDICT r3 weak #6 / next #7)."""
+
+    def test_decode_routing_is_dropless(self):
+        """Training uses fixed capacity buckets that may drop tokens;
+        the decode variant must not.  Rig the router so every token
+        picks expert 0: under the droppy training config later tokens
+        fall off the bucket (zero FFN output rows); the decode config
+        admits all of them."""
+
+        from tf_operator_tpu.models.moe import MoeConfig, MoeMlp
+        from tf_operator_tpu.models.transformer import TransformerConfig
+        import dataclasses as dc
+
+        base = TransformerConfig(
+            vocab_size=32, hidden=16, n_heads=2, head_dim=8,
+            n_layers=1, mlp_dim=32, max_len=64,
+        )
+        # E=8, cf=0.25 -> training capacity max(int(s/16), 4) = 4 at s=24
+        moe_train = MoeConfig(base=base, num_experts=8, capacity_factor=0.25)
+        moe_decode = dc.replace(moe_train, base=dc.replace(base, decode=True))
+        s = 24
+        x = jnp.asarray(
+            np.random.RandomState(0).rand(1, s, 16), jnp.float32
+        )
+        params = MoeMlp(moe_train).init(jax.random.PRNGKey(0), x)["params"]
+        # router kernel [H, E]: huge bias toward expert 0
+        rigged = jax.tree_util.tree_map(lambda p: p, params)
+        kernel = np.zeros((16, 8), np.float32)
+        kernel[:, 0] = 10.0
+        rigged["router"]["kernel"] = jnp.asarray(kernel)
+
+        out_train = MoeMlp(moe_train).apply({"params": rigged}, x)
+        out_decode = MoeMlp(moe_decode).apply({"params": rigged}, x)
+        # token rows past the capacity-4 bucket get NO expert output in
+        # training mode; decode mode serves every row
+        train_rows = np.abs(np.asarray(out_train[0])).sum(-1)
+        decode_rows = np.abs(np.asarray(out_decode[0])).sum(-1)
+        assert (train_rows[:4] > 1e-6).all()
+        assert (train_rows[4:] < 1e-6).all(), "tokens past capacity must drop"
+        assert (decode_rows > 1e-6).all(), "decode must be dropless"
+
+    def test_moe_cache_and_pos_index(self):
+        from tf_operator_tpu.models import moe_tiny
+        from tf_operator_tpu.models.decode import init_cache
+
+        model = moe_tiny(vocab_size=VOCAB, max_len=32)
+        cache = init_cache(model, batch_size=2)
+        ck = cache["layer_0"]["self_attn"]["cached_key"]
+        assert ck.shape == (2, 4, 32, 32)  # [B, H, max_len, D]
+        assert int(cache["pos_index"]) == 0
+
+    def test_trainer_generate_moe_ep_sharded(self):
+        """trainer.generate works for an ep-sharded MoE (the serving
+        path VERDICT r3 weak #6 said was missing)."""
+
+        from tf_operator_tpu.models import moe_lm_loss, moe_tiny
+        from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+
+        mesh = make_mesh({"dp": 4, "ep": 2})
+        ids = np.random.RandomState(3).randint(0, VOCAB, size=(8, 24)).astype(np.int32)
+        tr = Trainer(
+            moe_tiny(vocab_size=VOCAB, max_len=32, mesh=mesh),
+            TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+            mesh,
+            moe_lm_loss,
+            {"input_ids": ids},
+            init_args=(ids,),
+            shardings="logical",
+        )
+        for _ in range(6):
+            tr.train_step(tr.shard_batch({"input_ids": ids}))
+        prompt = jnp.asarray(ids[:2, :6])
+        out = tr.generate(prompt, max_new_tokens=6)
+        assert out.shape == (2, 12)
+        gen = np.asarray(out[:, 6:])
+        assert gen.min() >= 0 and gen.max() < VOCAB
+        np.testing.assert_array_equal(np.asarray(out[:, :6]), np.asarray(prompt))
 
 
 def test_temperature_without_rng_rejected():
@@ -246,6 +465,30 @@ def test_serve_lm_end_to_end(tmp_path):
         # health + error paths
         with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
             assert json.loads(r.read())["ok"]
+        # ADVICE r3: top_k arriving as a JSON string must be cast (not
+        # used raw as a compile key), including on the greedy path
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(
+                {"prompt": "abc", "max_new_tokens": 4, "top_k": "8"}
+            ).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert json.loads(resp.read())["sample"]
+        # negative temperature (inverted distribution) is a 400
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(
+                {"prompt": "abc", "max_new_tokens": 4, "temperature": -1.0}
+            ).encode(),
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("negative temperature not rejected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
         bad = urllib.request.Request(
             f"http://127.0.0.1:{port}/generate",
             data=json.dumps({"prompt": "x" * 100, "max_new_tokens": 100}).encode(),

@@ -1,0 +1,256 @@
+"""TPUJob → real-Kubernetes manifest compiler (the GKE translation layer).
+
+Parity: SURVEY.md §7 scopes the cluster substrate as "an in-proc fake
+and a local-process backend now; a real GKE/TPU-VM backend is an
+interface to be filled later".  A live GKE backend needs a cluster and
+network this box doesn't have — but the *compilable* half doesn't
+(VERDICT r3 missing #2): this module translates a TPUJob manifest into
+exactly the Kubernetes objects the reference operator would create
+(SURVEY.md §3.2's write boundary), so the declarative surface is
+cluster-ready and golden-testable offline:
+
+- one **Pod** per replica index, with the reference's label triple,
+  TF_CONFIG / TPUJOB_* / TPU_WORKER_* / MEGASCALE_* env injected at the
+  same point ``createNewPod`` would (SURVEY.md §2 "TF_CONFIG
+  generation"), the ExitCode→Never pod-restart mapping, and — for
+  TPU_SLICE replicas — the GKE TPU nodeSelectors
+  (``cloud.google.com/gke-tpu-accelerator``/``-topology``) plus
+  ``google.com/tpu`` chip limits per host;
+- one **headless Service** per replica (stable DNS for the cluster
+  spec — the ``<pod>.<ns>.svc`` names the dns_resolver emits);
+- a **volcano PodGroup** (``scheduling.volcano.sh/v1beta1``) when gang
+  scheduling is on, with ``minMember`` = total pod count and the
+  ``scheduling.k8s.io/group-name`` annotation + ``schedulerName:
+  volcano`` stamped on every pod (SURVEY.md §3.4).
+
+What a LIVE backend still needs beyond this compiler (documented for
+the interface): a kube-apiserver client implementing the 5
+ClusterBackend verbs + watch (pods/services CRUD, exit-code and phase
+readback), ownerReferences carrying the TPUJob CRD uid (unknowable
+offline — the operator sets them at create time), and RBAC for
+pods/services/events/podgroups.  See docs/ARCHITECTURE.md.
+
+Usage:
+    tpujob compile -f job.yaml            # multi-doc YAML on stdout
+    from tf_operator_tpu.backend.gke import compile_job, to_yaml
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.api.types import (
+    DEFAULT_PORT,
+    DEFAULT_PORT_NAME,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+    replica_labels,
+    replica_name,
+)
+from tf_operator_tpu.api.validation import CHIPS_PER_HOST, parse_tpu_topology
+from tf_operator_tpu.bootstrap.cluster_spec import _replica_port, dns_resolver
+from tf_operator_tpu.bootstrap.tpu_env import worker_env
+
+#: volcano's pod→group binding annotation (the REAL scheduler's
+#: convention; the in-proc backends use the internal
+#: ANNOTATION_GANG_GROUP instead)
+VOLCANO_GROUP_ANNOTATION = "scheduling.k8s.io/group-name"
+VOLCANO_SCHEDULER = "volcano"
+
+#: GKE accelerator nodeSelector value per TPU generation prefix
+_GKE_ACCELERATOR = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+#: chip count → GKE topology grid (v5e/v6e 2-D ICI layouts)
+_GKE_TOPOLOGY = {
+    1: "1x1", 4: "2x2", 8: "2x4", 16: "4x4",
+    32: "4x8", 64: "8x8", 128: "8x16", 256: "16x16",
+}
+
+
+def _pod_restart_policy(rp: Optional[RestartPolicy]) -> str:
+    """The reference's pod-level mapping: the operator owns retry for
+    ExitCode (pod must NOT self-restart → Never); Always becomes
+    OnFailure because bare pods forbid Always-after-success semantics
+    the operator implements itself (SURVEY.md §3.2 "restart-policy
+    mapping")."""
+
+    if rp in (RestartPolicy.EXIT_CODE, RestartPolicy.NEVER, None):
+        return "Never"
+    return "OnFailure"
+
+
+def _tpu_node_selector(topology: str) -> Dict[str, str]:
+    gen = topology.split("-", 1)[0].lower()
+    accel = _GKE_ACCELERATOR.get(gen)
+    if accel is None:
+        raise ValueError(
+            f"no GKE accelerator mapping for TPU generation {gen!r} "
+            f"(topology {topology!r}); known: {sorted(_GKE_ACCELERATOR)}"
+        )
+    chips = parse_tpu_topology(topology)
+    grid = _GKE_TOPOLOGY.get(chips)
+    if grid is None:
+        raise ValueError(
+            f"no GKE topology grid for {chips} chips (topology {topology!r})"
+        )
+    return {
+        "cloud.google.com/gke-tpu-accelerator": accel,
+        "cloud.google.com/gke-tpu-topology": grid,
+    }
+
+
+def _container_to_k8s(c, env: Dict[str, str], tpu_chips: int) -> Dict[str, Any]:
+    merged = dict(env)
+    merged.update(c.env)  # user-specified env wins, like the reconciler
+    out: Dict[str, Any] = {
+        "name": c.name,
+        "image": c.image or "REPLACE_WITH_TRAINING_IMAGE",
+        "env": [
+            {"name": k, "value": v} for k, v in sorted(merged.items())
+        ],
+    }
+    if c.command:
+        out["command"] = list(c.command)
+    if c.args:
+        out["args"] = list(c.args)
+    ports = [
+        {"name": p.name, "containerPort": p.container_port} for p in c.ports
+    ]
+    if not ports:
+        # the defaulted port the cluster spec advertises must be open
+        ports = [{"name": DEFAULT_PORT_NAME, "containerPort": DEFAULT_PORT}]
+    out["ports"] = ports
+    resources = dict(c.resources) if c.resources else {}
+    if tpu_chips:
+        limits = dict(resources.get("limits", {}))
+        limits["google.com/tpu"] = str(tpu_chips)
+        resources["limits"] = limits
+    if resources:
+        out["resources"] = resources
+    return out
+
+
+def _compile_pod(job: TPUJob, rtype: ReplicaType, index: int) -> Dict[str, Any]:
+    spec = job.spec.replica_specs[rtype]
+    template = spec.template
+    name = replica_name(job.metadata.name, rtype, index)
+    env = worker_env(job, rtype, index, dns_resolver)
+
+    tpu_chips = 0
+    node_selector = dict(template.node_selector)
+    if rtype is ReplicaType.TPU_SLICE and spec.tpu_topology:
+        node_selector.update(_tpu_node_selector(spec.tpu_topology))
+        # per-host chip share of the atomic slice (one pod per host VM)
+        chips = parse_tpu_topology(spec.tpu_topology)
+        hosts = spec.slice_host_count()
+        tpu_chips = min(CHIPS_PER_HOST, max(1, -(-chips // hosts)))
+
+    labels = {**template.labels, **replica_labels(job.metadata.name, rtype, index)}
+    annotations = dict(template.annotations)
+    scheduler = template.scheduler_name
+    if job.spec.enable_gang_scheduling:
+        annotations[VOLCANO_GROUP_ANNOTATION] = job.metadata.name
+        scheduler = scheduler or VOLCANO_SCHEDULER
+
+    pod_spec: Dict[str, Any] = {
+        "restartPolicy": _pod_restart_policy(spec.restart_policy),
+        "containers": [
+            _container_to_k8s(c, env, tpu_chips) for c in template.containers
+        ],
+    }
+    if node_selector:
+        pod_spec["nodeSelector"] = node_selector
+    if scheduler:
+        pod_spec["schedulerName"] = scheduler
+
+    meta: Dict[str, Any] = {
+        "name": name,
+        "namespace": job.metadata.namespace,
+        "labels": labels,
+    }
+    if annotations:
+        meta["annotations"] = annotations
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": meta, "spec": pod_spec}
+
+
+def _compile_service(job: TPUJob, rtype: ReplicaType, index: int) -> Dict[str, Any]:
+    name = replica_name(job.metadata.name, rtype, index)
+    labels = replica_labels(job.metadata.name, rtype, index)
+    port = _replica_port(job, rtype)
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": name,
+            "namespace": job.metadata.namespace,
+            "labels": dict(labels),
+        },
+        "spec": {
+            "clusterIP": "None",  # headless: DNS resolves to the pod IP
+            "selector": dict(labels),
+            "ports": [{"name": DEFAULT_PORT_NAME, "port": port}],
+        },
+    }
+
+
+def _compile_podgroup(job: TPUJob) -> Dict[str, Any]:
+    sp = job.spec.run_policy.scheduling_policy
+    min_member = (
+        sp.min_member
+        if sp is not None and sp.min_member is not None
+        else job.spec.total_pods()
+    )
+    out: Dict[str, Any] = {
+        "apiVersion": "scheduling.volcano.sh/v1beta1",
+        "kind": "PodGroup",
+        "metadata": {
+            "name": job.metadata.name,
+            "namespace": job.metadata.namespace,
+        },
+        "spec": {"minMember": min_member},
+    }
+    if sp is not None and sp.queue:
+        out["spec"]["queue"] = sp.queue
+    if sp is not None and sp.priority_class:
+        out["spec"]["priorityClassName"] = sp.priority_class
+    return out
+
+
+def compile_job(job: TPUJob) -> List[Dict[str, Any]]:
+    """All Kubernetes objects for one TPUJob, in apply order: PodGroup
+    (gang) first — pods referencing a group must find it — then per
+    replica the headless Service before its Pod (the cluster-spec DNS
+    names must resolve by the time training code reads TF_CONFIG)."""
+
+    objs: List[Dict[str, Any]] = []
+    if job.spec.enable_gang_scheduling:
+        objs.append(_compile_podgroup(job))
+    for rtype in job.spec.ordered_types():
+        for index in range(job.spec.pod_count(rtype)):
+            objs.append(_compile_service(job, rtype, index))
+            objs.append(_compile_pod(job, rtype, index))
+    return objs
+
+
+def to_yaml(objs: List[Dict[str, Any]]) -> str:
+    import yaml
+
+    return yaml.safe_dump_all(objs, sort_keys=False, default_flow_style=False)
+
+
+def compile_manifest(manifest: Dict[str, Any]) -> str:
+    """dict manifest → defaults → admission validation → k8s YAML."""
+
+    from tf_operator_tpu.api.defaults import set_defaults
+    from tf_operator_tpu.api.serde import job_from_dict
+    from tf_operator_tpu.api.validation import validate
+
+    job = set_defaults(job_from_dict(manifest))
+    validate(job)
+    return to_yaml(compile_job(job))

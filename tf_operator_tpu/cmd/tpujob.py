@@ -10,6 +10,8 @@ This client speaks the operator's HTTP job API instead:
     tpujob describe NAME [-n ns]         # kubectl describe (status + events)
     tpujob delete NAME [-n ns]           # kubectl delete
     tpujob logs NAME POD [-n ns]         # kubectl logs (local backend)
+    tpujob compile -f job.yaml           # TPUJob -> real Kubernetes YAML
+                                         # (backend/gke.py; offline, no server)
 
 Manifests are the serde camelCase shape, YAML or JSON.
 """
@@ -175,6 +177,21 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_compile(args) -> int:
+    from tf_operator_tpu.backend.gke import compile_manifest
+
+    with open(args.filename) as f:
+        manifest = yaml.safe_load(f)
+    out = compile_manifest(manifest)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out)
+        print(f"wrote {args.output}")
+    else:
+        print(out, end="")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpujob", description=__doc__.split("\n")[0])
     p.add_argument(
@@ -190,6 +207,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--wait", action="store_true", help="block until terminal")
     sp.add_argument("--timeout", type=float, default=600.0)
     sp.set_defaults(fn=cmd_submit)
+
+    cp = sub.add_parser(
+        "compile", help="translate a TPUJob manifest to Kubernetes YAML"
+    )
+    cp.add_argument("-f", "--filename", required=True)
+    cp.add_argument("-o", "--output", default="")
+    cp.set_defaults(fn=cmd_compile)
 
     lp = sub.add_parser("list", help="list TPUJobs")
     lp.add_argument("-n", "--namespace", default="")

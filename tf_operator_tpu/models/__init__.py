@@ -9,7 +9,11 @@ named mesh in tf_operator_tpu.parallel.
 
 from tf_operator_tpu.models.bert import Bert, BertForPretraining, bert_base, bert_tiny, mlm_loss
 from tf_operator_tpu.models.gpt import CausalLM, gpt_small, gpt_tiny, lm_loss
-from tf_operator_tpu.models.decode import generate, init_cache
+from tf_operator_tpu.models.decode import (
+    ChunkedServingDecoder,
+    generate,
+    init_cache,
+)
 from tf_operator_tpu.models.llama import LlamaLM, llama_7b_shape, llama_loss, llama_tiny
 from tf_operator_tpu.models.mnist import MnistCNN
 from tf_operator_tpu.models.pipelined_lm import PipelinedLM, lm_reference_apply

@@ -13,11 +13,12 @@ trained.  TPU-first design choices:
 - Prefill and decode share one code path (the MHA cache branch handles
   s_new = prompt_len and s_new = 1 uniformly).
 
-Works with every decoder family built on models/transformer.py
-(CausalLM/GPT with learned positions, LlamaLM with RoPE).  The MoE and
-pipelined families don't support decode yet (their routing/stage
-schedules are training-shaped); `_decode_variant` rejects them with a
-clear NotImplementedError.
+Works with every decoder family built on models/transformer.py:
+CausalLM/GPT (learned positions), LlamaLM (RoPE + GQA), and MoeLM
+(routed experts — dropless per-token dispatch at decode, see
+models/moe.py).  The pipelined family doesn't support decode (its
+stage schedule is training-shaped); `_decode_variant` rejects it with
+a clear NotImplementedError.
 """
 
 from __future__ import annotations
@@ -35,16 +36,21 @@ from tf_operator_tpu.models.transformer import TransformerConfig
 def _decode_variant(model):
     """The same architecture with decode=True (frozen-config swap)."""
 
-    # families opt in via SUPPORTS_DECODE (CausalLM, LlamaLM): rules
-    # out MoE/pipelined (training-shaped schedules) AND the non-decoder
-    # TransformerConfig families (T5 needs encoder ids; BERT would
-    # "generate" from a bidirectional encoder)
+    # families opt in via SUPPORTS_DECODE (CausalLM, LlamaLM, MoeLM):
+    # rules out pipelined (training-shaped stage schedule) AND the
+    # non-decoder TransformerConfig families (T5 needs encoder ids;
+    # BERT would "generate" from a bidirectional encoder)
     if not getattr(type(model), "SUPPORTS_DECODE", False):
         raise NotImplementedError(
             f"decode is supported for the autoregressive decoder "
-            f"families (CausalLM, LlamaLM — classes with "
+            f"families (CausalLM, LlamaLM, MoeLM — classes with "
             f"SUPPORTS_DECODE=True); got {type(model).__name__}"
         )
+    # families whose config nests TransformerConfig (MoeLM) provide the
+    # swap themselves
+    variant = getattr(model, "decode_variant", None)
+    if variant is not None:
+        return variant()
     cfg = model.cfg
     assert isinstance(cfg, TransformerConfig)
     return type(model)(dataclasses.replace(cfg, decode=True, dropout=0.0))
@@ -132,3 +138,163 @@ def generate(
     )
     gen = jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1)
     return jnp.concatenate([prompt_ids, gen], axis=1)
+
+
+class ChunkedServingDecoder:
+    """Compile-bounded generation for serving (VERDICT r3 next #9).
+
+    `generate()` compiles one XLA program per *prompt shape*, so a
+    server facing natural traffic (every distinct prompt length a fresh
+    shape) compiles without bound.  Padding prompts to buckets would
+    bound it but CHANGES the result (pad tokens land in the KV cache
+    and shift positions).  This decoder keeps the semantics exact and
+    the compile count logarithmic instead:
+
+    - **Prefill in power-of-2 chunks.**  The KV cache makes prefill
+      incremental: feeding the prompt as its binary decomposition
+      (e.g. 37 = 32+4+1) through the cache is bit-identical to one-shot
+      prefill, and every chunk width is a power of two — at most
+      log2(max_len)+1 prefill programs EVER, shared by all requests.
+    - **Token budgets rounded up to powers of two.**  The decode scan
+      compiles per (budget, sampling config); generating extra tokens
+      and slicing the first n is semantics-preserving (the rng chain
+      and cache writes for the first n tokens are identical).
+
+    `compile_count` exposes the number of distinct XLA programs built,
+    so tests (and capacity planning) can pin the bound.
+    """
+
+    def __init__(self, model, params, max_loops: int = 24):
+        from collections import OrderedDict
+
+        self.dmodel = _decode_variant(model)
+        self.params = params
+        self.max_len = self.dmodel.cfg.max_len
+        self._prefill = {}  # chunk width -> jitted apply; <= log2(max_len)+1
+        #: (budget, temperature, top_k) -> jitted scan.  LRU-bounded:
+        #: budgets are powers of two but temperature/top_k are
+        #: client-influenced — without a bound an adversarial sweep
+        #: (temperature grid x top_k range) would retain one compiled
+        #: program per combination forever
+        self._loops = OrderedDict()
+        self._max_loops = max_loops
+        self.compile_count = 0
+
+    @staticmethod
+    def _chunks(n: int) -> list:
+        """Binary decomposition of n, largest chunk first."""
+
+        out, bit = [], 1 << n.bit_length()
+        while n:
+            bit >>= 1
+            if n >= bit:
+                out.append(bit)
+                n -= bit
+        return out
+
+    def _prefill_fn(self, width: int):
+        if width not in self._prefill:
+            dmodel = self.dmodel
+
+            def prefill(params, cache, ids):
+                logits, vars_ = dmodel.apply(
+                    {"params": params, "cache": cache}, ids, mutable=["cache"]
+                )
+                return vars_["cache"], logits[:, -1]
+
+            self._prefill[width] = jax.jit(prefill)
+            self.compile_count += 1
+        return self._prefill[width]
+
+    def _loop_fn(self, n_new: int, temperature: float, top_k):
+        key = (n_new, temperature, top_k)
+        if key in self._loops:
+            self._loops.move_to_end(key)
+        else:
+            while len(self._loops) >= self._max_loops:
+                self._loops.popitem(last=False)
+            dmodel = self.dmodel
+
+            def sample(logits, r):
+                if temperature == 0.0:
+                    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                scaled = logits / temperature
+                if top_k is not None:
+                    kth = lax.top_k(scaled, top_k)[0][..., -1:]
+                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                return jax.random.categorical(r, scaled).astype(jnp.int32)
+
+            def loop(params, cache, last_logits, rng):
+                rng, r0 = jax.random.split(rng)
+                tok = sample(last_logits, r0)
+
+                def body(carry, _):
+                    cache, tok, rng = carry
+                    logits, vars_ = dmodel.apply(
+                        {"params": params, "cache": cache},
+                        tok[:, None],
+                        mutable=["cache"],
+                    )
+                    rng, r = jax.random.split(rng)
+                    nxt = sample(logits[:, 0], r)
+                    return (vars_["cache"], nxt, rng), tok
+
+                (_, last, _), toks = lax.scan(
+                    body, (cache, tok, rng), None, length=n_new - 1
+                )
+                return jnp.concatenate(
+                    [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1
+                )
+
+            self._loops[key] = jax.jit(loop)
+            self.compile_count += 1
+        return self._loops[key]
+
+    def generate(
+        self,
+        prompt_ids: jax.Array,  # [B, P] int32
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        rng: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        b, p = prompt_ids.shape
+        if p < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if p + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_len={self.max_len}"
+            )
+        if temperature == 0.0:
+            # greedy ignores top_k — normalising it off the compile key
+            # stops distinct greedy requests compiling identical loops
+            top_k = None
+        # budget stays an exact power of two so the loop-key set is
+        # logarithmic even when p + budget overruns max_len: the extra
+        # discarded steps write through dynamic_update_slice, whose
+        # start indices CLAMP at the cache edge, and every token we
+        # keep (step < max_new_tokens, position < max_len) is produced
+        # before any clamped write — overrun garbage is sliced away
+        budget = 1 << (max_new_tokens - 1).bit_length()  # next power of 2
+        if rng is None:
+            if temperature != 0.0:
+                raise ValueError("temperature sampling needs an explicit rng key")
+            rng = jax.random.PRNGKey(0)
+
+        cache = _init_cache_for(self.dmodel, b)
+        offset, last = 0, None
+        for width in self._chunks(p):
+            cache, last = self._prefill_fn(width)(
+                self.params, cache, prompt_ids[:, offset : offset + width]
+            )
+            offset += width
+        toks = self._loop_fn(budget, temperature, top_k)(
+            self.params, cache, last, rng
+        )
+        return jnp.concatenate([prompt_ids, toks[:, :max_new_tokens]], axis=1)

@@ -70,7 +70,15 @@ class TransformerConfig:
     # autoregressive decode mode: self-attention layers maintain a
     # [B, Hkv, max_len, D] K/V cache ("cache" collection) written at
     # the running index — static shapes throughout, so the whole
-    # generate loop jits into one XLA program (models/decode.py)
+    # generate loop jits into one XLA program (models/decode.py).
+    # DELIBERATE (ADVICE r3): decode IGNORES sp_impl/sp meshes — the
+    # sequence-parallel schedules shard the TRAINING sequence axis,
+    # while cached decode queries are s_new<=prompt_len against an
+    # unsharded cache, where plain masked attention is the correct
+    # (and only sensible) schedule.  An sp-trained model generates
+    # fine; its sp mesh axes simply don't participate.  This is a
+    # documented no-op, not a silent downgrade: raising here would
+    # break generation for every sp-trained model.
     decode: bool = False
 
     def __post_init__(self):

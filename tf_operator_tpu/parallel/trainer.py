@@ -239,12 +239,25 @@ class Trainer:
 
     def eval_step(self, batch: Batch) -> Dict[str, jax.Array]:
         """Forward-only metrics on a held-out batch: no grads, no state
-        update, deterministic.  Same sharding as train_step."""
+        update, deterministic.  Same sharding as train_step.
+
+        The compiled step is cached keyed on the CURRENT sharding trees
+        (ADVICE r3): swapping in differently-sharded state/batch
+        shardings rebuilds instead of silently running with stale
+        in_shardings.  The key holds strong references and compares by
+        identity — id()-based keys could alias a GC'd tree's reused
+        address."""
 
         import flax.linen as nn
 
-        if not hasattr(self, "_eval_step_fn"):
+        prev = getattr(self, "_eval_step_key", None)
+        if (
+            prev is None
+            or prev[0] is not self.state_sharding
+            or prev[1] is not self.batch_sharding
+        ):
             self._eval_step_fn = self._build_eval_step()
+            self._eval_step_key = (self.state_sharding, self.batch_sharding)
         with self.mesh, nn.logical_axis_rules(self._rules):
             return self._eval_step_fn(self.state, batch)
 
@@ -274,15 +287,26 @@ class Trainer:
         if rng is None:
             rng = jax.random.PRNGKey(0)  # greedy: never consumed meaningfully
         if not hasattr(self, "_gen_cache"):
-            self._gen_cache = {}
+            from collections import OrderedDict
+
+            self._gen_cache = OrderedDict()
         key = (tuple(prompt_ids.shape), max_new_tokens, temperature, top_k)
         if key not in self._gen_cache:
+            # LRU-bounded (ADVICE r3): many distinct prompt shapes must
+            # not accumulate compiled programs for the process lifetime.
+            # A server facing arbitrary lengths should use
+            # models/decode.ChunkedServingDecoder instead (logarithmic
+            # program count by construction).
+            while len(self._gen_cache) >= 16:
+                self._gen_cache.popitem(last=False)
             self._gen_cache[key] = jax.jit(
                 lambda params, prompt, r: generate(
                     self.model, params, prompt, max_new_tokens,
                     temperature=temperature, top_k=top_k, rng=r,
                 )
             )
+        else:
+            self._gen_cache.move_to_end(key)
         with self.mesh, nn.logical_axis_rules(self._rules):
             return self._gen_cache[key](self.state.params, prompt_ids, rng)
 
