@@ -106,18 +106,38 @@ class TestHappyPath:
 
 class TestEnvInjection:
     def test_tf_config_content(self):
+        # no-PS job: the dense config with true indices
         store, backend, c = harness()
-        submit(store, c, new_job(chief=1, ps=1, worker=2))
+        submit(store, c, new_job(chief=1, worker=2))
         pod = backend.get_pod("default", "job-worker-1")
         cfg = json.loads(pod.main_container().env["TF_CONFIG"])
         assert cfg["task"] == {"type": "worker", "index": 1}
         assert cfg["cluster"]["chief"] == ["job-chief-0.default.svc:2222"]
-        assert cfg["cluster"]["ps"] == ["job-ps-0.default.svc:2222"]
         assert cfg["cluster"]["worker"] == [
             "job-worker-0.default.svc:2222",
             "job-worker-1.default.svc:2222",
         ]
         assert cfg["environment"] == "cloud"
+
+    def test_tf_config_ps_topology_sparse(self):
+        # PS jobs inject the SPARSE variant for workers (the TF
+        # parameter-server convention — bootstrap/tpu_env.worker_env):
+        # full chief/ps lists, own-entry worker list as index 0; PS
+        # pods keep the dense view
+        store, backend, c = harness()
+        submit(store, c, new_job(chief=1, ps=1, worker=2))
+        cfg = json.loads(
+            backend.get_pod("default", "job-worker-1").main_container().env["TF_CONFIG"]
+        )
+        assert cfg["task"] == {"type": "worker", "index": 0}
+        assert cfg["cluster"]["chief"] == ["job-chief-0.default.svc:2222"]
+        assert cfg["cluster"]["ps"] == ["job-ps-0.default.svc:2222"]
+        assert cfg["cluster"]["worker"] == ["job-worker-1.default.svc:2222"]
+        ps_cfg = json.loads(
+            backend.get_pod("default", "job-ps-0").main_container().env["TF_CONFIG"]
+        )
+        assert ps_cfg["task"] == {"type": "ps", "index": 0}
+        assert len(ps_cfg["cluster"]["worker"]) == 2
 
     def test_tpu_env_coordinator_and_process_ids(self):
         store, backend, c = harness()

@@ -130,6 +130,7 @@ class TestDevicePrefetch:
         assert float(b["image"].max()) <= 1.0
 
 
+@pytest.mark.slow
 class TestLearnability:
     def test_mnist_accuracy_climbs(self, mnist_dir):
         """The procedural dataset carries real class signal: a CNN

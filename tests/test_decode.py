@@ -5,6 +5,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# default-tier exclusion (generation-loop compiles); see README 'Tests run in two tiers'
+pytestmark = pytest.mark.slow
+
 from tf_operator_tpu.models import generate, gpt_tiny, llama_tiny
 
 VOCAB = 128

@@ -11,6 +11,9 @@ import time
 
 import pytest
 
+# default-tier exclusion (subprocess jax.distributed worlds); see README 'Tests run in two tiers'
+pytestmark = pytest.mark.slow
+
 from tests.testutil import new_job
 from tf_operator_tpu.api.types import JobConditionType, ReplicaType, SuccessPolicy
 from tf_operator_tpu.backend.jobstore import JobStore

@@ -12,6 +12,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# default-tier exclusion (pallas interpret-mode kernels); see README 'Tests run in two tiers'
+pytestmark = pytest.mark.slow
+
 from tf_operator_tpu.ops import dot_product_attention, flash_attention
 from tf_operator_tpu.ops.flash_attention import attention
 

@@ -5,6 +5,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# default-tier exclusion (llama family compiles); see README 'Tests run in two tiers'
+pytestmark = pytest.mark.slow
+
 from tf_operator_tpu.models import llama_tiny, llama_loss
 from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
 

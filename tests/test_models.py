@@ -5,6 +5,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+# default-tier exclusion (full-model train-step compiles); see README 'Tests run in two tiers'
+pytestmark = pytest.mark.slow
 from jax.sharding import PartitionSpec as P
 
 from tf_operator_tpu.models import (

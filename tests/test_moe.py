@@ -6,6 +6,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# default-tier exclusion (routed-MoE train compiles); see README 'Tests run in two tiers'
+pytestmark = pytest.mark.slow
+
 from tf_operator_tpu.models import moe_lm_loss, moe_tiny
 from tf_operator_tpu.models.moe import MoeConfig, MoeMlp
 from tf_operator_tpu.models.transformer import TransformerConfig

@@ -8,6 +8,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+# default-tier exclusion (trainer/sharding compiles); see README 'Tests run in two tiers'
+pytestmark = pytest.mark.slow
 from jax.sharding import NamedSharding, PartitionSpec
 
 from tf_operator_tpu.models import MnistCNN, resnet18

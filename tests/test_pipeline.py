@@ -8,6 +8,9 @@ import numpy as np
 import optax
 import pytest
 
+# default-tier exclusion (pipeline schedule compiles); see README 'Tests run in two tiers'
+pytestmark = pytest.mark.slow
+
 from tf_operator_tpu.parallel import make_mesh, pipeline_apply, stack_stage_params
 
 D = 16

@@ -5,6 +5,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# default-tier exclusion (ring schedules in interpret mode); see README 'Tests run in two tiers'
+pytestmark = pytest.mark.slow
+
 from tf_operator_tpu.ops import dot_product_attention, ring_attention
 from tf_operator_tpu.parallel import make_mesh
 
@@ -354,14 +357,110 @@ def test_ring_window_grads_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5)
 
 
-def test_ring_window_flash_requested_rejected():
-    mesh = make_mesh({"sp": 4, "dp": -1})
-    q, k, v = _qkv()
-    with pytest.raises(NotImplementedError, match="flash-ring"):
-        ring_attention(
-            q, k, v, mesh, causal=True, window=8, use_flash=True,
-            block_q=8, block_k=8, interpret=True,
+class TestWindowFlashRing:
+    """window x flash-ring (ADVICE r3 #1): hop classification — banded
+    diagonal kernel, plain kernel for fully-in-band hops, XLA
+    global-offset blocks for the <=2 boundary hops, skipped band-out
+    hops — must equal the single-device banded reference exactly."""
+
+    def _qkv(self, B=2, H=2, HKV=None, S=128, D=64, seed=9):
+        r = np.random.RandomState(seed)
+        hkv = HKV or H
+        return (
+            jnp.asarray(r.randn(B, H, S, D), jnp.float32) * 0.3,
+            jnp.asarray(r.randn(B, hkv, S, D), jnp.float32) * 0.3,
+            jnp.asarray(r.randn(B, hkv, S, D), jnp.float32),
         )
+
+    # S=128 over sp=4 -> sq=32, past-hop deltas {32, 64, 96}.
+    # w=8: banded diagonal + ONE boundary hop (delta 32 < 8+31) whose
+    #   kept rows are 0-6; deltas 64/96 band-out.
+    # w=40: two boundary hops (32, 64); 96 band-out.
+    # w=70: delta 32 fully in band (plain kernel), 64 and 96 boundary.
+    # w=120: deltas 32/64 fully in, 96 boundary (96+31 >= 120).
+    # w=128: ALL past hops fully in band (96+31 < 128) — the all-plain-
+    #   kernel class, equivalent to unwindowed causal.
+    @pytest.mark.parametrize("w", [8, 40, 70, 120, 128])
+    def test_forward_matches_banded_reference(self, w):
+        mesh = make_mesh({"sp": 4, "dp": 2})
+        q, k, v = self._qkv()
+        ref = dot_product_attention(q, k, v, causal=True, window=w)
+        with mesh:
+            out = jax.jit(
+                lambda a, b, c: ring_attention(
+                    a, b, c, mesh, causal=True, window=w, use_flash=True,
+                    block_q=16, block_k=16, interpret=True,
+                )
+            )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    @pytest.mark.parametrize("pallas_bwd", ["1", "0"])
+    def test_grads_match_banded_reference(self, pallas_bwd, monkeypatch):
+        """w=40 exercises every hop class in the BACKWARD too, on both
+        the pallas ring backward and the XLA-recompute escape hatch."""
+
+        monkeypatch.setenv("TPU_OPERATOR_FLASH_BWD", pallas_bwd)
+        mesh = make_mesh({"sp": 4, "dp": 2})
+        q, k, v = self._qkv(seed=13)
+
+        def loss_flash(a, b, c):
+            return (
+                ring_attention(
+                    a, b, c, mesh, causal=True, window=40, use_flash=True,
+                    block_q=16, block_k=16, interpret=True,
+                )
+                ** 2
+            ).mean()
+
+        def loss_ref(a, b, c):
+            return (dot_product_attention(a, b, c, causal=True, window=40) ** 2).mean()
+
+        with mesh:
+            g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), g_flash, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5, err_msg=name
+            )
+
+    def test_gqa_window_flash_ring(self):
+        """GQA: hkv-width K/V ride the ring; the boundary blocks expand
+        per hop and fold gradients back to Hkv width."""
+
+        mesh = make_mesh({"sp": 4, "dp": 2})
+        q, k, v = self._qkv(H=4, HKV=2, seed=17)
+        ref = dot_product_attention(q, k, v, causal=True, window=40)
+
+        def loss_flash(a, b, c):
+            return (
+                ring_attention(
+                    a, b, c, mesh, causal=True, window=40, use_flash=True,
+                    block_q=16, block_k=16, interpret=True, heads_axis=None,
+                )
+                ** 2
+            ).mean()
+
+        def loss_ref(a, b, c):
+            return (dot_product_attention(a, b, c, causal=True, window=40) ** 2).mean()
+
+        with mesh:
+            out = jax.jit(
+                lambda a, b, c: ring_attention(
+                    a, b, c, mesh, causal=True, window=40, use_flash=True,
+                    block_q=16, block_k=16, interpret=True, heads_axis=None,
+                )
+            )(q, k, v)
+            g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), g_flash, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5, err_msg=name
+            )
 
 
 def test_ring_window_zero_rejected():

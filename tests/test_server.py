@@ -365,6 +365,7 @@ class TestSummariesEndpoint:
         ]
         assert items == []
 
+    @pytest.mark.slow
     def test_trainer_writes_series(self, tmp_path):
         """The Trainer emits the series every summary_every steps."""
 
@@ -493,6 +494,7 @@ class TestDeployStory:
         with pytest.raises(ValueError, match="leaderElect"):
             load_deployment(str(path))
 
+    @pytest.mark.slow
     def test_deploy_launcher_restarts_crashed_replica(self, tmp_path):
         """The launcher is the Deployment-controller analogue: kill the
         single replica, it comes back."""

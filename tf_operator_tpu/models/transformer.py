@@ -234,9 +234,12 @@ class MultiHeadAttention(nn.Module):
             # GQA-aware schedules: K/V enter at Hkv width and travel
             # the ring / all-to-all that way (the h/hkv bandwidth
             # saving), expanding only inside the local block compute.
-            # window composes: ulysses applies the banded kernels to
-            # its full local sequence; the ring masks by global offsets
-            # on its XLA path
+            # window composes on BOTH schedules and both ring impls:
+            # ulysses applies the banded kernels to its full local
+            # sequence; the ring classifies hops by global offsets
+            # (banded diagonal kernel / plain kernel in-band / XLA
+            # boundary blocks / skipped band-out) on the flash path,
+            # and masks per block on the XLA path
             sp_attn = ulysses_attention if cfg.sp_impl == "ulysses" else ring_attention
             out = sp_attn(q, k, v, cfg.mesh, causal=self.causal, window=cfg.window)
         else:

@@ -23,7 +23,11 @@ tile), each block is computed by the pallas flash kernel
 (ops/flash_attention.py, with_lse=True) and block results merge by
 logsumexp — so the forward never materialises a score matrix even per
 block, and causally-masked blocks skip their FLOPs entirely via
-lax.cond.  The backward is a pallas ring too (`_ring_flash_backward`):
+lax.cond.  Sliding windows compose with the flash ring by hop
+classification in global coordinates (diagonal hop → the kernel's
+banded grid; fully-in-band hops → plain kernel; the <=2 band-boundary
+hops → XLA blocks with the exact global-offset mask merged into the
+lse carry; band-out hops → skipped like future blocks).  The backward is a pallas ring too (`_ring_flash_backward`):
 the dq/dkv kernels run per hop against the forward's GLOBAL lse, with
 dk/dv accumulators riding the ring back to their owners — training
 memory is O(S/n · block) end to end (TPU_OPERATOR_FLASH_BWD=0 falls
@@ -87,6 +91,55 @@ def _ring_block(
     return m_new, l, o
 
 
+def _band_hop_class(my, src, sq: int, window: int):
+    """(in_band, fully_in) for a visiting past chunk at offset delta =
+    (my - src)·sq.  Shared by the flash ring forward AND backward so
+    the two can never disagree on the band predicates:
+
+    - fully_in: every (q, k) pair of the hop satisfies qpos - kpos <
+      window → plain non-causal kernel, no mask needed.
+    - in_band and not fully_in: the band edge crosses this hop (at most
+      2 such hops, deltas being multiples of sq) → XLA boundary block.
+    - not in_band: every pair is behind the band → skip.
+    """
+
+    delta = (my - src) * sq
+    in_band = jnp.logical_and(src < my, delta < window + sq - 1)
+    fully_in = delta + sq - 1 < window
+    return in_band, fully_in
+
+
+def _global_band_mask(sq: int, sk: int, q_off, k_off, window):
+    """[1,1,Sq,Sk] bool: causal ∧ sliding-band visibility in GLOBAL
+    coordinates — the one mask both boundary-block functions use."""
+
+    qpos = q_off + jnp.arange(sq)[:, None]
+    kpos = k_off + jnp.arange(sk)[None, :]
+    return jnp.logical_and(qpos >= kpos, qpos - kpos < window)[None, None]
+
+
+def _window_block_fwd(q, k, v, q_off, k_off, window):
+    """One off-diagonal block at the sliding band's boundary, masked in
+    GLOBAL coordinates, returned in the flash merge domain
+    (normalised out [B,H,Sq,D] f32, row lse [B,H,Sq,1] f32).  Only the
+    <=2 hops the band edge crosses pay this XLA score matrix; every
+    fully-in-band hop stays on the pallas kernel."""
+
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    vis = _global_band_mask(q.shape[-2], k.shape[-2], q_off, k_off, window)
+    s = jnp.where(vis, s, _NEG)
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.where(s <= _NEG / 2, 0.0, jnp.exp(s - m))
+    l = p.sum(axis=-1, keepdims=True)
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG)
+    o = jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    ) / jnp.maximum(l, 1e-30)
+    return o.astype(jnp.float32), lse
+
+
 def _ring_attention_local_flash(
     q: jax.Array,
     k: jax.Array,
@@ -98,6 +151,8 @@ def _ring_attention_local_flash(
     block_q: int,
     block_k: int,
     interpret: bool,
+    window=None,
+    group: int = 1,
     with_residuals: bool = False,
 ):
     """Ring schedule with the pallas flash kernel computing each block.
@@ -116,11 +171,22 @@ def _ring_attention_local_flash(
     (non-causal) attention; later-sequence blocks are skipped entirely
     via lax.cond — unlike the XLA ring path, masked blocks cost no
     FLOPs here.
+
+    window x flash (ADVICE r3): the sliding band composes by hop
+    classification in global coordinates.  With chunk offset delta =
+    (my - src)·Sq, a visiting past block is either fully inside the
+    band (delta + Sq - 1 < window: plain non-causal flash kernel, no
+    mask needed), fully behind it (delta >= window + Sq - 1: skipped
+    like a future block), or one of the <=2 BOUNDARY hops the band edge
+    crosses — those run `_window_block_fwd`, an XLA block with the
+    exact global-offset mask, merged into the same lse carry.  The
+    diagonal hop passes window straight to the kernel's banded grid.
     """
 
     from tf_operator_tpu.ops.flash_attention import _flash_forward
 
     my = lax.axis_index(axis_name)
+    sq = q.shape[-2]
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     flash = functools.partial(
         _flash_forward,
@@ -135,7 +201,7 @@ def _ring_attention_local_flash(
     # truth, so the carry keeps [..., :1] (128x less state per hop)
     # flash kernels are GQA-native (index-mapped K/V heads) — hkv-width
     # blocks go straight in, no repeat anywhere
-    out0, lse0 = flash(q, k, v, causal=causal)
+    out0, lse0 = flash(q, k, v, causal=causal, window=window)
     o = out0.astype(jnp.float32)
     lse = lse0[..., :1]
 
@@ -158,16 +224,30 @@ def _ring_attention_local_flash(
             bo, bl = flash(qq, kk, vv, causal=False)
             return bo.astype(jnp.float32), bl[..., :1]
 
+        def boundary(operands):
+            qq, kk, vv = operands
+            return _window_block_fwd(
+                qq, _rep_kv(kk, group), _rep_kv(vv, group),
+                my * sq, src * sq, window,
+            )
+
         def masked(operands):
             return (
                 jnp.zeros(q.shape, jnp.float32),
                 jnp.full(lse.shape, _NEG, jnp.float32),
             )
 
-        if causal:
+        if not causal:
+            bo, bl = visible((q, k_blk, v_blk))
+        elif window is None:
             bo, bl = lax.cond(src < my, visible, masked, (q, k_blk, v_blk))
         else:
-            bo, bl = visible((q, k_blk, v_blk))
+            in_band, fully_in = _band_hop_class(my, src, sq, window)
+
+            def banded_dispatch(operands):
+                return lax.cond(fully_in, visible, boundary, operands)
+
+            bo, bl = lax.cond(in_band, banded_dispatch, masked, (q, k_blk, v_blk))
         o, lse = merge(o, lse, bo, bl)
         return (k_blk, v_blk, o, lse), None
 
@@ -177,6 +257,40 @@ def _ring_attention_local_flash(
         # [B,H,Sq,1] f32 — exactly what the backward kernels need
         return o.astype(q.dtype), lse
     return o.astype(q.dtype)
+
+
+def _window_block_bwd(q, k_hkv, v_hkv, g, lse, delta_rows, q_off, k_off, window, group):
+    """Gradients of one band-boundary block (global-offset mask), the
+    XLA mirror of `_window_block_fwd`.  With the GLOBAL lse and
+    delta = rowsum(dO·O), each block's contribution is independent:
+    p = e^(s - lse); dv = pᵀg; ds = p(gVᵀ - delta); dq += ds·K;
+    dk/dv fold back to Hkv width by group-sum (inverse of _rep_kv's
+    consecutive repeat)."""
+
+    b, h, sq, d = q.shape
+    hkv = k_hkv.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    k_exp, v_exp = _rep_kv(k_hkv, group), _rep_kv(v_hkv, group)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_exp, preferred_element_type=jnp.float32) * scale
+    vis = _global_band_mask(sq, k_exp.shape[-2], q_off, k_off, window)
+    p = jnp.where(vis, jnp.exp(s - lse), 0.0)
+    gf = g.astype(jnp.float32)
+    dv_full = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+    dp = jnp.einsum(
+        "bhqd,bhkd->bhqk", gf, v_exp.astype(jnp.float32)
+    )
+    ds = p * (dp - delta_rows)
+    dq = jnp.einsum(
+        "bhqk,bhkd->bhqd", ds, k_exp.astype(jnp.float32)
+    ) * scale
+    dk_full = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32)) * scale
+
+    def fold(x):  # [B, H, Sk, D] -> [B, Hkv, Sk, D]
+        if group == 1:
+            return x
+        return x.reshape(b, hkv, group, x.shape[-2], d).sum(axis=2)
+
+    return dq, fold(dk_full), fold(dv_full)
 
 
 def _ring_flash_backward(
@@ -193,6 +307,8 @@ def _ring_flash_backward(
     block_q: int,
     block_k: int,
     interpret: bool,
+    window=None,
+    group: int = 1,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Ring backward with the pallas flash backward kernels per block.
 
@@ -231,7 +347,7 @@ def _ring_flash_backward(
     # GQA: the backward kernels are GQA-native (dk/dv come out at Hkv
     # width from the grouped kv-major grid), so the traveling
     # accumulators stay at Hkv width with no repeat or group-sum here
-    dq, dk, dv = blocks(q, k, v, g, lse_b, delta_b, causal=causal)
+    dq, dk, dv = blocks(q, k, v, g, lse_b, delta_b, causal=causal, window=window)
 
     def body(carry, i):
         k_blk, v_blk, dk_blk, dv_blk, dq = carry
@@ -245,6 +361,12 @@ def _ring_flash_backward(
             kk, vv = operands
             return blocks(q, kk, vv, g, lse_b, delta_b, causal=False)
 
+        def boundary(operands):
+            kk, vv = operands
+            return _window_block_bwd(
+                q, kk, vv, g, lse, delta, my * sq, src * sq, window, group
+            )
+
         def masked(operands):
             return (
                 jnp.zeros(q.shape, jnp.float32),
@@ -252,10 +374,17 @@ def _ring_flash_backward(
                 jnp.zeros(v.shape, jnp.float32),
             )
 
-        if causal:
+        if not causal:
+            dqi, dki, dvi = visible((k_blk, v_blk))
+        elif window is None:
             dqi, dki, dvi = lax.cond(src < my, visible, masked, (k_blk, v_blk))
         else:
-            dqi, dki, dvi = visible((k_blk, v_blk))
+            in_band, fully_in = _band_hop_class(my, src, sq, window)
+
+            def banded_dispatch(operands):
+                return lax.cond(fully_in, visible, boundary, operands)
+
+            dqi, dki, dvi = lax.cond(in_band, banded_dispatch, masked, (k_blk, v_blk))
         dq = dq + dqi
         dk_blk = dk_blk + dki
         dv_blk = dv_blk + dvi
@@ -279,6 +408,7 @@ def _make_flash_ring_local(
     block_k: int,
     interpret: bool,
     group: int = 1,
+    window=None,
 ):
     """The flash-ring local fn with a training-complete VJP.
 
@@ -302,6 +432,8 @@ def _make_flash_ring_local(
         block_q=block_q,
         block_k=block_k,
         interpret=interpret,
+        window=window,
+        group=group,
     )
     xla_impl = functools.partial(
         _ring_attention_local,
@@ -309,6 +441,7 @@ def _make_flash_ring_local(
         axis_size=axis_size,
         causal=causal,
         group=group,
+        window=window,
     )
     pallas_bwd = _use_pallas_bwd()
 
@@ -333,6 +466,8 @@ def _make_flash_ring_local(
                 block_q=block_q,
                 block_k=block_k,
                 interpret=interpret,
+                window=window,
+                group=group,
             )
         q, k, v = residuals
         _, vjp = jax.vjp(xla_impl, q, k, v)
@@ -476,16 +611,6 @@ def ring_attention(
 
     from tf_operator_tpu.ops.flash_attention import resolve_use_flash
 
-    if window is not None:
-        # the flash-ring hop kernels mask in LOCAL coordinates; the
-        # sliding band needs global offsets, which only the XLA ring
-        # blocks carry — window rides the XLA path for now
-        if use_flash:
-            raise NotImplementedError(
-                "window attention is not composed with the flash-ring "
-                "kernels yet — it runs on the XLA ring path (use_flash=False)"
-            )
-        use_flash = False
     use_flash = resolve_use_flash(
         use_flash,
         _flash_ring_applicable(q, n, block_q, block_k),
@@ -496,8 +621,14 @@ def ring_attention(
 
     spec = P(batch_axes, heads_axis, axis_name, None)
     if use_flash:
+        # window x flash composes by hop classification (ADVICE r3):
+        # the diagonal hop uses the kernel's banded grid, fully-in-band
+        # hops the plain kernel, band-out hops are skipped, and the
+        # <=2 boundary hops run an XLA block with the global-offset
+        # mask merged into the lse carry
         local = _make_flash_ring_local(
-            axis_name, n, causal, block_q, block_k, interpret, group=group
+            axis_name, n, causal, block_q, block_k, interpret,
+            group=group, window=window,
         )
     else:
         local = functools.partial(
