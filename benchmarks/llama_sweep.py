@@ -1,0 +1,91 @@
+"""Llama-mini MFU sweep: the variant matrix for the transformer
+co-headline, one child process per run (tunnel-stall-proof, fresh env
+per variant — same harness discipline as mfu_sweep.py).
+
+What it answers on the chip:
+  - flash vs XLA attention at training shapes (fwd+bwd, seq 1024-4096);
+  - whether remat buys a bigger batch that pays for its recompute;
+  - the banded-window kernels' wall-clock win at long seq;
+  - where MFU lands vs the >=0.40 target on a workload whose hot loop
+    is THIS framework's kernels.
+
+Usage: python benchmarks/llama_sweep.py [--quick] [--timeout 600]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+#: (label, extra args for profile_llama.py)
+MATRIX = [
+    ("s1024-flash", ["--seq", "1024", "--batch", "8"]),
+    ("s1024-xla", ["--seq", "1024", "--batch", "8", "--flash", "0"]),
+    ("s2048-flash", ["--seq", "2048", "--batch", "4"]),
+    ("s2048-xla", ["--seq", "2048", "--batch", "4", "--flash", "0"]),
+    ("s4096-flash", ["--seq", "4096", "--batch", "2"]),
+    ("s4096-w1024", ["--seq", "4096", "--batch", "2", "--window", "1024"]),
+    ("s1024-remat-b16", ["--seq", "1024", "--batch", "16", "--remat"]),
+    ("s1024-b16", ["--seq", "1024", "--batch", "16"]),
+]
+
+QUICK = MATRIX[:2]
+
+
+def run_one(label, extra, timeout):
+    cmd = [sys.executable, os.path.join(HERE, "profile_llama.py"), *extra]
+    try:
+        proc = subprocess.run(
+            cmd, env=dict(os.environ), capture_output=True, text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"label": label, "error": f"timeout >{timeout}s"}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                out = json.loads(line)
+                out["label"] = label
+                return out
+            except json.JSONDecodeError:
+                continue
+    tail = (proc.stderr or "").strip().splitlines()
+    return {
+        "label": label,
+        "error": (tail[-1] if tail else f"rc={proc.returncode}")[:160],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--timeout", type=int, default=600)
+    args = ap.parse_args()
+
+    results = []
+    for label, extra in (QUICK if args.quick else MATRIX):
+        print(f"--- {label} ...", flush=True)
+        res = run_one(label, extra, args.timeout)
+        results.append(res)
+        print(json.dumps(res), flush=True)
+
+    print("\n== llama sweep summary (sorted by mfu_analytic) ==")
+    ok = [r for r in results if "mfu_analytic" in r]
+    for r in sorted(ok, key=lambda r: -r["mfu_analytic"]):
+        print(
+            f"{r['label']:<18} mfu={r['mfu_analytic']:.4f}  "
+            f"tok/s={r['tokens_per_sec_per_chip']:.0f}  "
+            f"step={r['step_ms']:.1f}ms"
+        )
+    for r in results:
+        if "error" in r:
+            print(f"{r['label']:<18} ERROR: {r['error']}")
+
+
+if __name__ == "__main__":
+    main()
