@@ -273,15 +273,55 @@ def run_resnet() -> dict:
     raise RuntimeError(f"all batch sizes OOMed: {last_err}")
 
 
-def _llama_analytic_flops_per_token(cfg, n_params_matmul: int, seq: int) -> float:
+def _llama_analytic_flops_per_token(
+    cfg, n_params_matmul: int, seq: int, window: int | None = None
+) -> float:
     """Standard decoder-only model-flops per trained token: 6 flops per
     matmul parameter (fwd 2 + bwd 4) plus causal attention
-    3 × 2·(QKᵀ) + 2·(AV) = 3 × 2·S·D flops/token (S/2 average causal
-    context, two S·D-MAC matmuls, 3× for fwd+bwd)."""
+    3 × (2·(QKᵀ) + 2·(AV)) flops/token over the average visible
+    context — S/2 unwindowed; with a sliding window w the exact
+    causal-banded average is w·(1 - (w-1)/(2S)) (rows below w see
+    their full prefix), so windowed runs are scored on their USEFUL
+    flops, not the full quadratic."""
 
+    if window is None:
+        avg_ctx = seq / 2.0
+    else:
+        w = min(window, seq)
+        avg_ctx = w * (1.0 - (w - 1) / (2.0 * seq))
     d_total = cfg.n_heads * cfg.head_dim
-    attn_fwd_per_token = 2 * 2 * (seq / 2.0) * d_total * cfg.n_layers
+    attn_fwd_per_token = 2 * 2 * avg_ctx * d_total * cfg.n_layers
     return 6.0 * n_params_matmul + 3.0 * attn_fwd_per_token
+
+
+def llama_mini_config(seq: int, window: int | None = None):
+    """The ~120M llama-mini benchmark config (RoPE + GQA 16q:4kv +
+    SwiGLU) — ONE definition shared by bench.py, measure.py and
+    benchmarks/profile_llama.py so the BENCH artifact and the sweeps
+    can never measure different models under the same name."""
+
+    from tf_operator_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        vocab_size=32000, hidden=1024, n_heads=16, head_dim=64,
+        n_layers=8, mlp_dim=2816, max_len=seq, dropout=0.0,
+        rope=True, attn_bias=False, n_kv_heads=4, window=window,
+    )
+
+
+def matmul_param_count(params) -> int:
+    """Matmul parameters for the analytic flop count: every >=2-d
+    kernel except the embedding gather (llama's untied lm_head IS a
+    matmul and is in the tree under its own name)."""
+
+    import jax
+    import numpy as np
+
+    return sum(
+        int(np.prod(p.shape))
+        for path, p in jax.tree_util.tree_leaves_with_path(params)
+        if len(p.shape) >= 2 and "embed" not in str(path).lower()
+    )
 
 
 def run_llama() -> dict:
@@ -300,7 +340,6 @@ def run_llama() -> dict:
     import numpy as np
 
     from tf_operator_tpu.models import LlamaLM, llama_loss
-    from tf_operator_tpu.models.transformer import TransformerConfig
     from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
 
     devices = jax.devices()
@@ -308,11 +347,7 @@ def run_llama() -> dict:
     r = np.random.RandomState(0)
     seq = int(os.environ.get("BENCH_LLAMA_SEQ", "1024"))
     per_chip = int(os.environ.get("BENCH_LLAMA_BATCH", "8"))
-    cfg = TransformerConfig(
-        vocab_size=32000, hidden=1024, n_heads=16, head_dim=64,
-        n_layers=8, mlp_dim=2816, max_len=seq, dropout=0.0,
-        rope=True, attn_bias=False, n_kv_heads=4,
-    )
+    cfg = llama_mini_config(seq)
     lm = {
         "input_ids": jnp.asarray(
             r.randint(0, 32000, size=(per_chip * n_dev, seq)), jnp.int32
@@ -336,14 +371,7 @@ def run_llama() -> dict:
         "llama_seq": seq,
         "llama_batch_per_chip": per_chip,
     }
-    # matmul parameter count for analytic flops: the embedding gather is
-    # not a matmul (excluded); llama's UNTIED lm_head kernel is a matmul
-    # and is already in the tree under "lm_head", so nothing is added
-    n_matmul = sum(
-        int(np.prod(p.shape))
-        for path, p in jax.tree_util.tree_leaves_with_path(trainer.state.params)
-        if len(p.shape) >= 2 and "embed" not in str(path).lower()
-    )
+    n_matmul = matmul_param_count(trainer.state.params)
     flops_tok = _llama_analytic_flops_per_token(cfg, n_matmul, seq)
     peak = _peak_flops(devices[0])
     out["llama_mfu_analytic"] = round(tps * flops_tok / peak, 4)

@@ -41,8 +41,7 @@ def run_one(label, extra, timeout):
     cmd = [sys.executable, os.path.join(HERE, "profile_llama.py"), *extra]
     try:
         proc = subprocess.run(
-            cmd, env=dict(os.environ), capture_output=True, text=True,
-            timeout=timeout,
+            cmd, capture_output=True, text=True, timeout=timeout
         )
     except subprocess.TimeoutExpired:
         return {"label": label, "error": f"timeout >{timeout}s"}

@@ -41,19 +41,20 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    from bench import _llama_analytic_flops_per_token, _peak_flops, _step_flops
+    from bench import (
+        _llama_analytic_flops_per_token,
+        _peak_flops,
+        _step_flops,
+        llama_mini_config,
+        matmul_param_count,
+    )
     from tf_operator_tpu.models import LlamaLM, llama_loss
-    from tf_operator_tpu.models.transformer import TransformerConfig
     from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
 
     devices = jax.devices()
     n_dev = len(devices)
     r = np.random.RandomState(0)
-    cfg = TransformerConfig(
-        vocab_size=32000, hidden=1024, n_heads=16, head_dim=64,
-        n_layers=8, mlp_dim=2816, max_len=args.seq, dropout=0.0,
-        rope=True, attn_bias=False, n_kv_heads=4, window=args.window,
-    )
+    cfg = llama_mini_config(args.seq, window=args.window)
     lm = {
         "input_ids": jnp.asarray(
             r.randint(0, 32000, size=(args.batch * n_dev, args.seq)), jnp.int32
@@ -71,23 +72,12 @@ def main() -> int:
     stats = trainer.benchmark(lm, steps=args.steps, warmup=3)
     tps = stats["steps_per_sec"] * args.batch * args.seq
 
-    n_matmul = sum(
-        int(np.prod(p.shape))
-        for path, p in jax.tree_util.tree_leaves_with_path(trainer.state.params)
-        if len(p.shape) >= 2 and "embed" not in str(path).lower()
+    # the ONE shared formula (bench.py): windowed runs are scored on
+    # their useful per-token context, not the full quadratic
+    flops_tok = _llama_analytic_flops_per_token(
+        cfg, matmul_param_count(trainer.state.params), args.seq,
+        window=args.window,
     )
-    # windowed attention does O(S·window) work instead of O(S²/2): the
-    # analytic count uses the per-token average context so windowed
-    # MFU reflects USEFUL flops (a windowed run with unchanged step
-    # time shows a lower analytic MFU, as it should)
-    avg_ctx = (
-        args.seq / 2.0
-        if args.window is None
-        else min(args.window, args.seq / 2.0)
-    )
-    d_total = cfg.n_heads * cfg.head_dim
-    attn_fwd_tok = 2 * 2 * avg_ctx * d_total * cfg.n_layers
-    flops_tok = 6.0 * n_matmul + 3.0 * attn_fwd_tok
     peak = _peak_flops(devices[0])
     out = {
         "seq": args.seq,
@@ -103,11 +93,6 @@ def main() -> int:
     flops_xla = _step_flops(trainer, trainer.shard_batch(lm))
     if flops_xla:
         out["mfu_xla"] = round(flops_xla * stats["steps_per_sec"] / peak, 4)
-    # consistency check against bench.py's fixed-seq helper
-    if args.window is None:
-        assert abs(
-            flops_tok - _llama_analytic_flops_per_token(cfg, n_matmul, args.seq)
-        ) < 1e-3 * flops_tok
     print(json.dumps(out), flush=True)
     return 0
 
