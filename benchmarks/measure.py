@@ -223,16 +223,14 @@ def bench_training() -> dict:
     )
 
     # llama-mini (~120M: RoPE + GQA 16q:4kv + SwiGLU), seq 1024, bf16 —
-    # exercises the flash fwd+bwd kernels at a realistic long-ish seq
+    # exercises the flash fwd+bwd kernels at a realistic long-ish seq.
+    # ONE config definition (bench.llama_mini_config) shared with
+    # bench.py and benchmarks/profile_llama.py
+    from bench import llama_mini_config
     from tf_operator_tpu.models import LlamaLM, llama_loss
-    from tf_operator_tpu.models.transformer import TransformerConfig
 
     seq, per_chip = 1024, 8
-    cfg = TransformerConfig(
-        vocab_size=32000, hidden=1024, n_heads=16, head_dim=64,
-        n_layers=8, mlp_dim=2816, max_len=seq, dropout=0.0,
-        rope=True, attn_bias=False, n_kv_heads=4,
-    )
+    cfg = llama_mini_config(seq)
     lm = {"input_ids": jnp.asarray(r.randint(0, 32000, size=(per_chip * n_dev, seq)), jnp.int32)}
     lm_trainer = Trainer(
         LlamaLM(cfg),

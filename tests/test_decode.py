@@ -233,6 +233,32 @@ class TestChunkedServingDecoder:
         assert dec.compile_count <= bound, (dec.compile_count, bound)
         assert dec.compile_count < 50  # emphatically not one-per-request
 
+    def test_concurrent_requests_thread_safe(self):
+        """serve_lm fronts the decoder with ThreadingHTTPServer: cache
+        bookkeeping must survive concurrent request threads (the LRU
+        mutates on every call)."""
+
+        import concurrent.futures
+
+        _, _, dec = self._setup(max_len=64)
+        r = np.random.RandomState(5)
+        prompts = [
+            jnp.asarray(r.randint(0, VOCAB, size=(1, int(r.randint(1, 40)))), jnp.int32)
+            for _ in range(24)
+        ]
+
+        def one(prompt):
+            out = dec.generate(prompt, 5)
+            assert out.shape[1] == prompt.shape[1] + 5
+            return int(out[0, -1])
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+            toks = list(ex.map(one, prompts))
+        assert all(0 <= t < VOCAB for t in toks)
+        # tight: 6 chunk widths (2^0..2^5 for p in 1..39) + ONE loop —
+        # any duplicate compile from a cache race trips this
+        assert dec.compile_count <= 6 + 1, dec.compile_count
+
     def test_validation(self):
         _, _, dec = self._setup(max_len=32)
         prompt = jnp.zeros((1, 4), jnp.int32)

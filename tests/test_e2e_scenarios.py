@@ -359,6 +359,7 @@ class TestManifests:
         "fname",
         [
             "dist_mnist.yaml",
+            "dist_mnist_ps.yaml",
             "resnet_mwms.yaml",
             "bert_ps_analogue.yaml",
             "resnet_horovod_gang.yaml",

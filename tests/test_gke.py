@@ -33,6 +33,9 @@ BASELINE_CONFIGS = [
     "bert_ps_analogue",
     "resnet_horovod_gang",
     "t5_multihost",
+    # the untranslated PS topology (real PS replicas, sparse worker
+    # cluster specs) — VERDICT r3 weak #8's first-class-topology row
+    "dist_mnist_ps",
 ]
 
 

@@ -165,6 +165,7 @@ class ChunkedServingDecoder:
     """
 
     def __init__(self, model, params, max_loops: int = 24):
+        import threading
         from collections import OrderedDict
 
         self.dmodel = _decode_variant(model)
@@ -178,6 +179,11 @@ class ChunkedServingDecoder:
         #: program per combination forever
         self._loops = OrderedDict()
         self._max_loops = max_loops
+        #: serve_lm fronts this with ThreadingHTTPServer — cache
+        #: bookkeeping (LRU mutation, compile_count) must not race
+        #: across request threads.  XLA execution itself is thread-safe
+        #: and runs outside the lock.
+        self._lock = threading.Lock()
         self.compile_count = 0
 
     @staticmethod
@@ -193,21 +199,26 @@ class ChunkedServingDecoder:
         return out
 
     def _prefill_fn(self, width: int):
-        if width not in self._prefill:
-            dmodel = self.dmodel
+        with self._lock:
+            if width not in self._prefill:
+                dmodel = self.dmodel
 
-            def prefill(params, cache, ids):
-                logits, vars_ = dmodel.apply(
-                    {"params": params, "cache": cache}, ids, mutable=["cache"]
-                )
-                return vars_["cache"], logits[:, -1]
+                def prefill(params, cache, ids):
+                    logits, vars_ = dmodel.apply(
+                        {"params": params, "cache": cache}, ids, mutable=["cache"]
+                    )
+                    return vars_["cache"], logits[:, -1]
 
-            self._prefill[width] = jax.jit(prefill)
-            self.compile_count += 1
-        return self._prefill[width]
+                self._prefill[width] = jax.jit(prefill)
+                self.compile_count += 1
+            return self._prefill[width]
 
     def _loop_fn(self, n_new: int, temperature: float, top_k):
         key = (n_new, temperature, top_k)
+        with self._lock:
+            return self._loop_fn_locked(key, n_new, temperature, top_k)
+
+    def _loop_fn_locked(self, key, n_new: int, temperature: float, top_k):
         if key in self._loops:
             self._loops.move_to_end(key)
         else:
