@@ -503,6 +503,11 @@ class TestDeployStory:
         import time as _t
         import yaml
 
+        from tf_operator_tpu.backend.local import _free_port
+
+        # OS-assigned port: a fixed 18931 collided across parallel
+        # pytest workers (the round-3 lesson writ small)
+        port = _free_port()
         path = tmp_path / "dep.yaml"
         path.write_text(
             yaml.safe_dump(
@@ -512,7 +517,7 @@ class TestDeployStory:
                     "replicas": 1,
                     "config": {
                         "backend": "fake",
-                        "monitoringPort": 18931,
+                        "monitoringPort": port,
                         "leaseFile": str(tmp_path / "lease.lock"),
                     },
                 }
@@ -524,11 +529,13 @@ class TestDeployStory:
             cwd=os.getcwd(),
         )
         try:
-            def wait_health(timeout=30):
+            # 90s: a jax-importing operator boot can take >30s on a
+            # machine already running 4 parallel test workers
+            def wait_health(timeout=90):
                 deadline = _t.time() + timeout
                 while _t.time() < deadline:
                     try:
-                        if _get("http://127.0.0.1:18931/healthz").startswith("ok"):
+                        if _get(f"http://127.0.0.1:{port}/healthz").startswith("ok"):
                             return True
                     except Exception:
                         _t.sleep(0.2)
