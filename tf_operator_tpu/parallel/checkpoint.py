@@ -33,12 +33,21 @@ class TrainerCheckpointer:
     def save(self, trainer, step: Optional[int] = None, wait: bool = False) -> int:
         """Persist the trainer's full TrainState at ``step`` (default:
         the state's own step counter).  Async by default; ``wait``
-        blocks until durable."""
+        blocks until durable.
+
+        Saved UNBOXED (flax partitioning metadata stripped): the
+        artifact is a plain array tree, so it restores into any mesh's
+        trainer — the elastic-reshard contract (tests/test_elastic.py)
+        — instead of being welded to the sharding annotations of the
+        world that wrote it."""
+
+        from flax.core import meta
 
         if step is None:
             step = int(trainer.state.step)
         self.manager.save(
-            step, args=self._ocp.args.StandardSave({"state": trainer.state})
+            step,
+            args=self._ocp.args.StandardSave({"state": meta.unbox(trainer.state)}),
         )
         if wait:
             self.manager.wait_until_finished()
@@ -47,23 +56,62 @@ class TrainerCheckpointer:
     def restore_latest(self, trainer) -> Optional[int]:
         """Restore the newest checkpoint into ``trainer.state`` with the
         trainer's shardings; returns the restored step or None if the
-        directory is empty (fresh start)."""
+        directory is empty (fresh start).
+
+        The restore target comes from the LIVE trainer (shapes from its
+        state, layouts from its sharding tree), so a checkpoint written
+        on one mesh redistributes onto whatever mesh this trainer runs
+        — repartitioned, scaled out, or scaled in.  Values are grafted
+        back into the live state's partitioning-metadata boxes, keeping
+        the pytree structure the jitted step was traced with."""
+
+        from flax.core import meta
 
         latest = self.manager.latest_step()
         if latest is None:
             return None
-        # abstract target: shapes/dtypes from the live state, layouts
-        # from the trainer's sharding tree — orbax then loads each shard
-        # directly onto its devices
-        abstract = jax.tree_util.tree_map(
-            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+
+        def _is_box(x):
+            return isinstance(x, meta.AxisMetadata)
+
+        def _sds(x, s):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+
+        unboxed = meta.unbox(trainer.state)
+        abstract = jax.tree_util.tree_map(_sds, unboxed, trainer.state_sharding)
+        try:
+            restored = self.manager.restore(
+                latest, args=self._ocp.args.StandardRestore({"state": abstract})
+            )["state"]
+        except Exception:
+            # legacy artifact (pre elastic-reshard): saved with the flax
+            # partitioning boxes still in the tree, so its paths carry an
+            # extra nesting level — rebuild the abstract target in the
+            # boxed shape, then unbox what comes back.  Keeps the
+            # restart contract across the upgrade boundary.
+            boxed_abstract = jax.tree_util.tree_map(
+                lambda live, s: (
+                    live.replace_boxed(_sds(live.unbox(), s))
+                    if _is_box(live)
+                    else _sds(live, s)
+                ),
+                trainer.state,
+                trainer.state_sharding,
+                is_leaf=_is_box,
+            )
+            restored = meta.unbox(
+                self.manager.restore(
+                    latest,
+                    args=self._ocp.args.StandardRestore({"state": boxed_abstract}),
+                )["state"]
+            )
+
+        trainer.state = jax.tree_util.tree_map(
+            lambda live, val: live.replace_boxed(val) if _is_box(live) else val,
             trainer.state,
-            trainer.state_sharding,
+            restored,
+            is_leaf=_is_box,
         )
-        restored = self.manager.restore(
-            latest, args=self._ocp.args.StandardRestore({"state": abstract})
-        )
-        trainer.state = restored["state"]
         trainer._host_step = int(trainer.state.step)
         return latest
 
