@@ -48,6 +48,10 @@ def build_trainer(variant: str, batch_per_chip: int):
     kw = {}
     if variant == "s2d":
         kw["stem"] = "space_to_depth"
+    if variant == "bnbf16":
+        # PROFILE.md: stem and batch scaling are exhausted; the rest is
+        # bwd convs + BN chains — this probes the BN half
+        kw["bn_param_dtype"] = jnp.bfloat16
     model = resnet50(**kw)
     cfg = TrainerConfig(optimizer="sgd", learning_rate=0.1, momentum=0.9)
     if variant == "noclip":
@@ -183,12 +187,26 @@ def summarize_trace(trace_dir: str, top: int = 30):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--variant", default="baseline", choices=["baseline", "s2d", "noclip"])
+    ap.add_argument(
+        "--variant",
+        default="baseline",
+        choices=["baseline", "s2d", "noclip", "bnbf16"],
+    )
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--trace", default=None)
     ap.add_argument("--summarize-only", default=None, help="just parse an existing trace dir")
+    ap.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. cpu for a smoke run) via "
+             "jax.config — env-level JAX_PLATFORMS is re-pinned by this "
+             "box's sitecustomize",
+    )
     args = ap.parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     if args.summarize_only:
         summarize_xplane(args.summarize_only)
         summarize_trace(args.summarize_only)

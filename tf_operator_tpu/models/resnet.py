@@ -129,6 +129,11 @@ class ResNet(nn.Module):
     width: int = 64
     dtype: jnp.dtype = jnp.bfloat16
     stem: str = "conv7"  # conv7 | space_to_depth
+    #: BatchNorm scale/bias/stat dtype.  float32 is the safe default;
+    #: bfloat16 is a profiling experiment (benchmarks/mfu_sweep.py
+    #: "bnbf16") probing whether the f32 BN chains between bf16 convs
+    #: are a material slice of the step (benchmarks/PROFILE.md)
+    bn_param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -139,7 +144,7 @@ class ResNet(nn.Module):
             momentum=0.9,
             epsilon=1e-5,
             dtype=self.dtype,
-            param_dtype=jnp.float32,
+            param_dtype=self.bn_param_dtype,
         )
         x = x.astype(self.dtype)
         if self.stem == "space_to_depth":

@@ -83,12 +83,19 @@ class TrainerCheckpointer:
             restored = self.manager.restore(
                 latest, args=self._ocp.args.StandardRestore({"state": abstract})
             )["state"]
-        except Exception:
-            # legacy artifact (pre elastic-reshard): saved with the flax
-            # partitioning boxes still in the tree, so its paths carry an
-            # extra nesting level — rebuild the abstract target in the
-            # boxed shape, then unbox what comes back.  Keeps the
-            # restart contract across the upgrade boundary.
+        except ValueError as primary_err:
+            # ONLY the tree-structure mismatch means "legacy artifact":
+            # checkpoints written before the elastic-reshard change kept
+            # the flax partitioning boxes, whose saved paths differ.
+            # Every other failure (corruption, IO, shape change) must
+            # surface with its original diagnostic, not be retried
+            # against a structurally different target.
+            if "tree structures do not match" not in str(primary_err):
+                raise
+            # rebuild the abstract target in the boxed shape, then
+            # unbox what comes back — the restart contract holds across
+            # the upgrade boundary.  A failure here propagates chained
+            # to the primary error ("during handling of ...").
             boxed_abstract = jax.tree_util.tree_map(
                 lambda live, s: (
                     live.replace_boxed(_sds(live.unbox(), s))
