@@ -29,6 +29,10 @@ import os
 import sys
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+# the serving binary is launched standalone (`python examples/serve_lm.py`)
+# more often than under the operator's PYTHONPATH injection
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def build_handler(model, params, max_len: int):
     import jax
@@ -128,12 +132,34 @@ def main() -> int:
         jax.config.update("jax_platforms", args.platform)
 
     from tf_operator_tpu.models import llama_tiny
-    from tf_operator_tpu.parallel import load_params
+    from tf_operator_tpu.parallel import load_model_description, load_params
 
+    # validate against the tiny model.json FIRST — rejecting an
+    # incompatible artifact must not cost a full orbax restore
+    desc = load_model_description(args.artifact)
+    max_len = args.max_len
+    if desc is not None:
+        if desc["config"]["vocab_size"] != 256:
+            raise SystemExit(
+                f"this server is byte-level (vocab 256); the artifact "
+                f"was trained with vocab {desc['config']['vocab_size']}"
+            )
+        # cap the serving cache at the trained length: learned position
+        # tables are undefined past it (registry raises), and rope
+        # extension beyond training length degrades silently
+        if max_len > desc["config"]["max_len"]:
+            max_len = desc["config"]["max_len"]
+            print(f"capping --max-len to trained length {max_len}", flush=True)
+        from tf_operator_tpu.models.registry import model_from_description
+
+        model = model_from_description(desc, max_len=max_len)
+        print(f"serving family={desc['family']} from model.json", flush=True)
+    else:
+        # legacy artifact without a description: the historical default
+        model = llama_tiny(vocab_size=256, max_len=max_len)
     params = load_params(args.artifact)
-    model = llama_tiny(vocab_size=256, max_len=args.max_len)
     server = ThreadingHTTPServer(
-        ("127.0.0.1", args.port), build_handler(model, params, args.max_len)
+        ("127.0.0.1", args.port), build_handler(model, params, max_len)
     )
     print(f"serving on 127.0.0.1:{args.port} (artifact: {args.artifact})", flush=True)
     server.serve_forever()

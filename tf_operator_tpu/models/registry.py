@@ -1,0 +1,87 @@
+"""Self-describing serving artifacts: serialize a decoder family +
+config to a plain dict and reconstruct the model from it.
+
+The reference has no model/serving story at all (SURVEY.md §0); this
+framework's export→serve leg should not require the server operator to
+re-specify the architecture by hand (a mismatched reconstruction fails
+at restore time at best, silently at worst).  `export_params` writes
+`model.json` via `describe_model`; `serve_lm` rebuilds the exact
+architecture via `model_from_description`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+from tf_operator_tpu.models.gpt import CausalLM
+from tf_operator_tpu.models.llama import LlamaLM
+from tf_operator_tpu.models.moe import MoeConfig, MoeLM
+from tf_operator_tpu.models.transformer import TransformerConfig
+
+_FAMILIES = {"gpt": CausalLM, "llama": LlamaLM}
+
+
+def _cfg_to_dict(cfg: TransformerConfig) -> Dict[str, Any]:
+    d = dataclasses.asdict(dataclasses.replace(cfg, mesh=None))
+    d.pop("mesh")
+    d.pop("decode")  # a serving description is never decode-pinned
+    d["dtype"] = jnp.dtype(d["dtype"]).name
+    return d
+
+
+def describe_model(model) -> Optional[Dict[str, Any]]:
+    """JSON-safe description of a decoder-family model, or None for
+    families without a serving story (encoders, pipelined)."""
+
+    if isinstance(model, MoeLM):
+        moe_d = {
+            f.name: getattr(model.moe, f.name)
+            for f in dataclasses.fields(MoeConfig)
+            if f.name != "base"
+        }
+        return {
+            "family": "moe",
+            "moe": moe_d,
+            "config": _cfg_to_dict(model.moe.base),
+        }
+    for name, cls in _FAMILIES.items():
+        if type(model) is cls:
+            return {"family": name, "config": _cfg_to_dict(model.cfg)}
+    return None
+
+
+def model_from_description(
+    d: Dict[str, Any], max_len: Optional[int] = None, mesh=None
+):
+    """Rebuild the exact exported architecture.  ``max_len`` overrides
+    the cache length (a server may cap it below the training length);
+    ``mesh`` attaches a serving mesh for sharded decode."""
+
+    cfg_d = dict(d["config"])
+    cfg_d["dtype"] = jnp.dtype(cfg_d["dtype"])
+    if max_len is not None:
+        if max_len > cfg_d["max_len"] and not cfg_d.get("rope"):
+            # learned position tables have exactly max_len rows; decode
+            # past them silently clamps the dynamic slice and reuses
+            # the last embeddings — wrong samples, no error.  Only the
+            # rope families are defined past their training length.
+            raise ValueError(
+                f"max_len={max_len} exceeds the trained length "
+                f"{cfg_d['max_len']} and family {d['family']!r} uses a "
+                f"learned position table — extension is only defined "
+                f"for rope models"
+            )
+        cfg_d["max_len"] = max_len
+    cfg = TransformerConfig(mesh=mesh, **cfg_d)
+    family = d["family"]
+    if family == "moe":
+        return MoeLM(MoeConfig(base=cfg, **d["moe"]))
+    if family not in _FAMILIES:
+        raise ValueError(
+            f"unknown model family {family!r}; known: "
+            f"{sorted(_FAMILIES) + ['moe']}"
+        )
+    return _FAMILIES[family](cfg)

@@ -32,6 +32,7 @@ from tf_operator_tpu.parallel.mesh import (
 from tf_operator_tpu.parallel.checkpoint import (
     TrainerCheckpointer,
     export_params,
+    load_model_description,
     load_params,
 )
 from tf_operator_tpu.parallel.pipeline import (
@@ -65,6 +66,7 @@ __all__ = [
     "TrainerCheckpointer",
     "TrainerConfig",
     "export_params",
+    "load_model_description",
     "load_params",
     "pipeline_apply",
     "pipelined",

@@ -133,10 +133,20 @@ def export_params(trainer, directory: str) -> None:
     COLLECTIVE on multi-host meshes (orbax writes each process's shards
     directly; nothing funnels through host 0).  Partitioned metadata is
     unboxed first so the artifact is a plain array tree any consumer can
-    load without flax sharding annotations."""
+    load without flax sharding annotations.
+
+    For decoder families the artifact SELF-DESCRIBES: a ``model.json``
+    (models/registry.describe_model) lands next to the arrays, so the
+    serving side reconstructs the exact architecture instead of being
+    hand-configured (examples/serve_lm.py reads it)."""
+
+    import json
+    import os
 
     import orbax.checkpoint as ocp
     from flax.core import meta
+
+    from tf_operator_tpu.models.registry import describe_model
 
     params = meta.unbox(trainer.state.params)
     ckptr = ocp.StandardCheckpointer()
@@ -145,6 +155,27 @@ def export_params(trainer, directory: str) -> None:
     ckptr.save(directory, params, force=True)
     ckptr.wait_until_finished()
     ckptr.close()
+    desc = describe_model(trainer.model)
+    if desc is not None:
+        # process 0 writes on multi-host (the path is shared storage)
+        if jax.process_index() == 0:
+            with open(os.path.join(directory, "model.json"), "w") as f:
+                json.dump(desc, f, indent=1)
+
+
+def load_model_description(directory: str):
+    """The ``model.json`` an export wrote, or None (pre-registry
+    artifacts / non-decoder families).  Pair with
+    models/registry.model_from_description."""
+
+    import json
+    import os
+
+    path = os.path.join(directory, "model.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
 
 
 def load_params(directory: str):
