@@ -238,7 +238,10 @@ class TestLocalE2E:
         done = wait_for(
             store, "default", "mnist-data",
             lambda j: j.status.has_condition(JobConditionType.SUCCEEDED),
-            timeout=120.0,
+            # ~60s serially; parallel workers sharing the box have
+            # pushed a 120s deadline over the line (two jax processes +
+            # dataset generation + 25 distributed steps)
+            timeout=300.0,
         )
         assert done.status.replica_statuses[ReplicaType.WORKER].succeeded == 2
         # dataset generated once by the coordinator, read by both
