@@ -1,18 +1,21 @@
-"""Llama-family byte-level pretraining + generation — the modern-decoder
-example.
+"""Modern-decoder byte-level pretraining + generation.
 
 The reference's examples stop at 2019-era TF families; this one shows
 the framework's current-generation path end to end:
 
-- llama architecture (RoPE + RMSNorm + SwiGLU + GQA, models/llama.py)
+- llama architecture (RoPE + RMSNorm + SwiGLU + GQA, models/llama.py),
+  or ``--family moe`` for the routed-expert LM (models/moe.py) trained
+  over an expert-parallel mesh and decoded droplessly
 - byte-level REAL data from disk through the grain pipeline
   (data/text.py — per-process disjoint shards, no synthetic tensors)
 - logical sharding over whatever mesh fits the world (fsdp when
-  multi-device; sp=ring/ulysses work too — see tests/test_llama.py)
+  multi-device for llama, dp×ep for moe; sp=ring/ulysses work for
+  llama too — see tests/test_llama.py)
 - after training: KV-cache generation (models/decode.py) prints an
   actual sampled continuation, decoded back to text.
 
 Single process:   python examples/llama_pretrain.py --steps 60
+MoE:              python examples/llama_pretrain.py --family moe --steps 60
 Under the operator: examples/manifests/llama_pretrain.yaml
 """
 
@@ -30,6 +33,14 @@ def main() -> int:
     )
     parser.add_argument("--seq-len", type=int, default=128)
     parser.add_argument("--data-dir", default="examples/data/text")
+    parser.add_argument(
+        "--family", choices=["llama", "moe"], default="llama",
+        help="llama (RoPE+GQA+SwiGLU over fsdp[/sp]) or moe "
+             "(top-2 routed experts over dp x ep; ignores --sp)",
+    )
+    parser.add_argument(
+        "--experts", type=int, default=4, help="moe family: expert count"
+    )
     parser.add_argument("--sp", type=int, default=1, help="sequence-parallel axis size")
     parser.add_argument("--sp-impl", choices=["ring", "ulysses"], default="ring")
     parser.add_argument("--generate", type=int, default=48, help="tokens to sample after training")
@@ -48,11 +59,30 @@ def main() -> int:
     from tf_operator_tpu.data import as_lm_batches, decode_bytes, ensure_text, make_text_loader
     from tf_operator_tpu.data.synthetic import wait_for_dataset
     from tf_operator_tpu.data.text import text_meta
-    from tf_operator_tpu.models import generate, llama_loss, llama_tiny
+    from tf_operator_tpu.models import (
+        generate,
+        llama_loss,
+        llama_tiny,
+        moe_lm_loss,
+        moe_tiny,
+    )
     from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
 
     n_dev = len(jax.devices())
-    shape = {"sp": args.sp, "fsdp": max(n_dev // max(args.sp, 1), 1)}
+    if args.family == "moe":
+        import math
+
+        # ep must divide BOTH the expert count (the expert axis shards
+        # over it) AND the per-process device count: the batch rides
+        # (dp, fsdp), so dp = n_dev/ep has to keep one distinct batch
+        # shard per process — ep spanning a whole process would leave
+        # the batch "replicated" across hosts that actually hold
+        # DISJOINT data shards (silently wrong gradients)
+        local = max(n_dev // jax.process_count(), 1)
+        ep = math.gcd(args.experts, local)
+        shape = {"ep": ep, "dp": max(n_dev // ep, 1)}
+    else:
+        shape = {"sp": args.sp, "fsdp": max(n_dev // max(args.sp, 1), 1)}
     mesh = make_mesh(shape)
 
     meta = text_meta(seq_len=args.seq_len)
@@ -66,23 +96,30 @@ def main() -> int:
     batches = as_lm_batches(loader)
     first = next(batches)
 
-    model = llama_tiny(
-        vocab_size=256, max_len=args.seq_len, mesh=mesh, sp_impl=args.sp_impl
-    )
+    if args.family == "moe":
+        model = moe_tiny(
+            vocab_size=256, max_len=args.seq_len,
+            num_experts=args.experts, mesh=mesh,
+        )
+        loss_fn = moe_lm_loss
+        tag = f"moe bytes dp={shape['dp']} ep={shape['ep']} E={args.experts}"
+    else:
+        model = llama_tiny(
+            vocab_size=256, max_len=args.seq_len, mesh=mesh, sp_impl=args.sp_impl
+        )
+        loss_fn = llama_loss
+        tag = f"llama bytes fsdp={shape['fsdp']} sp={args.sp}({args.sp_impl})"
     trainer = Trainer(
         model,
         TrainerConfig(learning_rate=args.learning_rate, warmup_steps=10),
         mesh,
-        llama_loss,
+        loss_fn,
         first,
         init_args=(first["input_ids"],),
         shardings="logical",
     )
     sharded = (trainer.shard_batch(b) for b in batches)
-    train_loop(
-        trainer, sharded, args.steps,
-        tag=f"llama bytes fsdp={shape['fsdp']} sp={args.sp}({args.sp_impl})",
-    )
+    train_loop(trainer, sharded, args.steps, tag=tag)
 
     if args.export_dir:
         # collective: every process writes its shards directly
@@ -101,7 +138,12 @@ def main() -> int:
 
         params = gather_params(trainer)
         if jax.process_index() == 0:
-            gen_model = llama_tiny(vocab_size=256, max_len=args.seq_len)
+            if args.family == "moe":
+                gen_model = moe_tiny(
+                    vocab_size=256, max_len=args.seq_len, num_experts=args.experts
+                )
+            else:
+                gen_model = llama_tiny(vocab_size=256, max_len=args.seq_len)
             prompt_txt = "the sharded "
             prompt = np.frombuffer(prompt_txt.encode(), np.uint8)[None].astype(np.int32)
             # the KV cache is max_len slots: cap the ask so a short

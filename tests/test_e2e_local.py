@@ -315,3 +315,49 @@ class TestLocalE2E:
         assert done.status.replica_statuses[ReplicaType.WORKER].succeeded == 2
         log0 = backend.pod_log("default", "llama-pt-worker-0")
         assert "loss" in log0 and "sample:" in log0
+
+    def test_moe_pretrain_two_workers_with_export_and_generation(
+        self, local_harness, tmp_path
+    ):
+        """The routed-expert family under the operator: 2 processes
+        train byte-level MoE over a dp x ep mesh on the shared corpus,
+        export a SELF-DESCRIBING artifact (model.json says family=moe),
+        and decode droplessly on process 0."""
+
+        import json
+
+        script = os.path.join(REPO, "examples", "llama_pretrain.py")
+        data_dir = str(tmp_path / "text-data")
+        art_dir = str(tmp_path / "moe-art")
+        store, backend, c = local_harness
+        job = new_job(
+            name="moe-pt", worker=2,
+            command=[
+                sys.executable, script, "--family", "moe", "--experts", "2",
+                "--steps", "10", "--batch-per-device", "4", "--seq-len", "64",
+                "--data-dir", data_dir, "--generate", "12",
+                "--export-dir", art_dir,
+            ],
+        )
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].env = {
+            **cpu_env(),
+            # TWO devices per worker: ep caps at the per-process device
+            # count (disjoint data shards need dp >= processes), so this
+            # is the smallest world where expert parallelism actually
+            # crosses the process boundary (ep=2 x dp=2)
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        }
+        job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+        store.create(job)
+        done = wait_for(
+            store, "default", "moe-pt",
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED),
+            timeout=300.0,
+        )
+        assert done.status.replica_statuses[ReplicaType.WORKER].succeeded == 2
+        log0 = backend.pod_log("default", "moe-pt-worker-0")
+        # expert parallelism really crossed the process boundary
+        assert "moe bytes dp=2 ep=2" in log0 and "sample:" in log0
+        with open(os.path.join(art_dir, "model.json")) as f:
+            desc = json.load(f)
+        assert desc["family"] == "moe" and desc["moe"]["num_experts"] == 2
