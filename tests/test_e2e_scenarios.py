@@ -456,6 +456,55 @@ class TestMultiHostSharding:
         pairs = [re.search(r"loss ([\d.]+) -> ([\d.]+)", log).groups() for log in logs]
         assert pairs[0] == pairs[1]
 
+    def test_shard_batch_guard_fires_on_replicating_mesh(self, local_harness):
+        """The footgun the guard exists for: a tp-spanning mesh with
+        NO data axis across the two processes.  shard_batch must raise
+        (disjoint local data would be treated as bit-identical
+        replicas — silently wrong gradients); shard_global_batch with
+        an identical batch then trains fine in the same world."""
+
+        script = (
+            "from tf_operator_tpu.runtime import initialize\n"
+            "initialize()\n"
+            "import jax, numpy as np, jax.numpy as jnp\n"
+            "from tf_operator_tpu.models import gpt_tiny, lm_loss\n"
+            "from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh\n"
+            "mesh = make_mesh({'tp': 2})\n"
+            "ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (4, 16)))\n"
+            "tr = Trainer(gpt_tiny(vocab_size=64, max_len=16, mesh=mesh),\n"
+            "             TrainerConfig(), mesh, lm_loss, {'input_ids': ids},\n"
+            "             init_args=(ids,), shardings='logical')\n"
+            "local = jnp.asarray(np.random.RandomState(jax.process_index())\n"
+            "                    .randint(0, 64, (4, 16)))\n"
+            "try:\n"
+            "    tr.shard_batch({'input_ids': local})\n"
+            "    raise SystemExit('guard did not fire')\n"
+            "except ValueError as e:\n"
+            "    assert 'shard_global_batch' in str(e), e\n"
+            "    print('guard ok', flush=True)\n"
+            "m = tr.train_step(tr.shard_global_batch({'input_ids': ids}))\n"
+            "print('tp step ok', float(m['loss']), flush=True)\n"
+        )
+        store, backend, c = local_harness
+        job = new_job(
+            name="guard", worker=2, command=[sys.executable, "-c", script]
+        )
+        job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        }
+        store.create(job)
+        done = wait_for(
+            store, "default", "guard",
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED),
+            timeout=120.0,
+        )
+        assert done.status.replica_statuses[ReplicaType.WORKER].succeeded == 2
+        for i in (0, 1):
+            log = backend.pod_log("default", f"guard-worker-{i}")
+            assert "guard ok" in log and "tp step ok" in log
+
 
 @pytest.mark.slow
 class TestDistributedTraining:

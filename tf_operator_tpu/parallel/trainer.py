@@ -132,6 +132,14 @@ class Trainer:
         self.batch_sharding = jax.tree_util.tree_map(
             lambda _: batch_sharding(mesh), example_batch
         )
+        #: True on multi-process worlds whose mesh replicates batch
+        #: shards across processes (tp/ep/sp-heavy meshes): disjoint
+        #: per-process data is then UNSAFE through shard_batch — see
+        #: shard_batch / shard_global_batch.  Derived from the sharding
+        #: alone (mesh + spec), so it is decided at construction.
+        self._batch_replicated = (
+            jax.process_count() > 1 and self._sharding_replicates_across_processes()
+        )
         init_rng = jax.random.PRNGKey(seed)
         train_rng = jax.random.PRNGKey(seed + 1)
 
@@ -316,7 +324,7 @@ class Trainer:
         totals: Dict[str, float] = {}
         n = 0
         for batch in batches:
-            m = self.eval_step(self.shard_batch(batch))
+            m = self.eval_step(self._shard_input(batch))
             for k, v in m.items():
                 totals[k] = totals.get(k, 0.0) + float(v)
             n += 1
@@ -346,6 +354,33 @@ class Trainer:
         self._last_summary_time = now
         self.summary_writer.write(step, **scalars)
 
+    def _sharding_replicates_across_processes(self) -> bool:
+        """True when some batch shard spans devices of MULTIPLE
+        processes — the layout where feeding disjoint per-process data
+        through shard_batch is silently wrong (XLA assumes replicas
+        are bit-identical; different hosts' rows are not).  A property
+        of mesh + PartitionSpec only, probed with a synthetic
+        mesh-size-divisible shape (real batch shapes need not divide
+        the global partition count on this side of the boundary)."""
+
+        s = jax.tree_util.tree_leaves(self.batch_sharding)[0]
+        groups: dict = {}
+        for dev, idx in s.devices_indices_map((s.mesh.size,)).items():
+            key = (idx[0].start, idx[0].stop)
+            groups.setdefault(key, set()).add(dev.process_index)
+        return any(len(procs) > 1 for procs in groups.values())
+
+    def _shard_input(self, batch: Batch) -> Batch:
+        """Internal sharder for evaluate()/benchmark(): local-shard
+        semantics on data-parallel meshes, identical-global semantics
+        on replicating meshes (the only correct interpretation there —
+        callers on tp/ep/sp-spanning worlds must feed every process
+        the same batch)."""
+
+        if self._batch_replicated:
+            return self.shard_global_batch(batch)
+        return self.shard_batch(batch)
+
     def shard_batch(self, batch: Batch) -> Batch:
         """Lay the batch out on the mesh.
 
@@ -353,11 +388,27 @@ class Trainer:
         (jax.distributed): each process passes its *local shard* (its
         rows of the batch axis) and the returned arrays are global —
         the multi-host path the operator's examples use.
+
+        Raises when the mesh replicates batch shards across processes
+        (dp·fsdp shards fewer than processes — e.g. a tp- or ep-heavy
+        mesh): disjoint local data would be treated as bit-identical
+        replicas by XLA's collectives, silently diverging params
+        across hosts.  Pass an IDENTICAL global batch through
+        `shard_global_batch` instead, or reshape the mesh so every
+        process holds a distinct batch shard.
         """
 
         with self.mesh:
             if jax.process_count() == 1:
                 return jax.device_put(batch, self.batch_sharding)
+            if self._batch_replicated:
+                raise ValueError(
+                    "shard_batch: this mesh replicates batch shards across "
+                    "processes (batch shards < processes), so per-process "
+                    "DISJOINT data would silently diverge — use "
+                    "shard_global_batch with an identical global batch, or "
+                    "give the mesh a dp/fsdp extent >= the process count"
+                )
             return jax.tree_util.tree_map(
                 lambda x, s: jax.make_array_from_process_local_data(s, x),
                 batch,
@@ -415,7 +466,7 @@ class Trainer:
         }
 
     def benchmark(self, batch: Batch, steps: int = 20, warmup: int = 3) -> Dict[str, float]:
-        batch = self.shard_batch(batch)
+        batch = self._shard_input(batch)
         m = None
         for _ in range(warmup):
             m = self.train_step(batch)
