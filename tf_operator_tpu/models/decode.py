@@ -61,12 +61,24 @@ def _init_cache_for(dmodel, batch_size: int):
     shapes = jax.eval_shape(
         lambda: dmodel.init(jax.random.PRNGKey(0), dummy)
     )["cache"]
-    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    def init_leaf(path, s):
+        # the rolling-window cache tracks per-slot absolute positions
+        # with -1 = empty; zero would alias position 0 and admit
+        # garbage K/V slots into the band
+        name = str(path[-1])
+        fill = -1 if "cached_pos" in name else 0
+        return jnp.full(s.shape, fill, s.dtype)
+
+    return jax.tree_util.tree_map_with_path(init_leaf, shapes)
 
 
 def init_cache(model, batch_size: int):
-    """Zero-initialised KV cache for `batch_size` rows (no FLOPs —
-    shapes come from eval_shape, zeros from the shape tree)."""
+    """Empty KV cache for `batch_size` rows (no FLOPs — shapes come
+    from eval_shape).  K/V and indices are zeros; the rolling-window
+    `cached_pos` slots are -1 (the empty sentinel — zero would alias
+    position 0 and admit garbage slots into the band).  Build caches
+    through this function, not by zeroing the shape tree by hand."""
 
     return _init_cache_for(_decode_variant(model), batch_size)
 
@@ -117,10 +129,25 @@ def generate(
             logits = jnp.where(logits < kth, -jnp.inf, logits)
         return jax.random.categorical(r, logits).astype(jnp.int32)
 
-    # prefill: the whole prompt in one pass primes every layer's cache
-    logits, vars_ = dmodel.apply(
-        {"params": params, "cache": cache}, prompt_ids, mutable=["cache"]
-    )
+    # prefill: the whole prompt primes every layer's cache.  Windowed
+    # models with a ROLLING cache (window < max_len) accept at most
+    # `window` tokens per apply, so the prompt feeds through in window-
+    # sized chunks — cache-equivalent to one-shot prefill, since slots
+    # behind the band are dead either way.
+    w = cfg.window
+    if w is not None and w < cfg.max_len and p > w:
+        vars_ = {"cache": cache}
+        logits = None
+        for off in range(0, p, w):
+            logits, vars_ = dmodel.apply(
+                {"params": params, "cache": vars_["cache"]},
+                prompt_ids[:, off : off + w],
+                mutable=["cache"],
+            )
+    else:
+        logits, vars_ = dmodel.apply(
+            {"params": params, "cache": cache}, prompt_ids, mutable=["cache"]
+        )
     rng, r0 = jax.random.split(rng)
     tok = sample(logits[:, -1], r0)
 
@@ -171,6 +198,14 @@ class ChunkedServingDecoder:
         self.dmodel = _decode_variant(model)
         self.params = params
         self.max_len = self.dmodel.cfg.max_len
+        # windowed rolling cache accepts at most `window` tokens per
+        # apply: cap chunk widths at the largest power of two <= window
+        # (program count stays logarithmic — widths are still powers
+        # of two, just from a smaller set)
+        w = self.dmodel.cfg.window
+        self._max_chunk = (
+            1 << (w.bit_length() - 1) if w is not None and w < self.max_len else None
+        )
         self._prefill = {}  # chunk width -> jitted apply; <= log2(max_len)+1
         #: (budget, temperature, top_k) -> jitted scan.  LRU-bounded:
         #: budgets are powers of two but temperature/top_k are
@@ -187,7 +222,7 @@ class ChunkedServingDecoder:
         self.compile_count = 0
 
     @staticmethod
-    def _chunks(n: int) -> list:
+    def _binary_chunks(n: int) -> list:
         """Binary decomposition of n, largest chunk first."""
 
         out, bit = [], 1 << n.bit_length()
@@ -197,6 +232,12 @@ class ChunkedServingDecoder:
                 out.append(bit)
                 n -= bit
         return out
+
+    def _chunks(self, n: int) -> list:
+        if self._max_chunk is None or n <= self._max_chunk:
+            return self._binary_chunks(n)
+        full, rem = divmod(n, self._max_chunk)
+        return [self._max_chunk] * full + self._binary_chunks(rem)
 
     def _prefill_fn(self, width: int):
         with self._lock:
@@ -287,11 +328,14 @@ class ChunkedServingDecoder:
             # stops distinct greedy requests compiling identical loops
             top_k = None
         # budget stays an exact power of two so the loop-key set is
-        # logarithmic even when p + budget overruns max_len: the extra
-        # discarded steps write through dynamic_update_slice, whose
-        # start indices CLAMP at the cache edge, and every token we
-        # keep (step < max_new_tokens, position < max_len) is produced
-        # before any clamped write — overrun garbage is sliced away
+        # logarithmic even when p + budget overruns max_len.  Overrun
+        # steps are harmless because every KEPT token (step <
+        # max_new_tokens, position < max_len) is sampled BEFORE any
+        # overrun write lands: the full cache clamps its
+        # dynamic_update_slice at the edge, and the rolling cache wraps
+        # onto live slots — either way only steps whose outputs are
+        # discarded observe the corrupted tail, which `[:, :n]` slices
+        # away.  Do not read the cache after an overrun generate.
         budget = 1 << (max_new_tokens - 1).bit_length()  # next power of 2
         if rng is None:
             if temperature != 0.0:

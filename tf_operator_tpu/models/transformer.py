@@ -187,12 +187,30 @@ class MultiHeadAttention(nn.Module):
             # index (hkv width — GQA cache stays small), q attends to
             # every filled slot.  Works uniformly for prefill
             # (s_new = prompt len) and decode steps (s_new = 1).
+            #
+            # Sliding-window models get a ROLLING cache: only `window`
+            # slots are ever visible, so the cache is a circular buffer
+            # of that size — serving memory O(window) instead of
+            # O(max_len), the decode counterpart of the banded training
+            # kernels.  Each slot remembers its absolute position
+            # (cached_pos) so masking stays exact across wraps; RoPE is
+            # applied at write time with absolute positions, so wrapped
+            # slots need no re-rotation.
             b, _, s_new, _ = q.shape
+            rolling = cfg.window is not None and cfg.window < cfg.max_len
+            cache_len = cfg.window if rolling else cfg.max_len
+            if rolling and s_new > cache_len:
+                raise ValueError(
+                    f"windowed rolling decode prefills at most window="
+                    f"{cfg.window} tokens per apply (got {s_new}); feed "
+                    "the prompt in chunks <= window — models/decode.py's "
+                    "generate()/ChunkedServingDecoder do this"
+                )
             cached_k = self.variable(
-                "cache", "cached_key", jnp.zeros, (b, hkv, cfg.max_len, d), k.dtype
+                "cache", "cached_key", jnp.zeros, (b, hkv, cache_len, d), k.dtype
             )
             cached_v = self.variable(
-                "cache", "cached_value", jnp.zeros, (b, hkv, cfg.max_len, d), v.dtype
+                "cache", "cached_value", jnp.zeros, (b, hkv, cache_len, d), v.dtype
             )
             cache_idx = self.variable(
                 "cache", "cache_index", lambda: jnp.array(0, jnp.int32)
@@ -201,18 +219,50 @@ class MultiHeadAttention(nn.Module):
             row_pos = idx + jnp.arange(s_new)
             if cfg.rope:
                 q, k = apply_rope(q, k, positions=row_pos, theta=cfg.rope_theta)
-            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, 0, idx, 0))
-            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, idx, 0))
+            if rolling:
+                # Attend over [PRE-write buffer, current chunk]: an
+                # in-chunk write may land in the slot of an old key
+                # that EARLIER rows of this chunk still see (the band
+                # reaches back W-1 from each row), so the buffer must
+                # be read before any write.  Every position needed by
+                # any row is then present exactly once: the pre-write
+                # buffer holds the latest position per slot among
+                # those < idx (older same-slot positions were already
+                # dead to the band), and the chunk carries idx..idx+s-1.
+                # Per-slot absolute positions (-1 = empty) drive the
+                # mask, so wraps need no special cases.
+                cached_pos = self.variable(
+                    "cache", "cached_pos",
+                    lambda: jnp.full((cache_len,), -1, jnp.int32),
+                )
+                old_k, old_v = cached_k.value, cached_v.value
+                old_pos = cached_pos.value
+                slots = (idx + jnp.arange(s_new)) % cache_len
+                cached_k.value = old_k.at[:, :, slots].set(k)
+                cached_v.value = old_v.at[:, :, slots].set(v)
+                cached_pos.value = old_pos.at[slots].set(row_pos)
+                k = jnp.concatenate([old_k, k], axis=2)
+                v = jnp.concatenate([old_v, v], axis=2)
+                kpos = jnp.concatenate([old_pos, row_pos])[None, :]
+                qpos = row_pos[:, None]
+                vis = (kpos >= 0) & (kpos <= qpos) & (qpos - kpos < cfg.window)
+            else:
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k, (0, 0, idx, 0)
+                )
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v, (0, 0, idx, 0)
+                )
+                # the dispatcher's attention impls are GQA-native — the
+                # Hkv-width cache is consumed directly, never expanded
+                k, v = cached_k.value, cached_v.value
+                # causal over absolute positions; unfilled slots masked;
+                # sliding window drops slots behind the band
+                cols = jnp.arange(cache_len)[None, :]
+                vis = cols <= row_pos[:, None]
+                if cfg.window is not None:
+                    vis &= row_pos[:, None] - cols < cfg.window
             cache_idx.value = idx + s_new
-            # the dispatcher's attention impls are GQA-native — the
-            # Hkv-width cache is consumed directly, never expanded
-            k, v = cached_k.value, cached_v.value
-            # causal over absolute positions; unfilled slots masked;
-            # sliding window drops slots behind the band
-            cols = jnp.arange(cfg.max_len)[None, :]
-            vis = cols <= row_pos[:, None]
-            if cfg.window is not None:
-                vis &= row_pos[:, None] - cols < cfg.window
             dec_mask = vis[None, None]
             out = attention(q, k, v, mask=dec_mask, mesh=cfg.mesh)
             out = jnp.transpose(out, (0, 2, 1, 3))
