@@ -486,6 +486,49 @@ class TestRollingWindowCache:
         ref = generate(model, params, prompt, max_new_tokens=6)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
+    def test_windowed_artifact_serves_with_rolling_cache(self, tmp_path):
+        """export → model.json → rebuild → serve: a windowed artifact
+        decodes through the O(window) rolling cache end to end."""
+
+        from tf_operator_tpu.models import llama_loss
+        from tf_operator_tpu.models.decode import ChunkedServingDecoder, init_cache
+        from tf_operator_tpu.models.registry import model_from_description
+        from tf_operator_tpu.parallel import (
+            Trainer,
+            TrainerConfig,
+            export_params,
+            load_model_description,
+            load_params,
+            make_mesh,
+        )
+
+        mesh = make_mesh({"dp": 8})
+        ids = np.random.RandomState(6).randint(0, VOCAB, size=(8, 48)).astype(np.int32)
+        tr = Trainer(
+            llama_tiny(vocab_size=VOCAB, max_len=64, window=8, mesh=mesh),
+            TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+            mesh,
+            llama_loss,
+            {"input_ids": ids},
+            init_args=(ids,),
+            shardings="logical",
+        )
+        tr.train_step(tr.shard_batch({"input_ids": ids}))
+        art = str(tmp_path / "wart")
+        export_params(tr, art)
+        desc = load_model_description(art)
+        assert desc["config"]["window"] == 8
+        model = model_from_description(desc)
+        # the rebuilt server model really uses the rolling cache
+        ck = init_cache(model, 1)["layer_0"]["self_attn"]["cached_key"]
+        assert ck.shape[2] == 8  # window slots, not max_len=64
+        dec = ChunkedServingDecoder(model, load_params(art))
+        prompt = jnp.asarray(ids[:1, :20])
+        out = dec.generate(prompt, 6)
+        assert out.shape == (1, 26)
+        gen = np.asarray(out[:, 20:])
+        assert gen.min() >= 0 and gen.max() < VOCAB
+
     def test_oversized_single_apply_rejected(self):
         import dataclasses
 
