@@ -489,6 +489,32 @@ def main() -> int:
     child_timeout = float(os.environ.get("BENCH_CHILD_TIMEOUT", "600"))
     llama_timeout = float(os.environ.get("BENCH_LLAMA_TIMEOUT", "420"))
 
+    # The axon tunnel serves one claimant at a time; our own watcher /
+    # measurement window coordinate through an advisory chip lock.  The
+    # driver's bench run is the highest-priority consumer: evict any
+    # in-repo holder so a stale window can never stall the children
+    # (benchmarks/chiplock.py has the round-4 incident writeup).
+    lock_note = ""
+    if os.environ.get("TPU_CHIP_LOCK_INHERITED") == "1":
+        lock_note = "running under parent's chip claim"
+    else:
+        try:
+            import importlib.util
+
+            _spec = importlib.util.spec_from_file_location(
+                "tf_operator_tpu_chiplock",
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "benchmarks", "chiplock.py",
+                ),
+            )
+            _mod = importlib.util.module_from_spec(_spec)
+            _spec.loader.exec_module(_mod)
+            _lock = _mod.ChipLock("bench")
+            lock_note = _lock.acquire_or_preempt()
+        except Exception as e:  # the lock must never be able to fail the bench
+            lock_note = f"chiplock unavailable: {type(e).__name__}"
+
     probe_err = _probe(budget)
     if probe_err:
         _emit(
@@ -498,6 +524,7 @@ def main() -> int:
                 "unit": UNIT,
                 "vs_baseline": 0.0,
                 "error": probe_err,
+                **({"chip_lock": lock_note} if lock_note else {}),
             }
         )
         return 0
@@ -524,6 +551,7 @@ def main() -> int:
                 "unit": UNIT,
                 "vs_baseline": 0.0,
                 "error": last_err,
+                **({"chip_lock": lock_note} if lock_note else {}),
             }
         )
         return 0
@@ -538,6 +566,8 @@ def main() -> int:
     elif os.environ.get("BENCH_LLAMA", "1") == "1":
         result["llama_error"] = "skipped: total budget exhausted"
     result["budget_left_s"] = round(max(0.0, budget.left()), 1)
+    if lock_note:
+        result["chip_lock"] = lock_note
     _emit(result)
     return 0
 

@@ -1,0 +1,223 @@
+"""Advisory single-claimant lock for the local TPU chip.
+
+The axon tunnel serves ONE client process at a time: a second process
+that initialises the backend while a claim is live does not fail — it
+*blocks* until the claim frees.  Round 4's first measurement window
+lost its bench slot exactly this way (a concurrent dryrun held the
+claim for 900s; the bench child inside its 510s timeout never got the
+chip and was reported as "TPU stall").  The fix is coordination, not
+timeouts: every long-lived chip consumer in this repo takes this
+advisory flock first.
+
+Roles and priority:
+  - `bench` (the driver's end-of-round run) has absolute priority: on
+    contention it PREEMPTS the current holder (kills the recorded pid
+    and its children) — a stale watcher or an in-flight measurement
+    window must never cost the round its BENCH artifact.
+  - `window` / `watch` (our own measurement machinery) acquire
+    non-blocking and back off if someone else holds the chip.
+
+This is deliberately advisory-only: processes outside this repo (the
+driver's own compile checks) don't know about it, and the lock file
+lives in /tmp so a reboot clears it.  flock(2) gives crash-safety —
+a dead holder's lock vanishes with its fd, so `acquire` never sees a
+stale lock, and `preempt` only ever kills a live holder.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import signal
+import subprocess
+import time
+
+LOCK_PATH = os.environ.get("TPU_CHIP_LOCK", "/tmp/tpu_chip.lock")
+
+
+class ChipLock:
+    def __init__(self, role: str, path: str = LOCK_PATH):
+        self.role = role
+        self.path = path
+        self._fd: int | None = None
+        #: why the last try_acquire() failed: "flock" = a live holder
+        #: has the lock (preemptable); "open" = we couldn't even open
+        #: the lock file (permissions — NOT evidence anyone holds it)
+        self.last_fail: str | None = None
+
+    def try_acquire(self) -> bool:
+        """Non-blocking acquire; records pid+role for a preemptor.
+        Returns False on ANY OS-level failure (lock held, or e.g. an
+        unwritable lock file another user created) — callers treat
+        False as "back off", never as a crash."""
+        try:
+            fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+        except OSError:
+            self.last_fail = "open"
+            return False
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            self.last_fail = "flock"
+            return False
+        self.last_fail = None
+        os.ftruncate(fd, 0)
+        os.write(fd, json.dumps({"pid": os.getpid(), "role": self.role,
+                                 "t": time.time()}).encode())
+        os.fsync(fd)
+        self._fd = fd
+        return True
+
+    def holder(self) -> dict | None:
+        """Who holds the lock right now (None if free/unreadable)."""
+        try:
+            with open(self.path) as f:
+                return json.loads(f.read() or "null")
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def acquire_or_preempt(self, grace_s: float = 10.0) -> str:
+        """Bench-priority acquire: take the lock, evicting any holder.
+
+        Returns a short note for the caller's log/JSON ("" if the lock
+        was free).  Never raises; never blocks longer than ~2*grace_s.
+        """
+        if self.try_acquire():
+            return ""
+        if self.last_fail == "open":
+            # lock file unreadable, NOT held: the recorded pid (if any)
+            # is stale json from a dead run — killing it could hit a
+            # reused pid belonging to an unrelated process
+            return "chip lock file inaccessible; proceeding unlocked"
+        info = self.holder() or {}
+        pid, role = info.get("pid"), info.get("role", "?")
+        note = f"preempted chip holder role={role} pid={pid}"
+        if (
+            isinstance(pid, int) and pid > 1 and pid != os.getpid()
+            and _looks_like_ours(pid)
+        ):
+            _kill_tree(pid, grace_s)
+        deadline = time.time() + grace_s
+        while time.time() < deadline:
+            if self.try_acquire():
+                return note
+            time.sleep(0.5)
+        return note + " (lock still held; proceeding unlocked)"
+
+    def release(self) -> None:
+        if self._fd is not None:
+            try:
+                os.close(self._fd)  # closes fd -> drops flock
+            finally:
+                self._fd = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+def _children_of(pid: int) -> list[int]:
+    try:
+        out = subprocess.run(
+            ["ps", "-o", "pid=", "--ppid", str(pid)],
+            capture_output=True, text=True, timeout=10,
+        ).stdout
+        return [int(p) for p in out.split()]
+    except Exception:
+        return []
+
+
+def _descendants(pid: int, depth: int = 4) -> list[int]:
+    out, frontier = [], [pid]
+    for _ in range(depth):
+        nxt: list[int] = []
+        for p in frontier:
+            nxt.extend(_children_of(p))
+        if not nxt:
+            break
+        out.extend(nxt)
+        frontier = nxt
+    return out
+
+
+def _looks_like_ours(pid: int) -> bool:
+    """Pre-kill sanity check against pid reuse: the recorded holder
+    must still be a python/bash process (everything that takes this
+    lock is one).  A recycled pid running something else is spared."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmd = f.read().split(b"\0")[0].decode(errors="replace")
+    except OSError:
+        return False
+    base = os.path.basename(cmd)
+    return base.startswith(("python", "bash", "sh", "timeout"))
+
+
+def _kill_tree(pid: int, grace_s: float) -> None:
+    """TERM then KILL pid and its descendants.  The victim set is
+    re-enumerated on every pass AND accumulated across passes: a
+    holder mid-fanout can spawn a child after a one-shot snapshot, and
+    a grandchild that outlives its parent is reparented to init — a
+    fresh ppid-walk from the dead root would miss it, leaving the axon
+    chip claim alive behind the released flock."""
+    seen: set[int] = {pid}
+    for sig in (signal.SIGTERM, signal.SIGKILL):
+        deadline = time.time() + grace_s
+        while time.time() < deadline:
+            for p in list(seen):
+                seen.update(_descendants(p))
+            victims = [p for p in seen if _alive(p)]
+            if not victims:
+                return
+            for p in victims:
+                try:
+                    os.kill(p, sig)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            time.sleep(0.25)
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def _probe_main() -> int:
+    """`python benchmarks/chiplock.py probe` — the watcher's one probe
+    entrypoint.  Exit codes: 0 = lock taken AND the chip answered;
+    2 = lock held by another consumer (NOT a tunnel problem — the
+    watch log must not misread contention as an outage); 1 = chip not
+    answering.  The caller wraps this in `timeout` for the hang case."""
+    lock = ChipLock("watch")
+    if not lock.try_acquire():
+        print(f"chip lock held: {lock.holder()}", flush=True)
+        return 2
+    try:
+        import runpy
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        probe_src = runpy.run_path(os.path.join(here, "tpu_window.py"))["PROBE"]
+        exec(probe_src)  # noqa: S102 — our own constant
+        return 0
+    except Exception as e:
+        print(f"probe failed: {type(e).__name__}: {e}", flush=True)
+        return 1
+    finally:
+        lock.release()
+
+
+if __name__ == "__main__":
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "probe":
+        sys.exit(_probe_main())
+    sys.exit(f"usage: {sys.argv[0]} probe")

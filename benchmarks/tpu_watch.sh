@@ -2,17 +2,35 @@
 # Probe the TPU tunnel every 10 min; the moment it answers, run the
 # one-shot measurement window (benchmarks/tpu_window.py) and exit.
 # Launch detached:  nohup bash benchmarks/tpu_watch.sh &> benchmarks/tpu_watch.log &
+#
+# Coordination (benchmarks/chiplock.py): the probe takes the advisory
+# chip lock first; if another consumer holds it (e.g. the driver's
+# bench.py) the probe reports rc=2 and we back off — a probe process
+# queued on the axon claim would stall the holder's children (the
+# round-4 incident).  A window that loses the lock race (rc=2) is
+# retried, not abandoned: the watcher only exits after a window RAN.
 cd "$(dirname "$0")/.." || exit 1
 while true; do
   echo "[$(date +%H:%M:%S)] probing tpu..."
-  # PROBE is shared with tpu_window.py so the two can't drift
-  if timeout 120 python -c "import runpy; exec(runpy.run_path('benchmarks/tpu_window.py')['PROBE'])"; then
+  timeout 120 python benchmarks/chiplock.py probe
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
     echo "[$(date +%H:%M:%S)] TPU IS BACK — starting measurement window"
     python benchmarks/tpu_window.py
-    rc=$?
-    echo "[$(date +%H:%M:%S)] window done rc=$rc"
-    exit 0
+    wrc=$?
+    echo "[$(date +%H:%M:%S)] window done rc=$wrc"
+    # Exit ONLY on a fully completed window (rc=0).  rc=75 = lost the
+    # lock race; rc=1 = chip stopped answering mid-window; rc=143/137 =
+    # preempted by the driver's bench.py.  All of those mean the round
+    # still needs window data — keep watching.
+    if [ "$wrc" -eq 0 ]; then
+      exit 0
+    fi
+    echo "[$(date +%H:%M:%S)] window incomplete (rc=$wrc); retrying in 600s"
+  elif [ "$rc" -eq 2 ]; then
+    echo "[$(date +%H:%M:%S)] chip lock held by another consumer; sleeping 600s"
+  else
+    echo "[$(date +%H:%M:%S)] tunnel still down (rc=$rc); sleeping 600s"
   fi
-  echo "[$(date +%H:%M:%S)] tunnel still down; sleeping 600s"
   sleep 600
 done

@@ -86,6 +86,26 @@ def main() -> int:
 
     env = dict(os.environ)
     env["RUN_TPU_TESTS"] = "1"
+
+    # Hold the advisory chip lock for the whole window so our own
+    # watcher/probes back off; the driver's bench.py run preempts us
+    # by design (see benchmarks/chiplock.py).
+    sys.path.insert(0, HERE)
+    from chiplock import ChipLock
+
+    lock = ChipLock("window")
+    if not lock.try_acquire():
+        holder = lock.holder() or {}
+        print(f"chip lock held by {holder}; refusing to start window",
+              flush=True)
+        # EX_TEMPFAIL, NOT 2: argparse usage errors exit(2), and the
+        # watcher must be able to tell "lost the lock race, retry"
+        # from "broken invocation"
+        return 75
+    # children (incl. bench.py) run under our claim — they must not
+    # try to preempt their own parent
+    env["TPU_CHIP_LOCK_INHERITED"] = "1"
+
     with open(args.log, "a") as log:
         def emit(msg):
             line = f"[{time.strftime('%H:%M:%S')}] {msg}"

@@ -1,0 +1,168 @@
+"""The advisory TPU chip lock (benchmarks/chiplock.py).
+
+Round-4 incident: the axon tunnel serves one claimant at a time, and a
+concurrent background process silently stalled the bench child inside
+its timeout.  These tests pin the coordination contract: non-blocking
+acquire, holder metadata, bench-priority preemption (kills the
+holder's process tree), and crash-safety (a dead holder's flock
+vanishes with its fd).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+from chiplock import ChipLock  # noqa: E402
+
+
+@pytest.fixture
+def lock_path(tmp_path):
+    return str(tmp_path / "chip.lock")
+
+
+def test_acquire_free_lock(lock_path):
+    lock = ChipLock("window", path=lock_path)
+    assert lock.try_acquire()
+    info = lock.holder()
+    assert info["pid"] == os.getpid()
+    assert info["role"] == "window"
+    lock.release()
+
+
+def test_second_acquire_fails_then_succeeds_after_release(lock_path):
+    a = ChipLock("window", path=lock_path)
+    b = ChipLock("watch", path=lock_path)
+    assert a.try_acquire()
+    assert not b.try_acquire()
+    a.release()
+    assert b.try_acquire()
+    b.release()
+
+
+def test_holder_readable_without_acquiring(lock_path):
+    a = ChipLock("window", path=lock_path)
+    assert a.try_acquire()
+    info = ChipLock("bench", path=lock_path).holder()
+    assert info["role"] == "window"
+    a.release()
+
+
+def test_dead_holder_does_not_block(lock_path):
+    """flock dies with the process: a crashed holder leaves no stale lock."""
+    child = subprocess.Popen(
+        [
+            sys.executable, "-c",
+            "import sys, time; sys.path.insert(0, %r); "
+            "from chiplock import ChipLock; "
+            "assert ChipLock('window', path=%r).try_acquire(); "
+            "print('held', flush=True); time.sleep(60)"
+            % (os.path.join(REPO, "benchmarks"), lock_path),
+        ],
+        stdout=subprocess.PIPE, text=True,
+    )
+    assert child.stdout.readline().strip() == "held"
+    b = ChipLock("bench", path=lock_path)
+    assert not b.try_acquire()
+    child.kill()
+    child.wait()
+    deadline = time.time() + 10
+    while time.time() < deadline and not b.try_acquire():
+        time.sleep(0.1)
+    assert b.holder()["role"] == "bench"
+    b.release()
+
+
+def test_bench_preempts_live_holder(lock_path):
+    """acquire_or_preempt kills the recorded holder and takes the lock."""
+    child = subprocess.Popen(
+        [
+            sys.executable, "-c",
+            "import sys, time; sys.path.insert(0, %r); "
+            "from chiplock import ChipLock; "
+            "assert ChipLock('window', path=%r).try_acquire(); "
+            "print('held', flush=True); time.sleep(120)"
+            % (os.path.join(REPO, "benchmarks"), lock_path),
+        ],
+        stdout=subprocess.PIPE, text=True,
+    )
+    assert child.stdout.readline().strip() == "held"
+    bench = ChipLock("bench", path=lock_path)
+    note = bench.acquire_or_preempt(grace_s=15.0)
+    assert "preempted" in note and "window" in note
+    assert bench.holder()["role"] == "bench"
+    assert child.wait(timeout=10) != 0  # holder was killed, not exited
+    bench.release()
+
+
+def test_preempt_kills_term_ignoring_grandchild(lock_path):
+    """A descendant that ignores SIGTERM and outlives its parent must
+    still be reached by the SIGKILL escalation — an escaped grandchild
+    would keep the axon chip claim alive behind the released flock."""
+    child = subprocess.Popen(
+        [
+            sys.executable, "-c",
+            "import sys, time, subprocess; sys.path.insert(0, %r); "
+            "from chiplock import ChipLock; "
+            "assert ChipLock('window', path=%r).try_acquire(); "
+            "g = subprocess.Popen([sys.executable, '-c', "
+            "'import time, signal; signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+            "print(\"g up\", flush=True); time.sleep(120)']); "
+            "print('held', g.pid, flush=True); time.sleep(120)"
+            % (os.path.join(REPO, "benchmarks"), lock_path),
+        ],
+        stdout=subprocess.PIPE, text=True,
+    )
+    line = child.stdout.readline().split()
+    assert line[0] == "held"
+    gpid = int(line[1])
+    # wait for the grandchild to have installed its SIGTERM ignore
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            with open(f"/proc/{gpid}/cmdline", "rb") as f:
+                if b"SIG_IGN" in f.read():
+                    break
+        except OSError:
+            pass
+        time.sleep(0.1)
+    time.sleep(0.5)
+    bench = ChipLock("bench", path=lock_path)
+    note = bench.acquire_or_preempt(grace_s=5.0)
+    assert "preempted" in note
+    # the TERM-immune grandchild must be gone (KILLed), not orphaned
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            os.kill(gpid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.2)
+    else:
+        os.kill(gpid, 9)
+        raise AssertionError("grandchild escaped the kill tree")
+    child.wait(timeout=10)
+    bench.release()
+
+
+def test_preempt_on_free_lock_is_silent(lock_path):
+    bench = ChipLock("bench", path=lock_path)
+    assert bench.acquire_or_preempt() == ""
+    bench.release()
+
+
+def test_inherited_claim_env_skips_bench_locking(lock_path, monkeypatch):
+    """bench.py run as a window child must not preempt its own parent:
+    the TPU_CHIP_LOCK_INHERITED marker short-circuits locking."""
+    src = open(os.path.join(REPO, "bench.py")).read()
+    assert "TPU_CHIP_LOCK_INHERITED" in src
+    assert "running under parent's chip claim" in src
+    # and the window exports the marker for its children
+    wsrc = open(os.path.join(REPO, "benchmarks", "tpu_window.py")).read()
+    assert 'env["TPU_CHIP_LOCK_INHERITED"] = "1"' in wsrc
