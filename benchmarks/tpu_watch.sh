@@ -24,6 +24,20 @@ while true; do
     # preempted by the driver's bench.py.  All of those mean the round
     # still needs window data — keep watching.
     if [ "$wrc" -eq 0 ]; then
+      # land the numbers: regenerate BASELINE.md's training table from
+      # the window artifacts and commit the round's measured results.
+      # Adds are per-path (git add is all-or-nothing across a pathspec
+      # list: one missing file would stage NOTHING) and the commit is
+      # pathspec-scoped so operator-staged unrelated work is untouched.
+      if ! python benchmarks/collect_window.py; then
+        echo "[$(date +%H:%M:%S)] COLLECTOR FAILED — window artifacts left in benchmarks/window_out, NOT committed"
+      fi
+      for f in BASELINE.md benchmarks/RESULTS.md benchmarks/window_out; do
+        git add "$f" 2>/dev/null || echo "[$(date +%H:%M:%S)] could not stage $f"
+      done
+      git commit -q -m "Record measured TPU numbers from the completed measurement window" \
+        -- BASELINE.md benchmarks/RESULTS.md benchmarks/window_out \
+        || echo "[$(date +%H:%M:%S)] nothing to commit from collector"
       exit 0
     fi
     echo "[$(date +%H:%M:%S)] window incomplete (rc=$wrc); retrying in 600s"

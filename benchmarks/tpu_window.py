@@ -82,7 +82,20 @@ STEPS = [
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--log", default=os.path.join(HERE, "tpu_window.log"))
+    ap.add_argument(
+        "--out-dir", default=os.path.join(HERE, "window_out"),
+        help="full per-step stdout/stderr land here for "
+        "collect_window.py to turn into BASELINE.md rows",
+    )
     args = ap.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    def save_step(name: str, stdout, stderr) -> None:
+        for suffix, text in (("out", stdout), ("err", stderr)):
+            if isinstance(text, bytes):
+                text = text.decode(errors="replace")
+            with open(os.path.join(args.out_dir, f"{name}.{suffix}"), "w") as f:
+                f.write(text or "")
 
     env = dict(os.environ)
     env["RUN_TPU_TESTS"] = "1"
@@ -140,6 +153,7 @@ def main() -> int:
                 emit(f"   {name}: TIMEOUT >{timeout}s")
                 # postmortem: keep whatever the step printed before dying
                 out = exc.stdout
+                save_step(name, out, exc.stderr)
                 tail_lines(
                     out.decode(errors="replace") if isinstance(out, bytes) else out,
                     20, "",
@@ -149,6 +163,7 @@ def main() -> int:
                     return 1
                 continue
             dt = time.time() - t0
+            save_step(name, proc.stdout, proc.stderr)
             tail_lines(proc.stdout, 12, "")
             if proc.returncode != 0:
                 tail_lines(proc.stderr, 12, "stderr: ")

@@ -1,0 +1,120 @@
+"""collect_window.py turns window artifacts into BASELINE.md rows.
+
+The collector is the last hop between a measurement window and the
+committed evidence; a silent parse failure would lose a round's
+numbers, so its parsing and table-rewrite are pinned here (chip-free).
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+import collect_window as cw  # noqa: E402
+
+BENCH_LINE = (
+    '{"metric": "resnet50_train_examples_per_sec_per_chip", "value": 2400.5,'
+    ' "unit": "examples/sec/chip", "vs_baseline": 1.13, "batch_per_chip": 256,'
+    ' "step_ms": 106.6, "mfu_xla": 0.291, "mfu_analytic": 0.274,'
+    ' "pipeline_examples_per_sec_per_chip": 2300.1, "pipeline_step_ms": 111.2,'
+    ' "llama_train_tokens_per_sec_per_chip": 52000.3, "llama_step_ms": 157.5,'
+    ' "llama_mfu_analytic": 0.41, "llama_mfu_xla": 0.44,'
+    ' "llama_decode_tokens_per_sec": 2100.7}'
+)
+TRAIN_LINE = (
+    '{"train_backend": "tpu", "mnist_steps_per_sec_per_chip": 95.2,'
+    ' "mnist_examples_per_sec_per_chip": 24371.2,'
+    ' "bert_base_steps_per_sec_per_chip": 4.1,'
+    ' "bert_base_examples_per_sec_per_chip": 131.2}'
+)
+FLASH_OUT = (
+    "some pytest noise\n"
+    "flash fwd+bwd @4k: 41.2ms  xla: 70.1ms  speedup 1.70x\n"
+    "windowed fwd+bwd @8k/w1k: 30.5ms  full: 61.2ms  speedup 2.01x\n"
+    "2 passed\n"
+)
+
+TABLE = """# fake baseline
+
+<!-- train:begin -->
+| Metric | Value | Setup |
+|---|---|---|
+| ResNet-50 examples/sec/chip (train, bf16) | old | old |
+| ResNet-50 with the input pipeline live | pending | — |
+| llama-mini train tokens/sec/chip (~120M) | pending | — |
+| llama-mini steady decode tokens/sec (KV-cache greedy, batch 8) | pending | — |
+| mnist / BERT-base steps/sec/chip | pending | — |
+| Flash vs XLA attention, fwd+bwd @ seq 4096 | pending | — |
+| Windowed vs full flash attention, fwd+bwd | pending | — |
+<!-- train:end -->
+
+tail prose stays
+"""
+
+
+@pytest.fixture
+def artifacts(tmp_path):
+    d = tmp_path / "window_out"
+    d.mkdir()
+    (d / "bench.out").write_text("warmup noise\n" + BENCH_LINE + "\n")
+    (d / "train.out").write_text(TRAIN_LINE + "\n")
+    (d / "flash.out").write_text(FLASH_OUT)
+    (d / "sweep.out").write_text('{"label": "bnbf16", "mfu": 0.31}\n')
+    return str(d)
+
+
+def test_parse_artifacts(artifacts):
+    data = cw.parse_artifacts(artifacts)
+    assert data["bench"]["value"] == 2400.5
+    assert data["train"]["mnist_steps_per_sec_per_chip"] == 95.2
+    assert data["flash_fwd_bwd"]["speedup"] == 1.70
+    assert data["window_fwd_bwd"]["speedup"] == 2.01
+    assert data["sweep"][0]["label"] == "bnbf16"
+
+
+def test_error_bench_line_is_ignored(tmp_path):
+    d = tmp_path / "w"
+    d.mkdir()
+    (d / "bench.out").write_text(
+        '{"metric": "m", "value": 0.0, "error": "probe hung"}\n'
+    )
+    assert "bench" not in cw.parse_artifacts(str(d))
+
+
+def test_rewrite_replaces_only_fresh_rows(artifacts, tmp_path):
+    baseline = tmp_path / "BASELINE.md"
+    baseline.write_text(TABLE)
+    data = cw.parse_artifacts(artifacts)
+    rows = cw.build_rows(data, "2026-07-31")
+    n = cw.rewrite_baseline(rows, path=str(baseline))
+    assert n == 7
+    text = baseline.read_text()
+    assert "**2400.5 @ batch 256**" in text
+    assert "52000.3 tok/s/chip" in text
+    assert "**2100.7 tok/s**" in text
+    assert "**1.70×**" in text
+    assert "**2.01×**" in text
+    assert "mnist **95.2 steps/s**" in text
+    assert "pending" not in text.split("train:begin")[1].split("train:end")[0]
+    assert "tail prose stays" in text
+
+
+def test_partial_window_keeps_old_rows(tmp_path):
+    d = tmp_path / "w"
+    d.mkdir()
+    (d / "flash.out").write_text(FLASH_OUT)  # only the flash step ran
+    baseline = tmp_path / "BASELINE.md"
+    baseline.write_text(TABLE)
+    data = cw.parse_artifacts(str(d))
+    n = cw.rewrite_baseline(cw.build_rows(data, "2026-07-31"), path=str(baseline))
+    assert n == 2
+    text = baseline.read_text()
+    assert "| old |" in text          # resnet row untouched
+    assert "**1.70×**" in text        # flash row refreshed
+
+
+def test_empty_dir_returns_nothing(tmp_path):
+    assert cw.parse_artifacts(str(tmp_path)) == {}
