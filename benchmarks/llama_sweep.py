@@ -22,7 +22,7 @@ import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
-#: (label, extra args for profile_llama.py)
+#: (label, extra args for profile_llama.py[, extra env])
 MATRIX = [
     ("s1024-flash", ["--seq", "1024", "--batch", "8"]),
     ("s1024-xla", ["--seq", "1024", "--batch", "8", "--flash", "0"]),
@@ -32,16 +32,28 @@ MATRIX = [
     ("s4096-w1024", ["--seq", "4096", "--batch", "2", "--window", "1024"]),
     ("s1024-remat-b16", ["--seq", "1024", "--batch", "16", "--remat"]),
     ("s1024-b16", ["--seq", "1024", "--batch", "16"]),
+    # flash kernel block autotune (ops/flash_attention.default_flash_blocks
+    # reads these env knobs): if a shape wins clearly, pin it as the
+    # default in a followup — the committed sweep output is the evidence
+    ("s1024-bq256", ["--seq", "1024", "--batch", "8"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "256"}),
+    ("s1024-bk256", ["--seq", "1024", "--batch", "8"],
+     {"TPU_OPERATOR_FLASH_BLOCK_K": "256"}),
+    ("s1024-b256x256", ["--seq", "1024", "--batch", "8"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "256", "TPU_OPERATOR_FLASH_BLOCK_K": "256"}),
+    ("s2048-b512x256", ["--seq", "2048", "--batch", "4"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "256"}),
 ]
 
 QUICK = MATRIX[:2]
 
 
-def run_one(label, extra, timeout):
+def run_one(label, extra, timeout, env_extra=None):
     cmd = [sys.executable, os.path.join(HERE, "profile_llama.py"), *extra]
+    env = dict(os.environ, **(env_extra or {}))
     try:
         proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout
+            cmd, capture_output=True, text=True, timeout=timeout, env=env
         )
     except subprocess.TimeoutExpired:
         return {"label": label, "error": f"timeout >{timeout}s"}
@@ -67,9 +79,11 @@ def main():
     args = ap.parse_args()
 
     results = []
-    for label, extra in (QUICK if args.quick else MATRIX):
+    for entry in (QUICK if args.quick else MATRIX):
+        label, extra = entry[0], entry[1]
+        env_extra = entry[2] if len(entry) > 2 else None
         print(f"--- {label} ...", flush=True)
-        res = run_one(label, extra, args.timeout)
+        res = run_one(label, extra, args.timeout, env_extra)
         results.append(res)
         print(json.dumps(res), flush=True)
 

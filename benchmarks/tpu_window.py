@@ -43,14 +43,14 @@ STEPS = [
         4200,
     ),
     # the transformer co-headline's variant matrix (flash-vs-XLA at
-    # train shapes, remat, banded windows at long seq).  Step budget
-    # must exceed worst-case inner time: 8 variants x 480s child
-    # timeout = 3840s < 4200s, so a contended chip can't kill the
-    # sweep mid-matrix before the banded-window datapoint runs
+    # train shapes, remat, banded windows at long seq, and the flash
+    # block-size autotune candidates).  Step budget must exceed
+    # worst-case inner time: 12 variants x 480s child timeout = 5760s
+    # < 6000s, so a contended chip can't kill the sweep mid-matrix
     (
         "llama-sweep",
         [sys.executable, os.path.join(HERE, "llama_sweep.py"), "--timeout", "480"],
-        4200,
+        6000,
     ),
     (
         "trace",

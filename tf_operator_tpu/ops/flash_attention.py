@@ -656,6 +656,18 @@ def _flash_applicable(q, k, bias, mask, block_q, block_k, window=None) -> bool:
     return jax.default_backend() == "tpu"
 
 
+def default_flash_blocks() -> tuple:
+    """Kernel block sizes used when the caller doesn't pick:
+    TPU_OPERATOR_FLASH_BLOCK_Q / _BLOCK_K env overrides (the
+    benchmarks/llama_sweep.py autotune matrix sets these per variant),
+    else 128x128 — a safe VMEM fit at every supported head dim."""
+
+    return (
+        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_Q", "128")),
+        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_K", "128")),
+    )
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -665,13 +677,18 @@ def attention(
     bias: Optional[jax.Array] = None,
     mask: Optional[jax.Array] = None,
     mesh: Optional[Mesh] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     window: Optional[int] = None,
 ) -> jax.Array:
     """Dispatching attention: pallas flash kernel when it applies, the
     XLA-fused reference otherwise.  Drop-in for dot_product_attention;
     pass the mesh so multi-device calls get the shard_map wrapper."""
+
+    if block_q is None or block_k is None:
+        dq, dk = default_flash_blocks()
+        block_q = dq if block_q is None else block_q
+        block_k = dk if block_k is None else block_k
 
     if _flash_applicable(q, k, bias, mask, block_q, block_k, window):
         mode = _mesh_flash_applicable(mesh, q, k)
