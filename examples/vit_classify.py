@@ -1,0 +1,71 @@
+"""ViT image classification — transformer member of the image family.
+
+Beyond-reference example (the reference's image workloads are ResNet
+CNNs; SURVEY.md §6 configs 2/4): same synchronous data-parallel shape
+as resnet_dp.py, with the encoder stack, logical sharding rules, and
+attention dispatcher shared with the text families.  ``--tp`` shards
+heads/MLP over a tensor axis to demonstrate image models on a dp×tp
+mesh — the reference had no analogue.
+
+Runs single-process (the real chip) or multi-process under the
+operator's local backend (CPU collectives), like every example.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tf_operator_tpu.runtime import initialize
+from tf_operator_tpu.runtime.harness import batch_sizes, standard_parser, train_loop
+
+
+def main() -> int:
+    parser = standard_parser(__doc__.split("\n")[0], learning_rate=3e-3)
+    parser.add_argument("--model", choices=["vit_b16", "vit_tiny"], default="vit_tiny")
+    parser.add_argument("--image-size", type=int, default=32)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--tp", type=int, default=1, help="tensor axis size")
+    args = parser.parse_args()
+
+    initialize()
+
+    import jax
+    import numpy as np
+
+    from tf_operator_tpu.models import vit_b16, vit_loss, vit_tiny
+    from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+
+    n_dev = len(jax.devices())
+    assert n_dev % args.tp == 0, (n_dev, args.tp)
+    mesh = make_mesh({"dp": n_dev // args.tp, "tp": args.tp})
+
+    _, local_batch = batch_sizes(args.batch_per_device)
+    rng = np.random.RandomState(jax.process_index())
+    batch = {
+        "image": rng.rand(local_batch, args.image_size, args.image_size, 3).astype(
+            np.float32
+        ),
+        "label": rng.randint(0, args.num_classes, size=(local_batch,)).astype(
+            np.int32
+        ),
+    }
+
+    model_fn = vit_b16 if args.model == "vit_b16" else vit_tiny
+    trainer = Trainer(
+        model_fn(image_size=args.image_size, n_classes=args.num_classes, mesh=mesh),
+        TrainerConfig(optimizer="adamw", learning_rate=args.learning_rate),
+        mesh,
+        vit_loss,
+        batch,
+        shardings="logical",
+    )
+    sharded = trainer.shard_batch(batch)
+    tag = f"{args.model} dp={mesh.shape['dp']} tp={mesh.shape['tp']}"
+    train_loop(trainer, sharded, args.steps, tag=tag)
+    stats = trainer.benchmark(batch, steps=max(args.steps // 2, 5), warmup=0)
+    print(f"{tag}: {stats['examples_per_sec']:.1f} ex/s global", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

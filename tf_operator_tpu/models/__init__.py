@@ -19,6 +19,7 @@ from tf_operator_tpu.models.mnist import MnistCNN
 from tf_operator_tpu.models.pipelined_lm import PipelinedLM, lm_reference_apply
 from tf_operator_tpu.models.moe import MoeConfig, MoeLM, moe_lm_loss, moe_tiny
 from tf_operator_tpu.models.resnet import ResNet, resnet18, resnet50
+from tf_operator_tpu.models.vit import ViT, vit_b16, vit_loss, vit_tiny
 from tf_operator_tpu.models.t5 import T5, seq2seq_loss, t5_base, t5_tiny
 from tf_operator_tpu.models.transformer import TransformerConfig
 
@@ -42,6 +43,10 @@ __all__ = [
     "ResNet",
     "resnet18",
     "resnet50",
+    "ViT",
+    "vit_b16",
+    "vit_loss",
+    "vit_tiny",
     "T5",
     "seq2seq_loss",
     "t5_base",

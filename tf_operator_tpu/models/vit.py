@@ -1,0 +1,146 @@
+"""ViT — vision transformer image classifier.
+
+Beyond-reference model family (the reference's image workloads are
+ResNet CNNs — SURVEY.md §6 configs 2/4); added so the framework's
+image path has a transformer member that exercises the same encoder
+stack, sharding rules, and attention kernels as the text families.
+
+TPU-first choices:
+- patch embedding is a RESHAPE + DENSE, not a conv: [B, H, W, C] →
+  [B, N, p·p·C] → matmul to hidden.  Identical math to the standard
+  stride-p conv, but it lands on the MXU as one large [B·N, p²C]×
+  [p²C, hidden] matmul with no im2col/window machinery for XLA to
+  pattern-match — the fastest possible lowering for non-overlapping
+  patches.
+- mean-pool head (no CLS token): keeps the sequence length a clean
+  power of two (196→... stays whatever the grid gives, but no +1
+  ragged token), which keeps flash-attention tiling applicable at
+  larger image/patch combinations.
+- everything reuses transformer.py's EncoderLayer, so ViT inherits
+  fsdp/tp logical sharding rules, bf16 compute, and the attention
+  dispatcher (flash when shapes tile, XLA otherwise) for free.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models.transformer import (
+    ACT_HIDDEN,
+    EncoderLayer,
+    LayerNorm,
+    TransformerConfig,
+    dense,
+    logical_constraint,
+    param_with_axes,
+)
+
+
+class PatchEmbed(nn.Module):
+    """Non-overlapping patches → hidden, as one MXU matmul."""
+
+    cfg: TransformerConfig
+    patch: int
+
+    @nn.compact
+    def __call__(self, images):  # [B, H, W, C]
+        p = self.patch
+        b, h, w, c = images.shape
+        if h % p or w % p:
+            raise ValueError(f"image {h}x{w} not divisible by patch {p}")
+        gh, gw = h // p, w // p
+        x = images.reshape(b, gh, p, gw, p, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, gh * gw, p * p * c)
+        x = x.astype(self.cfg.dtype)
+        return dense(self.cfg.hidden, self.cfg, ("stack", "embed"),
+                     name="proj")(x)
+
+
+class ViT(nn.Module):
+    cfg: TransformerConfig
+    patch: int = 16
+    n_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, images, *, train: bool = False):
+        cfg = self.cfg
+        x = PatchEmbed(cfg, self.patch, name="patch_embed")(images)
+        n = x.shape[1]
+        pos = self.param(
+            "pos_embed",
+            param_with_axes(nn.initializers.normal(0.02), ("seq", "embed")),
+            (cfg.max_len, cfg.hidden),
+            jnp.float32,
+        )
+        if n > cfg.max_len:
+            raise ValueError(
+                f"{n} patches > max_len {cfg.max_len}; raise cfg.max_len"
+            )
+        x = x + pos[None, :n].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        x = logical_constraint(x, ACT_HIDDEN)
+        for i in range(cfg.n_layers):
+            x = EncoderLayer(cfg, name=f"layer_{i}")(x, train=train)
+        x = LayerNorm(cfg, name="ln_final")(x)
+        x = x.mean(axis=1)  # mean-pool over patches
+        logits = dense(self.n_classes, cfg, ("embed", "vocab"),
+                       name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+def vit_b16(image_size: int = 224, n_classes: int = 1000, mesh=None) -> ViT:
+    """ViT-Base/16 (~86M params at 224²/1000)."""
+    n = (image_size // 16) ** 2
+    return ViT(
+        TransformerConfig(
+            vocab_size=1,  # unused; classification head sizes itself
+            hidden=768,
+            n_heads=12,
+            head_dim=64,
+            n_layers=12,
+            mlp_dim=3072,
+            max_len=n,
+            mesh=mesh,
+        ),
+        patch=16,
+        n_classes=n_classes,
+    )
+
+
+def vit_tiny(image_size: int = 32, n_classes: int = 10, mesh=None, **kw) -> ViT:
+    """Test-scale ViT (patch 8, 2 layers)."""
+    n = (image_size // 8) ** 2
+    return ViT(
+        TransformerConfig(
+            vocab_size=1,
+            hidden=64,
+            n_heads=4,
+            head_dim=16,
+            n_layers=2,
+            mlp_dim=128,
+            max_len=n,
+            mesh=mesh,
+            **kw,
+        ),
+        patch=8,
+        n_classes=n_classes,
+    )
+
+
+def vit_loss(params, state, batch, rng, train: bool = True) -> Tuple[jax.Array, dict]:
+    """Supervised classification loss (same contract as
+    parallel.trainer.cross_entropy_loss; stateless model)."""
+    import optax
+
+    logits = state.apply_fn(
+        {"params": params}, batch["image"], train=train, rngs={"dropout": rng}
+    )
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), batch["label"]
+    ).mean()
+    acc = (logits.argmax(-1) == batch["label"]).mean()
+    return loss, {"metrics": {"accuracy": acc}}
