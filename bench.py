@@ -259,6 +259,29 @@ def run_resnet() -> dict:
                     pstats["examples_per_sec"] / n_dev, 2
                 )
                 result["pipeline_step_ms"] = round(pstats["step_ms"], 2)
+                # host→device bandwidth probe: on a tunneled chip (this
+                # box: axon) transfers ride the NETWORK, so the live-
+                # pipeline number can be wire-bound rather than
+                # framework-bound.  Reporting the measured h2d rate and
+                # the wire bytes/step makes the artifact self-explaining.
+                # Random payload (an all-zeros buffer is the best case
+                # for any compressing transport); own try/except so a
+                # probe hiccup can't wipe the pipeline fields below.
+                try:
+                    n_bytes = 16 * 10**6
+                    buf = np.random.RandomState(1).randint(
+                        0, 256, size=(n_bytes,), dtype=np.uint8
+                    )
+                    jax.device_put(buf).block_until_ready()  # warm the path
+                    t0 = time.perf_counter()
+                    jax.device_put(buf).block_until_ready()
+                    h2d = n_bytes / 1e6 / (time.perf_counter() - t0)
+                    result["h2d_mb_per_sec"] = round(h2d, 1)
+                    result["pipeline_wire_mb_per_step"] = round(
+                        global_batch * 224 * 224 * 3 / 1e6, 1
+                    )
+                except Exception as e:
+                    result["h2d_probe_error"] = f"{type(e).__name__}: {e}"[:120]
                 if flops_xla:
                     result["pipeline_mfu_xla"] = round(
                         flops_xla * pstats["steps_per_sec"] / peak, 4
@@ -390,6 +413,29 @@ def run_llama() -> dict:
     np.asarray(trainer.generate(prompt, max_new_tokens=n_new))
     dt = time.perf_counter() - t0
     out["llama_decode_tokens_per_sec"] = round(rows * n_new / dt, 1)
+    if os.environ.get("BENCH_QUANT", "1") != "0":
+        # int8 weights-only decode (ops/quant.py): same greedy program
+        # with the quantized tree — decode at batch 8 is weight-
+        # bandwidth-bound, so int8 weights should approach 2x
+        try:
+            from tf_operator_tpu.models import generate as raw_generate
+            from tf_operator_tpu.ops.quant import quantize_tree
+
+            qparams = quantize_tree(trainer.state.params)
+            jit_gen = jax.jit(
+                lambda q, ids: raw_generate(
+                    trainer.model, q, ids, max_new_tokens=n_new
+                )
+            )
+            np.asarray(jit_gen(qparams, prompt))  # compile
+            t0 = time.perf_counter()
+            np.asarray(jit_gen(qparams, prompt))
+            dt = time.perf_counter() - t0
+            out["llama_decode_int8_tokens_per_sec"] = round(
+                rows * n_new / dt, 1
+            )
+        except Exception as exc:  # measurement is additive, never fatal
+            out["llama_decode_int8_error"] = repr(exc)[:200]
     return out
 
 

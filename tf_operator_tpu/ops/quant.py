@@ -1,0 +1,139 @@
+"""Weights-only int8 quantization for the serving/decode path.
+
+Decode at small batch is weight-bandwidth-bound: every generated token
+re-reads each projection matrix from HBM while the MXU idles
+(arithmetic intensity ~ batch rows).  Halving the bytes per weight
+therefore nearly halves the per-token HBM time, which dominates the
+step.  The scheme here:
+
+- **Quantize OUTSIDE jit** (`quantize_tree`): selected param leaves
+  become :class:`QTensor` — int8 values + a per-output-channel
+  symmetric scale (max-abs / 127, reduced over all axes but the last).
+  QTensor is a registered pytree node, so the quantized tree passes
+  through ``jax.jit`` argument plumbing unchanged.
+- **Materialize INSIDE jit** (`materialize_tree`): the int8→bf16
+  convert-and-scale runs under the same jit as the matmul, where XLA
+  fuses it into the dot's operand read — the weight crosses HBM as
+  int8 and no bf16 copy is ever written back.
+
+Training stays bf16; this is a serving-side transform applied after
+`load_params` (see ``examples/serve_lm.py --quantize int8`` and
+``models/decode.py``, which both call :func:`materialize_tree` at the
+apply sites so quantized and plain trees share one code path).
+
+The reference (SURVEY.md §0) has no quantized-serving story — this is
+a beyond-reference capability.  On-chip numbers: ``bench.py``'s llama
+child measures decode tokens/s bf16 vs int8 (``llama_decode_tokens_
+per_sec`` / ``llama_decode_int8_tokens_per_sec``; gate off with
+``BENCH_QUANT=0``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# leaves smaller than this stay bf16: scales + a second HBM round trip
+# buy nothing on tiny tensors, and biases/norms are accuracy-critical
+DEFAULT_MIN_SIZE = 4096
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QTensor:
+    """int8 values + broadcastable per-channel scale."""
+
+    q: jax.Array  # int8, original shape
+    scale: jax.Array  # float32, shape (1, ..., 1, out_features)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes(self) -> int:
+        return self.q.nbytes + self.scale.nbytes
+
+    def materialize(self, dtype=jnp.bfloat16) -> jax.Array:
+        return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+
+def _is_q(leaf: Any) -> bool:
+    return isinstance(leaf, QTensor)
+
+
+def quantize_array(w: jax.Array) -> QTensor:
+    """Symmetric int8 with one scale per last-axis channel."""
+
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=tuple(range(w.ndim - 1)), keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale)
+
+
+def quantize_tree(
+    params,
+    *,
+    min_size: int = DEFAULT_MIN_SIZE,
+    quantize_embed: bool = False,
+):
+    """Quantize the projection kernels of a params pytree.
+
+    A leaf is quantized when its path ends in ``kernel``, it has >= 2
+    dims, and it holds at least ``min_size`` elements.  The embedding
+    table (which doubles as the logits head via ``Embed.attend``) is
+    accuracy-critical and stays bf16 unless ``quantize_embed=True``.
+    """
+
+    def f(path, leaf):
+        # params may be boxed (flax Partitioned / axis metadata), so the
+        # path can end in attribute keys like `.value` — the param NAME
+        # is the last dict key on the path
+        name = ""
+        for entry in reversed(path):
+            k = getattr(entry, "key", None)
+            if isinstance(k, str):
+                name = k
+                break
+        eligible = name == "kernel" or (quantize_embed and name == "embedding")
+        if eligible and hasattr(leaf, "ndim") and leaf.ndim >= 2 and leaf.size >= min_size:
+            return quantize_array(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(f, params)
+
+
+def materialize_tree(params, dtype=jnp.bfloat16):
+    """Dequantize QTensor leaves (bf16), pass everything else through.
+
+    Call this INSIDE jit, immediately before ``model.apply`` — that is
+    what lets XLA fuse the convert into the consuming dot.  On a tree
+    with no QTensor leaves this is an identity tree_map.
+    """
+
+    return jax.tree_util.tree_map(
+        lambda l: l.materialize(dtype) if _is_q(l) else l, params, is_leaf=_is_q
+    )
+
+
+def is_quantized(params) -> bool:
+    return any(
+        _is_q(l) for l in jax.tree_util.tree_leaves(params, is_leaf=_is_q)
+    )
+
+
+def tree_bytes(params) -> int:
+    return sum(
+        l.nbytes for l in jax.tree_util.tree_leaves(params, is_leaf=_is_q)
+    )
