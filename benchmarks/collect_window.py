@@ -73,6 +73,9 @@ def parse_artifacts(out_dir: str) -> dict:
     train = _last_json_line(_read(out_dir, "train.out"))
     if train and "mnist_steps_per_sec_per_chip" in train:
         data["train"] = train
+    batching = _last_json_line(_read(out_dir, "batching.out"))
+    if batching and "batching_pool_tokens_per_sec" in batching:
+        data["batching"] = batching
 
     flash = _read(out_dir, "flash.out")
     m = re.search(
@@ -179,6 +182,17 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             "ex/s, seq 128, fsdp) "
             f"| 1× v5 lite, `measure.py --section train`, {today} |"
         )
+    bt = data.get("batching")
+    if bt:
+        rows["Serving under concurrency"] = (
+            "| Serving under concurrency (8 staggered requests, "
+            "llama-mini, greedy 96 new tokens each) | continuous-"
+            f"batching pool **{bt['batching_pool_tokens_per_sec']} "
+            f"tok/s** vs sequential "
+            f"{bt['batching_sequential_tokens_per_sec']} tok/s — "
+            f"**{bt['batching_speedup']}×** (`models/batching.py`) "
+            f"| 1× v5 lite, `measure.py --section batching`, {today} |"
+        )
     f = data.get("flash_fwd_bwd")
     if f:
         rows["Flash vs XLA attention, fwd+bwd"] = (
@@ -217,6 +231,11 @@ def rewrite_baseline(rows: dict[str, str], path: str = BASELINE) -> int:
                     replaced += 1
                     break
         out_lines.append(line)
+    # fresh metrics with no existing row (a measurement added after the
+    # table was authored) append rather than vanish
+    for key in pending:
+        out_lines.append(pending[key])
+        replaced += 1
     new = head + BEGIN + "\n" + "\n".join(out_lines) + "\n" + END + tail
     with open(path, "w") as fh:
         fh.write(new)

@@ -8,7 +8,9 @@ Measures, on this box:
   3. training steps/sec/chip for mnist CNN and BERT-base on the default
      backend (the real chip when present; bench.py owns ResNet-50).
 
-Usage: python benchmarks/measure.py [--section all|reconcile|startup|train]
+Usage: python benchmarks/measure.py
+           [--section all|reconcile|startup|train|batching]
+(batching is chip-minutes heavy and runs only when named explicitly)
 Prints one JSON object; paste results into BASELINE.md.
 """
 
@@ -258,6 +260,78 @@ def bench_training() -> dict:
     return out
 
 
+def bench_batching() -> dict:
+    """Serving throughput under concurrency: aggregate decode tokens/s
+    for 8 staggered requests through the continuous-batching pool
+    (models/batching.py) vs the same 8 served back-to-back, one
+    ChunkedServingDecoder call each (today's one-request-at-a-time
+    server).  The pool's step cost is ~constant in occupancy, so its
+    win should approach min(8, slots)× on a weight-bandwidth-bound
+    chip decode."""
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import llama_mini_config
+    from tf_operator_tpu.models import LlamaLM
+    from tf_operator_tpu.models.batching import ContinuousBatchingDecoder
+    from tf_operator_tpu.models.decode import ChunkedServingDecoder
+
+    out = {"batching_backend": jax.default_backend()}
+    seq = int(os.environ.get("MEASURE_BATCHING_MAXLEN", "512"))
+    n_req = 8
+    n_new = int(os.environ.get("MEASURE_BATCHING_NEW", "96"))
+    if os.environ.get("MEASURE_BATCHING_TINY"):  # CPU smoke: tiny model
+        from tf_operator_tpu.models import llama_tiny
+
+        model = llama_tiny(vocab_size=256, max_len=seq)
+    else:
+        model = LlamaLM(llama_mini_config(seq))
+    vocab = model.cfg.vocab_size
+    r = np.random.RandomState(0)
+    init_ids = jnp.asarray(r.randint(0, vocab, size=(1, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), init_ids)["params"]
+    prompts = [
+        r.randint(0, vocab, size=(int(l),)).astype(np.int32)
+        for l in r.randint(8, 48, size=(n_req,))
+    ]
+
+    # ONE decoder of each kind, reused by warmup and timed runs: the
+    # jitted programs live on the instance, so a fresh decoder per run
+    # would put retrace+compile inside the timed window
+    pool_dec = ContinuousBatchingDecoder(model, params, slots=8)
+    seq_dec = ChunkedServingDecoder(model, params)
+
+    def pool_run():
+        rids = []
+        for p in prompts:
+            rids.append(pool_dec.submit(p, max_new_tokens=n_new))
+            pool_dec.step()  # staggered arrivals: the pool never drains
+        pool_dec.run()
+        return [pool_dec.result(rid) for rid in rids]
+
+    def sequential_run():
+        return [
+            np.asarray(seq_dec.generate(jnp.asarray(p[None, :]), n_new))
+            for p in prompts
+        ]
+
+    pool_run()  # compile
+    t0 = time.perf_counter()
+    pool_run()
+    dt_pool = time.perf_counter() - t0
+    sequential_run()  # compile
+    t0 = time.perf_counter()
+    sequential_run()
+    dt_seq = time.perf_counter() - t0
+    total = n_req * n_new
+    out["batching_pool_tokens_per_sec"] = round(total / dt_pool, 1)
+    out["batching_sequential_tokens_per_sec"] = round(total / dt_seq, 1)
+    out["batching_speedup"] = round(dt_seq / dt_pool, 2)
+    return out
+
+
 def write_baseline(out: dict) -> None:
     """Regenerate the control-plane table in BASELINE.md between the
     measured:begin/end markers (VERDICT r2 item 9: the scoreboard must
@@ -308,7 +382,9 @@ def write_baseline(out: dict) -> None:
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        "--section", choices=["all", "reconcile", "startup", "train"], default="all"
+        "--section",
+        choices=["all", "reconcile", "startup", "train", "batching"],
+        default="all",
     )
     parser.add_argument(
         "--write-baseline",
@@ -332,6 +408,8 @@ def main() -> int:
         out.update(bench_startup_latency())
     if args.section in ("all", "train"):
         out.update(bench_training())
+    if args.section == "batching":  # not in "all": needs chip minutes
+        out.update(bench_batching())
     print(json.dumps(out, indent=1))
     return 0
 

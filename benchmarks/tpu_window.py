@@ -76,6 +76,13 @@ STEPS = [
         [sys.executable, os.path.join(HERE, "measure.py"), "--section", "train"],
         1800,
     ),
+    # serving under concurrency: continuous-batching pool vs sequential
+    # (models/batching.py); parsed into BASELINE.md by collect_window
+    (
+        "batching",
+        [sys.executable, os.path.join(HERE, "measure.py"), "--section", "batching"],
+        1500,
+    ),
 ]
 
 

@@ -10,6 +10,11 @@ XLA program per shape) do the actual work.
     python examples/serve_lm.py --artifact /path/to/export --port 8600
     curl -s localhost:8600/generate -d '{"prompt": "the sharded ", "max_new_tokens": 32}'
 
+Serving modes: `--batching SLOTS` multiplexes concurrent requests
+through the continuous-batching pool (models/batching.py — one decode
+loop, step-granular joins); `--quantize int8` halves HBM weight
+traffic per decoded token (ops/quant.py).  The two compose.
+
 The jit-compile cache is bounded BY DESIGN (VERDICT r3 weak #5/next #9):
 prompts prefill through the KV cache in power-of-2 chunks (binary
 decomposition — exact semantics, no padding) and token budgets round up
@@ -34,15 +39,43 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_handler(model, params, max_len: int):
+def build_handler(model, params, max_len: int, batching_slots: int = 0):
+    """batching_slots > 0 serves through the continuous-batching pool
+    (models/batching.py): concurrent requests share one decode loop,
+    joining at step granularity, driven by a single background thread.
+    top_k is not yet supported there (the pool samples per-slot
+    greedy/temperature) and returns 400 rather than silently differing.
+    """
+
+    import threading
+    import time as _time
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from tf_operator_tpu.data.text import decode_bytes
+    from tf_operator_tpu.models.batching import ContinuousBatchingDecoder
     from tf_operator_tpu.models.decode import ChunkedServingDecoder
 
-    decoder = ChunkedServingDecoder(model, params)
+    if batching_slots > 0:
+        pool = ContinuousBatchingDecoder(model, params, slots=batching_slots)
+        pool_fatal = []  # driver-thread death must surface as 500s
+
+        def _drive():
+            while True:
+                try:
+                    if pool.step() == 0:
+                        _time.sleep(0.005)
+                except Exception as exc:  # a dead driver = hung clients
+                    pool_fatal.append(repr(exc))
+                    return
+
+        threading.Thread(target=_drive, daemon=True).start()
+    else:
+        pool = None
+        pool_fatal = []
+        decoder = ChunkedServingDecoder(model, params)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -97,6 +130,32 @@ def build_handler(model, params, max_len: int):
                     return self._reply(400, {
                         "error": f"prompt({len(ids)}) + max_new_tokens({n_new}) "
                                  f"> max_len({max_len})"})
+                if pool is not None:
+                    if top_k is not None:
+                        return self._reply(400, {
+                            "error": "top_k is not supported in "
+                                     "--batching mode"})
+                    if pool_fatal:
+                        return self._reply(500, {
+                            "error": f"decode driver died: {pool_fatal[0]}"})
+                    rid = pool.submit(
+                        ids.astype(np.int32), n_new,
+                        temperature=temperature,
+                        rng=jax.random.PRNGKey(seed)
+                        if temperature > 0.0 else None,
+                    )
+                    out_row = pool.result(rid)
+                    while out_row is None:
+                        if pool_fatal:
+                            return self._reply(500, {
+                                "error": "decode driver died: "
+                                         f"{pool_fatal[0]}"})
+                        _time.sleep(0.003)
+                        out_row = pool.result(rid)
+                    sample = decode_bytes(out_row[len(ids):])
+                    return self._reply(
+                        200, {"prompt": text, "sample": sample, "seed": seed}
+                    )
                 prompt = jnp.asarray(ids, jnp.int32)[None]
                 out = decoder.generate(
                     prompt, n_new, temperature=temperature, top_k=top_k,
@@ -123,6 +182,12 @@ def main() -> int:
         "--platform", default=None,
         help="force a jax platform (e.g. cpu) — goes through jax.config, "
              "which beats env-level pins like this box's sitecustomize",
+    )
+    ap.add_argument(
+        "--batching", type=int, default=0, metavar="SLOTS",
+        help="serve through the continuous-batching pool with this many "
+             "slots (concurrent requests share one decode loop); 0 = "
+             "one-request-at-a-time ChunkedServingDecoder",
     )
     ap.add_argument(
         "--quantize", choices=["int8"], default=None,
@@ -175,7 +240,8 @@ def main() -> int:
             flush=True,
         )
     server = ThreadingHTTPServer(
-        ("127.0.0.1", args.port), build_handler(model, params, max_len)
+        ("127.0.0.1", args.port),
+        build_handler(model, params, max_len, batching_slots=args.batching),
     )
     print(f"serving on 127.0.0.1:{args.port} (artifact: {args.artifact})", flush=True)
     server.serve_forever()

@@ -123,6 +123,46 @@ class TestQuantizedDecode:
         out = fn(qparams, prompt)
         assert out.shape == (2, 9)
 
+    @pytest.mark.slow
+    def test_moe_quantized_decode_parity(self):
+        # expert stacks [E, in, out] quantize with per-expert scales
+        from tf_operator_tpu.models import moe_tiny
+
+        model = moe_tiny(vocab_size=VOCAB, max_len=64)
+        prompt = jnp.asarray(
+            np.random.RandomState(3).randint(0, VOCAB, size=(2, 5)), jnp.int32
+        )
+        params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+        qparams = quantize_tree(params, min_size=1)
+
+        def leaf_names(tree):
+            out = set()
+            for p, l in jax.tree_util.tree_leaves_with_path(
+                tree, is_leaf=lambda l: isinstance(l, QTensor)
+            ):
+                if isinstance(l, QTensor):
+                    for entry in reversed(p):
+                        k = getattr(entry, "key", None)
+                        if isinstance(k, str):
+                            out.add(k)
+                            break
+            return out
+
+        assert {"wi", "wo"} <= leaf_names(qparams)
+        out = generate(model, qparams, prompt, max_new_tokens=6)
+        ref = generate(
+            model, materialize_tree(qparams), prompt, max_new_tokens=6
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_moe_expert_scales_are_per_expert(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (4, 64, 32), jnp.float32)
+        w = w * jnp.asarray([1.0, 2.0, 4.0, 8.0])[:, None, None]
+        qt = quantize_array(w, reduce_axes=(1,))
+        assert qt.scale.shape == (4, 1, 32)
+        err = jnp.abs(qt.materialize(jnp.float32) - w)
+        assert float(jnp.max(err / qt.scale)) <= 0.51
+
     def test_quantization_error_small_on_logits(self):
         model, params, prompt = _tiny()
         qparams = quantize_tree(params, min_size=1)

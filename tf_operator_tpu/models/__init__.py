@@ -9,6 +9,7 @@ named mesh in tf_operator_tpu.parallel.
 
 from tf_operator_tpu.models.bert import Bert, BertForPretraining, bert_base, bert_tiny, mlm_loss
 from tf_operator_tpu.models.gpt import CausalLM, gpt_small, gpt_tiny, lm_loss
+from tf_operator_tpu.models.batching import ContinuousBatchingDecoder
 from tf_operator_tpu.models.decode import (
     ChunkedServingDecoder,
     generate,
@@ -26,6 +27,10 @@ from tf_operator_tpu.models.transformer import TransformerConfig
 __all__ = [
     "Bert",
     "BertForPretraining",
+    "ChunkedServingDecoder",
+    "ContinuousBatchingDecoder",
+    "generate",
+    "init_cache",
     "bert_base",
     "bert_tiny",
     "mlm_loss",

@@ -57,6 +57,20 @@ def _decode_variant(model):
     return type(model)(dataclasses.replace(cfg, decode=True, dropout=0.0))
 
 
+def binary_chunks(n: int) -> list:
+    """Binary decomposition of n, largest chunk first — the power-of-2
+    prefill widths shared by ChunkedServingDecoder and the
+    continuous-batching pool (compile count stays logarithmic)."""
+
+    out, bit = [], 1 << n.bit_length()
+    while n:
+        bit >>= 1
+        if n >= bit:
+            out.append(bit)
+            n -= bit
+    return out
+
+
 def _init_cache_for(dmodel, batch_size: int):
     dummy = jnp.zeros((batch_size, 1), jnp.int32)
     shapes = jax.eval_shape(
@@ -231,23 +245,13 @@ class ChunkedServingDecoder:
         self._lock = threading.Lock()
         self.compile_count = 0
 
-    @staticmethod
-    def _binary_chunks(n: int) -> list:
-        """Binary decomposition of n, largest chunk first."""
-
-        out, bit = [], 1 << n.bit_length()
-        while n:
-            bit >>= 1
-            if n >= bit:
-                out.append(bit)
-                n -= bit
-        return out
+    _binary_chunks = staticmethod(binary_chunks)  # back-compat alias
 
     def _chunks(self, n: int) -> list:
         if self._max_chunk is None or n <= self._max_chunk:
-            return self._binary_chunks(n)
+            return binary_chunks(n)
         full, rem = divmod(n, self._max_chunk)
-        return [self._max_chunk] * full + self._binary_chunks(rem)
+        return [self._max_chunk] * full + binary_chunks(rem)
 
     def _prefill_fn(self, width: int):
         with self._lock:

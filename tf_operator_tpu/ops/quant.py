@@ -72,11 +72,18 @@ def _is_q(leaf: Any) -> bool:
     return isinstance(leaf, QTensor)
 
 
-def quantize_array(w: jax.Array) -> QTensor:
-    """Symmetric int8 with one scale per last-axis channel."""
+def quantize_array(w: jax.Array, reduce_axes=None) -> QTensor:
+    """Symmetric int8.  The scale must be constant along the CONTRACTED
+    axes of the consuming dot; by default all axes but the last are
+    reduced (safe for DenseGeneral kernels, whose leading axes are the
+    input side).  Callers with batch-like leading axes (MoE expert
+    stacks) pass the true contraction axes to keep per-expert scales.
+    """
 
+    if reduce_axes is None:
+        reduce_axes = tuple(range(w.ndim - 1))
     w32 = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(w32), axis=tuple(range(w.ndim - 1)), keepdims=True)
+    amax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
     return QTensor(q=q, scale=scale)
@@ -107,6 +114,12 @@ def quantize_tree(
                 name = k
                 break
         eligible = name == "kernel" or (quantize_embed and name == "embedding")
+        # MoE expert stacks (models/moe.py): [expert, in, out] with the
+        # expert axis batch-like — contract only `in` so each expert
+        # keeps its own scales
+        moe_expert = name in ("wi", "wo") and getattr(leaf, "ndim", 0) == 3
+        if moe_expert and leaf.size >= min_size:
+            return quantize_array(leaf, reduce_axes=(1,))
         if eligible and hasattr(leaf, "ndim") and leaf.ndim >= 2 and leaf.size >= min_size:
             return quantize_array(leaf)
         return leaf
