@@ -41,11 +41,18 @@ def _read(out_dir: str, name: str) -> str:
 
 
 def _last_json_line(text: str) -> dict | None:
-    for line in reversed(text.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
+    """Last JSON object in the artifact — single-line (bench.py) or
+    MULTI-LINE (measure.py prints `json.dumps(..., indent=1)`): from
+    the last line opening an object, try parsing through to EOF."""
+
+    lines = text.strip().splitlines()
+    for i in reversed(range(len(lines))):
+        s = lines[i].strip()
+        if not s.startswith("{"):
+            continue
+        for candidate in ("\n".join(lines[i:]), s):
             try:
-                return json.loads(line)
+                return json.loads(candidate)
             except json.JSONDecodeError:
                 continue
     return None
@@ -151,7 +158,9 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
         if b.get("llama_train_tokens_per_sec_per_chip"):
             rows["llama-mini train tokens/sec/chip"] = (
                 "| llama-mini train tokens/sec/chip (~120M, RoPE+GQA "
-                "16q:4kv+SwiGLU, seq 1024, bf16, flash fwd+bwd) | "
+                "16q:4kv+SwiGLU, seq 1024, bf16, auto attention — "
+                "measured crossover routes seq<2048 to XLA-fused, "
+                "flash above) | "
                 f"**{b['llama_train_tokens_per_sec_per_chip']} tok/s/chip**, "
                 f"step {b.get('llama_step_ms', '?')} ms, mfu_analytic "
                 f"{b.get('llama_mfu_analytic', '?')} / mfu_xla "
@@ -184,9 +193,10 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
         )
     bt = data.get("batching")
     if bt:
+        n_new = bt.get("batching_new_tokens", "?")
         rows["Serving under concurrency"] = (
             "| Serving under concurrency (8 staggered requests, "
-            "llama-mini, greedy 96 new tokens each) | continuous-"
+            f"llama-mini, greedy {n_new} new tokens each) | continuous-"
             f"batching pool **{bt['batching_pool_tokens_per_sec']} "
             f"tok/s** vs sequential "
             f"{bt['batching_sequential_tokens_per_sec']} tok/s — "

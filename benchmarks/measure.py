@@ -160,9 +160,23 @@ def bench_startup_latency(n_jobs: int = 8) -> dict:
     }
 
 
+def _apply_platform_override(jax) -> None:
+    """MEASURE_PLATFORM=cpu etc., via jax.config: this box's
+    sitecustomize re-pins JAX_PLATFORMS to the TPU tunnel after process
+    start, so env-level selection is NOT sufficient (same reason
+    bench.py and tests/conftest.py go through jax.config) — without
+    this a CPU smoke run BLOCKS on the tunnel's single-client claim."""
+
+    platform = os.environ.get("MEASURE_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+
 def bench_training() -> dict:
     import jax
     import numpy as np
+
+    _apply_platform_override(jax)
 
     from tf_operator_tpu.models import MnistCNN, bert_base, mlm_loss
     from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
@@ -278,10 +292,15 @@ def bench_batching() -> dict:
     from tf_operator_tpu.models.batching import ContinuousBatchingDecoder
     from tf_operator_tpu.models.decode import ChunkedServingDecoder
 
+    _apply_platform_override(jax)
     out = {"batching_backend": jax.default_backend()}
     seq = int(os.environ.get("MEASURE_BATCHING_MAXLEN", "512"))
     n_req = 8
-    n_new = int(os.environ.get("MEASURE_BATCHING_NEW", "96"))
+    # keep the budget a power of two: ChunkedServingDecoder rounds
+    # budgets UP to the next power of two, so e.g. 96 would make the
+    # sequential baseline run 128 compiled steps while only 96 are
+    # credited — inflating the pool's "speedup" by padding, not merit
+    n_new = int(os.environ.get("MEASURE_BATCHING_NEW", "64"))
     if os.environ.get("MEASURE_BATCHING_TINY"):  # CPU smoke: tiny model
         from tf_operator_tpu.models import llama_tiny
 
@@ -326,6 +345,7 @@ def bench_batching() -> dict:
     sequential_run()
     dt_seq = time.perf_counter() - t0
     total = n_req * n_new
+    out["batching_new_tokens"] = n_new
     out["batching_pool_tokens_per_sec"] = round(total / dt_pool, 1)
     out["batching_sequential_tokens_per_sec"] = round(total / dt_seq, 1)
     out["batching_speedup"] = round(dt_seq / dt_pool, 2)

@@ -135,23 +135,22 @@ def build_handler(model, params, max_len: int, batching_slots: int = 0):
                         return self._reply(400, {
                             "error": "top_k is not supported in "
                                      "--batching mode"})
-                    if pool_fatal:
-                        return self._reply(500, {
-                            "error": f"decode driver died: {pool_fatal[0]}"})
                     rid = pool.submit(
                         ids.astype(np.int32), n_new,
                         temperature=temperature,
                         rng=jax.random.PRNGKey(seed)
                         if temperature > 0.0 else None,
                     )
-                    out_row = pool.result(rid)
-                    while out_row is None:
+                    # condition wait (no lock-churning poll); the
+                    # periodic timeout is only to notice driver death
+                    while True:
+                        out_row = pool.result_wait(rid, timeout=0.5)
+                        if out_row is not None:
+                            break
                         if pool_fatal:
                             return self._reply(500, {
                                 "error": "decode driver died: "
                                          f"{pool_fatal[0]}"})
-                        _time.sleep(0.003)
-                        out_row = pool.result(rid)
                     sample = decode_bytes(out_row[len(ids):])
                     return self._reply(
                         200, {"prompt": text, "sample": sample, "seed": seed}

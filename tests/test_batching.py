@@ -83,6 +83,25 @@ class TestSlotIsolation:
             assert out.shape == (p.size + 4,)
             np.testing.assert_array_equal(out[: p.size], p)
 
+    def test_result_wait_blocks_until_done(self):
+        import threading
+
+        model, params = _tiny()
+        dec = ContinuousBatchingDecoder(model, params, slots=2)
+        p = _prompts(1, [5])[0]
+        rid = dec.submit(p, max_new_tokens=4)
+        assert dec.result_wait(rid, timeout=0.05) is None  # not stepped yet
+        got = {}
+
+        def waiter():
+            got["row"] = dec.result_wait(rid, timeout=120)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        dec.run()
+        t.join(timeout=120)
+        assert got["row"] is not None and got["row"].shape == (9,)
+
     def test_compile_count_constant_in_request_count(self):
         model, params = _tiny()
         dec = ContinuousBatchingDecoder(model, params, slots=2)

@@ -75,6 +75,46 @@ def test_parse_artifacts(artifacts):
     assert data["sweep"][0]["label"] == "bnbf16"
 
 
+def test_multiline_json_artifacts_parse(tmp_path):
+    # measure.py prints json.dumps(..., indent=1): the train/batching
+    # artifacts are MULTI-LINE objects, preceded by log noise — a
+    # single-line-only parser silently drops a whole window step
+    import json
+
+    d = tmp_path / "window_out"
+    d.mkdir()
+    (d / "train.out").write_text(
+        "WARNING: platform noise\n"
+        + json.dumps(json.loads(TRAIN_LINE), indent=1)
+        + "\n"
+    )
+    (d / "batching.out").write_text(
+        "noise\n"
+        + json.dumps(
+            {
+                "batching_new_tokens": 64,
+                "batching_pool_tokens_per_sec": 9000.0,
+                "batching_sequential_tokens_per_sec": 2000.0,
+                "batching_speedup": 4.5,
+            },
+            indent=1,
+        )
+        + "\n"
+    )
+    data = cw.parse_artifacts(str(d))
+    assert data["train"]["mnist_steps_per_sec_per_chip"] == 95.2
+    assert data["batching"]["batching_speedup"] == 4.5
+    rows = cw.build_rows(data, "2026-07-31")
+    assert "Serving under concurrency" in rows
+    # a metric with no pre-authored row APPENDS instead of vanishing
+    p = tmp_path / "BASELINE.md"
+    p.write_text(TABLE)
+    n = cw.rewrite_baseline(rows, str(p))
+    text = p.read_text()
+    assert "Serving under concurrency" in text
+    assert text.index("Serving under concurrency") < text.index("train:end")
+
+
 def test_error_bench_line_is_ignored(tmp_path):
     d = tmp_path / "w"
     d.mkdir()

@@ -22,6 +22,11 @@ a fixed pool of `slots` and one compiled step program:
   pool; prefill reuses the power-of-2 binary-chunk trick from
   `ChunkedServingDecoder` on a batch-1 cache, then the primed rows are
   scattered into the slot stack.
+- **K tokens per host round trip** (``steps_per_sync``): the step
+  program scans K decode steps, so a tunneled chip (host↔device rides
+  the network here) pays one round trip per K tokens instead of per
+  token.  Requests join/retire at K-step granularity — worst case
+  K-1 wasted slot-steps per finished request.
 
 Greedy and per-slot temperature sampling (a ``[slots]`` temperature
 vector; 0 = argmax).  Requests finish by token budget (byte-level
@@ -75,7 +80,7 @@ class ContinuousBatchingDecoder:
     driver thread calls `step`; all pool state is lock-protected.
     """
 
-    def __init__(self, model, params, slots: int = 8):
+    def __init__(self, model, params, slots: int = 8, steps_per_sync: int = 8):
         self.dmodel = _decode_variant(model)
         cfg = self.dmodel.cfg
         w = getattr(cfg, "window", None)
@@ -87,8 +92,17 @@ class ContinuousBatchingDecoder:
             )
         self.params = params
         self.slots = int(slots)
+        #: tokens generated per host round trip.  One device sync per
+        #: TOKEN would put a host↔device round trip (a NETWORK round
+        #: trip on a tunneled chip) on every step's critical path —
+        #: the sequential decoder runs its whole budget in one XLA
+        #: program and would win on latency alone.  K steps per sync
+        #: amortize that; requests join/retire at K-step granularity
+        #: (worst-case waste K-1 steps per finished request).
+        self.steps_per_sync = max(1, int(steps_per_sync))
         self.max_len = cfg.max_len
         self._lock = threading.Lock()
+        self._done_cond = threading.Condition(self._lock)
         self._rid = 0
         self._queue: List[_Request] = []  # submitted, no slot yet
         self._active: Dict[int, _Request] = {}  # slot -> request
@@ -144,6 +158,7 @@ class ContinuousBatchingDecoder:
     def _step(self):
         if self._step_fn is None:
             dmodel = self.dmodel
+            n_inner = self.steps_per_sync
 
             def one_slot(params, cache, tok):
                 # batch-1 apply; under vmap the weights broadcast and
@@ -156,17 +171,29 @@ class ContinuousBatchingDecoder:
                 return vars_["cache"], logits[0, 0]
 
             def step(params, stack, toks, temps, rngs):
-                params = materialize_tree(params)
-                stack, logits = jax.vmap(
-                    one_slot, in_axes=(None, 0, 0)
-                )(params, stack, toks)
-                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                safe_t = jnp.where(temps > 0.0, temps, 1.0)
-                sampled = jax.vmap(
-                    lambda r, l: jax.random.categorical(r, l)
-                )(rngs, logits / safe_t[:, None]).astype(jnp.int32)
-                nxt = jnp.where(temps > 0.0, sampled, greedy)
-                return stack, nxt
+                # K decode steps per host round trip: the whole inner
+                # loop is ONE XLA program, so a tunneled chip pays one
+                # network round trip per K tokens, not per token.
+                # Weights dequantize (quantized trees) INSIDE the scan
+                # body — see ops/quant.py on inflating-op hoisting.
+                def body(carry, _):
+                    stack, toks, rngs = carry
+                    stk, logits = jax.vmap(
+                        one_slot, in_axes=(None, 0, 0)
+                    )(materialize_tree(params), stack, toks)
+                    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    split = jax.vmap(jax.random.split)(rngs)
+                    safe_t = jnp.where(temps > 0.0, temps, 1.0)
+                    sampled = jax.vmap(
+                        lambda r, l: jax.random.categorical(r, l)
+                    )(split[:, 0], logits / safe_t[:, None]).astype(jnp.int32)
+                    nxt = jnp.where(temps > 0.0, sampled, greedy)
+                    return (stk, nxt, split[:, 1]), nxt
+
+                (stack, toks, _), toks_k = lax.scan(
+                    body, (stack, toks, rngs), None, length=n_inner
+                )
+                return stack, toks, toks_k  # toks_k: [K, slots]
 
             self._step_fn = jax.jit(step)
             self.compile_count += 1
@@ -202,9 +229,10 @@ class ContinuousBatchingDecoder:
         with self._lock:
             rid = self._rid
             self._rid += 1
+            # greedy requests never consume rng — storing a key would
+            # create a device array per request inside the pool lock
             req = _Request(
-                rid, prompt, max_new_tokens, float(temperature),
-                rng if rng is not None else jax.random.PRNGKey(0),
+                rid, prompt, max_new_tokens, float(temperature), rng,
             )
             self._queue.append(req)
             self._results[rid] = req
@@ -244,13 +272,15 @@ class ContinuousBatchingDecoder:
             if len(req.tokens) >= req.budget:
                 req.done = True
                 req.slot = None
+                self._done_cond.notify_all()
             else:
                 self._active[slot] = req
 
     def step(self) -> int:
-        """Admit waiting requests, run ONE decode step for every active
-        slot, append sampled tokens, retire finished requests.  Returns
-        the number of still-active slots."""
+        """Admit waiting requests, run `steps_per_sync` decode steps
+        for every active slot (one XLA program, one host round trip),
+        append sampled tokens, retire finished requests.  Returns the
+        number of still-active slots."""
 
         with self._lock:
             self._admit_locked()
@@ -265,22 +295,28 @@ class ContinuousBatchingDecoder:
                 if req.temperature > 0.0:
                     req.rng, r = jax.random.split(req.rng)
                     rngs[slot] = np.asarray(r)
-            self._cache, nxt = self._step()(
+            self._cache, self._last_tok, toks_k = self._step()(
                 self.params,
                 self._cache,
                 self._last_tok,
                 jnp.asarray(temps),
                 jnp.asarray(rngs),
             )
-            self._last_tok = nxt
-            host_next = np.asarray(nxt)
+            host_toks = np.asarray(toks_k)  # [K, slots]
+            finished = False
             for slot in list(self._active):
                 req = self._active[slot]
-                req.tokens.append(int(host_next[slot]))
+                take = min(len(host_toks), req.budget - len(req.tokens))
+                req.tokens.extend(int(t) for t in host_toks[:take, slot])
                 if len(req.tokens) >= req.budget:
+                    # overshoot steps (< K) wrote only this slot's own
+                    # dead cache rows; admission scatters a fresh cache
                     req.done = True
                     req.slot = None
                     del self._active[slot]
+                    finished = True
+            if finished:
+                self._done_cond.notify_all()
             return len(self._active)
 
     def run(self) -> None:
@@ -294,9 +330,29 @@ class ContinuousBatchingDecoder:
             self.step()
 
     def result(self, rid: int):
-        """[P + n] int32 (prompt + generated), or None if not done."""
+        """[P + n] int32 (prompt + generated), or None if not done.
 
-        req = self._results[rid]
-        if not req.done:
-            return None
+        A finished request is EVICTED on first read — a long-running
+        server submits without bound, so retaining every finished
+        request would be a memory leak.  Read once, keep the array."""
+
+        with self._lock:
+            req = self._results[rid]
+            if not req.done:
+                return None
+            del self._results[rid]
+        return np.concatenate([req.prompt, np.asarray(req.tokens, np.int32)])
+
+    def result_wait(self, rid: int, timeout: Optional[float] = None):
+        """Block (condition wait, no polling) until request `rid`
+        finishes; returns the [P + n] int32 row, or None on timeout.
+        Evicts on success like `result`."""
+
+        with self._done_cond:
+            ok = self._done_cond.wait_for(
+                lambda: self._results[rid].done, timeout=timeout
+            )
+            if not ok:
+                return None
+            req = self._results.pop(rid)
         return np.concatenate([req.prompt, np.asarray(req.tokens, np.int32)])

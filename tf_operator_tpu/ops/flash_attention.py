@@ -651,6 +651,15 @@ def _flash_applicable(q, k, bias, mask, block_q, block_k, window=None) -> bool:
         # banded grids need Sq == Sk; the XLA reference's position-based
         # window mask handles the cross-length case — route it there
         return False
+    # measured crossover (benchmarks/window_out/llama-sweep.out, r4):
+    # at train shapes seq 1024 the XLA-fused reference beats the pallas
+    # kernel fwd+bwd (llama-mini mfu 0.285 vs 0.202) — kernel launch +
+    # lse/residual overheads only pay once the quadratic term dominates;
+    # flash's win is long sequences (fwd ~5x at 8k, and it runs 32k
+    # where XLA OOMs).  Below the crossover, auto-dispatch takes XLA.
+    min_seq = int(os.environ.get("TPU_OPERATOR_FLASH_MIN_SEQ", "2048"))
+    if max(q.shape[-2], k.shape[-2]) < min_seq:
+        return False
     # the kernel targets the TPU backend; everything else takes the
     # XLA-fused reference path (the interpreter is for tests)
     return jax.default_backend() == "tpu"
