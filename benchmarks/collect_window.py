@@ -83,6 +83,9 @@ def parse_artifacts(out_dir: str) -> dict:
     batching = _last_json_line(_read(out_dir, "batching.out"))
     if batching and "batching_pool_tokens_per_sec" in batching:
         data["batching"] = batching
+    spec = _last_json_line(_read(out_dir, "speculative.out"))
+    if spec and "speculative_tokens_per_sec" in spec:
+        data["speculative"] = spec
 
     flash = _read(out_dir, "flash.out")
     m = re.search(
@@ -202,6 +205,18 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             f"{bt['batching_sequential_tokens_per_sec']} tok/s — "
             f"**{bt['batching_speedup']}×** (`models/batching.py`) "
             f"| 1× v5 lite, `measure.py --section batching`, {today} |"
+        )
+    sp = data.get("speculative")
+    if sp:
+        rows["Self-speculative decode"] = (
+            "| Self-speculative decode (llama-mini batch 1, int8 draft "
+            "of the same weights, k=4) | "
+            f"**{sp['speculative_tokens_per_sec']} tok/s** vs plain "
+            f"{sp['speculative_plain_tokens_per_sec']} tok/s — "
+            f"**{sp['speculative_speedup']}×**, acceptance "
+            f"{sp.get('speculative_acceptance', '?')} "
+            "(`models/speculative.py`) "
+            f"| 1× v5 lite, `measure.py --section speculative`, {today} |"
         )
     f = data.get("flash_fwd_bwd")
     if f:

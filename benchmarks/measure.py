@@ -352,6 +352,58 @@ def bench_batching() -> dict:
     return out
 
 
+def bench_speculative() -> dict:
+    """Self-speculative decode: target = llama-mini bf16, draft = the
+    SAME weights int8-quantized (no second model to train; the draft's
+    steps read half the HBM bytes and agree with the target almost
+    always).  Plain greedy generate vs SpeculativeDecoder tokens/s at
+    batch 1 — the latency-bound serving case speculation exists for."""
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import llama_mini_config
+    from tf_operator_tpu.models import LlamaLM, SpeculativeDecoder, generate
+    from tf_operator_tpu.ops.quant import quantize_tree
+
+    _apply_platform_override(jax)
+    out = {"speculative_backend": jax.default_backend()}
+    seq = int(os.environ.get("MEASURE_SPEC_MAXLEN", "512"))
+    n_new = int(os.environ.get("MEASURE_SPEC_NEW", "128"))
+    if os.environ.get("MEASURE_SPEC_TINY"):  # CPU smoke
+        from tf_operator_tpu.models import llama_tiny
+
+        model = llama_tiny(vocab_size=256, max_len=seq)
+    else:
+        model = LlamaLM(llama_mini_config(seq))
+    vocab = model.cfg.vocab_size
+    r = np.random.RandomState(0)
+    prompt = jnp.asarray(r.randint(0, vocab, size=(1, 32)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    qparams = quantize_tree(params)
+
+    plain = jax.jit(
+        lambda p, ids: generate(model, p, ids, max_new_tokens=n_new)
+    )
+    np.asarray(plain(params, prompt))  # compile
+    t0 = time.perf_counter()
+    np.asarray(plain(params, prompt))
+    dt_plain = time.perf_counter() - t0
+
+    dec = SpeculativeDecoder(model, params, model, qparams, k=4)
+    dec.generate(prompt, max_new_tokens=n_new)  # compile
+    t0 = time.perf_counter()
+    dec.generate(prompt, max_new_tokens=n_new)
+    dt_spec = time.perf_counter() - t0
+    out["speculative_new_tokens"] = n_new
+    out["speculative_plain_tokens_per_sec"] = round(n_new / dt_plain, 1)
+    out["speculative_tokens_per_sec"] = round(n_new / dt_spec, 1)
+    out["speculative_speedup"] = round(dt_plain / dt_spec, 2)
+    out["speculative_acceptance"] = round(dec.acceptance_rate, 3)
+    return out
+
+
 def write_baseline(out: dict) -> None:
     """Regenerate the control-plane table in BASELINE.md between the
     measured:begin/end markers (VERDICT r2 item 9: the scoreboard must
@@ -403,7 +455,9 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--section",
-        choices=["all", "reconcile", "startup", "train", "batching"],
+        choices=[
+            "all", "reconcile", "startup", "train", "batching", "speculative",
+        ],
         default="all",
     )
     parser.add_argument(
@@ -430,6 +484,8 @@ def main() -> int:
         out.update(bench_training())
     if args.section == "batching":  # not in "all": needs chip minutes
         out.update(bench_batching())
+    if args.section == "speculative":  # not in "all": needs chip minutes
+        out.update(bench_speculative())
     print(json.dumps(out, indent=1))
     return 0
 

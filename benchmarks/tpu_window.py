@@ -83,6 +83,14 @@ STEPS = [
         [sys.executable, os.path.join(HERE, "measure.py"), "--section", "batching"],
         1500,
     ),
+    # self-speculative decode (int8 draft of the same weights) vs plain
+    # greedy, batch 1 (models/speculative.py)
+    (
+        "speculative",
+        [sys.executable, os.path.join(HERE, "measure.py"),
+         "--section", "speculative"],
+        1500,
+    ),
 ]
 
 

@@ -1,0 +1,124 @@
+"""Speculative decoding (models/speculative.py).
+
+The invariant under test: output equals plain greedy `generate` on
+the target for ANY draft — a perfect draft (the target itself), an
+int8-quantized sibling, and an adversarial random draft.  The draft
+only moves speed (acceptance), never content.
+
+Numerics caveat the fixture controls for: verification applies the
+target at width k while plain generate applies width 1 — analytically
+identical, but matmul tiling differs, so an UNTRAINED model's
+near-tied logits can argmax-flip on rounding noise.  The fixture
+therefore trains the tiny target a few steps on a periodic byte
+pattern; with separated logits the equality is robust (and seeded, so
+deterministic).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # generation-loop compiles
+
+from tf_operator_tpu.models import generate, llama_tiny
+from tf_operator_tpu.models.speculative import SpeculativeDecoder
+
+VOCAB = 96
+
+
+_CACHE = {}
+
+
+def _setup(seed=0):
+    model = _CACHE.get("model")
+    if model is None:
+        import optax
+
+        model = llama_tiny(vocab_size=VOCAB, max_len=64)
+        # periodic pattern -> confident (well-separated) logits
+        seq = np.tile(np.arange(12, dtype=np.int32), 6)[None, :64]
+        batch = jnp.asarray(np.repeat(seq, 4, axis=0))
+        params = model.init(jax.random.PRNGKey(1), batch)["params"]
+        opt = optax.sgd(0.5)
+        opt_state = opt.init(params)
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, batch)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], batch[:, 1:]
+            ).mean()
+
+        @jax.jit
+        def step(params, opt_state):
+            upd, opt_state = opt.update(jax.grad(loss_fn)(params), opt_state)
+            return optax.apply_updates(params, upd), opt_state
+
+        for _ in range(8):
+            params, opt_state = step(params, opt_state)
+        _CACHE["model"], _CACHE["params"] = model, params
+    prompt = jnp.asarray(
+        np.random.RandomState(seed).randint(0, VOCAB, size=(2, 5)), jnp.int32
+    )
+    return _CACHE["model"], _CACHE["params"], prompt
+
+
+class TestExactness:
+    def test_perfect_draft_accepts_everything(self):
+        model, params, prompt = _setup()
+        ref = np.asarray(generate(model, params, prompt, max_new_tokens=12))
+        dec = SpeculativeDecoder(model, params, model, params, k=4)
+        out = dec.generate(prompt, max_new_tokens=12)
+        np.testing.assert_array_equal(out, ref)
+        assert dec.acceptance_rate == 1.0
+
+    def test_adversarial_draft_is_still_exact(self):
+        model, params, prompt = _setup()
+        draft_params = model.init(jax.random.PRNGKey(99), prompt)["params"]
+        ref = np.asarray(generate(model, params, prompt, max_new_tokens=12))
+        dec = SpeculativeDecoder(model, params, model, draft_params, k=4)
+        out = dec.generate(prompt, max_new_tokens=12)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_quantized_draft_is_exact_with_high_acceptance(self):
+        from tf_operator_tpu.ops.quant import quantize_tree
+
+        model, params, prompt = _setup()
+        qparams = quantize_tree(params, min_size=1)
+        ref = np.asarray(generate(model, params, prompt, max_new_tokens=10))
+        dec = SpeculativeDecoder(model, params, model, qparams, k=4)
+        out = dec.generate(prompt, max_new_tokens=10)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_budget_is_exact_near_max_len(self):
+        # prompt 5 + 59 new = 64 = max_len: the final rounds degrade to
+        # capped chunks then plain greedy; still exact to the last token
+        model, params, prompt = _setup()
+        ref = np.asarray(generate(model, params, prompt, max_new_tokens=59))
+        dec = SpeculativeDecoder(model, params, model, params, k=4)
+        out = dec.generate(prompt, max_new_tokens=59)
+        np.testing.assert_array_equal(out, ref)
+
+
+class TestValidation:
+    def test_rolling_window_rejected(self):
+        model = llama_tiny(vocab_size=VOCAB, max_len=64, window=8)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        with pytest.raises(NotImplementedError):
+            SpeculativeDecoder(model, params, model, params)
+
+    def test_vocab_mismatch_rejected(self):
+        model, params, prompt = _setup()
+        other = llama_tiny(vocab_size=VOCAB * 2, max_len=64)
+        oparams = other.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+        )["params"]
+        with pytest.raises(ValueError):
+            SpeculativeDecoder(model, params, other, oparams)
+
+    def test_overflow_rejected(self):
+        model, params, prompt = _setup()
+        dec = SpeculativeDecoder(model, params, model, params)
+        with pytest.raises(ValueError):
+            dec.generate(prompt, max_new_tokens=60)  # 5 + 60 > 64

@@ -10,6 +10,7 @@ named mesh in tf_operator_tpu.parallel.
 from tf_operator_tpu.models.bert import Bert, BertForPretraining, bert_base, bert_tiny, mlm_loss
 from tf_operator_tpu.models.gpt import CausalLM, gpt_small, gpt_tiny, lm_loss
 from tf_operator_tpu.models.batching import ContinuousBatchingDecoder
+from tf_operator_tpu.models.speculative import SpeculativeDecoder
 from tf_operator_tpu.models.decode import (
     ChunkedServingDecoder,
     generate,
@@ -29,6 +30,7 @@ __all__ = [
     "BertForPretraining",
     "ChunkedServingDecoder",
     "ContinuousBatchingDecoder",
+    "SpeculativeDecoder",
     "generate",
     "init_cache",
     "bert_base",

@@ -337,7 +337,12 @@ class ContinuousBatchingDecoder:
         request would be a memory leak.  Read once, keep the array."""
 
         with self._lock:
-            req = self._results[rid]
+            req = self._results.get(rid)
+            if req is None:
+                raise KeyError(
+                    f"request {rid} unknown or already collected "
+                    "(results evict on first read)"
+                )
             if not req.done:
                 return None
             del self._results[rid]
@@ -346,13 +351,20 @@ class ContinuousBatchingDecoder:
     def result_wait(self, rid: int, timeout: Optional[float] = None):
         """Block (condition wait, no polling) until request `rid`
         finishes; returns the [P + n] int32 row, or None on timeout.
-        Evicts on success like `result`."""
+        Evicts on success like `result`; a second wait on a collected
+        rid raises KeyError rather than blocking forever."""
 
         with self._done_cond:
             ok = self._done_cond.wait_for(
-                lambda: self._results[rid].done, timeout=timeout
+                lambda: rid not in self._results or self._results[rid].done,
+                timeout=timeout,
             )
             if not ok:
                 return None
-            req = self._results.pop(rid)
+            req = self._results.pop(rid, None)
+            if req is None:
+                raise KeyError(
+                    f"request {rid} unknown or already collected "
+                    "(results evict on first read)"
+                )
         return np.concatenate([req.prompt, np.asarray(req.tokens, np.int32)])

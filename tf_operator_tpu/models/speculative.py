@@ -1,0 +1,277 @@
+"""Greedy speculative decoding: a cheap DRAFT model proposes k tokens,
+the TARGET verifies them in ONE forward — output is provably identical
+to target-only greedy decode, so acceptance only changes SPEED.
+
+Why this fits the TPU: plain decode is weight-bandwidth-bound (one
+[B,1,D] matvec per weight read); verification re-reads the same
+weights once per k positions as a [B,k,D] matmul — the MXU finally has
+rows to chew while HBM traffic stays one weight pass.  With an
+agreeable draft (a distilled/quantized sibling), tokens/step ≈ 1 + m
+for m accepted proposals.
+
+Round structure (exact-greedy; `t1` = target's known next token) —
+the WHOLE round is ONE fused XLA program (`_round`), one host round
+trip each, because on a tunneled chip every device call rides the
+network:
+  1. draft proposes d_2..d_k autoregressively from t1 (lax.scan);
+  2. target applies the chunk [t1, d_2..d_k] through its KV cache
+     (width-k prefill) → greedy g_1..g_k, where g_i is target's choice
+     after the chunk's first i tokens;
+  3. accept the longest prefix with d_{i+1} == g_i (computed ON
+     DEVICE; a batch aligns on the MINIMUM acceptance — still exact
+     per row, see below); emit t1, the accepted d's, and set t1 := the
+     g at the first divergence (target's own correction);
+  4. ROLL BACK both KV caches to the accepted length, also in-graph:
+     decode attention masks strictly by `cache_index` (transformer.py's
+     non-rolling cache branch: `cols <= row_pos`), so stale K/V rows
+     past the index are invisible and rollback is just resetting the
+     index scalars — no recompute.
+
+Batch alignment: acceptance lengths differ per row; cache_index is one
+scalar per layer, so rows align on min(m_r).  Exactness holds: rows
+that agreed further simply re-derive their own next token as the
+"correction" (g_m equals their d_{m+1}).
+
+Rolling-window caches (window < max_len) are rejected — their wrap
+state (cached_pos) is not index-rollbackable.  The reference
+(SURVEY.md §0) has no serving story; this subsystem is
+beyond-reference.  Parity: `tests/test_speculative.py` pins
+speculative == plain greedy for BOTH a perfect draft (the target
+itself) and an adversarial draft (random weights — worst case, still
+exact, just slow).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tf_operator_tpu.models.decode import (
+    _decode_variant,
+    _init_cache_for,
+    binary_chunks,
+)
+from tf_operator_tpu.ops.quant import materialize_tree
+
+
+def _set_cache_index(cache, n):
+    """Reset every layer's cache_index scalar to n (rollback)."""
+
+    def f(path, leaf):
+        name = ""
+        for entry in reversed(path):
+            k = getattr(entry, "key", None)
+            if isinstance(k, str):
+                name = k
+                break
+        if name == "cache_index":
+            return jnp.asarray(n, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(f, cache)
+
+
+class SpeculativeDecoder:
+    """Greedy speculative decode; output == `generate(target, ...)`."""
+
+    def __init__(
+        self, target, tparams, draft, dparams, k: int = 4,
+        rounds_per_call: int = 8,
+    ):
+        self.dtar = _decode_variant(target)
+        self.ddraft = _decode_variant(draft)
+        for m, who in ((self.dtar, "target"), (self.ddraft, "draft")):
+            w = getattr(m.cfg, "window", None)
+            if w is not None and w < m.cfg.max_len:
+                raise NotImplementedError(
+                    f"speculative decode does not support the rolling-"
+                    f"window cache ({who}); wrap state is not "
+                    "index-rollbackable"
+                )
+        if target.cfg.vocab_size != draft.cfg.vocab_size:
+            raise ValueError("target and draft must share a vocabulary")
+        self.tparams = tparams
+        self.dparams = dparams
+        self.k = max(2, int(k))
+        self.rounds_per_call = max(1, int(rounds_per_call))
+        self.max_len = self.dtar.cfg.max_len
+        self._fns = {}
+        self.compile_count = 0
+        #: acceptance telemetry: proposals accepted / proposals made
+        self.proposed = 0
+        self.accepted = 0
+
+    # -- jitted pieces ---------------------------------------------------
+
+    def _jit(self, name, fn):
+        if name not in self._fns:
+            self._fns[name] = jax.jit(fn)
+            self.compile_count += 1
+        return self._fns[name]
+
+    def _prefill(self, model_tag, width):
+        dmodel = self.dtar if model_tag == "t" else self.ddraft
+
+        def prefill(params, cache, ids):
+            logits, vars_ = dmodel.apply(
+                {"params": materialize_tree(params), "cache": cache},
+                ids,
+                mutable=["cache"],
+            )
+            return vars_["cache"], jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+
+        return self._jit(("prefill", model_tag, width), prefill)
+
+    def _round(self, k: int):
+        """ONE XLA program per speculation round: draft-propose scan,
+        width-k target verify, device-side acceptance + cache-index
+        rollback.  A host-driven round would be ~4 device calls; on a
+        tunneled chip every call is a network round trip, so the fused
+        round keeps speculation profitable."""
+
+        dtar, ddraft = self.dtar, self.ddraft
+        n_prop = k - 1
+
+        def rnd(tparams, dparams, tcache, dcache, t1, n):
+            dparams = materialize_tree(dparams)
+
+            def body(carry, _):
+                cache, tok = carry
+                logits, vars_ = ddraft.apply(
+                    {"params": dparams, "cache": cache},
+                    tok[:, None],
+                    mutable=["cache"],
+                )
+                nxt = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+                return (vars_["cache"], nxt), nxt
+
+            (dcache, last), ds = lax.scan(
+                body, (dcache, t1), None, length=n_prop
+            )
+            # write the FINAL proposal's K/V too: under full acceptance
+            # the committed sequence includes it, and rollback must
+            # never mark an unwritten cache row valid
+            _, dvars = ddraft.apply(
+                {"params": dparams, "cache": dcache},
+                last[:, None],
+                mutable=["cache"],
+            )
+            dcache = dvars["cache"]
+            ds = jnp.swapaxes(ds, 0, 1)  # [B, k-1]
+            chunk = jnp.concatenate([t1[:, None], ds], axis=1)  # [B, k]
+            logits, tvars = dtar.apply(
+                {"params": materialize_tree(tparams), "cache": tcache},
+                chunk,
+                mutable=["cache"],
+            )
+            tcache = tvars["cache"]
+            g = jnp.argmax(logits, -1).astype(jnp.int32)  # [B, k]
+            # batch-aligned acceptance length m (min over rows)
+            col_ok = jnp.all(ds == g[:, : k - 1], axis=0)  # [k-1]
+            m = jnp.where(
+                jnp.all(col_ok), k - 1, jnp.argmin(col_ok)
+            ).astype(jnp.int32)
+            n_next = n + 1 + m
+            tcache = _set_cache_index(tcache, n_next)
+            dcache = _set_cache_index(dcache, n_next)
+            t1_next = lax.dynamic_index_in_dim(g, m, axis=1, keepdims=False)
+            return tcache, dcache, t1_next, m, chunk
+
+        return rnd
+
+    def _rounds(self, k: int, r: int):
+        """R rounds scanned into one program: on a tunneled chip the
+        per-call network round trip dominates a single round's compute,
+        so rounds batch until either R rounds ran or the host's room
+        budget (r <= room // k, set by the caller) is spent.  The host
+        slices each round's chunk by its returned m."""
+
+        key = ("rounds", k, r)
+        if key not in self._fns:
+            rnd = self._round(k)
+
+            def many(tparams, dparams, tcache, dcache, t1, n):
+                def body(carry, _):
+                    tcache, dcache, t1, n = carry
+                    tcache, dcache, t1, m, chunk = rnd(
+                        tparams, dparams, tcache, dcache, t1, n
+                    )
+                    return (tcache, dcache, t1, n + 1 + m), (m, chunk)
+
+                (tcache, dcache, t1, n), (ms, chunks) = lax.scan(
+                    body, (tcache, dcache, t1, n), None, length=r
+                )
+                return tcache, dcache, t1, n, ms, chunks
+
+            self._fns[key] = jax.jit(many)
+            self.compile_count += 1
+        return self._fns[key]
+
+    # -- public ----------------------------------------------------------
+
+    def generate(self, prompt_ids, max_new_tokens: int) -> np.ndarray:
+        """[B, P + N] int32, bit-identical to greedy `generate` on the
+        target (same decode-variant code path)."""
+
+        prompt = jnp.asarray(prompt_ids, jnp.int32)
+        b, p = prompt.shape
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if p + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_len={self.max_len}"
+            )
+        tcache = _init_cache_for(self.dtar, b)
+        dcache = _init_cache_for(self.ddraft, b)
+        t1 = None
+        off = 0
+        for width in binary_chunks(p):
+            ids = prompt[:, off : off + width]
+            tcache, t1 = self._prefill("t", width)(self.tparams, tcache, ids)
+            dcache, _ = self._prefill("d", width)(self.dparams, dcache, ids)
+            off += width
+        n = p  # committed sequence length in both caches
+        emitted = []  # list of [B] np arrays
+        while len(emitted) < max_new_tokens:
+            # cap the chunk so the verify never writes past max_len
+            room = self.max_len - n
+            k = min(self.k, room)
+            if k < 2:  # no space to speculate: plain greedy steps
+                tcache, t1_next = self._prefill("t", 1)(
+                    self.tparams, tcache, t1[:, None]
+                )
+                emitted.append(np.asarray(t1))
+                n += 1
+                t1 = t1_next
+                continue
+            # R rounds per device call; power-of-2 bucket bounds the
+            # compile count.  r <= room // k guarantees no cache
+            # overrun even under full acceptance (each round commits
+            # at most k tokens).
+            remaining = max_new_tokens - len(emitted)
+            r = max(1, min(self.rounds_per_call, room // k, remaining))
+            r = 1 << (r.bit_length() - 1)
+            tcache, dcache, t1, n_dev, ms, chunks = self._rounds(k, r)(
+                self.tparams, self.dparams, tcache, dcache, t1,
+                jnp.asarray(n, jnp.int32),
+            )
+            ms_h = np.asarray(ms)
+            chunks_h = np.asarray(chunks)  # [r, B, k]
+            for rr in range(r):
+                m = int(ms_h[rr])
+                self.proposed += (k - 1) * b
+                self.accepted += m * b
+                for i in range(1 + m):  # t1 then the accepted proposals
+                    emitted.append(chunks_h[rr][:, i])
+            n = int(n_dev)
+        toks = np.stack(emitted[:max_new_tokens], axis=1)
+        return np.concatenate([np.asarray(prompt), toks], axis=1)
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0

@@ -641,8 +641,13 @@ def _mesh_flash_applicable(mesh: Optional[Mesh], q, k) -> Optional[str]:
 
 
 def _flash_applicable(q, k, bias, mask, block_q, block_k, window=None) -> bool:
-    if os.environ.get("TPU_OPERATOR_FLASH", "1") == "0":
+    raw = os.environ.get("TPU_OPERATOR_FLASH")
+    if raw == "0":
         return False
+    # EXPLICIT "1" forces the kernel (bypasses the seq crossover below)
+    # — the sweeps set it to measure flash AT the crossover shapes;
+    # unset means auto-dispatch
+    forced = raw == "1"
     if bias is not None or mask is not None:
         return False
     if q.shape[-2] % block_q or k.shape[-2] % block_k or q.shape[1] % k.shape[1]:
@@ -658,7 +663,7 @@ def _flash_applicable(q, k, bias, mask, block_q, block_k, window=None) -> bool:
     # flash's win is long sequences (fwd ~5x at 8k, and it runs 32k
     # where XLA OOMs).  Below the crossover, auto-dispatch takes XLA.
     min_seq = int(os.environ.get("TPU_OPERATOR_FLASH_MIN_SEQ", "2048"))
-    if max(q.shape[-2], k.shape[-2]) < min_seq:
+    if not forced and max(q.shape[-2], k.shape[-2]) < min_seq:
         return False
     # the kernel targets the TPU backend; everything else takes the
     # XLA-fused reference path (the interpreter is for tests)
