@@ -274,7 +274,10 @@ def write_results(data: dict, today: str) -> None:
         fh.write("Raw parsed artifacts from the last completed window\n"
                  "(`benchmarks/window_out/`), collected by "
                  "`collect_window.py`.\n\n")
-        for key in ("bench", "train", "flash_fwd_bwd", "window_fwd_bwd"):
+        for key in (
+            "bench", "train", "batching", "speculative",
+            "flash_fwd_bwd", "window_fwd_bwd",
+        ):
             if key in data:
                 fh.write(f"## {key}\n\n```json\n"
                          + json.dumps(data[key], indent=1) + "\n```\n\n")

@@ -9,8 +9,9 @@ Measures, on this box:
      backend (the real chip when present; bench.py owns ResNet-50).
 
 Usage: python benchmarks/measure.py
-           [--section all|reconcile|startup|train|batching]
-(batching is chip-minutes heavy and runs only when named explicitly)
+           [--section all|reconcile|startup|train|batching|speculative]
+(batching and speculative are chip-minutes heavy and run only when
+named explicitly)
 Prints one JSON object; paste results into BASELINE.md.
 """
 

@@ -31,6 +31,10 @@ PROBE = (
     "print('probe ok', float((x@x).sum()))"
 )
 
+# Priority order is RECOVERY order: the tunnel has died mid-window
+# twice (rounds 3 and 4), so the steps whose numbers have never landed
+# run before the long sweeps — a window that dies early still
+# contributes fresh rows.  bench stays first (the driver's headline).
 STEPS = [
     ("probe", [sys.executable, "-c", PROBE], 120),
     ("bench", [sys.executable, os.path.join(REPO, "bench.py")], 3600),
@@ -38,28 +42,9 @@ STEPS = [
     # (compile-only, cheap — see benchmarks/FLOPS.md)
     ("flops", [sys.executable, os.path.join(HERE, "flops_audit.py")], 600),
     (
-        "sweep",
-        [sys.executable, os.path.join(HERE, "mfu_sweep.py"), "--timeout", "600"],
-        4200,
-    ),
-    # the transformer co-headline's variant matrix (flash-vs-XLA at
-    # train shapes, remat, banded windows at long seq, and the flash
-    # block-size autotune candidates).  Step budget must exceed
-    # worst-case inner time: 12 variants x 480s child timeout = 5760s
-    # < 6000s, so a contended chip can't kill the sweep mid-matrix
-    (
-        "llama-sweep",
-        [sys.executable, os.path.join(HERE, "llama_sweep.py"), "--timeout", "480"],
-        6000,
-    ),
-    (
-        "trace",
-        [
-            sys.executable, os.path.join(HERE, "profile_resnet.py"),
-            "--variant", "baseline", "--batch", "256", "--steps", "5",
-            "--trace", "/tmp/rn50-xplane",
-        ],
-        900,
+        "train",
+        [sys.executable, os.path.join(HERE, "measure.py"), "--section", "train"],
+        1800,
     ),
     (
         "flash",
@@ -70,11 +55,6 @@ STEPS = [
             "-q", "-s",
         ],
         900,
-    ),
-    (
-        "train",
-        [sys.executable, os.path.join(HERE, "measure.py"), "--section", "train"],
-        1800,
     ),
     # serving under concurrency: continuous-batching pool vs sequential
     # (models/batching.py); parsed into BASELINE.md by collect_window
@@ -90,6 +70,30 @@ STEPS = [
         [sys.executable, os.path.join(HERE, "measure.py"),
          "--section", "speculative"],
         1500,
+    ),
+    (
+        "trace",
+        [
+            sys.executable, os.path.join(HERE, "profile_resnet.py"),
+            "--variant", "baseline", "--batch", "256", "--steps", "5",
+            "--trace", "/tmp/rn50-xplane",
+        ],
+        900,
+    ),
+    (
+        "sweep",
+        [sys.executable, os.path.join(HERE, "mfu_sweep.py"), "--timeout", "600"],
+        4200,
+    ),
+    # the transformer co-headline's variant matrix (flash-vs-XLA at
+    # train shapes, remat, banded windows at long seq, and the flash
+    # block-size autotune candidates).  Step budget must exceed
+    # worst-case inner time: 12 variants x 480s child timeout = 5760s
+    # < 6000s, so a contended chip can't kill the sweep mid-matrix
+    (
+        "llama-sweep",
+        [sys.executable, os.path.join(HERE, "llama_sweep.py"), "--timeout", "480"],
+        6000,
     ),
 ]
 

@@ -13,7 +13,11 @@ XLA program per shape) do the actual work.
 Serving modes: `--batching SLOTS` multiplexes concurrent requests
 through the continuous-batching pool (models/batching.py — one decode
 loop, step-granular joins); `--quantize int8` halves HBM weight
-traffic per decoded token (ops/quant.py).  The two compose.
+traffic per decoded token (ops/quant.py); `--speculative` serves
+greedy requests through the int8 self-draft speculative decoder
+(models/speculative.py — batch-1 latency mode).  `--quantize`
+composes with either; `--batching` and `--speculative` are mutually
+exclusive (throughput vs latency optimizations).
 
 The jit-compile cache is bounded BY DESIGN (VERDICT r3 weak #5/next #9):
 prompts prefill through the KV cache in power-of-2 chunks (binary
@@ -39,12 +43,18 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_handler(model, params, max_len: int, batching_slots: int = 0):
+def build_handler(
+    model, params, max_len: int, batching_slots: int = 0,
+    speculative: bool = False,
+):
     """batching_slots > 0 serves through the continuous-batching pool
     (models/batching.py): concurrent requests share one decode loop,
     joining at step granularity, driven by a single background thread.
     top_k is not yet supported there (the pool samples per-slot
     greedy/temperature) and returns 400 rather than silently differing.
+    speculative=True serves GREEDY requests through the int8 self-draft
+    SpeculativeDecoder (batch-1 latency mode; temperature/top_k
+    requests fall back to the chunked decoder).
     """
 
     import threading
@@ -58,7 +68,27 @@ def build_handler(model, params, max_len: int, batching_slots: int = 0):
     from tf_operator_tpu.models.batching import ContinuousBatchingDecoder
     from tf_operator_tpu.models.decode import ChunkedServingDecoder
 
-    if batching_slots > 0:
+    if speculative:
+        if batching_slots > 0:
+            raise ValueError(
+                "--speculative and --batching are mutually exclusive: "
+                "speculation is a batch-1 latency optimization, the pool "
+                "is a throughput one"
+            )
+        from tf_operator_tpu.models.speculative import SpeculativeDecoder
+        from tf_operator_tpu.ops.quant import is_quantized, quantize_tree
+
+        # self-speculation: the draft is the SAME weights int8-quantized
+        # (half the HBM bytes per draft step, near-total agreement).
+        # If serving already quantized (--quantize int8), target and
+        # draft share the int8 tree — still exact, just less speedup.
+        dparams = params if is_quantized(params) else quantize_tree(params)
+        spec = SpeculativeDecoder(model, params, model, dparams, k=4)
+        spec_lock = threading.Lock()  # generate mutates decoder telemetry
+        pool = None
+        pool_fatal = []
+        decoder = ChunkedServingDecoder(model, params)  # sampling fallback
+    elif batching_slots > 0:
         pool = ContinuousBatchingDecoder(model, params, slots=batching_slots)
         pool_fatal = []  # driver-thread death must surface as 500s
 
@@ -72,8 +102,10 @@ def build_handler(model, params, max_len: int, batching_slots: int = 0):
                     return
 
         threading.Thread(target=_drive, daemon=True).start()
+        spec = None
     else:
         pool = None
+        spec = None
         pool_fatal = []
         decoder = ChunkedServingDecoder(model, params)
 
@@ -156,6 +188,13 @@ def build_handler(model, params, max_len: int, batching_slots: int = 0):
                         200, {"prompt": text, "sample": sample, "seed": seed}
                     )
                 prompt = jnp.asarray(ids, jnp.int32)[None]
+                if spec is not None and temperature == 0.0 and top_k is None:
+                    with spec_lock:
+                        out = spec.generate(prompt, n_new)
+                    sample = decode_bytes(np.asarray(out[0, prompt.shape[1]:]))
+                    return self._reply(
+                        200, {"prompt": text, "sample": sample, "seed": seed}
+                    )
                 out = decoder.generate(
                     prompt, n_new, temperature=temperature, top_k=top_k,
                     rng=jax.random.PRNGKey(seed),
@@ -181,6 +220,13 @@ def main() -> int:
         "--platform", default=None,
         help="force a jax platform (e.g. cpu) — goes through jax.config, "
              "which beats env-level pins like this box's sitecustomize",
+    )
+    ap.add_argument(
+        "--speculative", action="store_true",
+        help="serve greedy requests through the int8 self-draft "
+             "speculative decoder (batch-1 latency mode; sampling "
+             "requests fall back to the chunked decoder); mutually "
+             "exclusive with --batching",
     )
     ap.add_argument(
         "--batching", type=int, default=0, metavar="SLOTS",
@@ -240,7 +286,10 @@ def main() -> int:
         )
     server = ThreadingHTTPServer(
         ("127.0.0.1", args.port),
-        build_handler(model, params, max_len, batching_slots=args.batching),
+        build_handler(
+            model, params, max_len,
+            batching_slots=args.batching, speculative=args.speculative,
+        ),
     )
     print(f"serving on 127.0.0.1:{args.port} (artifact: {args.artifact})", flush=True)
     server.serve_forever()

@@ -100,6 +100,71 @@ class TestExactness:
         np.testing.assert_array_equal(out, ref)
 
 
+class TestServeLmSpeculativeMode:
+    def test_greedy_via_spec_sampling_falls_back(self):
+        import importlib.util
+        import json
+        import os
+        import threading
+        import urllib.request
+        from http.server import ThreadingHTTPServer
+
+        spec_mod = importlib.util.spec_from_file_location(
+            "serve_lm",
+            os.path.join(
+                os.path.dirname(__file__), "..", "examples", "serve_lm.py"
+            ),
+        )
+        serve_lm = importlib.util.module_from_spec(spec_mod)
+        spec_mod.loader.exec_module(serve_lm)
+
+        model = llama_tiny(vocab_size=256, max_len=64)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        handler = serve_lm.build_handler(
+            model, params, max_len=64, speculative=True
+        )
+        server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            for payload in (
+                {"prompt": "abc", "max_new_tokens": 6},  # greedy -> spec
+                {"prompt": "abc", "max_new_tokens": 6,
+                 "temperature": 0.8},  # sampling -> chunked fallback
+            ):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/generate",
+                    data=json.dumps(payload).encode(),
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=300) as resp:
+                    out = json.loads(resp.read())
+                assert len(out["sample"]) == 6
+        finally:
+            server.shutdown()
+
+    def test_batching_and_speculative_mutually_exclusive(self):
+        import importlib.util
+        import os
+
+        spec_mod = importlib.util.spec_from_file_location(
+            "serve_lm",
+            os.path.join(
+                os.path.dirname(__file__), "..", "examples", "serve_lm.py"
+            ),
+        )
+        serve_lm = importlib.util.module_from_spec(spec_mod)
+        spec_mod.loader.exec_module(serve_lm)
+        model = llama_tiny(vocab_size=256, max_len=64)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        with pytest.raises(ValueError):
+            serve_lm.build_handler(
+                model, params, max_len=64, batching_slots=2, speculative=True
+            )
+
+
 class TestValidation:
     def test_rolling_window_rejected(self):
         model = llama_tiny(vocab_size=VOCAB, max_len=64, window=8)

@@ -134,10 +134,14 @@ class TestTrainerOnChip:
         m = trainer.train_step(trainer.shard_batch(batch))
         assert np.isfinite(float(m["loss"]))
 
-    def test_one_gpt_step_with_flash(self, tpu):
+    def test_one_gpt_step_with_flash(self, tpu, monkeypatch):
         from tf_operator_tpu.models import gpt_tiny, lm_loss
         from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
 
+        # seq 256 sits below the auto-dispatch crossover
+        # (TPU_OPERATOR_FLASH_MIN_SEQ): force the kernel so this chip
+        # gate actually exercises the flash path it is named for
+        monkeypatch.setenv("TPU_OPERATOR_FLASH", "1")
         mesh = make_mesh({"dp": 1}, devices=[tpu])
         ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, size=(2, 256)))
         trainer = Trainer(

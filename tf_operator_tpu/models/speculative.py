@@ -190,26 +190,22 @@ class SpeculativeDecoder:
         budget (r <= room // k, set by the caller) is spent.  The host
         slices each round's chunk by its returned m."""
 
-        key = ("rounds", k, r)
-        if key not in self._fns:
-            rnd = self._round(k)
+        rnd = self._round(k)
 
-            def many(tparams, dparams, tcache, dcache, t1, n):
-                def body(carry, _):
-                    tcache, dcache, t1, n = carry
-                    tcache, dcache, t1, m, chunk = rnd(
-                        tparams, dparams, tcache, dcache, t1, n
-                    )
-                    return (tcache, dcache, t1, n + 1 + m), (m, chunk)
-
-                (tcache, dcache, t1, n), (ms, chunks) = lax.scan(
-                    body, (tcache, dcache, t1, n), None, length=r
+        def many(tparams, dparams, tcache, dcache, t1, n):
+            def body(carry, _):
+                tcache, dcache, t1, n = carry
+                tcache, dcache, t1, m, chunk = rnd(
+                    tparams, dparams, tcache, dcache, t1, n
                 )
-                return tcache, dcache, t1, n, ms, chunks
+                return (tcache, dcache, t1, n + 1 + m), (m, chunk)
 
-            self._fns[key] = jax.jit(many)
-            self.compile_count += 1
-        return self._fns[key]
+            (tcache, dcache, t1, n), (ms, chunks) = lax.scan(
+                body, (tcache, dcache, t1, n), None, length=r
+            )
+            return tcache, dcache, t1, n, ms, chunks
+
+        return self._jit(("rounds", k, r), many)
 
     # -- public ----------------------------------------------------------
 

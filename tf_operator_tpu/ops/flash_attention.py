@@ -644,10 +644,13 @@ def _flash_applicable(q, k, bias, mask, block_q, block_k, window=None) -> bool:
     raw = os.environ.get("TPU_OPERATOR_FLASH")
     if raw == "0":
         return False
-    # EXPLICIT "1" forces the kernel (bypasses the seq crossover below)
-    # — the sweeps set it to measure flash AT the crossover shapes;
-    # unset means auto-dispatch
-    forced = raw == "1"
+    # ANY explicit non-"0" value forces the kernel (bypasses the seq
+    # crossover below) — the sweeps set "1" to measure flash AT the
+    # crossover shapes; unset means auto-dispatch.  Matches
+    # resolve_use_flash's enabled/disabled reading of the same var (the
+    # sp schedules have no crossover: their per-shard applicability
+    # rules differ).
+    forced = raw is not None
     if bias is not None or mask is not None:
         return False
     if q.shape[-2] % block_q or k.shape[-2] % block_k or q.shape[1] % k.shape[1]:
