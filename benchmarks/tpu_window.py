@@ -4,14 +4,19 @@ timeout so one hang can't burn the window.
 
     python benchmarks/tpu_window.py [--log benchmarks/tpu_window.log]
 
-Steps (priority order; later steps only run if earlier ones prove the
-chip is answering):
-  1. probe      — 512x512 matmul (is the tunnel back at all?)
-  2. bench      — bench.py headline (incl. the live input pipeline)
-  3. sweep      — the MFU variant x flag matrix (mfu_sweep.py)
-  4. trace      — xplane trace of the hot step + top-op summary
-  5. flash      — the fwd+bwd flash-vs-XLA perf gate (records ratio)
-  6. train      — measure.py --section train (mnist/BERT rows)
+Steps (recovery order — the tunnel has died mid-window twice, so
+never-landed numbers run before the long sweeps; later steps only run
+if earlier ones prove the chip is answering):
+  1. probe        — 512x512 matmul (is the tunnel back at all?)
+  2. bench        — bench.py headline (incl. live pipeline, llama, int8)
+  3. flops        — on-TPU lowering check of the FLOPS.md accounting
+  4. train        — measure.py --section train (mnist/BERT rows)
+  5. flash        — the fwd+bwd flash-vs-XLA perf gates (record ratios)
+  6. batching     — continuous-batching pool vs sequential serving
+  7. speculative  — int8 self-draft speculation vs plain greedy
+  8. trace        — xplane trace of the hot step + top-op summary
+  9. sweep        — the ResNet MFU variant x flag matrix
+ 10. llama-sweep  — the transformer variant/autotune matrix
 """
 
 from __future__ import annotations

@@ -194,22 +194,14 @@ class TestValidationAndQuant:
 
 class TestServeLmBatchingMode:
     def test_concurrent_http_requests_share_the_pool(self):
-        import importlib.util
         import json
-        import os
         import threading
         import urllib.request
         from http.server import ThreadingHTTPServer
 
-        spec = importlib.util.spec_from_file_location(
-            "serve_lm",
-            os.path.join(
-                os.path.dirname(__file__), "..", "examples", "serve_lm.py"
-            ),
-        )
-        serve_lm = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(serve_lm)
+        from tests.testutil import load_serve_lm
 
+        serve_lm = load_serve_lm()
         model = llama_tiny(vocab_size=256, max_len=64)
         prompt = jnp.zeros((1, 4), jnp.int32)
         params = model.init(jax.random.PRNGKey(0), prompt)["params"]

@@ -645,15 +645,9 @@ def test_serve_lm_end_to_end(tmp_path):
     art = str(tmp_path / "artifact")
     export_params(tr, art)
 
-    import importlib.util
-    import os
+    from tests.testutil import load_serve_lm
 
-    spec = importlib.util.spec_from_file_location(
-        "serve_lm", os.path.join(os.path.dirname(__file__), "..", "examples", "serve_lm.py")
-    )
-    serve_lm = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(serve_lm)
-
+    serve_lm = load_serve_lm()
     model = llama_tiny(vocab_size=256, max_len=64)
     handler = serve_lm.build_handler(model, load_params(art), max_len=64)
     server = ThreadingHTTPServer(("127.0.0.1", 0), handler)

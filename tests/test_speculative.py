@@ -102,22 +102,14 @@ class TestExactness:
 
 class TestServeLmSpeculativeMode:
     def test_greedy_via_spec_sampling_falls_back(self):
-        import importlib.util
         import json
-        import os
         import threading
         import urllib.request
         from http.server import ThreadingHTTPServer
 
-        spec_mod = importlib.util.spec_from_file_location(
-            "serve_lm",
-            os.path.join(
-                os.path.dirname(__file__), "..", "examples", "serve_lm.py"
-            ),
-        )
-        serve_lm = importlib.util.module_from_spec(spec_mod)
-        spec_mod.loader.exec_module(serve_lm)
+        from tests.testutil import load_serve_lm
 
+        serve_lm = load_serve_lm()
         model = llama_tiny(vocab_size=256, max_len=64)
         prompt = jnp.zeros((1, 4), jnp.int32)
         params = model.init(jax.random.PRNGKey(0), prompt)["params"]
@@ -145,17 +137,9 @@ class TestServeLmSpeculativeMode:
             server.shutdown()
 
     def test_batching_and_speculative_mutually_exclusive(self):
-        import importlib.util
-        import os
+        from tests.testutil import load_serve_lm
 
-        spec_mod = importlib.util.spec_from_file_location(
-            "serve_lm",
-            os.path.join(
-                os.path.dirname(__file__), "..", "examples", "serve_lm.py"
-            ),
-        )
-        serve_lm = importlib.util.module_from_spec(spec_mod)
-        spec_mod.loader.exec_module(serve_lm)
+        serve_lm = load_serve_lm()
         model = llama_tiny(vocab_size=256, max_len=64)
         prompt = jnp.zeros((1, 4), jnp.int32)
         params = model.init(jax.random.PRNGKey(0), prompt)["params"]

@@ -84,3 +84,21 @@ def run_and_succeed_all(backend: FakeCluster, namespace: str = "default") -> Non
     for pod in list(backend._pods.values()):
         if pod.metadata.namespace == namespace:
             backend.succeed_pod(namespace, pod.metadata.name)
+
+
+def load_serve_lm():
+    """Import examples/serve_lm.py as a module (it is a script, not a
+    package member) — ONE loader for every serving test."""
+
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_lm",
+        os.path.join(
+            os.path.dirname(__file__), "..", "examples", "serve_lm.py"
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

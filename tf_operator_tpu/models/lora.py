@@ -1,0 +1,159 @@
+"""LoRA fine-tuning: low-rank adapters over frozen base weights.
+
+TPU-first shape: adapters merge into the base kernels INSIDE the
+jitted step — ``W_eff = W + (alpha/r)·(A@B)`` — so the model's hot
+matmuls stay exactly the dense MXU ops they were (no extra per-token
+matmul chain, no dynamic control flow).  The merge costs I·r·O flops
+per kernel per step: at rank 8 that is ~r/tokens of the main matmul's
+cost — noise.  Gradients flow only to A/B because only they are
+trainable arguments; the base tree rides the jaxpr as constants.
+
+The integration is a WRAPPER, not Trainer surgery:
+
+    lora = LoraModel(model, base_params, rank=8)
+    trainer = Trainer(lora, cfg, mesh, loss, batch, init_args=...,
+                      shardings="fsdp")
+
+`LoraModel.init` returns ONLY the adapter tree as "params", so the
+Trainer's optimizer state, checkpoints, and donation all scope to the
+adapters — an adapter checkpoint is a few hundred KB for a model whose
+base is GBs (the classic LoRA deployment story).  `merge_lora` bakes
+trained adapters back into a full tree for export/serving (the merged
+tree serves through every existing path: generate, the batching pool,
+int8 quantization, speculative decode).
+
+Selection mirrors ops/quant.py: leaves named ``kernel`` with >= 2 dims
+and >= ``min_size`` elements (all-but-last axes are the input side).
+The reference (SURVEY.md §0) has no fine-tuning story — this is a
+beyond-reference capability.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_MIN_SIZE = 4096
+
+
+def _leaf_name(path) -> str:
+    for entry in reversed(path):
+        k = getattr(entry, "key", None)
+        if isinstance(k, str):
+            return k
+    return ""
+
+
+def _path_key(path) -> str:
+    """Stable string key for a param path ('.value' boxes skipped)."""
+
+    parts = []
+    for entry in path:
+        k = getattr(entry, "key", None)
+        if isinstance(k, str):
+            parts.append(k)
+    return "/".join(parts)
+
+
+def lora_init(
+    base_params,
+    rng,
+    rank: int = 8,
+    *,
+    min_size: int = DEFAULT_MIN_SIZE,
+) -> Dict[str, Dict[str, Any]]:
+    """Adapter tree {path_key: {"a": [I,r], "b": [r,O]}} for every
+    selected kernel.  A ~ N(0, 0.02), B = 0 — the delta starts at
+    exactly zero, so step 0 reproduces the base model bit-for-bit."""
+
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    adapters: Dict[str, Dict[str, Any]] = {}
+    leaves = jax.tree_util.tree_leaves_with_path(base_params)
+    keys = jax.random.split(rng, max(1, len(leaves)))
+    for (path, leaf), key in zip(leaves, keys):
+        if (
+            _leaf_name(path) == "kernel"
+            and getattr(leaf, "ndim", 0) >= 2
+            and leaf.size >= min_size
+        ):
+            shape = leaf.shape
+            i = 1
+            for d in shape[:-1]:
+                i *= d
+            o = shape[-1]
+            adapters[_path_key(path)] = {
+                "a": (jax.random.normal(key, (i, rank), jnp.float32) * 0.02),
+                "b": jnp.zeros((rank, o), jnp.float32),
+            }
+    if not adapters:
+        raise ValueError(
+            "no kernels selected for LoRA — check min_size vs the "
+            "model's layer sizes"
+        )
+    return adapters
+
+
+def merge_lora(base_params, adapters, *, alpha: float = 16.0):
+    """Base tree with ``W + (alpha/r)·(A@B)`` at adapted kernels.
+    Call INSIDE jit (LoraModel.apply does) — XLA schedules the tiny
+    rank-r matmuls alongside everything else."""
+
+    def f(path, leaf):
+        ab = adapters.get(_path_key(path))
+        if ab is None:
+            return leaf
+        rank = ab["a"].shape[-1]
+        delta = (ab["a"] @ ab["b"]).reshape(leaf.shape) * (alpha / rank)
+        return (leaf.astype(jnp.float32) + delta).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(f, base_params)
+
+
+class LoraModel:
+    """Duck-typed flax-module stand-in whose "params" are the adapters.
+
+    Works anywhere the Trainer expects a model: ``init`` returns the
+    adapter tree, ``apply`` merges and delegates.  The base tree is
+    captured — under jit it becomes constants, never traced arguments,
+    so the optimizer/donation/checkpoint surface is adapters-only.
+    """
+
+    def __init__(
+        self,
+        model,
+        base_params,
+        rank: int = 8,
+        alpha: float = 16.0,
+        min_size: int = DEFAULT_MIN_SIZE,
+    ):
+        self.model = model
+        self.base_params = base_params
+        self.rank = rank
+        self.alpha = alpha
+        self.min_size = min_size
+        # the wrapped family's config rides along (decode/export paths
+        # read model.cfg)
+        self.cfg = getattr(model, "cfg", None)
+
+    def init(self, rng, *args, **kwargs):
+        return {
+            "params": lora_init(
+                self.base_params, rng, self.rank, min_size=self.min_size
+            )
+        }
+
+    def apply(self, variables, *args, **kwargs):
+        merged = merge_lora(
+            self.base_params, variables["params"], alpha=self.alpha
+        )
+        rest = {k: v for k, v in variables.items() if k != "params"}
+        return self.model.apply({"params": merged, **rest}, *args, **kwargs)
+
+    def merged_params(self, adapters):
+        """Full params with the trained adapters baked in — feed to
+        export_params / generate / quantize_tree / serving."""
+
+        return merge_lora(self.base_params, adapters, alpha=self.alpha)

@@ -650,7 +650,7 @@ def _flash_applicable(q, k, bias, mask, block_q, block_k, window=None) -> bool:
     # resolve_use_flash's enabled/disabled reading of the same var (the
     # sp schedules have no crossover: their per-shard applicability
     # rules differ).
-    forced = raw is not None
+    forced = bool(raw)  # "" (cleared var) reads as unset/auto
     if bias is not None or mask is not None:
         return False
     if q.shape[-2] % block_q or k.shape[-2] % block_k or q.shape[1] % k.shape[1]:

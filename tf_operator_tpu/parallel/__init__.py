@@ -31,6 +31,7 @@ from tf_operator_tpu.parallel.mesh import (
 )
 from tf_operator_tpu.parallel.checkpoint import (
     TrainerCheckpointer,
+    export_merged_params,
     export_params,
     load_model_description,
     load_params,
@@ -65,6 +66,7 @@ __all__ = [
     "Trainer",
     "TrainerCheckpointer",
     "TrainerConfig",
+    "export_merged_params",
     "export_params",
     "load_model_description",
     "load_params",

@@ -140,6 +140,24 @@ def export_params(trainer, directory: str) -> None:
     serving side reconstructs the exact architecture instead of being
     hand-configured (examples/serve_lm.py reads it)."""
 
+    model, params = trainer.model, trainer.state.params
+    if hasattr(model, "merged_params") and hasattr(model, "model"):
+        # LoRA trainer: state.params is the ADAPTER tree — exporting it
+        # raw under the base family's model.json would be a silently
+        # broken artifact.  Bake the adapters in; the artifact serves
+        # like any dense export.
+        params = model.merged_params(params)
+        model = model.model
+    export_merged_params(model, params, directory)
+
+
+def export_merged_params(model, params, directory: str) -> None:
+    """Artifact from an explicit (model, params) pair — the export core
+    `export_params` delegates to.  Use directly for trees that never
+    lived in a Trainer state: LoRA-merged weights
+    (models/lora.LoraModel.merged_params), surgically edited params,
+    etc.  Same self-describing model.json contract."""
+
     import json
     import os
 
@@ -148,14 +166,16 @@ def export_params(trainer, directory: str) -> None:
 
     from tf_operator_tpu.models.registry import describe_model
 
-    params = meta.unbox(trainer.state.params)
+    params = meta.unbox(params)
     ckptr = ocp.StandardCheckpointer()
     # force: re-exporting to a stable serving path ("latest/") must
     # overwrite, not raise
     ckptr.save(directory, params, force=True)
     ckptr.wait_until_finished()
     ckptr.close()
-    desc = describe_model(trainer.model)
+    # a LoraModel wrapper describes as its WRAPPED family (the merged
+    # tree is plain dense weights)
+    desc = describe_model(getattr(model, "model", model))
     if desc is not None:
         # process 0 writes on multi-host (the path is shared storage)
         if jax.process_index() == 0:
