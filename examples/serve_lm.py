@@ -49,12 +49,12 @@ def build_handler(
 ):
     """batching_slots > 0 serves through the continuous-batching pool
     (models/batching.py): concurrent requests share one decode loop,
-    joining at step granularity, driven by a single background thread.
-    top_k is not yet supported there (the pool samples per-slot
-    greedy/temperature) and returns 400 rather than silently differing.
-    speculative=True serves GREEDY requests through the int8 self-draft
-    SpeculativeDecoder (batch-1 latency mode; temperature/top_k
-    requests fall back to the chunked decoder).
+    joining at step granularity, driven by a single background thread;
+    per-slot temperature and top_k (<= batching.TOP_K_MAX — the pool's
+    static top-k width; larger values get a 400 rather than silently
+    differing).  speculative=True serves GREEDY requests through the
+    int8 self-draft SpeculativeDecoder (batch-1 latency mode;
+    temperature/top_k requests fall back to the chunked decoder).
     """
 
     import threading
@@ -163,13 +163,16 @@ def build_handler(
                         "error": f"prompt({len(ids)}) + max_new_tokens({n_new}) "
                                  f"> max_len({max_len})"})
                 if pool is not None:
-                    if top_k is not None:
+                    from tf_operator_tpu.models.batching import TOP_K_MAX
+
+                    if top_k is not None and top_k > TOP_K_MAX:
                         return self._reply(400, {
-                            "error": "top_k is not supported in "
-                                     "--batching mode"})
+                            "error": f"top_k must be <= {TOP_K_MAX} in "
+                                     "--batching mode (static top-k "
+                                     "width)"})
                     rid = pool.submit(
                         ids.astype(np.int32), n_new,
-                        temperature=temperature,
+                        temperature=temperature, top_k=top_k,
                         rng=jax.random.PRNGKey(seed)
                         if temperature > 0.0 else None,
                     )

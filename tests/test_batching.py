@@ -166,6 +166,36 @@ class TestValidationAndQuant:
         with pytest.raises(ValueError):
             dec.submit(np.zeros((4,), np.int32), max_new_tokens=2, temperature=0.5)
 
+    def test_top_k_one_equals_greedy(self):
+        # top_k=1 leaves exactly one candidate: sampling at any
+        # temperature must reproduce the greedy tokens — an exact
+        # semantic pin on the per-slot top-k masking
+        model, params = _tiny()
+        p = _prompts(1, [6])[0]
+        dec = ContinuousBatchingDecoder(model, params, slots=2)
+        greedy_rid = dec.submit(p, max_new_tokens=5)
+        topk_rid = dec.submit(
+            p, max_new_tokens=5, temperature=1.3, top_k=1,
+            rng=jax.random.PRNGKey(3),
+        )
+        dec.run()
+        np.testing.assert_array_equal(
+            dec.result(topk_rid), dec.result(greedy_rid)
+        )
+
+    def test_top_k_validation(self):
+        from tf_operator_tpu.models.batching import TOP_K_MAX
+
+        model, params = _tiny()
+        dec = ContinuousBatchingDecoder(model, params, slots=2)
+        rng = jax.random.PRNGKey(0)
+        with pytest.raises(ValueError):
+            dec.submit(np.zeros((4,), np.int32), 2, temperature=0.5,
+                       top_k=0, rng=rng)
+        with pytest.raises(ValueError):
+            dec.submit(np.zeros((4,), np.int32), 2, temperature=0.5,
+                       top_k=TOP_K_MAX + 1, rng=rng)
+
     def test_quantized_tree_slot_isolation(self):
         from tf_operator_tpu.ops.quant import quantize_tree
 
@@ -184,12 +214,31 @@ class TestValidationAndQuant:
         np.testing.assert_array_equal(dec.result(r1), want)
         assert dec.result(r2) is not None
 
-    def test_rolling_window_rejected(self):
+    def test_rolling_window_slot_isolation(self):
+        # windowed model whose prompt EXCEEDS the window: admission
+        # chunks cap at the window, per-slot wrap state stays
+        # slot-local, and a request's tokens are occupancy-independent
         model = llama_tiny(vocab_size=VOCAB, max_len=48, window=8)
-        prompt = jnp.zeros((1, 4), jnp.int32)
-        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
-        with pytest.raises(NotImplementedError):
-            ContinuousBatchingDecoder(model, params, slots=2)
+        r = np.random.RandomState(11)
+        prompts = [
+            r.randint(0, VOCAB, size=(l,)).astype(np.int32)
+            for l in (13, 5, 21)  # 13 and 21 > window=8
+        ]
+        init = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), init)["params"]
+
+        solo = []
+        for p in prompts:
+            dec = ContinuousBatchingDecoder(model, params, slots=3)
+            rid = dec.submit(p, max_new_tokens=5)
+            dec.run()
+            solo.append(dec.result(rid))
+
+        dec = ContinuousBatchingDecoder(model, params, slots=3)
+        rids = [dec.submit(p, max_new_tokens=5) for p in prompts]
+        dec.run()
+        for rid, want in zip(rids, solo):
+            np.testing.assert_array_equal(dec.result(rid), want)
 
 
 class TestServeLmBatchingMode:
@@ -237,17 +286,29 @@ class TestServeLmBatchingMode:
             assert set(results) == {0, 1, 2}
             for i in range(3):
                 assert len(results[i]["sample"]) == 6
-            # top_k is a loud 400 in batching mode, not silent drift
+            # per-slot top_k sampling works through the pool...
             req = urllib.request.Request(
                 f"http://127.0.0.1:{port}/generate",
                 data=json.dumps(
-                    {"prompt": "x", "max_new_tokens": 2, "top_k": 4}
+                    {"prompt": "x", "max_new_tokens": 3, "top_k": 4,
+                     "temperature": 0.7}
+                ).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                assert len(json.loads(resp.read())["sample"]) == 3
+            # ...but beyond the static width it is a loud 400
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps(
+                    {"prompt": "x", "max_new_tokens": 2, "top_k": 400,
+                     "temperature": 0.7}
                 ).encode(),
                 method="POST",
             )
             try:
                 urllib.request.urlopen(req, timeout=30)
-                raise AssertionError("top_k not rejected in batching mode")
+                raise AssertionError("oversize top_k not rejected")
             except urllib.error.HTTPError as e:
                 assert e.code == 400
         finally:

@@ -31,8 +31,9 @@ a fixed pool of `slots` and one compiled step program:
 Greedy and per-slot temperature sampling (a ``[slots]`` temperature
 vector; 0 = argmax).  Requests finish by token budget (byte-level
 serving has no universal EOS).  Rolling-window caches (window <
-max_len) are rejected for now — their wrap arithmetic is per-slot
-state this pool does not yet track.
+max_len) work unchanged — each slot's wrap state (cached_pos, circular
+slots) is slot-local under the vmapped step; admission prefill chunks
+cap at the window like ChunkedServingDecoder's.
 
 The reference (SURVEY.md §0) has no serving story at all; this is a
 beyond-reference subsystem.  On-chip evidence: aggregate decode
@@ -53,20 +54,27 @@ from jax import lax
 from tf_operator_tpu.models.decode import (
     _decode_variant,
     _init_cache_for,
-    binary_chunks,
+    max_window_chunk,
+    window_chunks,
 )
 from tf_operator_tpu.ops.quant import materialize_tree
 
 
+#: static top-k width: per-slot k thresholds within the top TOP_K_MAX
+#: candidates, so one compiled step serves every requested k
+TOP_K_MAX = 64
+
+
 class _Request:
-    __slots__ = ("rid", "prompt", "budget", "temperature", "rng",
+    __slots__ = ("rid", "prompt", "budget", "temperature", "top_k", "rng",
                  "tokens", "done", "slot")
 
-    def __init__(self, rid, prompt, budget, temperature, rng):
+    def __init__(self, rid, prompt, budget, temperature, top_k, rng):
         self.rid = rid
         self.prompt = prompt  # np.ndarray [P] int32
         self.budget = budget
         self.temperature = temperature
+        self.top_k = top_k  # None = no truncation
         self.rng = rng
         self.tokens: List[int] = []
         self.done = False
@@ -83,13 +91,14 @@ class ContinuousBatchingDecoder:
     def __init__(self, model, params, slots: int = 8, steps_per_sync: int = 8):
         self.dmodel = _decode_variant(model)
         cfg = self.dmodel.cfg
-        w = getattr(cfg, "window", None)
-        if w is not None and w < cfg.max_len:
-            raise NotImplementedError(
-                "continuous batching does not yet support rolling-window "
-                "caches (window < max_len): per-slot wrap state is not "
-                "tracked; serve these models via ChunkedServingDecoder"
-            )
+        # rolling-window caches (window < max_len) work unchanged: each
+        # slot's cache — including its wrap state (cached_pos, circular
+        # slots) — is independent under the vmapped batch-1 step.  Only
+        # PREFILL needs care: the rolling cache accepts at most
+        # `window` tokens per apply, so admission chunks cap at the
+        # window (ONE rule, shared with ChunkedServingDecoder —
+        # decode.window_chunks / max_window_chunk).
+        self._max_chunk = max_window_chunk(cfg)
         self.params = params
         self.slots = int(slots)
         #: tokens generated per host round trip.  One device sync per
@@ -170,7 +179,7 @@ class ContinuousBatchingDecoder:
                 )
                 return vars_["cache"], logits[0, 0]
 
-            def step(params, stack, toks, temps, rngs):
+            def step(params, stack, toks, temps, top_ks, rngs):
                 # K decode steps per host round trip: the whole inner
                 # loop is ONE XLA program, so a tunneled chip pays one
                 # network round trip per K tokens, not per token.
@@ -184,9 +193,22 @@ class ContinuousBatchingDecoder:
                     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                     split = jax.vmap(jax.random.split)(rngs)
                     safe_t = jnp.where(temps > 0.0, temps, 1.0)
+                    scaled = logits / safe_t[:, None]
+                    # per-slot top_k with one STATIC top-k (compile
+                    # stays shape-stable): threshold at each slot's own
+                    # k within the top TOP_K_MAX candidates; 0 = off
+                    k_max = min(TOP_K_MAX, scaled.shape[-1])
+                    top_vals = lax.top_k(scaled, k_max)[0]  # [slots,k_max]
+                    idx = jnp.clip(top_ks - 1, 0, k_max - 1)[:, None]
+                    kth = jnp.take_along_axis(top_vals, idx, axis=1)
+                    scaled = jnp.where(
+                        (top_ks[:, None] > 0) & (scaled < kth),
+                        -jnp.inf,
+                        scaled,
+                    )
                     sampled = jax.vmap(
                         lambda r, l: jax.random.categorical(r, l)
-                    )(split[:, 0], logits / safe_t[:, None]).astype(jnp.int32)
+                    )(split[:, 0], scaled).astype(jnp.int32)
                     nxt = jnp.where(temps > 0.0, sampled, greedy)
                     return (stk, nxt, split[:, 1]), nxt
 
@@ -207,6 +229,7 @@ class ContinuousBatchingDecoder:
         max_new_tokens: int,
         *,
         temperature: float = 0.0,
+        top_k: Optional[int] = None,
         rng: Optional[jax.Array] = None,
     ) -> int:
         """Queue a single request ([P] int32).  Returns a request id;
@@ -226,13 +249,22 @@ class ContinuousBatchingDecoder:
             raise ValueError("temperature must be >= 0")
         if temperature > 0.0 and rng is None:
             raise ValueError("temperature sampling needs an explicit rng key")
+        if temperature == 0.0:
+            top_k = None  # greedy ignores top_k (same as generate())
+        if top_k is not None:
+            top_k = int(top_k)
+            if not (1 <= top_k <= TOP_K_MAX):
+                raise ValueError(
+                    f"top_k must be in [1, {TOP_K_MAX}] (the pool's "
+                    f"static top-k width), got {top_k}"
+                )
         with self._lock:
             rid = self._rid
             self._rid += 1
             # greedy requests never consume rng — storing a key would
             # create a device array per request inside the pool lock
             req = _Request(
-                rid, prompt, max_new_tokens, float(temperature), rng,
+                rid, prompt, max_new_tokens, float(temperature), top_k, rng,
             )
             self._queue.append(req)
             self._results[rid] = req
@@ -249,7 +281,7 @@ class ContinuousBatchingDecoder:
             cache = _init_cache_for(self.dmodel, 1)
             last = None
             off = 0
-            for width in binary_chunks(req.prompt.size):
+            for width in window_chunks(req.prompt.size, self._max_chunk):
                 ids = jnp.asarray(
                     req.prompt[off : off + width][None, :], jnp.int32
                 )
@@ -258,9 +290,14 @@ class ContinuousBatchingDecoder:
             # the prompt's first sampled token comes from prefill logits
             if req.temperature > 0.0:
                 req.rng, r = jax.random.split(req.rng)
-                tok = jax.random.categorical(
-                    r, last / req.temperature
-                ).astype(jnp.int32)
+                scaled = last / req.temperature
+                if req.top_k is not None:
+                    # clamp to vocab: TOP_K_MAX-validated k can still
+                    # exceed a tiny model's vocab, and lax.top_k raises
+                    k = min(req.top_k, scaled.shape[-1])
+                    kth = lax.top_k(scaled, k)[0][..., -1:]
+                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                tok = jax.random.categorical(r, scaled).astype(jnp.int32)
             else:
                 tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
             self._cache, self._last_tok = self._scatter()(
@@ -287,11 +324,13 @@ class ContinuousBatchingDecoder:
             if not self._active:
                 return 0
             temps = np.zeros((self.slots,), np.float32)
+            top_ks = np.zeros((self.slots,), np.int32)  # 0 = no top_k
             # legacy uint32[2] keys vmap as plain rows; dead slots get
             # key 0 but their temps=0 routes them to the greedy branch
             rngs = np.zeros((self.slots, 2), np.uint32)
             for slot, req in self._active.items():
                 temps[slot] = req.temperature
+                top_ks[slot] = req.top_k or 0
                 if req.temperature > 0.0:
                     req.rng, r = jax.random.split(req.rng)
                     rngs[slot] = np.asarray(r)
@@ -300,6 +339,7 @@ class ContinuousBatchingDecoder:
                 self._cache,
                 self._last_tok,
                 jnp.asarray(temps),
+                jnp.asarray(top_ks),
                 jnp.asarray(rngs),
             )
             host_toks = np.asarray(toks_k)  # [K, slots]

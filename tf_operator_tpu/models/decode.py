@@ -71,6 +71,27 @@ def binary_chunks(n: int) -> list:
     return out
 
 
+def window_chunks(n: int, max_chunk) -> list:
+    """binary_chunks capped for a ROLLING cache: widths never exceed
+    max_chunk (the largest power of two <= window) because the cache
+    accepts at most `window` tokens per apply.  max_chunk None = no cap."""
+
+    if max_chunk is None or n <= max_chunk:
+        return binary_chunks(n)
+    full, rem = divmod(n, max_chunk)
+    return [max_chunk] * full + binary_chunks(rem)
+
+
+def max_window_chunk(cfg) -> "int | None":
+    """Largest power-of-two prefill width a rolling cache accepts, or
+    None for non-rolling configs."""
+
+    w = getattr(cfg, "window", None)
+    if w is not None and w < cfg.max_len:
+        return 1 << (w.bit_length() - 1)
+    return None
+
+
 def _init_cache_for(dmodel, batch_size: int):
     dummy = jnp.zeros((batch_size, 1), jnp.int32)
     shapes = jax.eval_shape(
@@ -223,13 +244,9 @@ class ChunkedServingDecoder:
         self.params = params
         self.max_len = self.dmodel.cfg.max_len
         # windowed rolling cache accepts at most `window` tokens per
-        # apply: cap chunk widths at the largest power of two <= window
-        # (program count stays logarithmic — widths are still powers
-        # of two, just from a smaller set)
-        w = self.dmodel.cfg.window
-        self._max_chunk = (
-            1 << (w.bit_length() - 1) if w is not None and w < self.max_len else None
-        )
+        # apply: cap chunk widths (program count stays logarithmic —
+        # widths are still powers of two, just from a smaller set)
+        self._max_chunk = max_window_chunk(self.dmodel.cfg)
         self._prefill = {}  # chunk width -> jitted apply; <= log2(max_len)+1
         #: (budget, temperature, top_k) -> jitted scan.  LRU-bounded:
         #: budgets are powers of two but temperature/top_k are
@@ -248,10 +265,7 @@ class ChunkedServingDecoder:
     _binary_chunks = staticmethod(binary_chunks)  # back-compat alias
 
     def _chunks(self, n: int) -> list:
-        if self._max_chunk is None or n <= self._max_chunk:
-            return binary_chunks(n)
-        full, rem = divmod(n, self._max_chunk)
-        return [self._max_chunk] * full + binary_chunks(rem)
+        return window_chunks(n, self._max_chunk)
 
     def _prefill_fn(self, width: int):
         with self._lock:
