@@ -55,6 +55,7 @@ from tf_operator_tpu.models.decode import (
     _decode_variant,
     _init_cache_for,
     max_window_chunk,
+    top_k_mask,
     window_chunks,
 )
 from tf_operator_tpu.ops.quant import materialize_tree
@@ -292,11 +293,7 @@ class ContinuousBatchingDecoder:
                 req.rng, r = jax.random.split(req.rng)
                 scaled = last / req.temperature
                 if req.top_k is not None:
-                    # clamp to vocab: TOP_K_MAX-validated k can still
-                    # exceed a tiny model's vocab, and lax.top_k raises
-                    k = min(req.top_k, scaled.shape[-1])
-                    kth = lax.top_k(scaled, k)[0][..., -1:]
-                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                    scaled = top_k_mask(scaled, req.top_k)
                 tok = jax.random.categorical(r, scaled).astype(jnp.int32)
             else:
                 tok = jnp.argmax(last, axis=-1).astype(jnp.int32)

@@ -71,6 +71,17 @@ def binary_chunks(n: int) -> list:
     return out
 
 
+def top_k_mask(logits: jax.Array, top_k: int) -> jax.Array:
+    """Logits with everything below the k-th largest set to -inf.
+    ONE implementation for every sampling path (generate,
+    ChunkedServingDecoder, the batching pool's admission).  k is
+    clamped to the vocab — lax.top_k raises on k > width."""
+
+    k = min(int(top_k), logits.shape[-1])
+    kth = lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
 def window_chunks(n: int, max_chunk) -> list:
     """binary_chunks capped for a ROLLING cache: widths never exceed
     max_chunk (the largest power of two <= window) because the cache
@@ -167,8 +178,7 @@ def generate(
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         logits = logits / temperature
         if top_k is not None:
-            kth = lax.top_k(logits, top_k)[0][..., -1:]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
+            logits = top_k_mask(logits, top_k)
         return jax.random.categorical(r, logits).astype(jnp.int32)
 
     # prefill: the whole prompt primes every layer's cache.  Windowed
@@ -302,8 +312,7 @@ class ChunkedServingDecoder:
                     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 scaled = logits / temperature
                 if top_k is not None:
-                    kth = lax.top_k(scaled, top_k)[0][..., -1:]
-                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                    scaled = top_k_mask(scaled, top_k)
                 return jax.random.categorical(r, scaled).astype(jnp.int32)
 
             def loop(params, cache, last_logits, rng):
