@@ -52,9 +52,11 @@ def build_handler(
     joining at step granularity, driven by a single background thread;
     per-slot temperature and top_k (<= batching.TOP_K_MAX — the pool's
     static top-k width; larger values get a 400 rather than silently
-    differing).  speculative=True serves GREEDY requests through the
-    int8 self-draft SpeculativeDecoder (batch-1 latency mode;
-    temperature/top_k requests fall back to the chunked decoder).
+    differing).  speculative=True serves greedy AND temperature
+    requests through the int8 self-draft SpeculativeDecoder (batch-1
+    latency mode; both are exact — greedy by verification, temperature
+    by the rejection rule); top_k requests fall back to the chunked
+    decoder.
     """
 
     import threading
@@ -191,9 +193,16 @@ def build_handler(
                         200, {"prompt": text, "sample": sample, "seed": seed}
                     )
                 prompt = jnp.asarray(ids, jnp.int32)[None]
-                if spec is not None and temperature == 0.0 and top_k is None:
+                if spec is not None and top_k is None:
+                    # greedy AND temperature requests: speculative
+                    # sampling is exact for both (rejection rule);
+                    # only top_k falls back to the chunked decoder
                     with spec_lock:
-                        out = spec.generate(prompt, n_new)
+                        out = spec.generate(
+                            prompt, n_new, temperature=temperature,
+                            rng=jax.random.PRNGKey(seed)
+                            if temperature > 0.0 else None,
+                        )
                     sample = decode_bytes(np.asarray(out[0, prompt.shape[1]:]))
                     return self._reply(
                         200, {"prompt": text, "sample": sample, "seed": seed}

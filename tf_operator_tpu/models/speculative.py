@@ -1,6 +1,10 @@
-"""Greedy speculative decoding: a cheap DRAFT model proposes k tokens,
-the TARGET verifies them in ONE forward — output is provably identical
-to target-only greedy decode, so acceptance only changes SPEED.
+"""Speculative decoding: a cheap DRAFT model proposes k tokens, the
+TARGET verifies them in ONE forward.  Greedy mode (temperature 0) is
+provably identical to target-only greedy decode; sampling mode
+(temperature > 0) uses the rejection rule (accept d w.p.
+min(1, p(d)/q(d)), replace from the residual norm(max(p-q, 0))), which
+samples EXACTLY the target distribution for any draft — acceptance
+only changes SPEED in both modes.
 
 Why this fits the TPU: plain decode is weight-bandwidth-bound (one
 [B,1,D] matvec per weight read); verification re-reads the same
@@ -122,9 +126,29 @@ class SpeculativeDecoder:
                 ids,
                 mutable=["cache"],
             )
-            return vars_["cache"], jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+            return vars_["cache"], logits[:, -1]  # caller samples/argmaxes
 
         return self._jit(("prefill", model_tag, width), prefill)
+
+    # shared round mechanics (both acceptance modes): the final
+    # proposal's K/V write — under full acceptance the committed
+    # sequence includes it, and rollback must never mark an unwritten
+    # cache row valid — and the width-k target verify
+    def _finalize_draft(self, dparams_m, dcache, last):
+        _, dvars = self.ddraft.apply(
+            {"params": dparams_m, "cache": dcache},
+            last[:, None],
+            mutable=["cache"],
+        )
+        return dvars["cache"]
+
+    def _verify_chunk(self, tparams, tcache, chunk):
+        logits, tvars = self.dtar.apply(
+            {"params": materialize_tree(tparams), "cache": tcache},
+            chunk,
+            mutable=["cache"],
+        )
+        return tvars["cache"], logits
 
     def _round(self, k: int):
         """ONE XLA program per speculation round: draft-propose scan,
@@ -133,16 +157,16 @@ class SpeculativeDecoder:
         tunneled chip every call is a network round trip, so the fused
         round keeps speculation profitable."""
 
-        dtar, ddraft = self.dtar, self.ddraft
+        ddraft = self.ddraft
         n_prop = k - 1
 
         def rnd(tparams, dparams, tcache, dcache, t1, n):
-            dparams = materialize_tree(dparams)
+            dparams_m = materialize_tree(dparams)
 
             def body(carry, _):
                 cache, tok = carry
                 logits, vars_ = ddraft.apply(
-                    {"params": dparams, "cache": cache},
+                    {"params": dparams_m, "cache": cache},
                     tok[:, None],
                     mutable=["cache"],
                 )
@@ -152,23 +176,10 @@ class SpeculativeDecoder:
             (dcache, last), ds = lax.scan(
                 body, (dcache, t1), None, length=n_prop
             )
-            # write the FINAL proposal's K/V too: under full acceptance
-            # the committed sequence includes it, and rollback must
-            # never mark an unwritten cache row valid
-            _, dvars = ddraft.apply(
-                {"params": dparams, "cache": dcache},
-                last[:, None],
-                mutable=["cache"],
-            )
-            dcache = dvars["cache"]
+            dcache = self._finalize_draft(dparams_m, dcache, last)
             ds = jnp.swapaxes(ds, 0, 1)  # [B, k-1]
             chunk = jnp.concatenate([t1[:, None], ds], axis=1)  # [B, k]
-            logits, tvars = dtar.apply(
-                {"params": materialize_tree(tparams), "cache": tcache},
-                chunk,
-                mutable=["cache"],
-            )
-            tcache = tvars["cache"]
+            tcache, logits = self._verify_chunk(tparams, tcache, chunk)
             g = jnp.argmax(logits, -1).astype(jnp.int32)  # [B, k]
             # batch-aligned acceptance length m (min over rows)
             col_ok = jnp.all(ds == g[:, : k - 1], axis=0)  # [k-1]
@@ -180,6 +191,88 @@ class SpeculativeDecoder:
             dcache = _set_cache_index(dcache, n_next)
             t1_next = lax.dynamic_index_in_dim(g, m, axis=1, keepdims=False)
             return tcache, dcache, t1_next, m, chunk
+
+        return rnd
+
+    def _round_sampled(self, k: int):
+        """Speculative SAMPLING round (Leviathan/Chen rejection rule):
+        draft samples d_i ~ q_i, target accepts with prob
+        min(1, p_i(d_i)/q_i(d_i)); at the first rejection the
+        replacement draws from the RESIDUAL distribution
+        norm(max(p - q, 0)).  Every committed token is therefore an
+        exact sample from the target distribution at `temperature`,
+        for ANY draft.  Batch rows align on the minimum acceptance:
+        a row that accepted further keeps its own d at the alignment
+        position (already a valid p-sample); its discarded tail is
+        simply re-drawn with fresh randomness next round — still
+        exact."""
+
+        ddraft = self.ddraft
+        n_prop = k - 1
+
+        def rnd(tparams, dparams, tcache, dcache, t1, n, rng, temp):
+            dparams_m = materialize_tree(dparams)
+
+            def body(carry, _):
+                cache, tok, rng = carry
+                logits, vars_ = ddraft.apply(
+                    {"params": dparams_m, "cache": cache},
+                    tok[:, None],
+                    mutable=["cache"],
+                )
+                ql = logits[:, 0] / temp  # [B, V]
+                rng, r = jax.random.split(rng)
+                d = jax.random.categorical(r, ql).astype(jnp.int32)
+                return (vars_["cache"], d, rng), (d, ql)
+
+            (dcache, last, rng), (ds, qls) = lax.scan(
+                body, (dcache, t1, rng), None, length=n_prop
+            )
+            dcache = self._finalize_draft(dparams_m, dcache, last)
+            ds = jnp.swapaxes(ds, 0, 1)  # [B, k-1]
+            qls = jnp.swapaxes(qls, 0, 1)  # [B, k-1, V]
+            chunk = jnp.concatenate([t1[:, None], ds], axis=1)
+            tcache, logits = self._verify_chunk(tparams, tcache, chunk)
+            pls = logits / temp  # [B, k, V]
+            logp = jax.nn.log_softmax(pls[:, : k - 1], -1)
+            logq = jax.nn.log_softmax(qls, -1)
+            tok_logp = jnp.take_along_axis(logp, ds[..., None], -1)[..., 0]
+            tok_logq = jnp.take_along_axis(logq, ds[..., None], -1)[..., 0]
+            rng, r = jax.random.split(rng)
+            u = jax.random.uniform(r, ds.shape)
+            accept = jnp.log(u) < jnp.minimum(0.0, tok_logp - tok_logq)
+            any_rej = jnp.any(~accept, axis=1)  # [B]
+            first_rej = jnp.where(
+                any_rej, jnp.argmax(~accept, axis=1), n_prop
+            )  # [B]; n_prop = accepted everything
+            m = jnp.min(first_rej).astype(jnp.int32)
+            # replacement token at the alignment position m:
+            #   first_rej == m  -> residual sample norm(max(p_m - q_m, 0))
+            #   first_rej >  m  -> keep own d_m (a valid p-sample)
+            #   m == k-1 (all rows accepted all): q pads to 0 so the
+            #   "residual" is exactly p_{k-1} — a fresh target sample
+            p_m = jax.nn.softmax(
+                lax.dynamic_index_in_dim(pls, m, axis=1, keepdims=False), -1
+            )  # [B, V]
+            q_probs = jnp.exp(logq)  # log_softmax already computed above
+            q_pad = jnp.concatenate(
+                [q_probs, jnp.zeros_like(q_probs[:, :1])], axis=1
+            )
+            q_m = lax.dynamic_index_in_dim(q_pad, m, axis=1, keepdims=False)
+            resid = jnp.clip(p_m - q_m, 0.0, None)
+            ok = jnp.sum(resid, -1, keepdims=True) > 1e-9
+            resid = jnp.where(ok, resid, p_m)  # numeric-zero fallback
+            rng, r = jax.random.split(rng)
+            corr = jax.random.categorical(
+                r, jnp.log(resid + 1e-20)
+            ).astype(jnp.int32)
+            ds_pad = jnp.concatenate([ds, jnp.zeros_like(ds[:, :1])], axis=1)
+            d_at_m = lax.dynamic_index_in_dim(ds_pad, m, axis=1, keepdims=False)
+            t1_next = jnp.where(first_rej <= m, corr, d_at_m)
+            n_next = n + 1 + m
+            tcache = _set_cache_index(tcache, n_next)
+            dcache = _set_cache_index(dcache, n_next)
+            return tcache, dcache, t1_next, m, chunk, rng
 
         return rnd
 
@@ -207,43 +300,87 @@ class SpeculativeDecoder:
 
         return self._jit(("rounds", k, r), many)
 
+    def _rounds_sampled(self, k: int, r: int):
+        rnd = self._round_sampled(k)
+
+        def many(tparams, dparams, tcache, dcache, t1, n, rng, temp):
+            def body(carry, _):
+                tcache, dcache, t1, n, rng = carry
+                tcache, dcache, t1, m, chunk, rng = rnd(
+                    tparams, dparams, tcache, dcache, t1, n, rng, temp
+                )
+                return (tcache, dcache, t1, n + 1 + m, rng), (m, chunk)
+
+            (tcache, dcache, t1, n, rng), (ms, chunks) = lax.scan(
+                body, (tcache, dcache, t1, n, rng), None, length=r
+            )
+            return tcache, dcache, t1, n, rng, ms, chunks
+
+        return self._jit(("rounds-sampled", k, r), many)
+
     # -- public ----------------------------------------------------------
 
-    def generate(self, prompt_ids, max_new_tokens: int) -> np.ndarray:
-        """[B, P + N] int32, bit-identical to greedy `generate` on the
-        target (same decode-variant code path)."""
+    def generate(
+        self,
+        prompt_ids,
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        rng=None,
+    ) -> np.ndarray:
+        """[B, P + N] int32.  temperature 0 = greedy, bit-identical to
+        greedy `generate` on the target (same decode-variant code
+        path); temperature > 0 = exact speculative SAMPLING from the
+        target distribution (rejection rule — see _round_sampled)."""
 
         prompt = jnp.asarray(prompt_ids, jnp.int32)
         b, p = prompt.shape
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+        if temperature > 0.0 and rng is None:
+            raise ValueError("temperature sampling needs an explicit rng key")
         if p + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({p}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_len={self.max_len}"
             )
+        sampled = temperature > 0.0
+        temp = jnp.float32(temperature if sampled else 1.0)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)  # greedy: never consumed
+
+        def pick(logits, r):
+            if not sampled:
+                return jnp.argmax(logits, -1).astype(jnp.int32)
+            return jax.random.categorical(r, logits / temp).astype(jnp.int32)
+
         tcache = _init_cache_for(self.dtar, b)
         dcache = _init_cache_for(self.ddraft, b)
-        t1 = None
+        last = None
         off = 0
         for width in binary_chunks(p):
             ids = prompt[:, off : off + width]
-            tcache, t1 = self._prefill("t", width)(self.tparams, tcache, ids)
+            tcache, last = self._prefill("t", width)(self.tparams, tcache, ids)
             dcache, _ = self._prefill("d", width)(self.dparams, dcache, ids)
             off += width
+        rng, r0 = jax.random.split(rng)
+        t1 = pick(last, r0)
         n = p  # committed sequence length in both caches
         emitted = []  # list of [B] np arrays
         while len(emitted) < max_new_tokens:
             # cap the chunk so the verify never writes past max_len
             room = self.max_len - n
             k = min(self.k, room)
-            if k < 2:  # no space to speculate: plain greedy steps
-                tcache, t1_next = self._prefill("t", 1)(
+            if k < 2:  # no space to speculate: plain target steps
+                tcache, last = self._prefill("t", 1)(
                     self.tparams, tcache, t1[:, None]
                 )
                 emitted.append(np.asarray(t1))
                 n += 1
-                t1 = t1_next
+                rng, r = jax.random.split(rng)
+                t1 = pick(last, r)
                 continue
             # R rounds per device call; power-of-2 bucket bounds the
             # compile count.  r <= room // k guarantees no cache
@@ -252,10 +389,19 @@ class SpeculativeDecoder:
             remaining = max_new_tokens - len(emitted)
             r = max(1, min(self.rounds_per_call, room // k, remaining))
             r = 1 << (r.bit_length() - 1)
-            tcache, dcache, t1, n_dev, ms, chunks = self._rounds(k, r)(
-                self.tparams, self.dparams, tcache, dcache, t1,
-                jnp.asarray(n, jnp.int32),
-            )
+            if sampled:
+                rng, sub = jax.random.split(rng)
+                (tcache, dcache, t1, n_dev, _, ms, chunks) = (
+                    self._rounds_sampled(k, r)(
+                        self.tparams, self.dparams, tcache, dcache, t1,
+                        jnp.asarray(n, jnp.int32), sub, temp,
+                    )
+                )
+            else:
+                tcache, dcache, t1, n_dev, ms, chunks = self._rounds(k, r)(
+                    self.tparams, self.dparams, tcache, dcache, t1,
+                    jnp.asarray(n, jnp.int32),
+                )
             ms_h = np.asarray(ms)
             chunks_h = np.asarray(chunks)  # [r, B, k]
             for rr in range(r):
