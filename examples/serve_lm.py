@@ -45,7 +45,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def build_handler(
     model, params, max_len: int, batching_slots: int = 0,
-    speculative: bool = False,
+    speculative: bool = False, prompt_cache: int = 0,
 ):
     """batching_slots > 0 serves through the continuous-batching pool
     (models/batching.py): concurrent requests share one decode loop,
@@ -89,8 +89,15 @@ def build_handler(
         spec_lock = threading.Lock()  # generate mutates decoder telemetry
         pool = None
         pool_fatal = []
-        decoder = ChunkedServingDecoder(model, params)  # sampling fallback
+        # top_k fallback path; prompt-KV reuse helps it too
+        decoder = ChunkedServingDecoder(model, params, prompt_cache=prompt_cache)
     elif batching_slots > 0:
+        if prompt_cache:
+            raise ValueError(
+                "--prompt-cache applies to the chunked decoder; the "
+                "batching pool prefills into per-slot caches and does "
+                "not consume it — drop one of the flags"
+            )
         pool = ContinuousBatchingDecoder(model, params, slots=batching_slots)
         pool_fatal = []  # driver-thread death must surface as 500s
 
@@ -109,7 +116,7 @@ def build_handler(
         pool = None
         spec = None
         pool_fatal = []
-        decoder = ChunkedServingDecoder(model, params)
+        decoder = ChunkedServingDecoder(model, params, prompt_cache=prompt_cache)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -234,6 +241,12 @@ def main() -> int:
              "which beats env-level pins like this box's sitecustomize",
     )
     ap.add_argument(
+        "--prompt-cache", type=int, default=0, metavar="N",
+        help="LRU of N prompt-KV snapshots: an exact repeat prompt "
+             "(same system+context, fresh budget/sampling) skips "
+             "prefill entirely; each entry holds one full KV cache",
+    )
+    ap.add_argument(
         "--speculative", action="store_true",
         help="serve greedy requests through the int8 self-draft "
              "speculative decoder (batch-1 latency mode; sampling "
@@ -301,6 +314,7 @@ def main() -> int:
         build_handler(
             model, params, max_len,
             batching_slots=args.batching, speculative=args.speculative,
+            prompt_cache=args.prompt_cache,
         ),
     )
     print(f"serving on 127.0.0.1:{args.port} (artifact: {args.artifact})", flush=True)

@@ -1,0 +1,72 @@
+"""Prompt-KV snapshot reuse in ChunkedServingDecoder.
+
+Exactness: a hit must produce the identical tokens a fresh prefill
+would — the snapshot holds the same immutable arrays.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # generation-loop compiles
+
+from tf_operator_tpu.models import llama_tiny
+from tf_operator_tpu.models.decode import ChunkedServingDecoder
+
+VOCAB = 96
+
+
+def _setup():
+    model = llama_tiny(vocab_size=VOCAB, max_len=64)
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, VOCAB, size=(1, 9)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+    return model, params, prompt
+
+
+def test_hit_is_exact_and_skips_prefill():
+    model, params, prompt = _setup()
+    dec = ChunkedServingDecoder(model, params, prompt_cache=4)
+    first = np.asarray(dec.generate(prompt, 6))
+    compiles_after_first = dec.compile_count
+    assert dec.prompt_cache_hits == 0
+    again = np.asarray(dec.generate(prompt, 6))
+    np.testing.assert_array_equal(first, again)
+    assert dec.prompt_cache_hits == 1
+    assert dec.compile_count == compiles_after_first  # no new programs
+    # different budget, same prompt: still a hit, budget still honored
+    longer = np.asarray(dec.generate(prompt, 11))
+    assert dec.prompt_cache_hits == 2
+    assert longer.shape == (1, 20)
+    np.testing.assert_array_equal(longer[:, :15], first[:, :15])
+
+
+def test_lru_eviction_and_distinct_prompts():
+    model, params, prompt = _setup()
+    dec = ChunkedServingDecoder(model, params, prompt_cache=2)
+    r = np.random.RandomState(5)
+    prompts = [
+        jnp.asarray(r.randint(0, VOCAB, size=(1, 7)), jnp.int32)
+        for _ in range(3)
+    ]
+    outs = [np.asarray(dec.generate(p, 4)) for p in prompts]
+    assert dec.prompt_cache_hits == 0
+    # p2, p1 cached (LRU size 2); p0 evicted
+    np.testing.assert_array_equal(
+        np.asarray(dec.generate(prompts[2], 4)), outs[2]
+    )
+    assert dec.prompt_cache_hits == 1
+    np.testing.assert_array_equal(
+        np.asarray(dec.generate(prompts[0], 4)), outs[0]  # miss, refills
+    )
+    assert dec.prompt_cache_hits == 1
+
+
+def test_disabled_by_default():
+    model, params, prompt = _setup()
+    dec = ChunkedServingDecoder(model, params)
+    dec.generate(prompt, 4)
+    dec.generate(prompt, 4)
+    assert dec.prompt_cache_hits == 0

@@ -28,6 +28,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from tf_operator_tpu.models.transformer import TransformerConfig
@@ -246,7 +247,8 @@ class ChunkedServingDecoder:
     so tests (and capacity planning) can pin the bound.
     """
 
-    def __init__(self, model, params, max_loops: int = 24):
+    def __init__(self, model, params, max_loops: int = 24,
+                 prompt_cache: int = 0):
         import threading
         from collections import OrderedDict
 
@@ -257,6 +259,16 @@ class ChunkedServingDecoder:
         # apply: cap chunk widths (program count stays logarithmic —
         # widths are still powers of two, just from a smaller set)
         self._max_chunk = max_window_chunk(self.dmodel.cfg)
+        #: prompt-KV snapshot reuse: exact prompt bytes -> (primed
+        #: cache, last logits).  A repeat prompt (the chat pattern:
+        #: same system+context, fresh budget/sampling) skips prefill
+        #: entirely.  EXACT — the snapshot holds the same arrays a
+        #: fresh prefill would produce, and jax arrays are immutable,
+        #: so decode loops can never corrupt a stored entry.  LRU;
+        #: each entry costs one full B-row KV cache.
+        self._prompt_cache_size = int(prompt_cache)
+        self._prompt_cache = OrderedDict()
+        self.prompt_cache_hits = 0
         self._prefill = {}  # chunk width -> jitted apply; <= log2(max_len)+1
         #: (budget, temperature, top_k) -> jitted scan.  LRU-bounded:
         #: budgets are powers of two but temperature/top_k are
@@ -384,6 +396,25 @@ class ChunkedServingDecoder:
                 raise ValueError("temperature sampling needs an explicit rng key")
             rng = jax.random.PRNGKey(0)
 
+        key = None
+        if self._prompt_cache_size > 0:
+            arr = np.asarray(prompt_ids)
+            # shape+dtype in the key: raw bytes alone collide across
+            # reshapes ([1,4] vs [2,2]) and dtype aliases
+            key = (arr.shape, arr.dtype.str, arr.tobytes())
+            with self._lock:
+                hit = self._prompt_cache.get(key)
+                if hit is not None:
+                    self._prompt_cache.move_to_end(key)
+                    self.prompt_cache_hits += 1
+            if hit is not None:
+                cache, last = hit
+                toks = self._loop_fn(budget, temperature, top_k)(
+                    self.params, cache, last, rng
+                )
+                return jnp.concatenate(
+                    [prompt_ids, toks[:, :max_new_tokens]], axis=1
+                )
         cache = _init_cache_for(self.dmodel, b)
         offset, last = 0, None
         for width in self._chunks(p):
@@ -391,6 +422,11 @@ class ChunkedServingDecoder:
                 self.params, cache, prompt_ids[:, offset : offset + width]
             )
             offset += width
+        if key is not None:
+            with self._lock:
+                while len(self._prompt_cache) >= self._prompt_cache_size:
+                    self._prompt_cache.popitem(last=False)
+                self._prompt_cache[key] = (cache, last)
         toks = self._loop_fn(budget, temperature, top_k)(
             self.params, cache, last, rng
         )
