@@ -9,6 +9,9 @@ XLA program per shape) do the actual work.
 
     python examples/serve_lm.py --artifact /path/to/export --port 8600
     curl -s localhost:8600/generate -d '{"prompt": "the sharded ", "max_new_tokens": 32}'
+    curl -s localhost:8600/metrics   # Prometheus text: requests by
+                                     # status, latency histogram,
+                                     # tokens generated, mode gauges
 
 Serving modes: `--batching SLOTS` multiplexes concurrent requests
 through the continuous-batching pool (models/batching.py — one decode
@@ -69,6 +72,11 @@ def build_handler(
     from tf_operator_tpu.data.text import decode_bytes
     from tf_operator_tpu.models.batching import ContinuousBatchingDecoder
     from tf_operator_tpu.models.decode import ChunkedServingDecoder
+    from tf_operator_tpu.utils.metrics import Metrics
+
+    # the same observability surface the operator exposes: counters +
+    # latency histogram in Prometheus text format on GET /metrics
+    metrics = Metrics()
 
     if speculative:
         if batching_slots > 0:
@@ -123,6 +131,18 @@ def build_handler(
             pass
 
         def _reply(self, code: int, payload: dict) -> None:
+            t0 = getattr(self, "_t0", None)
+            if t0 is not None:  # a /generate request being answered
+                self._t0 = None
+                metrics.observe_histogram(
+                    "serve_request_seconds", _time.perf_counter() - t0
+                )
+                metrics.inc("serve_requests_total", status=str(code))
+                if code == 200 and isinstance(payload.get("sample"), str):
+                    metrics.inc(
+                        "serve_tokens_generated_total",
+                        float(len(payload["sample"])),
+                    )
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -133,11 +153,39 @@ def build_handler(
         def do_GET(self):
             if self.path == "/healthz":
                 return self._reply(200, {"ok": True})
+            if self.path == "/metrics":
+                # live gauges appended to the counter exposition
+                # compile-count gauges in EVERY mode: bounded compile
+                # cardinality is this module's headline invariant, and
+                # a fragmenting workload should be visible on /metrics
+                extra = []
+                if pool is not None:
+                    extra.append(f"serve_pool_compiles {pool.compile_count}")
+                if spec is not None:
+                    extra.append(
+                        f"serve_spec_acceptance_rate {spec.acceptance_rate:.4f}"
+                    )
+                    extra.append(f"serve_spec_compiles {spec.compile_count}")
+                if pool is None:  # chunked decoder serves (or backstops)
+                    extra.append(
+                        f"serve_prompt_cache_hits {decoder.prompt_cache_hits}"
+                    )
+                    extra.append(
+                        f"serve_decoder_compiles {decoder.compile_count}"
+                    )
+                body = (metrics.exposition() + "\n".join(extra) + "\n").encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             return self._reply(404, {"error": "try POST /generate"})
 
         def do_POST(self):
             if self.path != "/generate":
                 return self._reply(404, {"error": "unknown path"})
+            self._t0 = _time.perf_counter()
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")

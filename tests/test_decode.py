@@ -667,6 +667,15 @@ def test_serve_lm_end_to_end(tmp_path):
         # health + error paths
         with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
             assert json.loads(r.read())["ok"]
+        # metrics surface: requests counted by status, latency
+        # histogram populated, tokens-generated counter advanced
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert 'serve_requests_total{status="200"} 1' in text
+        assert "serve_request_seconds_count 1" in text
+        assert "serve_tokens_generated_total 8.0" in text
+        assert "serve_prompt_cache_hits 0" in text
+        assert "serve_decoder_compiles" in text
         # ADVICE r3: top_k arriving as a JSON string must be cast (not
         # used raw as a compile key), including on the greedy path
         req = urllib.request.Request(
