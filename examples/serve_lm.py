@@ -210,6 +210,21 @@ def build_handler(
                     # return identical "samples" every time
                     seed = int.from_bytes(os.urandom(4), "little")
                 seed = int(seed)
+                # stop sequence: generation still runs its fixed-shape
+                # budget (XLA has no data-dependent early exit worth
+                # its recompiles here); the SAMPLE is truncated at the
+                # first occurrence, which is the API contract users
+                # expect.  Host-side, exact, compile-cache-neutral.
+                stop = req.get("stop")
+                if stop is not None and not isinstance(stop, str):
+                    return self._reply(400, {"error": "stop must be a string"})
+
+                def finish(sample: str) -> str:
+                    if stop:
+                        cut = sample.find(stop)
+                        if cut >= 0:
+                            return sample[:cut]
+                    return sample
                 if not text:
                     return self._reply(400, {"error": "empty prompt"})
                 if n_new < 1:
@@ -243,7 +258,7 @@ def build_handler(
                             return self._reply(500, {
                                 "error": "decode driver died: "
                                          f"{pool_fatal[0]}"})
-                    sample = decode_bytes(out_row[len(ids):])
+                    sample = finish(decode_bytes(out_row[len(ids):]))
                     return self._reply(
                         200, {"prompt": text, "sample": sample, "seed": seed}
                     )
@@ -258,7 +273,7 @@ def build_handler(
                             rng=jax.random.PRNGKey(seed)
                             if temperature > 0.0 else None,
                         )
-                    sample = decode_bytes(np.asarray(out[0, prompt.shape[1]:]))
+                    sample = finish(decode_bytes(np.asarray(out[0, prompt.shape[1]:])))
                     return self._reply(
                         200, {"prompt": text, "sample": sample, "seed": seed}
                     )
@@ -266,7 +281,7 @@ def build_handler(
                     prompt, n_new, temperature=temperature, top_k=top_k,
                     rng=jax.random.PRNGKey(seed),
                 )
-                sample = decode_bytes(np.asarray(out[0, prompt.shape[1]:]))
+                sample = finish(decode_bytes(np.asarray(out[0, prompt.shape[1]:])))
                 return self._reply(
                     200, {"prompt": text, "sample": sample, "seed": seed}
                 )

@@ -676,6 +676,28 @@ def test_serve_lm_end_to_end(tmp_path):
         assert "serve_tokens_generated_total 8.0" in text
         assert "serve_prompt_cache_hits 0" in text
         assert "serve_decoder_compiles" in text
+        # stop sequence: sample truncates at the first occurrence —
+        # with a single-byte stop drawn FROM the full sample, the
+        # truncation is verifiable exactly against the untruncated run
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt": "the worker ", "max_new_tokens": 8}).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            full = json.loads(resp.read())["sample"]
+        stop_ch = full[3]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(
+                {"prompt": "the worker ", "max_new_tokens": 8,
+                 "stop": stop_ch}
+            ).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            cut = json.loads(resp.read())["sample"]
+        assert cut == full[: full.index(stop_ch)]
         # ADVICE r3: top_k arriving as a JSON string must be cast (not
         # used raw as a compile key), including on the greedy path
         req = urllib.request.Request(
