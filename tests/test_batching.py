@@ -214,6 +214,28 @@ class TestValidationAndQuant:
         np.testing.assert_array_equal(dec.result(r1), want)
         assert dec.result(r2) is not None
 
+    def test_moe_family_slot_isolation(self):
+        # routed experts decode droplessly per token; under the vmapped
+        # slot step each row routes independently — occupancy must not
+        # change a request's expert paths or tokens
+        from tf_operator_tpu.models import moe_tiny
+
+        model = moe_tiny(vocab_size=VOCAB, max_len=48)
+        init = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), init)["params"]
+        prompts = _prompts(2, [6, 9])
+        solo = []
+        for p in prompts:
+            dec = ContinuousBatchingDecoder(model, params, slots=2)
+            rid = dec.submit(p, max_new_tokens=5)
+            dec.run()
+            solo.append(dec.result(rid))
+        dec = ContinuousBatchingDecoder(model, params, slots=2)
+        rids = [dec.submit(p, max_new_tokens=5) for p in prompts]
+        dec.run()
+        for rid, want in zip(rids, solo):
+            np.testing.assert_array_equal(dec.result(rid), want)
+
     def test_rolling_window_slot_isolation(self):
         # windowed model whose prompt EXCEEDS the window: admission
         # chunks cap at the window, per-slot wrap state stays
