@@ -263,6 +263,61 @@ class TestValidationAndQuant:
             np.testing.assert_array_equal(dec.result(rid), want)
 
 
+class TestConcurrencyStress:
+    def test_threaded_submitters_with_driver_thread(self):
+        # the serve_lm topology under load: N submitter threads racing
+        # a driver thread; every request must complete, echo its
+        # prompt, and honor its budget — no deadlocks, no lost slots
+        import threading
+
+        model, params = _tiny()
+        dec = ContinuousBatchingDecoder(model, params, slots=3)
+        stop = threading.Event()
+        results = {}
+        errors = []  # bound before the driver starts (drive closes over it)
+
+        def drive():
+            try:
+                while not stop.is_set():
+                    if dec.step() == 0:
+                        stop.wait(0.002)
+            except Exception as exc:  # surface the real decode failure
+                errors.append(("driver", repr(exc)))
+                stop.set()
+
+        driver = threading.Thread(target=drive, daemon=True)
+        driver.start()
+
+        def submitter(tid):
+            try:
+                r = np.random.RandomState(tid)
+                for j in range(4):
+                    p = r.randint(0, VOCAB, size=(3 + (tid + j) % 5,)).astype(
+                        np.int32
+                    )
+                    budget = 2 + (j % 3)
+                    rid = dec.submit(p, max_new_tokens=budget)
+                    row = dec.result_wait(rid, timeout=300)
+                    assert row is not None
+                    np.testing.assert_array_equal(row[: p.size], p)
+                    assert row.shape == (p.size + budget,)
+                    results[(tid, j)] = row
+            except Exception as exc:  # surfaced below; threads must not die silently
+                errors.append((tid, repr(exc)))
+
+        threads = [
+            threading.Thread(target=submitter, args=(t,)) for t in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        stop.set()
+        driver.join(timeout=10)
+        assert not errors, errors
+        assert len(results) == 12
+
+
 class TestServeLmBatchingMode:
     def test_concurrent_http_requests_share_the_pool(self):
         import json
