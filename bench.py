@@ -33,7 +33,7 @@ Env knobs: BENCH_TOTAL_BUDGET, BENCH_BATCH_PER_CHIP (default: autotune
 256/128/64), BENCH_STEPS, BENCH_RETRIES, BENCH_CHILD_TIMEOUT,
 BENCH_LLAMA_TIMEOUT, BENCH_PROBE_TIMEOUT, BENCH_PLATFORM (e.g. cpu for
 a smoke run), BENCH_PEAK_TFLOPS (MFU denominator override),
-BENCH_PIPELINE=0, BENCH_LLAMA=0 to skip sections.
+BENCH_PIPELINE=0, BENCH_LLAMA=0, BENCH_QUANT=0 to skip sections.
 """
 
 from __future__ import annotations
