@@ -574,3 +574,115 @@ class TestSummariesManifest:
         assert all("loss" in m for m in series)
         # both worker processes wrote their own file
         assert len(_glob.glob(os.path.join(sdir, "metrics-*.jsonl"))) == 2
+
+
+@pytest.mark.slow
+class TestServingJob:
+    """Operator-managed serving: the SAME control plane that runs
+    training jobs deploys the inference binary as a long-running
+    single-replica job (examples/manifests/serving.yaml), and job
+    deletion tears the server down (cleanPodPolicy All)."""
+
+    def test_serving_manifest_runs_and_answers_http(self, local_harness, tmp_path):
+        import json as _json
+        import socket
+        import urllib.error
+        import urllib.request
+
+        import jax
+        import numpy as np
+        import yaml
+
+        import jax.numpy as jnp
+        from tf_operator_tpu.api.serde import job_from_dict
+        from tf_operator_tpu.models import llama_loss, llama_tiny
+        from tf_operator_tpu.parallel import (
+            Trainer, TrainerConfig, export_params, make_mesh,
+        )
+
+        # a real artifact for the server to load (byte-level, vocab 256)
+        mesh = make_mesh({"dp": 8})  # conftest's 8-device CPU mesh
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, size=(8, 16)), jnp.int32
+        )
+        tr = Trainer(
+            llama_tiny(vocab_size=256, max_len=64, mesh=mesh),
+            TrainerConfig(optimizer="sgd", learning_rate=1e-2),
+            mesh,
+            llama_loss,
+            {"input_ids": ids},
+            init_args=(ids,),
+            shardings="logical",
+        )
+        tr.train_step(tr.shard_batch({"input_ids": ids}))
+        art = str(tmp_path / "artifact")
+        export_params(tr, art)
+
+        with socket.socket() as s:  # collision-free port
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        repo = os.path.dirname(os.path.dirname(EXAMPLE))
+        with open(os.path.join(repo, "examples", "manifests", "serving.yaml")) as f:
+            doc = yaml.safe_load(f)
+        spec = doc["spec"]["tpuReplicaSpecs"]["Worker"]["template"]["spec"]
+        cmd = spec["containers"][0]["command"]
+        cmd[0] = sys.executable
+        cmd[cmd.index("examples/serve_lm.py")] = os.path.join(
+            repo, "examples", "serve_lm.py"
+        )
+        cmd[cmd.index("--artifact") + 1] = art
+        cmd[cmd.index("--port") + 1] = str(port)
+        cmd += ["--platform", "cpu"]
+
+        store, backend, c = local_harness
+        job = job_from_dict(doc)
+        store.create(job)
+        wait_for(
+            store, "default", "serve-lm",
+            lambda j: j.status.has_condition(JobConditionType.RUNNING),
+            timeout=60.0,
+        )
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.time() + 120
+        while True:  # model load + first compile happen in-pod
+            try:
+                with urllib.request.urlopen(base + "/healthz", timeout=2) as r:
+                    if _json.loads(r.read())["ok"]:
+                        break
+            except Exception:
+                # diagnosable flake-out: surface a failed job / pod log
+                # instead of an opaque URLError after 120s (e.g. a
+                # TOCTOU loss of the ephemeral port -> EADDRINUSE)
+                j = store.get("default", "serve-lm")
+                if j is not None and j.status.has_condition(
+                    JobConditionType.FAILED
+                ):
+                    raise AssertionError(
+                        "serving job FAILED: "
+                        + backend.pod_log("default", "serve-lm-worker-0")[-500:]
+                    )
+                if time.time() > deadline:
+                    raise AssertionError(
+                        "healthz never came up; pod log tail: "
+                        + backend.pod_log("default", "serve-lm-worker-0")[-500:]
+                    )
+                time.sleep(1.0)
+        req = urllib.request.Request(
+            base + "/generate",
+            data=_json.dumps(
+                {"prompt": "operator ", "max_new_tokens": 4}
+            ).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=240) as resp:
+            out = _json.loads(resp.read())
+        assert len(out["sample"]) == 4
+        # deletion tears the server down (cleanPodPolicy All)
+        store.delete("default", "serve-lm")
+        wait_no_pods(backend, timeout=30.0)
+        try:
+            urllib.request.urlopen(base + "/healthz", timeout=2)
+            raise AssertionError("server still answering after job delete")
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass
