@@ -173,12 +173,23 @@ class TestValidation:
 class TestTopologyParse:
     @pytest.mark.parametrize(
         "s,n",
-        [("v5e-16", 16), ("v5p-8", 8), ("2x4", 8), ("4x4x4", 64), ("v5litepod-4", 4)],
+        [
+            ("v5e-16", 16),
+            # v4/v5p accelerator names count TensorCores, 2 per chip
+            # (the public convention: v5p-8 is a 4-chip slice)
+            ("v5p-8", 4),
+            ("v4-32", 16),
+            ("2x4", 8),
+            ("4x4x4", 64),
+            ("v5litepod-4", 4),
+        ],
     )
     def test_ok(self, s, n):
         assert parse_tpu_topology(s) == n
 
-    @pytest.mark.parametrize("s", ["", "v5e", "axb", "16"])
+    @pytest.mark.parametrize(
+        "s", ["", "v5e", "axb", "16", "v4-7", "v4-0", "v5e-0", "0x4"]
+    )
     def test_bad(self, s):
         with pytest.raises(ValueError):
             parse_tpu_topology(s)
