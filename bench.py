@@ -65,6 +65,25 @@ def _emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
+def _last_measured() -> dict | None:
+    """The most recent REAL numbers (benchmarks/LAST_MEASURED.json,
+    written by collect_window.py after every completed measurement
+    window).  Attached to error JSON so a dead-tunnel run still points
+    the reader at the latest measured values and their provenance
+    instead of a bare value: 0.0 (VERDICT r4 next #9)."""
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "LAST_MEASURED.json",
+    )
+    try:
+        with open(path) as f:
+            ledger = json.load(f)
+        return ledger or None
+    except Exception:
+        return None
+
+
 def _peak_flops(device) -> float:
     """Per-chip bf16 peak for MFU; overridable via BENCH_PEAK_TFLOPS."""
 
@@ -332,6 +351,23 @@ def llama_mini_config(seq: int, window: int | None = None):
     )
 
 
+def llama_wide_config(seq: int, window: int | None = None):
+    """The ~700M wide-llama config (d_model 2048, 12 layers, GQA
+    16q:8kv heads of 128, SwiGLU 5632) — the >=0.40-MFU existence-proof
+    shape (VERDICT r4 next #3): llama-mini's d_model 1024 cannot fill
+    the MXU's 128x128 tiles with enough arithmetic per weight byte;
+    this width can.  Sized so adam fp32 state (~8.4 GB) + bf16
+    activations at seq 2048 batch 2 (remat) fit one 16 GB v5e chip."""
+
+    from tf_operator_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        vocab_size=32000, hidden=2048, n_heads=16, head_dim=128,
+        n_layers=12, mlp_dim=5632, max_len=seq, dropout=0.0,
+        rope=True, attn_bias=False, n_kv_heads=8, window=window,
+    )
+
+
 def matmul_param_count(params) -> int:
     """Matmul parameters for the analytic flop count: every >=2-d
     kernel except the embedding gather (llama's untied lm_head IS a
@@ -563,6 +599,7 @@ def main() -> int:
 
     probe_err = _probe(budget)
     if probe_err:
+        last = _last_measured()
         _emit(
             {
                 "metric": METRIC,
@@ -570,6 +607,7 @@ def main() -> int:
                 "unit": UNIT,
                 "vs_baseline": 0.0,
                 "error": probe_err,
+                **({"last_measured": last} if last else {}),
                 **({"chip_lock": lock_note} if lock_note else {}),
             }
         )
@@ -590,6 +628,7 @@ def main() -> int:
             time.sleep(10)
 
     if result is None:
+        last = _last_measured()
         _emit(
             {
                 "metric": METRIC,
@@ -597,6 +636,7 @@ def main() -> int:
                 "unit": UNIT,
                 "vs_baseline": 0.0,
                 "error": last_err,
+                **({"last_measured": last} if last else {}),
                 **({"chip_lock": lock_note} if lock_note else {}),
             }
         )

@@ -11,7 +11,11 @@ previously measured value), except the leading "pending — " prefix is
 preserved as-is until a real number replaces it.
 
 Also writes benchmarks/RESULTS.md with the raw parsed summary (sweep
-matrices included) for the round's record.
+matrices included) for the round's record, and
+benchmarks/LAST_MEASURED.json — the machine-readable "most recent real
+numbers" ledger that bench.py's error JSON points at when the chip is
+unreachable, so a failed driver probe still references the last
+measured values instead of a bare `value: 0.0` (VERDICT r4 next #9).
 
 Idempotent and chip-free: safe to run any time, from the watcher or by
 hand.
@@ -115,12 +119,84 @@ def parse_artifacts(out_dir: str) -> dict:
     lsweep = _json_lines(_read(out_dir, "llama-sweep.out"))
     if lsweep:
         data["llama_sweep"] = lsweep
+    wide = [
+        r for r in _json_lines(_read(out_dir, "wide.out"))
+        if "mfu_analytic" in r
+    ]
+    if wide:
+        data["wide"] = wide
     return data
+
+
+def write_last_measured(data: dict, today: str) -> None:
+    """benchmarks/LAST_MEASURED.json: the flat most-recent-real-numbers
+    ledger.  Merges over the previous file so a partial window never
+    erases an older measurement — each key keeps its own provenance
+    (source artifact + date)."""
+
+    path = os.path.join(HERE, "LAST_MEASURED.json")
+    try:
+        with open(path) as fh:
+            ledger = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        ledger = {}
+
+    def put(key: str, value, artifact: str) -> None:
+        if value is not None:
+            ledger[key] = {
+                "value": value,
+                "artifact": f"benchmarks/window_out/{artifact}",
+                "date": today,
+            }
+
+    b = data.get("bench", {})
+    put("resnet50_examples_per_sec_per_chip", b.get("value"), "bench.out")
+    put("resnet50_mfu_analytic", b.get("mfu_analytic"), "bench.out")
+    put(
+        "llama_train_tokens_per_sec_per_chip",
+        b.get("llama_train_tokens_per_sec_per_chip"), "bench.out",
+    )
+    put(
+        "llama_decode_tokens_per_sec",
+        b.get("llama_decode_tokens_per_sec"), "bench.out",
+    )
+    put(
+        "llama_decode_int8_tokens_per_sec",
+        b.get("llama_decode_int8_tokens_per_sec"), "bench.out",
+    )
+    t = data.get("train", {})
+    put("mnist_steps_per_sec_per_chip",
+        t.get("mnist_steps_per_sec_per_chip"), "train.out")
+    put("bert_base_steps_per_sec_per_chip",
+        t.get("bert_base_steps_per_sec_per_chip"), "train.out")
+    bt = data.get("batching", {})
+    put("batching_pool_tokens_per_sec",
+        bt.get("batching_pool_tokens_per_sec"), "batching.out")
+    put("batching_speedup", bt.get("batching_speedup"), "batching.out")
+    sp = data.get("speculative", {})
+    put("speculative_speedup", sp.get("speculative_speedup"),
+        "speculative.out")
+    wd = data.get("wide")
+    if wd:
+        put(
+            "wide_llama_best_mfu_analytic",
+            max(r["mfu_analytic"] for r in wd),
+            "wide.out",
+        )
+    f = data.get("flash_fwd_bwd", {})
+    put("flash_fwd_bwd_speedup_vs_xla_seq4k", f.get("speedup"), "flash.out")
+    w = data.get("window_fwd_bwd", {})
+    put("window_fwd_bwd_speedup_seq8k_w1k", w.get("speedup"), "flash.out")
+    with open(path, "w") as fh:
+        json.dump(ledger, fh, indent=1, sort_keys=True)
+        fh.write("\n")
 
 
 def build_rows(data: dict, today: str) -> dict[str, str]:
     """Map: row-key (first-cell prefix) -> fresh '| metric | value | setup |'
-    line.  Only rows with fresh numbers appear."""
+    line.  Only rows with fresh numbers appear.  Every setup cell names
+    the window artifact the number was parsed from (VERDICT r4 next #9:
+    BASELINE.md rows must be traceable to their evidence)."""
     rows: dict[str, str] = {}
     b = data.get("bench")
     if b:
@@ -132,7 +208,7 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             f"step {b.get('step_ms', '?')} ms, "
             f"**mfu_xla {mfux} / mfu_analytic {mfua}** "
             "(accounting: `benchmarks/FLOPS.md`) "
-            f"| 1× v5 lite, `bench.py`, {today} |"
+            f"| 1× v5 lite, `bench.py` → `window_out/bench.out`, {today} |"
         )
         if b.get("pipeline_examples_per_sec_per_chip"):
             ratio = b["pipeline_examples_per_sec_per_chip"] / b["value"]
@@ -156,7 +232,7 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
                 f"{b.get('pipeline_step_ms', '?')} ms — grain loader from "
                 "disk, uint8 wire, on-device normalise, prefetch 3"
                 f"{wire} "
-                f"| 1× v5 lite, `bench.py` `pipeline_*`, {today} |"
+                f"| 1× v5 lite, `bench.py` `pipeline_*` → `window_out/bench.out`, {today} |"
             )
         if b.get("llama_train_tokens_per_sec_per_chip"):
             rows["llama-mini train tokens/sec/chip"] = (
@@ -168,7 +244,7 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
                 f"step {b.get('llama_step_ms', '?')} ms, mfu_analytic "
                 f"{b.get('llama_mfu_analytic', '?')} / mfu_xla "
                 f"{b.get('llama_mfu_xla', '?')} "
-                f"| 1× v5 lite, `bench.py` `llama_*`, {today} |"
+                f"| 1× v5 lite, `bench.py` `llama_*` → `window_out/bench.out`, {today} |"
             )
         if b.get("llama_decode_tokens_per_sec"):
             int8 = b.get("llama_decode_int8_tokens_per_sec")
@@ -181,7 +257,7 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
                 "| llama-mini steady decode tokens/sec (KV-cache greedy, "
                 "batch 8) | "
                 f"**{b['llama_decode_tokens_per_sec']} tok/s**{int8_txt} "
-                f"| 1× v5 lite, `bench.py`, {today} |"
+                f"| 1× v5 lite, `bench.py` → `window_out/bench.out`, {today} |"
             )
     t = data.get("train")
     if t:
@@ -192,7 +268,7 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             f"BERT-base **{t.get('bert_base_steps_per_sec_per_chip', '?')} "
             f"steps/s** ({t.get('bert_base_examples_per_sec_per_chip', '?')} "
             "ex/s, seq 128, fsdp) "
-            f"| 1× v5 lite, `measure.py --section train`, {today} |"
+            f"| 1× v5 lite, `measure.py --section train` → `window_out/train.out`, {today} |"
         )
     bt = data.get("batching")
     if bt:
@@ -204,7 +280,7 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             f"tok/s** vs sequential "
             f"{bt['batching_sequential_tokens_per_sec']} tok/s — "
             f"**{bt['batching_speedup']}×** (`models/batching.py`) "
-            f"| 1× v5 lite, `measure.py --section batching`, {today} |"
+            f"| 1× v5 lite, `measure.py --section batching` → `window_out/batching.out`, {today} |"
         )
     sp = data.get("speculative")
     if sp:
@@ -216,7 +292,22 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             f"**{sp['speculative_speedup']}×**, acceptance "
             f"{sp.get('speculative_acceptance', '?')} "
             "(`models/speculative.py`) "
-            f"| 1× v5 lite, `measure.py --section speculative`, {today} |"
+            f"| 1× v5 lite, `measure.py --section speculative` → `window_out/speculative.out`, {today} |"
+        )
+    wd = data.get("wide")
+    if wd:
+        best = max(wd, key=lambda r: r["mfu_analytic"])
+        rows["Wide-llama (~700M) MFU existence proof"] = (
+            "| Wide-llama (~700M) MFU existence proof (d_model 2048, "
+            "12L, GQA 16q:8kv, SwiGLU — VERDICT r4 next #3) | best "
+            f"**mfu_analytic {best['mfu_analytic']}** / mfu_xla "
+            f"{best.get('mfu_xla', '?')} at seq {best.get('seq', '?')} "
+            f"batch {best.get('batch_per_chip', '?')} "
+            f"(remat {best.get('remat', '?')}), "
+            f"{best.get('tokens_per_sec_per_chip', '?')} tok/s/chip; "
+            f"{len(wd)} variants measured "
+            f"| 1× v5 lite, `llama_sweep.py --set wide` → "
+            f"`window_out/wide.out`, {today} |"
         )
     f = data.get("flash_fwd_bwd")
     if f:
@@ -226,7 +317,7 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             f"**{f['speedup']:.2f}×** ({f['flash_ms']:.1f} ms vs "
             f"{f['xla_ms']:.1f} ms); fwd-only was ~5× @ seq 8192 (round 1), "
             "runs seq 32k where XLA OOMs "
-            f"| 1× v5 lite, `tests/test_tpu_chip.py`, {today} |"
+            f"| 1× v5 lite, `tests/test_tpu_chip.py` → `window_out/flash.out`, {today} |"
         )
     w = data.get("window_fwd_bwd")
     if w:
@@ -235,7 +326,7 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             "window 1024 | "
             f"**{w['speedup']:.2f}×** ({w['win_ms']:.1f} ms vs "
             f"{w['full_ms']:.1f} ms full) "
-            f"| 1× v5 lite, `tests/test_tpu_chip.py`, {today} |"
+            f"| 1× v5 lite, `tests/test_tpu_chip.py` → `window_out/flash.out`, {today} |"
         )
     return rows
 
@@ -281,7 +372,7 @@ def write_results(data: dict, today: str) -> None:
             if key in data:
                 fh.write(f"## {key}\n\n```json\n"
                          + json.dumps(data[key], indent=1) + "\n```\n\n")
-        for key in ("sweep", "llama_sweep"):
+        for key in ("sweep", "llama_sweep", "wide"):
             if key in data:
                 fh.write(f"## {key}\n\n")
                 for row in data[key]:
@@ -300,6 +391,7 @@ def main() -> int:
     today = time.strftime("%Y-%m-%d")
     n = rewrite_baseline(build_rows(data, today))
     write_results(data, today)
+    write_last_measured(data, today)
     print(f"updated {n} BASELINE.md rows; wrote benchmarks/RESULTS.md "
           f"(sections: {', '.join(sorted(data))})")
     return 0

@@ -45,7 +45,23 @@ MATRIX = [
      {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "256"}),
 ]
 
-QUICK = MATRIX[:2]
+#: the >=0.40-MFU existence proof (VERDICT r4 next #3): llama-mini's
+#: d_model 1024 cannot fill the MXU; these run the ~700M d_model-2048
+#: config (bench.llama_wide_config) at serious widths.  Ordered so a
+#: window that dies mid-step still lands the headline shape first.
+WIDE = [
+    ("wide-s2048-b2-remat",
+     ["--model", "wide", "--seq", "2048", "--batch", "2", "--remat"]),
+    ("wide-s2048-b2-remat-xla",
+     ["--model", "wide", "--seq", "2048", "--batch", "2", "--remat",
+      "--flash", "0"]),
+    ("wide-s2048-b4-remat",
+     ["--model", "wide", "--seq", "2048", "--batch", "4", "--remat"]),
+    ("wide-s4096-b1-remat",
+     ["--model", "wide", "--seq", "4096", "--batch", "1", "--remat"]),
+    ("wide-s1024-b4-remat",
+     ["--model", "wide", "--seq", "1024", "--batch", "4", "--remat"]),
+]
 
 
 def run_one(label, extra, timeout, env_extra=None):
@@ -75,11 +91,19 @@ def run_one(label, extra, timeout, env_extra=None):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--set", default="main", choices=["main", "wide"],
+        help="main = the llama-mini variant/autotune matrix; wide = the "
+        "~700M existence-proof shapes (their own window step)",
+    )
     ap.add_argument("--timeout", type=int, default=600)
     args = ap.parse_args()
 
+    matrix = WIDE if args.set == "wide" else MATRIX
+    if args.quick:
+        matrix = matrix[:2]  # first two of the SELECTED set
     results = []
-    for entry in (QUICK if args.quick else MATRIX):
+    for entry in matrix:
         label, extra = entry[0], entry[1]
         env_extra = entry[2] if len(entry) > 2 else None
         print(f"--- {label} ...", flush=True)

@@ -22,6 +22,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument(
+        "--model", default="mini", choices=["mini", "wide"],
+        help="mini = ~120M llama-mini; wide = ~700M d_model-2048 "
+        "(the >=0.40-MFU existence-proof shape, VERDICT r4 next #3)",
+    )
     ap.add_argument("--batch", type=int, default=8, help="per chip")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--flash", default="1", choices=["0", "1"])
@@ -46,6 +51,7 @@ def main() -> int:
         _peak_flops,
         _step_flops,
         llama_mini_config,
+        llama_wide_config,
         matmul_param_count,
     )
     from tf_operator_tpu.models import LlamaLM, llama_loss
@@ -54,7 +60,8 @@ def main() -> int:
     devices = jax.devices()
     n_dev = len(devices)
     r = np.random.RandomState(0)
-    cfg = llama_mini_config(args.seq, window=args.window)
+    make_cfg = llama_mini_config if args.model == "mini" else llama_wide_config
+    cfg = make_cfg(args.seq, window=args.window)
     lm = {
         "input_ids": jnp.asarray(
             r.randint(0, 32000, size=(args.batch * n_dev, args.seq)), jnp.int32
@@ -80,6 +87,7 @@ def main() -> int:
     )
     peak = _peak_flops(devices[0])
     out = {
+        "model": args.model,
         "seq": args.seq,
         "batch_per_chip": args.batch,
         "flash": args.flash,

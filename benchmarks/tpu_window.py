@@ -76,6 +76,16 @@ STEPS = [
          "--section", "speculative"],
         1500,
     ),
+    # the >=0.40-MFU existence proof at serious width (~700M d_model
+    # 2048, VERDICT r4 next #3) — before the long sweeps so a dying
+    # tunnel can't lose it again.  5 variants x 480s child timeout =
+    # 2400s < 2700s step budget.
+    (
+        "wide",
+        [sys.executable, os.path.join(HERE, "llama_sweep.py"),
+         "--set", "wide", "--timeout", "480"],
+        2700,
+    ),
     (
         "trace",
         [
