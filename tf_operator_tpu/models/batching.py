@@ -27,6 +27,18 @@ a fixed pool of `slots` and one compiled step program:
   the network here) pays one round trip per K tokens instead of per
   token.  Requests join/retire at K-step granularity — worst case
   K-1 wasted slot-steps per finished request.
+- **Admission prefill off the pool lock.**  ``submit`` primes the
+  request's batch-1 cache (chunked prefill + first token) on the
+  submitter's own thread when a staging permit is free (permits bound
+  eager device-memory use at 2x slots); burst overflow queues
+  host-side and primes lazily during ``_admit`` with the lock dropped.
+  Either way only the single-scatter seating of a staged request runs
+  under the lock — concurrent submitters prefill in parallel and
+  submit never blocks.  Within the staging bound the driver's ``step``
+  never stalls behind a prefill (VERDICT r4 next #7); past it, lazy
+  admissions DO run on the driver thread — the deliberate trade under
+  overload, where the alternative (unbounded eager staging) is a
+  device OOM.
 
 Greedy and per-slot temperature sampling (a ``[slots]`` temperature
 vector; 0 = argmax).  Requests finish by token budget (byte-level
@@ -68,7 +80,8 @@ TOP_K_MAX = 64
 
 class _Request:
     __slots__ = ("rid", "prompt", "budget", "temperature", "top_k", "rng",
-                 "tokens", "done", "slot")
+                 "tokens", "done", "slot", "staged_cache", "staged_tok",
+                 "has_permit")
 
     def __init__(self, rid, prompt, budget, temperature, top_k, rng):
         self.rid = rid
@@ -80,6 +93,12 @@ class _Request:
         self.tokens: List[int] = []
         self.done = False
         self.slot: Optional[int] = None
+        # primed batch-1 cache + first token: staged by the submitter's
+        # thread when a staging permit was free (has_permit=True), else
+        # primed lazily at admission; consumed by the seating scatter
+        self.staged_cache = None
+        self.staged_tok = None
+        self.has_permit = False
 
 
 class ContinuousBatchingDecoder:
@@ -113,6 +132,24 @@ class ContinuousBatchingDecoder:
         self.max_len = cfg.max_len
         self._lock = threading.Lock()
         self._done_cond = threading.Condition(self._lock)
+        # guards the jitted-fn caches: _prefill now runs on submitter
+        # threads with NO pool lock held, so fn creation needs its own
+        # (tiny) critical section
+        self._compile_lock = threading.Lock()
+        # staging backpressure: every submitted-but-unseated request
+        # that prefilled EAGERLY holds a primed batch-1 KV cache in
+        # DEVICE memory, and serve_lm's ThreadingHTTPServer puts no
+        # bound on concurrent submitters — without a cap, a burst of
+        # N >> slots requests would pin N full-max_len caches and OOM
+        # the chip.  Permits bound eager staging at 2x slots; overflow
+        # requests queue host-side (prompt only) and prefill lazily at
+        # admission instead (also off the pool lock, in _admit), so
+        # submit NEVER blocks and device memory stays bounded at
+        # slots + 2*slots caches.
+        self._staging = threading.BoundedSemaphore(max(1, 2 * self.slots))
+        #: slots picked by an in-flight lazy admission (lock dropped
+        #: during its prefill) — excluded from the free list meanwhile
+        self._reserved = set()
         self._rid = 0
         self._queue: List[_Request] = []  # submitted, no slot yet
         self._active: Dict[int, _Request] = {}  # slot -> request
@@ -131,39 +168,41 @@ class ContinuousBatchingDecoder:
     # -- compiled pieces -------------------------------------------------
 
     def _prefill(self, width: int):
-        if width not in self._prefill_fns:
-            dmodel = self.dmodel
+        with self._compile_lock:
+            if width not in self._prefill_fns:
+                dmodel = self.dmodel
 
-            def prefill(params, cache, ids):  # ids [1, width]
-                logits, vars_ = dmodel.apply(
-                    {"params": materialize_tree(params), "cache": cache},
-                    ids,
-                    mutable=["cache"],
-                )
-                return vars_["cache"], logits[0, -1]
+                def prefill(params, cache, ids):  # ids [1, width]
+                    logits, vars_ = dmodel.apply(
+                        {"params": materialize_tree(params), "cache": cache},
+                        ids,
+                        mutable=["cache"],
+                    )
+                    return vars_["cache"], logits[0, -1]
 
-            self._prefill_fns[width] = jax.jit(prefill)
-            self.compile_count += 1
-        return self._prefill_fns[width]
+                self._prefill_fns[width] = jax.jit(prefill)
+                self.compile_count += 1
+            return self._prefill_fns[width]
 
     def _scatter(self):
         """Write one batch-1 cache + token into slot `i` of the stack."""
 
-        if self._scatter_fn is None:
+        with self._compile_lock:
+            if self._scatter_fn is None:
 
-            def scatter(stack, row_cache, last_tok, toks, i):
-                stack = jax.tree_util.tree_map(
-                    lambda s, r: lax.dynamic_update_index_in_dim(
-                        s, r, i, axis=0
-                    ),
-                    stack,
-                    row_cache,
-                )
-                return stack, toks.at[i].set(last_tok)
+                def scatter(stack, row_cache, last_tok, toks, i):
+                    stack = jax.tree_util.tree_map(
+                        lambda s, r: lax.dynamic_update_index_in_dim(
+                            s, r, i, axis=0
+                        ),
+                        stack,
+                        row_cache,
+                    )
+                    return stack, toks.at[i].set(last_tok)
 
-            self._scatter_fn = jax.jit(scatter)
-            self.compile_count += 1
-        return self._scatter_fn
+                self._scatter_fn = jax.jit(scatter)
+                self.compile_count += 1
+            return self._scatter_fn
 
     def _step(self):
         if self._step_fn is None:
@@ -262,52 +301,125 @@ class ContinuousBatchingDecoder:
         with self._lock:
             rid = self._rid
             self._rid += 1
-            # greedy requests never consume rng — storing a key would
-            # create a device array per request inside the pool lock
-            req = _Request(
-                rid, prompt, max_new_tokens, float(temperature), top_k, rng,
-            )
-            self._queue.append(req)
+        req = _Request(
+            rid, prompt, max_new_tokens, float(temperature), top_k, rng,
+        )
+        # fast path: prefill on the SUBMITTER'S thread, no pool lock
+        # held — concurrent submitters prefill in parallel (serialized
+        # only by the device queue) while the driver's step() keeps
+        # decoding.  When the staging permits are exhausted (request
+        # burst >> slots) the request queues host-side instead and
+        # prefills lazily at admission — submit never blocks, device
+        # memory stays bounded (see _staging in __init__).
+        if self._staging.acquire(blocking=False):
+            req.has_permit = True
+            try:
+                self._prefill_request(req)
+            except BaseException:
+                self._staging.release()
+                raise
+        with self._lock:
             self._results[rid] = req
-        return rid
-
-    def _admit_locked(self) -> None:
-        """Prefill queued requests into free slots (device work done
-        outside the step program; one scatter per admission)."""
-
-        free = [s for s in range(self.slots) if s not in self._active]
-        while self._queue and free:
-            req = self._queue.pop(0)
-            slot = free.pop(0)
-            cache = _init_cache_for(self.dmodel, 1)
-            last = None
-            off = 0
-            for width in window_chunks(req.prompt.size, self._max_chunk):
-                ids = jnp.asarray(
-                    req.prompt[off : off + width][None, :], jnp.int32
-                )
-                cache, last = self._prefill(width)(self.params, cache, ids)
-                off += width
-            # the prompt's first sampled token comes from prefill logits
-            if req.temperature > 0.0:
-                req.rng, r = jax.random.split(req.rng)
-                scaled = last / req.temperature
-                if req.top_k is not None:
-                    scaled = top_k_mask(scaled, req.top_k)
-                tok = jax.random.categorical(r, scaled).astype(jnp.int32)
-            else:
-                tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            self._cache, self._last_tok = self._scatter()(
-                self._cache, cache, tok, self._last_tok,
-                jnp.int32(slot),
-            )
-            req.tokens.append(int(tok))
-            req.slot = slot
-            if len(req.tokens) >= req.budget:
+            if req.staged_cache is not None and len(req.tokens) >= req.budget:
+                # budget-1, eagerly prefilled: already complete —
+                # never needs a slot
                 req.done = True
-                req.slot = None
+                self._release_staged_locked(req)
                 self._done_cond.notify_all()
             else:
+                self._queue.append(req)
+        return rid
+
+    def _release_staged_locked(self, req: _Request) -> None:
+        req.staged_cache = req.staged_tok = None
+        if req.has_permit:
+            req.has_permit = False
+            self._staging.release()
+
+    def _prefill_request(self, req: _Request) -> None:
+        """Device-side admission work for one request — chunked prompt
+        prefill into a fresh batch-1 cache plus the first sampled
+        token — run with NO pool lock held (VERDICT r4 next #7: the
+        old under-lock prefill serialized every concurrent submit()
+        and the driver's step() behind a multi-device-call prefill;
+        at seq-1k prompts on a tunneled chip that stalled the whole
+        pool per admission).  Trade-off: a request waiting for a free
+        slot holds its primed batch-1 cache in device memory — bounded
+        by the staging semaphore (2x slots permits; see __init__),
+        which blocks further submits instead of letting a request
+        burst OOM the chip."""
+
+        cache = _init_cache_for(self.dmodel, 1)
+        last = None
+        off = 0
+        for width in window_chunks(req.prompt.size, self._max_chunk):
+            ids = jnp.asarray(
+                req.prompt[off : off + width][None, :], jnp.int32
+            )
+            cache, last = self._prefill(width)(self.params, cache, ids)
+            off += width
+        # the prompt's first sampled token comes from prefill logits
+        if req.temperature > 0.0:
+            req.rng, r = jax.random.split(req.rng)
+            scaled = last / req.temperature
+            if req.top_k is not None:
+                scaled = top_k_mask(scaled, req.top_k)
+            tok = jax.random.categorical(r, scaled).astype(jnp.int32)
+        else:
+            tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        req.staged_cache = cache
+        req.staged_tok = tok
+        req.tokens.append(int(tok))
+
+    def _admit(self) -> None:
+        """Seat queued requests into free slots.  Three phases per
+        request: reserve a seat under the lock; prefill with the lock
+        DROPPED if the request arrived un-staged (permit-exhausted
+        burst took the lazy path); then scatter + bookkeeping under
+        the lock.  Lock-held admission device work is always exactly
+        ONE scatter call."""
+
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return
+                free = [
+                    s for s in range(self.slots)
+                    if s not in self._active and s not in self._reserved
+                ]
+                if not free:
+                    return
+                req = self._queue.pop(0)
+                slot = free[0]
+                self._reserved.add(slot)
+            try:
+                if req.staged_cache is None:
+                    self._prefill_request(req)  # lazy path, off-lock
+            except BaseException:
+                # the request must survive a transient prefill failure
+                # (device OOM is the exact pressure this path exists
+                # for): back to the queue head so a retried step() can
+                # admit it; without this the rid would leak in
+                # _results and its waiters would hang forever
+                with self._lock:
+                    self._reserved.discard(slot)
+                    self._queue.insert(0, req)
+                raise
+            with self._lock:
+                self._reserved.discard(slot)
+                if len(req.tokens) >= req.budget:
+                    # budget-1 on the lazy path: the prefill token
+                    # completed it — never needs the seat after all
+                    req.done = True
+                    self._release_staged_locked(req)
+                    self._done_cond.notify_all()
+                    continue
+                self._cache, self._last_tok = self._scatter()(
+                    self._cache, req.staged_cache, req.staged_tok,
+                    self._last_tok, jnp.int32(slot),
+                )
+                self._release_staged_locked(req)
+                req.slot = slot
                 self._active[slot] = req
 
     def step(self) -> int:
@@ -316,8 +428,8 @@ class ContinuousBatchingDecoder:
         append sampled tokens, retire finished requests.  Returns the
         number of still-active slots."""
 
+        self._admit()
         with self._lock:
-            self._admit_locked()
             if not self._active:
                 return 0
             temps = np.zeros((self.slots,), np.float32)
