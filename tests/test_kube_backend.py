@@ -1,0 +1,387 @@
+"""KubeBackend <-> MiniApiServer: the real-Kubernetes-protocol tier
+(VERDICT r4 next #4).
+
+What must hold: the 5 ClusterBackend verbs + watch work over genuine
+HTTP — real paths, real JSON shapes, labelSelector filtering, 409/404
+error mapping, resourceVersion bookkeeping, chunked watch streams with
+replay, and the client-go 410-Gone → re-list recovery.  The tier-3
+e2e scenarios then run the whole operator over this pair
+(tests/test_e2e_scenarios.py's parametrized harness); this file pins
+the protocol itself.
+"""
+
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.api.types import Container, ObjectMeta, PodPhase
+from tf_operator_tpu.backend.base import AlreadyExistsError, NotFoundError
+from tf_operator_tpu.backend.kube import (
+    KubeBackend,
+    pod_from_json,
+    pod_to_json,
+)
+from tf_operator_tpu.backend.kubesim import MiniApiServer
+from tf_operator_tpu.backend.objects import (
+    Pod,
+    PodGroup,
+    PodGroupPhase,
+    Service,
+    WatchEventType,
+)
+
+SLEEP = [sys.executable, "-c", "import time; time.sleep(600)"]
+EXIT0 = [sys.executable, "-c", "raise SystemExit(0)"]
+
+
+@pytest.fixture
+def pair():
+    sim = MiniApiServer().start()
+    backend = KubeBackend(sim.url)
+    yield sim, backend
+    backend.close()
+    sim.stop()
+
+
+def make_pod(name, command, labels=None, ns="default"):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+        containers=[Container(command=command)],
+    )
+
+
+def wait_until(cond, timeout=15.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(what)
+
+
+class TestCodec:
+    def test_pod_round_trips_through_k8s_json(self):
+        pod = Pod(
+            metadata=ObjectMeta(
+                name="p",
+                namespace="ns1",
+                labels={"a": "b"},
+                annotations={"x": "y"},
+                owner_uid="job-1",
+            ),
+            containers=[
+                Container(
+                    command=["python3", "train.py"],
+                    args=["--steps", "5"],
+                    env={"K": "V"},
+                )
+            ],
+            scheduler_name="volcano",
+            node_selector={"pool": "tpu"},
+            phase=PodPhase.FAILED,
+            exit_code=137,
+            chip_request=4,
+        )
+        back = pod_from_json(pod_to_json(pod))
+        assert back.metadata.name == "p"
+        assert back.metadata.namespace == "ns1"
+        assert back.metadata.owner_uid == "job-1"
+        assert back.metadata.labels == {"a": "b"}
+        assert back.containers[0].command == ["python3", "train.py"]
+        assert back.containers[0].env == {"K": "V"}
+        assert back.scheduler_name == "volcano"
+        assert back.node_selector == {"pool": "tpu"}
+        assert back.phase is PodPhase.FAILED
+        assert back.exit_code == 137
+        assert back.chip_request == 4
+
+    def test_chip_request_rides_tpu_resource_limits(self):
+        pod = Pod(
+            metadata=ObjectMeta(name="p"),
+            containers=[Container(command=["x"])],
+            chip_request=8,
+        )
+        j = pod_to_json(pod)
+        limits = j["spec"]["containers"][0]["resources"]["limits"]
+        assert limits["google.com/tpu"] == "8"
+
+
+class TestCrud:
+    def test_create_assigns_uid_and_resource_version(self, pair):
+        sim, b = pair
+        pod = make_pod("p1", SLEEP)
+        b.create_pod(pod)
+        assert pod.metadata.uid
+        assert pod.metadata.resource_version >= 1
+
+    def test_conflict_and_not_found_map_to_backend_errors(self, pair):
+        sim, b = pair
+        pod = make_pod("p1", SLEEP)
+        b.create_pod(pod)
+        with pytest.raises(AlreadyExistsError):
+            b.create_pod(make_pod("p1", SLEEP))
+        with pytest.raises(NotFoundError):
+            b.delete_pod("default", "nope")
+        with pytest.raises(NotFoundError):
+            b.delete_service("default", "nope")
+        assert b.get_pod("default", "nope") is None
+
+    def test_label_selector_filters_server_side(self, pair):
+        sim, b = pair
+        b.create_pod(make_pod("a0", SLEEP, labels={"job": "a", "i": "0"}))
+        b.create_pod(make_pod("a1", SLEEP, labels={"job": "a", "i": "1"}))
+        b.create_pod(make_pod("b0", SLEEP, labels={"job": "b"}))
+        assert {
+            p.metadata.name for p in b.list_pods("default", {"job": "a"})
+        } == {"a0", "a1"}
+        assert {
+            p.metadata.name
+            for p in b.list_pods("default", {"job": "a", "i": "1"})
+        } == {"a1"}
+        assert b.list_pods("default", {"job": "zzz"}) == []
+
+    def test_namespaces_isolate(self, pair):
+        sim, b = pair
+        b.create_pod(make_pod("p", SLEEP, ns="ns-a"))
+        assert b.list_pods("ns-a")[0].metadata.name == "p"
+        assert b.list_pods("ns-b") == []
+
+    def test_owner_patch_adopts_and_orphans(self, pair):
+        sim, b = pair
+        b.create_pod(make_pod("p1", SLEEP))
+        b.update_pod_owner("default", "p1", "job-uid-9")
+        assert b.get_pod("default", "p1").metadata.owner_uid == "job-uid-9"
+        b.update_pod_owner("default", "p1", None)
+        assert b.get_pod("default", "p1").metadata.owner_uid == ""
+
+    def test_services_and_podgroups_crud(self, pair):
+        sim, b = pair
+        svc = Service(
+            metadata=ObjectMeta(name="s1", labels={"j": "x"}),
+            selector={"j": "x"},
+            port=2222,
+        )
+        b.create_service(svc)
+        assert b.list_services("default", {"j": "x"})[0].port == 2222
+        g = PodGroup(
+            metadata=ObjectMeta(name="g1"), min_member=3, chip_request=8
+        )
+        b.create_pod_group(g)
+        got = b.get_pod_group("default", "g1")
+        assert (got.min_member, got.chip_request) == (3, 8)
+        b.update_pod_group("default", "g1", 5, 16)
+        got = b.get_pod_group("default", "g1")
+        assert (got.min_member, got.chip_request) == (5, 16)
+        b.delete_service("default", "s1")
+        b.delete_pod_group("default", "g1")
+        assert b.get_pod_group("default", "g1") is None
+
+    def test_snapshot_relists_all_kinds(self, pair):
+        sim, b = pair
+        b.create_pod(make_pod("p1", SLEEP))
+        b.create_service(
+            Service(metadata=ObjectMeta(name="s1"), selector={}, port=1)
+        )
+        b.create_pod_group(PodGroup(metadata=ObjectMeta(name="g1")))
+        pods, svcs, groups = b.snapshot()
+        assert [p.metadata.name for p in pods] == ["p1"]
+        assert [s.metadata.name for s in svcs] == ["s1"]
+        assert [g.metadata.name for g in groups] == ["g1"]
+
+
+class TestKubeletSim:
+    def test_pod_runs_exits_and_surfaces_exit_code(self, pair):
+        sim, b = pair
+        b.create_pod(make_pod("ok", EXIT0))
+        b.create_pod(
+            make_pod("bad", [sys.executable, "-c", "raise SystemExit(3)"])
+        )
+        wait_until(
+            lambda: (
+                (p := b.get_pod("default", "ok")) is not None
+                and p.phase is PodPhase.SUCCEEDED
+            ),
+            what="ok pod success",
+        )
+        wait_until(
+            lambda: (
+                (p := b.get_pod("default", "bad")) is not None
+                and p.phase is PodPhase.FAILED
+            ),
+            what="bad pod failure",
+        )
+        assert b.get_pod("default", "ok").exit_code == 0
+        assert b.get_pod("default", "bad").exit_code == 3
+
+    def test_pod_log_served_over_http(self, pair):
+        sim, b = pair
+        b.create_pod(
+            make_pod("talk", [sys.executable, "-c", "print('from the pod')"])
+        )
+        wait_until(
+            lambda: "from the pod" in b.pod_log("default", "talk"),
+            what="pod log content",
+        )
+
+    def test_delete_kills_running_process(self, pair):
+        sim, b = pair
+        b.create_pod(make_pod("lived", SLEEP))
+        wait_until(
+            lambda: (
+                (p := b.get_pod("default", "lived")) is not None
+                and p.phase is PodPhase.RUNNING
+            ),
+            what="pod running",
+        )
+        b.delete_pod("default", "lived")
+        assert b.get_pod("default", "lived") is None
+        wait_until(lambda: not sim._procs, what="process reaped")
+
+
+class TestGangAdmission:
+    def test_capacity_gates_grants_and_regrants_on_release(self):
+        sim = MiniApiServer(total_chips=8).start()
+        b = KubeBackend(sim.url)
+        try:
+            b.create_pod_group(
+                PodGroup(metadata=ObjectMeta(name="g1"), chip_request=8)
+            )
+            b.create_pod_group(
+                PodGroup(metadata=ObjectMeta(name="g2"), chip_request=8)
+            )
+            assert b.get_pod_group("default", "g1").phase is PodGroupPhase.GRANTED
+            assert b.get_pod_group("default", "g2").phase is PodGroupPhase.PENDING
+            b.delete_pod_group("default", "g1")
+            wait_until(
+                lambda: b.get_pod_group("default", "g2").phase
+                is PodGroupPhase.GRANTED,
+                what="g2 regrant",
+            )
+        finally:
+            b.close()
+            sim.stop()
+
+    def test_gang_blocked_pod_stays_pending_until_grant(self):
+        from tf_operator_tpu.api.types import ANNOTATION_GANG_GROUP
+
+        sim = MiniApiServer(total_chips=4).start()
+        b = KubeBackend(sim.url)
+        try:
+            b.create_pod_group(
+                PodGroup(metadata=ObjectMeta(name="big"), chip_request=8)
+            )
+            pod = make_pod("member", EXIT0)
+            pod.metadata.annotations[ANNOTATION_GANG_GROUP] = "big"
+            b.create_pod(pod)
+            time.sleep(0.6)  # several kubelet ticks
+            assert b.get_pod("default", "member").phase is PodPhase.PENDING
+            # capacity grows (operator resize): the gang grants and the
+            # member finally runs to completion
+            b.update_pod_group("default", "big", 1, 4)
+            wait_until(
+                lambda: b.get_pod("default", "member").phase
+                is PodPhase.SUCCEEDED,
+                what="member ran after grant",
+            )
+        finally:
+            b.close()
+            sim.stop()
+
+
+class TestWatch:
+    def test_events_stream_to_subscribers(self, pair):
+        sim, b = pair
+        events = []
+        b.subscribe(lambda ev: events.append((ev.type, ev.kind, ev.obj.metadata.name)))
+        time.sleep(0.3)  # streams up
+        b.create_pod(make_pod("w1", EXIT0))
+        wait_until(
+            lambda: (WatchEventType.ADDED, "Pod", "w1") in events,
+            what="ADDED event",
+        )
+        wait_until(
+            lambda: any(
+                t is WatchEventType.MODIFIED and n == "w1"
+                for t, _, n in events
+            ),
+            what="MODIFIED events from kubelet phases",
+        )
+        b.delete_pod("default", "w1")
+        wait_until(
+            lambda: (WatchEventType.DELETED, "Pod", "w1") in events,
+            what="DELETED event",
+        )
+
+    def test_watch_replays_from_resource_version(self, pair):
+        """A watch opened at rv=N must replay everything after N —
+        the informer's no-lost-events contract."""
+
+        sim, b = pair
+        pod = make_pod("old", SLEEP)
+        b.create_pod(pod)
+        rv_after_create = pod.metadata.resource_version
+        b.create_pod(make_pod("new", SLEEP))
+        # raw protocol: watch from the older rv sees BOTH subsequent
+        # events (new's ADDED, old's Running MODIFIED) but not old's ADDED
+        conn_url = (
+            f"{sim.url}/api/v1/pods?watch=true"
+            f"&resourceVersion={rv_after_create}"
+        )
+        lines = []
+        with urllib.request.urlopen(conn_url, timeout=5) as resp:
+            deadline = time.time() + 5
+            while time.time() < deadline and len(lines) < 2:
+                line = resp.readline()
+                if line.strip():
+                    lines.append(json.loads(line))
+        names = [d["object"]["metadata"]["name"] for d in lines]
+        assert "new" in names
+        assert not any(
+            d["type"] == "ADDED" and d["object"]["metadata"]["name"] == "old"
+            for d in lines
+        )
+
+    def test_expired_resource_version_gets_410_and_client_recovers(self, pair):
+        sim, b = pair
+        # age the log out: tiny window
+        sim.store.log = type(sim.store.log)(maxlen=4)
+        for i in range(8):
+            b.create_service(
+                Service(metadata=ObjectMeta(name=f"s{i}"), selector={}, port=1)
+            )
+        # raw protocol: rv=1 is long gone -> 410
+        req = urllib.request.Request(
+            f"{sim.url}/api/v1/services?watch=true&resourceVersion=1"
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 410
+        # the client's ListAndWatch recovers: subscribe (internally
+        # re-lists) and still sees NEW events
+        events = []
+        b.subscribe(lambda ev: events.append((ev.kind, ev.obj.metadata.name)))
+        time.sleep(0.3)
+        b.create_service(
+            Service(metadata=ObjectMeta(name="fresh"), selector={}, port=1)
+        )
+        wait_until(
+            lambda: ("Service", "fresh") in events, what="post-410 event"
+        )
+
+    def test_concurrent_watchers_all_see_events(self, pair):
+        sim, b2 = pair
+        b1 = KubeBackend(sim.url)
+        try:
+            seen1, seen2 = [], []
+            b1.subscribe(lambda ev: seen1.append(ev.obj.metadata.name))
+            b2.subscribe(lambda ev: seen2.append(ev.obj.metadata.name))
+            time.sleep(0.3)
+            b2.create_pod(make_pod("shared", SLEEP))
+            wait_until(lambda: "shared" in seen1, what="watcher 1")
+            wait_until(lambda: "shared" in seen2, what="watcher 2")
+        finally:
+            b1.close()

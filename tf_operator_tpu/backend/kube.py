@@ -1,0 +1,616 @@
+"""KubeBackend: the 5 ClusterBackend verbs + watch spoken as REAL
+Kubernetes HTTP protocol (VERDICT r4 next #4).
+
+Parity: the reference's client-go tier (SURVEY.md §1 L1 "Generated API
+machinery", §2c row "Kubernetes API (HTTP/gRPC watch)") — typed
+clients + shared watch streams against a kube-apiserver.  This client
+speaks the genuine wire protocol:
+
+- ``POST/GET/DELETE/PATCH`` against the real paths
+  (``/api/v1/namespaces/{ns}/pods``,
+  ``/apis/scheduling.volcano.sh/v1beta1/.../podgroups``), objects in
+  real Kubernetes JSON (the same shapes ``backend/gke.py`` compiles —
+  metadata/spec/status, ownerReferences, labelSelector list filters);
+- ``?watch=true&resourceVersion=N`` chunked watch streams, one
+  ``{"type": "ADDED"|"MODIFIED"|"DELETED", "object": {...}}`` JSON
+  document per line, exactly client-go's framing;
+- 409 Conflict → AlreadyExistsError, 404 → NotFoundError, and
+  410 Gone on an expired watch window → full re-list + re-watch from
+  the fresh resourceVersion (the client-go ListAndWatch recovery).
+
+There is no cluster on this box (SURVEY.md §7: "a real GKE/TPU-VM
+backend is an interface to be filled later"), so the server half is
+``backend/kubesim.py`` — an in-repo threaded mini-apiserver with a
+kubelet/scheduler simulation that runs pods as local subprocesses.
+The client works against anything that speaks this protocol subset;
+pointing it at a real apiserver is a ``--kube-url`` away (plus auth,
+which the sim does not model).
+
+The JSON codec lives here (``pod_to_json``/``pod_from_json`` etc.) and
+is shared by the sim server, so both sides agree by construction and
+the golden GKE compiler shapes stay the single source of truth for
+what a compiled pod looks like.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.client import HTTPConnection
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.api.types import Container, ObjectMeta, PodPhase, Port
+from tf_operator_tpu.backend.base import (
+    AlreadyExistsError,
+    ClusterBackend,
+    NotFoundError,
+)
+from tf_operator_tpu.backend.local import LocalResolver
+from tf_operator_tpu.backend.objects import (
+    Pod,
+    PodGroup,
+    PodGroupPhase,
+    Service,
+    WatchEvent,
+    WatchEventType,
+    WatchHandler,
+)
+
+#: volcano's group apiVersion — the same wire shape backend/gke.py
+#: compiles for gang scheduling
+PODGROUP_API = "apis/scheduling.volcano.sh/v1beta1"
+TPU_RESOURCE = "google.com/tpu"
+
+
+# ---------------------------------------------------------------------------
+# JSON codec: repo dataclasses <-> real Kubernetes object shapes
+# ---------------------------------------------------------------------------
+
+
+def _meta_to_json(meta: ObjectMeta) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "name": meta.name,
+        "namespace": meta.namespace,
+    }
+    if meta.uid:
+        out["uid"] = meta.uid
+    if meta.labels:
+        out["labels"] = dict(meta.labels)
+    if meta.annotations:
+        out["annotations"] = dict(meta.annotations)
+    if meta.resource_version:
+        out["resourceVersion"] = str(meta.resource_version)
+    if meta.owner_uid:
+        out["ownerReferences"] = [
+            {
+                "apiVersion": "tpujob.dist/v1",
+                "kind": "TPUJob",
+                "uid": meta.owner_uid,
+                "controller": True,
+            }
+        ]
+    return out
+
+
+def _meta_from_json(m: Dict[str, Any]) -> ObjectMeta:
+    owner_uid = ""
+    for ref in m.get("ownerReferences", []):
+        if ref.get("controller"):
+            owner_uid = ref.get("uid", "")
+            break
+    rv = m.get("resourceVersion", "0")
+    return ObjectMeta(
+        name=m.get("name", ""),
+        namespace=m.get("namespace", "default"),
+        uid=m.get("uid", ""),
+        labels=dict(m.get("labels", {})),
+        annotations=dict(m.get("annotations", {})),
+        resource_version=int(rv) if str(rv).isdigit() else 0,
+        owner_uid=owner_uid,
+    )
+
+
+def _container_to_json(c: Container, chip_request: int) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"name": c.name}
+    if c.image:
+        out["image"] = c.image
+    if c.command:
+        out["command"] = list(c.command)
+    if c.args:
+        out["args"] = list(c.args)
+    if c.env:
+        out["env"] = [
+            {"name": k, "value": v} for k, v in sorted(c.env.items())
+        ]
+    if c.ports:
+        out["ports"] = [p.to_dict() for p in c.ports]
+    resources = {k: dict(v) for k, v in (c.resources or {}).items()}
+    if chip_request:
+        limits = dict(resources.get("limits", {}))
+        limits[TPU_RESOURCE] = str(chip_request)
+        resources["limits"] = limits
+    if resources:
+        out["resources"] = resources
+    if c.working_dir:
+        out["workingDir"] = c.working_dir
+    return out
+
+
+def _container_from_json(c: Dict[str, Any]) -> Container:
+    resources = {
+        k: dict(v) for k, v in c.get("resources", {}).items()
+        if isinstance(v, dict)
+    }
+    # the chip request round-trips separately (pod_from_json); keep the
+    # raw resources dict as-is so unknown limits survive
+    return Container(
+        name=c.get("name", "tensorflow"),
+        image=c.get("image", ""),
+        command=list(c.get("command", [])),
+        args=list(c.get("args", [])),
+        env={e["name"]: e.get("value", "") for e in c.get("env", [])},
+        ports=[
+            Port(name=p.get("name", ""), container_port=p["containerPort"])
+            for p in c.get("ports", [])
+        ],
+        resources=resources,
+        working_dir=c.get("workingDir", ""),
+    )
+
+
+def pod_to_json(pod: Pod) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "containers": [
+            _container_to_json(c, pod.chip_request) for c in pod.containers
+        ],
+    }
+    if pod.node_selector:
+        spec["nodeSelector"] = dict(pod.node_selector)
+    if pod.scheduler_name:
+        spec["schedulerName"] = pod.scheduler_name
+    status: Dict[str, Any] = {"phase": pod.phase.value}
+    cstatus: Dict[str, Any] = {
+        "name": pod.containers[0].name if pod.containers else "tensorflow",
+        "restartCount": pod.restart_count,
+    }
+    if pod.exit_code is not None:
+        cstatus["state"] = {"terminated": {"exitCode": pod.exit_code}}
+    status["containerStatuses"] = [cstatus]
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": _meta_to_json(pod.metadata),
+        "spec": spec,
+        "status": status,
+    }
+
+
+def pod_from_json(obj: Dict[str, Any]) -> Pod:
+    spec = obj.get("spec", {})
+    status = obj.get("status", {})
+    containers = [_container_from_json(c) for c in spec.get("containers", [])]
+    chip_request = 0
+    for c in spec.get("containers", []):
+        limits = c.get("resources", {}).get("limits", {})
+        if TPU_RESOURCE in limits:
+            chip_request = int(limits[TPU_RESOURCE])
+            break
+    exit_code = None
+    restart_count = 0
+    for cs in status.get("containerStatuses", []):
+        restart_count = int(cs.get("restartCount", 0))
+        term = cs.get("state", {}).get("terminated")
+        if term is not None and "exitCode" in term:
+            exit_code = int(term["exitCode"])
+        break
+    try:
+        phase = PodPhase(status.get("phase", "Pending"))
+    except ValueError:
+        phase = PodPhase.UNKNOWN
+    return Pod(
+        metadata=_meta_from_json(obj.get("metadata", {})),
+        containers=containers,
+        scheduler_name=spec.get("schedulerName", ""),
+        node_selector=dict(spec.get("nodeSelector", {})),
+        phase=phase,
+        exit_code=exit_code,
+        restart_count=restart_count,
+        chip_request=chip_request,
+    )
+
+
+def service_to_json(svc: Service) -> Dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta_to_json(svc.metadata),
+        "spec": {
+            "clusterIP": "None",
+            "selector": dict(svc.selector),
+            "ports": [{"port": svc.port}] if svc.port else [],
+        },
+    }
+
+
+def service_from_json(obj: Dict[str, Any]) -> Service:
+    spec = obj.get("spec", {})
+    ports = spec.get("ports", [])
+    return Service(
+        metadata=_meta_from_json(obj.get("metadata", {})),
+        selector=dict(spec.get("selector", {})),
+        port=int(ports[0]["port"]) if ports else 0,
+    )
+
+
+def podgroup_to_json(group: PodGroup) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "apiVersion": "scheduling.volcano.sh/v1beta1",
+        "kind": "PodGroup",
+        "metadata": _meta_to_json(group.metadata),
+        "spec": {"minMember": group.min_member},
+        "status": {"phase": group.phase.value},
+    }
+    if group.chip_request:
+        out["spec"]["minResources"] = {TPU_RESOURCE: str(group.chip_request)}
+    return out
+
+
+def podgroup_from_json(obj: Dict[str, Any]) -> PodGroup:
+    spec = obj.get("spec", {})
+    chip = spec.get("minResources", {}).get(TPU_RESOURCE, "0")
+    try:
+        phase = PodGroupPhase(obj.get("status", {}).get("phase", "Pending"))
+    except ValueError:
+        phase = PodGroupPhase.PENDING
+    return PodGroup(
+        metadata=_meta_from_json(obj.get("metadata", {})),
+        min_member=int(spec.get("minMember", 0)),
+        chip_request=int(chip),
+        phase=phase,
+    )
+
+
+KINDS = {
+    "Pod": (pod_to_json, pod_from_json),
+    "Service": (service_to_json, service_from_json),
+    "PodGroup": (podgroup_to_json, podgroup_from_json),
+}
+
+
+def selector_param(selector: Optional[Dict[str, str]]) -> str:
+    if not selector:
+        return ""
+    return ",".join(f"{k}={v}" for k, v in sorted(selector.items()))
+
+
+def parse_selector(param: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for part in param.split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HTTP error mapping
+# ---------------------------------------------------------------------------
+
+
+class ApiError(RuntimeError):
+    def __init__(self, status: int, body: str):
+        self.status = status
+        super().__init__(f"apiserver {status}: {body[:200]}")
+
+
+class GoneError(ApiError):
+    """410: the requested resourceVersion fell out of the watch window."""
+
+
+# ---------------------------------------------------------------------------
+# the client
+# ---------------------------------------------------------------------------
+
+
+class KubeBackend(ClusterBackend):
+    """ClusterBackend over the Kubernetes HTTP protocol.
+
+    One background thread per resource kind runs the client-go
+    ListAndWatch loop: list (capturing resourceVersion) → chunked
+    watch from that version → dispatch events to subscribers → on
+    disconnect or 410 Gone, re-list and re-watch.  Writes are plain
+    REST verbs; the async gap between a write and its watch event is
+    exactly the informer-cache lag the Expectations machinery guards
+    (the sim can be told to delay delivery to test this, but the
+    protocol itself is already asynchronous).
+    """
+
+    def __init__(self, base_url: str, connect_timeout: float = 5.0):
+        u = urllib.parse.urlparse(base_url)
+        if u.scheme != "http":
+            raise ValueError(f"KubeBackend speaks plain http; got {base_url!r}")
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or 80
+        self.timeout = connect_timeout
+        #: local subprocess pods → local address resolution, same
+        #: contract as LocalProcessBackend.resolver
+        self.resolver = LocalResolver()
+        self._handlers: List[WatchHandler] = []
+        self._handlers_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._watchers: List[threading.Thread] = []
+        self._watch_conns: List[HTTPConnection] = []
+        self._started = False
+
+    # -- plain REST ---------------------------------------------------------
+
+    def _request(
+        self, method: str, path: str, body: Optional[dict] = None
+    ) -> dict:
+        conn = HTTPConnection(self.host, self.port, timeout=self.timeout)
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if payload else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            text = resp.read().decode(errors="replace")
+            if resp.status == 404:
+                raise NotFoundError(path)
+            if resp.status == 409:
+                raise AlreadyExistsError(path)
+            if resp.status == 410:
+                raise GoneError(410, text)
+            if resp.status >= 400:
+                raise ApiError(resp.status, text)
+            return json.loads(text) if text else {}
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _collection(kind: str, namespace: Optional[str] = None) -> str:
+        prefix = "/api/v1" if kind in ("Pod", "Service") else f"/{PODGROUP_API}"
+        plural = {"Pod": "pods", "Service": "services", "PodGroup": "podgroups"}[kind]
+        if namespace is None:
+            return f"{prefix}/{plural}"
+        return f"{prefix}/namespaces/{namespace}/{plural}"
+
+    def _create(self, kind: str, obj) -> None:
+        to_json, _ = KINDS[kind]
+        ns = obj.metadata.namespace
+        out = self._request("POST", self._collection(kind, ns), to_json(obj))
+        # the server assigns uid + resourceVersion; reflect them back
+        # into the caller's object like client-go's Create does
+        meta = out.get("metadata", {})
+        obj.metadata.uid = meta.get("uid", obj.metadata.uid)
+        rv = meta.get("resourceVersion", "0")
+        obj.metadata.resource_version = int(rv) if str(rv).isdigit() else 0
+
+    def _delete(self, kind: str, namespace: str, name: str) -> None:
+        self._request(
+            "DELETE", f"{self._collection(kind, namespace)}/{name}"
+        )
+
+    def _list(
+        self, kind: str, namespace: Optional[str],
+        selector: Optional[Dict[str, str]] = None,
+    ) -> tuple:
+        _, from_json = KINDS[kind]
+        path = self._collection(kind, namespace)
+        sel = selector_param(selector)
+        if sel:
+            path += "?labelSelector=" + urllib.parse.quote(sel)
+        out = self._request("GET", path)
+        rv = out.get("metadata", {}).get("resourceVersion", "0")
+        items = [from_json(o) for o in out.get("items", [])]
+        return items, int(rv) if str(rv).isdigit() else 0
+
+    # -- ClusterBackend verbs ----------------------------------------------
+
+    def create_pod(self, pod: Pod) -> None:
+        self._create("Pod", pod)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._delete("Pod", namespace, name)
+
+    def list_pods(self, namespace: str, selector=None) -> List[Pod]:
+        items, _ = self._list("Pod", namespace, selector)
+        return items
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        try:
+            out = self._request(
+                "GET", f"{self._collection('Pod', namespace)}/{name}"
+            )
+        except NotFoundError:
+            return None
+        return pod_from_json(out)
+
+    def update_pod_owner(
+        self, namespace: str, name: str, owner_uid: Optional[str]
+    ) -> None:
+        refs = (
+            [{
+                "apiVersion": "tpujob.dist/v1",
+                "kind": "TPUJob",
+                "uid": owner_uid,
+                "controller": True,
+            }]
+            if owner_uid
+            else []
+        )
+        self._request(
+            "PATCH",
+            f"{self._collection('Pod', namespace)}/{name}",
+            {"metadata": {"ownerReferences": refs}},
+        )
+
+    def pod_log(self, namespace: str, name: str) -> str:
+        conn = HTTPConnection(self.host, self.port, timeout=self.timeout)
+        try:
+            conn.request(
+                "GET", f"{self._collection('Pod', namespace)}/{name}/log"
+            )
+            resp = conn.getresponse()
+            text = resp.read().decode(errors="replace")
+            return text if resp.status == 200 else ""
+        finally:
+            conn.close()
+
+    def create_service(self, svc: Service) -> None:
+        self._create("Service", svc)
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        self._delete("Service", namespace, name)
+
+    def list_services(self, namespace: str, selector=None) -> List[Service]:
+        items, _ = self._list("Service", namespace, selector)
+        return items
+
+    def create_pod_group(self, group: PodGroup) -> None:
+        self._create("PodGroup", group)
+
+    def delete_pod_group(self, namespace: str, name: str) -> None:
+        self._delete("PodGroup", namespace, name)
+
+    def update_pod_group(
+        self, namespace: str, name: str, min_member: int, chip_request: int
+    ) -> None:
+        body: Dict[str, Any] = {"spec": {"minMember": min_member}}
+        if chip_request:
+            body["spec"]["minResources"] = {TPU_RESOURCE: str(chip_request)}
+        else:
+            body["spec"]["minResources"] = {}
+        self._request(
+            "PATCH",
+            f"{self._collection('PodGroup', namespace)}/{name}",
+            body,
+        )
+
+    def get_pod_group(self, namespace: str, name: str) -> Optional[PodGroup]:
+        try:
+            out = self._request(
+                "GET", f"{self._collection('PodGroup', namespace)}/{name}"
+            )
+        except NotFoundError:
+            return None
+        return podgroup_from_json(out)
+
+    def snapshot(self):
+        """Full re-list of all three kinds (informer resync)."""
+
+        pods, _ = self._list("Pod", None)
+        services, _ = self._list("Service", None)
+        groups, _ = self._list("PodGroup", None)
+        return pods, services, groups
+
+    # -- watch --------------------------------------------------------------
+
+    def subscribe(self, handler: WatchHandler) -> None:
+        with self._handlers_lock:
+            self._handlers.append(handler)
+            if not self._started:
+                self._started = True
+                for kind in KINDS:
+                    t = threading.Thread(
+                        target=self._watch_loop, args=(kind,), daemon=True,
+                        name=f"kube-watch-{kind.lower()}",
+                    )
+                    self._watchers.append(t)
+                    t.start()
+
+    def _dispatch(self, ev: WatchEvent) -> None:
+        with self._handlers_lock:
+            handlers = list(self._handlers)
+        for h in handlers:
+            h(ev)
+
+    def _watch_loop(self, kind: str) -> None:
+        """client-go ListAndWatch: list → watch from rv → on
+        disconnect/410, list again and re-watch.  Events between the
+        dropped stream and the fresh list are healed by the informer's
+        periodic resync (snapshot), the same division of labour as the
+        reference."""
+
+        _, from_json = KINDS[kind]
+        rv = 0
+        while not self._stop.is_set():
+            try:
+                if rv == 0:
+                    _, rv = self._list(kind, None)
+                # resume from the last event the stream delivered — a
+                # cleanly closed stream (real apiservers recycle watch
+                # connections every few minutes) re-watches from there,
+                # NOT from the stale list rv (which would replay every
+                # event since the initial list as duplicates)
+                rv = self._stream(kind, rv, from_json)
+            except GoneError:
+                rv = 0  # expired window: full re-list
+            except Exception:
+                # anything else is a broken stream (half-closed socket
+                # raises assorted http.client internals mid-chunk);
+                # recover exactly like client-go: re-list, re-watch
+                if self._stop.is_set():
+                    return
+                time.sleep(0.05)
+                rv = 0
+
+    def _stream(self, kind: str, rv: int, from_json) -> int:
+        """One watch connection; returns the resourceVersion of the
+        last event delivered (== the passed rv if none arrived) so the
+        caller can resume without duplicates after a clean close."""
+
+        conn = HTTPConnection(self.host, self.port)
+        self._watch_conns.append(conn)
+        try:
+            path = (
+                f"{self._collection(kind, None)}"
+                f"?watch=true&resourceVersion={rv}"
+            )
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            if resp.status == 410:
+                raise GoneError(410, "")
+            if resp.status != 200:
+                raise ApiError(resp.status, "")
+            while not self._stop.is_set():
+                line = resp.readline()
+                if not line:
+                    return rv  # clean close: resume from last event
+                line = line.strip()
+                if not line:
+                    continue
+                doc = json.loads(line)
+                if doc.get("type") == "ERROR":
+                    status = doc.get("object", {})
+                    if status.get("code") == 410:
+                        raise GoneError(410, "")
+                    raise ApiError(int(status.get("code", 500)), str(status))
+                obj = from_json(doc["object"])
+                rv = max(rv, obj.metadata.resource_version)
+                self._dispatch(
+                    WatchEvent(
+                        type=WatchEventType(doc["type"]), kind=kind, obj=obj
+                    )
+                )
+            return rv
+        finally:
+            try:
+                self._watch_conns.remove(conn)
+            except ValueError:
+                pass
+            conn.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        for conn in list(self._watch_conns):
+            try:
+                conn.sock and conn.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            conn.close()
+        for t in self._watchers:
+            t.join(timeout=2.0)

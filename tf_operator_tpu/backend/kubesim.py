@@ -1,0 +1,625 @@
+"""MiniApiServer: an in-repo kube-apiserver simulator (VERDICT r4
+next #4's server half).
+
+Speaks the protocol subset ``backend/kube.py``'s client needs — which
+is the subset the reference's operator needs from a real apiserver
+(SURVEY.md §1 L1, §3.2's write boundary):
+
+- CRUD on pods/services (``/api/v1``) and volcano podgroups
+  (``/apis/scheduling.volcano.sh/v1beta1``), objects stored as real
+  Kubernetes JSON; 409 on create conflicts, 404 on missing objects;
+- ``labelSelector`` list filtering;
+- a global monotonically increasing **resourceVersion**, stamped on
+  every write and returned on lists;
+- **chunked watch streams** (``?watch=true&resourceVersion=N``): one
+  JSON document per line, replayed from a bounded event log (requests
+  below the log window get the real apiserver's **410 Gone**, forcing
+  the client's re-list — the exact client-go recovery path), then live;
+- ``PATCH`` merge semantics for ownerReferences (adoption/orphaning)
+  and podgroup resize;
+- ``GET .../pods/{name}/log`` serving the pod's stdout file.
+
+Beyond the protocol, the sim embeds what a real cluster provides
+around the apiserver so the tier-3 e2e suite can run unchanged:
+
+- **scheduler sim**: volcano-style gang admission — a podgroup is
+  Granted only if its chip request fits ``total_chips`` (None =
+  unlimited); pods carrying the gang annotation stay Pending until
+  their group grants (same semantics as ``backend/fake.py``);
+- **kubelet sim**: admissible Pending pods' commands spawn as real
+  local subprocesses (the ``backend/local.py`` contract: repo root as
+  WORKDIR, PYTHONPATH reset, process-group isolation); exits surface
+  as pod phase + containerStatuses exit codes through the store, with
+  watch events.
+
+Usage:
+    sim = MiniApiServer(total_chips=None); sim.start()
+    backend = KubeBackend(sim.url)           # backend/kube.py
+    ... run the operator against `backend` ...
+    backend.close(); sim.stop()
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import threading
+import urllib.parse
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from queue import Empty, Queue
+from typing import Any, Dict, List, Optional, Tuple
+
+from tf_operator_tpu.api.types import ANNOTATION_GANG_GROUP
+from tf_operator_tpu.backend.base import match_selector
+from tf_operator_tpu.backend.kube import parse_selector
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+#: events kept for watch replay; older resourceVersions get 410 Gone
+EVENT_LOG_WINDOW = 4096
+
+_PLURALS = {"pods": "Pod", "services": "Service", "podgroups": "PodGroup"}
+
+
+def _labels(obj: Dict[str, Any]) -> Dict[str, str]:
+    return obj.get("metadata", {}).get("labels", {}) or {}
+
+
+class _Store:
+    """The apiserver state: objects + resourceVersion + event log +
+    watch fan-out.  One lock; every mutation stamps a fresh global
+    resourceVersion, appends to the bounded event log, and wakes
+    watchers."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.rv = 0
+        #: (kind, ns, name) -> k8s JSON object
+        self.objects: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+        #: bounded replay window: (rv, kind, event-type, object-snapshot)
+        self.log: deque = deque(maxlen=EVENT_LOG_WINDOW)
+        self.watchers: List[Queue] = []
+        self._uid = 0
+
+    def next_uid(self, prefix: str) -> str:
+        self._uid += 1
+        return f"{prefix}-uid-{self._uid}"
+
+    def bump(self, kind: str, etype: str, obj: Dict[str, Any]) -> None:
+        """Stamp a new resourceVersion on obj and fan out the event.
+        Caller holds the lock."""
+
+        self.rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+        snapshot = json.loads(json.dumps(obj))  # watchers never alias
+        self.log.append((self.rv, kind, etype, snapshot))
+        for q in list(self.watchers):
+            q.put((self.rv, kind, etype, snapshot))
+
+    def oldest_rv(self) -> int:
+        return self.log[0][0] if self.log else self.rv + 1
+
+
+class MiniApiServer:
+    def __init__(
+        self,
+        total_chips: Optional[int] = None,
+        log_dir: Optional[str] = None,
+        kubelet_interval: float = 0.05,
+    ):
+        import tempfile
+
+        self.store = _Store()
+        self.total_chips = total_chips
+        self.log_dir = log_dir or tempfile.mkdtemp(prefix="tpujob-kubesim-")
+        self.kubelet_interval = kubelet_interval
+        self._procs: Dict[Tuple[str, str, str], subprocess.Popen] = {}
+        self._stop = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        assert self._httpd is not None, "call start() first"
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "MiniApiServer":
+        sim = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                sim._handle(self, "GET")
+
+            def do_POST(self):
+                sim._handle(self, "POST")
+
+            def do_DELETE(self):
+                sim._handle(self, "DELETE")
+
+            def do_PATCH(self):
+                sim._handle(self, "PATCH")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd.daemon_threads = True
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        k = threading.Thread(target=self._kubelet_loop, daemon=True)
+        k.start()
+        self._threads.append(k)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        with self.store.lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+            for q in self.store.watchers:
+                q.put(None)  # unblock stream threads
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        for proc in procs:
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass
+
+    # -- HTTP dispatch ------------------------------------------------------
+
+    @staticmethod
+    def _reply(h, status: int, obj=None, text: Optional[str] = None) -> None:
+        body = (
+            text.encode()
+            if text is not None
+            else json.dumps(obj if obj is not None else {}).encode()
+        )
+        h.send_response(status)
+        h.send_header(
+            "Content-Type",
+            "text/plain" if text is not None else "application/json",
+        )
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        try:
+            h.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    @staticmethod
+    def _status(code: int, reason: str, message: str) -> Dict[str, Any]:
+        return {
+            "apiVersion": "v1",
+            "kind": "Status",
+            "code": code,
+            "reason": reason,
+            "message": message,
+        }
+
+    def _parse_path(self, path: str):
+        """(kind, namespace|None, name|None, subresource|None) or None."""
+
+        parts = [p for p in path.split("/") if p]
+        # /api/v1/... or /apis/scheduling.volcano.sh/v1beta1/...
+        if parts[:2] == ["api", "v1"]:
+            rest = parts[2:]
+        elif parts[:3] == ["apis", "scheduling.volcano.sh", "v1beta1"]:
+            rest = parts[3:]
+        else:
+            return None
+        ns = None
+        if rest[:1] == ["namespaces"] and len(rest) >= 3:
+            ns = rest[1]
+            rest = rest[2:]
+        if not rest or rest[0] not in _PLURALS:
+            return None
+        kind = _PLURALS[rest[0]]
+        name = rest[1] if len(rest) > 1 else None
+        sub = rest[2] if len(rest) > 2 else None
+        return kind, ns, name, sub
+
+    def _handle(self, h, method: str) -> None:
+        u = urllib.parse.urlparse(h.path)
+        q = urllib.parse.parse_qs(u.query)
+        parsed = self._parse_path(u.path)
+        if parsed is None:
+            return self._reply(
+                h, 404, self._status(404, "NotFound", f"no route {u.path}")
+            )
+        kind, ns, name, sub = parsed
+        try:
+            if method == "GET" and name is None and q.get("watch", ["false"])[0] in ("true", "1"):
+                rv = int(q.get("resourceVersion", ["0"])[0] or "0")
+                return self._watch(h, kind, rv)
+            if method == "GET" and name is None:
+                sel = parse_selector(q.get("labelSelector", [""])[0])
+                return self._list(h, kind, ns, sel)
+            if method == "GET" and sub == "log":
+                return self._pod_log(h, ns, name)
+            if method == "GET":
+                return self._get(h, kind, ns, name)
+            if method == "POST" and name is None:
+                length = int(h.headers.get("Content-Length", "0"))
+                obj = json.loads(h.rfile.read(length) or b"{}")
+                return self._create(h, kind, ns, obj)
+            if method == "DELETE" and name is not None:
+                return self._delete_obj(h, kind, ns, name)
+            if method == "PATCH" and name is not None:
+                length = int(h.headers.get("Content-Length", "0"))
+                patch = json.loads(h.rfile.read(length) or b"{}")
+                return self._patch(h, kind, ns, name, patch)
+        except (ValueError, KeyError) as e:
+            return self._reply(
+                h, 400, self._status(400, "BadRequest", repr(e))
+            )
+        self._reply(
+            h, 405, self._status(405, "MethodNotAllowed", method)
+        )
+
+    # -- verbs --------------------------------------------------------------
+
+    def _create(self, h, kind: str, ns: Optional[str], obj: Dict[str, Any]):
+        meta = obj.setdefault("metadata", {})
+        namespace = ns or meta.get("namespace", "default")
+        meta["namespace"] = namespace
+        name = meta.get("name", "")
+        if not name:
+            return self._reply(
+                h, 400, self._status(400, "Invalid", "metadata.name required")
+            )
+        key = (kind, namespace, name)
+        with self.store.lock:
+            if key in self.store.objects:
+                return self._reply(
+                    h,
+                    409,
+                    self._status(409, "AlreadyExists", f"{kind} {name} exists"),
+                )
+            meta.setdefault("uid", self.store.next_uid(kind.lower()))
+            if kind == "Pod":
+                obj.setdefault("status", {})["phase"] = "Pending"
+            elif kind == "PodGroup":
+                granted = self._can_grant(self._group_chips(obj), exclude=None)
+                obj.setdefault("status", {})["phase"] = (
+                    "Granted" if granted else "Pending"
+                )
+            self.store.objects[key] = obj
+            self.store.bump(kind, "ADDED", obj)
+            return self._reply(h, 201, obj)
+
+    def _get(self, h, kind: str, ns: Optional[str], name: str):
+        key = (kind, ns or "default", name)
+        with self.store.lock:
+            obj = self.store.objects.get(key)
+            if obj is None:
+                return self._reply(
+                    h, 404, self._status(404, "NotFound", f"{kind} {name}")
+                )
+            return self._reply(h, 200, obj)
+
+    def _list(self, h, kind: str, ns: Optional[str], sel: Dict[str, str]):
+        with self.store.lock:
+            items = [
+                o
+                for (k, n, _), o in self.store.objects.items()
+                if k == kind
+                and (ns is None or n == ns)
+                and match_selector(_labels(o), sel)
+            ]
+            out = {
+                "apiVersion": "v1",
+                "kind": f"{kind}List",
+                "metadata": {"resourceVersion": str(self.store.rv)},
+                "items": items,
+            }
+            return self._reply(h, 200, out)
+
+    def _delete_obj(self, h, kind: str, ns: Optional[str], name: str):
+        key = (kind, ns or "default", name)
+        with self.store.lock:
+            obj = self.store.objects.pop(key, None)
+            if obj is None:
+                return self._reply(
+                    h, 404, self._status(404, "NotFound", f"{kind} {name}")
+                )
+            proc = self._procs.pop(key, None)
+            self.store.bump(kind, "DELETED", obj)
+            if kind == "PodGroup":
+                self._regrant_locked()
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    pass
+        return self._reply(h, 200, self._status(200, "Success", "deleted"))
+
+    def _patch(self, h, kind, ns, name, patch: Dict[str, Any]):
+        key = (kind, ns or "default", name)
+        with self.store.lock:
+            obj = self.store.objects.get(key)
+            if obj is None:
+                return self._reply(
+                    h, 404, self._status(404, "NotFound", f"{kind} {name}")
+                )
+            # strategic-merge-lite: dict values merge one level deep,
+            # everything else replaces (covers ownerReferences, status
+            # and podgroup spec resize)
+            for section, val in patch.items():
+                if isinstance(val, dict) and isinstance(obj.get(section), dict):
+                    obj[section].update(val)
+                else:
+                    obj[section] = val
+            self.store.bump(kind, "MODIFIED", obj)
+            if kind == "PodGroup":
+                # re-evaluate admission with the new size
+                chips = self._group_chips(obj)
+                granted = self._can_grant(chips, exclude=key)
+                obj["status"]["phase"] = "Granted" if granted else "Pending"
+                self.store.bump(kind, "MODIFIED", obj)
+                self._regrant_locked()
+            return self._reply(h, 200, obj)
+
+    def _pod_log(self, h, ns: Optional[str], name: str):
+        path = self._log_path(ns or "default", name)
+        try:
+            with open(path, "r", errors="replace") as f:
+                return self._reply(h, 200, text=f.read())
+        except FileNotFoundError:
+            return self._reply(h, 404, self._status(404, "NotFound", "no log"))
+
+    # -- watch --------------------------------------------------------------
+
+    def _watch(self, h, kind: str, rv: int):
+        q: Queue = Queue()
+        with self.store.lock:
+            if rv and rv < self.store.oldest_rv() - 1:
+                # the requested window is gone — the client must re-list
+                return self._reply(
+                    h,
+                    410,
+                    self._status(
+                        410, "Expired", f"resourceVersion {rv} is too old"
+                    ),
+                )
+            backlog = [
+                (erv, k, et, o)
+                for (erv, k, et, o) in self.store.log
+                if k == kind and erv > rv
+            ]
+            self.store.watchers.append(q)
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+
+            def emit(etype: str, obj: Dict[str, Any]) -> None:
+                line = (
+                    json.dumps({"type": etype, "object": obj}) + "\n"
+                ).encode()
+                h.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                h.wfile.flush()
+
+            for _, _, et, o in backlog:
+                emit(et, o)
+            while not self._stop.is_set():
+                try:
+                    item = q.get(timeout=0.5)
+                except Empty:
+                    continue
+                if item is None:
+                    break
+                erv, k, et, o = item
+                if k == kind and erv > rv:
+                    emit(et, o)
+            # terminating chunk (best effort; client may be gone)
+            h.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            with self.store.lock:
+                try:
+                    self.store.watchers.remove(q)
+                except ValueError:
+                    pass
+
+    # -- scheduler sim (gang admission, backend/fake.py semantics) ----------
+
+    @staticmethod
+    def _group_chips(obj: Dict[str, Any]) -> int:
+        res = obj.get("spec", {}).get("minResources", {})
+        try:
+            return int(res.get("google.com/tpu", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def _can_grant(self, chips: int, exclude) -> bool:
+        if self.total_chips is None:
+            return True
+        in_use = sum(
+            self._group_chips(o)
+            for key, o in self.store.objects.items()
+            if key[0] == "PodGroup"
+            and key != exclude
+            and o.get("status", {}).get("phase") == "Granted"
+        )
+        return in_use + chips <= self.total_chips
+
+    def _regrant_locked(self) -> None:
+        for key, o in self.store.objects.items():
+            if (
+                key[0] == "PodGroup"
+                and o.get("status", {}).get("phase") == "Pending"
+                and self._can_grant(self._group_chips(o), exclude=key)
+            ):
+                o["status"]["phase"] = "Granted"
+                self.store.bump("PodGroup", "MODIFIED", o)
+
+    def _gang_blocked(self, pod: Dict[str, Any]) -> bool:
+        ann = pod.get("metadata", {}).get("annotations", {}) or {}
+        gname = ann.get(ANNOTATION_GANG_GROUP) or ann.get(
+            "scheduling.k8s.io/group-name"
+        )
+        if not gname:
+            return False
+        ns = pod["metadata"].get("namespace", "default")
+        group = self.store.objects.get(("PodGroup", ns, gname))
+        return (
+            group is None
+            or group.get("status", {}).get("phase") != "Granted"
+        )
+
+    # -- kubelet sim --------------------------------------------------------
+
+    def _log_path(self, namespace: str, name: str) -> str:
+        d = os.path.join(self.log_dir, namespace)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"{name}.log")
+
+    def _spawn_env(self, pod: Dict[str, Any]) -> Dict[str, str]:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT
+        env.pop("JAX_PLATFORMS", None)
+        for c in pod.get("spec", {}).get("containers", []):
+            for e in c.get("env", []):
+                env[e["name"]] = e.get("value", "")
+            break
+        return env
+
+    def _kubelet_loop(self) -> None:
+        """scheduler + kubelet tick: start admissible Pending pods as
+        subprocesses; surface exits as pod phase + exit code."""
+
+        while not self._stop.is_set():
+            to_spawn = []
+            with self.store.lock:
+                for key, obj in self.store.objects.items():
+                    if key[0] != "Pod":
+                        continue
+                    if obj.get("status", {}).get("phase") != "Pending":
+                        continue
+                    if key in self._procs:
+                        continue
+                    if self._gang_blocked(obj):
+                        continue
+                    to_spawn.append((key, json.loads(json.dumps(obj))))
+            for key, obj in to_spawn:
+                self._spawn(key, obj)
+            # reap exits
+            with self.store.lock:
+                items = list(self._procs.items())
+            for key, proc in items:
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                with self.store.lock:
+                    self._procs.pop(key, None)
+                    obj = self.store.objects.get(key)
+                    if obj is None:
+                        continue
+                    phase = obj.get("status", {}).get("phase")
+                    if phase in ("Succeeded", "Failed"):
+                        continue
+                    code = rc if rc >= 0 else 128 - rc
+                    obj["status"]["phase"] = (
+                        "Succeeded" if rc == 0 else "Failed"
+                    )
+                    cname = "tensorflow"
+                    for c in obj.get("spec", {}).get("containers", []):
+                        cname = c.get("name", cname)
+                        break
+                    obj["status"]["containerStatuses"] = [
+                        {
+                            "name": cname,
+                            "restartCount": 0,
+                            "state": {"terminated": {"exitCode": code}},
+                        }
+                    ]
+                    self.store.bump("Pod", "MODIFIED", obj)
+            self._stop.wait(self.kubelet_interval)
+
+    def _spawn(self, key, obj: Dict[str, Any]) -> None:
+        ns, name = key[1], key[2]
+        main = None
+        for c in obj.get("spec", {}).get("containers", []):
+            main = c
+            break
+        cmd = list((main or {}).get("command", [])) + list(
+            (main or {}).get("args", [])
+        )
+        if not cmd:
+            self._fail_pod(key, 127, "no runnable command")
+            return
+        logf = open(self._log_path(ns, name), "ab")
+        try:
+            proc = subprocess.Popen(
+                cmd,
+                env=self._spawn_env(obj),
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                cwd=(main or {}).get("workingDir") or _REPO_ROOT,
+                start_new_session=True,
+            )
+        except OSError as e:
+            logf.write(f"spawn failed: {e}\n".encode())
+            logf.close()
+            self._fail_pod(key, 127, repr(e))
+            return
+        logf.close()
+        with self.store.lock:
+            live = self.store.objects.get(key)
+            if live is None:  # deleted while spawning
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                proc.wait()
+                return
+            self._procs[key] = proc
+            live["status"]["phase"] = "Running"
+            self.store.bump("Pod", "MODIFIED", live)
+
+    def _fail_pod(self, key, code: int, why: str) -> None:
+        with self.store.lock:
+            obj = self.store.objects.get(key)
+            if obj is None:
+                return
+            obj["status"]["phase"] = "Failed"
+            obj["status"]["containerStatuses"] = [
+                {
+                    "name": "tensorflow",
+                    "restartCount": 0,
+                    "state": {"terminated": {"exitCode": code}},
+                }
+            ]
+            self.store.bump("Pod", "MODIFIED", obj)

@@ -116,9 +116,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--backend",
-        choices=["local", "fake"],
+        choices=["local", "fake", "kube-sim", "kube"],
         default="local",
-        help="cluster backend: local subprocesses or in-memory fake",
+        help="cluster backend: local subprocesses, in-memory fake, an "
+        "embedded mini kube-apiserver spoken to over real Kubernetes "
+        "HTTP (kube-sim), or an external apiserver at --kube-url "
+        "speaking the same protocol (kube)",
+    )
+    p.add_argument(
+        "--kube-url",
+        default=None,
+        help="apiserver base URL for --backend kube (e.g. "
+        "http://127.0.0.1:6443)",
     )
     p.add_argument(
         "--namespace",
@@ -189,8 +198,29 @@ def main(argv=None) -> int:
     log = oplog.logger_for_job("-", "operator")
 
     store = JobStore()
+    sim = None
     if args.backend == "local":
         backend = LocalProcessBackend(log_dir=args.log_dir)
+        config = ReconcilerConfig(
+            enable_gang_scheduling=args.enable_gang_scheduling,
+            resolver=backend.resolver,
+        )
+    elif args.backend in ("kube-sim", "kube"):
+        from tf_operator_tpu.backend.kube import KubeBackend
+
+        if args.backend == "kube-sim":
+            from tf_operator_tpu.backend.kubesim import MiniApiServer
+
+            sim = MiniApiServer(
+                total_chips=args.total_chips, log_dir=args.log_dir
+            ).start()
+            url = sim.url
+            log.info("embedded mini apiserver listening on %s", url)
+        else:
+            if not args.kube_url:
+                parser.error("--backend kube requires --kube-url")
+            url = args.kube_url
+        backend = KubeBackend(url)
         config = ReconcilerConfig(
             enable_gang_scheduling=args.enable_gang_scheduling,
             resolver=backend.resolver,
@@ -266,6 +296,8 @@ def main(argv=None) -> int:
         close = getattr(backend, "close", None)
         if close:
             close()
+        if sim is not None:
+            sim.stop()
         if lease:
             lease.release()
         log.info("operator stopped")
