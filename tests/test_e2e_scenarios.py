@@ -51,10 +51,24 @@ RUNCONFIG_CHECK = [
 ]
 
 
-@pytest.fixture
-def local_harness():
+@pytest.fixture(params=["local", "kube-sim"])
+def local_harness(request):
+    """Every scenario runs twice: against the in-proc local-process
+    backend AND against the kube-sim pair — KubeBackend speaking real
+    Kubernetes HTTP (CRUD + labelSelector + chunked watch) to the
+    embedded mini apiserver whose kubelet sim runs the same
+    subprocesses (VERDICT r4 next #4: the client-go tier, executable)."""
+
     store = JobStore()
-    backend = LocalProcessBackend()
+    sim = None
+    if request.param == "local":
+        backend = LocalProcessBackend()
+    else:
+        from tf_operator_tpu.backend.kube import KubeBackend
+        from tf_operator_tpu.backend.kubesim import MiniApiServer
+
+        sim = MiniApiServer().start()
+        backend = KubeBackend(sim.url)
     controller = TPUJobController(
         store, backend, config=ReconcilerConfig(resolver=backend.resolver)
     )
@@ -62,6 +76,8 @@ def local_harness():
     yield store, backend, controller
     controller.stop()
     backend.close()
+    if sim is not None:
+        sim.stop()
 
 
 def wait_no_pods(backend, ns="default", timeout=15.0):
@@ -519,10 +535,12 @@ class TestDistributedTraining:
             job.spec.replica_specs[rt].template.containers[0].env = cpu_env()
         store.create(job)
         # chief-decides semantics (reference parity): the chief's exit 0
-        # marks the job Succeeded even if workers are a beat behind
+        # marks the job Succeeded even if workers are a beat behind.
+        # 240s: a 3-process jax.distributed world on a 1-core box under
+        # full-suite load needs the headroom (120s flaked on kube-sim)
         done = wait_for(
             store, "default", "mnist",
-            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED), timeout=120.0,
+            lambda j: j.status.has_condition(JobConditionType.SUCCEEDED), timeout=240.0,
         )
         st = done.status.replica_statuses
         assert st[ReplicaType.CHIEF].succeeded == 1
@@ -574,6 +592,92 @@ class TestSummariesManifest:
         assert all("loss" in m for m in series)
         # both worker processes wrote their own file
         assert len(_glob.glob(os.path.join(sdir, "metrics-*.jsonl"))) == 2
+
+
+def _export_serving_artifact(tmp_path):
+    """Train one step of the byte-level tiny llama and export it — a
+    real artifact for serve_lm to load (shared by the serving e2e
+    scenarios)."""
+
+    import numpy as np
+
+    import jax.numpy as jnp
+    from tf_operator_tpu.models import llama_loss, llama_tiny
+    from tf_operator_tpu.parallel import (
+        Trainer, TrainerConfig, export_params, make_mesh,
+    )
+
+    mesh = make_mesh({"dp": 8})  # conftest's 8-device CPU mesh
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(0, 256, size=(8, 16)), jnp.int32
+    )
+    tr = Trainer(
+        llama_tiny(vocab_size=256, max_len=64, mesh=mesh),
+        TrainerConfig(optimizer="sgd", learning_rate=1e-2),
+        mesh,
+        llama_loss,
+        {"input_ids": ids},
+        init_args=(ids,),
+        shardings="logical",
+    )
+    tr.train_step(tr.shard_batch({"input_ids": ids}))
+    art = str(tmp_path / "artifact")
+    export_params(tr, art)
+    return art
+
+
+def _serving_manifest(art: str, port: int):
+    """The serving.yaml manifest rewritten for a local run: absolute
+    interpreter/paths, the exported artifact, a collision-free port."""
+
+    import yaml
+
+    repo = os.path.dirname(os.path.dirname(EXAMPLE))
+    with open(os.path.join(repo, "examples", "manifests", "serving.yaml")) as f:
+        doc = yaml.safe_load(f)
+    spec = doc["spec"]["tpuReplicaSpecs"]["Worker"]["template"]["spec"]
+    cmd = spec["containers"][0]["command"]
+    cmd[0] = sys.executable
+    cmd[cmd.index("examples/serve_lm.py")] = os.path.join(
+        repo, "examples", "serve_lm.py"
+    )
+    cmd[cmd.index("--artifact") + 1] = art
+    cmd[cmd.index("--port") + 1] = str(port)
+    cmd += ["--platform", "cpu"]
+    return doc
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_healthz(base: str, store, backend, deadline_s: float = 120.0):
+    import json as _json
+    import urllib.request
+
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            with urllib.request.urlopen(base + "/healthz", timeout=2) as r:
+                if _json.loads(r.read())["ok"]:
+                    return
+        except Exception:
+            j = store.get("default", "serve-lm")
+            if j is not None and j.status.has_condition(JobConditionType.FAILED):
+                raise AssertionError(
+                    "serving job FAILED: "
+                    + backend.pod_log("default", "serve-lm-worker-0")[-500:]
+                )
+            if time.time() > deadline:
+                raise AssertionError(
+                    "healthz never came up; pod log tail: "
+                    + backend.pod_log("default", "serve-lm-worker-0")[-500:]
+                )
+            time.sleep(1.0)
 
 
 @pytest.mark.slow
@@ -686,3 +790,81 @@ class TestServingJob:
             raise AssertionError("server still answering after job delete")
         except (urllib.error.URLError, ConnectionError, OSError):
             pass
+
+    def test_serving_crash_restarts_and_answers_again(
+        self, local_harness, tmp_path
+    ):
+        """VERDICT r4 next #8: a serving pod killed mid-flight under
+        RestartPolicy Always must be restarted by the operator, come
+        back with a FRESH process (/metrics counters reset), and
+        answer requests again."""
+
+        import json as _json
+        import subprocess as _subprocess
+        import urllib.request
+
+        from tf_operator_tpu.api.serde import job_from_dict
+
+        art = _export_serving_artifact(tmp_path)
+        port = _free_port()
+        doc = _serving_manifest(art, port)
+        doc["spec"]["tpuReplicaSpecs"]["Worker"]["restartPolicy"] = "Always"
+        doc["spec"]["runPolicy"]["backoffLimit"] = 4
+
+        store, backend, c = local_harness
+        store.create(job_from_dict(doc))
+        wait_for(
+            store, "default", "serve-lm",
+            lambda j: j.status.has_condition(JobConditionType.RUNNING),
+            timeout=60.0,
+        )
+        base = f"http://127.0.0.1:{port}"
+        _wait_healthz(base, store, backend)
+
+        # drive one request so the pre-crash metrics are non-zero
+        req = urllib.request.Request(
+            base + "/generate",
+            data=_json.dumps(
+                {"prompt": "crash ", "max_new_tokens": 2}
+            ).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=240) as resp:
+            assert len(_json.loads(resp.read())["sample"]) == 2
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+            before = resp.read().decode()
+        assert 'serve_requests_total{status="200"} ' in before
+
+        # CRASH: SIGKILL the serving process (backend-agnostic — match
+        # the unique port in the command line), the e2e equivalent of
+        # the reference's shutdown_policy pod kills
+        _subprocess.run(
+            ["pkill", "-9", "-f", f"serve_lm.py.*--port {port}"], check=False
+        )
+
+        # the operator must notice the Failed pod (exit 137, signal
+        # death) and, under RestartPolicy Always, recreate the replica;
+        # the fresh process binds the same --port from the manifest.
+        # Wait for the restart to be COUNTED first so the metrics
+        # assertions below can't race the dying process.
+        wait_for(
+            store, "default", "serve-lm",
+            lambda j: j.status.restart_count >= 1,
+            timeout=60.0,
+        )
+        _wait_healthz(base, store, backend)
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+            after = resp.read().decode()
+        # fresh process: labeled counters mint on first use, so the
+        # pre-crash request count is GONE (not carried over)
+        assert 'serve_requests_total{status="200"}' not in after
+
+        # and the restarted server serves real traffic — after which
+        # its counter reads exactly 1 (this restart's own request)
+        with urllib.request.urlopen(req, timeout=240) as resp:
+            assert len(_json.loads(resp.read())["sample"]) == 2
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+            final = resp.read().decode()
+        assert 'serve_requests_total{status="200"} 1' in final
+        store.delete("default", "serve-lm")
+        wait_no_pods(backend, timeout=30.0)
