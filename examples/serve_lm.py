@@ -237,10 +237,12 @@ def build_handler(
                 if pool is not None:
                     from tf_operator_tpu.models.batching import TOP_K_MAX
 
-                    if top_k is not None and top_k > TOP_K_MAX:
+                    # full client-error range pre-validated here: the
+                    # pool's own ValueError would surface as a 500
+                    if top_k is not None and not (1 <= top_k <= TOP_K_MAX):
                         return self._reply(400, {
-                            "error": f"top_k must be <= {TOP_K_MAX} in "
-                                     "--batching mode (static top-k "
+                            "error": f"top_k must be in [1, {TOP_K_MAX}] "
+                                     "in --batching mode (static top-k "
                                      "width)"})
                     rid = pool.submit(
                         ids.astype(np.int32), n_new,

@@ -22,6 +22,17 @@ import jax  # noqa: E402
 # invocation against the real chip — don't pin CPU there.
 if not os.environ.get("RUN_TPU_TESTS"):
     jax.config.update("jax_platforms", "cpu")
+    # persistent compilation cache: the suite is dominated by XLA CPU
+    # compiles on a cold container (a fresh image turned the 3-minute
+    # default tier into 20+ minutes); cache them across runs.  Scoped
+    # to CPU runs only so the real-chip tier always measures honest
+    # compile times.
+    cache_dir = os.environ.get(
+        "TPU_OPERATOR_TEST_CACHE", "/tmp/tpujob-test-xla-cache"
+    )
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 

@@ -27,8 +27,20 @@ bias/mask, tile-aligned shapes) and falls back to
 ops.attention.dot_product_attention otherwise.  pallas_call has no
 GSPMD partitioning rule, so on a multi-device mesh the dispatcher wraps
 the kernel in shard_map over (dp/fsdp → batch, tp → heads); meshes that
-shard other attention dims fall back.  TPU_OPERATOR_FLASH=0 disables
-the kernel globally.
+shard other attention dims fall back.
+
+Env knobs — note the three-state semantics of TPU_OPERATOR_FLASH:
+  unset / ""  auto: the measured seq crossover decides (flash only at
+              max(Sq,Sk) >= TPU_OPERATOR_FLASH_MIN_SEQ, default 2048 —
+              from the r4 llama-sweep, where XLA-fused won at seq 1024;
+              the 1024..4096 midrange is pinned by the autotuned sweep
+              each window re-runs).
+  "0"         disable the kernel globally.
+  any other   FORCE flash wherever it applies, crossover ignored.
+              ** Semantics changed in r4: an explicit "1" used to be
+              the documented default value and is now a force — configs
+              that pinned TPU_OPERATOR_FLASH=1 get flash below the
+              crossover where auto would take XLA. **
 """
 
 from __future__ import annotations
