@@ -385,3 +385,94 @@ class TestWatch:
             wait_until(lambda: "shared" in seen2, what="watcher 2")
         finally:
             b1.close()
+
+
+class TestKubeLease:
+    """coordination.k8s.io/v1 Lease leader election over the sim — the
+    client-go resourcelock/leaderelection tier (SURVEY.md §3.1)."""
+
+    def _lease(self, sim, ident, **kw):
+        from tf_operator_tpu.cmd.leader import KubeLease
+
+        kw.setdefault("lease_duration", 1.0)
+        return KubeLease(sim.url, identity=ident, **kw)
+
+    def test_one_winner_while_lease_is_live(self, pair):
+        sim, _ = pair
+        a = self._lease(sim, "a")
+        b = self._lease(sim, "b")
+        assert a.try_acquire()
+        assert a.is_leader and a.holder() == "a"
+        assert not b.try_acquire()
+        assert not b.is_leader
+        a.release()
+
+    def test_crashed_leader_expires_and_is_replaced(self, pair):
+        sim, _ = pair
+        a = self._lease(sim, "a")
+        b = self._lease(sim, "b")
+        assert a.try_acquire()
+        # crash: stop renewing WITHOUT the clean release handoff
+        a._stop.set()
+        a._leading = False
+        assert not b.try_acquire()  # still within the lease duration
+        wait_until(lambda: b.try_acquire(), timeout=5.0, what="takeover")
+        assert b.holder() == "b"
+        b.release()
+
+    def test_release_hands_off_immediately(self, pair):
+        sim, _ = pair
+        a = self._lease(sim, "a")
+        b = self._lease(sim, "b")
+        assert a.try_acquire()
+        a.release()
+        assert b.try_acquire()  # no expiry wait
+        assert b.holder() == "b"
+        b.release()
+
+    def test_lost_leadership_fires_on_lost_and_demotes(self, pair):
+        sim, _ = pair
+        lost = []
+        a = self._lease(sim, "a", on_lost=lambda: lost.append(True))
+        assert a.try_acquire()
+        # a rival writes itself into the lease through the REAL
+        # protocol (correct resourceVersion precondition)
+        status, obj = a._request("GET", a._path)
+        assert status == 200
+        rv = obj["metadata"]["resourceVersion"]
+        spec = dict(obj["spec"])
+        spec["holderIdentity"] = "usurper"
+        spec["renewTime"] = __import__("time").time()
+        status, _ = a._request(
+            "PATCH", a._path,
+            {"metadata": {"resourceVersion": rv}, "spec": spec},
+        )
+        assert status == 200
+        wait_until(lambda: lost, timeout=5.0, what="on_lost callback")
+        assert not a.is_leader
+
+    def test_stale_resource_version_patch_conflicts(self, pair):
+        """The optimistic-concurrency precondition itself: a PATCH
+        carrying an out-of-date resourceVersion gets the apiserver's
+        409, which is what serializes two candidates racing for an
+        expired lease."""
+
+        sim, _ = pair
+        a = self._lease(sim, "a")
+        assert a.try_acquire()
+        status, obj = a._request("GET", a._path)
+        rv = obj["metadata"]["resourceVersion"]
+        spec = dict(obj["spec"])
+        # first CAS succeeds and bumps the version...
+        status, _ = a._request(
+            "PATCH", a._path,
+            {"metadata": {"resourceVersion": rv}, "spec": spec},
+        )
+        assert status == 200
+        # ...so replaying against the OLD version must conflict
+        status, _ = a._request(
+            "PATCH", a._path,
+            {"metadata": {"resourceVersion": rv}, "spec": spec},
+        )
+        assert status == 409
+        a.release()

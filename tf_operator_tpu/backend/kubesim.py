@@ -63,7 +63,12 @@ _REPO_ROOT = os.path.dirname(
 #: events kept for watch replay; older resourceVersions get 410 Gone
 EVENT_LOG_WINDOW = 4096
 
-_PLURALS = {"pods": "Pod", "services": "Service", "podgroups": "PodGroup"}
+_PLURALS = {
+    "pods": "Pod",
+    "services": "Service",
+    "podgroups": "PodGroup",
+    "leases": "Lease",
+}
 
 
 def _labels(obj: Dict[str, Any]) -> Dict[str, str]:
@@ -219,10 +224,13 @@ class MiniApiServer:
         """(kind, namespace|None, name|None, subresource|None) or None."""
 
         parts = [p for p in path.split("/") if p]
-        # /api/v1/... or /apis/scheduling.volcano.sh/v1beta1/...
+        # /api/v1/..., /apis/scheduling.volcano.sh/v1beta1/..., or
+        # /apis/coordination.k8s.io/v1/... (Leases — leader election)
         if parts[:2] == ["api", "v1"]:
             rest = parts[2:]
         elif parts[:3] == ["apis", "scheduling.volcano.sh", "v1beta1"]:
+            rest = parts[3:]
+        elif parts[:3] == ["apis", "coordination.k8s.io", "v1"]:
             rest = parts[3:]
         else:
             return None
@@ -370,6 +378,22 @@ class MiniApiServer:
             if obj is None:
                 return self._reply(
                     h, 404, self._status(404, "NotFound", f"{kind} {name}")
+                )
+            # optimistic concurrency (the real apiserver's update
+            # precondition): a patch carrying metadata.resourceVersion
+            # only applies against that exact version — the mechanism
+            # Lease-based leader election's compare-and-swap rides
+            want_rv = str(patch.get("metadata", {}).get("resourceVersion", ""))
+            have_rv = str(obj.get("metadata", {}).get("resourceVersion", ""))
+            if want_rv and want_rv != have_rv:
+                return self._reply(
+                    h,
+                    409,
+                    self._status(
+                        409,
+                        "Conflict",
+                        f"resourceVersion {want_rv} != {have_rv}",
+                    ),
                 )
             # strategic-merge-lite: dict values merge one level deep,
             # everything else replaces (covers ownerReferences, status

@@ -68,3 +68,213 @@ class FileLease:
     @property
     def is_leader(self) -> bool:
         return self._fd is not None
+
+
+class KubeLease:
+    """coordination.k8s.io/v1 Lease leader election over the real
+    Kubernetes HTTP protocol (the client-go ``leaderelection`` +
+    ``resourcelock`` tier; SURVEY.md §3.1 "leader election
+    (resourcelock via configmap/lease)").
+
+    The FileLease above is single-host by construction (flock); this
+    is the multi-host half, runnable today against
+    ``backend/kubesim.py``'s mini apiserver and against anything else
+    speaking the subset.  Semantics follow client-go:
+
+    - acquire: create the Lease if absent; else take over only when
+      ``renewTime`` is older than ``leaseDurationSeconds``.  Takeover
+      and renewal PATCH with ``metadata.resourceVersion`` as an
+      optimistic-concurrency precondition — two candidates racing for
+      an expired lease serialize through the apiserver's 409, so
+      exactly one wins (no distributed-lock primitive needed beyond
+      the apiserver itself).
+    - renew: a daemon thread re-PATCHes renewTime every duration/3
+      while leading.  A failed renewal (another holder, network gone
+      longer than the lease) demotes immediately and fires
+      ``on_lost`` — the operator wires that to its stop event, the
+      client-go "OnStoppedLeading: exit" convention, because a
+      controller that kept reconciling without the lease could fight
+      the new leader's writes.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        identity: str,
+        name: str = "tpu-operator",
+        namespace: str = "default",
+        lease_duration: float = 15.0,
+        on_lost=None,
+    ):
+        import urllib.parse
+
+        u = urllib.parse.urlparse(base_url)
+        self.host, self.port = u.hostname or "127.0.0.1", u.port or 80
+        self.identity = identity
+        self.name = name
+        self.namespace = namespace
+        self.duration = float(lease_duration)
+        self.on_lost = on_lost
+        self._leading = False
+        self._stop = None  # renew-thread stop event while leading
+        self._lock = __import__("threading").Lock()
+
+    # -- wire ---------------------------------------------------------------
+
+    @property
+    def _path(self) -> str:
+        return (
+            f"/apis/coordination.k8s.io/v1/namespaces/{self.namespace}"
+            f"/leases/{self.name}"
+        )
+
+    def _request(self, method: str, path: str, body=None):
+        from http.client import HTTPConnection
+
+        conn = HTTPConnection(self.host, self.port, timeout=5.0)
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if payload else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            text = resp.read().decode(errors="replace")
+            return resp.status, (json.loads(text) if text else {})
+        finally:
+            conn.close()
+
+    def _spec(self, transitions: int) -> dict:
+        now = time.time()
+        return {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.duration),
+            "renewTime": now,
+            "acquireTime": now,
+            "leaseTransitions": transitions,
+        }
+
+    # -- election -----------------------------------------------------------
+
+    def try_acquire(self) -> bool:
+        """Non-blocking: True when this process leads (and renewal is
+        running)."""
+
+        with self._lock:
+            if self._leading:
+                return True
+            status, obj = self._request("GET", self._path)
+            if status == 404:
+                status, obj = self._request(
+                    "POST",
+                    self._path.rsplit("/", 1)[0],
+                    {
+                        "apiVersion": "coordination.k8s.io/v1",
+                        "kind": "Lease",
+                        "metadata": {
+                            "name": self.name,
+                            "namespace": self.namespace,
+                        },
+                        "spec": self._spec(0),
+                    },
+                )
+                if status != 201:
+                    return False  # lost the create race
+            elif status == 200:
+                spec = obj.get("spec", {})
+                renew = float(spec.get("renewTime", 0.0))
+                if (
+                    spec.get("holderIdentity") != self.identity
+                    and time.time() - renew < self.duration
+                ):
+                    return False  # a live leader holds it
+                # expired (or our own stale lease): compare-and-swap
+                rv = obj.get("metadata", {}).get("resourceVersion", "")
+                status, _ = self._request(
+                    "PATCH",
+                    self._path,
+                    {
+                        "metadata": {"resourceVersion": rv},
+                        "spec": self._spec(
+                            int(spec.get("leaseTransitions", 0)) + 1
+                        ),
+                    },
+                )
+                if status != 200:
+                    return False  # 409: another candidate won the swap
+            else:
+                return False  # apiserver unreachable/unhappy
+            self._leading = True
+            self._start_renewer()
+            return True
+
+    def acquire(self, poll_interval: float = 0.5) -> None:
+        while not self.try_acquire():
+            time.sleep(poll_interval)
+
+    def _start_renewer(self) -> None:
+        import threading
+
+        self._stop = threading.Event()
+        stop = self._stop
+
+        def renew_loop():
+            while not stop.wait(self.duration / 3.0):
+                status, obj = self._request("GET", self._path)
+                ok = (
+                    status == 200
+                    and obj.get("spec", {}).get("holderIdentity")
+                    == self.identity
+                )
+                if ok:
+                    rv = obj.get("metadata", {}).get("resourceVersion", "")
+                    spec = dict(obj.get("spec", {}))
+                    spec["renewTime"] = time.time()
+                    status, _ = self._request(
+                        "PATCH",
+                        self._path,
+                        {"metadata": {"resourceVersion": rv}, "spec": spec},
+                    )
+                    ok = status == 200
+                if not ok:
+                    with self._lock:
+                        self._leading = False
+                    stop.set()
+                    if self.on_lost is not None:
+                        self.on_lost()
+                    return
+
+        threading.Thread(
+            target=renew_loop, daemon=True, name="kube-lease-renew"
+        ).start()
+
+    def holder(self) -> Optional[str]:
+        status, obj = self._request("GET", self._path)
+        if status != 200:
+            return None
+        return obj.get("spec", {}).get("holderIdentity")
+
+    def release(self) -> None:
+        with self._lock:
+            was_leading = self._leading
+            self._leading = False
+            if self._stop is not None:
+                self._stop.set()
+        if was_leading:
+            # hand off immediately: zero the renewTime so the next
+            # candidate's expiry check passes without waiting out the
+            # lease duration
+            status, obj = self._request("GET", self._path)
+            if status == 200 and (
+                obj.get("spec", {}).get("holderIdentity") == self.identity
+            ):
+                rv = obj.get("metadata", {}).get("resourceVersion", "")
+                spec = dict(obj.get("spec", {}))
+                spec["renewTime"] = 0.0
+                self._request(
+                    "PATCH",
+                    self._path,
+                    {"metadata": {"resourceVersion": rv}, "spec": spec},
+                )
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading

@@ -239,9 +239,28 @@ def main(argv=None) -> int:
             args.host,
         )
 
+    stop = threading.Event()
+
     lease = None
     if args.leader_elect:
-        lease = FileLease(args.lease_file, identity=f"pid-{os.getpid()}")
+        if args.backend in ("kube-sim", "kube"):
+            # multi-host election through the apiserver itself
+            # (coordination.k8s.io/v1 Lease, compare-and-swap on
+            # resourceVersion).  Lost leadership = shut down, the
+            # client-go OnStoppedLeading convention: a controller
+            # reconciling without the lease would fight the new
+            # leader's writes.
+            from tf_operator_tpu.cmd.leader import KubeLease
+
+            def _lost():
+                log.warning("leader lease lost: shutting down")
+                stop.set()
+
+            lease = KubeLease(
+                url, identity=f"pid-{os.getpid()}", on_lost=_lost
+            )
+        else:
+            lease = FileLease(args.lease_file, identity=f"pid-{os.getpid()}")
 
     controller = TPUJobController(store, backend, config=config)
     api = ApiServer(
@@ -256,8 +275,6 @@ def main(argv=None) -> int:
             None if lease is None else (lambda: (lease.is_leader, lease.holder()))
         ),
     )
-
-    stop = threading.Event()
 
     def handle_signal(signum, frame):
         log.info("signal %d: shutting down", signum)
@@ -274,7 +291,11 @@ def main(argv=None) -> int:
 
     controller_started = False
     if lease is not None:
-        log.info("waiting for leader lease at %s", args.lease_file)
+        log.info(
+            "waiting for leader lease (%s)",
+            "apiserver Lease" if args.backend in ("kube-sim", "kube")
+            else args.lease_file,
+        )
 
     try:
         while not stop.is_set():
