@@ -476,3 +476,132 @@ class TestKubeLease:
         )
         assert status == 409
         a.release()
+
+
+class TestKubeJobStore:
+    """TPUJobs as custom resources in the apiserver (backend/kubejobs.py)
+    — the reference's TFJob-CRD storage tier."""
+
+    @pytest.fixture
+    def jobs(self):
+        from tf_operator_tpu.backend.kubejobs import KubeJobStore
+
+        sim = MiniApiServer().start()
+        store = KubeJobStore(sim.url)
+        yield sim, store
+        store.close()
+        sim.stop()
+
+    def _job(self, name, **kw):
+        from tests.testutil import new_job
+
+        kw.setdefault("worker", 1)
+        kw.setdefault("command", EXIT0)
+        return new_job(name, **kw)
+
+    def test_create_get_list_delete_round_trip(self, jobs):
+        sim, store = jobs
+        job = self._job("rt", chief=1, worker=2)
+        stored = store.create(job)
+        assert stored.metadata.uid.startswith("tpujob-uid-")
+        assert job.metadata.uid == stored.metadata.uid  # reflected back
+        got = store.get("default", "rt")
+        from tf_operator_tpu.api.types import ReplicaType
+
+        assert got.spec.replica_specs[ReplicaType.WORKER].replicas == 2
+        assert [j.metadata.name for j in store.list()] == ["rt"]
+        store.delete("default", "rt")
+        assert store.get("default", "rt") is None
+
+    def test_admission_runs_client_side(self, jobs):
+        from tf_operator_tpu.api.validation import ValidationError
+
+        sim, store = jobs
+        bad = self._job("Bad_Name!")
+        with pytest.raises(ValidationError):
+            store.create(bad)
+        assert store.list() == []
+
+    def test_status_subresource_persists(self, jobs):
+        from tf_operator_tpu.api.types import (
+            JobCondition, JobConditionType, TPUJobStatus,
+        )
+
+        sim, store = jobs
+        store.create(self._job("st"))
+        status = TPUJobStatus()
+        status.conditions.append(
+            JobCondition(
+                type=JobConditionType.RUNNING, status=True,
+                reason="r", message="m",
+            )
+        )
+        store.update_status("default", "st", status)
+        again = store.get("default", "st")
+        assert again.status.has_condition(JobConditionType.RUNNING)
+
+    def test_update_spec_replaces_not_merges(self, jobs):
+        """A field UNSET by the new spec must really unset (PUT
+        replacement, not merge-patch key-keeping)."""
+
+        sim, store = jobs
+        job = self._job("gang")
+        job.spec.enable_gang_scheduling = True
+        store.create(job)
+        assert store.get("default", "gang").spec.enable_gang_scheduling
+        edited = store.get("default", "gang")
+        edited.spec.enable_gang_scheduling = False
+        store.update_spec(edited)
+        assert not store.get("default", "gang").spec.enable_gang_scheduling
+
+    def test_watch_streams_job_events(self, jobs):
+        sim, store = jobs
+        events = []
+        store.subscribe(lambda ev: events.append((ev.type, ev.obj.metadata.name)))
+        time.sleep(0.3)
+        store.create(self._job("w"))
+        wait_until(
+            lambda: (WatchEventType.ADDED, "w") in events, what="job ADDED"
+        )
+        store.delete("default", "w")
+        wait_until(
+            lambda: (WatchEventType.DELETED, "w") in events,
+            what="job DELETED",
+        )
+
+    def test_preexisting_jobs_reach_late_subscribers(self, jobs):
+        """ListAndWatch must feed LISTED objects as events: a job that
+        existed before this store/operator started (restart, failover)
+        reconciles immediately, not at first periodic resync."""
+
+        from tf_operator_tpu.backend.kubejobs import KubeJobStore
+
+        sim, store = jobs
+        store.create(self._job("old"))
+        late = KubeJobStore(sim.url)
+        try:
+            seen = []
+            late.subscribe(lambda ev: seen.append(ev.obj.metadata.name))
+            wait_until(lambda: "old" in seen, what="initial-list replay")
+        finally:
+            late.close()
+
+    def test_preexisting_pods_reach_late_backend_subscribers(self, jobs):
+        """Same ListAndWatch property for the pod watch (KubeBackend):
+        without it a restarted reconciler would re-create pods that
+        already run."""
+
+        sim, store = jobs
+        b1 = KubeBackend(sim.url)
+        b1.create_pod(make_pod("preexists", SLEEP))
+        b2 = KubeBackend(sim.url)
+        try:
+            seen = []
+            b2.subscribe(lambda ev: seen.append((ev.kind, ev.obj.metadata.name)))
+            wait_until(
+                lambda: ("Pod", "preexists") in seen,
+                what="pod initial-list replay",
+            )
+        finally:
+            b1.close()
+            b2.close()

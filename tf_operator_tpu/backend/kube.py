@@ -316,6 +316,33 @@ class GoneError(ApiError):
 # ---------------------------------------------------------------------------
 
 
+def http_json(
+    host: str, port: int, method: str, path: str,
+    body: Optional[dict] = None, timeout: float = 5.0,
+) -> dict:
+    """One JSON request with the apiserver error mapping (shared by
+    KubeBackend and the TPUJob store, backend/kubejobs.py)."""
+
+    conn = HTTPConnection(host, port, timeout=timeout)
+    try:
+        payload = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"} if payload else {}
+        conn.request(method, path, body=payload, headers=headers)
+        resp = conn.getresponse()
+        text = resp.read().decode(errors="replace")
+        if resp.status == 404:
+            raise NotFoundError(path)
+        if resp.status == 409:
+            raise AlreadyExistsError(path)
+        if resp.status == 410:
+            raise GoneError(410, text)
+        if resp.status >= 400:
+            raise ApiError(resp.status, text)
+        return json.loads(text) if text else {}
+    finally:
+        conn.close()
+
+
 class KubeBackend(ClusterBackend):
     """ClusterBackend over the Kubernetes HTTP protocol.
 
@@ -351,24 +378,9 @@ class KubeBackend(ClusterBackend):
     def _request(
         self, method: str, path: str, body: Optional[dict] = None
     ) -> dict:
-        conn = HTTPConnection(self.host, self.port, timeout=self.timeout)
-        try:
-            payload = json.dumps(body).encode() if body is not None else None
-            headers = {"Content-Type": "application/json"} if payload else {}
-            conn.request(method, path, body=payload, headers=headers)
-            resp = conn.getresponse()
-            text = resp.read().decode(errors="replace")
-            if resp.status == 404:
-                raise NotFoundError(path)
-            if resp.status == 409:
-                raise AlreadyExistsError(path)
-            if resp.status == 410:
-                raise GoneError(410, text)
-            if resp.status >= 400:
-                raise ApiError(resp.status, text)
-            return json.loads(text) if text else {}
-        finally:
-            conn.close()
+        return http_json(
+            self.host, self.port, method, path, body, self.timeout
+        )
 
     @staticmethod
     def _collection(kind: str, namespace: Optional[str] = None) -> str:
@@ -540,7 +552,22 @@ class KubeBackend(ClusterBackend):
         while not self._stop.is_set():
             try:
                 if rv == 0:
-                    _, rv = self._list(kind, None)
+                    items, rv = self._list(kind, None)
+                    # client-go ListAndWatch feeds the LISTED objects
+                    # to the informer, not just the resourceVersion:
+                    # objects that existed before this client started
+                    # (operator restart over a live cluster) must
+                    # reach the cache as events, or a fresh reconciler
+                    # would re-create pods that already run.  Replayed
+                    # ADDEDs on reconnect are level-triggered no-ops.
+                    for obj in items:
+                        self._dispatch(
+                            WatchEvent(
+                                type=WatchEventType.ADDED,
+                                kind=kind,
+                                obj=obj,
+                            )
+                        )
                 # resume from the last event the stream delivered — a
                 # cleanly closed stream (real apiservers recycle watch
                 # connections every few minutes) re-watches from there,

@@ -68,6 +68,7 @@ _PLURALS = {
     "services": "Service",
     "podgroups": "PodGroup",
     "leases": "Lease",
+    "tpujobs": "TPUJob",
 }
 
 
@@ -157,6 +158,9 @@ class MiniApiServer:
             def do_PATCH(self):
                 sim._handle(self, "PATCH")
 
+            def do_PUT(self):
+                sim._handle(self, "PUT")
+
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self._httpd.daemon_threads = True
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
@@ -224,13 +228,17 @@ class MiniApiServer:
         """(kind, namespace|None, name|None, subresource|None) or None."""
 
         parts = [p for p in path.split("/") if p]
-        # /api/v1/..., /apis/scheduling.volcano.sh/v1beta1/..., or
-        # /apis/coordination.k8s.io/v1/... (Leases — leader election)
+        # /api/v1/..., /apis/scheduling.volcano.sh/v1beta1/...,
+        # /apis/coordination.k8s.io/v1/... (Leases — leader election),
+        # or /apis/tpujob.dist/v1/... (the TPUJob custom resource —
+        # the reference's TFJob CRD tier)
         if parts[:2] == ["api", "v1"]:
             rest = parts[2:]
         elif parts[:3] == ["apis", "scheduling.volcano.sh", "v1beta1"]:
             rest = parts[3:]
         elif parts[:3] == ["apis", "coordination.k8s.io", "v1"]:
+            rest = parts[3:]
+        elif parts[:3] == ["apis", "tpujob.dist", "v1"]:
             rest = parts[3:]
         else:
             return None
@@ -275,6 +283,10 @@ class MiniApiServer:
                 length = int(h.headers.get("Content-Length", "0"))
                 patch = json.loads(h.rfile.read(length) or b"{}")
                 return self._patch(h, kind, ns, name, patch)
+            if method == "PUT" and name is not None:
+                length = int(h.headers.get("Content-Length", "0"))
+                obj = json.loads(h.rfile.read(length) or b"{}")
+                return self._replace(h, kind, ns, name, obj)
         except (ValueError, KeyError) as e:
             return self._reply(
                 h, 400, self._status(400, "BadRequest", repr(e))
@@ -412,6 +424,49 @@ class MiniApiServer:
                 self.store.bump(kind, "MODIFIED", obj)
                 self._regrant_locked()
             return self._reply(h, 200, obj)
+
+    def _replace(self, h, kind, ns, name, new_obj: Dict[str, Any]):
+        """PUT = whole-object replacement (client-go Update): unlike
+        merge-patch, absent keys are DROPPED — the semantics a spec
+        update needs to unset a field.  Identity (name/namespace/uid)
+        is server-owned and preserved."""
+
+        key = (kind, ns or "default", name)
+        with self.store.lock:
+            obj = self.store.objects.get(key)
+            if obj is None:
+                return self._reply(
+                    h, 404, self._status(404, "NotFound", f"{kind} {name}")
+                )
+            want_rv = str(
+                new_obj.get("metadata", {}).get("resourceVersion", "")
+            )
+            have_rv = str(obj.get("metadata", {}).get("resourceVersion", ""))
+            if want_rv and want_rv != have_rv:
+                return self._reply(
+                    h,
+                    409,
+                    self._status(
+                        409,
+                        "Conflict",
+                        f"resourceVersion {want_rv} != {have_rv}",
+                    ),
+                )
+            meta = new_obj.setdefault("metadata", {})
+            meta["name"] = name
+            meta["namespace"] = ns or "default"
+            meta["uid"] = obj.get("metadata", {}).get("uid", "")
+            self.store.objects[key] = new_obj
+            self.store.bump(kind, "MODIFIED", new_obj)
+            if kind == "PodGroup":
+                chips = self._group_chips(new_obj)
+                granted = self._can_grant(chips, exclude=key)
+                new_obj.setdefault("status", {})["phase"] = (
+                    "Granted" if granted else "Pending"
+                )
+                self.store.bump(kind, "MODIFIED", new_obj)
+                self._regrant_locked()
+            return self._reply(h, 200, new_obj)
 
     def _pod_log(self, h, ns: Optional[str], name: str):
         path = self._log_path(ns or "default", name)

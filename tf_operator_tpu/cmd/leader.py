@@ -156,8 +156,16 @@ class KubeLease:
 
     def try_acquire(self) -> bool:
         """Non-blocking: True when this process leads (and renewal is
-        running)."""
+        running).  Connection-level failures read as "not leading" —
+        a standby must keep polling through an apiserver blip, not
+        crash out of the operator loop."""
 
+        try:
+            return self._try_acquire()
+        except (OSError, ValueError):
+            return False
+
+    def _try_acquire(self) -> bool:
         with self._lock:
             if self._leading:
                 return True
@@ -217,24 +225,45 @@ class KubeLease:
         stop = self._stop
 
         def renew_loop():
+            # transient-vs-fatal policy (client-go's): a rival holder
+            # demotes IMMEDIATELY; a connection failure retries until
+            # the lease deadline — a single apiserver blip must not
+            # silently kill this thread (a dead renewer with
+            # _leading=True is exactly the split-brain the lease
+            # exists to prevent).
+            last_ok = time.time()
             while not stop.wait(self.duration / 3.0):
-                status, obj = self._request("GET", self._path)
-                ok = (
-                    status == 200
-                    and obj.get("spec", {}).get("holderIdentity")
-                    == self.identity
-                )
-                if ok:
-                    rv = obj.get("metadata", {}).get("resourceVersion", "")
-                    spec = dict(obj.get("spec", {}))
-                    spec["renewTime"] = time.time()
-                    status, _ = self._request(
-                        "PATCH",
-                        self._path,
-                        {"metadata": {"resourceVersion": rv}, "spec": spec},
-                    )
-                    ok = status == 200
-                if not ok:
+                usurped = False
+                renewed = False
+                try:
+                    status, obj = self._request("GET", self._path)
+                    if status == 200:
+                        holder = obj.get("spec", {}).get("holderIdentity")
+                        if holder != self.identity:
+                            usurped = True
+                        else:
+                            rv = obj.get("metadata", {}).get(
+                                "resourceVersion", ""
+                            )
+                            spec = dict(obj.get("spec", {}))
+                            spec["renewTime"] = time.time()
+                            status, _ = self._request(
+                                "PATCH",
+                                self._path,
+                                {
+                                    "metadata": {"resourceVersion": rv},
+                                    "spec": spec,
+                                },
+                            )
+                            renewed = status == 200
+                    elif status == 404:
+                        usurped = True  # lease deleted under us
+                except (OSError, ValueError):
+                    pass  # transient: judged against the deadline below
+                if renewed:
+                    last_ok = time.time()
+                    continue
+                if usurped or time.time() - last_ok > self.duration:
                     with self._lock:
                         self._leading = False
                     stop.set()
@@ -247,7 +276,10 @@ class KubeLease:
         ).start()
 
     def holder(self) -> Optional[str]:
-        status, obj = self._request("GET", self._path)
+        try:
+            status, obj = self._request("GET", self._path)
+        except (OSError, ValueError):
+            return None
         if status != 200:
             return None
         return obj.get("spec", {}).get("holderIdentity")
@@ -261,19 +293,24 @@ class KubeLease:
         if was_leading:
             # hand off immediately: zero the renewTime so the next
             # candidate's expiry check passes without waiting out the
-            # lease duration
-            status, obj = self._request("GET", self._path)
-            if status == 200 and (
-                obj.get("spec", {}).get("holderIdentity") == self.identity
-            ):
-                rv = obj.get("metadata", {}).get("resourceVersion", "")
-                spec = dict(obj.get("spec", {}))
-                spec["renewTime"] = 0.0
-                self._request(
-                    "PATCH",
-                    self._path,
-                    {"metadata": {"resourceVersion": rv}, "spec": spec},
-                )
+            # lease duration.  Best-effort — at shutdown the apiserver
+            # (an embedded sim, say) may already be gone, and an
+            # unreleased lease simply expires.
+            try:
+                status, obj = self._request("GET", self._path)
+                if status == 200 and (
+                    obj.get("spec", {}).get("holderIdentity") == self.identity
+                ):
+                    rv = obj.get("metadata", {}).get("resourceVersion", "")
+                    spec = dict(obj.get("spec", {}))
+                    spec["renewTime"] = 0.0
+                    self._request(
+                        "PATCH",
+                        self._path,
+                        {"metadata": {"resourceVersion": rv}, "spec": spec},
+                    )
+            except (OSError, ValueError):
+                pass
 
     @property
     def is_leader(self) -> bool:

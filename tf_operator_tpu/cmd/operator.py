@@ -43,6 +43,8 @@ CONFIG_KEYS = {
     "jsonLog": "json_log",
     "leaderElect": "leader_elect",
     "leaseFile": "lease_file",
+    "leaseDuration": "lease_duration",
+    "kubeUrl": "kube_url",
     "logDir": "log_dir",
     "totalChips": "total_chips",
 }
@@ -165,7 +167,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--lease-file",
         default="/tmp/tpu-operator-leader.lock",
-        help="lease path for --leader-elect",
+        help="lease path for --leader-elect (local/fake backends)",
+    )
+    p.add_argument(
+        "--lease-duration",
+        type=float,
+        default=15.0,
+        help="Lease expiry in seconds for --leader-elect on kube "
+        "backends (takeover latency after a leader crash)",
     )
     p.add_argument(
         "--log-dir", default=None, help="pod log directory (local backend)"
@@ -207,6 +216,7 @@ def main(argv=None) -> int:
         )
     elif args.backend in ("kube-sim", "kube"):
         from tf_operator_tpu.backend.kube import KubeBackend
+        from tf_operator_tpu.backend.kubejobs import KubeJobStore
 
         if args.backend == "kube-sim":
             from tf_operator_tpu.backend.kubesim import MiniApiServer
@@ -220,6 +230,9 @@ def main(argv=None) -> int:
             if not args.kube_url:
                 parser.error("--backend kube requires --kube-url")
             url = args.kube_url
+        # jobs live IN the apiserver (the reference's TFJob-CRD tier):
+        # operator restarts and leader failover resume them from there
+        store = KubeJobStore(url)
         backend = KubeBackend(url)
         config = ReconcilerConfig(
             enable_gang_scheduling=args.enable_gang_scheduling,
@@ -257,7 +270,8 @@ def main(argv=None) -> int:
                 stop.set()
 
             lease = KubeLease(
-                url, identity=f"pid-{os.getpid()}", on_lost=_lost
+                url, identity=f"pid-{os.getpid()}", on_lost=_lost,
+                lease_duration=args.lease_duration,
             )
         else:
             lease = FileLease(args.lease_file, identity=f"pid-{os.getpid()}")
@@ -272,7 +286,15 @@ def main(argv=None) -> int:
         port=args.monitoring_port,
         namespace=args.namespace,
         leadership=(
-            None if lease is None else (lambda: (lease.is_leader, lease.holder()))
+            None
+            if lease is None
+            # holder() can be a blocking apiserver GET (KubeLease):
+            # only look it up on the 503 path, never per leader request
+            else (
+                lambda: (True, None)
+                if lease.is_leader
+                else (False, lease.holder())
+            )
         ),
     )
 
@@ -317,10 +339,15 @@ def main(argv=None) -> int:
         close = getattr(backend, "close", None)
         if close:
             close()
-        if sim is not None:
-            sim.stop()
+        store_close = getattr(store, "close", None)
+        if store_close:
+            store_close()
+        # release BEFORE stopping the embedded apiserver: a KubeLease
+        # hand-off is an HTTP call to it
         if lease:
             lease.release()
+        if sim is not None:
+            sim.stop()
         log.info("operator stopped")
     return 0
 
