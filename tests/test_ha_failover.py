@@ -185,6 +185,33 @@ class TestLeaderFailover:
             log = sim._log_path("default", "failover-worker-0")
             with open(log) as f:
                 assert f.read().count("survived failover") == 1
+            # the audit trail SPANS the failover: events live in the
+            # apiserver, so the first leader's pod-create and the new
+            # leader's completion are one history
+            import urllib.parse as _up
+
+            q = _up.quote(
+                "involvedObject.name=failover,involvedObject.namespace=default"
+            )
+
+            def reasons():
+                with urllib.request.urlopen(
+                    f"{sim.url}/api/v1/namespaces/default/events"
+                    f"?fieldSelector={q}",
+                    timeout=5,
+                ) as resp:
+                    return [
+                        e["reason"]
+                        for e in json.loads(resp.read())["items"]
+                    ]
+
+            # posting is async; poll briefly for the final event
+            _wait(
+                lambda: "SuccessfulCreatePod" in reasons()  # first leader
+                and "JobSucceeded" in reasons(),  # second leader
+                15,
+                "audit trail spans the failover",
+            )
         finally:
             for p in procs:
                 if p.poll() is None:

@@ -605,3 +605,77 @@ class TestKubeJobStore:
         finally:
             b1.close()
             b2.close()
+
+
+class TestKubeEventRecorder:
+    """v1 Events in the apiserver (backend/kubejobs.KubeEventRecorder):
+    the reference's audit trail is cluster-side, not operator memory."""
+
+    def test_post_filter_and_cross_process_visibility(self):
+        from tf_operator_tpu.backend.kubejobs import KubeEventRecorder
+
+        sim = MiniApiServer().start()
+        try:
+            rec = KubeEventRecorder(sim.url)
+            rec.event("default/job-a", "Normal", "JobCreated", "created")
+            rec.event("default/job-a", "Normal", "SuccessfulCreatePod", "p0")
+            rec.event("default/job-b", "Warning", "JobFailed", "boom")
+            rec.event("ns2/job-a", "Normal", "JobCreated", "other ns")
+            rec.flush()  # posting is async (never blocks a reconcile)
+
+            evs = rec.for_object("default/job-a")
+            assert [e.reason for e in evs] == [
+                "JobCreated", "SuccessfulCreatePod",
+            ]
+            assert all(e.object_key == "default/job-a" for e in evs)
+            assert len(rec.all()) == 4
+
+            # a DIFFERENT recorder (new process / next leader) sees the
+            # same history — it lives in the apiserver
+            rec2 = KubeEventRecorder(sim.url)
+            assert [e.reason for e in rec2.for_object("default/job-b")] == [
+                "JobFailed"
+            ]
+            # wire shape: real v1 Event objects with involvedObject
+            raw = rec._request(
+                "GET", "/api/v1/namespaces/default/events"
+            )["items"]
+            assert all(o["kind"] == "Event" for o in raw)
+            assert all("involvedObject" in o for o in raw)
+        finally:
+            sim.stop()
+
+    def test_recorder_is_best_effort_when_apiserver_is_down(self):
+        from tf_operator_tpu.backend.kubejobs import KubeEventRecorder
+
+        rec = KubeEventRecorder("http://127.0.0.1:1")  # nothing listens
+        rec.event("default/x", "Normal", "JobCreated", "dropped, no raise")
+        rec.flush(timeout=3.0)
+        assert rec.for_object("default/x") == []
+        assert rec.all() == []
+
+    def test_rfc3339_timestamps_parse_and_order(self):
+        """Real-apiserver interop: events go out with RFC3339
+        firstTimestamp and read back from RFC3339 or epoch floats."""
+
+        from tf_operator_tpu.backend.kubejobs import KubeEventRecorder
+
+        sim = MiniApiServer().start()
+        try:
+            rec = KubeEventRecorder(sim.url)
+            rec.event("default/j", "Normal", "First", "1")
+            rec.event("default/j", "Normal", "Second", "2")
+            rec.flush()
+            raw = rec._request("GET", "/api/v1/namespaces/default/events")[
+                "items"
+            ]
+            for o in raw:
+                ts = o["firstTimestamp"]
+                assert isinstance(ts, str) and ts.endswith("Z"), ts
+                time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ")  # valid RFC3339
+            evs = rec.for_object("default/j")
+            # same-second events stay in emission order (name tie-break)
+            assert [e.reason for e in evs] == ["First", "Second"]
+            assert all(e.timestamp > 0 for e in evs)
+        finally:
+            sim.stop()

@@ -250,3 +250,175 @@ class KubeJobStore:
             conn.close()
         if self._watcher is not None:
             self._watcher.join(timeout=2.0)
+
+
+class KubeEventRecorder:
+    """EventRecorder surface posting REAL ``v1 Event`` objects to the
+    apiserver (``/api/v1/namespaces/{ns}/events``) — the reference's
+    audit trail lives in the events API, not operator memory, so
+    `kubectl get events`-style tooling and a post-failover leader both
+    see the history.  Reads filter server-side with the real
+    ``fieldSelector involvedObject.name=...`` shape.
+
+    Same surface as utils.events.EventRecorder (event / for_object /
+    all), so the controller, job API, and `tpujob describe` read path
+    take it unchanged.  Like client-go's event broadcaster, posting is
+    asynchronous AND best-effort: ``event()`` enqueues to a bounded
+    buffer drained by a daemon thread (an emission must never block a
+    reconcile worker on network I/O), and a full buffer or an
+    apiserver error drops the event rather than failing the reconcile
+    that emitted it.  Timestamps go out as RFC3339 (what a real
+    apiserver validates) and parse back from RFC3339 or epoch floats.
+    """
+
+    #: bounded post buffer; overflow drops the OLDEST events
+    QUEUE_MAX = 1024
+
+    def __init__(self, base_url: str, timeout: float = 2.0):
+        import collections
+
+        u = urllib.parse.urlparse(base_url)
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or 80
+        self.timeout = timeout
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._queue = collections.deque(maxlen=self.QUEUE_MAX)
+        self._kick = threading.Event()
+        self._stop = threading.Event()
+        self._poster = threading.Thread(
+            target=self._post_loop, daemon=True, name="kube-event-post"
+        )
+        self._poster.start()
+
+    def _request(self, method: str, path: str, body=None) -> dict:
+        return http_json(self.host, self.port, method, path, body, self.timeout)
+
+    @staticmethod
+    def _rfc3339(ts: float) -> str:
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+    @staticmethod
+    def _parse_ts(raw) -> float:
+        """Epoch float from either our epoch-float wire value or a real
+        apiserver's RFC3339 string; unparseable -> 0.0 (never raises:
+        this sits on the describe read path)."""
+
+        if isinstance(raw, (int, float)):
+            return float(raw)
+        if isinstance(raw, str):
+            try:
+                return float(raw)
+            except ValueError:
+                pass
+            import calendar
+
+            for fmt in ("%Y-%m-%dT%H:%M:%SZ", "%Y-%m-%dT%H:%M:%S.%fZ"):
+                try:
+                    return calendar.timegm(time.strptime(raw, fmt))
+                except ValueError:
+                    continue
+        return 0.0
+
+    def event(
+        self, object_key: str, etype: str, reason: str, message: str
+    ) -> None:
+        ns, _, name = object_key.partition("/")
+        now = time.time()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        obj = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                # unique AND lexicographically time-ordered (zero-padded
+                # micros): the same-second tie-break for sorted reads
+                "name": f"{name}.{int(now * 1e6):016x}.{seq}",
+                "namespace": ns,
+            },
+            "type": etype,
+            "reason": reason,
+            "message": message,
+            "involvedObject": {
+                "apiVersion": "tpujob.dist/v1",
+                "kind": "TPUJob",
+                "name": name,
+                "namespace": ns,
+            },
+            "firstTimestamp": self._rfc3339(now),
+        }
+        self._queue.append((ns, obj))  # deque(maxlen): overflow drops oldest
+        self._kick.set()
+
+    def _post_loop(self) -> None:
+        while not self._stop.is_set():
+            self._kick.wait(timeout=0.5)
+            self._kick.clear()
+            while True:
+                try:
+                    ns, obj = self._queue.popleft()
+                except IndexError:
+                    break
+                try:
+                    self._request(
+                        "POST", f"/api/v1/namespaces/{ns}/events", obj
+                    )
+                except Exception:
+                    pass  # best-effort, like client-go's broadcaster
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Block until the post buffer drains (tests / clean shutdown)."""
+
+        deadline = time.time() + timeout
+        while self._queue and time.time() < deadline:
+            time.sleep(0.02)
+
+    def close(self) -> None:
+        self.flush(timeout=2.0)
+        self._stop.set()
+        self._kick.set()
+        self._poster.join(timeout=2.0)
+
+    def _decode_events(self, items):
+        from tf_operator_tpu.utils.events import Event
+
+        decorated = []
+        for o in items:
+            inv = o.get("involvedObject", {}) or {}
+            decorated.append((
+                self._parse_ts(o.get("firstTimestamp")),
+                str(o.get("metadata", {}).get("name", "")),
+                Event(
+                    object_key=(
+                        f"{inv.get('namespace', '')}/{inv.get('name', '')}"
+                    ),
+                    type=o.get("type", "Normal"),
+                    reason=o.get("reason", ""),
+                    message=o.get("message", ""),
+                    timestamp=self._parse_ts(o.get("firstTimestamp")),
+                ),
+            ))
+        decorated.sort(key=lambda t: (t[0], t[1]))
+        return [e for _, _, e in decorated]
+
+    def for_object(self, object_key: str):
+        ns, _, name = object_key.partition("/")
+        fsel = urllib.parse.quote(
+            f"involvedObject.name={name},involvedObject.namespace={ns}"
+        )
+        try:
+            out = self._request(
+                "GET",
+                f"/api/v1/namespaces/{ns}/events?fieldSelector={fsel}",
+            )
+        except Exception:
+            return []
+        return self._decode_events(out.get("items", []))
+
+    def all(self):
+        try:
+            out = self._request("GET", "/api/v1/events")
+        except Exception:
+            return []
+        return self._decode_events(out.get("items", []))

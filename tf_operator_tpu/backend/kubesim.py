@@ -63,13 +63,28 @@ _REPO_ROOT = os.path.dirname(
 #: events kept for watch replay; older resourceVersions get 410 Gone
 EVENT_LOG_WINDOW = 4096
 
+#: stored v1 Event objects are GC'd beyond this count (a real
+#: apiserver expires events after ~1h TTL; without a cap a long-lived
+#: sim would grow without bound)
+MAX_EVENT_OBJECTS = 4096
+
 _PLURALS = {
     "pods": "Pod",
     "services": "Service",
     "podgroups": "PodGroup",
     "leases": "Lease",
     "tpujobs": "TPUJob",
+    "events": "Event",
 }
+
+
+def _field_get(obj: Dict[str, Any], dotted: str):
+    cur: Any = obj
+    for part in dotted.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
 
 
 def _labels(obj: Dict[str, Any]) -> Dict[str, str]:
@@ -268,7 +283,8 @@ class MiniApiServer:
                 return self._watch(h, kind, rv)
             if method == "GET" and name is None:
                 sel = parse_selector(q.get("labelSelector", [""])[0])
-                return self._list(h, kind, ns, sel)
+                fsel = parse_selector(q.get("fieldSelector", [""])[0])
+                return self._list(h, kind, ns, sel, fsel)
             if method == "GET" and sub == "log":
                 return self._pod_log(h, ns, name)
             if method == "GET":
@@ -324,6 +340,12 @@ class MiniApiServer:
                 )
             self.store.objects[key] = obj
             self.store.bump(kind, "ADDED", obj)
+            if kind == "Event":
+                # TTL-analogue GC: silently drop the oldest Events past
+                # the cap (insertion order; nobody watches Events)
+                ev_keys = [k for k in self.store.objects if k[0] == "Event"]
+                for old_key in ev_keys[: max(0, len(ev_keys) - MAX_EVENT_OBJECTS)]:
+                    self.store.objects.pop(old_key, None)
             return self._reply(h, 201, obj)
 
     def _get(self, h, kind: str, ns: Optional[str], name: str):
@@ -336,7 +358,10 @@ class MiniApiServer:
                 )
             return self._reply(h, 200, obj)
 
-    def _list(self, h, kind: str, ns: Optional[str], sel: Dict[str, str]):
+    def _list(
+        self, h, kind: str, ns: Optional[str], sel: Dict[str, str],
+        fsel: Optional[Dict[str, str]] = None,
+    ):
         with self.store.lock:
             items = [
                 o
@@ -344,6 +369,10 @@ class MiniApiServer:
                 if k == kind
                 and (ns is None or n == ns)
                 and match_selector(_labels(o), sel)
+                and all(
+                    str(_field_get(o, fk)) == fv
+                    for fk, fv in (fsel or {}).items()
+                )
             ]
             out = {
                 "apiVersion": "v1",

@@ -276,7 +276,17 @@ def main(argv=None) -> int:
         else:
             lease = FileLease(args.lease_file, identity=f"pid-{os.getpid()}")
 
-    controller = TPUJobController(store, backend, config=config)
+    recorder = None
+    if args.backend in ("kube-sim", "kube"):
+        # events are REAL v1 Event objects in the apiserver: visible
+        # to external tooling and to the next leader after a failover
+        from tf_operator_tpu.backend.kubejobs import KubeEventRecorder
+
+        recorder = KubeEventRecorder(url)
+
+    controller = TPUJobController(
+        store, backend, config=config, recorder=recorder
+    )
     api = ApiServer(
         store,
         backend,
@@ -342,6 +352,8 @@ def main(argv=None) -> int:
         store_close = getattr(store, "close", None)
         if store_close:
             store_close()
+        if recorder is not None:
+            recorder.close()  # drain the async event buffer
         # release BEFORE stopping the embedded apiserver: a KubeLease
         # hand-off is an HTTP call to it
         if lease:

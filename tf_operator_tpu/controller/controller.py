@@ -41,6 +41,7 @@ class TPUJobController:
         use_native: Optional[bool] = None,
         resync_period: float = 30.0,
         expectations_timeout: float = EXPECTATION_TIMEOUT_S,
+        recorder: Optional[EventRecorder] = None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -62,7 +63,10 @@ class TPUJobController:
             self.queue = WorkQueue()
             self.pod_exp = Expectations(expectations_timeout)
             self.svc_exp = Expectations(expectations_timeout)
-        self.recorder = EventRecorder()
+        # injectable: the kube backends post REAL v1 Event objects to
+        # the apiserver instead (backend/kubejobs.KubeEventRecorder —
+        # same surface, so the describe/API read path is unchanged)
+        self.recorder = recorder if recorder is not None else EventRecorder()
         self.metrics = metrics or default_metrics
         if config is None:
             config = ReconcilerConfig(use_native_decisions=self.native)
