@@ -310,3 +310,86 @@ class TestLeaderFailover:
                 except subprocess.TimeoutExpired:
                     p.kill()
             sim.stop()
+
+    def test_apiserver_outage_mid_job_heals(self, tmp_path):
+        """Failure-detection at the cluster tier: the apiserver drops
+        off the network mid-job (listener closed; kubelet keeps the
+        worker running — real kubelets outlive apiserver outages), the
+        worker FINISHES during the outage, and when the apiserver
+        returns the operator's watch streams re-list, see the
+        Succeeded pod, and complete the job.  No restarts, no Failed
+        conditions from infrastructure errors."""
+
+        sim = MiniApiServer().start()
+        procs = []
+        try:
+            log_path = tmp_path / "op.log"
+            lf = open(log_path, "w")
+            op = subprocess.Popen(
+                [
+                    sys.executable, "-m", "tf_operator_tpu.cmd.operator",
+                    "--backend", "kube", "--kube-url", sim.url,
+                    "--monitoring-port", "0",
+                ],
+                stdout=lf, stderr=subprocess.STDOUT, cwd=os.getcwd(),
+            )
+            procs.append(op)
+            port = _wait(lambda: _port_from_log(log_path), 30, "port")
+            manifest = {
+                "apiVersion": "tpujob.dist/v1",
+                "kind": "TPUJob",
+                "metadata": {"name": "outage", "namespace": "default"},
+                "spec": {
+                    "tpuReplicaSpecs": {
+                        "Worker": {
+                            "replicas": 1,
+                            "template": {"spec": {"containers": [{
+                                "name": "tensorflow",
+                                "command": [
+                                    sys.executable, "-c",
+                                    "import time; time.sleep(6); "
+                                    "print('finished during the outage')",
+                                ],
+                            }]}},
+                        }
+                    }
+                },
+            }
+            _job_api(
+                port, "POST", "/apis/v1/namespaces/default/tpujobs", manifest
+            )
+
+            def conds():
+                for j in _job_api(port)["items"]:
+                    if j["metadata"]["name"] == "outage":
+                        return [
+                            c["type"]
+                            for c in j.get("status", {}).get("conditions", [])
+                            if c.get("status") in (True, "True")
+                        ]
+                return None
+
+            _wait(lambda: "Running" in (conds() or []), 60, "Running")
+
+            sim.pause()  # the apiserver vanishes from the network...
+            time.sleep(8)  # ...spanning the worker's exit
+            sim.resume()
+
+            _wait(
+                lambda: "Succeeded" in (conds() or []), 90,
+                "job Succeeded after the apiserver returned",
+            )
+            assert "Failed" not in (conds() or [])
+            log = sim._log_path("default", "outage-worker-0")
+            with open(log) as f:
+                assert f.read().count("finished during the outage") == 1
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            sim.stop()

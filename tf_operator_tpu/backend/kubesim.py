@@ -141,6 +141,7 @@ class MiniApiServer:
         self.kubelet_interval = kubelet_interval
         self._procs: Dict[Tuple[str, str, str], subprocess.Popen] = {}
         self._stop = threading.Event()
+        self._paused = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: List[threading.Thread] = []
 
@@ -176,15 +177,47 @@ class MiniApiServer:
             def do_PUT(self):
                 sim._handle(self, "PUT")
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self._httpd.daemon_threads = True
-        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
-        t.start()
-        self._threads.append(t)
+        self._handler_cls = Handler
+        self._serve(("127.0.0.1", 0))
         k = threading.Thread(target=self._kubelet_loop, daemon=True)
         k.start()
         self._threads.append(k)
         return self
+
+    def _serve(self, addr) -> None:
+        """Bind + serve (shared by start and resume)."""
+
+        self._httpd = ThreadingHTTPServer(addr, self._handler_cls)
+        self._httpd.daemon_threads = True
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def pause(self) -> None:
+        """Simulate an apiserver NETWORK outage: close the HTTP
+        listener AND sever established connections (the long-lived
+        chunked watch streams break mid-flight, exactly like a real
+        network partition) while the store, scheduler and kubelet
+        sims keep running (real kubelets don't die when the apiserver
+        does).  ``resume()`` rebinds the same port; clients recover
+        through their re-list path."""
+
+        assert self._httpd is not None, "not started"
+        self._paused_addr = self._httpd.server_address[:2]
+        self._paused.set()
+        with self.store.lock:
+            for q in list(self.store.watchers):
+                q.put(None)  # wake blocked streams so they terminate
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+
+    def resume(self) -> None:
+        """End a pause(): rebind the remembered address and serve."""
+
+        assert self._httpd is None and hasattr(self, "_paused_addr")
+        self._paused.clear()
+        self._serve(self._paused_addr)
 
     def stop(self) -> None:
         self._stop.set()
@@ -540,7 +573,7 @@ class MiniApiServer:
 
             for _, _, et, o in backlog:
                 emit(et, o)
-            while not self._stop.is_set():
+            while not (self._stop.is_set() or self._paused.is_set()):
                 try:
                     item = q.get(timeout=0.5)
                 except Empty:
