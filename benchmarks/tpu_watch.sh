@@ -32,11 +32,11 @@ while true; do
       if ! python benchmarks/collect_window.py; then
         echo "[$(date +%H:%M:%S)] COLLECTOR FAILED — window artifacts left in benchmarks/window_out, NOT committed"
       fi
-      for f in BASELINE.md benchmarks/RESULTS.md benchmarks/window_out; do
+      for f in BASELINE.md benchmarks/RESULTS.md benchmarks/LAST_MEASURED.json benchmarks/window_out; do
         git add "$f" 2>/dev/null || echo "[$(date +%H:%M:%S)] could not stage $f"
       done
       git commit -q -m "Record measured TPU numbers from the completed measurement window" \
-        -- BASELINE.md benchmarks/RESULTS.md benchmarks/window_out \
+        -- BASELINE.md benchmarks/RESULTS.md benchmarks/LAST_MEASURED.json benchmarks/window_out \
         || echo "[$(date +%H:%M:%S)] nothing to commit from collector"
       exit 0
     fi
