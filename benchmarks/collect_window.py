@@ -279,7 +279,9 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             f"batching pool **{bt['batching_pool_tokens_per_sec']} "
             f"tok/s** vs sequential "
             f"{bt['batching_sequential_tokens_per_sec']} tok/s — "
-            f"**{bt['batching_speedup']}×** (`models/batching.py`) "
+            f"**{bt['batching_speedup']}×** (`models/batching.py`) — "
+            "tunnel-dispatch-bound: wall ≈ 66 ms RTT × dispatch count "
+            "on this box, not device math (PROFILE.md r5 serving) "
             f"| 1× v5 lite, `measure.py --section batching` → `window_out/batching.out`, {today} |"
         )
     sp = data.get("speculative")
@@ -291,7 +293,9 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             f"{sp['speculative_plain_tokens_per_sec']} tok/s — "
             f"**{sp['speculative_speedup']}×**, acceptance "
             f"{sp.get('speculative_acceptance', '?')} "
-            "(`models/speculative.py`) "
+            "(`models/speculative.py`) — one fused while-loop program "
+            "per call (r5); remaining gap is while-body DMA overlap + "
+            "thin self-draft economics at 120M (PROFILE.md r5 serving) "
             f"| 1× v5 lite, `measure.py --section speculative` → `window_out/speculative.out`, {today} |"
         )
     wd = data.get("wide")

@@ -1,0 +1,97 @@
+"""Category breakdown of a saved xplane trace: where did the step's
+device time actually go?
+
+    python benchmarks/trace_categories.py /tmp/rn50-xplane
+
+Groups the "[XLA Ops]" line (synchronous device ops — these sum to the
+critical path) by op family and prints each family's share, with the
+async-DMA line ("[Async XLA Ops]") reported separately since those
+overlap compute.  This is the trace-proven half of the "what bounds
+ResNet at ~0.29 MFU" claim (benchmarks/PROFILE.md): the sweep shows the
+plateau, this table names the ops on the critical path.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import sys
+from collections import defaultdict
+
+
+def categorize(name: str) -> str:
+    n = name.lower()
+    if "copy-start" in n or "copy-done" in n or n.startswith("%copy"):
+        return "copies / DMA"
+    if "all-reduce" in n or "reduce-scatter" in n or "all-gather" in n:
+        return "collectives"
+    # full word only — "convert" also contains "conv", and the int8
+    # dequant convert-fusions must not inflate the MXU share
+    if "convolution" in n:
+        return "convolution (MXU)"
+    if "reduce" in n:  # incl. convert_reduce_fusion (BN statistics)
+        return "reductions (BN stats etc.)"
+    if "dot" in n or "matmul" in n:
+        return "matmul (MXU)"
+    if "convert" in n:
+        return "dtype converts"
+    if "fusion" in n:
+        return "elementwise fusions"
+    if "infeed" in n or "outfeed" in n:
+        return "host transfer"
+    return "other"
+
+
+def main() -> int:
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/rn50-xplane"
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    )
+    if not paths:
+        print("no xplane found under", trace_dir)
+        return 1
+    path = max(paths, key=os.path.getmtime)
+    space = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        space.ParseFromString(f.read())
+    for plane in space.planes:
+        if "TPU" not in plane.name and "/device:" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name not in ("XLA Ops", "Async XLA Ops"):
+                continue
+            by_cat = defaultdict(float)
+            cnt = defaultdict(int)
+            total = 0.0
+            for ev in line.events:
+                meta = plane.event_metadata.get(ev.metadata_id)
+                name = meta.name if meta else "?"
+                dur = ev.duration_ps / 1e12
+                cat = categorize(name)
+                by_cat[cat] += dur
+                cnt[cat] += 1
+                total += dur
+            if not total:
+                continue
+            kind = (
+                "critical path (sync ops)"
+                if line.name == "XLA Ops"
+                else "overlapped DMA (async)"
+            )
+            print(
+                f"\n== {plane.name} / {line.name} — {kind}: "
+                f"{total*1e3:.1f} ms total =="
+            )
+            for cat, dur in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+                print(
+                    f"{dur*1e3:10.2f} ms  {dur/total*100:5.1f}%  "
+                    f"x{cnt[cat]:<6d} {cat}"
+                )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

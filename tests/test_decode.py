@@ -12,6 +12,11 @@ from tf_operator_tpu.models import generate, gpt_tiny, llama_tiny
 
 VOCAB = 128
 
+import sys as _sys, os as _os
+_sys.path.insert(0, _os.path.dirname(__file__))
+from testutil import assert_decode_equiv_up_to_ties  # noqa: E402
+
+
 
 def _reference_greedy(model, params, prompt, n):
     """No-cache reference: rerun the full forward on the growing
@@ -152,11 +157,12 @@ class TestChunkedServingDecoder:
         params = gather_params(tr)
         model = llama_tiny(vocab_size=VOCAB, max_len=128)
         dec = ChunkedServingDecoder(model, params)
+
         for p_len, n_new in ((1, 7), (5, 7), (37, 7), (64, 7)):
             prompt = jnp.asarray(r.randint(0, VOCAB, size=(2, p_len)), jnp.int32)
             a = dec.generate(prompt, max_new_tokens=n_new)
             b = generate(model, params, prompt, max_new_tokens=n_new)
-            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            assert_decode_equiv_up_to_ties(model, params, a, b)
         # longer/awkward prompts: assert the MATH (chunked prefill's
         # last-position logits vs one-shot) with bf16 tolerance — exact
         # greedy-token chains over many steps amplify benign program-
@@ -610,9 +616,13 @@ class TestModelRegistry:
         # what the live trainer generates
         model = model_from_description(desc)
         prompt = jnp.asarray(ids[:2, :6])
-        from_desc = generate(model, load_params(art), prompt, max_new_tokens=6)
+        params = load_params(art)
+        from_desc = generate(model, params, prompt, max_new_tokens=6)
         live = tr.generate(prompt, max_new_tokens=6)
-        np.testing.assert_array_equal(np.asarray(from_desc), np.asarray(live))
+        # reconstructed-vs-live runs two distinct programs (single-
+        # device generate vs the trainer's sharded path): exact up to
+        # sub-noise argmax ties
+        assert_decode_equiv_up_to_ties(model, params, from_desc, live)
 
 
 def test_serve_lm_end_to_end(tmp_path):

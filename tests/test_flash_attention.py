@@ -444,10 +444,11 @@ class TestDefaultBlockEnv:
 
         monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_Q", raising=False)
         monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_K", raising=False)
-        assert default_flash_blocks() == (128, 128)
-        monkeypatch.setenv("TPU_OPERATOR_FLASH_BLOCK_Q", "256")
+        # r5 default: the autotune winner (see default_flash_blocks)
+        assert default_flash_blocks() == (256, 256)
+        monkeypatch.setenv("TPU_OPERATOR_FLASH_BLOCK_Q", "128")
         monkeypatch.setenv("TPU_OPERATOR_FLASH_BLOCK_K", "512")
-        assert default_flash_blocks() == (256, 512)
+        assert default_flash_blocks() == (128, 512)
 
     def test_attention_uses_env_blocks(self, monkeypatch):
         """attention() resolves None block args from the env — the
@@ -468,7 +469,38 @@ class TestDefaultBlockEnv:
             return real(q, k, bias, mask, block_q, block_k, window)
 
         monkeypatch.setattr(fa, "_flash_applicable", spy)
-        monkeypatch.setenv("TPU_OPERATOR_FLASH_BLOCK_Q", "256")
+        monkeypatch.setenv("TPU_OPERATOR_FLASH_BLOCK_Q", "128")
         q, k, v = rand_qkv(7, 1, 2, 256, 64)
         fa.attention(q, k, v, causal=True)
-        assert seen["blocks"] == (256, 128)
+        # BLOCK_Q pinned by env, BLOCK_K from the 256 default
+        assert seen["blocks"] == (128, 256)
+
+    def test_shrunken_default_blocks_keep_xla_below_128block_crossover(
+        self, monkeypatch
+    ):
+        """seq 1152 tiles 128 but not the 256 default: the blocks
+        shrink so the kernel stays reachable, but in AUTO mode the
+        shrunken 128x128 config keeps its own measured crossover
+        (2048) — at 128 blocks the kernel loses 1.4x at ~1k (r4 sweep),
+        so auto must route 1152 to XLA, while force still forces."""
+
+        import importlib
+
+        fa = importlib.import_module("tf_operator_tpu.ops.flash_attention")
+        seen = {}
+        real = fa._flash_applicable
+
+        def spy(q, k, bias, mask, block_q, block_k, window=None):
+            seen["blocks"] = (block_q, block_k)
+            return real(q, k, bias, mask, block_q, block_k, window)
+
+        monkeypatch.setattr(fa, "_flash_applicable", spy)
+        monkeypatch.delenv("TPU_OPERATOR_FLASH", raising=False)
+        monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_Q", raising=False)
+        monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_K", raising=False)
+        q, k, v = rand_qkv(9, 1, 2, 1152, 64)
+        fa.attention(q, k, v, causal=True)
+        assert "blocks" not in seen  # early XLA return, kernel not consulted
+        monkeypatch.setenv("TPU_OPERATOR_FLASH", "1")
+        fa.attention(q, k, v, causal=True)
+        assert seen["blocks"] == (128, 128)  # forced: shrunken blocks

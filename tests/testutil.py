@@ -102,3 +102,42 @@ def load_serve_lm():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def assert_decode_equiv_up_to_ties(model, params, out, ref):
+    """Token-exact except argmax flips on near-tied logits: at each
+    row's first divergence, replay the reference prefix through the
+    decode variant and require the two CONTESTED tokens to be top-3
+    ranked and within bf16 cross-program noise of each other (pair
+    gap < 0.05 — measured: distinct XLA programs legitimately flip
+    decisions whose TRUE f32 margin is <= 0.022 on a 4-layer bf16
+    fixture).  After a flip the chains diverge by construction.  A
+    real plumbing bug (cache corruption, wrong weights, scale
+    misalignment) emits tokens ranked far below the top and fails.
+    Shared by the decode/quant/speculative parity tests."""
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.models.decode import _decode_variant, _init_cache_for
+
+    out, ref = np.asarray(out), np.asarray(ref)
+    assert out.shape == ref.shape
+    dmodel = _decode_variant(model)
+    for i in range(out.shape[0]):
+        if (out[i] == ref[i]).all():
+            continue
+        j = int(np.argwhere(out[i] != ref[i])[0][0])
+        cache = _init_cache_for(dmodel, 1)
+        logits, _ = dmodel.apply(
+            {"params": params, "cache": cache},
+            jnp.asarray(ref[i : i + 1, :j]),
+            mutable=["cache"],
+        )
+        lg = np.asarray(logits[0, -1], np.float32)
+        top3 = set(np.argsort(lg)[::-1][:3].tolist())
+        pair_gap = abs(float(lg[out[i, j]] - lg[ref[i, j]]))
+        assert out[i, j] in top3 and ref[i, j] in top3 and pair_gap < 0.05, (
+            f"row {i} diverges at pos {j} and it is NOT a near-tie: "
+            f"{out[i, j]} vs {ref[i, j]}, pair gap {pair_gap:.4f}"
+        )

@@ -70,7 +70,7 @@ from tf_operator_tpu.models.decode import (
     top_k_mask,
     window_chunks,
 )
-from tf_operator_tpu.ops.quant import materialize_tree
+from tf_operator_tpu.ops.quant import materialize_fn
 
 
 #: static top-k width: per-slot k thresholds within the top TOP_K_MAX
@@ -110,6 +110,7 @@ class ContinuousBatchingDecoder:
 
     def __init__(self, model, params, slots: int = 8, steps_per_sync: int = 8):
         self.dmodel = _decode_variant(model)
+        self._materialize = materialize_fn(model)
         cfg = self.dmodel.cfg
         # rolling-window caches (window < max_len) work unchanged: each
         # slot's cache — including its wrap state (cached_pos, circular
@@ -171,10 +172,11 @@ class ContinuousBatchingDecoder:
         with self._compile_lock:
             if width not in self._prefill_fns:
                 dmodel = self.dmodel
+                materialize = self._materialize
 
                 def prefill(params, cache, ids):  # ids [1, width]
                     logits, vars_ = dmodel.apply(
-                        {"params": materialize_tree(params), "cache": cache},
+                        {"params": materialize(params), "cache": cache},
                         ids,
                         mutable=["cache"],
                     )
@@ -208,6 +210,7 @@ class ContinuousBatchingDecoder:
         if self._step_fn is None:
             dmodel = self.dmodel
             n_inner = self.steps_per_sync
+            materialize = self._materialize
 
             def one_slot(params, cache, tok):
                 # batch-1 apply; under vmap the weights broadcast and
@@ -223,13 +226,13 @@ class ContinuousBatchingDecoder:
                 # K decode steps per host round trip: the whole inner
                 # loop is ONE XLA program, so a tunneled chip pays one
                 # network round trip per K tokens, not per token.
-                # Weights dequantize (quantized trees) INSIDE the scan
-                # body — see ops/quant.py on inflating-op hoisting.
+                # Quantized trees: QDense families keep int8 all the
+                # way to quant_matmul; others dequantize per step here.
                 def body(carry, _):
                     stack, toks, rngs = carry
                     stk, logits = jax.vmap(
                         one_slot, in_axes=(None, 0, 0)
-                    )(materialize_tree(params), stack, toks)
+                    )(materialize(params), stack, toks)
                     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                     split = jax.vmap(jax.random.split)(rngs)
                     safe_t = jnp.where(temps > 0.0, temps, 1.0)

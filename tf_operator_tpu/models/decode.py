@@ -32,7 +32,7 @@ import numpy as np
 from jax import lax
 
 from tf_operator_tpu.models.transformer import TransformerConfig
-from tf_operator_tpu.ops.quant import materialize_tree
+from tf_operator_tpu.ops.quant import materialize_fn
 
 
 def _decode_variant(model):
@@ -150,12 +150,11 @@ def generate(
     """
 
     dmodel = _decode_variant(model)  # also the supported-family guard
-    # int8-quantized trees (ops/quant.py): keep the tree int8 and
-    # dequantize at each apply site.  The decode-scan body dequantizes
-    # PER STEP — int8→bf16 is an inflating op XLA's loop-invariant
-    # code motion refuses to hoist, so weights cross HBM as int8 every
-    # token instead of being materialized bf16 once outside the loop.
+    # int8-quantized trees: QDense-stack families take the tree AS
+    # INT8 straight into apply; others dequantize per apply site (see
+    # ops/quant.materialize_fn for the policy + measurements)
     qparams = params
+    materialize = materialize_fn(model)
     cfg = dmodel.cfg
     b, p = prompt_ids.shape
     if max_new_tokens < 1:
@@ -188,7 +187,7 @@ def generate(
     # sized chunks — cache-equivalent to one-shot prefill, since slots
     # behind the band are dead either way.
     w = cfg.window
-    params = materialize_tree(qparams)  # prefill reads weights once
+    params = materialize(qparams)  # prefill reads weights once
     if w is not None and w < cfg.max_len and p > w:
         vars_ = {"cache": cache}
         logits = None
@@ -208,7 +207,7 @@ def generate(
     def body(carry, _):
         cache, tok, rng = carry
         logits, vars_ = dmodel.apply(
-            {"params": materialize_tree(qparams), "cache": cache},
+            {"params": materialize(qparams), "cache": cache},
             tok[:, None],
             mutable=["cache"],
         )
@@ -255,6 +254,7 @@ class ChunkedServingDecoder:
         self.dmodel = _decode_variant(model)
         self.params = params
         self.max_len = self.dmodel.cfg.max_len
+        self._materialize = materialize_fn(model)
         # windowed rolling cache accepts at most `window` tokens per
         # apply: cap chunk widths (program count stays logarithmic —
         # widths are still powers of two, just from a smaller set)
@@ -294,9 +294,11 @@ class ChunkedServingDecoder:
             if width not in self._prefill:
                 dmodel = self.dmodel
 
+                materialize = self._materialize
+
                 def prefill(params, cache, ids):
                     logits, vars_ = dmodel.apply(
-                        {"params": materialize_tree(params), "cache": cache},
+                        {"params": materialize(params), "cache": cache},
                         ids,
                         mutable=["cache"],
                     )
@@ -318,6 +320,7 @@ class ChunkedServingDecoder:
             while len(self._loops) >= self._max_loops:
                 self._loops.popitem(last=False)
             dmodel = self.dmodel
+            materialize = self._materialize
 
             def sample(logits, r):
                 if temperature == 0.0:
@@ -333,11 +336,11 @@ class ChunkedServingDecoder:
 
                 def body(carry, _):
                     cache, tok, rng = carry
-                    # dequantize PER STEP (inside the scan body): the
-                    # inflating int8→bf16 convert stays in the loop,
-                    # so quantized weights cross HBM as int8 each token
+                    # QDense families: int8 tree straight into apply
+                    # (quant_matmul dequantizes per tile in VMEM);
+                    # others dequantize per step here
                     logits, vars_ = dmodel.apply(
-                        {"params": materialize_tree(params), "cache": cache},
+                        {"params": materialize(params), "cache": cache},
                         tok[:, None],
                         mutable=["cache"],
                     )

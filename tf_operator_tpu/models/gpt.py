@@ -29,6 +29,7 @@ from tf_operator_tpu.models.transformer import (
 
 class CausalLM(nn.Module):
     SUPPORTS_DECODE = True  # autoregressive: models/decode.py can drive it
+    SUPPORTS_QTENSOR = True  # dense stack is QDenseGeneral (llama.py note)
 
     cfg: TransformerConfig
 

@@ -27,6 +27,7 @@ from tf_operator_tpu.models.transformer import (
     DecoderLayer,
     Embed,
     LayerNorm,
+    QDenseGeneral,
     TransformerConfig,
     logical_constraint,
     param_with_axes,
@@ -37,6 +38,10 @@ class LlamaLM(nn.Module):
     """Decoder-only LM over a TransformerConfig with rope=True."""
 
     SUPPORTS_DECODE = True  # autoregressive: models/decode.py can drive it
+    #: the whole stack routes QDenseGeneral/Embed, so the decode loops
+    #: may pass a quantize_tree'd params tree straight to apply — the
+    #: int8 weight feeds ops/quant_matmul per tile, no bf16 copy
+    SUPPORTS_QTENSOR = True
 
     cfg: TransformerConfig
 
@@ -52,7 +57,7 @@ class LlamaLM(nn.Module):
             )
         x = LayerNorm(cfg, rms=True, name="ln_final")(x)
         # untied head (llama convention), vocab on the tp axis
-        logits = nn.DenseGeneral(
+        logits = QDenseGeneral(
             cfg.vocab_size,
             use_bias=False,
             dtype=cfg.dtype,

@@ -70,7 +70,7 @@ from tf_operator_tpu.models.decode import (
     _init_cache_for,
     binary_chunks,
 )
-from tf_operator_tpu.ops.quant import materialize_tree
+from tf_operator_tpu.ops.quant import materialize_fn
 
 
 def _set_cache_index(cache, n):
@@ -112,8 +112,16 @@ class SpeculativeDecoder:
             raise ValueError("target and draft must share a vocabulary")
         self.tparams = tparams
         self.dparams = dparams
+        # int8 DRAFT is the economic premise (half the HBM bytes per
+        # draft step) — both models must consume QTensor natively
+        self._materialize = materialize_fn(target, draft)
         self.k = max(2, int(k))
         self.rounds_per_call = max(1, int(rounds_per_call))
+        #: whole-generation while_loop driver (one dispatch+fetch per
+        #: generate when room allows — see _fused).  Off = the host
+        #: round loop; kept for near-max_len prompts and as the parity
+        #: reference in tests.
+        self.use_fused = True
         self.max_len = self.dtar.cfg.max_len
         self._fns = {}
         self.compile_count = 0
@@ -157,9 +165,11 @@ class SpeculativeDecoder:
             )
             return vars_["cache"], logits[0, -1]
 
+        materialize = self._materialize
+
         def prefill(params, caches, ids):
             return jax.vmap(prefill_row, in_axes=(None, 0, 0))(
-                materialize_tree(params), caches, ids
+                materialize(params), caches, ids
             )
 
         return self._jit(("prefill", model_tag, width), prefill)
@@ -213,8 +223,13 @@ class SpeculativeDecoder:
                 nxt = jnp.argmax(logits[0, 0], -1).astype(jnp.int32)
                 return (vars_["cache"], nxt), nxt
 
+            # unroll: the k-1 sequential draft passes are tiny and
+            # weight-DMA-bound; unrolling lets XLA overlap each pass's
+            # weight streams instead of fencing at scan iteration
+            # boundaries (measured: the fused driver's wall time is
+            # async-DMA-bound, PROFILE.md "speculative")
             (dcache, last), ds = lax.scan(
-                body, (dcache, t1), None, length=n_prop
+                body, (dcache, t1), None, length=n_prop, unroll=True
             )  # ds [k-1]
             dcache = self._finalize_draft_row(dparams_m, dcache, last)
             chunk = jnp.concatenate([t1[None], ds])  # [k]
@@ -311,6 +326,95 @@ class SpeculativeDecoder:
 
         return rnd
 
+    def _fused(self, k: int, max_new: int, b: int, sampled: bool):
+        """The WHOLE generation as one device program: a lax.while_loop
+        over speculation rounds with an in-graph commit buffer, exited
+        when every row has its budget.  One dispatch + one packed fetch
+        per generate() call — the host-driven path pays ~4 tunnel round
+        trips (~66 ms each, measured) per rounds_per_call block, which
+        at small batch costs more than the compute it orchestrates
+        (round-4/5 windows measured 0.05× plain decode; this driver is
+        the fix).  Requires p + max_new + k <= max_len so cache room is
+        never the binding constraint (generate() falls back to the
+        host loop near max_len).
+
+        Packed return (int32): [B*(max_new+k) commit buffer, B final
+        n's, proposed, accepted, min-aligned-counterfactual]."""
+
+        rnd_row = (
+            self._round_row_sampled(k) if sampled else self._round_row(k)
+        )
+        width = max_new + k  # final round may overrun the budget by k-1
+        materialize = self._materialize
+
+        def fused(tparams, dparams, tcaches, dcaches, t1, n0, limit,
+                  rngs, temp):
+            tparams_m = materialize(tparams)
+            dparams_m = materialize(dparams)
+
+            def cond(st):
+                return jnp.any(st["n"] < limit)
+
+            def body(st):
+                if sampled:
+                    tc, dc, t1n, m, chunk, act, rngs_n = jax.vmap(
+                        rnd_row, in_axes=(None, None, 0, 0, 0, 0, 0, 0, None)
+                    )(
+                        tparams_m, dparams_m, st["tc"], st["dc"], st["t1"],
+                        st["n"], limit, st["rngs"], temp,
+                    )
+                else:
+                    tc, dc, t1n, m, chunk, act = jax.vmap(
+                        rnd_row, in_axes=(None, None, 0, 0, 0, 0, 0)
+                    )(
+                        tparams_m, dparams_m, st["tc"], st["dc"], st["t1"],
+                        st["n"], limit,
+                    )
+                    rngs_n = st["rngs"]
+                off = st["n"] - n0  # committed-new per row, pre-round
+
+                def write_row(out_row, off_r, chunk_r, m_r, act_r):
+                    idx = jnp.clip(off_r + jnp.arange(k), 0, width - 1)
+                    keep = act_r & (jnp.arange(k) <= m_r)
+                    return out_row.at[idx].set(
+                        jnp.where(keep, chunk_r, out_row[idx])
+                    )
+
+                out = jax.vmap(write_row)(st["out"], off, chunk, m, act)
+                n = st["n"] + jnp.where(act, 1 + m, 0)
+                n_act = act.sum().astype(jnp.int32)
+                m_masked = jnp.where(act, m, 0)
+                m_min = jnp.min(
+                    jnp.where(act, m, jnp.int32(2**30))
+                ).astype(jnp.int32)
+                telem = st["telem"] + jnp.where(
+                    n_act > 0,
+                    jnp.stack(
+                        [(k - 1) * n_act, m_masked.sum(), m_min * n_act]
+                    ).astype(jnp.int32),
+                    jnp.zeros((3,), jnp.int32),
+                )
+                return {
+                    "out": out, "tc": tc, "dc": dc, "n": n, "t1": t1n,
+                    "rngs": rngs_n, "telem": telem,
+                }
+
+            state = {
+                "out": jnp.zeros((b, width), jnp.int32),
+                "tc": tcaches, "dc": dcaches,
+                "n": n0, "t1": t1,
+                "rngs": rngs,
+                "telem": jnp.zeros((3,), jnp.int32),
+            }
+            state = lax.while_loop(cond, body, state)
+            return jnp.concatenate([
+                state["out"].ravel(),
+                state["n"].astype(jnp.int32),
+                state["telem"],
+            ])
+
+        return self._jit(("fused", k, max_new, b, sampled), fused)
+
     def _rounds(self, k: int, r: int):
         """R rounds scanned into one program, each round a vmap of the
         row round over the stacked axis: on a tunneled chip the
@@ -320,10 +424,11 @@ class SpeculativeDecoder:
         slices each round's per-row chunk by its returned m."""
 
         rnd_row = self._round_row(k)
+        materialize = self._materialize
 
         def many(tparams, dparams, tcaches, dcaches, t1, n, limit):
-            tparams_m = materialize_tree(tparams)
-            dparams_m = materialize_tree(dparams)
+            tparams_m = materialize(tparams)
+            dparams_m = materialize(dparams)
 
             def body(carry, _):
                 tcaches, dcaches, t1, n = carry
@@ -342,10 +447,11 @@ class SpeculativeDecoder:
 
     def _rounds_sampled(self, k: int, r: int):
         rnd_row = self._round_row_sampled(k)
+        materialize = self._materialize
 
         def many(tparams, dparams, tcaches, dcaches, t1, n, limit, rngs, temp):
-            tparams_m = materialize_tree(tparams)
-            dparams_m = materialize_tree(dparams)
+            tparams_m = materialize(tparams)
+            dparams_m = materialize(dparams)
 
             def body(carry, _):
                 tcaches, dcaches, t1, n, rngs = carry
@@ -434,6 +540,32 @@ class SpeculativeDecoder:
         # per-row rngs for the sampled rounds (greedy never consumes)
         rngs = jax.random.split(rng, b + 1)
         rng, row_rngs = rngs[0], rngs[1:]
+
+        # fused whole-generation driver (one dispatch + one fetch; see
+        # _fused) whenever cache room can never bind: every verify
+        # write fits even under full acceptance at the budget edge.
+        # The program is keyed on a POWER-OF-2 budget bucket, not the
+        # exact max_new_tokens — per-request budgets must not each
+        # compile the largest program in the stack (same discipline as
+        # the host path's round bucketing and ChunkedServingDecoder);
+        # the exact budget rides in the runtime `limit` vector.
+        bucket = 1 << max(0, max_new_tokens - 1).bit_length()
+        if self.use_fused and p + max_new_tokens + self.k <= self.max_len:
+            packed = np.asarray(
+                self._fused(self.k, bucket, b, sampled)(
+                    self.tparams, self.dparams, tcache, dcache, t1,
+                    jnp.full((b,), p, jnp.int32), limit, row_rngs, temp,
+                )
+            )
+            w = bucket + self.k
+            toks = packed[: b * w].reshape(b, w)[:, :max_new_tokens]
+            telem = packed[b * w + b :]
+            self.proposed += int(telem[0])
+            self.accepted += int(telem[1])
+            self.accepted_min_aligned += int(telem[2])
+            return np.concatenate(
+                [np.asarray(prompt), toks.astype(np.int32)], axis=1
+            )
         while shortest() < max_new_tokens:
             # cap the chunk so no ACTIVE row's verify writes past
             # max_len (frozen rows neither commit nor count)

@@ -99,9 +99,73 @@ class TransformerConfig:
         return self.mesh is not None and self.mesh.shape.get("sp", 1) > 1
 
 
+class QDenseGeneral(nn.Module):
+    """DenseGeneral that also accepts int8 `QTensor` kernels at apply
+    time (the serving path: `quantize_tree` → apply, no
+    `materialize_tree` — the weight crosses HBM as int8 and
+    `ops/quant_matmul` dequantizes per tile in VMEM).
+
+    For plain array kernels this reproduces `nn.DenseGeneral` exactly:
+    same param names ('kernel'/'bias'), same shapes, same init calls —
+    flax derives param RNG from the scope path only, so existing
+    checkpoints and seeded tests see identical parameters.  Only the
+    contract-the-last-axes form is implemented (`axis=-1` or
+    `(-2, -1)`), which is every call site in this stack."""
+
+    features: Any  # int | tuple
+    axis: Any = -1  # int | tuple, must be the trailing axes in order
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+    bias_init: Any = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        feat = (
+            tuple(self.features)
+            if isinstance(self.features, (tuple, list))
+            else (self.features,)
+        )
+        axes = (
+            tuple(self.axis) if isinstance(self.axis, (tuple, list))
+            else (self.axis,)
+        )
+        n_con = len(axes)
+        axes = tuple(a % x.ndim for a in axes)
+        if axes != tuple(range(x.ndim - n_con, x.ndim)):
+            raise NotImplementedError(
+                f"QDenseGeneral contracts trailing axes only, got {axes}"
+            )
+        in_shape = tuple(x.shape[-n_con:])
+        kernel = self.param("kernel", self.kernel_init, in_shape + feat, jnp.float32)
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, feat, jnp.float32)
+        else:
+            bias = None
+        from tf_operator_tpu.ops.quant import QTensor
+        from tf_operator_tpu.ops.quant_matmul import quant_matmul
+
+        if isinstance(kernel, QTensor):
+            k_flat = 1
+            for d in in_shape:
+                k_flat *= d
+            x2 = x.reshape(*x.shape[:-n_con], k_flat).astype(self.dtype)
+            qt = QTensor(kernel.q.reshape(k_flat, *feat), kernel.scale)
+            out = quant_matmul(x2, qt, dtype=self.dtype)
+        else:
+            out = jax.lax.dot_general(
+                x.astype(self.dtype),
+                jnp.asarray(kernel, self.dtype),
+                ((axes, tuple(range(n_con))), ((), ())),
+            )
+        if bias is not None:
+            out = out + jnp.asarray(bias, self.dtype)
+        return out
+
+
 def dense(features, cfg: TransformerConfig, axes, name=None, use_bias=True):
     n_feature_dims = len(features) if isinstance(features, (tuple, list)) else 1
-    return nn.DenseGeneral(
+    return QDenseGeneral(
         features,
         dtype=cfg.dtype,
         use_bias=use_bias,
@@ -146,11 +210,28 @@ class Embed(nn.Module):
             (cfg.vocab_size, self.features or cfg.hidden),
             jnp.float32,
         )
+        from tf_operator_tpu.ops.quant import QTensor
+
+        if isinstance(table, QTensor):
+            # int8 row gather + per-embed-channel rescale: the table
+            # crosses HBM as the gathered int8 rows only — never as a
+            # materialized bf16 copy (the decode-loop trap, see
+            # ops/quant_matmul.py)
+            rows = jnp.take(table.q, ids, axis=0).astype(cfg.dtype)
+            return rows * table.scale.reshape(-1).astype(cfg.dtype)
         return jnp.take(table, ids, axis=0).astype(cfg.dtype)
 
     def attend(self, x):
+        from tf_operator_tpu.ops.quant import QTensor
+
         table = self.get_variable("params", "embedding")
         value = getattr(table, "value", table)  # unbox nn.Partitioned
+        if isinstance(value, QTensor):
+            # scale is per embed channel (the CONTRACTED axis here), so
+            # it applies to x before the int8 contraction:
+            # x @ (q·s)^T == (x·s) @ q^T
+            xs = x * value.scale.reshape(-1).astype(x.dtype)
+            return jnp.einsum("bse,ve->bsv", xs, value.q.astype(x.dtype))
         return jnp.einsum("bse,ve->bsv", x, value.astype(x.dtype))
 
 
@@ -306,7 +387,7 @@ class MultiHeadAttention(nn.Module):
 
     def _project_out(self, out, train):
         cfg = self.cfg
-        out = nn.DenseGeneral(
+        out = QDenseGeneral(
             cfg.hidden,
             axis=(-2, -1),
             dtype=cfg.dtype,

@@ -31,10 +31,10 @@ shard other attention dims fall back.
 
 Env knobs — note the three-state semantics of TPU_OPERATOR_FLASH:
   unset / ""  auto: the measured seq crossover decides (flash only at
-              max(Sq,Sk) >= TPU_OPERATOR_FLASH_MIN_SEQ, default 2048 —
-              from the r4 llama-sweep, where XLA-fused won at seq 1024;
-              the 1024..4096 midrange is pinned by the autotuned sweep
-              each window re-runs).
+              max(Sq,Sk) >= TPU_OPERATOR_FLASH_MIN_SEQ, default 1024 —
+              r5 honest sweep: with the 256x256 default blocks the
+              kernel ties XLA at 1024 and wins 1.15x at 2048; below
+              1024 is unmeasured, XLA keeps it).
   "0"         disable the kernel globally.
   any other   FORCE flash wherever it applies, crossover ignored.
               ** Semantics changed in r4: an explicit "1" used to be
@@ -671,13 +671,15 @@ def _flash_applicable(q, k, bias, mask, block_q, block_k, window=None) -> bool:
         # banded grids need Sq == Sk; the XLA reference's position-based
         # window mask handles the cross-length case — route it there
         return False
-    # measured crossover (benchmarks/window_out/llama-sweep.out, r4):
-    # at train shapes seq 1024 the XLA-fused reference beats the pallas
-    # kernel fwd+bwd (llama-mini mfu 0.285 vs 0.202) — kernel launch +
-    # lse/residual overheads only pay once the quadratic term dominates;
-    # flash's win is long sequences (fwd ~5x at 8k, and it runs 32k
-    # where XLA OOMs).  Below the crossover, auto-dispatch takes XLA.
-    min_seq = int(os.environ.get("TPU_OPERATOR_FLASH_MIN_SEQ", "2048"))
+    # measured crossover (benchmarks/window_out/llama-sweep.out, r5
+    # honest slope-timed rerun): with the r5 default 256x256 blocks the
+    # kernel TIES the XLA-fused reference at seq 1024 fwd+bwd
+    # (67,670 vs 67,664 tok/s llama-mini) and WINS at 2048
+    # (58,730 vs 51,179, 1.15x — s2048-b512x256 row); with the old
+    # 128x128 blocks it lost 1.4x at 1024, which is what the r4
+    # crossover of 2048 was measuring.  Below 1024 is unmeasured;
+    # auto-dispatch keeps XLA there.
+    min_seq = int(os.environ.get("TPU_OPERATOR_FLASH_MIN_SEQ", "1024"))
     if not forced and max(q.shape[-2], k.shape[-2]) < min_seq:
         return False
     # the kernel targets the TPU backend; everything else takes the
@@ -689,11 +691,16 @@ def default_flash_blocks() -> tuple:
     """Kernel block sizes used when the caller doesn't pick:
     TPU_OPERATOR_FLASH_BLOCK_Q / _BLOCK_K env overrides (the
     benchmarks/llama_sweep.py autotune matrix sets these per variant),
-    else 128x128 — a safe VMEM fit at every supported head dim."""
+    else 256x256 — the r5 autotune winner (llama-mini fwd+bwd:
+    s1024 72.6→67.7k tok/s honest vs 48.8k at 128x128; s2048-b256x256
+    54.2k vs 33.8k; best-at-2048 was bq512/bk256 at 58.7k but 512 only
+    tiles seq >= 512 — 256 is the best default that tiles every shape
+    the dispatcher accepts).  Still a safe VMEM fit at every supported
+    head dim (two 256x128 bf16 K/V blocks + fp32 carries < 1 MB)."""
 
     return (
-        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_Q", "128")),
-        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_K", "128")),
+        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_Q", "256")),
+        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_K", "256")),
     )
 
 
@@ -714,10 +721,45 @@ def attention(
     XLA-fused reference otherwise.  Drop-in for dot_product_attention;
     pass the mesh so multi-device calls get the shard_map wrapper."""
 
+    shrunk = False
     if block_q is None or block_k is None:
         dq, dk = default_flash_blocks()
-        block_q = dq if block_q is None else block_q
-        block_k = dk if block_k is None else block_k
+        # a BUILT-IN default block that doesn't tile the sequence
+        # shrinks to one that does (floor 128) instead of silently
+        # losing the kernel — the 256 default would otherwise drop
+        # flash coverage for seqs divisible by 128 but not 256 (e.g.
+        # 1152), including under forced TPU_OPERATOR_FLASH=1.  PINNED
+        # blocks — caller args AND the BLOCK_Q/_K env knobs — are
+        # never adjusted (the sweep must measure exactly what it set;
+        # a non-tiling pin falls back to XLA via _flash_applicable).
+        if block_q is None:
+            if not os.environ.get("TPU_OPERATOR_FLASH_BLOCK_Q"):
+                while dq > 128 and q.shape[-2] % dq:
+                    dq //= 2
+                    shrunk = True
+            block_q = dq
+        if block_k is None:
+            if not os.environ.get("TPU_OPERATOR_FLASH_BLOCK_K"):
+                while dk > 128 and k.shape[-2] % dk:
+                    dk //= 2
+                    shrunk = True
+            block_k = dk
+    # the min_seq=1024 crossover was measured WITH the 256x256 blocks
+    # (they tie XLA at 1024); at 128x128 the kernel loses 1.4x there
+    # (r4 sweep), so shapes that shrank all the way DOWN to 128x128
+    # keep the 128-block crossover of 2048 in auto mode (force still
+    # forces; a shrink that stopped at 256 keeps the 1024 crossover
+    # its blocks were measured at)
+    if (
+        shrunk
+        and block_q == 128
+        and block_k == 128
+        and not os.environ.get("TPU_OPERATOR_FLASH", "")
+        and max(q.shape[-2], k.shape[-2]) < 2048
+    ):
+        return dot_product_attention(
+            q, k, v, causal=causal, bias=bias, mask=mask, window=window
+        )
 
     if _flash_applicable(q, k, bias, mask, block_q, block_k, window):
         mode = _mesh_flash_applicable(mesh, q, k)

@@ -11,15 +11,25 @@ step.  The scheme here:
   symmetric scale (max-abs / 127, reduced over all axes but the last).
   QTensor is a registered pytree node, so the quantized tree passes
   through ``jax.jit`` argument plumbing unchanged.
-- **Materialize INSIDE jit** (`materialize_tree`): the int8→bf16
-  convert-and-scale runs under the same jit as the matmul, where XLA
-  fuses it into the dot's operand read — the weight crosses HBM as
-  int8 and no bf16 copy is ever written back.
+- **Consume int8 DIRECTLY at the matmul** (`ops/quant_matmul` via
+  `QDenseGeneral`): QDense-stack families take the quantized tree
+  straight into `apply`; each projection computes the output-scale
+  form `(x @ q.astype(bf16)) · s` as one dot inside XLA's fusions, so
+  the weight crosses HBM as int8 and no bf16 copy is written back.
+  Measured on v5e: llama-wide (~700M) decode 1.63× bf16 at batch 1
+  (PROFILE.md "int8 decode").
+- **materialize_tree** remains for apply sites that need plain arrays
+  (MoE expert einsums).  NOTE (measured, r5): materializing *per decode
+  step* is an anti-pattern — XLA does not fuse the convert into the
+  dot's operand read inside the scan, and the materialized form ran
+  0.55× bf16 on v5e.
 
 Training stays bf16; this is a serving-side transform applied after
-`load_params` (see ``examples/serve_lm.py --quantize int8`` and
-``models/decode.py``, which both call :func:`materialize_tree` at the
-apply sites so quantized and plain trees share one code path).
+`load_params` (see ``examples/serve_lm.py --quantize int8``).  The
+decode loops pass the quantized tree straight to ``apply`` —
+`QDenseGeneral`/`Embed` handle both plain and QTensor leaves, so
+quantized and plain trees share one code path with no materialization
+in between.
 
 The reference (SURVEY.md §0) has no quantized-serving story — this is
 a beyond-reference capability.  On-chip numbers: ``bench.py``'s llama
@@ -138,6 +148,25 @@ def materialize_tree(params, dtype=jnp.bfloat16):
     return jax.tree_util.tree_map(
         lambda l: l.materialize(dtype) if _is_q(l) else l, params, is_leaf=_is_q
     )
+
+
+def materialize_fn(*models):
+    """The ONE apply-site policy for quantized trees: identity when
+    EVERY given model's dense stack consumes QTensor leaves natively
+    (``SUPPORTS_QTENSOR`` — QDenseGeneral/Embed route
+    ``ops/quant_matmul``, the weight crosses HBM as int8), else
+    :func:`materialize_tree`.  Shared by decode.generate,
+    ChunkedServingDecoder, ContinuousBatchingDecoder, and
+    SpeculativeDecoder so the selection can't drift between them.
+    NOTE (measured, r5): materializing per decode step is the 0.55×
+    anti-pattern — this helper exists so only non-QDense families
+    (MoE expert einsums) ever pay it."""
+
+    if all(
+        getattr(type(m), "SUPPORTS_QTENSOR", False) for m in models
+    ):
+        return lambda t: t
+    return materialize_tree
 
 
 def is_quantized(params) -> bool:

@@ -1,0 +1,166 @@
+"""int8 weights-only matmul for the decode path — output-scale XLA
+form by default, pallas kernel opt-in.
+
+Decode at small batch is weight-bandwidth-bound (ops/quant.py): the
+per-token step re-reads every projection from HBM while the MXU idles.
+The int8 scheme only pays off if the weight crosses HBM as int8.  The
+original `materialize_tree`-per-step form did NOT achieve that —
+measured on v5e (2026-08-01, window_out/bench.out): 0.55× the bf16
+path, because every step materialized the full bf16 weight tree to HBM
+(int8 read + bf16 write + bf16 read ≈ 2.5× the bf16-only traffic).
+
+`quant_matmul` is the fix, wired into the model stack by
+`QDenseGeneral` (models/transformer.py): the decode loops pass the
+quantized tree straight to `apply`, and each projection computes the
+algebraic output-scale form
+
+    x @ (q·s)  ==  (x @ q.astype(bf16)) · s        (s per out-channel)
+
+as ONE dot feeding XLA's own fusions — no weight-tree materialization
+anywhere in the program.  Measured decode, llama-wide ~700M
+(PROFILE.md "int8 decode"): **1.63× bf16 at batch 1, 1.54× at batch
+8**; llama-mini at batch 8 is too weight-light for int8 to pay at all
+(0.89×, weight reads are only ~60% of its 0.5 ms step).
+
+The hand-written pallas kernel (grid over N tiles, int8 tile HBM→VMEM,
+bf16 convert + MXU dot + f32 scale in VMEM, x resident across the
+grid) is kept OPT-IN via TPU_OPERATOR_QUANT_KERNEL=1: it wins isolated
+microbenches at the lm_head shape but loses end-to-end — 70+ pallas
+calls per token step are 70+ fusion barriers with operand staging
+copies (trace: 19k sync copies per 64 steps), which the XLA form never
+pays.  See `_use_kernel` for the measured table.
+
+Reference parity: SURVEY.md §2a (the reference's compute tier is CUDA
+kernels in its example images); no quantized serving exists there —
+this is a beyond-reference capability.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tf_operator_tpu.ops.quant import QTensor
+
+#: pallas GEMV path only below this many activation rows — above it the
+#: matmul is compute-bound and XLA's GEMM (with a one-shot dequant) wins
+_MAX_GEMV_ROWS = 64
+
+#: candidate N tile widths, largest first (lane-multiple of 128); the
+#: first that divides N wins.  256 caps the int8 tile at K×256 bytes —
+#: 1 MB at K=4096 — comfortably double-bufferable in 16 MB of VMEM.
+_BLOCK_N_CANDIDATES = (512, 256, 128)
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref):
+    w = q_ref[...].astype(jnp.bfloat16)  # int8→bf16 exact for |q|<=127
+    acc = jax.lax.dot_general(
+        x_ref[...], w,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[...] = (acc * s_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def _quant_matmul_2d(x, q, s, block_n: int, interpret: bool = False):
+    """x [M, K] bf16 · q [K, N] int8 · s [1, N] f32 → [M, N] x.dtype."""
+
+    m, k = x.shape
+    n = q.shape[1]
+    grid = (n // block_n,)
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((m, k), lambda i: (0, 0)),
+            pl.BlockSpec((k, block_n), lambda i: (0, i)),
+            pl.BlockSpec((1, block_n), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((m, block_n), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(x, q, s)
+
+
+def _pick_block_n(n: int) -> "int | None":
+    for bn in _BLOCK_N_CANDIDATES:
+        if n % bn == 0:
+            return bn
+    return None
+
+
+def _use_kernel() -> bool:
+    """Opt-IN (TPU_OPERATOR_QUANT_KERNEL=1): measured on v5e
+    (2026-08-01, PROFILE.md "int8 decode"), the XLA output-scale form
+    below beats this kernel end-to-end at every decode shape tried —
+    wide(700M) b8: 104.6 ms vs 142.9 ms; b1: 88.7 vs 93.2 — because 70+
+    pallas calls per token step are 70+ fusion barriers with operand
+    staging copies, while XLA keeps the int8→bf16 convert inside its
+    own fusions.  The kernel wins isolated microbenches at the lm_head
+    shape (176 GB/s vs 223 GB/s effective for twice the bytes) and is
+    kept for shapes/future tiles where a fused-sibling grid could
+    amortize the call count."""
+
+    return (
+        os.environ.get("TPU_OPERATOR_QUANT_KERNEL", "") == "1"
+        and jax.default_backend() == "tpu"
+    )
+
+
+def quant_matmul(x, qt: QTensor, dtype=jnp.bfloat16):
+    """`x @ qt` with the weight crossing HBM as int8.
+
+    x: [..., K] (any leading batch dims); qt.q: [K, *features] int8
+    with per-output-channel scale over the LAST axis.  Contraction is
+    over x's last axis and q's first — the DenseGeneral single-axis
+    case; callers contracting several axes reshape first
+    (QDenseGeneral does).  Returns [..., *features] in `dtype`.
+    """
+
+    q, s = qt.q, qt.scale
+    k = x.shape[-1]
+    feat = q.shape[1:]
+    n = 1
+    for f in feat:
+        n *= f
+    x2 = x.reshape(-1, k).astype(dtype)
+    q2 = q.reshape(k, n)
+    # scale must be per-output-channel over the flattened feature dim:
+    # broadcastable (1, ..., 1, last) with last == feat[-1]
+    per_channel = bool(feat) and s.size == feat[-1]
+    if not per_channel:
+        raise ValueError(
+            f"quant_matmul needs a per-output-channel scale over the last "
+            f"axis; got scale shape {s.shape} for kernel {q.shape}"
+        )
+    # scale per FLATTENED output channel: broadcast over the feature
+    # dims, then flatten to match q2's N axis
+    s2 = jnp.broadcast_to(s.reshape(-1), feat).reshape(1, n).astype(jnp.float32)
+    m = x2.shape[0]
+    block_n = _pick_block_n(n)
+    if (
+        _use_kernel()
+        and block_n is not None
+        and m <= _MAX_GEMV_ROWS
+        and k % 32 == 0  # int8 VMEM tile is (32, 128) on the (K, N) block
+    ):
+        out = _quant_matmul_2d(x2, q2, s2, block_n)
+    else:
+        out = (
+            jax.lax.dot_general(
+                x2, q2.astype(dtype),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * s2
+        ).astype(dtype)
+    return out.reshape(*x.shape[:-1], *feat)
