@@ -23,6 +23,7 @@ MATRIX = [
     ("s2d-b256", "s2d", 256, ""),
     ("noclip-b256", "noclip", 256, ""),
     ("bnbf16-b256", "bnbf16", 256, ""),
+    ("pbf16-b256", "pbf16", 256, ""),
     ("vmem64m-b256", "baseline", 256, "--xla_tpu_scoped_vmem_limit_kib=65536"),
     ("lhs-b256", "baseline", 256, "--xla_tpu_enable_latency_hiding_scheduler=true"),
     (

@@ -56,6 +56,11 @@ def build_trainer(variant: str, batch_per_chip: int):
     cfg = TrainerConfig(optimizer="sgd", learning_rate=0.1, momentum=0.9)
     if variant == "noclip":
         cfg.grad_clip = 0.0
+    if variant == "pbf16":
+        # bf16 param+momentum storage: probes the trace-shown ceiling —
+        # the f32 master-weight cast/copy swarm (PROFILE.md r5) — by
+        # removing it entirely; accuracy note in TrainerConfig
+        cfg.param_dtype = jnp.bfloat16
     trainer = Trainer(model, cfg, mesh, batchnorm_cross_entropy_loss, batch)
     return trainer, batch
 
@@ -190,7 +195,7 @@ def main():
     ap.add_argument(
         "--variant",
         default="baseline",
-        choices=["baseline", "s2d", "noclip", "bnbf16"],
+        choices=["baseline", "s2d", "noclip", "bnbf16", "pbf16"],
     )
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--steps", type=int, default=20)

@@ -145,6 +145,48 @@ def test_trainer_benchmark_smoke():
     assert stats["examples_per_sec"] == pytest.approx(stats["steps_per_sec"] * 8)
 
 
+def test_trainer_param_dtype_bf16_storage():
+    """TrainerConfig.param_dtype=bf16: params AND optimizer moments
+    store bf16 (the HBM-traffic probe knob, PROFILE.md r5), and a
+    train step updates params while KEEPING them bf16 — the whole
+    contract is storage dtype, so moment dtypes and the post-step
+    param dtype are asserted, not just the init-time cast."""
+
+    mesh = make_mesh({"dp": 8})
+    batch = _mnist_batch(8)
+    tr = Trainer(
+        MnistCNN(),
+        TrainerConfig(param_dtype=jnp.bfloat16),
+        mesh,
+        cross_entropy_loss,
+        batch,
+    )
+
+    def float_dtypes(tree):
+        return {
+            str(l.dtype)
+            for l in jax.tree_util.tree_leaves(tree)
+            if jnp.issubdtype(l.dtype, jnp.floating)
+        }
+
+    assert float_dtypes(tr.state.params) == {"bfloat16"}
+    # optax moments inherit the param dtype (the trainer comment's
+    # claim — pinned here so an optax default change can't silently
+    # reintroduce f32 moment traffic)
+    assert float_dtypes(tr.state.opt_state) == {"bfloat16"}
+    before = jax.tree_util.tree_map(
+        lambda x: np.asarray(x).copy(), tr.state.params
+    )
+    tr.train_step(tr.shard_batch(batch))
+    assert float_dtypes(tr.state.params) == {"bfloat16"}  # no promotion
+    changed = jax.tree_util.tree_map(
+        lambda a, b: bool(np.any(np.asarray(a) != np.asarray(b))),
+        before,
+        tr.state.params,
+    )
+    assert any(jax.tree_util.tree_leaves(changed))
+
+
 class TestTrainerCheckpointer:
     def test_save_restore_roundtrip_sharded(self, tmp_path):
         """Save a sharded TrainState, restore into a FRESH trainer on

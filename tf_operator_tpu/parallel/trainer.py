@@ -79,6 +79,16 @@ class TrainerConfig:
     #: write step-series metrics every N steps when a SummaryWriter is
     #: attached (utils/summaries.py; mnist_with_summaries parity)
     summary_every: int = 10
+    #: storage dtype for params (and therefore optimizer state — optax
+    #: inits moments from the param dtype).  None keeps the model's
+    #: init dtype (f32 master weights — the accuracy-safe default).
+    #: jnp.bfloat16 halves param+moment HBM traffic AND removes the
+    #: per-step f32→bf16 cast-copy swarm the ResNet trace shows
+    #: saturating the schedule (PROFILE.md r5 trace section) — at the
+    #: cost of bf16 weight-update rounding (no stochastic rounding on
+    #: this path; use for BW probes and BN-robust convnets, not as the
+    #: LM default).
+    param_dtype: Any = None
 
 
 def make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
@@ -165,6 +175,12 @@ class Trainer:
         def init_state() -> TrainState:
             variables = model.init(init_rng, *init_args, train=False)
             params = variables.pop("params")
+            if cfg.param_dtype is not None:
+                params = jax.tree_util.tree_map(
+                    lambda p: p.astype(cfg.param_dtype)
+                    if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                    params,
+                )
             return TrainState.create(
                 apply_fn=model.apply,
                 params=params,
