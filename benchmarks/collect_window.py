@@ -24,6 +24,7 @@ hand.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import re
@@ -119,10 +120,19 @@ def parse_artifacts(out_dir: str) -> dict:
     lsweep = _json_lines(_read(out_dir, "llama-sweep.out"))
     if lsweep:
         data["llama_sweep"] = lsweep
-    wide = [
-        r for r in _json_lines(_read(out_dir, "wide.out"))
-        if "mfu_analytic" in r
-    ]
+    # the wide existence-proof set plus every tuning pass that touched
+    # serious-width shapes (wide-xover*.out and any future wide*.out —
+    # globbed, so a new pass can't be silently dropped from the "best
+    # wide MFU" computation).  Same JSON-row shape; the model=="wide"
+    # filter excludes the mini cells mixed into the xover files.  Each
+    # row remembers which artifact it came from (provenance rule).
+    wide = []
+    for path in sorted(glob.glob(os.path.join(out_dir, "wide*.out"))):
+        fname = os.path.basename(path)
+        for r in _json_lines(_read(out_dir, fname)):
+            if "mfu_analytic" in r and r.get("model") == "wide":
+                r["_artifact"] = fname
+                wide.append(r)
     if wide:
         data["wide"] = wide
     return data
@@ -178,10 +188,11 @@ def write_last_measured(data: dict, today: str) -> None:
         "speculative.out")
     wd = data.get("wide")
     if wd:
+        best = max(wd, key=lambda r: r["mfu_analytic"])
         put(
             "wide_llama_best_mfu_analytic",
-            max(r["mfu_analytic"] for r in wd),
-            "wide.out",
+            best["mfu_analytic"],
+            best.get("_artifact", "wide.out"),
         )
     f = data.get("flash_fwd_bwd", {})
     put("flash_fwd_bwd_speedup_vs_xla_seq4k", f.get("speedup"), "flash.out")
@@ -237,9 +248,9 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
         if b.get("llama_train_tokens_per_sec_per_chip"):
             rows["llama-mini train tokens/sec/chip"] = (
                 "| llama-mini train tokens/sec/chip (~120M, RoPE+GQA "
-                "16q:4kv+SwiGLU, seq 1024, bf16, auto attention — "
-                "measured crossover routes seq<2048 to XLA-fused, "
-                "flash above) | "
+                "16q:4kv+SwiGLU, seq 1024, bf16, auto attention — the "
+                "block-keyed crossover picks flash 512x512 here, the "
+                "r5 completion-pass winner at every measured shape) | "
                 f"**{b['llama_train_tokens_per_sec_per_chip']} tok/s/chip**, "
                 f"step {b.get('llama_step_ms', '?')} ms, mfu_analytic "
                 f"{b.get('llama_mfu_analytic', '?')} / mfu_xla "
@@ -301,23 +312,26 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
     wd = data.get("wide")
     if wd:
         best = max(wd, key=lambda r: r["mfu_analytic"])
+        art = best.get("_artifact", "wide.out")
         rows["Wide-llama (~700M) MFU existence proof"] = (
             "| Wide-llama (~700M) MFU existence proof (d_model 2048, "
             "12L, GQA 16q:8kv, SwiGLU — VERDICT r4 next #3) | best "
             f"**mfu_analytic {best['mfu_analytic']}** / mfu_xla "
             f"{best.get('mfu_xla', '?')} at seq {best.get('seq', '?')} "
             f"batch {best.get('batch_per_chip', '?')} "
-            f"(remat {best.get('remat', '?')}), "
+            f"(remat {best.get('remat', '?')}, "
+            f"{'flash' if best.get('flash') != '0' else 'xla'} "
+            f"attention — `{best.get('label', '?')}`), "
             f"{best.get('tokens_per_sec_per_chip', '?')} tok/s/chip; "
             f"{len(wd)} variants measured "
-            f"| 1× v5 lite, `llama_sweep.py --set wide` → "
-            f"`window_out/wide.out`, {today} |"
+            f"| 1× v5 lite, `llama_sweep.py` wide sets → "
+            f"`window_out/{art}`, {today} |"
         )
     f = data.get("flash_fwd_bwd")
     if f:
         rows["Flash vs XLA attention, fwd+bwd"] = (
             "| Flash vs XLA attention, fwd+bwd @ seq 4096 (causal, bf16, "
-            "B2 H8 D64) | "
+            "B2 H8 D128) | "
             f"**{f['speedup']:.2f}×** ({f['flash_ms']:.1f} ms vs "
             f"{f['xla_ms']:.1f} ms); fwd-only was ~5× @ seq 8192 (round 1), "
             "runs seq 32k where XLA OOMs "

@@ -72,15 +72,113 @@ WIDE = [
     ("wide-s1024-b4", ["--model", "wide", "--seq", "1024", "--batch", "4"]),
     ("wide-s2048-b2-xla",
      ["--model", "wide", "--seq", "2048", "--batch", "2", "--flash", "0"]),
-    # the >=0.40 existence proof (measured 2026-08-01: mfu_analytic
-    # 0.4654 / mfu_xla 0.4849, 23,258 tok/s): non-remat + XLA-fused
-    # attention — at the wide model's 128-dim heads XLA beats the
-    # flash kernel at seq 1024 (176 vs 207 ms), unlike mini's 64-dim
-    # heads where they tie.  NOTE the s2048 xla variants crash in the
-    # tunnel's remote-compile helper (HTTP 500, helper exit 1) —
-    # infra, not model; see PROFILE.md.
+    # the first >=0.40 existence proof (2026-08-01: mfu_analytic
+    # 0.4654, 23,258 tok/s, XLA attention) — at that point XLA beat
+    # the flash kernel's then-256x256 blocks at D=128.  SUPERSEDED the
+    # same day by the XOVER block-tuning passes below: with 512x512
+    # blocks flash wins every wide shape (best mfu 0.6163 at s512).
+    # NOTE the wide s2048 xla variants crash in the tunnel's
+    # remote-compile helper (HTTP 500, helper exit 1) — infra, not
+    # model; see PROFILE.md.
     ("wide-s1024-b4-xla",
      ["--model", "wide", "--seq", "1024", "--batch", "4", "--flash", "0"]),
+]
+
+#: head-dim crossover matrix (r5 follow-up): the dispatcher's seq-only
+#: MIN_SEQ was tuned at mini's D=64 heads, but at wide's D=128 XLA won
+#: seq 1024 by 1.32x — so where (if anywhere) does flash win at D=128,
+#: and do bigger q blocks close the gap?  Also retries the two wide
+#: -xla variants that died on the transient remote-compile-helper 500,
+#: probes batch 8 at the existence-proof shape (more rows may raise
+#: the 0.4654 headline if it still fits HBM), and lands the first
+#: seq-4096 non-remat wide numbers on both paths.
+WIDE_XOVER = [
+    ("wx-s2048-b2-xla",
+     ["--model", "wide", "--seq", "2048", "--batch", "2", "--flash", "0"]),
+    ("wx-s2048-b2-b512x256",
+     ["--model", "wide", "--seq", "2048", "--batch", "2"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "256"}),
+    ("wx-s1024-b4-b512x256",
+     ["--model", "wide", "--seq", "1024", "--batch", "4"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "256"}),
+    ("wx-s1024-b8-xla",
+     ["--model", "wide", "--seq", "1024", "--batch", "8", "--flash", "0"]),
+    ("wx-s4096-b1-flash", ["--model", "wide", "--seq", "4096", "--batch", "1"]),
+    ("wx-s4096-b1-xla",
+     ["--model", "wide", "--seq", "4096", "--batch", "1", "--flash", "0"]),
+    ("wx-s2048-b2-b256x512",
+     ["--model", "wide", "--seq", "2048", "--batch", "2"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "256", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+]
+
+#: second tuning pass after WIDE_XOVER's findings (bq512/bk256 won
+#: s1024 at 0.5667; bq256/bk512 won s2048 at 0.5646 — large blocks in
+#: EITHER grid dim beat the 256x256 default at D=128): complete the
+#: 512-block quadrant at wide, and check whether mini's D=64 shapes
+#: also prefer 512 blocks (its committed winners were 256x256 at s1024
+#: and bq512/bk256 at s2048; bk512 was never tried on mini).
+WIDE_XOVER2 = [
+    ("wx2-s1024-b4-b256x512",
+     ["--model", "wide", "--seq", "1024", "--batch", "4"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "256", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+    ("wx2-s1024-b4-b512x512",
+     ["--model", "wide", "--seq", "1024", "--batch", "4"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+    ("wx2-s2048-b2-b512x512",
+     ["--model", "wide", "--seq", "2048", "--batch", "2"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+    ("wx2-s4096-b1-b256x512",
+     ["--model", "wide", "--seq", "4096", "--batch", "1"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "256", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+    ("wx2-s4096-b1-b512x256",
+     ["--model", "wide", "--seq", "4096", "--batch", "1"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "256"}),
+    ("wx2-mini-s1024-b256x512",
+     ["--seq", "1024", "--batch", "8"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "256", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+    ("wx2-mini-s1024-b512x256",
+     ["--seq", "1024", "--batch", "8"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "256"}),
+    ("wx2-mini-s2048-b256x512",
+     ["--seq", "2048", "--batch", "4"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "256", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+    ("wx2-mini-s4096-b256x512",
+     ["--seq", "4096", "--batch", "2"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "256", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+]
+
+#: the last untried 512x512 cells (bk=512 dominated everywhere in
+#: XOVER2; bq256-vs-512 is the remaining 3-10% question per shape)
+WIDE_XOVER3 = [
+    ("wx3-s4096-b1-b512x512",
+     ["--model", "wide", "--seq", "4096", "--batch", "1"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+    ("wx3-mini-s1024-b512x512",
+     ["--seq", "1024", "--batch", "8"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+    ("wx3-mini-s2048-b512x512",
+     ["--seq", "2048", "--batch", "4"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+]
+
+#: 512x512 won every XOVER2/3 cell on both head dims (up to 1.63-2.3x
+#: over XLA-fused).  Finish the table: mini s4096 at 512x512, and the
+#: seq-512 shapes that decide whether the auto-crossover MIN_SEQ drops
+#: below 1024 (at seq 512 the 512 blocks tile exactly — one grid step).
+WIDE_XOVER4 = [
+    ("wx4-mini-s4096-b512x512",
+     ["--seq", "4096", "--batch", "2"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+    ("wx4-mini-s512-b16-b512x512",
+     ["--seq", "512", "--batch", "16"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+    ("wx4-mini-s512-b16-xla",
+     ["--seq", "512", "--batch", "16", "--flash", "0"]),
+    ("wx4-wide-s512-b8-b512x512",
+     ["--model", "wide", "--seq", "512", "--batch", "8"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+    ("wx4-wide-s512-b8-xla",
+     ["--model", "wide", "--seq", "512", "--batch", "8", "--flash", "0"]),
 ]
 
 
@@ -127,14 +225,21 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument(
-        "--set", default="main", choices=["main", "wide"],
+        "--set", default="main",
+        choices=["main", "wide", "wide-xover", "wide-xover2", "wide-xover3",
+                 "wide-xover4"],
         help="main = the llama-mini variant/autotune matrix; wide = the "
-        "~700M existence-proof shapes (their own window step)",
+        "~700M existence-proof shapes (their own window step); "
+        "wide-xover = the D=128 head-dim flash/XLA crossover matrix; "
+        "wide-xover2 = the 512-block completion pass",
     )
     ap.add_argument("--timeout", type=int, default=600)
     args = ap.parse_args()
 
-    matrix = WIDE if args.set == "wide" else MATRIX
+    matrix = {
+        "wide": WIDE, "wide-xover": WIDE_XOVER, "wide-xover2": WIDE_XOVER2,
+        "wide-xover3": WIDE_XOVER3, "wide-xover4": WIDE_XOVER4,
+    }.get(args.set, MATRIX)
     if args.quick:
         matrix = matrix[:2]  # first two of the SELECTED set
     results = []

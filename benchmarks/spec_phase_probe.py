@@ -1,0 +1,98 @@
+"""Phase-level timing of the chunked-scan speculative driver: where do
+the ~1.7 s per 128-token generate() actually go?  Times prefill, each
+chunk dispatch+fetch, and the argmax/pick host step separately."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    p = os.environ.get("BENCH_PLATFORM")
+    if p:
+        jax.config.update("jax_platforms", p)
+
+    from bench import llama_mini_config
+    from tf_operator_tpu.models import LlamaLM, SpeculativeDecoder
+    from tf_operator_tpu.models.speculative import binary_chunks
+    from tf_operator_tpu.ops.quant import quantize_tree
+
+    seq = 512
+    n_new = 128
+    model = LlamaLM(llama_mini_config(seq))
+    vocab = model.cfg.vocab_size
+    r = np.random.RandomState(0)
+    prompt = jnp.asarray(r.randint(0, vocab, size=(1, 32)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    qparams = quantize_tree(params)
+    dec = SpeculativeDecoder(model, params, model, qparams, k=4)
+
+    b, p_len = prompt.shape
+    out = {}
+
+    def phase_run():
+        t = {}
+        t0 = time.perf_counter()
+        tcache = dec._stacked_cache(dec.dtar, b)
+        dcache = dec._stacked_cache(dec.ddraft, b)
+        last = None
+        off = 0
+        for width in binary_chunks(p_len):
+            ids = prompt[:, off : off + width]
+            tcache, last = dec._prefill("t", width)(dec.tparams, tcache, ids)
+            dcache, _ = dec._prefill("d", width)(dec.dparams, dcache, ids)
+            off += width
+        t1 = jnp.argmax(last, -1).astype(jnp.int32)
+        np.asarray(t1)
+        t["prefill_s"] = time.perf_counter() - t0
+
+        n0 = jnp.full((b,), p_len, jnp.int32)
+        limit = jnp.full((b,), p_len + n_new, jnp.int32)
+        rngs = jax.random.split(jax.random.PRNGKey(1), b)
+        temp = jnp.float32(1.0)
+        bucket = n_new
+        width_buf = bucket + dec.k
+        state = {
+            "out": jnp.zeros((b, width_buf), jnp.int32),
+            "tc": tcache, "dc": dcache,
+            "n": n0, "t1": t1,
+            "rngs": rngs,
+            "telem": jnp.zeros((3,), jnp.int32),
+        }
+        r0 = 32
+        chunks = []
+        limit_h = np.asarray(limit)
+        chunk_r = r0
+        while True:
+            fn = dec._fused_scan(dec.k, bucket, b, False, chunk_r)
+            t0 = time.perf_counter()
+            state, packed = fn(dec.tparams, dec.dparams, state, n0, limit, temp)
+            t_disp = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            packed_h = np.asarray(packed)
+            t_fetch = time.perf_counter() - t0
+            chunks.append((chunk_r, round(t_disp, 4), round(t_fetch, 4)))
+            n_h = packed_h[b * width_buf : b * width_buf + b]
+            if (n_h >= limit_h).all():
+                break
+            chunk_r = 8
+        t["chunks"] = chunks
+        return t
+
+    phase_run()  # compile everything
+    out["run1"] = phase_run()
+    out["run2"] = phase_run()
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Why does SpeculativeDecoder's prefill cost ~1.8 s for a 32-token
+prompt when plain decode's whole 128-token generate is ~0.1 s?  Times
+target-prefill and draft-prefill separately (each with a blocking
+fetch), plus plain generate for reference."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    p = os.environ.get("BENCH_PLATFORM")
+    if p:
+        jax.config.update("jax_platforms", p)
+
+    from bench import llama_mini_config
+    from tf_operator_tpu.models import LlamaLM, SpeculativeDecoder, generate
+    from tf_operator_tpu.ops.quant import quantize_tree
+
+    seq = 512
+    model = LlamaLM(llama_mini_config(seq))
+    vocab = model.cfg.vocab_size
+    r = np.random.RandomState(0)
+    prompt = jnp.asarray(r.randint(0, vocab, size=(1, 32)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    qparams = quantize_tree(params)
+    dec = SpeculativeDecoder(model, params, model, qparams, k=4)
+    b = 1
+    out = {}
+
+    def timed(fn, reps=3):
+        fn()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return round((time.perf_counter() - t0) / reps, 4)
+
+    tc0 = dec._stacked_cache(dec.dtar, b)
+    dc0 = dec._stacked_cache(dec.ddraft, b)
+
+    def t_prefill():
+        tc, last = dec._prefill("t", 32)(dec.tparams, tc0, prompt)
+        np.asarray(last)
+
+    def d_prefill():
+        dc, last = dec._prefill("d", 32)(dec.dparams, dc0, prompt)
+        np.asarray(last)
+
+    out["t_prefill_s"] = timed(t_prefill)
+    out["d_prefill_s"] = timed(d_prefill)
+
+    out["plain_generate128_s"] = timed(
+        lambda: np.asarray(generate(model, params, prompt, max_new_tokens=128))
+    )
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Probe: is the speculative driver's while-loop body the thing that
+defeats DMA overlap, or is the round itself just slow?
+
+PROFILE.md (r5 serving tier) traced the fused while-loop driver at
+~86 GB/s effective weight bandwidth where plain `lax.scan` decode
+sustains ~300 GB/s, and left "restore DMA overlap inside the while
+body" as the open engineering item.  This probe isolates the control
+structure: the SAME vmapped round (speculative._round_row) executed
+
+  A. inside `_fused`'s `lax.while_loop` (data-dependent trip count,
+     one program for the whole generation), vs
+  B. inside `_rounds`' `lax.scan` at a FIXED round count (one program
+     per chunk, host decides when to stop).
+
+Same weights, same caches, same k, same acceptance stream (greedy,
+self-draft int8) — the only variable is while vs scan.  If B's
+per-round device wall is materially lower, the fix is a chunked-scan
+driver (optimistic first chunk of ceil(N/k) rounds, then top-up
+chunks), not kernel surgery.
+
+Usage: python benchmarks/spec_scan_probe.py  (prints one JSON line)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    p = os.environ.get("BENCH_PLATFORM")
+    if p:
+        jax.config.update("jax_platforms", p)
+
+    from bench import llama_mini_config
+    from tf_operator_tpu.models import LlamaLM, SpeculativeDecoder
+    from tf_operator_tpu.ops.quant import quantize_tree
+
+    seq = int(os.environ.get("PROBE_SPEC_MAXLEN", "512"))
+    n_new = int(os.environ.get("PROBE_SPEC_NEW", "128"))
+    rounds = int(os.environ.get("PROBE_SPEC_ROUNDS", "16"))
+    out = {"backend": jax.default_backend(), "n_new": n_new, "rounds": rounds}
+
+    model = LlamaLM(llama_mini_config(seq))
+    vocab = model.cfg.vocab_size
+    r = np.random.RandomState(0)
+    prompt = jnp.asarray(r.randint(0, vocab, size=(1, 32)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    qparams = quantize_tree(params)
+    dec = SpeculativeDecoder(model, params, model, qparams, k=4)
+
+    def timed(fn, reps=3):
+        fn()  # compile + settle
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (time.perf_counter() - t0) / reps
+
+    # A. whole-generation while_loop program (the r5-morning driver)
+    dec.use_fused = True
+    dec.fused_driver = "while"
+    out["fused_while_s"] = round(timed(
+        lambda: dec.generate(prompt, max_new_tokens=n_new)
+    ), 4)
+
+    # C. the shipped chunked-scan driver end-to-end (optimistic first
+    # chunk + top-ups, one small fetch per chunk)
+    dec.fused_driver = "scan"
+    out["fused_scan_s"] = round(timed(
+        lambda: dec.generate(prompt, max_new_tokens=n_new)
+    ), 4)
+    out["scan_vs_while"] = round(
+        out["fused_while_s"] / out["fused_scan_s"], 2
+    )
+
+    # B. the same rounds as ONE fixed-length scan program.  Drive the
+    # compiled `_rounds` program directly so the host loop's multiple
+    # fetches don't pollute the device-side comparison: one dispatch,
+    # then a single blocking fetch of the committed-length vector.
+    b, p_len = prompt.shape
+    tcache = dec._stacked_cache(dec.dtar, b)
+    dcache = dec._stacked_cache(dec.ddraft, b)
+    last = None
+    off = 0
+    from tf_operator_tpu.models.speculative import binary_chunks
+
+    for width in binary_chunks(p_len):
+        ids = prompt[:, off : off + width]
+        tcache, last = dec._prefill("t", width)(dec.tparams, tcache, ids)
+        dcache, _ = dec._prefill("d", width)(dec.dparams, dcache, ids)
+        off += width
+    t1 = jnp.argmax(last, -1).astype(jnp.int32)
+    n0 = jnp.full((b,), p_len, jnp.int32)
+    limit = jnp.full((b,), p_len + n_new, jnp.int32)
+    rounds_fn = dec._rounds(dec.k, rounds)
+
+    def run_scan():
+        tc, dc, t1o, n_dev, ms, chunks, acts = rounds_fn(
+            dec.tparams, dec.dparams, tcache, dcache, t1, n0, limit
+        )
+        np.asarray(n_dev)  # one blocking fetch
+
+    out["scan_fixed_s"] = round(timed(run_scan), 4)
+    out["scan_rounds_per_s"] = round(rounds / out["scan_fixed_s"], 1)
+
+    # the while program's round count varies with acceptance; report
+    # the tokens actually produced so per-round walls can be compared
+    # honestly (tokens/round ~= 1 + mean accepted)
+    dec.proposed = dec.accepted = 0
+    toks = dec.generate(prompt, max_new_tokens=n_new)
+    out["acceptance"] = round(dec.acceptance_rate, 3)
+    out["fused_tokens"] = int(toks.shape[1] - p_len)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -444,8 +444,9 @@ class TestDefaultBlockEnv:
 
         monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_Q", raising=False)
         monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_K", raising=False)
-        # r5 default: the autotune winner (see default_flash_blocks)
-        assert default_flash_blocks() == (256, 256)
+        # r5 default: the completion-pass autotune winner at every
+        # measured shape (see default_flash_blocks)
+        assert default_flash_blocks() == (512, 512)
         monkeypatch.setenv("TPU_OPERATOR_FLASH_BLOCK_Q", "128")
         monkeypatch.setenv("TPU_OPERATOR_FLASH_BLOCK_K", "512")
         assert default_flash_blocks() == (128, 512)
@@ -475,14 +476,45 @@ class TestDefaultBlockEnv:
         # BLOCK_Q pinned by env, BLOCK_K from the 256 default
         assert seen["blocks"] == (128, 256)
 
-    def test_shrunken_default_blocks_keep_xla_below_128block_crossover(
-        self, monkeypatch
-    ):
-        """seq 1152 tiles 128 but not the 256 default: the blocks
-        shrink so the kernel stays reachable, but in AUTO mode the
-        shrunken 128x128 config keeps its own measured crossover
-        (2048) — at 128 blocks the kernel loses 1.4x at ~1k (r4 sweep),
-        so auto must route 1152 to XLA, while force still forces."""
+    def test_block_keyed_crossover(self, monkeypatch):
+        """The auto-crossover floor is keyed to the blocks in use
+        (each tier's floor = shortest seq where those blocks measured
+        a win/tie vs XLA, r5 wide-xover sweeps): 512-class blocks win
+        from seq 512, 256-class from 1024, 128x128 from 2048.  Shapes
+        whose defaults shrank (seq 1152 tiles only 128) keep the
+        128-block floor; force bypasses the floor entirely."""
+
+        import importlib
+
+        fa = importlib.import_module("tf_operator_tpu.ops.flash_attention")
+        monkeypatch.setattr(fa.jax, "default_backend", lambda: "tpu")
+        monkeypatch.delenv("TPU_OPERATOR_FLASH", raising=False)
+        monkeypatch.delenv("TPU_OPERATOR_FLASH_MIN_SEQ", raising=False)
+
+        def applicable(seq, bq, bk):
+            q, k, _ = rand_qkv(9, 1, 2, seq, 64)
+            return fa._flash_applicable(q, k, None, None, bq, bk)
+
+        assert applicable(512, 512, 512)        # 512 blocks: floor 512
+        assert not applicable(512, 256, 256)    # 256 blocks: floor 1024
+        assert applicable(1024, 256, 256)
+        assert not applicable(1152, 128, 128)   # 128 blocks: floor 2048
+        assert applicable(2048, 128, 128)
+        # a single shrunken dim keys the floor on the SMALLER class
+        assert not applicable(512, 512, 256)
+        # env floor override wins over the block-derived floor
+        monkeypatch.setenv("TPU_OPERATOR_FLASH_MIN_SEQ", "2048")
+        assert not applicable(1024, 512, 512)
+        monkeypatch.delenv("TPU_OPERATOR_FLASH_MIN_SEQ")
+        # force bypasses the floor but not tiling
+        monkeypatch.setenv("TPU_OPERATOR_FLASH", "1")
+        assert applicable(1152, 128, 128)
+        assert not applicable(1152, 256, 256)   # 1152 % 256 != 0
+
+    def test_attention_resolves_shrunken_blocks(self, monkeypatch):
+        """attention() shrinks unpinned default dims until they tile
+        (seq 1152: 512→256→128) and hands the RESOLVED blocks to the
+        dispatcher, so the crossover sees what will actually run."""
 
         import importlib
 
@@ -495,12 +527,9 @@ class TestDefaultBlockEnv:
             return real(q, k, bias, mask, block_q, block_k, window)
 
         monkeypatch.setattr(fa, "_flash_applicable", spy)
-        monkeypatch.delenv("TPU_OPERATOR_FLASH", raising=False)
+        monkeypatch.setenv("TPU_OPERATOR_FLASH", "1")
         monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_Q", raising=False)
         monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_K", raising=False)
         q, k, v = rand_qkv(9, 1, 2, 1152, 64)
         fa.attention(q, k, v, causal=True)
-        assert "blocks" not in seen  # early XLA return, kernel not consulted
-        monkeypatch.setenv("TPU_OPERATOR_FLASH", "1")
-        fa.attention(q, k, v, causal=True)
-        assert seen["blocks"] == (128, 128)  # forced: shrunken blocks
+        assert seen["blocks"] == (128, 128)

@@ -186,6 +186,13 @@ class TestBenchSmoke:
         result = json.loads(line)
         assert "error" not in result, result
         assert result["value"] > 0
+        # the success JSON must carry the measurement-window ledger when
+        # one exists (the driver artifact's full field set — r5)
+        ledger = os.path.join(
+            os.path.dirname(__file__), "..", "benchmarks", "LAST_MEASURED.json"
+        )
+        if os.path.exists(ledger):
+            assert "last_measured" in result
 
 
 @pytest.mark.tpu

@@ -117,11 +117,28 @@ class SpeculativeDecoder:
         self._materialize = materialize_fn(target, draft)
         self.k = max(2, int(k))
         self.rounds_per_call = max(1, int(rounds_per_call))
-        #: whole-generation while_loop driver (one dispatch+fetch per
-        #: generate when room allows — see _fused).  Off = the host
-        #: round loop; kept for near-max_len prompts and as the parity
-        #: reference in tests.
+        #: whole-generation fused drivers (used when room allows; the
+        #: host round loop takes over near max_len and remains the
+        #: parity reference in tests).  use_fused=False forces the
+        #: host loop.
         self.use_fused = True
+        #: which fused driver.  "while" (default): the whole
+        #: generation as one while_loop program, one dispatch + one
+        #: packed fetch.  "scan": chunked fixed-length scans with a
+        #: small host loop — built to test the r5 hypothesis that the
+        #: while body defeats cross-iteration weight-DMA pipelining;
+        #: the CLEAN probe refuted it (benchmarks/spec_scan_probe.py,
+        #: no concurrent chip/CPU load: 128 tokens 0.98 s while vs
+        #: 1.06 s scan; 512 tokens 1.04 vs 1.27 — the while body runs
+        #: ~2.6-3 ms/round, same as the scan program, and the chunk
+        #: driver's extra round trips are pure loss; the earlier
+        #: "~75 ms/round inside while" reading was contention from a
+        #: concurrently running test suite).  Kept selectable for
+        #: parity testing and for re-evaluation on other hosts.
+        self.fused_driver = "while"
+        #: top-up chunk length for the scan driver (the first chunk is
+        #: sized to the optimistic round count, bucket // k)
+        self.scan_chunk_rounds = 8
         self.max_len = self.dtar.cfg.max_len
         self._fns = {}
         self.compile_count = 0
@@ -326,35 +343,19 @@ class SpeculativeDecoder:
 
         return rnd
 
-    def _fused(self, k: int, max_new: int, b: int, sampled: bool):
-        """The WHOLE generation as one device program: a lax.while_loop
-        over speculation rounds with an in-graph commit buffer, exited
-        when every row has its budget.  One dispatch + one packed fetch
-        per generate() call — the host-driven path pays ~4 tunnel round
-        trips (~66 ms each, measured) per rounds_per_call block, which
-        at small batch costs more than the compute it orchestrates
-        (round-4/5 windows measured 0.05× plain decode; this driver is
-        the fix).  Requires p + max_new + k <= max_len so cache room is
-        never the binding constraint (generate() falls back to the
-        host loop near max_len).
-
-        Packed return (int32): [B*(max_new+k) commit buffer, B final
-        n's, proposed, accepted, min-aligned-counterfactual]."""
+    def _make_round_body(self, k: int, sampled: bool, width: int):
+        """One speculation round as a state-dict transform, shared by
+        the while-loop (`_fused`) and chunked-scan (`_fused_scan`)
+        drivers.  A row at its limit is frozen in-graph (act False —
+        no commit, no index advance), so running EXTRA rounds past
+        all-done is semantically a no-op; that is what makes a
+        fixed-length scan over rounds safe."""
 
         rnd_row = (
             self._round_row_sampled(k) if sampled else self._round_row(k)
         )
-        width = max_new + k  # final round may overrun the budget by k-1
-        materialize = self._materialize
 
-        def fused(tparams, dparams, tcaches, dcaches, t1, n0, limit,
-                  rngs, temp):
-            tparams_m = materialize(tparams)
-            dparams_m = materialize(dparams)
-
-            def cond(st):
-                return jnp.any(st["n"] < limit)
-
+        def make(tparams_m, dparams_m, n0, limit, temp):
             def body(st):
                 if sampled:
                     tc, dc, t1n, m, chunk, act, rngs_n = jax.vmap(
@@ -399,6 +400,46 @@ class SpeculativeDecoder:
                     "rngs": rngs_n, "telem": telem,
                 }
 
+            return body
+
+        return make
+
+    def _fused(self, k: int, max_new: int, b: int, sampled: bool):
+        """The WHOLE generation as one device program: a lax.while_loop
+        over speculation rounds with an in-graph commit buffer, exited
+        when every row has its budget.  One dispatch + one packed fetch
+        per generate() call — the host-driven path pays ~4 tunnel round
+        trips (~66 ms each, measured) per rounds_per_call block, which
+        at small batch costs more than the compute it orchestrates
+        (round-4/5 windows measured 0.05× plain decode; this driver is
+        the fix).  Requires p + max_new + k <= max_len so cache room is
+        never the binding constraint (generate() falls back to the
+        host loop near max_len).
+
+        r5 note: a chunked-scan alternative (`_fused_scan`) was built
+        on the hypothesis that the while body defeats cross-iteration
+        weight-DMA pipelining; the clean probe refuted it — this
+        driver's rounds run at the same ~3 ms as the scan program and
+        the chunk driver's extra round trips are pure loss on this
+        host (benchmarks/spec_scan_probe.py; PROFILE.md "scan-driver
+        experiment").  This stays the default.
+
+        Packed return (int32): [B*(max_new+k) commit buffer, B final
+        n's, proposed, accepted, min-aligned-counterfactual]."""
+
+        width = max_new + k  # final round may overrun the budget by k-1
+        materialize = self._materialize
+        make_body = self._make_round_body(k, sampled, width)
+
+        def fused(tparams, dparams, tcaches, dcaches, t1, n0, limit,
+                  rngs, temp):
+            body = make_body(
+                materialize(tparams), materialize(dparams), n0, limit, temp
+            )
+
+            def cond(st):
+                return jnp.any(st["n"] < limit)
+
             state = {
                 "out": jnp.zeros((b, width), jnp.int32),
                 "tc": tcaches, "dc": dcaches,
@@ -414,6 +455,84 @@ class SpeculativeDecoder:
             ])
 
         return self._jit(("fused", k, max_new, b, sampled), fused)
+
+    def _fused_scan(self, k: int, max_new: int, b: int, sampled: bool,
+                    r: int):
+        """One CHUNK of the generation: r speculation rounds as a
+        fixed-length lax.scan over the same round body `_fused` runs
+        under its while_loop.  Built to test whether the while body
+        defeats cross-iteration weight-DMA pipelining; the clean probe
+        says NO on this host (both structures run ~3 ms/round —
+        spec_scan_probe.py), so this driver is opt-in
+        (`fused_driver="scan"`), kept as the parity alternative and
+        for hosts with different while-loop scheduling.  The caller
+        re-dispatches chunks until every row reports done, fetching
+        only the B-length `n` vector between chunks (caches and the
+        commit buffer stay device-resident in the state dict; the
+        packed vector is fetched once, after the last chunk).  Rounds
+        past a row's budget are in-graph no-ops, so over-scanning the
+        tail chunk is safe — it costs compute, never correctness."""
+
+        width = max_new + k
+        materialize = self._materialize
+        make_body = self._make_round_body(k, sampled, width)
+
+        def chunk(tparams, dparams, state, n0, limit, temp):
+            body = make_body(
+                materialize(tparams), materialize(dparams), n0, limit, temp
+            )
+            state, _ = lax.scan(
+                lambda st, _: (body(st), None), state, None, length=r
+            )
+            # packed is part of every chunk's graph (a cheap device
+            # concat) but the host only FETCHES it after the last
+            # chunk; between chunks it fetches state["n"] alone — B
+            # int32s — for the done check
+            packed = jnp.concatenate([
+                state["out"].ravel(),
+                state["n"].astype(jnp.int32),
+                state["telem"],
+            ])
+            return state, packed
+
+        return self._jit(("fused-scan", k, max_new, b, sampled, r), chunk)
+
+    def _drive_scan(self, bucket: int, b: int, sampled: bool,
+                    tcache, dcache, t1, n0, limit, rngs, temp):
+        """Host side of the chunked-scan driver: dispatch an optimistic
+        first chunk (bucket // k rounds — the minimum that can finish,
+        every round commits at least one token per active row), then
+        fixed-size top-up chunks until every row reports done.  Between
+        chunks only the B-length `n` vector crosses the wire; the
+        packed commit buffer is fetched once after the final chunk,
+        and caches stay device-resident in the state pytree.  Two
+        compiled programs per (k, bucket, b, sampled) worst case — r0
+        and the top-up size are both deterministic."""
+
+        width = bucket + self.k
+        state = {
+            "out": jnp.zeros((b, width), jnp.int32),
+            "tc": tcache, "dc": dcache,
+            "n": n0, "t1": t1,
+            "rngs": rngs,
+            "telem": jnp.zeros((3,), jnp.int32),
+        }
+        r0 = max(1, -(-bucket // self.k))
+        r0 = 1 << max(0, r0 - 1).bit_length()  # pow2: bounded compiles
+        limit_h = np.asarray(limit)
+        chunk_r = r0
+        while True:
+            fn = self._fused_scan(self.k, bucket, b, sampled, chunk_r)
+            state, packed = fn(
+                self.tparams, self.dparams, state, n0, limit, temp
+            )
+            # between-chunk done check: fetch ONLY the B-length n
+            # vector; the full packed buffer (B*(bucket+k) ints)
+            # crosses the wire once, after the final chunk
+            n_h = np.asarray(state["n"])
+            if (n_h >= limit_h).all():
+                return np.asarray(packed)
+            chunk_r = max(1, min(self.scan_chunk_rounds, r0))
 
     def _rounds(self, k: int, r: int):
         """R rounds scanned into one program, each round a vmap of the
@@ -551,12 +670,19 @@ class SpeculativeDecoder:
         # the exact budget rides in the runtime `limit` vector.
         bucket = 1 << max(0, max_new_tokens - 1).bit_length()
         if self.use_fused and p + max_new_tokens + self.k <= self.max_len:
-            packed = np.asarray(
-                self._fused(self.k, bucket, b, sampled)(
-                    self.tparams, self.dparams, tcache, dcache, t1,
-                    jnp.full((b,), p, jnp.int32), limit, row_rngs, temp,
+            n0_dev = jnp.full((b,), p, jnp.int32)
+            if self.fused_driver == "scan":
+                packed = self._drive_scan(
+                    bucket, b, sampled, tcache, dcache, t1, n0_dev,
+                    limit, row_rngs, temp,
                 )
-            )
+            else:
+                packed = np.asarray(
+                    self._fused(self.k, bucket, b, sampled)(
+                        self.tparams, self.dparams, tcache, dcache, t1,
+                        n0_dev, limit, row_rngs, temp,
+                    )
+                )
             w = bucket + self.k
             toks = packed[: b * w].reshape(b, w)[:, :max_new_tokens]
             telem = packed[b * w + b :]

@@ -30,11 +30,14 @@ the kernel in shard_map over (dp/fsdp → batch, tp → heads); meshes that
 shard other attention dims fall back.
 
 Env knobs — note the three-state semantics of TPU_OPERATOR_FLASH:
-  unset / ""  auto: the measured seq crossover decides (flash only at
-              max(Sq,Sk) >= TPU_OPERATOR_FLASH_MIN_SEQ, default 1024 —
-              r5 honest sweep: with the 256x256 default blocks the
-              kernel ties XLA at 1024 and wins 1.15x at 2048; below
-              1024 is unmeasured, XLA keeps it).
+  unset / ""  auto: the measured seq crossover decides.  The floor is
+              keyed to the kernel blocks in use (r5 block-autotune,
+              window_out/wide-xover*.out): with the 512x512 defaults
+              flash wins from seq 512 up on both head dims (1.11-2.3x
+              over XLA-fused), so the floor is 512; shapes whose
+              blocks shrank to 256/128 keep the higher floors those
+              blocks were measured at (1024/2048).
+              TPU_OPERATOR_FLASH_MIN_SEQ overrides the floor.
   "0"         disable the kernel globally.
   any other   FORCE flash wherever it applies, crossover ignored.
               ** Semantics changed in r4: an explicit "1" used to be
@@ -518,25 +521,49 @@ def _compiler_params(interpret: bool):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_p(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+    window: Optional[int],
+) -> jax.Array:
+    """custom_vjp primal: concrete blocks only (the public wrapper
+    resolves None dims before this point so _fwd/_bwd see the same
+    values)."""
+
+    validate_window(window, causal)
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret, window=window)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: bool = False,
     window: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention over [B, H, S, D].  Sq % block_q == Sk % block_k
     == 0 required (dispatch checks this; call `attention` instead).
+    ``block_q``/``block_k``: None (default) takes the measured-winner
+    defaults (default_flash_blocks — 512x512, env-overridable), shrunk
+    per-dim until they tile the sequence; explicit values are used
+    exactly as given.
     ``window``: sliding-window local attention (requires causal) —
     the k grid dimension shrinks to the band (O(window/block_k) blocks
     per q block), so both FLOPs AND K/V DMA are O(S * window), not
     O(S^2).  Same banding in the backward kernels."""
 
-    validate_window(window, causal)
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret, window=window)
+    block_q, block_k = resolve_flash_blocks(
+        block_q, block_k, q.shape[-2], k.shape[-2]
+    )
+    return _flash_attention_p(q, k, v, causal, block_q, block_k, interpret, window)
 
 
 def resolve_use_flash(use_flash, applicable: bool, why_not: str) -> bool:
@@ -594,7 +621,7 @@ def _bwd(causal, block_q, block_k, interpret, window, res, g):
     )
 
 
-flash_attention.defvjp(_fwd, _bwd)
+_flash_attention_p.defvjp(_fwd, _bwd)
 
 
 def flash_attention_sharded(
@@ -603,15 +630,20 @@ def flash_attention_sharded(
     v: jax.Array,
     mesh: Mesh,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: bool = False,
     window: Optional[int] = None,
 ) -> jax.Array:
     """Flash over a multi-device mesh: shard_map over batch (dp, fsdp)
     and heads (tp) — attention is independent per (batch, head), so the
-    per-shard kernel is exact.  Requires sp == ep == 1 (ring attention
-    owns sp > 1)."""
+    per-shard kernel is exact (the per-shard sequence is the full S, so
+    None block dims resolve against the global shape).  Requires
+    sp == ep == 1 (ring attention owns sp > 1)."""
+
+    block_q, block_k = resolve_flash_blocks(
+        block_q, block_k, q.shape[-2], k.shape[-2]
+    )
 
     from tf_operator_tpu.utils.jax_compat import shard_map_unchecked
 
@@ -671,15 +703,27 @@ def _flash_applicable(q, k, bias, mask, block_q, block_k, window=None) -> bool:
         # banded grids need Sq == Sk; the XLA reference's position-based
         # window mask handles the cross-length case — route it there
         return False
-    # measured crossover (benchmarks/window_out/llama-sweep.out, r5
-    # honest slope-timed rerun): with the r5 default 256x256 blocks the
-    # kernel TIES the XLA-fused reference at seq 1024 fwd+bwd
-    # (67,670 vs 67,664 tok/s llama-mini) and WINS at 2048
-    # (58,730 vs 51,179, 1.15x — s2048-b512x256 row); with the old
-    # 128x128 blocks it lost 1.4x at 1024, which is what the r4
-    # crossover of 2048 was measuring.  Below 1024 is unmeasured;
-    # auto-dispatch keeps XLA there.
-    min_seq = int(os.environ.get("TPU_OPERATOR_FLASH_MIN_SEQ", "1024"))
+    # Measured crossover, keyed to the blocks actually in use — each
+    # tier's floor is the shortest seq where THOSE blocks were measured
+    # to win or tie the XLA-fused reference fwd+bwd
+    # (window_out/llama-sweep.out + wide-xover{,2,3,4}.out, r5):
+    #   512-class blocks: win from seq 512 up, both head dims
+    #     (mini s512 128.2k vs 115.5k XLA 1.11x, s1024 1.63x, s2048
+    #     1.82x; wide s1024 1.30x, s4096 2.30x) → floor 512;
+    #   256-class blocks (a dim shrank): tie at 1024 (67,670 vs
+    #     67,664 mini), win 1.06x at 2048 → floor 1024;
+    #   128x128 (fully shrunk or pinned): lose 1.4x at 1024, win
+    #     1.17x at 4096 (r4) → keep the old floor of 2048.
+    # TPU_OPERATOR_FLASH_MIN_SEQ overrides the block-derived floor.
+    raw_min = os.environ.get("TPU_OPERATOR_FLASH_MIN_SEQ")
+    if raw_min:
+        min_seq = int(raw_min)
+    elif min(block_q, block_k) >= 512:
+        min_seq = 512
+    elif min(block_q, block_k) >= 256:
+        min_seq = 1024
+    else:
+        min_seq = 2048
     if not forced and max(q.shape[-2], k.shape[-2]) < min_seq:
         return False
     # the kernel targets the TPU backend; everything else takes the
@@ -690,18 +734,50 @@ def _flash_applicable(q, k, bias, mask, block_q, block_k, window=None) -> bool:
 def default_flash_blocks() -> tuple:
     """Kernel block sizes used when the caller doesn't pick:
     TPU_OPERATOR_FLASH_BLOCK_Q / _BLOCK_K env overrides (the
-    benchmarks/llama_sweep.py autotune matrix sets these per variant),
-    else 256x256 — the r5 autotune winner (llama-mini fwd+bwd:
-    s1024 72.6→67.7k tok/s honest vs 48.8k at 128x128; s2048-b256x256
-    54.2k vs 33.8k; best-at-2048 was bq512/bk256 at 58.7k but 512 only
-    tiles seq >= 512 — 256 is the best default that tiles every shape
-    the dispatcher accepts).  Still a safe VMEM fit at every supported
-    head dim (two 256x128 bf16 K/V blocks + fp32 carries < 1 MB)."""
+    benchmarks/llama_sweep.py autotune matrices set these per variant),
+    else 512x512 — the r5 completion-pass winner at EVERY measured
+    training shape on both head dims (window_out/wide-xover{,2,3}.out,
+    llama fwd+bwd tok/s/chip vs the best previously-known path):
+      mini D=64:  s1024 110.6k (vs 67.7k XLA/256-block tie, 1.63x),
+                  s2048 93.0k (vs 58.7k), s4096 60.5k@bk512 (vs 37.8k)
+      wide D=128: s1024 30.1k mfu 0.603 (vs 23.3k XLA), s2048 28.2k,
+                  s4096 23.8k mfu 0.530 (vs 10.3k XLA, 2.3x)
+    Bigger K blocks = fewer grid steps and longer in-VMEM inner loops;
+    the win is monotone 128→256→512 everywhere measured.  VMEM still
+    fits at every supported head dim (two 512x128 bf16 K/V blocks,
+    double-buffered, + fp32 carries ≈ 1.5 MB).  Shapes that don't tile
+    512 shrink per-dim to 256/128 in `attention()`."""
 
     return (
-        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_Q", "256")),
-        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_K", "256")),
+        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_Q", "512")),
+        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_K", "512")),
     )
+
+
+def resolve_flash_blocks(
+    block_q: Optional[int], block_k: Optional[int], sq: int, sk: int
+) -> tuple:
+    """Fill unpinned block dims from default_flash_blocks(), shrinking
+    each BUILT-IN default per-dim (512→256→128) until it tiles the
+    given q/k sequence lengths.  Caller-pinned dims and BLOCK_Q/_K env
+    pins are never adjusted (a sweep must measure exactly what it set).
+    Used everywhere blocks default: `attention()` (whose auto-crossover
+    then keys on the resolved blocks), the raw kernel entry points, and
+    the sp schedules (ring/ulysses), which size blocks against their
+    per-shard sequence."""
+
+    dq, dk = default_flash_blocks()
+    if block_q is None:
+        if not os.environ.get("TPU_OPERATOR_FLASH_BLOCK_Q"):
+            while dq > 128 and sq % dq:
+                dq //= 2
+        block_q = dq
+    if block_k is None:
+        if not os.environ.get("TPU_OPERATOR_FLASH_BLOCK_K"):
+            while dk > 128 and sk % dk:
+                dk //= 2
+        block_k = dk
+    return block_q, block_k
 
 
 def attention(
@@ -721,46 +797,18 @@ def attention(
     XLA-fused reference otherwise.  Drop-in for dot_product_attention;
     pass the mesh so multi-device calls get the shard_map wrapper."""
 
-    shrunk = False
-    if block_q is None or block_k is None:
-        dq, dk = default_flash_blocks()
-        # a BUILT-IN default block that doesn't tile the sequence
-        # shrinks to one that does (floor 128) instead of silently
-        # losing the kernel — the 256 default would otherwise drop
-        # flash coverage for seqs divisible by 128 but not 256 (e.g.
-        # 1152), including under forced TPU_OPERATOR_FLASH=1.  PINNED
-        # blocks — caller args AND the BLOCK_Q/_K env knobs — are
-        # never adjusted (the sweep must measure exactly what it set;
-        # a non-tiling pin falls back to XLA via _flash_applicable).
-        if block_q is None:
-            if not os.environ.get("TPU_OPERATOR_FLASH_BLOCK_Q"):
-                while dq > 128 and q.shape[-2] % dq:
-                    dq //= 2
-                    shrunk = True
-            block_q = dq
-        if block_k is None:
-            if not os.environ.get("TPU_OPERATOR_FLASH_BLOCK_K"):
-                while dk > 128 and k.shape[-2] % dk:
-                    dk //= 2
-                    shrunk = True
-            block_k = dk
-    # the min_seq=1024 crossover was measured WITH the 256x256 blocks
-    # (they tie XLA at 1024); at 128x128 the kernel loses 1.4x there
-    # (r4 sweep), so shapes that shrank all the way DOWN to 128x128
-    # keep the 128-block crossover of 2048 in auto mode (force still
-    # forces; a shrink that stopped at 256 keeps the 1024 crossover
-    # its blocks were measured at)
-    if (
-        shrunk
-        and block_q == 128
-        and block_k == 128
-        and not os.environ.get("TPU_OPERATOR_FLASH", "")
-        and max(q.shape[-2], k.shape[-2]) < 2048
-    ):
-        return dot_product_attention(
-            q, k, v, causal=causal, bias=bias, mask=mask, window=window
-        )
-
+    # A BUILT-IN default block that doesn't tile the sequence shrinks
+    # per-dim to one that does (floor 128) instead of silently losing
+    # the kernel; pinned blocks — caller args AND the BLOCK_Q/_K env
+    # knobs — are never adjusted (the sweep must measure exactly what
+    # it set; a non-tiling pin falls back to XLA via
+    # _flash_applicable).  The auto-crossover inside _flash_applicable
+    # is keyed to the RESOLVED blocks, so shapes that shrank (or were
+    # pinned) down to smaller blocks keep the higher seq floor those
+    # blocks were measured at.
+    block_q, block_k = resolve_flash_blocks(
+        block_q, block_k, q.shape[-2], k.shape[-2]
+    )
     if _flash_applicable(q, k, bias, mask, block_q, block_k, window):
         mode = _mesh_flash_applicable(mesh, q, k)
         if mode == "single":

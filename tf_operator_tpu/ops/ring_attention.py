@@ -575,8 +575,8 @@ def ring_attention(
     batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
     heads_axis: Optional[str] = "tp",
     use_flash: Optional[bool] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: bool = False,
     window: Optional[int] = None,
 ) -> jax.Array:
@@ -609,8 +609,16 @@ def ring_attention(
         k, v = _rep_kv(k, group), _rep_kv(v, group)
         group = 1
 
-    from tf_operator_tpu.ops.flash_attention import resolve_use_flash
+    from tf_operator_tpu.ops.flash_attention import (
+        resolve_flash_blocks,
+        resolve_use_flash,
+    )
 
+    # blocks size against the PER-SHARD sequence (each ring hop's
+    # kernel call sees S/n); unpinned dims take the tuned defaults,
+    # shrunk until they tile the shard
+    local_s = q.shape[-2] // n if q.shape[-2] % n == 0 else q.shape[-2]
+    block_q, block_k = resolve_flash_blocks(block_q, block_k, local_s, local_s)
     use_flash = resolve_use_flash(
         use_flash,
         _flash_ring_applicable(q, n, block_q, block_k),

@@ -41,7 +41,11 @@ from tf_operator_tpu.ops.attention import (
     repeat_kv_heads as _rep_kv,
     validate_window,
 )
-from tf_operator_tpu.ops.flash_attention import flash_attention, resolve_use_flash
+from tf_operator_tpu.ops.flash_attention import (
+    flash_attention,
+    resolve_flash_blocks,
+    resolve_use_flash,
+)
 
 
 def _ulysses_local(
@@ -112,8 +116,8 @@ def ulysses_attention(
     batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
     heads_axis: Optional[str] = "tp",
     use_flash: Optional[bool] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: bool = False,
     window: Optional[int] = None,
 ) -> jax.Array:
@@ -156,6 +160,12 @@ def ulysses_attention(
     # head count splits across the axis too
     kv_native_a2a = group == 1 or (hkv // tp_size) % n == 0
 
+    # the local attention sees the FULL sequence (heads are what's
+    # sharded here): size unpinned block dims against S, tuned defaults
+    # shrunk until they tile
+    block_q, block_k = resolve_flash_blocks(
+        block_q, block_k, q.shape[-2], k.shape[-2]
+    )
     use_flash = resolve_use_flash(
         use_flash,
         _flash_local_applicable(q, block_q, block_k),
