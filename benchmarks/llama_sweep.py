@@ -181,6 +181,29 @@ WIDE_XOVER4 = [
      ["--model", "wide", "--seq", "512", "--batch", "8", "--flash", "0"]),
 ]
 
+#: MFU frontier pass: 0.6163 landed at wide s512 b8 and MFU rose as
+#: seq shrank (attention's share falls, the MXU-dense MLP GEMMs
+#: dominate) — so probe bigger batches at s512 and the s256 shapes
+#: (256-class blocks tile s256; the 512s don't).  HBM check: b16 s512
+#: non-remat has the same activation footprint as the b4 s1024 row
+#: that fit.
+WIDE_XOVER5 = [
+    ("wx5-wide-s512-b16-b512x512",
+     ["--model", "wide", "--seq", "512", "--batch", "16"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+    ("wx5-wide-s256-b32-b256x256",
+     ["--model", "wide", "--seq", "256", "--batch", "32"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "256", "TPU_OPERATOR_FLASH_BLOCK_K": "256"}),
+    ("wx5-wide-s256-b32-xla",
+     ["--model", "wide", "--seq", "256", "--batch", "32", "--flash", "0"]),
+    ("wx5-wide-s512-b32-b512x512",
+     ["--model", "wide", "--seq", "512", "--batch", "32"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+    ("wx5-mini-s512-b32-b512x512",
+     ["--seq", "512", "--batch", "32"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
+]
+
 
 def run_one(label, extra, timeout, env_extra=None):
     cmd = [sys.executable, os.path.join(HERE, "profile_llama.py"), *extra]
@@ -227,7 +250,7 @@ def main():
     ap.add_argument(
         "--set", default="main",
         choices=["main", "wide", "wide-xover", "wide-xover2", "wide-xover3",
-                 "wide-xover4"],
+                 "wide-xover4", "wide-xover5"],
         help="main = the llama-mini variant/autotune matrix; wide = the "
         "~700M existence-proof shapes (their own window step); "
         "wide-xover = the D=128 head-dim flash/XLA crossover matrix; "
@@ -238,7 +261,7 @@ def main():
 
     matrix = {
         "wide": WIDE, "wide-xover": WIDE_XOVER, "wide-xover2": WIDE_XOVER2,
-        "wide-xover3": WIDE_XOVER3, "wide-xover4": WIDE_XOVER4,
+        "wide-xover3": WIDE_XOVER3, "wide-xover4": WIDE_XOVER4, "wide-xover5": WIDE_XOVER5,
     }.get(args.set, MATRIX)
     if args.quick:
         matrix = matrix[:2]  # first two of the SELECTED set
