@@ -204,6 +204,24 @@ WIDE_XOVER5 = [
      {"TPU_OPERATOR_FLASH_BLOCK_Q": "512", "TPU_OPERATOR_FLASH_BLOCK_K": "512"}),
 ]
 
+#: 256-class floor calibration: wx5 showed 256-blocks WIN at wide s256
+#: (0.675 vs 0.613 XLA) yet the block-keyed floor (1024, measured on
+#: mini >= 1024) routes s256 to XLA.  Complete the short-seq cells on
+#: mini so the 256-class floor is set from data at the seqs where the
+#: class actually runs (s256/s512 shrink the 512 defaults to 256).
+WIDE_XOVER6 = [
+    ("wx6-mini-s512-b16-b256x256",
+     ["--seq", "512", "--batch", "16"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "256", "TPU_OPERATOR_FLASH_BLOCK_K": "256"}),
+    ("wx6-mini-s512-b16-xla",
+     ["--seq", "512", "--batch", "16", "--flash", "0"]),
+    ("wx6-mini-s256-b32-b256x256",
+     ["--seq", "256", "--batch", "32"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "256", "TPU_OPERATOR_FLASH_BLOCK_K": "256"}),
+    ("wx6-mini-s256-b32-xla",
+     ["--seq", "256", "--batch", "32", "--flash", "0"]),
+]
+
 
 def run_one(label, extra, timeout, env_extra=None):
     cmd = [sys.executable, os.path.join(HERE, "profile_llama.py"), *extra]
@@ -250,7 +268,7 @@ def main():
     ap.add_argument(
         "--set", default="main",
         choices=["main", "wide", "wide-xover", "wide-xover2", "wide-xover3",
-                 "wide-xover4", "wide-xover5"],
+                 "wide-xover4", "wide-xover5", "wide-xover6"],
         help="main = the llama-mini variant/autotune matrix; wide = the "
         "~700M existence-proof shapes (their own window step); "
         "wide-xover = the D=128 head-dim flash/XLA crossover matrix; "
@@ -261,7 +279,7 @@ def main():
 
     matrix = {
         "wide": WIDE, "wide-xover": WIDE_XOVER, "wide-xover2": WIDE_XOVER2,
-        "wide-xover3": WIDE_XOVER3, "wide-xover4": WIDE_XOVER4, "wide-xover5": WIDE_XOVER5,
+        "wide-xover3": WIDE_XOVER3, "wide-xover4": WIDE_XOVER4, "wide-xover5": WIDE_XOVER5, "wide-xover6": WIDE_XOVER6,
     }.get(args.set, MATRIX)
     if args.quick:
         matrix = matrix[:2]  # first two of the SELECTED set

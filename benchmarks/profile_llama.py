@@ -29,14 +29,20 @@ def main() -> int:
     )
     ap.add_argument("--batch", type=int, default=8, help="per chip")
     ap.add_argument("--steps", type=int, default=10)
-    ap.add_argument("--flash", default="1", choices=["0", "1"])
+    # "1" forces the kernel (sweeps measure flash AT crossover shapes),
+    # "0" disables it, "auto" clears the env var so the dispatcher's
+    # measured block-keyed crossover decides — used to verify the auto
+    # path routes where the sweep data says it should
+    ap.add_argument("--flash", default="1", choices=["0", "1", "auto"])
     ap.add_argument("--window", type=int, default=None)
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--accum", type=int, default=1)
     ap.add_argument("--platform", default=None)
     args = ap.parse_args()
 
-    os.environ["TPU_OPERATOR_FLASH"] = args.flash
+    os.environ["TPU_OPERATOR_FLASH"] = (
+        "" if args.flash == "auto" else args.flash
+    )
 
     import jax
 

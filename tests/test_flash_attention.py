@@ -480,9 +480,11 @@ class TestDefaultBlockEnv:
         """The auto-crossover floor is keyed to the blocks in use
         (each tier's floor = shortest seq where those blocks measured
         a win/tie vs XLA, r5 wide-xover sweeps): 512-class blocks win
-        from seq 512, 256-class from 1024, 128x128 from 2048.  Shapes
-        whose defaults shrank (seq 1152 tiles only 128) keep the
-        128-block floor; force bypasses the floor entirely."""
+        from seq 512; the 256-class floor is head-dim split (wins from
+        256 at D >= 128, from 1024 at D = 64 where XLA takes short
+        seqs — wx6 calibration); 128x128 from 2048.  Shapes whose
+        defaults shrank (seq 1152 tiles only 128) keep the 128-block
+        floor; force bypasses the floor entirely."""
 
         import importlib
 
@@ -491,13 +493,16 @@ class TestDefaultBlockEnv:
         monkeypatch.delenv("TPU_OPERATOR_FLASH", raising=False)
         monkeypatch.delenv("TPU_OPERATOR_FLASH_MIN_SEQ", raising=False)
 
-        def applicable(seq, bq, bk):
-            q, k, _ = rand_qkv(9, 1, 2, seq, 64)
+        def applicable(seq, bq, bk, d=64):
+            q, k, _ = rand_qkv(9, 1, 2, seq, d)
             return fa._flash_applicable(q, k, None, None, bq, bk)
 
         assert applicable(512, 512, 512)        # 512 blocks: floor 512
-        assert not applicable(512, 256, 256)    # 256 blocks: floor 1024
+        assert not applicable(512, 256, 256)    # 256@D64: floor 1024
         assert applicable(1024, 256, 256)
+        # 256-class floor is head-dim split: D>=128 wins from 256
+        assert applicable(256, 256, 256, d=128)
+        assert not applicable(256, 256, 256, d=64)
         assert not applicable(1152, 128, 128)   # 128 blocks: floor 2048
         assert applicable(2048, 128, 128)
         # a single shrunken dim keys the floor on the SMALLER class

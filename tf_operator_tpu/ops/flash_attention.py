@@ -35,8 +35,10 @@ Env knobs — note the three-state semantics of TPU_OPERATOR_FLASH:
               window_out/wide-xover*.out): with the 512x512 defaults
               flash wins from seq 512 up on both head dims (1.11-2.3x
               over XLA-fused), so the floor is 512; shapes whose
-              blocks shrank to 256/128 keep the higher floors those
-              blocks were measured at (1024/2048).
+              blocks shrank to 256 keep that class's measured floor
+              (256 at head dim >= 128 where it still wins, 1024 at
+              D=64 where XLA takes short seqs), and 128x128 keeps
+              2048.
               TPU_OPERATOR_FLASH_MIN_SEQ overrides the floor.
   "0"         disable the kernel globally.
   any other   FORCE flash wherever it applies, crossover ignored.
@@ -706,12 +708,16 @@ def _flash_applicable(q, k, bias, mask, block_q, block_k, window=None) -> bool:
     # Measured crossover, keyed to the blocks actually in use — each
     # tier's floor is the shortest seq where THOSE blocks were measured
     # to win or tie the XLA-fused reference fwd+bwd
-    # (window_out/llama-sweep.out + wide-xover{,2,3,4}.out, r5):
+    # (window_out/llama-sweep.out + wide-xover{,2,3,4,5,6}.out, r5):
     #   512-class blocks: win from seq 512 up, both head dims
     #     (mini s512 128.2k vs 115.5k XLA 1.11x, s1024 1.63x, s2048
-    #     1.82x; wide s1024 1.30x, s4096 2.30x) → floor 512;
-    #   256-class blocks (a dim shrank): tie at 1024 (67,670 vs
-    #     67,664 mini), win 1.06x at 2048 → floor 1024;
+    #     1.82x; wide s512 1.15x, s1024 1.30x, s4096 2.30x) → floor
+    #     512;
+    #   256-class blocks (a dim shrank): head-dim split — at D >= 128
+    #     they WIN from seq 256 (wide s256 34.7k vs 31.5k XLA 1.10x;
+    #     every mixed bk512 wide cell wins) → floor 256; at D < 128
+    #     they LOSE short (mini s256 0.78x, s512 0.90x) and only tie
+    #     at 1024 / win 1.06x at 2048 → floor 1024;
     #   128x128 (fully shrunk or pinned): lose 1.4x at 1024, win
     #     1.17x at 4096 (r4) → keep the old floor of 2048.
     # TPU_OPERATOR_FLASH_MIN_SEQ overrides the block-derived floor.
@@ -721,7 +727,7 @@ def _flash_applicable(q, k, bias, mask, block_q, block_k, window=None) -> bool:
     elif min(block_q, block_k) >= 512:
         min_seq = 512
     elif min(block_q, block_k) >= 256:
-        min_seq = 1024
+        min_seq = 256 if q.shape[-1] >= 128 else 1024
     else:
         min_seq = 2048
     if not forced and max(q.shape[-2], k.shape[-2]) < min_seq:
