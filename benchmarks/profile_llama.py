@@ -38,6 +38,7 @@ def main() -> int:
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--accum", type=int, default=1)
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--trace", default=None, help="xplane trace dir")
     args = ap.parse_args()
 
     os.environ["TPU_OPERATOR_FLASH"] = (
@@ -108,6 +109,19 @@ def main() -> int:
     if flops_xla:
         out["mfu_xla"] = round(flops_xla * stats["steps_per_sec"] / peak, 4)
     print(json.dumps(out), flush=True)
+    if args.trace:
+        # xplane capture of the hot step + top-op table (same tooling
+        # as profile_resnet) — the trace-proven half of an MFU-ceiling
+        # claim: the sweep shows the plateau, this names the ops
+        import jax as _jax
+
+        from profile_resnet import summarize_xplane
+
+        with _jax.profiler.trace(args.trace):
+            for _ in range(3):
+                trainer.train_step(trainer.shard_batch(lm))
+            _jax.effects_barrier()
+        summarize_xplane(args.trace)
     return 0
 
 
