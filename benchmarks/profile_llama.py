@@ -12,11 +12,21 @@ Usage: python benchmarks/profile_llama.py [--seq 1024] [--batch 8]
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _effective_chunks(s: int, n_chunks: int) -> int:
+    """Mirror of llama_loss_chunked's divisor fallback."""
+
+    c = max(1, min(n_chunks, s))
+    while s % c:
+        c -= 1
+    return c
 
 
 def main() -> int:
@@ -39,6 +49,12 @@ def main() -> int:
     ap.add_argument("--accum", type=int, default=1)
     ap.add_argument("--platform", default=None)
     ap.add_argument("--trace", default=None, help="xplane trace dir")
+    ap.add_argument(
+        "--chunked-loss", type=int, default=0, metavar="N",
+        help="stream the vocab projection + xent over N sequence "
+        "chunks (llama_loss_chunked) instead of materializing full "
+        "f32 logits",
+    )
     args = ap.parse_args()
 
     os.environ["TPU_OPERATOR_FLASH"] = (
@@ -61,7 +77,11 @@ def main() -> int:
         llama_wide_config,
         matmul_param_count,
     )
-    from tf_operator_tpu.models import LlamaLM, llama_loss
+    from tf_operator_tpu.models import (
+        LlamaLM,
+        llama_loss,
+        llama_loss_chunked,
+    )
     from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
 
     devices = jax.devices()
@@ -78,7 +98,8 @@ def main() -> int:
         LlamaLM(cfg),
         TrainerConfig(learning_rate=1e-3, remat=args.remat, accum_steps=args.accum),
         make_mesh({"fsdp": n_dev}),
-        llama_loss,
+        (functools.partial(llama_loss_chunked, n_chunks=args.chunked_loss)
+         if args.chunked_loss else llama_loss),
         lm,
         init_args=(lm["input_ids"],),
         shardings="logical",
@@ -100,6 +121,12 @@ def main() -> int:
         "flash": args.flash,
         "window": args.window,
         "remat": bool(args.remat),
+        "chunked_loss": args.chunked_loss,
+        # the loss silently drops to the largest divisor of S-1 that
+        # is <= the request — record what actually ran
+        "chunked_loss_effective": _effective_chunks(
+            args.seq - 1, args.chunked_loss
+        ) if args.chunked_loss else 0,
         "step_ms": round(stats["step_ms"], 2),
         "tokens_per_sec_per_chip": round(tps, 1),
         "mfu_analytic": round(tps * flops_tok / peak, 4),
@@ -113,14 +140,12 @@ def main() -> int:
         # xplane capture of the hot step + top-op table (same tooling
         # as profile_resnet) — the trace-proven half of an MFU-ceiling
         # claim: the sweep shows the plateau, this names the ops
-        import jax as _jax
-
         from profile_resnet import summarize_xplane
 
-        with _jax.profiler.trace(args.trace):
+        with jax.profiler.trace(args.trace):
             for _ in range(3):
                 trainer.train_step(trainer.shard_batch(lm))
-            _jax.effects_barrier()
+            jax.effects_barrier()
         summarize_xplane(args.trace)
     return 0
 

@@ -105,6 +105,76 @@ def test_llama_training_step():
     assert float(last["loss"]) < float(first["loss"])
 
 
+def test_chunked_loss_matches_full():
+    """llama_loss_chunked streams head+xent over seq chunks (never
+    materializing full f32 logits) — same math as llama_loss up to
+    summation order: loss, metrics AND grads must agree.  Also covers
+    a REAL multi-chunk split (seq 33 -> S-1 = 32 tiles n_chunks=8, so
+    lax.map runs 8 chunks — the reshape/summation under test), plus
+    the divisor fallback and a full Trainer step on the chunked path
+    (sharded, jitted, mode= kwargs threading)."""
+
+    import functools
+
+    from tf_operator_tpu.models import llama_loss_chunked
+
+    mesh = make_mesh({"dp": 8})
+    rng = np.random.RandomState(0)
+    ids = _ids(rng, 8, 33)
+    batch = {"input_ids": ids}
+    model = llama_tiny(vocab_size=VOCAB, max_len=64, mesh=mesh)
+    tr = Trainer(
+        model,
+        TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+        mesh,
+        llama_loss,
+        batch,
+        init_args=(ids,),
+        shardings="logical",
+    )
+    key = jax.random.PRNGKey(0)
+    lf, auxf = llama_loss(tr.state.params, tr.state, batch, key, train=False)
+    lc, auxc = llama_loss_chunked(
+        tr.state.params, tr.state, batch, key, train=False
+    )
+    np.testing.assert_allclose(float(lf), float(lc), rtol=1e-4)
+    # divisor fallback: n_chunks=7 doesn't tile S-1=32 -> drops to 4
+    lc7, _ = llama_loss_chunked(
+        tr.state.params, tr.state, batch, key, train=False, n_chunks=7
+    )
+    np.testing.assert_allclose(float(lf), float(lc7), rtol=1e-4)
+    np.testing.assert_allclose(
+        float(auxf["metrics"]["token_accuracy"]),
+        float(auxc["metrics"]["token_accuracy"]),
+        rtol=1e-6,
+    )
+    gf = jax.grad(
+        lambda p: llama_loss(p, tr.state, batch, key, train=False)[0]
+    )(tr.state.params)
+    gc = jax.grad(
+        lambda p: llama_loss_chunked(p, tr.state, batch, key, train=False)[0]
+    )(tr.state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(gf), jax.tree_util.tree_leaves(gc)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-3, atol=2e-3,
+        )
+    # the chunked loss must drive a full (jitted, sharded) train step
+    tr2 = Trainer(
+        model,
+        TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+        mesh,
+        functools.partial(llama_loss_chunked, n_chunks=4),
+        batch,
+        init_args=(ids,),
+        shardings="logical",
+    )
+    first = tr2.train_step(tr2.shard_batch(batch))
+    for _ in range(5):
+        last = tr2.train_step(tr2.shard_batch(batch))
+    assert float(last["loss"]) < float(first["loss"])
+
+
 @pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
 def test_llama_sp_matches_no_sp(sp_impl):
     """RoPE + GQA must compose exactly with both sp schedules."""

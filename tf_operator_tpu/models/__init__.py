@@ -16,7 +16,13 @@ from tf_operator_tpu.models.decode import (
     generate,
     init_cache,
 )
-from tf_operator_tpu.models.llama import LlamaLM, llama_7b_shape, llama_loss, llama_tiny
+from tf_operator_tpu.models.llama import (
+    LlamaLM,
+    llama_7b_shape,
+    llama_loss,
+    llama_loss_chunked,
+    llama_tiny,
+)
 from tf_operator_tpu.models.mnist import MnistCNN
 from tf_operator_tpu.models.pipelined_lm import PipelinedLM, lm_reference_apply
 from tf_operator_tpu.models.moe import MoeConfig, MoeLM, moe_lm_loss, moe_tiny

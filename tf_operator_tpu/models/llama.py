@@ -20,7 +20,10 @@ from __future__ import annotations
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+import optax
+from jax import lax
 
 from tf_operator_tpu.models.transformer import (
     ACT_HIDDEN,
@@ -46,8 +49,31 @@ class LlamaLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, input_ids, *, train: bool = False):
+    def __call__(self, input_ids, *, train: bool = False, mode: str = "full"):
+        """mode="full": ids -> f32 logits (the default contract).
+        mode="hidden": ids -> post-ln_final hidden states [B, S, D]
+        (the lm_head is not applied).  mode="head": input_ids is
+        ALREADY a hidden-state tensor; apply only the lm_head.  The
+        split modes exist for llama_loss_chunked, which streams the
+        vocab projection + cross-entropy over sequence chunks so the
+        [B, S, vocab] f32 logits tensor is never materialized (the
+        trace of the 0.69-MFU wide step shows the fp32 vocab tier as
+        the largest op cluster — benchmarks/PROFILE.md)."""
+
+        if mode not in ("full", "hidden", "head"):
+            raise ValueError(f"mode must be full|hidden|head, got {mode!r}")
         cfg = self.cfg
+        head = QDenseGeneral(
+            cfg.vocab_size,
+            use_bias=False,
+            dtype=cfg.dtype,
+            kernel_init=param_with_axes(
+                nn.initializers.normal(0.02), ("embed", "vocab")
+            ),
+            name="lm_head",
+        )
+        if mode == "head":
+            return head(input_ids).astype(jnp.float32)
         x = Embed(cfg, name="tok_embed")(input_ids)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         x = logical_constraint(x, ACT_HIDDEN)
@@ -56,17 +82,10 @@ class LlamaLM(nn.Module):
                 x, train=train
             )
         x = LayerNorm(cfg, rms=True, name="ln_final")(x)
+        if mode == "hidden":
+            return x
         # untied head (llama convention), vocab on the tp axis
-        logits = QDenseGeneral(
-            cfg.vocab_size,
-            use_bias=False,
-            dtype=cfg.dtype,
-            kernel_init=param_with_axes(
-                nn.initializers.normal(0.02), ("embed", "vocab")
-            ),
-            name="lm_head",
-        )(x)
-        return logits.astype(jnp.float32)
+        return head(x).astype(jnp.float32)
 
 
 def llama_tiny(
@@ -122,3 +141,54 @@ def llama_7b_shape(vocab_size: int = 32000, max_len: int = 4096, mesh=None, **kw
 # next-token cross-entropy: identical contract and math to the GPT
 # family's loss — one implementation, re-exported under the family name
 from tf_operator_tpu.models.gpt import lm_loss as llama_loss  # noqa: E402
+
+
+def llama_loss_chunked(
+    params, state, batch, rng, train: bool = True, *, n_chunks: int = 8
+):
+    """Next-token loss with the vocab projection + cross-entropy
+    streamed over sequence chunks (Trainer loss_fn contract, drop-in
+    for llama_loss).
+
+    Why: the full-logits path materializes an f32 [B, S, vocab] tensor
+    (~1 GB at the wide bench shape) and its bwd reads it back — the
+    trace of the 0.69-MFU step shows this fp32 vocab tier as the
+    largest op cluster (PROFILE.md).  Here each chunk computes its
+    logits + loss under jax.checkpoint, so only the chunk's hidden
+    states are saved for the backward and the full logits tensor never
+    exists; the checkpoint recomputes one chunk's head matmul in bwd —
+    MXU flops traded for HBM round trips, and the freed memory is what
+    lets bigger batches fit without remat.
+
+    Exact same math as llama_loss up to summation order (parity test:
+    tests/test_llama.py::test_chunked_loss_matches_full)."""
+
+    ids = batch["input_ids"]
+    h = state.apply_fn(
+        {"params": params}, ids, train=train, rngs={"dropout": rng},
+        mode="hidden",
+    )
+    h = h[:, :-1]
+    tgt = ids[:, 1:]
+    b, s, _ = h.shape
+    c = max(1, min(n_chunks, s))
+    while s % c:  # largest chunk count <= n_chunks that tiles S-1
+        c -= 1
+    hc = h.reshape(b, c, s // c, -1).swapaxes(0, 1)  # [C, B, s/C, D]
+    tc = tgt.reshape(b, c, s // c).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def one(args):
+        hcc, tcc = args
+        logits = state.apply_fn({"params": params}, hcc, mode="head")
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, tcc
+        ).sum()
+        acc = (logits.argmax(-1) == tcc).sum()
+        return loss, acc
+
+    losses, accs = lax.map(one, (hc, tc))
+    denom = b * s
+    return losses.sum() / denom, {
+        "metrics": {"token_accuracy": accs.sum() / denom}
+    }
