@@ -33,7 +33,9 @@ Env knobs: BENCH_TOTAL_BUDGET, BENCH_BATCH_PER_CHIP (default: autotune
 256/128/64), BENCH_STEPS, BENCH_RETRIES, BENCH_CHILD_TIMEOUT,
 BENCH_LLAMA_TIMEOUT, BENCH_PROBE_TIMEOUT, BENCH_PLATFORM (e.g. cpu for
 a smoke run), BENCH_PEAK_TFLOPS (MFU denominator override),
-BENCH_PIPELINE=0, BENCH_LLAMA=0, BENCH_QUANT=0 to skip sections.
+BENCH_PIPELINE=0, BENCH_LLAMA=0, BENCH_QUANT=0, BENCH_WIDE_DECODE=0 to
+skip sections (wide decode also self-skips past
+BENCH_WIDE_DECODE_CUTOFF seconds of llama-child elapsed, default 240).
 """
 
 from __future__ import annotations
@@ -389,6 +391,8 @@ def run_llama() -> dict:
     (VERDICT r3 item 3).  Mirrors measure.py --section train's config so
     the BASELINE.md row and the BENCH artifact agree."""
 
+    child_t0 = time.perf_counter()
+
     import jax
 
     platform = os.environ.get("BENCH_PLATFORM")
@@ -439,22 +443,45 @@ def run_llama() -> dict:
         out["llama_mfu_xla"] = round(
             flops_xla * stats["steps_per_sec"] / peak, 4
         )
-    # steady-state greedy decode with the live sharded params (jitted
-    # once; the second call is the steady-state number)
+    # steady-state greedy decode.  Slope timing (two windows, shared
+    # with every decode row below): the fixed dispatch/RTT cost rides
+    # on every call over this tunnel, so single-call timing both
+    # understates tok/s and compresses any weights-dtype ratio toward
+    # 1 (the fdad200 lesson — "the old one-window numbers were
+    # dispatch-bound").
+    def timed_decode(gen_fn, rows: int, n_new: int) -> float:
+        np.asarray(gen_fn())  # compile + settle
+
+        def window(k):
+            for _ in range(k):
+                res = gen_fn()
+            np.asarray(res)
+            return None
+
+        t0 = time.perf_counter()
+        window(1)
+        t1 = time.perf_counter()
+        window(3)
+        t2 = time.perf_counter()
+        dt = max(1e-9, ((t2 - t1) - (t1 - t0)) / 2)
+        return rows * n_new / dt
+
     prompt = lm["input_ids"][:8, :16]
     rows = prompt.shape[0]  # may be < 8 on small smoke batches
     n_new = 64
-    np.asarray(trainer.generate(prompt, max_new_tokens=n_new))  # compile
-    t0 = time.perf_counter()
-    np.asarray(trainer.generate(prompt, max_new_tokens=n_new))
-    dt = time.perf_counter() - t0
-    out["llama_decode_tokens_per_sec"] = round(rows * n_new / dt, 1)
+    out["llama_decode_tokens_per_sec"] = round(
+        timed_decode(
+            lambda: trainer.generate(prompt, max_new_tokens=n_new),
+            rows, n_new,
+        ), 1,
+    )
+    from tf_operator_tpu.models import generate as raw_generate
+
     if os.environ.get("BENCH_QUANT", "1") != "0":
         # int8 weights-only decode (ops/quant.py): same greedy program
         # with the quantized tree — decode at batch 8 is weight-
         # bandwidth-bound, so int8 weights should approach 2x
         try:
-            from tf_operator_tpu.models import generate as raw_generate
             from tf_operator_tpu.ops.quant import quantize_tree
 
             qparams = quantize_tree(trainer.state.params)
@@ -463,15 +490,63 @@ def run_llama() -> dict:
                     trainer.model, q, ids, max_new_tokens=n_new
                 )
             )
-            np.asarray(jit_gen(qparams, prompt))  # compile
-            t0 = time.perf_counter()
-            np.asarray(jit_gen(qparams, prompt))
-            dt = time.perf_counter() - t0
             out["llama_decode_int8_tokens_per_sec"] = round(
-                rows * n_new / dt, 1
+                timed_decode(lambda: jit_gen(qparams, prompt), rows, n_new),
+                1,
             )
         except Exception as exc:  # measurement is additive, never fatal
             out["llama_decode_int8_error"] = repr(exc)[:200]
+    if os.environ.get("BENCH_WIDE_DECODE", "1") != "0":
+        # the int8 economics only show at width: mini's batch-8 decode
+        # reads weights for ~60% of its step so int8 barely moves it,
+        # while the ~700M wide model is squarely weight-bandwidth-bound
+        # at batch 1 (PROFILE.md "int8 decode").  Put that ratio in the
+        # driver artifact: batch-1 greedy, bf16-STORED weights vs int8
+        # weights-only.  Guarded by the child's own elapsed clock so a
+        # slow window loses only this section, never the rows above.
+        elapsed = time.perf_counter() - child_t0
+        if elapsed > float(os.environ.get("BENCH_WIDE_DECODE_CUTOFF", "240")):
+            out["llama_wide_decode_error"] = (
+                f"skipped: llama child at {elapsed:.0f}s, cutoff 240s"
+            )
+            return out
+        try:
+            from tf_operator_tpu.models import LlamaLM as _LM
+            from tf_operator_tpu.ops.quant import quantize_tree
+
+            wcfg = llama_wide_config(256)
+            wmodel = _LM(wcfg)
+            wprompt = jnp.asarray(
+                np.random.RandomState(1).randint(0, 32000, size=(1, 16)),
+                jnp.int32,
+            )
+            wparams = wmodel.init(jax.random.PRNGKey(0), wprompt)["params"]
+            # flax init stores f32; the honest baseline stores bf16 —
+            # fp32-stored weights would double the baseline's HBM
+            # traffic and overstate the int8 ratio
+            wparams = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.bfloat16), wparams
+            )
+            wq = quantize_tree(wparams)
+            n_new_w = 64
+
+            def wide_tps(ps):
+                fn = jax.jit(
+                    lambda q, ids: raw_generate(
+                        wmodel, q, ids, max_new_tokens=n_new_w
+                    )
+                )
+                return timed_decode(lambda: fn(ps, wprompt), 1, n_new_w)
+
+            bf16_tps = wide_tps(wparams)
+            int8_tps = wide_tps(wq)
+            out["llama_wide_decode_tokens_per_sec"] = round(bf16_tps, 1)
+            out["llama_wide_decode_int8_tokens_per_sec"] = round(int8_tps, 1)
+            out["llama_wide_decode_int8_speedup"] = round(
+                int8_tps / bf16_tps, 2
+            )
+        except Exception as exc:  # additive, never fatal
+            out["llama_wide_decode_error"] = repr(exc)[:200]
     return out
 
 

@@ -174,6 +174,18 @@ def write_last_measured(data: dict, today: str) -> None:
         "llama_decode_int8_tokens_per_sec",
         b.get("llama_decode_int8_tokens_per_sec"), "bench.out",
     )
+    put(
+        "llama_wide_decode_tokens_per_sec",
+        b.get("llama_wide_decode_tokens_per_sec"), "bench.out",
+    )
+    put(
+        "llama_wide_decode_int8_tokens_per_sec",
+        b.get("llama_wide_decode_int8_tokens_per_sec"), "bench.out",
+    )
+    put(
+        "llama_wide_decode_int8_speedup",
+        b.get("llama_wide_decode_int8_speedup"), "bench.out",
+    )
     t = data.get("train", {})
     put("mnist_steps_per_sec_per_chip",
         t.get("mnist_steps_per_sec_per_chip"), "train.out")
@@ -268,6 +280,19 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
                 "| llama-mini steady decode tokens/sec (KV-cache greedy, "
                 "batch 8) | "
                 f"**{b['llama_decode_tokens_per_sec']} tok/s**{int8_txt} "
+                f"| 1× v5 lite, `bench.py` → `window_out/bench.out`, {today} |"
+            )
+        if b.get("llama_wide_decode_int8_speedup"):
+            rows["Wide-llama (~700M) int8 decode"] = (
+                "| Wide-llama (~700M) int8 decode (batch-1 greedy — the "
+                "weight-bandwidth-bound case int8 exists for; mini's "
+                "batch-8 step is only ~60% weight reads, see "
+                "PROFILE.md \"int8 decode\") | "
+                f"bf16 {b.get('llama_wide_decode_tokens_per_sec', '?')} "
+                f"tok/s → int8 "
+                f"{b.get('llama_wide_decode_int8_tokens_per_sec', '?')} "
+                f"tok/s — **{b['llama_wide_decode_int8_speedup']}×** "
+                f"(`ops/quant.py` QTensor-direct) "
                 f"| 1× v5 lite, `bench.py` → `window_out/bench.out`, {today} |"
             )
     t = data.get("train")

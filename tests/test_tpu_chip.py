@@ -177,7 +177,12 @@ class TestBenchSmoke:
         import sys
 
         env = dict(os.environ)
-        env.update(BENCH_BATCH_PER_CHIP="16", BENCH_STEPS="3", BENCH_RETRIES="1")
+        env.update(
+            BENCH_BATCH_PER_CHIP="16", BENCH_STEPS="3", BENCH_RETRIES="1",
+            # tiny smoke: the full-size ~700M wide-decode probe has no
+            # place in it
+            BENCH_WIDE_DECODE="0",
+        )
         out = subprocess.run(
             [sys.executable, os.path.join(os.path.dirname(__file__), "..", "bench.py")],
             env=env, capture_output=True, text=True, timeout=1200,
