@@ -21,6 +21,7 @@ Under the operator: examples/manifests/llama_pretrain.yaml
 
 from __future__ import annotations
 
+import functools
 import sys
 
 from tf_operator_tpu.runtime import initialize
@@ -45,6 +46,12 @@ def main() -> int:
     parser.add_argument("--sp-impl", choices=["ring", "ulysses"], default="ring")
     parser.add_argument("--generate", type=int, default=48, help="tokens to sample after training")
     parser.add_argument(
+        "--chunked-loss", type=int, default=0, metavar="N",
+        help="stream the vocab projection + cross-entropy over N "
+        "sequence chunks (llama_loss_chunked) — the memory knob for "
+        "big-batch/long-seq runs; 0 = full-logits loss",
+    )
+    parser.add_argument(
         "--export-dir", default="",
         help="write a params-only serving artifact here after training "
              "(consume with examples/serve_lm.py)",
@@ -62,6 +69,7 @@ def main() -> int:
     from tf_operator_tpu.models import (
         generate,
         llama_loss,
+        llama_loss_chunked,
         llama_tiny,
         moe_lm_loss,
         moe_tiny,
@@ -107,7 +115,10 @@ def main() -> int:
         model = llama_tiny(
             vocab_size=256, max_len=args.seq_len, mesh=mesh, sp_impl=args.sp_impl
         )
-        loss_fn = llama_loss
+        loss_fn = (
+            functools.partial(llama_loss_chunked, n_chunks=args.chunked_loss)
+            if args.chunked_loss else llama_loss
+        )
         tag = f"llama bytes fsdp={shape['fsdp']} sp={args.sp}({args.sp_impl})"
     trainer = Trainer(
         model,
