@@ -261,8 +261,8 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             rows["llama-mini train tokens/sec/chip"] = (
                 "| llama-mini train tokens/sec/chip (~120M, RoPE+GQA "
                 "16q:4kv+SwiGLU, seq 1024, bf16, auto attention — the "
-                "block-keyed crossover picks flash 512x512 here, the "
-                "r5 completion-pass winner at every measured shape) | "
+                "block-keyed crossover picks flash 1024x1024 here, the "
+                "r5 autotune winner at every shape it tiles) | "
                 f"**{b['llama_train_tokens_per_sec_per_chip']} tok/s/chip**, "
                 f"step {b.get('llama_step_ms', '?')} ms, mfu_analytic "
                 f"{b.get('llama_mfu_analytic', '?')} / mfu_xla "

@@ -223,6 +223,36 @@ WIDE_XOVER6 = [
 ]
 
 
+
+#: 1024-block pass: the 128->256->512 win was monotone, so keep going.
+#: 1024x1024 wins everywhere it tiles (committed artifact: mini
+#: s1024 +8%, s2048 +9%, s4096 +19%; wide s1024 +2%, s2048 +3%,
+#: s4096 +7% at 25.4k tok/s); 2048x2048 is past the VMEM wall
+#: (pallas stack alloc 30.85M vs the 16M scoped limit — and the
+#: compile-helper's "unexpected worker hostname" noise accompanies
+#: that OOM, explaining the wide-s2048 XLA "infra" crashes too).
+WIDE_XOVER7 = [
+    ("wx7-mini-s1024-b1024",
+     ["--seq", "1024", "--batch", "8"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "1024", "TPU_OPERATOR_FLASH_BLOCK_K": "1024"}),
+    ("wx7-mini-s2048-b1024",
+     ["--seq", "2048", "--batch", "4"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "1024", "TPU_OPERATOR_FLASH_BLOCK_K": "1024"}),
+    ("wx7-mini-s4096-b1024",
+     ["--seq", "4096", "--batch", "2"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "1024", "TPU_OPERATOR_FLASH_BLOCK_K": "1024"}),
+    ("wx7-wide-s1024-b1024",
+     ["--model", "wide", "--seq", "1024", "--batch", "4"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "1024", "TPU_OPERATOR_FLASH_BLOCK_K": "1024"}),
+    ("wx7-wide-s2048-b1024",
+     ["--model", "wide", "--seq", "2048", "--batch", "2"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "1024", "TPU_OPERATOR_FLASH_BLOCK_K": "1024"}),
+    ("wx7-wide-s4096-b1024",
+     ["--model", "wide", "--seq", "4096", "--batch", "1"],
+     {"TPU_OPERATOR_FLASH_BLOCK_Q": "1024", "TPU_OPERATOR_FLASH_BLOCK_K": "1024"}),
+]
+
+
 def run_one(label, extra, timeout, env_extra=None):
     cmd = [sys.executable, os.path.join(HERE, "profile_llama.py"), *extra]
     env = dict(os.environ, **(env_extra or {}))
@@ -268,7 +298,8 @@ def main():
     ap.add_argument(
         "--set", default="main",
         choices=["main", "wide", "wide-xover", "wide-xover2", "wide-xover3",
-                 "wide-xover4", "wide-xover5", "wide-xover6"],
+                 "wide-xover4", "wide-xover5", "wide-xover6",
+                 "wide-xover7"],
         help="main = the llama-mini variant/autotune matrix; wide = the "
         "~700M existence-proof shapes (their own window step); "
         "wide-xover = the D=128 head-dim flash/XLA crossover matrix; "
@@ -280,6 +311,7 @@ def main():
     matrix = {
         "wide": WIDE, "wide-xover": WIDE_XOVER, "wide-xover2": WIDE_XOVER2,
         "wide-xover3": WIDE_XOVER3, "wide-xover4": WIDE_XOVER4, "wide-xover5": WIDE_XOVER5, "wide-xover6": WIDE_XOVER6,
+        "wide-xover7": WIDE_XOVER7,
     }.get(args.set, MATRIX)
     if args.quick:
         matrix = matrix[:2]  # first two of the SELECTED set

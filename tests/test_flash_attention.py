@@ -444,9 +444,9 @@ class TestDefaultBlockEnv:
 
         monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_Q", raising=False)
         monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_K", raising=False)
-        # r5 default: the completion-pass autotune winner at every
-        # measured shape (see default_flash_blocks)
-        assert default_flash_blocks() == (512, 512)
+        # r5 default: the autotune winner at every measured shape —
+        # and the VMEM ceiling (see default_flash_blocks)
+        assert default_flash_blocks() == (1024, 1024)
         monkeypatch.setenv("TPU_OPERATOR_FLASH_BLOCK_Q", "128")
         monkeypatch.setenv("TPU_OPERATOR_FLASH_BLOCK_K", "512")
         assert default_flash_blocks() == (128, 512)
@@ -518,7 +518,7 @@ class TestDefaultBlockEnv:
 
     def test_attention_resolves_shrunken_blocks(self, monkeypatch):
         """attention() shrinks unpinned default dims until they tile
-        (seq 1152: 512→256→128) and hands the RESOLVED blocks to the
+        (seq 1152: 1024→512→256→128) and hands the RESOLVED blocks to the
         dispatcher, so the crossover sees what will actually run."""
 
         import importlib

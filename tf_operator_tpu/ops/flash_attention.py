@@ -32,13 +32,15 @@ shard other attention dims fall back.
 Env knobs — note the three-state semantics of TPU_OPERATOR_FLASH:
   unset / ""  auto: the measured seq crossover decides.  The floor is
               keyed to the kernel blocks in use (r5 block-autotune,
-              window_out/wide-xover*.out): with the 512x512 defaults
-              flash wins from seq 512 up on both head dims (1.11-2.3x
-              over XLA-fused), so the floor is 512; shapes whose
-              blocks shrank to 256 keep that class's measured floor
-              (256 at head dim >= 128 where it still wins, 1024 at
-              D=64 where XLA takes short seqs), and 128x128 keeps
-              2048.
+              window_out/wide-xover*.out): the default blocks are
+              1024x1024 (the monotone autotune winner AND the VMEM
+              ceiling), shrunk per-dim until they tile; 512-class and
+              above win from seq 512 on both head dims (1.11-2.3x
+              over XLA-fused, growing with seq), so their floor is
+              512; shapes whose blocks shrank to 256 keep that class's
+              measured floor (256 at head dim >= 128 where it still
+              wins, 1024 at D=64 where XLA takes short seqs), and
+              128x128 keeps 2048.
               TPU_OPERATOR_FLASH_MIN_SEQ overrides the floor.
   "0"         disable the kernel globally.
   any other   FORCE flash wherever it applies, crossover ignored.
@@ -554,7 +556,7 @@ def flash_attention(
     """Flash attention over [B, H, S, D].  Sq % block_q == Sk % block_k
     == 0 required (dispatch checks this; call `attention` instead).
     ``block_q``/``block_k``: None (default) takes the measured-winner
-    defaults (default_flash_blocks — 512x512, env-overridable), shrunk
+    defaults (default_flash_blocks — 1024x1024, env-overridable), shrunk
     per-dim until they tile the sequence; explicit values are used
     exactly as given.
     ``window``: sliding-window local attention (requires causal) —
@@ -741,22 +743,25 @@ def default_flash_blocks() -> tuple:
     """Kernel block sizes used when the caller doesn't pick:
     TPU_OPERATOR_FLASH_BLOCK_Q / _BLOCK_K env overrides (the
     benchmarks/llama_sweep.py autotune matrices set these per variant),
-    else 512x512 — the r5 completion-pass winner at EVERY measured
-    training shape on both head dims (window_out/wide-xover{,2,3}.out,
-    llama fwd+bwd tok/s/chip vs the best previously-known path):
-      mini D=64:  s1024 110.6k (vs 67.7k XLA/256-block tie, 1.63x),
-                  s2048 93.0k (vs 58.7k), s4096 60.5k@bk512 (vs 37.8k)
-      wide D=128: s1024 30.1k mfu 0.603 (vs 23.3k XLA), s2048 28.2k,
-                  s4096 23.8k mfu 0.530 (vs 10.3k XLA, 2.3x)
-    Bigger K blocks = fewer grid steps and longer in-VMEM inner loops;
-    the win is monotone 128→256→512 everywhere measured.  VMEM still
-    fits at every supported head dim (two 512x128 bf16 K/V blocks,
-    double-buffered, + fp32 carries ≈ 1.5 MB).  Shapes that don't tile
-    512 shrink per-dim to 256/128 in `attention()`."""
+    else 1024x1024 — the win is monotone in block size at EVERY
+    measured training shape on both head dims, 128→256→512→1024
+    (window_out/wide-xover*.out; fwd+bwd tok/s/chip at 1024 blocks vs
+    the 512-block pass):
+      mini D=64:  s1024 119.6k (+8%), s2048 101.6k (+9%),
+                  s4096 82.9k (+19%)
+      wide D=128: s1024 30.8k mfu 0.616 (+2%), s2048 29.1k (+3%),
+                  s4096 25.4k mfu 0.566 (+7%)
+    Bigger blocks = fewer grid steps, longer in-VMEM inner loops,
+    fewer K/V re-streams.  1024 is also the VMEM ceiling: 2048-class
+    blocks blow the 16 MB scoped-vmem limit (measured: pallas stack
+    alloc 30.85M at D=64 s2048 — and that compile-helper OOM surfaces
+    as the misleading "unexpected worker hostname" error).  Shapes
+    that don't tile 1024 shrink per-dim to 512/256/128 in
+    resolve_flash_blocks, keeping each class's measured floor."""
 
     return (
-        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_Q", "512")),
-        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_K", "512")),
+        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_Q", "1024")),
+        int(os.environ.get("TPU_OPERATOR_FLASH_BLOCK_K", "1024")),
     )
 
 
@@ -764,7 +769,7 @@ def resolve_flash_blocks(
     block_q: Optional[int], block_k: Optional[int], sq: int, sk: int
 ) -> tuple:
     """Fill unpinned block dims from default_flash_blocks(), shrinking
-    each BUILT-IN default per-dim (512→256→128) until it tiles the
+    each BUILT-IN default per-dim (1024→512→256→128) until it tiles the
     given q/k sequence lengths.  Caller-pinned dims and BLOCK_Q/_K env
     pins are never adjusted (a sweep must measure exactly what it set).
     Used everywhere blocks default: `attention()` (whose auto-crossover
