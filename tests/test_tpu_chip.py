@@ -117,8 +117,11 @@ class TestFlashKernelOnChip:
             f"\nflash fwd+bwd @4k: {t_flash*1e3:.1f}ms  xla: {t_xla*1e3:.1f}ms  "
             f"speedup {t_xla/t_flash:.2f}x"
         )
-        assert t_flash < 0.9 * t_xla, (
-            f"flash {t_flash*1e3:.1f}ms !< 0.9*xla {t_xla*1e3:.1f}ms"
+        # bar raised with the r5 block autotune: the 1024-block kernel
+        # measures 4.3-5.9x here; <2.5x would be a real regression
+        # (the pre-autotune 128-block kernel scored 1.17x)
+        assert t_flash < 0.4 * t_xla, (
+            f"flash {t_flash*1e3:.1f}ms !< 0.4*xla {t_xla*1e3:.1f}ms"
         )
 
 
@@ -257,4 +260,6 @@ class TestWindowAttentionOnChip:
             f"\nwindowed fwd+bwd @8k/w1k: {t_win*1e3:.1f}ms  "
             f"full: {t_full*1e3:.1f}ms  speedup {t_full/t_win:.2f}x"
         )
-        assert t_win < 0.7 * t_full  # the banding must actually pay
+        # the banding must actually pay; bar raised with the r5 block
+        # autotune (measured 2.7-5x depending on full-flash defaults)
+        assert t_win < 0.55 * t_full
