@@ -253,6 +253,18 @@ WIDE_XOVER7 = [
 ]
 
 
+
+#: longest-context row: does the ~0.57-MFU long-seq plateau hold at 8k?
+WIDE_XOVER8 = [
+    ("wx8-wide-s8192-b1",
+     ["--model", "wide", "--seq", "8192", "--batch", "1"]),
+    ("wx8-wide-s8192-b1-remat",
+     ["--model", "wide", "--seq", "8192", "--batch", "1", "--remat"]),
+    ("wx8-mini-s8192-b1",
+     ["--seq", "8192", "--batch", "1"]),
+]
+
+
 def run_one(label, extra, timeout, env_extra=None):
     cmd = [sys.executable, os.path.join(HERE, "profile_llama.py"), *extra]
     env = dict(os.environ, **(env_extra or {}))
@@ -299,7 +311,7 @@ def main():
         "--set", default="main",
         choices=["main", "wide", "wide-xover", "wide-xover2", "wide-xover3",
                  "wide-xover4", "wide-xover5", "wide-xover6",
-                 "wide-xover7"],
+                 "wide-xover7", "wide-xover8"],
         help="main = the llama-mini variant/autotune matrix; wide = the "
         "~700M existence-proof shapes (their own window step); "
         "wide-xover = the D=128 head-dim flash/XLA crossover matrix; "
@@ -311,7 +323,7 @@ def main():
     matrix = {
         "wide": WIDE, "wide-xover": WIDE_XOVER, "wide-xover2": WIDE_XOVER2,
         "wide-xover3": WIDE_XOVER3, "wide-xover4": WIDE_XOVER4, "wide-xover5": WIDE_XOVER5, "wide-xover6": WIDE_XOVER6,
-        "wide-xover7": WIDE_XOVER7,
+        "wide-xover7": WIDE_XOVER7, "wide-xover8": WIDE_XOVER8,
     }.get(args.set, MATRIX)
     if args.quick:
         matrix = matrix[:2]  # first two of the SELECTED set
