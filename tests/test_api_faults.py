@@ -1,0 +1,453 @@
+"""API fault tolerance, end to end over the HTTP transport.
+
+tests/test_chaos.py proves the level-triggered convergence property for
+the in-proc FakeCluster by dropping *watch events*; this suite extends
+it to the production-shaped path — controller → retrying HTTP clients
+(backend/kube.py, backend/kubejobs.py, cmd/leader.py) → MiniApiServer
+with a FaultInjector (backend/kubesim.py) throwing 5xx/429/Retry-After,
+connection resets, latency, and watch 410 storms at every layer.
+
+Everything here is deterministic: seeded fault schedules, seeded retry
+jitter.  The convergence test is the acceptance gate from ISSUE 1: a
+≥10% fault rate on ALL routes must not lose a job, a pod, or an
+exception.
+"""
+
+import json
+import random
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tests.testutil import new_job
+from tf_operator_tpu.api.types import JobConditionType, PodPhase, SuccessPolicy
+from tf_operator_tpu.backend.kube import ApiError, KubeBackend, http_json
+from tf_operator_tpu.backend.kubejobs import KubeEventRecorder, KubeJobStore
+from tf_operator_tpu.backend.kubesim import MiniApiServer
+from tf_operator_tpu.backend.retry import RetryPolicy
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.controller.reconciler import ReconcilerConfig
+from tf_operator_tpu.utils.metrics import Metrics
+
+EXIT0 = [sys.executable, "-c", "raise SystemExit(0)"]
+SLEEP = [sys.executable, "-c", "import time; time.sleep(600)"]
+
+
+def fast_policy(seed=0, **kw):
+    kw.setdefault("max_attempts", 6)
+    kw.setdefault("base_delay", 0.02)
+    kw.setdefault("max_delay", 0.2)
+    kw.setdefault("deadline", 5.0)
+    return RetryPolicy(rng=random.Random(seed), **kw)
+
+
+def wait_until(cond, timeout=15.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(what)
+
+
+class TestFaultInjector:
+    """The injector itself: per-route/per-verb targeting, shot counts,
+    Retry-After on the wire, latency, resets, and the admin endpoint."""
+
+    @pytest.fixture
+    def sim(self):
+        s = MiniApiServer(fault_seed=0).start()
+        yield s
+        s.stop()
+
+    def _get_status(self, sim, path):
+        req = urllib.request.Request(sim.url + path)
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers)
+
+    def test_error_mode_targets_route_and_verb(self, sim):
+        sim.faults.add(
+            path=r"/api/v1/pods", methods=["GET"], mode="error",
+            status=503, retry_after=1.5,
+        )
+        code, headers = self._get_status(sim, "/api/v1/pods")
+        assert code == 503
+        assert headers.get("Retry-After") == "1.5"
+        # other routes and other verbs are untouched
+        code, _ = self._get_status(sim, "/api/v1/services")
+        assert code == 200
+        out = http_json(
+            sim._httpd.server_address[0], sim._httpd.server_address[1],
+            "POST", "/api/v1/namespaces/default/pods",
+            {"metadata": {"name": "p1"}, "spec": {}},
+        )
+        assert out["metadata"]["name"] == "p1"
+
+    def test_shot_count_bounds_injection(self, sim):
+        sim.faults.add(path=r"/api/v1/pods", mode="error", status=500, times=2)
+        assert self._get_status(sim, "/api/v1/pods")[0] == 500
+        assert self._get_status(sim, "/api/v1/pods")[0] == 500
+        assert self._get_status(sim, "/api/v1/pods")[0] == 200
+        assert sim.faults.total_injected() == 2
+
+    def test_latency_mode_delays_then_serves(self, sim):
+        sim.faults.add(path=r"/api/v1/pods", mode="latency", delay=0.3, times=1)
+        t0 = time.time()
+        code, _ = self._get_status(sim, "/api/v1/pods")
+        assert code == 200
+        assert time.time() - t0 >= 0.3
+
+    def test_reset_mode_breaks_the_connection(self, sim):
+        sim.faults.add(path=r"/api/v1/pods", mode="reset", times=1)
+        host, port = sim._httpd.server_address[:2]
+        with pytest.raises(OSError):
+            # ConnectionResetError or a half-closed-socket HTTPException
+            # subclassing OSError — either way, a transport failure
+            http_json(host, port, "GET", "/api/v1/pods")
+        # next request is clean
+        assert self._get_status(sim, "/api/v1/pods")[0] == 200
+
+    def test_watch_gone_storm_rule(self, sim):
+        sim.faults.add(
+            path=r"watch=true", mode="error", status=410, times=1
+        )
+        code, _ = self._get_status(
+            sim, "/api/v1/pods?watch=true&resourceVersion=1"
+        )
+        assert code == 410
+        # plain (non-watch) list is untouched by the storm rule
+        assert self._get_status(sim, "/api/v1/pods")[0] == 200
+
+    def test_admin_endpoint_add_list_clear(self, sim):
+        req = urllib.request.Request(
+            sim.url + "/_faults",
+            data=json.dumps(
+                {"path": r"/api/v1/pods", "mode": "error", "status": 503,
+                 "retryAfter": 0.5, "times": 1}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 201
+            rule = json.loads(resp.read())
+        assert rule["status"] == 503 and rule["retryAfter"] == 0.5
+        assert self._get_status(sim, "/api/v1/pods")[0] == 503
+        with urllib.request.urlopen(sim.url + "/_faults", timeout=5) as resp:
+            rules = json.loads(resp.read())["rules"]
+        assert len(rules) == 1 and rules[0]["injected"] == 1
+        req = urllib.request.Request(
+            sim.url + "/_faults", method="DELETE"
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+        with urllib.request.urlopen(sim.url + "/_faults", timeout=5) as resp:
+            assert json.loads(resp.read())["rules"] == []
+
+    def test_admin_coerces_string_retry_after(self, sim):
+        """JSON clients send numbers as strings; the rule must coerce
+        at admission so the fault fires with a well-formed header."""
+
+        req = urllib.request.Request(
+            sim.url + "/_faults",
+            data=json.dumps(
+                {"path": r"/api/v1/pods", "mode": "error", "status": 429,
+                 "retryAfter": "1.5", "times": 1}
+            ).encode(),
+            method="POST",
+        )
+        assert urllib.request.urlopen(req, timeout=5).status == 201
+        code, headers = self._get_status(sim, "/api/v1/pods")
+        assert code == 429
+        assert headers.get("Retry-After") == "1.5"
+
+    def test_admin_rejects_bad_rule(self, sim):
+        req = urllib.request.Request(
+            sim.url + "/_faults",
+            data=json.dumps({"mode": "nonsense"}).encode(),
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+
+
+class TestRetrySmoke:
+    """Tier-1-safe fast smoke (deterministic seeds, sub-second): each
+    client layer rides out a short injected fault burst."""
+
+    def test_backend_rides_out_503_burst_with_retry_after(self):
+        sim = MiniApiServer(fault_seed=0).start()
+        m = Metrics()
+        b = KubeBackend(sim.url, retry=fast_policy(), metrics=m)
+        try:
+            sim.faults.add(
+                path=r"/api/v1/namespaces/default/pods", methods=["POST"],
+                mode="error", status=503, retry_after=0.01, times=2,
+            )
+            from tf_operator_tpu.api.types import Container, ObjectMeta
+            from tf_operator_tpu.backend.objects import Pod
+
+            b.create_pod(Pod(
+                metadata=ObjectMeta(name="p1", namespace="default"),
+                containers=[Container(command=list(SLEEP))],
+            ))
+            assert b.get_pod("default", "p1") is not None
+            assert m.counter(
+                "api_client_retries_total", client="kube-backend"
+            ) >= 2
+        finally:
+            b.close()
+            sim.stop()
+
+    def test_backend_rides_out_connection_resets(self):
+        sim = MiniApiServer(fault_seed=0).start()
+        m = Metrics()
+        b = KubeBackend(sim.url, retry=fast_policy(), metrics=m)
+        try:
+            sim.faults.add(
+                path=r"/api/v1/pods", methods=["GET"], mode="reset", times=2,
+            )
+            assert b.list_pods(None) == []  # /api/v1/pods, retried
+            assert m.counter(
+                "api_client_retries_total", client="kube-backend"
+            ) >= 2
+        finally:
+            b.close()
+            sim.stop()
+
+    def test_jobstore_rides_out_faults_and_exports_counters(self):
+        sim = MiniApiServer(fault_seed=0).start()
+        m = Metrics()
+        store = KubeJobStore(sim.url, retry=fast_policy(), metrics=m)
+        try:
+            sim.faults.add(
+                path=r"/apis/tpujob.dist", mode="error", status=429,
+                retry_after=0.01, times=3,
+            )
+            job = new_job("smoke", worker=1, command=EXIT0)
+            store.create(job)
+            assert store.get("default", "smoke") is not None
+            assert m.counter(
+                "api_client_retries_total", client="kube-jobs"
+            ) >= 3
+            # counters flow into the Prometheus exposition
+            assert "api_client_retries_total" in m.exposition()
+        finally:
+            store.close()
+            sim.stop()
+
+
+class TestCreateReplayAmbiguity:
+    def test_replayed_create_409_resolves_as_success_when_spec_matches(self):
+        """Against a real apiserver a create can commit while its
+        response is lost; the retry layer's replay then lands 409.
+        KubeJobStore.create must recognise 'the stored object is
+        exactly what I posted' as success — and still surface a
+        genuine conflict for a different pre-existing job."""
+
+        from tf_operator_tpu.backend.base import AlreadyExistsError
+
+        sim = MiniApiServer(fault_seed=0).start()
+        store = KubeJobStore(sim.url, retry=fast_policy())
+        try:
+            POST_RULE = dict(
+                path=r"/apis/tpujob\.dist/v1/namespaces/default/tpujobs$",
+                methods=["POST"], times=1,
+            )
+            job = new_job("dup", worker=2, command=EXIT0)
+            stored = store.create(job)
+            # a FIRST-ATTEMPT 409 (no replay) is a genuine duplicate
+            # submission and must stay a conflict, even spec-identical
+            with pytest.raises(AlreadyExistsError):
+                store.create(new_job("dup", worker=2, command=EXIT0))
+            # a retry after a DEFINITIVE error response (503 = the
+            # server answered, nothing committed) is not ambiguous
+            # either: the replayed 409 is still a real conflict
+            sim.faults.add(mode="error", status=503, **POST_RULE)
+            with pytest.raises(AlreadyExistsError):
+                store.create(new_job("dup", worker=2, command=EXIT0))
+            # the committed-but-response-LOST shape (connection reset,
+            # no response): the replay lands 409 and the stored spec
+            # matches what we posted → resolves as our own create
+            sim.faults.add(mode="reset", **POST_RULE)
+            replay = new_job("dup", worker=2, command=EXIT0)
+            again = store.create(replay)
+            assert again.metadata.uid == stored.metadata.uid
+            assert replay.metadata.uid == stored.metadata.uid
+            # lost-response replay against a DIFFERENT stored spec
+            # still surfaces the conflict
+            sim.faults.add(mode="reset", **POST_RULE)
+            with pytest.raises(AlreadyExistsError):
+                store.create(new_job("dup", worker=3, command=EXIT0))
+        finally:
+            store.close()
+            sim.stop()
+
+
+class TestWatchGoneRelist:
+    def test_kubejobs_watch_410_storm_relists_and_recovers(self):
+        """The untested path from ISSUE 1: KubeJobStore's ListAndWatch
+        must treat a watch-stream 410 as 'window expired', re-list,
+        and keep delivering — under a storm of them."""
+
+        sim = MiniApiServer(fault_seed=0).start()
+        m = Metrics()
+        store = KubeJobStore(sim.url, retry=fast_policy(), metrics=m)
+        try:
+            store.create(new_job("old", worker=1, command=SLEEP))
+            # every watch attempt 410s three times before one connects
+            sim.faults.add(
+                path=r"/apis/tpujob\.dist/v1/tpujobs\?watch=true",
+                mode="error", status=410, times=3,
+            )
+            seen = []
+            store.subscribe(lambda ev: seen.append(ev.obj.metadata.name))
+            # the pre-existing job arrives via the re-list replay...
+            wait_until(lambda: "old" in seen, what="relist replay")
+            # ...and once the storm is spent, the live stream delivers
+            wait_until(
+                lambda: sim.faults.total_injected() >= 3, what="storm spent"
+            )
+            store.create(new_job("fresh", worker=1, command=SLEEP))
+            wait_until(lambda: "fresh" in seen, what="post-storm live event")
+            assert m.counter("api_watch_gone_total", kind="TPUJob") >= 1
+        finally:
+            store.close()
+            sim.stop()
+
+
+class TestLeaseUnderFaults:
+    def _lease(self, sim, ident, m, **kw):
+        from tf_operator_tpu.cmd.leader import KubeLease
+
+        kw.setdefault("lease_duration", 1.0)
+        kw.setdefault("metrics", m)
+        kw.setdefault(
+            "retry",
+            RetryPolicy(
+                max_attempts=3, base_delay=0.02, max_delay=0.1,
+                deadline=0.3, rng=random.Random(1),
+            ),
+        )
+        return KubeLease(sim.url, identity=ident, **kw)
+
+    def test_renewal_survives_bounded_500_burst(self):
+        """A burst shorter than the lease deadline must NOT demote:
+        the retrying client + the renew loop's transient-vs-fatal
+        policy absorb it."""
+
+        sim = MiniApiServer(fault_seed=0).start()
+        m = Metrics()
+        lost = []
+        lease = self._lease(sim, "a", m, on_lost=lambda: lost.append(True))
+        try:
+            assert lease.try_acquire()
+            # 4 shots ≈ one whole renew tick's calls all failing
+            sim.faults.add(
+                path=r"/apis/coordination\.k8s\.io", mode="error",
+                status=500, times=4,
+            )
+            time.sleep(1.6)  # several renew periods (duration/3 = 0.33s)
+            assert lease.is_leader, "bounded burst must not demote"
+            assert not lost
+            assert m.counter(
+                "api_client_retries_total", client="kube-lease"
+            ) >= 1
+            assert lease.holder() == "a"
+        finally:
+            lease.release()
+            sim.stop()
+
+    def test_total_outage_still_demotes_within_lease_deadline(self):
+        """Retries must not MASK a real outage: when the apiserver
+        stays down past the lease duration, on_lost fires (the
+        split-brain guard keeps working under the retry layer)."""
+
+        sim = MiniApiServer(fault_seed=0).start()
+        m = Metrics()
+        lost = []
+        lease = self._lease(sim, "a", m, on_lost=lambda: lost.append(True))
+        try:
+            assert lease.try_acquire()
+            sim.faults.add(
+                path=r"/apis/coordination\.k8s\.io", mode="error", status=500,
+            )
+            wait_until(lambda: lost, timeout=5.0, what="on_lost under outage")
+            assert not lease.is_leader
+        finally:
+            lease.release()
+            sim.stop()
+
+
+class TestConvergenceUnderFaults:
+    """ISSUE 1 acceptance: ≥10% injected 5xx/429/reset on ALL apiserver
+    routes; a controller + KubeJobStore drive a multi-replica job to
+    Succeeded with no lost pods, no unhandled exceptions, and non-zero
+    exported retry counters."""
+
+    def test_multi_replica_job_succeeds_under_fault_schedule(self):
+        sim = MiniApiServer(fault_seed=1234).start()
+        # combined ~13% fault probability across every route — resets,
+        # 503+Retry-After, and naked 429s
+        sim.faults.add(mode="error", status=503, retry_after=0.02,
+                       probability=0.05)
+        sim.faults.add(mode="error", status=429, probability=0.04)
+        sim.faults.add(mode="reset", probability=0.04)
+
+        m = Metrics()
+        store = KubeJobStore(sim.url, retry=fast_policy(seed=1), metrics=m)
+        backend = KubeBackend(sim.url, retry=fast_policy(seed=2), metrics=m)
+        recorder = KubeEventRecorder(sim.url, metrics=m)
+        controller = TPUJobController(
+            store, backend,
+            config=ReconcilerConfig(resolver=backend.resolver),
+            metrics=m, recorder=recorder,
+            resync_period=0.3, expectations_timeout=0.3,
+        )
+
+        crashes = []
+        prev_hook = threading.excepthook
+        threading.excepthook = lambda args: crashes.append(args)
+        try:
+            controller.run(threadiness=2)
+            # ALL_WORKERS success: the job is terminal only when every
+            # one of the 3 replicas ran to completion — so Succeeded
+            # proves no pod was lost to the fault schedule
+            job = new_job("chaos-http", worker=3, command=EXIT0)
+            job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+            store.create(job)
+
+            def succeeded():
+                j = store.get("default", "chaos-http")
+                return j is not None and j.status.has_condition(
+                    JobConditionType.SUCCEEDED
+                )
+
+            wait_until(succeeded, timeout=60.0, what="job Succeeded")
+            pods = backend.list_pods("default")
+            assert {p.metadata.name for p in pods} == {
+                f"chaos-http-worker-{i}" for i in range(3)
+            }
+            assert all(p.phase is PodPhase.SUCCEEDED for p in pods)
+        finally:
+            threading.excepthook = prev_hook
+            controller.stop()
+            recorder.close()
+            backend.close()
+            store.close()
+            sim.stop()
+
+        assert not crashes, f"unhandled thread exceptions: {crashes}"
+        assert sim.faults.total_injected() > 0, "schedule never fired"
+        # the observability story: retries happened and are exported
+        assert m.total("api_client_retries_total") > 0
+        exposition = m.exposition()
+        assert "api_client_retries_total" in exposition
+        assert "api_client_errors_total" in exposition

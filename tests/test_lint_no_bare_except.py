@@ -1,0 +1,95 @@
+"""Lint gate: no silent broad-exception swallows in the API layers.
+
+ISSUE 1 removed the `except Exception: pass` swallows from
+tf_operator_tpu/backend/ and tf_operator_tpu/cmd/ — every broad
+handler there now retries, counts, or logs.  This AST walk keeps it
+that way: a NEW bare swallow (``except Exception:``/``except:`` whose
+body is only ``pass``/``...``) in those packages fails tier-1.
+
+Narrow handlers (``except OSError: pass``) stay allowed — ignoring a
+specific expected error is a decision; ignoring *everything* silently
+is how watch events and job state got lost before this gate existed.
+"""
+
+import ast
+import pathlib
+
+import tf_operator_tpu
+
+PKG_ROOT = pathlib.Path(tf_operator_tpu.__file__).parent
+CHECKED_PACKAGES = ("backend", "cmd")
+
+#: exception names considered "broad" — swallowing these silently
+#: hides every bug class at once
+BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare `except:`
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in BROAD
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in BROAD for e in t.elts
+        )
+    return False
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    return all(
+        isinstance(s, ast.Pass)
+        or (
+            isinstance(s, ast.Expr)
+            and isinstance(s.value, ast.Constant)
+            and s.value.value is Ellipsis
+        )
+        for s in handler.body
+    )
+
+
+def find_silent_broad_excepts(root: pathlib.Path):
+    offenders = []
+    for pkg in CHECKED_PACKAGES:
+        for path in sorted((root / pkg).rglob("*.py")):
+            tree = ast.parse(path.read_text(), filename=str(path))
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.ExceptHandler)
+                    and _is_broad(node)
+                    and _is_silent(node)
+                ):
+                    offenders.append(f"{path}:{node.lineno}")
+    return offenders
+
+
+def test_no_silent_broad_excepts_in_api_layers():
+    offenders = find_silent_broad_excepts(PKG_ROOT)
+    assert not offenders, (
+        "silent broad-exception swallows found (retry/log/count instead; "
+        "see backend/retry.py):\n  " + "\n  ".join(offenders)
+    )
+
+
+def test_walker_catches_a_planted_swallow(tmp_path):
+    """The gate itself works: a planted offender is found, and the
+    allowed shapes (narrow except, broad-but-logged) are not."""
+
+    pkg = tmp_path / "backend"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "try:\n    x = 1\nexcept Exception:\n    pass\n"
+    )
+    (pkg / "alsobad.py").write_text(
+        "try:\n    x = 1\nexcept (ValueError, Exception):\n    ...\n"
+    )
+    (pkg / "ok.py").write_text(
+        "try:\n    x = 1\nexcept OSError:\n    pass\n"
+        "try:\n    y = 2\nexcept Exception as e:\n    print(e)\n"
+    )
+    (tmp_path / "cmd").mkdir()
+    offenders = find_silent_broad_excepts(tmp_path)
+    assert [o.rsplit("/", 1)[-1] for o in offenders] == [
+        "alsobad.py:3", "bad.py:3",
+    ]

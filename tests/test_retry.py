@@ -1,0 +1,269 @@
+"""backend/retry.py unit contract: backoff shape, retry-on rules,
+Retry-After honoring, deadline budget, circuit breaker transitions,
+and the status-returning (cmd/leader.py) result path.
+
+All tests inject fake sleep/clock/rng so they are instant and
+deterministic.
+"""
+
+import random
+
+import pytest
+
+from tf_operator_tpu.backend.base import NotFoundError
+from tf_operator_tpu.backend.kube import ApiError, GoneError
+from tf_operator_tpu.backend.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+)
+from tf_operator_tpu.utils.metrics import Metrics
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.now += s
+
+    def __call__(self):
+        return self.now
+
+
+def make_policy(**kw):
+    clock = FakeClock()
+    kw.setdefault("rng", random.Random(42))
+    policy = RetryPolicy(sleep=clock.sleep, clock=clock, **kw)
+    return policy, clock
+
+
+class Flaky:
+    """Raises the scripted errors in order, then returns 'ok'."""
+
+    def __init__(self, *errors):
+        self.errors = list(errors)
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.errors:
+            raise self.errors.pop(0)
+        return "ok"
+
+
+class TestBackoffShape:
+    def test_full_jitter_within_exponential_caps(self):
+        policy, _ = make_policy(base_delay=0.1, max_delay=1.0)
+        for attempt in range(8):
+            cap = min(0.1 * 2**attempt, 1.0)
+            for _ in range(20):
+                d = policy.backoff(attempt)
+                assert 0.0 <= d <= cap
+
+    def test_seeded_rng_replays(self):
+        p1, _ = make_policy(rng=random.Random(7))
+        p2, _ = make_policy(rng=random.Random(7))
+        assert [p1.backoff(i) for i in range(5)] == [
+            p2.backoff(i) for i in range(5)
+        ]
+
+
+class TestRetryRules:
+    def test_retries_5xx_and_429_then_succeeds(self):
+        for status in (429, 500, 502, 503, 504):
+            policy, _ = make_policy()
+            fn = Flaky(ApiError(status, "boom"), ApiError(status, "boom"))
+            assert policy.call(fn) == "ok"
+            assert fn.calls == 3
+
+    def test_semantic_statuses_never_retry(self):
+        for err in (NotFoundError("x"), GoneError(410, "")):
+            policy, clock = make_policy()
+            fn = Flaky(err)
+            with pytest.raises(type(err)):
+                policy.call(fn)
+            assert fn.calls == 1
+            assert clock.sleeps == []
+
+    def test_network_errors_retry(self):
+        policy, _ = make_policy()
+        fn = Flaky(ConnectionResetError(), ConnectionRefusedError())
+        assert policy.call(fn) == "ok"
+        assert fn.calls == 3
+
+    def test_gives_up_after_max_attempts_with_original_error(self):
+        policy, _ = make_policy(max_attempts=3)
+        fn = Flaky(*[ApiError(503, "x")] * 10)
+        with pytest.raises(ApiError) as ei:
+            policy.call(fn)
+        assert ei.value.status == 503  # the underlying error, unwrapped
+        assert fn.calls == 3
+
+    def test_metrics_counters_and_last_error_gauge(self):
+        m = Metrics()
+        policy, _ = make_policy()
+        policy.call(Flaky(ApiError(503, "x")), client="c1", metrics=m)
+        assert m.counter("api_client_retries_total", client="c1") == 1
+        assert m.counter(
+            "api_client_errors_total", client="c1", error="ApiError"
+        ) == 1
+        assert m.gauge("api_client_last_error_unixtime", client="c1") > 0
+        with pytest.raises(ApiError):
+            policy, _ = make_policy(max_attempts=2)
+            policy.call(
+                Flaky(*[ApiError(503, "x")] * 5), client="c1", metrics=m
+            )
+        assert m.counter("api_client_giveups_total", client="c1") == 1
+
+
+class TestRetryAfterAndDeadline:
+    def test_retry_after_floors_the_delay(self):
+        policy, clock = make_policy(base_delay=0.001, max_delay=0.01)
+        err = ApiError(429, "slow down")
+        err.retry_after = 0.7
+        policy.call(Flaky(err))
+        assert clock.sleeps == [0.7]  # floored above the jittered value
+
+    def test_retry_after_is_capped(self):
+        policy, clock = make_policy(retry_after_cap=1.5)
+        err = ApiError(503, "")
+        err.retry_after = 3600.0  # hostile/buggy server
+        policy.call(Flaky(err))
+        assert clock.sleeps[0] <= 1.5
+
+    def test_deadline_budget_stops_retrying(self):
+        policy, clock = make_policy(
+            max_attempts=100, base_delay=1.0, max_delay=1.0, deadline=2.5
+        )
+        fn = Flaky(*[ApiError(503, "x")] * 100)
+        with pytest.raises(ApiError):
+            policy.call(fn)
+        assert clock.now <= 2.5
+        assert fn.calls < 100
+
+
+class TestResultPath:
+    """cmd/leader.py's client returns (status, obj) instead of raising."""
+
+    def test_retryable_status_result_retries_then_returns(self):
+        policy, _ = make_policy()
+        results = [(503, {}), (503, {}), (200, {"ok": True})]
+        out = policy.call(
+            lambda: results.pop(0),
+            retryable_result=lambda res: res[0] in (429, 500, 502, 503, 504),
+        )
+        assert out == (200, {"ok": True})
+
+    def test_budget_exhausted_returns_last_result_not_raise(self):
+        policy, _ = make_policy(max_attempts=2)
+        out = policy.call(
+            lambda: (503, {}),
+            retryable_result=lambda res: res[0] == 503,
+        )
+        assert out == (503, {})  # caller keeps its own status handling
+
+    def test_float_verdict_floors_sleep_at_retry_after(self):
+        """A status client can surface the server's Retry-After as the
+        verdict; the next sleep is floored at it, like the exception
+        path honoring ApiError.retry_after."""
+
+        policy, clock = make_policy(base_delay=0.001, max_delay=0.01)
+        results = [(429, {}, 0.8), (200, {}, None)]
+        out = policy.call(
+            lambda: results.pop(0),
+            retryable_result=lambda res: (
+                (res[2] or True) if res[0] == 429 else False
+            ),
+        )
+        assert out == (200, {}, None)
+        assert clock.sleeps == [0.8]
+
+    def test_retry_after_zero_verdict_still_retries(self):
+        """Retry-After: 0 is legal HTTP ('retry immediately'); the
+        falsy 0.0 verdict must still mean retry, not success."""
+
+        policy, clock = make_policy()
+        results = [(429, {}, 0.0), (200, {}, None)]
+        out = policy.call(
+            lambda: results.pop(0),
+            retryable_result=lambda res: (
+                (res[2] if res[2] is not None else True)
+                if res[0] == 429 else False
+            ),
+        )
+        assert out == (200, {}, None)
+
+    def test_semantic_status_returns_immediately(self):
+        policy, clock = make_policy()
+        calls = []
+        out = policy.call(
+            lambda: calls.append(1) or (409, {}),
+            retryable_result=lambda res: res[0] in (429, 500),
+        )
+        assert out == (409, {})
+        assert len(calls) == 1
+        assert clock.sleeps == []
+
+
+class TestCircuitBreaker:
+    def test_opens_after_consecutive_failures_then_fails_fast_behind_probe(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=3, probe_timeout=5.0, clock=clock)
+        policy, pclock = make_policy(max_attempts=1)
+        m = Metrics()
+        for _ in range(3):
+            with pytest.raises(ApiError):
+                policy.call(
+                    Flaky(*[ApiError(503, "x")] * 3), breaker=br, metrics=m
+                )
+        assert br.state == "open"  # tripped, probe slot free
+        assert br.allow()  # this caller takes the probe slot...
+        assert br.state == "half-open"  # trial in flight
+        with pytest.raises(CircuitOpenError):
+            # ...so a concurrent caller fails fast
+            policy.call(lambda: "ok", breaker=br, metrics=m)
+        assert m.counter("api_client_circuit_open_total", client="api") == 1
+
+    def test_first_call_after_recovery_closes_with_zero_latency(self):
+        """The apiserver-outage property: once the server is back, the
+        very first call goes straight through and closes the circuit —
+        no reset-window of refused service after recovery."""
+
+        br = CircuitBreaker(failure_threshold=2)
+        policy, _ = make_policy(max_attempts=1)
+        for _ in range(2):
+            with pytest.raises(ApiError):
+                policy.call(Flaky(ApiError(503, "x")), breaker=br)
+        assert br.state == "open"
+        assert policy.call(lambda: "ok", breaker=br) == "ok"
+        assert br.state == "closed"
+
+    def test_probe_failure_keeps_circuit_open(self):
+        br = CircuitBreaker(failure_threshold=2)
+        br.record_failure()
+        br.record_failure()
+        assert br.allow()  # probe
+        br.record_failure()
+        assert br.state == "open"  # still tripped; next probe may try
+        assert br.allow()
+
+    def test_stuck_probe_slot_reclaimed_after_timeout(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=1, probe_timeout=5.0, clock=clock)
+        br.record_failure()
+        assert br.allow()  # probe taken, never recorded (thread died)
+        assert not br.allow()
+        assert br.state == "half-open"  # stuck probe counts as in flight
+        clock.now += 5.0
+        assert br.allow()  # slot reclaimed
+
+    def test_semantic_error_counts_as_server_alive(self):
+        br = CircuitBreaker(failure_threshold=2)
+        policy, _ = make_policy(max_attempts=1)
+        for _ in range(5):
+            with pytest.raises(NotFoundError):
+                policy.call(Flaky(NotFoundError("x")), breaker=br)
+        assert br.state == "closed"  # 404s are answers, not outages

@@ -71,13 +71,34 @@ class TestWorkQueue:
         assert q.get(0.5) == "a"
 
     def test_rate_limited_backoff_grows(self, WQ):
-        q = WQ(base_delay=0.01, max_delay=1.0)
+        # jitter=False pins the exact exponential delays; the native
+        # queue is jitterless by construction
+        q = (
+            WQ(base_delay=0.01, max_delay=1.0, jitter=False)
+            if WQ is WorkQueue
+            else WQ(base_delay=0.01, max_delay=1.0)
+        )
         d1 = q.add_rate_limited("a")
         d2 = q.add_rate_limited("a")
         d3 = q.add_rate_limited("a")
         assert d1 < d2 < d3
         q.forget("a")
         assert q.num_requeues("a") == 0
+
+    def test_rate_limited_full_jitter_bounded_and_seeded(self):
+        """Python queue default: full jitter — each delay lands in
+        [0, min(base*2^n, max)], the requeue count still grows, and a
+        seeded rng replays the exact sequence (deterministic tests)."""
+
+        import random
+
+        q = WorkQueue(base_delay=0.01, max_delay=1.0, rng=random.Random(7))
+        delays = [q.add_rate_limited("a") for _ in range(4)]
+        for n, d in enumerate(delays):
+            assert 0.0 <= d <= min(0.01 * 2**n, 1.0)
+        assert q.num_requeues("a") == 4
+        q2 = WorkQueue(base_delay=0.01, max_delay=1.0, rng=random.Random(7))
+        assert [q2.add_rate_limited("a") for _ in range(4)] == delays
 
     def test_get_blocks_until_add(self, WQ):
         q = WQ()

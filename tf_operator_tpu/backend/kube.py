@@ -35,6 +35,7 @@ what a compiled pod looks like.
 from __future__ import annotations
 
 import json
+import logging
 import socket
 import threading
 import time
@@ -43,6 +44,8 @@ import urllib.request
 from http.client import HTTPConnection
 from typing import Any, Dict, List, Optional
 
+_log = logging.getLogger("tpujob.kube")
+
 from tf_operator_tpu.api.types import Container, ObjectMeta, PodPhase, Port
 from tf_operator_tpu.backend.base import (
     AlreadyExistsError,
@@ -50,6 +53,7 @@ from tf_operator_tpu.backend.base import (
     NotFoundError,
 )
 from tf_operator_tpu.backend.local import LocalResolver
+from tf_operator_tpu.backend.retry import watch_recovery
 from tf_operator_tpu.backend.objects import (
     Pod,
     PodGroup,
@@ -304,6 +308,9 @@ def parse_selector(param: str) -> Dict[str, str]:
 class ApiError(RuntimeError):
     def __init__(self, status: int, body: str):
         self.status = status
+        #: float seconds from a Retry-After header, when the server
+        #: sent one (429/503) — honored by backend/retry.RetryPolicy
+        self.retry_after: Optional[float] = None
         super().__init__(f"apiserver {status}: {body[:200]}")
 
 
@@ -319,28 +326,49 @@ class GoneError(ApiError):
 def http_json(
     host: str, port: int, method: str, path: str,
     body: Optional[dict] = None, timeout: float = 5.0,
+    policy=None, metrics=None, client: str = "api", breaker=None,
 ) -> dict:
     """One JSON request with the apiserver error mapping (shared by
-    KubeBackend and the TPUJob store, backend/kubejobs.py)."""
+    KubeBackend and the TPUJob store, backend/kubejobs.py).
 
-    conn = HTTPConnection(host, port, timeout=timeout)
-    try:
-        payload = json.dumps(body).encode() if body is not None else None
-        headers = {"Content-Type": "application/json"} if payload else {}
-        conn.request(method, path, body=payload, headers=headers)
-        resp = conn.getresponse()
-        text = resp.read().decode(errors="replace")
-        if resp.status == 404:
-            raise NotFoundError(path)
-        if resp.status == 409:
-            raise AlreadyExistsError(path)
-        if resp.status == 410:
-            raise GoneError(410, text)
-        if resp.status >= 400:
-            raise ApiError(resp.status, text)
-        return json.loads(text) if text else {}
-    finally:
-        conn.close()
+    With ``policy`` (a backend/retry.RetryPolicy) the request retries
+    transient failures — 429/5xx responses, connection resets, broken
+    sockets — under the policy's jittered-backoff budget, honoring
+    Retry-After; 404/409/410 stay semantic and raise immediately.
+    """
+
+    def attempt() -> dict:
+        conn = HTTPConnection(host, port, timeout=timeout)
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if payload else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            text = resp.read().decode(errors="replace")
+            if resp.status == 404:
+                raise NotFoundError(path)
+            if resp.status == 409:
+                raise AlreadyExistsError(path)
+            if resp.status == 410:
+                raise GoneError(410, text)
+            if resp.status >= 400:
+                err = ApiError(resp.status, text)
+                ra = resp.getheader("Retry-After")
+                if ra is not None:
+                    try:
+                        err.retry_after = float(ra)
+                    except ValueError:
+                        pass
+                raise err
+            return json.loads(text) if text else {}
+        finally:
+            conn.close()
+
+    if policy is None:
+        return attempt()
+    return policy.call(
+        attempt, client=client, metrics=metrics, breaker=breaker,
+    )
 
 
 class KubeBackend(ClusterBackend):
@@ -356,13 +384,29 @@ class KubeBackend(ClusterBackend):
     protocol itself is already asynchronous).
     """
 
-    def __init__(self, base_url: str, connect_timeout: float = 5.0):
+    def __init__(
+        self,
+        base_url: str,
+        connect_timeout: float = 5.0,
+        retry=None,
+        metrics=None,
+        breaker=None,
+    ):
+        from tf_operator_tpu.backend.retry import CircuitBreaker, default_policy
+        from tf_operator_tpu.utils.metrics import default_metrics
+
         u = urllib.parse.urlparse(base_url)
         if u.scheme != "http":
             raise ValueError(f"KubeBackend speaks plain http; got {base_url!r}")
         self.host = u.hostname or "127.0.0.1"
         self.port = u.port or 80
         self.timeout = connect_timeout
+        #: retry policy for every plain REST verb (watch streams have
+        #: their own ListAndWatch recovery loop, which this policy's
+        #: jittered backoff also paces)
+        self.retry = retry if retry is not None else default_policy()
+        self.metrics = metrics if metrics is not None else default_metrics
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
         #: local subprocess pods → local address resolution, same
         #: contract as LocalProcessBackend.resolver
         self.resolver = LocalResolver()
@@ -379,7 +423,9 @@ class KubeBackend(ClusterBackend):
         self, method: str, path: str, body: Optional[dict] = None
     ) -> dict:
         return http_json(
-            self.host, self.port, method, path, body, self.timeout
+            self.host, self.port, method, path, body, self.timeout,
+            policy=self.retry, metrics=self.metrics, client="kube-backend",
+            breaker=self.breaker,
         )
 
     @staticmethod
@@ -549,6 +595,7 @@ class KubeBackend(ClusterBackend):
 
         _, from_json = KINDS[kind]
         rv = 0
+        fails = 0  # consecutive broken streams/relists → jittered backoff
         while not self._stop.is_set():
             try:
                 if rv == 0:
@@ -574,15 +621,25 @@ class KubeBackend(ClusterBackend):
                 # NOT from the stale list rv (which would replay every
                 # event since the initial list as duplicates)
                 rv = self._stream(kind, rv, from_json)
+                fails = 0
             except GoneError:
-                rv = 0  # expired window: full re-list
-            except Exception:
+                # expired window (or an injected 410 storm): full
+                # re-list, with backoff so a storm can't relist-spin
+                fails = watch_recovery(
+                    fails, stop=self._stop, policy=self.retry,
+                    metrics=self.metrics, kind=kind, gone=True,
+                )
+                rv = 0
+            except Exception as e:  # noqa: BLE001 - ListAndWatch recovery
                 # anything else is a broken stream (half-closed socket
                 # raises assorted http.client internals mid-chunk);
-                # recover exactly like client-go: re-list, re-watch
-                if self._stop.is_set():
-                    return
-                time.sleep(0.05)
+                # recover exactly like client-go: re-list, re-watch —
+                # under jittered backoff so a flapping apiserver isn't
+                # hammered by every watcher at once
+                fails = watch_recovery(
+                    fails, stop=self._stop, policy=self.retry,
+                    metrics=self.metrics, kind=kind, log=_log, exc=e,
+                )
                 rv = 0
 
     def _stream(self, kind: str, rv: int, from_json) -> int:

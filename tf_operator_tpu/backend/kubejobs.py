@@ -34,6 +34,7 @@ for.
 from __future__ import annotations
 
 import json
+import logging
 import socket
 import threading
 import time
@@ -55,6 +56,16 @@ from tf_operator_tpu.backend.objects import (
     WatchEventType,
     WatchHandler,
 )
+from tf_operator_tpu.backend.retry import (
+    NETWORK_ERRORS,
+    CircuitBreaker,
+    RetryPolicy,
+    default_policy,
+    watch_recovery,
+)
+from tf_operator_tpu.utils.metrics import default_metrics
+
+_log = logging.getLogger("tpujob.kubejobs")
 
 COLLECTION = "/apis/tpujob.dist/v1/tpujobs"
 
@@ -73,11 +84,17 @@ def _decode(obj: dict) -> TPUJob:
 class KubeJobStore:
     """JobStore surface over the Kubernetes HTTP protocol."""
 
-    def __init__(self, base_url: str, timeout: float = 5.0):
+    def __init__(
+        self, base_url: str, timeout: float = 5.0,
+        retry: Optional[RetryPolicy] = None, metrics=None, breaker=None,
+    ):
         u = urllib.parse.urlparse(base_url)
         self.host = u.hostname or "127.0.0.1"
         self.port = u.port or 80
         self.timeout = timeout
+        self.retry = retry if retry is not None else default_policy()
+        self.metrics = metrics if metrics is not None else default_metrics
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
         self._handlers: List[WatchHandler] = []
         self._handlers_lock = threading.Lock()
         self._stop = threading.Event()
@@ -85,20 +102,68 @@ class KubeJobStore:
         self._watch_conn: Optional[HTTPConnection] = None
 
     def _request(self, method: str, path: str, body=None) -> dict:
-        return http_json(self.host, self.port, method, path, body, self.timeout)
+        return http_json(
+            self.host, self.port, method, path, body, self.timeout,
+            policy=self.retry, metrics=self.metrics, client="kube-jobs",
+            breaker=self.breaker,
+        )
 
     # -- JobStore surface ---------------------------------------------------
 
     def create(self, job: TPUJob) -> TPUJob:
         """Admission client-side, storage in the apiserver."""
 
+        from tf_operator_tpu.backend.base import AlreadyExistsError
+
         set_defaults(job)
         validate(job)
         d = job_to_dict(job)
         d.setdefault("metadata", {})["namespace"] = job.metadata.namespace
-        out = self._request(
-            "POST", _ns_path(job.metadata.namespace), d
-        )
+        path = _ns_path(job.metadata.namespace)
+        ambiguous = []
+
+        def attempt():
+            try:
+                return http_json(
+                    self.host, self.port, "POST", path, d, self.timeout
+                )
+            except NETWORK_ERRORS:
+                # the send died without a response: the server may or
+                # may not have committed it.  Error RESPONSES (429,
+                # injected 503) are definitive non-commits and do not
+                # mark ambiguity.
+                ambiguous.append(True)
+                raise
+
+        try:
+            out = self.retry.call(
+                attempt,
+                client="kube-jobs",
+                metrics=self.metrics,
+                breaker=self.breaker,
+            )
+        except AlreadyExistsError:
+            # a 409 is ambiguous ONLY after a lost-response send:
+            # against a real apiserver that first send may have
+            # committed, so our own replay lands 409.  If that
+            # happened AND the stored object's spec is exactly what we
+            # posted, this IS our create — return it.  A 409 with no
+            # lost response (genuine duplicate submission, even after
+            # a definitive 429/503 retry) and a conflicting
+            # pre-existing spec both still raise.
+            if ambiguous:
+                existing = self.get(
+                    job.metadata.namespace, job.metadata.name
+                )
+                if existing is not None and job_to_dict(existing).get(
+                    "spec"
+                ) == d.get("spec"):
+                    job.metadata.uid = existing.metadata.uid
+                    job.metadata.resource_version = (
+                        existing.metadata.resource_version
+                    )
+                    return existing
+            raise
         stored = _decode(out)
         # reflect server-assigned identity back into the caller's
         # object, like JobStore.create / client-go Create
@@ -174,6 +239,7 @@ class KubeJobStore:
         the last delivered event; 410 or a broken stream re-lists)."""
 
         rv = 0
+        fails = 0  # consecutive broken streams → jittered backoff
         while not self._stop.is_set():
             try:
                 if rv == 0:
@@ -192,12 +258,20 @@ class KubeJobStore:
                             )
                         )
                 rv = self._stream(rv)
+                fails = 0
             except GoneError:
+                # expired watch window (or injected 410 storm): re-list
+                # from scratch, under backoff so a storm can't spin
+                fails = watch_recovery(
+                    fails, stop=self._stop, policy=self.retry,
+                    metrics=self.metrics, kind="TPUJob", gone=True,
+                )
                 rv = 0
-            except Exception:
-                if self._stop.is_set():
-                    return
-                time.sleep(0.05)
+            except Exception as e:  # noqa: BLE001 - ListAndWatch recovery
+                fails = watch_recovery(
+                    fails, stop=self._stop, policy=self.retry,
+                    metrics=self.metrics, kind="TPUJob", log=_log, exc=e,
+                )
                 rv = 0
 
     def _stream(self, rv: int) -> int:
@@ -274,13 +348,22 @@ class KubeEventRecorder:
     #: bounded post buffer; overflow drops the OLDEST events
     QUEUE_MAX = 1024
 
-    def __init__(self, base_url: str, timeout: float = 2.0):
+    def __init__(
+        self, base_url: str, timeout: float = 2.0,
+        retry: Optional[RetryPolicy] = None, metrics=None,
+    ):
         import collections
 
         u = urllib.parse.urlparse(base_url)
         self.host = u.hostname or "127.0.0.1"
         self.port = u.port or 80
         self.timeout = timeout
+        # tighter budget than the control-loop default: event posting
+        # is best-effort and must never wedge the drain thread long
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, base_delay=0.05, max_delay=0.5, deadline=2.0
+        )
+        self.metrics = metrics if metrics is not None else default_metrics
         self._seq = 0
         self._lock = threading.Lock()
         self._queue = collections.deque(maxlen=self.QUEUE_MAX)
@@ -292,7 +375,10 @@ class KubeEventRecorder:
         self._poster.start()
 
     def _request(self, method: str, path: str, body=None) -> dict:
-        return http_json(self.host, self.port, method, path, body, self.timeout)
+        return http_json(
+            self.host, self.port, method, path, body, self.timeout,
+            policy=self.retry, metrics=self.metrics, client="kube-events",
+        )
 
     @staticmethod
     def _rfc3339(ts: float) -> str:
@@ -352,6 +438,7 @@ class KubeEventRecorder:
         self._kick.set()
 
     def _post_loop(self) -> None:
+        dropped = 0
         while not self._stop.is_set():
             self._kick.wait(timeout=0.5)
             self._kick.clear()
@@ -361,11 +448,22 @@ class KubeEventRecorder:
                 except IndexError:
                     break
                 try:
+                    # bounded retry (self.retry inside _request); still
+                    # best-effort like client-go's broadcaster, but a
+                    # drop is now COUNTED and periodically logged, not
+                    # silently swallowed
                     self._request(
                         "POST", f"/api/v1/namespaces/{ns}/events", obj
                     )
-                except Exception:
-                    pass  # best-effort, like client-go's broadcaster
+                except Exception as e:  # noqa: BLE001 - best-effort sink
+                    dropped += 1
+                    self.metrics.inc("api_events_dropped_total")
+                    if dropped == 1 or dropped % 100 == 0:
+                        _log.warning(
+                            "dropped %d event(s); last: %s posting %s (%s)",
+                            dropped, type(e).__name__,
+                            obj.get("reason", "?"), e,
+                        )
 
     def flush(self, timeout: float = 5.0) -> None:
         """Block until the post buffer drains (tests / clean shutdown)."""
@@ -402,6 +500,17 @@ class KubeEventRecorder:
         decorated.sort(key=lambda t: (t[0], t[1]))
         return [e for _, _, e in decorated]
 
+    def _read_failed(self, what: str, e: Exception) -> list:
+        """Describe-path reads degrade to [] (must never raise), but
+        the failure is counted and logged — not silently swallowed."""
+
+        self.metrics.inc("api_event_read_failures_total")
+        _log.warning(
+            "event read %s failed after retries: %s: %s",
+            what, type(e).__name__, e,
+        )
+        return []
+
     def for_object(self, object_key: str):
         ns, _, name = object_key.partition("/")
         fsel = urllib.parse.quote(
@@ -412,13 +521,13 @@ class KubeEventRecorder:
                 "GET",
                 f"/api/v1/namespaces/{ns}/events?fieldSelector={fsel}",
             )
-        except Exception:
-            return []
+        except Exception as e:  # noqa: BLE001 - degrade-to-empty read path
+            return self._read_failed(object_key, e)
         return self._decode_events(out.get("items", []))
 
     def all(self):
         try:
             out = self._request("GET", "/api/v1/events")
-        except Exception:
-            return []
+        except Exception as e:  # noqa: BLE001 - degrade-to-empty read path
+            return self._read_failed("all", e)
         return self._decode_events(out.get("items", []))

@@ -43,9 +43,14 @@ from __future__ import annotations
 
 import json
 import os
+import random
+import re
 import signal
+import socket
+import struct
 import subprocess
 import threading
+import time
 import urllib.parse
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -76,6 +81,130 @@ _PLURALS = {
     "tpujobs": "TPUJob",
     "events": "Event",
 }
+
+
+class FaultRule:
+    """One fault-injection rule: regex over the raw request line
+    (path INCLUDING query, so ``watch=true`` streams are targetable),
+    a verb set, an action, a probability, and an optional shot count.
+
+    Modes:
+      - ``error``:   reply ``status`` (with ``Retry-After: retry_after``
+                     when given) INSTEAD of executing the verb — so a
+                     client's blind retry of a non-idempotent verb is
+                     safe against this server;
+      - ``reset``:   hard-close the accepted socket (SO_LINGER 0 → RST,
+                     the mid-handshake connection-reset case);
+      - ``latency``: sleep ``delay`` seconds, then serve normally.
+    """
+
+    _ids = 0
+    _ids_lock = threading.Lock()
+
+    def __init__(
+        self,
+        path: str = ".*",
+        methods: Optional[List[str]] = None,
+        mode: str = "error",
+        status: int = 503,
+        retry_after: Optional[float] = None,
+        delay: float = 0.0,
+        probability: float = 1.0,
+        times: Optional[int] = None,
+    ):
+        if mode not in ("error", "reset", "latency"):
+            raise ValueError(f"unknown fault mode {mode!r}")
+        with FaultRule._ids_lock:
+            FaultRule._ids += 1
+            self.id = FaultRule._ids
+        self.path = path
+        self.path_re = re.compile(path)
+        self.methods = (
+            None if methods is None else {m.upper() for m in methods}
+        )
+        self.mode = mode
+        self.status = int(status)
+        self.retry_after = None if retry_after is None else float(retry_after)
+        self.delay = float(delay)
+        self.probability = float(probability)
+        self.remaining = None if times is None else int(times)
+        self.injected = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "path": self.path,
+            "methods": sorted(self.methods) if self.methods else None,
+            "mode": self.mode,
+            "status": self.status,
+            "retryAfter": self.retry_after,
+            "delay": self.delay,
+            "probability": self.probability,
+            "remaining": self.remaining,
+            "injected": self.injected,
+        }
+
+
+class FaultInjector:
+    """Per-route/per-verb fault schedule for MiniApiServer.
+
+    Deterministic under a seed (chaos tests replay exactly); drivable
+    in-process (``sim.faults.add(...)``) or over HTTP via the admin
+    endpoint ``/_faults`` (GET = rules+counters, POST = add rule JSON,
+    DELETE = clear) — the admin route itself is never injected.
+    """
+
+    def __init__(self, seed: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        self._rules: List[FaultRule] = []
+
+    def add(self, **kw) -> FaultRule:
+        rule = FaultRule(**kw)
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def remove(self, rule_id: int) -> bool:
+        with self._lock:
+            before = len(self._rules)
+            self._rules = [r for r in self._rules if r.id != rule_id]
+            return len(self._rules) < before
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules.clear()
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.to_dict() for r in self._rules]
+
+    def total_injected(self) -> int:
+        with self._lock:
+            return sum(r.injected for r in self._rules)
+
+    def decide(self, method: str, raw_path: str) -> Optional[tuple]:
+        """First matching rule that fires wins; None = serve normally."""
+
+        with self._lock:
+            for r in self._rules:
+                if r.methods is not None and method.upper() not in r.methods:
+                    continue
+                if not r.path_re.search(raw_path):
+                    continue
+                if r.remaining is not None and r.remaining <= 0:
+                    continue
+                if r.probability < 1.0 and self._rng.random() >= r.probability:
+                    continue
+                if r.remaining is not None:
+                    r.remaining -= 1
+                r.injected += 1
+                if r.mode == "error":
+                    return ("error", r.status, r.retry_after)
+                if r.mode == "reset":
+                    return ("reset",)
+                return ("latency", r.delay)
+        return None
 
 
 def _field_get(obj: Dict[str, Any], dotted: str):
@@ -132,10 +261,13 @@ class MiniApiServer:
         total_chips: Optional[int] = None,
         log_dir: Optional[str] = None,
         kubelet_interval: float = 0.05,
+        fault_seed: Optional[int] = None,
     ):
         import tempfile
 
         self.store = _Store()
+        #: per-route/per-verb fault schedule (chaos tests + /_faults)
+        self.faults = FaultInjector(seed=fault_seed)
         self.total_chips = total_chips
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="tpujob-kubesim-")
         self.kubelet_interval = kubelet_interval
@@ -244,7 +376,10 @@ class MiniApiServer:
     # -- HTTP dispatch ------------------------------------------------------
 
     @staticmethod
-    def _reply(h, status: int, obj=None, text: Optional[str] = None) -> None:
+    def _reply(
+        h, status: int, obj=None, text: Optional[str] = None,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
         body = (
             text.encode()
             if text is not None
@@ -256,6 +391,8 @@ class MiniApiServer:
             "text/plain" if text is not None else "application/json",
         )
         h.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            h.send_header(k, v)
         h.end_headers()
         try:
             h.wfile.write(body)
@@ -304,6 +441,41 @@ class MiniApiServer:
     def _handle(self, h, method: str) -> None:
         u = urllib.parse.urlparse(h.path)
         q = urllib.parse.parse_qs(u.query)
+        if u.path == "/_faults":
+            return self._admin_faults(h, method)
+        act = self.faults.decide(method, h.path)
+        if act is not None:
+            if act[0] == "error":
+                _, code, retry_after = act
+                extra = (
+                    {"Retry-After": f"{retry_after:g}"}
+                    if retry_after is not None
+                    else None
+                )
+                return self._reply(
+                    h,
+                    code,
+                    self._status(code, "FaultInjected", "injected fault"),
+                    headers=extra,
+                )
+            if act[0] == "reset":
+                # RST, not FIN: SO_LINGER 0 makes close() abort the
+                # connection, so the client sees ECONNRESET mid-request
+                try:
+                    h.connection.setsockopt(
+                        socket.SOL_SOCKET,
+                        socket.SO_LINGER,
+                        struct.pack("ii", 1, 0),
+                    )
+                except OSError:
+                    pass
+                h.close_connection = True
+                try:
+                    h.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                return None
+            time.sleep(act[1])  # latency: delay, then serve normally
         parsed = self._parse_path(u.path)
         if parsed is None:
             return self._reply(
@@ -341,6 +513,35 @@ class MiniApiServer:
                 h, 400, self._status(400, "BadRequest", repr(e))
             )
         self._reply(
+            h, 405, self._status(405, "MethodNotAllowed", method)
+        )
+
+    def _admin_faults(self, h, method: str) -> None:
+        """Chaos admin endpoint (never itself injected): GET lists the
+        rules with their injected-counters, POST adds one rule (the
+        FaultRule kwargs in JSON, camelCase retryAfter accepted),
+        DELETE clears the schedule."""
+
+        if method == "GET":
+            return self._reply(h, 200, {"rules": self.faults.snapshot()})
+        if method == "POST":
+            length = int(h.headers.get("Content-Length", "0"))
+            try:
+                spec = json.loads(h.rfile.read(length) or b"{}")
+                if not isinstance(spec, dict):
+                    raise ValueError("rule must be a JSON object")
+                if "retryAfter" in spec:
+                    spec["retry_after"] = spec.pop("retryAfter")
+                rule = self.faults.add(**spec)
+            except (ValueError, TypeError, re.error) as e:
+                return self._reply(
+                    h, 400, self._status(400, "BadRequest", repr(e))
+                )
+            return self._reply(h, 201, rule.to_dict())
+        if method == "DELETE":
+            self.faults.clear()
+            return self._reply(h, 200, self._status(200, "Success", "cleared"))
+        return self._reply(
             h, 405, self._status(405, "MethodNotAllowed", method)
         )
 

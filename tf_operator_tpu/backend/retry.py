@@ -1,0 +1,367 @@
+"""Shared API-client fault tolerance: RetryPolicy + CircuitBreaker.
+
+Parity: client-go wraps every apiserver round-trip in rest.Request's
+backoff manager + the shared flowcontrol rate limiter, and the
+reference operator inherits that for free.  The HTTP clients here
+(``backend/kube.py``, ``backend/kubejobs.py``, ``cmd/leader.py``) were
+single naked calls; this module is the one place their retry behaviour
+lives so all three layers degrade the same way under apiserver faults
+(``backend/kubesim.FaultInjector`` is the matching server half).
+
+Semantics:
+
+- **exponential backoff with full jitter**: attempt ``n`` sleeps
+  ``uniform(0, min(base * 2**n, max_delay))`` — the AWS-architecture
+  full-jitter scheme, chosen so a fleet of clients whose requests all
+  failed together (apiserver restart) do not re-arrive together;
+- **retry-on rules**: 429/500/502/503/504 responses retry for every
+  verb (the sim injects faults *before* the verb executes, and against
+  a real apiserver a replayed create surfaces as 409 → the reconciler
+  already treats AlreadyExists as success); 404/409/410 are semantic
+  outcomes and never retry; network-level errors (connection refused/
+  reset, half-closed sockets mid-chunk) retry likewise;
+- **Retry-After honoring**: a 429/503 carrying ``Retry-After`` floors
+  the next sleep at that value (capped — a hostile/buggy server must
+  not park a client for minutes);
+- **budgets**: ``max_attempts`` bounds tries; ``deadline`` bounds the
+  wall-clock a call spends before dispatching another attempt —
+  attempts themselves are not preemptible, so a call can overrun the
+  deadline by at most ONE in-flight attempt (the transport timeout);
+- **circuit breaker**: after N *consecutive* retryable failures the
+  circuit opens and calls fail fast with ``CircuitOpenError``, except
+  one serialized probe at a time — a hung apiserver costs one parked
+  thread instead of one per caller, and a recovered apiserver closes
+  the circuit on the very first call after it returns.
+
+Observability: every retry/giveup/circuit transition increments
+labelled counters in a ``utils/metrics.Metrics`` registry and stamps a
+last-error gauge, so ``/metrics`` (and the dashboard's client-health
+panel) shows exactly how rough the apiserver connection is.
+"""
+
+from __future__ import annotations
+
+import http.client
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+#: statuses safe to retry blindly (see module docstring for why this
+#: includes non-idempotent verbs against this operator's apiservers)
+RETRYABLE_STATUS = (429, 500, 502, 503, 504)
+
+#: transport-level failures: the request may never have been processed
+NETWORK_ERRORS = (OSError, http.client.HTTPException)
+
+
+class CircuitOpenError(RuntimeError):
+    """Fail-fast result while the breaker is open (apiserver presumed
+    down); callers treat it like any other transient API error."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with SERIALIZED probes.
+
+    After ``failure_threshold`` consecutive retryable failures the
+    circuit opens.  Open does not time-gate recovery: one caller at a
+    time is let straight through as the probe — so the first call
+    after the apiserver returns succeeds immediately and closes the
+    circuit (a time-gated half-open would keep refusing service for a
+    reset window after recovery, which turned an healed outage into
+    spurious 5xx from the operator's own API).  While a probe is in
+    flight every other caller fails fast — the protection that matters
+    when the apiserver *hangs* rather than refuses, because at most
+    one thread is ever parked on the dead connection.  A probe stuck
+    past ``probe_timeout`` is presumed dead and its slot reclaimed.
+    """
+
+    def __init__(
+        self,
+        failure_threshold: int = 8,
+        probe_timeout: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.failure_threshold = int(failure_threshold)
+        self.probe_timeout = float(probe_timeout)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._open = False
+        self._probe_started: Optional[float] = None
+
+    def _probe_active_locked(self) -> bool:
+        return (
+            self._probe_started is not None
+            and self._clock() - self._probe_started < self.probe_timeout
+        )
+
+    @property
+    def state(self) -> str:
+        """closed / open (tripped, next caller becomes the probe) /
+        half-open (tripped with the trial probe in flight)."""
+
+        with self._lock:
+            if not self._open:
+                return "closed"
+            return "half-open" if self._probe_active_locked() else "open"
+
+    def allow(self) -> bool:
+        """True when a call may proceed (closed, or this caller takes
+        the probe slot)."""
+
+        with self._lock:
+            if not self._open:
+                return True
+            if self._probe_active_locked():
+                return False  # another thread holds the probe slot
+            self._probe_started = self._clock()
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._open = False
+            self._probe_started = None
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            self._probe_started = None
+            if self._failures >= self.failure_threshold:
+                self._open = True
+
+
+class RetryPolicy:
+    """Exponential-backoff-with-full-jitter retry around one callable.
+
+    Shareable across threads; per-call state is local.  ``sleep`` and
+    ``rng`` are injectable so tests run deterministic and instant.
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 5,
+        base_delay: float = 0.05,
+        max_delay: float = 2.0,
+        deadline: Optional[float] = 15.0,
+        retry_status=RETRYABLE_STATUS,
+        retry_after_cap: float = 5.0,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.deadline = None if deadline is None else float(deadline)
+        self.retry_status = frozenset(retry_status)
+        self.retry_after_cap = float(retry_after_cap)
+        self._rng = rng or random.Random()
+        self._sleep = sleep
+        self._clock = clock
+
+    # -- classification -----------------------------------------------------
+
+    def classify(self, exc: BaseException):
+        """(retryable, retry_after_floor) for a raised attempt.
+
+        Duck-typed on ``.status`` / ``.retry_after`` so this module
+        doesn't import the client error types it serves (kube.py
+        imports us)."""
+
+        status = getattr(exc, "status", None)
+        if isinstance(status, int):
+            if status in self.retry_status:
+                ra = getattr(exc, "retry_after", None)
+                return True, (float(ra) if ra is not None else None)
+            return False, None
+        if isinstance(exc, NETWORK_ERRORS):
+            return True, None
+        return False, None
+
+    def backoff(self, attempt: int) -> float:
+        """Full-jitter delay for the given 0-based attempt number."""
+
+        cap = min(self.base_delay * (2.0 ** attempt), self.max_delay)
+        return self._rng.uniform(0.0, cap)
+
+    # -- the loop -----------------------------------------------------------
+
+    def call(
+        self,
+        fn: Callable[[], object],
+        *,
+        client: str = "api",
+        metrics=None,
+        breaker: Optional[CircuitBreaker] = None,
+        retryable_result: Optional[Callable[[object], object]] = None,
+    ):
+        """Run ``fn`` under this policy.
+
+        ``retryable_result`` covers clients that return statuses rather
+        than raising (cmd/leader.py): a truthy verdict retries like a
+        retryable exception — return a float to floor the next sleep
+        at a server-advertised Retry-After — and the last result is
+        RETURNED (not raised) when the budget runs out, so the caller
+        keeps its own status handling.  On giveup after raised errors,
+        the last underlying exception re-raises unchanged so caller
+        ``except`` clauses keep working.
+        """
+
+        start = self._clock()
+        attempt = 0
+        while True:
+            if breaker is not None and not breaker.allow():
+                if metrics is not None:
+                    metrics.inc(
+                        "api_client_circuit_open_total", client=client
+                    )
+                raise CircuitOpenError(
+                    f"{client}: circuit open (apiserver presumed down)"
+                )
+            try:
+                out = fn()
+            except Exception as e:  # noqa: BLE001 - classification below
+                retryable, retry_after = self.classify(e)
+                if breaker is not None:
+                    if retryable:
+                        breaker.record_failure()
+                    else:
+                        breaker.record_success()  # server answered
+                if not retryable:
+                    # semantic outcomes (404 probe-miss, 409 create
+                    # race, 410 window-expiry) are normal reconcile
+                    # traffic — counting them would make a perfectly
+                    # healthy client look permanently degraded
+                    raise
+                if metrics is not None:
+                    metrics.inc(
+                        "api_client_errors_total",
+                        client=client,
+                        error=type(e).__name__,
+                    )
+                    metrics.set(
+                        "api_client_last_error_unixtime",
+                        time.time(),
+                        client=client,
+                    )
+                if not self._schedule(
+                    start, attempt, retry_after, client, metrics
+                ):
+                    raise
+                attempt += 1
+                continue
+            verdict = (
+                retryable_result(out)
+                if retryable_result is not None
+                else None
+            )
+            # ANY numeric verdict — including 0.0, a legal
+            # "Retry-After: 0, retry immediately" — means retry; only
+            # False/None mean the result is final (bool first: False
+            # is an int instance)
+            if isinstance(verdict, bool):
+                retry_wanted, result_retry_after = verdict, None
+            elif isinstance(verdict, (int, float)):
+                retry_wanted, result_retry_after = True, float(verdict)
+            else:
+                retry_wanted, result_retry_after = bool(verdict), None
+            if retry_wanted:
+                if metrics is not None:
+                    metrics.inc(
+                        "api_client_errors_total",
+                        client=client,
+                        error="retryable_status",
+                    )
+                    metrics.set(
+                        "api_client_last_error_unixtime",
+                        time.time(),
+                        client=client,
+                    )
+                if breaker is not None:
+                    breaker.record_failure()
+                if not self._schedule(
+                    start, attempt, result_retry_after, client, metrics
+                ):
+                    return out  # budget spent: caller sees the status
+                attempt += 1
+                continue
+            if breaker is not None:
+                breaker.record_success()
+            return out
+
+    def _schedule(
+        self, start, attempt, retry_after, client, metrics
+    ) -> bool:
+        """Sleep before the next attempt; False = budget exhausted."""
+
+        if attempt + 1 >= self.max_attempts:
+            if metrics is not None:
+                metrics.inc("api_client_giveups_total", client=client)
+            return False
+        delay = self.backoff(attempt)
+        if retry_after is not None:
+            delay = max(delay, min(retry_after, self.retry_after_cap))
+        if (
+            self.deadline is not None
+            and (self._clock() - start) + delay > self.deadline
+        ):
+            if metrics is not None:
+                metrics.inc("api_client_giveups_total", client=client)
+            return False
+        if metrics is not None:
+            metrics.inc("api_client_retries_total", client=client)
+        self._sleep(delay)
+        return True
+
+
+def watch_recovery(
+    fails: int,
+    *,
+    stop,
+    policy: "RetryPolicy",
+    metrics,
+    kind: str,
+    log=None,
+    exc: Optional[BaseException] = None,
+    gone: bool = False,
+) -> int:
+    """One ListAndWatch failure-recovery step, shared by the watch
+    loops in kube.py and kubejobs.py so their behaviour can't drift:
+    bump the right counter (``api_watch_gone_total`` for an expired
+    window / 410 storm, ``api_watch_restarts_total`` for a broken
+    stream), throttle-log broken streams (first failure, then every
+    20th), and sleep a jittered backoff interruptible by ``stop``.
+    Returns the new consecutive-failure count; callers reset it to 0
+    after a stream completes cleanly.
+    """
+
+    fails += 1
+    if gone:
+        metrics.inc("api_watch_gone_total", kind=kind)
+    else:
+        metrics.inc("api_watch_restarts_total", kind=kind)
+        if log is not None and (fails == 1 or fails % 20 == 0):
+            log.warning(
+                "%s watch broken (%s: %s); re-listing",
+                kind,
+                type(exc).__name__ if exc is not None else "?",
+                exc,
+            )
+    if not stop.is_set():
+        stop.wait(policy.backoff(min(fails, 6)))
+    return fails
+
+
+#: conservative defaults for control-loop clients (reconciler reads/
+#: writes): a few quick tries, bounded well under a resync period
+DEFAULT_POLICY_ARGS = dict(
+    max_attempts=5, base_delay=0.05, max_delay=2.0, deadline=15.0
+)
+
+
+def default_policy(**overrides) -> RetryPolicy:
+    args = dict(DEFAULT_POLICY_ARGS)
+    args.update(overrides)
+    return RetryPolicy(**args)

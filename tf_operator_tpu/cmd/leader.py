@@ -16,6 +16,16 @@ import os
 import time
 from typing import Optional
 
+from tf_operator_tpu.backend.retry import NETWORK_ERRORS
+
+#: transport failures a lease client must absorb (keep polling / judge
+#: against the lease deadline), not crash on: connection-level OSErrors
+#: AND http.client's own exceptions (IncompleteRead etc. — raised by a
+#: reset mid-response and NOT OSError subclasses), plus bad JSON.  A
+#: renew thread dying on an uncaught one of these with _leading still
+#: True is exactly the split-brain the lease exists to prevent.
+_TRANSIENT_ERRORS = NETWORK_ERRORS + (ValueError,)
+
 
 class FileLease:
     def __init__(self, path: str, identity: str):
@@ -105,8 +115,13 @@ class KubeLease:
         namespace: str = "default",
         lease_duration: float = 15.0,
         on_lost=None,
+        retry=None,
+        metrics=None,
     ):
         import urllib.parse
+
+        from tf_operator_tpu.backend.retry import RetryPolicy
+        from tf_operator_tpu.utils.metrics import default_metrics
 
         u = urllib.parse.urlparse(base_url)
         self.host, self.port = u.hostname or "127.0.0.1", u.port or 80
@@ -115,6 +130,19 @@ class KubeLease:
         self.namespace = namespace
         self.duration = float(lease_duration)
         self.on_lost = on_lost
+        # retry budget deliberately SHORTER than the renew cadence
+        # (duration/3): a flaky apiserver gets a few jittered tries per
+        # renewal tick without one tick's retries spanning the next.
+        # The deadline gates dispatching further attempts; an in-flight
+        # attempt can still overrun it by the 5s transport timeout, so
+        # the renew loop's own lease-deadline check stays the arbiter.
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3,
+            base_delay=0.05,
+            max_delay=0.5,
+            deadline=min(self.duration / 3.0, max(0.2, self.duration / 6.0)),
+        )
+        self.metrics = metrics if metrics is not None else default_metrics
         self._leading = False
         self._stop = None  # renew-thread stop event while leading
         self._lock = __import__("threading").Lock()
@@ -128,19 +156,58 @@ class KubeLease:
             f"/leases/{self.name}"
         )
 
+
     def _request(self, method: str, path: str, body=None):
+        """One (status, obj) round-trip under the retry policy: network
+        errors and 5xx/429 replies retry with jittered backoff; the
+        semantic statuses the election logic branches on (404 absent,
+        409 lost-the-CAS, 200/201) return untouched.  Replays are safe:
+        every mutating call here is a create-if-absent POST or a
+        resourceVersion-preconditioned PATCH (a duplicate of either
+        lands as 409, which the caller already treats as 'lost')."""
+
         from http.client import HTTPConnection
 
-        conn = HTTPConnection(self.host, self.port, timeout=5.0)
-        try:
-            payload = json.dumps(body).encode() if body is not None else None
-            headers = {"Content-Type": "application/json"} if payload else {}
-            conn.request(method, path, body=payload, headers=headers)
-            resp = conn.getresponse()
-            text = resp.read().decode(errors="replace")
-            return resp.status, (json.loads(text) if text else {})
-        finally:
-            conn.close()
+        def attempt():
+            conn = HTTPConnection(self.host, self.port, timeout=5.0)
+            try:
+                payload = (
+                    json.dumps(body).encode() if body is not None else None
+                )
+                headers = (
+                    {"Content-Type": "application/json"} if payload else {}
+                )
+                conn.request(method, path, body=payload, headers=headers)
+                resp = conn.getresponse()
+                text = resp.read().decode(errors="replace")
+                ra = resp.getheader("Retry-After")
+                try:
+                    ra = None if ra is None else float(ra)
+                except ValueError:
+                    ra = None
+                return resp.status, (json.loads(text) if text else {}), ra
+            finally:
+                conn.close()
+
+        def verdict(res):
+            # the policy's own status set, so a narrowed injected
+            # policy narrows BOTH classification paths consistently;
+            # 404/409 are election semantics and return untouched.  A
+            # float verdict floors the next sleep at the server's
+            # Retry-After (backpressure an overloaded apiserver sends
+            # precisely so clients like this stop hammering it).
+            status, _, retry_after = res
+            if status not in self.retry.retry_status:
+                return False
+            return retry_after if retry_after is not None else True
+
+        status, obj, _ = self.retry.call(
+            attempt,
+            client="kube-lease",
+            metrics=self.metrics,
+            retryable_result=verdict,
+        )
+        return status, obj
 
     def _spec(self, transitions: int) -> dict:
         now = time.time()
@@ -162,7 +229,7 @@ class KubeLease:
 
         try:
             return self._try_acquire()
-        except (OSError, ValueError):
+        except _TRANSIENT_ERRORS:
             return False
 
     def _try_acquire(self) -> bool:
@@ -258,7 +325,7 @@ class KubeLease:
                             renewed = status == 200
                     elif status == 404:
                         usurped = True  # lease deleted under us
-                except (OSError, ValueError):
+                except _TRANSIENT_ERRORS:
                     pass  # transient: judged against the deadline below
                 if renewed:
                     last_ok = time.time()
@@ -278,7 +345,7 @@ class KubeLease:
     def holder(self) -> Optional[str]:
         try:
             status, obj = self._request("GET", self._path)
-        except (OSError, ValueError):
+        except _TRANSIENT_ERRORS:
             return None
         if status != 200:
             return None
@@ -309,7 +376,7 @@ class KubeLease:
                         self._path,
                         {"metadata": {"resourceVersion": rv}, "spec": spec},
                     )
-            except (OSError, ValueError):
+            except _TRANSIENT_ERRORS:
                 pass
 
     @property

@@ -17,14 +17,28 @@ Pure Python here; the C++ native engine provides the same surface
 from __future__ import annotations
 
 import heapq
+import random
 import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
 
 class WorkQueue:
-    def __init__(self, base_delay: float = 0.005, max_delay: float = 60.0):
+    def __init__(
+        self,
+        base_delay: float = 0.005,
+        max_delay: float = 60.0,
+        jitter: bool = True,
+        rng: Optional[random.Random] = None,
+    ):
         self._lock = threading.Condition()
+        #: full jitter on rate-limited requeues: when one apiserver
+        #: outage fails every in-flight sync at once, the retries must
+        #: not re-arrive as one synchronized wave (rng injectable for
+        #: deterministic tests; jitter=False restores the exact
+        #: client-go ItemExponentialFailureRateLimiter delays)
+        self.jitter = jitter
+        self._rng = rng or random.Random()
         self._queue: List[str] = []
         self._queued: Set[str] = set()
         self._processing: Set[str] = set()
@@ -89,12 +103,16 @@ class WorkQueue:
     # -- rate limiting ------------------------------------------------------
 
     def add_rate_limited(self, key: str) -> float:
-        """Re-add after exponential backoff; returns the delay applied."""
+        """Re-add after exponential backoff with full jitter; returns
+        the delay applied.  The failure-count read, bump, and delay
+        computation happen under ONE lock acquisition so concurrent
+        workers requeuing the same key can't race the exponent."""
 
         with self._lock:
             failures = self._failures.get(key, 0)
             self._failures[key] = failures + 1
-        delay = min(self.base_delay * (2**failures), self.max_delay)
+            cap = min(self.base_delay * (2**failures), self.max_delay)
+            delay = self._rng.uniform(0.0, cap) if self.jitter else cap
         self.add_after(key, delay)
         return delay
 
@@ -103,7 +121,8 @@ class WorkQueue:
             self._failures.pop(key, None)
 
     def num_requeues(self, key: str) -> int:
-        return self._failures.get(key, 0)
+        with self._lock:
+            return self._failures.get(key, 0)
 
     # -- delayed ------------------------------------------------------------
 

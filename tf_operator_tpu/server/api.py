@@ -145,7 +145,24 @@ class ApiServer:
 
                         return self._send(200, DASHBOARD_HTML, "text/html")
                     if p == ["healthz"]:
-                        return self._send(200, "ok\n", "text/plain")
+                        # liveness plus an apiserver-client fault digest
+                        # (backend/retry.py counters): "ok" stays the
+                        # first token so probes keep matching, and the
+                        # digest tells an operator at a glance whether
+                        # the control plane is riding out API faults
+                        m = outer.metrics
+                        body = (
+                            "ok\n"
+                            f"api_client_retries_total "
+                            f"{m.total('api_client_retries_total'):g}\n"
+                            f"api_client_giveups_total "
+                            f"{m.total('api_client_giveups_total'):g}\n"
+                            f"api_client_circuit_open_total "
+                            f"{m.total('api_client_circuit_open_total'):g}\n"
+                            f"api_events_dropped_total "
+                            f"{m.total('api_events_dropped_total'):g}\n"
+                        )
+                        return self._send(200, body, "text/plain")
                     if p == ["metrics"]:
                         return self._send(
                             200, outer.metrics.exposition(), "text/plain"

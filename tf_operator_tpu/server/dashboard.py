@@ -29,6 +29,9 @@ DASHBOARD_HTML = """<!doctype html>
   .Restarting { color: #a86500; }
   #detail { white-space: pre-wrap; background: #fff; padding: 1rem;
             border: 1px solid #e5e5e5; font-size: .8rem; }
+  #client-health { white-space: pre-wrap; background: #fff; padding: .6rem;
+                   border: 1px solid #e5e5e5; font-size: .75rem; }
+  #client-health.degraded { border-color: #b3261e; }
   .muted { color: #888; font-size: .75rem; }
   #manifest { width: 100%; box-sizing: border-box; font-family: inherit;
               font-size: .8rem; border: 1px solid #e5e5e5; }
@@ -49,6 +52,8 @@ DASHBOARD_HTML = """<!doctype html>
 </h2>
 <div id="spark" style="display:none"></div>
 <div id="detail" style="display:none"></div>
+<h2>api client health</h2>
+<div id="client-health" class="muted">no apiserver client traffic</div>
 <h2>submit job</h2>
 <textarea id="manifest" rows="10"
   placeholder="paste a TPUJob manifest (JSON or YAML)"></textarea>
@@ -101,6 +106,28 @@ async function refresh() {
   document.getElementById("refreshed").textContent =
     "refreshed " + new Date().toLocaleTimeString();
   if (selected) detail();
+  refreshHealth();
+}
+
+async function refreshHealth() {
+  // retry / circuit-breaker / watch-recovery counters from the shared
+  // metrics registry (backend/retry.py): how rough the apiserver
+  // connection is, straight from /metrics
+  let text;
+  try { text = await (await fetch("/metrics")).text(); }
+  catch (e) { return; }
+  const lines = text.split("\\n").filter(l =>
+    l.startsWith("api_client_") || l.startsWith("api_watch_") ||
+    l.startsWith("api_events_dropped") || l.startsWith("api_event_read_"));
+  const el = document.getElementById("client-health");
+  el.textContent = lines.length ? lines.join("\\n")
+                                : "no apiserver client traffic";
+  const bad = lines.some(l =>
+    (l.startsWith("api_client_giveups_total") ||
+     l.startsWith("api_client_circuit_open_total") ||
+     l.startsWith("api_events_dropped_total")) &&
+    parseFloat(l.split(" ").pop()) > 0);
+  el.classList.toggle("degraded", bad);
 }
 
 function highlight() {

@@ -23,6 +23,7 @@ class Metrics:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
+        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._observations: Dict[str, List[float]] = defaultdict(list)
         #: name -> (buckets, counts[len(buckets)+1], sum, count)
         self._histograms: Dict[str, list] = {}
@@ -31,6 +32,19 @@ class Metrics:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._counters[key] += value
+
+    def set(self, name: str, value: float, **labels: str) -> None:
+        """Gauge write (last-value-wins) — e.g. the API clients' last-
+        error timestamps (backend/retry.py)."""
+
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauges[key] = float(value)
+
+    def gauge(self, name: str, **labels: str) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._gauges.get(key, 0.0)
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:
@@ -88,6 +102,15 @@ class Metrics:
         with self._lock:
             return self._counters.get(key, 0.0)
 
+    def total(self, name: str) -> float:
+        """Sum of one counter across all of its label sets (e.g. every
+        client's api_client_retries_total)."""
+
+        with self._lock:
+            return sum(
+                v for (n, _), v in self._counters.items() if n == name
+            )
+
     def summary(self, name: str) -> Dict[str, float]:
         with self._lock:
             vals = sorted(self._observations.get(name, []))
@@ -108,6 +131,9 @@ class Metrics:
         lines = []
         with self._lock:
             for (name, labels), v in sorted(self._counters.items()):
+                label_s = ",".join(f'{k}="{v2}"' for k, v2 in labels)
+                lines.append(f"{name}{{{label_s}}} {v}" if label_s else f"{name} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
                 label_s = ",".join(f'{k}="{v2}"' for k, v2 in labels)
                 lines.append(f"{name}{{{label_s}}} {v}" if label_s else f"{name} {v}")
             for name, vals in sorted(self._observations.items()):
