@@ -4,7 +4,13 @@ Mirrors the reference's colocated API unit tests (SURVEY.md §4 tier 1).
 """
 
 import pytest
-from hypothesis import given, settings, strategies as st
+
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:  # boxes without hypothesis: property tests skip
+    from tests.testutil import import_hypothesis_or_stubs
+
+    given, settings, st = import_hypothesis_or_stubs()
 
 from tf_operator_tpu.api.defaults import (
     DEFAULT_CLEAN_POD_POLICY,

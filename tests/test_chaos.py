@@ -12,7 +12,13 @@ terminal or fully materialised, and no deleted job leaves pods behind.
 import time
 
 import pytest
-from hypothesis import given, settings, strategies as st
+
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:  # boxes without hypothesis: property tests skip
+    from tests.testutil import import_hypothesis_or_stubs
+
+    given, settings, st = import_hypothesis_or_stubs()
 
 from tests.testutil import new_job
 from tf_operator_tpu.api.types import (

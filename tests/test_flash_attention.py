@@ -391,6 +391,7 @@ class TestWindowProperty:
     match the banded reference, forward and gradients."""
 
     def test_random_configs(self):
+        pytest.importorskip("hypothesis")
         from hypothesis import given, settings, strategies as st
 
         from tf_operator_tpu.ops.flash_attention import flash_attention

@@ -5,6 +5,9 @@ tf_operator_tpu/backend/ and tf_operator_tpu/cmd/ — every broad
 handler there now retries, counts, or logs.  This AST walk keeps it
 that way: a NEW bare swallow (``except Exception:``/``except:`` whose
 body is only ``pass``/``...``) in those packages fails tier-1.
+ISSUE 2 extended the gate over controller/, server/ and utils/ — the
+whole control-plane vertical the tracing subsystem instruments (a
+silent swallow there would also silently eat span/status recording).
 
 Narrow handlers (``except OSError: pass``) stay allowed — ignoring a
 specific expected error is a decision; ignoring *everything* silently
@@ -17,7 +20,7 @@ import pathlib
 import tf_operator_tpu
 
 PKG_ROOT = pathlib.Path(tf_operator_tpu.__file__).parent
-CHECKED_PACKAGES = ("backend", "cmd")
+CHECKED_PACKAGES = ("backend", "cmd", "controller", "server", "utils")
 
 #: exception names considered "broad" — swallowing these silently
 #: hides every bug class at once
@@ -52,6 +55,8 @@ def _is_silent(handler: ast.ExceptHandler) -> bool:
 def find_silent_broad_excepts(root: pathlib.Path):
     offenders = []
     for pkg in CHECKED_PACKAGES:
+        if not (root / pkg).is_dir():
+            continue  # planted-offender fixtures build partial trees
         for path in sorted((root / pkg).rglob("*.py")):
             tree = ast.parse(path.read_text(), filename=str(path))
             for node in ast.walk(tree):

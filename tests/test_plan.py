@@ -4,7 +4,13 @@ semantics must match the reference behaviors the reconciler tests pin.
 """
 
 import pytest
-from hypothesis import given, settings, strategies as st
+
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:  # boxes without hypothesis: property tests skip
+    from tests.testutil import import_hypothesis_or_stubs
+
+    given, settings, st = import_hypothesis_or_stubs()
 
 from tf_operator_tpu import native
 from tf_operator_tpu.api.types import (

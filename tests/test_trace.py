@@ -1,0 +1,510 @@
+"""Tracing subsystem tests (ISSUE 2): tracer/store semantics, the
+x-trace-id propagation contract through the kubesim apiserver and the
+retrying HTTP clients, the /traces read surface, and the acceptance
+e2e — one trace id stitching apiserver request → workqueue →
+reconcile sync → every backend retry attempt under a ≥10% mixed fault
+schedule, with the slow-sync warn log naming the trace.
+"""
+
+import json
+import logging
+import random
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.testutil import new_job
+from tf_operator_tpu.api.types import JobConditionType, PodPhase, SuccessPolicy
+from tf_operator_tpu.backend.kube import KubeBackend
+from tf_operator_tpu.backend.kubejobs import KubeJobStore
+from tf_operator_tpu.backend.kubesim import MiniApiServer
+from tf_operator_tpu.backend.retry import RetryPolicy
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.controller.reconciler import ReconcilerConfig
+from tf_operator_tpu.server.api import ApiServer
+from tf_operator_tpu.utils.metrics import Metrics
+from tf_operator_tpu.utils.trace import (
+    TraceStore,
+    Tracer,
+    extract_headers,
+    inject_headers,
+)
+
+EXIT0 = [sys.executable, "-c", "raise SystemExit(0)"]
+
+
+def fast_policy(seed=0, **kw):
+    kw.setdefault("max_attempts", 6)
+    kw.setdefault("base_delay", 0.02)
+    kw.setdefault("max_delay", 0.2)
+    kw.setdefault("deadline", 5.0)
+    return RetryPolicy(rng=random.Random(seed), **kw)
+
+
+def wait_until(cond, timeout=15.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(what)
+
+
+class TestTracerCore:
+    def test_ids_deterministic_under_seed(self):
+        """No wall-clock/random flake: two tracers with the same seed
+        mint the same trace and span id sequences."""
+
+        a, b = Tracer(seed=42), Tracer(seed=42)
+        ids_a = [a.start_span(f"s{i}", root=True) for i in range(5)]
+        ids_b = [b.start_span(f"s{i}", root=True) for i in range(5)]
+        assert [s.trace_id for s in ids_a] == [s.trace_id for s in ids_b]
+        assert [s.span_id for s in ids_a] == [s.span_id for s in ids_b]
+        # a different seed gives a different session prefix
+        assert Tracer(seed=43).start_span("x").trace_id != ids_a[0].trace_id
+
+    def test_context_parenting(self):
+        tr = Tracer(seed=0)
+        with tr.span("parent") as p:
+            assert tr.current_trace_id() == p.trace_id
+            with tr.span("child") as c:
+                assert c.trace_id == p.trace_id
+                assert c.parent_id == p.span_id
+                with tr.span("grandchild") as g:
+                    assert g.parent_id == c.span_id
+        assert tr.current_trace_id() is None
+
+    def test_exception_marks_error_and_restores_context(self):
+        tr = Tracer(seed=0)
+        with pytest.raises(ValueError):
+            with tr.span("boom") as sp:
+                raise ValueError("nope")
+        assert sp.status == "error"
+        assert "ValueError" in sp.status_message
+        assert tr.current_trace_id() is None
+        stored = tr.store.trace(sp.trace_id)
+        assert stored is not None and stored["error"]
+
+    def test_explicit_trace_id_joins_remote_trace(self):
+        tr = Tracer(seed=0)
+        sp = tr.start_span("server", trace_id="tremote", parent_id="sremote")
+        assert sp.trace_id == "tremote" and sp.parent_id == "sremote"
+        sp.end()
+        assert tr.store.trace("tremote") is not None
+
+    def test_header_inject_extract_round_trip(self):
+        tr = Tracer(seed=0)
+        with tr.span("op") as sp:
+            headers = inject_headers({})
+        assert headers == {
+            "x-trace-id": sp.trace_id, "x-parent-span-id": sp.span_id,
+        }
+        tid, parent = extract_headers(headers)
+        assert (tid, parent) == (sp.trace_id, sp.span_id)
+        assert inject_headers({}) == {}  # no active trace: no-op
+
+    def test_explicit_start_end_mono(self):
+        """queue.wait-style spans backdate their start to the enqueue
+        timestamp so the waterfall shows the real wait."""
+
+        tr = Tracer(seed=0)
+        now = time.monotonic()
+        sp = tr.start_span("queue.wait", start_mono=now - 2.5)
+        sp.end(end_mono=now)
+        assert 2.49 <= sp.duration <= 2.51
+        sp.end()  # idempotent
+        assert 2.49 <= sp.duration <= 2.51
+
+
+class TestTraceStore:
+    def _span(self, tr, name="op", error=False, slow=False):
+        sp = tr.start_span(name, root=True)
+        if error:
+            sp.set_error("x")
+        if slow:
+            sp.end(end_mono=sp.start_mono + 10.0)
+        else:
+            sp.end(end_mono=sp.start_mono + 0.001)
+        return sp
+
+    def test_eviction_keeps_error_and_slow(self):
+        store = TraceStore(max_traces=4, slow_seconds=1.0)
+        tr = Tracer(store=store, seed=0)
+        err = self._span(tr, error=True)
+        slow = self._span(tr, slow=True)
+        ok = [self._span(tr) for _ in range(6)]
+        assert len(store) == 4
+        # tail sampling: the error and slow traces survive; the evicted
+        # ones are all ok-and-fast
+        assert store.trace(err.trace_id) is not None
+        assert store.trace(slow.trace_id) is not None
+        assert store.trace(ok[0].trace_id) is None
+
+    def test_eviction_bounded_even_when_all_protected(self):
+        """A store full of protected traces keeps accepting NEW traces
+        (oldest protected evicted) — it must not wedge on its first
+        max_traces errors and silently drop everything after."""
+
+        store = TraceStore(max_traces=3, slow_seconds=1.0)
+        tr = Tracer(store=store, seed=0)
+        spans = [self._span(tr, error=True) for _ in range(10)]
+        assert len(store) == 3
+        # the newest error traces survive; the oldest were evicted
+        assert store.trace(spans[-1].trace_id) is not None
+        assert store.trace(spans[-2].trace_id) is not None
+        assert store.trace(spans[0].trace_id) is None
+
+    def test_per_trace_span_cap_counts_drops(self):
+        store = TraceStore(max_spans_per_trace=5)
+        tr = Tracer(store=store, seed=0)
+        with tr.span("root") as root:
+            for i in range(9):
+                tr.start_span(f"c{i}").end()
+        t = store.trace(root.trace_id)
+        assert len(t["spans"]) == 5
+        assert t["droppedSpans"] == 5  # 9 children + root - 5 kept
+
+    def test_summaries_and_jsonl_export(self, tmp_path):
+        store = TraceStore()
+        tr = Tracer(store=store, seed=0)
+        with tr.span("outer"):
+            tr.start_span("queue.wait").end()
+        s = store.summaries()
+        assert len(s) == 1
+        assert s[0]["root"] == "outer" and s[0]["spanCount"] == 2
+        out = tmp_path / "spans.jsonl"
+        with open(out, "w") as f:
+            n = store.export_jsonl(f)
+        lines = [json.loads(l) for l in out.read_text().splitlines()]
+        assert n == len(lines) == 2
+        assert {l["name"] for l in lines} == {"outer", "queue.wait"}
+
+
+class TestQueueLatencyCapture:
+    def test_deduped_readd_keeps_first_enqueue_timestamp(self):
+        """client-go workqueue semantics: the queue dedups re-adds of a
+        pending key, so the latency clock must run from the FIRST
+        unprocessed add — re-adds during a backlog must not reset it."""
+
+        from tf_operator_tpu.backend.fake import FakeCluster
+        from tf_operator_tpu.backend.jobstore import JobStore
+
+        c = TPUJobController(
+            JobStore(), FakeCluster(), resync_period=0,
+            tracer=Tracer(seed=0),
+        )
+        try:
+            c._enqueue("default/j")
+            first = c._pending_trace["default/j"]
+            time.sleep(0.02)
+            c._enqueue("default/j")  # deduped re-add
+            assert c._pending_trace["default/j"] == first
+        finally:
+            c.stop()
+
+
+class TestSimPropagation:
+    """The wire contract: EVERY kubesim apiserver response carries
+    x-trace-id — echoed when the caller sent one, minted otherwise —
+    and the server records a span per request, tagged with any
+    injected fault."""
+
+    @pytest.fixture
+    def sim(self):
+        tracer = Tracer(seed=5)
+        s = MiniApiServer(fault_seed=0, tracer=tracer).start()
+        yield s
+        s.stop()
+
+    def _get(self, sim, path, headers=None, method="GET", data=None):
+        req = urllib.request.Request(
+            sim.url + path, headers=headers or {}, method=method, data=data
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers)
+
+    def test_every_response_carries_trace_id(self, sim):
+        for path, method, data in [
+            ("/api/v1/pods", "GET", None),
+            ("/api/v1/namespaces/default/pods/nope", "GET", None),  # 404
+            ("/_faults", "GET", None),
+            (
+                "/api/v1/namespaces/default/pods", "POST",
+                json.dumps({"metadata": {"name": "p1"}, "spec": {}}).encode(),
+            ),
+        ]:
+            _, headers = self._get(sim, path, method=method, data=data)
+            assert headers.get("x-trace-id"), f"{method} {path}"
+
+    def test_incoming_trace_id_echoed_and_adopted(self, sim):
+        code, headers = self._get(
+            sim, "/api/v1/pods", headers={"x-trace-id": "tcaller01"}
+        )
+        assert code == 200
+        assert headers["x-trace-id"] == "tcaller01"
+        t = sim.tracer.store.trace("tcaller01")
+        assert t is not None
+        [span] = t["spans"]
+        assert span["name"] == "apiserver GET /api/v1/pods"
+        assert span["kind"] == "server"
+
+    def test_fault_injected_reply_is_traced_and_tagged(self, sim):
+        sim.faults.add(
+            path=r"/api/v1/pods", mode="error", status=503, times=1
+        )
+        code, headers = self._get(
+            sim, "/api/v1/pods", headers={"x-trace-id": "tfault01"}
+        )
+        assert code == 503
+        assert headers["x-trace-id"] == "tfault01"
+        [span] = sim.tracer.store.trace("tfault01")["spans"]
+        assert span["attributes"]["fault"] == "error"
+        assert span["status"] == "error"
+
+    def test_watch_response_carries_trace_id(self, sim):
+        req = urllib.request.Request(
+            sim.url + "/api/v1/pods?watch=true&resourceVersion=0",
+            headers={"x-trace-id": "twatch01"},
+        )
+        resp = urllib.request.urlopen(req, timeout=5)
+        try:
+            assert resp.headers["x-trace-id"] == "twatch01"
+        finally:
+            resp.close()
+        t = sim.tracer.store.trace("twatch01")
+        assert t is not None and t["spans"][0]["attributes"]["watch"] is True
+
+
+class TestRetryAttemptSpans:
+    def test_one_attempt_span_per_retry(self):
+        """A fault-injected retry sequence yields one client span per
+        attempt — 0-based attempt numbers, failures marked error, the
+        final success ok — all under ONE trace id, with matching
+        server spans."""
+
+        tracer = Tracer(seed=9)
+        m = Metrics()
+        sim = MiniApiServer(fault_seed=0, tracer=tracer).start()
+        backend = KubeBackend(
+            sim.url, retry=fast_policy(), metrics=m, tracer=tracer
+        )
+        try:
+            sim.faults.add(
+                path=r"/api/v1/namespaces/default/pods$", methods=["POST"],
+                mode="error", status=503, retry_after=0.01, times=2,
+            )
+            from tf_operator_tpu.api.types import Container, ObjectMeta
+            from tf_operator_tpu.backend.objects import Pod
+
+            with tracer.span("test.create") as root:
+                backend.create_pod(Pod(
+                    metadata=ObjectMeta(name="p1", namespace="default"),
+                    containers=[Container(command=list(EXIT0))],
+                ))
+            trace = tracer.store.trace(root.trace_id)
+            attempts = [
+                s for s in trace["spans"]
+                if s["name"] == "http POST /api/v1/namespaces/default/pods"
+            ]
+            assert [s["attributes"]["attempt"] for s in attempts] == [0, 1, 2]
+            assert [s["status"] for s in attempts] == ["error", "error", "ok"]
+            assert all(
+                s["attributes"].get("injectedFault") for s in attempts[:2]
+            )
+            servers = [
+                s for s in trace["spans"]
+                if s["name"] == "apiserver POST /api/v1/namespaces/default/pods"
+            ]
+            assert len(servers) == 3  # one server span per client attempt
+            # exemplar linkage: the error counter names this trace
+            assert m.exemplar("api_client_errors_total") == root.trace_id
+        finally:
+            backend.close()
+            sim.stop()
+
+
+class TestTraceApi:
+    def test_traces_endpoints_and_response_header(self):
+        from tf_operator_tpu.backend.fake import FakeCluster
+        from tf_operator_tpu.backend.jobstore import JobStore
+        from tf_operator_tpu.utils.events import EventRecorder
+
+        tracer = Tracer(seed=3)
+        with tracer.span("seeded.op") as sp:
+            tracer.start_span("child").end()
+        api = ApiServer(
+            JobStore(), FakeCluster(), Metrics(), EventRecorder(),
+            tracer=tracer,
+        )
+        api.start()
+        base = f"http://127.0.0.1:{api.port}"
+        try:
+            with urllib.request.urlopen(base + "/traces", timeout=5) as r:
+                items = json.loads(r.read())["items"]
+            assert any(t["traceId"] == sp.trace_id for t in items)
+            with urllib.request.urlopen(
+                base + f"/traces/{sp.trace_id}", timeout=5
+            ) as r:
+                trace = json.loads(r.read())
+            assert {s["name"] for s in trace["spans"]} == {
+                "seeded.op", "child",
+            }
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/traces/tmissing", timeout=5)
+            assert ei.value.code == 404
+            # job-API responses carry x-trace-id (observability routes
+            # like /traces itself are deliberately untraced)
+            with urllib.request.urlopen(
+                base + "/apis/v1/tpujobs", timeout=5
+            ) as r:
+                assert r.headers["x-trace-id"]
+        finally:
+            api.stop()
+
+
+class TestE2EWaterfallUnderFaults:
+    """ISSUE 2 acceptance: a multi-replica job reaches Succeeded under
+    a ≥10% mixed fault schedule, and ONE trace id links the apiserver
+    request spans, the workqueue queue-latency span, the reconcile
+    sync, and every backend retry attempt — with /traces/<id> serving
+    the waterfall and the slow-sync warn log naming the trace."""
+
+    def test_single_trace_links_the_vertical(self, caplog):
+        tracer = Tracer(seed=1234)
+        sim = MiniApiServer(fault_seed=1234, tracer=tracer).start()
+        # ~13% combined fault probability on every route, plus a
+        # deterministic 2-shot 503 on the first pod create so at least
+        # one sync provably contains a retry ladder
+        sim.faults.add(
+            path=r"/api/v1/namespaces/default/pods$", methods=["POST"],
+            mode="error", status=503, retry_after=0.01, times=2,
+        )
+        sim.faults.add(mode="error", status=503, retry_after=0.02,
+                       probability=0.05)
+        sim.faults.add(mode="error", status=429, probability=0.04)
+        sim.faults.add(mode="reset", probability=0.04)
+
+        m = Metrics()
+        store = KubeJobStore(
+            sim.url, retry=fast_policy(seed=1), metrics=m, tracer=tracer
+        )
+        backend = KubeBackend(
+            sim.url, retry=fast_policy(seed=2), metrics=m, tracer=tracer
+        )
+        controller = TPUJobController(
+            store, backend,
+            config=ReconcilerConfig(
+                resolver=backend.resolver,
+                # every sync "slow"-warns so the exemplar linkage is
+                # deterministically exercised
+                slow_sync_warn_seconds=0.0,
+            ),
+            metrics=m, resync_period=0.3, expectations_timeout=0.3,
+            tracer=tracer,
+        )
+        api = ApiServer(
+            store, backend, m, controller.recorder, tracer=tracer
+        )
+        api.start()
+
+        crashes = []
+        prev_hook = threading.excepthook
+        threading.excepthook = lambda args: crashes.append(args)
+        caplog.set_level(logging.WARNING, logger="tpujob")
+        try:
+            controller.run(threadiness=2)
+            job = new_job("traced", worker=3, command=EXIT0)
+            job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+            store.create(job)
+
+            def succeeded():
+                j = store.get("default", "traced")
+                return j is not None and j.status.has_condition(
+                    JobConditionType.SUCCEEDED
+                )
+
+            wait_until(succeeded, timeout=60.0, what="job Succeeded")
+            pods = backend.list_pods("default")
+            assert all(p.phase is PodPhase.SUCCEEDED for p in pods)
+
+            # ---- find the sync trace that rode out the 503 ladder on
+            # the pod-create route (other traces may carry retries on
+            # list/status routes; this one provably has the 2-shot rule)
+            target = None
+            for summary in tracer.store.summaries(limit=250):
+                t = tracer.store.trace(summary["traceId"])
+                if any(
+                    s["kind"] == "client"
+                    and s["name"].endswith("/namespaces/default/pods")
+                    and s["name"].startswith("http POST")
+                    and s["attributes"].get("attempt", 0) >= 1
+                    for s in t["spans"]
+                ):
+                    target = t
+                    break
+            assert target is not None, "no trace with a retried pod create"
+            names = [s["name"] for s in target["spans"]]
+            # the full vertical under ONE trace id:
+            assert any(n.startswith("sync default/") for n in names)
+            assert "queue.wait" in names
+            assert any(n.startswith("reconcile default/") for n in names)
+            assert any(n.startswith("pod.create") for n in names)
+            assert any(n.startswith("apiserver POST") for n in names)
+            # ...and every retry attempt is its own span: each
+            # pod.create wraps exactly one backend call, so its client
+            # children's attempt numbers form a contiguous 0..n ladder
+            pod_creates = {
+                s["spanId"] for s in target["spans"]
+                if s["name"].startswith("pod.create")
+            }
+            ladders = {}
+            for s in target["spans"]:
+                if s["kind"] == "client" and s["parentId"] in pod_creates:
+                    ladders.setdefault(s["parentId"], []).append(
+                        s["attributes"]["attempt"]
+                    )
+            assert ladders
+            for parent, attempts in ladders.items():
+                assert sorted(attempts) == list(range(len(attempts))), parent
+            assert any(
+                max(a) >= 2 for a in ladders.values()
+            ), "the 2-shot 503 ladder should show attempts 0,1,2"
+
+            # ---- the slow-sync warn log names this trace
+            slow_ids = set()
+            for rec in caplog.records:
+                msg = rec.getMessage()
+                if "slow sync" in msg:
+                    found = re.search(r"trace=(\S+?)[),\]]", msg)
+                    if found:
+                        slow_ids.add(found.group(1))
+            assert target["traceId"] in slow_ids
+
+            # ---- /traces/<id> serves the complete waterfall over HTTP
+            base = f"http://127.0.0.1:{api.port}"
+            with urllib.request.urlopen(
+                base + f"/traces/{target['traceId']}", timeout=5
+            ) as r:
+                served = json.loads(r.read())
+            assert {s["spanId"] for s in served["spans"]} == {
+                s["spanId"] for s in target["spans"]
+            }
+            # queue-latency metrics flowed
+            assert m.histogram("workqueue_queue_latency_seconds")["count"] > 0
+            assert sim.faults.total_injected() > 0
+        finally:
+            threading.excepthook = prev_hook
+            api.stop()
+            controller.stop()
+            backend.close()
+            store.close()
+            sim.stop()
+        assert not crashes, f"unhandled thread exceptions: {crashes}"

@@ -89,7 +89,16 @@ def test_sp1_falls_back_to_plain():
 def test_gpt_ulysses_matches_no_sp():
     """Ulysses training (sp=4) must match plain attention (sp=1)
     numerically — same model, same data, same init (the ring twin of
-    this test is tests/test_models.py::test_gpt_sp_matches_no_sp)."""
+    this test is tests/test_models.py::test_gpt_sp_matches_no_sp).
+
+    Tolerance note (ISSUE 2 triage): this compares runs on DIFFERENT
+    mesh shapes ({dp:8} vs {dp:2,sp:4}), and XLA re-fuses the whole
+    model per sharding layout — a {dp:2,fsdp:4} control (identical
+    math, no sequence parallelism at all) shows the same ~2e-3
+    relative loss drift vs {dp:8} on CPU f32.  The op-level
+    equivalence stays pinned at 2e-5 (tests above); 5e-3 here still
+    catches wiring bugs (wrong mask/schedule shifts loss by O(0.1+))
+    without failing on cross-mesh fusion noise."""
 
     from tf_operator_tpu.models import gpt_tiny, lm_loss
     from tf_operator_tpu.parallel import Trainer, TrainerConfig
@@ -119,7 +128,7 @@ def test_gpt_ulysses_matches_no_sp():
         losses[label] = [
             float(tr.train_step(tr.shard_batch(batch))["loss"]) for _ in range(3)
         ]
-    np.testing.assert_allclose(losses["nosp"], losses["ulysses"], rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(losses["nosp"], losses["ulysses"], rtol=5e-3, atol=5e-3)
 
 
 class TestUlyssesGQA:

@@ -53,7 +53,7 @@ from tf_operator_tpu.backend.base import (
     NotFoundError,
 )
 from tf_operator_tpu.backend.local import LocalResolver
-from tf_operator_tpu.backend.retry import watch_recovery
+from tf_operator_tpu.backend.retry import NETWORK_ERRORS, watch_recovery
 from tf_operator_tpu.backend.objects import (
     Pod,
     PodGroup,
@@ -327,6 +327,7 @@ def http_json(
     host: str, port: int, method: str, path: str,
     body: Optional[dict] = None, timeout: float = 5.0,
     policy=None, metrics=None, client: str = "api", breaker=None,
+    tracer=None,
 ) -> dict:
     """One JSON request with the apiserver error mapping (shared by
     KubeBackend and the TPUJob store, backend/kubejobs.py).
@@ -335,16 +336,47 @@ def http_json(
     transient failures — 429/5xx responses, connection resets, broken
     sockets — under the policy's jittered-backoff budget, honoring
     Retry-After; 404/409/410 stay semantic and raise immediately.
+
+    Tracing: when a trace is active (utils/trace contextvar), EVERY
+    attempt — including each retry — records its own client span
+    tagged with the 0-based ``attempt`` number and carries the trace
+    id to the server in ``x-trace-id``, so one waterfall shows the
+    whole retry ladder against the apiserver's matching server spans.
+    Semantic statuses (404/409/410) stay span-status ok — they are
+    normal reconcile traffic, exactly like the retry classifier.
     """
 
+    from tf_operator_tpu.utils.trace import default_tracer, inject_headers
+
+    tr = tracer if tracer is not None else default_tracer
+    route = path.split("?")[0]
+    attempt_n = [0]
+
     def attempt() -> dict:
+        span = None
+        if tr.current_trace_id() is not None:
+            span = tr.start_span(
+                f"http {method} {route}",
+                kind="client",
+                attributes={
+                    "client": client, "method": method,
+                    "attempt": attempt_n[0],
+                },
+            )
+        attempt_n[0] += 1
         conn = HTTPConnection(host, port, timeout=timeout)
         try:
             payload = json.dumps(body).encode() if body is not None else None
             headers = {"Content-Type": "application/json"} if payload else {}
+            if span is not None:
+                inject_headers(headers, span)
             conn.request(method, path, body=payload, headers=headers)
             resp = conn.getresponse()
             text = resp.read().decode(errors="replace")
+            if span is not None:
+                span.set_attribute("status", resp.status)
+                if "FaultInjected" in text:
+                    span.set_attribute("injectedFault", True)
             if resp.status == 404:
                 raise NotFoundError(path)
             if resp.status == 409:
@@ -359,9 +391,17 @@ def http_json(
                         err.retry_after = float(ra)
                     except ValueError:
                         pass
+                if span is not None:
+                    span.set_error(f"apiserver {resp.status}")
                 raise err
             return json.loads(text) if text else {}
+        except NETWORK_ERRORS as e:
+            if span is not None:
+                span.set_error(f"{type(e).__name__}: {e}")
+            raise
         finally:
+            if span is not None:
+                span.end()
             conn.close()
 
     if policy is None:
@@ -391,9 +431,11 @@ class KubeBackend(ClusterBackend):
         retry=None,
         metrics=None,
         breaker=None,
+        tracer=None,
     ):
         from tf_operator_tpu.backend.retry import CircuitBreaker, default_policy
         from tf_operator_tpu.utils.metrics import default_metrics
+        from tf_operator_tpu.utils.trace import default_tracer
 
         u = urllib.parse.urlparse(base_url)
         if u.scheme != "http":
@@ -407,6 +449,7 @@ class KubeBackend(ClusterBackend):
         self.retry = retry if retry is not None else default_policy()
         self.metrics = metrics if metrics is not None else default_metrics
         self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self.tracer = tracer if tracer is not None else default_tracer
         #: local subprocess pods → local address resolution, same
         #: contract as LocalProcessBackend.resolver
         self.resolver = LocalResolver()
@@ -425,7 +468,7 @@ class KubeBackend(ClusterBackend):
         return http_json(
             self.host, self.port, method, path, body, self.timeout,
             policy=self.retry, metrics=self.metrics, client="kube-backend",
-            breaker=self.breaker,
+            breaker=self.breaker, tracer=self.tracer,
         )
 
     @staticmethod

@@ -87,7 +87,10 @@ class KubeJobStore:
     def __init__(
         self, base_url: str, timeout: float = 5.0,
         retry: Optional[RetryPolicy] = None, metrics=None, breaker=None,
+        tracer=None,
     ):
+        from tf_operator_tpu.utils.trace import default_tracer
+
         u = urllib.parse.urlparse(base_url)
         self.host = u.hostname or "127.0.0.1"
         self.port = u.port or 80
@@ -95,6 +98,7 @@ class KubeJobStore:
         self.retry = retry if retry is not None else default_policy()
         self.metrics = metrics if metrics is not None else default_metrics
         self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self.tracer = tracer if tracer is not None else default_tracer
         self._handlers: List[WatchHandler] = []
         self._handlers_lock = threading.Lock()
         self._stop = threading.Event()
@@ -105,7 +109,7 @@ class KubeJobStore:
         return http_json(
             self.host, self.port, method, path, body, self.timeout,
             policy=self.retry, metrics=self.metrics, client="kube-jobs",
-            breaker=self.breaker,
+            breaker=self.breaker, tracer=self.tracer,
         )
 
     # -- JobStore surface ---------------------------------------------------
@@ -125,7 +129,8 @@ class KubeJobStore:
         def attempt():
             try:
                 return http_json(
-                    self.host, self.port, "POST", path, d, self.timeout
+                    self.host, self.port, "POST", path, d, self.timeout,
+                    tracer=self.tracer,
                 )
             except NETWORK_ERRORS:
                 # the send died without a response: the server may or

@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from tf_operator_tpu.api.types import ANNOTATION_GANG_GROUP
 from tf_operator_tpu.backend.base import match_selector
 from tf_operator_tpu.backend.kube import parse_selector
+from tf_operator_tpu.utils.trace import TRACE_HEADER, extract_headers
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -262,12 +263,20 @@ class MiniApiServer:
         log_dir: Optional[str] = None,
         kubelet_interval: float = 0.05,
         fault_seed: Optional[int] = None,
+        tracer=None,
     ):
         import tempfile
+
+        from tf_operator_tpu.utils.trace import default_tracer
 
         self.store = _Store()
         #: per-route/per-verb fault schedule (chaos tests + /_faults)
         self.faults = FaultInjector(seed=fault_seed)
+        #: server-side request spans: adopts an incoming x-trace-id
+        #: (minting one otherwise) and echoes it on every response —
+        #: in-process deployments share the operator's default tracer,
+        #: so /traces/<id> shows client AND server halves of each call
+        self.tracer = tracer if tracer is not None else default_tracer
         self.total_chips = total_chips
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="tpujob-kubesim-")
         self.kubelet_interval = kubelet_interval
@@ -391,6 +400,11 @@ class MiniApiServer:
             "text/plain" if text is not None else "application/json",
         )
         h.send_header("Content-Length", str(len(body)))
+        span = getattr(h, "_trace_span", None)
+        if span is not None:
+            # the propagation contract: EVERY response names its trace
+            h.send_header(TRACE_HEADER, span.trace_id)
+            span.set_attribute("status", status)
         for k, v in (headers or {}).items():
             h.send_header(k, v)
         h.end_headers()
@@ -439,14 +453,34 @@ class MiniApiServer:
         return kind, ns, name, sub
 
     def _handle(self, h, method: str) -> None:
+        # server span: adopt the caller's trace (x-trace-id header) or
+        # mint one; echoed on every reply by _reply, tagged with any
+        # injected fault so the waterfall names the failure source
+        tid, parent = extract_headers(h.headers)
+        span = self.tracer.start_span(
+            f"apiserver {method} {h.path.split('?')[0]}",
+            kind="server",
+            trace_id=tid,
+            parent_id=parent,
+            attributes={"method": method},
+        )
+        h._trace_span = span
+        try:
+            return self._handle_traced(h, method, span)
+        finally:
+            span.end()
+
+    def _handle_traced(self, h, method: str, span) -> None:
         u = urllib.parse.urlparse(h.path)
         q = urllib.parse.parse_qs(u.query)
         if u.path == "/_faults":
             return self._admin_faults(h, method)
         act = self.faults.decide(method, h.path)
         if act is not None:
+            span.set_attribute("fault", act[0])
             if act[0] == "error":
                 _, code, retry_after = act
+                span.set_error(f"injected {code}")
                 extra = (
                     {"Retry-After": f"{retry_after:g}"}
                     if retry_after is not None
@@ -459,6 +493,7 @@ class MiniApiServer:
                     headers=extra,
                 )
             if act[0] == "reset":
+                span.set_error("injected connection reset")
                 # RST, not FIN: SO_LINGER 0 makes close() abort the
                 # connection, so the client sees ECONNRESET mid-request
                 try:
@@ -763,6 +798,13 @@ class MiniApiServer:
             h.send_response(200)
             h.send_header("Content-Type", "application/json")
             h.send_header("Transfer-Encoding", "chunked")
+            span = getattr(h, "_trace_span", None)
+            if span is not None:
+                h.send_header(TRACE_HEADER, span.trace_id)
+                # streams outlive any sane span duration: the traced
+                # unit is the watch ACCEPT; end it once committed
+                span.set_attribute("watch", True)
+                span.end()
             h.end_headers()
 
             def emit(etype: str, obj: Dict[str, Any]) -> None:

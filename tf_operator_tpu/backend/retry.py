@@ -47,6 +47,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from tf_operator_tpu.utils.trace import current_trace_id
+
 #: statuses safe to retry blindly (see module docstring for why this
 #: includes non-idempotent verbs against this operator's apiservers)
 RETRYABLE_STATUS = (429, 500, 502, 503, 504)
@@ -215,7 +217,9 @@ class RetryPolicy:
             if breaker is not None and not breaker.allow():
                 if metrics is not None:
                     metrics.inc(
-                        "api_client_circuit_open_total", client=client
+                        "api_client_circuit_open_total",
+                        exemplar=current_trace_id(),
+                        client=client,
                     )
                 raise CircuitOpenError(
                     f"{client}: circuit open (apiserver presumed down)"
@@ -238,6 +242,7 @@ class RetryPolicy:
                 if metrics is not None:
                     metrics.inc(
                         "api_client_errors_total",
+                        exemplar=current_trace_id(),
                         client=client,
                         error=type(e).__name__,
                     )
@@ -271,6 +276,7 @@ class RetryPolicy:
                 if metrics is not None:
                     metrics.inc(
                         "api_client_errors_total",
+                        exemplar=current_trace_id(),
                         client=client,
                         error="retryable_status",
                     )
@@ -298,7 +304,10 @@ class RetryPolicy:
 
         if attempt + 1 >= self.max_attempts:
             if metrics is not None:
-                metrics.inc("api_client_giveups_total", client=client)
+                metrics.inc(
+                    "api_client_giveups_total",
+                    exemplar=current_trace_id(), client=client,
+                )
             return False
         delay = self.backoff(attempt)
         if retry_after is not None:
@@ -308,7 +317,10 @@ class RetryPolicy:
             and (self._clock() - start) + delay > self.deadline
         ):
             if metrics is not None:
-                metrics.inc("api_client_giveups_total", client=client)
+                metrics.inc(
+                    "api_client_giveups_total",
+                    exemplar=current_trace_id(), client=client,
+                )
             return False
         if metrics is not None:
             metrics.inc("api_client_retries_total", client=client)

@@ -117,11 +117,13 @@ class KubeLease:
         on_lost=None,
         retry=None,
         metrics=None,
+        tracer=None,
     ):
         import urllib.parse
 
         from tf_operator_tpu.backend.retry import RetryPolicy
         from tf_operator_tpu.utils.metrics import default_metrics
+        from tf_operator_tpu.utils.trace import default_tracer
 
         u = urllib.parse.urlparse(base_url)
         self.host, self.port = u.hostname or "127.0.0.1", u.port or 80
@@ -143,9 +145,26 @@ class KubeLease:
             deadline=min(self.duration / 3.0, max(0.2, self.duration / 6.0)),
         )
         self.metrics = metrics if metrics is not None else default_metrics
+        self.tracer = tracer if tracer is not None else default_tracer
         self._leading = False
         self._stop = None  # renew-thread stop event while leading
         self._lock = __import__("threading").Lock()
+
+    def _transition(self, event: str, **attrs) -> None:
+        """Leadership transitions as instant root spans: acquired /
+        lost / released show up in the trace store next to the syncs
+        they gate, and the transition counter gets the trace exemplar."""
+
+        span = self.tracer.start_span(
+            "leader.transition", root=True,
+            attributes={"event": event, "identity": self.identity, **attrs},
+        )
+        if event == "lost":
+            span.set_error(f"leadership lost ({attrs.get('reason', '?')})")
+        span.end()
+        self.metrics.inc(
+            "leader_transitions_total", exemplar=span.trace_id, event=event
+        )
 
     # -- wire ---------------------------------------------------------------
 
@@ -279,6 +298,7 @@ class KubeLease:
                 return False  # apiserver unreachable/unhappy
             self._leading = True
             self._start_renewer()
+            self._transition("acquired")
             return True
 
     def acquire(self, poll_interval: float = 0.5) -> None:
@@ -334,6 +354,10 @@ class KubeLease:
                     with self._lock:
                         self._leading = False
                     stop.set()
+                    self._transition(
+                        "lost",
+                        reason="usurped" if usurped else "lease-deadline",
+                    )
                     if self.on_lost is not None:
                         self.on_lost()
                     return
@@ -358,6 +382,7 @@ class KubeLease:
             if self._stop is not None:
                 self._stop.set()
         if was_leading:
+            self._transition("released")
             # hand off immediately: zero the renewTime so the next
             # candidate's expiry check passes without waiting out the
             # lease duration.  Best-effort — at shutdown the apiserver

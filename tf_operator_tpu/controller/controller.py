@@ -14,7 +14,8 @@ how the tier-1 tests run "multi-node" scenarios as pure data
 from __future__ import annotations
 
 import threading
-from typing import Optional
+import time
+from typing import Dict, Optional, Tuple
 
 from tf_operator_tpu.backend.base import ClusterBackend
 from tf_operator_tpu.backend.jobstore import JobStore
@@ -28,6 +29,7 @@ from tf_operator_tpu.controller.workqueue import WorkQueue
 from tf_operator_tpu.utils.events import EventRecorder
 from tf_operator_tpu.utils.logging import logger_for_job
 from tf_operator_tpu.utils.metrics import Metrics, default_metrics
+from tf_operator_tpu.utils.trace import Tracer, default_tracer
 
 
 class TPUJobController:
@@ -42,9 +44,17 @@ class TPUJobController:
         resync_period: float = 30.0,
         expectations_timeout: float = EXPECTATION_TIMEOUT_S,
         recorder: Optional[EventRecorder] = None,
+        tracer: Optional[Tracer] = None,
     ):
         self.jobs = job_store
         self.backend = backend
+        self.tracer = tracer if tracer is not None else default_tracer
+        #: key -> (trace_id, parent_span_id, enqueue_monotonic): the
+        #: trace context captured at enqueue time, consumed at dequeue
+        #: so the queue-latency span and the sync join the trace that
+        #: triggered the work (informer event, requeue, resync)
+        self._pending_trace: Dict[str, Tuple[Optional[str], Optional[str], float]] = {}
+        self._pending_lock = threading.Lock()
         # native (C++) runtime by default when buildable — the reference's
         # queue/expectations tier is native (SURVEY.md §2a); the Python
         # twins back it on boxes without a toolchain.
@@ -75,7 +85,7 @@ class TPUJobController:
             import dataclasses
 
             config = dataclasses.replace(config, use_native_decisions=self.native)
-        self.cache = InformerCache(self.queue.add, self.pod_exp, self.svc_exp)
+        self.cache = InformerCache(self._enqueue, self.pod_exp, self.svc_exp)
         self.reconciler = Reconciler(
             job_store,
             backend,
@@ -85,14 +95,56 @@ class TPUJobController:
             recorder=self.recorder,
             metrics=self.metrics,
             config=config,
-            requeue_after=self.queue.add_after,
+            requeue_after=self._requeue_after,
+            tracer=self.tracer,
         )
         self.max_sync_retries = max_sync_retries
         self.resync_period = resync_period
         self._threads: list = []
         self._stop = threading.Event()
-        backend.subscribe(self.cache.handle_event)
-        job_store.subscribe(self.cache.handle_event)
+        backend.subscribe(self._handle_event)
+        job_store.subscribe(self._handle_event)
+
+    # ------------------------------------------------------------- tracing
+
+    def _handle_event(self, ev) -> None:
+        """Informer event delivery under a span: on a watch thread this
+        starts the trace that the enqueue → queue-wait → sync chain
+        joins; under a sync-delivery backend it nests inside the sync
+        that caused the event (the re-entrancy becomes visible)."""
+
+        etype = getattr(ev.type, "value", str(ev.type))
+        with self.tracer.span(
+            f"informer {ev.kind} {etype}",
+            attributes={"kind": ev.kind, "eventType": etype},
+        ):
+            self.cache.handle_event(ev)
+
+    def _capture_trace(self, key: str, offset: float = 0.0) -> None:
+        span = self.tracer.current_span()
+        with self._pending_lock:
+            # first unprocessed add wins (client-go workqueue
+            # semantics): the queue dedups re-adds, so overwriting here
+            # would reset the enqueue timestamp on every re-add and
+            # under-report queue latency exactly when the queue is
+            # backlogged — the condition the histogram exists to show
+            self._pending_trace.setdefault(key, (
+                span.trace_id if span is not None else None,
+                span.span_id if span is not None else None,
+                time.monotonic() + offset,
+            ))
+
+    def _enqueue(self, key: str) -> None:
+        self._capture_trace(key)
+        self.queue.add(key)
+        self.metrics.set("workqueue_depth", float(len(self.queue)))
+
+    def _requeue_after(self, key: str, delay: float) -> None:
+        # the intentional delay is not queue latency: measure the wait
+        # from the moment the key becomes due
+        self._capture_trace(key, offset=delay)
+        self.queue.add_after(key, delay)
+        self.metrics.set("workqueue_depth", float(len(self.queue)))
 
     def resync(self) -> int:
         """One full informer resync: authoritative re-list of jobs from
@@ -101,47 +153,81 @@ class TPUJobController:
         "informer resync (periodic full re-list heals missed events)").
         Returns the number of jobs enqueued."""
 
-        before = self.cache.event_count
-        jobs = self.jobs.list(None)
-        snap = self.backend.snapshot()
-        if snap is None:
-            # backend can't re-list: no cache swap, just re-enqueue every
-            # known job so level-triggered syncs re-examine them
-            with self.cache._lock:
-                keys = set(self.cache.jobs) | {j.key for j in jobs}
-            for key in keys:
-                self.queue.add(key)
+        with self.tracer.span("informer.resync") as sp:
+            before = self.cache.event_count
+            jobs = self.jobs.list(None)
+            snap = self.backend.snapshot()
+            if snap is None:
+                # backend can't re-list: no cache swap, just re-enqueue
+                # every known job so level-triggered syncs re-examine them
+                with self.cache._lock:
+                    keys = set(self.cache.jobs) | {j.key for j in jobs}
+                for key in keys:
+                    self._enqueue(key)
+                self.metrics.inc("tpujob_resyncs_total")
+                sp.set_attribute("enqueued", len(keys))
+                return len(keys)
+            pods, services, groups = snap
+            affected = self.cache.resync(
+                jobs, pods, services, groups, expected_event_count=before
+            )
             self.metrics.inc("tpujob_resyncs_total")
-            return len(keys)
-        pods, services, groups = snap
-        affected = self.cache.resync(
-            jobs, pods, services, groups, expected_event_count=before
-        )
-        self.metrics.inc("tpujob_resyncs_total")
-        return len(affected)
+            sp.set_attribute("enqueued", len(affected))
+            return len(affected)
 
     # ---------------------------------------------------------------- loops
 
     def process_next(self, timeout: Optional[float] = 0.0) -> bool:
-        """One queue item; returns False when nothing was processed."""
+        """One queue item; returns False when nothing was processed.
+
+        Traced: the sync joins the trace captured at enqueue time (or
+        roots a fresh one), with a ``queue.wait`` span spanning
+        enqueue→dequeue — the queue-latency leg of the waterfall, also
+        observed into ``workqueue_queue_latency_seconds``.
+        """
 
         key = self.queue.get(timeout=timeout)
         if key is None:
             return False
-        try:
-            self.reconciler.sync(key)
-        except Exception as e:  # noqa: BLE001 - retry-with-backoff path
-            ns, _, name = key.partition("/")
-            logger_for_job(ns, name).error("sync error: %s", e)
-            self.metrics.inc("tpujob_sync_errors_total")
-            if self.queue.num_requeues(key) < self.max_sync_retries:
-                self.queue.add_rate_limited(key)
+        now = time.monotonic()
+        with self._pending_lock:
+            pending = self._pending_trace.pop(key, None)
+        self.metrics.set("workqueue_depth", float(len(self.queue)))
+        tid, parent, enq_ts = pending if pending else (None, None, None)
+        if tid is not None:
+            root = self.tracer.start_span(
+                f"sync {key}", trace_id=tid, parent_id=parent
+            )
+        else:
+            root = self.tracer.start_span(f"sync {key}", root=True)
+        with root:
+            if enq_ts is not None:
+                wait = max(0.0, now - enq_ts)
+                self.metrics.observe_histogram(
+                    "workqueue_queue_latency_seconds", wait
+                )
+                self.tracer.start_span(
+                    "queue.wait", start_mono=now - wait
+                ).end(end_mono=now)
+            try:
+                self.reconciler.sync(key)
+            except Exception as e:  # noqa: BLE001 - retry-with-backoff path
+                ns, _, name = key.partition("/")
+                logger_for_job(ns, name).error(
+                    "sync error: %s [trace=%s]", e, root.trace_id
+                )
+                root.set_error(f"{type(e).__name__}: {e}")
+                self.metrics.inc(
+                    "tpujob_sync_errors_total", exemplar=root.trace_id
+                )
+                if self.queue.num_requeues(key) < self.max_sync_retries:
+                    self.queue.add_rate_limited(key)
+                else:
+                    self.queue.forget(key)
             else:
                 self.queue.forget(key)
-        else:
-            self.queue.forget(key)
-        finally:
-            self.queue.done(key)
+            finally:
+                self.queue.done(key)
         return True
 
     def sync_until_quiet(self, max_iters: int = 10_000) -> int:

@@ -60,6 +60,7 @@ from tf_operator_tpu.controller.status import (
 from tf_operator_tpu.utils.events import EventRecorder
 from tf_operator_tpu.utils.logging import logger_for_job
 from tf_operator_tpu.utils.metrics import Metrics, default_metrics
+from tf_operator_tpu.utils.trace import Tracer, default_tracer
 
 
 @dataclass
@@ -93,6 +94,7 @@ class Reconciler:
         metrics: Optional[Metrics] = None,
         config: Optional[ReconcilerConfig] = None,
         requeue_after: Optional[Callable[[str, float], None]] = None,
+        tracer: Optional[Tracer] = None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -102,6 +104,7 @@ class Reconciler:
         self.recorder = recorder or EventRecorder()
         self.metrics = metrics or default_metrics
         self.config = config or ReconcilerConfig()
+        self.tracer = tracer if tracer is not None else default_tracer
         self.requeue_after = requeue_after or (lambda key, delay: None)
         #: job key -> absolute deadline wakeup already scheduled
         self._deadline_scheduled: Dict[str, float] = {}
@@ -111,29 +114,36 @@ class Reconciler:
     def sync(self, key: str) -> None:
         """One level-triggered reconcile of ``key`` ("<ns>/<name>").
 
-        Span-instrumented (SURVEY.md §5): per-sync duration lands in the
-        tpujob_sync_duration_seconds histogram, outcomes in
-        tpujob_syncs_total{result=ok|error}, slow syncs warn-log.
+        Span-instrumented (SURVEY.md §5): the whole sync runs under a
+        ``reconcile <key>`` span (joining the enqueue trace when the
+        controller started one; rooting a fresh trace when called
+        directly), with child spans per plan step below.  Per-sync
+        duration lands in the tpujob_sync_duration_seconds histogram,
+        outcomes in tpujob_syncs_total{result=ok|error}, slow syncs
+        warn-log WITH their trace id (exemplar linkage: the log line
+        names the waterfall that explains it).
         """
 
         t0 = time.perf_counter()
-        try:
-            self._sync(key)
-        except Exception:
-            self._observe_sync(key, time.perf_counter() - t0, "error")
-            raise
-        self._observe_sync(key, time.perf_counter() - t0, "ok")
+        with self.tracer.span(f"reconcile {key}") as sp:
+            try:
+                self._sync(key)
+            except Exception:
+                self._observe_sync(key, time.perf_counter() - t0, "error", sp)
+                raise
+            self._observe_sync(key, time.perf_counter() - t0, "ok", sp)
 
-    def _observe_sync(self, key: str, dt: float, result: str) -> None:
+    def _observe_sync(self, key: str, dt: float, result: str, span) -> None:
         self.metrics.observe_histogram("tpujob_sync_duration_seconds", dt)
         self.metrics.inc("tpujob_syncs_total", result=result)
         if dt >= self.config.slow_sync_warn_seconds:
             ns, _, name = key.partition("/")
             logger_for_job(ns, name).warning(
-                "slow sync: %.3fs (threshold %.3fs, result=%s)",
+                "slow sync: %.3fs (threshold %.3fs, result=%s, trace=%s)",
                 dt,
                 self.config.slow_sync_warn_seconds,
                 result,
+                span.trace_id,
             )
 
     def _sync(self, key: str) -> None:
@@ -154,6 +164,13 @@ class Reconciler:
 
         if not (self.pod_exp.satisfied(key) and self.svc_exp.satisfied(key)):
             # cache can't be trusted yet; watch events will re-enqueue
+            span = self.tracer.current_span()
+            if span is not None:
+                span.add_event(
+                    "expectations.pending",
+                    pods=self.pod_exp.pending(key),
+                    services=self.svc_exp.pending(key),
+                )
             return
 
         old_status = job.status.clone()
@@ -166,7 +183,11 @@ class Reconciler:
             )
             self.recorder.event(key, "Normal", "JobCreated", "job accepted by reconciler")
 
-        pods_by_type = self._claim_pods(job)
+        with self.tracer.span("pods.claim") as claim_sp:
+            pods_by_type = self._claim_pods(job)
+            claim_sp.set_attribute(
+                "claimed", sum(len(v) for v in pods_by_type.values())
+            )
 
         # ---- deadline / backoff enforcement (before creating anything)
         if self._past_active_deadline(job):
@@ -177,9 +198,10 @@ class Reconciler:
 
         # ---- ONE batch decision call: success evaluation + every
         # replica type's plan (native syncdecide.cc when available)
-        decision = sync_decide(
-            job, pods_by_type, use_native=self.config.use_native_decisions
-        )
+        with self.tracer.span("plan.decide"):
+            decision = sync_decide(
+                job, pods_by_type, use_native=self.config.use_native_decisions
+            )
         succeeded, reason = decision.succeeded, decision.reason
         if succeeded:
             update_replica_statuses(job, pods_by_type)
@@ -194,7 +216,8 @@ class Reconciler:
         # ---- gang group before any pod (all-or-nothing admission)
         gang = self.config.enable_gang_scheduling or job.spec.enable_gang_scheduling
         if gang:
-            self._sync_pod_group(job)
+            with self.tracer.span("podgroup.sync"):
+                self._sync_pod_group(job)
 
         # ---- per-replica-type reconcile
         failed_fatal: Optional[str] = None
@@ -203,7 +226,8 @@ class Reconciler:
             spec = job.spec.replica_specs[rtype]
             pods = pods_by_type.get(rtype, [])
             outcome = self._reconcile_pods(job, rtype, pods, gang, decision.plans[rtype])
-            self._reconcile_services(job, rtype, spec)
+            with self.tracer.span(f"services.reconcile {rtype.value}"):
+                self._reconcile_services(job, rtype, spec)
             if outcome == "fatal" and failed_fatal is None:
                 failed_fatal = f"{rtype.value} replica failed permanently"
             restarting = restarting or outcome == "restarting"
@@ -386,29 +410,36 @@ class Reconciler:
             pod.scheduler_name = pod.scheduler_name or self.config.gang_scheduler_name
 
         self.pod_exp.expect_creations(key, 1)
-        try:
-            self.backend.create_pod(pod)
-        except AlreadyExistsError:
-            # stale cache (expired expectation / informer lag): reconcile
-            # again once the watch catches up
-            self.pod_exp.creation_observed(key)
-            return
-        except Exception:
-            self.pod_exp.creation_observed(key)
-            raise
+        with self.tracer.span(
+            f"pod.create {name}",
+            attributes={"replicaType": rtype.value, "index": index},
+        ) as sp:
+            try:
+                self.backend.create_pod(pod)
+            except AlreadyExistsError:
+                # stale cache (expired expectation / informer lag):
+                # reconcile again once the watch catches up
+                sp.add_event("already-exists")
+                self.pod_exp.creation_observed(key)
+                return
+            except Exception:
+                self.pod_exp.creation_observed(key)
+                raise
         self.metrics.inc("tpujob_pods_created_total", replica_type=rtype.value)
         self.recorder.event(key, "Normal", "SuccessfulCreatePod", f"created pod {name}")
 
     def _delete_pod(self, key: str, pod: Pod) -> None:
         self.pod_exp.expect_deletions(key, 1)
-        try:
-            self.backend.delete_pod(pod.metadata.namespace, pod.metadata.name)
-        except NotFoundError:
-            self.pod_exp.deletion_observed(key)
-            return
-        except Exception:
-            self.pod_exp.deletion_observed(key)
-            raise
+        with self.tracer.span(f"pod.delete {pod.metadata.name}") as sp:
+            try:
+                self.backend.delete_pod(pod.metadata.namespace, pod.metadata.name)
+            except NotFoundError:
+                sp.add_event("not-found")
+                self.pod_exp.deletion_observed(key)
+                return
+            except Exception:
+                self.pod_exp.deletion_observed(key)
+                raise
         self.metrics.inc("tpujob_pods_deleted_total")
         self.recorder.event(key, "Normal", "SuccessfulDeletePod", f"deleted pod {pod.metadata.name}")
 
@@ -608,10 +639,13 @@ class Reconciler:
 
     def _update_status(self, job: TPUJob, old_status) -> None:
         if job.status != old_status:
-            try:
-                self.jobs.update_status(job.metadata.namespace, job.metadata.name, job.status)
-            except NotFoundError:
-                pass
+            with self.tracer.span("status.update"):
+                try:
+                    self.jobs.update_status(
+                        job.metadata.namespace, job.metadata.name, job.status
+                    )
+                except NotFoundError:
+                    pass
 
     def _observe_startup_latency(self, job: TPUJob) -> None:
         if job.status.start_time is not None:

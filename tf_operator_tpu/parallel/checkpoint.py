@@ -43,14 +43,21 @@ class TrainerCheckpointer:
 
         from flax.core import meta
 
+        from tf_operator_tpu.utils.trace import default_tracer
+
         if step is None:
             step = int(trainer.state.step)
-        self.manager.save(
-            step,
-            args=self._ocp.args.StandardSave({"state": meta.unbox(trainer.state)}),
-        )
-        if wait:
-            self.manager.wait_until_finished()
+        with default_tracer.span(
+            "checkpoint.save", attributes={"step": step, "wait": wait}
+        ):
+            self.manager.save(
+                step,
+                args=self._ocp.args.StandardSave(
+                    {"state": meta.unbox(trainer.state)}
+                ),
+            )
+            if wait:
+                self.manager.wait_until_finished()
         return step
 
     def restore_latest(self, trainer) -> Optional[int]:
@@ -67,10 +74,17 @@ class TrainerCheckpointer:
 
         from flax.core import meta
 
+        from tf_operator_tpu.utils.trace import default_tracer
+
         latest = self.manager.latest_step()
         if latest is None:
             return None
+        with default_tracer.span(
+            "checkpoint.restore", attributes={"step": latest}
+        ):
+            return self._restore(trainer, latest, meta)
 
+    def _restore(self, trainer, latest: int, meta) -> int:
         def _is_box(x):
             return isinstance(x, meta.AxisMetadata)
 

@@ -72,6 +72,7 @@ def train_loop(
     start_step: int = 0,
     tag: str = "train",
     assert_decreasing: bool = True,
+    tracer=None,
 ) -> List[float]:
     """Run ``steps`` steps, print the standard per-process summary, and
     (by default) fail loudly if the loss did not decrease — the examples
@@ -79,12 +80,23 @@ def train_loop(
 
     ``batch_or_batches``: one device-resident batch (reused every step)
     or an iterator of batches (a live input pipeline).
+
+    Traced (utils/trace): the run is one ``train <tag>`` trace with a
+    span per step, split into ``data.load`` and ``train.step`` children
+    — the training-side end of the operator's trace story, so a slow
+    step shows *which half* (input pipeline vs device step) ate the
+    time.  Long runs truncate at the store's per-trace span cap; the
+    waterfall reports how many spans were dropped.
     """
 
     import sys
 
     import jax
     import numpy as np
+
+    from tf_operator_tpu.utils.trace import default_tracer
+
+    tr = tracer if tracer is not None else default_tracer
 
     batches: Optional[Iterable[Dict]] = None
     fixed = None
@@ -94,10 +106,19 @@ def train_loop(
         fixed = batch_or_batches
 
     losses: List[float] = []
-    for _ in range(start_step, steps):
-        batch = next(batches) if batches is not None else fixed
-        metrics = trainer.train_step(batch)
-        losses.append(float(metrics["loss"]))
+    with tr.span(
+        f"train {tag}", attributes={"startStep": start_step, "steps": steps}
+    ):
+        for step in range(start_step, steps):
+            with tr.span(f"step {step}"):
+                if batches is not None:
+                    with tr.span("data.load"):
+                        batch = next(batches)
+                else:
+                    batch = fixed
+                with tr.span("train.step"):
+                    metrics = trainer.train_step(batch)
+            losses.append(float(metrics["loss"]))
 
     if losses:
         first, last = losses[0], float(np.mean(losses[-5:]))

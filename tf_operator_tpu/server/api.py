@@ -8,6 +8,8 @@ the operator binary carries the equivalent surface itself:
 
     GET  /healthz                                     liveness
     GET  /metrics                                     Prometheus text
+    GET  /traces                                      recent trace summaries
+    GET  /traces/{id}                                 one trace's span waterfall
     GET  /debug/stacks                                all-thread stack dump
     GET  /apis/v1/tpujobs                             list (all ns)
     GET  /apis/v1/namespaces/{ns}/tpujobs             list
@@ -41,6 +43,12 @@ from tf_operator_tpu.backend.base import AlreadyExistsError, ClusterBackend, Not
 from tf_operator_tpu.backend.jobstore import JobStore
 from tf_operator_tpu.utils.events import EventRecorder
 from tf_operator_tpu.utils.metrics import Metrics
+from tf_operator_tpu.utils.trace import (
+    TRACE_HEADER,
+    Tracer,
+    default_tracer,
+    extract_headers,
+)
 
 
 def _pod_to_dict(pod) -> dict:
@@ -68,11 +76,17 @@ class ApiServer:
         port: int = 0,
         namespace: str = "",
         leadership: Optional[Callable[[], Tuple[bool, Optional[str]]]] = None,
+        tracer: Optional[Tracer] = None,
     ):
         self.jobs = job_store
         self.backend = backend
         self.metrics = metrics
         self.recorder = recorder
+        #: request spans + the /traces read surface; in-process the
+        #: controller, backends and (kube-sim) the embedded apiserver
+        #: all share this tracer's store, so /traces/<id> returns the
+        #: complete waterfall for one trace id
+        self.tracer = tracer if tracer is not None else default_tracer
         #: when set, the job API serves only this namespace (--namespace)
         self.namespace = namespace
         #: () -> (is_leader, holder_identity).  With --leader-elect each
@@ -101,8 +115,42 @@ class ApiServer:
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                span = getattr(self, "_trace_span", None)
+                if span is not None:
+                    self.send_header(TRACE_HEADER, span.trace_id)
+                    span.set_attribute("status", code)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _traced(self, method: str, impl):
+                """Run a verb handler under a server span (joining an
+                incoming x-trace-id); observability endpoints are NOT
+                traced — the dashboard polls them every 2s and the
+                resulting ok-and-fast traces would only churn the
+                store's eviction."""
+
+                route = self.path.split("?")[0]
+                untraced = ("/healthz", "/metrics", "/traces")
+                if method == "GET" and (
+                    route == "/" or any(
+                        route == u or route.startswith(u + "/")
+                        for u in untraced
+                    )
+                ):
+                    # keep-alive reuses the handler across requests: a
+                    # stale span from the previous request must not
+                    # stamp this untraced response
+                    self._trace_span = None
+                    return impl()
+                tid, parent = extract_headers(self.headers)
+                span = outer.tracer.start_span(
+                    f"api {method} {route}",
+                    kind="server", trace_id=tid, parent_id=parent,
+                    attributes={"method": method},
+                )
+                self._trace_span = span
+                with span:
+                    return impl()
 
             def _error(self, code: int, message: str):
                 self._send(code, {"error": message})
@@ -138,6 +186,15 @@ class ApiServer:
 
             # -- verbs -----------------------------------------------------
             def do_GET(self):
+                return self._traced("GET", self._do_get)
+
+            def do_POST(self):
+                return self._traced("POST", self._do_post)
+
+            def do_DELETE(self):
+                return self._traced("DELETE", self._do_delete)
+
+            def _do_get(self):
                 p = self._route()
                 try:
                     if not p:
@@ -167,6 +224,21 @@ class ApiServer:
                         return self._send(
                             200, outer.metrics.exposition(), "text/plain"
                         )
+                    # trace read surface: served on every replica
+                    # (leader or standby) like /metrics — its job is
+                    # diagnosing whichever process you can reach
+                    if p == ["traces"]:
+                        return self._send(
+                            200,
+                            {"items": outer.tracer.store.summaries()},
+                        )
+                    if len(p) == 2 and p[0] == "traces":
+                        trace = outer.tracer.store.trace(p[1])
+                        if trace is None:
+                            return self._error(
+                                404, f"trace {p[1]} not found (evicted?)"
+                            )
+                        return self._send(200, trace)
                     if p == ["debug", "stacks"]:
                         import sys
                         import traceback
@@ -272,7 +344,7 @@ class ApiServer:
                 except Exception as e:  # noqa: BLE001 - HTTP boundary
                     return self._error(500, f"{type(e).__name__}: {e}")
 
-            def do_POST(self):
+            def _do_post(self):
                 p = self._route()
                 try:
                     if self._not_leader():
@@ -314,7 +386,7 @@ class ApiServer:
                 except Exception as e:  # noqa: BLE001 - HTTP boundary
                     return self._error(500, f"{type(e).__name__}: {e}")
 
-            def do_DELETE(self):
+            def _do_delete(self):
                 p = self._route()
                 try:
                     if self._not_leader():

@@ -27,11 +27,27 @@ class Metrics:
         self._observations: Dict[str, List[float]] = defaultdict(list)
         #: name -> (buckets, counts[len(buckets)+1], sum, count)
         self._histograms: Dict[str, list] = {}
+        #: name -> trace id of the most recent exemplar-carrying inc —
+        #: the counter→trace link (OpenMetrics-exemplar-style): "this
+        #: client has 14 errors" becomes "...and HERE is one of them"
+        self._exemplars: Dict[str, str] = {}
 
-    def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
+    def inc(
+        self, name: str, value: float = 1.0, *,
+        exemplar: "str | None" = None, **labels: str,
+    ) -> None:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._counters[key] += value
+            if exemplar:
+                self._exemplars[name] = str(exemplar)
+
+    def exemplar(self, name: str) -> "str | None":
+        """Trace id recorded with the most recent increment of ``name``
+        (None when no exemplar-carrying inc has happened)."""
+
+        with self._lock:
+            return self._exemplars.get(name)
 
     def set(self, name: str, value: float, **labels: str) -> None:
         """Gauge write (last-value-wins) — e.g. the API clients' last-
@@ -147,6 +163,11 @@ class Metrics:
                 lines.append(f'{name}_bucket{{le="+Inf"}} {n}')
                 lines.append(f"{name}_sum {total}")
                 lines.append(f"{name}_count {n}")
+            # exemplar links as comments: Prometheus text parsers skip
+            # them, the dashboard reads them to deep-link error
+            # counters to their trace waterfalls
+            for name, tid in sorted(self._exemplars.items()):
+                lines.append(f'# exemplar {name} trace_id="{tid}"')
         return "\n".join(lines) + "\n"
 
 
