@@ -191,12 +191,23 @@ def write_last_measured(data: dict, today: str) -> None:
         t.get("mnist_steps_per_sec_per_chip"), "train.out")
     put("bert_base_steps_per_sec_per_chip",
         t.get("bert_base_steps_per_sec_per_chip"), "train.out")
+    put("bert_base_mfu_analytic",
+        t.get("bert_base_mfu_analytic"), "train.out")
     bt = data.get("batching", {})
     put("batching_pool_tokens_per_sec",
         bt.get("batching_pool_tokens_per_sec"), "batching.out")
     put("batching_speedup", bt.get("batching_speedup"), "batching.out")
+    put("batching_best_steps_per_sync",
+        bt.get("batching_steps_per_sync"), "batching.out")
+    put("batching_admission_dispatches_per_request",
+        bt.get("batching_admission_dispatches_per_request"),
+        "batching.out")
     sp = data.get("speculative", {})
     put("speculative_speedup", sp.get("speculative_speedup"),
+        "speculative.out")
+    # the draft!=target wide row serve_lm's --speculative guard reads:
+    # the feature unfences itself the first window this reaches >= 1
+    put("speculative_wide_speedup", sp.get("speculative_wide_speedup"),
         "speculative.out")
     wd = data.get("wide")
     if wd:
@@ -297,31 +308,66 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             )
     t = data.get("train")
     if t:
+        bert_mfu = ""
+        if t.get("bert_base_mfu_analytic") is not None:
+            bert_mfu = (
+                f", **mfu_analytic {t['bert_base_mfu_analytic']}** / "
+                f"mfu_xla {t.get('bert_base_mfu_xla', '?')} "
+                "(accounting: `benchmarks/FLOPS.md` \"BERT\")"
+            )
         rows["mnist / BERT-base steps/sec/chip"] = (
             "| mnist / BERT-base steps/sec/chip | "
             f"mnist **{t.get('mnist_steps_per_sec_per_chip', '?')} steps/s** "
             f"({t.get('mnist_examples_per_sec_per_chip', '?')} ex/s); "
             f"BERT-base **{t.get('bert_base_steps_per_sec_per_chip', '?')} "
             f"steps/s** ({t.get('bert_base_examples_per_sec_per_chip', '?')} "
-            "ex/s, seq 128, fsdp) "
+            f"ex/s, seq 128, fsdp){bert_mfu} "
             f"| 1× v5 lite, `measure.py --section train` → `window_out/train.out`, {today} |"
         )
     bt = data.get("batching")
     if bt:
         n_new = bt.get("batching_new_tokens", "?")
+        adm = bt.get("batching_admission_dispatches_per_request")
+        ksw = bt.get("batching_k_sweep", {})
+        sweep_txt = ", ".join(
+            f"K{k}: {row.get('tokens_per_sec', '?')}"
+            for k, row in sorted(
+                ksw.items(), key=lambda kv: int(kv[0])
+            )
+        )
         rows["Serving under concurrency"] = (
             "| Serving under concurrency (8 staggered requests, "
             f"llama-mini, greedy {n_new} new tokens each) | continuous-"
             f"batching pool **{bt['batching_pool_tokens_per_sec']} "
-            f"tok/s** vs sequential "
+            f"tok/s** at best K={bt.get('batching_steps_per_sync', '?')} "
+            f"vs sequential "
             f"{bt['batching_sequential_tokens_per_sec']} tok/s — "
-            f"**{bt['batching_speedup']}×** (`models/batching.py`) — "
-            "tunnel-dispatch-bound: wall ≈ 66 ms RTT × dispatch count "
-            "on this box, not device math (PROFILE.md r5 serving) "
+            f"**{bt['batching_speedup']}×** (`models/batching.py`, "
+            "single-dispatch admission: "
+            f"{adm if adm is not None else '?'} admission "
+            f"dispatches/request; K sweep tok/s: {sweep_txt or '?'}; "
+            "full dispatch ledger in the artifact + PROFILE.md "
+            "\"dispatch ledger\") "
             f"| 1× v5 lite, `measure.py --section batching` → `window_out/batching.out`, {today} |"
         )
     sp = data.get("speculative")
     if sp:
+        wide_txt = (
+            " — no wide draft≠target row this window"
+        )
+        if sp.get("speculative_wide_speedup") is not None:
+            wide_txt = (
+                f"; draft≠target wide-700M target int8 draft: "
+                f"**{sp.get('speculative_wide_tokens_per_sec', '?')} "
+                f"tok/s** vs plain "
+                f"{sp.get('speculative_wide_plain_tokens_per_sec', '?')} "
+                f"— **{sp['speculative_wide_speedup']}×**, acceptance "
+                f"{sp.get('speculative_wide_acceptance', '?')}"
+            )
+        elif sp.get("speculative_wide_error"):
+            wide_txt = (
+                f"; wide row errored: {sp['speculative_wide_error'][:80]}"
+            )
         rows["Self-speculative decode"] = (
             "| Self-speculative decode (llama-mini batch 1, int8 draft "
             "of the same weights, k=4) | "
@@ -329,9 +375,9 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             f"{sp['speculative_plain_tokens_per_sec']} tok/s — "
             f"**{sp['speculative_speedup']}×**, acceptance "
             f"{sp.get('speculative_acceptance', '?')} "
-            "(`models/speculative.py`) — one fused while-loop program "
-            "per call (r5); remaining gap is while-body DMA overlap + "
-            "thin self-draft economics at 120M (PROFILE.md r5 serving) "
+            f"(`models/speculative.py`){wide_txt}.  `serve_lm "
+            "--speculative` refuses while the best measured row is "
+            "< 1× (measured-slowdown guard) "
             f"| 1× v5 lite, `measure.py --section speculative` → `window_out/speculative.out`, {today} |"
         )
     wd = data.get("wide")

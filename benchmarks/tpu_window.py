@@ -78,19 +78,24 @@ STEPS = [
         1500,
     ),
     # serving under concurrency: continuous-batching pool vs sequential
-    # (models/batching.py); parsed into BASELINE.md by collect_window
+    # (models/batching.py); parsed into BASELINE.md by collect_window.
+    # r6: sweeps steps_per_sync K (one step-program compile per K on
+    # this 1-core host) and embeds the dispatch ledger — budget raised
+    # accordingly
     (
         "batching",
         [sys.executable, os.path.join(HERE, "measure.py"), "--section", "batching"],
-        1800,
+        2400,
     ),
-    # self-speculative decode (int8 draft of the same weights) vs plain
-    # greedy, batch 1 (models/speculative.py)
+    # speculative decode vs plain greedy, batch 1: int8 self-draft
+    # mini AND the draft!=target wide-700M config (the row serve_lm's
+    # --speculative guard reads); the ~700M init + two extra generate
+    # compiles on the 1-core host earn the bigger budget
     (
         "speculative",
         [sys.executable, os.path.join(HERE, "measure.py"),
          "--section", "speculative"],
-        1800,
+        2700,
     ),
     # the >=0.40-MFU existence proof at serious width (~700M d_model
     # 2048, VERDICT r4 next #3) — before the long sweeps so a dying

@@ -31,6 +31,22 @@ to powers of two, so arbitrary request lengths share at most
 0.05 grid and top_k is validated/int-cast unconditionally, so no request
 field can force unbounded fresh compiles.  Byte-level vocab (256) to
 match the llama_pretrain artifact.
+
+Observability (r6): every /generate request runs inside a server trace
+span (adopting an incoming ``x-trace-id`` and echoing it on the
+response — the PR-2 propagation contract), every decoder device
+dispatch is counted and timed through a shared
+``utils/metrics.DispatchLedger`` (``serving_dispatch_*`` on /metrics;
+request-thread dispatches appear as ``dispatch.<phase>`` child spans in
+the request waterfall), and ``/traces`` + ``/traces/<id>`` expose the
+trace store like the operator API does.
+
+Honest speculation (r6, VERDICT r5 next #2): ``--speculative`` consults
+the measured ledger (benchmarks/LAST_MEASURED.json).  If every measured
+speculative configuration on this box is a slowdown (<1x), the server
+REFUSES to start with the measured number and its artifact, instead of
+silently serving 10x slower; ``--speculative-force`` overrides for
+real-RTT deployments where the dispatch economics differ.
 """
 
 from __future__ import annotations
@@ -46,9 +62,41 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def speculative_slowdown(ledger_path: "str | None" = None):
+    """The measured speculative verdict from the last-measured ledger:
+    ``(best_speedup, row)`` over every measured speculative config
+    (self-draft mini ``speculative_speedup``, int8-draft wide target
+    ``speculative_wide_speedup``), or ``(None, None)`` when nothing has
+    been measured.  main() refuses --speculative when the best measured
+    config is a slowdown — the 0.1x row must not be the feature's
+    silent default face."""
+
+    if ledger_path is None:
+        ledger_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmarks", "LAST_MEASURED.json",
+        )
+    try:
+        with open(ledger_path) as f:
+            ledger = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None, None
+    rows = {
+        key: ledger[key]
+        for key in ("speculative_speedup", "speculative_wide_speedup")
+        if isinstance(ledger.get(key), dict) and "value" in ledger[key]
+    }
+    if not rows:
+        return None, None
+    best_key = max(rows, key=lambda key: rows[key]["value"])
+    row = dict(rows[best_key])
+    row["metric"] = best_key
+    return row["value"], row
+
+
 def build_handler(
     model, params, max_len: int, batching_slots: int = 0,
-    speculative: bool = False, prompt_cache: int = 0,
+    speculative: bool = False, prompt_cache: int = 0, tracer=None,
 ):
     """batching_slots > 0 serves through the continuous-batching pool
     (models/batching.py): concurrent requests share one decode loop,
@@ -72,11 +120,23 @@ def build_handler(
     from tf_operator_tpu.data.text import decode_bytes
     from tf_operator_tpu.models.batching import ContinuousBatchingDecoder
     from tf_operator_tpu.models.decode import ChunkedServingDecoder
-    from tf_operator_tpu.utils.metrics import Metrics
+    from tf_operator_tpu.utils.metrics import DispatchLedger, Metrics
+    from tf_operator_tpu.utils.trace import (
+        TRACE_HEADER,
+        Tracer,
+        extract_headers,
+    )
 
     # the same observability surface the operator exposes: counters +
-    # latency histogram in Prometheus text format on GET /metrics
+    # latency histogram in Prometheus text format on GET /metrics,
+    # plus the PR-2 trace store on /traces.  One DispatchLedger is
+    # shared by every decoder in the process: serving_dispatch_*
+    # counters land in /metrics and request-thread dispatches become
+    # dispatch.<phase> child spans of the request span.
     metrics = Metrics()
+    if tracer is None:
+        tracer = Tracer()
+    ledger = DispatchLedger(metrics=metrics, tracer=tracer)
 
     if speculative:
         if batching_slots > 0:
@@ -93,12 +153,15 @@ def build_handler(
         # If serving already quantized (--quantize int8), target and
         # draft share the int8 tree — still exact, just less speedup.
         dparams = params if is_quantized(params) else quantize_tree(params)
-        spec = SpeculativeDecoder(model, params, model, dparams, k=4)
+        spec = SpeculativeDecoder(model, params, model, dparams, k=4,
+                                  ledger=ledger)
         spec_lock = threading.Lock()  # generate mutates decoder telemetry
         pool = None
         pool_fatal = []
         # top_k fallback path; prompt-KV reuse helps it too
-        decoder = ChunkedServingDecoder(model, params, prompt_cache=prompt_cache)
+        decoder = ChunkedServingDecoder(
+            model, params, prompt_cache=prompt_cache, ledger=ledger,
+        )
     elif batching_slots > 0:
         if prompt_cache:
             raise ValueError(
@@ -106,7 +169,9 @@ def build_handler(
                 "batching pool prefills into per-slot caches and does "
                 "not consume it — drop one of the flags"
             )
-        pool = ContinuousBatchingDecoder(model, params, slots=batching_slots)
+        pool = ContinuousBatchingDecoder(
+            model, params, slots=batching_slots, ledger=ledger,
+        )
         pool_fatal = []  # driver-thread death must surface as 500s
 
         def _drive():
@@ -124,7 +189,9 @@ def build_handler(
         pool = None
         spec = None
         pool_fatal = []
-        decoder = ChunkedServingDecoder(model, params, prompt_cache=prompt_cache)
+        decoder = ChunkedServingDecoder(
+            model, params, prompt_cache=prompt_cache, ledger=ledger,
+        )
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -147,10 +214,17 @@ def build_handler(
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            tid = getattr(self, "_trace_id", None)
+            if tid:  # the PR-2 propagation contract: echo on EVERY reply
+                self.send_header(TRACE_HEADER, tid)
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):
+            # keep-alive reuses the handler instance across requests: a
+            # stale span id from a previous POST on this connection must
+            # not stamp an untraced response (same guard as server/api)
+            self._trace_id = None
             if self.path == "/healthz":
                 return self._reply(200, {"ok": True})
             if self.path == "/metrics":
@@ -180,11 +254,34 @@ def build_handler(
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if self.path == "/traces":
+                return self._reply(200, {"traces": tracer.store.summaries(50)})
+            if self.path.startswith("/traces/"):
+                t = tracer.store.trace(self.path[len("/traces/"):])
+                if t is None:
+                    return self._reply(404, {"error": "unknown trace id"})
+                return self._reply(200, t)
             return self._reply(404, {"error": "try POST /generate"})
 
         def do_POST(self):
             if self.path != "/generate":
                 return self._reply(404, {"error": "unknown path"})
+            # every request is a server span: adopt an incoming trace
+            # id (x-trace-id/x-parent-span-id) or root a fresh one;
+            # request-thread decoder dispatches (chunked + speculative
+            # paths) nest under it as dispatch.<phase> children.  Pool
+            # dispatches run on the driver thread — they link by the
+            # rid attribute instead (docs/ARCHITECTURE.md "serving
+            # dispatch accounting").
+            tid, parent = extract_headers(self.headers)
+            with tracer.start_span(
+                "serve.generate", kind="server", trace_id=tid,
+                parent_id=parent,
+            ) as span:
+                self._trace_id = span.trace_id
+                self._generate(span)
+
+        def _generate(self, span):
             self._t0 = _time.perf_counter()
             try:
                 n = int(self.headers.get("Content-Length", 0))
@@ -234,7 +331,10 @@ def build_handler(
                     return self._reply(400, {
                         "error": f"prompt({len(ids)}) + max_new_tokens({n_new}) "
                                  f"> max_len({max_len})"})
+                span.set_attribute("prompt_tokens", int(len(ids)))
+                span.set_attribute("max_new_tokens", n_new)
                 if pool is not None:
+                    span.set_attribute("mode", "pool")
                     from tf_operator_tpu.models.batching import TOP_K_MAX
 
                     # full client-error range pre-validated here: the
@@ -250,6 +350,10 @@ def build_handler(
                         rng=jax.random.PRNGKey(seed)
                         if temperature > 0.0 else None,
                     )
+                    # the pool's admission/step dispatches run on the
+                    # driver thread; the rid is the join key between
+                    # this request span and those ledger spans
+                    span.set_attribute("rid", rid)
                     # condition wait (no lock-churning poll); the
                     # periodic timeout is only to notice driver death
                     while True:
@@ -269,6 +373,7 @@ def build_handler(
                     # greedy AND temperature requests: speculative
                     # sampling is exact for both (rejection rule);
                     # only top_k falls back to the chunked decoder
+                    span.set_attribute("mode", "speculative")
                     with spec_lock:
                         out = spec.generate(
                             prompt, n_new, temperature=temperature,
@@ -279,6 +384,7 @@ def build_handler(
                     return self._reply(
                         200, {"prompt": text, "sample": sample, "seed": seed}
                     )
+                span.set_attribute("mode", "chunked")
                 out = decoder.generate(
                     prompt, n_new, temperature=temperature, top_k=top_k,
                     rng=jax.random.PRNGKey(seed),
@@ -290,6 +396,7 @@ def build_handler(
             except (ValueError, TypeError, KeyError, json.JSONDecodeError) as exc:
                 return self._reply(400, {"error": repr(exc)})  # client's fault
             except Exception as exc:  # serving must not die on bad input
+                span.set_error(repr(exc))  # tail sampling protects it
                 return self._reply(500, {"error": repr(exc)})
 
     return Handler
@@ -316,7 +423,15 @@ def main() -> int:
         help="serve greedy requests through the int8 self-draft "
              "speculative decoder (batch-1 latency mode; sampling "
              "requests fall back to the chunked decoder); mutually "
-             "exclusive with --batching",
+             "exclusive with --batching.  REFUSES to start when every "
+             "measured speculative config in "
+             "benchmarks/LAST_MEASURED.json is a slowdown on this box",
+    )
+    ap.add_argument(
+        "--speculative-force", action="store_true",
+        help="serve --speculative even though the measured ledger says "
+             "it is a slowdown here (for real-RTT deployments where "
+             "the dispatch economics differ)",
     )
     ap.add_argument(
         "--batching", type=int, default=0, metavar="SLOTS",
@@ -331,6 +446,20 @@ def main() -> int:
              "token; embedding/logits head stays bf16",
     )
     args = ap.parse_args()
+
+    if args.speculative and not args.speculative_force:
+        best, row = speculative_slowdown()
+        if best is not None and best < 1.0:
+            raise SystemExit(
+                f"--speculative refused: the best MEASURED speculative "
+                f"config on this box is {best}x of plain decode "
+                f"({row['metric']}, {row['artifact']}, {row['date']}) — "
+                "serving it would be a measured slowdown, not a feature. "
+                "Re-measure with `python benchmarks/measure.py --section "
+                "speculative` (the draft!=target wide config included), "
+                "or pass --speculative-force on a deployment whose "
+                "dispatch RTT is not this box's ~66 ms tunnel."
+            )
 
     if args.platform:
         import jax

@@ -318,73 +318,129 @@ class TestConcurrencyStress:
         assert len(results) == 12
 
 
-class TestAdmissionOffLock:
-    def test_submit_prefills_without_a_free_slot(self):
-        """VERDICT r4 next #7: admission prefill runs on the
-        submitter's thread, decoupled from slot availability and the
-        step loop — submit() returns with the first token already
-        computed even when every slot is busy, and a budget-1 request
-        completes without ever being seated."""
+class TestSingleDispatchAdmission:
+    def test_submit_is_host_only_and_never_blocks(self):
+        """r6 admission fusion: submit() validates and queues — it
+        never touches the device, even with every slot busy.  The
+        whole admission (prefill + first token + seating) happens as
+        one compiled dispatch inside _admit, and a budget-1 request
+        completes at that admission without keeping a seat."""
+
+        import time as _time
 
         model, params = _tiny()
         dec = ContinuousBatchingDecoder(model, params, slots=2)
         prompts = _prompts(4, [5, 7, 4, 6])
-        # fill both slots with long-budget requests
         r0 = dec.submit(prompts[0], max_new_tokens=30)
         r1 = dec.submit(prompts[1], max_new_tokens=30)
         dec.step()  # seats both; pool is now full
-        # a third submit has no slot — its prefill must happen anyway
+        admissions = dec.ledger.count("admission")
+        t0 = _time.monotonic()
         r2 = dec.submit(prompts[2], max_new_tokens=3)
-        with dec._lock:
-            req = dec._results[r2]
-            assert req.slot is None and not req.done
-            assert len(req.tokens) == 1  # first token staged at submit
-            assert req.staged_cache is not None
-        # budget-1 completes AT submit, never taking a slot
         r3 = dec.submit(prompts[3], max_new_tokens=1)
+        assert _time.monotonic() - t0 < 60  # host-only, no blocking
+        with dec._lock:
+            # no device work at submit: nothing staged, no new dispatch
+            assert all(r.staged_cache is None for r in dec._queue)
+            assert all(len(r.tokens) == 0 for r in dec._queue)
+        assert dec.ledger.count("admission") == admissions
+        dec.run()
+        # budget-1 completed at its single admission dispatch; it never
+        # occupied a slot past it
         row3 = dec.result(r3)
         assert row3 is not None and row3.shape == (prompts[3].size + 1,)
-        dec.run()
         for rid, p, budget in ((r0, prompts[0], 30), (r1, prompts[1], 30),
                                (r2, prompts[2], 3)):
             row = dec.result(rid)
             np.testing.assert_array_equal(row[: p.size], p)
             assert row.shape == (p.size + budget,)
 
-    def test_lock_held_admission_is_scatter_only(self):
-        """The lock-held admission path must not run prefill device
-        calls: within the staging bound every queued request arrives
-        with an eagerly staged cache, and _admit only scatters it."""
+    def test_admission_is_exactly_one_dispatch_per_request(self):
+        """The tentpole invariant (ISSUE 3): per-request admission
+        device-dispatch count is EXACTLY 1 on the fused path — no
+        chunked prefill dispatches, no sampling op group, no separate
+        scatter.  The ledger counts real compiled-program calls; the
+        legacy machinery must never have run (its jit caches stay
+        empty), so the count cannot be satisfied by mislabeling."""
 
         model, params = _tiny()
         dec = ContinuousBatchingDecoder(model, params, slots=2)
-        rids = [dec.submit(p, max_new_tokens=2) for p in _prompts(3, [5, 6, 7])]
-        with dec._lock:
-            # 3 requests < 2*slots permits: all eagerly staged
-            assert all(r.staged_cache is not None for r in dec._queue)
-            before = dec.compile_count
-        dec._admit()
-        with dec._lock:
-            # admission may compile at most the one scatter program
-            assert dec.compile_count <= before + 1
-            assert all(
-                r.staged_cache is None for r in dec._active.values()
-            )
+        prompts = _prompts(5, [5, 9, 3, 5, 16])  # incl. an exact pow2
+        rids = [dec.submit(p, max_new_tokens=4) for p in prompts]
+        # a sampled request must ALSO admit in one dispatch (its rng
+        # split happens in-graph)
+        rids.append(
+            dec.submit(prompts[0], max_new_tokens=4, temperature=0.7,
+                       rng=jax.random.PRNGKey(5))
+        )
         dec.run()
+        assert dec.ledger.count("admission") == len(rids)
+        assert dec.ledger.count("prefill") == 0
+        assert dec.ledger.count("sample") == 0
+        assert dec.ledger.count("scatter") == 0
+        assert dec._prefill_fns == {} and dec._scatter_fn is None
         for rid in rids:
             assert dec.result(rid) is not None
 
-    def test_burst_beyond_staging_bound_never_blocks_submit(self):
-        """Regression for the staging-backpressure deadlock: more
-        submits than staging permits (2x slots), all BEFORE any driver
-        runs — submit must return (overflow queues un-staged, lazy
-        path) and every request must still complete."""
+    def test_admission_failure_requeues_request(self):
+        """A transient device failure inside the fused admission must
+        re-queue the request (the legacy prefill path's survival rule):
+        a retried step() admits it and waiters never hang."""
+
+        model, params = _tiny()
+        dec = ContinuousBatchingDecoder(model, params, slots=2)
+        real = dec._admission
+        blown = []
+
+        def flaky(width):
+            fn = real(width)
+            if not blown:
+                blown.append(True)
+
+                def boom(*a, **kw):
+                    raise RuntimeError("RESOURCE_EXHAUSTED (simulated)")
+
+                return boom
+            return fn
+
+        dec._admission = flaky
+        p = _prompts(1, [5])[0]
+        rid = dec.submit(p, max_new_tokens=3)
+        with pytest.raises(RuntimeError):
+            dec.step()
+        with dec._lock:
+            assert dec._queue and dec._queue[0].rid == rid  # requeued
+        dec.run()  # retry succeeds
+        out = dec.result(rid)
+        assert out.shape == (p.size + 3,)
+        np.testing.assert_array_equal(out[: p.size], p)
+
+    def test_admission_compile_count_is_per_width_class(self):
+        """One fused program per power-of-2 prompt-width class: prompts
+        of length 5 and 7 share the width-8 program; 9 compiles 16."""
+
+        model, params = _tiny()
+        dec = ContinuousBatchingDecoder(model, params, slots=2)
+        for p in _prompts(3, [5, 7, 9]):
+            dec.submit(p, max_new_tokens=2)
+        dec.run()
+        assert sorted(dec._admit_fns) == [8, 16]
+
+    def test_rolling_window_keeps_staged_path(self):
+        """Rolling-window caches can't take the fused path (pad writes
+        would poison cached_pos, and the wrap state is not index-
+        rollbackable): they keep the legacy staged admission — eager
+        submitter-thread prefill bounded by 2x-slots permits, burst
+        overflow lazily primed, submit never blocking — and the ledger
+        records it as prefill/sample/scatter, never as admission."""
 
         import time as _time
 
-        model, params = _tiny()
+        model = llama_tiny(vocab_size=VOCAB, max_len=48, window=8)
+        init = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), init)["params"]
         dec = ContinuousBatchingDecoder(model, params, slots=1)  # 2 permits
-        prompts = _prompts(5, [4, 6, 3, 5, 7])
+        prompts = _prompts(5, [4, 6, 3, 5, 13])
         t0 = _time.monotonic()
         rids = [dec.submit(p, max_new_tokens=3) for p in prompts]
         assert _time.monotonic() - t0 < 60  # no blocking on permits
@@ -394,6 +450,9 @@ class TestAdmissionOffLock:
         assert staged <= 2  # the permit bound held
         assert raw >= 3  # overflow took the lazy path
         dec.run()
+        assert dec.ledger.count("admission") == 0
+        assert dec.ledger.count("scatter") == len(prompts)
+        assert dec.ledger.count("prefill") >= len(prompts)
         for rid, p in zip(rids, prompts):
             out = dec.result(rid)
             assert out.shape == (p.size + 3,)

@@ -1,0 +1,126 @@
+"""bench.py's driver-artifact contract (VERDICT r5 next #3).
+
+Five rounds of artifact fumbles: r1 rc=1, r3 rc=124, r4
+parsed-but-error, r5 rc=0 with `"parsed": null` — the final stdout
+line embedded the whole last_measured ledger and outgrew the driver's
+bounded tail capture, truncating mid-key.  The contract pinned here:
+the FINAL stdout line of `python bench.py` is compact (<
+bench.FINAL_LINE_LIMIT = 2 KB), valid JSON with the driver-parsed
+fields, and the ledger/overflow detail prints on its own lines
+UPSTREAM of it.  `emit_final` enforces this in-process on every exit
+path (success, probe failure, budget exhaustion).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def _fat_ledger(n=60):
+    return {
+        f"metric_{i}": {
+            "value": i * 1.5,
+            "artifact": "benchmarks/window_out/" + "x" * 60 + ".out",
+            "date": "2026-08-03",
+        }
+        for i in range(n)
+    }
+
+
+def test_emit_final_moves_ledger_upstream_and_stays_compact(capsys):
+    result = {
+        "metric": bench.METRIC,
+        "value": 2600.49,
+        "unit": bench.UNIT,
+        "vs_baseline": 1.1,
+        "mfu_analytic": 0.3156,
+        "last_measured": _fat_ledger(),
+    }
+    bench.emit_final(result)
+    lines = [
+        ln for ln in capsys.readouterr().out.strip().splitlines()
+        if ln.strip()
+    ]
+    final = lines[-1]
+    assert len(final) < bench.FINAL_LINE_LIMIT
+    parsed = json.loads(final)
+    assert parsed["metric"] == bench.METRIC and parsed["value"] == 2600.49
+    assert "last_measured" not in parsed
+    # the ledger is still in the artifact — upstream of the final line,
+    # itself valid JSON
+    upstream = [json.loads(ln) for ln in lines[:-1]]
+    assert any("last_measured" in obj for obj in upstream)
+
+
+def test_emit_final_sheds_noncore_fields_rather_than_overflowing(capsys):
+    result = {
+        "metric": bench.METRIC,
+        "value": 1.0,
+        "unit": bench.UNIT,
+        "vs_baseline": 1.0,
+        "giant_sweep_blob": [{"k": "v" * 50, "i": i} for i in range(100)],
+    }
+    bench.emit_final(result)
+    lines = capsys.readouterr().out.strip().splitlines()
+    final = json.loads(lines[-1])
+    assert len(lines[-1]) < bench.FINAL_LINE_LIMIT
+    assert "giant_sweep_blob" not in final and final["value"] == 1.0
+    # the shed detail survives upstream with an explicit marker
+    shed = json.loads(lines[-2])
+    assert shed["final_line_overflow_dropped"] == ["giant_sweep_blob"]
+    assert "giant_sweep_blob" in shed
+
+
+def test_error_paths_attach_ledger_and_keep_contract(capsys):
+    # the dead-tunnel shape: error result carrying the full ledger
+    bench.emit_final({
+        "metric": bench.METRIC, "value": 0.0, "unit": bench.UNIT,
+        "vs_baseline": 0.0, "error": "probe hung: TPU tunnel not answering",
+        "last_measured": _fat_ledger(),
+    })
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines[-1]) < bench.FINAL_LINE_LIMIT
+    assert json.loads(lines[-1])["error"].startswith("probe hung")
+
+
+@pytest.mark.slow
+def test_bench_py_end_to_end_final_line_parses():
+    """Run the real binary on the budget-exhausted path (CPU platform,
+    tiny budget: the probe answers, then no time remains for children)
+    and assert the stdout the driver would capture obeys the contract.
+    TPU_CHIP_LOCK_INHERITED short-circuits the chip lock so this test
+    can never preempt a live measurement window's claim."""
+
+    env = dict(
+        os.environ,
+        BENCH_PLATFORM="cpu",
+        BENCH_TOTAL_BUDGET="25",
+        BENCH_PROBE_TIMEOUT="60",
+        BENCH_PROBE_RETRIES="1",
+        TPU_CHIP_LOCK_INHERITED="1",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    lines = [
+        ln for ln in proc.stdout.strip().splitlines() if ln.strip()
+    ]
+    final = lines[-1]
+    assert len(final) < bench.FINAL_LINE_LIMIT
+    parsed = json.loads(final)
+    assert parsed["metric"] == bench.METRIC
+    assert "value" in parsed and "vs_baseline" in parsed
+    assert "last_measured" not in parsed
+    # the repo ships a non-empty LAST_MEASURED.json, so the ledger
+    # line must have printed upstream
+    assert any(ln.startswith('{"last_measured"') for ln in lines[:-1])

@@ -1,0 +1,92 @@
+"""DispatchLedger (utils/metrics.py): serving dispatch accounting.
+
+Dispatch COUNTS are the load-bearing artifact — platform-independent
+program-call counts that turn "tunnel overhead" into `count x RTT`
+arithmetic (PROFILE.md "dispatch ledger").  These tests pin the
+counting, the metrics sink (`serving_dispatch_*` on /metrics), and the
+tracer sink (dispatch child spans in a request waterfall).  The
+decoder-level invariants — the pool's exactly-one-admission-dispatch,
+the chunked decoder's per-request counts — live with their decoders in
+test_batching.py / test_decode.py.
+"""
+
+from tf_operator_tpu.utils.metrics import DispatchLedger, Metrics
+from tf_operator_tpu.utils.trace import Tracer
+
+
+def test_counts_and_seconds_accumulate():
+    led = DispatchLedger()
+    with led.dispatch("step"):
+        pass
+    led.record("step", 0.5, n=2)
+    led.record("admission", 0.1)
+    assert led.count("step") == 3
+    assert led.count("admission") == 1
+    assert led.count() == 4
+    assert led.count("never") == 0
+    snap = led.snapshot()
+    assert snap["step"]["count"] == 3
+    assert snap["step"]["seconds"] >= 0.5
+    assert led.seconds("admission") == 0.1
+    led.reset()
+    assert led.count() == 0 and led.snapshot() == {}
+
+
+def test_dispatch_records_on_exception_too():
+    # a failing device call still consumed a round trip; the ledger
+    # must not undercount the expensive path — and its span must be
+    # marked FAILED (error status is what tail sampling protects)
+    tracer = Tracer(seed=3)
+    led = DispatchLedger(tracer=tracer)
+    with tracer.span("serve.generate") as root:
+        try:
+            with led.dispatch("prefill"):
+                raise RuntimeError("device OOM")
+        except RuntimeError:
+            pass
+    assert led.count("prefill") == 1
+    t = tracer.store.trace(root.trace_id)
+    sp = next(s for s in t["spans"] if s["name"] == "dispatch.prefill")
+    assert sp["status"] == "error"
+    assert t["error"] is True
+
+
+def test_metrics_sink_exports_counters_and_histograms():
+    m = Metrics()
+    led = DispatchLedger(metrics=m)
+    with led.dispatch("admission"):
+        pass
+    with led.dispatch("admission"):
+        pass
+    with led.dispatch("step"):
+        pass
+    assert m.counter("serving_dispatch_total", phase="admission") == 2.0
+    assert m.total("serving_dispatch_total") == 3.0
+    expo = m.exposition()
+    assert 'serving_dispatch_total{phase="admission"} 2.0' in expo
+    assert "serving_dispatch_seconds_step_count 1" in expo
+
+
+def test_tracer_sink_nests_dispatch_spans_under_request_span():
+    tracer = Tracer(seed=7)
+    led = DispatchLedger(tracer=tracer)
+    with tracer.span("serve.generate") as root:
+        with led.dispatch("decode", rid=3):
+            pass
+    t = tracer.store.trace(root.trace_id)
+    assert t is not None
+    spans = {s["name"]: s for s in t["spans"]}
+    assert "dispatch.decode" in spans
+    assert spans["dispatch.decode"]["parentId"] == root.span_id
+    assert spans["dispatch.decode"]["attributes"]["rid"] == 3
+
+
+def test_table_accounts_against_wall():
+    led = DispatchLedger()
+    led.record("step", 0.2)
+    led.record("admission", 0.1)
+    txt = led.table(wall=0.5)
+    assert "| admission | 1 |" in txt
+    assert "of 0.5 s wall" in txt
+    # without a wall the totals row still renders
+    assert "**all** | 2" in led.table()

@@ -299,6 +299,91 @@ class TestServeLmSpeculativeMode:
                 model, params, max_len=64, batching_slots=2, speculative=True
             )
 
+    def test_speculative_guard_reads_measured_ledger(self, tmp_path):
+        """serve_lm --speculative refuses while the BEST measured
+        speculative config is a slowdown; a >=1x row (either the
+        self-draft key or the draft!=target wide key) unfences it, and
+        an unmeasured box stays permissive (no claim to enforce)."""
+
+        import json as _json
+
+        from tests.testutil import load_serve_lm
+
+        serve_lm = load_serve_lm()
+        row = {"artifact": "a.out", "date": "2026-08-03"}
+        p = tmp_path / "LAST_MEASURED.json"
+        p.write_text(_json.dumps(
+            {"speculative_speedup": {"value": 0.1, **row}}
+        ))
+        best, meta = serve_lm.speculative_slowdown(str(p))
+        assert best == 0.1 and meta["metric"] == "speculative_speedup"
+        p.write_text(_json.dumps({
+            "speculative_speedup": {"value": 0.1, **row},
+            "speculative_wide_speedup": {"value": 1.2, **row},
+        }))
+        best, meta = serve_lm.speculative_slowdown(str(p))
+        assert best == 1.2 and meta["metric"] == "speculative_wide_speedup"
+        assert serve_lm.speculative_slowdown(
+            str(tmp_path / "missing.json")
+        ) == (None, None)
+
+    def test_serve_lm_binary_refuses_measured_slowdown(self):
+        """End to end on the real binary + the repo's real ledger: as
+        long as the committed LAST_MEASURED.json shows every measured
+        speculative config < 1x, `serve_lm --speculative` must exit
+        with the measured-slowdown message BEFORE touching the
+        artifact (skipped automatically once a window measures a
+        config >= 1x — then the guard SHOULD let it serve)."""
+
+        import os
+        import subprocess
+        import sys
+
+        from tests.testutil import load_serve_lm
+
+        serve_lm = load_serve_lm()
+        best, _ = serve_lm.speculative_slowdown()
+        if best is None or best >= 1.0:
+            pytest.skip("measured ledger shows no slowdown; guard inactive")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "examples", "serve_lm.py"),
+             "--speculative", "--artifact", "/nonexistent"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode != 0
+        assert "--speculative refused" in proc.stderr
+        assert "--speculative-force" in proc.stderr
+
+
+class TestScanDriverBound:
+    def test_runaway_round_body_raises_instead_of_looping(self, monkeypatch):
+        """ADVICE r5: a regression that stops rows from committing must
+        surface as an error after the worst-case round budget, not as
+        an infinite host loop of device dispatches.  Simulated by
+        freezing the n vector the driver's done-check reads."""
+
+        model, params, prompt = _setup()
+        dec = SpeculativeDecoder(model, params, model, params, k=2)
+        dec.fused_driver = "scan"
+        real = dec._fused_scan
+
+        def stuck(k, bucket, b, sampled, r):
+            fn = real(k, bucket, b, sampled, r)
+
+            def wrapper(tp, dp, state, n0, limit, temp):
+                new_state, packed = fn(tp, dp, state, n0, limit, temp)
+                new_state = dict(new_state)
+                new_state["n"] = state["n"]  # rows never advance
+                return new_state, packed
+
+            return wrapper
+
+        monkeypatch.setattr(dec, "_fused_scan", stuck)
+        with pytest.raises(RuntimeError, match="act/freeze"):
+            dec.generate(prompt, max_new_tokens=8)
+
 
 class TestSampling:
     def test_identical_draft_accepts_everything_when_sampling(self):

@@ -19,26 +19,46 @@ a fixed pool of `slots` and one compiled step program:
   is constant, which is exactly the point: an arriving request rides
   a loop that was already paying for it.
 - **Compile count is O(1) + O(log max_len).**  One step program per
-  pool; prefill reuses the power-of-2 binary-chunk trick from
-  `ChunkedServingDecoder` on a batch-1 cache, then the primed rows are
-  scattered into the slot stack.
+  pool; admission compiles one fused program per power-of-2 prompt
+  width class (below), the rolling-window legacy path reuses the
+  binary-chunk prefill programs.
 - **K tokens per host round trip** (``steps_per_sync``): the step
   program scans K decode steps, so a tunneled chip (host↔device rides
   the network here) pays one round trip per K tokens instead of per
   token.  Requests join/retire at K-step granularity — worst case
   K-1 wasted slot-steps per finished request.
-- **Admission prefill off the pool lock.**  ``submit`` primes the
-  request's batch-1 cache (chunked prefill + first token) on the
-  submitter's own thread when a staging permit is free (permits bound
-  eager device-memory use at 2x slots); burst overflow queues
-  host-side and primes lazily during ``_admit`` with the lock dropped.
-  Either way only the single-scatter seating of a staged request runs
-  under the lock — concurrent submitters prefill in parallel and
-  submit never blocks.  Within the staging bound the driver's ``step``
-  never stalls behind a prefill (VERDICT r4 next #7); past it, lazy
-  admissions DO run on the driver thread — the deliberate trade under
-  overload, where the alternative (unbounded eager staging) is a
-  device OOM.
+- **Single-dispatch admission** (r6, VERDICT r5 next #5).  The old
+  admission sequence — chunked prefill into a batch-1 cache (>=1
+  dispatch per chunk), a first-token sample, then a scatter-seating
+  dispatch — cost >=3 device round trips per request; on a tunneled
+  chip (~66 ms RTT each, PROFILE.md "r5 serving") admissions alone
+  outweighed the decode they fed.  Admission is now ONE compiled
+  program per power-of-2 prompt-width class: the prompt, zero-padded
+  to the next power of two, prefills a fresh batch-1 cache in-graph;
+  causal masking makes the true last position's logits exact despite
+  the pad, and resetting ``cache_index`` back to the true length
+  (``decode.set_cache_index`` — the speculative-rollback primitive)
+  makes the pad rows invisible to every later step; the first token
+  samples and the row scatters into the slot stack in the same
+  program.  Exactly 1 dispatch per admitted request, compile count
+  still logarithmic.  Cost of the trick: up to 2x prefill compute on
+  pad positions (worst case p = 2^k + 1), irrelevant here and cheap
+  against a single round trip anywhere.  The fused program needs a
+  seat, so it runs in ``_admit`` under the pool lock (``submit`` just
+  validates and queues — it never blocks and never touches the
+  device); the device serializes programs regardless, so driver-side
+  seating loses no throughput, only the old eager-prefill overlap of
+  per-chunk dispatch latencies — which is the thing being deleted.
+  ROLLING-WINDOW caches keep the legacy staged path (pad writes would
+  poison ``cached_pos``, and the wrap state is not index-rollbackable)
+  with eager submitter-thread prefill bounded by staging permits at
+  2x slots, exactly as before; same for prompts whose padded width
+  exceeds max_len.
+- **Dispatch ledger.**  Every device call is counted and timed through
+  ``utils/metrics.DispatchLedger`` (phases: admission, step, and the
+  legacy path's prefill/scatter), so "tunnel overhead" is an auditable
+  ``count x RTT`` number — ``measure.py --section batching`` embeds
+  the ledger in its JSON and tests pin admission at exactly 1.
 
 Greedy and per-slot temperature sampling (a ``[slots]`` temperature
 vector; 0 = argmax).  Requests finish by token budget (byte-level
@@ -67,10 +87,12 @@ from tf_operator_tpu.models.decode import (
     _decode_variant,
     _init_cache_for,
     max_window_chunk,
+    set_cache_index,
     top_k_mask,
     window_chunks,
 )
 from tf_operator_tpu.ops.quant import materialize_fn
+from tf_operator_tpu.utils.metrics import DispatchLedger
 
 
 #: static top-k width: per-slot k thresholds within the top TOP_K_MAX
@@ -108,7 +130,11 @@ class ContinuousBatchingDecoder:
     driver thread calls `step`; all pool state is lock-protected.
     """
 
-    def __init__(self, model, params, slots: int = 8, steps_per_sync: int = 8):
+    def __init__(self, model, params, slots: int = 8, steps_per_sync: int = 8,
+                 ledger: Optional[DispatchLedger] = None):
+        #: device-dispatch accounting (phases: admission, step, and the
+        #: legacy rolling-window path's prefill/scatter)
+        self.ledger = ledger if ledger is not None else DispatchLedger()
         self.dmodel = _decode_variant(model)
         self._materialize = materialize_fn(model)
         cfg = self.dmodel.cfg
@@ -155,13 +181,21 @@ class ContinuousBatchingDecoder:
         self._queue: List[_Request] = []  # submitted, no slot yet
         self._active: Dict[int, _Request] = {}  # slot -> request
         self._results: Dict[int, _Request] = {}
-        # device state: stacked batch-1 caches + per-slot last token
+        # device state: stacked batch-1 caches + per-slot last token.
+        # Only the SHAPES of the batch-1 row survive on self (the
+        # fused admission program builds its fresh cache in-graph from
+        # them); keeping the materialized template would pin an extra
+        # 1/slots of the pool's cache memory in device HBM for nothing.
+        row0 = _init_cache_for(self.dmodel, 1)
+        self._row_shapes = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), row0
+        )
         self._cache = jax.tree_util.tree_map(
-            lambda l: jnp.stack([l] * self.slots),
-            _init_cache_for(self.dmodel, 1),
+            lambda l: jnp.stack([l] * self.slots), row0
         )
         self._last_tok = jnp.zeros((self.slots,), jnp.int32)
         self._prefill_fns = {}  # chunk width -> jitted batch-1 prefill
+        self._admit_fns = {}  # pow2 prompt width -> fused admission
         self._step_fn = None
         self._scatter_fn = None
         self.compile_count = 0
@@ -205,6 +239,81 @@ class ContinuousBatchingDecoder:
                 self._scatter_fn = jax.jit(scatter)
                 self.compile_count += 1
             return self._scatter_fn
+
+    def _fused_width(self, p: int) -> Optional[int]:
+        """Padded width class for single-dispatch admission, or None
+        when the request must take the legacy staged path (rolling-
+        window cache, or a pad-to-pow2 width the cache can't hold)."""
+
+        if self._max_chunk is not None:
+            return None  # rolling cache: pad writes poison cached_pos
+        w = 1 << max(0, p - 1).bit_length()
+        return w if w <= self.max_len else None
+
+    def _admission(self, width: int):
+        """The whole admission as ONE compiled program per power-of-2
+        prompt-width class: padded prefill into a fresh in-graph
+        batch-1 cache, cache_index rollback to the true length (pad
+        rows become invisible — set_cache_index, the speculative
+        rollback primitive), first-token sample at the true last
+        position, and the scatter-seating into slot `slot`.  Returns
+        (stack, last_toks, first_token, advanced_rng) — the rng split
+        happens in-graph so a sampled admission is still exactly one
+        dispatch."""
+
+        with self._compile_lock:
+            if width not in self._admit_fns:
+                dmodel = self.dmodel
+                materialize = self._materialize
+                template = self._row_shapes  # ShapeDtypeStructs
+
+                def admit(params, stack, toks, ids, n, slot, temp,
+                          top_k, rng):
+                    cache = jax.tree_util.tree_map(
+                        lambda l: jnp.zeros(l.shape, l.dtype), template
+                    )
+                    logits, vars_ = dmodel.apply(
+                        {"params": materialize(params), "cache": cache},
+                        ids,
+                        mutable=["cache"],
+                    )
+                    # causal masking: rows < n never see the pad rows,
+                    # so the true last position's logits are exact;
+                    # the index reset makes the pad K/V rows invisible
+                    # to every later decode step
+                    row_cache = set_cache_index(vars_["cache"], n)
+                    last = lax.dynamic_index_in_dim(
+                        logits[0], n - 1, axis=0, keepdims=False
+                    )  # [V]
+                    greedy = jnp.argmax(last, -1).astype(jnp.int32)
+                    split = jax.random.split(rng)
+                    rng_next, r = split[0], split[1]
+                    safe_t = jnp.where(temp > 0.0, temp, 1.0)
+                    scaled = last / safe_t
+                    # same static top-k trick as the step body: the
+                    # runtime k thresholds within the top TOP_K_MAX
+                    k_max = min(TOP_K_MAX, scaled.shape[-1])
+                    top_vals = lax.top_k(scaled, k_max)[0]
+                    kth = top_vals[jnp.clip(top_k - 1, 0, k_max - 1)]
+                    scaled = jnp.where(
+                        (top_k > 0) & (scaled < kth), -jnp.inf, scaled
+                    )
+                    samp = jax.random.categorical(r, scaled).astype(
+                        jnp.int32
+                    )
+                    tok = jnp.where(temp > 0.0, samp, greedy)
+                    stack = jax.tree_util.tree_map(
+                        lambda s, row: lax.dynamic_update_index_in_dim(
+                            s, row, slot, axis=0
+                        ),
+                        stack,
+                        row_cache,
+                    )
+                    return stack, toks.at[slot].set(tok), tok, rng_next
+
+                self._admit_fns[width] = jax.jit(admit)
+                self.compile_count += 1
+            return self._admit_fns[width]
 
     def _step(self):
         if self._step_fn is None:
@@ -307,14 +416,16 @@ class ContinuousBatchingDecoder:
         req = _Request(
             rid, prompt, max_new_tokens, float(temperature), top_k, rng,
         )
-        # fast path: prefill on the SUBMITTER'S thread, no pool lock
-        # held — concurrent submitters prefill in parallel (serialized
-        # only by the device queue) while the driver's step() keeps
-        # decoding.  When the staging permits are exhausted (request
-        # burst >> slots) the request queues host-side instead and
-        # prefills lazily at admission — submit never blocks, device
-        # memory stays bounded (see _staging in __init__).
-        if self._staging.acquire(blocking=False):
+        # fused-eligible requests (non-rolling cache, pad width fits)
+        # queue host-side untouched: their ENTIRE admission — prefill,
+        # first token, seating — is one compiled dispatch in _admit,
+        # so submit never touches the device.  Only the legacy path
+        # (rolling-window caches, oversize pad widths) still prefills
+        # eagerly on the submitter's thread under a staging permit;
+        # past the permit bound it queues and primes lazily at
+        # admission — submit never blocks on either path.
+        if self._fused_width(prompt.size) is None and \
+                self._staging.acquire(blocking=False):
             req.has_permit = True
             try:
                 self._prefill_request(req)
@@ -359,28 +470,69 @@ class ContinuousBatchingDecoder:
             ids = jnp.asarray(
                 req.prompt[off : off + width][None, :], jnp.int32
             )
-            cache, last = self._prefill(width)(self.params, cache, ids)
+            with self.ledger.dispatch("prefill", rid=req.rid):
+                cache, last = self._prefill(width)(self.params, cache, ids)
             off += width
-        # the prompt's first sampled token comes from prefill logits
-        if req.temperature > 0.0:
-            req.rng, r = jax.random.split(req.rng)
-            scaled = last / req.temperature
-            if req.top_k is not None:
-                scaled = top_k_mask(scaled, req.top_k)
-            tok = jax.random.categorical(r, scaled).astype(jnp.int32)
-        else:
-            tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        # the prompt's first sampled token comes from prefill logits.
+        # Recorded as one "sample" ledger entry — the un-jitted op
+        # group below is 1 (greedy) to ~3 (split+mask+categorical)
+        # tiny device calls; the fused admission folds all of this
+        # into its single program
+        with self.ledger.dispatch("sample", rid=req.rid):
+            if req.temperature > 0.0:
+                req.rng, r = jax.random.split(req.rng)
+                scaled = last / req.temperature
+                if req.top_k is not None:
+                    scaled = top_k_mask(scaled, req.top_k)
+                tok = jax.random.categorical(r, scaled).astype(jnp.int32)
+            else:
+                tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
         req.staged_cache = cache
         req.staged_tok = tok
         req.tokens.append(int(tok))
 
+    def _admit_fused(self, req: _Request, slot: int, width: int) -> None:
+        """Seat one request with exactly ONE device dispatch (the fused
+        per-width admission program).  Caller holds the pool lock: the
+        program rewrites the shared slot stack, so it must serialize
+        with step() — the device would serialize the programs anyway;
+        the lock only mirrors that ordering on the host."""
+
+        ids = np.zeros((1, width), np.int32)
+        ids[0, : req.prompt.size] = req.prompt
+        sampled = req.temperature > 0.0
+        rng = req.rng if sampled else jnp.zeros((2,), jnp.uint32)
+        with self.ledger.dispatch("admission", rid=req.rid, width=width):
+            stack, toks, tok, rng_next = self._admission(width)(
+                self.params, self._cache, self._last_tok,
+                jnp.asarray(ids), jnp.int32(req.prompt.size),
+                jnp.int32(slot), jnp.float32(req.temperature),
+                jnp.int32(req.top_k or 0), rng,
+            )
+            tok_h = int(tok)  # host fetch: the ledger RTT includes it
+        self._cache, self._last_tok = stack, toks
+        if sampled:
+            req.rng = rng_next
+        req.tokens.append(tok_h)
+        if len(req.tokens) >= req.budget:
+            # budget-1: the admission token completed it; the scattered
+            # cache rows are dead and the slot stays free
+            req.done = True
+            self._done_cond.notify_all()
+        else:
+            req.slot = slot
+            self._active[slot] = req
+
     def _admit(self) -> None:
-        """Seat queued requests into free slots.  Three phases per
-        request: reserve a seat under the lock; prefill with the lock
-        DROPPED if the request arrived un-staged (permit-exhausted
-        burst took the lazy path); then scatter + bookkeeping under
-        the lock.  Lock-held admission device work is always exactly
-        ONE scatter call."""
+        """Seat queued requests into free slots.
+
+        Fused path (non-rolling caches): the whole admission is ONE
+        compiled dispatch under the lock (_admit_fused).  Legacy path
+        (rolling-window caches / oversize pad widths): reserve a seat
+        under the lock; prefill with the lock DROPPED if the request
+        arrived un-staged (permit-exhausted burst took the lazy path);
+        then scatter + bookkeeping under the lock — lock-held legacy
+        device work is always exactly ONE scatter call."""
 
         while True:
             with self._lock:
@@ -394,6 +546,20 @@ class ContinuousBatchingDecoder:
                     return
                 req = self._queue.pop(0)
                 slot = free[0]
+                width = self._fused_width(req.prompt.size)
+                if width is not None and req.staged_cache is None:
+                    try:
+                        self._admit_fused(req, slot, width)
+                    except BaseException:
+                        # same survival rule as the legacy prefill: a
+                        # transient device failure must re-queue the
+                        # request, not strand its rid in _results with
+                        # waiters blocked forever (_admit_fused mutates
+                        # pool state only after a successful dispatch,
+                        # so head-of-queue reinsertion is safe)
+                        self._queue.insert(0, req)
+                        raise
+                    continue
                 self._reserved.add(slot)
             try:
                 if req.staged_cache is None:
@@ -417,10 +583,11 @@ class ContinuousBatchingDecoder:
                     self._release_staged_locked(req)
                     self._done_cond.notify_all()
                     continue
-                self._cache, self._last_tok = self._scatter()(
-                    self._cache, req.staged_cache, req.staged_tok,
-                    self._last_tok, jnp.int32(slot),
-                )
+                with self.ledger.dispatch("scatter", rid=req.rid):
+                    self._cache, self._last_tok = self._scatter()(
+                        self._cache, req.staged_cache, req.staged_tok,
+                        self._last_tok, jnp.int32(slot),
+                    )
                 self._release_staged_locked(req)
                 req.slot = slot
                 self._active[slot] = req
@@ -446,15 +613,16 @@ class ContinuousBatchingDecoder:
                 if req.temperature > 0.0:
                     req.rng, r = jax.random.split(req.rng)
                     rngs[slot] = np.asarray(r)
-            self._cache, self._last_tok, toks_k = self._step()(
-                self.params,
-                self._cache,
-                self._last_tok,
-                jnp.asarray(temps),
-                jnp.asarray(top_ks),
-                jnp.asarray(rngs),
-            )
-            host_toks = np.asarray(toks_k)  # [K, slots]
+            with self.ledger.dispatch("step", active=len(self._active)):
+                self._cache, self._last_tok, toks_k = self._step()(
+                    self.params,
+                    self._cache,
+                    self._last_tok,
+                    jnp.asarray(temps),
+                    jnp.asarray(top_ks),
+                    jnp.asarray(rngs),
+                )
+                host_toks = np.asarray(toks_k)  # [K, slots]
             finished = False
             for slot in list(self._active):
                 req = self._active[slot]

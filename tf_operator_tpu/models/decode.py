@@ -104,6 +104,32 @@ def max_window_chunk(cfg) -> "int | None":
     return None
 
 
+def set_cache_index(cache, n):
+    """Reset every layer's ``cache_index`` scalar to ``n``.
+
+    The rollback primitive shared by speculative decoding (rewind past
+    rejected proposals) and the batching pool's fused admission
+    (invalidate pad-position writes after a padded-width prefill):
+    non-rolling decode attention masks strictly by ``cache_index``
+    (transformer.py: ``cols <= row_pos``), so K/V rows at positions
+    >= n are invisible after the reset — no recompute, no copies.
+    NOT valid for rolling-window caches (their ``cached_pos`` wrap
+    state is not index-rollbackable); callers gate on that."""
+
+    def f(path, leaf):
+        name = ""
+        for entry in reversed(path):
+            k = getattr(entry, "key", None)
+            if isinstance(k, str):
+                name = k
+                break
+        if name == "cache_index":
+            return jnp.asarray(n, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(f, cache)
+
+
 def _init_cache_for(dmodel, batch_size: int):
     dummy = jnp.zeros((batch_size, 1), jnp.int32)
     shapes = jax.eval_shape(
@@ -247,10 +273,16 @@ class ChunkedServingDecoder:
     """
 
     def __init__(self, model, params, max_loops: int = 24,
-                 prompt_cache: int = 0):
+                 prompt_cache: int = 0, ledger=None):
         import threading
         from collections import OrderedDict
 
+        from tf_operator_tpu.utils.metrics import DispatchLedger
+
+        #: device-dispatch accounting (phases: prefill, decode) — the
+        #: sequential-serving baseline's "~5 dispatches per request"
+        #: becomes a counted number instead of a PROFILE.md estimate
+        self.ledger = ledger if ledger is not None else DispatchLedger()
         self.dmodel = _decode_variant(model)
         self.params = params
         self.max_len = self.dmodel.cfg.max_len
@@ -412,25 +444,28 @@ class ChunkedServingDecoder:
                     self.prompt_cache_hits += 1
             if hit is not None:
                 cache, last = hit
-                toks = self._loop_fn(budget, temperature, top_k)(
-                    self.params, cache, last, rng
-                )
+                with self.ledger.dispatch("decode"):
+                    toks = self._loop_fn(budget, temperature, top_k)(
+                        self.params, cache, last, rng
+                    )
                 return jnp.concatenate(
                     [prompt_ids, toks[:, :max_new_tokens]], axis=1
                 )
         cache = _init_cache_for(self.dmodel, b)
         offset, last = 0, None
         for width in self._chunks(p):
-            cache, last = self._prefill_fn(width)(
-                self.params, cache, prompt_ids[:, offset : offset + width]
-            )
+            with self.ledger.dispatch("prefill"):
+                cache, last = self._prefill_fn(width)(
+                    self.params, cache, prompt_ids[:, offset : offset + width]
+                )
             offset += width
         if key is not None:
             with self._lock:
                 while len(self._prompt_cache) >= self._prompt_cache_size:
                     self._prompt_cache.popitem(last=False)
                 self._prompt_cache[key] = (cache, last)
-        toks = self._loop_fn(budget, temperature, top_k)(
-            self.params, cache, last, rng
-        )
+        with self.ledger.dispatch("decode"):
+            toks = self._loop_fn(budget, temperature, top_k)(
+                self.params, cache, last, rng
+            )
         return jnp.concatenate([prompt_ids, toks[:, :max_new_tokens]], axis=1)

@@ -69,25 +69,10 @@ from tf_operator_tpu.models.decode import (
     _decode_variant,
     _init_cache_for,
     binary_chunks,
+    set_cache_index as _set_cache_index,  # rollback primitive, shared
 )
 from tf_operator_tpu.ops.quant import materialize_fn
-
-
-def _set_cache_index(cache, n):
-    """Reset every layer's cache_index scalar to n (rollback)."""
-
-    def f(path, leaf):
-        name = ""
-        for entry in reversed(path):
-            k = getattr(entry, "key", None)
-            if isinstance(k, str):
-                name = k
-                break
-        if name == "cache_index":
-            return jnp.asarray(n, leaf.dtype)
-        return leaf
-
-    return jax.tree_util.tree_map_with_path(f, cache)
+from tf_operator_tpu.utils.metrics import DispatchLedger
 
 
 class SpeculativeDecoder:
@@ -96,8 +81,13 @@ class SpeculativeDecoder:
 
     def __init__(
         self, target, tparams, draft, dparams, k: int = 4,
-        rounds_per_call: int = 8,
+        rounds_per_call: int = 8, ledger: "DispatchLedger | None" = None,
     ):
+        #: device-dispatch accounting (phases: prefill, generate for
+        #: the fused while driver, chunk for the scan driver,
+        #: round/step for the host loop) — the "one dispatch +
+        #: one packed fetch per generate()" claim, counted
+        self.ledger = ledger if ledger is not None else DispatchLedger()
         self.dtar = _decode_variant(target)
         self.ddraft = _decode_variant(draft)
         for m, who in ((self.dtar, "target"), (self.ddraft, "draft")):
@@ -507,7 +497,13 @@ class SpeculativeDecoder:
         packed commit buffer is fetched once after the final chunk,
         and caches stay device-resident in the state pytree.  Two
         compiled programs per (k, bucket, b, sampled) worst case — r0
-        and the top-up size are both deterministic."""
+        and the top-up size are both deterministic.
+
+        The loop is BOUNDED at the worst case (ADVICE r5): every round
+        commits at least one token per active row, so `bucket` rounds
+        total must finish every row.  Rows still unfinished past that
+        mean the round body's act/freeze logic regressed — raise
+        instead of dispatching device programs forever."""
 
         width = bucket + self.k
         state = {
@@ -521,17 +517,29 @@ class SpeculativeDecoder:
         r0 = 1 << max(0, r0 - 1).bit_length()  # pow2: bounded compiles
         limit_h = np.asarray(limit)
         chunk_r = r0
+        rounds_done = 0
         while True:
             fn = self._fused_scan(self.k, bucket, b, sampled, chunk_r)
-            state, packed = fn(
-                self.tparams, self.dparams, state, n0, limit, temp
-            )
-            # between-chunk done check: fetch ONLY the B-length n
-            # vector; the full packed buffer (B*(bucket+k) ints)
-            # crosses the wire once, after the final chunk
-            n_h = np.asarray(state["n"])
+            with self.ledger.dispatch("chunk", rounds=chunk_r):
+                state, packed = fn(
+                    self.tparams, self.dparams, state, n0, limit, temp
+                )
+                # between-chunk done check: fetch ONLY the B-length n
+                # vector; the full packed buffer (B*(bucket+k) ints)
+                # crosses the wire once, after the final chunk
+                n_h = np.asarray(state["n"])
+            rounds_done += chunk_r
             if (n_h >= limit_h).all():
                 return np.asarray(packed)
+            if rounds_done >= bucket:
+                raise RuntimeError(
+                    f"speculative scan driver dispatched {rounds_done} "
+                    f"rounds (worst case {bucket}: every round commits "
+                    f">=1 token per active row) with rows still "
+                    f"unfinished (n={n_h.tolist()}, "
+                    f"limit={limit_h.tolist()}) — the round body's "
+                    "act/freeze logic has regressed"
+                )
             chunk_r = max(1, min(self.scan_chunk_rounds, r0))
 
     def _rounds(self, k: int, r: int):
@@ -636,8 +644,14 @@ class SpeculativeDecoder:
         off = 0
         for width in binary_chunks(p):
             ids = prompt[:, off : off + width]
-            tcache, last = self._prefill("t", width)(self.tparams, tcache, ids)
-            dcache, _ = self._prefill("d", width)(self.dparams, dcache, ids)
+            with self.ledger.dispatch("prefill", model="target", width=width):
+                tcache, last = self._prefill("t", width)(
+                    self.tparams, tcache, ids
+                )
+            with self.ledger.dispatch("prefill", model="draft", width=width):
+                dcache, _ = self._prefill("d", width)(
+                    self.dparams, dcache, ids
+                )
             off += width
         rng, r0 = jax.random.split(rng)
         t1 = pick(last, r0)
@@ -677,12 +691,13 @@ class SpeculativeDecoder:
                     limit, row_rngs, temp,
                 )
             else:
-                packed = np.asarray(
-                    self._fused(self.k, bucket, b, sampled)(
-                        self.tparams, self.dparams, tcache, dcache, t1,
-                        n0_dev, limit, row_rngs, temp,
+                with self.ledger.dispatch("generate", bucket=bucket):
+                    packed = np.asarray(
+                        self._fused(self.k, bucket, b, sampled)(
+                            self.tparams, self.dparams, tcache, dcache, t1,
+                            n0_dev, limit, row_rngs, temp,
+                        )
                     )
-                )
             w = bucket + self.k
             toks = packed[: b * w].reshape(b, w)[:, :max_new_tokens]
             telem = packed[b * w + b :]
@@ -703,12 +718,14 @@ class SpeculativeDecoder:
                 # row freezes (room is no longer monotone), and a
                 # draft left behind here would propose from stale
                 # context ever after — acceptance would collapse.
-                tcache, last = self._prefill("t", 1)(
-                    self.tparams, tcache, t1[:, None]
-                )
-                dcache, _ = self._prefill("d", 1)(
-                    self.dparams, dcache, t1[:, None]
-                )
+                with self.ledger.dispatch("step", model="target"):
+                    tcache, last = self._prefill("t", 1)(
+                        self.tparams, tcache, t1[:, None]
+                    )
+                with self.ledger.dispatch("step", model="draft"):
+                    dcache, _ = self._prefill("d", 1)(
+                        self.dparams, dcache, t1[:, None]
+                    )
                 for i in active_rows():
                     rows[i].append(int(t1[i]))
                 n += 1  # device cache indexes advanced for every row
@@ -722,21 +739,21 @@ class SpeculativeDecoder:
             remaining = max_new_tokens - shortest()
             r = max(1, min(self.rounds_per_call, room // k, remaining))
             r = 1 << (r.bit_length() - 1)
-            if sampled:
-                (tcache, dcache, t1, n_dev, row_rngs, ms, chunks, acts) = (
-                    self._rounds_sampled(k, r)(
+            with self.ledger.dispatch("round", rounds=r):
+                if sampled:
+                    (tcache, dcache, t1, n_dev, row_rngs, ms, chunks,
+                     acts) = self._rounds_sampled(k, r)(
                         self.tparams, self.dparams, tcache, dcache, t1,
                         jnp.asarray(n, jnp.int32), limit, row_rngs, temp,
                     )
-                )
-            else:
-                tcache, dcache, t1, n_dev, ms, chunks, acts = (
-                    self._rounds(k, r)(
-                        self.tparams, self.dparams, tcache, dcache, t1,
-                        jnp.asarray(n, jnp.int32), limit,
+                else:
+                    tcache, dcache, t1, n_dev, ms, chunks, acts = (
+                        self._rounds(k, r)(
+                            self.tparams, self.dparams, tcache, dcache, t1,
+                            jnp.asarray(n, jnp.int32), limit,
+                        )
                     )
-                )
-            ms_h = np.asarray(ms)  # [r, B]
+                ms_h = np.asarray(ms)  # [r, B]
             chunks_h = np.asarray(chunks)  # [r, B, k]
             acts_h = np.asarray(acts)  # [r, B] bool
             for rr in range(r):

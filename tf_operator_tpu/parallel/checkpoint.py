@@ -225,7 +225,11 @@ def load_params(directory: str):
     import orbax.checkpoint as ocp
 
     ckptr = ocp.StandardCheckpointer()
-    meta = ckptr.metadata(directory).item_metadata
+    meta = ckptr.metadata(directory)
+    # orbax API drift: newer StandardCheckpointer.metadata returns the
+    # item tree directly; older releases wrap it in a CheckpointMetadata
+    # with .item_metadata
+    meta = getattr(meta, "item_metadata", meta)
     dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
     abstract = jax.tree_util.tree_map(
         lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=dev), meta

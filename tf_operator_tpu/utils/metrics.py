@@ -8,9 +8,11 @@ text exposition (servable later; no network dependency here).
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 from collections import defaultdict
-from typing import Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 
 #: default histogram buckets (seconds) — sync/span durations
@@ -169,6 +171,157 @@ class Metrics:
             for name, tid in sorted(self._exemplars.items()):
                 lines.append(f'# exemplar {name} trace_id="{tid}"')
         return "\n".join(lines) + "\n"
+
+
+class DispatchLedger:
+    """Device-dispatch accounting for the serving hot path.
+
+    On this box every device call rides a network tunnel whose
+    host↔device round trip (~66 ms, measured — benchmarks/PROFILE.md
+    "r5 serving") dwarfs the device math it orchestrates, so serving
+    walls decompose as ``dispatch count × RTT + device time``.  The
+    ledger turns that claim into an auditable number: every serving
+    decoder wraps each compiled-program call in ``dispatch(phase)``,
+    which counts it and measures the wall time of dispatch + any
+    in-block host fetch.  Dispatch COUNTS are platform-independent
+    (the same program structure runs everywhere); the measured
+    per-dispatch seconds are this box's RTT+device share.
+
+    Phases are free-form strings; the serving convention is
+    ``admission`` (the pool's fused prefill+sample+seat program),
+    ``prefill`` / ``scatter`` (the pool's legacy rolling-window path
+    and the chunked decoder's prompt chunks), ``step`` (the pool's
+    K-step sync), ``decode`` (the chunked decoder's budget loop),
+    ``generate`` (speculative's fused whole-generation program),
+    ``round`` / ``chunk`` (speculative's host-driven and scan
+    drivers).
+
+    Optional sinks, both None-safe:
+      - ``metrics``: every dispatch increments
+        ``serving_dispatch_total{phase=...}`` and observes
+        ``serving_dispatch_seconds_<phase>`` (bounded histogram), so
+        ``/metrics`` exports the ledger live;
+      - ``tracer``: when the calling thread is inside a trace (e.g. a
+        serve_lm request span), each dispatch records a child span
+        ``dispatch.<phase>`` — the per-request waterfall shows where
+        the round trips went.  Pool dispatches run on the driver
+        thread, outside any request context; they carry their request
+        id as a span attribute instead (see docs/ARCHITECTURE.md
+        "serving dispatch accounting").
+    """
+
+    def __init__(
+        self,
+        metrics: "Metrics | None" = None,
+        tracer=None,
+        prefix: str = "serving_dispatch",
+    ):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = defaultdict(int)
+        self._seconds: Dict[str, float] = defaultdict(float)
+        self.metrics = metrics
+        self.tracer = tracer
+        self.prefix = prefix
+
+    def record(self, phase: str, seconds: float, n: int = 1) -> None:
+        with self._lock:
+            self._counts[phase] += n
+            self._seconds[phase] += seconds
+        if self.metrics is not None:
+            self.metrics.inc(f"{self.prefix}_total", float(n), phase=phase)
+            self.metrics.observe_histogram(
+                f"{self.prefix}_seconds_{phase}", seconds
+            )
+
+    @contextlib.contextmanager
+    def dispatch(self, phase: str, **attrs: Any):
+        """``with ledger.dispatch("step"): fn(...)`` — count one device
+        dispatch and time the block (include the host fetch of any
+        value you need, so the measured seconds cover the full round
+        trip, not just the async enqueue)."""
+
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start_span(
+                f"dispatch.{phase}", kind="client", attributes=attrs or None
+            )
+            span.__enter__()
+        t0 = time.perf_counter()
+        try:
+            yield
+        except BaseException as exc:
+            # a failed device call must show as a FAILED span — error
+            # status is what tail sampling protects; closing it ok
+            # would get the one trace worth keeping evicted
+            if span is not None:
+                span.__exit__(type(exc), exc, exc.__traceback__)
+                span = None
+            raise
+        finally:
+            dt = time.perf_counter() - t0
+            if span is not None:
+                span.__exit__(None, None, None)
+            self.record(phase, dt)
+
+    # -- reads -------------------------------------------------------------
+
+    def count(self, phase: Optional[str] = None) -> int:
+        with self._lock:
+            if phase is not None:
+                return self._counts.get(phase, 0)
+            return sum(self._counts.values())
+
+    def seconds(self, phase: Optional[str] = None) -> float:
+        with self._lock:
+            if phase is not None:
+                return self._seconds.get(phase, 0.0)
+            return sum(self._seconds.values())
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """{phase: {count, seconds, mean_ms}} — the machine-readable
+        ledger benchmarks embed in their JSON output."""
+
+        with self._lock:
+            return {
+                phase: {
+                    "count": n,
+                    "seconds": round(self._seconds[phase], 4),
+                    "mean_ms": round(self._seconds[phase] / n * 1e3, 2),
+                }
+                for phase, n in sorted(self._counts.items())
+                if n
+            }
+
+    def table(self, wall: Optional[float] = None) -> str:
+        """Markdown ledger table: phase | dispatches | mean RTT | total.
+        With ``wall``, appends the accounting row — dispatch seconds vs
+        wall, i.e. how much of the wall the round trips explain."""
+
+        lines = [
+            "| phase | dispatches | mean ms/dispatch | total s |",
+            "|---|---|---|---|",
+        ]
+        snap = self.snapshot()
+        for phase, row in snap.items():
+            lines.append(
+                f"| {phase} | {row['count']} | {row['mean_ms']} "
+                f"| {row['seconds']} |"
+            )
+        total_n = sum(r["count"] for r in snap.values())
+        total_s = sum(r["seconds"] for r in snap.values())
+        tail = f"| **all** | {total_n} | — | {round(total_s, 4)} |"
+        if wall is not None and wall > 0:
+            tail = (
+                f"| **all** | {total_n} | — | {round(total_s, 4)} "
+                f"(= {total_s / wall:.0%} of {round(wall, 3)} s wall) |"
+            )
+        lines.append(tail)
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._seconds.clear()
 
 
 #: process-global default registry (controller accepts an override)
