@@ -193,6 +193,24 @@ def write_last_measured(data: dict, today: str) -> None:
         t.get("bert_base_steps_per_sec_per_chip"), "train.out")
     put("bert_base_mfu_analytic",
         t.get("bert_base_mfu_analytic"), "train.out")
+    # r7: the step-sync ledger sweep — the top-K fused step time is the
+    # "sync-free" training number; steady syncs/step is the invariant
+    # (0.0 when the windowed loop holds).  Read from the sweep dict
+    # itself so a non-default MEASURE_TRAIN_K window still lands its
+    # headline instead of vanishing behind a hard-coded key.
+    ksw = t.get("train_sync_k_sweep") or {}
+    if ksw:
+        k_top = max(ksw, key=int)
+        put(
+            f"train_k{k_top}_step_ms",
+            ksw[k_top].get("step_ms"), "train.out",
+        )
+    put("train_steady_syncs_per_step",
+        t.get("train_steady_syncs_per_step"), "train.out")
+    put("train_prefetch_best_depth",
+        t.get("train_prefetch_best_depth"), "train.out")
+    put("train_prefetch_vs_resident",
+        t.get("train_prefetch_vs_resident"), "train.out")
     bt = data.get("batching", {})
     put("batching_pool_tokens_per_sec",
         bt.get("batching_pool_tokens_per_sec"), "batching.out")
@@ -324,6 +342,31 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             f"ex/s, seq 128, fsdp){bert_mfu} "
             f"| 1× v5 lite, `measure.py --section train` → `window_out/train.out`, {today} |"
         )
+        ksw = t.get("train_sync_k_sweep")
+        if ksw:
+            sweep_txt = ", ".join(
+                f"K{k}: {row.get('step_ms', '?')} ms/step"
+                for k, row in sorted(ksw.items(), key=lambda kv: int(kv[0]))
+            )
+            steady = t.get("train_steady_syncs_per_step")
+            prefetch_txt = ""
+            if t.get("train_prefetch_best_depth") is not None:
+                prefetch_txt = (
+                    f"; live-pipeline prefetch sweep: best depth "
+                    f"{t['train_prefetch_best_depth']} at "
+                    f"{t.get('train_prefetch_vs_resident', '?')}× of "
+                    "device-resident"
+                )
+            rows["Training sync accounting"] = (
+                "| Training sync accounting (mnist CNN through the "
+                "harness train_loop, StepSyncLedger embedded — "
+                "PROFILE.md \"step-sync ledger\") | "
+                f"{sweep_txt}; steady-state blocking syncs/step "
+                f"**{steady if steady is not None else '?'}** "
+                "(K=1 = legacy per-step resolve; K>1 = fused "
+                f"lax.scan windows, deferred metric resolve){prefetch_txt} "
+                f"| 1× v5 lite, `measure.py --section train` → `window_out/train.out`, {today} |"
+            )
     bt = data.get("batching")
     if bt:
         n_new = bt.get("batching_new_tokens", "?")

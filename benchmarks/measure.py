@@ -187,7 +187,9 @@ def bench_training() -> dict:
     mesh = make_mesh({"dp": n_dev})
     r = np.random.RandomState(0)
 
-    # mnist CNN, batch 256
+    # mnist CNN, batch 256/chip (MEASURE_TRAIN_BATCH overrides — the
+    # CPU smoke of the r7 sweeps uses a small batch; the chip default
+    # stays 256)
     import jax.numpy as jnp
     import optax
 
@@ -198,9 +200,11 @@ def bench_training() -> dict:
         ).mean()
         return loss, {}
 
+    per_dev = int(os.environ.get("MEASURE_TRAIN_BATCH", "256"))
+    out["mnist_batch_per_chip"] = per_dev
     batch = {
-        "image": jnp.asarray(r.rand(256 * n_dev, 28, 28, 1), jnp.float32),
-        "label": jnp.asarray(r.randint(0, 10, size=(256 * n_dev,))),
+        "image": jnp.asarray(r.rand(per_dev * n_dev, 28, 28, 1), jnp.float32),
+        "label": jnp.asarray(r.randint(0, 10, size=(per_dev * n_dev,))),
     }
     trainer = Trainer(
         MnistCNN(),
@@ -214,6 +218,121 @@ def bench_training() -> dict:
     out["mnist_examples_per_sec_per_chip"] = round(
         stats["examples_per_sec"] / n_dev, 1
     )
+
+    # ---- r7 tentpole: the step-sync ledger K sweep.  The same mnist
+    # trainer driven through the harness train loop at steps_per_sync
+    # K in {1, 8, 32}; every run embeds its StepSyncLedger, so the
+    # artifact itself carries the invariant: K=1 resolves per step
+    # (sync count == steps — the legacy/debug baseline), K>1 fuses K
+    # steps into one lax.scan dispatch and defers metric resolution
+    # one window (``step``-phase syncs == 0 in steady state; only
+    # ``window``/``final`` fetches remain).  On the tunneled chip the
+    # K=32 step time is the "as fast as the hardware allows" training
+    # number; on CPU the same sweep smoke-tests the accounting.
+    if os.environ.get("MEASURE_TRAIN_SYNC", "1") != "0":
+        from tf_operator_tpu.runtime.harness import train_loop
+        from tf_operator_tpu.utils.metrics import StepSyncLedger
+
+        sync_steps = int(os.environ.get("MEASURE_TRAIN_SYNC_STEPS", "64"))
+        ks = [
+            int(x)
+            for x in os.environ.get("MEASURE_TRAIN_K", "1,8,32").split(",")
+        ]
+        sharded = trainer.shard_batch(batch)
+        ksweep = {}
+        for k_sync in ks:
+            # warmup compiles the window program(s) outside the wall
+            train_loop(
+                trainer, sharded, max(k_sync, 2), steps_per_sync=k_sync,
+                assert_decreasing=False, sync_ledger=StepSyncLedger(),
+            )
+            led = StepSyncLedger()
+            t0 = time.perf_counter()
+            train_loop(
+                trainer, sharded, sync_steps, steps_per_sync=k_sync,
+                assert_decreasing=False, sync_ledger=led,
+            )
+            wall = time.perf_counter() - t0
+            snap = led.snapshot()
+            ksweep[str(k_sync)] = {
+                "steps": sync_steps,
+                "wall_s": round(wall, 3),
+                "steps_per_sec": round(sync_steps / wall, 1),
+                "step_ms": round(wall / sync_steps * 1e3, 3),
+                "steady_step_syncs": led.count("step"),
+                "syncs_per_step": snap["_steps"]["syncs_per_step"],
+                "ledger": snap,
+            }
+        out["train_sync_k_sweep"] = ksweep
+        k_top = str(max(ks))
+        if k_top in ksweep:
+            out[f"train_k{k_top}_step_ms"] = ksweep[k_top]["step_ms"]
+            out["train_steady_syncs_per_step"] = ksweep[k_top][
+                "steady_step_syncs"
+            ] / sync_steps
+
+    # ---- device_prefetch depth sweep (r7): the live grain pipeline
+    # at prefetch depth 1/2/4/8 against the device-resident rate above
+    # — once the steady-state step is sync-free, the input pipeline is
+    # the next candidate constraint, and this table shows at which
+    # depth (if any) the loader stops being it.
+    if os.environ.get("MEASURE_PREFETCH", "1") != "0":
+        from tf_operator_tpu.data import (
+            device_prefetch,
+            ensure_mnist,
+            make_loader,
+        )
+
+        depths = [
+            int(x)
+            for x in os.environ.get(
+                "MEASURE_PREFETCH_DEPTHS", "1,2,4,8"
+            ).split(",")
+        ]
+        data_dir = os.environ.get(
+            "MEASURE_DATA_DIR",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "examples", "data", "mnist-measure",
+            ),
+        )
+        ensure_mnist(data_dir, n=8192)
+        psweep = {}
+        for depth in depths:
+            loader = make_loader(
+                data_dir, per_dev * n_dev, process_id=0, process_count=1,
+                num_epochs=None,
+            )
+            batches = device_prefetch(
+                loader, trainer.batch_sharding, prefetch=depth
+            )
+            pstats = trainer.benchmark_stream(batches, steps=20, warmup=3)
+            psweep[str(depth)] = {
+                "examples_per_sec_per_chip": round(
+                    pstats["examples_per_sec"] / n_dev, 1
+                ),
+                "step_ms": round(pstats["step_ms"], 3),
+            }
+        out["train_prefetch_sweep"] = psweep
+        best = max(
+            psweep.items(),
+            key=lambda kv: kv[1]["examples_per_sec_per_chip"],
+        )
+        out["train_prefetch_best_depth"] = int(best[0])
+        out["train_prefetch_best_examples_per_sec_per_chip"] = best[1][
+            "examples_per_sec_per_chip"
+        ]
+        out["train_prefetch_vs_resident"] = round(
+            best[1]["examples_per_sec_per_chip"]
+            / out["mnist_examples_per_sec_per_chip"],
+            3,
+        ) if out["mnist_examples_per_sec_per_chip"] else None
+
+    if os.environ.get("MEASURE_TRAIN_TINY"):
+        # CPU smoke of the mnist + K-sweep + prefetch accounting only:
+        # BERT-base/llama-mini steps are chip work (a CPU run would
+        # burn the window budget compiling them for meaningless rates)
+        return out
 
     # BERT-base MLM, seq 128, batch 32/chip
     from examples.bert_pretrain import synthetic_mlm_batch

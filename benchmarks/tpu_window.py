@@ -62,10 +62,13 @@ STEPS = [
     # TPU-lowering confirmation of the FLOPS.md accounting table
     # (compile-only, cheap — see benchmarks/FLOPS.md)
     ("flops", [sys.executable, os.path.join(HERE, "flops_audit.py")], 600),
+    # r7: the section now also runs the steps_per_sync K sweep (one
+    # lax.scan compile per K on this 1-core host) and the prefetch
+    # depth sweep — budget raised from 1800 accordingly
     (
         "train",
         [sys.executable, os.path.join(HERE, "measure.py"), "--section", "train"],
-        1800,
+        2700,
     ),
     (
         "flash",

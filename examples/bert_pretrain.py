@@ -72,7 +72,9 @@ def main() -> int:
     )
     sharded = trainer.shard_batch(batch)
     train_loop(
-        trainer, sharded, args.steps, tag=f"{args.model} fsdp={mesh.shape['fsdp']}"
+        trainer, sharded, args.steps,
+        tag=f"{args.model} fsdp={mesh.shape['fsdp']}",
+        steps_per_sync=args.steps_per_sync,
     )
     return 0
 

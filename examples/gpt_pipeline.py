@@ -122,6 +122,9 @@ def main() -> int:
             ids,
             args.steps,
             tag=f"{args.family} pp={args.pp} dp={dp} mb={args.microbatches}",
+            # _Loop has no train_steps: K>1 still windows the metric
+            # resolution (no per-step sync), dispatch stays per-step
+            steps_per_sync=args.steps_per_sync,
         )
     return 0
 

@@ -130,7 +130,10 @@ def main() -> int:
         shardings="logical",
     )
     sharded = (trainer.shard_batch(b) for b in batches)
-    train_loop(trainer, sharded, args.steps, tag=tag)
+    train_loop(
+        trainer, sharded, args.steps, tag=tag,
+        steps_per_sync=args.steps_per_sync,
+    )
 
     if args.export_dir:
         # collective: every process writes its shards directly

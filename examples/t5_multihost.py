@@ -79,6 +79,7 @@ def main() -> int:
     train_loop(
         trainer, sharded, args.steps,
         tag=f"{args.model} dp={mesh.shape['dp']} tp={mesh.shape['tp']}",
+        steps_per_sync=args.steps_per_sync,
     )
     return 0
 

@@ -61,7 +61,10 @@ def main() -> int:
     )
     sharded = trainer.shard_batch(batch)
     tag = f"{args.model} dp={mesh.shape['dp']} tp={mesh.shape['tp']}"
-    train_loop(trainer, sharded, args.steps, tag=tag)
+    train_loop(
+        trainer, sharded, args.steps, tag=tag,
+        steps_per_sync=args.steps_per_sync,
+    )
     stats = trainer.benchmark(batch, steps=max(args.steps // 2, 5), warmup=0)
     print(f"{tag}: {stats['examples_per_sec']:.1f} ex/s global", flush=True)
     return 0

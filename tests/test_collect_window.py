@@ -115,6 +115,48 @@ def test_multiline_json_artifacts_parse(tmp_path):
     assert text.index("Serving under concurrency") < text.index("train:end")
 
 
+def test_train_sync_keys_parse_into_row_and_ledger(tmp_path):
+    """r7: the step-sync K sweep + prefetch keys flow from train.out
+    into the 'Training sync accounting' BASELINE row and the
+    LAST_MEASURED ledger — a window that measures the sync-free step
+    must not drop it on the floor."""
+
+    import json
+
+    d = tmp_path / "window_out"
+    d.mkdir()
+    t = dict(json.loads(TRAIN_LINE))
+    t.update(
+        {
+            "train_sync_k_sweep": {
+                "1": {"step_ms": 70.0, "steady_step_syncs": 64},
+                "8": {"step_ms": 12.5, "steady_step_syncs": 0},
+                "32": {"step_ms": 6.1, "steady_step_syncs": 0},
+            },
+            "train_k32_step_ms": 6.1,
+            "train_steady_syncs_per_step": 0.0,
+            "train_prefetch_best_depth": 4,
+            "train_prefetch_vs_resident": 0.91,
+        }
+    )
+    (d / "train.out").write_text(json.dumps(t, indent=1) + "\n")
+    data = cw.parse_artifacts(str(d))
+    rows = cw.build_rows(data, "2026-08-03")
+    row = rows["Training sync accounting"]
+    assert "K1: 70.0 ms/step" in row and "K32: 6.1 ms/step" in row
+    assert "syncs/step **0.0**" in row
+    assert "best depth 4" in row
+
+    import unittest.mock as mock
+
+    with mock.patch.object(cw, "HERE", str(tmp_path)):
+        cw.write_last_measured(data, "2026-08-03")
+        led = json.load(open(tmp_path / "LAST_MEASURED.json"))
+    assert led["train_k32_step_ms"]["value"] == 6.1
+    assert led["train_steady_syncs_per_step"]["value"] == 0.0
+    assert led["train_prefetch_best_depth"]["value"] == 4
+
+
 def test_error_bench_line_is_ignored(tmp_path):
     d = tmp_path / "w"
     d.mkdir()

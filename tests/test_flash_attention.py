@@ -452,6 +452,54 @@ class TestDefaultBlockEnv:
         monkeypatch.setenv("TPU_OPERATOR_FLASH_BLOCK_K", "512")
         assert default_flash_blocks() == (128, 512)
 
+    def test_head_dim_caps_default_block_class(self, monkeypatch):
+        """ADVICE r5 #1: the 1024-class default was measured at the
+        16 MB scoped-VMEM ceiling only for D=64/128 — a larger head dim
+        must cap the BUILT-IN default at the 512 class (block footprint
+        scales with D) instead of routing into a Pallas compile OOM.
+        Pins (caller args, BLOCK env) stay exactly as given."""
+
+        from tf_operator_tpu.ops.flash_attention import resolve_flash_blocks
+
+        monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_Q", raising=False)
+        monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_K", raising=False)
+        # measured head dims keep the 1024 default
+        assert resolve_flash_blocks(None, None, 2048, 2048, head_dim=64) == (1024, 1024)
+        assert resolve_flash_blocks(None, None, 2048, 2048, head_dim=128) == (1024, 1024)
+        # D > 128: capped to the 512 class before sequence tiling
+        assert resolve_flash_blocks(None, None, 2048, 2048, head_dim=256) == (512, 512)
+        # the cap composes with sequence shrinking (512 doesn't tile 256)
+        assert resolve_flash_blocks(None, None, 256, 256, head_dim=256) == (256, 256)
+        # head_dim unknown (legacy callers): old behavior
+        assert resolve_flash_blocks(None, None, 2048, 2048) == (1024, 1024)
+        # caller pins are NEVER adjusted, big D or not
+        assert resolve_flash_blocks(1024, None, 2048, 2048, head_dim=256) == (1024, 512)
+        # env pins are NEVER adjusted either (a sweep measures what it set)
+        monkeypatch.setenv("TPU_OPERATOR_FLASH_BLOCK_Q", "1024")
+        assert resolve_flash_blocks(None, None, 2048, 2048, head_dim=256) == (1024, 512)
+
+    def test_attention_routes_big_head_dim_to_capped_blocks(self, monkeypatch):
+        """The dispatching attention() passes q's head dim through, so
+        a D=256 model auto-resolves 512-class blocks (the regression
+        route: head_dim>128 through the default path)."""
+
+        import importlib
+
+        fa = importlib.import_module("tf_operator_tpu.ops.flash_attention")
+        monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_Q", raising=False)
+        monkeypatch.delenv("TPU_OPERATOR_FLASH_BLOCK_K", raising=False)
+        seen = {}
+        real = fa._flash_applicable
+
+        def spy(q, k, bias, mask, block_q, block_k, window=None):
+            seen["blocks"] = (block_q, block_k)
+            return real(q, k, bias, mask, block_q, block_k, window)
+
+        monkeypatch.setattr(fa, "_flash_applicable", spy)
+        q, k, v = rand_qkv(11, 1, 2, 2048, 256)
+        fa.attention(q, k, v, causal=True)
+        assert seen["blocks"] == (512, 512)
+
     def test_attention_uses_env_blocks(self, monkeypatch):
         """attention() resolves None block args from the env — the
         sweep's per-variant processes tune the kernel without touching

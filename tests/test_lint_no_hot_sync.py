@@ -1,0 +1,139 @@
+"""Lint gate: no raw blocking host↔device syncs in the training hot
+path (ISSUE 4, the training twin of test_lint_no_bare_except.py).
+
+The tentpole made steady-state training dispatch-free-running: every
+device→host fetch in the step loop goes through
+``StepSyncLedger.resolve()`` so it is counted, timed, and visible on
+``/metrics`` as ``train_sync_*``.  A raw ``float(...)`` /
+``np.asarray(...)`` / ``jax.device_get(...)`` / ``.block_until_ready()``
+re-introduced into the step-loop bodies would silently bring back the
+one-RTT-per-step serialization PR 4 removed — this AST walk keeps it
+out.
+
+Scope: the functions that ARE the step loop — ``train_loop`` in
+runtime/harness.py and the train-step path in parallel/trainer.py
+(``train_step`` / ``train_steps`` / the compiled bodies).  A forbidden
+call is exempt only when its own arguments contain a ``.resolve(...)``
+call (``float(ledger.resolve(...))`` — already host-side by
+construction).  Measurement helpers (benchmark/_slope_time/hard_sync)
+and eval are off the steady-state path and stay unlinted.
+"""
+
+import ast
+import pathlib
+
+import tf_operator_tpu
+
+PKG_ROOT = pathlib.Path(tf_operator_tpu.__file__).parent
+
+#: (file, function names that constitute its step-loop hot path)
+HOT_FUNCTIONS = {
+    "runtime/harness.py": {"train_loop"},
+    "parallel/trainer.py": {
+        "train_step",
+        "train_steps",
+        "_step_body",
+        "_build_step",
+        "_build_multi_step",
+    },
+}
+
+#: bare-name calls that force a device→host sync
+FORBIDDEN_NAMES = {"float"}
+#: attribute calls that force one (any receiver: np.asarray,
+#: jax.device_get, arr.block_until_ready)
+FORBIDDEN_ATTRS = {"asarray", "device_get", "block_until_ready"}
+
+
+def _forbidden(call: ast.Call):
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in FORBIDDEN_NAMES:
+        return f.id
+    if isinstance(f, ast.Attribute) and f.attr in FORBIDDEN_ATTRS:
+        return f.attr
+    return None
+
+
+def _contains_resolve(node: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "resolve"
+        for n in ast.walk(node)
+    )
+
+
+def _is_exempt(call: ast.Call) -> bool:
+    args = list(call.args) + [kw.value for kw in call.keywords]
+    return any(_contains_resolve(a) for a in args)
+
+
+def find_hot_syncs(tree: ast.AST, func_names, label: str):
+    offenders = []
+    for fn in ast.walk(tree):
+        if (
+            isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and fn.name in func_names
+        ):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    name = _forbidden(node)
+                    if name is not None and not _is_exempt(node):
+                        offenders.append(f"{label}:{node.lineno} {name}(...)")
+    return offenders
+
+
+def _lint_package():
+    offenders = []
+    for rel, funcs in sorted(HOT_FUNCTIONS.items()):
+        path = PKG_ROOT / rel
+        tree = ast.parse(path.read_text(), filename=str(path))
+        offenders.extend(find_hot_syncs(tree, funcs, rel))
+    return offenders
+
+
+def test_no_raw_syncs_in_training_hot_path():
+    offenders = _lint_package()
+    assert not offenders, (
+        "raw blocking host<->device syncs in the training step loop — "
+        "route them through StepSyncLedger.resolve() "
+        "(utils/metrics.py):\n  " + "\n  ".join(offenders)
+    )
+
+
+def test_walker_catches_planted_syncs():
+    """The gate itself works: each forbidden spelling is found inside a
+    hot function, resolve-routed fetches are not, and functions outside
+    the hot set are ignored."""
+
+    src = (
+        "def train_loop(trainer, batch):\n"
+        "    for step in range(10):\n"
+        "        m = trainer.train_step(batch)\n"
+        "        a = float(m['loss'])\n"                 # offender
+        "        b = np.asarray(m['loss'])\n"            # offender
+        "        jax.device_get(m)\n"                    # offender
+        "        m['loss'].block_until_ready()\n"        # offender
+        "        ok = float(ledger.resolve('step', m['loss']))\n"  # exempt
+        "        ok2 = np.asarray(ledger.resolve('w', m))\n"       # exempt
+        "\n"
+        "def evaluate(batches):\n"
+        "    return [float(b) for b in batches]\n"       # not hot: ignored
+    )
+    offenders = find_hot_syncs(ast.parse(src), {"train_loop"}, "planted")
+    assert [o.split()[1] for o in offenders] == [
+        "float(...)", "asarray(...)", "device_get(...)",
+        "block_until_ready(...)",
+    ]
+
+
+def test_resolve_argument_interior_is_not_exempt():
+    """``ledger.resolve('x', float(y))`` evaluates float(y) BEFORE the
+    ledger sees anything — that interior sync must still be flagged."""
+
+    src = (
+        "def train_step(self, batch):\n"
+        "    self.ledger.resolve('x', float(batch['y']))\n"
+    )
+    offenders = find_hot_syncs(ast.parse(src), {"train_step"}, "planted")
+    assert len(offenders) == 1 and "float" in offenders[0]

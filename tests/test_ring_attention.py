@@ -168,6 +168,45 @@ class TestFlashRing:
                     block_q=16, block_k=16, interpret=True,
                 )
 
+    def test_use_flash_short_circuits_on_indivisible_seq(self):
+        """ADVICE r5 #3: when S % n != 0 there is NO per-shard length,
+        so use_flash resolution must short-circuit — use_flash=True
+        raises the divisibility error (not a block-tiling message
+        computed against the fictitious global length), and auto mode
+        never consults block resolution at all."""
+
+        import importlib
+
+        mesh = make_mesh({"sp": 4, "dp": 2})
+        q, k, v = self._qkv(S=98)  # 98 % 4 != 0
+        with pytest.raises(ValueError, match="does not divide"):
+            with mesh:
+                ring_attention(q, k, v, mesh, use_flash=True, interpret=True)
+        # auto mode (use_flash=None) must not even resolve blocks
+        # against the global length — the resolver is off-limits here
+        fa = importlib.import_module("tf_operator_tpu.ops.flash_attention")
+
+        def boom(*a, **kw):  # pragma: no cover - the assertion IS the call
+            raise AssertionError(
+                "resolve_flash_blocks consulted for an indivisible seq"
+            )
+
+        orig = fa.resolve_flash_blocks
+        fa.resolve_flash_blocks = boom
+        try:
+            with mesh:
+                # S=98 also doesn't shard over sp=4 for the XLA local
+                # path's shard_map — expect the standard shard error,
+                # NOT the planted AssertionError
+                try:
+                    ring_attention(q, k, v, mesh, interpret=True)
+                except AssertionError:
+                    raise
+                except Exception:
+                    pass
+        finally:
+            fa.resolve_flash_blocks = orig
+
 
 class TestFlashRingBackward:
     """The pallas ring backward (gradient accumulators riding the ring)

@@ -98,7 +98,7 @@ def device_prefetch(
     sharding_tree,
     *,
     image_dtype=np.float32,
-    prefetch: int = 2,
+    prefetch: Optional[int] = None,
     normalize_on_device: bool = False,
 ) -> Iterator[dict]:
     """Iterate device-resident global batches, transfer overlapped.
@@ -109,6 +109,13 @@ def device_prefetch(
     the next batch run while the current step computes — jax transfers
     are async, so simply staying ahead of consumption is enough.
 
+    ``prefetch`` (the depth knob): None reads ``TPU_OPERATOR_PREFETCH``
+    (default 2).  Depth trades host memory (depth × batch bytes staged)
+    against tolerance for loader jitter; once the training step is
+    sync-free (steps_per_sync > 1) the pipeline is the remaining
+    constraint candidate, and ``measure.py --section train`` sweeps
+    this knob so PROFILE.md shows where more depth stops paying.
+
     ``normalize_on_device=True`` ships the uint8 pixels as-is (4-8x
     less transfer traffic) and casts/scales on device — the right mode
     whenever host→device bandwidth is the constraint.
@@ -117,6 +124,11 @@ def device_prefetch(
     import collections
 
     import jax
+
+    if prefetch is None:
+        prefetch = int(os.environ.get("TPU_OPERATOR_PREFETCH", "2"))
+    if prefetch < 1:
+        raise ValueError(f"prefetch depth must be >= 1, got {prefetch}")
 
     scale = None
     if normalize_on_device:

@@ -565,7 +565,7 @@ def flash_attention(
     O(S^2).  Same banding in the backward kernels."""
 
     block_q, block_k = resolve_flash_blocks(
-        block_q, block_k, q.shape[-2], k.shape[-2]
+        block_q, block_k, q.shape[-2], k.shape[-2], head_dim=q.shape[-1]
     )
     return _flash_attention_p(q, k, v, causal, block_q, block_k, interpret, window)
 
@@ -646,7 +646,7 @@ def flash_attention_sharded(
     sp == ep == 1 (ring attention owns sp > 1)."""
 
     block_q, block_k = resolve_flash_blocks(
-        block_q, block_k, q.shape[-2], k.shape[-2]
+        block_q, block_k, q.shape[-2], k.shape[-2], head_dim=q.shape[-1]
     )
 
     from tf_operator_tpu.utils.jax_compat import shard_map_unchecked
@@ -766,7 +766,11 @@ def default_flash_blocks() -> tuple:
 
 
 def resolve_flash_blocks(
-    block_q: Optional[int], block_k: Optional[int], sq: int, sk: int
+    block_q: Optional[int],
+    block_k: Optional[int],
+    sq: int,
+    sk: int,
+    head_dim: Optional[int] = None,
 ) -> tuple:
     """Fill unpinned block dims from default_flash_blocks(), shrinking
     each BUILT-IN default per-dim (1024→512→256→128) until it tiles the
@@ -775,16 +779,34 @@ def resolve_flash_blocks(
     Used everywhere blocks default: `attention()` (whose auto-crossover
     then keys on the resolved blocks), the raw kernel entry points, and
     the sp schedules (ring/ulysses), which size blocks against their
-    per-shard sequence."""
+    per-shard sequence.
+
+    ``head_dim`` (ADVICE r5 #1): the 1024-class default sits AT the
+    16 MB scoped-VMEM ceiling, measured only at D=64/128 — kernel
+    block footprint scales with D, so a larger head dim would route an
+    UNMEASURED config into a Pallas compile OOM (which this platform
+    surfaces as the misleading "unexpected worker hostname" error, see
+    default_flash_blocks) instead of the XLA fallback.  When the
+    caller passes the head dim and it exceeds 128, the built-in
+    default class is capped at 512 before sequence tiling; explicit
+    pins (caller args / BLOCK env vars) are still taken exactly as
+    given — a sweep probing big-D 1024 blocks measures what it set."""
 
     dq, dk = default_flash_blocks()
+    cap = 512 if head_dim is not None and head_dim > 128 else None
     if block_q is None:
         if not os.environ.get("TPU_OPERATOR_FLASH_BLOCK_Q"):
+            if cap is not None:
+                while dq > cap:
+                    dq //= 2
             while dq > 128 and sq % dq:
                 dq //= 2
         block_q = dq
     if block_k is None:
         if not os.environ.get("TPU_OPERATOR_FLASH_BLOCK_K"):
+            if cap is not None:
+                while dk > cap:
+                    dk //= 2
             while dk > 128 and sk % dk:
                 dk //= 2
         block_k = dk
@@ -818,7 +840,7 @@ def attention(
     # pinned) down to smaller blocks keep the higher seq floor those
     # blocks were measured at.
     block_q, block_k = resolve_flash_blocks(
-        block_q, block_k, q.shape[-2], k.shape[-2]
+        block_q, block_k, q.shape[-2], k.shape[-2], head_dim=q.shape[-1]
     )
     if _flash_applicable(q, k, bias, mask, block_q, block_k, window):
         mode = _mesh_flash_applicable(mesh, q, k)

@@ -614,18 +614,33 @@ def ring_attention(
         resolve_use_flash,
     )
 
-    # blocks size against the PER-SHARD sequence (each ring hop's
-    # kernel call sees S/n); unpinned dims take the tuned defaults,
-    # shrunk until they tile the shard
-    local_s = q.shape[-2] // n if q.shape[-2] % n == 0 else q.shape[-2]
-    block_q, block_k = resolve_flash_blocks(block_q, block_k, local_s, local_s)
-    use_flash = resolve_use_flash(
-        use_flash,
-        _flash_ring_applicable(q, n, block_q, block_k),
-        f"use_flash=True but per-shard shapes don't tile the kernel: "
-        f"seq {q.shape[-2]} over {n} shards with blocks "
-        f"({block_q},{block_k})",
-    )
+    if q.shape[-2] % n:
+        # a non-divisible sequence has NO per-shard length to size
+        # blocks against (ADVICE r5 #3: resolving against the global S
+        # here produced blocks for a length no shard ever sees) —
+        # short-circuit use_flash instead of consulting the kernel
+        if use_flash:
+            raise ValueError(
+                f"use_flash=True but seq {q.shape[-2]} does not divide "
+                f"over {n} '{axis_name}' shards — flash ring needs a "
+                f"whole per-shard sequence to tile"
+            )
+        use_flash = False
+    else:
+        # blocks size against the PER-SHARD sequence (each ring hop's
+        # kernel call sees S/n); unpinned dims take the tuned defaults,
+        # shrunk until they tile the shard
+        local_s = q.shape[-2] // n
+        block_q, block_k = resolve_flash_blocks(
+            block_q, block_k, local_s, local_s, head_dim=q.shape[-1]
+        )
+        use_flash = resolve_use_flash(
+            use_flash,
+            _flash_ring_applicable(q, n, block_q, block_k),
+            f"use_flash=True but per-shard shapes don't tile the kernel: "
+            f"seq {q.shape[-2]} over {n} shards with blocks "
+            f"({block_q},{block_k})",
+        )
 
     spec = P(batch_axes, heads_axis, axis_name, None)
     if use_flash:

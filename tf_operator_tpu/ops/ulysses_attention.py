@@ -164,7 +164,7 @@ def ulysses_attention(
     # sharded here): size unpinned block dims against S, tuned defaults
     # shrunk until they tile
     block_q, block_k = resolve_flash_blocks(
-        block_q, block_k, q.shape[-2], k.shape[-2]
+        block_q, block_k, q.shape[-2], k.shape[-2], head_dim=q.shape[-1]
     )
     use_flash = resolve_use_flash(
         use_flash,

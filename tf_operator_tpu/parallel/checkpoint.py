@@ -13,15 +13,83 @@ back laid out exactly as the mesh expects (no gather through host 0).
 
 from __future__ import annotations
 
+import threading
+from collections import deque
 from typing import Optional
 
 import jax
 
 
-class TrainerCheckpointer:
-    """Thin orbax CheckpointManager wrapper bound to a Trainer."""
+def _device_copy(tree, zero):
+    """A REAL on-device copy of every leaf, as a full-size
+    ``dynamic_slice`` whose start index is a RUNTIME value (``zero``,
+    passed as a traced argument).  Two lesser spellings fail on this
+    platform, both measured 2026-08-03:
 
-    def __init__(self, directory: str, max_to_keep: int = 2):
+    - ``jax.jit(lambda t: t)`` is input-forwarded by jax (outputs that
+      are literally inputs skip XLA and return the same buffers — the
+      "snapshot" is then clobbered by the next donated train step);
+    - an add-zero copy is algebraically foldable, and its compiled
+      output buffers were observed tracking the live state under the
+      training suite (content drifting toward later-step values while
+      the checkpoint writer held the only reference).
+
+    A dynamic_slice with a start XLA cannot prove constant must
+    materialize a genuine gather into fresh buffers — nothing to fold,
+    nothing to alias."""
+
+    def cp(x):
+        if x.ndim == 0:
+            return jax.lax.dynamic_slice(x[None], (zero,), (1,))[0]
+        return jax.lax.dynamic_slice(x, (zero,) * x.ndim, x.shape)
+
+    return jax.tree_util.tree_map(cp, tree)
+
+
+class TrainerCheckpointer:
+    """Orbax CheckpointManager wrapper bound to a Trainer, with an
+    ASYNC save path that never blocks the step loop.
+
+    The old save() called ``manager.save`` on the LIVE state inline,
+    which device_gets the full TrainState synchronously — the step loop
+    stalled for (pending compute + D2H of params+optimizer state) every
+    save.  Now:
+
+      1. ``save()`` dispatches a jitted device COPY of the state
+         (async — the copy runs after in-flight steps finish and
+         materializes buffers the step loop's donation can't
+         invalidate) and parks it as the PENDING snapshot;
+      2. the pending snapshot is fetched to host at the NEXT
+         checkpointer call (save/wait/restore/close), on the MAIN
+         thread — by then its compute finished a whole checkpoint
+         interval ago, so the fetch is a pure transfer, not a pipeline
+         drain.  Fetching from the main thread is deliberate: on this
+         platform a background thread's ``device_get`` racing the step
+         loop's donated dispatches returns wrong values (measured
+         2026-08-03, deterministic drift toward later-step state even
+         though the snapshot's buffers are independent — same family
+         of platform lies as hard_sync's, PROFILE.md "timing
+         honesty"), so background threads here do DISK work only;
+      3. the host tree goes to a background writer thread for the
+         orbax write, with a bounded in-flight budget
+         (``max_in_flight``): when the budget is full the caller waits
+         for the oldest writer — bounded memory, traced honestly
+         (``checkpoint.save.budget_wait``) because it is the one spot
+         the step loop can still stall.
+
+    Durability contract: a ``wait=False`` save is durable at latest by
+    the NEXT checkpointer call; ``wait=True`` preserves the synchronous
+    contract (save returns with the checkpoint durable) — same code
+    path, flushed immediately, so sync and async artifacts are
+    byte-identical at the payload level
+    (tests/test_checkpoint_async.py).  A background write failure is
+    re-raised on the NEXT save/restore/wait/close call — async must not
+    mean silently lossy.
+    """
+
+    def __init__(
+        self, directory: str, max_to_keep: int = 2, max_in_flight: int = 1
+    ):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
@@ -29,11 +97,107 @@ class TrainerCheckpointer:
             directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
         )
+        if max_in_flight < 1:
+            raise ValueError(f"max_in_flight must be >= 1, got {max_in_flight}")
+        self.max_in_flight = max_in_flight
+        self._in_flight: deque = deque()  # (step, Thread) — disk writers
+        #: the parked device snapshot awaiting its main-thread fetch:
+        #: (step, unboxed device tree, originating trace id) or None
+        self._pending = None
+        self._errors: list = []
+        self._errors_lock = threading.Lock()
+        #: orbax managers are not safe for concurrent save calls: with
+        #: max_in_flight > 1 the writer threads serialize here (the
+        #: budget bounds queued SNAPSHOTS, not concurrent writes)
+        self._manager_lock = threading.Lock()
+
+    def _raise_pending_error(self) -> None:
+        with self._errors_lock:
+            if self._errors:
+                err = self._errors.pop(0)
+                raise RuntimeError(
+                    f"async checkpoint save (step {err[0]}) failed"
+                ) from err[1]
+
+    def _reap(self) -> None:
+        while self._in_flight and not self._in_flight[0][1].is_alive():
+            self._in_flight.popleft()
+
+    def _flush_pending(self) -> None:
+        """MAIN-thread fetch of the parked snapshot (see class
+        docstring: background device access is unsafe here), then hand
+        the host tree to a disk-writer thread under the budget."""
+
+        from tf_operator_tpu.utils.trace import default_tracer
+
+        if self._pending is None:
+            return
+        step, unboxed, trace_id = self._pending
+        self._pending = None
+        with default_tracer.span(
+            "checkpoint.fetch",
+            attributes={"step": step, "saveTraceId": trace_id},
+        ):
+            host_state = jax.device_get(unboxed)
+        self._reap()
+        while len(self._in_flight) >= self.max_in_flight:
+            with default_tracer.span(
+                "checkpoint.save.budget_wait",
+                attributes={"inFlight": len(self._in_flight)},
+            ):
+                self._in_flight.popleft()[1].join()
+        thread = threading.Thread(
+            target=self._write,
+            args=(step, host_state, trace_id),
+            name=f"ckpt-save-{step}",
+            daemon=True,
+        )
+        self._in_flight.append((step, thread))
+        thread.start()
+
+    def _drain(self) -> None:
+        """Flush the parked snapshot, join every in-flight writer and
+        the orbax background work — after this, the newest requested
+        save is durable."""
+
+        self._flush_pending()
+        while self._in_flight:
+            self._in_flight.popleft()[1].join()
+        self.manager.wait_until_finished()
+        self._raise_pending_error()
+
+    def _write(self, step: int, host_state, parent_trace_id) -> None:
+        """Background writer body: the orbax DISK write of an
+        already-host state tree — no device access off the main thread.
+        Its span is a fresh root (threads don't inherit the loop's
+        context) linked back via the saveTraceId attribute."""
+
+        from tf_operator_tpu.utils.trace import default_tracer
+
+        try:
+            with default_tracer.span(
+                "checkpoint.write",
+                root=True,
+                attributes={"step": step, "saveTraceId": parent_trace_id},
+            ):
+                with self._manager_lock:
+                    self.manager.save(
+                        step,
+                        args=self._ocp.args.StandardSave(
+                            {"state": host_state}
+                        ),
+                    )
+                    self.manager.wait_until_finished()
+        except BaseException as exc:  # surfaces on the next caller op
+            with self._errors_lock:
+                self._errors.append((step, exc))
 
     def save(self, trainer, step: Optional[int] = None, wait: bool = False) -> int:
         """Persist the trainer's full TrainState at ``step`` (default:
-        the state's own step counter).  Async by default; ``wait``
-        blocks until durable.
+        the trainer's HOST-side step mirror — reading
+        ``trainer.state.step`` would be a blocking device sync in the
+        step loop).  Returns after snapshot + enqueue; ``wait=True``
+        blocks until durable (the test/shutdown contract).
 
         Saved UNBOXED (flax partitioning metadata stripped): the
         artifact is a plain array tree, so it restores into any mesh's
@@ -45,20 +209,66 @@ class TrainerCheckpointer:
 
         from tf_operator_tpu.utils.trace import default_tracer
 
+        self._raise_pending_error()
         if step is None:
-            step = int(trainer.state.step)
+            host_step = getattr(trainer, "_host_step", None)
+            # duck-typed trainers without the host-side mirror fall
+            # back to reading the device step — a blocking sync, but
+            # correct beats silently writing every checkpoint at 0
+            step = (
+                int(host_step)
+                if host_step is not None
+                else int(trainer.state.step)
+            )
+        # the span covers exactly what the STEP LOOP waited on: the
+        # (async) snapshot dispatch, the PREVIOUS save's deferred
+        # fetch (pure transfer — its compute finished an interval
+        # ago), any budget wait, and — only with wait=True — the full
+        # flush; the disk wall lives in the writer's own
+        # checkpoint.write span
         with default_tracer.span(
             "checkpoint.save", attributes={"step": step, "wait": wait}
-        ):
-            self.manager.save(
-                step,
-                args=self._ocp.args.StandardSave(
-                    {"state": meta.unbox(trainer.state)}
-                ),
-            )
+        ) as sp:
+            # device-side copy: dispatch is async; the copied buffers
+            # are independent of the live state, so the next
+            # train_step's donation cannot invalidate what the
+            # deferred fetch will read (_device_copy — a jit identity
+            # would be input-forwarded and alias the donated buffers).
+            # The snapshot compiles OUTSIDE the persistent compilation
+            # cache: on this platform a cache-deserialized SPMD
+            # executable of this program has computed WRONG VALUES
+            # (measured 2026-08-03, only on the cache read path), and
+            # a corrupt snapshot program silently saves wrong bytes.
+            # One honest in-process compile per shape is the price of
+            # a checkpoint you can trust.
+            if not hasattr(self, "_snapshot_fn"):
+                self._snapshot_fn = jax.jit(_device_copy)
+            import jax.numpy as jnp
+
+            prev_cache = jax.config.jax_enable_compilation_cache
+            jax.config.update("jax_enable_compilation_cache", False)
+            try:
+                snapshot = self._snapshot_fn(
+                    trainer.state, jnp.zeros((), jnp.int32)
+                )
+            finally:
+                jax.config.update(
+                    "jax_enable_compilation_cache", prev_cache
+                )
+            # resolve the PREVIOUS parked snapshot first (the same
+            # deferred-window discipline as the train loop's metric
+            # resolution), then park this one
+            self._flush_pending()
+            self._pending = (step, meta.unbox(snapshot), sp.trace_id)
             if wait:
-                self.manager.wait_until_finished()
+                self._drain()
         return step
+
+    def wait(self) -> None:
+        """Block until every enqueued save is durable (end-of-run
+        barrier for callers that saved with wait=False)."""
+
+        self._drain()
 
     def restore_latest(self, trainer) -> Optional[int]:
         """Restore the newest checkpoint into ``trainer.state`` with the
@@ -76,6 +286,9 @@ class TrainerCheckpointer:
 
         from tf_operator_tpu.utils.trace import default_tracer
 
+        # restore-while-saving must see the newest requested step (and
+        # surface any background write failure) — drain first
+        self._drain()
         latest = self.manager.latest_step()
         if latest is None:
             return None
@@ -103,16 +316,30 @@ class TrainerCheckpointer:
             # the flax partitioning boxes, whose saved paths differ.
             # Every other failure (corruption, IO, shape change) must
             # surface with its original diagnostic, not be retried
-            # against a structurally different target.
-            if "tree structures do not match" not in str(primary_err):
+            # against a structurally different target.  Orbax wording
+            # drift: 0.5 said "tree structures do not match"; 0.7 hits
+            # the same mismatch as "Expected dict, got ArrayRestoreArgs"
+            # (flatten_up_to of the boxed artifact against the plain
+            # target).
+            msg = str(primary_err)
+            if (
+                "tree structures do not match" not in msg
+                and "Expected dict" not in msg
+            ):
                 raise
-            # rebuild the abstract target in the boxed shape, then
-            # unbox what comes back — the restart contract holds across
-            # the upgrade boundary.  A failure here propagates chained
-            # to the primary error ("during handling of ...").
+            # rebuild the abstract target in the boxed ARTIFACT shape,
+            # then unwrap what comes back — the restart contract holds
+            # across the upgrade boundary.  A legacy artifact stored
+            # each flax partitioning box through its pytree form, i.e.
+            # an extra {"value": leaf} nesting level; the target must
+            # mirror that as PLAIN dicts (orbax 0.7 rejects real
+            # AxisMetadata nodes in restore targets — the tree-flatten
+            # mismatch this except arm exists for).  A failure here
+            # propagates chained to the primary error ("during
+            # handling of ...").
             boxed_abstract = jax.tree_util.tree_map(
                 lambda live, s: (
-                    live.replace_boxed(_sds(live.unbox(), s))
+                    {"value": _sds(live.unbox(), s)}
                     if _is_box(live)
                     else _sds(live, s)
                 ),
@@ -120,11 +347,15 @@ class TrainerCheckpointer:
                 trainer.state_sharding,
                 is_leaf=_is_box,
             )
-            restored = meta.unbox(
-                self.manager.restore(
-                    latest,
-                    args=self._ocp.args.StandardRestore({"state": boxed_abstract}),
-                )["state"]
+            restored = self.manager.restore(
+                latest,
+                args=self._ocp.args.StandardRestore({"state": boxed_abstract}),
+            )["state"]
+            restored = jax.tree_util.tree_map(
+                lambda live, val: val["value"] if _is_box(live) else val,
+                trainer.state,
+                restored,
+                is_leaf=_is_box,
             )
 
         trainer.state = jax.tree_util.tree_map(
@@ -137,8 +368,10 @@ class TrainerCheckpointer:
         return latest
 
     def close(self) -> None:
-        self.manager.wait_until_finished()
-        self.manager.close()
+        try:
+            self._drain()
+        finally:
+            self.manager.close()
 
 
 def export_params(trainer, directory: str) -> None:

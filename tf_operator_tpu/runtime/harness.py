@@ -31,6 +31,14 @@ def standard_parser(description: str, **defaults) -> argparse.ArgumentParser:
         type=float,
         default=defaults.get("learning_rate", 0.1),
     )
+    p.add_argument(
+        "--steps-per-sync",
+        type=int,
+        default=defaults.get("steps_per_sync", 8),
+        help="K: fuse K train steps per host dispatch (lax.scan) and "
+        "resolve metrics once per window — 0 blocking syncs per "
+        "steady-state step.  1 = the per-step legacy path (debugging)",
+    )
     return p
 
 
@@ -64,6 +72,20 @@ def batch_sizes(batch_per_device: int):
     return global_batch, local_batch
 
 
+def _resolve_losses(ledger, phase: str, pending) -> List[float]:
+    """Resolve a window's device-side loss arrays (scalars and/or
+    stacked [n] vectors) to a flat host float list through the sync
+    ledger — the ONE device→host route the training loop uses."""
+
+    import numpy as np
+
+    out: List[float] = []
+    for v in ledger.resolve(phase, pending):
+        a = np.asarray(v)
+        out.extend(a.reshape(-1).tolist() if a.ndim else [a.item()])
+    return out
+
+
 def train_loop(
     trainer,
     batch_or_batches,
@@ -73,6 +95,8 @@ def train_loop(
     tag: str = "train",
     assert_decreasing: bool = True,
     tracer=None,
+    steps_per_sync: int = 1,
+    sync_ledger=None,
 ) -> List[float]:
     """Run ``steps`` steps, print the standard per-process summary, and
     (by default) fail loudly if the loss did not decrease — the examples
@@ -81,22 +105,44 @@ def train_loop(
     ``batch_or_batches``: one device-resident batch (reused every step)
     or an iterator of batches (a live input pipeline).
 
-    Traced (utils/trace): the run is one ``train <tag>`` trace with a
-    span per step, split into ``data.load`` and ``train.step`` children
-    — the training-side end of the operator's trace story, so a slow
-    step shows *which half* (input pipeline vs device step) ate the
-    time.  Long runs truncate at the store's per-trace span cap; the
-    waterfall reports how many spans were dropped.
+    ``steps_per_sync`` (K) is the training twin of serving's
+    steps_per_sync knob: with K > 1 the loop keeps losses as DEVICE
+    arrays and resolves them to floats once per K-step window — always
+    the *previous* window, after the next one is already dispatched, so
+    the host never waits on work it just enqueued.  On a fixed batch the
+    window itself is ONE compiled program (``Trainer.train_steps``'s
+    K-step ``lax.scan``); on a live pipeline each step still dispatches
+    (the prefetch buffer owns the batches) but metric resolution stays
+    windowed.  Steady-state steps therefore perform exactly 0 blocking
+    host↔device syncs — counted, per phase, by ``sync_ledger`` (a
+    ``utils/metrics.StepSyncLedger``; one is created against the
+    default metrics registry when not passed).  K=1 is the legacy
+    per-step path, bit-identical losses to the pre-windowing loop (and
+    1 honest ``step``-phase sync per step on the ledger) — keep it for
+    debugging.  The loss-decrease e2e guard always runs on the fully
+    resolved series at the end.
+
+    Traced (utils/trace): one ``train <tag>`` trace; K=1 keeps a span
+    per step, K>1 emits a span per window, each split into
+    ``data.load`` / ``train.step`` children, with the ledger's
+    ``sync.window`` / ``sync.final`` spans marking the deferred
+    resolves.  Long runs truncate at the store's per-trace span cap;
+    the waterfall reports how many spans were dropped.
     """
 
     import sys
 
     import jax
-    import numpy as np
 
+    from tf_operator_tpu.utils.metrics import StepSyncLedger, default_metrics
     from tf_operator_tpu.utils.trace import default_tracer
 
     tr = tracer if tracer is not None else default_tracer
+    ledger = (
+        sync_ledger
+        if sync_ledger is not None
+        else StepSyncLedger(metrics=default_metrics, tracer=tr)
+    )
 
     batches: Optional[Iterable[Dict]] = None
     fixed = None
@@ -105,23 +151,86 @@ def train_loop(
     else:
         fixed = batch_or_batches
 
+    k = max(1, int(steps_per_sync))
+    # fused scan windows need a fixed batch and a trainer that ships
+    # train_steps; custom trainers without it keep per-step dispatch
+    # (windowed resolution still applies — dispatch is async anyway)
+    fused = fixed is not None and callable(
+        getattr(trainer, "train_steps", None)
+    )
+
+    # ONE ledger covers the whole run: the trainer's own fetches
+    # (summary-interval scalar resolves) must land on the same ledger
+    # as the loop's window resolves, or the embedded snapshot
+    # under-reports the run's real syncs
+    prev_trainer_ledger = getattr(trainer, "sync_ledger", None)
+    if prev_trainer_ledger is not None:
+        trainer.sync_ledger = ledger
+
     losses: List[float] = []
-    with tr.span(
-        f"train {tag}", attributes={"startStep": start_step, "steps": steps}
-    ):
-        for step in range(start_step, steps):
-            with tr.span(f"step {step}"):
-                if batches is not None:
-                    with tr.span("data.load"):
-                        batch = next(batches)
-                else:
-                    batch = fixed
-                with tr.span("train.step"):
-                    metrics = trainer.train_step(batch)
-            losses.append(float(metrics["loss"]))
+    pending: List = []  # previous window's device-side loss arrays
+    try:
+        with tr.span(
+            f"train {tag}",
+            attributes={
+                "startStep": start_step, "steps": steps, "stepsPerSync": k,
+            },
+        ):
+            if k == 1:
+                # legacy per-step path: resolve EVERY step (one counted
+                # sync per step — the debugging baseline the ledger's
+                # steady-state invariant is measured against)
+                for step in range(start_step, steps):
+                    with tr.span(f"step {step}"):
+                        if batches is not None:
+                            with tr.span("data.load"):
+                                batch = next(batches)
+                        else:
+                            batch = fixed
+                        with tr.span("train.step"):
+                            metrics = trainer.train_step(batch)
+                    ledger.step()
+                    losses.extend(_resolve_losses(ledger, "step", [metrics["loss"]]))
+            else:
+                step = start_step
+                while step < steps:
+                    n = min(k, steps - step)
+                    window: List = []
+                    with tr.span(
+                        f"steps {step}..{step + n}", attributes={"k": n}
+                    ):
+                        if fused:
+                            with tr.span("train.step"):
+                                metrics = trainer.train_steps(fixed, n)
+                            window.append(metrics["loss"])  # stacked [n]
+                        else:
+                            for _ in range(n):
+                                if batches is not None:
+                                    with tr.span("data.load"):
+                                        batch = next(batches)
+                                else:
+                                    batch = fixed
+                                with tr.span("train.step"):
+                                    m = trainer.train_step(batch)
+                                window.append(m["loss"])
+                    ledger.step(n)
+                    # deferred resolution: fetch the PREVIOUS window now
+                    # that this one is dispatched — its arrays are (almost
+                    # always) already finished, so the host rides behind
+                    # the device instead of gating it
+                    if pending:
+                        losses.extend(_resolve_losses(ledger, "window", pending))
+                    pending = window
+                    step += n
+            if pending:
+                losses.extend(_resolve_losses(ledger, "final", pending))
+
+    finally:
+        if prev_trainer_ledger is not None:
+            trainer.sync_ledger = prev_trainer_ledger
 
     if losses:
-        first, last = losses[0], float(np.mean(losses[-5:]))
+        first, last = losses[0], sum(losses[-5:]) / len(losses[-5:])
         print(
             f"process {jax.process_index()}/{jax.process_count()} [{tag}]: "
             f"steps {start_step}..{steps} loss {first:.4f} -> {last:.4f}",

@@ -215,6 +215,7 @@ class DispatchLedger:
         metrics: "Metrics | None" = None,
         tracer=None,
         prefix: str = "serving_dispatch",
+        span_prefix: str = "dispatch",
     ):
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = defaultdict(int)
@@ -222,6 +223,7 @@ class DispatchLedger:
         self.metrics = metrics
         self.tracer = tracer
         self.prefix = prefix
+        self.span_prefix = span_prefix
 
     def record(self, phase: str, seconds: float, n: int = 1) -> None:
         with self._lock:
@@ -243,7 +245,8 @@ class DispatchLedger:
         span = None
         if self.tracer is not None:
             span = self.tracer.start_span(
-                f"dispatch.{phase}", kind="client", attributes=attrs or None
+                f"{self.span_prefix}.{phase}", kind="client",
+                attributes=attrs or None,
             )
             span.__enter__()
         t0 = time.perf_counter()
@@ -301,7 +304,11 @@ class DispatchLedger:
             "| phase | dispatches | mean ms/dispatch | total s |",
             "|---|---|---|---|",
         ]
-        snap = self.snapshot()
+        # subclasses may add "_"-prefixed meta rows (e.g. the sync
+        # ledger's _steps summary) that are not dispatch phases
+        snap = {
+            k: v for k, v in self.snapshot().items() if not k.startswith("_")
+        }
         for phase, row in snap.items():
             lines.append(
                 f"| {phase} | {row['count']} | {row['mean_ms']} "
@@ -322,6 +329,135 @@ class DispatchLedger:
         with self._lock:
             self._counts.clear()
             self._seconds.clear()
+
+
+class StepSyncLedger(DispatchLedger):
+    """Blocking host↔device sync accounting for the TRAINING hot path —
+    the training-side generalization of the serving DispatchLedger.
+
+    Serving's disease was dispatch count × RTT; training's is the dual:
+    a single ``float(metrics["loss"])`` per step serializes host
+    dispatch against device compute, so the step loop runs at one RTT
+    per step regardless of model FLOPs.  This ledger turns "the step
+    loop never waits on the device" into an auditable number: every
+    value that crosses device→host in the training loop must go through
+    :meth:`resolve`, which counts it, times it, and records whether the
+    host actually had to WAIT (the arrays were not yet ready — a true
+    blocking sync) or merely fetched finished results.
+
+    Phase convention (see docs/ARCHITECTURE.md "training sync
+    accounting"):
+      ``step``    — a per-step resolve (the K=1 legacy/debug path; any
+                    count here during steady state is the bug this
+                    ledger exists to catch);
+      ``window``  — the deferred every-K-steps resolve of the PREVIOUS
+                    metrics window (steady state: the only fetches);
+      ``final``   — the end-of-run resolve of the last window;
+      ``summary`` — interval summary-writer scalar conversions;
+      ``checkpoint`` — waits attributable to checkpoint save budgets.
+
+    The steady-state invariant tests pin (the training twin of "1
+    dispatch per request"): **count("step") == 0** for every
+    steps_per_sync > 1 run — zero blocking syncs per steady-state step.
+
+    Sinks mirror DispatchLedger: counters ``train_sync_total{phase=}``
+    (+ ``train_sync_blocked_total`` when the host provably waited),
+    histograms ``train_sync_seconds_<phase>``, and ``sync.<phase>``
+    trace spans.
+    """
+
+    def __init__(
+        self,
+        metrics: "Metrics | None" = None,
+        tracer=None,
+        prefix: str = "train_sync",
+    ):
+        super().__init__(
+            metrics=metrics, tracer=tracer, prefix=prefix, span_prefix="sync"
+        )
+        self._blocked: Dict[str, int] = defaultdict(int)
+        self._steps = 0
+
+    def step(self, n: int = 1) -> None:
+        """Mark ``n`` training steps dispatched (host-side counter — a
+        device read here would be the very sync this ledger forbids)."""
+
+        with self._lock:
+            self._steps += n
+
+    @property
+    def steps(self) -> int:
+        with self._lock:
+            return self._steps
+
+    def resolve(self, phase: str, tree):
+        """THE sanctioned device→host fetch: returns host (numpy)
+        values for ``tree``'s leaves.  Counted under ``phase``; if any
+        leaf was still computing when the fetch started, the resolve is
+        additionally counted as BLOCKED (the host waited on the device,
+        not just on the wire).  The static lint gate
+        (tests/test_lint_no_hot_sync.py) forbids raw ``float()`` /
+        ``device_get`` in the step-loop bodies precisely so every sync
+        funnels through here."""
+
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree)
+        ready = all(
+            getattr(x, "is_ready", lambda: True)() for x in leaves
+        )
+        with self.dispatch(phase, blocked=not ready):
+            out = jax.device_get(tree)
+        if not ready:
+            with self._lock:
+                self._blocked[phase] += 1
+            if self.metrics is not None:
+                self.metrics.inc(
+                    f"{self.prefix}_blocked_total", 1.0, phase=phase
+                )
+        return out
+
+    def blocked(self, phase: Optional[str] = None) -> int:
+        """Resolves where the host provably WAITED on device compute
+        (leaves not ready at fetch start).  Indicative, not pinned: on
+        fast hosts a window's arrays often finish before the deferred
+        resolve arrives, so blocked <= count by design."""
+
+        with self._lock:
+            if phase is not None:
+                return self._blocked.get(phase, 0)
+            return sum(self._blocked.values())
+
+    def per_step(self, phase: Optional[str] = None) -> float:
+        """Syncs per dispatched training step (count/steps; 0 when no
+        steps were marked)."""
+
+        n = self.count(phase)
+        with self._lock:
+            return n / self._steps if self._steps else 0.0
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """DispatchLedger's {phase: {count, seconds, mean_ms}} plus
+        per-phase ``blocked`` and a ``_steps`` summary row — the shape
+        measure.py embeds in the K-sweep artifact."""
+
+        snap = super().snapshot()
+        with self._lock:
+            for phase, row in snap.items():
+                row["blocked"] = self._blocked.get(phase, 0)
+            steps = self._steps
+        total = sum(r["count"] for r in snap.values())
+        snap["_steps"] = {
+            "count": steps,
+            "syncs_per_step": round(total / steps, 4) if steps else 0.0,
+        }
+        return snap
+
+    def reset(self) -> None:
+        super().reset()
+        with self._lock:
+            self._blocked.clear()
+            self._steps = 0
 
 
 #: process-global default registry (controller accepts an override)
