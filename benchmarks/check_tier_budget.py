@@ -1,0 +1,75 @@
+"""Slow-tier budget gate (VERDICT r5 next #8: "cap the slow tier").
+
+tests/conftest.py records every pytest session's wall clock per tier
+into benchmarks/SUITE_RECORD.json; this check FAILS (exit 1) when the
+most recent slow-tier run exceeded its budget, so a creeping e2e suite
+is a round-end error rather than a silent tax.  Run it after the tiers:
+
+    python -m pytest tests/ -m 'not slow' ...   # records tier1
+    python -m pytest tests/ -m 'slow' ...       # records slow
+    python benchmarks/check_tier_budget.py      # gate
+
+No slow record yet = warn + exit 0 (tier-1-only rounds must not fail),
+so the gate only bites rounds that actually ran the slow tier.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+#: VERDICT r5 target: slow tier < 30 min (at -n 4; serial runs get the
+#: same cap — the point is the trend, and serial r5 measured ~11 min
+#: for a 42-test sample, so the full suite has headroom to stay under)
+SLOW_TIER_BUDGET_S = 1800.0
+
+RECORD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "SUITE_RECORD.json"
+)
+
+
+def check(record: dict, budget_s: float = SLOW_TIER_BUDGET_S):
+    """(ok, message) for a parsed SUITE_RECORD.json dict."""
+
+    lines = []
+    for tier in ("tier1", "slow", "all"):
+        row = record.get(tier)
+        if row:
+            lines.append(
+                f"{tier}: {row['wall_s']:.0f}s wall, "
+                f"{row.get('collected', '?')} collected, "
+                f"exit {row.get('exitstatus', '?')} ({row.get('when', '?')})"
+            )
+    summary = "\n".join(lines) if lines else "no recorded sessions"
+    slow = record.get("slow")
+    if slow is None:
+        return True, summary + "\nslow tier: no record yet (gate skipped)"
+    if float(slow["wall_s"]) > budget_s:
+        return False, (
+            summary
+            + f"\nSLOW TIER OVER BUDGET: {slow['wall_s']:.0f}s > "
+            f"{budget_s:.0f}s — collapse scenarios (shared-harness jobs, "
+            "see tests/test_e2e_scenarios.py's merged boots) or raise "
+            "the budget with a justification here"
+        )
+    return True, (
+        summary
+        + f"\nslow tier within budget: {slow['wall_s']:.0f}s <= {budget_s:.0f}s"
+    )
+
+
+def main() -> int:
+    try:
+        with open(RECORD_PATH) as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        print("no benchmarks/SUITE_RECORD.json yet (gate skipped)")
+        return 0
+    ok, message = check(record)
+    print(message)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,6 +100,17 @@ def run_variant(variant: str, batch_per_chip: int, steps: int, trace_dir: str | 
                 trainer.train_step(batch)
             jax.effects_barrier()
         summarize_xplane(trace_dir)
+        # the category half (VERDICT r5 next #4, wired): every traced
+        # run also prints the per-family share table AND its committed
+        # markdown shape, so the window artifact carries the FLOPS.md
+        # "trace category table" rows without a second invocation
+        import trace_categories
+
+        tables = trace_categories.category_tables(trace_dir)
+        if tables:
+            print(trace_categories.format_text(tables))
+            print("\n--- markdown (FLOPS.md 'trace category table') ---")
+            print(trace_categories.format_markdown(tables))
     return out
 
 

@@ -119,6 +119,17 @@ STEPS = [
         ],
         900,
     ),
+    # r8: the category table, standalone (profile_resnet already prints
+    # it inline post-trace; this re-reads the saved xplane so the
+    # committed FLOPS.md "trace category table" rows land in their own
+    # window_out file for collect_window even if the trace step's
+    # stdout is truncated)
+    (
+        "trace-categories",
+        [sys.executable, os.path.join(HERE, "trace_categories.py"),
+         "/tmp/rn50-xplane", "--md"],
+        300,
+    ),
     (
         "sweep",
         [sys.executable, os.path.join(HERE, "mfu_sweep.py"), "--timeout", "700"],

@@ -1,7 +1,7 @@
 """Category breakdown of a saved xplane trace: where did the step's
 device time actually go?
 
-    python benchmarks/trace_categories.py /tmp/rn50-xplane
+    python benchmarks/trace_categories.py /tmp/rn50-xplane [--md]
 
 Groups the "[XLA Ops]" line (synchronous device ops — these sum to the
 critical path) by op family and prints each family's share, with the
@@ -9,15 +9,22 @@ async-DMA line ("[Async XLA Ops]") reported separately since those
 overlap compute.  This is the trace-proven half of the "what bounds
 ResNet at ~0.29 MFU" claim (benchmarks/PROFILE.md): the sweep shows the
 plateau, this table names the ops on the critical path.
+
+Importable (r8, VERDICT r5 next #4): ``profile_resnet.py --trace``
+calls :func:`category_tables` + :func:`format_markdown` right after
+capturing, so every traced run emits the committed-table shape
+(benchmarks/FLOPS.md "trace category table") without a second tool
+invocation; the tpu_window trace step passes ``--md`` for the same
+reason.
 """
 
 from __future__ import annotations
 
 import glob
 import os
-import re
 import sys
 from collections import defaultdict
+from typing import Any, Dict, List
 
 
 def categorize(name: str) -> str:
@@ -43,53 +50,133 @@ def categorize(name: str) -> str:
     return "other"
 
 
-def main() -> int:
-    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/rn50-xplane"
+def category_tables(trace_dir: str) -> List[Dict[str, Any]]:
+    """Parse the newest xplane under ``trace_dir`` into one table per
+    device plane/op line: ``{plane, line, kind, total_s, rows}`` with
+    ``rows`` = [(category, seconds, count)] sorted by share desc.
+    Returns [] when no xplane exists (the caller prints the miss)."""
+
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     paths = glob.glob(
         os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
     )
     if not paths:
-        print("no xplane found under", trace_dir)
-        return 1
+        return []
     path = max(paths, key=os.path.getmtime)
     space = xplane_pb2.XSpace()
     with open(path, "rb") as f:
         space.ParseFromString(f.read())
+    tables: List[Dict[str, Any]] = []
     for plane in space.planes:
-        if "TPU" not in plane.name and "/device:" not in plane.name:
+        # device planes ("/device:TPU:0") on chip; the XLA client
+        # executor lines of "/host:CPU" carry the op events on a CPU
+        # smoke run (no "XLA Ops" line exists there — every non-python
+        # line aggregates into one pseudo-table instead)
+        cpu_smoke = plane.name == "/host:CPU"
+        if (
+            "TPU" not in plane.name
+            and "/device:" not in plane.name
+            and not cpu_smoke
+        ):
             continue
+        groups: Dict[str, list] = defaultdict(list)
         for line in plane.lines:
-            if line.name not in ("XLA Ops", "Async XLA Ops"):
-                continue
+            if cpu_smoke:
+                if line.name in ("python", "Steps"):
+                    continue
+                groups["XLA client ops"].append(line)
+            elif line.name in ("XLA Ops", "Async XLA Ops"):
+                groups[line.name].append(line)
+        for gname, lines in groups.items():
             by_cat = defaultdict(float)
             cnt = defaultdict(int)
             total = 0.0
-            for ev in line.events:
-                meta = plane.event_metadata.get(ev.metadata_id)
-                name = meta.name if meta else "?"
-                dur = ev.duration_ps / 1e12
-                cat = categorize(name)
-                by_cat[cat] += dur
-                cnt[cat] += 1
-                total += dur
+            for line in lines:
+                for ev in line.events:
+                    meta = plane.event_metadata.get(ev.metadata_id)
+                    name = meta.name if meta else "?"
+                    if cpu_smoke and (
+                        "thunkexecutor" in name.lower()
+                        or name.startswith(("while", "call."))
+                    ):
+                        # container events (the executor frame, while-
+                        # loop and call wrappers) span every op they
+                        # contain: counting them would double every
+                        # category into "other"
+                        continue
+                    dur = ev.duration_ps / 1e12
+                    cat = categorize(name)
+                    by_cat[cat] += dur
+                    cnt[cat] += 1
+                    total += dur
             if not total:
                 continue
-            kind = (
-                "critical path (sync ops)"
-                if line.name == "XLA Ops"
-                else "overlapped DMA (async)"
+            tables.append({
+                "plane": plane.name,
+                "line": gname,
+                "kind": (
+                    "critical path (sync ops)"
+                    if gname == "XLA Ops"
+                    else "overlapped DMA (async)"
+                    if gname == "Async XLA Ops"
+                    else "cpu smoke (all client lines, threads overlap)"
+                ),
+                "total_s": total,
+                "rows": sorted(
+                    ((cat, dur, cnt[cat]) for cat, dur in by_cat.items()),
+                    key=lambda r: -r[1],
+                ),
+            })
+    return tables
+
+
+def format_text(tables: List[Dict[str, Any]]) -> str:
+    out = []
+    for t in tables:
+        out.append(
+            f"\n== {t['plane']} / {t['line']} — {t['kind']}: "
+            f"{t['total_s'] * 1e3:.1f} ms total =="
+        )
+        for cat, dur, n in t["rows"]:
+            out.append(
+                f"{dur * 1e3:10.2f} ms  {dur / t['total_s'] * 100:5.1f}%  "
+                f"x{n:<6d} {cat}"
             )
-            print(
-                f"\n== {plane.name} / {line.name} — {kind}: "
-                f"{total*1e3:.1f} ms total =="
+    return "\n".join(out)
+
+
+def format_markdown(tables: List[Dict[str, Any]]) -> str:
+    """The committed-table shape (benchmarks/FLOPS.md): one markdown
+    table per plane/line."""
+
+    out = []
+    for t in tables:
+        out.append(
+            f"\n**{t['plane']} / {t['line']}** — {t['kind']}, "
+            f"{t['total_s'] * 1e3:.1f} ms total\n"
+        )
+        out.append("| category | ms | share | ops |")
+        out.append("|---|---|---|---|")
+        for cat, dur, n in t["rows"]:
+            out.append(
+                f"| {cat} | {dur * 1e3:.2f} | "
+                f"{dur / t['total_s'] * 100:.1f}% | {n} |"
             )
-            for cat, dur in sorted(by_cat.items(), key=lambda kv: -kv[1]):
-                print(
-                    f"{dur*1e3:10.2f} ms  {dur/total*100:5.1f}%  "
-                    f"x{cnt[cat]:<6d} {cat}"
-                )
+    return "\n".join(out)
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    trace_dir = args[0] if args else "/tmp/rn50-xplane"
+    tables = category_tables(trace_dir)
+    if not tables:
+        print("no xplane found under", trace_dir)
+        return 1
+    print(format_text(tables))
+    if "--md" in sys.argv[1:]:
+        print("\n--- markdown (FLOPS.md 'trace category table') ---")
+        print(format_markdown(tables))
     return 0
 
 

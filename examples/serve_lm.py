@@ -97,6 +97,7 @@ def speculative_slowdown(ledger_path: "str | None" = None):
 def build_handler(
     model, params, max_len: int, batching_slots: int = 0,
     speculative: bool = False, prompt_cache: int = 0, tracer=None,
+    model_label: str = "", metrics=None,
 ):
     """batching_slots > 0 serves through the continuous-batching pool
     (models/batching.py): concurrent requests share one decode loop,
@@ -120,7 +121,12 @@ def build_handler(
     from tf_operator_tpu.data.text import decode_bytes
     from tf_operator_tpu.models.batching import ContinuousBatchingDecoder
     from tf_operator_tpu.models.decode import ChunkedServingDecoder
-    from tf_operator_tpu.utils.metrics import DispatchLedger, Metrics
+    from tf_operator_tpu.utils import flight
+    from tf_operator_tpu.utils.metrics import (
+        SLO_BUCKETS,
+        DispatchLedger,
+        Metrics,
+    )
     from tf_operator_tpu.utils.trace import (
         TRACE_HEADER,
         Tracer,
@@ -133,10 +139,51 @@ def build_handler(
     # shared by every decoder in the process: serving_dispatch_*
     # counters land in /metrics and request-thread dispatches become
     # dispatch.<phase> child spans of the request span.
-    metrics = Metrics()
+    # main() passes ITS registry so every sink in the process — the
+    # handler's /metrics, the watchdog's stall counter, the flight
+    # recorder's deltas — reads and writes the same exposition
+    metrics = metrics if metrics is not None else Metrics()
     if tracer is None:
         tracer = Tracer()
     ledger = DispatchLedger(metrics=metrics, tracer=tracer)
+    model_label = model_label or "unknown"
+    #: the serving-SLO families (TTFT / time-per-output-token / queue
+    #: wait / end-to-end), labeled by model+mode (route on the e2e
+    #: family), get the long-tail SLO buckets — a 256-token generate
+    #: on a tunneled chip is tens of seconds
+    for fam in (
+        "serve_ttft_seconds",
+        "serve_time_per_output_token_seconds",
+        "serve_queue_wait_seconds",
+        "serve_request_seconds",
+    ):
+        metrics.set_buckets(fam, SLO_BUCKETS)
+    #: process flight recorder: spans + logs + metric deltas survive to
+    #: the moment of failure; served on /debug/flightrecorder
+    recorder = flight.default_recorder
+    recorder.attach_tracer(tracer)
+    recorder.attach_metrics(metrics)
+
+    def observe_slo(mode: str, queue_wait: float, ttft: float,
+                    tpot: float) -> None:
+        """Single-dispatch modes (chunked/speculative) produce their
+        whole output in one program: the first token is host-visible
+        only when every token is, so TTFT is honestly the full
+        generate wall and time-per-output-token is wall/n (docs/
+        SERVING.md "SLO definitions").  The pool observes its own
+        precise per-request values instead."""
+
+        metrics.observe_histogram(
+            "serve_queue_wait_seconds", queue_wait,
+            model=model_label, mode=mode,
+        )
+        metrics.observe_histogram(
+            "serve_ttft_seconds", ttft, model=model_label, mode=mode,
+        )
+        metrics.observe_histogram(
+            "serve_time_per_output_token_seconds", tpot,
+            model=model_label, mode=mode,
+        )
 
     if speculative:
         if batching_slots > 0:
@@ -171,16 +218,26 @@ def build_handler(
             )
         pool = ContinuousBatchingDecoder(
             model, params, slots=batching_slots, ledger=ledger,
+            metrics=metrics, model_label=model_label,
         )
         pool_fatal = []  # driver-thread death must surface as 500s
 
         def _drive():
+            # the pool driver is THE liveness-critical thread: a wedge
+            # here hangs every queued client, so it heartbeats the
+            # process watchdog (which dumps stacks + flight recorder
+            # past the deadline — utils/watchdog.py)
+            from tf_operator_tpu.utils.watchdog import default_watchdog
+
+            hb = default_watchdog.register("serving.pool")
             while True:
                 try:
+                    hb.beat()
                     if pool.step() == 0:
                         _time.sleep(0.005)
                 except Exception as exc:  # a dead driver = hung clients
                     pool_fatal.append(repr(exc))
+                    default_watchdog.unregister(hb.name)
                     return
 
         threading.Thread(target=_drive, daemon=True).start()
@@ -202,7 +259,8 @@ def build_handler(
             if t0 is not None:  # a /generate request being answered
                 self._t0 = None
                 metrics.observe_histogram(
-                    "serve_request_seconds", _time.perf_counter() - t0
+                    "serve_request_seconds", _time.perf_counter() - t0,
+                    route="/generate", model=model_label,
                 )
                 metrics.inc("serve_requests_total", status=str(code))
                 if code == 200 and isinstance(payload.get("sample"), str):
@@ -261,6 +319,46 @@ def build_handler(
                 if t is None:
                     return self._reply(404, {"error": "unknown trace id"})
                 return self._reply(200, t)
+            if self.path == "/slo":
+                # the operator's one-look answer to "what latency are
+                # users seeing right now": per-{model,mode} quantiles
+                # of every SLO family plus the live load gauges
+                fams = {}
+                for fam in (
+                    "serve_ttft_seconds",
+                    "serve_time_per_output_token_seconds",
+                    "serve_queue_wait_seconds",
+                    "serve_request_seconds",
+                ):
+                    fams[fam] = [
+                        {**dict(labels), **summary}
+                        for labels, summary in sorted(
+                            metrics.histogram_family(fam).items()
+                        )
+                    ]
+                return self._reply(200, {
+                    "model": model_label,
+                    "histograms": fams,
+                    "gauges": {
+                        "serve_admission_queue_depth": metrics.gauge(
+                            "serve_admission_queue_depth", model=model_label
+                        ),
+                        "serve_tokens_in_flight": metrics.gauge(
+                            "serve_tokens_in_flight", model=model_label
+                        ),
+                    },
+                    "requests_ok": metrics.counter(
+                        "serve_requests_total", status="200"
+                    ),
+                })
+            if self.path == "/debug/flightrecorder":
+                body = recorder.dump_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             return self._reply(404, {"error": "try POST /generate"})
 
         def do_POST(self):
@@ -374,21 +472,34 @@ def build_handler(
                     # sampling is exact for both (rejection rule);
                     # only top_k falls back to the chunked decoder
                     span.set_attribute("mode", "speculative")
+                    t_q = _time.perf_counter()
                     with spec_lock:
+                        # lock wait IS this mode's admission queue
+                        t_gen = _time.perf_counter()
                         out = spec.generate(
                             prompt, n_new, temperature=temperature,
                             rng=jax.random.PRNGKey(seed)
                             if temperature > 0.0 else None,
                         )
+                    done = _time.perf_counter()
+                    # TTFT from SUBMIT (t_q): the lock wait is queueing
+                    # the user experiences, same clock as pool TTFT
+                    observe_slo(
+                        "speculative", t_gen - t_q, done - t_q,
+                        (done - t_gen) / n_new,
+                    )
                     sample = finish(decode_bytes(np.asarray(out[0, prompt.shape[1]:])))
                     return self._reply(
                         200, {"prompt": text, "sample": sample, "seed": seed}
                     )
                 span.set_attribute("mode", "chunked")
+                t_gen = _time.perf_counter()
                 out = decoder.generate(
                     prompt, n_new, temperature=temperature, top_k=top_k,
                     rng=jax.random.PRNGKey(seed),
                 )
+                wall = _time.perf_counter() - t_gen
+                observe_slo("chunked", 0.0, wall, wall / n_new)
                 sample = finish(decode_bytes(np.asarray(out[0, prompt.shape[1]:])))
                 return self._reply(
                     200, {"prompt": text, "sample": sample, "seed": seed}
@@ -468,11 +579,25 @@ def main() -> int:
 
     from tf_operator_tpu.models import llama_tiny
     from tf_operator_tpu.parallel import load_model_description, load_params
+    from tf_operator_tpu.utils import flight
+    from tf_operator_tpu.utils.metrics import Metrics
+    from tf_operator_tpu.utils.watchdog import maybe_start_from_env
+
+    # ONE registry for the whole serving process: the handler's
+    # /metrics+/slo, the watchdog's stall counter, and the flight
+    # recorder's metric deltas all share it — a stall must be visible
+    # on the endpoint the operator actually scrapes
+    serve_metrics = Metrics()
+    # black-box recorder: SIGTERM / a fatal exception dumps the recent
+    # spans+logs+metric deltas; TPUJOB_WATCHDOG=1 adds the stall monitor
+    flight.install(metrics=serve_metrics)
+    maybe_start_from_env(metrics=serve_metrics)
 
     # validate against the tiny model.json FIRST — rejecting an
     # incompatible artifact must not cost a full orbax restore
     desc = load_model_description(args.artifact)
     max_len = args.max_len
+    model_label = "llama-tiny"
     if desc is not None:
         if desc["config"]["vocab_size"] != 256:
             raise SystemExit(
@@ -488,6 +613,7 @@ def main() -> int:
         from tf_operator_tpu.models.registry import model_from_description
 
         model = model_from_description(desc, max_len=max_len)
+        model_label = desc["family"]
         print(f"serving family={desc['family']} from model.json", flush=True)
     else:
         # legacy artifact without a description: the historical default
@@ -508,7 +634,8 @@ def main() -> int:
         build_handler(
             model, params, max_len,
             batching_slots=args.batching, speculative=args.speculative,
-            prompt_cache=args.prompt_cache,
+            prompt_cache=args.prompt_cache, model_label=model_label,
+            metrics=serve_metrics,
         ),
     )
     print(f"serving on 127.0.0.1:{args.port} (artifact: {args.artifact})", flush=True)

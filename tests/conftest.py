@@ -49,3 +49,72 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "tpu" in item.keywords:
             item.add_marker(skip_tpu)
+
+
+# ---------------------------------------------------------------------------
+# Round-end suite record (VERDICT r5 next #8): every pytest session
+# appends its tier's wall clock to benchmarks/SUITE_RECORD.json so the
+# round record reports BOTH tiers, and benchmarks/check_tier_budget.py
+# can fail the round when the slow tier blows its budget.
+# ---------------------------------------------------------------------------
+
+_session_t0 = None
+
+
+def _session_tier(config) -> str:
+    """tier1 = the default `-m 'not slow'` run; slow = a `-m slow`
+    (or slow-including) run; anything else records as `all`."""
+
+    expr = (config.getoption("-m", default="") or "").strip()
+    if "not slow" in expr:
+        return "tier1"
+    if "slow" in expr:
+        return "slow"
+    return "all"
+
+
+def pytest_sessionstart(session):
+    global _session_t0
+    import time
+
+    _session_t0 = time.time()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import json
+    import time
+
+    if _session_t0 is None or os.environ.get("TPUJOB_NO_SUITE_RECORD"):
+        return
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "SUITE_RECORD.json",
+    )
+    record = {}
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        pass
+    tier = _session_tier(session.config)
+    collected = int(getattr(session, "testscollected", 0) or 0)
+    prev = record.get(tier)
+    if prev and collected < 0.5 * int(prev.get("collected", 0) or 0):
+        # a targeted subset run (`pytest tests/test_x.py -m slow`) must
+        # not overwrite the full-tier record — a 2s partial would mask
+        # a budget violation the gate exists to catch
+        return
+    record[tier] = {
+        "wall_s": round(time.time() - _session_t0, 1),
+        "exitstatus": int(exitstatus),
+        "collected": collected,
+        "when": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    try:  # atomic-ish: a crashed writer must not corrupt the record
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        pass

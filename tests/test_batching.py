@@ -382,6 +382,33 @@ class TestSingleDispatchAdmission:
         for rid in rids:
             assert dec.result(rid) is not None
 
+    def test_slo_observations_per_request(self):
+        """Every pooled request lands queue-wait + TTFT + time-per-
+        output-token observations labeled {model, mode="pool"}, and
+        the load gauges return to zero once the pool drains (ISSUE 5
+        serving-SLO layer)."""
+
+        from tf_operator_tpu.utils.metrics import Metrics
+
+        model, params = _tiny()
+        m = Metrics()
+        dec = ContinuousBatchingDecoder(
+            model, params, slots=2, metrics=m, model_label="llama"
+        )
+        prompts = _prompts(3, [5, 7, 4])
+        rids = [dec.submit(p, max_new_tokens=4) for p in prompts]
+        with dec._lock:
+            # gauges live while queued: 3 requests x 4-token budgets
+            assert m.gauge("serve_tokens_in_flight", model="llama") == 12.0
+        dec.run()
+        for rid in rids:
+            assert dec.result(rid) is not None
+        for fam in ("serve_queue_wait_seconds", "serve_ttft_seconds",
+                    "serve_time_per_output_token_seconds"):
+            assert m.histogram(fam, model="llama", mode="pool")["count"] == 3, fam
+        assert m.gauge("serve_admission_queue_depth", model="llama") == 0.0
+        assert m.gauge("serve_tokens_in_flight", model="llama") == 0.0
+
     def test_admission_failure_requeues_request(self):
         """A transient device failure inside the fused admission must
         re-queue the request (the legacy prefill path's survival rule):

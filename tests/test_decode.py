@@ -682,10 +682,30 @@ def test_serve_lm_end_to_end(tmp_path):
         with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
             text = r.read().decode()
         assert 'serve_requests_total{status="200"} 1' in text
-        assert "serve_request_seconds_count 1" in text
+        assert ('serve_request_seconds_count'
+                '{model="unknown",route="/generate"} 1') in text
+        # the SLO families: every request observes TTFT and
+        # time-per-output-token, labeled by model+mode
+        assert ('serve_ttft_seconds_count'
+                '{mode="chunked",model="unknown"} 1') in text
+        assert ('serve_time_per_output_token_seconds_count'
+                '{mode="chunked",model="unknown"} 1') in text
         assert "serve_tokens_generated_total 8.0" in text
         assert "serve_prompt_cache_hits 0" in text
         assert "serve_decoder_compiles" in text
+        # /slo: the summary endpoint over the same histogram families
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/slo", timeout=10) as r:
+            slo = json.loads(r.read())
+        assert slo["requests_ok"] == 1.0
+        ttft_rows = slo["histograms"]["serve_ttft_seconds"]
+        assert len(ttft_rows) == 1 and ttft_rows[0]["count"] == 1
+        assert ttft_rows[0]["model"] == "unknown"
+        # /debug/flightrecorder: JSONL rings, meta record first
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/flightrecorder", timeout=10
+        ) as r:
+            lines = r.read().decode().strip().splitlines()
+        assert json.loads(lines[0])["type"] == "meta"
         # stop sequence: sample truncates at the first occurrence —
         # with a single-byte stop drawn FROM the full sample, the
         # truncation is verifiable exactly against the untruncated run

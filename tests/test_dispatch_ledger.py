@@ -64,7 +64,7 @@ def test_metrics_sink_exports_counters_and_histograms():
     assert m.total("serving_dispatch_total") == 3.0
     expo = m.exposition()
     assert 'serving_dispatch_total{phase="admission"} 2.0' in expo
-    assert "serving_dispatch_seconds_step_count 1" in expo
+    assert 'serving_dispatch_seconds_count{phase="step"} 1' in expo
 
 
 def test_tracer_sink_nests_dispatch_spans_under_request_span():

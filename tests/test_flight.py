@@ -1,0 +1,103 @@
+"""Flight recorder (utils/flight.py): ring bounds, dump determinism,
+and the attach points (tracer / logger / metrics deltas)."""
+
+import io
+import json
+import logging
+
+from tf_operator_tpu.utils.flight import FlightRecorder
+from tf_operator_tpu.utils.metrics import Metrics
+from tf_operator_tpu.utils.trace import Tracer
+
+
+def dump_records(rec):
+    buf = io.StringIO()
+    rec.dump(fileobj=buf)
+    return [json.loads(line) for line in buf.getvalue().strip().splitlines()]
+
+
+class TestRings:
+    def test_span_ring_bounded_oldest_dropped(self):
+        rec = FlightRecorder(max_spans=4)
+        for i in range(10):
+            rec.record_span({"name": f"s{i}", "traceId": "t", "duration": 0.0})
+        records = [r for r in dump_records(rec) if r["type"] == "span"]
+        assert [r["name"] for r in records] == ["s6", "s7", "s8", "s9"]
+
+    def test_log_ring_bounded(self):
+        rec = FlightRecorder(max_logs=3)
+        for i in range(7):
+            rec.record_log("INFO", "t", f"m{i}")
+        logs = [r for r in dump_records(rec) if r["type"] == "log"]
+        assert [r["message"] for r in logs] == ["m4", "m5", "m6"]
+
+    def test_dump_order_deterministic(self):
+        """meta, then spans, then logs, then metric snapshots — two
+        dumps with no intervening activity differ only in the meta
+        record's wall clock and prior-dump counter."""
+
+        rec = FlightRecorder()
+        rec.record_span({"name": "a", "traceId": "t"})
+        rec.record_log("WARN", "x", "boom")
+        a = dump_records(rec)
+        b = dump_records(rec)
+        assert [r["type"] for r in a] == ["meta", "span", "log"]
+        strip = lambda rs: [  # noqa: E731
+            {k: v for k, v in r.items() if k not in ("unix", "priorDumps")}
+            for r in rs
+        ]
+        assert strip(a) == strip(b)
+
+    def test_dump_to_path_and_reason(self, tmp_path):
+        rec = FlightRecorder()
+        rec.record_log("INFO", "t", "hello")
+        path = rec.dump(path=str(tmp_path / "f.jsonl"), reason="test")
+        lines = [json.loads(x) for x in open(path)]
+        assert lines[0]["type"] == "meta" and lines[0]["reason"] == "test"
+        assert lines[1]["message"] == "hello"
+
+
+class TestAttachPoints:
+    def test_tracer_attach_captures_finished_spans_and_chains(self):
+        seen = []
+        tracer = Tracer(seed=3)
+        tracer.on_finish = seen.append  # pre-existing sink must survive
+        rec = FlightRecorder()
+        rec.attach_tracer(tracer)
+        with tracer.span("op.one"):
+            pass
+        spans = [r for r in dump_records(rec) if r["type"] == "span"]
+        assert [s["name"] for s in spans] == ["op.one"]
+        assert [s.name for s in seen] == ["op.one"]
+
+    def test_logger_attach_captures_fielded_records(self):
+        rec = FlightRecorder()
+        log = logging.getLogger("tpujob-flight-test")
+        log.setLevel(logging.INFO)
+        rec.attach_logger(log)
+        log.warning("stalled", extra={"fields": {"job": "ns/j"}})
+        logs = [r for r in dump_records(rec) if r["type"] == "log"]
+        assert logs[0]["level"] == "WARNING"
+        assert logs[0]["fields"] == {"job": "ns/j"}
+
+    def test_metric_deltas_between_snapshots(self):
+        m = Metrics()
+        rec = FlightRecorder()
+        rec.attach_metrics(m)
+        m.inc("x_total", 3.0)
+        first = rec.snapshot_metrics("boot")
+        assert first == {"x_total": 3.0}
+        m.inc("x_total")
+        m.inc("y_total", phase="p")
+        delta = rec.snapshot_metrics("later")
+        assert delta == {"x_total": 1.0, 'y_total{phase="p"}': 1.0}
+        snaps = [r for r in dump_records(rec) if r["type"] == "metrics"]
+        assert [s["label"] for s in snaps] == ["boot", "later"]
+
+    def test_dump_text_matches_jsonl(self):
+        rec = FlightRecorder()
+        rec.record_log("INFO", "t", "x")
+        text = rec.dump_text()
+        assert len(text.strip().splitlines()) == 2
+        for line in text.strip().splitlines():
+            json.loads(line)

@@ -129,7 +129,7 @@ class TestWindowedResolution:
         assert m.counter("train_sync_total", phase="final") == 1.0
         expo = m.exposition()
         assert 'train_sync_total{phase="window"} 1.0' in expo
-        assert "train_sync_seconds_final_count 1" in expo
+        assert 'train_sync_seconds_count{phase="final"} 1' in expo
 
     def test_loop_ledger_attached_to_trainer_and_restored(self):
         """ONE ledger covers the run: the loop temporarily swaps its

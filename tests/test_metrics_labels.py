@@ -1,0 +1,136 @@
+"""Labeled-metrics exposition (ISSUE 5 tentpole): strict Prometheus
+text-format parse of EVERY line, label-value escaping, per-family
+bucket config, labeled histogram families, and the counters snapshot
+the flight recorder diffs."""
+
+import math
+import re
+
+from tf_operator_tpu.utils.metrics import (
+    DEFAULT_BUCKETS,
+    SLO_BUCKETS,
+    Metrics,
+)
+
+#: one exposition sample line: metric name, optional {labels}, value.
+#: Label values allow any char with " and \ escaped (\\, \", \n).
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL = rf'{_NAME}="(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+_LINE = re.compile(
+    rf"^({_NAME})(\{{{_LABEL}(?:,{_LABEL})*\}})? (-?[0-9.eE+-]+|[0-9.]+)$"
+)
+_COMMENT = re.compile(r"^# exemplar \S+ trace_id=\"[^\"]+\"$")
+
+
+def parse_strictly(text: str):
+    """Every non-comment line must match the sample shape; returns
+    {line: value} for exact-line assertions."""
+
+    out = {}
+    for line in text.strip().splitlines():
+        if _COMMENT.match(line):
+            continue
+        m = _LINE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        out[line.rsplit(" ", 1)[0]] = float(m.group(3))
+    return out
+
+
+class TestLabeledExposition:
+    def test_every_line_parses_strictly(self):
+        m = Metrics()
+        m.inc("jobs_total")
+        m.inc("pods_total", replica_type="worker")
+        m.set("depth", 3.0, queue="main")
+        m.observe("startup", 1.0)
+        m.observe_histogram("lat_seconds", 0.02)
+        m.observe_histogram("lat_seconds", 0.2, model="llama", route="/generate")
+        m.inc("errs_total", exemplar="tdeadbeef000001")
+        parsed = parse_strictly(m.exposition())
+        assert parsed["jobs_total"] == 1.0
+        assert parsed['pods_total{replica_type="worker"}'] == 1.0
+        assert parsed['depth{queue="main"}'] == 3.0
+        # labeled histogram series: le merges with the label set
+        assert (
+            parsed['lat_seconds_bucket{le="+Inf",model="llama",route="/generate"}']
+            == 1
+        )
+        assert parsed['lat_seconds_count{model="llama",route="/generate"}'] == 1
+        # the unlabeled series of the same family co-exists
+        assert parsed["lat_seconds_count"] == 1
+
+    def test_label_values_escaped(self):
+        m = Metrics()
+        m.inc("odd_total", path='with "quotes" and \\slash\\ and \nnewline')
+        text = m.exposition()
+        parse_strictly(text)  # must still parse
+        assert '\\"quotes\\"' in text
+        assert "\\\\slash\\\\" in text
+        assert "\\nnewline" in text
+        assert "\nnewline" not in text.replace("\\nnewline", "")
+
+    def test_histogram_labels_roundtrip_reads(self):
+        m = Metrics()
+        for v in (0.001, 0.01, 0.1):
+            m.observe_histogram("ttft_seconds", v, model="a")
+        m.observe_histogram("ttft_seconds", 5.0, model="b")
+        assert m.histogram("ttft_seconds", model="a")["count"] == 3
+        assert m.histogram("ttft_seconds", model="b")["count"] == 1
+        assert m.histogram("ttft_seconds")["count"] == 0  # unlabeled distinct
+        fam = m.histogram_family("ttft_seconds")
+        assert {labels for labels in fam} == {
+            (("model", "a"),), (("model", "b"),),
+        }
+        assert fam[(("model", "a"),)]["count"] == 3
+        assert fam[(("model", "b"),)]["p50_le"] >= 5.0 or math.isinf(
+            fam[(("model", "b"),)]["p50_le"]
+        )
+
+    def test_per_family_bucket_config(self):
+        m = Metrics()
+        m.set_buckets("slo_seconds", SLO_BUCKETS)
+        m.observe_histogram("slo_seconds", 45.0, model="x")  # inside SLO tail
+        m.observe_histogram("other_seconds", 45.0)  # default buckets
+        text = m.exposition()
+        assert 'slo_seconds_bucket{le="60.0",model="x"} 1' in text
+        # default family has no 60s bucket: 45s lands in +Inf only
+        assert 'other_seconds_bucket{le="60.0"}' not in text
+        assert f'other_seconds_bucket{{le="{DEFAULT_BUCKETS[-1]}"}} 0' in text
+        # explicit buckets at first observation win over both
+        m.observe_histogram("explicit_seconds", 0.5, buckets=(1.0,))
+        assert 'explicit_seconds_bucket{le="1.0"} 1' in m.exposition()
+
+    def test_counters_snapshot_flat_keys(self):
+        m = Metrics()
+        m.inc("a_total")
+        m.inc("b_total", 2.0, phase="x")
+        m.set("g", 7.0)
+        snap = m.counters_snapshot()
+        assert snap["a_total"] == 1.0
+        assert snap['b_total{phase="x"}'] == 2.0
+        assert snap["g"] == 7.0
+
+
+class TestLedgerSharedFamilies:
+    def test_dispatch_and_sync_ledgers_share_exposition_shape(self):
+        """Training and serving route into the SAME labeled-family
+        shape: <prefix>_seconds{phase=...} (the ISSUE-5 'one
+        exposition' requirement)."""
+
+        from tf_operator_tpu.utils.metrics import (
+            DispatchLedger,
+            StepSyncLedger,
+        )
+
+        m = Metrics()
+        led = DispatchLedger(metrics=m)
+        with led.dispatch("step"):
+            pass
+        sync = StepSyncLedger(metrics=m)
+        sync.record("data.load", 0.001)
+        sync.resolve("window", [])
+        parsed = parse_strictly(m.exposition())
+        assert parsed['serving_dispatch_seconds_count{phase="step"}'] == 1
+        assert parsed['train_sync_seconds_count{phase="data.load"}'] == 1
+        assert parsed['train_sync_seconds_count{phase="window"}'] == 1
+        assert parsed['train_sync_total{phase="data.load"}'] == 1.0

@@ -1,0 +1,94 @@
+"""Round-end suite record + slow-tier budget gate (ISSUE 5 satellite,
+VERDICT r5 next #8): the conftest tier classifier and the
+check_tier_budget gate logic, on synthetic records."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_tier_budget",
+        os.path.join(REPO, "benchmarks", "check_tier_budget.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTierClassifier:
+    def test_markexpr_maps_to_tier(self):
+        from tests.conftest import _session_tier
+
+        class Cfg:
+            def __init__(self, expr):
+                self._expr = expr
+
+            def getoption(self, name, default=None):
+                return self._expr
+
+        assert _session_tier(Cfg("not slow")) == "tier1"
+        assert _session_tier(Cfg("slow")) == "slow"
+        assert _session_tier(Cfg("slow and not tpu")) == "slow"
+        assert _session_tier(Cfg("")) == "all"
+        assert _session_tier(Cfg(None)) == "all"
+
+
+class TestBudgetGate:
+    def test_no_slow_record_passes(self):
+        mod = _load_checker()
+        ok, msg = mod.check({"tier1": {"wall_s": 150.0, "collected": 300,
+                                       "exitstatus": 0, "when": "x"}})
+        assert ok and "gate skipped" in msg
+
+    def test_slow_within_budget_passes(self):
+        mod = _load_checker()
+        ok, msg = mod.check({"slow": {"wall_s": 900.0, "collected": 200,
+                                      "exitstatus": 0, "when": "x"}})
+        assert ok and "within budget" in msg
+
+    def test_slow_over_budget_fails(self):
+        mod = _load_checker()
+        ok, msg = mod.check({"slow": {"wall_s": 5400.0, "collected": 200,
+                                      "exitstatus": 0, "when": "x"}})
+        assert not ok and "OVER BUDGET" in msg
+
+    def test_cli_exit_codes(self, tmp_path):
+        """The gate as tooling: exit 0 without a record file."""
+
+        env = dict(os.environ)
+        env["TPUJOB_NO_SUITE_RECORD"] = "1"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "benchmarks",
+                                          "check_tier_budget.py")],
+            capture_output=True, text=True, cwd=str(tmp_path), env=env,
+        )
+        # record may or may not exist in the repo; either way the exit
+        # code must reflect check()'s verdict, never crash
+        assert proc.returncode in (0, 1)
+        assert proc.stdout.strip()
+
+
+class TestRecordWriting:
+    def test_sessionfinish_merges_tiers(self, tmp_path, monkeypatch):
+        """Drive the conftest hook body shape via a real JSON merge:
+        a tier1 record then a slow record must coexist in the file."""
+
+        path = tmp_path / "SUITE_RECORD.json"
+        for tier, wall in (("tier1", 140.0), ("slow", 800.0)):
+            record = {}
+            if path.exists():
+                record = json.loads(path.read_text())
+            record[tier] = {"wall_s": wall, "exitstatus": 0,
+                            "collected": 10, "when": "t"}
+            path.write_text(json.dumps(record))
+        final = json.loads(path.read_text())
+        assert set(final) == {"tier1", "slow"}
+        mod = _load_checker()
+        ok, msg = mod.check(final)
+        assert ok and "tier1" in msg and "slow" in msg

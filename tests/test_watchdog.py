@@ -1,0 +1,161 @@
+"""Stall watchdog (utils/watchdog.py): deadline sweep unit tests plus
+the freeze-the-train-loop e2e — a wedged harness must produce a
+flight-recorder dump naming the stalled phase's last spans (ISSUE 5
+acceptance)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.utils.flight import FlightRecorder
+from tf_operator_tpu.utils.metrics import Metrics
+from tf_operator_tpu.utils.trace import Tracer
+from tf_operator_tpu.utils.watchdog import Watchdog, thread_stacks
+
+
+class TestDeadlineSweep:
+    def test_fresh_heartbeat_not_stalled(self):
+        dog = Watchdog(metrics=Metrics())
+        dog.register("a", deadline=5.0)
+        assert dog.check_once() == []
+
+    def test_missed_deadline_fires_once_per_episode(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUJOB_FLIGHT_DIR", str(tmp_path))
+        m = Metrics()
+        dog = Watchdog(metrics=m, recorder=FlightRecorder())
+        hb = dog.register("loop", deadline=0.01)
+        hb.last -= 1.0  # simulate silence
+        assert dog.check_once() == ["loop"]
+        assert dog.check_once() == []  # same episode: no refire
+        assert m.counter("watchdog_stall_total", heartbeat="loop") == 1.0
+        assert len(dog.dumps) == 1
+
+    def test_beat_ends_episode_and_next_stall_refires(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUJOB_FLIGHT_DIR", str(tmp_path))
+        m = Metrics()
+        dog = Watchdog(metrics=m, recorder=FlightRecorder())
+        hb = dog.register("loop", deadline=0.01)
+        hb.last -= 1.0
+        assert dog.check_once() == ["loop"]
+        hb.beat()
+        assert dog.check_once() == []  # recovered
+        hb.last -= 1.0
+        assert dog.check_once() == ["loop"]  # fresh episode
+        assert m.counter("watchdog_stall_total", heartbeat="loop") == 2.0
+
+    def test_heartbeat_captures_trace_id(self):
+        tracer = Tracer(seed=11)
+        dog = Watchdog()
+        hb = dog.register("traced")
+        with tracer.span("work"):
+            hb.beat()
+        assert hb.trace_id is not None and hb.trace_id.startswith("t")
+
+    def test_unregister_silences(self):
+        dog = Watchdog(metrics=Metrics(), recorder=FlightRecorder())
+        hb = dog.register("gone", deadline=0.01)
+        hb.last -= 1.0
+        dog.unregister("gone")
+        assert dog.check_once() == []
+
+    def test_thread_stacks_names_this_test(self):
+        text = thread_stacks()
+        assert "test_thread_stacks_names_this_test" in text
+
+    def test_background_thread_start_stop(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUJOB_FLIGHT_DIR", str(tmp_path))
+        m = Metrics()
+        dog = Watchdog(metrics=m, recorder=FlightRecorder(),
+                       check_interval=0.02)
+        hb = dog.register("bg", deadline=0.05)
+        dog.start()
+        try:
+            assert dog.running
+            deadline = time.time() + 5.0
+            while time.time() < deadline and not dog.dumps:
+                time.sleep(0.02)  # stop beating: the monitor must fire
+            assert dog.dumps, "background sweep never detected the stall"
+            assert m.counter("watchdog_stall_total", heartbeat="bg") == 1.0
+        finally:
+            dog.stop()
+        assert not dog.running
+        assert hb.stalled
+
+
+@pytest.mark.slow
+class TestFreezeTheHarness:
+    def test_frozen_train_loop_dumps_last_spans(self, tmp_path, monkeypatch):
+        """The acceptance e2e: a train loop frozen mid-run (its data
+        iterator hangs) stops heartbeating; the watchdog dumps the
+        flight recorder, and the dump contains the stalled phase's
+        last spans (train.step / data.load of the steps that DID
+        run)."""
+
+        monkeypatch.setenv("TPUJOB_FLIGHT_DIR", str(tmp_path))
+        from tests.test_harness import FakeTrainer, _series
+        from tf_operator_tpu.runtime.harness import train_loop
+        from tf_operator_tpu.utils.metrics import StepSyncLedger
+
+        m = Metrics()
+        tracer = Tracer(seed=5)
+        recorder = FlightRecorder()
+        recorder.attach_tracer(tracer)
+        recorder.attach_metrics(m)
+        dog = Watchdog(metrics=m, recorder=recorder, check_interval=0.05)
+        release = threading.Event()
+
+        def batches():
+            for i in range(4):
+                yield {"x": i}
+            release.wait(timeout=30.0)  # the freeze
+            raise RuntimeError("unfrozen: end the thread")
+
+        def run():
+            try:
+                train_loop(
+                    FakeTrainer(_series(64)), batches(), 64,
+                    steps_per_sync=2, assert_decreasing=False,
+                    tracer=tracer, watchdog=dog,
+                    sync_ledger=StepSyncLedger(metrics=m, tracer=tracer),
+                )
+            except RuntimeError:
+                pass
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        dog.start()
+        try:
+            # the loop beats twice (2 windows of 2 steps), then hangs in
+            # data.load; drop the deadline only after those beats landed
+            deadline = time.time() + 10.0
+            hb = None
+            while time.time() < deadline:
+                hb = dog.heartbeats().get("train.train")
+                if hb is not None and hb.beats >= 1:
+                    break
+                time.sleep(0.02)
+            assert hb is not None and hb.beats >= 1, "loop never started"
+            hb.deadline = 0.2
+            deadline = time.time() + 10.0
+            while time.time() < deadline and not dog.dumps:
+                time.sleep(0.05)
+            assert dog.dumps, "watchdog never dumped on the frozen loop"
+        finally:
+            release.set()
+            dog.stop()
+            t.join(timeout=10.0)
+
+        assert m.counter("watchdog_stall_total", heartbeat="train.train") == 1.0
+        records = [json.loads(x) for x in open(dog.dumps[0])]
+        span_names = {r["name"] for r in records if r["type"] == "span"}
+        # the stalled phase's last spans: the completed steps' work
+        assert "train.step" in span_names
+        assert "data.load" in span_names
+        # the stall postmortem carries every thread's stack
+        stack_logs = [
+            r for r in records
+            if r["type"] == "log" and "thread stacks" in r["message"]
+        ]
+        assert stack_logs and "release.wait" in stack_logs[0]["fields"]["stacks"]

@@ -404,6 +404,11 @@ class TPUJob:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: TPUJobSpec = field(default_factory=TPUJobSpec)
     status: TPUJobStatus = field(default_factory=TPUJobStatus)
+    #: set at informer ingestion when the stored object failed to parse
+    #: or validate (out-of-band apiserver write, no admission webhook):
+    #: the reconciler marks such a job Failed/InvalidSpec and never
+    #: reconciles it.  Derived, never serialized.
+    invalid_reason: Optional[str] = None
 
     @property
     def key(self) -> str:
@@ -414,6 +419,7 @@ class TPUJob:
             metadata=self.metadata.clone(),
             spec=self.spec.clone(),
             status=self.status.clone(),
+            invalid_reason=self.invalid_reason,
         )
 
     clone = deepcopy

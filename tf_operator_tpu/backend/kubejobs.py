@@ -46,9 +46,10 @@ from tf_operator_tpu.api.defaults import set_defaults
 from tf_operator_tpu.api.serde import (
     job_from_dict,
     job_to_dict,
+    status_from_dict,
     status_to_dict,
 )
-from tf_operator_tpu.api.types import TPUJob, TPUJobStatus
+from tf_operator_tpu.api.types import ObjectMeta, TPUJob, TPUJobStatus
 from tf_operator_tpu.api.validation import validate
 from tf_operator_tpu.backend.kube import ApiError, GoneError, http_json
 from tf_operator_tpu.backend.objects import (
@@ -75,8 +76,40 @@ def _ns_path(namespace: str) -> str:
 
 
 def _decode(obj: dict) -> TPUJob:
-    job = job_from_dict(obj)
-    rv = obj.get("metadata", {}).get("resourceVersion", "0")
+    """Stored JSON → TPUJob, NEVER raising: the watch loop and list
+    path must survive out-of-band apiserver writes (no admission
+    webhook on a real cluster without ours deployed).  An object that
+    fails to parse or validate comes back as a skeleton carrying
+    ``invalid_reason`` — the informer still caches/keys it, and the
+    reconciler marks it Failed/InvalidSpec instead of crashing or
+    silently spinning the ListAndWatch recovery path."""
+
+    meta_d = obj.get("metadata", {}) if isinstance(obj, dict) else {}
+    try:
+        job = job_from_dict(obj)
+        validate(job)
+    except Exception as e:  # noqa: BLE001 - ingestion admission boundary
+        job = TPUJob(
+            metadata=ObjectMeta(
+                name=str(meta_d.get("name", "")),
+                namespace=str(meta_d.get("namespace", "default")),
+                uid=str(meta_d.get("uid", "")),
+            ),
+            invalid_reason=f"{type(e).__name__}: {e}",
+        )
+        try:
+            # keep any status the leader already wrote (e.g. our own
+            # Failed/InvalidSpec condition) so is_terminal() holds on
+            # re-ingestion and the object is cleaned up, not re-marked
+            if isinstance(obj, dict) and "status" in obj:
+                job.status = status_from_dict(obj["status"])
+        except Exception as status_err:  # noqa: BLE001 - garbage status stays empty
+            _log.warning(
+                "invalid object %s also has unparseable status: %s",
+                job.key, status_err,
+            )
+        default_metrics.inc("informer_invalid_objects_total", kind="TPUJob")
+    rv = meta_d.get("resourceVersion", "0")
     job.metadata.resource_version = int(rv) if str(rv).isdigit() else 0
     return job
 

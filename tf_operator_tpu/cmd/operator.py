@@ -206,6 +206,7 @@ def main(argv=None) -> int:
     oplog.configure(json_log=args.json_log)
     log = oplog.logger_for_job("-", "operator")
 
+
     store = JobStore()
     sim = None
     if args.backend == "local":
@@ -314,6 +315,16 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, handle_signal)
     signal.signal(signal.SIGINT, handle_signal)
+
+    # black-box flight recorder: recent spans/logs/metric deltas dump
+    # on SIGTERM (chaining into the graceful-shutdown handler above)
+    # and on fatal exceptions; /debug/flightrecorder serves the rings
+    # live.  TPUJOB_WATCHDOG=1 starts the stall monitor on top.
+    from tf_operator_tpu.utils import flight
+    from tf_operator_tpu.utils.watchdog import maybe_start_from_env
+
+    flight.install(metrics=controller.metrics)
+    maybe_start_from_env(metrics=controller.metrics)
 
     # monitoring/API surface is up regardless of leadership (reference
     # parity: the monitoring port serves on standbys too); only the

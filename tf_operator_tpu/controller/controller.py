@@ -260,8 +260,21 @@ class TPUJobController:
                 logger_for_job("-", "resync").error("resync failed: %s", e)
 
     def _worker(self) -> None:
-        while not self._stop.is_set():
-            self.process_next(timeout=0.2)
+        # each reconcile worker heartbeats the process watchdog: a sync
+        # wedged on a dead backend stops beating, and past the deadline
+        # the watchdog dumps every thread's stack + the flight recorder
+        # (utils/watchdog.py; monitoring is opt-in, registration free)
+        from tf_operator_tpu.utils.watchdog import default_watchdog
+
+        hb = default_watchdog.register(
+            f"controller.{threading.current_thread().name}"
+        )
+        try:
+            while not self._stop.is_set():
+                hb.beat()
+                self.process_next(timeout=0.2)
+        finally:
+            default_watchdog.unregister(hb.name)
 
     def stop(self) -> None:
         self._stop.set()

@@ -157,8 +157,27 @@ class Reconciler:
             return
         log = logger_for_job(job.metadata.namespace, job.metadata.name)
 
+        if job.invalid_reason and not job.is_terminal():
+            # server-side admission backstop (VERDICT r5 next #9): an
+            # invalid object written out-of-band (no admission webhook)
+            # is marked Failed/InvalidSpec + evented ONCE and never
+            # reconciled — no pods, no services, no gang group
+            old_status = job.status.clone()
+            msg = f"invalid TPUJob spec: {job.invalid_reason}"
+            set_condition(job, JobConditionType.FAILED, "InvalidSpec", msg)
+            self.recorder.event(key, "Warning", "InvalidSpec", msg)
+            self.metrics.inc("tpujob_invalid_total")
+            log.warning("refusing to reconcile: %s", msg)
+            self._update_status(job, old_status)
+            return
+
         if job.is_terminal():
             self._deadline_scheduled.pop(key, None)
+            if job.invalid_reason:
+                # terminal AND invalid (our own InvalidSpec mark, or a
+                # corrupted finished job): nothing to clean up that the
+                # spec-less skeleton could name — leave it be
+                return
             self._cleanup_terminal(job)
             return
 

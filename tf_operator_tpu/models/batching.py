@@ -76,6 +76,7 @@ tokens/s at concurrency 8 vs sequential single-request serving —
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -103,7 +104,7 @@ TOP_K_MAX = 64
 class _Request:
     __slots__ = ("rid", "prompt", "budget", "temperature", "top_k", "rng",
                  "tokens", "done", "slot", "staged_cache", "staged_tok",
-                 "has_permit")
+                 "has_permit", "t_submit", "t_first")
 
     def __init__(self, rid, prompt, budget, temperature, top_k, rng):
         self.rid = rid
@@ -121,6 +122,10 @@ class _Request:
         self.staged_cache = None
         self.staged_tok = None
         self.has_permit = False
+        # SLO clocks (host monotonic): submit time, first-token time —
+        # queue-wait/TTFT/time-per-output-token derive from these
+        self.t_submit = time.perf_counter()
+        self.t_first = None
 
 
 class ContinuousBatchingDecoder:
@@ -131,10 +136,19 @@ class ContinuousBatchingDecoder:
     """
 
     def __init__(self, model, params, slots: int = 8, steps_per_sync: int = 8,
-                 ledger: Optional[DispatchLedger] = None):
+                 ledger: Optional[DispatchLedger] = None,
+                 metrics=None, model_label: str = ""):
         #: device-dispatch accounting (phases: admission, step, and the
         #: legacy rolling-window path's prefill/scatter)
         self.ledger = ledger if ledger is not None else DispatchLedger()
+        #: SLO sink (utils/metrics.Metrics or None): every request
+        #: observes queue-wait / TTFT / time-per-output-token
+        #: histograms labeled {model, mode="pool"}, plus the
+        #: serve_admission_queue_depth and serve_tokens_in_flight
+        #: gauges — the user-facing latency layer over the ledger's
+        #: per-dispatch accounting
+        self.metrics = metrics if metrics is not None else self.ledger.metrics
+        self.model_label = model_label or "unknown"
         self.dmodel = _decode_variant(model)
         self._materialize = materialize_fn(model)
         cfg = self.dmodel.cfg
@@ -199,6 +213,63 @@ class ContinuousBatchingDecoder:
         self._step_fn = None
         self._scatter_fn = None
         self.compile_count = 0
+
+    # -- SLO observations ------------------------------------------------
+
+    def _observe_first_token(self, req: _Request, work_start: float) -> None:
+        """First output token just landed on the host: observe
+        queue-wait (submit → first device work) and TTFT (submit →
+        first token), once per request."""
+
+        if req.t_first is not None:
+            return
+        req.t_first = time.perf_counter()
+        if self.metrics is None:
+            return
+        self.metrics.observe_histogram(
+            "serve_queue_wait_seconds",
+            max(0.0, work_start - req.t_submit),
+            model=self.model_label, mode="pool",
+        )
+        self.metrics.observe_histogram(
+            "serve_ttft_seconds",
+            req.t_first - req.t_submit,
+            model=self.model_label, mode="pool",
+        )
+
+    def _observe_done(self, req: _Request) -> None:
+        """Request retired: observe time-per-output-token (first token
+        → done, over the tokens after the first)."""
+
+        if self.metrics is None:
+            return
+        t_done = time.perf_counter()
+        t_first = req.t_first if req.t_first is not None else t_done
+        self.metrics.observe_histogram(
+            "serve_time_per_output_token_seconds",
+            (t_done - t_first) / max(1, len(req.tokens) - 1),
+            model=self.model_label, mode="pool",
+        )
+
+    def _update_gauges_locked(self) -> None:
+        """Admission-queue depth + tokens-in-flight gauges (caller
+        holds the pool lock)."""
+
+        if self.metrics is None:
+            return
+        self.metrics.set(
+            "serve_admission_queue_depth",
+            float(len(self._queue)),
+            model=self.model_label,
+        )
+        inflight = sum(
+            r.budget - len(r.tokens) for r in self._active.values()
+        ) + sum(r.budget - len(r.tokens) for r in self._queue)
+        self.metrics.set(
+            "serve_tokens_in_flight",
+            float(max(0, inflight)),
+            model=self.model_label,
+        )
 
     # -- compiled pieces -------------------------------------------------
 
@@ -439,9 +510,11 @@ class ContinuousBatchingDecoder:
                 # never needs a slot
                 req.done = True
                 self._release_staged_locked(req)
+                self._observe_done(req)
                 self._done_cond.notify_all()
             else:
                 self._queue.append(req)
+            self._update_gauges_locked()
         return rid
 
     def _release_staged_locked(self, req: _Request) -> None:
@@ -463,6 +536,7 @@ class ContinuousBatchingDecoder:
         which blocks further submits instead of letting a request
         burst OOM the chip."""
 
+        work_start = time.perf_counter()
         cache = _init_cache_for(self.dmodel, 1)
         last = None
         off = 0
@@ -490,6 +564,7 @@ class ContinuousBatchingDecoder:
         req.staged_cache = cache
         req.staged_tok = tok
         req.tokens.append(int(tok))
+        self._observe_first_token(req, work_start)
 
     def _admit_fused(self, req: _Request, slot: int, width: int) -> None:
         """Seat one request with exactly ONE device dispatch (the fused
@@ -502,6 +577,7 @@ class ContinuousBatchingDecoder:
         ids[0, : req.prompt.size] = req.prompt
         sampled = req.temperature > 0.0
         rng = req.rng if sampled else jnp.zeros((2,), jnp.uint32)
+        work_start = time.perf_counter()
         with self.ledger.dispatch("admission", rid=req.rid, width=width):
             stack, toks, tok, rng_next = self._admission(width)(
                 self.params, self._cache, self._last_tok,
@@ -514,10 +590,12 @@ class ContinuousBatchingDecoder:
         if sampled:
             req.rng = rng_next
         req.tokens.append(tok_h)
+        self._observe_first_token(req, work_start)
         if len(req.tokens) >= req.budget:
             # budget-1: the admission token completed it; the scattered
             # cache rows are dead and the slot stays free
             req.done = True
+            self._observe_done(req)
             self._done_cond.notify_all()
         else:
             req.slot = slot
@@ -550,6 +628,7 @@ class ContinuousBatchingDecoder:
                 if width is not None and req.staged_cache is None:
                     try:
                         self._admit_fused(req, slot, width)
+                        self._update_gauges_locked()
                     except BaseException:
                         # same survival rule as the legacy prefill: a
                         # transient device failure must re-queue the
@@ -581,6 +660,8 @@ class ContinuousBatchingDecoder:
                     # completed it — never needs the seat after all
                     req.done = True
                     self._release_staged_locked(req)
+                    self._observe_done(req)
+                    self._update_gauges_locked()
                     self._done_cond.notify_all()
                     continue
                 with self.ledger.dispatch("scatter", rid=req.rid):
@@ -591,6 +672,7 @@ class ContinuousBatchingDecoder:
                 self._release_staged_locked(req)
                 req.slot = slot
                 self._active[slot] = req
+                self._update_gauges_locked()
 
     def step(self) -> int:
         """Admit waiting requests, run `steps_per_sync` decode steps
@@ -634,7 +716,9 @@ class ContinuousBatchingDecoder:
                     req.done = True
                     req.slot = None
                     del self._active[slot]
+                    self._observe_done(req)
                     finished = True
+            self._update_gauges_locked()
             if finished:
                 self._done_cond.notify_all()
             return len(self._active)

@@ -97,6 +97,7 @@ def train_loop(
     tracer=None,
     steps_per_sync: int = 1,
     sync_ledger=None,
+    watchdog=None,
 ) -> List[float]:
     """Run ``steps`` steps, print the standard per-process summary, and
     (by default) fail loudly if the loss did not decrease — the examples
@@ -128,14 +129,25 @@ def train_loop(
     ``sync.window`` / ``sync.final`` spans marking the deferred
     resolves.  Long runs truncate at the store's per-trace span cap;
     the waterfall reports how many spans were dropped.
+
+    Observability (r8): ``data.load`` waits are ALSO recorded on the
+    sync ledger (``train_sync_total{phase="data.load"}`` + the shared
+    ``train_sync_seconds`` histogram family) — a starved input
+    pipeline shows up next to the window resolves it delays; and the
+    loop registers a ``train.<tag>`` heartbeat on ``watchdog``
+    (default: the process watchdog, utils/watchdog.py), beaten once
+    per resolved window — a wedged step or data iterator past the
+    deadline dumps thread stacks + the flight recorder.
     """
 
     import sys
+    import time
 
     import jax
 
     from tf_operator_tpu.utils.metrics import StepSyncLedger, default_metrics
     from tf_operator_tpu.utils.trace import default_tracer
+    from tf_operator_tpu.utils.watchdog import default_watchdog
 
     tr = tracer if tracer is not None else default_tracer
     ledger = (
@@ -143,6 +155,8 @@ def train_loop(
         if sync_ledger is not None
         else StepSyncLedger(metrics=default_metrics, tracer=tr)
     )
+    dog = watchdog if watchdog is not None else default_watchdog
+    hb = dog.register(f"train.{tag}")
 
     batches: Optional[Iterable[Dict]] = None
     fixed = None
@@ -184,12 +198,18 @@ def train_loop(
                     with tr.span(f"step {step}"):
                         if batches is not None:
                             with tr.span("data.load"):
+                                t_load = time.perf_counter()
                                 batch = next(batches)
+                                ledger.record(
+                                    "data.load",
+                                    time.perf_counter() - t_load,
+                                )
                         else:
                             batch = fixed
                         with tr.span("train.step"):
                             metrics = trainer.train_step(batch)
                     ledger.step()
+                    hb.beat()
                     losses.extend(_resolve_losses(ledger, "step", [metrics["loss"]]))
             else:
                 step = start_step
@@ -207,13 +227,19 @@ def train_loop(
                             for _ in range(n):
                                 if batches is not None:
                                     with tr.span("data.load"):
+                                        t_load = time.perf_counter()
                                         batch = next(batches)
+                                        ledger.record(
+                                            "data.load",
+                                            time.perf_counter() - t_load,
+                                        )
                                 else:
                                     batch = fixed
                                 with tr.span("train.step"):
                                     m = trainer.train_step(batch)
                                 window.append(m["loss"])
                     ledger.step(n)
+                    hb.beat()
                     # deferred resolution: fetch the PREVIOUS window now
                     # that this one is dispatched — its arrays are (almost
                     # always) already finished, so the host rides behind
@@ -226,6 +252,7 @@ def train_loop(
                 losses.extend(_resolve_losses(ledger, "final", pending))
 
     finally:
+        dog.unregister(hb.name)
         if prev_trainer_ledger is not None:
             trainer.sync_ledger = prev_trainer_ledger
 

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Tuple
 
@@ -122,35 +123,66 @@ class ApiServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            @staticmethod
+            def _route_class(route: str) -> str:
+                """Bounded-cardinality route label for the request
+                latency histogram: names/namespaces collapse to the
+                resource shape (``tpujobs``, ``tpujobs/events``, ...)
+                so a burst of jobs cannot mint unbounded label sets."""
+
+                parts = [p for p in route.split("/") if p]
+                if not parts:
+                    return "/"
+                if parts[0] != "apis":
+                    return parts[0] if len(parts) == 1 else f"{parts[0]}/*"
+                # /apis/v1/tpujobs | /apis/v1/namespaces/{ns}/tpujobs[/{name}[/sub...]]
+                if parts[2:3] == ["namespaces"]:
+                    rest = parts[4:]
+                else:
+                    rest = parts[2:]
+                resource = rest[0] if rest else "?"
+                sub = rest[2] if len(rest) > 2 else ""
+                return f"{resource}/{sub}" if sub else resource
+
             def _traced(self, method: str, impl):
                 """Run a verb handler under a server span (joining an
                 incoming x-trace-id); observability endpoints are NOT
                 traced — the dashboard polls them every 2s and the
                 resulting ok-and-fast traces would only churn the
-                store's eviction."""
+                store's eviction.  EVERY request (traced or not)
+                observes ``api_request_seconds{method=,route=}`` — the
+                control-plane half of the SLO exposition."""
 
                 route = self.path.split("?")[0]
-                untraced = ("/healthz", "/metrics", "/traces")
-                if method == "GET" and (
-                    route == "/" or any(
-                        route == u or route.startswith(u + "/")
-                        for u in untraced
+                t0 = time.perf_counter()
+                try:
+                    untraced = ("/healthz", "/metrics", "/traces", "/debug")
+                    if method == "GET" and (
+                        route == "/" or any(
+                            route == u or route.startswith(u + "/")
+                            for u in untraced
+                        )
+                    ):
+                        # keep-alive reuses the handler across requests:
+                        # a stale span from the previous request must
+                        # not stamp this untraced response
+                        self._trace_span = None
+                        return impl()
+                    tid, parent = extract_headers(self.headers)
+                    span = outer.tracer.start_span(
+                        f"api {method} {route}",
+                        kind="server", trace_id=tid, parent_id=parent,
+                        attributes={"method": method},
                     )
-                ):
-                    # keep-alive reuses the handler across requests: a
-                    # stale span from the previous request must not
-                    # stamp this untraced response
-                    self._trace_span = None
-                    return impl()
-                tid, parent = extract_headers(self.headers)
-                span = outer.tracer.start_span(
-                    f"api {method} {route}",
-                    kind="server", trace_id=tid, parent_id=parent,
-                    attributes={"method": method},
-                )
-                self._trace_span = span
-                with span:
-                    return impl()
+                    self._trace_span = span
+                    with span:
+                        return impl()
+                finally:
+                    outer.metrics.observe_histogram(
+                        "api_request_seconds",
+                        time.perf_counter() - t0,
+                        method=method, route=self._route_class(route),
+                    )
 
             def _error(self, code: int, message: str):
                 self._send(code, {"error": message})
@@ -240,19 +272,24 @@ class ApiServer:
                             )
                         return self._send(200, trace)
                     if p == ["debug", "stacks"]:
-                        import sys
-                        import traceback
+                        from tf_operator_tpu.utils.watchdog import (
+                            thread_stacks,
+                        )
 
-                        names = {
-                            t.ident: t.name for t in threading.enumerate()
-                        }
-                        chunks = []
-                        for tid, frame in sys._current_frames().items():
-                            chunks.append(
-                                f"--- thread {names.get(tid, '?')} (id {tid}) ---\n"
-                                + "".join(traceback.format_stack(frame))
-                            )
-                        return self._send(200, "\n".join(chunks), "text/plain")
+                        return self._send(200, thread_stacks(), "text/plain")
+                    if p == ["debug", "flightrecorder"]:
+                        # the black-box rings (utils/flight.py): what
+                        # this process was doing just now, as JSONL —
+                        # served on every replica like /debug/stacks
+                        from tf_operator_tpu.utils.flight import (
+                            default_recorder,
+                        )
+
+                        return self._send(
+                            200,
+                            default_recorder.dump_text(),
+                            "application/x-ndjson",
+                        )
                     if p[0] == "apis" and self._not_leader():
                         return None
                     if p == ["apis", "v1", "tpujobs"]:

@@ -83,6 +83,12 @@ DASHBOARD_HTML = """<!doctype html>
 <div id="client-health" class="muted">no apiserver client traffic</div>
 <h2>workqueue</h2>
 <div id="workqueue" class="muted">no queue traffic</div>
+<h2>slo</h2>
+<table id="slo">
+  <thead><tr><th>latency family</th><th>labels</th><th>count</th>
+  <th>p50 &le;</th><th>p99 &le;</th></tr></thead>
+  <tbody><tr><td class="muted" colspan="5">no latency histograms yet</td></tr></tbody>
+</table>
 <h2>traces</h2>
 <table id="traces">
   <thead><tr><th>trace</th><th>root</th><th>spans</th><th>duration</th>
@@ -168,6 +174,55 @@ async function refreshHealth() {
     parseFloat(l.split(" ").pop()) > 0);
   el.classList.toggle("degraded", bad);
   refreshWorkqueue(all);
+  refreshSLO(all);
+}
+
+function refreshSLO(metricLines) {
+  // SLO panel: p50/p99 per latency-histogram series, straight from the
+  // *_bucket lines of /metrics (utils/metrics.py labeled histograms).
+  // Families: user-facing serving SLOs (serve_*), the training/serving
+  // sync ledgers, control-plane sync + queue + API request latencies.
+  const WANT = /^(serve_|serving_dispatch_seconds|train_sync_seconds|workqueue_queue_latency_seconds|tpujob_sync_duration_seconds|api_request_seconds)/;
+  const series = {};
+  const re = /^([A-Za-z0-9_:]+)_bucket\\{(.*)\\} ([0-9.eE+-]+)$/;
+  for (const l of metricLines) {
+    const m = l.match(re);
+    if (!m || !WANT.test(m[1])) continue;
+    const le = (m[2].match(/le="([^"]+)"/) || [])[1];
+    if (le === undefined) continue;
+    const rest = m[2].replace(/le="[^"]+",?/, "").replace(/,$/, "");
+    const key = m[1] + "|" + rest;
+    (series[key] = series[key] || { fam: m[1], labels: rest, b: [] })
+      .b.push([le === "+Inf" ? Infinity : parseFloat(le), parseFloat(m[3])]);
+  }
+  const tbody = document.querySelector("#slo tbody");
+  const keys = Object.keys(series).sort();
+  tbody.innerHTML = "";
+  if (!keys.length) {
+    const tr = document.createElement("tr");
+    const td = document.createElement("td");
+    td.textContent = "no latency histograms yet"; td.className = "muted";
+    td.colSpan = 5; tr.appendChild(td); tbody.appendChild(tr);
+    return;
+  }
+  const fmt = v => v === Infinity ? "+Inf" :
+    (v >= 1 ? v.toFixed(2) + " s" : (1000 * v).toFixed(1) + " ms");
+  for (const key of keys) {
+    const s = series[key];
+    s.b.sort((x, y) => x[0] - y[0]);
+    const count = s.b.length ? s.b[s.b.length - 1][1] : 0;
+    if (!count) continue;
+    const q = p => { for (const [le, c] of s.b) if (c >= p * count) return le;
+                     return Infinity; };
+    const tr = document.createElement("tr");
+    for (const text of [s.fam, s.labels, String(count),
+                        fmt(q(0.5)), fmt(q(0.99))]) {
+      const td = document.createElement("td");
+      td.textContent = text;
+      tr.appendChild(td);
+    }
+    tbody.appendChild(tr);
+  }
 }
 
 function refreshWorkqueue(metricLines) {

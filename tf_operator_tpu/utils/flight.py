@@ -1,0 +1,294 @@
+"""Black-box flight recorder: what was this process doing just before
+it wedged or died?
+
+The tracing/metrics/logging subsystems answer "how is the system doing"
+while someone is watching.  This module answers the postmortem
+question: bounded ring buffers of the most recent finished spans, log
+records, and metric-delta snapshots are kept process-wide at ~zero
+cost, and ``dump()`` writes them as one JSONL snapshot at the moment of
+failure — wired to SIGTERM, the fatal-exception hook, the stall
+watchdog (utils/watchdog.py), and the ``/debug/flightrecorder``
+endpoints on the operator monitoring port, the kubesim apiserver, and
+serve_lm.
+
+Everything here is host-side bookkeeping (appends to deques under a
+lock); nothing touches the device, so the PR-4 no-hot-sync invariant is
+untouched by recording from the training loop.
+
+Determinism contract (test-pinned): ``records()``/``dump()`` emit a
+single ``meta`` record followed by spans, then logs, then metric
+deltas, each oldest-first; two dumps with no intervening activity are
+identical except the meta record's wall-clock fields.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+def _ring_log_handler(recorder: "FlightRecorder"):
+    """logging.Handler appending formatted records to the recorder's
+    log ring (the Handler subclass is defined lazily so importing this
+    module does not import logging config)."""
+
+    import logging
+
+    class Handler(logging.Handler):
+        def emit(self, record):
+            try:
+                recorder.record_log(
+                    level=record.levelname,
+                    logger=record.name,
+                    message=record.getMessage(),
+                    fields=getattr(record, "fields", None),
+                )
+            except Exception:  # a recorder bug must never kill logging
+                # counted, not logged: logging from a failing log
+                # handler would recurse
+                recorder.ring_errors += 1
+
+    return Handler()
+
+
+class FlightRecorder:
+    """Bounded rings of recent spans / logs / metric deltas + dump().
+
+    Attach points (all optional, all chainable):
+      - ``attach_tracer(tracer)``: chains onto ``tracer.on_finish`` so
+        every finished span's dict lands in the span ring;
+      - ``attach_logger(logger)``: adds a ring handler to a stdlib
+        logger (default: the ``tpujob`` root);
+      - ``attach_metrics(metrics)``: remembers the registry so
+        ``snapshot_metrics()`` can record counter/gauge deltas.
+    """
+
+    def __init__(
+        self,
+        max_spans: int = 256,
+        max_logs: int = 512,
+        max_snapshots: int = 32,
+    ):
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=max_spans)
+        self._logs: deque = deque(maxlen=max_logs)
+        self._snapshots: deque = deque(maxlen=max_snapshots)
+        self._metrics = None
+        self._last_counters: Dict[str, float] = {}
+        self._dumps = 0
+        #: recorder-internal failures (ring-handler emit errors) —
+        #: surfaced in the dump meta record rather than swallowed
+        self.ring_errors = 0
+
+    # -- recording ----------------------------------------------------------
+
+    def record_span(self, span) -> None:
+        """Append one finished span (a Span or its dict)."""
+
+        d = span.to_dict() if hasattr(span, "to_dict") else dict(span)
+        with self._lock:
+            self._spans.append(d)
+
+    def record_log(
+        self,
+        level: str,
+        logger: str,
+        message: str,
+        fields: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        rec = {"level": level, "logger": logger, "message": message,
+               "unix": time.time()}
+        if fields:
+            rec["fields"] = dict(fields)
+        with self._lock:
+            self._logs.append(rec)
+
+    def snapshot_metrics(self, label: str = "") -> Dict[str, float]:
+        """Record the delta of every counter/gauge since the previous
+        snapshot (first call records absolute values).  Returns the
+        delta dict.  No-op ({}) without an attached registry."""
+
+        if self._metrics is None:
+            return {}
+        now = self._metrics.counters_snapshot()
+        with self._lock:
+            delta = {
+                k: round(v - self._last_counters.get(k, 0.0), 6)
+                for k, v in now.items()
+                if v != self._last_counters.get(k, 0.0)
+            }
+            self._last_counters = now
+            self._snapshots.append(
+                {"label": label, "unix": time.time(), "delta": delta}
+            )
+        return delta
+
+    # -- attach points ------------------------------------------------------
+
+    def attach_tracer(self, tracer) -> None:
+        prev = tracer.on_finish
+
+        def chained(span):
+            self.record_span(span)
+            if prev is not None:
+                prev(span)
+
+        tracer.on_finish = chained
+
+    def attach_logger(self, logger=None) -> None:
+        import logging
+
+        if logger is None:
+            logger = logging.getLogger("tpujob")
+        logger.addHandler(_ring_log_handler(self))
+
+    def attach_metrics(self, metrics) -> None:
+        self._metrics = metrics
+
+    # -- export -------------------------------------------------------------
+
+    def records(self) -> List[Dict[str, Any]]:
+        """meta + spans + logs + metric snapshots, oldest-first within
+        each section — the exact dump order (determinism contract)."""
+
+        with self._lock:
+            spans = list(self._spans)
+            logs = list(self._logs)
+            snaps = list(self._snapshots)
+            dumps = self._dumps
+        meta = {
+            "type": "meta",
+            "pid": os.getpid(),
+            "unix": time.time(),
+            "spans": len(spans),
+            "logs": len(logs),
+            "metricSnapshots": len(snaps),
+            "priorDumps": dumps,
+            "ringErrors": self.ring_errors,
+        }
+        out: List[Dict[str, Any]] = [meta]
+        out.extend({"type": "span", **s} for s in spans)
+        out.extend({"type": "log", **r} for r in logs)
+        out.extend({"type": "metrics", **s} for s in snaps)
+        return out
+
+    def dump(self, fileobj=None, path: Optional[str] = None, reason: str = "") -> str:
+        """Write the JSONL snapshot.  With ``path`` (or neither arg) a
+        file under ``$TPUJOB_FLIGHT_DIR`` (default /tmp) is created and
+        its path returned; with ``fileobj`` the lines stream there and
+        the return value is "".  Never raises — a dying process calls
+        this from signal/excepthook context."""
+
+        try:
+            records = self.records()
+            if reason:
+                records[0]["reason"] = reason
+            with self._lock:
+                self._dumps += 1
+            if fileobj is not None:
+                for r in records:
+                    fileobj.write(json.dumps(r) + "\n")
+                return ""
+            if path is None:
+                d = os.environ.get("TPUJOB_FLIGHT_DIR", "/tmp")
+                os.makedirs(d, exist_ok=True)
+                path = os.path.join(
+                    d,
+                    f"flight-{os.getpid()}-{self._dumps}"
+                    f"{'-' + reason if reason else ''}.jsonl",
+                )
+            with open(path, "w") as f:
+                for r in records:
+                    f.write(json.dumps(r) + "\n")
+            return path
+        except Exception:  # noqa: BLE001 - crash-path best effort
+            return ""
+
+    def dump_text(self) -> str:
+        """The JSONL snapshot as one string (the HTTP endpoints)."""
+
+        return "\n".join(json.dumps(r) for r in self.records()) + "\n"
+
+
+#: process-global default (mirrors metrics/tracer defaults): the HTTP
+#: debug endpoints and the watchdog read this instance
+default_recorder = FlightRecorder()
+
+_installed = False
+_install_lock = threading.Lock()
+
+
+def install(
+    recorder: Optional[FlightRecorder] = None,
+    tracer=None,
+    metrics=None,
+    logger=None,
+    signals: bool = True,
+    excepthook: bool = True,
+) -> FlightRecorder:
+    """Register the recorder process-wide: tracer + logger + metrics
+    attach, SIGTERM chains a dump before the previous handler runs,
+    and a fatal (uncaught) exception dumps from sys.excepthook.
+    Idempotent — a second install returns the already-wired default."""
+
+    global _installed
+    rec = recorder if recorder is not None else default_recorder
+    with _install_lock:
+        if _installed:
+            return rec
+        _installed = True
+
+    from tf_operator_tpu.utils.metrics import default_metrics
+    from tf_operator_tpu.utils.trace import default_tracer
+
+    rec.attach_tracer(tracer if tracer is not None else default_tracer)
+    rec.attach_logger(logger)
+    rec.attach_metrics(metrics if metrics is not None else default_metrics)
+
+    if signals and threading.current_thread() is threading.main_thread():
+        prev_term = signal.getsignal(signal.SIGTERM)
+
+        def on_term(signum, frame):
+            rec.dump(reason="sigterm")
+            if callable(prev_term):
+                prev_term(signum, frame)
+            elif prev_term == signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, on_term)
+
+    if excepthook:
+        prev_hook = sys.excepthook
+
+        def on_fatal(exc_type, exc, tb):
+            rec.record_log(
+                "FATAL", "excepthook", f"{exc_type.__name__}: {exc}"
+            )
+            rec.dump(reason="fatal")
+            prev_hook(exc_type, exc, tb)
+
+        sys.excepthook = on_fatal
+
+        # most of this process's work runs on THREADS (watch loops,
+        # kubelet sim, reconcile workers) — sys.excepthook never fires
+        # for those; threading.excepthook does
+        prev_thread_hook = threading.excepthook
+
+        def on_thread_fatal(args):
+            rec.record_log(
+                "FATAL", "threading.excepthook",
+                f"{args.exc_type.__name__}: {args.exc_value} "
+                f"(thread {getattr(args.thread, 'name', '?')})",
+            )
+            rec.dump(reason="fatal-thread")
+            prev_thread_hook(args)
+
+        threading.excepthook = on_thread_fatal
+    return rec

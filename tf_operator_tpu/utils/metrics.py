@@ -20,6 +20,33 @@ DEFAULT_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+#: serving-SLO buckets (seconds): user-facing latencies stretch past the
+#: sync-duration range (a 256-token generate on a tunneled chip is tens
+#: of seconds), so the SLO families get a longer tail
+SLO_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _escape_label(value) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline must be escaped or the exposition line is
+    unparseable (the strict-parse test enforces this round-trips)."""
+
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _label_str(labels: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return ",".join(parts)
+
 
 class Metrics:
     def __init__(self):
@@ -27,8 +54,11 @@ class Metrics:
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
         self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._observations: Dict[str, List[float]] = defaultdict(list)
-        #: name -> (buckets, counts[len(buckets)+1], sum, count)
-        self._histograms: Dict[str, list] = {}
+        #: (name, labels) -> [buckets, counts[len(buckets)+1], sum, count]
+        self._histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], list] = {}
+        #: per-family bucket config (set_buckets): consulted when a
+        #: family's first observation arrives without explicit buckets
+        self._family_buckets: Dict[str, Tuple[float, ...]] = {}
         #: name -> trace id of the most recent exemplar-carrying inc —
         #: the counter→trace link (OpenMetrics-exemplar-style): "this
         #: client has 14 errors" becomes "...and HERE is one of them"
@@ -68,18 +98,39 @@ class Metrics:
         with self._lock:
             self._observations[name].append(value)
 
+    def set_buckets(self, name: str, buckets: Tuple[float, ...]) -> None:
+        """Per-family bucket config: every later observation of
+        ``name`` (any label set) that does not pass explicit buckets
+        uses these.  Call before the first observation — an existing
+        series keeps the buckets it was created with."""
+
+        with self._lock:
+            self._family_buckets[name] = tuple(buckets)
+
     def observe_histogram(
-        self, name: str, value: float, buckets: Tuple[float, ...] = DEFAULT_BUCKETS
+        self,
+        name: str,
+        value: float,
+        buckets: "Tuple[float, ...] | None" = None,
+        **labels: str,
     ) -> None:
         """Bounded-memory histogram (Prometheus bucket semantics) — use
         for unbounded-cardinality series like per-sync durations, where
-        the raw-observation list of ``observe`` would leak."""
+        the raw-observation list of ``observe`` would leak.  Labeled:
+        each label set is its own bucket series within the family
+        (``serve_ttft_seconds{model="llama-tiny"}``)."""
 
+        key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
         with self._lock:
-            h = self._histograms.get(name)
+            h = self._histograms.get(key)
             if h is None:
-                h = [buckets, [0] * (len(buckets) + 1), 0.0, 0]
-                self._histograms[name] = h
+                bks = (
+                    tuple(buckets)
+                    if buckets is not None
+                    else self._family_buckets.get(name, DEFAULT_BUCKETS)
+                )
+                h = [bks, [0] * (len(bks) + 1), 0.0, 0]
+                self._histograms[key] = h
             bks, counts, _, _ = h
             i = 0
             while i < len(bks) and value > bks[i]:
@@ -88,16 +139,20 @@ class Metrics:
             h[2] += value
             h[3] += 1
 
-    def histogram(self, name: str) -> Dict[str, float]:
-        """Summary view of a histogram: count, sum, approx p50/p99
-        (upper bucket bounds)."""
+    def histogram(self, name: str, **labels: str) -> Dict[str, float]:
+        """Summary view of one histogram series: count, sum, approx
+        p50/p99 (upper bucket bounds)."""
 
+        key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
         with self._lock:
-            h = self._histograms.get(name)
+            h = self._histograms.get(key)
             if h is None:
                 return {"count": 0}
             bks, counts, total, n = h[0], list(h[1]), h[2], h[3]
+        return self._summarize(bks, counts, total, n)
 
+    @staticmethod
+    def _summarize(bks, counts, total, n) -> Dict[str, float]:
         def quantile(q: float) -> float:
             target = q * n
             acc = 0
@@ -113,6 +168,21 @@ class Metrics:
             "mean": total / n if n else 0.0,
             "p50_le": quantile(0.5),
             "p99_le": quantile(0.99),
+        }
+
+    def histogram_family(self, name: str) -> Dict[Tuple[Tuple[str, str], ...], Dict[str, float]]:
+        """Every label set of one histogram family, summarized — the
+        /slo endpoint's read (``{(("model","x"),): {count, p50_le, ...}}``)."""
+
+        with self._lock:
+            items = [
+                (labels, (h[0], list(h[1]), h[2], h[3]))
+                for (n, labels), h in self._histograms.items()
+                if n == name
+            ]
+        return {
+            labels: self._summarize(bks, counts, total, cnt)
+            for labels, (bks, counts, total, cnt) in items
         }
 
     def counter(self, name: str, **labels: str) -> float:
@@ -144,33 +214,61 @@ class Metrics:
         }
 
     def exposition(self) -> str:
-        """Prometheus text format."""
+        """Prometheus text format (label values escaped per the text
+        exposition rules — see ``_escape_label``)."""
 
         lines = []
         with self._lock:
             for (name, labels), v in sorted(self._counters.items()):
-                label_s = ",".join(f'{k}="{v2}"' for k, v2 in labels)
+                label_s = _label_str(labels)
                 lines.append(f"{name}{{{label_s}}} {v}" if label_s else f"{name} {v}")
             for (name, labels), v in sorted(self._gauges.items()):
-                label_s = ",".join(f'{k}="{v2}"' for k, v2 in labels)
+                label_s = _label_str(labels)
                 lines.append(f"{name}{{{label_s}}} {v}" if label_s else f"{name} {v}")
             for name, vals in sorted(self._observations.items()):
                 lines.append(f"{name}_count {len(vals)}")
                 lines.append(f"{name}_sum {sum(vals)}")
-            for name, (bks, counts, total, n) in sorted(self._histograms.items()):
+            for (name, labels), (bks, counts, total, n) in sorted(
+                self._histograms.items()
+            ):
+                label_s = _label_str(labels)
+                suffix = f",{label_s}" if label_s else ""
                 acc = 0
                 for i, b in enumerate(bks):
                     acc += counts[i]
-                    lines.append(f'{name}_bucket{{le="{b}"}} {acc}')
-                lines.append(f'{name}_bucket{{le="+Inf"}} {n}')
-                lines.append(f"{name}_sum {total}")
-                lines.append(f"{name}_count {n}")
+                    lines.append(f'{name}_bucket{{le="{b}"{suffix}}} {acc}')
+                lines.append(f'{name}_bucket{{le="+Inf"{suffix}}} {n}')
+                lines.append(
+                    f"{name}_sum{{{label_s}}} {total}"
+                    if label_s
+                    else f"{name}_sum {total}"
+                )
+                lines.append(
+                    f"{name}_count{{{label_s}}} {n}"
+                    if label_s
+                    else f"{name}_count {n}"
+                )
             # exemplar links as comments: Prometheus text parsers skip
             # them, the dashboard reads them to deep-link error
             # counters to their trace waterfalls
             for name, tid in sorted(self._exemplars.items()):
                 lines.append(f'# exemplar {name} trace_id="{tid}"')
         return "\n".join(lines) + "\n"
+
+    def counters_snapshot(self) -> Dict[str, float]:
+        """Flat {\"name{labels}\": value} copy of every counter and
+        gauge — the flight recorder diffs successive snapshots into
+        metric-delta records."""
+
+        out: Dict[str, float] = {}
+        with self._lock:
+            for (name, labels), v in self._counters.items():
+                label_s = _label_str(labels)
+                out[f"{name}{{{label_s}}}" if label_s else name] = v
+            for (name, labels), v in self._gauges.items():
+                label_s = _label_str(labels)
+                out[f"{name}{{{label_s}}}" if label_s else name] = v
+        return out
 
 
 class DispatchLedger:
@@ -198,8 +296,8 @@ class DispatchLedger:
 
     Optional sinks, both None-safe:
       - ``metrics``: every dispatch increments
-        ``serving_dispatch_total{phase=...}`` and observes
-        ``serving_dispatch_seconds_<phase>`` (bounded histogram), so
+        ``serving_dispatch_total{phase=...}`` and observes the labeled
+        ``serving_dispatch_seconds{phase=...}`` histogram family, so
         ``/metrics`` exports the ledger live;
       - ``tracer``: when the calling thread is inside a trace (e.g. a
         serve_lm request span), each dispatch records a child span
@@ -231,8 +329,13 @@ class DispatchLedger:
             self._seconds[phase] += seconds
         if self.metrics is not None:
             self.metrics.inc(f"{self.prefix}_total", float(n), phase=phase)
+            # ONE labeled family per ledger (``serving_dispatch_seconds
+            # {phase="step"}`` / ``train_sync_seconds{phase="window"}``)
+            # — training and serving share the exposition shape the
+            # SLO panel reads, instead of a name-mangled family per
+            # phase
             self.metrics.observe_histogram(
-                f"{self.prefix}_seconds_{phase}", seconds
+                f"{self.prefix}_seconds", seconds, phase=phase
             )
 
     @contextlib.contextmanager
@@ -362,8 +465,8 @@ class StepSyncLedger(DispatchLedger):
 
     Sinks mirror DispatchLedger: counters ``train_sync_total{phase=}``
     (+ ``train_sync_blocked_total`` when the host provably waited),
-    histograms ``train_sync_seconds_<phase>``, and ``sync.<phase>``
-    trace spans.
+    the labeled ``train_sync_seconds{phase=}`` histogram family, and
+    ``sync.<phase>`` trace spans.
     """
 
     def __init__(
