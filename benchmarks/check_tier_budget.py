@@ -33,6 +33,7 @@ def check(record: dict, budget_s: float = SLOW_TIER_BUDGET_S):
     """(ok, message) for a parsed SUITE_RECORD.json dict."""
 
     lines = []
+    red = []
     for tier in ("tier1", "slow", "all"):
         row = record.get(tier)
         if row:
@@ -41,7 +42,23 @@ def check(record: dict, budget_s: float = SLOW_TIER_BUDGET_S):
                 f"{row.get('collected', '?')} collected, "
                 f"exit {row.get('exitstatus', '?')} ({row.get('when', '?')})"
             )
+            # 'all' is whatever unmarked pytest invocation ran last
+            # (often a targeted local subset) — only the real tiers
+            # can redden the gate
+            if tier != "all" and row.get("exitstatus") not in (0, None):
+                red.append(tier)
     summary = "\n".join(lines) if lines else "no recorded sessions"
+    if red:
+        # a wall-clock budget on a FAILING tier is meaningless — a red
+        # record must never slip past the gate on timing alone
+        return False, (
+            summary
+            + "\nRED TIER RECORD: "
+            + ", ".join(
+                f"{t} exited {record[t]['exitstatus']}" for t in red
+            )
+            + " — fix the failures and re-run the tier before gating"
+        )
     slow = record.get("slow")
     if slow is None:
         return True, summary + "\nslow tier: no record yet (gate skipped)"

@@ -498,9 +498,13 @@ def build_handler(
                     prompt, n_new, temperature=temperature, top_k=top_k,
                     rng=jax.random.PRNGKey(seed),
                 )
+                # generate returns an UN-fetched device array; without
+                # this host fetch inside the timed window, wall would
+                # record async-dispatch latency (~ms), not generation
+                new_ids = np.asarray(out[0, prompt.shape[1]:])
                 wall = _time.perf_counter() - t_gen
                 observe_slo("chunked", 0.0, wall, wall / n_new)
-                sample = finish(decode_bytes(np.asarray(out[0, prompt.shape[1]:])))
+                sample = finish(decode_bytes(new_ids))
                 return self._reply(
                     200, {"prompt": text, "sample": sample, "seed": seed}
                 )

@@ -97,6 +97,13 @@ def pytest_sessionfinish(session, exitstatus):
     except (OSError, ValueError):
         pass
     tier = _session_tier(session.config)
+    if tier == "all":
+        # unmarked runs are overwhelmingly targeted local invocations
+        # (`pytest tests/test_x.py`): recording them would rewrite a
+        # COMMITTED benchmark file on every such run (perpetually
+        # dirty trees, meaningless data) — only the round's real
+        # tiers (`-m 'not slow'` / `-m slow`) are worth a record
+        return
     collected = int(getattr(session, "testscollected", 0) or 0)
     prev = record.get(tier)
     if prev and collected < 0.5 * int(prev.get("collected", 0) or 0):

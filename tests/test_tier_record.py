@@ -58,6 +58,21 @@ class TestBudgetGate:
                                       "exitstatus": 0, "when": "x"}})
         assert not ok and "OVER BUDGET" in msg
 
+    def test_red_tier_record_fails(self):
+        """A failing tier (nonzero exitstatus) must not pass the gate
+        on wall clock alone, even with no slow record."""
+
+        mod = _load_checker()
+        ok, msg = mod.check({"tier1": {"wall_s": 150.0, "collected": 300,
+                                       "exitstatus": 1, "when": "x"}})
+        assert not ok and "RED TIER RECORD" in msg and "exited 1" in msg
+
+    def test_red_slow_record_fails_despite_budget(self):
+        mod = _load_checker()
+        ok, msg = mod.check({"slow": {"wall_s": 900.0, "collected": 200,
+                                      "exitstatus": 2, "when": "x"}})
+        assert not ok and "RED TIER RECORD" in msg
+
     def test_cli_exit_codes(self, tmp_path):
         """The gate as tooling: exit 0 without a record file."""
 

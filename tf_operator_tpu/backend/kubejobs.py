@@ -75,7 +75,7 @@ def _ns_path(namespace: str) -> str:
     return f"/apis/tpujob.dist/v1/namespaces/{namespace}/tpujobs"
 
 
-def _decode(obj: dict) -> TPUJob:
+def _decode(obj: dict, metrics=None) -> TPUJob:
     """Stored JSON → TPUJob, NEVER raising: the watch loop and list
     path must survive out-of-band apiserver writes (no admission
     webhook on a real cluster without ours deployed).  An object that
@@ -108,7 +108,12 @@ def _decode(obj: dict) -> TPUJob:
                 "invalid object %s also has unparseable status: %s",
                 job.key, status_err,
             )
-        default_metrics.inc("informer_invalid_objects_total", kind="TPUJob")
+        # count on the CALLER's registry when one was injected —
+        # the store routes every other fault counter there, and a
+        # /metrics missing exactly this family hides garbage ingestion
+        (metrics if metrics is not None else default_metrics).inc(
+            "informer_invalid_objects_total", kind="TPUJob"
+        )
     rv = meta_d.get("resourceVersion", "0")
     job.metadata.resource_version = int(rv) if str(rv).isdigit() else 0
     return job
@@ -202,7 +207,7 @@ class KubeJobStore:
                     )
                     return existing
             raise
-        stored = _decode(out)
+        stored = _decode(out, self.metrics)
         # reflect server-assigned identity back into the caller's
         # object, like JobStore.create / client-go Create
         job.metadata.uid = stored.metadata.uid
@@ -216,12 +221,12 @@ class KubeJobStore:
             out = self._request("GET", f"{_ns_path(namespace)}/{name}")
         except NotFoundError:
             return None
-        return _decode(out)
+        return _decode(out, self.metrics)
 
     def list(self, namespace: Optional[str] = None) -> List[TPUJob]:
         path = COLLECTION if namespace is None else _ns_path(namespace)
         out = self._request("GET", path)
-        return [_decode(o) for o in out.get("items", [])]
+        return [_decode(o, self.metrics) for o in out.get("items", [])]
 
     def update_status(
         self, namespace: str, name: str, status: TPUJobStatus
@@ -234,7 +239,7 @@ class KubeJobStore:
             f"{_ns_path(namespace)}/{name}",
             {"status": status_to_dict(status)},
         )
-        return _decode(out)
+        return _decode(out, self.metrics)
 
     def update_spec(self, job: TPUJob) -> TPUJob:
         """Whole-spec REPLACEMENT (JobStore.update_spec parity, via
@@ -248,7 +253,7 @@ class KubeJobStore:
         current = self._request("GET", path)
         current["spec"] = job_to_dict(job)["spec"]
         out = self._request("PUT", path, current)
-        return _decode(out)
+        return _decode(out, self.metrics)
 
     def delete(self, namespace: str, name: str) -> None:
         self._request("DELETE", f"{_ns_path(namespace)}/{name}")
@@ -292,7 +297,7 @@ class KubeJobStore:
                             WatchEvent(
                                 type=WatchEventType.ADDED,
                                 kind="TPUJob",
-                                obj=_decode(o),
+                                obj=_decode(o, self.metrics),
                             )
                         )
                 rv = self._stream(rv)
@@ -337,7 +342,7 @@ class KubeJobStore:
                     if status.get("code") == 410:
                         raise GoneError(410, "")
                     raise ApiError(int(status.get("code", 500)), str(status))
-                job = _decode(doc["object"])
+                job = _decode(doc["object"], self.metrics)
                 rv = max(rv, job.metadata.resource_version)
                 self._dispatch(
                     WatchEvent(

@@ -217,6 +217,20 @@ def _field_get(obj: Dict[str, Any], dotted: str):
     return cur
 
 
+def _merge_patch(obj: Dict[str, Any], patch: Dict[str, Any]) -> None:
+    """Strategic-merge-lite, in place: dict values merge one level
+    deep, everything else replaces (covers ownerReferences, status and
+    podgroup spec resize).  The ONE merge used both to build the
+    admission pre-check object and to apply the patch — shared so the
+    validated object can never drift from the stored one."""
+
+    for section, val in patch.items():
+        if isinstance(val, dict) and isinstance(obj.get(section), dict):
+            obj[section].update(val)
+        else:
+            obj[section] = val
+
+
 def _labels(obj: Dict[str, Any]) -> Dict[str, str]:
     return obj.get("metadata", {}).get("labels", {}) or {}
 
@@ -403,17 +417,24 @@ class MiniApiServer:
             if text is not None
             else json.dumps(obj if obj is not None else {}).encode()
         )
+        span = getattr(h, "_trace_span", None)
+        if span is not None:
+            # commit the span to the store BEFORE any response bytes
+            # reach the client: a caller may query the tracer the
+            # instant it has our reply, and end() is idempotent so the
+            # _handle finally-net stays a no-op (same contract as the
+            # watch-accept path)
+            span.set_attribute("status", status)
+            span.end()
         h.send_response(status)
         h.send_header(
             "Content-Type",
             "text/plain" if text is not None else "application/json",
         )
         h.send_header("Content-Length", str(len(body)))
-        span = getattr(h, "_trace_span", None)
         if span is not None:
             # the propagation contract: EVERY response names its trace
             h.send_header(TRACE_HEADER, span.trace_id)
-            span.set_attribute("status", status)
         for k, v in (headers or {}).items():
             h.send_header(k, v)
         h.end_headers()
@@ -509,6 +530,7 @@ class MiniApiServer:
                 )
             if act[0] == "reset":
                 span.set_error("injected connection reset")
+                span.end()  # commit before the client sees ECONNRESET
                 # RST, not FIN: SO_LINGER 0 makes close() abort the
                 # connection, so the client sees ECONNRESET mid-request
                 try:
@@ -752,26 +774,13 @@ class MiniApiServer:
             # land even on inadmissible stored objects
             if kind == "TPUJob" and self.admission and "spec" in patch:
                 merged = json.loads(json.dumps(obj))
-                for section, val in patch.items():
-                    if isinstance(val, dict) and isinstance(
-                        merged.get(section), dict
-                    ):
-                        merged[section].update(val)
-                    else:
-                        merged[section] = val
+                _merge_patch(merged, patch)
                 problem = self._tpujob_admission_problem(merged)
                 if problem is not None:
                     return self._reply(
                         h, 422, self._status(422, "Invalid", problem)
                     )
-            # strategic-merge-lite: dict values merge one level deep,
-            # everything else replaces (covers ownerReferences, status
-            # and podgroup spec resize)
-            for section, val in patch.items():
-                if isinstance(val, dict) and isinstance(obj.get(section), dict):
-                    obj[section].update(val)
-                else:
-                    obj[section] = val
+            _merge_patch(obj, patch)
             self.store.bump(kind, "MODIFIED", obj)
             if kind == "PodGroup":
                 # re-evaluate admission with the new size

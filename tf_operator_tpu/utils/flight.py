@@ -191,6 +191,11 @@ class FlightRecorder:
                 records[0]["reason"] = reason
             with self._lock:
                 self._dumps += 1
+                # the filename seq is THIS dump's increment: reading
+                # self._dumps outside the lock would let two concurrent
+                # dumps (two worker threads dying at once) compute the
+                # same path and overwrite one postmortem
+                seq = self._dumps
             if fileobj is not None:
                 for r in records:
                     fileobj.write(json.dumps(r) + "\n")
@@ -200,7 +205,7 @@ class FlightRecorder:
                 os.makedirs(d, exist_ok=True)
                 path = os.path.join(
                     d,
-                    f"flight-{os.getpid()}-{self._dumps}"
+                    f"flight-{os.getpid()}-{seq}"
                     f"{'-' + reason if reason else ''}.jsonl",
                 )
             with open(path, "w") as f:
@@ -220,7 +225,7 @@ class FlightRecorder:
 #: debug endpoints and the watchdog read this instance
 default_recorder = FlightRecorder()
 
-_installed = False
+_installed: Optional[FlightRecorder] = None
 _install_lock = threading.Lock()
 
 
@@ -235,22 +240,29 @@ def install(
     """Register the recorder process-wide: tracer + logger + metrics
     attach, SIGTERM chains a dump before the previous handler runs,
     and a fatal (uncaught) exception dumps from sys.excepthook.
-    Idempotent — a second install returns the already-wired default."""
+    Idempotent — a second install is a no-op that returns whichever
+    recorder was ACTUALLY wired first (never an unwired argument)."""
 
     global _installed
     rec = recorder if recorder is not None else default_recorder
     with _install_lock:
-        if _installed:
-            return rec
-        _installed = True
+        if _installed is not None:
+            return _installed
+        # wire UNDER the lock and publish only on success: a concurrent
+        # install() must never be handed a recorder whose attaches
+        # haven't run yet, and a wiring failure (e.g. signal.signal in
+        # a restricted environment) must leave the slot free instead of
+        # pinning a half-wired recorder forever
+        _wire(rec, tracer, metrics, logger, signals, excepthook)
+        _installed = rec
+    return rec
 
-    from tf_operator_tpu.utils.metrics import default_metrics
-    from tf_operator_tpu.utils.trace import default_tracer
 
-    rec.attach_tracer(tracer if tracer is not None else default_tracer)
-    rec.attach_logger(logger)
-    rec.attach_metrics(metrics if metrics is not None else default_metrics)
-
+def _wire(rec, tracer, metrics, logger, signals, excepthook) -> None:
+    # the FALLIBLE wiring (signal.signal can raise in restricted
+    # environments) runs FIRST so a failed install leaves nothing
+    # attached — a retry then cannot chain on_finish / ring handlers
+    # twice; the attaches at the bottom are plain assignments
     if signals and threading.current_thread() is threading.main_thread():
         prev_term = signal.getsignal(signal.SIGTERM)
 
@@ -291,4 +303,10 @@ def install(
             prev_thread_hook(args)
 
         threading.excepthook = on_thread_fatal
-    return rec
+
+    from tf_operator_tpu.utils.metrics import default_metrics
+    from tf_operator_tpu.utils.trace import default_tracer
+
+    rec.attach_tracer(tracer if tracer is not None else default_tracer)
+    rec.attach_logger(logger)
+    rec.attach_metrics(metrics if metrics is not None else default_metrics)

@@ -210,7 +210,24 @@ def maybe_start_from_env(metrics=None) -> Optional[Watchdog]:
         from tf_operator_tpu.utils.metrics import default_metrics
 
         default_watchdog._metrics = default_metrics
+    import math
+
     dl = os.environ.get("TPUJOB_WATCHDOG_DEADLINE")
     if dl:
-        default_watchdog.default_deadline = float(dl)
+        # a typo in an opt-in diagnostics knob must not take the
+        # binary down at boot, and nan/inf/<=0 would silently disarm
+        # the watchdog (or stall-storm every heartbeat) — warn and
+        # keep the default for anything but a finite positive float
+        try:
+            parsed = float(dl)
+        except ValueError:
+            parsed = None
+        if parsed is not None and math.isfinite(parsed) and parsed > 0:
+            default_watchdog.default_deadline = parsed
+        else:
+            default_watchdog._log.warning(
+                "ignoring malformed TPUJOB_WATCHDOG_DEADLINE=%r "
+                "(want seconds as a finite positive float); keeping %.0fs",
+                dl, default_watchdog.default_deadline,
+            )
     return default_watchdog.start()
