@@ -12,6 +12,9 @@ XLA program per shape) do the actual work.
     curl -s localhost:8600/metrics   # Prometheus text: requests by
                                      # status, latency histogram,
                                      # tokens generated, mode gauges
+    curl -s localhost:8600/slo       # JSON SLO quantile summaries
+    curl -s localhost:8600/alerts    # burn-rate/threshold alert state
+                                     # (utils/alerts.py, firing first)
 
 Serving modes: `--batching SLOTS` multiplexes concurrent requests
 through the continuous-batching pool (models/batching.py — one decode
@@ -126,6 +129,7 @@ def build_handler(
         SLO_BUCKETS,
         DispatchLedger,
         Metrics,
+        finite_summary,
     )
     from tf_operator_tpu.utils.trace import (
         TRACE_HEADER,
@@ -163,6 +167,17 @@ def build_handler(
     recorder = flight.default_recorder
     recorder.attach_tracer(tracer)
     recorder.attach_metrics(metrics)
+    #: SLO alert engine over THIS registry (utils/alerts.py): GET
+    #: /alerts serves the lifecycle state, and a pending→firing
+    #: transition dumps the flight recorder once per episode.  NOT
+    #: started here — tests build handlers by the dozen and an
+    #: evaluator thread per handler would leak; main() starts the one
+    #: that serves real traffic (exposed as ``Handler.alert_engine``).
+    from tf_operator_tpu.utils.alerts import AlertEngine, default_rules
+
+    alert_engine = AlertEngine(
+        default_rules(), metrics=metrics, recorder=recorder
+    )
 
     def observe_slo(mode: str, queue_wait: float, ttft: float,
                     tpot: float) -> None:
@@ -331,7 +346,7 @@ def build_handler(
                     "serve_request_seconds",
                 ):
                     fams[fam] = [
-                        {**dict(labels), **summary}
+                        {**dict(labels), **finite_summary(summary)}
                         for labels, summary in sorted(
                             metrics.histogram_family(fam).items()
                         )
@@ -351,6 +366,10 @@ def build_handler(
                         "serve_requests_total", status="200"
                     ),
                 })
+            if self.path == "/alerts":
+                # the serving plane's alert state: same read contract
+                # as the operator API's GET /alerts
+                return self._reply(200, alert_engine.snapshot())
             if self.path == "/debug/flightrecorder":
                 body = recorder.dump_text().encode()
                 self.send_response(200)
@@ -514,6 +533,9 @@ def build_handler(
                 span.set_error(repr(exc))  # tail sampling protects it
                 return self._reply(500, {"error": repr(exc)})
 
+    #: the engine this handler's /alerts serves — main() starts/stops
+    #: its evaluator; tests can drive evaluate_once() synthetically
+    Handler.alert_engine = alert_engine
     return Handler
 
 
@@ -633,17 +655,21 @@ def main() -> int:
             f"{before / 1e6:.1f} MB -> {tree_bytes(params) / 1e6:.1f} MB",
             flush=True,
         )
-    server = ThreadingHTTPServer(
-        ("127.0.0.1", args.port),
-        build_handler(
-            model, params, max_len,
-            batching_slots=args.batching, speculative=args.speculative,
-            prompt_cache=args.prompt_cache, model_label=model_label,
-            metrics=serve_metrics,
-        ),
+    handler = build_handler(
+        model, params, max_len,
+        batching_slots=args.batching, speculative=args.speculative,
+        prompt_cache=args.prompt_cache, model_label=model_label,
+        metrics=serve_metrics,
     )
+    server = ThreadingHTTPServer(("127.0.0.1", args.port), handler)
+    # the serving binary boots the SLO evaluator (build_handler only
+    # constructs it — see the leak note there)
+    handler.alert_engine.start()
     print(f"serving on 127.0.0.1:{args.port} (artifact: {args.artifact})", flush=True)
-    server.serve_forever()
+    try:
+        server.serve_forever()
+    finally:
+        handler.alert_engine.stop()
     return 0
 
 

@@ -1,0 +1,164 @@
+"""Lint gate: the default alert rule set can never silently orphan
+(ISSUE 6 satellite, sibling of test_lint_no_hot_sync.py).
+
+An alert rule references metric families by NAME; renaming a metric in
+code would leave the rule evaluating a family nobody writes — it would
+simply never fire again, which is the worst possible failure mode for
+an alerting layer.  This gate walks the package (and examples/) AST
+collecting every literal metric-family name and its label keys from
+``inc`` / ``set`` / ``observe`` / ``observe_histogram`` /
+``set_buckets`` call sites, then asserts every default rule references
+an emitted family with valid label keys, ordered finite windows, and —
+for burn rules — an objective that is an exact bucket bound (so the
+conservative straddling-bucket accounting never applies to stock
+rules).
+"""
+
+import ast
+import pathlib
+
+import tf_operator_tpu
+from tf_operator_tpu.utils.alerts import (
+    BurnRateRule,
+    ThresholdRule,
+    default_rules,
+    validate_rule,
+)
+from tf_operator_tpu.utils.metrics import DEFAULT_BUCKETS, SLO_BUCKETS
+
+PKG_ROOT = pathlib.Path(tf_operator_tpu.__file__).parent
+EXAMPLES = PKG_ROOT.parent / "examples"
+
+#: metrics-registry write methods whose first positional arg is the
+#: family name and whose keyword args (minus these control kwargs) are
+#: label keys
+_WRITERS = {"inc", "set", "observe", "observe_histogram", "set_buckets"}
+_CONTROL_KWARGS = {"exemplar", "buckets"}
+
+#: families built with f-strings from ledger prefixes
+#: (utils/metrics.DispatchLedger / StepSyncLedger) — not collectable as
+#: literals; _assert_prefixes_still_exist pins the prefixes against the
+#: source so this table cannot go stale silently
+_LEDGER_FAMILIES = {
+    "serving_dispatch_total": {"phase"},
+    "serving_dispatch_seconds": {"phase"},
+    "train_sync_total": {"phase"},
+    "train_sync_seconds": {"phase"},
+    "train_sync_blocked_total": {"phase"},
+}
+
+
+def _assert_prefixes_still_exist():
+    src = (PKG_ROOT / "utils" / "metrics.py").read_text()
+    for prefix in ("serving_dispatch", "train_sync"):
+        assert f'"{prefix}"' in src, (
+            f"ledger prefix {prefix!r} gone from utils/metrics.py — "
+            "update _LEDGER_FAMILIES in this lint"
+        )
+
+
+def collect_emitted_families():
+    """{family: set(label keys)} for every literal registry write in
+    the package + examples."""
+
+    families = {k: set(v) for k, v in _LEDGER_FAMILIES.items()}
+    paths = list(PKG_ROOT.rglob("*.py")) + list(EXAMPLES.glob("*.py"))
+    for path in paths:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _WRITERS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            name = node.args[0].value
+            keys = {
+                kw.arg
+                for kw in node.keywords
+                if kw.arg is not None and kw.arg not in _CONTROL_KWARGS
+            }
+            families.setdefault(name, set()).update(keys)
+    return families
+
+
+def test_default_rules_reference_live_metrics():
+    _assert_prefixes_still_exist()
+    families = collect_emitted_families()
+    problems = []
+    for rule in default_rules():
+        validate_rule(rule)  # shape: windows ordered, thresholds finite
+        if rule.metric not in families:
+            problems.append(
+                f"rule {rule.name!r} references {rule.metric!r} which no "
+                "code emits"
+            )
+            continue
+        unknown = set(rule.labels) - families[rule.metric]
+        if unknown:
+            problems.append(
+                f"rule {rule.name!r} filters on label keys {sorted(unknown)} "
+                f"never attached to {rule.metric!r} "
+                f"(emitted keys: {sorted(families[rule.metric])})"
+            )
+    assert not problems, "orphaned alert rules:\n  " + "\n  ".join(problems)
+
+
+def test_burn_objectives_are_exact_bucket_bounds():
+    """objective_le must be a bound of the bucket set its family uses,
+    or the straddling bucket silently counts as bad (conservative but
+    surprising).  Stock families use SLO_BUCKETS or DEFAULT_BUCKETS."""
+
+    bounds = set(SLO_BUCKETS) | set(DEFAULT_BUCKETS)
+    for rule in default_rules():
+        if isinstance(rule, BurnRateRule):
+            assert rule.objective_le in bounds, (
+                f"rule {rule.name!r}: objective_le={rule.objective_le} is "
+                "not an exact bucket bound"
+            )
+
+
+def test_default_rule_names_unique_and_windows_parameterized():
+    rules = default_rules(short=7.0, long=11.0)
+    names = [r.name for r in rules]
+    assert len(names) == len(set(names))
+    for r in rules:
+        if isinstance(r, BurnRateRule):
+            assert r.windows == (7.0, 11.0)
+        elif r.kind == "counter_increase":
+            # window is a counter_increase concept only: gauge kinds
+            # evaluate instantaneous snapshots (see ThresholdRule)
+            assert r.window in (7.0, 11.0)
+
+
+def test_collector_sees_known_call_sites():
+    """The AST collector itself works: families written across the
+    stack are found with their label keys."""
+
+    families = collect_emitted_families()
+    # watchdog (utils/watchdog.py)
+    assert "heartbeat" in families["watchdog_stall_total"]
+    # operator API (server/api.py)
+    assert {"method", "route"} <= families["api_request_seconds"]
+    # serving plane (examples/serve_lm.py + models/batching.py)
+    assert {"route", "model"} <= families["serve_request_seconds"]
+    assert "serve_admission_queue_depth" in families
+    # retry clients (backend/retry.py)
+    assert "client" in families["api_client_circuit_open_total"]
+    # checkpointer durability stamp (parallel/checkpoint.py)
+    assert "checkpoint_last_success_unix" in families
+
+
+def test_lint_catches_a_renamed_metric():
+    """Planted orphan: a rule naming a family nobody emits must be
+    reported (the gate's own regression test)."""
+
+    families = collect_emitted_families()
+    ghost = ThresholdRule(
+        "ghost", "metric_that_was_renamed_total", kind="counter_increase"
+    )
+    validate_rule(ghost)
+    assert ghost.metric not in families

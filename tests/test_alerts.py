@@ -1,0 +1,778 @@
+"""SLO alert engine (ISSUE 6 tentpole): burn-rate math, threshold
+kinds, the alert lifecycle state machine, the once-per-episode flight
+dump, the controller health rollup into TPUJob.status, and the
+/alerts + /slo read surfaces on the operator API."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tests.testutil import harness, new_job
+from tf_operator_tpu.api.serde import job_from_dict, job_to_dict
+from tf_operator_tpu.api.types import JobConditionType, PodPhase
+from tf_operator_tpu.utils.alerts import (
+    AlertEngine,
+    BurnRateRule,
+    ThresholdRule,
+    default_rules,
+    validate_rule,
+)
+from tf_operator_tpu.utils.flight import FlightRecorder
+from tf_operator_tpu.utils.metrics import SLO_BUCKETS, Metrics
+
+T0 = 1_700_000_000.0  # synthetic unix clock base
+
+
+def burn_rule(**kw):
+    kw.setdefault("name", "burn")
+    kw.setdefault("family", "lat_seconds")
+    kw.setdefault("objective_le", 0.05)
+    kw.setdefault("objective_ratio", 0.9)
+    kw.setdefault("windows", (2.0, 8.0))
+    kw.setdefault("burn_threshold", 3.0)
+    return BurnRateRule(**kw)
+
+
+class TestRuleValidation:
+    def test_default_rules_validate(self):
+        for r in default_rules():
+            validate_rule(r)
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            dict(objective_ratio=1.0),
+            dict(objective_ratio=0.0),
+            dict(objective_le=float("inf")),
+            dict(windows=(8.0, 2.0)),  # unordered
+            dict(windows=(2.0, float("inf"))),
+            dict(burn_threshold=0.0),
+            dict(burn_threshold=float("nan")),
+            dict(for_seconds=-1.0),
+        ],
+    )
+    def test_bad_burn_rules_rejected(self, bad):
+        with pytest.raises(ValueError):
+            validate_rule(burn_rule(**bad))
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            dict(kind="nope"),
+            dict(threshold=float("nan")),
+            dict(window=0.0),
+            dict(metric=""),
+        ],
+    )
+    def test_bad_threshold_rules_rejected(self, bad):
+        kw = dict(name="t", metric="x_total")
+        kw.update(bad)
+        with pytest.raises(ValueError):
+            validate_rule(ThresholdRule(**kw))
+
+    def test_duplicate_rule_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            AlertEngine([burn_rule(), burn_rule()], metrics=Metrics())
+
+
+class TestBurnRateLifecycle:
+    def _engine(self, m, **rule_kw):
+        return AlertEngine(
+            [burn_rule(**rule_kw)], metrics=m, recorder=FlightRecorder()
+        )
+
+    def test_good_traffic_never_breaches(self):
+        m = Metrics()
+        eng = self._engine(m)
+        for i in range(20):
+            m.observe_histogram("lat_seconds", 0.01)
+            eng.evaluate_once(T0 + i)
+        (a,) = eng.alerts()
+        assert a.state == "inactive" and a.episodes == 0
+
+    def test_full_lifecycle_pending_firing_resolved_inactive(self):
+        m = Metrics()
+        eng = self._engine(m, for_seconds=2.0)
+        eng.resolved_hold = 60.0
+        # warm up: enough good history to cover both windows
+        t = T0
+        for i in range(10):
+            m.observe_histogram("lat_seconds", 0.01)
+            eng.evaluate_once(t + i)
+        (a,) = eng.alerts()
+        assert a.state == "inactive"
+        # violate: every observation over the objective
+        t = T0 + 10
+        for _ in range(20):
+            m.observe_histogram("lat_seconds", 1.0)
+        eng.evaluate_once(t)
+        assert a.state == "pending"  # breach seen, for_seconds dwell
+        for _ in range(20):
+            m.observe_histogram("lat_seconds", 1.0)
+        eng.evaluate_once(t + 1)
+        assert a.state == "pending"
+        for _ in range(20):
+            m.observe_histogram("lat_seconds", 1.0)
+        eng.evaluate_once(t + 2.5)  # dwell elapsed
+        assert a.state == "firing" and a.episodes == 1
+        assert m.counter("alerts_fired_total", rule="burn") == 1.0
+        assert m.gauge("alert_state", rule="burn") == 2.0
+        # recover: good traffic until the bad samples age out of both
+        # windows
+        t = T0 + 13
+        for i in range(12):
+            for _ in range(100):
+                m.observe_histogram("lat_seconds", 0.01)
+            eng.evaluate_once(t + i)
+        assert a.state == "resolved"
+        assert m.counter("alerts_resolved_total", rule="burn") == 1.0
+        # resolved decays to inactive after resolved_hold
+        eng.evaluate_once(t + 12 + 61.0)
+        assert a.state == "inactive"
+
+    def test_no_traffic_is_not_a_breach(self):
+        m = Metrics()
+        eng = self._engine(m)
+        for i in range(20):
+            eng.evaluate_once(T0 + i)
+        (a,) = eng.alerts()
+        assert a.state == "inactive"
+
+    def test_short_burst_does_not_fire_long_window(self):
+        """Multi-window: a burst breaching only the short window (long
+        window still dominated by good traffic) must not fire."""
+
+        m = Metrics()
+        eng = self._engine(m, windows=(1.0, 16.0))
+        t = T0
+        for i in range(16):
+            for _ in range(100):
+                m.observe_histogram("lat_seconds", 0.01)
+            eng.evaluate_once(t + i)
+        # a 1-evaluation burst of 20 bad vs 1500 good in the long window
+        for _ in range(20):
+            m.observe_histogram("lat_seconds", 1.0)
+        eng.evaluate_once(t + 16)
+        (a,) = eng.alerts()
+        assert a.state == "inactive", a.value
+
+    def test_cold_start_coverage_guard(self):
+        """All-bad traffic from the first sample: no firing until at
+        least half of the LONG window has observed history."""
+
+        m = Metrics()
+        eng = self._engine(m, windows=(2.0, 8.0), for_seconds=0.0)
+        for t in (T0, T0 + 1.0):  # long window only 12% covered
+            for _ in range(50):
+                m.observe_histogram("lat_seconds", 1.0)
+            eng.evaluate_once(t)
+        (a,) = eng.alerts()
+        assert a.state == "inactive"
+        for _ in range(50):
+            m.observe_histogram("lat_seconds", 1.0)
+        eng.evaluate_once(T0 + 5.0)  # > half of 8s covered
+        assert a.state in ("pending", "firing")
+
+    def test_label_filter_scopes_the_family(self):
+        m = Metrics()
+        eng = AlertEngine(
+            [burn_rule(labels={"route": "/generate"})],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        t = T0
+        for i in range(10):
+            # the violating traffic is on ANOTHER route
+            m.observe_histogram("lat_seconds", 5.0, route="/other")
+            m.observe_histogram("lat_seconds", 0.01, route="/generate")
+            eng.evaluate_once(t + i)
+        (a,) = eng.alerts()
+        assert a.state == "inactive"
+
+
+class TestThresholdRules:
+    def test_counter_increase_fires_and_resolves(self):
+        m = Metrics()
+        eng = AlertEngine(
+            [ThresholdRule("stalls", "watchdog_stall_total",
+                           kind="counter_increase", threshold=0.0,
+                           window=10.0)],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        eng.evaluate_once(T0)
+        eng.evaluate_once(T0 + 1)
+        (a,) = eng.alerts()
+        assert a.state == "inactive"
+        m.inc("watchdog_stall_total", heartbeat="train.x")
+        eng.evaluate_once(T0 + 2)
+        assert a.state == "firing" and a.value["increase"] == 1.0
+        # the increase ages out of the window -> resolved
+        eng.evaluate_once(T0 + 15)
+        eng.evaluate_once(T0 + 16)
+        assert a.state == "resolved"
+
+    def test_gauge_level_rule(self):
+        m = Metrics()
+        eng = AlertEngine(
+            [ThresholdRule("depth", "serve_admission_queue_depth",
+                           kind="gauge", threshold=8.0)],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        m.set("serve_admission_queue_depth", 3.0, model="m")
+        eng.evaluate_once(T0)
+        (a,) = eng.alerts()
+        assert a.state == "inactive"
+        m.set("serve_admission_queue_depth", 20.0, model="m")
+        eng.evaluate_once(T0 + 1)
+        assert a.state == "firing" and a.value["level"] == 20.0
+        m.set("serve_admission_queue_depth", 0.0, model="m")
+        eng.evaluate_once(T0 + 2)
+        assert a.state == "resolved"
+
+    def test_gauge_age_rule_skips_unset_gauge(self):
+        m = Metrics()
+        eng = AlertEngine(
+            [ThresholdRule("ckpt", "checkpoint_last_success_unix",
+                           kind="gauge_age", threshold=60.0)],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        eng.evaluate_once(T0)  # gauge never set: not a breach
+        (a,) = eng.alerts()
+        assert a.state == "inactive"
+        m.set("checkpoint_last_success_unix", T0 - 300.0)
+        eng.evaluate_once(T0 + 1)
+        assert a.state == "firing" and a.value["age"] > 60.0
+        m.set("checkpoint_last_success_unix", T0 + 1)
+        eng.evaluate_once(T0 + 2)
+        assert a.state == "resolved"
+
+
+class TestFiringSideEffects:
+    def _firing_engine(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUJOB_FLIGHT_DIR", str(tmp_path))
+        m = Metrics()
+        rec = FlightRecorder()
+        rec.attach_metrics(m)
+        eng = AlertEngine(
+            [ThresholdRule("stalls", "watchdog_stall_total",
+                           kind="counter_increase", threshold=0.0,
+                           window=30.0)],
+            metrics=m, recorder=rec,
+        )
+        eng.evaluate_once(T0)
+        m.inc("watchdog_stall_total", heartbeat="x")
+        eng.evaluate_once(T0 + 1)
+        return m, eng
+
+    def test_flight_recorder_dumped_once_per_episode(self, tmp_path, monkeypatch):
+        m, eng = self._firing_engine(tmp_path, monkeypatch)
+        (a,) = eng.alerts()
+        assert a.state == "firing"
+        assert len(eng.dumps) == 1
+        # the dump names the alert and carries the firing log record
+        records = [
+            json.loads(line)
+            for line in open(eng.dumps[0]).read().splitlines()
+        ]
+        assert records[0]["reason"] == "alert-stalls"
+        logs = [r for r in records if r["type"] == "log"]
+        assert any("alert stalls firing" in r["message"] for r in logs)
+        # still firing on later sweeps: no second dump this episode
+        m.inc("watchdog_stall_total", heartbeat="x")
+        eng.evaluate_once(T0 + 2)
+        assert a.state == "firing" and len(eng.dumps) == 1
+
+    def test_quiet_rules_still_export_alert_state(self):
+        """alert_state{rule=} series must exist after one sweep even
+        when nothing ever breaches — scrape-side absent() checks need
+        to tell 'engine evaluating, all quiet' from 'never started'."""
+
+        m = Metrics()
+        eng = AlertEngine(
+            [ThresholdRule("stalls", "watchdog_stall_total",
+                           kind="counter_increase", threshold=0.0,
+                           window=30.0)],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        eng.evaluate_once(T0)
+        assert m.gauge("alert_state", rule="stalls") == 0.0
+        assert (("rule", "stalls"),) in m.gauge_series("alert_state")
+
+    def test_pending_flap_back_to_inactive_clears_message(self):
+        """pending -> inactive must drop the breach message: /alerts
+        serving an inactive rule with an active-sounding message
+        misleads pollers that read message rather than state."""
+
+        m = Metrics()
+        eng = AlertEngine(
+            [ThresholdRule("stalls", "watchdog_stall_total",
+                           kind="counter_increase", threshold=0.0,
+                           window=5.0, for_seconds=10.0)],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        eng.evaluate_once(T0)
+        m.inc("watchdog_stall_total")
+        eng.evaluate_once(T0 + 1)
+        (a,) = eng.alerts()
+        assert a.state == "pending" and a.message
+        eng.evaluate_once(T0 + 8)  # increase ages out before the dwell
+        assert a.state == "inactive" and a.message == ""
+
+    def test_flap_reentry_from_resolved_is_same_episode(
+        self, tmp_path, monkeypatch
+    ):
+        """A breach returning while the alert sits in resolved_hold
+        re-enters firing WITHOUT a new episode: no second recorder
+        dump, no alerts_fired_total increment — a signal oscillating
+        around its threshold must not dump the black box (and mint a
+        Warning episode) every other evaluation tick."""
+
+        m, eng = self._firing_engine(tmp_path, monkeypatch)
+        (a,) = eng.alerts()
+        assert a.state == "firing" and a.episodes == 1
+        # increase ages out of the 30s window -> resolved
+        eng.evaluate_once(T0 + 35)
+        assert a.state == "resolved"
+        # breach returns inside resolved_hold -> firing, SAME episode
+        m.inc("watchdog_stall_total", heartbeat="x")
+        eng.evaluate_once(T0 + 36)
+        assert a.state == "firing"
+        assert a.episodes == 1
+        assert len(eng.dumps) == 1
+        assert m.counter("alerts_fired_total", rule="stalls") == 1.0
+
+    def test_subscriber_sees_every_transition(self, tmp_path, monkeypatch):
+        seen = []
+        m = Metrics()
+        eng = AlertEngine(
+            [ThresholdRule("stalls", "watchdog_stall_total",
+                           kind="counter_increase", threshold=0.0,
+                           window=5.0)],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        eng.subscribe(lambda a, old, new: seen.append((old, new)))
+        eng.evaluate_once(T0)
+        m.inc("watchdog_stall_total")
+        eng.evaluate_once(T0 + 1)
+        eng.evaluate_once(T0 + 10)
+        # for_seconds=0 collapses inactive->pending->firing into one
+        # sweep; subscribers see one callback per sweep with the final
+        # state
+        assert seen == [("inactive", "firing"), ("firing", "resolved")]
+
+    def test_unsubscribe_detaches_callback(self):
+        """Consumers sharing a long-lived engine (the process-global
+        default) must be able to detach on shutdown — subscribe with
+        no removal would pin them alive forever."""
+
+        seen = []
+        m = Metrics()
+        eng = AlertEngine(
+            [ThresholdRule("stalls", "watchdog_stall_total",
+                           kind="counter_increase", threshold=0.0,
+                           window=5.0)],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        cb = lambda a, old, new: seen.append((old, new))  # noqa: E731
+        eng.subscribe(cb)
+        eng.unsubscribe(cb)
+        eng.unsubscribe(cb)  # idempotent on an absent callback
+        eng.evaluate_once(T0)
+        m.inc("watchdog_stall_total")
+        eng.evaluate_once(T0 + 1)
+        assert seen == []
+
+    def test_evaluator_thread_starts_and_stops(self):
+        eng = AlertEngine(
+            [ThresholdRule("t", "x_total", kind="counter_increase",
+                           window=5.0)],
+            metrics=Metrics(), recorder=FlightRecorder(), interval=0.01,
+        )
+        eng.start()
+        assert eng.running
+        deadline = time.time() + 2.0
+        while (
+            eng.metrics.counter("alert_evaluations_total") < 2
+            and time.time() < deadline
+        ):
+            time.sleep(0.01)
+        eng.stop()
+        assert not eng.running
+        assert eng.metrics.counter("alert_evaluations_total") >= 2
+
+
+class TestHealthRollup:
+    def _running_job(self, alerts, m):
+        from tf_operator_tpu.backend.fake import FakeCluster
+        from tf_operator_tpu.backend.jobstore import JobStore
+        from tf_operator_tpu.controller.controller import TPUJobController
+
+        store = JobStore()
+        backend = FakeCluster(delivery="sync")
+        c = TPUJobController(store, backend, metrics=m, alerts=alerts)
+        job = new_job(name="hj", worker=1)
+        store.create(job)
+        c.sync_until_quiet()
+        backend.set_pod_phase("default", "hj-worker-0", PodPhase.RUNNING)
+        c.sync_until_quiet()
+        return store, backend, c
+
+    def test_degraded_condition_and_health_block_roundtrip(self):
+        m = Metrics()
+        eng = AlertEngine(
+            [ThresholdRule("stalls", "watchdog_stall_total",
+                           kind="counter_increase", threshold=0.0,
+                           window=60.0)],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        store, backend, c = self._running_job(eng, m)
+        job = store.get("default", "hj")
+        assert job.status.observed_health["firingAlerts"] == []
+        assert not job.status.has_condition(JobConditionType.DEGRADED)
+
+        t = time.time()
+        eng.evaluate_once(t)
+        m.inc("watchdog_stall_total", heartbeat="train.x")
+        eng.evaluate_once(t + 1)
+        c.sync_until_quiet()
+        job = store.get("default", "hj")
+        assert job.status.has_condition(JobConditionType.DEGRADED)
+        deg = job.status.condition(JobConditionType.DEGRADED)
+        assert deg.reason == "HealthDegraded" and "stalls" in deg.message
+        health = job.status.observed_health
+        assert health["firingAlerts"] == ["stalls"]
+        assert health["stallCount"] == 1
+        # still Running: Degraded is health, not phase
+        assert job.status.has_condition(JobConditionType.RUNNING)
+        events = [
+            (e.type, e.reason) for e in c.recorder.for_object("default/hj")
+        ]
+        assert ("Warning", "HealthDegraded") in events
+        # one Warning per episode, not per sync
+        c.reconciler.config.health_refresh_seconds = 0.0
+        c.sync_until_quiet()
+        events = [
+            (e.type, e.reason) for e in c.recorder.for_object("default/hj")
+        ]
+        assert events.count(("Warning", "HealthDegraded")) == 1
+
+        # the wire shape round-trips (kube-backed stores serialize it)
+        j2 = job_from_dict(job_to_dict(job))
+        assert j2.status.observed_health == job.status.observed_health
+        assert j2.status.has_condition(JobConditionType.DEGRADED)
+
+        # resolve: condition clears + Normal event
+        eng.evaluate_once(t + 70)
+        eng.evaluate_once(t + 71)
+        c.sync_until_quiet()
+        job = store.get("default", "hj")
+        assert not job.status.has_condition(JobConditionType.DEGRADED)
+        events = [
+            (e.type, e.reason) for e in c.recorder.for_object("default/hj")
+        ]
+        assert ("Normal", "SLORecovered") in events
+
+    def test_slo_violation_reason_for_burn_rules(self):
+        m = Metrics()
+        eng = AlertEngine(
+            [burn_rule(windows=(1.0, 4.0))],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        store, backend, c = self._running_job(eng, m)
+        t = time.time()
+        for i in range(6):
+            for _ in range(30):
+                m.observe_histogram("lat_seconds", 1.0)
+            eng.evaluate_once(t + i)
+        assert [a.rule.name for a in eng.firing()] == ["burn"]
+        c.sync_until_quiet()
+        job = store.get("default", "hj")
+        deg = job.status.condition(JobConditionType.DEGRADED)
+        assert deg is not None and deg.status
+        assert deg.reason == "SLOViolation"
+
+    def test_rollup_throttle_prevents_status_churn(self):
+        m = Metrics()
+        eng = AlertEngine([], metrics=m, recorder=FlightRecorder())
+        store, backend, c = self._running_job(eng, m)
+        job = store.get("default", "hj")
+        stamp = job.status.observed_health["updatedAt"]
+        # immediate re-syncs inside the refresh window must not touch
+        # the block (each touch would be a status write + watch event)
+        c.sync_until_quiet()
+        c.sync_until_quiet()
+        job = store.get("default", "hj")
+        assert job.status.observed_health["updatedAt"] == stamp
+
+    def test_stale_summary_series_reports_no_throughput(self, tmp_path):
+        """throughputStepsPerSec is LIVE health: a trainer that hung
+        hours ago still has a healthy-looking last-20 summary window,
+        and the rollup must not report that historical rate under a
+        fresh updatedAt."""
+
+        import json as _json
+
+        from tf_operator_tpu.utils.summaries import ANNOTATION_SUMMARY_DIR
+
+        m = Metrics()
+        eng = AlertEngine([], metrics=m, recorder=FlightRecorder())
+        store, backend, c = self._running_job(eng, m)
+        job = store.get("default", "hj")
+        job.metadata.annotations[ANNOTATION_SUMMARY_DIR] = str(tmp_path)
+
+        def write_series(t_last):
+            with open(tmp_path / "metrics-0.jsonl", "w") as f:
+                for i in range(5):
+                    f.write(_json.dumps(
+                        {"step": i * 10, "time": t_last - (4 - i) * 2.0}
+                    ) + "\n")
+
+        # wedged: newest record far beyond the staleness bound
+        write_series(time.time() - 7200)
+        assert c.reconciler._recent_throughput(job) is None
+        # live: same shape, recent tail -> 10 steps / 2s
+        write_series(time.time())
+        assert c.reconciler._recent_throughput(job) == 5.0
+
+    def test_failed_job_does_not_retain_degraded(self):
+        """A job that fails WHILE alerts are firing must end Failed
+        with Degraded cleared — the same-sync rollup must not re-mark
+        a terminal job (it would stay Degraded forever)."""
+
+        from tf_operator_tpu.api.types import RestartPolicy
+        from tf_operator_tpu.backend.fake import FakeCluster
+        from tf_operator_tpu.backend.jobstore import JobStore
+        from tf_operator_tpu.controller.controller import TPUJobController
+
+        m = Metrics()
+        eng = AlertEngine(
+            [ThresholdRule("stalls", "watchdog_stall_total",
+                           kind="counter_increase", threshold=0.0,
+                           window=600.0)],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        store = JobStore()
+        backend = FakeCluster(delivery="sync")
+        c = TPUJobController(store, backend, metrics=m, alerts=eng)
+        job = new_job(name="fj", worker=1,
+                      restart_policy=RestartPolicy.NEVER)
+        store.create(job)
+        c.sync_until_quiet()
+        backend.set_pod_phase("default", "fj-worker-0", PodPhase.RUNNING)
+        c.sync_until_quiet()
+        t = time.time()
+        eng.evaluate_once(t)
+        m.inc("watchdog_stall_total")
+        eng.evaluate_once(t + 1)
+        c.sync_until_quiet()
+        assert store.get("default", "fj").status.has_condition(
+            JobConditionType.DEGRADED
+        )
+        # fail while the alert is STILL firing
+        backend.set_pod_phase(
+            "default", "fj-worker-0", PodPhase.FAILED, exit_code=1
+        )
+        c.sync_until_quiet()
+        job = store.get("default", "fj")
+        assert job.status.has_condition(JobConditionType.FAILED)
+        assert not job.status.has_condition(JobConditionType.DEGRADED)
+        # the observedHealth block is LIVE health and goes with it — a
+        # terminal job must not keep reporting its last firing alerts
+        # (describe would print them as current forever)
+        assert job.status.observed_health == {}
+
+    def test_degraded_message_tracks_growing_firing_set(self):
+        """A second rule joining the episode (same reason) must update
+        the condition MESSAGE without a second Warning event."""
+
+        m = Metrics()
+        eng = AlertEngine(
+            [
+                ThresholdRule("stalls", "watchdog_stall_total",
+                              kind="counter_increase", threshold=0.0,
+                              window=600.0),
+                ThresholdRule("circuit", "api_client_circuit_open_total",
+                              kind="counter_increase", threshold=0.0,
+                              window=600.0),
+            ],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        store, backend, c = self._running_job(eng, m)
+        t = time.time()
+        eng.evaluate_once(t)
+        m.inc("watchdog_stall_total")
+        eng.evaluate_once(t + 1)
+        c.sync_until_quiet()
+        deg = store.get("default", "hj").status.condition(
+            JobConditionType.DEGRADED
+        )
+        assert "stalls" in deg.message and "circuit" not in deg.message
+        transition_stamp = deg.last_transition_time
+        m.inc("api_client_circuit_open_total", client="x")
+        eng.evaluate_once(t + 2)
+        c.sync_until_quiet()
+        deg = store.get("default", "hj").status.condition(
+            JobConditionType.DEGRADED
+        )
+        assert deg.status and "circuit" in deg.message
+        # k8s convention: lastTransitionTime moves on status/reason
+        # flips only — "degraded since X" must survive a rule joining
+        # the same episode (message-only update)
+        assert deg.last_transition_time == transition_stamp
+        assert deg.last_update_time >= transition_stamp
+        events = [
+            (e.type, e.reason) for e in c.recorder.for_object("default/hj")
+        ]
+        assert events.count(("Warning", "HealthDegraded")) == 1
+
+    def test_invalid_spec_clears_degraded(self):
+        """The InvalidSpec terminal path must clear Degraded like the
+        other terminal paths — an invalid job never syncs again, so a
+        live-health condition left True would be pinned forever."""
+
+        m = Metrics()
+        eng = AlertEngine(
+            [ThresholdRule("stalls", "watchdog_stall_total",
+                           kind="counter_increase", threshold=0.0,
+                           window=600.0)],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        store, backend, c = self._running_job(eng, m)
+        t = time.time()
+        eng.evaluate_once(t)
+        m.inc("watchdog_stall_total")
+        eng.evaluate_once(t + 1)
+        c.sync_until_quiet()
+        assert store.get("default", "hj").status.has_condition(
+            JobConditionType.DEGRADED
+        )
+        # an out-of-band write corrupts the spec: the informer ingests
+        # an invalid skeleton that PRESERVES the old status (and with
+        # it the Degraded condition)
+        with c.cache._lock:
+            c.cache.jobs["default/hj"].invalid_reason = "corrupted spec"
+        c._enqueue("default/hj")
+        c.sync_until_quiet()
+        job = store.get("default", "hj")
+        failed = job.status.condition(JobConditionType.FAILED)
+        assert failed is not None and failed.reason == "InvalidSpec"
+        assert not job.status.has_condition(JobConditionType.DEGRADED)
+
+    def test_alert_transition_reenqueue_scoped_to_firing(self):
+        """Only transitions entering/leaving ``firing`` can change the
+        rollup (it reads firing()); pending flaps and resolved decay
+        must not trigger full-cache sweeps.  stop() detaches the
+        controller's subscriber from the (shared) engine."""
+
+        m = Metrics()
+        eng = AlertEngine([], metrics=m, recorder=FlightRecorder())
+        store, backend, c = self._running_job(eng, m)
+        alert = type("A", (), {})()  # the handler ignores the alert arg
+        for old, new in (
+            ("inactive", "pending"), ("pending", "inactive"),
+            ("resolved", "inactive"),
+        ):
+            c._on_alert_transition(alert, old, new)
+        assert len(c.queue) == 0
+        c._on_alert_transition(alert, "pending", "firing")
+        assert len(c.queue) == 1
+        c.stop()
+        assert c._on_alert_transition not in eng._callbacks
+
+    def test_terminal_job_clears_degraded(self):
+        m = Metrics()
+        eng = AlertEngine(
+            [ThresholdRule("stalls", "watchdog_stall_total",
+                           kind="counter_increase", threshold=0.0,
+                           window=600.0)],
+            metrics=m, recorder=FlightRecorder(),
+        )
+        store, backend, c = self._running_job(eng, m)
+        t = time.time()
+        eng.evaluate_once(t)
+        m.inc("watchdog_stall_total")
+        eng.evaluate_once(t + 1)
+        c.sync_until_quiet()
+        assert store.get("default", "hj").status.has_condition(
+            JobConditionType.DEGRADED
+        )
+        backend.set_pod_phase(
+            "default", "hj-worker-0", PodPhase.SUCCEEDED, exit_code=0
+        )
+        c.sync_until_quiet()
+        job = store.get("default", "hj")
+        assert job.status.has_condition(JobConditionType.SUCCEEDED)
+        assert not job.status.has_condition(JobConditionType.DEGRADED)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+    try:
+        return json.loads(body)
+    except ValueError:
+        return body
+
+
+class TestApiSurfaces:
+    @pytest.fixture
+    def api(self):
+        from tf_operator_tpu.server.api import ApiServer
+
+        store, backend, controller = harness()
+        engine = AlertEngine(
+            default_rules(), metrics=controller.metrics,
+            recorder=FlightRecorder(),
+        )
+        server = ApiServer(
+            store, backend, controller.metrics, controller.recorder,
+            alerts=engine,
+        )
+        server.start()
+        yield controller, engine, f"http://127.0.0.1:{server.port}"
+        server.stop()
+
+    def test_alerts_endpoint_serves_engine_state(self, api):
+        controller, engine, base = api
+        snap = _get(f"{base}/alerts")
+        assert snap["firing"] == []
+        names = {a["name"] for a in snap["alerts"]}
+        assert "watchdog-stall" in names
+        for a in snap["alerts"]:
+            assert a["state"] == "inactive"
+        # fire one and re-read: firing sorts first
+        t = time.time()
+        engine.evaluate_once(t)
+        controller.metrics.inc("watchdog_stall_total", heartbeat="x")
+        engine.evaluate_once(t + 1)
+        snap = _get(f"{base}/alerts")
+        assert snap["firing"] == ["watchdog-stall"]
+        assert snap["alerts"][0]["name"] == "watchdog-stall"
+        assert snap["alerts"][0]["state"] == "firing"
+
+    def test_slo_endpoint_matches_serving_contract(self, api):
+        controller, engine, base = api
+        _get(f"{base}/healthz")  # generates an api_request_seconds sample
+        slo = _get(f"{base}/slo")
+        assert set(slo["histograms"]) == {
+            "api_request_seconds",
+            "tpujob_sync_duration_seconds",
+            "workqueue_queue_latency_seconds",
+        }
+        rows = slo["histograms"]["api_request_seconds"]
+        assert rows, "healthz request not observed"
+        row = next(r for r in rows if r.get("route") == "healthz")
+        assert row["method"] == "GET" and row["count"] >= 1
+        assert "p99_le" in row and "p50_le" in row
+        assert "workqueue_depth" in slo["gauges"]
+
+    def test_kubesim_serves_alerts_route(self):
+        from tf_operator_tpu.backend.kubesim import MiniApiServer
+
+        sim = MiniApiServer().start()
+        try:
+            snap = _get(f"{sim.url}/alerts")
+            assert "alerts" in snap and "firing" in snap
+        finally:
+            sim.stop()

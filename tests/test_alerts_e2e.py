@@ -1,0 +1,211 @@
+"""Chaos e2e for the alert→status vertical (ISSUE 6 acceptance):
+
+A fault-injected serving run (the PR-1 injector on the kubesim
+apiserver adds real latency to real HTTP requests) drives a burn-rate
+alert through its full lifecycle:
+
+    pending -> firing -> Degraded condition + Warning event on the
+    TPUJob + one flight-recorder dump -> faults cleared -> alert
+    resolves -> condition clears + Normal event
+
+plus the clean-soak half: the same run without faults fires ZERO
+alerts — a false-positive-free baseline is part of the contract.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tests.testutil import new_job
+from tf_operator_tpu.api.types import JobConditionType, PodPhase
+from tf_operator_tpu.backend.fake import FakeCluster
+from tf_operator_tpu.backend.jobstore import JobStore
+from tf_operator_tpu.backend.kubesim import MiniApiServer
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.utils.alerts import AlertEngine, BurnRateRule
+from tf_operator_tpu.utils.flight import FlightRecorder
+from tf_operator_tpu.utils.metrics import SLO_BUCKETS, Metrics
+
+#: the serving SLO under test: p90 of request wall <= 50 ms.  The
+#: injected fault adds 120 ms, a clean local request takes ~2-5 ms —
+#: margin on both sides against a loaded CI box.
+OBJECTIVE_LE = 0.05
+WINDOWS = (0.5, 1.5)
+FAULT_DELAY = 0.12
+
+
+def _request(url: str) -> float:
+    """One real HTTP request; returns its wall seconds."""
+
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(url, timeout=10) as r:
+        r.read()
+    return time.perf_counter() - t0
+
+
+@pytest.fixture
+def rig(tmp_path, monkeypatch):
+    """kubesim (the fault-injectable data plane) + a sync-delivery
+    controller with an alert engine wired, and one running TPUJob."""
+
+    monkeypatch.setenv("TPUJOB_FLIGHT_DIR", str(tmp_path))
+    sim = MiniApiServer().start()
+    metrics = Metrics()
+    metrics.set_buckets("serve_request_seconds", SLO_BUCKETS)
+    recorder = FlightRecorder()
+    recorder.attach_metrics(metrics)
+    engine = AlertEngine(
+        [
+            BurnRateRule(
+                "serve-burn",
+                family="serve_request_seconds",
+                objective_le=OBJECTIVE_LE,
+                objective_ratio=0.9,
+                labels={"route": "/pods"},
+                windows=WINDOWS,
+                burn_threshold=3.0,
+            )
+        ],
+        metrics=metrics,
+        recorder=recorder,
+    )
+    store = JobStore()
+    backend = FakeCluster(delivery="sync")
+    controller = TPUJobController(
+        store, backend, metrics=metrics, alerts=engine
+    )
+    job = new_job(name="chaos-job", worker=1)
+    store.create(job)
+    controller.sync_until_quiet()
+    backend.set_pod_phase("default", "chaos-job-worker-0", PodPhase.RUNNING)
+    controller.sync_until_quiet()
+    assert store.get("default", "chaos-job").status.has_condition(
+        JobConditionType.RUNNING
+    )
+    yield sim, metrics, engine, store, controller
+    controller.stop()
+    sim.stop()
+
+
+def _serve_and_evaluate(sim, metrics, engine, seconds: float,
+                        until=None) -> None:
+    """The miniature serving run: real GETs against the apiserver,
+    each observed into the serving SLO family, the engine evaluated
+    after every request.  Stops early when ``until()`` is true."""
+
+    url = f"{sim.url}/api/v1/namespaces/default/pods"
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        dt = _request(url)
+        metrics.observe_histogram(
+            "serve_request_seconds", dt, route="/pods", model="chaos"
+        )
+        engine.evaluate_once()
+        if until is not None and until():
+            return
+        time.sleep(0.02)
+
+
+class TestChaosLifecycle:
+    def test_burn_alert_full_lifecycle_through_job_status(self, rig):
+        sim, metrics, engine, store, controller = rig
+        (alert,) = engine.alerts()
+
+        # ---- inject: every pods GET rides a 120 ms latency fault
+        sim.faults.add(
+            path="/pods", methods=["GET"], mode="latency",
+            delay=FAULT_DELAY,
+        )
+        _serve_and_evaluate(
+            sim, metrics, engine, seconds=10.0,
+            until=lambda: alert.state == "firing",
+        )
+        assert alert.state == "firing", (
+            f"alert never fired: state={alert.state} value={alert.value}"
+        )
+        assert sim.faults.total_injected() > 0
+
+        # ---- firing -> the rollup publishes Degraded + Warning event
+        controller.sync_until_quiet()
+        job = store.get("default", "chaos-job")
+        deg = job.status.condition(JobConditionType.DEGRADED)
+        assert deg is not None and deg.status
+        assert deg.reason == "SLOViolation"
+        assert "serve-burn" in deg.message
+        # still Running — Degraded is health, not phase
+        assert job.status.has_condition(JobConditionType.RUNNING)
+        assert job.status.observed_health["firingAlerts"] == ["serve-burn"]
+        events = [
+            (e.type, e.reason)
+            for e in controller.recorder.for_object("default/chaos-job")
+        ]
+        assert ("Warning", "SLOViolation") in events
+
+        # ---- the black box captured the episode: exactly one dump,
+        # carrying the firing log
+        assert len(engine.dumps) == 1
+        records = [
+            json.loads(line)
+            for line in open(engine.dumps[0]).read().splitlines()
+        ]
+        assert records[0]["reason"] == "alert-serve-burn"
+        assert any(
+            r["type"] == "log" and "serve-burn" in r.get("message", "")
+            for r in records
+        )
+
+        # ---- clear the faults: good traffic ages the violation out of
+        # both windows and the alert resolves
+        sim.faults.clear()
+        _serve_and_evaluate(
+            sim, metrics, engine, seconds=12.0,
+            until=lambda: alert.state == "resolved",
+        )
+        assert alert.state == "resolved", (
+            f"alert never resolved: value={alert.value}"
+        )
+
+        # ---- resolved -> condition clears + Normal event
+        controller.reconciler.config.health_refresh_seconds = 0.0
+        controller.sync_until_quiet()
+        job = store.get("default", "chaos-job")
+        assert not job.status.has_condition(JobConditionType.DEGRADED)
+        assert job.status.observed_health["firingAlerts"] == []
+        events = [
+            (e.type, e.reason)
+            for e in controller.recorder.for_object("default/chaos-job")
+        ]
+        assert ("Normal", "SLORecovered") in events
+        # one Warning + one Normal for the whole episode, not per sync
+        assert events.count(("Warning", "SLOViolation")) == 1
+        assert events.count(("Normal", "SLORecovered")) == 1
+        # still exactly the one dump from the firing transition
+        assert len(engine.dumps) == 1
+
+    def test_clean_soak_fires_zero_alerts(self, rig):
+        """The false-positive half: the same serving run with NO faults
+        must never leave inactive — covering well past the long window
+        so every burn evaluation runs fully covered."""
+
+        sim, metrics, engine, store, controller = rig
+        fired = []
+        engine.subscribe(lambda a, old, new: fired.append((old, new)))
+        _serve_and_evaluate(
+            sim, metrics, engine, seconds=WINDOWS[1] * 2.5
+        )
+        (alert,) = engine.alerts()
+        assert alert.state == "inactive"
+        assert fired == []
+        assert metrics.total("alerts_fired_total") == 0.0
+        assert engine.dumps == []
+        controller.reconciler.config.health_refresh_seconds = 0.0
+        controller.sync_until_quiet()
+        job = store.get("default", "chaos-job")
+        assert not job.status.has_condition(JobConditionType.DEGRADED)
+        events = [
+            e.reason
+            for e in controller.recorder.for_object("default/chaos-job")
+        ]
+        assert "SLOViolation" not in events

@@ -1,7 +1,8 @@
-"""Labeled-metrics exposition (ISSUE 5 tentpole): strict Prometheus
-text-format parse of EVERY line, label-value escaping, per-family
-bucket config, labeled histogram families, and the counters snapshot
-the flight recorder diffs."""
+"""Labeled-metrics exposition (ISSUE 5 tentpole, extended by ISSUE 6):
+strict Prometheus text-format parse of EVERY line, label-value
+escaping, per-family bucket config, labeled histogram families, the
+counters snapshot the flight recorder diffs, and — the ISSUE 6 pin —
+``# HELP`` / ``# TYPE`` metadata lines required for every family."""
 
 import math
 import re
@@ -20,18 +21,41 @@ _LINE = re.compile(
     rf"^({_NAME})(\{{{_LABEL}(?:,{_LABEL})*\}})? (-?[0-9.eE+-]+|[0-9.]+)$"
 )
 _COMMENT = re.compile(r"^# exemplar \S+ trace_id=\"[^\"]+\"$")
+_META = re.compile(rf"^# (HELP|TYPE) ({_NAME}) (.+)$")
+_TYPES = {"counter", "gauge", "histogram", "summary"}
 
 
 def parse_strictly(text: str):
-    """Every non-comment line must match the sample shape; returns
-    {line: value} for exact-line assertions."""
+    """Every non-comment line must match the sample shape AND belong to
+    a family that declared ``# HELP`` + ``# TYPE`` before its first
+    sample; returns {line: value} for exact-line assertions."""
 
     out = {}
+    helps, types = set(), {}
     for line in text.strip().splitlines():
+        meta = _META.match(line)
+        if meta:
+            kind, fam, rest = meta.groups()
+            if kind == "HELP":
+                helps.add(fam)
+            else:
+                assert rest in _TYPES, f"bad # TYPE value: {line!r}"
+                types[fam] = rest
+            continue
         if _COMMENT.match(line):
             continue
         m = _LINE.match(line)
         assert m, f"unparseable exposition line: {line!r}"
+        name = m.group(1)
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                fam = name[: -len(suffix)]
+                break
+        assert fam in types and fam in helps, (
+            f"sample {line!r} has no preceding # HELP/# TYPE for "
+            f"family {fam!r}"
+        )
         out[line.rsplit(" ", 1)[0]] = float(m.group(3))
     return out
 
@@ -99,6 +123,42 @@ class TestLabeledExposition:
         # explicit buckets at first observation win over both
         m.observe_histogram("explicit_seconds", 0.5, buckets=(1.0,))
         assert 'explicit_seconds_bucket{le="1.0"} 1' in m.exposition()
+
+    def test_help_and_type_emitted_for_every_family(self):
+        """ISSUE 6 satellite: every family gets # HELP and # TYPE, with
+        the right TYPE per storage kind, before its first sample."""
+
+        m = Metrics()
+        m.inc("c_total")
+        m.set("g_depth", 1.0)
+        m.observe("s_latency", 0.5)
+        m.observe_histogram("h_seconds", 0.1, phase="x")
+        text = m.exposition()
+        assert "# HELP c_total" in text
+        assert "# TYPE c_total counter" in text
+        assert "# TYPE g_depth gauge" in text
+        assert "# TYPE s_latency summary" in text
+        assert "# TYPE h_seconds histogram" in text
+        # metadata precedes the family's first sample
+        lines = text.splitlines()
+        assert lines.index("# TYPE h_seconds histogram") < lines.index(
+            'h_seconds_bucket{le="0.001",phase="x"} 0'
+        )
+        parse_strictly(text)  # the strict pin itself enforces coverage
+
+    def test_describe_sets_help_text_and_escapes(self):
+        m = Metrics()
+        m.describe("c_total", "requests served\nsince boot \\ total")
+        m.inc("c_total")
+        text = m.exposition()
+        assert "# HELP c_total requests served\\nsince boot \\\\ total" in text
+        parse_strictly(text)
+
+    def test_strict_parser_rejects_family_without_metadata(self):
+        import pytest
+
+        with pytest.raises(AssertionError, match="HELP"):
+            parse_strictly("orphan_total 1\n")
 
     def test_counters_snapshot_flat_keys(self):
         m = Metrics()

@@ -216,7 +216,7 @@ def _replica_spec_to_dict(rs: ReplicaSpec) -> Dict[str, Any]:
 
 
 def status_to_dict(st: TPUJobStatus) -> Dict[str, Any]:
-    return {
+    out = {
         "conditions": [
             {
                 "type": c.type.value,
@@ -236,6 +236,9 @@ def status_to_dict(st: TPUJobStatus) -> Dict[str, Any]:
         "completionTime": st.completion_time,
         "restartCount": st.restart_count,
     }
+    if st.observed_health:
+        out["observedHealth"] = dict(st.observed_health)
+    return out
 
 
 def status_from_dict(d: Dict[str, Any]) -> TPUJobStatus:
@@ -243,6 +246,7 @@ def status_from_dict(d: Dict[str, Any]) -> TPUJobStatus:
         start_time=d.get("startTime"),
         completion_time=d.get("completionTime"),
         restart_count=d.get("restartCount", 0),
+        observed_health=dict(d.get("observedHealth", {})),
     )
     for c in d.get("conditions", []):
         st.conditions.append(

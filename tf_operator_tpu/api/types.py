@@ -96,13 +96,22 @@ class SuccessPolicy(str, enum.Enum):
 
 
 class JobConditionType(str, enum.Enum):
-    """Job condition types (SURVEY.md §2 "Common API types")."""
+    """Job condition types (SURVEY.md §2 "Common API types").
+
+    ``DEGRADED`` is ours, not the reference's: it is NOT a phase — it
+    coexists with Running (a job can be running AND burning its SLO
+    budget) and is set/cleared by the health rollup
+    (controller/reconciler.py) from the alert engine's firing set
+    (utils/alerts.py).  Reason ``SLOViolation`` when a burn-rate rule
+    fires, ``HealthDegraded`` for threshold rules.
+    """
 
     CREATED = "Created"
     RUNNING = "Running"
     RESTARTING = "Restarting"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
+    DEGRADED = "Degraded"
 
 
 class PodPhase(str, enum.Enum):
@@ -343,6 +352,13 @@ class TPUJobStatus:
     completion_time: Optional[float] = None
     #: operator-side restart count, compared against backoff_limit
     restart_count: int = 0
+    #: live health rollup published by the reconciler (flat JSON-able
+    #: scalars/lists, camelCase keys — serialized as ``observedHealth``):
+    #: firingAlerts, stallCount, restartCount, lastCheckpointAgeSeconds,
+    #: throughputStepsPerSec, updatedAt.  Empty until an alert engine is
+    #: wired; ``get``/``describe`` surface it so status shows live
+    #: health, not just phase.
+    observed_health: Dict[str, Any] = field(default_factory=dict)
 
     def condition(self, ctype: JobConditionType) -> Optional[JobCondition]:
         for c in self.conditions:
@@ -366,6 +382,10 @@ class TPUJobStatus:
             start_time=self.start_time,
             completion_time=self.completion_time,
             restart_count=self.restart_count,
+            observed_health={
+                k: (list(v) if isinstance(v, list) else v)
+                for k, v in self.observed_health.items()
+            },
         )
 
     def has_condition(self, ctype: JobConditionType, status: bool = True) -> bool:

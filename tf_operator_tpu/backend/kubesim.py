@@ -511,6 +511,14 @@ class MiniApiServer:
             from tf_operator_tpu.utils.flight import default_recorder
 
             return self._reply(h, 200, text=default_recorder.dump_text())
+        if u.path == "/alerts" and method == "GET":
+            # the process-global alert engine's state (utils/alerts.py)
+            # — admin/debug surface like /_faults, never injected: the
+            # route that tells you things are on fire must not itself
+            # be set on fire
+            from tf_operator_tpu.utils.alerts import default_engine
+
+            return self._reply(h, 200, default_engine.snapshot())
         act = self.faults.decide(method, h.path)
         if act is not None:
             span.set_attribute("fault", act[0])

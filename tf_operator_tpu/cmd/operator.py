@@ -285,14 +285,28 @@ def main(argv=None) -> int:
 
         recorder = KubeEventRecorder(url)
 
+    # SLO alert engine (utils/alerts.py): the stock burn-rate +
+    # threshold rules evaluated over the controller's registry on a
+    # background thread.  The controller rolls the firing set into
+    # TPUJob.status (Degraded condition + observedHealth) and the API
+    # serves GET /alerts; a pending→firing transition dumps the flight
+    # recorder once per episode.  The PROCESS-GLOBAL default_engine
+    # (default rules over default_metrics — exactly this binary's
+    # registry) is used rather than a private instance so kubesim's
+    # own /alerts debug route reports the engine that actually runs,
+    # not a never-started twin.
+    from tf_operator_tpu.utils.alerts import default_engine as alert_engine
+
     controller = TPUJobController(
-        store, backend, config=config, recorder=recorder
+        store, backend, config=config, recorder=recorder,
+        alerts=alert_engine,
     )
     api = ApiServer(
         store,
         backend,
         controller.metrics,
         controller.recorder,
+        alerts=alert_engine,
         host=args.host,
         port=args.monitoring_port,
         namespace=args.namespace,
@@ -325,6 +339,7 @@ def main(argv=None) -> int:
 
     flight.install(metrics=controller.metrics)
     maybe_start_from_env(metrics=controller.metrics)
+    alert_engine.start()
 
     # monitoring/API surface is up regardless of leadership (reference
     # parity: the monitoring port serves on standbys too); only the
@@ -354,6 +369,7 @@ def main(argv=None) -> int:
                 )
             stop.wait(0.5)
     finally:
+        alert_engine.stop()
         if controller_started:
             controller.stop()
         api.stop()

@@ -71,6 +71,10 @@ def _condition_summary(job: dict) -> str:
     for terminal in ("Succeeded", "Failed"):
         if terminal in active:
             return terminal
+    # live health outranks phase for a non-terminal job: a running job
+    # burning its SLO budget shows Degraded, not Running
+    if "Degraded" in active:
+        return "Degraded"
     for c in reversed(conds):
         if _is_true(c):
             return c["type"]
@@ -141,6 +145,21 @@ def cmd_describe(args) -> int:
             f"  {c['type']:<12} {str(c.get('status')):<6} "
             f"{c.get('reason', ''):<24} {c.get('message', '')}"
         )
+    health = st.get("observedHealth") or {}
+    if health:
+        # the live rollup the reconciler publishes (alert engine +
+        # watchdog + checkpoint age): health, not just phase
+        print("Health:")
+        firing = health.get("firingAlerts", [])
+        print(f"  firingAlerts:     {', '.join(firing) if firing else '(none)'}")
+        for key, label in (
+            ("throughputStepsPerSec", "throughput"),
+            ("lastCheckpointAgeSeconds", "checkpointAge"),
+            ("stallCount", "stalls"),
+            ("restartCount", "restarts"),
+        ):
+            if key in health:
+                print(f"  {label + ':':<18}{health[key]}")
     events = _request(
         "GET", _jobs_url(args.server, args.namespace, args.name, "events")
     )["items"]

@@ -45,6 +45,7 @@ class TPUJobController:
         expectations_timeout: float = EXPECTATION_TIMEOUT_S,
         recorder: Optional[EventRecorder] = None,
         tracer: Optional[Tracer] = None,
+        alerts=None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -86,6 +87,13 @@ class TPUJobController:
 
             config = dataclasses.replace(config, use_native_decisions=self.native)
         self.cache = InformerCache(self._enqueue, self.pod_exp, self.svc_exp)
+        #: utils/alerts.AlertEngine (optional): the reconciler rolls its
+        #: firing set into TPUJob.status; every alert transition
+        #: re-enqueues all known jobs so Degraded lands/clears without
+        #: waiting for the next watch event or resync
+        self.alerts = alerts
+        if alerts is not None:
+            alerts.subscribe(self._on_alert_transition)
         self.reconciler = Reconciler(
             job_store,
             backend,
@@ -97,6 +105,7 @@ class TPUJobController:
             config=config,
             requeue_after=self._requeue_after,
             tracer=self.tracer,
+            alerts=alerts,
         )
         self.max_sync_retries = max_sync_retries
         self.resync_period = resync_period
@@ -133,6 +142,21 @@ class TPUJobController:
                 span.span_id if span is not None else None,
                 time.monotonic() + offset,
             ))
+
+    def _on_alert_transition(self, alert, old: str, new: str) -> None:
+        """Alert-engine subscriber (runs on the evaluator thread):
+        re-enqueue every cached job so the reconciler's health rollup
+        republishes promptly.  Only transitions entering or leaving
+        ``firing`` can change the Degraded condition or observedHealth
+        (the rollup reads ``alerts.firing()``), so pending flaps and
+        the resolved→inactive decay skip the full-cache sweep."""
+
+        if old != "firing" and new != "firing":
+            return
+        with self.cache._lock:
+            keys = list(self.cache.jobs)
+        for key in keys:
+            self._enqueue(key)
 
     def _enqueue(self, key: str) -> None:
         self._capture_trace(key)
@@ -278,6 +302,11 @@ class TPUJobController:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.alerts is not None:
+            # detach from the (possibly process-global) engine — it
+            # outlives this controller and would otherwise pin it and
+            # keep invoking the callback forever
+            self.alerts.unsubscribe(self._on_alert_transition)
         self.queue.shutdown()
         for t in self._threads:
             t.join(timeout=2.0)

@@ -52,6 +52,7 @@ from tf_operator_tpu.controller.expectations import Expectations
 from tf_operator_tpu.controller.informer import InformerCache
 from tf_operator_tpu.controller.plan import sync_decide
 from tf_operator_tpu.controller.status import (
+    clear_condition,
     initialize_replica_statuses,
     is_running,
     set_condition,
@@ -80,6 +81,17 @@ class ReconcilerConfig:
     #: a thrashing job (expectations churn, hot requeue) surfaces here
     #: and in the tpujob_sync_duration_seconds histogram
     slow_sync_warn_seconds: float = 1.0
+    #: observed-health rollup refresh floor: the block carries
+    #: timestamps/ages that change every sync, so unthrottled it would
+    #: turn every sync into a status write (and, on watch-fed stores,
+    #: every status write into another sync).  A firing-set change
+    #: bypasses the throttle — Degraded must land promptly.
+    health_refresh_seconds: float = 5.0
+    #: observedHealth.throughputStepsPerSec is LIVE health: summary
+    #: series whose newest record is older than this are ignored — a
+    #: wedged trainer must not keep reporting its historical rate
+    #: under a fresh updatedAt
+    throughput_stale_seconds: float = 300.0
 
 
 class Reconciler:
@@ -95,6 +107,7 @@ class Reconciler:
         config: Optional[ReconcilerConfig] = None,
         requeue_after: Optional[Callable[[str, float], None]] = None,
         tracer: Optional[Tracer] = None,
+        alerts=None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -108,6 +121,12 @@ class Reconciler:
         self.requeue_after = requeue_after or (lambda key, delay: None)
         #: job key -> absolute deadline wakeup already scheduled
         self._deadline_scheduled: Dict[str, float] = {}
+        #: utils/alerts.AlertEngine (None = no health rollup): the
+        #: firing set drives the Degraded/SLOViolation condition and
+        #: the observedHealth block published into TPUJob.status
+        self.alerts = alerts
+        #: job key -> unix of the last health-rollup refresh (throttle)
+        self._health_refreshed: Dict[str, float] = {}
 
     # ------------------------------------------------------------------ sync
 
@@ -153,6 +172,7 @@ class Reconciler:
             self.pod_exp.delete(key)
             self.svc_exp.delete(key)
             self._deadline_scheduled.pop(key, None)
+            self._health_refreshed.pop(key, None)
             self._gc_orphans(key)
             return
         log = logger_for_job(job.metadata.namespace, job.metadata.name)
@@ -164,6 +184,7 @@ class Reconciler:
             # reconciled — no pods, no services, no gang group
             old_status = job.status.clone()
             msg = f"invalid TPUJob spec: {job.invalid_reason}"
+            self._clear_live_health(job)
             set_condition(job, JobConditionType.FAILED, "InvalidSpec", msg)
             self.recorder.event(key, "Warning", "InvalidSpec", msg)
             self.metrics.inc("tpujob_invalid_total")
@@ -225,6 +246,7 @@ class Reconciler:
         if succeeded:
             update_replica_statuses(job, pods_by_type)
             job.status.completion_time = time.time()
+            self._clear_live_health(job)
             set_condition(job, JobConditionType.SUCCEEDED, "JobSucceeded", reason)
             self.recorder.event(key, "Normal", "JobSucceeded", reason)
             self.metrics.inc("tpujob_jobs_succeeded_total")
@@ -268,6 +290,7 @@ class Reconciler:
                 self._observe_startup_latency(job)
             set_condition(job, JobConditionType.RUNNING, "JobRunning", f"TPUJob {key} is running.")
 
+        self._rollup_health(job)
         self._update_status(job, old_status)
         log.debug("sync complete")
 
@@ -553,8 +576,22 @@ class Reconciler:
 
     # ------------------------------------------------------ terminal paths
 
+    def _clear_live_health(self, job: TPUJob) -> None:
+        """Terminal paths drop LIVE health: the Degraded condition and
+        the observedHealth block describe the run while it happens — a
+        job that never syncs again must not keep reporting its last
+        firing alerts (or a frozen checkpoint age) as current, and the
+        condition would otherwise be pinned True forever."""
+
+        clear_condition(
+            job, JobConditionType.DEGRADED, "JobFinished",
+            "terminal state clears degraded",
+        )
+        job.status.observed_health = {}
+
     def _fail_job(self, job: TPUJob, reason: str, message: str) -> None:
         job.status.completion_time = job.status.completion_time or time.time()
+        self._clear_live_health(job)
         set_condition(job, JobConditionType.FAILED, reason, message)
         self.recorder.event(job.key, "Warning", "JobFailed", message)
         self.metrics.inc("tpujob_jobs_failed_total")
@@ -653,6 +690,115 @@ class Reconciler:
         if remaining > 0:
             self._deadline_scheduled[job.key] = due
             self.requeue_after(job.key, remaining + 0.01)
+
+    # ------------------------------------------------------- health rollup
+
+    def _rollup_health(self, job: TPUJob) -> None:
+        """Publish live health into TPUJob.status (ISSUE 6 rollup half):
+        a ``Degraded`` condition driven by the alert engine's firing
+        set plus an ``observedHealth`` block (firing alerts, stall
+        count, restart count, checkpoint age, recent throughput) — so
+        ``tpujob get/describe`` shows health, not just phase.
+
+        No-op without an engine.  Refreshes are throttled
+        (``health_refresh_seconds``) because the block carries ages
+        that change every sync; a CHANGE in the firing set bypasses the
+        throttle so conditions land promptly.
+        """
+
+        if self.alerts is None:
+            return
+        if job.is_terminal():
+            # the failed_fatal path reaches here AFTER _fail_job cleared
+            # Degraded; re-marking a terminal job would pin the
+            # condition forever (terminal jobs never sync again)
+            return
+        key = job.key
+        # ONE firing snapshot for names, reason, and message — the
+        # evaluator thread may transition rules between calls, and
+        # reason/message must never disagree
+        firing_alerts = self.alerts.firing()
+        firing = sorted(a.rule.name for a in firing_alerts)
+        now = time.time()
+        throttled = (
+            now - self._health_refreshed.get(key, 0.0)
+            < self.config.health_refresh_seconds
+        )
+        if throttled and firing == job.status.observed_health.get(
+            "firingAlerts", []
+        ):
+            return
+        self._health_refreshed[key] = now
+
+        # ---- Degraded condition + one Warning/Normal event per flip
+        if firing:
+            from tf_operator_tpu.utils.alerts import BurnRateRule
+
+            reason = (
+                "SLOViolation"
+                if any(isinstance(a.rule, BurnRateRule) for a in firing_alerts)
+                else "HealthDegraded"
+            )
+            msg = "alerts firing: " + ", ".join(firing)
+            newly = not job.status.has_condition(JobConditionType.DEGRADED)
+            if set_condition(job, JobConditionType.DEGRADED, reason, msg) and newly:
+                self.recorder.event(key, "Warning", reason, msg)
+                self.metrics.inc("tpujob_degraded_total")
+        elif clear_condition(
+            job, JobConditionType.DEGRADED, "Recovered",
+            "all alerts resolved",
+        ):
+            self.recorder.event(
+                key, "Normal", "SLORecovered", "all alerts resolved"
+            )
+
+        # ---- observedHealth block
+        health: Dict[str, object] = {
+            "firingAlerts": firing,
+            "stallCount": int(self.metrics.total("watchdog_stall_total")),
+            "restartCount": job.status.restart_count,
+            "updatedAt": round(now, 3),
+        }
+        ckpt = self.metrics.gauge("checkpoint_last_success_unix")
+        if ckpt > 0:
+            health["lastCheckpointAgeSeconds"] = round(max(0.0, now - ckpt), 1)
+        tput = self._recent_throughput(job)
+        if tput is not None:
+            health["throughputStepsPerSec"] = tput
+        job.status.observed_health = health
+
+    def _recent_throughput(self, job: TPUJob) -> Optional[float]:
+        """Δstep/Δtime over the tail of the job's summary series (the
+        same per-job metrics the API's /metrics sub-resource serves);
+        None when the job publishes no series."""
+
+        from tf_operator_tpu.utils.summaries import (
+            ANNOTATION_SUMMARY_DIR,
+            read_series,
+        )
+
+        sdir = job.metadata.annotations.get(ANNOTATION_SUMMARY_DIR)
+        if not sdir:
+            return None
+        try:
+            series = read_series(sdir, limit=20)
+        except OSError:
+            return None
+        if len(series) < 2:
+            return None
+        # staleness bound: the tail must be RECENT — a trainer that
+        # hung hours ago still has a perfectly healthy-looking last-20
+        # window, and reporting it as live throughput is exactly the
+        # failure observedHealth exists to expose
+        if time.time() - series[-1].get("time", 0.0) > (
+            self.config.throughput_stale_seconds
+        ):
+            return None
+        d_step = series[-1].get("step", 0) - series[0].get("step", 0)
+        d_time = series[-1].get("time", 0.0) - series[0].get("time", 0.0)
+        if d_time <= 0 or d_step <= 0:
+            return None
+        return round(d_step / d_time, 3)
 
     # -------------------------------------------------------------- status
 

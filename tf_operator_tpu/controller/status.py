@@ -67,14 +67,44 @@ def set_condition(job: TPUJob, ctype: JobConditionType, reason: str, message: st
             )
         )
         return True
-    if not existing.status or existing.reason != reason:
+    if (
+        not existing.status
+        or existing.reason != reason
+        or existing.message != message
+    ):
+        # message-only changes matter too: the Degraded condition's
+        # message lists the firing alert names, which can change while
+        # the reason stays the same (one more rule joins the episode).
+        # But lastTransitionTime moves only when the STATUS or reason
+        # actually changes (k8s convention) — "degraded for X" must not
+        # reset because one more rule joined the same episode
+        if not existing.status or existing.reason != reason:
+            existing.last_transition_time = now
         existing.status = True
         existing.reason = reason
         existing.message = message
         existing.last_update_time = now
-        existing.last_transition_time = now
         return True
     return changed
+
+
+def clear_condition(
+    job: TPUJob, ctype: JobConditionType, reason: str, message: str
+) -> bool:
+    """Flip a condition to status=False (it stays in the list as
+    history, k8s-style).  Returns True if it was True — the health
+    rollup uses this to event exactly once on Degraded→recovered."""
+
+    c = job.status.condition(ctype)
+    if c is None or not c.status:
+        return False
+    now = time.time()
+    c.status = False
+    c.reason = reason
+    c.message = message
+    c.last_update_time = now
+    c.last_transition_time = now
+    return True
 
 
 def initialize_replica_statuses(job: TPUJob) -> None:

@@ -183,6 +183,26 @@ def train_loop(
 
     losses: List[float] = []
     pending: List = []  # previous window's device-side loss arrays
+    #: recent-throughput gauge (host-side wall arithmetic only — the
+    #: no-hot-sync gate stays satisfied): steps dispatched per second
+    #: since the previous window (per step when K=1), on the ledger's
+    #: registry — visible on THIS process's /metrics exposition only.
+    #: The health rollup's throughputStepsPerSec comes from the job
+    #: summary series instead (reconciler._recent_throughput): a
+    #: subprocess-pod trainer's gauge never reaches the operator
+    #: registry (see docs/ARCHITECTURE.md on checkpoint-gauge scope)
+    mreg = getattr(ledger, "metrics", None)
+    t_prev = time.perf_counter()
+
+    def _observe_throughput(n_steps: int) -> None:
+        nonlocal t_prev
+        now_t = time.perf_counter()
+        if mreg is not None and now_t > t_prev:
+            mreg.set(
+                "train_window_steps_per_second", n_steps / (now_t - t_prev)
+            )
+        t_prev = now_t
+
     try:
         with tr.span(
             f"train {tag}",
@@ -210,6 +230,7 @@ def train_loop(
                             metrics = trainer.train_step(batch)
                     ledger.step()
                     hb.beat()
+                    _observe_throughput(1)
                     losses.extend(_resolve_losses(ledger, "step", [metrics["loss"]]))
             else:
                 step = start_step
@@ -240,6 +261,7 @@ def train_loop(
                                 window.append(m["loss"])
                     ledger.step(n)
                     hb.beat()
+                    _observe_throughput(n)
                     # deferred resolution: fetch the PREVIOUS window now
                     # that this one is dispatched — its arrays are (almost
                     # always) already finished, so the host rides behind

@@ -8,6 +8,8 @@ the operator binary carries the equivalent surface itself:
 
     GET  /healthz                                     liveness
     GET  /metrics                                     Prometheus text
+    GET  /slo                                         control-plane SLO quantiles
+    GET  /alerts                                      alert-engine state (firing first)
     GET  /traces                                      recent trace summaries
     GET  /traces/{id}                                 one trace's span waterfall
     GET  /debug/stacks                                all-thread stack dump
@@ -43,7 +45,7 @@ from tf_operator_tpu.api.types import LABEL_JOB_NAME
 from tf_operator_tpu.backend.base import AlreadyExistsError, ClusterBackend, NotFoundError
 from tf_operator_tpu.backend.jobstore import JobStore
 from tf_operator_tpu.utils.events import EventRecorder
-from tf_operator_tpu.utils.metrics import Metrics
+from tf_operator_tpu.utils.metrics import Metrics, finite_summary
 from tf_operator_tpu.utils.trace import (
     TRACE_HEADER,
     Tracer,
@@ -78,11 +80,20 @@ class ApiServer:
         namespace: str = "",
         leadership: Optional[Callable[[], Tuple[bool, Optional[str]]]] = None,
         tracer: Optional[Tracer] = None,
+        alerts=None,
     ):
         self.jobs = job_store
         self.backend = backend
         self.metrics = metrics
         self.recorder = recorder
+        #: utils/alerts.AlertEngine serving GET /alerts; defaults to the
+        #: process-global engine so the endpoint exists (empty/inactive)
+        #: even on binaries that never start an evaluator
+        if alerts is None:
+            from tf_operator_tpu.utils.alerts import default_engine
+
+            alerts = default_engine
+        self.alerts = alerts
         #: request spans + the /traces read surface; in-process the
         #: controller, backends and (kube-sim) the embedded apiserver
         #: all share this tracer's store, so /traces/<id> returns the
@@ -156,7 +167,10 @@ class ApiServer:
                 route = self.path.split("?")[0]
                 t0 = time.perf_counter()
                 try:
-                    untraced = ("/healthz", "/metrics", "/traces", "/debug")
+                    untraced = (
+                        "/healthz", "/metrics", "/slo", "/alerts",
+                        "/traces", "/debug",
+                    )
                     if method == "GET" and (
                         route == "/" or any(
                             route == u or route.startswith(u + "/")
@@ -256,6 +270,39 @@ class ApiServer:
                         return self._send(
                             200, outer.metrics.exposition(), "text/plain"
                         )
+                    if p == ["slo"]:
+                        # the control-plane twin of serve_lm's /slo:
+                        # per-label-set quantile summaries over the
+                        # operator's latency families — both planes
+                        # expose the same SLO read contract
+                        fams = {}
+                        for fam in (
+                            "api_request_seconds",
+                            "tpujob_sync_duration_seconds",
+                            "workqueue_queue_latency_seconds",
+                        ):
+                            fams[fam] = [
+                                {**dict(labels), **finite_summary(summary)}
+                                for labels, summary in sorted(
+                                    outer.metrics.histogram_family(
+                                        fam
+                                    ).items()
+                                )
+                            ]
+                        return self._send(200, {
+                            "histograms": fams,
+                            "gauges": {
+                                "workqueue_depth": outer.metrics.gauge(
+                                    "workqueue_depth"
+                                ),
+                            },
+                        })
+                    if p == ["alerts"]:
+                        # the alert engine's lifecycle state (firing
+                        # first) — served on every replica like
+                        # /metrics; the dashboard's alerts panel and
+                        # external pollers read this
+                        return self._send(200, outer.alerts.snapshot())
                     # trace read surface: served on every replica
                     # (leader or standby) like /metrics — its job is
                     # diagnosing whichever process you can reach

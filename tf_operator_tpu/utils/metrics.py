@@ -9,6 +9,7 @@ text exposition (servable later; no network dependency here).
 from __future__ import annotations
 
 import contextlib
+import math
 import threading
 import time
 from collections import defaultdict
@@ -26,6 +27,18 @@ DEFAULT_BUCKETS = (
 SLO_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
+
+
+def finite_summary(summary: Dict[str, float]) -> Dict[str, Any]:
+    """JSON-safe histogram summary for the /slo endpoints: a quantile
+    landing in the overflow bucket is ``float('inf')``, which
+    ``json.dumps`` would emit as the non-JSON token ``Infinity`` and
+    break strict parsers — map non-finite floats to None."""
+
+    return {
+        k: (None if isinstance(v, float) and not math.isfinite(v) else v)
+        for k, v in summary.items()
+    }
 
 
 def _escape_label(value) -> str:
@@ -63,6 +76,18 @@ class Metrics:
         #: the counter→trace link (OpenMetrics-exemplar-style): "this
         #: client has 14 errors" becomes "...and HERE is one of them"
         self._exemplars: Dict[str, str] = {}
+        #: family name -> HELP text (describe()); families without one
+        #: get an auto-generated line — the exposition emits # HELP and
+        #: # TYPE for EVERY family either way (strict-parse pinned)
+        self._help: Dict[str, str] = {}
+
+    def describe(self, name: str, help_text: str) -> None:
+        """Attach a ``# HELP`` line to a family.  Optional — families
+        never described still get an auto HELP plus the correct
+        ``# TYPE`` in the exposition."""
+
+        with self._lock:
+            self._help[name] = str(help_text)
 
     def inc(
         self, name: str, value: float = 1.0, *,
@@ -190,6 +215,41 @@ class Metrics:
         with self._lock:
             return self._counters.get(key, 0.0)
 
+    def counter_series(self, name: str) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """Every label set of one counter family with its value — the
+        alert engine's windowed-increase read (utils/alerts.py)."""
+
+        with self._lock:
+            return {
+                labels: v
+                for (n, labels), v in self._counters.items()
+                if n == name
+            }
+
+    def gauge_series(self, name: str) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """Every label set of one gauge family with its value."""
+
+        with self._lock:
+            return {
+                labels: v
+                for (n, labels), v in self._gauges.items()
+                if n == name
+            }
+
+    def histogram_raw(
+        self, name: str
+    ) -> Dict[Tuple[Tuple[str, str], ...], Tuple[Tuple[float, ...], List[int], float, int]]:
+        """Raw (buckets, counts, sum, count) per label set of one
+        histogram family — the burn-rate evaluator needs cumulative
+        bucket counts, not the summarized quantiles."""
+
+        with self._lock:
+            return {
+                labels: (h[0], list(h[1]), h[2], h[3])
+                for (n, labels), h in self._histograms.items()
+                if n == name
+            }
+
     def total(self, name: str) -> float:
         """Sum of one counter across all of its label sets (e.g. every
         client's api_client_retries_total)."""
@@ -213,24 +273,45 @@ class Metrics:
             "p99": vals[min(len(vals) - 1, int(len(vals) * 0.99))],
         }
 
+    def _header(self, lines: List[str], emitted: set, name: str, kind: str) -> None:
+        """# HELP + # TYPE once per family, immediately before its
+        first sample (Prometheus requires family samples contiguous
+        after their metadata; each section is name-sorted so they are).
+        Newlines/backslashes in help text are escaped per the text
+        format, keeping the exposition line-parseable."""
+
+        if name in emitted:
+            return
+        emitted.add(name)
+        help_text = self._help.get(name, f"{name} ({kind})")
+        help_text = help_text.replace("\\", "\\\\").replace("\n", "\\n")
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+
     def exposition(self) -> str:
         """Prometheus text format (label values escaped per the text
-        exposition rules — see ``_escape_label``)."""
+        exposition rules — see ``_escape_label``).  Every family is
+        preceded by its ``# HELP`` / ``# TYPE`` metadata lines."""
 
         lines = []
+        emitted: set = set()
         with self._lock:
             for (name, labels), v in sorted(self._counters.items()):
+                self._header(lines, emitted, name, "counter")
                 label_s = _label_str(labels)
                 lines.append(f"{name}{{{label_s}}} {v}" if label_s else f"{name} {v}")
             for (name, labels), v in sorted(self._gauges.items()):
+                self._header(lines, emitted, name, "gauge")
                 label_s = _label_str(labels)
                 lines.append(f"{name}{{{label_s}}} {v}" if label_s else f"{name} {v}")
             for name, vals in sorted(self._observations.items()):
+                self._header(lines, emitted, name, "summary")
                 lines.append(f"{name}_count {len(vals)}")
                 lines.append(f"{name}_sum {sum(vals)}")
             for (name, labels), (bks, counts, total, n) in sorted(
                 self._histograms.items()
             ):
+                self._header(lines, emitted, name, "histogram")
                 label_s = _label_str(labels)
                 suffix = f",{label_s}" if label_s else ""
                 acc = 0
