@@ -20,16 +20,79 @@ import jax  # noqa: E402
 
 # RUN_TPU_TESTS=1 runs the @tpu-marked tests in a separate pytest
 # invocation against the real chip — don't pin CPU there.
+#: the shared warm store sessions SEED from and PUBLISH back to — but
+#: never write in place (see _isolated_cache_dir)
+_CACHE_BASE = "/tmp/tpujob-test-xla-cache"
+_session_cache_dir = None
+
+
+def _isolated_cache_dir() -> str:
+    """Per-SESSION compile-cache dir, seeded from the shared base.
+
+    The old design pointed every pytest run's XLA persistent cache at
+    one shared /tmp dir; concurrent runs writing it in place corrupted
+    SPMD executables twice (CHANGES.md PR 4 note: elastic NaNs,
+    checkpoint snapshot drift — cache-deserialized programs computing
+    wrong numerics).  Now each session compiles into its own fresh
+    tmpdir — no two XLA processes ever write the same directory — and
+    warmth survives two ways: the session dir is seeded by copying the
+    base (~10 MB, milliseconds), and new entries publish back at
+    session end via copy-to-temp + atomic os.replace (entries are
+    content-keyed, so concurrent publishers are last-wins-identical).
+    """
+
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="tpujob-xla-cache-")
+    try:
+        for name in os.listdir(_CACHE_BASE):
+            src = os.path.join(_CACHE_BASE, name)
+            if os.path.isfile(src):
+                shutil.copy2(src, os.path.join(d, name))
+    except OSError:
+        pass  # no base yet: cold session, publishes the first warm set
+    return d
+
+
+def _publish_cache(session_dir: str) -> None:
+    """Copy entries the session compiled into the shared base,
+    atomically (temp file + os.replace), then drop the session dir."""
+
+    import shutil
+
+    try:
+        os.makedirs(_CACHE_BASE, exist_ok=True)
+        for name in os.listdir(session_dir):
+            src = os.path.join(session_dir, name)
+            dst = os.path.join(_CACHE_BASE, name)
+            if not os.path.isfile(src) or os.path.exists(dst):
+                continue
+            tmp = os.path.join(_CACHE_BASE, f".tmp-{os.getpid()}-{name}")
+            try:
+                shutil.copy2(src, tmp)
+                os.replace(tmp, dst)
+            except OSError:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    shutil.rmtree(session_dir, ignore_errors=True)
+
+
 if not os.environ.get("RUN_TPU_TESTS"):
     jax.config.update("jax_platforms", "cpu")
     # persistent compilation cache: the suite is dominated by XLA CPU
     # compiles on a cold container (a fresh image turned the 3-minute
     # default tier into 20+ minutes); cache them across runs.  Scoped
     # to CPU runs only so the real-chip tier always measures honest
-    # compile times.
-    cache_dir = os.environ.get(
-        "TPU_OPERATOR_TEST_CACHE", "/tmp/tpujob-test-xla-cache"
-    )
+    # compile times.  TPU_OPERATOR_TEST_CACHE overrides with a fixed
+    # dir (no isolation/publish — the caller owns its lifecycle).
+    cache_dir = os.environ.get("TPU_OPERATOR_TEST_CACHE")
+    if cache_dir is None:
+        cache_dir = _session_cache_dir = _isolated_cache_dir()
     if cache_dir:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
@@ -83,6 +146,18 @@ def pytest_sessionstart(session):
 def pytest_sessionfinish(session, exitstatus):
     import json
     import time
+
+    if _session_cache_dir is not None:
+        # publish this session's new compile-cache entries into the
+        # shared base only when pytest ran to completion (0 = green,
+        # 1 = test failures — both leave valid artifacts); an
+        # interrupted/erroring session may hold partial writes
+        if int(exitstatus) in (0, 1):
+            _publish_cache(_session_cache_dir)
+        else:
+            import shutil
+
+            shutil.rmtree(_session_cache_dir, ignore_errors=True)
 
     if _session_t0 is None or os.environ.get("TPUJOB_NO_SUITE_RECORD"):
         return

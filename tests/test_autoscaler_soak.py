@@ -1,0 +1,429 @@
+"""Chaos soak e2e (ISSUE 7 acceptance): bursty serving traffic + PR 1
+fault injection against a serving job and a training job CONCURRENTLY,
+with the autoscaler closing the loop end to end.
+
+The scenario ("as many scenarios as you can imagine", ROADMAP item 3):
+
+1. Both jobs run.  A REAL trainer (gpt_tiny on an fsdp-8 CPU mesh)
+   writes the training job's summary series and saves an async
+   checkpoint whose durability stamp flows registry → summary series →
+   operator (the PR 6 scope-gap closure this PR's satellite ships).
+2. Burst: the PR 1 injector adds latency to real kubesim HTTP requests
+   that a miniature serving loop measures into the queue-wait SLO
+   family; the admission-queue gauge spikes.  The burn-rate alert
+   fires, the serving job goes Degraded, and the autoscaler scales
+   serving 1 → 3 with cooldown respected.  Simultaneously the stall
+   counter drives the training alert and the autoscaler sheds a
+   training replica — gated on the (fresh) checkpoint — bouncing the
+   replica set; the real trainer re-shards onto the 4-device survivor
+   mesh by restoring that checkpoint and TRAINS ON.
+3. Recovery: faults clear, stalls stop.  Alerts resolve, Degraded
+   clears, serving shrinks back to 1 and training grows back to its
+   declared size, each after the stabilization dwell.
+4. Completion: every pod succeeds; both jobs end Succeeded with live
+   health cleared.
+
+Assertions pin the acceptance contract: zero decision flapping (each
+job's decision sequence is exactly its planned phases, no
+oscillation), cooldown respected between consecutive decisions, every
+decision visible as a Normal event AND a GET /autoscaler entry AND an
+observedHealth.autoscaler block that round-trips through serde, and
+the clean-recovery end state.
+"""
+
+import json
+import re
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from tests.testutil import new_job
+from tf_operator_tpu.api.serde import job_from_dict, job_to_dict
+from tf_operator_tpu.api.types import (
+    AutoscalingPolicy,
+    AutoscalingSpec,
+    JobConditionType,
+    PodPhase,
+    ReplicaType,
+    SignalBinding,
+)
+from tf_operator_tpu.backend.fake import FakeCluster
+from tf_operator_tpu.backend.jobstore import JobStore
+from tf_operator_tpu.backend.kubesim import MiniApiServer
+from tf_operator_tpu.controller.autoscaler import Autoscaler
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.models import gpt_tiny, lm_loss
+from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+from tf_operator_tpu.parallel.checkpoint import TrainerCheckpointer
+from tf_operator_tpu.server.api import ApiServer
+from tf_operator_tpu.utils.alerts import AlertEngine, BurnRateRule, ThresholdRule
+from tf_operator_tpu.utils.flight import FlightRecorder
+from tf_operator_tpu.utils.metrics import SLO_BUCKETS, Metrics, StepSyncLedger
+from tf_operator_tpu.utils.summaries import ANNOTATION_SUMMARY_DIR, SummaryWriter
+
+VOCAB = 128
+FAULT_DELAY = 0.12
+#: serving SLO under test: p90 of queue wait <= 50 ms (clean local
+#: requests are ~2-5 ms, the injected fault adds 120 ms — margin both
+#: ways on a loaded CI box)
+OBJECTIVE_LE = 0.05
+BURN_WINDOWS = (0.5, 1.5)
+COOLDOWN = 0.5
+STABILIZATION = 2.0
+
+
+def _trainer(mesh, ids, **kw):
+    return Trainer(
+        gpt_tiny(vocab_size=VOCAB, max_len=ids.shape[1], mesh=mesh),
+        TrainerConfig(learning_rate=1e-2, summary_every=1),
+        mesh,
+        lm_loss,
+        {"input_ids": ids},
+        init_args=(ids,),
+        shardings="logical",
+        **kw,
+    )
+
+
+class SoakRig:
+    def __init__(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUJOB_FLIGHT_DIR", str(tmp_path / "flight"))
+        self.sim = MiniApiServer().start()
+        self.metrics = Metrics()
+        self.metrics.set_buckets("serve_queue_wait_seconds", SLO_BUCKETS)
+        self.engine = AlertEngine(
+            [
+                BurnRateRule(
+                    "serve-queue-wait-burn",
+                    family="serve_queue_wait_seconds",
+                    objective_le=OBJECTIVE_LE,
+                    objective_ratio=0.9,
+                    windows=BURN_WINDOWS,
+                    burn_threshold=3.0,
+                ),
+                ThresholdRule(
+                    "train-stall",
+                    metric="watchdog_stall_total",
+                    kind="counter_increase",
+                    threshold=0.0,
+                    window=3.0,
+                ),
+            ],
+            metrics=self.metrics,
+            recorder=FlightRecorder(),
+        )
+        self.autoscaler = Autoscaler(metrics=self.metrics, alerts=self.engine)
+        self.store = JobStore()
+        self.backend = FakeCluster(delivery="sync")
+        self.controller = TPUJobController(
+            self.store,
+            self.backend,
+            metrics=self.metrics,
+            alerts=self.engine,
+            autoscaler=self.autoscaler,
+        )
+        self.controller.reconciler.config.health_refresh_seconds = 0.0
+        self.api = ApiServer(
+            self.store,
+            self.backend,
+            self.metrics,
+            self.controller.recorder,
+            alerts=self.engine,
+            autoscaler=self.autoscaler,
+        )
+        self.api.start()
+
+    def http(self, route):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{self.api.port}{route}", timeout=10
+        ) as r:
+            return json.loads(r.read())
+
+    def add_jobs(self, summary_dir):
+        serving = new_job(name="serve", worker=1)
+        serving.spec.autoscaling = AutoscalingSpec(policies=[
+            AutoscalingPolicy(
+                replica_type=ReplicaType.WORKER,
+                mode="serving",
+                min_replicas=1, max_replicas=3,
+                cooldown_seconds=COOLDOWN,
+                stabilization_seconds=STABILIZATION,
+                signals=[
+                    SignalBinding(kind="alert", name="serve-queue-wait-burn"),
+                    SignalBinding(
+                        kind="gauge", name="serve_admission_queue_depth",
+                        threshold=64.0,
+                    ),
+                ],
+            )
+        ])
+        training = new_job(name="train", worker=4)
+        training.metadata.annotations[ANNOTATION_SUMMARY_DIR] = summary_dir
+        training.spec.autoscaling = AutoscalingSpec(policies=[
+            AutoscalingPolicy(
+                replica_type=ReplicaType.WORKER,
+                mode="training",
+                min_replicas=2, max_replicas=4,
+                cooldown_seconds=COOLDOWN,
+                stabilization_seconds=STABILIZATION,
+                max_checkpoint_age_seconds=600.0,
+                signals=[SignalBinding(kind="alert", name="train-stall")],
+            )
+        ])
+        for job in (serving, training):
+            self.store.create(job)
+        self.pump(0)
+        assert self.running_pods("serve") == 1
+        assert self.running_pods("train") == 4
+
+    def running_pods(self, name):
+        self.backend.run_all("default")
+        self.controller.sync_until_quiet()
+        return sum(
+            1
+            for p in self.backend.list_pods(
+                "default", {"tpujob.dist/job-name": name}
+            )
+            if p.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+        )
+
+    def pump(self, seconds, traffic=False, until=None):
+        """The soak's heartbeat: (optionally) one real HTTP request per
+        tick observed into the SLO family, alert + autoscaler
+        evaluation, pod scheduling, controller drain."""
+
+        url = f"{self.sim.url}/api/v1/namespaces/default/pods"
+        deadline = time.time() + seconds
+        while True:
+            if traffic:
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    r.read()
+                self.metrics.observe_histogram(
+                    "serve_queue_wait_seconds",
+                    time.perf_counter() - t0,
+                    model="soak",
+                )
+            self.engine.evaluate_once()
+            self.autoscaler.evaluate_once()
+            self.backend.run_all("default")
+            self.controller.sync_until_quiet()
+            if until is not None and until():
+                return True
+            if time.time() >= deadline:
+                return until is None
+            time.sleep(0.02)
+
+    def desired(self, name):
+        st = self.store.get("default", name).status
+        blk = (st.observed_health.get("autoscaler") or {}).get("Worker", {})
+        return blk.get("desiredReplicas")
+
+    def events(self, name):
+        return [
+            (e.reason, e.message)
+            for e in self.controller.recorder.for_object(f"default/{name}")
+        ]
+
+    def decisions(self, name):
+        return [
+            d for d in self.autoscaler.decisions()
+            if d.job_key == f"default/{name}"
+        ]
+
+    def stop(self):
+        self.api.stop()
+        self.controller.stop()
+        self.sim.stop()
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_burst_distress_recovery_completion(self, tmp_path, monkeypatch, capsys):
+        rig = SoakRig(tmp_path, monkeypatch)
+        try:
+            self._run(rig, tmp_path, capsys)
+        finally:
+            rig.stop()
+
+    def _run(self, rig, tmp_path, capsys):
+        # ---- phase 0: a REAL trainer backs the training job: its
+        # summary series carries the checkpoint durability stamp the
+        # autoscaler's resize gate reads (registry → series → operator)
+        sdir = str(tmp_path / "summaries")
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(0, VOCAB, size=(8, 32)), jnp.int32
+        )
+        mesh_a = make_mesh({"fsdp": 8})
+        writer = SummaryWriter(sdir)
+        tr = _trainer(
+            mesh_a, ids,
+            summary_writer=writer,
+            sync_ledger=StepSyncLedger(metrics=rig.metrics),
+        )
+        batch = {"input_ids": ids}
+        for _ in range(2):
+            tr.train_step(tr.shard_batch(batch))
+        ckpt = TrainerCheckpointer(
+            str(tmp_path / "ckpt"), metrics=rig.metrics
+        )
+        saved_step = ckpt.save(tr, wait=True)
+        assert saved_step == 2
+        # the post-save step's summary write republishes the stamp
+        loss_before = float(
+            tr.eval_step(tr.shard_batch(batch))["loss"]
+        )
+        tr.train_step(tr.shard_batch(batch))
+        writer.close()
+        ckpt.close()
+        rig.add_jobs(sdir)
+
+        # ---- phase 1: burst + faults → serving scales up, Degraded.
+        # Convergence = the WHOLE phase state: the gauge signal alone
+        # scales 1→3 in under a second, so waiting on replicas only
+        # would race the burn rule (it needs ~a long-window of traffic
+        # history) and the Degraded rollup it drives.
+        rig.sim.faults.add(
+            path="/pods", methods=["GET"], mode="latency", delay=FAULT_DELAY
+        )
+        rig.metrics.set("serve_admission_queue_depth", 200.0)
+
+        def burst_converged():
+            if rig.desired("serve") != 3:
+                return False
+            a = rig.engine.alert("serve-queue-wait-burn")
+            if a is None or a.state != "firing":
+                return False
+            deg = rig.store.get("default", "serve").status.condition(
+                JobConditionType.DEGRADED
+            )
+            return deg is not None and deg.status
+
+        assert rig.pump(20.0, traffic=True, until=burst_converged), (
+            "serving burst never converged: "
+            f"desired={rig.desired('serve')} "
+            f"alert={rig.engine.alert('serve-queue-wait-burn').state}"
+        )
+        assert rig.sim.faults.total_injected() > 0
+        assert rig.engine.alert("serve-queue-wait-burn").state == "firing"
+        assert rig.running_pods("serve") == 3
+        serve_job = rig.store.get("default", "serve")
+        deg = serve_job.status.condition(JobConditionType.DEGRADED)
+        assert deg is not None and deg.status and deg.reason == "SLOViolation"
+        # Running coexists with Degraded — health, not phase
+        assert serve_job.status.has_condition(JobConditionType.RUNNING)
+
+        # ---- phase 2: concurrent training distress → gated shed +
+        # re-shard bounce, while serving stays scaled up
+        rig.metrics.inc("watchdog_stall_total", heartbeat="train.loop")
+        assert rig.pump(
+            15.0, traffic=True, until=lambda: rig.desired("train") == 3
+        ), (
+            "training never shed a replica under distress: "
+            f"alert={rig.engine.alert('train-stall').state}"
+            f":{rig.engine.alert('train-stall').value} "
+            f"policies={rig.autoscaler.snapshot()['policies']} "
+            f"health={rig.store.get('default', 'train').status.observed_health}"
+        )
+        assert rig.running_pods("train") == 3
+        train_events = [r for r, _ in rig.events("train")]
+        assert "ScaledDown" in train_events
+        assert "Resharding" in train_events
+        (down,) = rig.decisions("train")
+        assert down.reshard and "checkpoint" in down.reason
+
+        # the REAL re-shard + resume: restore the checkpoint onto the
+        # 4-device survivor mesh and train on (tests/test_elastic.py's
+        # contract, exercised here as the autoscaler's consequence)
+        mesh_b = make_mesh(
+            {"dp": 2, "fsdp": 2}, devices=jax.devices()[:4]
+        )
+        tr2 = _trainer(mesh_b, ids)
+        ckpt2 = TrainerCheckpointer(str(tmp_path / "ckpt"))
+        assert ckpt2.restore_latest(tr2) == saved_step
+        loss_after = float(tr2.eval_step(tr2.shard_batch(batch))["loss"])
+        np.testing.assert_allclose(loss_after, loss_before, rtol=2e-2)
+        m = tr2.train_step(tr2.shard_batch(batch))
+        assert np.isfinite(float(m["loss"]))
+        ckpt2.close()
+
+        # ---- acceptance surfaces mid-storm: every decision shows on
+        # GET /autoscaler, the status block round-trips serde, the CLI
+        # renders both planes
+        snap = rig.http("/autoscaler")
+        assert {(d["job"], d["direction"]) for d in snap["decisions"]} >= {
+            ("default/serve", "up"), ("default/train", "down"),
+        }
+        job_d = job_to_dict(rig.store.get("default", "train"))
+        assert job_from_dict(job_d).status.observed_health["autoscaler"] == (
+            rig.store.get("default", "train").status.observed_health["autoscaler"]
+        )
+        from tf_operator_tpu.cmd import tpujob as tpujob_cli
+
+        server = f"http://127.0.0.1:{rig.api.port}"
+        assert tpujob_cli.main(["--server", server, "alerts"]) == 0
+        assert tpujob_cli.main(["--server", server, "autoscaler"]) == 0
+        cli_out = capsys.readouterr().out
+        assert "serve-queue-wait-burn" in cli_out
+        assert re.search(r"default/serve\s+Worker", cli_out)
+
+        # ---- phase 3: recovery — faults clear, stalls stop; alerts
+        # resolve, Degraded clears, both policies relax
+        rig.sim.faults.clear()
+        rig.metrics.set("serve_admission_queue_depth", 0.0)
+        assert rig.pump(
+            30.0, traffic=True,
+            until=lambda: rig.desired("serve") == 1
+            and rig.desired("train") == 4,
+        ), (
+            f"recovery incomplete: serve={rig.desired('serve')} "
+            f"train={rig.desired('train')} "
+            f"alerts={[ (a.rule.name, a.state) for a in rig.engine.alerts() ]}"
+        )
+        assert rig.running_pods("serve") == 1
+        assert rig.running_pods("train") == 4
+        rig.pump(0)
+        assert not rig.store.get("default", "serve").status.has_condition(
+            JobConditionType.DEGRADED
+        )
+
+        # ---- zero flapping: each job's decision sequence is exactly
+        # its planned phases — monotone up then down (serving), down
+        # then up (training) — and consecutive decisions respect the
+        # cooldown floor
+        serve_dirs = "".join(d.direction[0] for d in rig.decisions("serve"))
+        train_dirs = "".join(d.direction[0] for d in rig.decisions("train"))
+        assert re.fullmatch(r"u+d+", serve_dirs), serve_dirs
+        assert re.fullmatch(r"d+u+", train_dirs), train_dirs
+        for name in ("serve", "train"):
+            ds = rig.decisions(name)
+            for a, b in zip(ds, ds[1:]):
+                assert b.time - a.time >= COOLDOWN * 0.99, (
+                    f"{name}: decisions {a.to_dict()} -> {b.to_dict()} "
+                    "violate the cooldown"
+                )
+            reasons = [r for r, _ in rig.events(name)]
+            assert reasons.count("ScaledUp") + reasons.count(
+                "ScaledDown"
+            ) == len(ds), "every decision must be exactly one Normal event"
+
+        # ---- phase 4: completion — all pods succeed, jobs end
+        # Succeeded, live health (incl. the autoscaler block) cleared
+        for name in ("serve", "train"):
+            for p in rig.backend.list_pods(
+                "default", {"tpujob.dist/job-name": name}
+            ):
+                if p.phase in (PodPhase.PENDING, PodPhase.RUNNING):
+                    rig.backend.succeed_pod("default", p.metadata.name)
+        rig.controller.sync_until_quiet()
+        for name in ("serve", "train"):
+            st = rig.store.get("default", name).status
+            assert st.has_condition(JobConditionType.SUCCEEDED), name
+            assert not st.has_condition(JobConditionType.DEGRADED), name
+            assert st.observed_health == {}, name

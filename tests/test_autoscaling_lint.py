@@ -1,0 +1,102 @@
+"""Lint gate: stock autoscaling policies can never silently orphan
+(ISSUE 7 satellite — the test_alert_rules_lint.py pattern extended to
+the act layer).
+
+An autoscaling policy binds signals by NAME: an ``alert`` binding names
+a rule in the default alert set, a ``gauge`` binding names an emitted
+metric family.  Renaming either would leave the policy evaluating a
+signal nobody drives — it would simply never scale again, the act-layer
+twin of an orphaned alert rule.  This gate reuses the alert lint's AST
+collector (every literal metric-family write in the package + examples)
+plus the default rule set's names, and asserts every stock policy's
+bindings resolve; it also pins the structural validator against the
+stock policies so a bad template can never ship.
+"""
+
+import pytest
+
+from tests.test_alert_rules_lint import collect_emitted_families
+from tests.testutil import new_job
+from tf_operator_tpu.api.types import AutoscalingSpec, ReplicaType
+from tf_operator_tpu.api.validation import validate
+from tf_operator_tpu.controller.autoscaler import (
+    default_serving_policy,
+    default_training_policy,
+)
+from tf_operator_tpu.utils.alerts import default_rules
+
+
+def stock_policies():
+    return [default_serving_policy(), default_training_policy()]
+
+
+def test_stock_policy_signals_resolve_to_live_rules_or_families():
+    families = collect_emitted_families()
+    rule_names = {r.name for r in default_rules()}
+    problems = []
+    for pol in stock_policies():
+        for sig in pol.signals:
+            if sig.kind == "alert":
+                if sig.name not in rule_names:
+                    problems.append(
+                        f"policy {pol.mode}/{pol.replica_type.value} binds "
+                        f"alert {sig.name!r} which is not in the default "
+                        "rule set (utils/alerts.default_rules)"
+                    )
+            elif sig.kind == "gauge":
+                if sig.name not in families:
+                    problems.append(
+                        f"policy {pol.mode}/{pol.replica_type.value} binds "
+                        f"gauge {sig.name!r} which no code emits"
+                    )
+            else:
+                problems.append(
+                    f"policy {pol.mode}/{pol.replica_type.value} has "
+                    f"unknown signal kind {sig.kind!r}"
+                )
+    assert not problems, "orphaned autoscaling bindings:\n  " + "\n  ".join(
+        problems
+    )
+
+
+def test_stock_policies_pass_spec_validation():
+    for pol in stock_policies():
+        job = new_job(name="lint", worker=2)
+        job.spec.autoscaling = AutoscalingSpec(policies=[pol])
+        validate(job)  # raises on a structurally bad template
+
+
+def test_autoscaler_metric_families_are_emitted_with_expected_labels():
+    """The autoscaler's own exposition (the families dashboards and
+    future alert rules may bind) is collectable by the AST gate — so
+    THOSE can be rule/policy targets without orphaning either."""
+
+    families = collect_emitted_families()
+    assert "direction" in families["autoscaler_decisions_total"]
+    assert "reason" in families["autoscaler_skipped_total"]
+    assert {"job", "replicaType"} <= families["autoscaler_desired_replicas"]
+    assert "autoscaler_evaluations_total" in families
+    assert "tpujob_reshards_total" in families
+
+
+def test_lint_catches_a_renamed_signal():
+    """Planted orphan: a policy binding a gauge nobody emits must be
+    reported (the gate's own regression test)."""
+
+    families = collect_emitted_families()
+    pol = default_serving_policy()
+    pol.signals[1].name = "metric_that_was_renamed_depth"
+    assert pol.signals[1].name not in families
+
+
+def test_stock_policy_checkpoint_gate_is_consistent_with_alert_rule():
+    """The training policy's resize gate and the checkpoint-stale alert
+    read the same stamp: the gate threshold must not be LOOSER than the
+    alert threshold, or the autoscaler would happily resize a job whose
+    checkpoint the alert layer already calls stale."""
+
+    stale_rule = next(
+        r for r in default_rules() if r.name == "checkpoint-stale"
+    )
+    pol = default_training_policy()
+    assert pol.max_checkpoint_age_seconds <= stale_rule.threshold

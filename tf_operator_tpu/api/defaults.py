@@ -71,6 +71,12 @@ def set_defaults(job: TPUJob) -> TPUJob:
     if ReplicaType.TPU_SLICE in spec.replica_specs:
         spec.enable_gang_scheduling = True
 
+    if spec.autoscaling is not None:
+        # an autoscaled worker set IS the v1.x dynamic-worker feature
+        # (SURVEY.md §2b "Elastic") — flip the flag so consumers keying
+        # on it see the truth
+        spec.enable_dynamic_worker = True
+
     if spec.enable_gang_scheduling and rp.scheduling_policy is None:
         # min_member stays None unless the user pinned it: the reconciler
         # resolves None to the job's *current* total replicas each sync,

@@ -12,6 +12,8 @@ from __future__ import annotations
 from typing import Any, Dict
 
 from tf_operator_tpu.api.types import (
+    AutoscalingPolicy,
+    AutoscalingSpec,
     CleanPodPolicy,
     Container,
     JobCondition,
@@ -25,6 +27,7 @@ from tf_operator_tpu.api.types import (
     RestartPolicy,
     RunPolicy,
     SchedulingPolicy,
+    SignalBinding,
     SuccessPolicy,
     TPUJob,
     TPUJobSpec,
@@ -99,6 +102,72 @@ def _template_to_dict(t: PodTemplateSpec) -> Dict[str, Any]:
     return out
 
 
+def _autoscaling_from_dict(d: Dict[str, Any]) -> AutoscalingSpec:
+    policies = []
+    for p in d.get("policies", []):
+        defaults = AutoscalingPolicy()
+        policies.append(
+            AutoscalingPolicy(
+                replica_type=ReplicaType.from_str(p.get("replicaType", "Worker")),
+                mode=p.get("mode", defaults.mode),
+                min_replicas=int(p.get("minReplicas", defaults.min_replicas)),
+                max_replicas=int(p.get("maxReplicas", defaults.max_replicas)),
+                step=int(p.get("step", defaults.step)),
+                cooldown_seconds=float(
+                    p.get("cooldownSeconds", defaults.cooldown_seconds)
+                ),
+                stabilization_seconds=float(
+                    p.get("stabilizationSeconds", defaults.stabilization_seconds)
+                ),
+                hysteresis_ratio=float(
+                    p.get("hysteresisRatio", defaults.hysteresis_ratio)
+                ),
+                max_checkpoint_age_seconds=float(
+                    p.get(
+                        "maxCheckpointAgeSeconds",
+                        defaults.max_checkpoint_age_seconds,
+                    )
+                ),
+                signals=[
+                    SignalBinding(
+                        kind=s.get("kind", "alert"),
+                        name=s.get("name", ""),
+                        threshold=float(s.get("threshold", 0.0)),
+                        labels=dict(s.get("labels", {})),
+                    )
+                    for s in p.get("signals", [])
+                ],
+            )
+        )
+    return AutoscalingSpec(policies=policies)
+
+
+def _autoscaling_to_dict(a: AutoscalingSpec) -> Dict[str, Any]:
+    out = []
+    for p in a.policies:
+        pd: Dict[str, Any] = {
+            "replicaType": p.replica_type.value,
+            "mode": p.mode,
+            "minReplicas": p.min_replicas,
+            "maxReplicas": p.max_replicas,
+            "step": p.step,
+            "cooldownSeconds": p.cooldown_seconds,
+            "stabilizationSeconds": p.stabilization_seconds,
+            "hysteresisRatio": p.hysteresis_ratio,
+            "maxCheckpointAgeSeconds": p.max_checkpoint_age_seconds,
+            "signals": [],
+        }
+        for s in p.signals:
+            sd: Dict[str, Any] = {"kind": s.kind, "name": s.name}
+            if s.kind == "gauge":
+                sd["threshold"] = s.threshold
+                if s.labels:
+                    sd["labels"] = dict(s.labels)
+            pd["signals"].append(sd)
+        out.append(pd)
+    return {"policies": out}
+
+
 def job_from_dict(d: Dict[str, Any]) -> TPUJob:
     meta_d = d.get("metadata", {})
     spec_d = d.get("spec", {})
@@ -144,6 +213,11 @@ def job_from_dict(d: Dict[str, Any]) -> TPUJob:
             success_policy=SuccessPolicy(spec_d.get("successPolicy", "")),
             enable_gang_scheduling=bool(spec_d.get("enableGangScheduling", False)),
             enable_dynamic_worker=bool(spec_d.get("enableDynamicWorker", False)),
+            autoscaling=(
+                _autoscaling_from_dict(spec_d["autoscaling"])
+                if spec_d.get("autoscaling")
+                else None
+            ),
         ),
         status=status_from_dict(d["status"]) if "status" in d else TPUJobStatus(),
     )
@@ -184,6 +258,8 @@ def job_to_dict(job: TPUJob) -> Dict[str, Any]:
         spec_d["enableGangScheduling"] = True
     if spec.enable_dynamic_worker:
         spec_d["enableDynamicWorker"] = True
+    if spec.autoscaling is not None:
+        spec_d["autoscaling"] = _autoscaling_to_dict(spec.autoscaling)
 
     out: Dict[str, Any] = {
         "apiVersion": API_VERSION,

@@ -280,6 +280,108 @@ class ReplicaSpec:
             return 1
 
 
+#: AutoscalingPolicy.mode values
+AUTOSCALING_MODES = ("serving", "training")
+
+#: SignalBinding.kind values
+SIGNAL_KINDS = ("alert", "gauge")
+
+
+@dataclass
+class SignalBinding:
+    """One scaling signal: either a registered alert rule (breaching =
+    the rule is firing) or a gauge metric family (breaching = worst
+    matching level > ``threshold``).  The autoscaler
+    (controller/autoscaler.py) evaluates these against the operator's
+    alert engine and metrics registry."""
+
+    kind: str = "alert"
+    name: str = ""
+    #: gauge kind only: breach when the level exceeds this
+    threshold: float = 0.0
+    #: gauge kind only: label filter (subset match, like alert rules)
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def clone(self) -> "SignalBinding":
+        return SignalBinding(
+            kind=self.kind,
+            name=self.name,
+            threshold=self.threshold,
+            labels=dict(self.labels),
+        )
+
+
+@dataclass
+class AutoscalingPolicy:
+    """Declarative elastic-scaling policy for one replica set
+    (SURVEY.md §2b "Elastic" — the reference reserved scale-in/out of
+    replica sets for v1.x; this is the TPU-native realisation).
+
+    ``mode`` picks the response direction:
+
+    - ``serving`` scales INTO pressure: any breaching signal adds
+      replicas (stateless serving replicas behind a shared admission
+      queue); sustained quiet shrinks back toward ``min_replicas``.
+    - ``training`` scales AWAY from distress: a breaching signal
+      (stall/preemption alerts) sheds replicas so the job re-shards
+      onto the survivors and resumes from checkpoint; sustained quiet
+      grows back toward the spec's declared replica count.  Every
+      training resize restarts the replica set (the world size is
+      baked into each pod's bootstrap env) and is gated by
+      ``max_checkpoint_age_seconds`` — a resize may only throw away
+      work a sufficiently fresh checkpoint bounds.
+    """
+
+    replica_type: ReplicaType = ReplicaType.WORKER
+    mode: str = "serving"
+    min_replicas: int = 1
+    max_replicas: int = 1
+    #: replicas added/removed per decision
+    step: int = 1
+    #: floor between consecutive decisions for this policy (both
+    #: directions share it — half of the anti-flap story)
+    cooldown_seconds: float = 60.0
+    #: every signal must be quiet this long before the relief direction
+    #: engages (temporal hysteresis — the other half)
+    stabilization_seconds: float = 120.0
+    #: gauge signals only: level hysteresis — a breached gauge counts
+    #: as quiet only once it drops to <= threshold * ratio, so a level
+    #: hovering at the threshold cannot flap decisions
+    hysteresis_ratio: float = 0.5
+    #: training mode only: resize safety gate — skip any resize unless
+    #: the job's checkpoint is at most this old (unknown age = skip)
+    max_checkpoint_age_seconds: float = 600.0
+    signals: List[SignalBinding] = field(default_factory=list)
+
+    def clone(self) -> "AutoscalingPolicy":
+        return AutoscalingPolicy(
+            replica_type=self.replica_type,
+            mode=self.mode,
+            min_replicas=self.min_replicas,
+            max_replicas=self.max_replicas,
+            step=self.step,
+            cooldown_seconds=self.cooldown_seconds,
+            stabilization_seconds=self.stabilization_seconds,
+            hysteresis_ratio=self.hysteresis_ratio,
+            max_checkpoint_age_seconds=self.max_checkpoint_age_seconds,
+            signals=[s.clone() for s in self.signals],
+        )
+
+
+@dataclass
+class AutoscalingSpec:
+    policies: List[AutoscalingPolicy] = field(default_factory=list)
+
+    def policy_for(self, rtype: ReplicaType) -> Optional[AutoscalingPolicy]:
+        for p in self.policies:
+            if p.replica_type is rtype:
+                return p
+        return None
+
+    def clone(self) -> "AutoscalingSpec":
+        return AutoscalingSpec(policies=[p.clone() for p in self.policies])
+
+
 @dataclass
 class TPUJobSpec:
     replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
@@ -287,8 +389,12 @@ class TPUJobSpec:
     success_policy: SuccessPolicy = SuccessPolicy.DEFAULT
     #: enable gang (all-or-nothing) scheduling for this job
     enable_gang_scheduling: bool = False
-    #: later v1.x scale-in/out for workers (SURVEY.md §2b "Elastic")
+    #: v1.x scale-in/out for workers (SURVEY.md §2b "Elastic") —
+    #: defaulted True whenever ``autoscaling`` is declared
     enable_dynamic_worker: bool = False
+    #: elastic autoscaling policies (controller/autoscaler.py); None =
+    #: the operator never touches this job's replica counts
+    autoscaling: Optional[AutoscalingSpec] = None
 
     def total_replicas(self) -> int:
         return sum(int(rs.replicas or 0) for rs in self.replica_specs.values())
@@ -319,12 +425,26 @@ class TPUJobSpec:
             success_policy=self.success_policy,
             enable_gang_scheduling=self.enable_gang_scheduling,
             enable_dynamic_worker=self.enable_dynamic_worker,
+            autoscaling=self.autoscaling.clone() if self.autoscaling else None,
         )
 
 
 # ---------------------------------------------------------------------------
 # Status objects
 # ---------------------------------------------------------------------------
+
+
+def _copy_jsonish(value):
+    """Recursive copy of a JSON-shaped tree (dict/list/scalars) — the
+    observedHealth block now nests (the ``autoscaler`` sub-block), and
+    a shallow clone would alias the nested containers across status
+    snapshots, defeating the old-vs-new status diff."""
+
+    if isinstance(value, dict):
+        return {k: _copy_jsonish(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_copy_jsonish(v) for v in value]
+    return value
 
 
 @dataclass
@@ -382,10 +502,7 @@ class TPUJobStatus:
             start_time=self.start_time,
             completion_time=self.completion_time,
             restart_count=self.restart_count,
-            observed_health={
-                k: (list(v) if isinstance(v, list) else v)
-                for k, v in self.observed_health.items()
-            },
+            observed_health=_copy_jsonish(self.observed_health),
         )
 
     def has_condition(self, ctype: JobConditionType, status: bool = True) -> bool:

@@ -12,12 +12,15 @@ SURVEY.md §2b row "Parameter-server").
 
 from __future__ import annotations
 
+import math
 import re
 from typing import List
 
 from tf_operator_tpu.api.types import (
+    AUTOSCALING_MODES,
     CHIEF_LIKE,
     DEFAULT_CONTAINER_NAME,
+    SIGNAL_KINDS,
     ReplicaType,
     TPUJob,
 )
@@ -171,5 +174,93 @@ def validate(job: TPUJob) -> None:
             "sharding instead; SURVEY.md §2b)"
         )
 
+    if spec.autoscaling is not None:
+        _validate_autoscaling(spec, problems)
+
     if problems:
         raise ValidationError(problems)
+
+
+def _finite_nonneg(v) -> bool:
+    return isinstance(v, (int, float)) and math.isfinite(v) and v >= 0
+
+
+def _validate_autoscaling(spec, problems: List[str]) -> None:
+    """Structural checks on ``spec.autoscaling`` — admission must
+    reject what the autoscaler's evaluation loop would otherwise act
+    nonsensically on (negative bounds, unknown modes, an empty signal
+    list that could never trigger).  Whether a bound ALERT name exists
+    is an engine-runtime property the static lint gate covers for the
+    stock policy set (tests/test_autoscaling_lint.py)."""
+
+    seen_types = set()
+    for i, pol in enumerate(spec.autoscaling.policies):
+        prefix = f"autoscaling.policies[{i}]"
+        if not isinstance(pol.replica_type, ReplicaType):
+            problems.append(f"{prefix}: unknown replica type {pol.replica_type!r}")
+            continue
+        if pol.replica_type not in spec.replica_specs:
+            problems.append(
+                f"{prefix}: replicaType {pol.replica_type.value} has no "
+                "replica spec to scale"
+            )
+        if pol.replica_type in CHIEF_LIKE:
+            problems.append(
+                f"{prefix}: chief/master replicas cannot be autoscaled"
+            )
+        if pol.replica_type in seen_types:
+            problems.append(
+                f"{prefix}: duplicate policy for {pol.replica_type.value}"
+            )
+        seen_types.add(pol.replica_type)
+        if pol.mode not in AUTOSCALING_MODES:
+            problems.append(
+                f"{prefix}.mode must be one of {AUTOSCALING_MODES}, "
+                f"got {pol.mode!r}"
+            )
+        if not (
+            isinstance(pol.min_replicas, int)
+            and isinstance(pol.max_replicas, int)
+            and 0 <= pol.min_replicas <= pol.max_replicas
+            and pol.max_replicas >= 1
+        ):
+            problems.append(
+                f"{prefix}: need 0 <= minReplicas <= maxReplicas "
+                f"(got {pol.min_replicas!r}..{pol.max_replicas!r})"
+            )
+        if not (isinstance(pol.step, int) and pol.step >= 1):
+            problems.append(f"{prefix}.step must be an integer >= 1")
+        if not _finite_nonneg(pol.cooldown_seconds):
+            problems.append(f"{prefix}.cooldownSeconds must be finite and >= 0")
+        if not _finite_nonneg(pol.stabilization_seconds):
+            problems.append(
+                f"{prefix}.stabilizationSeconds must be finite and >= 0"
+            )
+        if not (
+            isinstance(pol.hysteresis_ratio, (int, float))
+            and math.isfinite(pol.hysteresis_ratio)
+            and 0 < pol.hysteresis_ratio <= 1
+        ):
+            problems.append(f"{prefix}.hysteresisRatio must be in (0, 1]")
+        if not (
+            _finite_nonneg(pol.max_checkpoint_age_seconds)
+            and pol.max_checkpoint_age_seconds > 0
+        ):
+            problems.append(
+                f"{prefix}.maxCheckpointAgeSeconds must be finite and > 0"
+            )
+        if not pol.signals:
+            problems.append(f"{prefix}.signals must bind at least one signal")
+        for j, sig in enumerate(pol.signals):
+            spre = f"{prefix}.signals[{j}]"
+            if sig.kind not in SIGNAL_KINDS:
+                problems.append(
+                    f"{spre}.kind must be one of {SIGNAL_KINDS}, got {sig.kind!r}"
+                )
+            if not sig.name:
+                problems.append(f"{spre}.name is required")
+            if sig.kind == "gauge" and not (
+                isinstance(sig.threshold, (int, float))
+                and math.isfinite(sig.threshold)
+            ):
+                problems.append(f"{spre}.threshold must be finite")

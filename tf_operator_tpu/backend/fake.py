@@ -242,6 +242,46 @@ class FakeCluster(ClusterBackend):
                 g.phase = PodGroupPhase.GRANTED
                 self._emit(WatchEventType.MODIFIED, "PodGroup", g)
 
+    def set_total_chips(self, total_chips: Optional[int]) -> List[str]:
+        """Resize the simulated chip pool (None = unlimited); returns
+        names of gangs revoked by a shrink.  Shrinking preempts the
+        most-recently granted gangs until the rest fit (LIFO — the
+        oldest work keeps its grant) and FAILS their live pods (the
+        kubesim twin kills the processes; losing the grant without
+        losing the pods would oversubscribe the pool and hide the
+        failures the autoscaler's distress signals key on); growing
+        regrants pending gangs.  The kubesim /_capacity knob's in-proc
+        twin — the capacity add/remove scenario the elastic autoscaler
+        acts on."""
+
+        revoked: List[str] = []
+        with self._lock:
+            self.total_chips = total_chips
+            if total_chips is not None:
+                granted = [
+                    g for g in self._groups.values()
+                    if g.phase is PodGroupPhase.GRANTED
+                ]
+                in_use = sum(g.chip_request for g in granted)
+                for g in reversed(granted):
+                    if in_use <= total_chips:
+                        break
+                    g.phase = PodGroupPhase.PENDING
+                    in_use -= g.chip_request
+                    revoked.append(g.metadata.name)
+                    self._emit(WatchEventType.MODIFIED, "PodGroup", g)
+                gone = set(revoked)
+                for pod in self._pods.values():
+                    gname = pod.metadata.annotations.get(ANNOTATION_GANG_GROUP)
+                    if gname in gone and pod.phase in (
+                        PodPhase.PENDING, PodPhase.RUNNING
+                    ):
+                        pod.phase = PodPhase.FAILED
+                        pod.exit_code = 137  # SIGKILL: preempted
+                        self._emit(WatchEventType.MODIFIED, "Pod", pod)
+            self._regrant_pending_groups()
+        return revoked
+
     # -- kubelet/scheduler simulation helpers (test-facing) -----------------
 
     def _gang_blocked(self, pod: Pod) -> bool:

@@ -519,6 +519,16 @@ class MiniApiServer:
             from tf_operator_tpu.utils.alerts import default_engine
 
             return self._reply(h, 200, default_engine.snapshot())
+        if u.path == "/autoscaler" and method == "GET":
+            # the process-global autoscaler's decisions + policy state
+            # (controller/autoscaler.py) — debug surface, never injected
+            from tf_operator_tpu.controller.autoscaler import (
+                default_autoscaler,
+            )
+
+            return self._reply(h, 200, default_autoscaler.snapshot())
+        if u.path == "/_capacity":
+            return self._admin_capacity(h, method)
         act = self.faults.decide(method, h.path)
         if act is not None:
             span.set_attribute("fault", act[0])
@@ -624,6 +634,101 @@ class MiniApiServer:
         return self._reply(
             h, 405, self._status(405, "MethodNotAllowed", method)
         )
+
+    def _admin_capacity(self, h, method: str) -> None:
+        """Capacity admin endpoint (never itself injected — the
+        /_faults contract): GET reports total/granted chips, POST
+        ``{"totalChips": N}`` (null = unlimited) resizes the simulated
+        accelerator pool.  Shrinking PREEMPTS: most-recently granted
+        gangs are revoked until the rest fit, their running pods are
+        killed (they reap as Failed) — the capacity-loss scenario the
+        elastic autoscaler's training policies exist to survive.
+        Growing regrants pending gangs — "capacity returns"."""
+
+        if method == "GET":
+            with self.store.lock:
+                granted = sum(
+                    self._group_chips(o)
+                    for key, o in self.store.objects.items()
+                    if key[0] == "PodGroup"
+                    and o.get("status", {}).get("phase") == "Granted"
+                )
+            return self._reply(
+                h, 200, {"totalChips": self.total_chips, "grantedChips": granted}
+            )
+        if method == "POST":
+            length = int(h.headers.get("Content-Length", "0"))
+            try:
+                spec = json.loads(h.rfile.read(length) or b"{}")
+                total = spec.get("totalChips")
+                if total is not None:
+                    total = int(total)
+                    if total < 0:
+                        raise ValueError("totalChips must be >= 0 or null")
+            except (ValueError, TypeError) as e:
+                return self._reply(
+                    h, 400, self._status(400, "BadRequest", repr(e))
+                )
+            revoked = self.set_total_chips(total)
+            return self._reply(
+                h, 200, {"totalChips": self.total_chips, "revoked": revoked}
+            )
+        return self._reply(
+            h, 405, self._status(405, "MethodNotAllowed", method)
+        )
+
+    def set_total_chips(self, total_chips: Optional[int]) -> List[str]:
+        """Resize the simulated chip pool (None = unlimited); returns
+        the names of gang groups revoked by a shrink.  In-process twin
+        of the /_capacity admin route."""
+
+        to_kill: List[Tuple[str, str, str]] = []
+        revoked: List[str] = []
+        with self.store.lock:
+            self.total_chips = total_chips
+            if total_chips is not None:
+                # revoke most-recently granted gangs until the rest fit
+                # (LIFO preemption — deterministic, and the oldest work
+                # keeps its grant, the volcano-ish convention)
+                granted = [
+                    (key, o)
+                    for key, o in self.store.objects.items()
+                    if key[0] == "PodGroup"
+                    and o.get("status", {}).get("phase") == "Granted"
+                ]
+                in_use = sum(self._group_chips(o) for _, o in granted)
+                for key, o in reversed(granted):
+                    if in_use <= total_chips:
+                        break
+                    o["status"]["phase"] = "Pending"
+                    in_use -= self._group_chips(o)
+                    revoked.append(key[2])
+                    self.store.bump("PodGroup", "MODIFIED", o)
+                    # preempt the gang's pods: kill their processes so
+                    # the kubelet reap marks them Failed with a signal
+                    # exit — exactly what losing the slice looks like
+                    ns = key[1]
+                    for pkey, pobj in self.store.objects.items():
+                        if pkey[0] != "Pod" or pkey[1] != ns:
+                            continue
+                        ann = (
+                            pobj.get("metadata", {}).get("annotations", {})
+                            or {}
+                        )
+                        gname = ann.get(ANNOTATION_GANG_GROUP) or ann.get(
+                            "scheduling.k8s.io/group-name"
+                        )
+                        if gname == key[2] and pkey in self._procs:
+                            to_kill.append(pkey)
+            self._regrant_locked()
+        for pkey in to_kill:
+            proc = self._procs.get(pkey)
+            if proc is not None and proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        return revoked
 
     # -- verbs --------------------------------------------------------------
 

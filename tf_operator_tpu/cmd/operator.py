@@ -297,9 +297,20 @@ def main(argv=None) -> int:
     # not a never-started twin.
     from tf_operator_tpu.utils.alerts import default_engine as alert_engine
 
+    # elastic autoscaler (controller/autoscaler.py): consumes the alert
+    # engine + metrics registry and scales jobs that declare
+    # spec.autoscaling — serving replicas into pressure, training
+    # replicas elastically (re-shard + checkpoint resume) away from
+    # distress.  The PROCESS-GLOBAL default_autoscaler for the same
+    # reason the engine is: kubesim's /autoscaler debug route must
+    # report the instance that actually runs.
+    from tf_operator_tpu.controller.autoscaler import (
+        default_autoscaler as autoscaler,
+    )
+
     controller = TPUJobController(
         store, backend, config=config, recorder=recorder,
-        alerts=alert_engine,
+        alerts=alert_engine, autoscaler=autoscaler,
     )
     api = ApiServer(
         store,
@@ -307,6 +318,7 @@ def main(argv=None) -> int:
         controller.metrics,
         controller.recorder,
         alerts=alert_engine,
+        autoscaler=autoscaler,
         host=args.host,
         port=args.monitoring_port,
         namespace=args.namespace,
@@ -340,6 +352,7 @@ def main(argv=None) -> int:
     flight.install(metrics=controller.metrics)
     maybe_start_from_env(metrics=controller.metrics)
     alert_engine.start()
+    autoscaler.start()
 
     # monitoring/API surface is up regardless of leadership (reference
     # parity: the monitoring port serves on standbys too); only the
@@ -369,6 +382,7 @@ def main(argv=None) -> int:
                 )
             stop.wait(0.5)
     finally:
+        autoscaler.stop()
         alert_engine.stop()
         if controller_started:
             controller.stop()

@@ -10,6 +10,8 @@ This client speaks the operator's HTTP job API instead:
     tpujob describe NAME [-n ns]         # kubectl describe (status + events)
     tpujob delete NAME [-n ns]           # kubectl delete
     tpujob logs NAME POD [-n ns]         # kubectl logs (local backend)
+    tpujob alerts [RULE]                 # alert-engine state (firing first)
+    tpujob autoscaler [JOB]              # scale decisions + policy state
     tpujob compile -f job.yaml           # TPUJob -> real Kubernetes YAML
                                          # (backend/gke.py; offline, no server)
 
@@ -160,6 +162,18 @@ def cmd_describe(args) -> int:
         ):
             if key in health:
                 print(f"  {label + ':':<18}{health[key]}")
+        for rtype, blk in (health.get("autoscaler") or {}).items():
+            line = (
+                f"{blk.get('desiredReplicas')} desired "
+                f"(spec {blk.get('specReplicas')}, "
+                f"{blk.get('minReplicas')}..{blk.get('maxReplicas')})"
+            )
+            if blk.get("breaching"):
+                line += "  BREACHING"
+            if blk.get("lastDecision"):
+                d = blk["lastDecision"]
+                line += f"  last: {d.get('direction')} -> {d.get('to')}"
+            print(f"  {'autoscale/' + rtype + ':':<18}{line}")
     events = _request(
         "GET", _jobs_url(args.server, args.namespace, args.name, "events")
     )["items"]
@@ -193,6 +207,97 @@ def cmd_logs(args) -> int:
         _jobs_url(args.server, args.namespace, args.name, f"pods/{args.pod}/log"),
     )
     print(out if isinstance(out, str) else json.dumps(out))
+    return 0
+
+
+def _fmt_signal_values(value: dict) -> str:
+    return " ".join(
+        f"{k}={v:.2f}" if isinstance(v, float) else f"{k}={v}"
+        for k, v in value.items()
+    )
+
+
+def cmd_alerts(args) -> int:
+    """kubectl-get-style view of GET /alerts: the server orders firing
+    first (the Degraded-first convention — what needs acting on leads);
+    with a RULE argument, a describe-style single-rule dump."""
+
+    snap = _request("GET", f"{args.server}/alerts")
+    items = snap.get("alerts", [])
+    if args.rule:
+        matches = [a for a in items if a["name"] == args.rule]
+        if not matches:
+            raise SystemExit(f"error: no alert rule named {args.rule!r}")
+        a = matches[0]
+        print(f"Name:      {a['name']}")
+        print(f"State:     {a['state']}")
+        print(f"Kind:      {a['kind']}")
+        print(f"Metric:    {a['metric']}")
+        print(f"Severity:  {a['severity']}")
+        print(f"Episodes:  {a.get('episodes', 0)}")
+        if a.get("labels"):
+            print(f"Labels:    {a['labels']}")
+        if a.get("value"):
+            print(f"Value:     {_fmt_signal_values(a['value'])}")
+        if a.get("message"):
+            print(f"Message:   {a['message']}")
+        return 0
+    fmt = "{:<28} {:<10} {:<8} {:<10} {}"
+    print(fmt.format("RULE", "STATE", "SEVERITY", "EPISODES", "VALUE"))
+    for a in items:
+        print(
+            fmt.format(
+                a["name"], a["state"], a["severity"],
+                str(a.get("episodes", 0)),
+                _fmt_signal_values(a.get("value", {})),
+            )
+        )
+    firing = snap.get("firing", [])
+    if firing:
+        print(f"\n{len(firing)} firing: {', '.join(firing)}")
+    return 0
+
+
+def cmd_autoscaler(args) -> int:
+    """GET /autoscaler: per-policy live state (breaching first, the
+    server's ordering) and the decision log newest first; with a JOB
+    argument, filtered to that job's policies and decisions."""
+
+    snap = _request("GET", f"{args.server}/autoscaler")
+    policies = snap.get("policies", [])
+    decisions = snap.get("decisions", [])
+    if args.job:
+        want = args.job if "/" in args.job else f"{args.namespace}/{args.job}"
+        policies = [p for p in policies if p["job"] == want]
+        decisions = [d for d in decisions if d["job"] == want]
+    fmt = "{:<24} {:<10} {:<8} {:<9} {:<8} {}"
+    print(fmt.format("JOB", "TYPE", "DESIRED", "BREACHING", "RESHARD", "SIGNALS"))
+    for p in policies:
+        sig = " ".join(
+            f"{name}:{'breach' if v.get('breaching') else 'ok'}"
+            for name, v in sorted(p.get("signals", {}).items())
+        )
+        print(
+            fmt.format(
+                p["job"], p["replicaType"],
+                "-" if p.get("desiredReplicas") is None else str(p["desiredReplicas"]),
+                "yes" if p.get("breaching") else "no",
+                "yes" if p.get("reshardPending") else "no",
+                sig,
+            )
+        )
+        if p.get("lastSkip"):
+            print(f"  last skip: {p['lastSkip'].get('reason', '')}")
+    if not policies:
+        print("  (no autoscaled jobs)")
+    print("\nDECISIONS (newest first):")
+    for d in decisions[: args.limit]:
+        print(
+            f"  {d['job']:<24} {d['replicaType']:<10} {d['direction']:<5} "
+            f"{d['from']} -> {d['to']}  {d['reason']}"
+        )
+    if not decisions:
+        print("  (none)")
     return 0
 
 
@@ -237,6 +342,19 @@ def build_parser() -> argparse.ArgumentParser:
     lp = sub.add_parser("list", help="list TPUJobs")
     lp.add_argument("-n", "--namespace", default="")
     lp.set_defaults(fn=cmd_list)
+
+    ap = sub.add_parser("alerts", help="alert-engine state (firing first)")
+    ap.add_argument("rule", nargs="?", default="")
+    ap.set_defaults(fn=cmd_alerts)
+
+    asp = sub.add_parser(
+        "autoscaler", help="autoscaler decisions + policy state"
+    )
+    asp.add_argument("job", nargs="?", default="")
+    asp.add_argument("-n", "--namespace", default="default")
+    asp.add_argument("--limit", type=int, default=20,
+                     help="decision-log rows shown")
+    asp.set_defaults(fn=cmd_autoscaler)
 
     for name, fn, extra in (
         ("get", cmd_get, []),

@@ -1,0 +1,704 @@
+"""Elastic autoscaler: the subsystem that ACTS on the alert engine.
+
+PR 6 closed the observe→alert gap (utils/alerts.py fires on SLO burn,
+stalls, queue depth); this module closes alert→act (ROADMAP item 3,
+SURVEY.md §2b "Elastic" — the reference reserved replica-set
+scale-in/out for v1.x).  A job declares ``spec.autoscaling`` policies
+(api/types.AutoscalingPolicy) binding SIGNALS — registered alert rules
+or gauge families — to one replica set each, and the autoscaler
+evaluates them on a host-side loop:
+
+- **serving** policies scale INTO pressure: any breaching signal
+  (queue-wait burn rate firing, admission queue depth over threshold)
+  adds ``step`` replicas up to ``max_replicas``; once every signal has
+  been quiet for ``stabilization_seconds`` the policy sheds replicas
+  back toward ``min_replicas``.  Serving replicas are stateless pool
+  members behind a shared admission queue, so scale events touch only
+  the new/removed indices.
+- **training** policies scale AWAY from distress: a breaching signal
+  (watchdog stalls, preemption) SHEDS replicas so the job re-shards
+  onto the survivors — the reconciler restarts the whole replica set
+  at the new world size (the size is baked into each pod's bootstrap
+  env) and the training processes resume from the latest async
+  checkpoint (parallel/checkpoint.restore_latest redistributes the
+  artifact onto whatever mesh the survivors form —
+  tests/test_elastic.py).  Sustained quiet grows the set back toward
+  the spec's declared size.  EVERY training resize is gated by
+  checkpoint freshness (``max_checkpoint_age_seconds``): a resize may
+  only throw away work a sufficiently fresh checkpoint bounds, and an
+  UNKNOWN age refuses the resize rather than guessing (skips are
+  recorded and visible on ``GET /autoscaler``).
+
+Anti-flap design (all three must agree before a decision lands):
+``cooldown_seconds`` floors the time between decisions (both
+directions); ``stabilization_seconds`` is temporal hysteresis — the
+relief direction engages only after sustained quiet; gauge signals add
+LEVEL hysteresis — a breached gauge stays latched until it drops to
+``threshold * hysteresis_ratio``, so a level hovering at its threshold
+cannot oscillate decisions.  Alert signals inherit the alert engine's
+own dwell + resolved-hold absorption.
+
+The autoscaler never edits the stored job spec: decisions land in an
+in-memory **desired-replica overlay** the reconciler applies to its
+working copy each sync (``apply()``), so the user's declaration stays
+the baseline and an operator restart falls back to it.  Every decision
+is visible three ways (the acceptance contract): a ``ScaledUp`` /
+``ScaledDown`` Normal event on the job, an entry in the bounded
+decision log served at ``GET /autoscaler``, and the
+``observedHealth.autoscaler`` status block the health rollup
+publishes.
+
+Process-scope honesty (same contract as the alert engine, documented
+in docs/ARCHITECTURE.md): gauge bindings and alert bindings read the
+registry/engine of THIS process.  The checkpoint-freshness gate is the
+exception — it prefers the job's summary series
+(``checkpoint_time_unix``, republished pod-side by the trainer), which
+crosses the process boundary, over the process-local gauge.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tf_operator_tpu.api.types import (
+    AutoscalingPolicy,
+    ReplicaType,
+    SignalBinding,
+    TPUJob,
+)
+from tf_operator_tpu.utils.logging import FieldLogger, _root
+
+#: decision log length — GET /autoscaler serves the tail, newest first
+MAX_DECISIONS = 256
+
+
+def default_serving_policy(
+    min_replicas: int = 1, max_replicas: int = 4
+) -> AutoscalingPolicy:
+    """The stock serving policy (examples + the static lint gate):
+    scale on the queue-wait burn-rate alert OR raw admission queue
+    depth.  Signal names here are pinned against the live rule set /
+    emitted families by tests/test_autoscaling_lint.py — renaming
+    either orphans this policy and fails tier-1."""
+
+    return AutoscalingPolicy(
+        replica_type=ReplicaType.WORKER,
+        mode="serving",
+        min_replicas=min_replicas,
+        max_replicas=max_replicas,
+        signals=[
+            SignalBinding(kind="alert", name="serve-queue-wait-burn"),
+            SignalBinding(
+                kind="gauge", name="serve_admission_queue_depth", threshold=64.0
+            ),
+        ],
+    )
+
+
+def default_training_policy(
+    min_replicas: int = 1, max_replicas: int = 8
+) -> AutoscalingPolicy:
+    """The stock training policy: shed replicas on sustained stalls
+    (the watchdog rule dwells before firing), resize-gated on a fresh
+    checkpoint."""
+
+    return AutoscalingPolicy(
+        replica_type=ReplicaType.WORKER,
+        mode="training",
+        min_replicas=min_replicas,
+        max_replicas=max_replicas,
+        signals=[SignalBinding(kind="alert", name="watchdog-stall")],
+    )
+
+
+def job_checkpoint_age(
+    job: TPUJob, now: float, metrics=None, series=None
+) -> Optional[float]:
+    """Seconds since the job's newest durable checkpoint, or None
+    (unknown).  Prefers the POD-scope stamp in the job's summary
+    series (``checkpoint_time_unix`` — utils/summaries, crosses the
+    process boundary) and falls back to this process's
+    ``checkpoint_last_success_unix`` gauge (live for embedded
+    single-process runs).  Shared by the reconciler's health rollup
+    (which passes its already-read ``series`` tail to avoid a second
+    disk read) and the autoscaler's resize gate so the two can never
+    disagree."""
+
+    from tf_operator_tpu.utils.summaries import (
+        ANNOTATION_SUMMARY_DIR,
+        latest_checkpoint_time,
+    )
+
+    sdir = job.metadata.annotations.get(ANNOTATION_SUMMARY_DIR)
+    if sdir:
+        try:
+            t = latest_checkpoint_time(sdir, series=series)
+        except OSError:
+            t = None
+        if t is not None:
+            return max(0.0, now - t)
+    if metrics is not None:
+        g = metrics.gauge("checkpoint_last_success_unix")
+        if g > 0:
+            return max(0.0, now - g)
+    return None
+
+
+@dataclass
+class ScalingDecision:
+    """One applied scale decision — what the event, the /autoscaler
+    log entry, and the observedHealth block all describe."""
+
+    time: float
+    job_key: str
+    replica_type: ReplicaType
+    mode: str
+    direction: str  # "up" | "down"
+    from_replicas: int
+    to_replicas: int
+    reason: str
+    #: training resizes restart the replica set (re-shard + resume)
+    reshard: bool = False
+    signals: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def event_reason(self) -> str:
+        return "ScaledUp" if self.direction == "up" else "ScaledDown"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "time": round(self.time, 3),
+            "job": self.job_key,
+            "replicaType": self.replica_type.value,
+            "mode": self.mode,
+            "direction": self.direction,
+            "from": self.from_replicas,
+            "to": self.to_replicas,
+            "reason": self.reason,
+            "reshard": self.reshard,
+            "signals": dict(self.signals),
+        }
+
+
+class _PolicyState:
+    """Runtime state of one (job, replica-type) policy."""
+
+    __slots__ = (
+        "desired", "last_scale", "quiet_since", "breaching", "latched",
+        "reshard_pending", "last_skip", "signals", "last_decision",
+        "spec_replicas",
+    )
+
+    def __init__(self):
+        #: the overlay; None = the spec governs
+        self.desired: Optional[int] = None
+        #: the STORED spec's replica count, recorded before any overlay
+        #: (the reconciler's working copy is mutated by apply(), so the
+        #: health block cannot read the baseline off the job later)
+        self.spec_replicas: Optional[int] = None
+        self.last_scale = 0.0
+        #: unix since which every signal has been quiet (None while any
+        #: breaches, or before the first evaluation)
+        self.quiet_since: Optional[float] = None
+        self.breaching = False
+        #: per-gauge-signal hysteresis latch: name -> bool
+        self.latched: Dict[str, bool] = {}
+        #: a training resize decided but not yet executed by the
+        #: reconciler (the replica-set bounce)
+        self.reshard_pending = False
+        #: last safety-gate refusal, for /autoscaler visibility
+        self.last_skip: Optional[Dict[str, Any]] = None
+        #: last measured signal values
+        self.signals: Dict[str, Any] = {}
+        self.last_decision: Optional[ScalingDecision] = None
+
+
+class Autoscaler:
+    """Evaluate every cached job's ``spec.autoscaling`` policies.
+
+    ``evaluate_once(now)`` is the whole engine (tests drive it with a
+    synthetic clock, the alert-engine pattern); ``start()`` runs it on
+    a daemon thread every ``interval`` seconds.  The controller
+    ``attach()``es a job lister and a decision callback; the
+    reconciler consults ``apply()``/``take_reshard()`` during sync.
+    """
+
+    def __init__(
+        self,
+        metrics=None,
+        alerts=None,
+        interval: float = 5.0,
+        max_decisions: int = MAX_DECISIONS,
+    ):
+        if metrics is None:
+            from tf_operator_tpu.utils.metrics import default_metrics
+
+            metrics = default_metrics
+        self.metrics = metrics
+        if alerts is None:
+            from tf_operator_tpu.utils.alerts import default_engine
+
+            alerts = default_engine
+        #: utils/alerts.AlertEngine backing alert-kind signal bindings
+        #: (set to None explicitly and they measure as unknown — never
+        #: breaching, visible in the snapshot)
+        self.alerts = alerts
+        self.interval = float(interval)
+        self._lock = threading.Lock()
+        #: (job_key, ReplicaType) -> _PolicyState
+        self._state: Dict[Tuple[str, ReplicaType], _PolicyState] = {}
+        self._decisions: deque = deque(maxlen=max_decisions)
+        self._callbacks: List[Callable[[ScalingDecision], None]] = []
+        self._list_jobs: Optional[Callable[[], List[TPUJob]]] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._log = FieldLogger(_root, component="autoscaler")
+
+    # -- wiring -------------------------------------------------------------
+
+    def attach(
+        self,
+        list_jobs: Callable[[], List[TPUJob]],
+        on_decision: Optional[Callable[[ScalingDecision], None]] = None,
+    ) -> None:
+        """Wire the job source (the controller's informer cache) and an
+        optional per-decision callback (the controller uses it to emit
+        the Normal event and re-enqueue the job)."""
+
+        with self._lock:
+            self._list_jobs = list_jobs
+            if on_decision is not None:
+                self._callbacks.append(on_decision)
+
+    def detach(
+        self,
+        list_jobs: Optional[Callable[[], List[TPUJob]]] = None,
+        on_decision: Optional[Callable[[ScalingDecision], None]] = None,
+    ) -> None:
+        """Reverse of attach (controller shutdown): a long-lived
+        (process-global) autoscaler must not pin dead controllers.
+        ``list_jobs`` is the lister the caller installed — the lister
+        is only cleared if it is still the active one, so a stopped
+        controller can never sever a successor that re-attached."""
+
+        with self._lock:
+            if list_jobs is None or self._list_jobs is list_jobs:
+                self._list_jobs = None
+            if on_decision is not None:
+                try:
+                    self._callbacks.remove(on_decision)
+                except ValueError:
+                    pass
+
+    def forget(self, job_key: str) -> None:
+        """Drop all state for a deleted job — including its
+        per-job gauge series (a deleted job must not keep exporting a
+        desired replica count)."""
+
+        with self._lock:
+            for k in [k for k in self._state if k[0] == job_key]:
+                del self._state[k]
+        self.metrics.clear_gauge("autoscaler_desired_replicas", job=job_key)
+
+    # -- reconciler surface -------------------------------------------------
+
+    def apply(self, job: TPUJob) -> None:
+        """Overlay desired replica counts onto ``job`` (the
+        reconciler's per-sync working clone, never the stored object):
+        downstream planning — pod create/scale-in, services, gang
+        sizing, success evaluation — then sees one consistent world."""
+
+        if job.spec.autoscaling is None:
+            return
+        for pol in job.spec.autoscaling.policies:
+            spec = job.spec.replica_specs.get(pol.replica_type)
+            if spec is None:
+                continue
+            with self._lock:
+                st = self._state.get((job.key, pol.replica_type))
+                if st is None:
+                    continue
+                # the pre-overlay value IS the stored spec's: remember
+                # it for the health block (the mutated working copy
+                # can't answer "what did the user declare" afterwards)
+                st.spec_replicas = int(spec.replicas or 0)
+                if st.desired is not None:
+                    spec.replicas = st.desired
+
+    def take_reshard(self, job_key: str) -> List[ReplicaType]:
+        """Replica types with a decided-but-unexecuted training resize:
+        the reconciler bounces their pods (delete all; next sync
+        recreates at the new world size) and then ``consume_reshard``s.
+        Peek-only — safe to call every sync."""
+
+        with self._lock:
+            return [
+                rt
+                for (jk, rt), st in self._state.items()
+                if jk == job_key and st.reshard_pending
+            ]
+
+    def consume_reshard(self, job_key: str, rtype: ReplicaType) -> None:
+        with self._lock:
+            st = self._state.get((job_key, rtype))
+            if st is not None:
+                st.reshard_pending = False
+
+    def health_block(self, job: TPUJob) -> Optional[Dict[str, Any]]:
+        """The ``observedHealth.autoscaler`` sub-block for one job
+        (JSON-shaped, round-trips through serde), or None when the job
+        declares no autoscaling."""
+
+        if job.spec.autoscaling is None:
+            return None
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for pol in job.spec.autoscaling.policies:
+                st = self._state.get((job.key, pol.replica_type))
+                spec = job.spec.replica_specs.get(pol.replica_type)
+                spec_replicas = (
+                    st.spec_replicas
+                    if st is not None and st.spec_replicas is not None
+                    else int(spec.replicas or 0) if spec else 0
+                )
+                entry: Dict[str, Any] = {
+                    "mode": pol.mode,
+                    "desiredReplicas": (
+                        st.desired
+                        if st is not None and st.desired is not None
+                        else spec_replicas
+                    ),
+                    "specReplicas": spec_replicas,
+                    "minReplicas": pol.min_replicas,
+                    "maxReplicas": pol.max_replicas,
+                    "breaching": bool(st.breaching) if st else False,
+                }
+                if st is not None and st.last_decision is not None:
+                    d = st.last_decision
+                    entry["lastDecision"] = {
+                        "direction": d.direction,
+                        "to": d.to_replicas,
+                        "time": round(d.time, 3),
+                        "reason": d.reason,
+                    }
+                if st is not None and st.last_skip is not None:
+                    entry["lastSkip"] = dict(st.last_skip)
+                out[pol.replica_type.value] = entry
+        return out
+
+    # -- reads --------------------------------------------------------------
+
+    def decisions(self) -> List[ScalingDecision]:
+        with self._lock:
+            return list(self._decisions)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The GET /autoscaler JSON body: per-policy live state
+        (breaching first — the thing needing attention leads, the
+        alerts-panel convention) plus the decision log newest first."""
+
+        with self._lock:
+            policies = []
+            for (job_key, rtype), st in self._state.items():
+                entry: Dict[str, Any] = {
+                    "job": job_key,
+                    "replicaType": rtype.value,
+                    "desiredReplicas": st.desired,
+                    "breaching": st.breaching,
+                    "reshardPending": st.reshard_pending,
+                    "signals": dict(st.signals),
+                }
+                if st.last_decision is not None:
+                    entry["lastDecision"] = st.last_decision.to_dict()
+                if st.last_skip is not None:
+                    entry["lastSkip"] = dict(st.last_skip)
+                policies.append(entry)
+            decisions = [d.to_dict() for d in reversed(self._decisions)]
+        policies.sort(key=lambda p: (not p["breaching"], p["job"], p["replicaType"]))
+        return {"policies": policies, "decisions": decisions}
+
+    # -- evaluation ---------------------------------------------------------
+
+    def evaluate_once(self, now: Optional[float] = None) -> List[ScalingDecision]:
+        """One sweep over every autoscaled job; returns the decisions
+        issued this sweep."""
+
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            lister = self._list_jobs
+        if lister is None:
+            return []
+        try:
+            jobs = list(lister())
+        except Exception as e:  # noqa: BLE001 - engine outlives cache bugs
+            self._log.error("job lister failed: %s: %s", type(e).__name__, e)
+            return []
+        self.metrics.inc("autoscaler_evaluations_total")
+        issued: List[ScalingDecision] = []
+        live_keys = set()
+        for job in jobs:
+            if job.spec.autoscaling is None:
+                continue
+            live_keys.add(job.key)
+            if job.invalid_reason or job.is_terminal():
+                continue
+            for pol in job.spec.autoscaling.policies:
+                try:
+                    d = self._evaluate_policy(job, pol, now)
+                except Exception as e:  # noqa: BLE001 - one bad policy must not stop the sweep
+                    self._log.error(
+                        "policy evaluation failed for %s/%s: %s: %s",
+                        job.key, pol.replica_type.value, type(e).__name__, e,
+                    )
+                    continue
+                if d is not None:
+                    issued.append(d)
+        # GC state of jobs that no longer declare autoscaling (removed
+        # block = revert to the declared spec and forget history) or
+        # that the cache no longer knows
+        with self._lock:
+            stale = [k for k in self._state if k[0] not in live_keys]
+            for k in stale:
+                del self._state[k]
+            callbacks = list(self._callbacks)
+        for k in {jk for jk, _ in stale}:
+            self.metrics.clear_gauge("autoscaler_desired_replicas", job=k)
+        for d in issued:
+            for fn in callbacks:
+                try:
+                    fn(d)
+                except Exception as e:  # noqa: BLE001 - see AlertEngine.subscribe
+                    self._log.error(
+                        "decision callback failed for %s: %s: %s",
+                        d.job_key, type(e).__name__, e,
+                    )
+        return issued
+
+    def _evaluate_policy(
+        self, job: TPUJob, pol: AutoscalingPolicy, now: float
+    ) -> Optional[ScalingDecision]:
+        key = (job.key, pol.replica_type)
+        with self._lock:
+            st = self._state.get(key)
+            if st is None:
+                st = self._state[key] = _PolicyState()
+        breach, values = self._measure_signals(pol, st)
+        spec = job.spec.replica_specs.get(pol.replica_type)
+        spec_replicas = int(spec.replicas or 0) if spec else 0
+        st.spec_replicas = spec_replicas  # cache jobs are pre-overlay
+        current = st.desired if st.desired is not None else spec_replicas
+
+        st.breaching = breach
+        st.signals = values
+
+        decision: Optional[ScalingDecision] = None
+        cooled = now - st.last_scale >= pol.cooldown_seconds
+        if breach:
+            st.quiet_since = None
+            if pol.mode == "serving":
+                target = min(current + pol.step, pol.max_replicas)
+                if target > current and cooled:
+                    decision = self._decide(
+                        job, pol, st, now, current, target,
+                        reason="scale-up: "
+                        + ", ".join(sorted(n for n, v in values.items() if v.get("breaching"))),
+                    )
+            else:  # training: shed toward min, re-shard onto survivors
+                target = max(current - pol.step, pol.min_replicas)
+                if target < current and cooled:
+                    decision = self._gated_resize(
+                        job, pol, st, now, current, target,
+                        reason="distress scale-down: "
+                        + ", ".join(sorted(n for n, v in values.items() if v.get("breaching"))),
+                    )
+        else:
+            if st.quiet_since is None:
+                st.quiet_since = now
+            stabilized = now - st.quiet_since >= pol.stabilization_seconds
+            if pol.mode == "serving":
+                target = max(current - pol.step, pol.min_replicas)
+                if target < current and stabilized and cooled:
+                    decision = self._decide(
+                        job, pol, st, now, current, target,
+                        reason=f"signals quiet {now - st.quiet_since:.0f}s",
+                    )
+            else:  # training: recover toward the declared size
+                baseline = min(spec_replicas, pol.max_replicas)
+                target = min(current + pol.step, baseline)
+                if target > current and stabilized and cooled:
+                    decision = self._gated_resize(
+                        job, pol, st, now, current, target,
+                        reason="capacity recovered: signals quiet "
+                        f"{now - st.quiet_since:.0f}s",
+                    )
+        return decision
+
+    def _gated_resize(
+        self, job, pol, st, now: float, current: int, target: int, reason: str
+    ) -> Optional[ScalingDecision]:
+        """Training resizes pass the checkpoint-freshness gate first: a
+        re-shard resumes from the latest checkpoint, so the resize may
+        only discard work the checkpoint bounds.  Unknown age = refuse
+        (recorded, never silent)."""
+
+        age = job_checkpoint_age(job, now, metrics=self.metrics)
+        if age is None or age > pol.max_checkpoint_age_seconds:
+            why = (
+                "checkpoint age unknown"
+                if age is None
+                else f"checkpoint {age:.0f}s old (> {pol.max_checkpoint_age_seconds:g}s)"
+            )
+            skip = {
+                "time": round(now, 3),
+                "wanted": target,
+                "reason": f"resize refused: {why}",
+            }
+            # log/count at most once per cooldown window — the gate can
+            # refuse every tick for as long as the checkpoint is stale
+            if st.last_skip is None or now - st.last_skip["time"] >= pol.cooldown_seconds:
+                self.metrics.inc(
+                    "autoscaler_skipped_total", reason="checkpoint_stale"
+                )
+                self._log.warning(
+                    "autoscaler %s/%s: %s", job.key,
+                    pol.replica_type.value, skip["reason"],
+                )
+                st.last_skip = skip
+            else:
+                st.last_skip = {**st.last_skip, "wanted": target}
+            return None
+        st.last_skip = None
+        return self._decide(
+            job, pol, st, now, current, target,
+            reason=f"{reason} (checkpoint {age:.0f}s fresh)", reshard=True,
+        )
+
+    def _decide(
+        self, job, pol, st, now: float, current: int, target: int,
+        reason: str, reshard: bool = False,
+    ) -> ScalingDecision:
+        d = ScalingDecision(
+            time=now,
+            job_key=job.key,
+            replica_type=pol.replica_type,
+            mode=pol.mode,
+            direction="up" if target > current else "down",
+            from_replicas=current,
+            to_replicas=target,
+            reason=reason,
+            reshard=reshard,
+            signals=dict(st.signals),
+        )
+        with self._lock:
+            st.desired = target
+            st.last_scale = now
+            st.last_decision = d
+            if reshard:
+                st.reshard_pending = True
+            self._decisions.append(d)
+        self.metrics.inc("autoscaler_decisions_total", direction=d.direction)
+        self.metrics.set(
+            "autoscaler_desired_replicas",
+            float(target),
+            job=job.key,
+            replicaType=pol.replica_type.value,
+        )
+        self._log.info(
+            "autoscaler %s/%s: %s %d -> %d (%s)",
+            job.key, pol.replica_type.value, d.direction,
+            current, target, reason,
+        )
+        return d
+
+    # -- signal measurement -------------------------------------------------
+
+    def _measure_signals(
+        self, pol: AutoscalingPolicy, st: _PolicyState
+    ) -> Tuple[bool, Dict[str, Any]]:
+        """(any_breaching, {signal name: measured}) — gauge signals
+        carry the hysteresis latch in ``st.latched``."""
+
+        any_breach = False
+        values: Dict[str, Any] = {}
+        for sig in pol.signals:
+            if sig.kind == "alert":
+                breach, meas = self._measure_alert(sig)
+            else:
+                breach, meas = self._measure_gauge(sig, pol, st)
+            values[sig.name] = {**meas, "breaching": breach}
+            any_breach = any_breach or breach
+        return any_breach, values
+
+    def _measure_alert(self, sig: SignalBinding) -> Tuple[bool, Dict[str, Any]]:
+        if self.alerts is None:
+            return False, {"kind": "alert", "unknown": True}
+        alert = self.alerts.alert(sig.name)
+        if alert is None:
+            # bound to a rule the engine does not run: never breaches,
+            # but the snapshot says so instead of looking healthy —
+            # the runtime twin of the static lint gate
+            return False, {"kind": "alert", "unknown": True}
+        return alert.state == "firing", {"kind": "alert", "state": alert.state}
+
+    def _measure_gauge(
+        self, sig: SignalBinding, pol: AutoscalingPolicy, st: _PolicyState
+    ) -> Tuple[bool, Dict[str, Any]]:
+        series = self.metrics.gauge_series(sig.name)
+        level = 0.0
+        for labels, v in series.items():
+            d = dict(labels)
+            if all(d.get(k) == str(val) for k, val in sig.labels.items()):
+                level = max(level, v)
+        latched = st.latched.get(sig.name, False)
+        if level > sig.threshold:
+            latched = True
+        elif level <= sig.threshold * pol.hysteresis_ratio:
+            latched = False
+        # between the release level and the threshold: hold the latch
+        st.latched[sig.name] = latched
+        return latched, {
+            "kind": "gauge",
+            "level": round(level, 3),
+            "threshold": sig.threshold,
+        }
+
+    # -- evaluator thread ---------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "Autoscaler":
+        if self.running:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="autoscaler"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.evaluate_once()
+            except Exception as e:  # noqa: BLE001 - must outlive bugs
+                self._log.error(
+                    "autoscaler sweep failed: %s: %s", type(e).__name__, e
+                )
+
+
+#: process-global default (the metrics/tracer/alerts pattern): kubesim's
+#: /autoscaler debug route and the operator binary share this instance.
+#: NOT started, and inert until a controller attach()es its job cache.
+default_autoscaler = Autoscaler()

@@ -46,6 +46,7 @@ class TPUJobController:
         recorder: Optional[EventRecorder] = None,
         tracer: Optional[Tracer] = None,
         alerts=None,
+        autoscaler=None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -94,6 +95,13 @@ class TPUJobController:
         self.alerts = alerts
         if alerts is not None:
             alerts.subscribe(self._on_alert_transition)
+        #: controller/autoscaler.Autoscaler (optional): we feed it the
+        #: informer cache as its job source; each decision emits a
+        #: ScaledUp/ScaledDown Normal event and re-enqueues the job so
+        #: the reconciler applies the new desired count promptly
+        self.autoscaler = autoscaler
+        if autoscaler is not None:
+            autoscaler.attach(self._list_cached_jobs, self._on_scale_decision)
         self.reconciler = Reconciler(
             job_store,
             backend,
@@ -106,6 +114,7 @@ class TPUJobController:
             requeue_after=self._requeue_after,
             tracer=self.tracer,
             alerts=alerts,
+            autoscaler=autoscaler,
         )
         self.max_sync_retries = max_sync_retries
         self.resync_period = resync_period
@@ -142,6 +151,30 @@ class TPUJobController:
                 span.span_id if span is not None else None,
                 time.monotonic() + offset,
             ))
+
+    def _list_cached_jobs(self):
+        """The autoscaler's job source: a snapshot of the informer
+        cache's job objects (read-only — watch events REPLACE cached
+        objects, never mutate them, so holding references is safe)."""
+
+        with self.cache._lock:
+            return list(self.cache.jobs.values())
+
+    def _on_scale_decision(self, decision) -> None:
+        """Autoscaler decision callback (runs on its evaluator thread):
+        one Normal event per decision — the acceptance contract's
+        event leg — plus a prompt re-enqueue so the reconciler applies
+        the new desired count without waiting for a watch event."""
+
+        self.recorder.event(
+            decision.job_key,
+            "Normal",
+            decision.event_reason,
+            f"{decision.replica_type.value} replicas "
+            f"{decision.from_replicas} -> {decision.to_replicas}: "
+            f"{decision.reason}",
+        )
+        self._enqueue(decision.job_key)
 
     def _on_alert_transition(self, alert, old: str, new: str) -> None:
         """Alert-engine subscriber (runs on the evaluator thread):
@@ -302,6 +335,12 @@ class TPUJobController:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.autoscaler is not None:
+            # same contract as the alert engine below: the (possibly
+            # process-global) autoscaler outlives this controller
+            self.autoscaler.detach(
+                self._list_cached_jobs, self._on_scale_decision
+            )
         if self.alerts is not None:
             # detach from the (possibly process-global) engine — it
             # outlives this controller and would otherwise pin it and

@@ -87,6 +87,15 @@ class ReconcilerConfig:
     #: every status write into another sync).  A firing-set change
     #: bypasses the throttle — Degraded must land promptly.
     health_refresh_seconds: float = 5.0
+    #: hard floor under health_refresh_seconds for liveness-only
+    #: rewrites (nothing material changed — just updatedAt/ages).  The
+    #: rollup's own status write feeds back as a watch event and
+    #: another sync; at health_refresh_seconds=0 that feedback would
+    #: livelock the queue rewriting updatedAt forever (each sync slow
+    #: enough that round(now, 3) advances).  Material changes — the
+    #: firing set, the autoscaler block — always bypass both throttles,
+    #: so 0 still means "decisions and Degraded land immediately".
+    health_rewrite_floor_seconds: float = 0.05
     #: observedHealth.throughputStepsPerSec is LIVE health: summary
     #: series whose newest record is older than this are ignored — a
     #: wedged trainer must not keep reporting its historical rate
@@ -108,6 +117,7 @@ class Reconciler:
         requeue_after: Optional[Callable[[str, float], None]] = None,
         tracer: Optional[Tracer] = None,
         alerts=None,
+        autoscaler=None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -125,6 +135,11 @@ class Reconciler:
         #: firing set drives the Degraded/SLOViolation condition and
         #: the observedHealth block published into TPUJob.status
         self.alerts = alerts
+        #: controller/autoscaler.Autoscaler (None = no elastic scaling):
+        #: its desired-replica overlay is applied to each sync's working
+        #: copy, training resizes bounce the replica set (re-shard +
+        #: resume), and its per-job state joins observedHealth
+        self.autoscaler = autoscaler
         #: job key -> unix of the last health-rollup refresh (throttle)
         self._health_refreshed: Dict[str, float] = {}
 
@@ -173,6 +188,8 @@ class Reconciler:
             self.svc_exp.delete(key)
             self._deadline_scheduled.pop(key, None)
             self._health_refreshed.pop(key, None)
+            if self.autoscaler is not None:
+                self.autoscaler.forget(key)
             self._gc_orphans(key)
             return
         log = logger_for_job(job.metadata.namespace, job.metadata.name)
@@ -223,11 +240,31 @@ class Reconciler:
             )
             self.recorder.event(key, "Normal", "JobCreated", "job accepted by reconciler")
 
+        # desired-replica overlay (controller/autoscaler.py): the
+        # autoscaler's decisions overwrite replica counts on THIS
+        # sync's working copy only — the stored spec stays the user's
+        # declaration — so planning, services, gang sizing and success
+        # evaluation all see one consistent scaled world
+        if self.autoscaler is not None:
+            self.autoscaler.apply(job)
+
         with self.tracer.span("pods.claim") as claim_sp:
             pods_by_type = self._claim_pods(job)
             claim_sp.set_attribute(
                 "claimed", sum(len(v) for v in pods_by_type.values())
             )
+
+        # elastic training resize: a decided re-shard bounces the whole
+        # replica set — the world size is baked into every pod's
+        # bootstrap env, so survivors must restart to form the new
+        # world and resume from the latest checkpoint
+        # (parallel/checkpoint.restore_latest redistributes the
+        # artifact onto whatever mesh the survivors form)
+        if self.autoscaler is not None and self._bounce_for_reshard(
+            job, pods_by_type
+        ):
+            self._update_status(job, old_status)
+            return
 
         # ---- deadline / backoff enforcement (before creating anything)
         if self._past_active_deadline(job):
@@ -363,6 +400,45 @@ class Reconciler:
                 f"released pod {pod.metadata.name} (selector no longer matches)",
             )
         return out
+
+    # --------------------------------------------------- elastic resize
+
+    def _bounce_for_reshard(self, job: TPUJob, pods_by_type) -> bool:
+        """Execute pending training resizes: delete every pod of the
+        resized replica set (the next sync recreates them at the new
+        world size with fresh bootstrap env; the training processes
+        restore from the latest async checkpoint).  Returns True when
+        anything was bounced — the caller ends the sync and lets the
+        watch-confirmed deletions gate the recreate."""
+
+        key = job.key
+        bounced = False
+        for rtype in self.autoscaler.take_reshard(key):
+            live = [
+                p
+                for p in pods_by_type.get(rtype, [])
+                if p.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+            ]
+            if not live:
+                # the set already finished (a resize decided while the
+                # last pods were succeeding): resizing a completed set
+                # would delete its success record and re-run the job —
+                # drop the stale decision and let success evaluation
+                # proceed this same sync
+                self.autoscaler.consume_reshard(key, rtype)
+                continue
+            want = job.spec.pod_count(rtype)
+            self.recorder.event(
+                key, "Normal", "Resharding",
+                f"elastic resize: restarting {rtype.value} replicas at "
+                f"world size {want} (re-shard + resume from checkpoint)",
+            )
+            self.metrics.inc("tpujob_reshards_total")
+            for p in pods_by_type.get(rtype, []):
+                self._delete_pod(key, p)
+            self.autoscaler.consume_reshard(key, rtype)
+            bounced = True
+        return bounced
 
     # ------------------------------------------------------- pod reconcile
 
@@ -706,7 +782,7 @@ class Reconciler:
         throttle so conditions land promptly.
         """
 
-        if self.alerts is None:
+        if self.alerts is None and self.autoscaler is None:
             return
         if job.is_terminal():
             # the failed_fatal path reaches here AFTER _fail_job cleared
@@ -717,15 +793,23 @@ class Reconciler:
         # ONE firing snapshot for names, reason, and message — the
         # evaluator thread may transition rules between calls, and
         # reason/message must never disagree
-        firing_alerts = self.alerts.firing()
+        firing_alerts = self.alerts.firing() if self.alerts is not None else []
         firing = sorted(a.rule.name for a in firing_alerts)
-        now = time.time()
-        throttled = (
-            now - self._health_refreshed.get(key, 0.0)
-            < self.config.health_refresh_seconds
+        auto_blk = (
+            self.autoscaler.health_block(job)
+            if self.autoscaler is not None
+            else None
         )
-        if throttled and firing == job.status.observed_health.get(
-            "firingAlerts", []
+        now = time.time()
+        throttled = now - self._health_refreshed.get(key, 0.0) < max(
+            self.config.health_refresh_seconds,
+            self.config.health_rewrite_floor_seconds,
+        )
+        if (
+            throttled
+            and firing == job.status.observed_health.get("firingAlerts", [])
+            # a scale decision must land promptly, like a firing change
+            and auto_blk == job.status.observed_health.get("autoscaler")
         ):
             return
         self._health_refreshed[key] = now
@@ -759,18 +843,28 @@ class Reconciler:
             "restartCount": job.status.restart_count,
             "updatedAt": round(now, 3),
         }
-        ckpt = self.metrics.gauge("checkpoint_last_success_unix")
-        if ckpt > 0:
-            health["lastCheckpointAgeSeconds"] = round(max(0.0, now - ckpt), 1)
-        tput = self._recent_throughput(job)
+        # checkpoint freshness: the POD-scope summary-series stamp wins
+        # over the operator-process gauge (the PR 6 scope gap, closed —
+        # same helper the autoscaler's resize gate uses, so status and
+        # gate can never disagree); ONE tail read serves both it and
+        # the throughput window
+        from tf_operator_tpu.controller.autoscaler import job_checkpoint_age
+
+        series = self._read_series_tail(job)
+        age = job_checkpoint_age(job, now, metrics=self.metrics, series=series)
+        if age is not None:
+            health["lastCheckpointAgeSeconds"] = round(age, 1)
+        tput = self._recent_throughput(job, series=series)
         if tput is not None:
             health["throughputStepsPerSec"] = tput
+        if auto_blk:
+            health["autoscaler"] = auto_blk
         job.status.observed_health = health
 
-    def _recent_throughput(self, job: TPUJob) -> Optional[float]:
-        """Δstep/Δtime over the tail of the job's summary series (the
-        same per-job metrics the API's /metrics sub-resource serves);
-        None when the job publishes no series."""
+    def _read_series_tail(self, job: TPUJob) -> "Optional[List[dict]]":
+        """One read of the job's summary-series tail per rollup, shared
+        by the checkpoint-age and throughput consumers (None = no
+        series)."""
 
         from tf_operator_tpu.utils.summaries import (
             ANNOTATION_SUMMARY_DIR,
@@ -781,9 +875,22 @@ class Reconciler:
         if not sdir:
             return None
         try:
-            series = read_series(sdir, limit=20)
+            return read_series(sdir, limit=50)
         except OSError:
             return None
+
+    def _recent_throughput(
+        self, job: TPUJob, series: "Optional[List[dict]]" = None
+    ) -> Optional[float]:
+        """Δstep/Δtime over the tail of the job's summary series (the
+        same per-job metrics the API's /metrics sub-resource serves);
+        None when the job publishes no series."""
+
+        if series is None:
+            series = self._read_series_tail(job)
+        if series is None:
+            return None
+        series = series[-20:]
         if len(series) < 2:
             return None
         # staleness bound: the tail must be RECENT — a trainer that

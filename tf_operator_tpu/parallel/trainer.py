@@ -502,6 +502,17 @@ class Trainer:
             scalars["steps_per_sec"] = (step - self._last_summary_step) / (
                 now - self._last_summary_time
             )
+        # republish the checkpointer's durability stamp into the series:
+        # the gauge is PROCESS scope (a subprocess-pod trainer's registry
+        # never reaches the operator), the summary series is the one
+        # channel that already crosses that boundary — the health
+        # rollup's lastCheckpointAgeSeconds and the autoscaler's resize
+        # gate read it back via utils/summaries.latest_checkpoint_time
+        mreg = getattr(self.sync_ledger, "metrics", None)
+        if mreg is not None:
+            ckpt = mreg.gauge("checkpoint_last_success_unix")
+            if ckpt > 0:
+                scalars["checkpoint_time_unix"] = ckpt
         self._last_summary_time = now
         self._last_summary_step = step
         self.summary_writer.write(step, **scalars)

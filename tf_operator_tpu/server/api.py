@@ -10,6 +10,7 @@ the operator binary carries the equivalent surface itself:
     GET  /metrics                                     Prometheus text
     GET  /slo                                         control-plane SLO quantiles
     GET  /alerts                                      alert-engine state (firing first)
+    GET  /autoscaler                                  scale decisions + policy state
     GET  /traces                                      recent trace summaries
     GET  /traces/{id}                                 one trace's span waterfall
     GET  /debug/stacks                                all-thread stack dump
@@ -81,6 +82,7 @@ class ApiServer:
         leadership: Optional[Callable[[], Tuple[bool, Optional[str]]]] = None,
         tracer: Optional[Tracer] = None,
         alerts=None,
+        autoscaler=None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -94,6 +96,16 @@ class ApiServer:
 
             alerts = default_engine
         self.alerts = alerts
+        #: controller/autoscaler.Autoscaler serving GET /autoscaler;
+        #: defaults to the process-global instance (same contract as
+        #: /alerts: the endpoint exists, empty, on every binary)
+        if autoscaler is None:
+            from tf_operator_tpu.controller.autoscaler import (
+                default_autoscaler,
+            )
+
+            autoscaler = default_autoscaler
+        self.autoscaler = autoscaler
         #: request spans + the /traces read surface; in-process the
         #: controller, backends and (kube-sim) the embedded apiserver
         #: all share this tracer's store, so /traces/<id> returns the
@@ -169,7 +181,7 @@ class ApiServer:
                 try:
                     untraced = (
                         "/healthz", "/metrics", "/slo", "/alerts",
-                        "/traces", "/debug",
+                        "/autoscaler", "/traces", "/debug",
                     )
                     if method == "GET" and (
                         route == "/" or any(
@@ -303,6 +315,11 @@ class ApiServer:
                         # /metrics; the dashboard's alerts panel and
                         # external pollers read this
                         return self._send(200, outer.alerts.snapshot())
+                    if p == ["autoscaler"]:
+                        # the autoscaler's decision log + per-policy
+                        # live state (breaching first) — the act half
+                        # of the /alerts observe half
+                        return self._send(200, outer.autoscaler.snapshot())
                     # trace read surface: served on every replica
                     # (leader or standby) like /metrics — its job is
                     # diagnosing whichever process you can reach

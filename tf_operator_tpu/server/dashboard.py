@@ -15,6 +15,9 @@ subsystem's /traces endpoints, utils/trace.py):
 - **alerts** — the alert engine's lifecycle state (utils/alerts.py),
   firing rules first and colored by state, with the measured burn
   rates / levels and the breach message;
+- **autoscaler** — per-policy live state (controller/autoscaler.py,
+  breaching first) + the scale-decision tail from GET /autoscaler:
+  the act half next to the alerts panel's observe half;
 
 - **api client health** — retry/circuit/watch-recovery counters, with
   exemplar trace links (`# exemplar` comment lines in the exposition)
@@ -53,6 +56,9 @@ DASHBOARD_HTML = """<!doctype html>
   #client-health.degraded { border-color: #b3261e; }
   #workqueue { white-space: pre-wrap; background: #fff; padding: .6rem;
                border: 1px solid #e5e5e5; font-size: .75rem; }
+  #autoscaler-decisions { white-space: pre-wrap; background: #fff;
+               padding: .6rem; border: 1px solid #e5e5e5;
+               font-size: .75rem; }
   tr.trace-err td:first-child { color: #b3261e; }
   tr.trace-slow td:first-child { color: #a86500; }
   #waterfall { background: #fff; border: 1px solid #e5e5e5;
@@ -92,6 +98,13 @@ DASHBOARD_HTML = """<!doctype html>
   <th>value</th><th>detail</th></tr></thead>
   <tbody><tr><td class="muted" colspan="5">no alert engine data yet</td></tr></tbody>
 </table>
+<h2>autoscaler</h2>
+<table id="autoscaler">
+  <thead><tr><th>job</th><th>replicas</th><th>desired</th>
+  <th>breaching</th><th>signals</th></tr></thead>
+  <tbody><tr><td class="muted" colspan="5">no autoscaled jobs</td></tr></tbody>
+</table>
+<div id="autoscaler-decisions" class="muted"></div>
 <h2>api client health</h2>
 <div id="client-health" class="muted">no apiserver client traffic</div>
 <h2>workqueue</h2>
@@ -164,8 +177,50 @@ async function refresh() {
     "refreshed " + new Date().toLocaleTimeString();
   if (selected) detail();
   refreshAlerts();
+  refreshAutoscaler();
   refreshHealth();
   refreshTraces();
+}
+
+async function refreshAutoscaler() {
+  // the act half of the alerts panel (controller/autoscaler.py):
+  // per-policy live state breaching-first, plus the decision tail
+  let snap;
+  try { snap = await (await fetch("/autoscaler")).json(); }
+  catch (e) { return; }
+  const tbody = document.querySelector("#autoscaler tbody");
+  tbody.innerHTML = "";
+  const policies = snap.policies || [];
+  if (!policies.length) {
+    const tr = document.createElement("tr");
+    const td = document.createElement("td");
+    td.textContent = "no autoscaled jobs"; td.className = "muted";
+    td.colSpan = 5; tr.appendChild(td); tbody.appendChild(tr);
+  }
+  for (const p of policies) {
+    const tr = document.createElement("tr");
+    if (p.breaching) tr.classList.add("alert-firing");
+    const sig = Object.entries(p.signals || {})
+      .map(([n, v]) => `${n}:${v.breaching ? "breach" : "ok"}`).join(" ");
+    const cells = [
+      p.job, p.replicaType,
+      p.desiredReplicas == null ? "spec" : String(p.desiredReplicas),
+      p.breaching ? "yes" : "no", sig,
+    ];
+    for (const text of cells) {
+      const td = document.createElement("td");
+      td.textContent = text;  // job names are user input
+      tr.appendChild(td);
+    }
+    tbody.appendChild(tr);
+  }
+  const dec = (snap.decisions || []).slice(0, 8);
+  document.getElementById("autoscaler-decisions").textContent = dec.length
+    ? dec.map(d =>
+        `${new Date(d.time * 1000).toLocaleTimeString()} ${d.job} ` +
+        `${d.replicaType} ${d.direction} ${d.from}->${d.to}: ${d.reason}`
+      ).join("\\n")
+    : "no scale decisions yet";
 }
 
 async function refreshAlerts() {

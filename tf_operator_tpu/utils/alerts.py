@@ -357,6 +357,13 @@ class AlertEngine:
         with self._lock:
             return [a for a in self._alerts.values() if a.state == "firing"]
 
+    def alert(self, name: str) -> Optional[Alert]:
+        """The live Alert for one rule name (None = not registered) —
+        the autoscaler's signal-binding read."""
+
+        with self._lock:
+            return self._alerts.get(name)
+
     def snapshot(self) -> Dict[str, Any]:
         """The /alerts JSON body: every alert, firing first."""
 

@@ -119,6 +119,23 @@ class Metrics:
         with self._lock:
             return self._gauges.get(key, 0.0)
 
+    def clear_gauge(self, name: str, **labels: str) -> None:
+        """Drop every series of gauge ``name`` whose labels contain
+        ``labels`` (subset match; no labels = the whole family).
+        Per-object gauges (autoscaler_desired_replicas{job=}) must not
+        outlive their object — a deleted job exporting a stale desired
+        count forever is a lie, and per-object label sets otherwise
+        grow monotonically across churn."""
+
+        with self._lock:
+            for key in [
+                k
+                for k in self._gauges
+                if k[0] == name
+                and all(dict(k[1]).get(n) == str(v) for n, v in labels.items())
+            ]:
+                del self._gauges[key]
+
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             self._observations[name].append(value)

@@ -56,6 +56,31 @@ class SummaryWriter:
         self.close()
 
 
+def latest_checkpoint_time(
+    directory: str, series: Optional[List[dict]] = None
+) -> Optional[float]:
+    """Newest ``checkpoint_time_unix`` value in the series, or None.
+
+    This is how a POD-scope durability stamp crosses the process
+    boundary: the checkpointer stamps ``checkpoint_last_success_unix``
+    on its own process registry (parallel/checkpoint.py), the trainer
+    republishes it into the summary series at each summary interval,
+    and the operator — a different process — reads it here for the
+    health rollup's ``lastCheckpointAgeSeconds`` and the autoscaler's
+    resize safety gate (closing the process-scope gap documented in
+    docs/ARCHITECTURE.md).  Pass ``series`` (an already-read
+    ``read_series`` tail) to reuse one disk read across consumers —
+    the health rollup reads the tail once for this AND throughput."""
+
+    if series is None:
+        series = read_series(directory, limit=50)
+    for rec in reversed(series):
+        v = rec.get("checkpoint_time_unix")
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+    return None
+
+
 def read_series(directory: str, limit: Optional[int] = None) -> List[dict]:
     """Merge every process's series, ordered by (step, time).
 
