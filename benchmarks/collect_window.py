@@ -91,6 +91,9 @@ def parse_artifacts(out_dir: str) -> dict:
     spec = _last_json_line(_read(out_dir, "speculative.out"))
     if spec and "speculative_tokens_per_sec" in spec:
         data["speculative"] = spec
+    paged = _last_json_line(_read(out_dir, "paged.out"))
+    if paged and "paged_tokens_per_sec" in paged:
+        data["paged"] = paged
 
     flash = _read(out_dir, "flash.out")
     m = re.search(
@@ -220,6 +223,16 @@ def write_last_measured(data: dict, today: str) -> None:
     put("batching_admission_dispatches_per_request",
         bt.get("batching_admission_dispatches_per_request"),
         "batching.out")
+    pg = data.get("paged", {})
+    put("paged_tokens_per_sec", pg.get("paged_tokens_per_sec"),
+        "paged.out")
+    put("paged_capacity_ratio", pg.get("paged_capacity_ratio"),
+        "paged.out")
+    put("paged_prefix_hit_rate", pg.get("paged_prefix_hit_rate"),
+        "paged.out")
+    put("paged_p99_ttft_s", pg.get("paged_p99_ttft_s"), "paged.out")
+    put("paged_equal_slots_wall_ratio",
+        pg.get("paged_equal_slots_wall_ratio"), "paged.out")
     sp = data.get("speculative", {})
     put("speculative_speedup", sp.get("speculative_speedup"),
         "speculative.out")
@@ -392,6 +405,34 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             "full dispatch ledger in the artifact + PROFILE.md "
             "\"dispatch ledger\") "
             f"| 1× v5 lite, `measure.py --section batching` → `window_out/batching.out`, {today} |"
+        )
+    pg = data.get("paged")
+    if pg:
+        backend = pg.get("paged_backend", "?")
+        rows["Paged KV serving"] = (
+            "| Paged KV serving (bursty mixed-length trace, "
+            f"{pg.get('paged_trace_requests', '?')} requests, equal "
+            f"arena budget of {pg.get('paged_arena_blocks', '?')} "
+            "blocks) | capacity "
+            f"**{pg.get('paged_capacity_ratio', '?')}×** at the same "
+            f"HBM (**{pg.get('paged_concurrent_admitted', '?')} "
+            "concurrent** paged vs "
+            f"{pg.get('paged_slot_baseline_concurrent', '?')} slot-"
+            "bound), prefix-hit rate "
+            f"**{pg.get('paged_prefix_hit_rate', '?')}**; equal-seats "
+            "wall ratio "
+            f"**{pg.get('paged_equal_slots_wall_ratio', '?')}×** "
+            "(<1 = paged faster: prefix hits skip prefill; "
+            f"{pg.get('paged_equal_slots_tokens_per_sec', '?')} vs "
+            f"{pg.get('paged_slot_baseline_tokens_per_sec', '?')} "
+            "tok/s); at-capacity "
+            f"{pg['paged_tokens_per_sec']} tok/s, p99 TTFT ≤ "
+            f"{pg.get('paged_p99_ttft_s', '?')} s "
+            "(`models/batching.PagedContinuousBatchingDecoder`, block-"
+            "gated admission + shared prefix cache; ledger in the "
+            "artifact; at-capacity tok/s is chip-meaningful only — "
+            "CPU smoke is compute-bound by the extra seats) "
+            f"| {backend} smoke, `measure.py --section paged` → `window_out/paged.out`, {today} |"
         )
     sp = data.get("speculative")
     if sp:

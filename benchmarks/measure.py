@@ -539,6 +539,184 @@ def bench_batching() -> dict:
     return out
 
 
+def bench_paged() -> dict:
+    """Paged KV-cache serving vs the slot-based pool at the SAME HBM
+    arena budget (ISSUE 8 acceptance): replay a bursty mixed-length
+    trace — 60% of requests share a multi-block system prompt, budgets
+    and tail lengths drawn from a spread — through both pools and
+    record sustained tokens/sec, p99 TTFT, max concurrent requests
+    admitted, and the prefix-cache hit rate.
+
+    Equal-budget framing: the slot baseline runs S seats, each pinning
+    a full max_len KV cache (S × max_len/block_size blocks of HBM);
+    the paged pool gets EXACTLY that many arena blocks but 4×S seats —
+    admission is gated on blocks free, so mixed-length traffic packs
+    strictly more concurrent requests into the same memory.  Both runs
+    embed their DispatchLedger; the paged run's admission entries
+    carry prefix_tokens, so "full hit = zero prefill work" is visible
+    in the artifact, not just in the test pin.
+
+    CPU smoke: MEASURE_PAGED_TINY=1 swaps in llama_tiny (the
+    tpu_window step runs this so the accounting is exercised every
+    window without chip minutes)."""
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.models.batching import (
+        ContinuousBatchingDecoder,
+        PagedContinuousBatchingDecoder,
+    )
+    from tf_operator_tpu.utils.metrics import SLO_BUCKETS, Metrics
+
+    _apply_platform_override(jax)
+    out = {"paged_backend": jax.default_backend()}
+    seq = int(os.environ.get("MEASURE_PAGED_MAXLEN", "512"))
+    block = int(os.environ.get("MEASURE_PAGED_BLOCK", "16"))
+    slots_base = int(os.environ.get("MEASURE_PAGED_SLOTS", "4"))
+    n_req = int(os.environ.get("MEASURE_PAGED_REQUESTS", "24"))
+    k_sync = int(os.environ.get("MEASURE_PAGED_K", "32"))
+    burst = int(os.environ.get("MEASURE_PAGED_BURST", "8"))
+    if os.environ.get("MEASURE_PAGED_TINY"):
+        from tf_operator_tpu.models import llama_tiny
+
+        model = llama_tiny(vocab_size=256, max_len=seq)
+    else:
+        from bench import llama_mini_config
+        from tf_operator_tpu.models import LlamaLM
+
+        model = LlamaLM(llama_mini_config(seq))
+    vocab = model.cfg.vocab_size
+    r = np.random.RandomState(0)
+    init_ids = jnp.asarray(r.randint(0, vocab, size=(1, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), init_ids)["params"]
+
+    # the bursty mixed-length trace: shared system prompt (2 full
+    # blocks) on 60% of requests, tails 4..seq/4, budgets 8..64
+    sys_prefix = r.randint(0, vocab, size=(2 * block,)).astype(np.int32)
+    trace = []
+    for _ in range(n_req):
+        tail = r.randint(
+            0, vocab, size=(int(r.randint(4, max(5, seq // 4))),)
+        ).astype(np.int32)
+        prompt = (
+            np.concatenate([sys_prefix, tail]) if r.rand() < 0.6 else tail
+        )
+        budget = int(r.choice([8, 16, 32, 64]))
+        if prompt.size + budget > seq:
+            prompt = prompt[: seq - budget]
+        trace.append((prompt, budget))
+    total_new = sum(b for _, b in trace)
+    out["paged_trace_requests"] = n_req
+    out["paged_trace_new_tokens"] = total_new
+    out["paged_arena_blocks"] = slots_base * (seq // block)
+
+    def replay(make_pool):
+        """Burst-submit the trace, drive to drain; returns
+        (wall, max_concurrent, pool, metrics)."""
+
+        metrics = Metrics()
+        metrics.set_buckets("serve_ttft_seconds", SLO_BUCKETS)
+        pool = make_pool(metrics)
+        # warmup TWICE: the cold pass compiles the miss-path width
+        # classes; the second pass runs against the now-published
+        # prefix blocks and compiles the REMAINDER width classes the
+        # hit path admits at (without it those compiles land in the
+        # timed window and masquerade as paging overhead)
+        for _ in range(2):
+            for p, budget in trace:
+                pool.submit(p, budget)
+            pool.run()
+        pool.ledger.reset()
+        metrics2 = Metrics()
+        metrics2.set_buckets("serve_ttft_seconds", SLO_BUCKETS)
+        pool.metrics = metrics2
+        # steady-state hit accounting: the warmup published the shared
+        # prefix blocks (deliberate — the timed replay models a warm
+        # server); count only the timed run's hits/misses
+        prefix = getattr(pool, "prefix", None)
+        hits0 = (prefix.hits, prefix.misses) if prefix else (0, 0)
+        pool._hit_base = hits0
+        max_conc = 0
+        t0 = time.perf_counter()
+        i = 0
+        while True:
+            for p, budget in trace[i : i + burst]:
+                pool.submit(p, budget)
+            i += burst
+            active = pool.step()
+            with pool._lock:
+                max_conc = max(max_conc, len(pool._active))
+            if i >= len(trace) and active == 0:
+                with pool._lock:
+                    if not pool._queue:
+                        break
+        wall = time.perf_counter() - t0
+        return wall, max_conc, pool, metrics2
+
+    # leg A — slot baseline: S seats, each pinning a contiguous
+    # max_len cache (the r6 pool)
+    wall_s, conc_s, slot_pool, m_s = replay(
+        lambda m: ContinuousBatchingDecoder(
+            model, params, slots=slots_base, steps_per_sync=k_sync,
+            metrics=m, model_label="paged-bench",
+        )
+    )
+    out["paged_slot_baseline_tokens_per_sec"] = round(total_new / wall_s, 1)
+    out["paged_slot_baseline_concurrent"] = conc_s
+    out["paged_slot_baseline_p99_ttft_s"] = m_s.histogram(
+        "serve_ttft_seconds", model="paged-bench", mode="pool"
+    ).get("p99_le")
+    out["paged_slot_baseline_dispatches"] = slot_pool.ledger.snapshot()
+
+    # leg B — paging overhead isolated: SAME seats, SAME HBM, only
+    # the cache layout differs.  wall_B/wall_A is the pure cost of
+    # the block-table gather/scatter round trip per program
+    wall_e, _, eq_pool, m_e = replay(
+        lambda m: PagedContinuousBatchingDecoder(
+            model, params, slots=slots_base, steps_per_sync=k_sync,
+            kv_blocks=slots_base * (seq // block), kv_block_size=block,
+            metrics=m, model_label="paged-bench",
+        )
+    )
+    out["paged_equal_slots_tokens_per_sec"] = round(total_new / wall_e, 1)
+    out["paged_equal_slots_p99_ttft_s"] = m_e.histogram(
+        "serve_ttft_seconds", model="paged-bench", mode="pool"
+    ).get("p99_le")
+    # < 1.0 = paged is FASTER at equal resources (prefix-cache hits
+    # skip prefill work and outweigh the gather/scatter layout cost)
+    out["paged_equal_slots_wall_ratio"] = round(wall_e / wall_s, 2)
+
+    # leg C — the capacity claim: the SAME block budget, 4x the
+    # seats.  Admission is block-gated, so mixed-length traffic packs
+    # more concurrent requests into the same HBM; tokens/sec here is
+    # the chip-relevant number (decode is weight-bandwidth-bound at
+    # small batch — more seats amortize the weight reads).  On the
+    # CPU smoke the extra seats COST compute instead, so judge this
+    # leg's tokens/sec only from an on-chip window.
+    wall_p, conc_p, paged_pool, m_p = replay(
+        lambda m: PagedContinuousBatchingDecoder(
+            model, params, slots=4 * slots_base, steps_per_sync=k_sync,
+            kv_blocks=slots_base * (seq // block), kv_block_size=block,
+            metrics=m, model_label="paged-bench",
+        )
+    )
+    out["paged_tokens_per_sec"] = round(total_new / wall_p, 1)
+    out["paged_concurrent_admitted"] = conc_p
+    out["paged_p99_ttft_s"] = m_p.histogram(
+        "serve_ttft_seconds", model="paged-bench", mode="pool"
+    ).get("p99_le")
+    out["paged_dispatches"] = paged_pool.ledger.snapshot()
+    h0, m0 = paged_pool._hit_base
+    hits = paged_pool.prefix.hits - h0
+    misses = paged_pool.prefix.misses - m0
+    out["paged_prefix_hit_rate"] = round(hits / max(1, hits + misses), 3)
+    out["paged_speedup_vs_slot"] = round(wall_s / wall_p, 2)
+    out["paged_capacity_ratio"] = round(conc_p / max(1, conc_s), 2)
+    return out
+
+
 def _spec_pair(model, params, qparams, prompt, n_new, prefix, out) -> None:
     """Measure plain greedy generate vs SpeculativeDecoder (int8
     self-draft) for one model; writes `{prefix}_*` rows + the decoder's
@@ -700,7 +878,8 @@ def main() -> int:
     parser.add_argument(
         "--section",
         choices=[
-            "all", "reconcile", "startup", "train", "batching", "speculative",
+            "all", "reconcile", "startup", "train", "batching",
+            "speculative", "paged",
         ],
         default="all",
     )
@@ -730,6 +909,8 @@ def main() -> int:
         out.update(bench_batching())
     if args.section == "speculative":  # not in "all": needs chip minutes
         out.update(bench_speculative())
+    if args.section == "paged":  # not in "all": needs chip minutes
+        out.update(bench_paged())
     print(json.dumps(out, indent=1))
     return 0
 

@@ -13,6 +13,7 @@ if earlier ones prove the chip is answering):
   4. train        — measure.py --section train (mnist/BERT rows)
   5. flash        — the fwd+bwd flash-vs-XLA perf gates (record ratios)
   6. batching     — continuous-batching pool vs sequential serving
+  6b. paged       — paged-KV pool vs slot pool at equal arena (CPU smoke)
   7. speculative  — int8 self-draft speculation vs plain greedy
   8. trace        — xplane trace of the hot step + top-op summary
   9. sweep        — the ResNet MFU variant x flag matrix
@@ -89,6 +90,26 @@ STEPS = [
         "batching",
         [sys.executable, os.path.join(HERE, "measure.py"), "--section", "batching"],
         2400,
+    ),
+    # paged KV serving vs the slot pool at equal arena budget
+    # (ISSUE 8).  CPU SMOKE by design: the capacity/hit-rate/TTFT
+    # accounting is platform-independent (admission is host-side
+    # arithmetic), so the window exercises it every round on the
+    # host instead of spending chip minutes; drop the env overrides
+    # for an on-chip tokens/sec row when the serving rows get their
+    # dedicated window
+    (
+        "paged",
+        [sys.executable, os.path.join(HERE, "measure.py"),
+         "--section", "paged"],
+        1500,
+        {
+            "MEASURE_PLATFORM": "cpu",
+            "MEASURE_PAGED_TINY": "1",
+            "MEASURE_PAGED_MAXLEN": "128",
+            "MEASURE_PAGED_REQUESTS": "16",
+            "MEASURE_PAGED_K": "8",
+        },
     ),
     # speculative decode vs plain greedy, batch 1: int8 self-draft
     # mini AND the draft!=target wide-700M config (the row serve_lm's

@@ -18,10 +18,14 @@ XLA program per shape) do the actual work.
 
 Serving modes: `--batching SLOTS` multiplexes concurrent requests
 through the continuous-batching pool (models/batching.py — one decode
-loop, step-granular joins); `--quantize int8` halves HBM weight
-traffic per decoded token (ops/quant.py); `--speculative` serves
-greedy requests through the int8 self-draft speculative decoder
-(models/speculative.py — batch-1 latency mode).  `--quantize`
+loop, step-granular joins; PAGED by default since r11: block-granular
+KV admission + shared prefix cache, `--kv-blocks`/`--kv-block-size`
+size the arena); `--replicas N` runs N pool replicas behind one
+admission queue (models/pool_router.py — per-replica gauges on
+/metrics, merged quantiles on /slo); `--quantize int8` halves HBM
+weight traffic per decoded token (ops/quant.py); `--speculative`
+serves greedy requests through the int8 self-draft speculative
+decoder (models/speculative.py — batch-1 latency mode).  `--quantize`
 composes with either; `--batching` and `--speculative` are mutually
 exclusive (throughput vs latency optimizations).
 
@@ -100,7 +104,8 @@ def speculative_slowdown(ledger_path: "str | None" = None):
 def build_handler(
     model, params, max_len: int, batching_slots: int = 0,
     speculative: bool = False, prompt_cache: int = 0, tracer=None,
-    model_label: str = "", metrics=None,
+    model_label: str = "", metrics=None, replicas: int = 1,
+    kv_blocks: "int | None" = None, kv_block_size: int = 16,
 ):
     """batching_slots > 0 serves through the continuous-batching pool
     (models/batching.py): concurrent requests share one decode loop,
@@ -228,34 +233,74 @@ def build_handler(
         if prompt_cache:
             raise ValueError(
                 "--prompt-cache applies to the chunked decoder; the "
-                "batching pool prefills into per-slot caches and does "
-                "not consume it — drop one of the flags"
+                "batching pool consumes the shared PREFIX cache "
+                "(models/prefix_cache.py) instead — drop one of the "
+                "flags"
             )
-        pool = ContinuousBatchingDecoder(
-            model, params, slots=batching_slots, ledger=ledger,
-            metrics=metrics, model_label=model_label,
+        from tf_operator_tpu.models.batching import (
+            PagedContinuousBatchingDecoder,
+        )
+        from tf_operator_tpu.models.kv_blocks import NotPageableError
+        from tf_operator_tpu.models.pool_router import PoolRouter
+
+        n_replicas = max(1, int(replicas))
+        pool_replicas = []
+        for i in range(n_replicas):
+            # replica labels only under the router: single-replica
+            # serving keeps the legacy unlabeled series
+            rep = str(i) if n_replicas > 1 else ""
+            try:
+                # PAGED is the default pool (ISSUE 8): admission gated
+                # on blocks free, shared prefix cache; kv_blocks=None
+                # sizes the arena at the slot pool's HBM budget
+                p = PagedContinuousBatchingDecoder(
+                    model, params, slots=batching_slots,
+                    kv_blocks=kv_blocks, kv_block_size=kv_block_size,
+                    ledger=ledger, metrics=metrics,
+                    model_label=model_label, replica_label=rep,
+                )
+            except NotPageableError as exc:
+                # MODEL-shape fallback only (rolling-window caches):
+                # operator config errors (bad --kv-blocks /
+                # --kv-block-size) must fail startup, not silently
+                # downgrade away the paged capacity they asked for
+                print(f"paged pool unavailable ({exc}); serving the "
+                      "contiguous slot pool", flush=True)
+                p = ContinuousBatchingDecoder(
+                    model, params, slots=batching_slots, ledger=ledger,
+                    metrics=metrics, model_label=model_label,
+                    replica_label=rep,
+                )
+            pool_replicas.append(p)
+        pool = (
+            PoolRouter(pool_replicas) if n_replicas > 1
+            else pool_replicas[0]
         )
         pool_fatal = []  # driver-thread death must surface as 500s
 
-        def _drive():
+        def _drive(p, hb_name):
             # the pool driver is THE liveness-critical thread: a wedge
             # here hangs every queued client, so it heartbeats the
             # process watchdog (which dumps stacks + flight recorder
             # past the deadline — utils/watchdog.py)
             from tf_operator_tpu.utils.watchdog import default_watchdog
 
-            hb = default_watchdog.register("serving.pool")
+            hb = default_watchdog.register(hb_name)
             while True:
                 try:
                     hb.beat()
-                    if pool.step() == 0:
+                    if p.step() == 0:
                         _time.sleep(0.005)
                 except Exception as exc:  # a dead driver = hung clients
                     pool_fatal.append(repr(exc))
                     default_watchdog.unregister(hb.name)
                     return
 
-        threading.Thread(target=_drive, daemon=True).start()
+        for i, p in enumerate(pool_replicas):
+            name = "serving.pool" if n_replicas == 1 else f"serving.pool{i}"
+            threading.Thread(
+                target=_drive, args=(p, name), daemon=True
+            ).start()
         spec = None
     else:
         pool = None
@@ -337,7 +382,11 @@ def build_handler(
             if self.path == "/slo":
                 # the operator's one-look answer to "what latency are
                 # users seeing right now": per-{model,mode} quantiles
-                # of every SLO family plus the live load gauges
+                # of every SLO family plus the live load gauges.
+                # MERGED across {replica=} (histogram_family_merged):
+                # multi-replica serving reports ONE user-facing p99
+                # TTFT, not N disjoint per-replica summaries; /metrics
+                # keeps the per-replica series for capacity eyes.
                 fams = {}
                 for fam in (
                     "serve_ttft_seconds",
@@ -348,19 +397,32 @@ def build_handler(
                     fams[fam] = [
                         {**dict(labels), **finite_summary(summary)}
                         for labels, summary in sorted(
-                            metrics.histogram_family(fam).items()
+                            metrics.histogram_family_merged(fam).items()
                         )
                     ]
+
+                def gauge_sum(name: str) -> float:
+                    # per-replica gauge series sum to the fleet view
+                    return sum(
+                        v
+                        for labels, v in metrics.gauge_series(name).items()
+                        if dict(labels).get("model", model_label)
+                        == model_label
+                    )
+
                 return self._reply(200, {
                     "model": model_label,
+                    "replicas": max(1, int(replicas)),
                     "histograms": fams,
                     "gauges": {
-                        "serve_admission_queue_depth": metrics.gauge(
-                            "serve_admission_queue_depth", model=model_label
+                        "serve_admission_queue_depth": gauge_sum(
+                            "serve_admission_queue_depth"
                         ),
-                        "serve_tokens_in_flight": metrics.gauge(
-                            "serve_tokens_in_flight", model=model_label
+                        "serve_tokens_in_flight": gauge_sum(
+                            "serve_tokens_in_flight"
                         ),
+                        "kv_blocks_free": gauge_sum("kv_blocks_free"),
+                        "kv_blocks_in_use": gauge_sum("kv_blocks_in_use"),
                     },
                     "requests_ok": metrics.counter(
                         "serve_requests_total", status="200"
@@ -574,7 +636,27 @@ def main() -> int:
         "--batching", type=int, default=0, metavar="SLOTS",
         help="serve through the continuous-batching pool with this many "
              "slots (concurrent requests share one decode loop); 0 = "
-             "one-request-at-a-time ChunkedServingDecoder",
+             "one-request-at-a-time ChunkedServingDecoder.  The pool is "
+             "PAGED by default (block-granular KV admission + shared "
+             "prefix cache — models/batching.py); rolling-window "
+             "models fall back to the contiguous slot pool",
+    )
+    ap.add_argument(
+        "--replicas", type=int, default=1, metavar="N",
+        help="run N pool replicas behind one admission queue "
+             "(models/pool_router.py — least-blocks-in-use routing; "
+             "per-replica gauges on /metrics, merged quantiles on "
+             "/slo).  Requires --batching",
+    )
+    ap.add_argument(
+        "--kv-blocks", type=int, default=None, metavar="N",
+        help="paged pool arena size in KV blocks per replica (default: "
+             "slots x max_len / block-size — the same HBM the slot "
+             "pool would pin, now admitting by blocks free)",
+    )
+    ap.add_argument(
+        "--kv-block-size", type=int, default=16, metavar="TOKENS",
+        help="tokens per KV block (must divide max_len)",
     )
     ap.add_argument(
         "--quantize", choices=["int8"], default=None,
@@ -655,11 +737,14 @@ def main() -> int:
             f"{before / 1e6:.1f} MB -> {tree_bytes(params) / 1e6:.1f} MB",
             flush=True,
         )
+    if args.replicas > 1 and not args.batching:
+        raise SystemExit("--replicas requires --batching SLOTS")
     handler = build_handler(
         model, params, max_len,
         batching_slots=args.batching, speculative=args.speculative,
         prompt_cache=args.prompt_cache, model_label=model_label,
-        metrics=serve_metrics,
+        metrics=serve_metrics, replicas=args.replicas,
+        kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size,
     )
     server = ThreadingHTTPServer(("127.0.0.1", args.port), handler)
     # the serving binary boots the SLO evaluator (build_handler only

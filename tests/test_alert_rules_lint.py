@@ -150,6 +150,13 @@ def test_collector_sees_known_call_sites():
     assert "client" in families["api_client_circuit_open_total"]
     # checkpointer durability stamp (parallel/checkpoint.py)
     assert "checkpoint_last_success_unix" in families
+    # paged KV serving (models/batching.py + prefix_cache.py, ISSUE 8):
+    # the kv-blocks-pressure rule and the rebound serving policy bind
+    # these — the keys must stay declared at the literal call sites
+    assert {"model", "replica"} <= families["kv_blocks_pressure"]
+    assert {"model", "replica"} <= families["kv_blocks_free"]
+    assert "mode" in families["serve_prefix_cache_hits_total"]
+    assert "mode" in families["serve_prefix_cache_evictions_total"]
 
 
 def test_lint_catches_a_renamed_metric():

@@ -89,6 +89,47 @@ def test_lint_catches_a_renamed_signal():
     assert pol.signals[1].name not in families
 
 
+def test_serving_policy_binds_blocks_free_pressure():
+    """ISSUE 8: the stock serving policy is rebound to blocks-free
+    pressure — its gauge binding must name the paged pool's emitted
+    ``kv_blocks_pressure`` family (with the declared {model, replica}
+    keys) and trigger BEFORE the kv-blocks-pressure alert pages, so
+    the autoscaler acts on real memory headroom first."""
+
+    families = collect_emitted_families()
+    pol = default_serving_policy()
+    gauge_sigs = [s for s in pol.signals if s.kind == "gauge"]
+    assert any(s.name == "kv_blocks_pressure" for s in gauge_sigs)
+    assert {"model", "replica"} <= families["kv_blocks_pressure"]
+    pressure_rule = next(
+        r for r in default_rules() if r.name == "kv-blocks-pressure"
+    )
+    (sig,) = [s for s in gauge_sigs if s.name == "kv_blocks_pressure"]
+    assert sig.threshold <= pressure_rule.threshold
+
+
+def test_paged_serving_families_are_emitted_with_expected_labels():
+    """The ISSUE 8 metric families any rule/policy/dashboard may bind:
+    kv_blocks_* gauges carry {model, replica}; the unified prefix
+    cache counters carry {mode} — a rename fails tier-1 here before
+    it orphans a binding silently."""
+
+    families = collect_emitted_families()
+    for fam in (
+        "kv_blocks_free",
+        "kv_blocks_total",
+        "kv_blocks_in_use",
+        "kv_blocks_pressure",
+    ):
+        assert {"model", "replica"} <= families[fam], fam
+    for fam in (
+        "serve_prefix_cache_hits_total",
+        "serve_prefix_cache_misses_total",
+        "serve_prefix_cache_evictions_total",
+    ):
+        assert "mode" in families[fam], fam
+
+
 def test_stock_policy_checkpoint_gate_is_consistent_with_alert_rule():
     """The training policy's resize gate and the checkpoint-stale alert
     read the same stamp: the gate threshold must not be LOOSER than the

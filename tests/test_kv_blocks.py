@@ -1,0 +1,196 @@
+"""Block allocator + prefix cache invariants (ISSUE 8 satellite).
+
+Property-style: random admit/finish/share/evict sequences must
+conserve the free list (free + live == usable, no double-free, no id
+aliased across live holders) and never reclaim a refcounted shared
+block while anything maps it.  Host-only — no jax, runs in tier-1.
+"""
+
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models.kv_blocks import (
+    SCRATCH_BLOCK,
+    BlockAllocator,
+    BlockError,
+    blocks_for,
+)
+from tf_operator_tpu.models.prefix_cache import (
+    PrefixCache,
+    chain_keys,
+    exact_key,
+)
+
+
+class TestBlockAllocator:
+    def test_alloc_free_roundtrip_and_conservation(self):
+        a = BlockAllocator(9, 16)  # 8 usable + scratch
+        assert a.usable == 8 and a.free_count == 8
+        ids = a.alloc(5)
+        assert len(ids) == 5 and len(set(ids)) == 5
+        assert SCRATCH_BLOCK not in ids
+        assert a.free_count == 3 and a.in_use == 5
+        a.check()
+        assert a.release(ids) == 5
+        assert a.free_count == 8 and a.in_use == 0
+        a.check()
+
+    def test_all_or_nothing_on_shortfall(self):
+        a = BlockAllocator(5, 8)  # 4 usable
+        first = a.alloc(3)
+        assert a.alloc(2) is None  # only 1 free: nothing allocated
+        assert a.free_count == 1
+        a.check()
+        a.release(first)
+        assert a.alloc(4) is not None
+
+    def test_refcounted_share_survives_first_release(self):
+        a = BlockAllocator(4, 8)
+        (bid,) = a.alloc(1)
+        a.retain([bid])  # second holder (e.g. the prefix cache)
+        assert a.refcount(bid) == 2
+        assert a.release([bid]) == 0  # still held: NOT freed
+        assert a.refcount(bid) == 1 and a.in_use == 1
+        assert a.release([bid]) == 1  # last holder frees it
+        assert a.in_use == 0
+        a.check()
+
+    def test_double_free_and_bad_retain_raise(self):
+        a = BlockAllocator(4, 8)
+        (bid,) = a.alloc(1)
+        a.release([bid])
+        with pytest.raises(BlockError):
+            a.release([bid])
+        with pytest.raises(BlockError):
+            a.retain([bid])
+        a.check()
+
+    def test_random_sequences_conserve_the_free_list(self):
+        """The property test: random alloc/retain/release interleavings
+        never break conservation, never alias, never double-free."""
+
+        r = np.random.RandomState(0)
+        a = BlockAllocator(33, 16)  # 32 usable
+        live = []  # (ids, extra_refs)
+        for _ in range(500):
+            op = r.randint(3)
+            if op == 0:
+                n = int(r.randint(1, 6))
+                ids = a.alloc(n)
+                if ids is not None:
+                    assert len(set(ids)) == len(ids)
+                    for held_ids, _ in live:
+                        assert not (set(ids) & set(held_ids)), "aliased!"
+                    live.append([ids, 0])
+            elif op == 1 and live:
+                ent = live[r.randint(len(live))]
+                a.retain(ent[0])
+                ent[1] += 1
+            elif op == 2 and live:
+                i = r.randint(len(live))
+                ids, extra = live[i]
+                a.release(ids)
+                if extra:
+                    live[i][1] -= 1
+                else:
+                    live.pop(i)
+            a.check()
+        total_live = set()
+        for ids, _ in live:
+            total_live |= set(ids)
+        assert a.in_use == len(total_live)
+        assert a.free_count == a.usable - len(total_live)
+
+    def test_blocks_for(self):
+        assert blocks_for(1, 16) == 1
+        assert blocks_for(16, 16) == 1
+        assert blocks_for(17, 16) == 2
+        assert blocks_for(64, 16) == 4
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            BlockAllocator(1, 16)
+        with pytest.raises(ValueError):
+            BlockAllocator(4, 0)
+
+
+class TestChainKeys:
+    def test_chain_addresses_the_whole_prefix(self):
+        toks = np.arange(48, dtype=np.int32)
+        keys = chain_keys(toks, 16)
+        assert len(keys) == 3
+        # same prefix -> same chain; divergence at block i changes
+        # keys i.. and leaves 0..i-1 intact
+        other = toks.copy()
+        other[20] += 1
+        keys2 = chain_keys(other, 16)
+        assert keys2[0] == keys[0]
+        assert keys2[1] != keys[1] and keys2[2] != keys[2]
+        # partial trailing block gets no key
+        assert len(chain_keys(toks[:40], 16)) == 2
+
+    def test_same_block_content_different_prefix_differs(self):
+        # content-addressing is CHAINED: block 1 of [A,B] and block 1
+        # of [C,B] must not collide even though B's tokens match
+        a = np.arange(32, dtype=np.int32)
+        b = np.concatenate([np.arange(16, 32, dtype=np.int32),
+                            np.arange(16, 32, dtype=np.int32)])
+        assert chain_keys(a, 16)[1] != chain_keys(b, 16)[1]
+
+    def test_exact_key_includes_shape_and_dtype(self):
+        flat = np.arange(4, dtype=np.int32)
+        assert exact_key(flat.reshape(1, 4)) != exact_key(flat.reshape(2, 2))
+        assert exact_key(flat) != exact_key(flat.astype(np.int64))
+
+
+class TestPrefixCache:
+    def test_lru_capacity_and_metrics(self):
+        from tf_operator_tpu.utils.metrics import Metrics
+
+        m = Metrics()
+        c = PrefixCache(capacity=2, metrics=m, mode="pool")
+        c.put(b"a", 1)
+        c.put(b"b", 2)
+        assert c.get(b"a") == 1  # refreshes a
+        c.put(b"c", 3)  # evicts b (LRU)
+        assert c.get(b"b") is None
+        assert c.get(b"c") == 3
+        assert (c.hits, c.misses, c.evictions) == (2, 1, 1)
+        assert m.counter("serve_prefix_cache_hits_total", mode="pool") == 2
+        assert m.counter("serve_prefix_cache_misses_total", mode="pool") == 1
+        assert m.counter("serve_prefix_cache_evictions_total", mode="pool") == 1
+
+    def test_referenced_entries_never_evict(self):
+        """The aliasing guard: an entry whose block something still
+        maps (can_evict False) survives any pressure; eviction takes
+        the next LRU candidate instead."""
+
+        alloc = BlockAllocator(5, 8)
+        ids = alloc.alloc(3)
+        mapped = {ids[0]}  # a seat maps block ids[0]
+        for bid in ids:
+            alloc.retain([bid])  # the cache's own reference
+        freed = []
+        c = PrefixCache(
+            can_evict=lambda bid: bid not in mapped,
+            on_evict=lambda bid: freed.append(alloc.release([bid])),
+        )
+        for i, bid in enumerate(ids):
+            c.put(bytes([i]), bid)
+        assert c.evict_lru(need=3) == 2  # the mapped one is skipped
+        assert bytes([0]) in c and len(c) == 1
+        alloc.check()
+        assert alloc.refcount(ids[0]) == 2  # untouched
+        # unmap -> now evictable
+        mapped.clear()
+        assert c.evict_lru(need=1) == 1
+        assert len(c) == 0
+
+    def test_peek_does_not_count(self):
+        c = PrefixCache()
+        c.put(b"k", 7)
+        assert c.peek(b"k") == 7 and c.peek(b"x") is None
+        assert (c.hits, c.misses) == (0, 0)
+        c.record(True)
+        c.record(False)
+        assert (c.hits, c.misses) == (1, 1)

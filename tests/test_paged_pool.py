@@ -1,0 +1,445 @@
+"""Paged KV-cache serving (ISSUE 8 tentpole): block-granular
+admission, shared prefix cache, multi-replica router.
+
+The load-bearing pins:
+
+- EXACTNESS: paged + prefix-cached decode produces token-identical
+  output to the contiguous pool for a seeded mixed request set (the
+  gather/scatter is an identity re-layout feeding the same compiled
+  math).
+- ZERO-PREFILL FULL HIT: a request whose prompt's full blocks are all
+  cached admits in exactly ONE fused dispatch with 0 prefill-phase
+  dispatches and the admission width collapsed to the remainder class
+  (DispatchLedger-pinned — extending the PR-3 single-dispatch
+  contract).
+- CAPACITY: at an equal HBM arena budget the paged pool admits
+  strictly more concurrent mixed-length requests than the slot pool.
+- No aliasing: allocator conservation holds after every scenario and
+  shared blocks are never reclaimed while mapped.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # generation-loop compiles
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models import llama_tiny
+from tf_operator_tpu.models.batching import (
+    ContinuousBatchingDecoder,
+    PagedContinuousBatchingDecoder,
+)
+from tf_operator_tpu.models.pool_router import PoolRouter
+from tf_operator_tpu.utils.metrics import Metrics
+
+VOCAB = 96
+
+
+def _setup(max_len=64):
+    model = llama_tiny(vocab_size=VOCAB, max_len=max_len)
+    init = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), init)["params"]
+    return model, params
+
+
+def _prompts(r, lens):
+    return [r.randint(0, VOCAB, size=(l,)).astype(np.int32) for l in lens]
+
+
+class TestPagedExactness:
+    def test_token_identical_to_contiguous_for_seeded_mix(self):
+        """The acceptance exactness pin: a seeded mixed-length request
+        set — greedy and temperature, short and multi-block prompts,
+        a repeated prompt that takes the prefix-cache hit path —
+        produces byte-identical rows through the paged pool and the
+        contiguous pool."""
+
+        model, params = _setup()
+        r = np.random.RandomState(7)
+        sys_prompt = r.randint(0, VOCAB, size=(35,)).astype(np.int32)
+        reqs = [
+            (_p, kw)
+            for _p, kw in [
+                (sys_prompt, dict(max_new_tokens=5)),
+                # shares sys_prompt's first two full blocks
+                (np.concatenate([sys_prompt[:32],
+                                 r.randint(0, VOCAB, size=(6,))
+                                 .astype(np.int32)]),
+                 dict(max_new_tokens=6)),
+                (_prompts(r, [3])[0], dict(max_new_tokens=9)),
+                # full-hit repeat, sampled
+                (sys_prompt, dict(max_new_tokens=7, temperature=0.8,
+                                  rng=jax.random.PRNGKey(9))),
+                (_prompts(r, [17])[0],
+                 dict(max_new_tokens=4, temperature=1.1, top_k=8,
+                      rng=jax.random.PRNGKey(3))),
+            ]
+        ]
+
+        base = ContinuousBatchingDecoder(model, params, slots=4)
+        want = []
+        for p, kw in reqs:
+            rid = base.submit(p, **kw)
+            base.run()
+            want.append(base.result(rid))
+
+        paged = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16
+        )
+        rids = []
+        for p, kw in reqs:
+            rids.append(paged.submit(p, **kw))
+            paged.step()  # staggered: hit paths see published blocks
+        paged.run()
+        for rid, w in zip(rids, want):
+            np.testing.assert_array_equal(paged.result(rid), w)
+        # every scenario ends with the arena conserved: live blocks
+        # are exactly the prefix cache's published ones
+        paged.alloc.check()
+        assert paged.alloc.in_use == len(paged.prefix)
+        assert paged.prefix.hits >= 1  # the repeat really hit
+
+    def test_slot_isolation_under_occupancy(self):
+        model, params = _setup()
+        r = np.random.RandomState(11)
+        prompts = _prompts(r, [5, 9, 3])
+        solo = []
+        for p in prompts:
+            dec = PagedContinuousBatchingDecoder(
+                model, params, slots=4, kv_block_size=16
+            )
+            rid = dec.submit(p, max_new_tokens=6)
+            dec.run()
+            solo.append(dec.result(rid))
+        dec = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16
+        )
+        rids = [dec.submit(p, max_new_tokens=6) for p in prompts]
+        dec.run()
+        for rid, w in zip(rids, solo):
+            np.testing.assert_array_equal(dec.result(rid), w)
+
+    def test_overshoot_at_max_len_cannot_corrupt_published_blocks(self):
+        """A request ending exactly at max_len overshoots its final
+        K-window past the cache edge (the in-view writes clamp, like
+        the contiguous pool's documented dead-row writes).  The
+        clamped positions land only in the seat's OWN tail block —
+        a later request mapping the retiree's published prefix blocks
+        must still decode token-identically."""
+
+        model, params = _setup(max_len=64)
+        r = np.random.RandomState(9)
+        prompt = r.randint(0, VOCAB, size=(34,)).astype(np.int32)
+        tail = r.randint(0, VOCAB, size=(5,)).astype(np.int32)
+        follow = np.concatenate([prompt[:32], tail])
+
+        base = ContinuousBatchingDecoder(model, params, slots=2,
+                                         steps_per_sync=8)
+        b1 = base.submit(prompt, max_new_tokens=30)  # 34 + 30 == 64
+        base.run()
+        base.result(b1)
+        b2 = base.submit(follow, max_new_tokens=6)
+        base.run()
+        want = base.result(b2)
+
+        paged = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=16, steps_per_sync=8
+        )
+        p1 = paged.submit(prompt, max_new_tokens=30)
+        paged.run()
+        assert paged.result(p1) is not None
+        p2 = paged.submit(follow, max_new_tokens=6)  # maps published blocks
+        paged.run()
+        np.testing.assert_array_equal(paged.result(p2), want)
+        assert paged.prefix.hits == 1
+        paged.alloc.check()
+
+    def test_non_pow2_block_size_straddle_is_exact(self):
+        """Review regression: a block size that divides max_len but
+        NOT the pow2 width class (48, bs=12: a 13-token prompt pads to
+        width 16, straddling two blocks) — the admission scatter must
+        CEIL its block count or the straddle block is dropped (and the
+        never-written block could even publish into the prefix
+        cache)."""
+
+        model, params = _setup(max_len=48)
+        r = np.random.RandomState(13)
+        reqs = [
+            (r.randint(0, VOCAB, size=(13,)).astype(np.int32),
+             dict(max_new_tokens=6)),
+            (r.randint(0, VOCAB, size=(25,)).astype(np.int32),
+             dict(max_new_tokens=5)),
+        ]
+        base = ContinuousBatchingDecoder(model, params, slots=2)
+        want = []
+        for p, kw in reqs:
+            rid = base.submit(p, **kw)
+            base.run()
+            want.append(base.result(rid))
+        paged = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=12
+        )
+        for (p, kw), w in zip(reqs, want):
+            rid = paged.submit(p, **kw)
+            paged.run()
+            np.testing.assert_array_equal(paged.result(rid), w)
+        # repeat the first prompt: its published straddle-adjacent
+        # block must hold REAL prefill content
+        rid = paged.submit(reqs[0][0], **reqs[0][1])
+        paged.run()
+        np.testing.assert_array_equal(paged.result(rid), want[0])
+        assert paged.prefix.hits == 1
+        paged.alloc.check()
+
+    def test_rolling_window_models_are_refused(self):
+        from tf_operator_tpu.models.kv_blocks import NotPageableError
+
+        model = llama_tiny(vocab_size=VOCAB, max_len=48, window=8)
+        init = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), init)["params"]
+        with pytest.raises(NotPageableError):
+            PagedContinuousBatchingDecoder(model, params, slots=2)
+        # config errors are NOT NotPageableError: serve_lm's fallback
+        # must not swallow them (review regression)
+        model2, params2 = _setup(max_len=64)
+        with pytest.raises(ValueError) as ei:
+            PagedContinuousBatchingDecoder(
+                model2, params2, slots=2, kv_block_size=24  # !| 64
+            )
+        assert not isinstance(ei.value, NotPageableError)
+
+
+class TestFullPrefixHit:
+    def test_full_hit_admits_with_zero_prefill_dispatches(self):
+        """Ledger pin: a repeat of a multi-block prompt maps its full
+        blocks copy-free and admits in ONE 'admission' dispatch — 0
+        prefill-phase dispatches ever, the legacy prefill jit caches
+        stay empty, and the fused program runs at the REMAINDER width
+        class (<= one block), not the prompt's."""
+
+        model, params = _setup()
+        r = np.random.RandomState(5)
+        prompt = r.randint(0, VOCAB, size=(33,)).astype(np.int32)
+        dec = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=16
+        )
+        r1 = dec.submit(prompt, max_new_tokens=4)
+        dec.run()
+        assert dec.result(r1) is not None
+        assert len(dec.prefix) == 2  # both full blocks published
+        first_widths = sorted(dec._admit_fns)  # the miss compiled 64
+
+        r2 = dec.submit(prompt, max_new_tokens=6)  # full hit
+        dec.run()
+        assert dec.result(r2) is not None
+        assert dec.prefix.hits == 1
+        # exactly one admission per request, zero prefill/sample/
+        # scatter dispatches, legacy machinery never constructed
+        assert dec.ledger.count("admission") == 2
+        assert dec.ledger.count("prefill") == 0
+        assert dec.ledger.count("sample") == 0
+        assert dec.ledger.count("scatter") == 0
+        assert dec._prefill_fns == {} and dec._scatter_fn is None
+        # the full hit compiled/ran the remainder class: 33 - 32
+        # cached = 1 token -> width 1, vs the miss's width-64 program
+        new_widths = sorted(set(dec._admit_fns) - set(first_widths))
+        assert new_widths == [1]
+        dec.alloc.check()
+
+    def test_shared_blocks_never_reclaimed_while_mapped(self):
+        """A seat decoding over shared prefix blocks pins them: arena
+        pressure may evict every cold cache entry but the mapped
+        blocks survive until the seat retires."""
+
+        model, params = _setup()
+        r = np.random.RandomState(6)
+        prompt = r.randint(0, VOCAB, size=(33,)).astype(np.int32)
+        # arena of 6 blocks: the long-lived request holds 2 shared + 2
+        # fresh; pressure then forces eviction attempts
+        dec = PagedContinuousBatchingDecoder(
+            model, params, slots=3, kv_block_size=16, kv_blocks=6
+        )
+        warm = dec.submit(prompt, max_new_tokens=4)
+        dec.run()
+        dec.result(warm)
+        shared_bids = [dec.prefix.peek(k) for k in list(
+            dec.prefix._entries)]
+        assert len(shared_bids) == 2
+        # long-runner maps the shared blocks and stays active
+        long_rid = dec.submit(prompt, max_new_tokens=25)
+        dec._admit()
+        for bid in shared_bids:
+            assert dec.alloc.refcount(bid) == 2  # cache + seat
+        # now a burst that wants more blocks than are free: eviction
+        # pressure must NOT reclaim the mapped shared blocks
+        burst = dec.submit(r.randint(0, VOCAB, size=(20,)).astype(np.int32),
+                           max_new_tokens=12)
+        dec.run()
+        assert dec.result(long_rid) is not None
+        assert dec.result(burst) is not None
+        dec.alloc.check()
+
+
+class TestBlockGatedAdmission:
+    def test_admission_gates_on_blocks_not_slots(self):
+        """The capacity acceptance pin: at the SAME HBM arena budget
+        (2 max_len slots' worth of KV), the paged pool concurrently
+        admits every short request while the slot pool caps at 2."""
+
+        model, params = _setup()
+        r = np.random.RandomState(3)
+        prompts = _prompts(r, [6, 6, 6, 6, 6])
+
+        slot_pool = ContinuousBatchingDecoder(model, params, slots=2)
+        for p in prompts:
+            slot_pool.submit(p, max_new_tokens=10)
+        slot_pool._admit()
+        with slot_pool._lock:
+            slot_concurrent = len(slot_pool._active)
+        assert slot_concurrent == 2  # seats are the cap
+
+        # same budget: 2 slots x (64/16) blocks = 8 blocks
+        paged = PagedContinuousBatchingDecoder(
+            model, params, slots=8, kv_block_size=16, kv_blocks=8
+        )
+        rids = [paged.submit(p, max_new_tokens=10) for p in prompts]
+        paged._admit()
+        with paged._lock:
+            paged_concurrent = len(paged._active)
+        assert paged_concurrent == 5  # strictly more, same memory
+        paged.run()
+        slot_pool.run()
+        for rid in rids:
+            assert paged.result(rid) is not None
+        paged.alloc.check()
+
+    def test_queue_holds_until_blocks_free(self):
+        model, params = _setup()
+        r = np.random.RandomState(4)
+        big = _prompts(r, [20, 20, 20])
+        dec = PagedContinuousBatchingDecoder(
+            model, params, slots=6, kv_block_size=16, kv_blocks=4
+        )
+        rids = [dec.submit(p, max_new_tokens=14) for p in big]  # 3 blocks ea
+        dec._admit()
+        with dec._lock:
+            assert len(dec._active) == 1 and len(dec._queue) == 2
+        dec.run()  # retires free blocks; the queue drains
+        for rid, p in zip(rids, big):
+            out = dec.result(rid)
+            assert out.shape == (p.size + 14,)
+            np.testing.assert_array_equal(out[: p.size], p)
+        dec.alloc.check()
+
+    def test_submit_rejects_requests_larger_than_the_arena(self):
+        model, params = _setup()
+        dec = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=16, kv_blocks=3
+        )
+        with pytest.raises(ValueError):
+            dec.submit(np.zeros((40,), np.int32), max_new_tokens=24)
+
+    def test_pressure_evicts_cold_cache_entries(self):
+        """Staging-backpressure satellite: queued work never pins
+        device memory (submit is host-only under paging), and arena
+        pressure reclaims UNMAPPED prefix-cache blocks LRU-first
+        instead of blocking admission."""
+
+        model, params = _setup()
+        r = np.random.RandomState(8)
+        prompt = r.randint(0, VOCAB, size=(33,)).astype(np.int32)
+        dec = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, kv_blocks=4
+        )
+        x = dec.submit(prompt, max_new_tokens=4)
+        dec.run()
+        dec.result(x)
+        assert len(dec.prefix) == 2 and dec.alloc.in_use == 2
+        # 4-block request: only 2 free -> evicts both cold entries
+        y = dec.submit(r.randint(0, VOCAB, size=(30,)).astype(np.int32),
+                       max_new_tokens=20)
+        dec.run()
+        assert dec.result(y) is not None
+        # both cold entries reclaimed; the new prompt's own full block
+        # is published in their place
+        assert dec.prefix.evictions == 2 and len(dec.prefix) == 1
+        dec.alloc.check()
+
+    def test_gauges_track_blocks_and_pressure(self):
+        model, params = _setup()
+        m = Metrics()
+        dec = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, kv_blocks=8,
+            metrics=m, model_label="t",
+        )
+        assert m.gauge("kv_blocks_free", model="t", replica="0") == 8.0
+        assert m.gauge("kv_blocks_total", model="t", replica="0") == 8.0
+        rid = dec.submit(np.arange(20, dtype=np.int32) % VOCAB,
+                         max_new_tokens=20)  # 3 blocks
+        dec._admit()
+        assert m.gauge("kv_blocks_free", model="t", replica="0") == 5.0
+        assert m.gauge(
+            "kv_blocks_pressure", model="t", replica="0"
+        ) == pytest.approx(3 / 8)
+        dec.run()
+        dec.result(rid)
+        # retire frees the non-published blocks; the published prompt
+        # block stays under the cache's reference
+        assert m.gauge("kv_blocks_free", model="t", replica="0") == 7.0
+
+
+class TestPoolRouter:
+    def test_least_blocks_routing_and_result_surface(self):
+        model, params = _setup()
+        pools = [
+            PagedContinuousBatchingDecoder(
+                model, params, slots=4, kv_block_size=16, kv_blocks=8,
+                replica_label=str(i),
+            )
+            for i in range(2)
+        ]
+        router = PoolRouter(pools)
+        r = np.random.RandomState(2)
+        prompts = _prompts(r, [6, 6, 6, 6])
+        rids = [router.submit(p, max_new_tokens=10) for p in prompts]
+        # least-loaded routing alternates while nothing drains
+        with pools[0]._lock, pools[1]._lock:
+            q0 = len(pools[0]._queue)
+            q1 = len(pools[1]._queue)
+        assert (q0, q1) == (2, 2)
+        router.run()
+        for rid, p in zip(rids, prompts):
+            out = router.result_wait(rid, timeout=60)
+            assert out is not None
+            np.testing.assert_array_equal(out[: p.size], p)
+        # evict-on-read + unknown rid contract matches the pool's
+        with pytest.raises(KeyError):
+            router.result(rids[0])
+
+    def test_replica_outputs_match_single_pool(self):
+        """Routing must not change tokens: each replica is the same
+        compiled math, so a request's row is identical whichever
+        replica served it."""
+
+        model, params = _setup()
+        solo = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16
+        )
+        p = np.arange(9, dtype=np.int32) % VOCAB
+        rid = solo.submit(p, max_new_tokens=6)
+        solo.run()
+        want = solo.result(rid)
+
+        router = PoolRouter([
+            PagedContinuousBatchingDecoder(
+                model, params, slots=4, kv_block_size=16,
+                replica_label=str(i),
+            )
+            for i in range(3)
+        ])
+        rids = [router.submit(p, max_new_tokens=6) for _ in range(3)]
+        router.run()
+        for rid in rids:
+            np.testing.assert_array_equal(router.result(rid), want)

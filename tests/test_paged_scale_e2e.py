@@ -1,0 +1,217 @@
+"""Closed loop (ISSUE 8 acceptance): blocks-free pressure from a REAL
+paged pool drives a 1→N serving scale-up through the PR 7 autoscaler
+against kubesim — per-replica gauges visible on /metrics, merged
+quantiles on /slo.
+
+The chain under test: paged pool admissions consume arena blocks →
+``kv_blocks_pressure`` gauge (worst replica) → the STOCK serving
+policy's rebound gauge binding breaches → Autoscaler decision → the
+kubesim-backed reconciler creates worker pods.  Relief drains the pool
+and the hysteresis latch + stabilization shed the replicas back.
+"""
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # pool compiles + kubesim round trips
+
+import jax
+import jax.numpy as jnp
+
+from tests.testutil import new_job
+from tf_operator_tpu.api.types import AutoscalingSpec
+from tf_operator_tpu.backend.kube import KubeBackend
+from tf_operator_tpu.backend.kubejobs import KubeJobStore
+from tf_operator_tpu.backend.kubesim import MiniApiServer
+from tf_operator_tpu.controller.autoscaler import (
+    Autoscaler,
+    default_serving_policy,
+)
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.controller.reconciler import ReconcilerConfig
+from tf_operator_tpu.models import llama_tiny
+from tf_operator_tpu.models.batching import PagedContinuousBatchingDecoder
+from tf_operator_tpu.utils.alerts import AlertEngine, default_rules
+from tf_operator_tpu.utils.flight import FlightRecorder
+from tf_operator_tpu.utils.metrics import Metrics
+
+VOCAB = 256
+
+
+def test_blocks_free_pressure_scales_serving_one_to_three():
+    sim = MiniApiServer().start()
+    store = KubeJobStore(sim.url)
+    backend = KubeBackend(sim.url)
+    metrics = Metrics()
+    engine = AlertEngine(
+        default_rules(), metrics=metrics, recorder=FlightRecorder()
+    )
+    autoscaler = Autoscaler(metrics=metrics, alerts=engine)
+    controller = TPUJobController(
+        store, backend, metrics=metrics, alerts=engine,
+        autoscaler=autoscaler,
+        config=ReconcilerConfig(resolver=backend.resolver),
+    )
+    controller.run(threadiness=2)
+    try:
+        # THE STOCK POLICY, unmodified except bounds/cadence: its gauge
+        # binding is kv_blocks_pressure (the ISSUE 8 rebind) at 0.85
+        pol = default_serving_policy(min_replicas=1, max_replicas=3)
+        pol.cooldown_seconds = 5.0
+        pol.stabilization_seconds = 20.0
+        # kubesim RUNS pod commands as subprocesses: serving replicas
+        # must be long-lived or the job goes terminal under us
+        job = new_job(
+            name="pool", worker=1,
+            command=[sys.executable, "-c", "import time; time.sleep(120)"],
+        )
+        job.spec.autoscaling = AutoscalingSpec(policies=[pol])
+        store.create(job)
+
+        def pods():
+            return sorted(
+                p.metadata.name
+                for p in backend.list_pods(
+                    "default", {"tpujob.dist/job-name": "pool"}
+                )
+            )
+
+        deadline = time.time() + 20
+        while time.time() < deadline and len(pods()) < 1:
+            time.sleep(0.1)
+        assert pods() == ["pool-worker-0"]
+
+        # REAL pressure: a paged pool whose arena fills past 85%
+        model = llama_tiny(vocab_size=VOCAB, max_len=64)
+        init = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), init)["params"]
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=6, kv_block_size=16, kv_blocks=8,
+            metrics=metrics, model_label="tiny",
+        )
+        r = np.random.RandomState(1)
+        rids = [
+            pool.submit(
+                r.randint(0, VOCAB, size=(6,)).astype(np.int32),
+                max_new_tokens=26,  # 2 blocks per request
+            )
+            for _ in range(4)
+        ]
+        pool._admit()  # 8/8 blocks live -> pressure 1.0
+        assert metrics.gauge(
+            "kv_blocks_pressure", model="tiny", replica="0"
+        ) == 1.0
+
+        t0 = time.time()
+        (d1,) = autoscaler.evaluate_once(t0)
+        assert (d1.direction, d1.from_replicas, d1.to_replicas) == (
+            "up", 1, 2,
+        )
+        assert "kv_blocks_pressure" in d1.reason
+        assert autoscaler.evaluate_once(t0 + 1) == []  # cooldown
+        (d2,) = autoscaler.evaluate_once(t0 + 6)
+        assert d2.to_replicas == 3
+
+        # the decision callback re-enqueues the job; the running
+        # controller creates the new workers against kubesim
+        deadline = time.time() + 30
+        while time.time() < deadline and len(pods()) < 3:
+            time.sleep(0.2)
+        assert pods() == [
+            "pool-worker-0", "pool-worker-1", "pool-worker-2",
+        ]  # the 1 -> 3 scale-up landed in kubesim
+
+        # relief: drain the pool; pressure collapses below the
+        # hysteresis release (0.85 * 0.5), stabilization passes, and
+        # the policy sheds back down
+        pool.run()
+        for rid in rids:
+            assert pool.result(rid) is not None
+        assert metrics.gauge(
+            "kv_blocks_pressure", model="tiny", replica="0"
+        ) < 0.85 * pol.hysteresis_ratio
+        assert autoscaler.evaluate_once(t0 + 12) == []  # quiet starts
+        (down,) = autoscaler.evaluate_once(t0 + 40)
+        assert down.direction == "down" and down.to_replicas == 2
+    finally:
+        controller.stop()
+        backend.close()
+        store.close()
+        sim.stop()
+
+
+def test_multi_replica_metrics_and_merged_slo_over_http():
+    """The visibility half: N pool replicas behind one admission queue
+    export per-replica serve_admission_queue_depth / kv_blocks_free on
+    /metrics while GET /slo reports ONE merged quantile row per
+    {model, mode} (no replica key) — multi-replica serving has one
+    user-facing p99 TTFT."""
+
+    from http.server import ThreadingHTTPServer
+
+    from tests.testutil import load_serve_lm
+
+    serve_lm = load_serve_lm()
+    model = llama_tiny(vocab_size=256, max_len=64)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    handler = serve_lm.build_handler(
+        model, params, max_len=64, batching_slots=2, replicas=2
+    )
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        results = {}
+
+        def post(i):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps(
+                    {"prompt": f"req {i} ", "max_new_tokens": 6}
+                ).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                results[i] = json.loads(resp.read())
+
+        threads = [
+            threading.Thread(target=post, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert set(results) == {0, 1, 2, 3}
+        for i in range(4):
+            assert len(results[i]["sample"]) == 6
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+        for rep in ("0", "1"):
+            assert f'kv_blocks_free{{model="unknown",replica="{rep}"}}' \
+                in text
+            assert (
+                "serve_admission_queue_depth"
+                f'{{model="unknown",replica="{rep}"}}'
+            ) in text
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/slo", timeout=10
+        ) as resp:
+            slo = json.loads(resp.read())
+        rows = slo["histograms"]["serve_ttft_seconds"]
+        assert len(rows) == 1, rows  # merged across replicas
+        assert rows[0]["count"] == 4 and "replica" not in rows[0]
+        assert slo["replicas"] == 2
+        assert slo["gauges"]["kv_blocks_free"] == 16.0  # fleet sum
+    finally:
+        server.shutdown()

@@ -80,10 +80,16 @@ def default_serving_policy(
     min_replicas: int = 1, max_replicas: int = 4
 ) -> AutoscalingPolicy:
     """The stock serving policy (examples + the static lint gate):
-    scale on the queue-wait burn-rate alert OR raw admission queue
-    depth.  Signal names here are pinned against the live rule set /
-    emitted families by tests/test_autoscaling_lint.py — renaming
-    either orphans this policy and fails tier-1."""
+    scale on the queue-wait burn-rate alert OR blocks-free pressure —
+    since the paged pool (ISSUE 8) admission is gated on KV blocks
+    free, ``kv_blocks_pressure`` (in-use/usable, worst replica wins)
+    is REAL memory headroom, the thing a serving replica actually runs
+    out of; queue depth was only its shadow.  Scale-up triggers at
+    0.85 (before the 0.9 alert pages) and the hysteresis latch
+    releases at 0.85 × hysteresis_ratio.  Signal names here are pinned
+    against the live rule set / emitted families by
+    tests/test_autoscaling_lint.py — renaming either orphans this
+    policy and fails tier-1."""
 
     return AutoscalingPolicy(
         replica_type=ReplicaType.WORKER,
@@ -93,7 +99,7 @@ def default_serving_policy(
         signals=[
             SignalBinding(kind="alert", name="serve-queue-wait-burn"),
             SignalBinding(
-                kind="gauge", name="serve_admission_queue_depth", threshold=64.0
+                kind="gauge", name="kv_blocks_pressure", threshold=0.85
             ),
         ],
     )

@@ -9,7 +9,11 @@ named mesh in tf_operator_tpu.parallel.
 
 from tf_operator_tpu.models.bert import Bert, BertForPretraining, bert_base, bert_tiny, mlm_loss
 from tf_operator_tpu.models.gpt import CausalLM, gpt_small, gpt_tiny, lm_loss
-from tf_operator_tpu.models.batching import ContinuousBatchingDecoder
+from tf_operator_tpu.models.batching import (
+    ContinuousBatchingDecoder,
+    PagedContinuousBatchingDecoder,
+)
+from tf_operator_tpu.models.pool_router import PoolRouter
 from tf_operator_tpu.models.speculative import SpeculativeDecoder
 from tf_operator_tpu.models.decode import (
     ChunkedServingDecoder,
@@ -36,6 +40,8 @@ __all__ = [
     "BertForPretraining",
     "ChunkedServingDecoder",
     "ContinuousBatchingDecoder",
+    "PagedContinuousBatchingDecoder",
+    "PoolRouter",
     "SpeculativeDecoder",
     "generate",
     "init_cache",

@@ -87,11 +87,23 @@ from jax import lax
 from tf_operator_tpu.models.decode import (
     _decode_variant,
     _init_cache_for,
+    gather_block_stack,
+    gather_block_view,
     max_window_chunk,
+    paged_arena,
+    scatter_block_stack,
+    scatter_block_view,
     set_cache_index,
     top_k_mask,
     window_chunks,
 )
+from tf_operator_tpu.models.kv_blocks import (
+    SCRATCH_BLOCK,
+    BlockAllocator,
+    NotPageableError,
+    blocks_for,
+)
+from tf_operator_tpu.models.prefix_cache import PrefixCache, chain_keys
 from tf_operator_tpu.ops.quant import materialize_fn
 from tf_operator_tpu.utils.metrics import DispatchLedger
 
@@ -99,6 +111,27 @@ from tf_operator_tpu.utils.metrics import DispatchLedger
 #: static top-k width: per-slot k thresholds within the top TOP_K_MAX
 #: candidates, so one compiled step serves every requested k
 TOP_K_MAX = 64
+
+
+def _admission_sample(last, temp, top_k, rng):
+    """First-token sampling shared by the contiguous and paged fused
+    admission programs (identical math is what makes the paged
+    exactness pin possible): in-graph rng split + greedy/temperature
+    select + the static top-k trick.  Returns (tok, rng_next)."""
+
+    greedy = jnp.argmax(last, -1).astype(jnp.int32)
+    split = jax.random.split(rng)
+    rng_next, r = split[0], split[1]
+    safe_t = jnp.where(temp > 0.0, temp, 1.0)
+    scaled = last / safe_t
+    # same static top-k trick as the step body: the runtime k
+    # thresholds within the top TOP_K_MAX candidates
+    k_max = min(TOP_K_MAX, scaled.shape[-1])
+    top_vals = lax.top_k(scaled, k_max)[0]
+    kth = top_vals[jnp.clip(top_k - 1, 0, k_max - 1)]
+    scaled = jnp.where((top_k > 0) & (scaled < kth), -jnp.inf, scaled)
+    samp = jax.random.categorical(r, scaled).astype(jnp.int32)
+    return jnp.where(temp > 0.0, samp, greedy), rng_next
 
 
 class _Request:
@@ -137,7 +170,8 @@ class ContinuousBatchingDecoder:
 
     def __init__(self, model, params, slots: int = 8, steps_per_sync: int = 8,
                  ledger: Optional[DispatchLedger] = None,
-                 metrics=None, model_label: str = ""):
+                 metrics=None, model_label: str = "",
+                 replica_label: str = ""):
         #: device-dispatch accounting (phases: admission, step, and the
         #: legacy rolling-window path's prefill/scatter)
         self.ledger = ledger if ledger is not None else DispatchLedger()
@@ -149,6 +183,11 @@ class ContinuousBatchingDecoder:
         #: per-dispatch accounting
         self.metrics = metrics if metrics is not None else self.ledger.metrics
         self.model_label = model_label or "unknown"
+        #: set by the multi-replica router (models/pool_router.py):
+        #: non-empty adds a {replica=} label to every SLO observation
+        #: and gauge, so /metrics distinguishes replicas while /slo
+        #: merges them (utils/metrics.histogram_family_merged)
+        self.replica_label = replica_label
         self.dmodel = _decode_variant(model)
         self._materialize = materialize_fn(model)
         cfg = self.dmodel.cfg
@@ -204,9 +243,7 @@ class ContinuousBatchingDecoder:
         self._row_shapes = jax.tree_util.tree_map(
             lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), row0
         )
-        self._cache = jax.tree_util.tree_map(
-            lambda l: jnp.stack([l] * self.slots), row0
-        )
+        self._init_pool_cache(row0)
         self._last_tok = jnp.zeros((self.slots,), jnp.int32)
         self._prefill_fns = {}  # chunk width -> jitted batch-1 prefill
         self._admit_fns = {}  # pow2 prompt width -> fused admission
@@ -214,7 +251,27 @@ class ContinuousBatchingDecoder:
         self._scatter_fn = None
         self.compile_count = 0
 
+    def _init_pool_cache(self, row0) -> None:
+        """Allocate the contiguous slot-stacked cache (the paged
+        subclass overrides this with its block arena instead — the
+        whole point is NOT materializing slots × max_len HBM)."""
+
+        self._cache = jax.tree_util.tree_map(
+            lambda l: jnp.stack([l] * self.slots), row0
+        )
+
     # -- SLO observations ------------------------------------------------
+
+    def _labels(self, **extra) -> Dict[str, str]:
+        """{model[, replica]} + extra.  The replica key appears only
+        under the multi-replica router; label-key lint coverage for
+        these families comes from the literal serve_lm/metric-gate
+        call sites, not from this helper."""
+
+        out = dict(model=self.model_label, **extra)
+        if self.replica_label:
+            out["replica"] = self.replica_label
+        return out
 
     def _observe_first_token(self, req: _Request, work_start: float) -> None:
         """First output token just landed on the host: observe
@@ -229,12 +286,12 @@ class ContinuousBatchingDecoder:
         self.metrics.observe_histogram(
             "serve_queue_wait_seconds",
             max(0.0, work_start - req.t_submit),
-            model=self.model_label, mode="pool",
+            **self._labels(mode="pool"),
         )
         self.metrics.observe_histogram(
             "serve_ttft_seconds",
             req.t_first - req.t_submit,
-            model=self.model_label, mode="pool",
+            **self._labels(mode="pool"),
         )
 
     def _observe_done(self, req: _Request) -> None:
@@ -248,7 +305,7 @@ class ContinuousBatchingDecoder:
         self.metrics.observe_histogram(
             "serve_time_per_output_token_seconds",
             (t_done - t_first) / max(1, len(req.tokens) - 1),
-            model=self.model_label, mode="pool",
+            **self._labels(mode="pool"),
         )
 
     def _update_gauges_locked(self) -> None:
@@ -260,7 +317,7 @@ class ContinuousBatchingDecoder:
         self.metrics.set(
             "serve_admission_queue_depth",
             float(len(self._queue)),
-            model=self.model_label,
+            **self._labels(),
         )
         inflight = sum(
             r.budget - len(r.tokens) for r in self._active.values()
@@ -268,7 +325,7 @@ class ContinuousBatchingDecoder:
         self.metrics.set(
             "serve_tokens_in_flight",
             float(max(0, inflight)),
-            model=self.model_label,
+            **self._labels(),
         )
 
     # -- compiled pieces -------------------------------------------------
@@ -356,23 +413,7 @@ class ContinuousBatchingDecoder:
                     last = lax.dynamic_index_in_dim(
                         logits[0], n - 1, axis=0, keepdims=False
                     )  # [V]
-                    greedy = jnp.argmax(last, -1).astype(jnp.int32)
-                    split = jax.random.split(rng)
-                    rng_next, r = split[0], split[1]
-                    safe_t = jnp.where(temp > 0.0, temp, 1.0)
-                    scaled = last / safe_t
-                    # same static top-k trick as the step body: the
-                    # runtime k thresholds within the top TOP_K_MAX
-                    k_max = min(TOP_K_MAX, scaled.shape[-1])
-                    top_vals = lax.top_k(scaled, k_max)[0]
-                    kth = top_vals[jnp.clip(top_k - 1, 0, k_max - 1)]
-                    scaled = jnp.where(
-                        (top_k > 0) & (scaled < kth), -jnp.inf, scaled
-                    )
-                    samp = jax.random.categorical(r, scaled).astype(
-                        jnp.int32
-                    )
-                    tok = jnp.where(temp > 0.0, samp, greedy)
+                    tok, rng_next = _admission_sample(last, temp, top_k, rng)
                     stack = jax.tree_util.tree_map(
                         lambda s, row: lax.dynamic_update_index_in_dim(
                             s, row, slot, axis=0
@@ -386,21 +427,61 @@ class ContinuousBatchingDecoder:
                 self.compile_count += 1
             return self._admit_fns[width]
 
+    def _make_step_body(self, params, temps, top_ks):
+        """The K-step scan body over the stacked slot cache — ONE
+        definition shared by the contiguous step program and the paged
+        step program (which feeds it a block-table-gathered view of
+        the arena; identical math is the paged exactness contract).
+        ``params`` is captured as a closure constant, exactly like the
+        pre-refactor body (threading it through the scan carry would
+        change the compiled program)."""
+
+        dmodel = self.dmodel
+        materialize = self._materialize
+
+        def one_slot(p, cache, tok):
+            # batch-1 apply; under vmap the weights broadcast and
+            # the per-slot cache_index stays a scalar per slot
+            logits, vars_ = dmodel.apply(
+                {"params": p, "cache": cache},
+                tok[None, None],
+                mutable=["cache"],
+            )
+            return vars_["cache"], logits[0, 0]
+
+        def body(carry, _):
+            stack, toks, rngs = carry
+            stk, logits = jax.vmap(
+                one_slot, in_axes=(None, 0, 0)
+            )(materialize(params), stack, toks)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            split = jax.vmap(jax.random.split)(rngs)
+            safe_t = jnp.where(temps > 0.0, temps, 1.0)
+            scaled = logits / safe_t[:, None]
+            # per-slot top_k with one STATIC top-k (compile
+            # stays shape-stable): threshold at each slot's own
+            # k within the top TOP_K_MAX candidates; 0 = off
+            k_max = min(TOP_K_MAX, scaled.shape[-1])
+            top_vals = lax.top_k(scaled, k_max)[0]  # [slots,k_max]
+            idx = jnp.clip(top_ks - 1, 0, k_max - 1)[:, None]
+            kth = jnp.take_along_axis(top_vals, idx, axis=1)
+            scaled = jnp.where(
+                (top_ks[:, None] > 0) & (scaled < kth),
+                -jnp.inf,
+                scaled,
+            )
+            sampled = jax.vmap(
+                lambda r, l: jax.random.categorical(r, l)
+            )(split[:, 0], scaled).astype(jnp.int32)
+            nxt = jnp.where(temps > 0.0, sampled, greedy)
+            return (stk, nxt, split[:, 1]), nxt
+
+        return body
+
     def _step(self):
         if self._step_fn is None:
-            dmodel = self.dmodel
             n_inner = self.steps_per_sync
-            materialize = self._materialize
-
-            def one_slot(params, cache, tok):
-                # batch-1 apply; under vmap the weights broadcast and
-                # the per-slot cache_index stays a scalar per slot
-                logits, vars_ = dmodel.apply(
-                    {"params": params, "cache": cache},
-                    tok[None, None],
-                    mutable=["cache"],
-                )
-                return vars_["cache"], logits[0, 0]
+            make_body = self._make_step_body
 
             def step(params, stack, toks, temps, top_ks, rngs):
                 # K decode steps per host round trip: the whole inner
@@ -408,33 +489,7 @@ class ContinuousBatchingDecoder:
                 # network round trip per K tokens, not per token.
                 # Quantized trees: QDense families keep int8 all the
                 # way to quant_matmul; others dequantize per step here.
-                def body(carry, _):
-                    stack, toks, rngs = carry
-                    stk, logits = jax.vmap(
-                        one_slot, in_axes=(None, 0, 0)
-                    )(materialize(params), stack, toks)
-                    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                    split = jax.vmap(jax.random.split)(rngs)
-                    safe_t = jnp.where(temps > 0.0, temps, 1.0)
-                    scaled = logits / safe_t[:, None]
-                    # per-slot top_k with one STATIC top-k (compile
-                    # stays shape-stable): threshold at each slot's own
-                    # k within the top TOP_K_MAX candidates; 0 = off
-                    k_max = min(TOP_K_MAX, scaled.shape[-1])
-                    top_vals = lax.top_k(scaled, k_max)[0]  # [slots,k_max]
-                    idx = jnp.clip(top_ks - 1, 0, k_max - 1)[:, None]
-                    kth = jnp.take_along_axis(top_vals, idx, axis=1)
-                    scaled = jnp.where(
-                        (top_ks[:, None] > 0) & (scaled < kth),
-                        -jnp.inf,
-                        scaled,
-                    )
-                    sampled = jax.vmap(
-                        lambda r, l: jax.random.categorical(r, l)
-                    )(split[:, 0], scaled).astype(jnp.int32)
-                    nxt = jnp.where(temps > 0.0, sampled, greedy)
-                    return (stk, nxt, split[:, 1]), nxt
-
+                body = make_body(params, temps, top_ks)
                 (stack, toks, _), toks_k = lax.scan(
                     body, (stack, toks, rngs), None, length=n_inner
                 )
@@ -674,6 +729,15 @@ class ContinuousBatchingDecoder:
                 self._active[slot] = req
                 self._update_gauges_locked()
 
+    def load_score(self) -> float:
+        """Routing pressure for the multi-replica router
+        (models/pool_router.py): active + queued request count.  The
+        paged subclass overrides with real memory pressure (blocks in
+        use + queued block demand over arena size)."""
+
+        with self._lock:
+            return float(len(self._active) + len(self._queue))
+
     def step(self) -> int:
         """Admit waiting requests, run `steps_per_sync` decode steps
         for every active slot (one XLA program, one host round trip),
@@ -772,3 +836,457 @@ class ContinuousBatchingDecoder:
                     "(results evict on first read)"
                 )
         return np.concatenate([req.prompt, np.asarray(req.tokens, np.int32)])
+
+
+class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
+    """The pool with a PAGED KV cache (ISSUE 8 tentpole): seats no
+    longer own contiguous max_len caches — one pre-allocated block
+    arena (``models/decode.paged_arena``) backs every seat through a
+    per-seat block table, and ADMISSION IS GATED ON BLOCKS FREE, not
+    slots free.  A short request reserves only
+    ``ceil((prompt+budget)/block_size)`` blocks, so at the same HBM
+    budget the paged pool admits strictly more concurrent mixed-length
+    requests than the slot pool (the `measure.py --section paged`
+    acceptance comparison).
+
+    Reservation is FULL at admission (prompt + budget): no mid-decode
+    block exhaustion, no preemption machinery — the no-surprise
+    contract.  The step and admission programs gather a seat's blocks
+    into the exact contiguous view the unchanged attention math
+    expects and scatter back only the newly written blocks (see
+    decode.py — identity re-layout, so paged decode is token-identical
+    to the contiguous pool, test-pinned).
+
+    Prefix cache: completed prompt blocks are published under rolling
+    token-hash chain keys (models/prefix_cache.py); a new request maps
+    its longest cached prefix COPY-FREE into its block table
+    (refcounted — a shared block is never reclaimed while any seat
+    maps it), and only prefills the remainder, still in ONE fused
+    admission dispatch.  A full hit prefills at most one block's worth
+    of tokens: ledger-pinned as ``admission == 1, prefill == 0`` per
+    request with the admission width collapsed to the remainder class
+    (extending the PR-3 single-dispatch contract; the last prompt
+    token always re-runs because its logits seed the first sampled
+    token).
+
+    Staging backpressure is structural here: submit() never touches
+    the device (every admission is fused), queued requests hold host
+    prompts only, and arena pressure evicts UNMAPPED prefix-cache
+    entries LRU-first before an admission blocks — the documented
+    OOM hazard of the legacy eager-staging path cannot exist.
+
+    Rolling-window models are not pageable (their wrap state aliases
+    positions); construction refuses them — serve those through the
+    contiguous pool.
+    """
+
+    def __init__(self, model, params, slots: int = 8,
+                 steps_per_sync: int = 8, kv_blocks: Optional[int] = None,
+                 kv_block_size: int = 16,
+                 ledger: Optional[DispatchLedger] = None,
+                 metrics=None, model_label: str = "",
+                 replica_label: str = "",
+                 prefix_cache_entries: Optional[int] = None):
+        super().__init__(
+            model, params, slots=slots, steps_per_sync=steps_per_sync,
+            ledger=ledger, metrics=metrics, model_label=model_label,
+            replica_label=replica_label,
+        )
+        if self._max_chunk is not None:
+            raise NotPageableError(
+                "rolling-window caches are not pageable (wrap state "
+                "aliases positions); use ContinuousBatchingDecoder"
+            )
+        bs = int(kv_block_size)
+        if bs < 1 or self.max_len % bs:
+            raise ValueError(
+                f"kv_block_size={bs} must divide max_len={self.max_len}"
+            )
+        self.block_size = bs
+        self.max_blocks = self.max_len // bs
+        if kv_blocks is None:
+            # default arena = the HBM the contiguous pool would pin
+            # (slots × max_len): same budget, block-granular admission
+            kv_blocks = self.slots * self.max_blocks
+        #: arena rows = usable blocks + the scratch block (id 0)
+        self.num_blocks = int(kv_blocks) + 1
+        self.alloc = BlockAllocator(self.num_blocks, bs)
+        self._arena = paged_arena(self.dmodel, self.num_blocks, bs)
+        #: per-seat block tables + lengths live HOST-side (tiny int32
+        #: arrays passed per dispatch); the device holds only the arena
+        self._tables = np.full(
+            (self.slots, self.max_blocks), SCRATCH_BLOCK, np.int32
+        )
+        self._lengths = np.zeros((self.slots,), np.int32)
+        self._seat_refs: Dict[int, List[int]] = {}
+        #: step write-back window: K new positions straddle at most
+        #: this many blocks (start block + full span + boundary)
+        self._step_nbw = (self.steps_per_sync - 1) // bs + 2
+        #: shared prefix store — evictable only while NOTHING maps the
+        #: block (allocator refcount 1 = the cache's own reference)
+        self.prefix = PrefixCache(
+            capacity=prefix_cache_entries,
+            metrics=self.metrics,
+            mode="pool",
+            can_evict=lambda bid: self.alloc.refcount(bid) == 1,
+            on_evict=lambda bid: self.alloc.release([bid]),
+        )
+        self._update_kv_gauges()
+
+    def _init_pool_cache(self, row0) -> None:
+        self._cache = None  # the arena replaces the slot stack
+
+    # -- accounting --------------------------------------------------------
+
+    def _update_kv_gauges(self) -> None:
+        """kv_blocks_{free,total,in_use} + kv_blocks_pressure gauges,
+        labeled {model, replica} — the blocks-free pressure signal the
+        stock serving autoscaling policy and the kv-blocks-pressure
+        alert rule bind (tests/test_autoscaling_lint.py pins the
+        names+keys against these literal call sites)."""
+
+        if self.metrics is None:
+            return
+        rep = self.replica_label or "0"
+        free = float(self.alloc.free_count)
+        total = float(self.alloc.usable)
+        self.metrics.set(
+            "kv_blocks_free", free, model=self.model_label, replica=rep
+        )
+        self.metrics.set(
+            "kv_blocks_total", total, model=self.model_label, replica=rep
+        )
+        self.metrics.set(
+            "kv_blocks_in_use", total - free,
+            model=self.model_label, replica=rep,
+        )
+        self.metrics.set(
+            "kv_blocks_pressure", (total - free) / total,
+            model=self.model_label, replica=rep,
+        )
+
+    def _update_gauges_locked(self) -> None:
+        super()._update_gauges_locked()
+        self._update_kv_gauges()
+
+    def blocks_in_use(self) -> int:
+        return self.alloc.in_use
+
+    def load_score(self) -> float:
+        """Least-BLOCKS-in-use routing signal: live arena occupancy
+        plus the block demand already queued, normalized by arena size
+        — the router sends the next request to real memory headroom,
+        not just the shortest queue."""
+
+        with self._lock:
+            queued = sum(
+                blocks_for(r.prompt.size + r.budget, self.block_size)
+                for r in self._queue
+            )
+        return (self.alloc.in_use + queued) / max(1, self.alloc.usable)
+
+    # -- admission ---------------------------------------------------------
+
+    def _paged_width(self, r: int) -> int:
+        """Compiled admission width class for ``r`` remainder tokens:
+        the next power of two, capped at max_len (prompts always fit —
+        submit validated prompt+budget <= max_len).  Class count stays
+        logarithmic (+1 for the exact-max_len cap)."""
+
+        w = 1 << max(0, r - 1).bit_length()
+        return w if w <= self.max_len else self.max_len
+
+    def _fused_width(self, p: int) -> Optional[int]:
+        # every paged admission is fused — base submit() must never
+        # take the legacy eager-staging branch
+        return self._paged_width(p)
+
+    def submit(self, prompt_ids, max_new_tokens, **kw) -> int:
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if max_new_tokens >= 1 and prompt.size >= 1:
+            need = blocks_for(prompt.size + max_new_tokens, self.block_size)
+            if need > self.alloc.usable:
+                raise ValueError(
+                    f"request needs {need} KV blocks but the arena has "
+                    f"only {self.alloc.usable} — admission could never "
+                    "succeed (raise kv_blocks or lower the budget)"
+                )
+        return super().submit(prompt_ids, max_new_tokens, **kw)
+
+    def _plan_admission(self, req: _Request):
+        """Reserve the request's block budget (caller holds the pool
+        lock).  Longest cached prefix is retained FIRST (pinning it
+        against eviction), fresh blocks are allocated for everything
+        from the prefix end through prompt+budget, and on shortfall
+        unmapped prefix-cache entries are evicted LRU-first before
+        giving up.  Returns a plan dict or None (arena exhausted —
+        admission stays gated on blocks free)."""
+
+        bs = self.block_size
+        p_len = req.prompt.size
+        keys = chain_keys(req.prompt, bs)
+        shared: List[int] = []
+        # usable prefix caps at the last FULL block strictly before the
+        # prompt's final token: its logits seed the first sample, so
+        # the last token always re-runs through admission prefill
+        for key in keys[: (p_len - 1) // bs]:
+            bid = self.prefix.peek(key)
+            if bid is None:
+                break
+            shared.append(int(bid))
+        # the padded remainder must still fit the cache view: drop
+        # trailing shared blocks until prefix + width class <= max_len
+        while shared and \
+                len(shared) * bs + self._paged_width(p_len - len(shared) * bs) \
+                > self.max_len:
+            shared.pop()
+        if shared:
+            self.alloc.retain(shared)
+        total_blocks = blocks_for(p_len + req.budget, bs)
+        need = total_blocks - len(shared)
+        new_ids = self.alloc.alloc(need)
+        if new_ids is None:
+            # arena pressure: reclaim cold cache entries, retry once
+            self.prefix.evict_lru(need=need - self.alloc.free_count)
+            new_ids = self.alloc.alloc(need)
+        if new_ids is None:
+            if shared:
+                self.alloc.release(shared)
+            return None
+        row = np.full((self.max_blocks,), SCRATCH_BLOCK, np.int32)
+        row[: len(shared)] = shared
+        row[len(shared) : total_blocks] = new_ids
+        return {
+            "shared": shared, "new": new_ids, "keys": keys, "row": row,
+            "L": len(shared) * bs,
+        }
+
+    def _release_plan(self, plan) -> None:
+        refs = plan["shared"] + plan["new"]
+        if refs:
+            self.alloc.release(refs)
+
+    def _admit(self) -> None:
+        """Seat queued requests while both a seat AND their block
+        budget are free.  FIFO: a head request the arena cannot hold
+        blocks the queue (fairness over packing — documented)."""
+
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return
+                free = [
+                    s for s in range(self.slots) if s not in self._active
+                ]
+                if not free:
+                    return
+                plan = self._plan_admission(self._queue[0])
+                if plan is None:
+                    self._update_gauges_locked()
+                    return
+                req = self._queue.pop(0)
+                slot = free[0]
+                try:
+                    self._admit_paged(req, slot, plan)
+                    self._update_gauges_locked()
+                except BaseException:
+                    # transient device failure: the request must
+                    # survive — reservation rolled back, head-of-queue
+                    # reinsertion, waiters never hang (the base pool's
+                    # survival rule)
+                    self._release_plan(plan)
+                    self._queue.insert(0, req)
+                    raise
+
+    def _admit_paged(self, req: _Request, slot: int, plan) -> None:
+        """One fused dispatch: gather the shared prefix view, prefill
+        the padded remainder at offset L, rollback pad rows, sample
+        the first token, scatter the new blocks into the arena.
+        Caller holds the pool lock (the program rewrites the shared
+        arena, so it serializes with step() like the contiguous fused
+        admission)."""
+
+        bs = self.block_size
+        p_len = req.prompt.size
+        prefix_len = plan["L"]
+        remainder = p_len - prefix_len
+        width = self._paged_width(remainder)
+        # CEIL division: when block_size does not divide the pow2
+        # width class, the prefill writes straddle a partial block —
+        # floor would silently drop it from the scatter (and publish
+        # the never-written block), corrupting decode
+        nbw = blocks_for(width, bs)
+        ids = np.zeros((1, width), np.int32)
+        ids[0, :remainder] = req.prompt[prefix_len:]
+        row_pad = np.concatenate(
+            [plan["row"], np.full((nbw,), SCRATCH_BLOCK, np.int32)]
+        )
+        sampled = req.temperature > 0.0
+        rng = req.rng if sampled else jnp.zeros((2,), jnp.uint32)
+        work_start = time.perf_counter()
+        with self.ledger.dispatch(
+            "admission", rid=req.rid, width=width, prefix_tokens=prefix_len,
+        ):
+            arena, toks, tok, rng_next = self._admission(width)(
+                self.params, self._arena, self._last_tok,
+                jnp.asarray(row_pad), jnp.asarray(ids),
+                jnp.int32(prefix_len), jnp.int32(remainder),
+                jnp.int32(slot), jnp.float32(req.temperature),
+                jnp.int32(req.top_k or 0), rng,
+            )
+            tok_h = int(tok)  # host fetch: the ledger RTT includes it
+        self._arena, self._last_tok = arena, toks
+        if sampled:
+            req.rng = rng_next
+        req.tokens.append(tok_h)
+        self.prefix.record(prefix_len > 0)
+        # publish every FULL prompt block (final content — decode
+        # writes start at p_len, never inside them) under its chain
+        # key; the cache takes its own reference per entry
+        for i in range(p_len // bs):
+            key = plan["keys"][i]
+            if key not in self.prefix:
+                bid = int(plan["row"][i])
+                self.alloc.retain([bid])
+                self.prefix.put(key, bid)
+        self._observe_first_token(req, work_start)
+        refs = plan["shared"] + plan["new"]
+        if len(req.tokens) >= req.budget:
+            # budget-1: the admission token completed it — blocks go
+            # straight back (published ones live on via the cache ref)
+            req.done = True
+            self.alloc.release(refs)
+            self._observe_done(req)
+            self._done_cond.notify_all()
+        else:
+            req.slot = slot
+            self._active[slot] = req
+            self._tables[slot] = plan["row"]
+            self._lengths[slot] = p_len
+            self._seat_refs[slot] = refs
+
+    def _admission(self, width: int):
+        with self._compile_lock:
+            if width not in self._admit_fns:
+                dmodel = self.dmodel
+                materialize = self._materialize
+                bs = self.block_size
+                mb = self.max_blocks
+                nbw = blocks_for(width, bs)  # ceil: cover straddle
+
+                def admit(params, arena, toks, row_pad, ids, L, n, slot,
+                          temp, top_k, rng):
+                    view = gather_block_view(arena, row_pad[:mb], L, bs)
+                    logits, vars_ = dmodel.apply(
+                        {"params": materialize(params), "cache": view},
+                        ids,
+                        mutable=["cache"],
+                    )
+                    # pad rows rolled back exactly like the contiguous
+                    # fused admission; garbage at gathered positions
+                    # >= cache_index was masked throughout
+                    cache2 = set_cache_index(vars_["cache"], L + n)
+                    last = lax.dynamic_index_in_dim(
+                        logits[0], n - 1, axis=0, keepdims=False
+                    )
+                    tok, rng_next = _admission_sample(last, temp, top_k, rng)
+                    arena = scatter_block_view(
+                        arena, cache2, row_pad, L // bs, nbw, bs
+                    )
+                    return arena, toks.at[slot].set(tok), tok, rng_next
+
+                self._admit_fns[width] = jax.jit(admit)
+                self.compile_count += 1
+            return self._admit_fns[width]
+
+    # -- decode step -------------------------------------------------------
+
+    def _step(self):
+        if self._step_fn is None:
+            n_inner = self.steps_per_sync
+            make_body = self._make_step_body
+            bs = self.block_size
+            mb = self.max_blocks
+            nbw = self._step_nbw
+
+            def step(params, arena, toks, tables_pad, lengths, temps,
+                     top_ks, rngs):
+                stack = gather_block_stack(
+                    arena, tables_pad[:, :mb], lengths, bs
+                )
+                body = make_body(params, temps, top_ks)
+                (stack, toks, _), toks_k = lax.scan(
+                    body, (stack, toks, rngs), None, length=n_inner
+                )
+                arena = scatter_block_stack(
+                    arena, stack, tables_pad, lengths // bs, nbw, bs
+                )
+                return arena, toks, toks_k
+
+            self._step_fn = jax.jit(step)
+            self.compile_count += 1
+        return self._step_fn
+
+    def _retire_seat_locked(self, slot: int) -> None:
+        refs = self._seat_refs.pop(slot, [])
+        if refs:
+            self.alloc.release(refs)
+        self._tables[slot] = SCRATCH_BLOCK
+        self._lengths[slot] = 0
+
+    def step(self) -> int:
+        """Admit (block-gated), run `steps_per_sync` decode steps over
+        the arena through the block tables (one XLA program, one host
+        round trip), retire finished requests and free their blocks."""
+
+        self._admit()
+        with self._lock:
+            if not self._active:
+                return 0
+            temps = np.zeros((self.slots,), np.float32)
+            top_ks = np.zeros((self.slots,), np.int32)
+            rngs = np.zeros((self.slots, 2), np.uint32)
+            for slot, req in self._active.items():
+                temps[slot] = req.temperature
+                top_ks[slot] = req.top_k or 0
+                if req.temperature > 0.0:
+                    req.rng, r = jax.random.split(req.rng)
+                    rngs[slot] = np.asarray(r)
+            tables_pad = np.concatenate(
+                [
+                    self._tables,
+                    np.full((self.slots, self._step_nbw), SCRATCH_BLOCK,
+                            np.int32),
+                ],
+                axis=1,
+            )
+            with self.ledger.dispatch("step", active=len(self._active)):
+                arena, toks, toks_k = self._step()(
+                    self.params, self._arena, self._last_tok,
+                    jnp.asarray(tables_pad), jnp.asarray(self._lengths),
+                    jnp.asarray(temps), jnp.asarray(top_ks),
+                    jnp.asarray(rngs),
+                )
+                host_toks = np.asarray(toks_k)  # [K, slots]
+            self._arena, self._last_tok = arena, toks
+            finished = False
+            for slot in list(self._active):
+                req = self._active[slot]
+                # the cache now holds K more positions for this seat
+                # (overshoot past the budget landed in scratch via the
+                # padded table; the reserved tail blocks absorb the
+                # in-budget span)
+                self._lengths[slot] += len(host_toks)
+                take = min(len(host_toks), req.budget - len(req.tokens))
+                req.tokens.extend(int(t) for t in host_toks[:take, slot])
+                if len(req.tokens) >= req.budget:
+                    req.done = True
+                    req.slot = None
+                    del self._active[slot]
+                    self._retire_seat_locked(slot)
+                    self._observe_done(req)
+                    finished = True
+            self._update_gauges_locked()
+            if finished:
+                self._done_cond.notify_all()
+            return len(self._active)

@@ -130,6 +130,178 @@ def set_cache_index(cache, n):
     return jax.tree_util.tree_map_with_path(f, cache)
 
 
+def _leaf_name(path) -> str:
+    """Last string key on a tree path (flax cache leaves are named
+    dicts: cached_key / cached_value / cache_index / cached_pos)."""
+
+    for entry in reversed(path):
+        k = getattr(entry, "key", None)
+        if isinstance(k, str):
+            return k
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# Paged KV arena (ISSUE 8): the serving cache as fixed-size token
+# blocks over ONE pre-allocated device tensor per layer, addressed
+# through per-seat block tables.  The compiled programs below GATHER a
+# seat's blocks into the exact contiguous [1, Hkv, max_len, D] view the
+# flax decode branch expects, run the unchanged attention math, and
+# SCATTER only the newly written blocks back — a memcpy round trip, so
+# paged decode is token-identical to the contiguous path by
+# construction (test-pinned, tests/test_paged_pool.py).  On this box
+# the gather/scatter lowers to XLA take/scatter (the fused Pallas
+# paged-attention kernel that skips the materialized view is the
+# chip-window follow-up); the PERSISTENT HBM story — what admission is
+# gated on — is the arena, which is the whole point.
+#
+# Block id 0 is scratch (models/kv_blocks.SCRATCH_BLOCK): unused table
+# entries point at it, overshoot/pad writes land in it, and every read
+# of it is masked by cache_index.
+# ---------------------------------------------------------------------------
+
+
+def paged_arena(dmodel, num_blocks: int, block_size: int):
+    """Zeroed arena tree for ``dmodel``'s cache: every cached_key /
+    cached_value leaf ``[1, H, max_len, D]`` becomes
+    ``[num_blocks, H, block_size, D]``; cache_index leaves stay as
+    placeholder scalars (per-seat lengths live host-side).  Raises for
+    rolling-window caches (their wrap state is position-aliased — not
+    pageable) and for cache layouts this pager does not understand."""
+
+    from tf_operator_tpu.models.kv_blocks import NotPageableError
+
+    cfg = dmodel.cfg
+    w = getattr(cfg, "window", None)
+    if w is not None and w < cfg.max_len:
+        raise NotPageableError(
+            "rolling-window caches are not pageable (cached_pos wrap "
+            "state aliases positions); serve windowed models through "
+            "the contiguous pool"
+        )
+    if cfg.max_len % block_size:
+        raise ValueError(
+            f"max_len={cfg.max_len} must be a multiple of "
+            f"block_size={block_size}"
+        )
+    template = _init_cache_for(dmodel, 1)
+
+    def f(path, leaf):
+        name = _leaf_name(path)
+        if name == "cache_index":
+            return jnp.zeros((), leaf.dtype)
+        if name in ("cached_key", "cached_value"):
+            if leaf.ndim != 4 or leaf.shape[0] != 1 or \
+                    leaf.shape[2] != cfg.max_len:
+                raise NotPageableError(
+                    f"unpageable cache leaf {name} of shape {leaf.shape} "
+                    f"(expected [1, H, max_len={cfg.max_len}, D])"
+                )
+            return jnp.zeros(
+                (num_blocks, leaf.shape[1], block_size, leaf.shape[3]),
+                leaf.dtype,
+            )
+        raise NotPageableError(f"unknown cache leaf {name!r}")
+
+    return jax.tree_util.tree_map_with_path(f, template)
+
+
+def gather_block_view(arena, table, length, block_size: int):
+    """Batch-1 contiguous cache view from the arena: K/V leaves
+    ``[1, H, MB*bs, D]`` gathered by ``table`` ([MB] int32 block ids),
+    cache_index = ``length``.  Traced — runs inside the compiled
+    admission program."""
+
+    def f(path, leaf):
+        name = _leaf_name(path)
+        if name == "cache_index":
+            return jnp.asarray(length, leaf.dtype)
+        g = jnp.take(leaf, table, axis=0)  # [MB, H, bs, D]
+        g = jnp.transpose(g, (1, 0, 2, 3))  # [H, MB, bs, D]
+        h, mb, bs, d = g.shape
+        return g.reshape(h, mb * bs, d)[None]
+
+    return jax.tree_util.tree_map_with_path(f, arena)
+
+
+def gather_block_stack(arena, tables, lengths, block_size: int):
+    """Stacked (per-seat) view: K/V leaves ``[S, 1, H, MB*bs, D]``
+    gathered by ``tables`` ([S, MB]), cache_index = ``lengths`` ([S])
+    — exactly the slot-stacked cache the pool's vmapped step body
+    consumes."""
+
+    def f(path, leaf):
+        name = _leaf_name(path)
+        if name == "cache_index":
+            return jnp.asarray(lengths, leaf.dtype)
+        g = jnp.take(leaf, tables, axis=0)  # [S, MB, H, bs, D]
+        g = jnp.transpose(g, (0, 2, 1, 3, 4))  # [S, H, MB, bs, D]
+        s, h, mb, bs, d = g.shape
+        return g.reshape(s, h, mb * bs, d)[:, None]
+
+    return jax.tree_util.tree_map_with_path(f, arena)
+
+
+def scatter_block_view(arena, cache, table_pad, start_block, n_blocks: int,
+                       block_size: int):
+    """Write ``n_blocks`` blocks of a batch-1 cache view back into the
+    arena, starting at logical block ``start_block`` (physical ids from
+    ``table_pad``, which carries ``n_blocks`` scratch entries past the
+    table so the slice never clamps — overshoot lands in scratch)."""
+
+    def f(path, aleaf, cleaf):
+        name = _leaf_name(path)
+        if name == "cache_index":
+            return aleaf
+        x = cleaf[0]  # [H, ML, D]
+        h, _, d = x.shape
+        x = jnp.pad(x, ((0, 0), (0, n_blocks * block_size), (0, 0)))
+        win = lax.dynamic_slice(
+            x, (0, start_block * block_size, 0),
+            (h, n_blocks * block_size, d),
+        )
+        win = win.reshape(h, n_blocks, block_size, d)
+        win = jnp.transpose(win, (1, 0, 2, 3))  # [nb, H, bs, D]
+        ids = lax.dynamic_slice(table_pad, (start_block,), (n_blocks,))
+        return aleaf.at[ids].set(win.astype(aleaf.dtype))
+
+    return jax.tree_util.tree_map_with_path(f, arena, cache)
+
+
+def scatter_block_stack(arena, stack, tables_pad, start_blocks,
+                        n_blocks: int, block_size: int):
+    """Per-seat window write-back for the stacked step view: seat s
+    writes its ``n_blocks`` blocks from logical block
+    ``start_blocks[s]``.  Live seats' windows are exclusively owned
+    (admission reserved through prompt+budget); only scratch ids can
+    collide across seats, and scratch content is never observable."""
+
+    def f(path, aleaf, sleaf):
+        name = _leaf_name(path)
+        if name == "cache_index":
+            return aleaf
+        x = sleaf[:, 0]  # [S, H, ML, D]
+        s, h, _, d = x.shape
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, n_blocks * block_size), (0, 0)))
+
+        def per_seat(xs, b0):
+            return lax.dynamic_slice(
+                xs, (0, b0 * block_size, 0), (h, n_blocks * block_size, d)
+            )
+
+        win = jax.vmap(per_seat)(x, start_blocks)  # [S, H, nb*bs, D]
+        win = win.reshape(s, h, n_blocks, block_size, d)
+        win = jnp.transpose(win, (0, 2, 1, 3, 4))  # [S, nb, H, bs, D]
+        ids = jax.vmap(
+            lambda row, b0: lax.dynamic_slice(row, (b0,), (n_blocks,))
+        )(tables_pad, start_blocks)  # [S, nb]
+        return aleaf.at[ids.reshape(-1)].set(
+            win.reshape(s * n_blocks, h, block_size, d).astype(aleaf.dtype)
+        )
+
+    return jax.tree_util.tree_map_with_path(f, arena, stack)
+
+
 def _init_cache_for(dmodel, batch_size: int):
     dummy = jnp.zeros((batch_size, 1), jnp.int32)
     shapes = jax.eval_shape(
@@ -291,16 +463,29 @@ class ChunkedServingDecoder:
         # apply: cap chunk widths (program count stays logarithmic —
         # widths are still powers of two, just from a smaller set)
         self._max_chunk = max_window_chunk(self.dmodel.cfg)
-        #: prompt-KV snapshot reuse: exact prompt bytes -> (primed
-        #: cache, last logits).  A repeat prompt (the chat pattern:
-        #: same system+context, fresh budget/sampling) skips prefill
+        #: prompt-KV snapshot reuse: exact prompt -> (primed cache,
+        #: last logits).  A repeat prompt (the chat pattern: same
+        #: system+context, fresh budget/sampling) skips prefill
         #: entirely.  EXACT — the snapshot holds the same arrays a
         #: fresh prefill would produce, and jax arrays are immutable,
-        #: so decode loops can never corrupt a stored entry.  LRU;
-        #: each entry costs one full B-row KV cache.
-        self._prompt_cache_size = int(prompt_cache)
-        self._prompt_cache = OrderedDict()
-        self.prompt_cache_hits = 0
+        #: so decode loops can never corrupt a stored entry.  Since
+        #: ISSUE 8 this is a CLIENT of the shared content-addressed
+        #: prefix cache (models/prefix_cache.py — the paged pool's
+        #: block store is the other client): one LRU eviction policy,
+        #: one serve_prefix_cache_{hits,misses,evictions}_total metric
+        #: family, keyed here by the degenerate whole-prompt chain
+        #: (exact_key).  Each entry costs one full B-row KV cache.
+        from tf_operator_tpu.models.prefix_cache import PrefixCache
+
+        self._prompt_cache = (
+            PrefixCache(
+                capacity=int(prompt_cache),
+                metrics=self.ledger.metrics,
+                mode="chunked",
+            )
+            if int(prompt_cache) > 0
+            else None
+        )
         self._prefill = {}  # chunk width -> jitted apply; <= log2(max_len)+1
         #: (budget, temperature, top_k) -> jitted scan.  LRU-bounded:
         #: budgets are powers of two but temperature/top_k are
@@ -317,6 +502,10 @@ class ChunkedServingDecoder:
         self.compile_count = 0
 
     _binary_chunks = staticmethod(binary_chunks)  # back-compat alias
+
+    @property
+    def prompt_cache_hits(self) -> int:
+        return 0 if self._prompt_cache is None else self._prompt_cache.hits
 
     def _chunks(self, n: int) -> list:
         return window_chunks(n, self._max_chunk)
@@ -432,16 +621,11 @@ class ChunkedServingDecoder:
             rng = jax.random.PRNGKey(0)
 
         key = None
-        if self._prompt_cache_size > 0:
-            arr = np.asarray(prompt_ids)
-            # shape+dtype in the key: raw bytes alone collide across
-            # reshapes ([1,4] vs [2,2]) and dtype aliases
-            key = (arr.shape, arr.dtype.str, arr.tobytes())
-            with self._lock:
-                hit = self._prompt_cache.get(key)
-                if hit is not None:
-                    self._prompt_cache.move_to_end(key)
-                    self.prompt_cache_hits += 1
+        if self._prompt_cache is not None:
+            from tf_operator_tpu.models.prefix_cache import exact_key
+
+            key = exact_key(np.asarray(prompt_ids))
+            hit = self._prompt_cache.get(key)  # counts hit/miss
             if hit is not None:
                 cache, last = hit
                 with self.ledger.dispatch("decode"):
@@ -460,10 +644,7 @@ class ChunkedServingDecoder:
                 )
             offset += width
         if key is not None:
-            with self._lock:
-                while len(self._prompt_cache) >= self._prompt_cache_size:
-                    self._prompt_cache.popitem(last=False)
-                self._prompt_cache[key] = (cache, last)
+            self._prompt_cache.put(key, (cache, last))
         with self.ledger.dispatch("decode"):
             toks = self._loop_fn(budget, temperature, top_k)(
                 self.params, cache, last, rng

@@ -1,0 +1,178 @@
+"""Block-granular KV-cache accounting for paged serving (ISSUE 8).
+
+The continuous-batching pool's original per-seat cache pins
+``max_len`` KV positions per seat whether a request needs 20 tokens or
+2000 — HBM, not compute, caps concurrency.  Paged serving (the vLLM
+move, re-shaped for XLA's static-shape world) splits the cache into
+fixed-size token BLOCKS over one pre-allocated device arena; a seat
+holds a block *table* (logical block i → physical block id) and
+admission is gated on blocks free, not slots free.
+
+This module is the HOST side of that story: a free-list allocator with
+O(1) alloc/free and per-block refcounts.  Refcounts make copy-free
+prefix sharing safe — a block mapped by a live seat AND published in
+the prefix cache (models/prefix_cache.py) carries one reference per
+holder, and returns to the free list only when the last holder
+releases it.  The device side (arena layout, block-table gather/
+scatter inside the compiled programs) lives in models/decode.py; the
+pool that drives both is models/batching.py's
+``PagedContinuousBatchingDecoder``.
+
+Block id 0 is the SCRATCH block: it is never allocated, every unused
+block-table entry points at it, and padded/overshoot writes land in it
+— reads of scratch content are always masked by ``cache_index``, so
+its garbage is never observable.  The allocator therefore manages ids
+``1 .. num_blocks-1``.
+
+Conservation invariant (test-pinned, tests/test_kv_blocks.py): at all
+times ``free + live == usable`` with no id both free and referenced —
+no double-free, no aliasing across live holders.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+#: the global scratch block id (see module docstring)
+SCRATCH_BLOCK = 0
+
+
+class BlockError(RuntimeError):
+    """An allocator-contract violation (double free, release of a
+    never-allocated id).  Raised loudly: silent refcount corruption is
+    cross-request cache ALIASING, the worst serving bug there is."""
+
+
+class NotPageableError(ValueError):
+    """This MODEL cannot be paged (rolling-window wrap state aliases
+    positions; unrecognised cache layout) — serve it through the
+    contiguous pool.  Distinct from plain ValueError so callers
+    (serve_lm's auto-fallback) can downgrade ONLY for model-shape
+    reasons; operator configuration errors (bad --kv-blocks /
+    --kv-block-size) stay fatal instead of silently losing the paged
+    capacity they asked for."""
+
+
+class BlockAllocator:
+    """Free-list + refcount bookkeeping over ``num_blocks`` arena rows.
+
+    Thread-safe (one lock; every method is O(ids) with O(1) per id).
+    ``alloc`` returns None on shortfall instead of raising so callers
+    can evict/queue — admission backpressure is the caller's policy.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (scratch + at least one "
+                f"usable block), got {num_blocks}"
+            )
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._lock = threading.Lock()
+        # LIFO free list: most-recently-freed block is reused first
+        # (warm pages on a real memory system; determinism in tests)
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._refs: Dict[int, int] = {}  # bid -> refcount; absent = free
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def usable(self) -> int:
+        """Blocks the allocator manages (everything but scratch)."""
+
+        return self.num_blocks - 1
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        with self._lock:
+            return len(self._refs)
+
+    def pressure(self) -> float:
+        """in_use / usable — the blocks-free pressure signal the stock
+        serving autoscaling policy binds (controller/autoscaler.py)."""
+
+        with self._lock:
+            return len(self._refs) / (self.num_blocks - 1)
+
+    def refcount(self, bid: int) -> int:
+        with self._lock:
+            return self._refs.get(bid, 0)
+
+    def check(self) -> None:
+        """Assert the conservation invariant (cheap; tests call it
+        after every random op)."""
+
+        with self._lock:
+            free = set(self._free)
+            if len(free) != len(self._free):
+                raise BlockError("free list holds a duplicate id")
+            if free & set(self._refs):
+                raise BlockError("an id is both free and referenced")
+            if SCRATCH_BLOCK in free or SCRATCH_BLOCK in self._refs:
+                raise BlockError("scratch block entered the allocator")
+            if len(free) + len(self._refs) != self.num_blocks - 1:
+                raise BlockError(
+                    f"conservation broken: {len(free)} free + "
+                    f"{len(self._refs)} live != {self.num_blocks - 1}"
+                )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh blocks at refcount 1, or None when fewer than
+        ``n`` are free (nothing is allocated on shortfall — all or
+        nothing, so a failed admission never leaks)."""
+
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            ids = [self._free.pop() for _ in range(n)]
+            for bid in ids:
+                self._refs[bid] = 1
+            return ids
+
+    def retain(self, ids: List[int]) -> None:
+        """+1 reference per id (prefix-cache publication, mapping a
+        shared block into another seat's table)."""
+
+        with self._lock:
+            for bid in ids:
+                if bid not in self._refs:
+                    raise BlockError(f"retain of unallocated block {bid}")
+                self._refs[bid] += 1
+
+    def release(self, ids: List[int]) -> int:
+        """-1 reference per id; ids reaching 0 return to the free
+        list.  Returns how many blocks were actually freed."""
+
+        freed = 0
+        with self._lock:
+            for bid in ids:
+                rc = self._refs.get(bid)
+                if rc is None:
+                    raise BlockError(f"double free of block {bid}")
+                if rc == 1:
+                    del self._refs[bid]
+                    self._free.append(bid)
+                    freed += 1
+                else:
+                    self._refs[bid] = rc - 1
+        return freed
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``tokens`` positions (ceil division)."""
+
+    return -(-int(tokens) // int(block_size))

@@ -1,0 +1,122 @@
+"""Multi-replica serving pool behind ONE admission queue (ISSUE 8).
+
+``serve_lm --replicas N`` runs N continuous-batching pool replicas —
+each with its own compiled programs, KV arena, and driver thread — and
+this router fronts them with the single submit/result surface the
+handler already speaks.  Routing is least-blocks-in-use
+(``load_score()``: paged pools report live arena occupancy + queued
+block demand over arena size; contiguous pools fall back to
+active+queued counts), so the next request lands on real memory
+headroom, not just the shortest queue.
+
+Each replica carries a ``replica_label``: its SLO observations and
+gauges export per-replica on ``/metrics``
+(``serve_admission_queue_depth{replica=}`` /
+``kv_blocks_free{replica=}`` — the per-replica visibility half of the
+acceptance contract), while ``/slo`` merges the quantile summaries
+across the replica label (utils/metrics.histogram_family_merged) so
+multi-replica serving reports ONE user-facing p99 TTFT.
+
+On this single-host box N replicas are N model copies sharing the
+process (the scale-out topology without the network); under the
+operator each replica is a serving-TPUJob worker pod and the router's
+role is played by the shared admission queue in front of them —
+the routing policy and the metrics contract are what this module
+pins.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class PoolRouter:
+    """N pool replicas, one admission queue, one rid namespace.
+
+    ``pools`` are ContinuousBatchingDecoder / PagedContinuousBatching-
+    Decoder instances (mixed is allowed but pointless).  Thread-safe:
+    submit/result_wait may race driver threads exactly like a single
+    pool's surface.
+    """
+
+    def __init__(self, pools: List):
+        if not pools:
+            raise ValueError("router needs at least one pool replica")
+        self.pools = list(pools)
+        self._lock = threading.Lock()
+        self._rid = 0
+        #: router rid -> (pool index, pool-local rid)
+        self._route: Dict[int, Tuple[int, int]] = {}
+
+    def __len__(self) -> int:
+        return len(self.pools)
+
+    @property
+    def compile_count(self) -> int:
+        return sum(p.compile_count for p in self.pools)
+
+    def load_scores(self) -> List[float]:
+        return [p.load_score() for p in self.pools]
+
+    def submit(self, prompt_ids, max_new_tokens: int, **kw) -> int:
+        """Route to the least-loaded replica; returns a ROUTER rid
+        (collect with this router's result/result_wait, not the
+        pool's).  Validation failures raise before any routing state
+        is recorded."""
+
+        scores = self.load_scores()
+        idx = min(range(len(self.pools)), key=lambda i: (scores[i], i))
+        prid = self.pools[idx].submit(prompt_ids, max_new_tokens, **kw)
+        with self._lock:
+            rid = self._rid
+            self._rid += 1
+            self._route[rid] = (idx, prid)
+        return rid
+
+    def _lookup(self, rid: int) -> Tuple[int, int]:
+        with self._lock:
+            entry = self._route.get(rid)
+        if entry is None:
+            raise KeyError(
+                f"request {rid} unknown or already collected "
+                "(results evict on first read)"
+            )
+        return entry
+
+    def result(self, rid: int):
+        idx, prid = self._lookup(rid)
+        row = self.pools[idx].result(prid)
+        if row is not None:
+            with self._lock:
+                self._route.pop(rid, None)
+        return row
+
+    def result_wait(self, rid: int, timeout: Optional[float] = None):
+        idx, prid = self._lookup(rid)
+        row = self.pools[idx].result_wait(prid, timeout=timeout)
+        if row is not None:
+            with self._lock:
+                self._route.pop(rid, None)
+        return row
+
+    def step_all(self) -> int:
+        """Drive every replica one step (tests / single-threaded
+        drivers); serve_lm runs one driver thread per replica
+        instead.  Returns total still-active seats."""
+
+        return sum(p.step() for p in self.pools)
+
+    def run(self) -> None:
+        """Step every replica until all queues drain (test helper)."""
+
+        while True:
+            idle = True
+            for p in self.pools:
+                with p._lock:
+                    if p._queue or p._active:
+                        idle = False
+                        break
+            if idle:
+                return
+            self.step_all()

@@ -1,0 +1,188 @@
+"""Content-addressed prefix cache — ONE store for both serving reuse
+paths (ISSUE 8 satellite: the pool's block-level prefix sharing and
+ChunkedServingDecoder's batch-1 snapshot reuse used to be two bespoke
+stores with two eviction policies; now both are clients of this class,
+and there is one ``serve_prefix_cache_{hits,misses,evictions}_total``
+metric family, labeled ``{mode}``).
+
+Keys are rolling token-hash CHAIN keys (``chain_keys``): the key of
+block *i* hashes the previous block's key together with block *i*'s
+tokens, so a key addresses the entire prefix up to and including its
+block — two requests sharing a system prompt produce identical chain
+prefixes, and a lookup walks the chain until the first miss (the
+longest cached prefix).  The chunked decoder uses the degenerate
+single-link chain over the whole prompt (``exact_key``) — exact-prompt
+snapshot reuse is prefix caching with one maximal block.
+
+Values are opaque to the cache:
+
+- the paged pool stores PHYSICAL BLOCK IDS (models/kv_blocks.py).  A
+  hit maps the block into the new seat's table copy-free; refcounts
+  (``can_evict`` hook → allocator refcount == 1, i.e. only the cache
+  itself holds the block) guarantee a shared block is never evicted —
+  and therefore never reclaimed/rewritten — while any seat maps it;
+- the chunked decoder stores (primed cache, last logits) snapshot
+  tuples — immutable jax arrays, exact by construction.
+
+Eviction is LRU, entry-capacity bounded (``capacity``) and/or
+pressure-driven (``evict_lru(need=...)`` — the paged pool calls it
+when the arena can't satisfy an admission).  Entries whose value is
+still externally referenced (``can_evict`` False) are skipped, never
+reclaimed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, List, Optional
+
+
+def chain_keys(tokens, block_size: int) -> List[bytes]:
+    """Rolling hash-chain keys for every FULL block of ``tokens``
+    (host ints/np array): key_i = H(key_{i-1} || tokens[i*bs:(i+1)*bs]).
+    Partial trailing blocks get no key — only final, never-rewritten
+    blocks are publishable."""
+
+    import numpy as np
+
+    toks = np.asarray(tokens, np.int32).reshape(-1)
+    keys: List[bytes] = []
+    prev = b"kv-chain-v1"
+    for off in range(0, (toks.size // block_size) * block_size, block_size):
+        h = hashlib.sha256()
+        h.update(prev)
+        h.update(toks[off : off + block_size].tobytes())
+        prev = h.digest()
+        keys.append(prev)
+    return keys
+
+
+def exact_key(arr) -> bytes:
+    """Whole-array content key for exact-prompt snapshot reuse: shape
+    and dtype are part of the key (raw bytes alone collide across
+    reshapes — [1,4] vs [2,2] — and dtype aliases)."""
+
+    import numpy as np
+
+    a = np.asarray(arr)
+    h = hashlib.sha256()
+    h.update(repr((a.shape, a.dtype.str)).encode())
+    h.update(a.tobytes())
+    return h.digest()
+
+
+class PrefixCache:
+    """Refcount-aware LRU keyed by chain keys.  Thread-safe.
+
+    ``capacity`` bounds entry count (None = unbounded, pressure-driven
+    eviction only).  ``can_evict(value) -> bool`` gates eviction (the
+    pool supplies "allocator refcount == 1"); ``on_evict(value)`` runs
+    after removal (the pool releases the cache's block reference).
+    Hit/miss accounting is REQUEST-level, not per-chain-link: callers
+    walk the chain with ``peek`` and then ``record`` once.
+    """
+
+    def __init__(
+        self,
+        capacity: Optional[int] = None,
+        metrics=None,
+        mode: str = "pool",
+        can_evict: Optional[Callable[[Any], bool]] = None,
+        on_evict: Optional[Callable[[Any], None]] = None,
+    ):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[bytes, Any]" = OrderedDict()
+        self.capacity = capacity
+        self.metrics = metrics
+        self.mode = mode
+        self._can_evict = can_evict
+        self._on_evict = on_evict
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def peek(self, key: bytes):
+        """Value for ``key`` (refreshing its LRU position) WITHOUT
+        hit/miss accounting, or None.  Chain walks peek per link and
+        ``record`` once per request."""
+
+        with self._lock:
+            if key not in self._entries:
+                return None
+            self._entries.move_to_end(key)
+            return self._entries[key]
+
+    def record(self, hit: bool) -> None:
+        """Count one request-level hit or miss (one increment per
+        served request, however many chain links matched)."""
+
+        with self._lock:
+            if hit:
+                self.hits += 1
+            else:
+                self.misses += 1
+        if self.metrics is not None:
+            if hit:
+                self.metrics.inc(
+                    "serve_prefix_cache_hits_total", mode=self.mode
+                )
+            else:
+                self.metrics.inc(
+                    "serve_prefix_cache_misses_total", mode=self.mode
+                )
+
+    def get(self, key: bytes):
+        """peek + record in one call — the exact-prompt (single-link)
+        client's read."""
+
+        v = self.peek(key)
+        self.record(v is not None)
+        return v
+
+    def put(self, key: bytes, value: Any) -> None:
+        """Insert/refresh; evicts LRU entries past ``capacity`` (the
+        refcount gate applies — an over-capacity cache whose every
+        entry is mapped simply stays over capacity until seats
+        retire)."""
+
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+        if self.capacity is not None and len(self) > self.capacity:
+            self.evict_lru(need=len(self) - self.capacity)
+
+    def evict_lru(self, need: int = 1) -> int:
+        """Evict up to ``need`` LRU entries whose values pass
+        ``can_evict``; returns how many were evicted.  Entries still
+        referenced are skipped (and keep their LRU position) — a
+        mapped shared block survives any pressure."""
+
+        evicted = 0
+        with self._lock:
+            for key in list(self._entries):
+                if evicted >= need:
+                    break
+                value = self._entries[key]
+                if self._can_evict is not None and not self._can_evict(value):
+                    continue
+                del self._entries[key]
+                self.evictions += 1
+                evicted += 1
+                if self._on_evict is not None:
+                    self._on_evict(value)
+        if evicted and self.metrics is not None:
+            self.metrics.inc(
+                "serve_prefix_cache_evictions_total", float(evicted),
+                mode=self.mode,
+            )
+        return evicted

@@ -286,7 +286,11 @@ class ApiServer:
                         # the control-plane twin of serve_lm's /slo:
                         # per-label-set quantile summaries over the
                         # operator's latency families — both planes
-                        # expose the same SLO read contract
+                        # expose the same SLO read contract.  Merged
+                        # across {replica=} like serve_lm's (an
+                        # embedded/forwarded serving family must
+                        # summarize as ONE fleet quantile, not N
+                        # per-replica rows)
                         fams = {}
                         for fam in (
                             "api_request_seconds",
@@ -296,7 +300,7 @@ class ApiServer:
                             fams[fam] = [
                                 {**dict(labels), **finite_summary(summary)}
                                 for labels, summary in sorted(
-                                    outer.metrics.histogram_family(
+                                    outer.metrics.histogram_family_merged(
                                         fam
                                     ).items()
                                 )

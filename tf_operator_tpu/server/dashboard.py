@@ -294,10 +294,20 @@ function refreshSLO(metricLines) {
     if (!m || !WANT.test(m[1])) continue;
     const le = (m[2].match(/le="([^"]+)"/) || [])[1];
     if (le === undefined) continue;
-    const rest = m[2].replace(/le="[^"]+",?/, "").replace(/,$/, "");
+    // merge across {replica=}: multi-replica serving must read as ONE
+    // user-facing quantile row (cumulative bucket counts at the same
+    // le sum across replicas); /metrics keeps the raw per-replica
+    // series for capacity eyes
+    const rest = m[2].replace(/le="[^"]+",?/, "")
+      .replace(/replica="[^"]+",?/, "").replace(/,$/, "");
     const key = m[1] + "|" + rest;
-    (series[key] = series[key] || { fam: m[1], labels: rest, b: [] })
-      .b.push([le === "+Inf" ? Infinity : parseFloat(le), parseFloat(m[3])]);
+    const s = (series[key] = series[key] || { fam: m[1], labels: rest, sum: {} });
+    const bound = le === "+Inf" ? Infinity : parseFloat(le);
+    s.sum[bound] = (s.sum[bound] || 0) + parseFloat(m[3]);
+  }
+  for (const key of Object.keys(series)) {
+    const s = series[key];
+    s.b = Object.keys(s.sum).map(k => [parseFloat(k), s.sum[k]]);
   }
   const tbody = document.querySelector("#slo tbody");
   const keys = Object.keys(series).sort();

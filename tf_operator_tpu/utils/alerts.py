@@ -248,6 +248,18 @@ def default_rules(
             severity="ticket",
         ),
         ThresholdRule(
+            # paged-serving memory headroom (ISSUE 8): the arena is
+            # nearly exhausted — admission is about to gate on blocks
+            # free.  Worst replica drives it (gauge kind takes the max
+            # matching level); the stock serving autoscaling policy
+            # binds the same family so the alert and the scale-up act
+            # on one number
+            "kv-blocks-pressure",
+            metric="kv_blocks_pressure",
+            kind="gauge", threshold=0.9,
+            severity="ticket",
+        ),
+        ThresholdRule(
             "checkpoint-stale",
             metric="checkpoint_last_success_unix",
             kind="gauge_age", threshold=1800.0,

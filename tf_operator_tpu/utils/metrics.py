@@ -227,6 +227,44 @@ class Metrics:
             for labels, (bks, counts, total, cnt) in items
         }
 
+    def histogram_family_merged(
+        self, name: str, drop: Tuple[str, ...] = ("replica",)
+    ) -> Dict[Tuple[Tuple[str, str], ...], Dict[str, float]]:
+        """``histogram_family`` with the ``drop`` label keys merged
+        away: series differing only in those labels sum their bucket
+        counts before summarization.  This is the /slo read under
+        multi-replica serving (ISSUE 8 bugfix): N per-replica
+        ``serve_ttft_seconds{replica=...}`` series become ONE
+        user-facing quantile summary instead of N disjoint ones.
+        Bucket-boundary mismatches (same family observed with
+        different explicit buckets) keep those series separate — a
+        positional sum would be a lie."""
+
+        merged: Dict[Tuple[Tuple[str, str], ...], list] = {}
+        with self._lock:
+            items = [
+                (labels, (h[0], list(h[1]), h[2], h[3]))
+                for (n, labels), h in self._histograms.items()
+                if n == name
+            ]
+        for labels, (bks, counts, total, cnt) in sorted(items):
+            key = tuple((k, v) for k, v in labels if k not in drop)
+            have = merged.get(key)
+            if have is not None and have[0] == bks:
+                have[1] = [a + b for a, b in zip(have[1], counts)]
+                have[2] += total
+                have[3] += cnt
+            elif have is None:
+                merged[key] = [bks, counts, total, cnt]
+            else:
+                # incompatible buckets: keep the series distinct under
+                # its full label set rather than mis-merge
+                merged[labels] = [bks, counts, total, cnt]
+        return {
+            labels: self._summarize(bks, counts, total, cnt)
+            for labels, (bks, counts, total, cnt) in merged.items()
+        }
+
     def counter(self, name: str, **labels: str) -> float:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
