@@ -91,8 +91,42 @@ def parse_artifacts(out_dir: str) -> dict:
     spec = _last_json_line(_read(out_dir, "speculative.out"))
     if spec and "speculative_tokens_per_sec" in spec:
         data["speculative"] = spec
-    paged = _last_json_line(_read(out_dir, "paged.out"))
-    if paged and "paged_tokens_per_sec" in paged:
+    # prefer the ON-CHIP serving row (ISSUE 10's paged-chip step —
+    # fused-kernel decode bandwidth lives only there) when it came
+    # from the CURRENT window: window_out is never cleared between
+    # windows, and a window that dies before the chip step (the
+    # tunnel has died mid-window before) must not let a weeks-old
+    # chip artifact shadow the round's real data and get restamped
+    # with today's date.  Freshness rule: within one window span
+    # (24 h, windows run hours) of the CPU smoke the chip row wins —
+    # the smoke step runs AFTER paged-chip in a healthy window, so a
+    # strict newest-mtime rule would always discard the chip row.
+    _PAGED_CHIP_STALE_S = 24 * 3600.0
+
+    def _paged_row(name):
+        row = _last_json_line(_read(out_dir, name))
+        if not (row and "paged_tokens_per_sec" in row):
+            return None, 0.0
+        try:
+            mtime = os.path.getmtime(os.path.join(out_dir, name))
+        except OSError:
+            mtime = 0.0
+        return row, mtime
+
+    chip_row, chip_mt = _paged_row("paged-chip.out")
+    smoke_row, smoke_mt = _paged_row("paged.out")
+    # freshness anchor: the smoke artifact when present, else NOW — a
+    # missing/corrupt paged.out must not make an arbitrarily old chip
+    # artifact look current (smoke_mt would be 0.0 and the age test
+    # could never fire)
+    anchor = smoke_mt if smoke_row else time.time()
+    if chip_row and anchor - chip_mt > _PAGED_CHIP_STALE_S:
+        chip_row = None  # stale: from an earlier window
+    paged, paged_src = (
+        (chip_row, "paged-chip.out") if chip_row else (smoke_row, "paged.out")
+    )
+    if paged:
+        paged["_artifact"] = paged_src
         data["paged"] = paged
 
     flash = _read(out_dir, "flash.out")
@@ -224,15 +258,33 @@ def write_last_measured(data: dict, today: str) -> None:
         bt.get("batching_admission_dispatches_per_request"),
         "batching.out")
     pg = data.get("paged", {})
+    pg_src = pg.get("_artifact", "paged.out")
     put("paged_tokens_per_sec", pg.get("paged_tokens_per_sec"),
-        "paged.out")
+        pg_src)
     put("paged_capacity_ratio", pg.get("paged_capacity_ratio"),
-        "paged.out")
+        pg_src)
     put("paged_prefix_hit_rate", pg.get("paged_prefix_hit_rate"),
-        "paged.out")
-    put("paged_p99_ttft_s", pg.get("paged_p99_ttft_s"), "paged.out")
+        pg_src)
+    put("paged_p99_ttft_s", pg.get("paged_p99_ttft_s"), pg_src)
     put("paged_equal_slots_wall_ratio",
-        pg.get("paged_equal_slots_wall_ratio"), "paged.out")
+        pg.get("paged_equal_slots_wall_ratio"), pg_src)
+    # ISSUE 10: every decode-bandwidth MEASUREMENT the fused-kernel
+    # leg emits (gather/fused tokens-per-sec per ctx x seats, read
+    # speedups, the CPU interpret probe) — keyed dynamically so new
+    # ctx/seat mixes land without a collector edit.  Config echoes
+    # (paged_kernel_windows, backend strings) are not measurements
+    # and stay out of the measured-keys ledger.
+    _MEASURED_PREFIXES = (
+        "paged_kernel_gather_",
+        "paged_kernel_fused_",
+        "paged_kernel_read_speedup_",
+        "paged_kernel_interpret_max_err",
+    )
+    for key in sorted(pg):
+        if key.startswith(_MEASURED_PREFIXES) and isinstance(
+            pg[key], (int, float)
+        ):
+            put(key, pg[key], pg_src)
     sp = data.get("speculative", {})
     put("speculative_speedup", sp.get("speculative_speedup"),
         "speculative.out")
@@ -409,6 +461,52 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
     pg = data.get("paged")
     if pg:
         backend = pg.get("paged_backend", "?")
+        pg_art = pg.get("_artifact", "paged.out")
+        on_chip = backend == "tpu"
+        # a chip-fed row's at-capacity number IS the measurement; only
+        # the CPU smoke needs the compute-bound caveat
+        capacity_caveat = (
+            "at-capacity tok/s measured on chip"
+            if on_chip
+            else "at-capacity tok/s is chip-meaningful only — CPU "
+            "smoke is compute-bound by the extra seats"
+        )
+        provenance = (
+            f"1× v5 lite, `measure.py --section paged` → "
+            f"`window_out/{pg_art}`"
+            if on_chip
+            else f"{backend} smoke, `measure.py --section paged` → "
+            f"`window_out/{pg_art}`"
+        )
+        # ISSUE 10 provenance: which decode read produced the row —
+        # fused Pallas kernel speedups when the window ran on chip,
+        # otherwise the emulation with the interpret numerics probe
+        speedups = {
+            k: v for k, v in pg.items()
+            if k.startswith("paged_kernel_read_speedup_")
+        }
+        if speedups:
+            sp_txt = ", ".join(
+                f"{k[len('paged_kernel_read_speedup_'):]}: {v}×"
+                for k, v in sorted(speedups.items())
+            )
+            kernel_txt = (
+                f"; decode read: FUSED Pallas paged-attention vs "
+                f"gather emulation {sp_txt}"
+            )
+        else:
+            err = pg.get("paged_kernel_interpret_max_err")
+            probe_txt = (
+                f"interpret probe max err {err}"
+                if err is not None
+                # pre-leg-D artifact (a window died before both paged
+                # steps reran): say so instead of "max err None"
+                else "no interpret probe in this artifact"
+            )
+            kernel_txt = (
+                "; decode read: gather emulation (fused kernel needs "
+                f"the TPU backend; {probe_txt})"
+            )
         rows["Paged KV serving"] = (
             "| Paged KV serving (bursty mixed-length trace, "
             f"{pg.get('paged_trace_requests', '?')} requests, equal "
@@ -430,9 +528,8 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             f"{pg.get('paged_p99_ttft_s', '?')} s "
             "(`models/batching.PagedContinuousBatchingDecoder`, block-"
             "gated admission + shared prefix cache; ledger in the "
-            "artifact; at-capacity tok/s is chip-meaningful only — "
-            "CPU smoke is compute-bound by the extra seats) "
-            f"| {backend} smoke, `measure.py --section paged` → `window_out/paged.out`, {today} |"
+            f"artifact; {capacity_caveat}{kernel_txt}) "
+            f"| {provenance}, {today} |"
         )
     sp = data.get("speculative")
     if sp:

@@ -556,6 +556,14 @@ def bench_paged() -> dict:
     carry prefix_tokens, so "full hit = zero prefill work" is visible
     in the artifact, not just in the test pin.
 
+    Leg D (ISSUE 10) measures decode BANDWIDTH: steady-state decode
+    windows only, gather-emulation vs the fused Pallas paged-attention
+    read, across context lengths x seat mixes
+    (``paged_kernel_{gather,fused}_tokens_per_sec_c{CTX}_s{SEATS}`` +
+    ``paged_kernel_read_speedup_*``).  The fused numbers exist only on
+    the TPU backend; the CPU smoke records an interpreter-mode
+    numerics probe instead.
+
     CPU smoke: MEASURE_PAGED_TINY=1 swaps in llama_tiny (the
     tpu_window step runs this so the accounting is exercised every
     window without chip minutes)."""
@@ -714,6 +722,108 @@ def bench_paged() -> dict:
     out["paged_prefix_hit_rate"] = round(hits / max(1, hits + misses), 3)
     out["paged_speedup_vs_slot"] = round(wall_s / wall_p, 2)
     out["paged_capacity_ratio"] = round(conc_p / max(1, conc_s), 2)
+
+    # leg D — decode BANDWIDTH (ISSUE 10): steady-state decode windows
+    # only (admission excluded), gather-emulation vs the fused Pallas
+    # paged-attention read, at several context lengths x seat mixes.
+    # The emulation materializes the contiguous view per program
+    # (~2x KV traffic); the fused step reads the arena once — the
+    # ratio is the on-chip number that gates the paged pool's
+    # at-capacity tokens/sec.  On CPU the compiled kernel cannot run:
+    # the fused leg is skipped (recorded as such) and a tiny
+    # interpreter-mode probe pins the kernel path's numerics instead,
+    # so every CPU-smoke window still proves the kernel alive.
+    from tf_operator_tpu.models.kv_blocks import blocks_for
+
+    windows = int(os.environ.get("MEASURE_PAGED_WINDOWS", "8"))
+    ctx_raw = os.environ.get("MEASURE_PAGED_CTX", "")
+    # +2 windows of budget: admission yields 1 token, the untimed
+    # warmup step K more, the timed region windows*K — seats must NOT
+    # hit their budget inside the timed region, or the one-time retire
+    # jit compile + dispatch lands in the measured wall and deflates
+    # the bandwidth numbers
+    budget_d = (windows + 2) * k_sync
+    if ctx_raw:
+        ctxs = [int(c) for c in ctx_raw.split(",") if c.strip()]
+    else:
+        ctxs = sorted({max(block, seq // 8), max(2 * block, seq // 2)})
+    # a ctx must leave room for the decode budget (prompt + budget <=
+    # max_len is the pool's submit contract) — when the window/K
+    # config leaves no valid ctx, SKIP leg D with a recorded reason
+    # instead of crashing the section and losing legs A-C's artifact;
+    # a PARTIAL drop is recorded too (no silent caps — a missing
+    # long-context cell must be visible in the artifact)
+    dropped = [c for c in ctxs if c + budget_d > seq]
+    ctxs = [c for c in ctxs if c + budget_d <= seq]
+    if dropped and ctxs:
+        out["paged_kernel_ctx_dropped"] = (
+            f"{dropped}: ctx + decode budget {budget_d} exceeds "
+            f"max_len={seq}"
+        )
+    mixes = [slots_base, 4 * slots_base]
+    on_tpu = jax.default_backend() == "tpu"
+    out["paged_kernel_backend"] = jax.default_backend()
+    out["paged_kernel_windows"] = windows
+
+    def decode_leg(kernel_mode: str, ctx: int, seats: int):
+        """tokens/sec over ``windows`` steady-state decode windows at
+        full occupancy (seats x ctx context, K tokens per window)."""
+
+        rd = np.random.RandomState(1234 + ctx + seats)
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=seats, steps_per_sync=k_sync,
+            kv_blocks=seats * blocks_for(ctx + budget_d, block),
+            kv_block_size=block, paged_kernel=kernel_mode,
+        )
+        for _ in range(seats):
+            pool.submit(
+                rd.randint(0, vocab, size=(ctx,)).astype(np.int32),
+                budget_d,
+            )
+        pool.step()  # admissions + first window (compiles)
+        t0 = time.perf_counter()
+        for _ in range(windows):
+            pool.step()
+        wall = time.perf_counter() - t0
+        return round(seats * k_sync * windows / wall, 1)
+
+    if not ctxs:
+        out["paged_kernel_decode_leg"] = (
+            f"skipped: decode budget {budget_d} (windows={windows} x "
+            f"K={k_sync}) leaves no valid context length under "
+            f"max_len={seq} — lower MEASURE_PAGED_WINDOWS/"
+            "MEASURE_PAGED_K or raise MEASURE_PAGED_MAXLEN"
+        )
+    for ctx in ctxs:
+        for seats in mixes:
+            gather = decode_leg("off", ctx, seats)
+            out[f"paged_kernel_gather_tokens_per_sec_c{ctx}_s{seats}"] = gather
+            if on_tpu:
+                fused = decode_leg("on", ctx, seats)
+                out[f"paged_kernel_fused_tokens_per_sec_c{ctx}_s{seats}"] = fused
+                out[f"paged_kernel_read_speedup_c{ctx}_s{seats}"] = round(
+                    fused / max(1e-9, gather), 2
+                )
+    if not on_tpu:
+        # interpreter probe: the REAL kernel, tiny shape — numerics
+        # pinned against the gather reference in every smoke window
+        from tf_operator_tpu.ops.paged_attention import paged_attention
+
+        rp = np.random.RandomState(7)
+        ka = jnp.asarray(rp.randn(5, 2, 8, 32), jnp.float32)
+        va = jnp.asarray(rp.randn(5, 2, 8, 32), jnp.float32)
+        qp = jnp.asarray(rp.randn(2, 4, 32), jnp.float32)
+        tbl = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+        ln = jnp.asarray([9, 15], jnp.int32)
+        got = paged_attention(qp, ka, va, tbl, ln, impl="pallas-interpret")
+        ref = paged_attention(qp, ka, va, tbl, ln, impl="xla")
+        out["paged_kernel_interpret_max_err"] = float(
+            jnp.max(jnp.abs(got - ref))
+        )
+        out["paged_kernel_fused_leg"] = (
+            "skipped: compiled kernel needs the TPU backend "
+            "(interpret probe above pins the kernel path)"
+        )
     return out
 
 

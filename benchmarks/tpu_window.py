@@ -91,13 +91,35 @@ STEPS = [
         [sys.executable, os.path.join(HERE, "measure.py"), "--section", "batching"],
         2400,
     ),
-    # paged KV serving vs the slot pool at equal arena budget
-    # (ISSUE 8).  CPU SMOKE by design: the capacity/hit-rate/TTFT
+    # paged KV serving ON CHIP (ISSUE 10): the pending BASELINE rows —
+    # pool >= 1x prediction, paged at-capacity tok/s — become measured,
+    # plus leg D's gather-emulation vs FUSED Pallas paged-attention
+    # decode-bandwidth comparison (paged_kernel_* keys; the kernel
+    # only exists here).  Runs right after batching so a dying tunnel
+    # can't lose the serving rows again.  Budget: ~11 pool builds
+    # (3 legs + 2 ctx x 2 seat-mix x 2 mode bandwidth legs) x
+    # width-class compiles on the 1-core host.  WINDOWS=4 keeps the
+    # leg-D decode budget ((4+2) x K = 192) low enough that BOTH ctx
+    # classes (64 and 256) fit under max_len=512 — the long-context
+    # cell is the most bandwidth-bound mix, the one the fused kernel
+    # exists for.
+    (
+        "paged-chip",
+        [sys.executable, os.path.join(HERE, "measure.py"),
+         "--section", "paged"],
+        2700,
+        {
+            "MEASURE_PAGED_MAXLEN": "512",
+            "MEASURE_PAGED_REQUESTS": "24",
+            "MEASURE_PAGED_K": "32",
+            "MEASURE_PAGED_WINDOWS": "4",
+        },
+    ),
+    # paged KV serving CPU smoke: the capacity/hit-rate/TTFT
     # accounting is platform-independent (admission is host-side
-    # arithmetic), so the window exercises it every round on the
-    # host instead of spending chip minutes; drop the env overrides
-    # for an on-chip tokens/sec row when the serving rows get their
-    # dedicated window
+    # arithmetic), so the window also exercises it every round on the
+    # host — including the interpret-mode kernel numerics probe —
+    # even when the chip half dies mid-window
     (
         "paged",
         [sys.executable, os.path.join(HERE, "measure.py"),

@@ -106,6 +106,7 @@ def build_handler(
     speculative: bool = False, prompt_cache: int = 0, tracer=None,
     model_label: str = "", metrics=None, replicas: int = 1,
     kv_blocks: "int | None" = None, kv_block_size: int = 16,
+    paged_kernel: str = "auto",
 ):
     """batching_slots > 0 serves through the continuous-batching pool
     (models/batching.py): concurrent requests share one decode loop,
@@ -252,13 +253,25 @@ def build_handler(
             try:
                 # PAGED is the default pool (ISSUE 8): admission gated
                 # on blocks free, shared prefix cache; kv_blocks=None
-                # sizes the arena at the slot pool's HBM budget
+                # sizes the arena at the slot pool's HBM budget.
+                # --paged-kernel (ISSUE 10) selects the steady-state
+                # step: "auto" fuses the Pallas paged-attention read
+                # on TPU / emulates elsewhere; an explicit "on" FAILS
+                # here (ValueError, not NotPageableError) when the
+                # kernel cannot serve — never a silent downgrade
                 p = PagedContinuousBatchingDecoder(
                     model, params, slots=batching_slots,
                     kv_blocks=kv_blocks, kv_block_size=kv_block_size,
                     ledger=ledger, metrics=metrics,
                     model_label=model_label, replica_label=rep,
+                    paged_kernel=paged_kernel,
                 )
+                if i == 0:
+                    print(
+                        "paged decode step: "
+                        + (p._kernel_impl or "gather emulation"),
+                        flush=True,
+                    )
             except NotPageableError as exc:
                 # MODEL-shape fallback only (rolling-window caches):
                 # operator config errors (bad --kv-blocks /
@@ -659,6 +672,17 @@ def main() -> int:
         help="tokens per KV block (must divide max_len)",
     )
     ap.add_argument(
+        "--paged-kernel", choices=["auto", "on", "off", "interpret"],
+        default="auto", metavar="MODE",
+        help="paged-attention decode step (ISSUE 10): 'auto' reads KV "
+             "straight off the block arena with the Pallas kernel on "
+             "the TPU backend and falls back to the gather emulation "
+             "elsewhere; 'on' REFUSES to start where the kernel cannot "
+             "serve (no silent downgrade); 'off' pins the emulation; "
+             "'interpret' runs the kernel through the Pallas "
+             "interpreter (test/debug only — slow)",
+    )
+    ap.add_argument(
         "--quantize", choices=["int8"], default=None,
         help="weights-only int8 for the projection kernels "
              "(ops/quant.py): ~2x less HBM weight traffic per decoded "
@@ -745,6 +769,7 @@ def main() -> int:
         prompt_cache=args.prompt_cache, model_label=model_label,
         metrics=serve_metrics, replicas=args.replicas,
         kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size,
+        paged_kernel=args.paged_kernel,
     )
     server = ThreadingHTTPServer(("127.0.0.1", args.port), handler)
     # the serving binary boots the SLO evaluator (build_handler only

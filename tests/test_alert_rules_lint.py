@@ -155,6 +155,8 @@ def test_collector_sees_known_call_sites():
     # these — the keys must stay declared at the literal call sites
     assert {"model", "replica"} <= families["kv_blocks_pressure"]
     assert {"model", "replica"} <= families["kv_blocks_free"]
+    # ISSUE 10: the queued-demand component of the pressure ramp
+    assert {"model", "replica"} <= families["kv_blocks_queued_demand"]
     assert "mode" in families["serve_prefix_cache_hits_total"]
     assert "mode" in families["serve_prefix_cache_evictions_total"]
 

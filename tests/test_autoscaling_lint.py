@@ -119,6 +119,7 @@ def test_paged_serving_families_are_emitted_with_expected_labels():
         "kv_blocks_free",
         "kv_blocks_total",
         "kv_blocks_in_use",
+        "kv_blocks_queued_demand",  # ISSUE 10: mid-burst demand ramp
         "kv_blocks_pressure",
     ):
         assert {"model", "replica"} <= families[fam], fam

@@ -17,6 +17,18 @@ call is exempt only when its own arguments contain a ``.resolve(...)``
 call (``float(ledger.resolve(...))`` — already host-side by
 construction).  Measurement helpers (benchmark/_slope_time/hard_sync)
 and eval are off the steady-state path and stay unlinted.
+
+ISSUE 10 extends the gate to the SERVING paged step loop
+(``PagedContinuousBatchingDecoder.step``/``_step`` in
+models/batching.py — class-scoped so the legacy contiguous pool's
+documented host work stays out of scope): steady-state paged decode
+runs over device-resident tables with zero per-step uploads, so any
+raw host gather there would quietly re-introduce the per-step traffic
+the fused kernel removed.  The serving-side sanctioned fetch is the
+one INSIDE a ``with ...dispatch(...)`` block — the DispatchLedger's
+counting+timing wrapper, serving's equivalent of the training
+ledger's ``.resolve(...)`` (the ledger contract says the in-block
+fetch is part of the measured round trip).
 """
 
 import ast
@@ -35,6 +47,15 @@ HOT_FUNCTIONS = {
         "_step_body",
         "_build_step",
         "_build_multi_step",
+    },
+}
+
+#: file -> {class name -> step-loop functions} (serving hot paths are
+#: methods; class scoping keeps same-named base-class methods with
+#: documented host work out of the gate)
+HOT_CLASS_FUNCTIONS = {
+    "models/batching.py": {
+        "PagedContinuousBatchingDecoder": {"step", "_step"},
     },
 }
 
@@ -68,18 +89,83 @@ def _is_exempt(call: ast.Call) -> bool:
     return any(_contains_resolve(a) for a in args)
 
 
-def find_hot_syncs(tree: ast.AST, func_names, label: str):
+def _in_dispatch_block(node: ast.AST) -> bool:
+    """True for a ``with <...>.dispatch(...)`` statement — the serving
+    ledger's counting+timing wrapper (the sanctioned fetch window)."""
+
+    if not isinstance(node, ast.With):
+        return False
+    for item in node.items:
+        expr = item.context_expr
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr == "dispatch"
+        ):
+            return True
+    return False
+
+
+def _walk_fn(fn: ast.AST, label: str, offenders, allow_dispatch: bool):
+    def visit(node, in_dispatch):
+        if isinstance(node, ast.Call):
+            name = _forbidden(node)
+            if (
+                name is not None
+                and not _is_exempt(node)
+                and not (allow_dispatch and in_dispatch)
+            ):
+                offenders.append(f"{label}:{node.lineno} {name}(...)")
+        if allow_dispatch and _in_dispatch_block(node):
+            # only the with BODY is inside the ledger's timed window;
+            # the header (context_expr/optional_vars) evaluates BEFORE
+            # the window opens — a sync there must stay flagged (the
+            # serving twin of test_resolve_argument_interior_is_not_
+            # exempt)
+            for item in node.items:
+                visit(item.context_expr, in_dispatch)
+                if item.optional_vars is not None:
+                    visit(item.optional_vars, in_dispatch)
+            for child in node.body:
+                visit(child, True)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_dispatch)
+
+    for child in ast.iter_child_nodes(fn):
+        visit(child, False)
+
+
+def find_hot_syncs(tree: ast.AST, func_names, label: str,
+                   allow_dispatch: bool = False):
     offenders = []
     for fn in ast.walk(tree):
         if (
             isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
             and fn.name in func_names
         ):
-            for node in ast.walk(fn):
-                if isinstance(node, ast.Call):
-                    name = _forbidden(node)
-                    if name is not None and not _is_exempt(node):
-                        offenders.append(f"{label}:{node.lineno} {name}(...)")
+            _walk_fn(fn, label, offenders, allow_dispatch)
+    return offenders
+
+
+def find_hot_syncs_in_class(tree: ast.AST, class_map, label: str):
+    """Class-scoped variant with the serving dispatch-window exemption
+    (module docstring): only the named classes' named methods are
+    walked."""
+
+    offenders = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name in class_map:
+            funcs = class_map[node.name]
+            for fn in node.body:
+                if (
+                    isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and fn.name in funcs
+                ):
+                    _walk_fn(
+                        fn, f"{label}:{node.name}", offenders,
+                        allow_dispatch=True,
+                    )
     return offenders
 
 
@@ -89,6 +175,10 @@ def _lint_package():
         path = PKG_ROOT / rel
         tree = ast.parse(path.read_text(), filename=str(path))
         offenders.extend(find_hot_syncs(tree, funcs, rel))
+    for rel, class_map in sorted(HOT_CLASS_FUNCTIONS.items()):
+        path = PKG_ROOT / rel
+        tree = ast.parse(path.read_text(), filename=str(path))
+        offenders.extend(find_hot_syncs_in_class(tree, class_map, rel))
     return offenders
 
 
@@ -125,6 +215,56 @@ def test_walker_catches_planted_syncs():
         "float(...)", "asarray(...)", "device_get(...)",
         "block_until_ready(...)",
     ]
+
+
+def test_paged_step_loop_collector_scopes_and_exempts():
+    """The serving extension works: forbidden calls inside the paged
+    class's step loop are flagged, the fetch inside a ``with
+    ledger.dispatch(...)`` block is sanctioned, and the SAME method
+    name on another class (the contiguous pool's documented host work)
+    stays out of scope."""
+
+    src = (
+        "class PagedContinuousBatchingDecoder:\n"
+        "    def step(self):\n"
+        "        with self.ledger.dispatch('step'):\n"
+        "            host_toks = np.asarray(toks_k)\n"       # sanctioned
+        "        bad = np.asarray(self._tables_dev)\n"        # offender
+        "        worse = float(lengths[0])\n"                 # offender
+        "\n"
+        "class ContinuousBatchingDecoder:\n"
+        "    def step(self):\n"
+        "        rngs = np.asarray(r)\n"                      # out of scope
+    )
+    offenders = find_hot_syncs_in_class(
+        ast.parse(src),
+        {"PagedContinuousBatchingDecoder": {"step"}},
+        "planted",
+    )
+    assert [o.split()[1] for o in offenders] == [
+        "asarray(...)", "float(...)",
+    ]
+    assert all("PagedContinuousBatchingDecoder" in o for o in offenders)
+
+
+def test_dispatch_block_header_is_not_exempt():
+    """A sync smuggled into the ``with ledger.dispatch(...)`` HEADER
+    runs before the timed window opens — it must stay flagged even
+    though the With body is sanctioned (the serving twin of
+    test_resolve_argument_interior_is_not_exempt)."""
+
+    src = (
+        "class PagedContinuousBatchingDecoder:\n"
+        "    def step(self):\n"
+        "        with self.ledger.dispatch('step', n=float(x[0])):\n"  # offender
+        "            ok = np.asarray(toks_k)\n"                        # sanctioned
+    )
+    offenders = find_hot_syncs_in_class(
+        ast.parse(src),
+        {"PagedContinuousBatchingDecoder": {"step"}},
+        "planted",
+    )
+    assert len(offenders) == 1 and "float" in offenders[0]
 
 
 def test_resolve_argument_interior_is_not_exempt():

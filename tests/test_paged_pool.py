@@ -390,6 +390,168 @@ class TestBlockGatedAdmission:
         assert m.gauge("kv_blocks_free", model="t", replica="0") == 7.0
 
 
+class TestFusedKernelStep:
+    """ISSUE 10: the Pallas paged-attention decode step (run through
+    the interpreter — the same kernel path that compiles on TPU)."""
+
+    def test_kernel_step_token_identical_to_contiguous(self):
+        """The acceptance pin: greedy, temperature+top_k, and a
+        prefix-hit repeat decode token-identically to the contiguous
+        pool when the steady-state step reads KV straight off the
+        arena (no gather, no scatter-back, in-place appends)."""
+
+        model, params = _setup()
+        r = np.random.RandomState(21)
+        sys_prompt = r.randint(0, VOCAB, size=(33,)).astype(np.int32)
+        reqs = [
+            (sys_prompt, dict(max_new_tokens=5)),
+            # straddle: 17 tokens end one past a block boundary
+            (r.randint(0, VOCAB, size=(17,)).astype(np.int32),
+             dict(max_new_tokens=6, temperature=0.9, top_k=8,
+                  rng=jax.random.PRNGKey(5))),
+            (sys_prompt, dict(max_new_tokens=4)),  # full-block hit
+        ]
+        base = ContinuousBatchingDecoder(model, params, slots=4)
+        want = []
+        for p, kw in reqs:
+            rid = base.submit(p, **kw)
+            base.run()
+            want.append(base.result(rid))
+
+        paged = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16,
+            paged_kernel="interpret",
+        )
+        assert paged._kernel_impl == "pallas-interpret"
+        rids = []
+        for p, kw in reqs:
+            rids.append(paged.submit(p, **kw))
+            paged.step()  # staggered: the repeat sees published blocks
+        paged.run()
+        for rid, w in zip(rids, want):
+            np.testing.assert_array_equal(paged.result(rid), w)
+        assert paged.prefix.hits >= 1
+        paged.alloc.check()
+
+    def test_paged_kernel_on_fails_off_tpu_instead_of_downgrading(self):
+        """The honesty rule: an explicit --paged-kernel on must FAIL
+        where the kernel cannot serve — as a config-class ValueError
+        (serve_lm's NotPageableError fallback must NOT swallow it)."""
+
+        from tf_operator_tpu.models.kv_blocks import NotPageableError
+
+        if jax.default_backend() == "tpu":
+            pytest.skip("TPU backend: the compiled kernel applies")
+        model, params = _setup()
+        with pytest.raises(ValueError) as ei:
+            PagedContinuousBatchingDecoder(
+                model, params, slots=2, kv_block_size=16,
+                paged_kernel="on",
+            )
+        assert not isinstance(ei.value, NotPageableError)
+        assert "backend" in str(ei.value)
+        with pytest.raises(ValueError):
+            PagedContinuousBatchingDecoder(
+                model, params, slots=2, kv_block_size=16,
+                paged_kernel="sideways",
+            )
+        # auto on CPU quietly serves the emulation (documented)
+        dec = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=16, paged_kernel="auto",
+        )
+        assert dec._kernel_impl is None
+        # an UNPAGEABLE model turns an explicit kernel request into a
+        # config error too (ValueError, not the NotPageableError that
+        # serve_lm's model-shape fallback would quietly swallow) —
+        # and a typo'd mode fails before pageability is even checked
+        win_model = llama_tiny(vocab_size=VOCAB, max_len=48, window=8)
+        win_params = win_model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+        )["params"]
+        for bad_mode in ("interpret", "sideways"):
+            with pytest.raises(ValueError) as ei:
+                PagedContinuousBatchingDecoder(
+                    win_model, win_params, slots=2,
+                    paged_kernel=bad_mode,
+                )
+            assert not isinstance(ei.value, NotPageableError)
+
+
+class TestDeviceResidentState:
+    def test_steady_state_is_one_dispatch_per_step_and_no_uploads(self):
+        """The ISSUE 10 ledger pin: a decode window is exactly ONE
+        ``step`` dispatch — no per-step table uploads, host gathers,
+        prefill or scatter phases ever appear; the only non-step
+        dispatches are the once-per-request ``admission`` (which
+        writes the device table delta in-graph) and the batched
+        ``retire`` reset."""
+
+        model, params = _setup()
+        dec = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=16, steps_per_sync=4
+        )
+        rid = dec.submit(
+            np.arange(9, dtype=np.int32) % VOCAB, max_new_tokens=13,
+            temperature=0.7, rng=jax.random.PRNGKey(2),
+        )
+        dec.step()  # admission + window 1
+        assert dec.ledger.count("admission") == 1
+        assert dec.ledger.count("step") == 1
+        assert dec.ledger.count("retire") == 0
+        mid = dec.ledger.count()
+        dec.step()  # steady state: window 2, nothing else
+        assert dec.ledger.count() == mid + 1
+        assert dec.ledger.count("step") == 2
+        dec.run()
+        assert dec.result(rid) is not None
+        snap = dec.ledger.snapshot()
+        assert set(snap) <= {"admission", "step", "retire"}, snap
+        assert dec.ledger.count("prefill") == 0
+        assert dec.ledger.count("sample") == 0
+        assert dec.ledger.count("scatter") == 0
+        assert dec.ledger.count("retire") == 1  # batched, once
+        # the retired seat's device row went back to scratch/zero (its
+        # freed blocks may re-allocate immediately); never-admitted
+        # slots keep their harmless scratch-routed drift
+        assert int(np.asarray(dec._tables_dev).max()) == 0  # all scratch
+        assert int(np.asarray(dec._lengths_dev)[0]) == 0  # seat 0 retired
+        dec.alloc.check()
+
+    def test_pressure_ramps_with_queued_demand(self):
+        """ISSUE 10 satellite: kv_blocks_pressure includes queued
+        block demand and refreshes per decode window — a burst the
+        arena cannot admit ramps the signal ABOVE occupancy (and past
+        1.0 under backlog) instead of step-functioning at admission."""
+
+        model, params = _setup()
+        m = Metrics()
+        dec = PagedContinuousBatchingDecoder(
+            model, params, slots=6, kv_block_size=16, kv_blocks=4,
+            metrics=m, model_label="t",
+        )
+        g = lambda name: m.gauge(name, model="t", replica="0")
+        r = np.random.RandomState(3)
+        first = dec.submit(r.randint(0, VOCAB, size=(20,)).astype(np.int32),
+                           max_new_tokens=14)  # 3 of 4 blocks
+        dec._admit()
+        assert g("kv_blocks_pressure") == pytest.approx(3 / 4)
+        # two more queue (the head needs 3 blocks, only 1 free): the
+        # gauge now carries demand, not just occupancy
+        more = [
+            dec.submit(r.randint(0, VOCAB, size=(20,)).astype(np.int32),
+                       max_new_tokens=14)
+            for _ in range(2)
+        ]
+        dec.step()  # decode window refreshes the gauges
+        assert g("kv_blocks_queued_demand") == 6.0
+        assert g("kv_blocks_pressure") == pytest.approx((3 + 6) / 4)
+        dec.run()
+        for rid in [first] + more:
+            assert dec.result(rid) is not None
+        assert g("kv_blocks_queued_demand") == 0.0
+        dec.alloc.check()
+
+
 class TestPoolRouter:
     def test_least_blocks_routing_and_result_surface(self):
         model, params = _setup()

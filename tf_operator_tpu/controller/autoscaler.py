@@ -82,7 +82,9 @@ def default_serving_policy(
     """The stock serving policy (examples + the static lint gate):
     scale on the queue-wait burn-rate alert OR blocks-free pressure —
     since the paged pool (ISSUE 8) admission is gated on KV blocks
-    free, ``kv_blocks_pressure`` (in-use/usable, worst replica wins)
+    free, ``kv_blocks_pressure`` ((in-use + queued block demand) /
+    usable since ISSUE 10 — refreshed per decode window so a burst
+    RAMPS it, and it exceeds 1.0 under backlog; worst replica wins)
     is REAL memory headroom, the thing a serving replica actually runs
     out of; queue depth was only its shadow.  Scale-up triggers at
     0.85 (before the 0.9 alert pages) and the hysteresis latch

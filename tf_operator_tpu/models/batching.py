@@ -91,9 +91,12 @@ from tf_operator_tpu.models.decode import (
     gather_block_view,
     max_window_chunk,
     paged_arena,
+    paged_cache_tree,
+    paged_decode_variant,
     scatter_block_stack,
     scatter_block_view,
     set_cache_index,
+    split_paged_cache,
     top_k_mask,
     window_chunks,
 )
@@ -132,6 +135,33 @@ def _admission_sample(last, temp, top_k, rng):
     scaled = jnp.where((top_k > 0) & (scaled < kth), -jnp.inf, scaled)
     samp = jax.random.categorical(r, scaled).astype(jnp.int32)
     return jnp.where(temp > 0.0, samp, greedy), rng_next
+
+
+def _step_sample(logits, temps, top_ks, rngs):
+    """Per-slot next-token sampling for one decode step: [S, V] logits
+    -> (next_tokens [S], next_keys [S, 2]).  ONE definition shared by
+    the contiguous/emulation scan body (_make_step_body) and the fused
+    paged step program — identical math is the paged token-identity
+    contract.  Greedy when temps[s] == 0; per-slot top_k thresholds
+    within one STATIC top-TOP_K_MAX (compile stays shape-stable)."""
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    split = jax.vmap(jax.random.split)(rngs)
+    safe_t = jnp.where(temps > 0.0, temps, 1.0)
+    scaled = logits / safe_t[:, None]
+    k_max = min(TOP_K_MAX, scaled.shape[-1])
+    top_vals = lax.top_k(scaled, k_max)[0]  # [slots, k_max]
+    idx = jnp.clip(top_ks - 1, 0, k_max - 1)[:, None]
+    kth = jnp.take_along_axis(top_vals, idx, axis=1)
+    scaled = jnp.where(
+        (top_ks[:, None] > 0) & (scaled < kth),
+        -jnp.inf,
+        scaled,
+    )
+    sampled = jax.vmap(
+        lambda r, l: jax.random.categorical(r, l)
+    )(split[:, 0], scaled).astype(jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy), split[:, 1]
 
 
 class _Request:
@@ -454,27 +484,8 @@ class ContinuousBatchingDecoder:
             stk, logits = jax.vmap(
                 one_slot, in_axes=(None, 0, 0)
             )(materialize(params), stack, toks)
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            split = jax.vmap(jax.random.split)(rngs)
-            safe_t = jnp.where(temps > 0.0, temps, 1.0)
-            scaled = logits / safe_t[:, None]
-            # per-slot top_k with one STATIC top-k (compile
-            # stays shape-stable): threshold at each slot's own
-            # k within the top TOP_K_MAX candidates; 0 = off
-            k_max = min(TOP_K_MAX, scaled.shape[-1])
-            top_vals = lax.top_k(scaled, k_max)[0]  # [slots,k_max]
-            idx = jnp.clip(top_ks - 1, 0, k_max - 1)[:, None]
-            kth = jnp.take_along_axis(top_vals, idx, axis=1)
-            scaled = jnp.where(
-                (top_ks[:, None] > 0) & (scaled < kth),
-                -jnp.inf,
-                scaled,
-            )
-            sampled = jax.vmap(
-                lambda r, l: jax.random.categorical(r, l)
-            )(split[:, 0], scaled).astype(jnp.int32)
-            nxt = jnp.where(temps > 0.0, sampled, greedy)
-            return (stk, nxt, split[:, 1]), nxt
+            nxt, rngs_next = _step_sample(logits, temps, top_ks, rngs)
+            return (stk, nxt, rngs_next), nxt
 
         return body
 
@@ -851,11 +862,27 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
 
     Reservation is FULL at admission (prompt + budget): no mid-decode
     block exhaustion, no preemption machinery — the no-surprise
-    contract.  The step and admission programs gather a seat's blocks
-    into the exact contiguous view the unchanged attention math
-    expects and scatter back only the newly written blocks (see
-    decode.py — identity re-layout, so paged decode is token-identical
-    to the contiguous pool, test-pinned).
+    contract.  The admission program gathers a seat's blocks into the
+    exact contiguous view the unchanged attention math expects and
+    scatters back only the newly written blocks (see decode.py —
+    identity re-layout, so paged decode is token-identical to the
+    contiguous pool, test-pinned).
+
+    Steady-state decode (ISSUE 10): the step program runs over
+    DEVICE-RESIDENT state only — block tables, per-seat lengths,
+    sampling params and rng keys are written once at admission (in the
+    fused admission program), advanced in-graph per window, and reset
+    by one batched ``retire`` dispatch when seats finish — zero
+    per-step uploads and zero host gathers beyond the sanctioned token
+    fetch inside the ledger's dispatch window.  With ``paged_kernel``
+    resolved to a kernel impl ("auto" on the TPU backend, "on" to
+    force, "interpret" for CI), the scan body is the PAGED decode
+    branch: each step appends the new token's K/V in place to its
+    seat's block and attends straight off the arena through the
+    ops/paged_attention Pallas kernel — the gather → scan →
+    scatter-back emulation (and its ~2x KV traffic) exists only as
+    the CPU/"off" fallback, and an explicit "on"/"interpret" FAILS
+    where the kernel cannot serve rather than silently downgrading.
 
     Prefix cache: completed prompt blocks are published under rolling
     token-hash chain keys (models/prefix_cache.py); a new request maps
@@ -886,38 +913,110 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                  ledger: Optional[DispatchLedger] = None,
                  metrics=None, model_label: str = "",
                  replica_label: str = "",
-                 prefix_cache_entries: Optional[int] = None):
+                 prefix_cache_entries: Optional[int] = None,
+                 paged_kernel: str = "auto"):
         super().__init__(
             model, params, slots=slots, steps_per_sync=steps_per_sync,
             ledger=ledger, metrics=metrics, model_label=model_label,
             replica_label=replica_label,
         )
-        if self._max_chunk is not None:
-            raise NotPageableError(
-                "rolling-window caches are not pageable (wrap state "
-                "aliases positions); use ContinuousBatchingDecoder"
-            )
-        bs = int(kv_block_size)
-        if bs < 1 or self.max_len % bs:
+        # -- paged_kernel mode validation FIRST (ISSUE 10 honesty): a
+        # typo'd mode must fail even for models whose pageability
+        # checks below raise NotPageableError — serve_lm's model-shape
+        # fallback would otherwise swallow the config error.
+        mode = str(paged_kernel or "auto").lower()
+        if mode not in ("auto", "on", "off", "interpret"):
             raise ValueError(
-                f"kv_block_size={bs} must divide max_len={self.max_len}"
+                f"paged_kernel must be auto|on|off|interpret, got "
+                f"{paged_kernel!r}"
             )
-        self.block_size = bs
-        self.max_blocks = self.max_len // bs
-        if kv_blocks is None:
-            # default arena = the HBM the contiguous pool would pin
-            # (slots × max_len): same budget, block-granular admission
-            kv_blocks = self.slots * self.max_blocks
-        #: arena rows = usable blocks + the scratch block (id 0)
-        self.num_blocks = int(kv_blocks) + 1
-        self.alloc = BlockAllocator(self.num_blocks, bs)
-        self._arena = paged_arena(self.dmodel, self.num_blocks, bs)
-        #: per-seat block tables + lengths live HOST-side (tiny int32
-        #: arrays passed per dispatch); the device holds only the arena
-        self._tables = np.full(
-            (self.slots, self.max_blocks), SCRATCH_BLOCK, np.int32
+        self.paged_kernel_mode = mode
+        try:
+            if self._max_chunk is not None:
+                raise NotPageableError(
+                    "rolling-window caches are not pageable (wrap state "
+                    "aliases positions); use ContinuousBatchingDecoder"
+                )
+            bs = int(kv_block_size)
+            if bs < 1 or self.max_len % bs:
+                raise ValueError(
+                    f"kv_block_size={bs} must divide max_len={self.max_len}"
+                )
+            self.block_size = bs
+            self.max_blocks = self.max_len // bs
+            if kv_blocks is None:
+                # default arena = the HBM the contiguous pool would pin
+                # (slots × max_len): same budget, block-granular
+                # admission
+                kv_blocks = self.slots * self.max_blocks
+            #: arena rows = usable blocks + the scratch block (id 0)
+            self.num_blocks = int(kv_blocks) + 1
+            self.alloc = BlockAllocator(self.num_blocks, bs)
+            self._arena = paged_arena(self.dmodel, self.num_blocks, bs)
+        except NotPageableError as exc:
+            if mode in ("on", "interpret"):
+                # an EXPLICIT kernel request on a model that cannot
+                # page at all is a config error, not a model-shape
+                # fallback — fail instead of letting serve_lm quietly
+                # serve the contiguous pool with no kernel
+                raise ValueError(
+                    f"paged_kernel={mode!r} refused: {exc} — failing "
+                    "instead of silently downgrading to the contiguous "
+                    "pool"
+                ) from exc
+            raise
+        # -- fused Pallas decode (ISSUE 10): paged_kernel selects the
+        # steady-state step program.  "auto" fuses on the TPU backend
+        # and falls back to the gather emulation elsewhere; an explicit
+        # "on" FAILS when the kernel cannot serve here (the
+        # NotPageableError-style honesty rule: never silently
+        # downgrade what the operator asked for); "interpret" runs the
+        # real kernel through the Pallas interpreter (the CI path);
+        # "off" pins the emulation.
+        from tf_operator_tpu.ops.paged_attention import (
+            paged_kernel_available,
         )
-        self._lengths = np.zeros((self.slots,), np.int32)
+
+        head_dim = self.dmodel.cfg.head_dim
+        self._kernel_impl: Optional[str] = None
+        if mode != "off":
+            ok, why = paged_kernel_available(
+                head_dim, bs, interpret=(mode == "interpret")
+            )
+            if mode == "auto":
+                self._kernel_impl = "pallas" if ok else None
+            elif not ok:
+                raise ValueError(
+                    f"paged_kernel={mode!r} refused: {why} — failing "
+                    "instead of silently serving the gather emulation"
+                )
+            else:
+                self._kernel_impl = (
+                    "pallas-interpret" if mode == "interpret" else "pallas"
+                )
+        self._pmodel = (
+            paged_decode_variant(model, self._kernel_impl)
+            if self._kernel_impl is not None
+            else None
+        )
+        # per-seat block tables + lengths are DEVICE-RESIDENT (ISSUE
+        # 10 satellite): written in-graph by the fused admission
+        # program, advanced in-graph by the step program, reset by the
+        # retire program — zero per-step table uploads and no host
+        # mirror to drift out of sync.
+        self._tables_dev = jnp.full(
+            (self.slots, self.max_blocks), SCRATCH_BLOCK, jnp.int32
+        )
+        self._lengths_dev = jnp.zeros((self.slots,), jnp.int32)
+        #: per-seat sampling state, device-resident for the same
+        #: reason: temps/top_ks are static per request (written at
+        #: admission), rng keys advance in-graph (the per-window
+        #: split that the contiguous pool does host-side happens
+        #: inside the step program — same split chain, zero uploads)
+        self._temps_dev = jnp.zeros((self.slots,), jnp.float32)
+        self._topks_dev = jnp.zeros((self.slots,), jnp.int32)
+        self._rngs_dev = jnp.zeros((self.slots, 2), jnp.uint32)
+        self._retire_fn = None
         self._seat_refs: Dict[int, List[int]] = {}
         #: step write-back window: K new positions straddle at most
         #: this many blocks (start block + full span + boundary)
@@ -939,17 +1038,28 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
     # -- accounting --------------------------------------------------------
 
     def _update_kv_gauges(self) -> None:
-        """kv_blocks_{free,total,in_use} + kv_blocks_pressure gauges,
-        labeled {model, replica} — the blocks-free pressure signal the
-        stock serving autoscaling policy and the kv-blocks-pressure
-        alert rule bind (tests/test_autoscaling_lint.py pins the
-        names+keys against these literal call sites)."""
+        """kv_blocks_{free,total,in_use,queued_demand} +
+        kv_blocks_pressure gauges, labeled {model, replica} — the
+        blocks-free pressure signal the stock serving autoscaling
+        policy and the kv-blocks-pressure alert rule bind
+        (tests/test_autoscaling_lint.py pins the names+keys against
+        these literal call sites).
+
+        ISSUE 10: pressure includes the block DEMAND already queued,
+        i.e. (in_use + queued_need) / usable, and is refreshed every
+        decode window — a traffic burst ramps the signal request by
+        request as the queue builds (it can exceed 1.0 under backlog),
+        instead of step-functioning only when admission/release land.
+        The PR-7 autoscaler and the 0.9 alert rule therefore see the
+        ramp mid-burst, while an idle pool with cold cache entries
+        still reads plain occupancy."""
 
         if self.metrics is None:
             return
         rep = self.replica_label or "0"
         free = float(self.alloc.free_count)
         total = float(self.alloc.usable)
+        queued = float(self._queued_blocks())
         self.metrics.set(
             "kv_blocks_free", free, model=self.model_label, replica=rep
         )
@@ -961,7 +1071,11 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
             model=self.model_label, replica=rep,
         )
         self.metrics.set(
-            "kv_blocks_pressure", (total - free) / total,
+            "kv_blocks_queued_demand", queued,
+            model=self.model_label, replica=rep,
+        )
+        self.metrics.set(
+            "kv_blocks_pressure", (total - free + queued) / total,
             model=self.model_label, replica=rep,
         )
 
@@ -972,6 +1086,18 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
     def blocks_in_use(self) -> int:
         return self.alloc.in_use
 
+    def _queued_blocks(self) -> int:
+        """Block demand of queued-but-unadmitted requests — ONE
+        definition feeding both the kv_blocks_pressure gauge (the
+        autoscaler/alert signal) and the router's load_score, so the
+        two can never silently diverge.  Caller holds the pool lock
+        (both call sites do)."""
+
+        return sum(
+            blocks_for(r.prompt.size + r.budget, self.block_size)
+            for r in self._queue
+        )
+
     def load_score(self) -> float:
         """Least-BLOCKS-in-use routing signal: live arena occupancy
         plus the block demand already queued, normalized by arena size
@@ -979,10 +1105,7 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
         not just the shortest queue."""
 
         with self._lock:
-            queued = sum(
-                blocks_for(r.prompt.size + r.budget, self.block_size)
-                for r in self._queue
-            )
+            queued = self._queued_blocks()
         return (self.alloc.in_use + queued) / max(1, self.alloc.usable)
 
     # -- admission ---------------------------------------------------------
@@ -1101,10 +1224,13 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
     def _admit_paged(self, req: _Request, slot: int, plan) -> None:
         """One fused dispatch: gather the shared prefix view, prefill
         the padded remainder at offset L, rollback pad rows, sample
-        the first token, scatter the new blocks into the arena.
-        Caller holds the pool lock (the program rewrites the shared
-        arena, so it serializes with step() like the contiguous fused
-        admission)."""
+        the first token, scatter the new blocks into the arena — and
+        write the seat's DEVICE-RESIDENT table row, length, sampling
+        params and rng key in the same program (the once-per-request
+        table delta; steady-state steps then reuse the on-device
+        state, ISSUE 10 satellite).  Caller holds the pool lock (the
+        program rewrites the shared arena, so it serializes with
+        step() like the contiguous fused admission)."""
 
         bs = self.block_size
         p_len = req.prompt.size
@@ -1127,8 +1253,11 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
         with self.ledger.dispatch(
             "admission", rid=req.rid, width=width, prefix_tokens=prefix_len,
         ):
-            arena, toks, tok, rng_next = self._admission(width)(
+            (arena, toks, tables_dev, lengths_dev, temps_dev, topks_dev,
+             rngs_dev, tok, rng_next) = self._admission(width)(
                 self.params, self._arena, self._last_tok,
+                self._tables_dev, self._lengths_dev, self._temps_dev,
+                self._topks_dev, self._rngs_dev,
                 jnp.asarray(row_pad), jnp.asarray(ids),
                 jnp.int32(prefix_len), jnp.int32(remainder),
                 jnp.int32(slot), jnp.float32(req.temperature),
@@ -1136,6 +1265,9 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
             )
             tok_h = int(tok)  # host fetch: the ledger RTT includes it
         self._arena, self._last_tok = arena, toks
+        self._tables_dev, self._lengths_dev = tables_dev, lengths_dev
+        self._temps_dev, self._topks_dev = temps_dev, topks_dev
+        self._rngs_dev = rngs_dev
         if sampled:
             req.rng = rng_next
         req.tokens.append(tok_h)
@@ -1154,15 +1286,18 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
         if len(req.tokens) >= req.budget:
             # budget-1: the admission token completed it — blocks go
             # straight back (published ones live on via the cache ref)
+            # and the seat's freshly written device row must be
+            # retired NOW: the freed blocks can re-allocate to another
+            # seat, and a stale table row would let this never-seated
+            # slot's step writes corrupt the new owner
             req.done = True
             self.alloc.release(refs)
+            self._retire_device_locked([slot])
             self._observe_done(req)
             self._done_cond.notify_all()
         else:
             req.slot = slot
             self._active[slot] = req
-            self._tables[slot] = plan["row"]
-            self._lengths[slot] = p_len
             self._seat_refs[slot] = refs
 
     def _admission(self, width: int):
@@ -1174,8 +1309,9 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                 mb = self.max_blocks
                 nbw = blocks_for(width, bs)  # ceil: cover straddle
 
-                def admit(params, arena, toks, row_pad, ids, L, n, slot,
-                          temp, top_k, rng):
+                def admit(params, arena, toks, tables_dev, lengths_dev,
+                          temps_dev, topks_dev, rngs_dev, row_pad, ids,
+                          L, n, slot, temp, top_k, rng):
                     view = gather_block_view(arena, row_pad[:mb], L, bs)
                     logits, vars_ = dmodel.apply(
                         {"params": materialize(params), "cache": view},
@@ -1193,35 +1329,136 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                     arena = scatter_block_view(
                         arena, cache2, row_pad, L // bs, nbw, bs
                     )
-                    return arena, toks.at[slot].set(tok), tok, rng_next
+                    # the once-per-request device-state delta: table
+                    # row + length + sampling params + rng key — the
+                    # step program reuses these without any upload
+                    tables_dev = tables_dev.at[slot].set(row_pad[:mb])
+                    lengths_dev = lengths_dev.at[slot].set(L + n)
+                    temps_dev = temps_dev.at[slot].set(temp)
+                    topks_dev = topks_dev.at[slot].set(top_k)
+                    rngs_dev = rngs_dev.at[slot].set(rng_next)
+                    return (arena, toks.at[slot].set(tok), tables_dev,
+                            lengths_dev, temps_dev, topks_dev, rngs_dev,
+                            tok, rng_next)
 
                 self._admit_fns[width] = jax.jit(admit)
                 self.compile_count += 1
             return self._admit_fns[width]
 
+    def _retire(self):
+        """One compiled reset of retired seats' device state: table
+        rows back to scratch, lengths/temps/top_ks to zero.  Required
+        for correctness, not hygiene — a retired seat's freed blocks
+        can re-allocate immediately, and the (still computing) dead
+        seat's in-place step appends would corrupt the new owner if
+        its device table row survived retirement."""
+
+        with self._compile_lock:
+            if self._retire_fn is None:
+
+                def retire(tables, lengths, temps, topks, mask):
+                    tables = jnp.where(
+                        mask[:, None], jnp.int32(SCRATCH_BLOCK), tables
+                    )
+                    lengths = jnp.where(mask, 0, lengths)
+                    temps = jnp.where(mask, 0.0, temps)
+                    topks = jnp.where(mask, 0, topks)
+                    return tables, lengths, temps, topks
+
+                self._retire_fn = jax.jit(retire)
+                self.compile_count += 1
+            return self._retire_fn
+
+    def _retire_device_locked(self, slots) -> None:
+        """Reset the device-resident rows of ``slots`` (one dispatch
+        for the whole batch, ledger phase ``retire`` — admission-class
+        work, never on the steady-state step path)."""
+
+        mask = np.zeros((self.slots,), bool)
+        mask[list(slots)] = True
+        with self.ledger.dispatch("retire", slots=len(slots)):
+            (self._tables_dev, self._lengths_dev, self._temps_dev,
+             self._topks_dev) = self._retire()(
+                self._tables_dev, self._lengths_dev, self._temps_dev,
+                self._topks_dev, mask,
+            )
+
     # -- decode step -------------------------------------------------------
 
     def _step(self):
+        """The steady-state decode window as ONE compiled program over
+        device-resident state ONLY (params, arena, tables, lengths,
+        sampling params, rng keys, last tokens) — zero per-step
+        uploads on either path.  The per-window rng split the
+        contiguous pool performs host-side happens in-graph here (same
+        split chain, token-identical).
+
+        Fused path (``paged_kernel`` resolved a kernel impl): the
+        K-step scan runs the PAGED decode branch (transformer.py) —
+        each step appends the new K/V in place to its seat's block and
+        attends straight off the arena through
+        ops/paged_attention; no contiguous view, no scatter-back.
+
+        Emulation path: PR 8's gather → the shared
+        ``_make_step_body`` scan → window scatter-back, with the
+        table pad built and the lengths advanced in-graph."""
+
         if self._step_fn is None:
             n_inner = self.steps_per_sync
-            make_body = self._make_step_body
             bs = self.block_size
-            mb = self.max_blocks
             nbw = self._step_nbw
+            n_slots = self.slots
+            if self._kernel_impl is not None:
+                pmodel = self._pmodel
+                materialize = self._materialize
 
-            def step(params, arena, toks, tables_pad, lengths, temps,
-                     top_ks, rngs):
-                stack = gather_block_stack(
-                    arena, tables_pad[:, :mb], lengths, bs
-                )
-                body = make_body(params, temps, top_ks)
-                (stack, toks, _), toks_k = lax.scan(
-                    body, (stack, toks, rngs), None, length=n_inner
-                )
-                arena = scatter_block_stack(
-                    arena, stack, tables_pad, lengths // bs, nbw, bs
-                )
-                return arena, toks, toks_k
+                def step(params, arena, tables, lengths, temps, top_ks,
+                         rngs, toks):
+                    split = jax.vmap(jax.random.split)(rngs)
+                    rngs_next, keys = split[:, 0], split[:, 1]
+                    cache0 = paged_cache_tree(arena, tables, lengths)
+
+                    def body(carry, _):
+                        cache, tok, ks = carry
+                        logits, vars_ = pmodel.apply(
+                            {"params": materialize(params), "cache": cache},
+                            tok[:, None],
+                            mutable=["cache"],
+                        )
+                        nxt, ks2 = _step_sample(
+                            logits[:, 0], temps, top_ks, ks
+                        )
+                        return (vars_["cache"], nxt, ks2), nxt
+
+                    (cache, toks, _), toks_k = lax.scan(
+                        body, (cache0, toks, keys), None, length=n_inner
+                    )
+                    arena2, lengths2 = split_paged_cache(cache)
+                    return arena2, lengths2, rngs_next, toks, toks_k
+            else:
+                make_body = self._make_step_body
+
+                def step(params, arena, tables, lengths, temps, top_ks,
+                         rngs, toks):
+                    split = jax.vmap(jax.random.split)(rngs)
+                    rngs_next, keys = split[:, 0], split[:, 1]
+                    tables_pad = jnp.concatenate(
+                        [
+                            tables,
+                            jnp.full((n_slots, nbw), SCRATCH_BLOCK,
+                                     jnp.int32),
+                        ],
+                        axis=1,
+                    )
+                    stack = gather_block_stack(arena, tables, lengths, bs)
+                    body = make_body(params, temps, top_ks)
+                    (stack, toks, _), toks_k = lax.scan(
+                        body, (stack, toks, keys), None, length=n_inner
+                    )
+                    arena2 = scatter_block_stack(
+                        arena, stack, tables_pad, lengths // bs, nbw, bs
+                    )
+                    return arena2, lengths + n_inner, rngs_next, toks, toks_k
 
             self._step_fn = jax.jit(step)
             self.compile_count += 1
@@ -1231,52 +1468,42 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
         refs = self._seat_refs.pop(slot, [])
         if refs:
             self.alloc.release(refs)
-        self._tables[slot] = SCRATCH_BLOCK
-        self._lengths[slot] = 0
 
     def step(self) -> int:
         """Admit (block-gated), run `steps_per_sync` decode steps over
-        the arena through the block tables (one XLA program, one host
-        round trip), retire finished requests and free their blocks."""
+        the arena through the DEVICE-RESIDENT block tables (one XLA
+        program, one host round trip, zero uploads — the only
+        device→host traffic is the sanctioned token fetch inside the
+        ledger's dispatch window), retire finished requests and free
+        their blocks (one batched ``retire`` dispatch when any seat
+        finished)."""
 
         self._admit()
         with self._lock:
             if not self._active:
+                # per-window gauge refresh even while only queueing:
+                # a burst the arena cannot admit must still ramp
+                # kv_blocks_pressure (host arithmetic, no device work)
+                self._update_gauges_locked()
                 return 0
-            temps = np.zeros((self.slots,), np.float32)
-            top_ks = np.zeros((self.slots,), np.int32)
-            rngs = np.zeros((self.slots, 2), np.uint32)
-            for slot, req in self._active.items():
-                temps[slot] = req.temperature
-                top_ks[slot] = req.top_k or 0
-                if req.temperature > 0.0:
-                    req.rng, r = jax.random.split(req.rng)
-                    rngs[slot] = np.asarray(r)
-            tables_pad = np.concatenate(
-                [
-                    self._tables,
-                    np.full((self.slots, self._step_nbw), SCRATCH_BLOCK,
-                            np.int32),
-                ],
-                axis=1,
-            )
             with self.ledger.dispatch("step", active=len(self._active)):
-                arena, toks, toks_k = self._step()(
-                    self.params, self._arena, self._last_tok,
-                    jnp.asarray(tables_pad), jnp.asarray(self._lengths),
-                    jnp.asarray(temps), jnp.asarray(top_ks),
-                    jnp.asarray(rngs),
+                (arena, lengths_dev, rngs_dev, toks, toks_k) = self._step()(
+                    self.params, self._arena, self._tables_dev,
+                    self._lengths_dev, self._temps_dev, self._topks_dev,
+                    self._rngs_dev, self._last_tok,
                 )
                 host_toks = np.asarray(toks_k)  # [K, slots]
             self._arena, self._last_tok = arena, toks
-            finished = False
+            self._lengths_dev, self._rngs_dev = lengths_dev, rngs_dev
+            finished = []
             for slot in list(self._active):
                 req = self._active[slot]
                 # the cache now holds K more positions for this seat
-                # (overshoot past the budget landed in scratch via the
-                # padded table; the reserved tail blocks absorb the
+                # (the step program advanced the device-resident
+                # lengths in-graph; overshoot past the budget landed
+                # in scratch via the padded table / scratch-routed
+                # append — the reserved tail blocks absorb the
                 # in-budget span)
-                self._lengths[slot] += len(host_toks)
                 take = min(len(host_toks), req.budget - len(req.tokens))
                 req.tokens.extend(int(t) for t in host_toks[:take, slot])
                 if len(req.tokens) >= req.budget:
@@ -1285,7 +1512,12 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                     del self._active[slot]
                     self._retire_seat_locked(slot)
                     self._observe_done(req)
-                    finished = True
+                    finished.append(slot)
+            if finished:
+                # freed blocks may re-allocate immediately: the dead
+                # seats' device table rows must go back to scratch
+                # before the next step's in-place appends
+                self._retire_device_locked(finished)
             self._update_gauges_locked()
             if finished:
                 self._done_cond.notify_all()

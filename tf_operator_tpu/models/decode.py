@@ -144,16 +144,18 @@ def _leaf_name(path) -> str:
 # ---------------------------------------------------------------------------
 # Paged KV arena (ISSUE 8): the serving cache as fixed-size token
 # blocks over ONE pre-allocated device tensor per layer, addressed
-# through per-seat block tables.  The compiled programs below GATHER a
-# seat's blocks into the exact contiguous [1, Hkv, max_len, D] view the
-# flax decode branch expects, run the unchanged attention math, and
-# SCATTER only the newly written blocks back — a memcpy round trip, so
-# paged decode is token-identical to the contiguous path by
-# construction (test-pinned, tests/test_paged_pool.py).  On this box
-# the gather/scatter lowers to XLA take/scatter (the fused Pallas
-# paged-attention kernel that skips the materialized view is the
-# chip-window follow-up); the PERSISTENT HBM story — what admission is
-# gated on — is the arena, which is the whole point.
+# through per-seat block tables.  The gather/scatter helpers below
+# build the exact contiguous [1, Hkv, max_len, D] view the flax decode
+# branch expects and write back only the touched blocks — a memcpy
+# round trip, so paged decode through them is token-identical to the
+# contiguous path by construction (test-pinned,
+# tests/test_paged_pool.py).  They serve the fused ADMISSION program
+# and the CPU/"off" step fallback; since ISSUE 10 the steady-state
+# step on the kernel path skips the materialized view entirely
+# (transformer.py's paged decode branch + ops/paged_attention, wired
+# through paged_decode_variant/paged_cache_tree/split_paged_cache
+# below, with tables/lengths device-resident).  The PERSISTENT HBM
+# story — what admission is gated on — is the arena either way.
 #
 # Block id 0 is scratch (models/kv_blocks.SCRATCH_BLOCK): unused table
 # entries point at it, overshoot/pad writes land in it, and every read
@@ -165,7 +167,9 @@ def paged_arena(dmodel, num_blocks: int, block_size: int):
     """Zeroed arena tree for ``dmodel``'s cache: every cached_key /
     cached_value leaf ``[1, H, max_len, D]`` becomes
     ``[num_blocks, H, block_size, D]``; cache_index leaves stay as
-    placeholder scalars (per-seat lengths live host-side).  Raises for
+    placeholder scalars (per-seat lengths are injected per program —
+    paged_cache_tree for the fused step, the gather helpers'
+    ``length``/``lengths`` args elsewhere).  Raises for
     rolling-window caches (their wrap state is position-aliased — not
     pageable) and for cache layouts this pager does not understand."""
 
@@ -204,6 +208,76 @@ def paged_arena(dmodel, num_blocks: int, block_size: int):
         raise NotPageableError(f"unknown cache leaf {name!r}")
 
     return jax.tree_util.tree_map_with_path(f, template)
+
+
+def paged_decode_variant(model, impl: str):
+    """The decode variant with the PAGED attention branch enabled
+    (ISSUE 10): same parameters, but self-attention reads/writes the
+    block arena through per-seat tables instead of a contiguous cache.
+    ``impl`` is an ops/paged_attention impl name ("xla" / "pallas" /
+    "pallas-interpret").  Only plain-TransformerConfig decoder families
+    are pageable (MoeLM's nested config carries extra cache state —
+    pos_index — that paged_arena already refuses)."""
+
+    from tf_operator_tpu.models.kv_blocks import NotPageableError
+
+    dmodel = _decode_variant(model)
+    cfg = dmodel.cfg
+    if not isinstance(cfg, TransformerConfig):
+        raise NotPageableError(
+            f"{type(model).__name__} is not pageable (non-Transformer"
+            "Config cache state)"
+        )
+    return type(dmodel)(dataclasses.replace(cfg, paged=impl))
+
+
+def paged_cache_tree(arena, tables, lengths):
+    """Inject the per-seat ``block_tables`` [S, MB] and vector
+    ``cache_index`` (= lengths [S]) into every attention layer's arena
+    dict — the cache collection the paged decode branch
+    (transformer.py) consumes.  Pure tree surgery on traced values; it
+    runs INSIDE the compiled step program, so tables/lengths stay
+    device-resident across the whole decode window."""
+
+    def walk(d):
+        if "cached_key" in d:
+            out = dict(d)
+            out["cache_index"] = lengths
+            out["block_tables"] = tables
+            return out
+        return {
+            k: (walk(v) if isinstance(v, dict) else v) for k, v in d.items()
+        }
+
+    return walk(arena)
+
+
+def split_paged_cache(tree):
+    """Inverse of :func:`paged_cache_tree` after an apply/scan: returns
+    ``(arena, lengths)`` — the arena tree restored to its scalar
+    ``cache_index`` placeholders (so the gather/scatter admission
+    programs keep consuming it unchanged) and the advanced per-seat
+    lengths (every layer advances identically; the first is taken)."""
+
+    found = []
+
+    def walk(d):
+        if "cached_key" in d:
+            out = dict(d)
+            found.append(out.pop("block_tables"))
+            lengths = out["cache_index"]
+            if len(found) == 1:
+                found.append(lengths)
+            out["cache_index"] = jnp.zeros((), lengths.dtype)
+            return out
+        return {
+            k: (walk(v) if isinstance(v, dict) else v) for k, v in d.items()
+        }
+
+    arena = walk(tree)
+    if len(found) < 2:
+        raise ValueError("no attention cache leaves in the paged tree")
+    return arena, found[1]
 
 
 def gather_block_view(arena, table, length, block_size: int):

@@ -80,12 +80,35 @@ class TransformerConfig:
     # documented no-op, not a silent downgrade: raising here would
     # break generation for every sp-trained model.
     decode: bool = False
+    # paged decode (ISSUE 10): self-attention reads K/V straight from
+    # the block arena through per-seat block tables instead of a
+    # per-seat contiguous cache.  None = contiguous decode; otherwise
+    # the ops/paged_attention impl name ("xla" reference / "pallas"
+    # kernel / "pallas-interpret" for CI).  The cache collection is
+    # built EXTERNALLY (models/decode.paged_arena + the pool's table
+    # injection); requires decode=True, batch = seats, s_new = 1.
+    paged: Optional[str] = None
 
     def __post_init__(self):
         if self.sp_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"sp_impl must be 'ring' or 'ulysses', got {self.sp_impl!r}"
             )
+        if self.paged is not None:
+            from tf_operator_tpu.ops.paged_attention import PAGED_IMPLS
+
+            if self.paged not in PAGED_IMPLS:
+                raise ValueError(
+                    f"paged must be None or one of {PAGED_IMPLS}, "
+                    f"got {self.paged!r}"
+                )
+            if not self.decode:
+                raise ValueError("paged attention requires decode=True")
+            if self.window is not None and self.window < self.max_len:
+                raise ValueError(
+                    "rolling-window caches are not pageable (wrap state "
+                    "aliases positions)"
+                )
         if self.n_kv_heads is not None and self.n_heads % self.n_kv_heads:
             raise ValueError(
                 f"n_heads ({self.n_heads}) must be a multiple of "
@@ -256,6 +279,84 @@ class MultiHeadAttention(nn.Module):
         v = dense((hkv, d), cfg, ("embed", "heads", "kv"), name="value", use_bias=bias_p)(kv_in)
         # [B,S,H,D] -> [B,H,S,D]; heads over tp, seq over sp
         q, k, v = (jnp.transpose(a, (0, 2, 1, 3)) for a in (q, k, v))
+
+        if cfg.decode and is_self and cfg.paged is not None:
+            # PAGED decode (ISSUE 10): batch = seats, one token per
+            # seat.  K/V live in the per-layer block ARENA
+            # [NB, Hkv, bs, D] addressed through per-seat block tables;
+            # the new token's K/V is appended IN PLACE to its seat's
+            # block (no contiguous view, no scatter-back), and
+            # attention runs straight off the arena
+            # (ops/paged_attention — the Pallas kernel or its
+            # bit-exact XLA reference, per cfg.paged).  The cache
+            # collection is built externally (decode.paged_arena +
+            # decode.paged_cache_tree) — batch-1 init shapes would be
+            # wrong here, so missing leaves raise.
+            from tf_operator_tpu.ops.paged_attention import paged_attention
+
+            if mask is not None or bias is not None:
+                raise ValueError(
+                    "paged decode builds its own masks; caller-supplied "
+                    "mask/bias is not supported"
+                )
+            seats, _, s_new, _ = q.shape
+            if s_new != 1:
+                raise ValueError(
+                    f"paged decode is single-token (s_new == 1, got "
+                    f"{s_new}); prefill runs through the gathered-view "
+                    "admission path (models/batching.py)"
+                )
+
+            def _missing(name):
+                def init(*a):
+                    raise ValueError(
+                        f"paged decode cache leaf {name!r} missing — the "
+                        "cache collection must be built via models/"
+                        "decode.paged_arena + paged_cache_tree, not init()"
+                    )
+                return init
+
+            arena_k = self.variable("cache", "cached_key", _missing("cached_key"))
+            arena_v = self.variable("cache", "cached_value", _missing("cached_value"))
+            idx_var = self.variable("cache", "cache_index", _missing("cache_index"))
+            tbl_var = self.variable("cache", "block_tables", _missing("block_tables"))
+            lengths = idx_var.value  # [S] tokens already cached per seat
+            tables = tbl_var.value  # [S, MB] int32
+            bs = arena_k.value.shape[2]
+            mb = tables.shape[1]
+            pos = lengths  # each seat's new token position
+            if cfg.rope:
+                # per-seat absolute positions ([S,1,1] broadcasts over
+                # heads and the single query row) — same rotation the
+                # contiguous branch applies per slot
+                q, k = apply_rope(
+                    q, k, positions=pos[:, None, None], theta=cfg.rope_theta
+                )
+            # in-place append: seat s writes its K/V row into physical
+            # block tables[s, pos//bs] at offset pos%bs.  Seats own
+            # their tail blocks exclusively (admission reserves
+            # prompt+budget; shared prefix blocks are all strictly
+            # before the first write position), so only SCRATCH ids can
+            # collide across seats — and drifted/overshot positions
+            # (retired seats between windows, post-budget steps) are
+            # routed to scratch explicitly, whose content is never
+            # observable (length-masked).
+            li = jnp.clip(pos // bs, 0, mb - 1)
+            bids = jnp.take_along_axis(tables, li[:, None], axis=1)[:, 0]
+            bids = jnp.where(pos < mb * bs, bids, 0)  # SCRATCH_BLOCK
+            offs = pos % bs
+            arena_k.value = arena_k.value.at[bids, :, offs, :].set(
+                k[:, :, 0, :].astype(arena_k.value.dtype)
+            )
+            arena_v.value = arena_v.value.at[bids, :, offs, :].set(
+                v[:, :, 0, :].astype(arena_v.value.dtype)
+            )
+            idx_var.value = pos + 1
+            out = paged_attention(
+                q[:, :, 0, :], arena_k.value, arena_v.value, tables,
+                pos + 1, impl=cfg.paged,
+            )  # [S, H, D]
+            return self._project_out(out[:, None, :, :], train)
 
         if cfg.decode and is_self:
             if mask is not None or bias is not None:

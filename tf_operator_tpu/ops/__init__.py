@@ -9,6 +9,7 @@ the XLA-fused reference both fall back to.
 
 from tf_operator_tpu.ops.attention import dot_product_attention
 from tf_operator_tpu.ops.flash_attention import attention, flash_attention
+from tf_operator_tpu.ops.paged_attention import paged_attention
 from tf_operator_tpu.ops.quant import materialize_tree, quantize_tree
 from tf_operator_tpu.ops.ring_attention import ring_attention
 from tf_operator_tpu.ops.ulysses_attention import ulysses_attention
@@ -18,6 +19,7 @@ __all__ = [
     "dot_product_attention",
     "flash_attention",
     "materialize_tree",
+    "paged_attention",
     "quantize_tree",
     "ring_attention",
     "ulysses_attention",

@@ -1,0 +1,300 @@
+"""Pallas paged-attention decode — single-query attention straight off
+the block arena (ISSUE 10 tentpole).
+
+The paged pool (models/batching.PagedContinuousBatchingDecoder) keeps
+every seat's KV in fixed-size token blocks over one pre-allocated
+arena, addressed by per-seat block tables.  PR 8's decode step
+EMULATED that layout: gather the seat's blocks into a contiguous
+[1, Hkv, max_len, D] view, run the unchanged attention math, scatter
+the written window back.  Correct — and roughly double the KV traffic
+of what decode actually needs, on the phase that is memory-bandwidth
+bound (BASELINE.md int8/wide decode rows).  This kernel removes the
+round trip: each grid program walks ONE seat's block table (scalar-
+prefetched, so the table drives the DMA index map), streams that
+seat's K/V blocks HBM→VMEM tile by tile, and runs an online-softmax
+accumulation against the seat's single query.  No contiguous view
+ever exists; the arena is read exactly once.
+
+Layout contract:
+
+- ``q``        [S, H, D]        one query per seat (decode s_new == 1)
+- ``k_arena``  [NB, Hkv, bs, D] the per-layer arena leaf
+- ``v_arena``  [NB, Hkv, bs, D]
+- ``tables``   [S, MB] int32    logical block -> physical arena block
+- ``lengths``  [S] int32        valid positions per seat INCLUDING the
+                                just-appended token (attend to
+                                positions 0 .. lengths[s]-1)
+- returns      [S, H, D] in v_arena.dtype
+
+Masking rules (the kernel contract, docs/ARCHITECTURE.md):
+
+- per-seat length mask: position p contributes iff p < lengths[s];
+- scratch-block-0: unused table entries point at the scratch block —
+  they sit at logical positions >= lengths[s], so the length mask IS
+  the scratch mask (one rule, not two);
+- tiles fully past the length skip their compute via @pl.when (their
+  DMA still lands — the table clamps them to scratch/reserved blocks,
+  never to another seat's live data).
+
+Tile size: ``resolve_flash_blocks`` (ops/flash_attention.py — the
+head-dim-capped VMEM-ceiling resolver) picks the kv tile class; the
+tile is then shrunk until it divides ``block_size`` so every grid step
+reads within one arena block (``_resolve_paged_tile``).  Grid:
+(seats, kv_heads, MB, block_size/tile), scalar-prefetched tables in
+the K/V index maps, fp32 online-softmax carry in VMEM scratch
+persisting across the two innermost (sequential) dims — the classic
+flash layout, re-gridded for paged decode.
+
+Impls (the ``impl`` arg — callers resolve "auto" themselves so an
+explicit request can FAIL instead of silently downgrading):
+
+- ``"xla"``              gather the table's blocks and run
+                         ops.attention.dot_product_attention — BIT-
+                         IDENTICAL to the contiguous pool's decode
+                         math (same einsum, same mask shape), the
+                         reference the kernel is property-tested
+                         against and the CPU fallback;
+- ``"pallas"``           the TPU kernel;
+- ``"pallas-interpret"`` the same kernel in interpreter mode — how the
+                         CI (JAX_PLATFORMS=cpu) exercises the real
+                         kernel path end to end.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tf_operator_tpu.ops.attention import dot_product_attention
+from tf_operator_tpu.ops.flash_attention import resolve_flash_blocks
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+#: lane width — the online-softmax carries pad to full lanes, exactly
+#: like the flash kernel's scratch
+_LANES = 128
+
+PAGED_IMPLS = ("xla", "pallas", "pallas-interpret")
+
+
+def _resolve_paged_tile(block_size: int, head_dim: int) -> int:
+    """KV positions per grid step: the resolve_flash_blocks block_k
+    class (head-dim capped at the measured VMEM ceiling), shrunk until
+    it divides ``block_size`` so a tile never straddles two arena
+    blocks (arena blocks are only contiguous within themselves)."""
+
+    _, bk = resolve_flash_blocks(
+        None, None, 1, block_size, head_dim=head_dim
+    )
+    tile = min(int(block_size), int(bk))
+    while tile > 1 and block_size % tile:
+        tile //= 2
+    return max(1, tile)
+
+
+def paged_kernel_available(
+    head_dim: int, block_size: int, *, interpret: bool = False
+) -> Tuple[bool, str]:
+    """(ok, why_not) — can the Pallas kernel serve this config HERE?
+
+    The honesty contract (ISSUE 10): ``--paged-kernel on`` callers must
+    FAIL on (False, why) rather than silently run the gather emulation.
+    ``interpret=True`` waives the backend requirement (the interpreter
+    runs the real kernel anywhere — the CI path)."""
+
+    if head_dim < 1 or block_size < 1:
+        return False, f"degenerate shape (head_dim={head_dim}, block_size={block_size})"
+    if not interpret and jax.default_backend() != "tpu":
+        return (
+            False,
+            "the paged-attention kernel needs the TPU backend (got "
+            f"{jax.default_backend()!r}); the gather emulation serves "
+            "CPU, or pass paged_kernel='interpret' for kernel-path tests",
+        )
+    return True, ""
+
+
+def _paged_attention_xla(q, k_arena, v_arena, tables, lengths):
+    """Reference: gather the table's blocks into the contiguous view
+    and run the one true attention math (ops.attention).  Bit-identical
+    to the contiguous pool's decode branch — masked positions zero out
+    exactly, so scratch/unwritten content is unobservable."""
+
+    s, mb = tables.shape
+    nb, hkv, bs, d = k_arena.shape
+
+    def view(a):
+        g = jnp.take(a, tables, axis=0)  # [S, MB, Hkv, bs, D]
+        g = jnp.transpose(g, (0, 2, 1, 3, 4))
+        return g.reshape(s, hkv, mb * bs, d)
+
+    mask = (jnp.arange(mb * bs)[None, :] < lengths[:, None])[
+        :, None, None, :
+    ]  # [S, 1, 1, MB*bs]
+    out = dot_product_attention(
+        q[:, :, None, :], view(k_arena), view(v_arena), mask=mask
+    )
+    return out[:, :, 0, :]
+
+
+def _paged_attn_kernel(
+    tables_ref,  # scalar-prefetch [S, MB]
+    lengths_ref,  # scalar-prefetch [S]
+    q_ref,  # [1, G, D]
+    k_ref,  # [1, 1, tile, D]
+    v_ref,
+    o_ref,  # [1, G, D]
+    m_ref,  # VMEM [G, LANES] fp32
+    l_ref,
+    acc_ref,  # VMEM [G, D] fp32
+    *,
+    block_size: int,
+    tile: int,
+    scale: float,
+):
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+    c = pl.program_id(3)
+    nj = pl.num_programs(2)
+    nc = pl.num_programs(3)
+    length = lengths_ref[s]
+    base = j * block_size + c * tile
+
+    @pl.when((j == 0) & (c == 0))
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # tiles fully past the seat's length contribute nothing: skip the
+    # compute (their DMA lands in scratch/reserved blocks — the table
+    # guarantees no other seat's live data is ever addressed)
+    @pl.when(base < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # [G, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [tile, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [G, tile]
+        # per-seat length mask == scratch mask (module docstring)
+        kpos = base + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        logits = jnp.where(kpos < length, logits, _NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(logits, -1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(logits - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            l_prev * alpha + jnp.sum(p, -1, keepdims=True), l_ref.shape
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when((j == nj - 1) & (c == nc - 1))
+    def _finalize():
+        # a fully-masked seat divides safely (cannot happen live: the
+        # new token was appended before the call, so length >= 1)
+        l = jnp.maximum(l_ref[:, :1], 1e-37)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(
+    q, k_arena, v_arena, tables, lengths, *, interpret: bool
+):
+    s, h, d = q.shape
+    nb, hkv, bs, _ = k_arena.shape
+    mb = tables.shape[1]
+    if h % hkv:
+        raise ValueError(
+            f"q heads ({h}) must be a multiple of kv heads ({hkv})"
+        )
+    group = h // hkv
+    tile = _resolve_paged_tile(bs, d)
+    scale = 1.0 / (d**0.5)
+    kernel = functools.partial(
+        _paged_attn_kernel, block_size=bs, tile=tile, scale=scale
+    )
+
+    def kv_idx(si, hi, j, c, tables_ref, lengths_ref):
+        # the scalar-prefetched block table IS the DMA schedule: grid
+        # step (seat, head, logical block j, chunk c) streams physical
+        # block tables[seat, j] — never a contiguous view
+        return (tables_ref[si, j], hi, c, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, hkv, mb, bs // tile),
+        in_specs=[
+            pl.BlockSpec(
+                (1, group, d), lambda si, hi, j, c, t, L: (si, hi, 0)
+            ),
+            pl.BlockSpec((1, 1, tile, d), kv_idx),
+            pl.BlockSpec((1, 1, tile, d), kv_idx),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, group, d), lambda si, hi, j, c, t, L: (si, hi, 0)
+        ),
+        scratch_shapes=[
+            # carries persist across the two innermost (sequential)
+            # grid dims — the flash-kernel pattern
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    compiler_params = None
+    if not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel", "parallel", "arbitrary", "arbitrary",
+            )
+        )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((s, h, d), v_arena.dtype),
+        grid_spec=grid_spec,
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), q, k_arena, v_arena)
+
+
+def paged_attention(
+    q: jax.Array,
+    k_arena: jax.Array,
+    v_arena: jax.Array,
+    tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    impl: str = "xla",
+) -> jax.Array:
+    """Single-query attention against the block arena (module
+    docstring for the layout/masking contract).  ``impl`` is explicit
+    by design — "auto" lives in the CALLER (the pool / serve_lm flag)
+    where refusing to downgrade is possible; this function just runs
+    what it is told."""
+
+    if impl not in PAGED_IMPLS:
+        raise ValueError(
+            f"impl must be one of {PAGED_IMPLS}, got {impl!r}"
+        )
+    if q.ndim != 3 or k_arena.ndim != 4 or tables.ndim != 2:
+        raise ValueError(
+            f"paged_attention layout: q [S,H,D], arena [NB,Hkv,bs,D], "
+            f"tables [S,MB]; got q{q.shape}, k{k_arena.shape}, "
+            f"tables{tables.shape}"
+        )
+    if impl == "xla":
+        return _paged_attention_xla(q, k_arena, v_arena, tables, lengths)
+    return _paged_attention_pallas(
+        q, k_arena, v_arena, tables, lengths,
+        interpret=(impl == "pallas-interpret"),
+    )

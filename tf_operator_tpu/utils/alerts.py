@@ -250,7 +250,10 @@ def default_rules(
         ThresholdRule(
             # paged-serving memory headroom (ISSUE 8): the arena is
             # nearly exhausted — admission is about to gate on blocks
-            # free.  Worst replica drives it (gauge kind takes the max
+            # free.  Since ISSUE 10 the gauge is (in-use + queued
+            # demand)/usable refreshed per decode window, so a burst
+            # ramps through 0.9 instead of step-functioning past it.
+            # Worst replica drives it (gauge kind takes the max
             # matching level); the stock serving autoscaling policy
             # binds the same family so the alert and the scale-up act
             # on one number
