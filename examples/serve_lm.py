@@ -48,6 +48,21 @@ request-thread dispatches appear as ``dispatch.<phase>`` child spans in
 the request waterfall), and ``/traces`` + ``/traces/<id>`` expose the
 trace store like the operator API does.
 
+Request autopsy (r13, ISSUE 11): every pool request carries a
+first-class id (the trace id — adopted from ``x-trace-id`` when sent,
+returned as ``request_id`` in the /generate body) and a complete
+lifecycle: the pool emits ``queue.wait`` / ``admission`` /
+``decode.window`` / ``retire`` spans on the request's trace (the
+router adds ``route``), and ``GET /requests/<id>`` serves the
+assembled record — timings, blocks reserved, prefix-hit depth,
+per-request dispatch counts — from the bounded per-replica RequestLog
+(``GET /requests`` lists recent ones, merged across replicas).
+``GET /debug/arena`` serves the per-replica KV-arena occupancy
+timeline (the time-series twin of kv_blocks_pressure), and
+``GET /debug/profile?seconds=N`` wraps ``jax.profiler`` around the
+live decode loop and returns the trace-artifact path (host-side only;
+one profile at a time).
+
 Honest speculation (r6, VERDICT r5 next #2): ``--speculative`` consults
 the measured ledger (benchmarks/LAST_MEASURED.json).  If every measured
 speculative configuration on this box is a slowdown (<1x), the server
@@ -186,24 +201,27 @@ def build_handler(
     )
 
     def observe_slo(mode: str, queue_wait: float, ttft: float,
-                    tpot: float) -> None:
+                    tpot: float, exemplar: "str | None" = None) -> None:
         """Single-dispatch modes (chunked/speculative) produce their
         whole output in one program: the first token is host-visible
         only when every token is, so TTFT is honestly the full
         generate wall and time-per-output-token is wall/n (docs/
         SERVING.md "SLO definitions").  The pool observes its own
-        precise per-request values instead."""
+        precise per-request values instead.  ``exemplar`` is the
+        request's trace id — the "p99 is bad → which request?" link
+        (ISSUE 11)."""
 
         metrics.observe_histogram(
             "serve_queue_wait_seconds", queue_wait,
-            model=model_label, mode=mode,
+            exemplar=exemplar, model=model_label, mode=mode,
         )
         metrics.observe_histogram(
-            "serve_ttft_seconds", ttft, model=model_label, mode=mode,
+            "serve_ttft_seconds", ttft, exemplar=exemplar,
+            model=model_label, mode=mode,
         )
         metrics.observe_histogram(
             "serve_time_per_output_token_seconds", tpot,
-            model=model_label, mode=mode,
+            exemplar=exemplar, model=model_label, mode=mode,
         )
 
     if speculative:
@@ -225,6 +243,7 @@ def build_handler(
                                   ledger=ledger)
         spec_lock = threading.Lock()  # generate mutates decoder telemetry
         pool = None
+        pool_replicas = []
         pool_fatal = []
         # top_k fallback path; prompt-KV reuse helps it too
         decoder = ChunkedServingDecoder(
@@ -286,9 +305,16 @@ def build_handler(
                 )
             pool_replicas.append(p)
         pool = (
-            PoolRouter(pool_replicas) if n_replicas > 1
+            PoolRouter(pool_replicas, tracer=tracer) if n_replicas > 1
             else pool_replicas[0]
         )
+        # autopsies + arena history ride every flight-recorder dump:
+        # an alert/watchdog post-mortem names the requests in flight
+        # and the pressure ramp that preceded the episode (ISSUE 11)
+        for p in pool_replicas:
+            recorder.attach_request_log(p.request_log)
+            if getattr(p, "timeline", None) is not None:
+                recorder.attach_arena_timeline(p.timeline)
         pool_fatal = []  # driver-thread death must surface as 500s
 
         def _drive(p, hb_name):
@@ -318,10 +344,48 @@ def build_handler(
     else:
         pool = None
         spec = None
+        pool_replicas = []
         pool_fatal = []
         decoder = ChunkedServingDecoder(
             model, params, prompt_cache=prompt_cache, ledger=ledger,
         )
+
+    #: one live device profile at a time (GET /debug/profile):
+    #: jax.profiler has process-global start/stop state, so a second
+    #: concurrent request must 409, not corrupt the first
+    profile_lock = threading.Lock()
+
+    # /requests + /debug/arena reads: the multi-replica merge lives on
+    # PoolRouter (request_autopsy/recent_requests/arena_snapshots —
+    # duck-typed below so the single-pool and no-pool modes answer the
+    # same shape without duplicating the merge logic here)
+    def recent_requests(limit: int = 50):
+        if hasattr(pool, "recent_requests"):
+            return pool.recent_requests(limit)
+        if pool_replicas:
+            return pool_replicas[0].request_log.recent(limit)
+        return []
+
+    def request_autopsy(req_id: str):
+        if hasattr(pool, "request_autopsy"):
+            return pool.request_autopsy(req_id)
+        if pool_replicas:
+            return pool_replicas[0].request_log.get(req_id)
+        return None
+
+    # the dashboard strip renders at most ~160 samples per replica —
+    # shipping the full 512-sample ring on every 2 s poll would be
+    # pure serialization waste on the process serving decode traffic
+    ARENA_SAMPLE_LIMIT = 160
+
+    def arena_snapshots():
+        if hasattr(pool, "arena_snapshots"):
+            return pool.arena_snapshots(ARENA_SAMPLE_LIMIT)
+        return [
+            p.timeline.snapshot(ARENA_SAMPLE_LIMIT)
+            for p in pool_replicas
+            if getattr(p, "timeline", None) is not None
+        ]
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -333,6 +397,7 @@ def build_handler(
                 self._t0 = None
                 metrics.observe_histogram(
                     "serve_request_seconds", _time.perf_counter() - t0,
+                    exemplar=getattr(self, "_trace_id", None),
                     route="/generate", model=model_label,
                 )
                 metrics.inc("serve_requests_total", status=str(code))
@@ -392,6 +457,28 @@ def build_handler(
                 if t is None:
                     return self._reply(404, {"error": "unknown trace id"})
                 return self._reply(200, t)
+            if self.path == "/requests":
+                # recent autopsies newest-first, merged across every
+                # replica's RequestLog (the /slo merged-family
+                # pattern applied to request records)
+                return self._reply(200, {"requests": recent_requests(50)})
+            if self.path.startswith("/requests/"):
+                entry = request_autopsy(self.path[len("/requests/"):])
+                if entry is not None:
+                    return self._reply(200, entry)
+                return self._reply(404, {
+                    "error": "unknown request id (pool modes only; ids "
+                             "are trace ids — the /generate response's "
+                             "request_id / x-trace-id header)"})
+            if self.path == "/debug/arena":
+                # the KV-arena occupancy timeline per paged replica —
+                # the time-series twin of kv_blocks_pressure
+                return self._reply(200, {"replicas": arena_snapshots()})
+            if self.path == "/debug/profile" or \
+                    self.path.startswith("/debug/profile?"):
+                # exact-or-query match only: a typo'd /debug/profileX
+                # must 404, not trigger a real device profile
+                return self._profile()
             if self.path == "/slo":
                 # the operator's one-look answer to "what latency are
                 # users seeing right now": per-{model,mode} quantiles
@@ -454,6 +541,58 @@ def build_handler(
                 self.wfile.write(body)
                 return
             return self._reply(404, {"error": "try POST /generate"})
+
+        def _profile(self):
+            """GET /debug/profile?seconds=N — wrap jax.profiler around
+            the LIVE decode loop (the driver threads keep stepping;
+            this request thread only sleeps) and return the trace
+            artifact directory.  Host-side only: profiling observes
+            the device stream, it never fetches from it, so the
+            no-hot-sync gate over the step loop is untouched."""
+
+            seconds = 1.0
+            query = self.path.split("?", 1)[1] if "?" in self.path else ""
+            for part in query.split("&"):
+                if part.startswith("seconds="):
+                    try:
+                        seconds = float(part.split("=", 1)[1])
+                    except ValueError:
+                        return self._reply(
+                            400, {"error": "seconds must be a number"}
+                        )
+            if not (0.0 < seconds <= 30.0):
+                return self._reply(
+                    400, {"error": "seconds must be in (0, 30]"}
+                )
+            if not profile_lock.acquire(blocking=False):
+                return self._reply(
+                    409, {"error": "a profile is already running "
+                                   "(jax.profiler is process-global)"}
+                )
+            try:
+                import tempfile
+
+                base = os.environ.get("TPUJOB_PROFILE_DIR")
+                if base:
+                    os.makedirs(base, exist_ok=True)
+                out_dir = tempfile.mkdtemp(
+                    prefix="serve-profile-", dir=base or None
+                )
+                t0 = _time.perf_counter()
+                jax.profiler.start_trace(out_dir)
+                try:
+                    _time.sleep(seconds)
+                finally:
+                    jax.profiler.stop_trace()
+                return self._reply(200, {
+                    "artifact": out_dir,
+                    "seconds": seconds,
+                    "wall_seconds": round(_time.perf_counter() - t0, 3),
+                })
+            except Exception as exc:  # profiler quirks must not 500 loop
+                return self._reply(500, {"error": repr(exc)})
+            finally:
+                profile_lock.release()
 
         def do_POST(self):
             if self.path != "/generate":
@@ -536,15 +675,18 @@ def build_handler(
                             "error": f"top_k must be in [1, {TOP_K_MAX}] "
                                      "in --batching mode (static top-k "
                                      "width)"})
+                    # the request's first-class id IS this span's trace
+                    # id (adopted x-trace-id or freshly minted): every
+                    # pool lifecycle span — route, queue.wait,
+                    # admission, decode.window, retire — and the
+                    # /requests/<id> autopsy key on it (ISSUE 11)
                     rid = pool.submit(
                         ids.astype(np.int32), n_new,
                         temperature=temperature, top_k=top_k,
                         rng=jax.random.PRNGKey(seed)
                         if temperature > 0.0 else None,
+                        trace_id=span.trace_id,
                     )
-                    # the pool's admission/step dispatches run on the
-                    # driver thread; the rid is the join key between
-                    # this request span and those ledger spans
                     span.set_attribute("rid", rid)
                     # condition wait (no lock-churning poll); the
                     # periodic timeout is only to notice driver death
@@ -558,7 +700,9 @@ def build_handler(
                                          f"{pool_fatal[0]}"})
                     sample = finish(decode_bytes(out_row[len(ids):]))
                     return self._reply(
-                        200, {"prompt": text, "sample": sample, "seed": seed}
+                        200, {"prompt": text, "sample": sample,
+                              "seed": seed,
+                              "request_id": span.trace_id}
                     )
                 prompt = jnp.asarray(ids, jnp.int32)[None]
                 if spec is not None and top_k is None:
@@ -580,7 +724,7 @@ def build_handler(
                     # the user experiences, same clock as pool TTFT
                     observe_slo(
                         "speculative", t_gen - t_q, done - t_q,
-                        (done - t_gen) / n_new,
+                        (done - t_gen) / n_new, exemplar=span.trace_id,
                     )
                     sample = finish(decode_bytes(np.asarray(out[0, prompt.shape[1]:])))
                     return self._reply(
@@ -597,7 +741,8 @@ def build_handler(
                 # record async-dispatch latency (~ms), not generation
                 new_ids = np.asarray(out[0, prompt.shape[1]:])
                 wall = _time.perf_counter() - t_gen
-                observe_slo("chunked", 0.0, wall, wall / n_new)
+                observe_slo("chunked", 0.0, wall, wall / n_new,
+                            exemplar=span.trace_id)
                 sample = finish(decode_bytes(new_ids))
                 return self._reply(
                     200, {"prompt": text, "sample": sample, "seed": seed}

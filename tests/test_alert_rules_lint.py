@@ -161,6 +161,107 @@ def test_collector_sees_known_call_sites():
     assert "mode" in families["serve_prefix_cache_evictions_total"]
 
 
+def collect_dispatch_phases():
+    """{phase literal: [site, ...]} for every literal first-arg
+    ``<ledger>.dispatch("<phase>", ...)`` call in the package +
+    examples — the same AST-collector pattern as
+    collect_emitted_families, aimed at the serving span taxonomy."""
+
+    phases = {}
+    paths = list(PKG_ROOT.rglob("*.py")) + list(EXAMPLES.glob("*.py"))
+    for path in paths:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "dispatch"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                phases.setdefault(node.args[0].value, []).append(
+                    f"{path.name}:{node.lineno}"
+                )
+    return phases
+
+
+def test_dispatch_phase_literals_match_span_taxonomy():
+    """ISSUE 11 satellite: every DispatchLedger phase literal in the
+    code must appear in the declared ``DISPATCH_PHASES`` taxonomy and
+    vice versa.  The ledger derives each phase's span name as
+    ``dispatch.<phase>``, and the request-autopsy waterfall, the
+    dashboard SLO panel, and the per-request dispatch counts key on
+    those literals — a renamed phase would orphan them all silently,
+    so the rename must fail tier-1 instead."""
+
+    from tf_operator_tpu.utils.metrics import DISPATCH_PHASES
+
+    emitted = collect_dispatch_phases()
+    declared = set(DISPATCH_PHASES)
+    unknown = set(emitted) - declared
+    assert not unknown, (
+        "dispatch phases emitted but missing from "
+        "utils/metrics.DISPATCH_PHASES (their dispatch.<phase> spans "
+        "would be orphans to the autopsy/waterfall layers): "
+        + ", ".join(
+            f"{p} ({', '.join(emitted[p])})" for p in sorted(unknown)
+        )
+    )
+    orphaned = declared - set(emitted)
+    assert not orphaned, (
+        "DISPATCH_PHASES declares phases no code dispatches (stale "
+        "taxonomy — remove them or restore the emitter): "
+        + ", ".join(sorted(orphaned))
+    )
+
+
+def test_every_declared_phase_lowers_to_a_dispatch_span():
+    """The other half of the contract: dispatching any declared phase
+    really does emit a ``dispatch.<phase>`` span (the ledger's
+    span_prefix is part of the taxonomy, not an implementation
+    detail)."""
+
+    from tf_operator_tpu.utils.metrics import DISPATCH_PHASES, DispatchLedger
+    from tf_operator_tpu.utils.trace import Tracer
+
+    tracer = Tracer(seed=0)
+    finished = []
+    tracer.on_finish = finished.append
+    ledger = DispatchLedger(tracer=tracer)
+    for phase in DISPATCH_PHASES:
+        with ledger.dispatch(phase):
+            pass
+    assert {s.name for s in finished} == {
+        f"dispatch.{p}" for p in DISPATCH_PHASES
+    }
+
+
+def test_phase_collector_catches_a_renamed_phase():
+    """The gate's own regression test: a phase literal outside the
+    taxonomy is reported (plant the rename the gate exists for)."""
+
+    from tf_operator_tpu.utils.metrics import DISPATCH_PHASES
+
+    planted = ast.parse(
+        "def f(self):\n"
+        "    with self.ledger.dispatch('admit_v2'):\n"
+        "        pass\n"
+    )
+    found = set()
+    for node in ast.walk(planted):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "dispatch"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+        ):
+            found.add(node.args[0].value)
+    assert found == {"admit_v2"}
+    assert not found <= set(DISPATCH_PHASES)
+
+
 def test_lint_catches_a_renamed_metric():
     """Planted orphan: a rule naming a family nobody emits must be
     reported (the gate's own regression test)."""

@@ -160,6 +160,47 @@ class TestLabeledExposition:
         with pytest.raises(AssertionError, match="HELP"):
             parse_strictly("orphan_total 1\n")
 
+    def test_histogram_exemplar_linkage(self):
+        """ISSUE 11 satellite: ``observe_histogram`` records exemplars
+        exactly like ``inc`` — a bad SLO quantile deep-links to a
+        request's trace id — and the exposition stays strictly
+        parseable with the ``# exemplar`` comment lines present."""
+
+        m = Metrics()
+        m.observe_histogram(
+            "serve_ttft_seconds", 0.2, exemplar="tabc00000001", model="x"
+        )
+        assert m.exemplar("serve_ttft_seconds") == "tabc00000001"
+        text = m.exposition()
+        assert '# exemplar serve_ttft_seconds trace_id="tabc00000001"' \
+            in text
+        parsed = parse_strictly(text)
+        # the exemplar kwarg is control, never a label key
+        assert parsed['serve_ttft_seconds_count{model="x"}'] == 1
+        assert "exemplar=" not in text
+        # newest exemplar wins (the freshest reproduction is the one
+        # an operator wants), and exemplar=None leaves the last intact
+        m.observe_histogram(
+            "serve_ttft_seconds", 0.4, exemplar="tabc00000002", model="x"
+        )
+        m.observe_histogram("serve_ttft_seconds", 0.1, model="x")
+        assert m.exemplar("serve_ttft_seconds") == "tabc00000002"
+        parse_strictly(m.exposition())
+
+    def test_strict_parser_rejects_malformed_exemplar_comment(self):
+        """The exemplar comment shape is part of the contract the
+        dashboard's deep-links read — a malformed line must fail the
+        strict parse, not slip through as an ignorable comment."""
+
+        import pytest
+
+        m = Metrics()
+        m.inc("ok_total")
+        good = m.exposition()
+        parse_strictly(good)
+        with pytest.raises(AssertionError):
+            parse_strictly(good + "# exemplar missing_the_trace_id\n")
+
     def test_counters_snapshot_flat_keys(self):
         m = Metrics()
         m.inc("a_total")

@@ -1,0 +1,559 @@
+"""Request autopsy for the paged serving plane (ISSUE 11): per-request
+lifecycle tracing, the RequestLog / ArenaTimeline rings, flight-dump
+sections, on-demand device profiling, and the acceptance e2e — one
+request through a 2-replica paged pool over real HTTP yields a
+complete autopsy at /requests/<id> with every lifecycle span under one
+trace id and /debug/arena showing the occupancy rise and fall.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models.batching import RequestLog
+from tf_operator_tpu.models.kv_blocks import ArenaTimeline
+from tf_operator_tpu.utils.flight import FlightRecorder
+from tf_operator_tpu.utils.metrics import DispatchLedger, Metrics
+from tf_operator_tpu.utils.trace import TraceStore, Tracer
+
+VOCAB = 96
+
+
+class TestRequestLog:
+    def _open(self, log, i, **kw):
+        return log.open(
+            id=f"t{i:04d}", rid=i, replica="0", model="m",
+            prompt_tokens=4, max_new_tokens=8, **kw,
+        )
+
+    def test_bounded_fifo_eviction(self):
+        log = RequestLog(capacity=4)
+        for i in range(10):
+            self._open(log, i)
+        assert len(log) == 4
+        assert log.evicted == 6
+        assert log.get("t0000") is None
+        assert log.get("t0009") is not None
+
+    def test_entry_mutators_and_copies(self):
+        log = RequestLog()
+        e = self._open(log, 1)
+        log.update(e, state="active", slot=2)
+        log.count_dispatch(e, "admission")
+        log.add_window(e, 5)
+        log.add_window(e, 3)
+        got = log.get("t0001")
+        assert got["state"] == "active" and got["slot"] == 2
+        assert got["windows"] == 2 and got["tokens"] == 8
+        assert got["dispatches"] == {"admission": 1, "step": 2}
+        # reads are copies: mutating the returned dict (or the entry
+        # afterwards) never aliases the other side
+        got["dispatches"]["step"] = 99
+        assert log.get("t0001")["dispatches"]["step"] == 2
+
+    def test_id_collision_keeps_both_autopsies(self):
+        """A client reusing an x-trace-id must not silently destroy
+        the first request's record: the plain id resolves to the
+        newest request, the older one survives under <id>~<rid>
+        (URL-unreserved separator — a '#' would be eaten as a URI
+        fragment and make the demoted record unfetchable)."""
+
+        log = RequestLog()
+        first = log.open(id="tdup", rid=1, replica="0", model="m",
+                         prompt_tokens=1, max_new_tokens=1)
+        log.update(first, state="active")
+        second = log.open(id="tdup", rid=2, replica="0", model="m",
+                          prompt_tokens=1, max_new_tokens=1)
+        assert log.get("tdup")["rid"] == 2
+        assert log.get("tdup~1")["rid"] == 1
+        assert log.get("tdup~1")["id"] == "tdup~1"  # listing-key parity
+        assert log.get("tdup~1")["state"] == "active"
+        # the demoted entry's dict is STILL the live one the pool
+        # mutates — later lifecycle updates are not lost
+        log.update(first, state="done")
+        assert log.get("tdup~1")["state"] == "done"
+        assert second is log._entries["tdup"]
+
+    def test_eviction_protects_in_flight_entries(self):
+        """Capacity pressure evicts FINISHED autopsies first: the
+        long-running request an operator is actively debugging must
+        not vanish from /requests/<id> because short requests churned
+        past it."""
+
+        log = RequestLog(capacity=4)
+        live = self._open(log, 0)  # oldest, still in flight
+        log.update(live, state="active")
+        for i in range(1, 8):
+            e = self._open(log, i)
+            log.update(e, state="done")
+        assert len(log) == 4
+        assert log.get("t0000")["state"] == "active"  # survived churn
+        # only done entries were evicted, oldest first
+        assert log.get("t0001") is None
+        # all-live logs still keep the bound (oldest-first fallback)
+        flood = RequestLog(capacity=3)
+        for i in range(6):
+            flood.update(self._open(flood, i), state="active")
+        assert len(flood) == 3
+        assert flood.get("t0000") is None
+
+    def test_recent_newest_first(self):
+        log = RequestLog()
+        for i in range(5):
+            self._open(log, i)
+        ids = [e["id"] for e in log.recent(3)]
+        assert ids == ["t0004", "t0003", "t0002"]
+
+
+class TestArenaTimeline:
+    def test_bounded_ring_and_snapshot(self):
+        tl = ArenaTimeline(capacity=8, block_size=16, usable=32,
+                           replica="1")
+        for i in range(20):
+            tl.sample(free=32 - i, live=i, prefix_cached=min(i, 3),
+                      queued_demand=0, seats_active=i % 4)
+        assert len(tl) == 8
+        assert tl.dropped == 12
+        snap = tl.snapshot()
+        assert snap["replica"] == "1" and snap["usable"] == 32
+        assert snap["block_size"] == 16 and snap["dropped"] == 12
+        assert len(snap["samples"]) == 8
+        # oldest-first tail; limit takes the newest
+        assert snap["samples"][-1]["live"] == 19
+        assert [s["live"] for s in tl.tail(limit=2)] == [18, 19]
+        json.dumps(snap)  # JSON-safe end to end
+
+
+class TestFlightAutopsySections:
+    """ISSUE 11 bugfix: alert/watchdog flight dumps carry the last-K
+    request autopsies and the arena-timeline tail, after the existing
+    sections (the determinism contract extends, never reorders)."""
+
+    def _dump(self, rec):
+        import io
+
+        buf = io.StringIO()
+        rec.dump(fileobj=buf)
+        return [json.loads(x) for x in buf.getvalue().strip().splitlines()]
+
+    def test_dump_carries_requests_and_arena_tail(self):
+        rec = FlightRecorder(max_requests=3, max_arena_samples=4)
+        log = RequestLog()
+        for i in range(6):
+            log.open(id=f"t{i}", rid=i, replica="0", model="m",
+                     prompt_tokens=1, max_new_tokens=1)
+        tl = ArenaTimeline(block_size=16, usable=8, replica="0")
+        for i in range(10):
+            tl.sample(free=8 - (i % 3), live=i % 3, prefix_cached=0,
+                      queued_demand=0, seats_active=1)
+        rec.attach_request_log(log)
+        rec.attach_arena_timeline(tl)
+        rec.record_log("WARN", "x", "episode")
+        records = self._dump(rec)
+        types = [r["type"] for r in records]
+        # order: meta, then logs, then the new sections LAST
+        assert types == ["meta", "log", "request", "request", "request",
+                         "arena"]
+        assert records[0]["requests"] == 3
+        assert records[0]["arenaTimelines"] == 1
+        # last-K means the NEWEST K requests, oldest-first in the dump
+        assert [r["id"] for r in records if r["type"] == "request"] == [
+            "t3", "t4", "t5",
+        ]
+        [arena] = [r for r in records if r["type"] == "arena"]
+        assert len(arena["samples"]) == 4  # the tail, bounded
+
+    def test_dump_merges_requests_across_logs_by_time(self):
+        """Two replica logs, K-slot budget: the dump keeps the NEWEST
+        K across BOTH logs (time-merged), not whichever log was
+        attached last."""
+
+        rec = FlightRecorder(max_requests=4)
+        a, b = RequestLog(), RequestLog()
+        for i in range(4):
+            a.open(id=f"a{i}", rid=i, replica="0", model="m",
+                   prompt_tokens=1, max_new_tokens=1,
+                   submit_unix=float(2 * i))
+            b.open(id=f"b{i}", rid=i, replica="1", model="m",
+                   prompt_tokens=1, max_new_tokens=1,
+                   submit_unix=float(2 * i + 1))
+        rec.attach_request_log(a)
+        rec.attach_request_log(b)
+        ids = [r["id"] for r in rec.records() if r["type"] == "request"]
+        # newest 4 of the interleaved timeline, oldest-first
+        assert ids == ["a2", "b2", "a3", "b3"]
+
+    def test_unattached_recorder_dump_shape_unchanged(self):
+        rec = FlightRecorder()
+        rec.record_log("INFO", "x", "m")
+        assert [r["type"] for r in self._dump(rec)] == ["meta", "log"]
+
+
+class TestProfileAndSurfaceEndpoints:
+    """The host-side serving endpoints that need no pool: /debug/profile
+    wraps jax.profiler and returns the artifact path; /requests and
+    /debug/arena answer sanely in non-pool modes."""
+
+    @pytest.fixture(scope="class")
+    def server(self):
+        from http.server import ThreadingHTTPServer
+
+        import jax
+        import jax.numpy as jnp
+
+        from tests.testutil import load_serve_lm
+        from tf_operator_tpu.models import llama_tiny
+
+        serve_lm = load_serve_lm()
+        model = llama_tiny(vocab_size=256, max_len=64)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+        )["params"]
+        handler = serve_lm.build_handler(model, params, max_len=64)
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        yield f"http://127.0.0.1:{srv.server_address[1]}"
+        srv.shutdown()
+
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def test_profile_returns_artifact_path(self, server, tmp_path,
+                                           monkeypatch):
+        import os
+
+        monkeypatch.setenv("TPUJOB_PROFILE_DIR", str(tmp_path))
+        code, body = self._get(server + "/debug/profile?seconds=0.1")
+        assert code == 200
+        assert body["seconds"] == 0.1
+        assert body["artifact"].startswith(str(tmp_path))
+        # the profiler really wrote a trace artifact under the dir
+        found = [
+            f for root, _, fs in os.walk(body["artifact"]) for f in fs
+        ]
+        assert found, "profile artifact directory is empty"
+
+    def test_profile_validates_seconds(self, server):
+        for bad in ("seconds=0", "seconds=31", "seconds=nope"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    server + "/debug/profile?" + bad, timeout=30
+                )
+            assert ei.value.code == 400
+
+    def test_profile_path_is_exact(self, server):
+        """A typo'd /debug/profileX must 404, never trigger a real
+        device profile."""
+
+        for path in ("/debug/profiler", "/debug/profileX"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(server + path, timeout=30)
+            assert ei.value.code == 404
+
+    def test_requests_and_arena_without_a_pool(self, server):
+        code, body = self._get(server + "/requests")
+        assert code == 200 and body == {"requests": []}
+        code, body = self._get(server + "/debug/arena")
+        assert code == 200 and body == {"replicas": []}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(server + "/requests/tmissing",
+                                   timeout=30)
+        assert ei.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# pool-driving coverage (generation-loop compiles)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import llama_tiny
+
+    model = llama_tiny(vocab_size=VOCAB, max_len=64)
+    params = model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return model, params
+
+
+@pytest.mark.slow
+class TestLifecycleThroughThePool:
+    def test_paged_pool_emits_full_lifecycle(self, tiny_model):
+        """Direct (no-HTTP) pin of the tentpole: a paged pool request
+        gets queue.wait / admission / decode.window / retire spans on
+        ITS trace id, a complete autopsy in the RequestLog, and the
+        arena timeline records the occupancy swing."""
+
+        from tf_operator_tpu.models.batching import (
+            PagedContinuousBatchingDecoder,
+        )
+
+        model, params = tiny_model
+        m = Metrics()
+        tracer = Tracer(seed=0)
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=16,
+            ledger=DispatchLedger(metrics=m, tracer=tracer),
+            metrics=m, model_label="tiny",
+        )
+        r = np.random.RandomState(0)
+        rid = pool.submit(
+            r.randint(0, VOCAB, size=(20,)).astype(np.int32), 14,
+            trace_id="treqpin00001",
+        )
+        pool.run()
+        assert pool.result(rid) is not None
+
+        entry = pool.request_log.get("treqpin00001")
+        assert entry["state"] == "done"
+        assert entry["rid"] == rid and entry["replica"] == "0"
+        assert entry["queue_wait_seconds"] is not None
+        assert entry["ttft_seconds"] >= entry["queue_wait_seconds"]
+        adm = entry["admission"]
+        # 20 prompt + 14 budget at block 16 -> 3 blocks, no prefix hit
+        assert adm["blocks_reserved"] == 3
+        assert adm["prefix_hit_tokens"] == 0
+        assert adm["prefill_dispatches"] == 0
+        assert entry["windows"] >= 1
+        assert entry["tokens"] == 14
+        assert entry["dispatches"]["admission"] == 1
+        assert entry["dispatches"]["step"] == entry["windows"]
+        assert entry["dispatches"]["retire"] == 1
+        # one full prompt block stays published in the prefix cache
+        assert entry["retire"]["blocks_freed"] == 2
+
+        trace = tracer.store.trace("treqpin00001")
+        names = {s["name"] for s in trace["spans"]}
+        assert {"queue.wait", "admission", "dispatch.admission",
+                "decode.window", "retire"} <= names
+        # the device dispatch nests under the lifecycle admission span
+        by_name = {s["name"]: s for s in trace["spans"]}
+        assert (by_name["dispatch.admission"]["parentId"]
+                == by_name["admission"]["spanId"])
+        assert by_name["admission"]["attributes"]["blocks_reserved"] == 3
+        assert by_name["retire"]["attributes"]["blocks_freed"] == 2
+        # every span carries the replica tag (satellite: dispatch spans
+        # gain a replica attribute)
+        assert by_name["dispatch.admission"]["attributes"]["replica"] == "0"
+
+        # the arena timeline saw the occupancy rise and fall
+        samples = pool.timeline.tail()
+        lives = [s["live"] for s in samples]
+        assert max(lives) >= 3
+        assert lives[-1] == 1  # the published prefix block remains
+        # SLO exemplars name this request's trace
+        assert m.exemplar("serve_ttft_seconds") == "treqpin00001"
+
+    def test_prefix_hit_depth_recorded(self, tiny_model):
+        """A repeat prompt's autopsy carries the prefix-chain hit
+        depth the admission actually used."""
+
+        from tf_operator_tpu.models.batching import (
+            PagedContinuousBatchingDecoder,
+        )
+
+        model, params = tiny_model
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=16,
+            ledger=DispatchLedger(tracer=Tracer(seed=1)),
+        )
+        r = np.random.RandomState(3)
+        prompt = r.randint(0, VOCAB, size=(36,)).astype(np.int32)
+        first = pool.submit(prompt, 4, trace_id="tcold")
+        pool.run()
+        assert pool.result(first) is not None
+        second = pool.submit(prompt, 4, trace_id="twarm")
+        pool.run()
+        assert pool.result(second) is not None
+        cold = pool.request_log.get("tcold")["admission"]
+        warm = pool.request_log.get("twarm")["admission"]
+        assert cold["prefix_hit_tokens"] == 0
+        assert warm["prefix_hit_tokens"] == 32  # 2 full blocks of 16
+        assert warm["prefix_hit_blocks"] == 2
+        assert warm["blocks_reserved"] == 3  # 2 shared + 1 fresh
+
+    def test_trace_store_tail_sampling_under_sustained_load(
+        self, tiny_model
+    ):
+        """ISSUE 11 satellite: a few hundred pool requests through a
+        SMALL TraceStore — memory stays bounded at max_traces, and the
+        protect-error-and-slow invariant holds end to end (the error
+        and slow request traces survive the flood of ok-and-fast
+        ones)."""
+
+        from tf_operator_tpu.models.batching import (
+            PagedContinuousBatchingDecoder,
+        )
+
+        store = TraceStore(max_traces=24, slow_seconds=30.0)
+        tracer = Tracer(store=store, seed=2)
+        pool = PagedContinuousBatchingDecoder(
+            model=tiny_model[0], params=tiny_model[1], slots=4,
+            steps_per_sync=4, kv_block_size=16,
+            ledger=DispatchLedger(tracer=tracer),
+        )
+        r = np.random.RandomState(9)
+        protected_err = []
+        protected_slow = []
+        total = 300
+        for i in range(total):
+            tid = f"tload{i:05d}"
+            pool.submit(
+                r.randint(0, VOCAB, size=(4 + i % 5,)).astype(np.int32),
+                3, trace_id=tid,
+            )
+            if i % 40 == 0:
+                # a failed request: its serve-span error status is what
+                # tail sampling protects
+                sp = tracer.start_span("serve.generate", trace_id=tid)
+                sp.set_error("boom")
+                sp.end()
+                protected_err.append(tid)
+            if i == total // 2:
+                # a pathologically slow request (backdated span)
+                sp = tracer.start_span(
+                    "serve.generate", trace_id=tid,
+                    start_mono=time.monotonic() - 60.0,
+                )
+                sp.end()
+                protected_slow.append(tid)
+            if i % 3 == 0:
+                pool.step()
+        pool.run()
+
+        # bounded memory under ~10x max_traces of request traffic
+        assert len(store) == 24
+        # the protected traces survived the flood
+        for tid in protected_err:
+            t = store.trace(tid)
+            assert t is not None and t["error"], tid
+        for tid in protected_slow:
+            t = store.trace(tid)
+            assert t is not None and t["slow"], tid
+        # and the autopsy ring stayed bounded too
+        assert len(pool.request_log) == pool.request_log.capacity
+
+
+@pytest.mark.slow
+class TestAutopsyE2E:
+    """ISSUE 11 acceptance: one request to a 2-replica paged pool over
+    real HTTP yields a complete autopsy at /requests/<id> — queue.wait,
+    admission (blocks reserved + prefix-hit depth), >=1 decode window,
+    and retire all under ONE trace id, with the serving replica
+    identified — and /debug/arena shows the block-occupancy rise and
+    fall.  All recording is host-side; the no-hot-sync lint gate
+    (tests/test_lint_no_hot_sync.py) runs unchanged in the same suite.
+    """
+
+    def test_http_autopsy_and_arena(self):
+        from http.server import ThreadingHTTPServer
+
+        import jax
+        import jax.numpy as jnp
+
+        from tests.testutil import load_serve_lm
+        from tf_operator_tpu.models import llama_tiny
+
+        serve_lm = load_serve_lm()
+        model = llama_tiny(vocab_size=256, max_len=64)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+        )["params"]
+        handler = serve_lm.build_handler(
+            model, params, max_len=64, batching_slots=2, replicas=2
+        )
+        server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            tid = "treqe2e00001"
+            req = urllib.request.Request(
+                base + "/generate",
+                data=json.dumps(
+                    {"prompt": "autopsy this request ",
+                     "max_new_tokens": 12}
+                ).encode(),
+                headers={"x-trace-id": tid},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                body = json.loads(resp.read())
+                assert resp.headers["x-trace-id"] == tid
+            # the request's first-class id is the adopted trace id
+            assert body["request_id"] == tid
+            assert len(body["sample"]) == 12
+
+            # a second request so both replicas see traffic / the
+            # router provably chose for each
+            req2 = urllib.request.Request(
+                base + "/generate",
+                data=json.dumps(
+                    {"prompt": "second ", "max_new_tokens": 4}
+                ).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req2, timeout=300) as resp:
+                body2 = json.loads(resp.read())
+
+            # ---- the autopsy, over HTTP, by request id
+            with urllib.request.urlopen(
+                base + f"/requests/{tid}", timeout=30
+            ) as resp:
+                autopsy = json.loads(resp.read())
+            assert autopsy["id"] == tid
+            assert autopsy["state"] == "done"
+            assert autopsy["replica"] in ("0", "1")
+            assert autopsy["queue_wait_seconds"] is not None
+            adm = autopsy["admission"]
+            assert adm["blocks_reserved"] >= 1
+            assert adm["prefix_hit_tokens"] >= 0
+            assert autopsy["windows"] >= 1
+            assert autopsy["tokens"] == 12
+            assert autopsy["retire"] is not None
+
+            # ---- every lifecycle span under ONE trace id
+            with urllib.request.urlopen(
+                base + f"/traces/{tid}", timeout=30
+            ) as resp:
+                trace = json.loads(resp.read())
+            names = {s["name"] for s in trace["spans"]}
+            assert {"serve.generate", "route", "queue.wait", "admission",
+                    "dispatch.admission", "decode.window",
+                    "retire"} <= names
+            assert all(s["traceId"] == tid for s in trace["spans"])
+            route = next(s for s in trace["spans"] if s["name"] == "route")
+            assert route["attributes"]["replica"] == autopsy["replica"]
+            assert "load_score" in route["attributes"]
+
+            # ---- /requests lists both, merged across replicas
+            with urllib.request.urlopen(
+                base + "/requests", timeout=30
+            ) as resp:
+                listing = json.loads(resp.read())["requests"]
+            ids = {e["id"] for e in listing}
+            assert {tid, body2["request_id"]} <= ids
+
+            # ---- the arena timeline shows the rise and fall
+            with urllib.request.urlopen(
+                base + "/debug/arena", timeout=30
+            ) as resp:
+                arena = json.loads(resp.read())
+            served = next(
+                r for r in arena["replicas"]
+                if r["replica"] == autopsy["replica"]
+            )
+            lives = [s["live"] for s in served["samples"]]
+            assert max(lives) >= adm["blocks_reserved"]  # the rise
+            assert lives[-1] < max(lives)                # the fall
+        finally:
+            server.shutdown()

@@ -75,9 +75,11 @@ tokens/s at concurrency 8 vs sequential single-request serving —
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -102,6 +104,7 @@ from tf_operator_tpu.models.decode import (
 )
 from tf_operator_tpu.models.kv_blocks import (
     SCRATCH_BLOCK,
+    ArenaTimeline,
     BlockAllocator,
     NotPageableError,
     blocks_for,
@@ -164,10 +167,141 @@ def _step_sample(logits, temps, top_ks, rngs):
     return jnp.where(temps > 0.0, sampled, greedy), split[:, 1]
 
 
+class RequestLog:
+    """Bounded ring of per-request lifecycle autopsies (ISSUE 11).
+
+    The trace store answers "show me the spans of trace T"; this log
+    answers the operator question one level up — "what happened to
+    REQUEST R": queue wait, admission accounting (width class, blocks
+    reserved, prefix-hit depth, prefill dispatches), decode-window and
+    token counts, the per-request dispatch share from the ledger, and
+    retirement (blocks freed) — one JSON-safe record per request,
+    keyed by the request id (= its trace id), served at
+    ``GET /requests/<id>`` on serve_lm and riding flight-recorder
+    dumps so a post-mortem names the requests in flight.
+
+    Bounded FIFO (oldest evicted past ``capacity``).  Entries are
+    mutated through the log's own lock, so an HTTP read never races a
+    driver-thread field write mid-serialization.
+    """
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self.evicted = 0
+
+    def open(self, **fields) -> Dict[str, Any]:
+        """Insert a fresh entry (state=queued) and return it; the
+        pool mutates it through update/count_dispatch/add_window.
+
+        Id collisions (a client reusing an ``x-trace-id``): the plain
+        id resolves to the NEWEST request (matching the exemplar
+        store's latest-wins), and the older autopsy survives under
+        ``<id>~<rid>`` instead of being silently dropped (``~`` is
+        URL-unreserved, so the demoted id stays fetchable at
+        ``/requests/<id>~<rid>`` — ``#`` would be eaten as a URI
+        fragment)."""
+
+        entry: Dict[str, Any] = {
+            "state": "queued",
+            "submit_unix": time.time(),
+            "queue_wait_seconds": None,
+            "ttft_seconds": None,
+            "tpot_seconds": None,
+            "total_seconds": None,
+            "admission": None,
+            "windows": 0,
+            "tokens": 0,
+            "dispatches": {},
+            "retire": None,
+            "slot": None,
+        }
+        entry.update(fields)
+        with self._lock:
+            old = self._entries.pop(entry["id"], None)
+            if old is not None:
+                # rewrite the demoted entry's id too, so /requests
+                # listings and the lookup key agree
+                old["id"] = f"{old['id']}~{old['rid']}"
+                self._entries[old["id"]] = old
+            self._entries[entry["id"]] = entry
+            while len(self._entries) > self.capacity:
+                # evict finished autopsies first: an IN-FLIGHT entry
+                # is exactly the one an operator is debugging, and
+                # its dict is still being written — only when every
+                # entry is live does oldest-first keep the bound
+                victim = next(
+                    (k for k, e in self._entries.items()
+                     if e["state"] == "done"),
+                    None,
+                )
+                if victim is not None:
+                    del self._entries[victim]
+                else:
+                    self._entries.popitem(last=False)
+                self.evicted += 1
+        return entry
+
+    def update(self, entry: Dict[str, Any], **fields) -> None:
+        with self._lock:
+            entry.update(fields)
+
+    def count_dispatch(self, entry: Dict[str, Any], phase: str,
+                       n: int = 1) -> None:
+        """This request's share of the ledger: +n dispatches under
+        ``phase`` (shared dispatches like a decode window count once
+        per seated request — the share, not the global total)."""
+
+        with self._lock:
+            entry["dispatches"][phase] = (
+                entry["dispatches"].get(phase, 0) + n
+            )
+
+    def add_window(self, entry: Dict[str, Any], tokens: int) -> None:
+        with self._lock:
+            entry["windows"] += 1
+            entry["tokens"] += int(tokens)
+            entry["dispatches"]["step"] = (
+                entry["dispatches"].get("step", 0) + 1
+            )
+
+    def _copy(self, entry: Dict[str, Any]) -> Dict[str, Any]:
+        # entries nest at most one dict deep — copy those too so the
+        # caller's JSON serialization never races a later mutation
+        return {
+            k: (dict(v) if isinstance(v, dict) else v)
+            for k, v in entry.items()
+        }
+
+    def get(self, request_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            entry = self._entries.get(request_id)
+            return self._copy(entry) if entry is not None else None
+
+    def recent(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Newest-first entry copies (list endpoints, flight dumps).
+        ``limit <= 0`` returns none — never the whole ring (the
+        ``[-0:]`` slice pitfall)."""
+
+        if limit <= 0:
+            return []
+        with self._lock:
+            items = [
+                self._copy(e) for e in list(self._entries.values())[-limit:]
+            ]
+        return items[::-1]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
 class _Request:
     __slots__ = ("rid", "prompt", "budget", "temperature", "top_k", "rng",
                  "tokens", "done", "slot", "staged_cache", "staged_tok",
-                 "has_permit", "t_submit", "t_first")
+                 "has_permit", "t_submit", "t_first", "trace_id", "entry",
+                 "t_submit_mono", "queue_waited")
 
     def __init__(self, rid, prompt, budget, temperature, top_k, rng):
         self.rid = rid
@@ -189,6 +323,13 @@ class _Request:
         # queue-wait/TTFT/time-per-output-token derive from these
         self.t_submit = time.perf_counter()
         self.t_first = None
+        # ISSUE 11: first-class request identity (= the trace id every
+        # lifecycle span joins; serve_lm adopts the HTTP x-trace-id) +
+        # this request's RequestLog autopsy entry
+        self.trace_id: Optional[str] = None
+        self.entry: Optional[Dict[str, Any]] = None
+        self.t_submit_mono = time.monotonic()
+        self.queue_waited = False  # queue.wait span emitted once
 
 
 class ContinuousBatchingDecoder:
@@ -218,6 +359,13 @@ class ContinuousBatchingDecoder:
         #: and gauge, so /metrics distinguishes replicas while /slo
         #: merges them (utils/metrics.histogram_family_merged)
         self.replica_label = replica_label
+        #: ISSUE 11 request-lifecycle observability: the ledger's
+        #: tracer (serve_lm shares ONE across all decoders) carries
+        #: the per-request queue.wait/admission/decode.window/retire
+        #: spans; the RequestLog holds the assembled autopsies the
+        #: /requests/<id> endpoint serves
+        self.tracer = self.ledger.tracer
+        self.request_log = RequestLog()
         self.dmodel = _decode_variant(model)
         self._materialize = materialize_fn(model)
         cfg = self.dmodel.cfg
@@ -303,6 +451,92 @@ class ContinuousBatchingDecoder:
             out["replica"] = self.replica_label
         return out
 
+    # -- request lifecycle (ISSUE 11) ------------------------------------
+
+    def dispatch(self, phase: str, **attrs):
+        """``ledger.dispatch`` with this replica's label stamped on
+        the span attributes — set in ONE place so no device-call site
+        can silently produce a replica-less dispatch span (the
+        per-replica waterfall merge keys on it).  Same name as the
+        ledger method on purpose: the no-hot-sync lint's sanctioned
+        ``with ...dispatch(...)`` window and the phase-taxonomy lint
+        both match on the attribute name."""
+
+        attrs.setdefault("replica", self.replica_label or "0")
+        return self.ledger.dispatch(phase, **attrs)
+
+    def _request_span(self, req: _Request, name: str, *,
+                      start_mono: Optional[float] = None, **attrs):
+        """A lifecycle span on ``req``'s trace — a context manager
+        (nullcontext when untraced).  Pool lifecycle spans run on the
+        DRIVER thread, so they join the request's trace by explicit
+        trace id; ledger dispatches issued inside the entered span
+        nest under it via contextvars, which is what stitches HTTP →
+        router → replica → device dispatch into one waterfall."""
+
+        if self.tracer is None or req.trace_id is None:
+            return contextlib.nullcontext(None)
+        attrs.setdefault("rid", req.rid)
+        attrs.setdefault("replica", self.replica_label or "0")
+        return self.tracer.start_span(
+            name, trace_id=req.trace_id, attributes=attrs,
+            start_mono=start_mono,
+        )
+
+    def _emit_span(self, req: _Request, name: str, start_mono: float,
+                   end_mono: float, **attrs) -> None:
+        """A completed lifecycle span with explicit endpoints (e.g.
+        queue.wait backdated to submit, decode.window to the window's
+        bounds)."""
+
+        if self.tracer is None or req.trace_id is None:
+            return
+        attrs.setdefault("rid", req.rid)
+        attrs.setdefault("replica", self.replica_label or "0")
+        self.tracer.start_span(
+            name, trace_id=req.trace_id, attributes=attrs,
+            start_mono=start_mono,
+        ).end(end_mono=end_mono)
+
+    def _emit_queue_wait(self, req: _Request) -> None:
+        """The queue.wait span: submit → first admission work,
+        backdated to the submit timestamp so the waterfall shows the
+        real wait.  Once per request (guarded like t_first): an
+        admission retried after a transient device failure must not
+        emit a second span swallowing the first attempt."""
+
+        if req.queue_waited:
+            return
+        req.queue_waited = True
+        self._emit_span(
+            req, "queue.wait", req.t_submit_mono, time.monotonic(),
+        )
+
+    def _finish_request(self, req: _Request, blocks_freed: int = 0) -> None:
+        """Retirement bookkeeping shared by every completion path:
+        the retire lifecycle span (tagged blocks freed), the autopsy
+        entry's final timings, and the SLO observation."""
+
+        now = time.monotonic()
+        self._emit_span(
+            req, "retire", now, now, blocks_freed=blocks_freed,
+            tokens=len(req.tokens),
+        )
+        if req.entry is not None:
+            t_done = time.perf_counter()
+            t_first = req.t_first if req.t_first is not None else t_done
+            self.request_log.update(
+                req.entry,
+                state="done",
+                retire={"blocks_freed": int(blocks_freed)},
+                total_seconds=round(t_done - req.t_submit, 6),
+                tpot_seconds=round(
+                    (t_done - t_first) / max(1, len(req.tokens) - 1), 6
+                ),
+                tokens=len(req.tokens),
+            )
+        self._observe_done(req)
+
     def _observe_first_token(self, req: _Request, work_start: float) -> None:
         """First output token just landed on the host: observe
         queue-wait (submit → first device work) and TTFT (submit →
@@ -311,16 +545,26 @@ class ContinuousBatchingDecoder:
         if req.t_first is not None:
             return
         req.t_first = time.perf_counter()
+        if req.entry is not None:
+            self.request_log.update(
+                req.entry,
+                queue_wait_seconds=round(
+                    max(0.0, work_start - req.t_submit), 6
+                ),
+                ttft_seconds=round(req.t_first - req.t_submit, 6),
+            )
         if self.metrics is None:
             return
         self.metrics.observe_histogram(
             "serve_queue_wait_seconds",
             max(0.0, work_start - req.t_submit),
+            exemplar=req.trace_id,
             **self._labels(mode="pool"),
         )
         self.metrics.observe_histogram(
             "serve_ttft_seconds",
             req.t_first - req.t_submit,
+            exemplar=req.trace_id,
             **self._labels(mode="pool"),
         )
 
@@ -335,6 +579,7 @@ class ContinuousBatchingDecoder:
         self.metrics.observe_histogram(
             "serve_time_per_output_token_seconds",
             (t_done - t_first) / max(1, len(req.tokens) - 1),
+            exemplar=req.trace_id,
             **self._labels(mode="pool"),
         )
 
@@ -520,9 +765,19 @@ class ContinuousBatchingDecoder:
         temperature: float = 0.0,
         top_k: Optional[int] = None,
         rng: Optional[jax.Array] = None,
+        trace_id: Optional[str] = None,
     ) -> int:
         """Queue a single request ([P] int32).  Returns a request id;
-        collect the output with `result` after `step`s (or `run`)."""
+        collect the output with `result` after `step`s (or `run`).
+
+        ``trace_id`` is the request's first-class identity (ISSUE 11):
+        serve_lm passes its request span's trace id (which adopted any
+        incoming ``x-trace-id``), so every lifecycle span the pool
+        emits — queue.wait, admission, decode.window, retire — joins
+        the caller's trace, and the autopsy lands in ``request_log``
+        under that id.  Without one, the pool mints an id from its
+        tracer (or a local fallback), so direct submitters get the
+        same lifecycle record."""
 
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size < 1:
@@ -553,6 +808,18 @@ class ContinuousBatchingDecoder:
         req = _Request(
             rid, prompt, max_new_tokens, float(temperature), top_k, rng,
         )
+        if trace_id is not None:
+            req.trace_id = str(trace_id)
+        elif self.tracer is not None:
+            req.trace_id = self.tracer.mint_trace_id()
+        else:
+            req.trace_id = f"treq-{self.replica_label or 0}-{rid}"
+        req.entry = self.request_log.open(
+            id=req.trace_id, rid=rid,
+            replica=self.replica_label or "0", model=self.model_label,
+            prompt_tokens=int(prompt.size),
+            max_new_tokens=int(max_new_tokens),
+        )
         # fused-eligible requests (non-rolling cache, pad width fits)
         # queue host-side untouched: their ENTIRE admission — prefill,
         # first token, seating — is one compiled dispatch in _admit,
@@ -576,7 +843,7 @@ class ContinuousBatchingDecoder:
                 # never needs a slot
                 req.done = True
                 self._release_staged_locked(req)
-                self._observe_done(req)
+                self._finish_request(req)
                 self._done_cond.notify_all()
             else:
                 self._queue.append(req)
@@ -603,6 +870,11 @@ class ContinuousBatchingDecoder:
         burst OOM the chip."""
 
         work_start = time.perf_counter()
+        # queue.wait ends HERE — the first device work — matching the
+        # serve_queue_wait_seconds metric's clock; the later seating
+        # scatter is admission work, not queueing (a span emitted at
+        # seating would swallow the prefill into "queue.wait")
+        self._emit_queue_wait(req)
         cache = _init_cache_for(self.dmodel, 1)
         last = None
         off = 0
@@ -610,15 +882,17 @@ class ContinuousBatchingDecoder:
             ids = jnp.asarray(
                 req.prompt[off : off + width][None, :], jnp.int32
             )
-            with self.ledger.dispatch("prefill", rid=req.rid):
+            with self.dispatch("prefill", rid=req.rid):
                 cache, last = self._prefill(width)(self.params, cache, ids)
+            if req.entry is not None:
+                self.request_log.count_dispatch(req.entry, "prefill")
             off += width
         # the prompt's first sampled token comes from prefill logits.
         # Recorded as one "sample" ledger entry — the un-jitted op
         # group below is 1 (greedy) to ~3 (split+mask+categorical)
         # tiny device calls; the fused admission folds all of this
         # into its single program
-        with self.ledger.dispatch("sample", rid=req.rid):
+        with self.dispatch("sample", rid=req.rid):
             if req.temperature > 0.0:
                 req.rng, r = jax.random.split(req.rng)
                 scaled = last / req.temperature
@@ -630,6 +904,8 @@ class ContinuousBatchingDecoder:
         req.staged_cache = cache
         req.staged_tok = tok
         req.tokens.append(int(tok))
+        if req.entry is not None:
+            self.request_log.count_dispatch(req.entry, "sample")
         self._observe_first_token(req, work_start)
 
     def _admit_fused(self, req: _Request, slot: int, width: int) -> None:
@@ -644,24 +920,36 @@ class ContinuousBatchingDecoder:
         sampled = req.temperature > 0.0
         rng = req.rng if sampled else jnp.zeros((2,), jnp.uint32)
         work_start = time.perf_counter()
-        with self.ledger.dispatch("admission", rid=req.rid, width=width):
-            stack, toks, tok, rng_next = self._admission(width)(
-                self.params, self._cache, self._last_tok,
-                jnp.asarray(ids), jnp.int32(req.prompt.size),
-                jnp.int32(slot), jnp.float32(req.temperature),
-                jnp.int32(req.top_k or 0), rng,
-            )
-            tok_h = int(tok)  # host fetch: the ledger RTT includes it
+        self._emit_queue_wait(req)
+        with self._request_span(req, "admission", width=width, slot=slot):
+            with self.dispatch("admission", rid=req.rid, width=width):
+                stack, toks, tok, rng_next = self._admission(width)(
+                    self.params, self._cache, self._last_tok,
+                    jnp.asarray(ids), jnp.int32(req.prompt.size),
+                    jnp.int32(slot), jnp.float32(req.temperature),
+                    jnp.int32(req.top_k or 0), rng,
+                )
+                tok_h = int(tok)  # host fetch: the ledger RTT includes it
         self._cache, self._last_tok = stack, toks
         if sampled:
             req.rng = rng_next
         req.tokens.append(tok_h)
+        if req.entry is not None:
+            self.request_log.count_dispatch(req.entry, "admission")
+            self.request_log.update(
+                req.entry, state="active", slot=slot,
+                admission={
+                    "width": int(width),
+                    "prefill_dispatches": 0,
+                    "seconds": round(time.perf_counter() - work_start, 6),
+                },
+            )
         self._observe_first_token(req, work_start)
         if len(req.tokens) >= req.budget:
             # budget-1: the admission token completed it; the scattered
             # cache rows are dead and the slot stays free
             req.done = True
-            self._observe_done(req)
+            self._finish_request(req)
             self._done_cond.notify_all()
         else:
             req.slot = slot
@@ -726,16 +1014,29 @@ class ContinuousBatchingDecoder:
                     # completed it — never needs the seat after all
                     req.done = True
                     self._release_staged_locked(req)
-                    self._observe_done(req)
+                    self._finish_request(req)
                     self._update_gauges_locked()
                     self._done_cond.notify_all()
                     continue
-                with self.ledger.dispatch("scatter", rid=req.rid):
-                    self._cache, self._last_tok = self._scatter()(
-                        self._cache, req.staged_cache, req.staged_tok,
-                        self._last_tok, jnp.int32(slot),
-                    )
+                with self._request_span(req, "admission", slot=slot,
+                                        path="staged"):
+                    with self.dispatch("scatter", rid=req.rid):
+                        self._cache, self._last_tok = self._scatter()(
+                            self._cache, req.staged_cache, req.staged_tok,
+                            self._last_tok, jnp.int32(slot),
+                        )
                 self._release_staged_locked(req)
+                if req.entry is not None:
+                    self.request_log.count_dispatch(req.entry, "scatter")
+                    self.request_log.update(
+                        req.entry, state="active", slot=slot,
+                        admission={
+                            "width": None,
+                            "prefill_dispatches": req.entry["dispatches"]
+                            .get("prefill", 0),
+                            "path": "staged",
+                        },
+                    )
                 req.slot = slot
                 self._active[slot] = req
                 self._update_gauges_locked()
@@ -770,7 +1071,9 @@ class ContinuousBatchingDecoder:
                 if req.temperature > 0.0:
                     req.rng, r = jax.random.split(req.rng)
                     rngs[slot] = np.asarray(r)
-            with self.ledger.dispatch("step", active=len(self._active)):
+            seats_active = len(self._active)
+            t_window0 = time.monotonic()
+            with self.dispatch("step", active=seats_active):
                 self._cache, self._last_tok, toks_k = self._step()(
                     self.params,
                     self._cache,
@@ -780,18 +1083,25 @@ class ContinuousBatchingDecoder:
                     jnp.asarray(rngs),
                 )
                 host_toks = np.asarray(toks_k)  # [K, slots]
+            t_window1 = time.monotonic()
             finished = False
             for slot in list(self._active):
                 req = self._active[slot]
                 take = min(len(host_toks), req.budget - len(req.tokens))
                 req.tokens.extend(int(t) for t in host_toks[:take, slot])
+                self._emit_span(
+                    req, "decode.window", t_window0, t_window1,
+                    tokens=take, seats_active=seats_active,
+                )
+                if req.entry is not None:
+                    self.request_log.add_window(req.entry, take)
                 if len(req.tokens) >= req.budget:
                     # overshoot steps (< K) wrote only this slot's own
                     # dead cache rows; admission scatters a fresh cache
                     req.done = True
                     req.slot = None
                     del self._active[slot]
-                    self._observe_done(req)
+                    self._finish_request(req)
                     finished = True
             self._update_gauges_locked()
             if finished:
@@ -1030,6 +1340,14 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
             can_evict=lambda bid: self.alloc.refcount(bid) == 1,
             on_evict=lambda bid: self.alloc.release([bid]),
         )
+        #: ISSUE 11: bounded occupancy history — one sample per gauge
+        #: refresh (every decode window + admission/retire), served at
+        #: /debug/arena and carried in flight-recorder dumps; the
+        #: time-series twin of the kv_blocks_pressure gauge
+        self.timeline = ArenaTimeline(
+            block_size=self.block_size, usable=self.alloc.usable,
+            replica=self.replica_label or "0",
+        )
         self._update_kv_gauges()
 
     def _init_pool_cache(self, row0) -> None:
@@ -1054,12 +1372,21 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
         ramp mid-burst, while an idle pool with cold cache entries
         still reads plain occupancy."""
 
-        if self.metrics is None:
-            return
-        rep = self.replica_label or "0"
         free = float(self.alloc.free_count)
         total = float(self.alloc.usable)
         queued = float(self._queued_blocks())
+        # timeline sample regardless of a metrics sink: the occupancy
+        # history is its own read surface (host arithmetic only)
+        self.timeline.sample(
+            free=int(free),
+            live=int(total - free),
+            prefix_cached=len(self.prefix),
+            queued_demand=int(queued),
+            seats_active=len(self._active),
+        )
+        if self.metrics is None:
+            return
+        rep = self.replica_label or "0"
         self.metrics.set(
             "kv_blocks_free", free, model=self.model_label, replica=rep
         )
@@ -1249,21 +1576,32 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
         )
         sampled = req.temperature > 0.0
         rng = req.rng if sampled else jnp.zeros((2,), jnp.uint32)
+        blocks_reserved = len(plan["shared"]) + len(plan["new"])
         work_start = time.perf_counter()
-        with self.ledger.dispatch(
-            "admission", rid=req.rid, width=width, prefix_tokens=prefix_len,
+        self._emit_queue_wait(req)
+        with self._request_span(
+            req, "admission", width=width, slot=slot,
+            blocks_reserved=blocks_reserved,
+            prefix_hit_tokens=prefix_len,
+            prefix_hit_blocks=len(plan["shared"]),
         ):
-            (arena, toks, tables_dev, lengths_dev, temps_dev, topks_dev,
-             rngs_dev, tok, rng_next) = self._admission(width)(
-                self.params, self._arena, self._last_tok,
-                self._tables_dev, self._lengths_dev, self._temps_dev,
-                self._topks_dev, self._rngs_dev,
-                jnp.asarray(row_pad), jnp.asarray(ids),
-                jnp.int32(prefix_len), jnp.int32(remainder),
-                jnp.int32(slot), jnp.float32(req.temperature),
-                jnp.int32(req.top_k or 0), rng,
-            )
-            tok_h = int(tok)  # host fetch: the ledger RTT includes it
+            with self.dispatch(
+                "admission", rid=req.rid, width=width,
+                prefix_tokens=prefix_len,
+            ):
+                (arena, toks, tables_dev, lengths_dev, temps_dev,
+                 topks_dev, rngs_dev, tok, rng_next) = self._admission(
+                    width
+                )(
+                    self.params, self._arena, self._last_tok,
+                    self._tables_dev, self._lengths_dev, self._temps_dev,
+                    self._topks_dev, self._rngs_dev,
+                    jnp.asarray(row_pad), jnp.asarray(ids),
+                    jnp.int32(prefix_len), jnp.int32(remainder),
+                    jnp.int32(slot), jnp.float32(req.temperature),
+                    jnp.int32(req.top_k or 0), rng,
+                )
+                tok_h = int(tok)  # host fetch: the ledger RTT includes it
         self._arena, self._last_tok = arena, toks
         self._tables_dev, self._lengths_dev = tables_dev, lengths_dev
         self._temps_dev, self._topks_dev = temps_dev, topks_dev
@@ -1281,6 +1619,19 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                 bid = int(plan["row"][i])
                 self.alloc.retain([bid])
                 self.prefix.put(key, bid)
+        if req.entry is not None:
+            self.request_log.count_dispatch(req.entry, "admission")
+            self.request_log.update(
+                req.entry, state="active", slot=slot,
+                admission={
+                    "width": int(width),
+                    "blocks_reserved": blocks_reserved,
+                    "prefix_hit_tokens": int(prefix_len),
+                    "prefix_hit_blocks": len(plan["shared"]),
+                    "prefill_dispatches": 0,
+                    "seconds": round(time.perf_counter() - work_start, 6),
+                },
+            )
         self._observe_first_token(req, work_start)
         refs = plan["shared"] + plan["new"]
         if len(req.tokens) >= req.budget:
@@ -1291,9 +1642,9 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
             # seat, and a stale table row would let this never-seated
             # slot's step writes corrupt the new owner
             req.done = True
-            self.alloc.release(refs)
-            self._retire_device_locked([slot])
-            self._observe_done(req)
+            freed = self.alloc.release(refs)
+            self._retire_device_locked([slot], reqs=[req])
+            self._finish_request(req, blocks_freed=freed)
             self._done_cond.notify_all()
         else:
             req.slot = slot
@@ -1369,19 +1720,24 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                 self.compile_count += 1
             return self._retire_fn
 
-    def _retire_device_locked(self, slots) -> None:
+    def _retire_device_locked(self, slots, reqs=()) -> None:
         """Reset the device-resident rows of ``slots`` (one dispatch
         for the whole batch, ledger phase ``retire`` — admission-class
-        work, never on the steady-state step path)."""
+        work, never on the steady-state step path).  ``reqs`` are the
+        retiring requests: each counts its share of the batched
+        dispatch in its autopsy entry."""
 
         mask = np.zeros((self.slots,), bool)
         mask[list(slots)] = True
-        with self.ledger.dispatch("retire", slots=len(slots)):
+        with self.dispatch("retire", slots=len(slots)):
             (self._tables_dev, self._lengths_dev, self._temps_dev,
              self._topks_dev) = self._retire()(
                 self._tables_dev, self._lengths_dev, self._temps_dev,
                 self._topks_dev, mask,
             )
+        for req in reqs:
+            if req.entry is not None:
+                self.request_log.count_dispatch(req.entry, "retire")
 
     # -- decode step -------------------------------------------------------
 
@@ -1464,10 +1820,15 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
             self.compile_count += 1
         return self._step_fn
 
-    def _retire_seat_locked(self, slot: int) -> None:
+    def _retire_seat_locked(self, slot: int) -> int:
+        """Release the seat's block references; returns how many
+        blocks actually went back to the free list (shared prefix
+        blocks a cache entry still holds do not)."""
+
         refs = self._seat_refs.pop(slot, [])
         if refs:
-            self.alloc.release(refs)
+            return self.alloc.release(refs)
+        return 0
 
     def step(self) -> int:
         """Admit (block-gated), run `steps_per_sync` decode steps over
@@ -1486,16 +1847,20 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                 # kv_blocks_pressure (host arithmetic, no device work)
                 self._update_gauges_locked()
                 return 0
-            with self.ledger.dispatch("step", active=len(self._active)):
+            seats_active = len(self._active)
+            t_window0 = time.monotonic()
+            with self.dispatch("step", active=seats_active):
                 (arena, lengths_dev, rngs_dev, toks, toks_k) = self._step()(
                     self.params, self._arena, self._tables_dev,
                     self._lengths_dev, self._temps_dev, self._topks_dev,
                     self._rngs_dev, self._last_tok,
                 )
                 host_toks = np.asarray(toks_k)  # [K, slots]
+            t_window1 = time.monotonic()
             self._arena, self._last_tok = arena, toks
             self._lengths_dev, self._rngs_dev = lengths_dev, rngs_dev
             finished = []
+            finished_reqs = []
             for slot in list(self._active):
                 req = self._active[slot]
                 # the cache now holds K more positions for this seat
@@ -1506,18 +1871,25 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                 # in-budget span)
                 take = min(len(host_toks), req.budget - len(req.tokens))
                 req.tokens.extend(int(t) for t in host_toks[:take, slot])
+                self._emit_span(
+                    req, "decode.window", t_window0, t_window1,
+                    tokens=take, seats_active=seats_active,
+                )
+                if req.entry is not None:
+                    self.request_log.add_window(req.entry, take)
                 if len(req.tokens) >= req.budget:
                     req.done = True
                     req.slot = None
                     del self._active[slot]
-                    self._retire_seat_locked(slot)
-                    self._observe_done(req)
+                    freed = self._retire_seat_locked(slot)
+                    self._finish_request(req, blocks_freed=freed)
                     finished.append(slot)
+                    finished_reqs.append(req)
             if finished:
                 # freed blocks may re-allocate immediately: the dead
                 # seats' device table rows must go back to scratch
                 # before the next step's in-place appends
-                self._retire_device_locked(finished)
+                self._retire_device_locked(finished, reqs=finished_reqs)
             self._update_gauges_locked()
             if finished:
                 self._done_cond.notify_all()

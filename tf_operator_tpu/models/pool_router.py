@@ -28,7 +28,7 @@ pins.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 
 class PoolRouter:
@@ -38,12 +38,20 @@ class PoolRouter:
     Decoder instances (mixed is allowed but pointless).  Thread-safe:
     submit/result_wait may race driver threads exactly like a single
     pool's surface.
+
+    ISSUE 11: routing is part of a request's lifecycle — with a
+    ``tracer`` every submit emits a ``route`` span on the request's
+    trace, tagged the chosen replica and its ``load_score`` (plus the
+    full score vector), so the waterfall answers "why did THIS replica
+    serve it".  The router also merges the per-replica request logs /
+    arena timelines for the /requests and /debug/arena endpoints.
     """
 
-    def __init__(self, pools: List):
+    def __init__(self, pools: List, tracer=None):
         if not pools:
             raise ValueError("router needs at least one pool replica")
         self.pools = list(pools)
+        self.tracer = tracer
         self._lock = threading.Lock()
         self._rid = 0
         #: router rid -> (pool index, pool-local rid)
@@ -67,12 +75,72 @@ class PoolRouter:
 
         scores = self.load_scores()
         idx = min(range(len(self.pools)), key=lambda i: (scores[i], i))
-        prid = self.pools[idx].submit(prompt_ids, max_new_tokens, **kw)
+        # the request's identity is settled HERE (adopted from the
+        # caller or minted) so the route span and the replica's
+        # lifecycle spans share one trace id
+        tid = kw.get("trace_id")
+        if tid is None and self.tracer is not None:
+            tid = self.tracer.mint_trace_id()
+            kw["trace_id"] = tid
+        if self.tracer is not None:
+            span = self.tracer.start_span(
+                "route", trace_id=tid, attributes={
+                    "replica": str(idx),
+                    "load_score": round(scores[idx], 4),
+                    "scores": [round(s, 4) for s in scores],
+                },
+            )
+            with span:
+                prid = self.pools[idx].submit(
+                    prompt_ids, max_new_tokens, **kw
+                )
+                span.set_attribute("rid", prid)
+        else:
+            prid = self.pools[idx].submit(prompt_ids, max_new_tokens, **kw)
         with self._lock:
             rid = self._rid
             self._rid += 1
             self._route[rid] = (idx, prid)
         return rid
+
+    # -- merged observability reads (ISSUE 11) ---------------------------
+
+    def request_autopsy(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """The request's lifecycle record, or None.  Ids are trace
+        ids, normally unique — but a client reusing an ``x-trace-id``
+        can land the same id on TWO replicas (per-replica ``~rid``
+        demotion never fires across logs), so matches are resolved
+        newest-submit-first to honor RequestLog's latest-wins
+        contract."""
+
+        matches = [
+            entry
+            for p in self.pools
+            if (entry := p.request_log.get(request_id)) is not None
+        ]
+        if not matches:
+            return None
+        return max(matches, key=lambda e: e.get("submit_unix", 0.0))
+
+    def recent_requests(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Newest-first autopsies merged across every replica's log
+        (the /slo merged-family pattern applied to request records)."""
+
+        merged: List[Dict[str, Any]] = []
+        for p in self.pools:
+            merged.extend(p.request_log.recent(limit))
+        merged.sort(key=lambda e: e.get("submit_unix", 0.0), reverse=True)
+        return merged[:limit]
+
+    def arena_snapshots(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Per-replica arena-timeline snapshots (paged replicas only —
+        contiguous pools have no arena)."""
+
+        return [
+            p.timeline.snapshot(limit)
+            for p in self.pools
+            if getattr(p, "timeline", None) is not None
+        ]
 
     def _lookup(self, rid: int) -> Tuple[int, int]:
         with self._lock:

@@ -26,7 +26,16 @@ subsystem's /traces endpoints, utils/trace.py):
   (`workqueue_depth`, `workqueue_queue_latency_seconds`);
 - **traces** — recent trace summaries (tail sampling keeps error and
   slow traces), slow queue waits flagged, click-through to a span
-  waterfall rendered from /traces/<id>.
+  waterfall rendered from /traces/<id>;
+- **kv arena** (ISSUE 11) — the serving plane's block-arena occupancy
+  strip, one stacked band per replica rendered from the
+  `/debug/arena` timeline (live blocks, prefix-cached share, queued
+  demand overflow) — the time-series twin of the instantaneous
+  `kv_blocks_pressure` gauge.  The panel self-hides when there is no
+  paged-pool data: the operator API has no `/debug/arena` route (the
+  fetch 404s), and serve_lm without a paged pool answers 200 with an
+  empty `replicas` list — both paths leave the panel hidden, so the
+  operator dashboard and an embedded serving dashboard share one page.
 """
 
 DASHBOARD_HTML = """<!doctype html>
@@ -115,6 +124,10 @@ DASHBOARD_HTML = """<!doctype html>
   <th>p50 &le;</th><th>p99 &le;</th></tr></thead>
   <tbody><tr><td class="muted" colspan="5">no latency histograms yet</td></tr></tbody>
 </table>
+<div id="arena-panel" style="display:none">
+<h2>kv arena</h2>
+<div id="arena"></div>
+</div>
 <h2>traces</h2>
 <table id="traces">
   <thead><tr><th>trace</th><th>root</th><th>spans</th><th>duration</th>
@@ -180,6 +193,79 @@ async function refresh() {
   refreshAutoscaler();
   refreshHealth();
   refreshTraces();
+  refreshArena();
+}
+
+async function refreshArena() {
+  // KV-arena occupancy strip (ISSUE 11): per-replica timeline from
+  // /debug/arena — live (blue) with the prefix-cached share (green)
+  // stacked from the bottom, queued demand (amber) above the line.
+  // No data hides the panel: the operator API 404s (no such route),
+  // serve_lm without a paged pool answers an empty replicas list.
+  let snap;
+  try {
+    const res = await fetch("/debug/arena");
+    if (!res.ok) throw new Error("no arena");
+    snap = await res.json();
+  } catch (e) {
+    document.getElementById("arena-panel").style.display = "none";
+    return;
+  }
+  const reps = (snap.replicas || []).filter(r => (r.samples || []).length);
+  const panel = document.getElementById("arena-panel");
+  if (!reps.length) { panel.style.display = "none"; return; }
+  panel.style.display = "";
+  const el = document.getElementById("arena");
+  el.innerHTML = "";
+  const W = 640, H = 48;
+  for (const rep of reps) {
+    const samples = rep.samples.slice(-160);
+    const usable = rep.usable || 1;
+    const svg = document.createElementNS("http://www.w3.org/2000/svg", "svg");
+    svg.setAttribute("width", W); svg.setAttribute("height", H);
+    svg.style.background = "#f6f6f6"; svg.style.border = "1px solid #e5e5e5";
+    // x-axis is TIME, not sample count: the ring collapses identical
+    // consecutive samples, so a sample's bar must stretch until the
+    // NEXT state change or the strip would compress quiet plateaus
+    // into slivers and stretch bursts across the whole width
+    const t0 = samples[0].unix;
+    const span = Math.max(samples[samples.length - 1].unix - t0, 1e-9);
+    const xs = samples.map(s => W * (s.unix - t0) / span);
+    for (const [i, s] of samples.entries()) {
+      const xEnd = i + 1 < samples.length ? xs[i + 1] : W;
+      const bw = Math.max(1, xEnd - xs[i]);
+      // clamp INTO the canvas: the newest sample IS the latest state
+      // change (dedupe), and at xs=W it would render clipped,
+      // contradicting the text label below
+      const x = Math.min(xs[i], W - bw).toFixed(2);
+      const live = Math.min(1, s.live / usable);
+      const cached = Math.min(live, s.prefix_cached / usable);
+      const queued = Math.min(1, s.queued_demand / usable);
+      const mk = (frac, y0frac, color) => {
+        if (frac <= 0) return;
+        const r = document.createElementNS(
+          "http://www.w3.org/2000/svg", "rect");
+        r.setAttribute("x", x); r.setAttribute("width", bw.toFixed(2));
+        r.setAttribute("y", (H * (1 - y0frac - frac)).toFixed(2));
+        r.setAttribute("height", Math.max(1, H * frac).toFixed(2));
+        r.setAttribute("fill", color);
+        svg.appendChild(r);
+      };
+      mk(live - cached, cached, "#0b57d0");   // seat-mapped blocks
+      mk(cached, 0, "#0a7d32");               // prefix-cached share
+      // queued demand renders as an over-line marker band at the top
+      if (queued > 0) mk(Math.min(0.12, 0.12 * queued), 0.88, "#a86500");
+    }
+    const last = samples[samples.length - 1];
+    const label = document.createElement("div");
+    label.className = "muted";
+    label.textContent =
+      `replica ${rep.replica}: ${last.live}/${usable} blocks live ` +
+      `(${last.prefix_cached} prefix-cached), ` +
+      `${last.queued_demand} queued demand, ` +
+      `${last.seats_active} seats — ${samples.length} samples`;
+    el.appendChild(svg); el.appendChild(label);
+  }
 }
 
 async function refreshAutoscaler() {

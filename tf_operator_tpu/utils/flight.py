@@ -52,7 +52,7 @@ def _ring_log_handler(recorder: "FlightRecorder"):
             except Exception:  # a recorder bug must never kill logging
                 # counted, not logged: logging from a failing log
                 # handler would recurse
-                recorder.ring_errors += 1
+                recorder._count_ring_errors()
 
     return Handler()
 
@@ -74,6 +74,8 @@ class FlightRecorder:
         max_spans: int = 256,
         max_logs: int = 512,
         max_snapshots: int = 32,
+        max_requests: int = 16,
+        max_arena_samples: int = 64,
     ):
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=max_spans)
@@ -82,9 +84,24 @@ class FlightRecorder:
         self._metrics = None
         self._last_counters: Dict[str, float] = {}
         self._dumps = 0
-        #: recorder-internal failures (ring-handler emit errors) —
-        #: surfaced in the dump meta record rather than swallowed
+        #: ISSUE 11: serving-plane attach points — request autopsies
+        #: (models/batching.RequestLog) and arena occupancy timelines
+        #: (models/kv_blocks.ArenaTimeline).  Bounded deques: tests
+        #: build handlers by the dozen against the process-global
+        #: recorder, so stale sources age out instead of accumulating.
+        self.max_requests = int(max_requests)
+        self.max_arena_samples = int(max_arena_samples)
+        self._request_logs: deque = deque(maxlen=8)
+        self._arena_timelines: deque = deque(maxlen=8)
+        #: recorder-internal failures (ring-handler emit errors, dump
+        #: source errors) — surfaced in the dump meta record rather
+        #: than swallowed.  Counted through _count_ring_errors so two
+        #: concurrent dumps cannot lose an increment.
         self.ring_errors = 0
+
+    def _count_ring_errors(self, n: int = 1) -> None:
+        with self._lock:
+            self.ring_errors += n
 
     # -- recording ----------------------------------------------------------
 
@@ -151,17 +168,59 @@ class FlightRecorder:
     def attach_metrics(self, metrics) -> None:
         self._metrics = metrics
 
+    def attach_request_log(self, log) -> None:
+        """Register a serving RequestLog: every dump carries its
+        last-K request autopsies, so a post-mortem names the requests
+        in flight when the episode fired (ISSUE 11 bugfix)."""
+
+        with self._lock:
+            self._request_logs.append(log)
+
+    def attach_arena_timeline(self, timeline) -> None:
+        """Register a KV-arena occupancy timeline: every dump carries
+        its sample tail — the pressure history leading into the
+        failure, not just the final gauge value."""
+
+        with self._lock:
+            self._arena_timelines.append(timeline)
+
     # -- export -------------------------------------------------------------
 
     def records(self) -> List[Dict[str, Any]]:
-        """meta + spans + logs + metric snapshots, oldest-first within
-        each section — the exact dump order (determinism contract)."""
+        """meta + spans + logs + metric snapshots + request autopsies
+        + arena timelines, oldest-first within each section — the
+        exact dump order (determinism contract).  The serving sections
+        appear only when sources are attached and non-empty."""
 
         with self._lock:
             spans = list(self._spans)
             logs = list(self._logs)
             snaps = list(self._snapshots)
             dumps = self._dumps
+            request_logs = list(self._request_logs)
+            timelines = list(self._arena_timelines)
+        source_errors = 0
+        requests: List[Dict[str, Any]] = []
+        for log in request_logs if self.max_requests > 0 else []:
+            try:
+                requests.extend(log.recent(self.max_requests))
+            except Exception:  # a source bug must never kill a dump
+                source_errors += 1
+        # time-merge across logs BEFORE truncating: a plain per-log
+        # concatenation would let the last-attached replica's entries
+        # crowd every other replica out of the K-slot tail
+        requests.sort(key=lambda e: e.get("submit_unix", 0.0))
+        requests = requests[-self.max_requests:] if requests else []
+        arenas: List[Dict[str, Any]] = []
+        for tl in timelines if self.max_arena_samples > 0 else []:
+            try:
+                snap = tl.snapshot(self.max_arena_samples)
+                if snap["samples"]:
+                    arenas.append(snap)
+            except Exception:
+                source_errors += 1
+        if source_errors:
+            self._count_ring_errors(source_errors)
         meta = {
             "type": "meta",
             "pid": os.getpid(),
@@ -169,6 +228,8 @@ class FlightRecorder:
             "spans": len(spans),
             "logs": len(logs),
             "metricSnapshots": len(snaps),
+            "requests": len(requests),
+            "arenaTimelines": len(arenas),
             "priorDumps": dumps,
             "ringErrors": self.ring_errors,
         }
@@ -176,6 +237,8 @@ class FlightRecorder:
         out.extend({"type": "span", **s} for s in spans)
         out.extend({"type": "log", **r} for r in logs)
         out.extend({"type": "metrics", **s} for s in snaps)
+        out.extend({"type": "request", **r} for r in requests)
+        out.extend({"type": "arena", **a} for a in arenas)
         return out
 
     def dump(self, fileobj=None, path: Optional[str] = None, reason: str = "") -> str:

@@ -28,6 +28,27 @@ SLO_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
 
+#: the serving DispatchLedger phase taxonomy — the closed set of
+#: literal phases the serving decoders pass to ``ledger.dispatch()``.
+#: Every phase here lowers to a ``dispatch.<phase>`` trace span (the
+#: ledger derives the span name), and the request-autopsy /
+#: waterfall layers key on those literal names, so a renamed phase
+#: would silently orphan them.  tests/test_alert_rules_lint.py walks
+#: the package AST and pins the emitted literals against this tuple
+#: in BOTH directions (ISSUE 11 satellite).
+DISPATCH_PHASES = (
+    "admission",  # pool fused prefill+sample+seat (one program)
+    "prefill",    # legacy/chunked prompt chunks; speculative prefills
+    "sample",     # legacy pool first-token sample
+    "scatter",    # legacy pool seating scatter
+    "step",       # pool K-step decode window; speculative host driver
+    "retire",     # paged pool batched device-state reset
+    "decode",     # chunked decoder budget loop
+    "generate",   # speculative fused whole-generation program
+    "round",      # speculative host-driven round loop
+    "chunk",      # speculative scan driver
+)
+
 
 def finite_summary(summary: Dict[str, float]) -> Dict[str, Any]:
     """JSON-safe histogram summary for the /slo endpoints: a quantile
@@ -154,16 +175,26 @@ class Metrics:
         name: str,
         value: float,
         buckets: "Tuple[float, ...] | None" = None,
+        *,
+        exemplar: "str | None" = None,
         **labels: str,
     ) -> None:
         """Bounded-memory histogram (Prometheus bucket semantics) — use
         for unbounded-cardinality series like per-sync durations, where
         the raw-observation list of ``observe`` would leak.  Labeled:
         each label set is its own bucket series within the family
-        (``serve_ttft_seconds{model="llama-tiny"}``)."""
+        (``serve_ttft_seconds{model="llama-tiny"}``).  ``exemplar``
+        records a trace id against the FAMILY (same store and
+        latest-write-wins semantics as ``inc``'s exemplars, surfaced
+        as ``# exemplar`` comment lines — deliberately not per label
+        set: one freshest reproduction per family is the contract the
+        dashboard's deep-links parse) — "p99 TTFT is bad" deep-links
+        to a request that lived it (ISSUE 11 satellite)."""
 
         key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
         with self._lock:
+            if exemplar:
+                self._exemplars[name] = str(exemplar)
             h = self._histograms.get(key)
             if h is None:
                 bks = (
@@ -421,14 +452,13 @@ class DispatchLedger:
     (the same program structure runs everywhere); the measured
     per-dispatch seconds are this box's RTT+device share.
 
-    Phases are free-form strings; the serving convention is
-    ``admission`` (the pool's fused prefill+sample+seat program),
-    ``prefill`` / ``scatter`` (the pool's legacy rolling-window path
-    and the chunked decoder's prompt chunks), ``step`` (the pool's
-    K-step sync), ``decode`` (the chunked decoder's budget loop),
-    ``generate`` (speculative's fused whole-generation program),
-    ``round`` / ``chunk`` (speculative's host-driven and scan
-    drivers).
+    Phases are strings from the CLOSED ``DISPATCH_PHASES`` taxonomy
+    (above — the single source of truth, one line of intent per
+    phase): each lowers to a ``dispatch.<phase>`` span that the
+    request-autopsy/waterfall layers key on, and the lint in
+    tests/test_alert_rules_lint.py pins every literal phase in the
+    code against the taxonomy BOTH ways — adding or renaming a phase
+    means updating DISPATCH_PHASES in the same change.
 
     Optional sinks, both None-safe:
       - ``metrics``: every dispatch increments

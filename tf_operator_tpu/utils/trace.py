@@ -327,6 +327,16 @@ class Tracer:
             self._counter += 1
             return f"{tag}{self._prefix}{self._counter:06x}"
 
+    def mint_trace_id(self) -> str:
+        """A fresh trace id WITHOUT starting a span — the serving
+        pool's request identity when a request arrives with no
+        incoming trace context (ISSUE 11: every request gets a
+        first-class id at submit; the HTTP path adopts ``x-trace-id``
+        instead).  Same id space as span-rooted traces, so the later
+        lifecycle spans join it exactly like a remote trace."""
+
+        return self._next_id("t")
+
     # -- span creation ------------------------------------------------------
 
     def start_span(
