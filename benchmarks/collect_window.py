@@ -279,6 +279,18 @@ def write_last_measured(data: dict, today: str) -> None:
         "paged_kernel_fused_",
         "paged_kernel_read_speedup_",
         "paged_kernel_interpret_max_err",
+        # ISSUE 12 leg E: budget-on-demand capacity vs the worst-case
+        # reservation baseline, per-tier SLO quantiles, preemption and
+        # swap traffic under the two-tier oversubscribed trace
+        "paged_lazy_capacity_",
+        "paged_lazy_tokens_per_sec",
+        "paged_worstcase_capacity_concurrent",
+        "paged_worstcase_tokens_per_sec",
+        "paged_tier_interactive_p99_",
+        "paged_tier_batch_p99_",
+        "paged_preemptions",
+        "paged_swap_out_bytes",
+        "paged_swap_in_bytes",
     )
     for key in sorted(pg):
         if key.startswith(_MEASURED_PREFIXES) and isinstance(
@@ -531,6 +543,33 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             f"artifact; {capacity_caveat}{kernel_txt}) "
             f"| {provenance}, {today} |"
         )
+        # ISSUE 12 leg E: the budget-on-demand + preemption + tier row
+        if pg.get("paged_lazy_capacity_concurrent") is not None:
+            rows["Tiered oversubscribed serving"] = (
+                "| Tiered oversubscribed serving (two-tier bursty "
+                f"trace, {pg.get('paged_tier_trace_requests', '?')} "
+                "requests at "
+                f"{pg.get('paged_tier_trace_demand_ratio', '?')}× "
+                "worst-case arena demand, interactive share "
+                f"{pg.get('paged_tier_interactive_share', '?')}) | "
+                "budget-on-demand admits "
+                f"**{pg.get('paged_lazy_capacity_concurrent', '?')} "
+                "concurrent** vs "
+                f"{pg.get('paged_worstcase_capacity_concurrent', '?')} "
+                "worst-case-reserved — "
+                f"**{pg.get('paged_lazy_capacity_ratio', '?')}×**; "
+                "interactive p99 TTFT "
+                f"**{pg.get('paged_tier_interactive_p99_ttft_s', '?')} "
+                "s** vs batch "
+                f"{pg.get('paged_tier_batch_p99_ttft_s', '?')} s; "
+                f"{pg.get('paged_preemptions', '?')} preemption(s), "
+                f"swap {pg.get('paged_swap_out_bytes', '?')} B out / "
+                f"{pg.get('paged_swap_in_bytes', '?')} B in "
+                "(`models/batching.py` lazy reservation + mid-decode "
+                "preemption with host KV swap + SLO tiers; "
+                f"{'on-chip' if on_chip else 'CPU smoke — tok/s cells are chip-meaningful only'}) "
+                f"| {provenance}, {today} |"
+            )
     sp = data.get("speculative")
     if sp:
         wide_txt = (

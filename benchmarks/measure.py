@@ -824,6 +824,103 @@ def bench_paged() -> dict:
             "skipped: compiled kernel needs the TPU backend "
             "(interpret probe above pins the kernel path)"
         )
+
+    # leg E — two-tier bursty oversubscription (ISSUE 12): an
+    # interactive minority + batch majority whose WORST-CASE block
+    # demand runs ~1.5x the arena.  Budget-on-demand admission packs
+    # strictly more concurrent seats than PR 8's worst-case
+    # reservation at the same arena (paged_lazy_capacity_* vs
+    # paged_worstcase_capacity_concurrent), and the preemption/swap
+    # machinery keeps interactive p99 TTFT honest while batch degrades
+    # gracefully — per-tier quantiles, preemption and swap-byte
+    # counts all land in the artifact.
+    seats_e = 4 * slots_base
+    arena_e = slots_base * (seq // block)
+    rt = np.random.RandomState(42)
+    trace_e = []
+    demand = 0
+    target_demand = int(1.5 * arena_e)
+    while demand < target_demand:
+        p_len = int(rt.randint(4, max(5, seq // 8)))
+        budget = int(rt.choice([32, 48, 64]))
+        if p_len + budget > seq:
+            budget = seq - p_len
+        tier = "interactive" if rt.rand() < 0.25 else "batch"
+        prompt = rt.randint(0, vocab, size=(p_len,)).astype(np.int32)
+        trace_e.append((prompt, budget, tier))
+        demand += blocks_for(p_len + budget, block)
+    out["paged_tier_trace_requests"] = len(trace_e)
+    out["paged_tier_trace_demand_ratio"] = round(demand / arena_e, 2)
+    out["paged_tier_interactive_share"] = round(
+        sum(1 for _, _, t in trace_e if t == "interactive")
+        / len(trace_e), 2,
+    )
+
+    def replay_tiered(reserve: str):
+        metrics = Metrics()
+        metrics.set_buckets("serve_ttft_seconds", SLO_BUCKETS)
+        metrics.set_buckets("serve_queue_wait_seconds", SLO_BUCKETS)
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=seats_e, steps_per_sync=k_sync,
+            kv_blocks=arena_e, kv_block_size=block, metrics=metrics,
+            model_label="paged-bench", reserve=reserve,
+            age_boost_seconds=2.0,
+        )
+        # warmup compiles the admission width classes off the clock
+        for p, budget, tier in trace_e[: max(4, burst)]:
+            pool.submit(p, budget, tier=tier)
+        pool.run()
+        pool.ledger.reset()
+        metrics2 = Metrics()
+        metrics2.set_buckets("serve_ttft_seconds", SLO_BUCKETS)
+        metrics2.set_buckets("serve_queue_wait_seconds", SLO_BUCKETS)
+        pool.metrics = metrics2
+        pool.preemptions = 0
+        max_conc = 0
+        new_toks = sum(b for _, b, _ in trace_e)
+        t0 = time.perf_counter()
+        i = 0
+        while True:
+            for p, budget, tier in trace_e[i : i + burst]:
+                pool.submit(p, budget, tier=tier)
+            i += burst
+            active = pool.step()
+            with pool._lock:
+                max_conc = max(max_conc, len(pool._active))
+            if i >= len(trace_e) and active == 0:
+                with pool._lock:
+                    if not pool._queue:
+                        break
+        wall = time.perf_counter() - t0
+        pool.alloc.check()
+        return wall, max_conc, pool, metrics2, new_toks
+
+    wall_lz, conc_lz, pool_lz, m_lz, toks_e = replay_tiered("lazy")
+    out["paged_lazy_capacity_concurrent"] = conc_lz
+    out["paged_lazy_tokens_per_sec"] = round(toks_e / wall_lz, 1)
+    for tier in ("interactive", "batch"):
+        out[f"paged_tier_{tier}_p99_ttft_s"] = m_lz.histogram(
+            "serve_ttft_seconds", model="paged-bench", mode="pool",
+            tier=tier,
+        ).get("p99_le")
+        out[f"paged_tier_{tier}_p99_queue_wait_s"] = m_lz.histogram(
+            "serve_queue_wait_seconds", model="paged-bench", mode="pool",
+            tier=tier,
+        ).get("p99_le")
+    out["paged_preemptions"] = pool_lz.preemptions
+    swap = pool_lz.swap.snapshot()
+    out["paged_swap_out_bytes"] = swap["bytes_out_total"]
+    out["paged_swap_in_bytes"] = swap["bytes_in_total"]
+    out["paged_tier_dispatches"] = pool_lz.ledger.snapshot()
+
+    wall_wc, conc_wc, pool_wc, _, _ = replay_tiered("worst-case")
+    out["paged_worstcase_capacity_concurrent"] = conc_wc
+    out["paged_worstcase_tokens_per_sec"] = round(toks_e / wall_wc, 1)
+    out["paged_lazy_capacity_ratio"] = round(conc_lz / max(1, conc_wc), 2)
+    # worst-case admissions cover the whole budget so the GROW path
+    # never preempts, but the tier policy still may (an interactive
+    # admission evicting a batch seat) — record, don't assume zero
+    out["paged_worstcase_preemptions"] = pool_wc.preemptions
     return out
 
 

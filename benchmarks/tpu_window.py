@@ -95,9 +95,13 @@ STEPS = [
     # pool >= 1x prediction, paged at-capacity tok/s — become measured,
     # plus leg D's gather-emulation vs FUSED Pallas paged-attention
     # decode-bandwidth comparison (paged_kernel_* keys; the kernel
-    # only exists here).  Runs right after batching so a dying tunnel
-    # can't lose the serving rows again.  Budget: ~11 pool builds
-    # (3 legs + 2 ctx x 2 seat-mix x 2 mode bandwidth legs) x
+    # only exists here) and leg E's two-tier oversubscription run
+    # (ISSUE 12: paged_lazy_capacity_* / paged_tier_* / preemption +
+    # swap counts — 2 more pool builds, decode volume is small).
+    # Runs right after batching so a dying tunnel
+    # can't lose the serving rows again.  Budget: ~13 pool builds
+    # (3 legs + 2 ctx x 2 seat-mix x 2 mode bandwidth legs + 2 tier
+    # legs) x
     # width-class compiles on the 1-core host.  WINDOWS=4 keeps the
     # leg-D decode budget ((4+2) x K = 192) low enough that BOTH ctx
     # classes (64 and 256) fit under max_len=512 — the long-context

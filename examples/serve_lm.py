@@ -63,6 +63,17 @@ timeline (the time-series twin of kv_blocks_pressure), and
 live decode loop and returns the trace-artifact path (host-side only;
 one profile at a time).
 
+SLO tiers (r14, ISSUE 12): /generate accepts ``"tier":
+"interactive"|"batch"`` (or the ``x-slo-tier`` header; default batch).
+The paged pool admits by PRIORITY, not FIFO — interactive first, with
+a bounded age boost so batch never starves — reserves KV budget
+on-demand (admission commits prompt blocks + 1; decode blocks
+allocate lazily at block boundaries), and under arena pressure
+preempts batch seats mid-decode, swapping their KV to a host-side
+arena and resuming them later token-identically.  Every TTFT /
+time-per-output-token / queue-wait observation carries the {tier}
+label, so ``/slo`` reports per-tier quantiles.
+
 Honest speculation (r6, VERDICT r5 next #2): ``--speculative`` consults
 the measured ledger (benchmarks/LAST_MEASURED.json).  If every measured
 speculative configuration on this box is a slowdown (<1x), the server
@@ -121,7 +132,7 @@ def build_handler(
     speculative: bool = False, prompt_cache: int = 0, tracer=None,
     model_label: str = "", metrics=None, replicas: int = 1,
     kv_blocks: "int | None" = None, kv_block_size: int = 16,
-    paged_kernel: str = "auto",
+    paged_kernel: str = "auto", kv_swap_blocks: "int | None" = None,
 ):
     """batching_slots > 0 serves through the continuous-batching pool
     (models/batching.py): concurrent requests share one decode loop,
@@ -284,6 +295,7 @@ def build_handler(
                     ledger=ledger, metrics=metrics,
                     model_label=model_label, replica_label=rep,
                     paged_kernel=paged_kernel,
+                    swap_blocks=kv_swap_blocks,
                 )
                 if i == 0:
                     print(
@@ -646,6 +658,18 @@ def build_handler(
                 stop = req.get("stop")
                 if stop is not None and not isinstance(stop, str):
                     return self._reply(400, {"error": "stop must be a string"})
+                # SLO tier (ISSUE 12): body field wins over the
+                # x-slo-tier header; default batch — callers opt INTO
+                # interactive priority explicitly.  Validated here so
+                # a typo'd tier is a 400, not a silent batch demotion
+                # — including falsy body values ("" / null-as-False):
+                # an explicit `is None` check, not `or`-chaining
+                tier = req.get("tier")
+                if tier is None:
+                    tier = self.headers.get("x-slo-tier") or "batch"
+                if tier not in ("interactive", "batch"):
+                    return self._reply(400, {
+                        "error": "tier must be 'interactive' or 'batch'"})
 
                 def finish(sample: str) -> str:
                     if stop:
@@ -680,12 +704,14 @@ def build_handler(
                     # pool lifecycle span — route, queue.wait,
                     # admission, decode.window, retire — and the
                     # /requests/<id> autopsy key on it (ISSUE 11)
+                    span.set_attribute("tier", tier)
                     rid = pool.submit(
                         ids.astype(np.int32), n_new,
                         temperature=temperature, top_k=top_k,
                         rng=jax.random.PRNGKey(seed)
                         if temperature > 0.0 else None,
                         trace_id=span.trace_id,
+                        tier=tier,
                     )
                     span.set_attribute("rid", rid)
                     # condition wait (no lock-churning poll); the
@@ -817,6 +843,15 @@ def main() -> int:
         help="tokens per KV block (must divide max_len)",
     )
     ap.add_argument(
+        "--kv-swap-blocks", type=int, default=None, metavar="N",
+        help="cap the host-side KV swap arena at N blocks per replica "
+             "(ISSUE 12 preemption spill space; default: unbounded). "
+             "When BOTH the device arena and the swap cap are "
+             "exhausted, requests queue/park — the pool never crashes "
+             "mid-decode (docs/SERVING.md oversubscription honesty "
+             "rule)",
+    )
+    ap.add_argument(
         "--paged-kernel", choices=["auto", "on", "off", "interpret"],
         default="auto", metavar="MODE",
         help="paged-attention decode step (ISSUE 10): 'auto' reads KV "
@@ -914,7 +949,7 @@ def main() -> int:
         prompt_cache=args.prompt_cache, model_label=model_label,
         metrics=serve_metrics, replicas=args.replicas,
         kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size,
-        paged_kernel=args.paged_kernel,
+        paged_kernel=args.paged_kernel, kv_swap_blocks=args.kv_swap_blocks,
     )
     server = ThreadingHTTPServer(("127.0.0.1", args.port), handler)
     # the serving binary boots the SLO evaluator (build_handler only

@@ -159,6 +159,17 @@ def test_collector_sees_known_call_sites():
     assert {"model", "replica"} <= families["kv_blocks_queued_demand"]
     assert "mode" in families["serve_prefix_cache_hits_total"]
     assert "mode" in families["serve_prefix_cache_evictions_total"]
+    # ISSUE 12: the preemption/swap plane — the preemption-rate rule
+    # and the committed-vs-reserved split bind these literal sites
+    assert {"model", "tier"} <= families["serve_preemptions_total"]
+    assert "direction" in families["kv_swap_bytes_total"]
+    assert {"model", "replica"} <= families["kv_blocks_committed"]
+    assert {"model", "replica"} <= families["kv_blocks_reserved"]
+    # tier-labeled SLO histograms: /slo per-tier quantiles depend on
+    # the pool's literal observation sites carrying the tier key
+    assert "tier" in families["serve_ttft_seconds"]
+    assert "tier" in families["serve_time_per_output_token_seconds"]
+    assert "tier" in families["serve_queue_wait_seconds"]
 
 
 def collect_dispatch_phases():

@@ -131,6 +131,34 @@ def test_paged_serving_families_are_emitted_with_expected_labels():
         assert "mode" in families[fam], fam
 
 
+def test_serving_policy_binds_preemption_rate():
+    """ISSUE 12: the stock serving policy must carry the thrash
+    signal — its alert binding names the live ``serve-preemption-rate``
+    rule, which in turn references the emitted
+    ``serve_preemptions_total{model,tier}`` family — so sustained
+    swapping scales replicas out before interactive TTFT burns."""
+
+    families = collect_emitted_families()
+    pol = default_serving_policy()
+    alert_sigs = {s.name for s in pol.signals if s.kind == "alert"}
+    assert "serve-preemption-rate" in alert_sigs
+    rule = next(
+        r for r in default_rules() if r.name == "serve-preemption-rate"
+    )
+    assert rule.metric == "serve_preemptions_total"
+    assert rule.kind == "counter_increase"
+    assert {"model", "tier"} <= families[rule.metric]
+
+
+def test_swap_and_commit_families_are_emitted_with_expected_labels():
+    """The ISSUE 12 families any rule/policy/dashboard may bind."""
+
+    families = collect_emitted_families()
+    assert "direction" in families["kv_swap_bytes_total"]
+    for fam in ("kv_blocks_committed", "kv_blocks_reserved"):
+        assert {"model", "replica"} <= families[fam], fam
+
+
 def test_stock_policy_checkpoint_gate_is_consistent_with_alert_rule():
     """The training policy's resize gate and the checkpoint-stale alert
     read the same stamp: the gate threshold must not be LOOSER than the

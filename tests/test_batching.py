@@ -405,7 +405,11 @@ class TestSingleDispatchAdmission:
             assert dec.result(rid) is not None
         for fam in ("serve_queue_wait_seconds", "serve_ttft_seconds",
                     "serve_time_per_output_token_seconds"):
-            assert m.histogram(fam, model="llama", mode="pool")["count"] == 3, fam
+            # ISSUE 12: every pool SLO observation is tier-labeled
+            # (default batch) so /slo reports per-tier quantiles
+            assert m.histogram(
+                fam, model="llama", mode="pool", tier="batch"
+            )["count"] == 3, fam
         assert m.gauge("serve_admission_queue_depth", model="llama") == 0.0
         assert m.gauge("serve_tokens_in_flight", model="llama") == 0.0
 

@@ -13,6 +13,7 @@ from tf_operator_tpu.models.kv_blocks import (
     SCRATCH_BLOCK,
     BlockAllocator,
     BlockError,
+    SwapArena,
     blocks_for,
 )
 from tf_operator_tpu.models.prefix_cache import (
@@ -112,6 +113,137 @@ class TestBlockAllocator:
             BlockAllocator(1, 16)
         with pytest.raises(ValueError):
             BlockAllocator(4, 0)
+
+
+class TestSwapArena:
+    def test_put_pop_accounting_and_caps(self):
+        s = SwapArena(capacity_blocks=4)
+        assert s.admit(4) and not s.admit(5)
+        s.put(1, {"live": [], "blocks": [0, 1, 2]}, n_blocks=3, nbytes=300)
+        assert s.swapped_blocks == 3 and len(s) == 1
+        assert s.admit(1) and not s.admit(2)
+        with pytest.raises(BlockError):
+            s.put(1, {}, n_blocks=0, nbytes=0)  # double record
+        rec = s.pop(1, nbytes=300)
+        assert rec["blocks"] == [0, 1, 2]
+        assert s.swapped_blocks == 0 and len(s) == 0
+        assert s.bytes_out_total == 300 and s.bytes_in_total == 300
+        with pytest.raises(BlockError):
+            s.pop(1)
+        # unbounded arena admits anything
+        assert SwapArena().admit(10**9)
+
+    def test_random_admit_preempt_resume_retire_conserves(self):
+        """ISSUE 12 conservation property: across random
+        admit/grow/publish/preempt/resume/retire sequences using the
+        pool's exact reference discipline, the device side conserves
+        (free + live == usable), the host side accounts for every
+        preempted request's committed set
+        (swapped + swap-exempt live == committed), and the union of
+        seat/cache/swap-record holders explains every live block —
+        ``free + live + swapped`` covers each logical block exactly
+        once."""
+
+        r = np.random.RandomState(7)
+        alloc = BlockAllocator(25, 16)  # 24 usable
+        swap = SwapArena()
+        seats = {}    # rid -> [bid, ...] (logical order)
+        records = {}  # rid -> swap record (the pool's shape)
+        cache = []    # bids the prefix cache holds (one ref each)
+        rid_next = 0
+
+        def check_world():
+            alloc.check()
+            held = set(b for refs in seats.values() for b in refs)
+            held |= set(cache)
+            for rec in records.values():
+                held |= {b for _, b in rec["live"]}
+            assert alloc.in_use == len(held)
+            assert alloc.free_count == alloc.usable - len(held)
+            for rid, rec in records.items():
+                assert rec["n_blocks"] + len(rec["live"]) == rec["committed"]
+            assert swap.swapped_blocks == sum(
+                rec["n_blocks"] for rec in records.values()
+            )
+
+        for _ in range(600):
+            op = r.randint(5)
+            if op == 0:  # admit: commit a few blocks, maybe publish one
+                ids = alloc.alloc(int(r.randint(1, 5)))
+                if ids is not None:
+                    seats[rid_next] = list(ids)
+                    if r.rand() < 0.4:
+                        alloc.retain([ids[0]])  # publish to the cache
+                        cache.append(ids[0])
+                    rid_next += 1
+            elif op == 1 and seats:  # lazy grow
+                rid = list(seats)[r.randint(len(seats))]
+                ids = alloc.alloc(1)
+                if ids is not None:
+                    seats[rid].extend(ids)
+            elif op == 2 and seats:  # preempt: private swap, exempt live
+                rid = list(seats)[r.randint(len(seats))]
+                refs = seats.pop(rid)
+                exempt = [(i, b) for i, b in enumerate(refs)
+                          if alloc.refcount(b) > 1]
+                private = [(i, b) for i, b in enumerate(refs)
+                           if alloc.refcount(b) == 1]
+                alloc.release([b for _, b in private])
+                swap.put(rid, {"live": exempt,
+                               "blocks": [i for i, _ in private],
+                               "committed": len(refs)},
+                         n_blocks=len(private), nbytes=len(private) * 10)
+            elif op == 3 and records:  # resume: re-alloc + pop
+                rid = list(records)[r.randint(len(records))]
+                rec = records[rid]
+                ids = alloc.alloc(rec["n_blocks"])
+                if ids is not None:
+                    refs = [None] * rec["committed"]
+                    for i, b in rec["live"]:
+                        refs[i] = b
+                    for j, i in enumerate(rec["blocks"]):
+                        refs[i] = ids[j]
+                    swap.pop(rid, nbytes=rec["n_blocks"] * 10)
+                    del records[rid]
+                    seats[rid] = refs
+            elif op == 4 and seats:  # retire
+                rid = list(seats)[r.randint(len(seats))]
+                alloc.release(seats.pop(rid))
+            # swap.put side: records dict mirrors the arena store
+            for rid in list(swap._records):
+                if rid not in records:
+                    records[rid] = swap._records[rid]
+            check_world()
+        # drain: resume everything (waiting for space), then retire all
+        guard = 0
+        while records and guard < 1000:
+            guard += 1
+            for rid in list(records):
+                rec = records[rid]
+                ids = alloc.alloc(rec["n_blocks"])
+                if ids is None:
+                    # pressure: retire a seat, else evict a cold
+                    # cache entry (the pool's evict_lru analogue)
+                    if seats:
+                        alloc.release(seats.pop(list(seats)[0]))
+                    elif cache:
+                        alloc.release([cache.pop()])
+                    continue
+                refs = [None] * rec["committed"]
+                for i, b in rec["live"]:
+                    refs[i] = b
+                for j, i in enumerate(rec["blocks"]):
+                    refs[i] = ids[j]
+                swap.pop(rid)
+                del records[rid]
+                seats[rid] = refs
+            check_world()
+        assert not records, "swap arena failed to drain"
+        for rid in list(seats):
+            alloc.release(seats.pop(rid))
+        alloc.release(cache)
+        alloc.check()
+        assert alloc.in_use == 0 and swap.swapped_blocks == 0
 
 
 class TestChainKeys:

@@ -52,10 +52,20 @@ HOT_FUNCTIONS = {
 
 #: file -> {class name -> step-loop functions} (serving hot paths are
 #: methods; class scoping keeps same-named base-class methods with
-#: documented host work out of the gate)
+#: documented host work out of the gate).  ISSUE 12 extends the set
+#: to the swap/lazy-allocation paths: growth runs in the per-window
+#: host window and preemption/resume do real device→host copies —
+#: every one of those copies must route through the sanctioned
+#: ``with ...dispatch(...)`` window so it is counted, timed, and can
+#: never silently serialize the steady-state step loop.
 HOT_CLASS_FUNCTIONS = {
     "models/batching.py": {
-        "PagedContinuousBatchingDecoder": {"step", "_step"},
+        "PagedContinuousBatchingDecoder": {
+            "step", "_step", "_grow_seats_locked", "_alloc_blocks_locked",
+            "_preempt_seat_locked", "_admit_swapped",
+            "_plan_resume_locked", "_pick_victim_locked",
+            "_demote_queued_locked",
+        },
     },
 }
 

@@ -146,6 +146,76 @@ def test_blocks_free_pressure_scales_serving_one_to_three():
         sim.stop()
 
 
+def test_preemption_rate_scales_serving_out():
+    """ISSUE 12 stock-policy refresh, e2e through the PR 8 pattern:
+    REAL preemptions from a thrashing paged pool (budget-on-demand
+    oversubscription losing its gamble) increment
+    ``serve_preemptions_total`` → the stock ``serve-preemption-rate``
+    threshold rule fires in the alert engine → the STOCK serving
+    policy's alert binding breaches → the autoscaler scales the
+    worker set out before interactive TTFT burns."""
+
+    metrics = Metrics()
+    engine = AlertEngine(
+        default_rules(short=5.0, long=30.0), metrics=metrics,
+        recorder=FlightRecorder(),
+    )
+    autoscaler = Autoscaler(metrics=metrics, alerts=engine)
+    pol = default_serving_policy(min_replicas=1, max_replicas=3)
+    pol.cooldown_seconds = 5.0
+    job = new_job(name="thrash", worker=1)
+    job.spec.autoscaling = AutoscalingSpec(policies=[pol])
+    autoscaler.attach(lambda: [job])
+
+    t0 = time.time()
+    engine.evaluate_once(t0)  # baseline counter sample
+    assert autoscaler.evaluate_once(t0) == []  # quiet: no decision
+
+    # REAL thrash: a tight arena, long budgets, competing batch seats
+    # — growth keeps preempting until the preemption-rate threshold
+    # (8 per window) is crossed
+    model = llama_tiny(vocab_size=VOCAB, max_len=64)
+    init = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), init)["params"]
+    pool = PagedContinuousBatchingDecoder(
+        model, params, slots=4, kv_block_size=16, kv_blocks=4,
+        steps_per_sync=8, metrics=metrics, model_label="tiny",
+    )
+    r = np.random.RandomState(1)
+    rids = []
+    deadline = time.time() + 120
+    while pool.preemptions <= 8 and time.time() < deadline:
+        # sustained oversubscription: keep ~6 long-budget requests in
+        # flight so growth contention never drains
+        with pool._lock:
+            backlog = len(pool._queue) + len(pool._active)
+        while backlog < 6 and len(rids) < 64:
+            rids.append(pool.submit(
+                r.randint(0, VOCAB, size=(6,)).astype(np.int32),
+                max_new_tokens=40,
+            ))
+            backlog += 1
+        pool.step()
+    assert pool.preemptions > 8, "scenario failed to thrash"
+    assert metrics.counter(
+        "serve_preemptions_total", model="tiny", tier="batch",
+        replica="0",
+    ) == pool.preemptions
+
+    engine.evaluate_once(t0 + 2)  # increase lands inside the window
+    alert = engine.alert("serve-preemption-rate")
+    assert alert is not None and alert.state == "firing"
+    (up,) = autoscaler.evaluate_once(t0 + 3)
+    assert (up.direction, up.from_replicas, up.to_replicas) == ("up", 1, 2)
+    assert "serve-preemption-rate" in up.reason
+
+    # drain; every preempted request still completed (never crashed)
+    pool.run()
+    for rid in rids:
+        assert pool.result(rid) is not None
+    pool.alloc.check()
+
+
 def test_multi_replica_metrics_and_merged_slo_over_http():
     """The visibility half: N pool replicas behind one admission queue
     export per-replica serve_admission_queue_depth / kv_blocks_free on

@@ -1,0 +1,417 @@
+"""Budget-on-demand admission + mid-decode preemption + SLO tiers
+(ISSUE 12 tentpole).
+
+The load-bearing pins:
+
+- TOKEN IDENTITY: a preempted-then-resumed request decodes
+  byte-identically to an undisturbed run — greedy and temperature, on
+  BOTH step paths (gather emulation and the interpret-mode Pallas
+  kernel).  The swap round trip (device→host block snapshot, host→
+  device re-upload, rng/length/last-token restore) is exact.
+- LAZY CAPACITY: at the same arena, budget-on-demand admission admits
+  strictly more concurrent requests than the worst-case reservation
+  (``reserve="worst-case"`` — PR 8's contract, kept as the measured
+  baseline), and both modes produce identical tokens.
+- TIER POLICY: interactive preempts batch (admission- and grow-time);
+  a batch request under sustained interactive load still completes
+  within the age-boost bound (anti-starvation).
+- STEADY STATE: a decode window that grows its block tables is still
+  exactly ONE ``step`` dispatch (the delta rides the dispatch).
+- ACCOUNTING: preemption shows up everywhere it must — autopsy
+  ``preempted``/``swapped_blocks``, ``preempt``/``swap_out``/
+  ``swap_in`` lifecycle spans, ``serve_preemptions_total{model,tier}``
+  / ``kv_swap_bytes_total{direction}``, the arena timeline's
+  ``swapped`` series — and the allocator conserves through arbitrary
+  preempt/resume interleavings.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # generation-loop compiles
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models import llama_tiny
+from tf_operator_tpu.models.batching import PagedContinuousBatchingDecoder
+from tf_operator_tpu.utils.metrics import DispatchLedger, Metrics
+from tf_operator_tpu.utils.trace import Tracer
+
+VOCAB = 96
+
+
+def _setup(max_len=64):
+    model = llama_tiny(vocab_size=VOCAB, max_len=max_len)
+    init = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), init)["params"]
+    return model, params
+
+
+def _prompt(r, n):
+    return r.randint(0, VOCAB, size=(n,)).astype(np.int32)
+
+
+class TestTokenIdentity:
+    @pytest.mark.parametrize("kernel", ["off", "interpret"])
+    @pytest.mark.parametrize("temp", [0.0, 0.9])
+    def test_preempted_then_resumed_is_token_identical(self, kernel, temp):
+        """The acceptance pin: batch request A is preempted mid-decode
+        by an interactive admission (its private blocks swap to the
+        host arena), resumes later, and its output is byte-identical
+        to an undisturbed run — greedy and temperature, emulation and
+        interpret-mode kernel paths."""
+
+        model, params = _setup()
+        r = np.random.RandomState(3)
+        prompt_a = _prompt(r, 6)
+        prompt_i = _prompt(r, 33)
+        kw = (
+            dict(temperature=temp, rng=jax.random.PRNGKey(5))
+            if temp else {}
+        )
+
+        solo = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, steps_per_sync=8,
+            paged_kernel=kernel,
+        )
+        rid = solo.submit(prompt_a, max_new_tokens=24, **kw)
+        solo.run()
+        want = solo.result(rid)
+
+        # arena of 4 blocks: A commits 2 and grows; the interactive
+        # admission needs 3 -> preempts A (tier policy)
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, kv_blocks=4,
+            steps_per_sync=8, paged_kernel=kernel,
+        )
+        a = pool.submit(prompt_a, max_new_tokens=24, **kw)
+        pool.step()  # admit A + window 1
+        pool.step()  # window 2 — A's table has grown
+        i = pool.submit(prompt_i, max_new_tokens=8, tier="interactive")
+        pool.run()
+        assert pool.preemptions >= 1, "scenario failed to preempt"
+        got_i = pool.result(i)
+        assert got_i.shape == (41,)
+        np.testing.assert_array_equal(pool.result(a), want)
+        pool.alloc.check()
+        assert len(pool.swap) == 0 and pool.swap.swapped_blocks == 0
+
+    def test_lazy_and_worst_case_modes_are_token_identical(self):
+        """Reservation policy must never change tokens: the same
+        request set decodes identically under lazy and worst-case
+        admission (scheduling differs, math does not)."""
+
+        model, params = _setup()
+        r = np.random.RandomState(11)
+        reqs = [(_prompt(r, n), b) for n, b in
+                [(6, 30), (20, 14), (9, 24)]]
+        outs = {}
+        for reserve in ("worst-case", "lazy"):
+            pool = PagedContinuousBatchingDecoder(
+                model, params, slots=4, kv_block_size=16,
+                reserve=reserve,
+            )
+            rids = [pool.submit(p, max_new_tokens=b) for p, b in reqs]
+            pool.run()
+            outs[reserve] = [pool.result(rid) for rid in rids]
+            pool.alloc.check()
+        for a, b in zip(outs["lazy"], outs["worst-case"]):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestLazyCapacity:
+    def test_lazy_admits_strictly_more_than_worst_case(self):
+        """The capacity acceptance pin: at the same 8-block arena,
+        budget-on-demand admission seats strictly more of the same
+        long-budget requests than PR 8's worst-case reservation."""
+
+        model, params = _setup()
+        r = np.random.RandomState(5)
+        prompts = [_prompt(r, 6) for _ in range(5)]
+
+        conc = {}
+        for reserve in ("worst-case", "lazy"):
+            pool = PagedContinuousBatchingDecoder(
+                model, params, slots=8, kv_block_size=16, kv_blocks=8,
+                reserve=reserve,
+            )
+            for p in prompts:
+                pool.submit(p, max_new_tokens=40)  # worst case: 3 blocks
+            pool._admit()
+            with pool._lock:
+                conc[reserve] = len(pool._active)
+        assert conc["worst-case"] == 2  # floor(8 / 3)
+        assert conc["lazy"] == 4       # commit = prompt + 1 = 2 blocks
+        assert conc["lazy"] > conc["worst-case"]
+
+    def test_worst_case_mode_never_grows_or_preempts_alone(self):
+        """PR 8 parity: worst-case admissions cover the whole budget,
+        so a single-tier run has no growth shortfall and no
+        preemptions — the no-surprise contract survives as a mode."""
+
+        model, params = _setup()
+        r = np.random.RandomState(6)
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, kv_blocks=8,
+            reserve="worst-case",
+        )
+        rids = [
+            pool.submit(_prompt(r, 6), max_new_tokens=40)
+            for _ in range(3)
+        ]
+        pool.run()
+        for rid in rids:
+            assert pool.result(rid) is not None
+        assert pool.preemptions == 0
+        assert pool.ledger.count("swap_out") == 0
+        assert pool.ledger.count("swap_in") == 0
+        pool.alloc.check()
+
+
+class TestTierScheduling:
+    def test_interactive_admitted_ahead_of_batch_queue(self):
+        """Priority admission replacing blind FIFO: with every seat's
+        blocks contended, a later interactive submit is admitted
+        before earlier batch submits."""
+
+        model, params = _setup()
+        r = np.random.RandomState(8)
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, kv_blocks=3,
+        )
+        # 3-block arena, every request needs 2 commit blocks: only one
+        # fits at a time.  The interactive submit arrives LAST but is
+        # seated FIRST — priority admission, not FIFO.
+        b1 = pool.submit(_prompt(r, 20), max_new_tokens=8)
+        b2 = pool.submit(_prompt(r, 20), max_new_tokens=8)
+        i1 = pool.submit(_prompt(r, 20), max_new_tokens=8,
+                         tier="interactive")
+        pool._admit()
+        with pool._lock:
+            active = {req.rid for req in pool._active.values()}
+            queued = [req.rid for req in pool._queue]
+        assert active == {i1}
+        assert queued == [b1, b2]  # batch keeps FIFO within its rank
+        pool.run()
+        for rid in (b1, b2, i1):
+            assert pool.result(rid) is not None
+        pool.alloc.check()
+
+    def test_batch_never_starves_past_the_age_boost(self):
+        """Anti-starvation pin: under a sustained interactive stream
+        that always keeps the queue non-empty, a batch request still
+        completes once its age boost lifts it — and interactive
+        backlog remains when it does (i.e. it did NOT just win by the
+        queue draining)."""
+
+        model, params = _setup()
+        r = np.random.RandomState(9)
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=16, kv_blocks=2,
+            steps_per_sync=4, age_boost_seconds=0.25,
+        )
+        batch = pool.submit(_prompt(r, 6), max_new_tokens=8)
+        interactive = []
+        done_at_backlog = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            # keep >= 2 interactive queued at all times
+            with pool._lock:
+                queued_i = sum(
+                    1 for q in pool._queue if q.tier == "interactive"
+                )
+            while queued_i < 2 and len(interactive) < 200:
+                interactive.append(pool.submit(
+                    _prompt(r, 20), max_new_tokens=8, tier="interactive",
+                ))
+                queued_i += 1
+            pool.step()
+            if pool.result_wait(batch, timeout=0) is not None:
+                with pool._lock:
+                    done_at_backlog = sum(
+                        1 for q in pool._queue
+                        if q.tier == "interactive"
+                    )
+                break
+        assert done_at_backlog is not None, (
+            "batch request starved past the age boost bound"
+        )
+        assert done_at_backlog >= 1  # it won THROUGH backlog, not after
+        pool.run()  # drain the stream
+        pool.alloc.check()
+
+
+class TestSteadyStateThroughGrowth:
+    def test_growth_window_is_still_one_dispatch(self):
+        """The ISSUE 12 half of the PR 10 invariant: a decode window
+        whose seat crosses a block boundary (lazy allocation fires)
+        is still exactly ONE ``step`` dispatch — the table delta rides
+        the dispatch, it does not add one."""
+
+        model, params = _setup()
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=16, steps_per_sync=8,
+        )
+        rid = pool.submit(
+            np.arange(6, dtype=np.int32) % VOCAB, max_new_tokens=48,
+        )
+        pool.step()  # admission + window 1
+        grew = False
+        for _ in range(4):  # windows 2..5 cross into blocks 3 and 4
+            with pool._lock:
+                committed0 = len(pool._seat_refs[0])
+            base = pool.ledger.count()
+            steps0 = pool.ledger.count("step")
+            pool.step()
+            with pool._lock:
+                if 0 in pool._seat_refs and \
+                        len(pool._seat_refs[0]) > committed0:
+                    grew = True
+            # growth or not: every window is exactly ONE dispatch
+            assert pool.ledger.count() == base + 1
+            assert pool.ledger.count("step") == steps0 + 1
+        assert grew, "scenario never crossed a block boundary"
+        pool.run()
+        assert pool.result(rid) is not None
+        snap = pool.ledger.snapshot()
+        assert set(snap) <= {"admission", "step", "retire"}, snap
+        pool.alloc.check()
+
+
+class TestPreemptionAccounting:
+    def _preempt_scenario(self, metrics=None, tracer=None):
+        model, params = _setup()
+        ledger = DispatchLedger(metrics=metrics, tracer=tracer)
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, kv_blocks=4,
+            steps_per_sync=8, ledger=ledger, metrics=metrics,
+            model_label="tiny",
+        )
+        r = np.random.RandomState(3)
+        a = pool.submit(_prompt(r, 6), max_new_tokens=24,
+                        trace_id="tpreempt0001")
+        pool.step()
+        pool.step()
+        i = pool.submit(_prompt(r, 33), max_new_tokens=8,
+                        tier="interactive")
+        pool.run()
+        assert pool.preemptions >= 1
+        assert pool.result(a) is not None
+        assert pool.result(i) is not None
+        pool.alloc.check()
+        return pool
+
+    def test_autopsy_records_the_leave_and_return(self):
+        """ISSUE 12 satellite: the autopsy has vocabulary for a seat
+        that leaves and returns — preempted count, swapped blocks,
+        swap_out/swap_in dispatch shares — instead of silently
+        truncating at the first eviction."""
+
+        pool = self._preempt_scenario(tracer=Tracer(seed=0))
+        entry = pool.request_log.get("tpreempt0001")
+        assert entry["state"] == "done"
+        assert entry["tier"] == "batch"
+        assert entry["preempted"] == 1
+        assert entry["swapped_blocks"] >= 1
+        assert entry["dispatches"]["swap_out"] == 1
+        assert entry["dispatches"]["swap_in"] == 1
+        assert entry["tokens"] == 24  # complete despite the eviction
+
+    def test_lifecycle_spans_and_metrics(self):
+        """preempt/swap_out/swap_in spans land on the victim's trace;
+        serve_preemptions_total{model,tier} and
+        kv_swap_bytes_total{direction} count the episode; the arena
+        timeline's ``swapped`` series shows the host-resident span."""
+
+        m = Metrics()
+        tracer = Tracer(seed=0)
+        pool = self._preempt_scenario(metrics=m, tracer=tracer)
+        trace = tracer.store.trace("tpreempt0001")
+        names = {s["name"] for s in trace["spans"]}
+        assert {"preempt", "swap_out", "swap_in", "retire"} <= names
+        assert m.counter(
+            "serve_preemptions_total", model="tiny", tier="batch",
+            replica="0",
+        ) == pool.preemptions
+        out_b = m.counter("kv_swap_bytes_total", direction="out")
+        in_b = m.counter("kv_swap_bytes_total", direction="in")
+        assert out_b > 0 and out_b == in_b  # full round trip
+        swapped = [s["swapped"] for s in pool.timeline.tail()]
+        assert max(swapped) >= 1  # the strip shows the spill
+        assert swapped[-1] == 0   # ...and its resolution
+
+    def test_swap_exempt_pin_cannot_wedge_the_pool(self):
+        """Review regression (the deadlock breaker): a preempted
+        QUEUED request holds refs on its prefix-published blocks
+        (swap-exempt), which the cache cannot evict (refcount 2) and
+        no active seat can free — without demotion, an admission
+        needing the whole arena would gate the queue forever with
+        zero active seats.  The demotion path copies the queued
+        holder's live blocks host-side, the cache entries become
+        evictable, the admission proceeds, and the demoted request
+        still resumes token-identically."""
+
+        model, params = _setup()
+        r = np.random.RandomState(23)
+        prompt_a = _prompt(r, 33)  # 2 publishable full blocks
+        prompt_b = _prompt(r, 33)  # distinct: no prefix sharing
+
+        solo = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=16, steps_per_sync=8,
+        )
+        sa = solo.submit(prompt_a, max_new_tokens=24)
+        solo.run()
+        want_a = solo.result(sa)
+
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=16, kv_blocks=4,
+            steps_per_sync=8,
+        )
+        a = pool.submit(prompt_a, max_new_tokens=24)  # commits all 4
+        pool.step()  # progress (victim-eligible) + 2 blocks published
+        assert len(pool.prefix) == 2
+        # the interactive admission needs the WHOLE arena: preempting
+        # A frees only its 2 private blocks; its 2 published blocks
+        # are swap-exempt and pinned by A's queued record — only the
+        # demotion path can break the pin
+        i = pool.submit(prompt_b, max_new_tokens=24, tier="interactive")
+        pool.run()
+        assert pool.preemptions >= 1
+        assert pool.result(i) is not None
+        np.testing.assert_array_equal(pool.result(a), want_a)
+        pool.alloc.check()
+        assert len(pool.swap) == 0 and pool.swap.swapped_blocks == 0
+        # A's autopsy saw the demotion: more blocks swapped than the
+        # seat eviction alone moved
+        entries = {e["rid"]: e for e in pool.request_log.recent(10)}
+        assert entries[a]["swapped_blocks"] >= 3
+
+    def test_random_two_tier_churn_conserves_and_completes(self):
+        """Churn test: a burst of mixed-tier, mixed-budget requests
+        through a tight arena — every request completes, the allocator
+        conserves, and the swap arena drains to empty."""
+
+        model, params = _setup()
+        r = np.random.RandomState(17)
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=6, kv_block_size=16, kv_blocks=6,
+            steps_per_sync=8, age_boost_seconds=0.5,
+        )
+        rids = []
+        for k in range(12):
+            tier = "interactive" if k % 4 == 0 else "batch"
+            p = _prompt(r, int(r.randint(4, 24)))
+            budget = int(r.choice([8, 24, 40]))
+            rids.append(pool.submit(p, max_new_tokens=budget, tier=tier))
+            if k % 3 == 0:
+                pool.step()
+        pool.run()
+        for rid in rids:
+            assert pool.result(rid) is not None
+        pool.alloc.check()
+        assert len(pool.swap) == 0 and pool.swap.swapped_blocks == 0
+        # published prefix blocks are the only live remainder
+        assert pool.alloc.in_use == len(pool.prefix)

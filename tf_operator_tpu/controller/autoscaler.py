@@ -80,16 +80,21 @@ def default_serving_policy(
     min_replicas: int = 1, max_replicas: int = 4
 ) -> AutoscalingPolicy:
     """The stock serving policy (examples + the static lint gate):
-    scale on the queue-wait burn-rate alert OR blocks-free pressure —
-    since the paged pool (ISSUE 8) admission is gated on KV blocks
-    free, ``kv_blocks_pressure`` ((in-use + queued block demand) /
-    usable since ISSUE 10 — refreshed per decode window so a burst
-    RAMPS it, and it exceeds 1.0 under backlog; worst replica wins)
-    is REAL memory headroom, the thing a serving replica actually runs
-    out of; queue depth was only its shadow.  Scale-up triggers at
-    0.85 (before the 0.9 alert pages) and the hysteresis latch
-    releases at 0.85 × hysteresis_ratio.  Signal names here are pinned
-    against the live rule set / emitted families by
+    scale on the queue-wait burn-rate alert, blocks-free pressure, or
+    a sustained preemption rate.  Since ISSUE 12 the paged pool
+    reserves decode budget ON DEMAND, so ``kv_blocks_pressure`` is
+    COMMITTED pressure — (blocks actually allocated + queued block
+    demand) / usable, refreshed per decode window; the worst-case
+    reservation the old scheme pinned is exported separately as
+    ``kv_blocks_reserved`` and may exceed the arena (the
+    oversubscription gamble).  Committed pressure is what admission
+    really gates on, so the policy and the 0.9 alert act on real
+    oversubscription, not the worst-case shadow.  Scale-up triggers
+    at 0.85 (before the 0.9 alert pages); the ``serve-preemption-rate``
+    alert binding adds the thrash signal — when the oversubscription
+    gamble keeps losing (seats swapping through the host arena),
+    replicas scale out BEFORE interactive TTFT burns.  Signal names
+    here are pinned against the live rule set / emitted families by
     tests/test_autoscaling_lint.py — renaming either orphans this
     policy and fails tier-1."""
 
@@ -103,6 +108,7 @@ def default_serving_policy(
             SignalBinding(
                 kind="gauge", name="kv_blocks_pressure", threshold=0.85
             ),
+            SignalBinding(kind="alert", name="serve-preemption-rate"),
         ],
     )
 

@@ -376,6 +376,33 @@ def scatter_block_stack(arena, stack, tables_pad, start_blocks,
     return jax.tree_util.tree_map_with_path(f, arena, stack)
 
 
+def gather_blocks_by_id(arena, ids):
+    """K/V rows for physical block ``ids`` ([n] int32) from every
+    arena leaf — ``[n, H, bs, D]`` per leaf, cache_index placeholders
+    passed through.  The device side of a host swap-OUT (ISSUE 12):
+    the caller fetches the result inside its ledger dispatch window
+    and parks it in the SwapArena.  Pad ids with SCRATCH — the padded
+    rows fetch masked scratch garbage the caller trims."""
+
+    return jax.tree_util.tree_map(
+        lambda l: jnp.take(l, ids, axis=0) if l.ndim == 4 else l, arena
+    )
+
+
+def scatter_blocks_by_id(arena, bufs, ids):
+    """Write ``bufs`` rows (``[n, H, bs, D]`` per K/V leaf) into the
+    arena at physical block ``ids`` — the swap-IN inverse of
+    :func:`gather_blocks_by_id`, run inside the resume program.  Pad
+    ids with SCRATCH: padded rows land in the scratch block, whose
+    content is never observable."""
+
+    return jax.tree_util.tree_map(
+        lambda a, b: a.at[ids].set(b.astype(a.dtype)) if a.ndim == 4
+        else a,
+        arena, bufs,
+    )
+
+
 def _init_cache_for(dmodel, batch_size: int):
     dummy = jnp.zeros((batch_size, 1), jnp.int32)
     shapes = jax.eval_shape(

@@ -174,6 +174,96 @@ class BlockAllocator:
         return freed
 
 
+class SwapArena:
+    """Host-side store of swapped-out KV blocks (ISSUE 12).
+
+    Mid-decode preemption frees a victim seat's device blocks by
+    parking their CONTENT here: one record per preempted request,
+    holding the gathered host copies of its private (refcount-1)
+    blocks plus the bookkeeping resume needs (the donation-safe
+    device→host snapshot pattern from parallel/checkpoint.py, applied
+    per-block).  Prefix-cache-shared blocks are swap-EXEMPT — they
+    stay device-resident under their surviving refcounts and re-map
+    copy-free at resume — so a record covers only blocks nothing else
+    holds.
+
+    ``capacity_blocks`` bounds the host footprint (None = unbounded —
+    the default; host RAM dwarfs the arena).  ``admit`` answers
+    whether a prospective swap fits; a full swap arena means the
+    scheduler PARKS the grower instead of preempting (the documented
+    "queue, never crash" honesty rule — docs/SERVING.md).
+
+    Conservation (test-pinned, tests/test_kv_blocks.py): device
+    ``free + live`` plus this arena's ``swapped_blocks`` accounts for
+    every logical block any request owns — a preempted request's
+    committed set is exactly its swapped records + its swap-exempt
+    live blocks.
+    """
+
+    def __init__(self, capacity_blocks: Optional[int] = None):
+        self.capacity_blocks = (
+            None if capacity_blocks is None else int(capacity_blocks)
+        )
+        self._lock = threading.Lock()
+        self._records: Dict[int, Dict[str, Any]] = {}  # rid -> record
+        self.swapped_blocks = 0
+        self.bytes_out_total = 0  # cumulative device→host
+        self.bytes_in_total = 0   # cumulative host→device (resumes)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def admit(self, n_blocks: int) -> bool:
+        """Would ``n_blocks`` more swapped blocks fit the cap?"""
+
+        if self.capacity_blocks is None:
+            return True
+        with self._lock:
+            return self.swapped_blocks + int(n_blocks) <= self.capacity_blocks
+
+    def put(self, rid: int, record: Dict[str, Any], n_blocks: int,
+            nbytes: int) -> None:
+        """Store a preempted request's swap record (keyed by pool
+        rid).  ``n_blocks``/``nbytes`` are the PRIVATE blocks actually
+        copied (exempt blocks stay on device and count zero here)."""
+
+        with self._lock:
+            if rid in self._records:
+                raise BlockError(f"request {rid} already has a swap record")
+            record = dict(record)
+            record["n_blocks"] = int(n_blocks)
+            self._records[rid] = record
+            self.swapped_blocks += int(n_blocks)
+            self.bytes_out_total += int(nbytes)
+
+    def peek(self, rid: int) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._records.get(rid)
+
+    def pop(self, rid: int, nbytes: int = 0) -> Dict[str, Any]:
+        """Remove and return the record at resume (its blocks are
+        being uploaded back into freshly allocated device blocks)."""
+
+        with self._lock:
+            rec = self._records.pop(rid, None)
+            if rec is None:
+                raise BlockError(f"request {rid} has no swap record")
+            self.swapped_blocks -= rec["n_blocks"]
+            self.bytes_in_total += int(nbytes)
+            return rec
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "records": len(self._records),
+                "swapped_blocks": self.swapped_blocks,
+                "capacity_blocks": self.capacity_blocks,
+                "bytes_out_total": self.bytes_out_total,
+                "bytes_in_total": self.bytes_in_total,
+            }
+
+
 class ArenaTimeline:
     """Bounded ring of block-arena occupancy samples — the time-series
     twin of the instantaneous ``kv_blocks_pressure`` gauge (ISSUE 11).
@@ -191,7 +281,11 @@ class ArenaTimeline:
     Sample shape (all counts in BLOCKS): ``unix``, ``free``, ``live``
     (allocated: seat-mapped + cache-held), ``prefix_cached`` (blocks
     held by the prefix cache — a subset of live), ``queued_demand``
-    (block need of queued-but-unadmitted requests), ``seats_active``.
+    (block need of queued-but-unadmitted requests), ``seats_active``,
+    and ``swapped`` (blocks whose content currently lives host-side in
+    the SwapArena — ISSUE 12: without this series a preempted
+    request's occupancy history would silently truncate at its first
+    eviction).
     """
 
     def __init__(self, capacity: int = 512, block_size: int = 0,
@@ -212,6 +306,7 @@ class ArenaTimeline:
         prefix_cached: int,
         queued_demand: int,
         seats_active: int,
+        swapped: int = 0,
     ) -> None:
         rec = {
             "unix": time.time(),
@@ -220,6 +315,7 @@ class ArenaTimeline:
             "prefix_cached": int(prefix_cached),
             "queued_demand": int(queued_demand),
             "seats_active": int(seats_active),
+            "swapped": int(swapped),
         }
         with self._lock:
             # consecutive identical samples collapse to the first: an

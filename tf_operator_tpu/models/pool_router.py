@@ -88,6 +88,10 @@ class PoolRouter:
                     "replica": str(idx),
                     "load_score": round(scores[idx], 4),
                     "scores": [round(s, 4) for s in scores],
+                    # ISSUE 12: the SLO tier is routing-relevant
+                    # context — a preempted batch request's waterfall
+                    # should show what class it competed in
+                    "tier": str(kw.get("tier", "batch")),
                 },
             )
             with span:

@@ -30,8 +30,9 @@ subsystem's /traces endpoints, utils/trace.py):
 - **kv arena** (ISSUE 11) — the serving plane's block-arena occupancy
   strip, one stacked band per replica rendered from the
   `/debug/arena` timeline (live blocks, prefix-cached share, queued
-  demand overflow) — the time-series twin of the instantaneous
-  `kv_blocks_pressure` gauge.  The panel self-hides when there is no
+  demand overflow, and since ISSUE 12 the swapped-out block band —
+  preempted seats' KV living host-side) — the time-series twin of the
+  instantaneous `kv_blocks_pressure` gauge.  The panel self-hides when there is no
   paged-pool data: the operator API has no `/debug/arena` route (the
   fetch 404s), and serve_lm without a paged pool answers 200 with an
   empty `replicas` list — both paths leave the panel hidden, so the
@@ -251,10 +252,15 @@ async function refreshArena() {
         r.setAttribute("fill", color);
         svg.appendChild(r);
       };
+      const swapped = Math.min(1, (s.swapped || 0) / usable);
       mk(live - cached, cached, "#0b57d0");   // seat-mapped blocks
       mk(cached, 0, "#0a7d32");               // prefix-cached share
       // queued demand renders as an over-line marker band at the top
       if (queued > 0) mk(Math.min(0.12, 0.12 * queued), 0.88, "#a86500");
+      // swapped-out blocks (ISSUE 12): host-resident KV of preempted
+      // seats — a purple under-line band, so a thrashing pool reads
+      // as live pressure on top AND spill volume below
+      if (swapped > 0) mk(Math.min(0.12, 0.12 * swapped), 0, "#7a2ea0");
     }
     const last = samples[samples.length - 1];
     const label = document.createElement("div");
@@ -263,6 +269,7 @@ async function refreshArena() {
       `replica ${rep.replica}: ${last.live}/${usable} blocks live ` +
       `(${last.prefix_cached} prefix-cached), ` +
       `${last.queued_demand} queued demand, ` +
+      `${last.swapped || 0} swapped, ` +
       `${last.seats_active} seats — ${samples.length} samples`;
     el.appendChild(svg); el.appendChild(label);
   }

@@ -263,6 +263,20 @@ def default_rules(
             severity="ticket",
         ),
         ThresholdRule(
+            # sustained mid-decode preemption (ISSUE 12): the paged
+            # pool's budget-on-demand oversubscription is losing its
+            # gamble often enough that seats are thrashing through the
+            # host swap arena — interactive TTFT is about to burn.
+            # The stock serving autoscaling policy binds this rule so
+            # sustained swapping scales replicas OUT before the SLO
+            # pages; a handful of preemptions per window is the
+            # mechanism working as designed and stays quiet.
+            "serve-preemption-rate",
+            metric="serve_preemptions_total",
+            kind="counter_increase", threshold=8.0, window=short,
+            severity="ticket",
+        ),
+        ThresholdRule(
             "checkpoint-stale",
             metric="checkpoint_last_success_unix",
             kind="gauge_age", threshold=1800.0,

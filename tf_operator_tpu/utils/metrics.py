@@ -43,6 +43,8 @@ DISPATCH_PHASES = (
     "scatter",    # legacy pool seating scatter
     "step",       # pool K-step decode window; speculative host driver
     "retire",     # paged pool batched device-state reset
+    "swap_out",   # preemption: victim block gather + rng fetch (ISSUE 12)
+    "swap_in",    # resume: swapped-block upload + device-row restore
     "decode",     # chunked decoder budget loop
     "generate",   # speculative fused whole-generation program
     "round",      # speculative host-driven round loop
