@@ -674,7 +674,8 @@ def bench_paged() -> dict:
     out["paged_slot_baseline_tokens_per_sec"] = round(total_new / wall_s, 1)
     out["paged_slot_baseline_concurrent"] = conc_s
     out["paged_slot_baseline_p99_ttft_s"] = m_s.histogram(
-        "serve_ttft_seconds", model="paged-bench", mode="pool"
+        "serve_ttft_seconds", model="paged-bench", mode="pool",
+        tier="batch",
     ).get("p99_le")
     out["paged_slot_baseline_dispatches"] = slot_pool.ledger.snapshot()
 
@@ -690,7 +691,8 @@ def bench_paged() -> dict:
     )
     out["paged_equal_slots_tokens_per_sec"] = round(total_new / wall_e, 1)
     out["paged_equal_slots_p99_ttft_s"] = m_e.histogram(
-        "serve_ttft_seconds", model="paged-bench", mode="pool"
+        "serve_ttft_seconds", model="paged-bench", mode="pool",
+        tier="batch",
     ).get("p99_le")
     # < 1.0 = paged is FASTER at equal resources (prefix-cache hits
     # skip prefill work and outweigh the gather/scatter layout cost)
@@ -713,7 +715,8 @@ def bench_paged() -> dict:
     out["paged_tokens_per_sec"] = round(total_new / wall_p, 1)
     out["paged_concurrent_admitted"] = conc_p
     out["paged_p99_ttft_s"] = m_p.histogram(
-        "serve_ttft_seconds", model="paged-bench", mode="pool"
+        "serve_ttft_seconds", model="paged-bench", mode="pool",
+        tier="batch",
     ).get("p99_le")
     out["paged_dispatches"] = paged_pool.ledger.snapshot()
     h0, m0 = paged_pool._hit_base
@@ -921,6 +924,218 @@ def bench_paged() -> dict:
     # never preempts, but the tier policy still may (an interactive
     # admission evicting a batch seat) — record, don't assume zero
     out["paged_worstcase_preemptions"] = pool_wc.preemptions
+
+    # leg F — DISAGGREGATED serving (ISSUE 13): at the SAME total
+    # arena and seat count, a prefill/decode phase-split fleet (1
+    # prefill + 1 decode replica over the prefix-cache fabric) vs the
+    # uniform 2-replica pool, under a mixed long-prompt/short-decode
+    # bursty trace where 60% of the long prompts share a multi-block
+    # system prefix.  The split removes prefill head-of-line blocking
+    # from the decode loop (a long chunked prefill admission no longer
+    # stalls a replica's decode batch) and the fabric shares prefix
+    # work ACROSS replicas (a uniform fleet's per-replica caches
+    # cannot).  p99 TTFT is computed EXACTLY from the per-request
+    # autopsies (no histogram bucket rounding).  CPU-smoke caveats:
+    # both fleets' replicas share this box's cores, so tokens/sec
+    # mainly proves accounting — the p99 TTFT comparison is the
+    # chip-transferable number (HOL blocking is scheduling, not
+    # compute).  MEASURE_PAGED_DISAGG=0 skips the leg.
+    if os.environ.get("MEASURE_PAGED_DISAGG", "1") != "0":
+        out.update(_bench_disaggregated(
+            model, params, vocab, seq=seq, block=block,
+            slots_base=slots_base, k_sync=k_sync, burst=burst,
+        ))
+    return out
+
+
+def _bench_disaggregated(model, params, vocab, *, seq, block, slots_base,
+                         k_sync, burst) -> dict:
+    """bench_paged leg F (see its comment): uniform vs phase-split
+    fleet at equal total arena; returns paged_uniform_* /
+    paged_disagg_* keys."""
+
+    import threading
+
+    import numpy as np
+
+    from tf_operator_tpu.models.batching import (
+        PagedContinuousBatchingDecoder,
+    )
+    from tf_operator_tpu.models.pool_router import PoolRouter
+    from tf_operator_tpu.models.prefix_cache import PrefixFabric
+    from tf_operator_tpu.utils.metrics import Metrics
+
+    out = {}
+    arena_rep = slots_base * (seq // block)  # per replica; total = 2x
+    n_req = int(os.environ.get("MEASURE_PAGED_DISAGG_REQUESTS", "24"))
+    # one SHAPE plan, two content realizations: the warmup replays the
+    # same prompt lengths/budgets with DIFFERENT tokens, so every
+    # admission width class compiles off the clock while the timed
+    # run's prompt content stays COLD — both fleets really pay the
+    # long prefills the leg exists to compare (a content-identical
+    # warmup would pre-publish the prefixes into every cache and
+    # erase the effect)
+    shape_r = np.random.RandomState(99)
+    long_p = min(seq // 2, seq - 24)
+    plan = []  # (is_long, tail_len, budget)
+    for _ in range(n_req):
+        if shape_r.rand() < 0.35:
+            plan.append((True, 8, 8))
+        else:
+            plan.append((False, int(shape_r.randint(4, 12)),
+                         int(shape_r.choice([8, 16]))))
+
+    def make_trace(seed):
+        r = np.random.RandomState(seed)
+        sys_prefix = r.randint(
+            0, vocab, size=(long_p - 8,)
+        ).astype(np.int32)
+        trace = []
+        for is_long, tail_len, budget in plan:
+            tail = r.randint(0, vocab, size=(tail_len,)).astype(np.int32)
+            prompt = (
+                np.concatenate([sys_prefix, tail]) if is_long else tail
+            )
+            trace.append((prompt, budget))
+        return trace
+
+    warm_trace, trace = make_trace(77), make_trace(1234)
+    total_new = sum(b for _, b in trace)
+    out["paged_disagg_trace_requests"] = n_req
+    out["paged_disagg_long_share"] = round(
+        sum(1 for is_long, _, _ in plan if is_long) / n_req, 2
+    )
+    out["paged_disagg_arena_blocks_total"] = 2 * arena_rep
+
+    def replay_fleet(tag, make_pools):
+        metrics = Metrics()
+        pools = make_pools(metrics)
+        router = PoolRouter(pools)
+        stop = threading.Event()
+
+        def drive(p):
+            while not stop.is_set():
+                if p.step() == 0:
+                    time.sleep(0.001)
+
+        threads = [
+            threading.Thread(target=drive, args=(p,), daemon=True)
+            for p in pools
+        ]
+        for t in threads:
+            t.start()
+
+        def run_trace(run, replay):
+            rids = [None] * len(replay)
+
+            def one(j):
+                rids[j] = router.submit(
+                    replay[j][0], replay[j][1],
+                    trace_id=f"{tag}-{run}-{j}",
+                )
+
+            subs = []
+            for j0 in range(0, len(replay), burst):
+                batch = [
+                    threading.Thread(target=one, args=(j,))
+                    for j in range(j0, min(j0 + burst, len(replay)))
+                ]
+                for t in batch:
+                    t.start()
+                subs.extend(batch)
+                time.sleep(0.02)  # bursty, not all-at-once
+            for t in subs:
+                t.join()
+            for rid in rids:
+                assert router.result_wait(rid, timeout=600) is not None
+
+        try:
+            # shape-identical, content-fresh warmup (see plan comment)
+            run_trace("warm", warm_trace)
+            t0 = time.perf_counter()
+            run_trace("timed", trace)
+            wall = time.perf_counter() - t0
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        ttfts = [
+            router.request_autopsy(f"{tag}-timed-{j}")["ttft_seconds"]
+            for j in range(len(trace))
+        ]
+        return wall, ttfts, pools
+
+    wall_u, ttft_u, _ = replay_fleet(
+        "uni",
+        lambda m: [
+            PagedContinuousBatchingDecoder(
+                model, params, slots=slots_base, steps_per_sync=k_sync,
+                kv_blocks=arena_rep, kv_block_size=block, metrics=m,
+                model_label="paged-bench", replica_label=str(i),
+            )
+            for i in range(2)
+        ],
+    )
+
+    def split_pools(m):
+        fabric = PrefixFabric(metrics=m, model_label="paged-bench")
+        return [
+            PagedContinuousBatchingDecoder(
+                model, params, slots=slots_base, steps_per_sync=k_sync,
+                kv_blocks=arena_rep, kv_block_size=block, metrics=m,
+                model_label="paged-bench", replica_label="p0",
+                role="prefill", fabric=fabric,
+            ),
+            PagedContinuousBatchingDecoder(
+                model, params, slots=slots_base, steps_per_sync=k_sync,
+                kv_blocks=arena_rep, kv_block_size=block, metrics=m,
+                model_label="paged-bench", replica_label="d0",
+                role="decode", fabric=fabric,
+            ),
+        ]
+
+    wall_d, ttft_d, pools_d = replay_fleet("dis", split_pools)
+    p99 = lambda xs: round(float(np.percentile(np.asarray(xs), 99)), 4)
+    shorts = [j for j, (is_long, _, _) in enumerate(plan) if not is_long]
+    longs = [j for j, (is_long, _, _) in enumerate(plan) if is_long]
+    out["paged_uniform_tokens_per_sec"] = round(total_new / wall_u, 1)
+    out["paged_uniform_p99_ttft_s"] = p99(ttft_u)
+    out["paged_uniform_mean_ttft_s"] = round(float(np.mean(ttft_u)), 4)
+    out["paged_disagg_tokens_per_sec"] = round(total_new / wall_d, 1)
+    out["paged_disagg_p99_ttft_s"] = p99(ttft_d)
+    out["paged_disagg_mean_ttft_s"] = round(float(np.mean(ttft_d)), 4)
+    # per-class quantiles: the short-decode class is the one prefill
+    # head-of-line blocking victimizes in a uniform fleet
+    if shorts:
+        out["paged_uniform_short_p99_ttft_s"] = p99(
+            [ttft_u[j] for j in shorts]
+        )
+        out["paged_disagg_short_p99_ttft_s"] = p99(
+            [ttft_d[j] for j in shorts]
+        )
+    if longs:
+        out["paged_uniform_long_p99_ttft_s"] = p99(
+            [ttft_u[j] for j in longs]
+        )
+        out["paged_disagg_long_p99_ttft_s"] = p99(
+            [ttft_d[j] for j in longs]
+        )
+    # > 1.0 = the phase split BEATS the uniform pool on p99 TTFT
+    out["paged_disagg_ttft_p99_speedup"] = round(
+        p99(ttft_u) / max(1e-9, p99(ttft_d)), 2
+    )
+    fabric = pools_d[0].fabric
+    snap = fabric.snapshot()
+    out["paged_disagg_fabric_publishes"] = snap["publishes"]
+    out["paged_disagg_fabric_blocks"] = snap["blocks"]
+    out["paged_disagg_fabric_hit_rate"] = round(
+        snap["hits"] / max(1, snap["hits"] + snap["misses"]), 3
+    )
+    dec_phases = pools_d[1].ledger.snapshot()
+    out["paged_disagg_migrate_in_dispatches"] = dec_phases.get(
+        "migrate_in", {}
+    ).get("count", 0)
+    out["paged_disagg_decode_dispatches"] = dec_phases
     return out
 
 

@@ -101,7 +101,7 @@ STEPS = [
     # Runs right after batching so a dying tunnel
     # can't lose the serving rows again.  Budget: ~13 pool builds
     # (3 legs + 2 ctx x 2 seat-mix x 2 mode bandwidth legs + 2 tier
-    # legs) x
+    # legs + leg F's 4 disaggregation fleet pools, ISSUE 13) x
     # width-class compiles on the 1-core host.  WINDOWS=4 keeps the
     # leg-D decode budget ((4+2) x K = 192) low enough that BOTH ctx
     # classes (64 and 256) fit under max_len=512 — the long-context
@@ -111,7 +111,7 @@ STEPS = [
         "paged-chip",
         [sys.executable, os.path.join(HERE, "measure.py"),
          "--section", "paged"],
-        2700,
+        3300,
         {
             "MEASURE_PAGED_MAXLEN": "512",
             "MEASURE_PAGED_REQUESTS": "24",
@@ -128,7 +128,7 @@ STEPS = [
         "paged",
         [sys.executable, os.path.join(HERE, "measure.py"),
          "--section", "paged"],
-        1500,
+        2100,
         {
             "MEASURE_PLATFORM": "cpu",
             "MEASURE_PAGED_TINY": "1",

@@ -22,7 +22,13 @@ loop, step-granular joins; PAGED by default since r11: block-granular
 KV admission + shared prefix cache, `--kv-blocks`/`--kv-block-size`
 size the arena); `--replicas N` runs N pool replicas behind one
 admission queue (models/pool_router.py — per-replica gauges on
-/metrics, merged quantiles on /slo); `--quantize int8` halves HBM
+/metrics, merged quantiles on /slo); `--roles prefill=1,decode=2`
+phase-splits the fleet (r15, ISSUE 13): prefill replicas chunk-prefill
+and publish finished prompt blocks into the shared prefix-cache
+fabric, decode replicas map the published chain (pulling only the
+missing tail — migrate_in) and run the unchanged 1-dispatch/step
+loop, and the two replica classes scale independently off
+kv_blocks_pressure{role=}; `--quantize int8` halves HBM
 weight traffic per decoded token (ops/quant.py); `--speculative`
 serves greedy requests through the int8 self-draft speculative
 decoder (models/speculative.py — batch-1 latency mode).  `--quantize`
@@ -127,12 +133,61 @@ def speculative_slowdown(ledger_path: "str | None" = None):
     return row["value"], row
 
 
+def parse_roles(spec: str) -> "list[str]":
+    """``--roles prefill=1,decode=2`` → ["prefill", "decode",
+    "decode"] (ISSUE 13).  Roles come from
+    models/batching.REPLICA_ROLES; a disaggregated spec (any prefill)
+    must also declare at least one decode/unified replica."""
+
+    roles: "list[str]" = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"--roles entries are role=count, got {part!r}"
+            )
+        role, _, count = part.partition("=")
+        role = role.strip()
+        if role not in ("prefill", "decode", "unified"):
+            raise ValueError(
+                f"unknown role {role!r} (prefill|decode|unified)"
+            )
+        try:
+            n = int(count)
+        except ValueError:
+            raise ValueError(f"--roles count must be an int, got {count!r}")
+        if n < 0:
+            raise ValueError(f"--roles count must be >= 0, got {n}")
+        roles.extend([role] * n)
+    if not roles:
+        raise ValueError("--roles declared no replicas")
+    if "prefill" in roles and not any(
+        r in ("decode", "unified") for r in roles
+    ):
+        raise ValueError(
+            "--roles with prefill replicas needs at least one "
+            "decode/unified replica (prefill replicas never decode)"
+        )
+    if "decode" in roles and "prefill" not in roles:
+        # a decode-only fleet would behave like a uniform pool while
+        # wearing role="decode" labels — the disaggregated policy
+        # slices and /metrics would misrepresent it as phase-split
+        raise ValueError(
+            "--roles with decode replicas needs at least one prefill "
+            "replica (use unified=N for a non-split fleet)"
+        )
+    return roles
+
+
 def build_handler(
     model, params, max_len: int, batching_slots: int = 0,
     speculative: bool = False, prompt_cache: int = 0, tracer=None,
     model_label: str = "", metrics=None, replicas: int = 1,
     kv_blocks: "int | None" = None, kv_block_size: int = 16,
     paged_kernel: str = "auto", kv_swap_blocks: "int | None" = None,
+    roles: "list[str] | None" = None,
 ):
     """batching_slots > 0 serves through the continuous-batching pool
     (models/batching.py): concurrent requests share one decode loop,
@@ -256,6 +311,7 @@ def build_handler(
         pool = None
         pool_replicas = []
         pool_fatal = []
+        pool_fabric = None
         # top_k fallback path; prompt-KV reuse helps it too
         decoder = ChunkedServingDecoder(
             model, params, prompt_cache=prompt_cache, ledger=ledger,
@@ -273,8 +329,22 @@ def build_handler(
         )
         from tf_operator_tpu.models.kv_blocks import NotPageableError
         from tf_operator_tpu.models.pool_router import PoolRouter
+        from tf_operator_tpu.models.prefix_cache import PrefixFabric
 
         n_replicas = max(1, int(replicas))
+        role_list = list(roles) if roles else ["unified"] * n_replicas
+        if len(role_list) != n_replicas:
+            raise ValueError(
+                f"--roles declares {len(role_list)} replicas but "
+                f"--replicas says {n_replicas}"
+            )
+        # ISSUE 13: the prefix-cache FABRIC is the migration transport
+        # of a disaggregated fleet — one shared host-side store every
+        # replica publishes into / pulls from
+        fabric = (
+            PrefixFabric(metrics=metrics, model_label=model_label)
+            if "prefill" in role_list else None
+        )
         pool_replicas = []
         for i in range(n_replicas):
             # replica labels only under the router: single-replica
@@ -296,6 +366,7 @@ def build_handler(
                     model_label=model_label, replica_label=rep,
                     paged_kernel=paged_kernel,
                     swap_blocks=kv_swap_blocks,
+                    role=role_list[i], fabric=fabric,
                 )
                 if i == 0:
                     print(
@@ -304,6 +375,14 @@ def build_handler(
                         flush=True,
                     )
             except NotPageableError as exc:
+                if fabric is not None:
+                    # the fabric transport is block-granular: a model
+                    # the paged pool refuses cannot be disaggregated —
+                    # fail startup rather than silently serve a
+                    # unified contiguous fleet under --roles
+                    raise ValueError(
+                        f"--roles requires the paged pool: {exc}"
+                    ) from exc
                 # MODEL-shape fallback only (rolling-window caches):
                 # operator config errors (bad --kv-blocks /
                 # --kv-block-size) must fail startup, not silently
@@ -353,11 +432,13 @@ def build_handler(
                 target=_drive, args=(p, name), daemon=True
             ).start()
         spec = None
+        pool_fabric = fabric
     else:
         pool = None
         spec = None
         pool_replicas = []
         pool_fatal = []
+        pool_fabric = None
         decoder = ChunkedServingDecoder(
             model, params, prompt_cache=prompt_cache, ledger=ledger,
         )
@@ -483,9 +564,16 @@ def build_handler(
                              "are trace ids — the /generate response's "
                              "request_id / x-trace-id header)"})
             if self.path == "/debug/arena":
-                # the KV-arena occupancy timeline per paged replica —
-                # the time-series twin of kv_blocks_pressure
-                return self._reply(200, {"replicas": arena_snapshots()})
+                # the KV-arena occupancy timeline per paged replica
+                # (each snapshot carries the replica's phase role) —
+                # the time-series twin of kv_blocks_pressure — plus
+                # the fabric's publish/pull accounting when the fleet
+                # is disaggregated (ISSUE 13)
+                return self._reply(200, {
+                    "replicas": arena_snapshots(),
+                    "fabric": pool_fabric.snapshot()
+                    if pool_fabric is not None else None,
+                })
             if self.path == "/debug/profile" or \
                     self.path.startswith("/debug/profile?"):
                 # exact-or-query match only: a typo'd /debug/profileX
@@ -833,6 +921,18 @@ def main() -> int:
              "/slo).  Requires --batching",
     )
     ap.add_argument(
+        "--roles", default=None, metavar="ROLE=N,...",
+        help="phase-split the replica fleet (ISSUE 13 disaggregated "
+             "serving): e.g. 'prefill=1,decode=2' runs one prefill "
+             "replica (chunk-prefills prompts and publishes finished "
+             "blocks into the prefix-cache fabric) and two decode "
+             "replicas (admit by mapping the published chain, pulling "
+             "only the missing tail — migrate_in — then run the "
+             "unchanged 1-dispatch/step loop).  Implies --replicas = "
+             "the declared total; requires --batching and a pageable "
+             "model.  Default: every replica 'unified' (both phases)",
+    )
+    ap.add_argument(
         "--kv-blocks", type=int, default=None, metavar="N",
         help="paged pool arena size in KV blocks per replica (default: "
              "slots x max_len / block-size — the same HBM the slot "
@@ -943,6 +1043,21 @@ def main() -> int:
         )
     if args.replicas > 1 and not args.batching:
         raise SystemExit("--replicas requires --batching SLOTS")
+    role_list = None
+    if args.roles:
+        if not args.batching:
+            raise SystemExit("--roles requires --batching SLOTS")
+        try:
+            role_list = parse_roles(args.roles)
+        except ValueError as exc:
+            raise SystemExit(f"bad --roles: {exc}")
+        if args.replicas > 1 and args.replicas != len(role_list):
+            raise SystemExit(
+                f"--roles declares {len(role_list)} replicas but "
+                f"--replicas says {args.replicas} — drop one of the flags"
+            )
+        args.replicas = len(role_list)
+        print(f"disaggregated roles: {','.join(role_list)}", flush=True)
     handler = build_handler(
         model, params, max_len,
         batching_slots=args.batching, speculative=args.speculative,
@@ -950,6 +1065,7 @@ def main() -> int:
         metrics=serve_metrics, replicas=args.replicas,
         kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size,
         paged_kernel=args.paged_kernel, kv_swap_blocks=args.kv_swap_blocks,
+        roles=role_list,
     )
     server = ThreadingHTTPServer(("127.0.0.1", args.port), handler)
     # the serving binary boots the SLO evaluator (build_handler only

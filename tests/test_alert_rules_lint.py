@@ -170,6 +170,15 @@ def test_collector_sees_known_call_sites():
     assert "tier" in families["serve_ttft_seconds"]
     assert "tier" in families["serve_time_per_output_token_seconds"]
     assert "tier" in families["serve_queue_wait_seconds"]
+    # ISSUE 13: the disaggregated serving plane — the role-filtered
+    # stock policies bind kv_blocks_pressure{role=}, and the fabric
+    # transport's own families must stay declared at literal sites
+    assert {"model", "replica", "role"} <= families["kv_blocks_pressure"]
+    assert {"model", "replica", "role"} <= families["kv_blocks_free"]
+    assert "direction" in families["kv_migrate_bytes_total"]
+    assert "model" in families["kv_fabric_blocks"]
+    assert "model" in families["kv_fabric_publishes_total"]
+    assert "model" in families["serve_fabric_publish_failures_total"]
 
 
 def collect_dispatch_phases():

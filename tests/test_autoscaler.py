@@ -568,3 +568,42 @@ class TestCapacityKnobs:
             assert capacity()["grantedChips"] == 32
         finally:
             sim.stop()
+
+
+def test_role_filtered_bindings_keep_separate_hysteresis_latches():
+    """ISSUE 13 review finding: two label-filtered bindings on ONE
+    gauge family in one policy must not share a hysteresis latch — a
+    breached {role=prefill} slice would otherwise latch the
+    {role=decode} slice breaching while decode sits in the
+    between-release-and-threshold band (and their signal keys must
+    not collide in the values map either)."""
+
+    from tf_operator_tpu.controller.autoscaler import _PolicyState
+
+    m = Metrics()
+    a = Autoscaler(metrics=m)
+    pol = serving_policy(signals=[
+        SignalBinding(kind="gauge", name="kv_blocks_pressure",
+                      threshold=0.85, labels={"role": "prefill"}),
+        SignalBinding(kind="gauge", name="kv_blocks_pressure",
+                      threshold=0.85, labels={"role": "decode"}),
+    ])
+    st = _PolicyState()
+    m.set("kv_blocks_pressure", 1.0, model="t", replica="0",
+          role="prefill")
+    # decode sits between the release level (0.85*0.5) and the
+    # threshold: with a fresh latch of its own this is NOT breaching
+    m.set("kv_blocks_pressure", 0.5, model="t", replica="1",
+          role="decode")
+    breach, values = a._measure_signals(pol, st)
+    assert breach
+    assert values["kv_blocks_pressure{role=prefill}"]["breaching"]
+    assert not values["kv_blocks_pressure{role=decode}"]["breaching"]
+    # and the latches stay separate on release too
+    m.set("kv_blocks_pressure", 0.0, model="t", replica="0",
+          role="prefill")
+    m.set("kv_blocks_pressure", 1.0, model="t", replica="1",
+          role="decode")
+    _, values = a._measure_signals(pol, st)
+    assert not values["kv_blocks_pressure{role=prefill}"]["breaching"]
+    assert values["kv_blocks_pressure{role=decode}"]["breaching"]

@@ -110,9 +110,10 @@ def test_serving_policy_binds_blocks_free_pressure():
 
 def test_paged_serving_families_are_emitted_with_expected_labels():
     """The ISSUE 8 metric families any rule/policy/dashboard may bind:
-    kv_blocks_* gauges carry {model, replica}; the unified prefix
-    cache counters carry {mode} — a rename fails tier-1 here before
-    it orphans a binding silently."""
+    kv_blocks_* gauges carry {model, replica} plus — since ISSUE 13 —
+    the {role} key the disaggregated policies filter on; the unified
+    prefix cache counters carry {mode} — a rename fails tier-1 here
+    before it orphans a binding silently."""
 
     families = collect_emitted_families()
     for fam in (
@@ -122,7 +123,7 @@ def test_paged_serving_families_are_emitted_with_expected_labels():
         "kv_blocks_queued_demand",  # ISSUE 10: mid-burst demand ramp
         "kv_blocks_pressure",
     ):
-        assert {"model", "replica"} <= families[fam], fam
+        assert {"model", "replica", "role"} <= families[fam], fam
     for fam in (
         "serve_prefix_cache_hits_total",
         "serve_prefix_cache_misses_total",
@@ -157,6 +158,56 @@ def test_swap_and_commit_families_are_emitted_with_expected_labels():
     assert "direction" in families["kv_swap_bytes_total"]
     for fam in ("kv_blocks_committed", "kv_blocks_reserved"):
         assert {"model", "replica"} <= families[fam], fam
+
+
+def test_disaggregated_policies_bind_role_labeled_pressure():
+    """ISSUE 13: the stock disaggregated policy pair scales the
+    prefill (PS) and decode (WORKER) replica classes INDEPENDENTLY off
+    ``kv_blocks_pressure{role=}``.  The gate pins: both role filters
+    name label KEYS the emitting call sites declare, the gauge family
+    is live, every role value is a real replica role, thresholds stay
+    below the kv-blocks-pressure page, the decode class keeps the
+    SLO/thrash alert bindings, and the pair passes admission on a
+    PS+WORKER serving job."""
+
+    from tf_operator_tpu.controller.autoscaler import (
+        default_disaggregated_policies,
+    )
+    from tf_operator_tpu.models.batching import REPLICA_ROLES
+
+    families = collect_emitted_families()
+    pols = default_disaggregated_policies()
+    assert len(pols) == 2
+    rule_names = {r.name for r in default_rules()}
+    pressure_rule = next(
+        r for r in default_rules() if r.name == "kv-blocks-pressure"
+    )
+    roles_bound = set()
+    for pol in pols:
+        for sig in pol.signals:
+            if sig.kind == "gauge":
+                assert sig.name in families, sig.name
+                assert set(sig.labels) <= families[sig.name], (
+                    f"{pol.replica_type.value} filters on label keys "
+                    f"{sorted(set(sig.labels) - families[sig.name])} "
+                    f"never attached to {sig.name!r}"
+                )
+                role = sig.labels.get("role")
+                assert role in REPLICA_ROLES, role
+                roles_bound.add(role)
+                assert sig.threshold <= pressure_rule.threshold
+            else:
+                assert sig.name in rule_names, sig.name
+    assert roles_bound == {"prefill", "decode"}
+    decode_pol = next(
+        p for p in pols if p.replica_type is ReplicaType.WORKER
+    )
+    alert_sigs = {s.name for s in decode_pol.signals if s.kind == "alert"}
+    assert {"serve-queue-wait-burn", "serve-preemption-rate"} <= alert_sigs
+
+    job = new_job(name="disagg-lint", ps=1, worker=2)
+    job.spec.autoscaling = AutoscalingSpec(policies=pols)
+    validate(job)  # raises on a structurally bad template
 
 
 def test_stock_policy_checkpoint_gate_is_consistent_with_alert_rule():

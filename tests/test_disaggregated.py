@@ -1,0 +1,512 @@
+"""Disaggregated serving (ISSUE 13 tentpole): phase-split prefill/
+decode replicas with KV-block migration over the prefix-cache fabric.
+
+The load-bearing pins:
+
+- TOKEN IDENTITY: a request served through the disaggregated path
+  (prefill replica publishes → fabric → decode replica maps/pulls and
+  decodes) is byte-identical to the uniform pool — greedy AND
+  temperature, on BOTH step paths (gather emulation and the
+  interpret-mode Pallas kernel).  The decode replica's admission runs
+  the request's own rng split chain; the prefill replica's internal
+  publish prefill is greedy and consumes nothing.
+- DISPATCH ACCOUNTING: steady-state decode stays exactly 1 dispatch
+  per step window, with migration appearing ONLY as the new
+  ``migrate_out`` (prefill side) / ``migrate_in`` (decode side) ledger
+  phases — the decode replica never runs a prefill phase.
+- ATTRIBUTION: the autopsy names BOTH replicas (prefill_replica /
+  decode_replica), counts migrated blocks, and the route spans carry
+  phase/role; internal publish prefills never pollute user-facing SLO
+  histograms.
+- FAILURE SEMANTICS: a prefill replica dying mid-publish degrades to
+  the decode replica recomputing the prefix — same tokens, one
+  counted failure, no user-visible error.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # generation-loop compiles
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models import llama_tiny
+from tf_operator_tpu.models.batching import PagedContinuousBatchingDecoder
+from tf_operator_tpu.models.pool_router import PoolRouter
+from tf_operator_tpu.models.prefix_cache import PrefixFabric
+from tf_operator_tpu.utils.metrics import Metrics
+from tf_operator_tpu.utils.trace import Tracer
+
+VOCAB = 96
+
+
+def _setup(max_len=64):
+    model = llama_tiny(vocab_size=VOCAB, max_len=max_len)
+    init = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), init)["params"]
+    return model, params
+
+
+class _Fleet:
+    """1 prefill + 1 decode replica over one fabric, with driver
+    threads (the router's disaggregated submit BLOCKS on the prefill
+    handshake, so somebody must be stepping the pools)."""
+
+    def __init__(self, model, params, kernel="off", metrics=None,
+                 tracer=None, slots=4, kv_blocks=None):
+        from tf_operator_tpu.utils.metrics import DispatchLedger
+
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.fabric = PrefixFabric(metrics=self.metrics, model_label="t")
+        # per-pool ledgers (phase counts stay per-replica) sharing the
+        # router's tracer, so lifecycle + dispatch spans join the
+        # request's trace like serve_lm's wiring
+        self.prefill = PagedContinuousBatchingDecoder(
+            model, params, slots=slots, kv_block_size=16,
+            kv_blocks=kv_blocks, paged_kernel=kernel, metrics=self.metrics,
+            ledger=DispatchLedger(metrics=self.metrics, tracer=tracer),
+            model_label="t", replica_label="p0", role="prefill",
+            fabric=self.fabric,
+        )
+        self.decode = PagedContinuousBatchingDecoder(
+            model, params, slots=slots, kv_block_size=16,
+            kv_blocks=kv_blocks, paged_kernel=kernel, metrics=self.metrics,
+            ledger=DispatchLedger(metrics=self.metrics, tracer=tracer),
+            model_label="t", replica_label="d0", role="decode",
+            fabric=self.fabric,
+        )
+        self.router = PoolRouter([self.prefill, self.decode],
+                                 tracer=tracer)
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._drive, args=(p,), daemon=True)
+            for p in (self.prefill, self.decode)
+        ]
+
+    def _drive(self, pool):
+        while not self._stop.is_set():
+            if pool.step() == 0:
+                time.sleep(0.002)
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+        return False
+
+
+def _mixed_trace(r, n=6):
+    """Long prompts (multi-block, 60% sharing a system prefix — the
+    fabric's bread and butter) mixed with short single-block ones."""
+
+    sys_prefix = r.randint(0, VOCAB, size=(32,)).astype(np.int32)
+    trace = []
+    for i in range(n):
+        if i % 3 == 2:
+            prompt = r.randint(0, VOCAB, size=(6,)).astype(np.int32)
+        elif i % 2 == 0:
+            tail = r.randint(0, VOCAB, size=(int(r.randint(3, 9)),))
+            prompt = np.concatenate([sys_prefix, tail.astype(np.int32)])
+        else:
+            prompt = r.randint(0, VOCAB, size=(38,)).astype(np.int32)
+        trace.append((prompt, int(r.choice([4, 8]))))
+    return trace
+
+
+class TestTokenIdentity:
+    @pytest.mark.parametrize("kernel", ["off", "interpret"])
+    @pytest.mark.parametrize("temp", [0.0, 0.9])
+    def test_disaggregated_path_token_identical_to_uniform(self, kernel,
+                                                           temp):
+        model, params = _setup()
+        r = np.random.RandomState(11)
+        trace = _mixed_trace(r, n=4 if kernel == "interpret" else 6)
+
+        def submit_all(target):
+            rids = []
+            for j, (prompt, budget) in enumerate(trace):
+                rids.append(target.submit(
+                    prompt, budget, temperature=temp,
+                    rng=jax.random.PRNGKey(100 + j) if temp > 0 else None,
+                    trace_id=f"ti-{j}",
+                ))
+            return rids
+
+        with _Fleet(model, params, kernel=kernel) as fleet:
+            rids = submit_all(fleet.router)
+            outs = [fleet.router.result_wait(rid, timeout=300)
+                    for rid in rids]
+        assert all(o is not None for o in outs)
+        # migration really happened (the trace has publishable blocks)
+        assert fleet.fabric.snapshot()["publishes"] > 0
+        assert any(
+            p["count"] > 0
+            for ph, p in fleet.decode.ledger.snapshot().items()
+            if ph == "migrate_in"
+        )
+
+        uniform = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, paged_kernel=kernel,
+        )
+        urids = []
+        for j, (prompt, budget) in enumerate(trace):
+            urids.append(uniform.submit(
+                prompt, budget, temperature=temp,
+                rng=jax.random.PRNGKey(100 + j) if temp > 0 else None,
+            ))
+        uniform.run()
+        for out, urid in zip(outs, urids):
+            ref = uniform.result(urid)
+            assert np.array_equal(out, ref), (out, ref)
+        fleet.prefill.alloc.check()
+        fleet.decode.alloc.check()
+
+
+class TestDispatchAccounting:
+    def test_decode_replica_never_prefills_and_steps_stay_single_dispatch(self):
+        """The decode replica's ledger holds ONLY {admission, step,
+        retire, migrate_in} — no prefill/sample/scatter phase ever —
+        and the step count equals the number of decode windows (the
+        PR 10 exactly-1-dispatch/step contract survives migration).
+        The prefill replica's ledger shows the mirror image:
+        admission + retire (budget-1 publishes) + migrate_out, and no
+        step at all for publish-only traffic."""
+
+        model, params = _setup()
+        r = np.random.RandomState(3)
+        trace = _mixed_trace(r, n=6)
+        with _Fleet(model, params) as fleet:
+            rids = [fleet.router.submit(p, b, trace_id=f"da-{j}")
+                    for j, (p, b) in enumerate(trace)]
+            outs = [fleet.router.result_wait(rid, timeout=300)
+                    for rid in rids]
+        assert all(o is not None for o in outs)
+        dec = {ph: v["count"]
+               for ph, v in fleet.decode.ledger.snapshot().items()}
+        pre = {ph: v["count"]
+               for ph, v in fleet.prefill.ledger.snapshot().items()}
+        assert set(dec) <= {"admission", "step", "retire", "migrate_in"}, dec
+        assert dec.get("migrate_in", 0) > 0
+        assert dec["admission"] == len(trace)
+        # prefill-side: internal budget-1 admissions retire at
+        # admission — publish-only traffic never decodes a window
+        assert set(pre) <= {"admission", "retire", "migrate_out"}, pre
+        assert pre.get("migrate_out", 0) > 0
+        # window accounting: each step dispatch produced one
+        # decode.window per then-active seat; the autopsy's per-request
+        # share must sum to >= the global step count (shared windows)
+        windows = sum(
+            fleet.router.request_autopsy(f"da-{j}")["windows"]
+            for j in range(len(trace))
+        )
+        assert windows >= dec["step"]
+
+    def test_internal_publishes_never_pollute_user_slo(self):
+        model, params = _setup()
+        r = np.random.RandomState(5)
+        trace = _mixed_trace(r, n=4)
+        with _Fleet(model, params) as fleet:
+            rids = [fleet.router.submit(p, b) for p, b in trace]
+            for rid in rids:
+                assert fleet.router.result_wait(rid, timeout=300) \
+                    is not None
+        fam = fleet.metrics.histogram_family("serve_ttft_seconds")
+        total = sum(s["count"] for s in fam.values())
+        # one TTFT observation per USER request — the prefill
+        # replica's internal publish prefills observe nothing
+        assert total == len(trace)
+        for labels, _ in fam.items():
+            assert dict(labels)["role"] == "decode"
+
+
+class TestAttributionAndSpans:
+    def test_autopsy_names_both_replicas_and_counts_migration(self):
+        model, params = _setup()
+        tracer = Tracer(seed=0)
+        r = np.random.RandomState(9)
+        sys_prefix = r.randint(0, VOCAB, size=(32,)).astype(np.int32)
+        long_prompt = np.concatenate(
+            [sys_prefix, r.randint(0, VOCAB, size=(5,)).astype(np.int32)]
+        )
+        short_prompt = r.randint(0, VOCAB, size=(6,)).astype(np.int32)
+        with _Fleet(model, params, tracer=tracer) as fleet:
+            rid_l = fleet.router.submit(long_prompt, 4, trace_id="long")
+            rid_s = fleet.router.submit(short_prompt, 4, trace_id="short")
+            assert fleet.router.result_wait(rid_l, timeout=300) is not None
+            assert fleet.router.result_wait(rid_s, timeout=300) is not None
+        a = fleet.router.request_autopsy("long")
+        assert a["prefill_replica"] == "p0"
+        assert a["decode_replica"] == "d0"
+        assert a["migrated_blocks"] == 2  # (33-1)//16 full chain blocks
+        assert a["dispatches"].get("migrate_in") == 1
+        # short prompts (no publishable block) skip the handshake: the
+        # decode replica IS the prefill replica
+        s = fleet.router.request_autopsy("short")
+        assert s["prefill_replica"] == "d0"
+        assert s["decode_replica"] == "d0"
+        assert s["migrated_blocks"] == 0
+        # route spans carry phase/role; the long request has BOTH
+        trace = tracer.store.trace("long")
+        routes = [
+            sp for sp in trace["spans"] if sp["name"] == "route"
+        ]
+        phases = {
+            sp["attributes"]["phase"]: sp["attributes"] for sp in routes
+        }
+        assert set(phases) == {"prefill", "decode"}
+        assert phases["prefill"]["role"] == "prefill"
+        assert phases["prefill"]["replica"] == "p0"
+        assert phases["decode"]["replica"] == "d0"
+        # the migrate lifecycle span landed on the same trace
+        assert any(sp["name"] == "migrate" for sp in trace["spans"])
+
+    def test_role_labeled_pressure_gauges_split_by_class(self):
+        model, params = _setup()
+        m = Metrics()
+        with _Fleet(model, params, metrics=m) as fleet:
+            r = np.random.RandomState(2)
+            prompt = r.randint(0, VOCAB, size=(40,)).astype(np.int32)
+            rid = fleet.router.submit(prompt, 8)
+            assert fleet.router.result_wait(rid, timeout=300) is not None
+        for rep, role in (("p0", "prefill"), ("d0", "decode")):
+            series = m.gauge_series("kv_blocks_pressure")
+            match = [
+                v for labels, v in series.items()
+                if dict(labels).get("replica") == rep
+                and dict(labels).get("role") == role
+            ]
+            assert match, (rep, role, series)
+        # the arena timelines carry the role too (per-role strips)
+        snaps = fleet.router.arena_snapshots()
+        assert {s["role"] for s in snaps} == {"prefill", "decode"}
+
+
+class TestFailureSemantics:
+    def test_prefill_death_mid_publish_degrades_to_local_recompute(self):
+        """The documented failure rule: when the prefill replica dies
+        mid-publish, the decode replica recomputes whatever never
+        reached the fabric — same tokens, one counted failure, no
+        user-visible error."""
+
+        model, params = _setup()
+        m = Metrics()
+        r = np.random.RandomState(4)
+        prompt = r.randint(0, VOCAB, size=(40,)).astype(np.int32)
+
+        uniform = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16,
+        )
+        urid = uniform.submit(prompt, 6)
+        uniform.run()
+        ref = uniform.result(urid)
+
+        with _Fleet(model, params, metrics=m) as fleet:
+            def dead_publish(*a, **k):
+                raise RuntimeError("prefill replica died mid-publish")
+
+            fleet.prefill.publish_to_fabric = dead_publish
+            rid = fleet.router.submit(prompt, 6)
+            out = fleet.router.result_wait(rid, timeout=300)
+        assert out is not None and np.array_equal(out, ref)
+        assert m.counter(
+            "serve_fabric_publish_failures_total", model="t"
+        ) == 1.0
+        # nothing migrated — the decode replica computed the prefix
+        assert "migrate_in" not in fleet.decode.ledger.snapshot()
+
+    def test_dead_prefill_driver_times_out_into_recompute(self):
+        """A WEDGED (not crashed) prefill replica — driver thread
+        never steps — must not hang the submit thread forever:
+        publish_to_fabric times out, the failure path counts it, and
+        the decode replica recomputes (review finding)."""
+
+        model, params = _setup()
+        m = Metrics()
+        r = np.random.RandomState(8)
+        prompt = r.randint(0, VOCAB, size=(40,)).astype(np.int32)
+
+        uniform = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16,
+        )
+        urid = uniform.submit(prompt, 6)
+        uniform.run()
+        ref = uniform.result(urid)
+
+        fleet = _Fleet(model, params, metrics=m)
+        fleet.router.publish_timeout = 0.5
+        # start ONLY the decode driver: the prefill pool accepts the
+        # internal submit but nobody ever steps it
+        fleet._threads[1].start()
+        try:
+            rid = fleet.router.submit(prompt, 6)
+            out = fleet.router.result_wait(rid, timeout=300)
+        finally:
+            fleet._stop.set()
+            fleet._threads[1].join(timeout=30)
+        assert out is not None and np.array_equal(out, ref)
+        assert m.counter(
+            "serve_fabric_publish_failures_total", model="t"
+        ) == 1.0
+
+    def test_evicted_head_with_live_tail_pulls_without_leaking(self):
+        """Chain walks refresh LRU head-first, so a pressured local
+        cache evicts a chain's HEAD while its tail stays resident.
+        The fabric pull must stop at the first still-local link — a
+        pull-over would prefix.put over the live entry and leak the
+        old block's cache reference (review finding; alloc.check()
+        catches the leak)."""
+
+        model, params = _setup()
+        r = np.random.RandomState(10)
+        prompt = r.randint(0, VOCAB, size=(40,)).astype(np.int32)
+        with _Fleet(model, params) as fleet:
+            rid = fleet.router.submit(prompt, 6)
+            assert fleet.router.result_wait(rid, timeout=300) is not None
+            # both full blocks now sit in the decode replica's local
+            # cache (refcount 1 each) AND the fabric; evict the HEAD
+            with fleet.decode._lock:
+                assert fleet.decode.prefix.evict_lru(need=1) == 1
+            # same prompt again: the pull re-fetches the head from the
+            # fabric but must stop before the still-local tail
+            rid2 = fleet.router.submit(prompt, 6)
+            out2 = fleet.router.result_wait(rid2, timeout=300)
+        assert out2 is not None
+        uniform = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16,
+        )
+        urid = uniform.submit(prompt, 6)
+        uniform.run()
+        assert np.array_equal(out2, uniform.result(urid))
+        # the leak check: conservation still holds and draining the
+        # cache releases every block
+        fleet.decode.alloc.check()
+        while fleet.decode.prefix.evict_lru(need=64):
+            pass
+        assert fleet.decode.alloc.in_use == 0
+
+    def test_fabric_capacity_eviction_degrades_to_recompute(self):
+        """A fabric too small to hold the chain still serves exactly:
+        evicted entries are recomputed decode-side (the pull just
+        misses)."""
+
+        model, params = _setup()
+        r = np.random.RandomState(6)
+        trace = _mixed_trace(r, n=4)
+        fleet = _Fleet(model, params)
+        fleet.fabric.capacity_blocks = 1  # pathological: one block
+        with fleet:
+            rids = [fleet.router.submit(p, b) for p, b in trace]
+            outs = [fleet.router.result_wait(rid, timeout=300)
+                    for rid in rids]
+        assert all(o is not None for o in outs)
+        uniform = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16,
+        )
+        urids = [uniform.submit(p, b) for p, b in trace]
+        uniform.run()
+        for out, urid in zip(outs, urids):
+            assert np.array_equal(out, uniform.result(urid))
+
+
+class TestServeLmRoles:
+    """serve_lm wiring: --roles parsing and the full HTTP surface of a
+    disaggregated fleet."""
+
+    def test_parse_roles(self):
+        from tests.testutil import load_serve_lm
+
+        serve_lm = load_serve_lm()
+        assert serve_lm.parse_roles("prefill=1,decode=2") == [
+            "prefill", "decode", "decode",
+        ]
+        assert serve_lm.parse_roles("unified=2") == ["unified", "unified"]
+        for bad in ("prefill=2", "prefill=1,decode=x", "chef=1", "",
+                    "prefill=-1,decode=1", "decode=2"):
+            # decode-only is rejected too: it would serve like a
+            # uniform fleet while wearing role="decode" labels
+            with pytest.raises(ValueError):
+                serve_lm.parse_roles(bad)
+
+    def test_disaggregated_fleet_over_http(self):
+        import json as _json
+        import urllib.request
+        from http.server import ThreadingHTTPServer
+
+        from tests.testutil import load_serve_lm
+
+        serve_lm = load_serve_lm()
+        model, params = _setup()
+        handler = serve_lm.build_handler(
+            model, params, max_len=64, batching_slots=2, replicas=2,
+            roles=["prefill", "decode"],
+        )
+        server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            # a multi-block prompt: the decode replica pulls its chain
+            # tail through the fabric
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=_json.dumps({
+                    "prompt": "x" * 40, "max_new_tokens": 6,
+                }).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                body = _json.loads(resp.read())
+            assert len(body["sample"]) == 6
+            rid = body["request_id"]
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/requests/{rid}", timeout=30
+            ) as resp:
+                autopsy = _json.loads(resp.read())
+            assert autopsy["prefill_replica"] == "0"
+            assert autopsy["decode_replica"] == "1"
+            assert autopsy["migrated_blocks"] == 2  # (40-1)//16
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/arena", timeout=30
+            ) as resp:
+                arena = _json.loads(resp.read())
+            assert arena["fabric"]["publishes"] >= 2
+            assert {r["role"] for r in arena["replicas"]} == {
+                "prefill", "decode",
+            }
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30
+            ) as resp:
+                text = resp.read().decode()
+            assert (
+                'kv_blocks_pressure{model="unknown",replica="0",'
+                'role="prefill"}'
+            ) in text
+            assert (
+                'kv_blocks_pressure{model="unknown",replica="1",'
+                'role="decode"}'
+            ) in text
+            assert "kv_fabric_blocks" in text
+            assert 'kv_migrate_bytes_total{direction="in"}' in text
+
+            # /slo still reports ONE user-facing TTFT row (role and
+            # replica merged away), counting only the USER request
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/slo", timeout=30
+            ) as resp:
+                slo = _json.loads(resp.read())
+            rows = slo["histograms"]["serve_ttft_seconds"]
+            assert len(rows) == 1 and rows[0]["count"] == 1
+            assert "role" not in rows[0] and "replica" not in rows[0]
+        finally:
+            server.shutdown()

@@ -326,3 +326,98 @@ class TestPrefixCache:
         c.record(True)
         c.record(False)
         assert (c.hits, c.misses) == (1, 1)
+
+
+class TestPrefixFabric:
+    """The cross-replica prefix-cache FABRIC (ISSUE 13): the migration
+    transport of disaggregated serving.  Host-only — records are plain
+    np trees here; the device gather/upload halves are covered by
+    tests/test_disaggregated.py."""
+
+    def _rec(self, seed: int = 0):
+        return {"k": np.full((1, 2, 16, 4), seed, np.float32)}
+
+    def test_put_get_contains_and_accounting(self):
+        from tf_operator_tpu.models.prefix_cache import PrefixFabric
+
+        f = PrefixFabric()
+        key = chain_keys(np.arange(16, dtype=np.int32), 16)[0]
+        assert key not in f and f.get(key) is None
+        f.put(key, self._rec(), nbytes=512)
+        assert key in f and len(f) == 1
+        assert f.get(key)["nbytes"] == 512
+        # idempotent re-publish: no double count
+        f.put(key, self._rec(), nbytes=512)
+        snap = f.snapshot()
+        assert snap["publishes"] == 1 and snap["bytes_published"] == 512
+        f.record(True)
+        f.record(False)
+        assert f.snapshot()["hits"] == 1
+        assert f.snapshot()["misses"] == 1
+
+    def test_identical_prefixes_produce_identical_chain_keys_across_replicas(self):
+        """The content-addressing property the transport rests on:
+        chain keys are a pure function of token content, so two
+        DISTINCT replicas (two independent key computations over
+        copies of the prompt) address the same fabric entries — and a
+        divergent prompt never collides.  300 random prompt pairs."""
+
+        r = np.random.RandomState(7)
+        seen = {}  # key -> the prefix token tuple it addresses
+        for _ in range(300):
+            n = int(r.randint(16, 80))
+            a = r.randint(0, 997, size=(n,)).astype(np.int32)
+            b = a.copy()  # "the other replica's" copy
+            assert chain_keys(a, 16) == chain_keys(b, 16)
+            # divergence at a random position kills every key from
+            # that block on — and never resurrects an earlier chain
+            d = b.copy()
+            pos = int(r.randint(0, n))
+            d[pos] = (d[pos] + 1) % 997
+            ka, kd = chain_keys(a, 16), chain_keys(d, 16)
+            for i, (x, y) in enumerate(zip(ka, kd)):
+                if i < pos // 16:
+                    assert x == y
+                else:
+                    assert x != y
+            # global no-collision: one key = one exact prefix content
+            for i, key in enumerate(ka):
+                prefix = tuple(a[: (i + 1) * 16].tolist())
+                assert seen.setdefault(key, prefix) == prefix
+
+    def test_pinned_entry_never_evicted(self):
+        """The never-reclaim-while-referenced rule, fabric edition: an
+        entry a migration holds a pin on survives ANY publish
+        pressure; unpinning releases it to LRU."""
+
+        from tf_operator_tpu.models.prefix_cache import PrefixFabric
+
+        f = PrefixFabric(capacity_blocks=2)
+        keys = chain_keys(np.arange(160, dtype=np.int32), 16)
+        f.put(keys[0], self._rec(0), nbytes=8)
+        assert f.get(keys[0], pin=True) is not None
+        for i in range(1, 9):
+            f.put(keys[i], self._rec(i), nbytes=8)
+        assert keys[0] in f  # pinned: survived 8 evict-pressure puts
+        assert len(f) <= 3  # cap + the one pinned straggler
+        f.unpin(keys[0])
+        f.put(keys[9], self._rec(9), nbytes=8)
+        assert keys[0] not in f  # unpinned -> LRU reclaimed
+        assert len(f) <= 2
+
+    def test_pin_is_counted_per_migration(self):
+        from tf_operator_tpu.models.prefix_cache import PrefixFabric
+
+        f = PrefixFabric(capacity_blocks=1)
+        key = chain_keys(np.arange(16, dtype=np.int32), 16)[0]
+        f.put(key, self._rec(), nbytes=8)
+        f.get(key, pin=True)
+        f.get(key, pin=True)  # two concurrent migrations
+        f.unpin(key)
+        other = chain_keys(np.arange(16, 32, dtype=np.int32), 16)[0]
+        f.put(other, self._rec(1), nbytes=8)
+        assert key in f  # still one pin outstanding
+        f.unpin(key)
+        f.put(chain_keys(np.arange(32, 48, dtype=np.int32), 16)[0],
+              self._rec(2), nbytes=8)
+        assert key not in f

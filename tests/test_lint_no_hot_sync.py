@@ -58,6 +58,12 @@ HOT_FUNCTIONS = {
 #: every one of those copies must route through the sanctioned
 #: ``with ...dispatch(...)`` window so it is counted, timed, and can
 #: never silently serialize the steady-state step loop.
+#: ISSUE 13 extends the set again to the MIGRATION planning paths:
+#: fabric publishes (prefill side) and pulls (decode side) do real
+#: device↔host block copies — every one must route through the
+#: sanctioned ``with ...dispatch(...)`` window (migrate_out /
+#: migrate_in) so disaggregation can never smuggle an uncounted sync
+#: into admission planning.
 HOT_CLASS_FUNCTIONS = {
     "models/batching.py": {
         "PagedContinuousBatchingDecoder": {
@@ -65,6 +71,7 @@ HOT_CLASS_FUNCTIONS = {
             "_preempt_seat_locked", "_admit_swapped",
             "_plan_resume_locked", "_pick_victim_locked",
             "_demote_queued_locked",
+            "_plan_admission", "_migrate_in_locked", "publish_to_fabric",
         },
     },
 }

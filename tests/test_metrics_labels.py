@@ -235,3 +235,41 @@ class TestLedgerSharedFamilies:
         assert parsed['train_sync_seconds_count{phase="data.load"}'] == 1
         assert parsed['train_sync_seconds_count{phase="window"}'] == 1
         assert parsed['train_sync_total{phase="data.load"}'] == 1.0
+
+
+class TestMergedFamilies:
+    """histogram_family_merged: the /slo read under multi-replica —
+    and, since ISSUE 13, disaggregated — serving."""
+
+    def test_replica_and_role_merge_to_one_row(self):
+        """Series differing only in {replica} and {role} sum into ONE
+        user-facing quantile row: a disaggregated fleet (prefill/
+        decode roles across N replicas) still reports one p99 TTFT."""
+
+        m = Metrics()
+        m.observe_histogram("serve_ttft_seconds", 0.01, model="t",
+                            mode="pool", replica="0", role="prefill")
+        m.observe_histogram("serve_ttft_seconds", 0.02, model="t",
+                            mode="pool", replica="1", role="decode")
+        m.observe_histogram("serve_ttft_seconds", 0.03, model="t",
+                            mode="pool", replica="2", role="decode")
+        merged = m.histogram_family_merged("serve_ttft_seconds")
+        assert len(merged) == 1
+        (labels, summary), = merged.items()
+        keys = {k for k, _ in labels}
+        assert "replica" not in keys and "role" not in keys
+        assert summary["count"] == 3
+
+    def test_other_labels_keep_rows_distinct(self):
+        """The merge drops ONLY replica/role — {tier} (and any other
+        key) still splits rows, so /slo keeps per-tier quantiles."""
+
+        m = Metrics()
+        m.observe_histogram("serve_ttft_seconds", 0.01, model="t",
+                            tier="interactive", replica="0", role="decode")
+        m.observe_histogram("serve_ttft_seconds", 0.02, model="t",
+                            tier="batch", replica="1", role="decode")
+        merged = m.histogram_family_merged("serve_ttft_seconds")
+        assert len(merged) == 2
+        tiers = {dict(labels)["tier"] for labels in merged}
+        assert tiers == {"interactive", "batch"}

@@ -374,20 +374,30 @@ class TestBlockGatedAdmission:
             model, params, slots=4, kv_block_size=16, kv_blocks=8,
             metrics=m, model_label="t",
         )
-        assert m.gauge("kv_blocks_free", model="t", replica="0") == 8.0
-        assert m.gauge("kv_blocks_total", model="t", replica="0") == 8.0
+        # the {role=} key rides every kv_blocks_* series (ISSUE 13);
+        # unified pools export role="unified"
+        assert m.gauge(
+            "kv_blocks_free", model="t", replica="0", role="unified"
+        ) == 8.0
+        assert m.gauge(
+            "kv_blocks_total", model="t", replica="0", role="unified"
+        ) == 8.0
         rid = dec.submit(np.arange(20, dtype=np.int32) % VOCAB,
                          max_new_tokens=20)  # 3 blocks
         dec._admit()
-        assert m.gauge("kv_blocks_free", model="t", replica="0") == 5.0
         assert m.gauge(
-            "kv_blocks_pressure", model="t", replica="0"
+            "kv_blocks_free", model="t", replica="0", role="unified"
+        ) == 5.0
+        assert m.gauge(
+            "kv_blocks_pressure", model="t", replica="0", role="unified"
         ) == pytest.approx(3 / 8)
         dec.run()
         dec.result(rid)
         # retire frees the non-published blocks; the published prompt
         # block stays under the cache's reference
-        assert m.gauge("kv_blocks_free", model="t", replica="0") == 7.0
+        assert m.gauge(
+            "kv_blocks_free", model="t", replica="0", role="unified"
+        ) == 7.0
 
 
 class TestFusedKernelStep:
@@ -529,7 +539,8 @@ class TestDeviceResidentState:
             model, params, slots=6, kv_block_size=16, kv_blocks=4,
             metrics=m, model_label="t",
         )
-        g = lambda name: m.gauge(name, model="t", replica="0")
+        g = lambda name: m.gauge(name, model="t", replica="0",
+                                 role="unified")
         r = np.random.RandomState(3)
         first = dec.submit(r.randint(0, VOCAB, size=(20,)).astype(np.int32),
                            max_new_tokens=14)  # 3 of 4 blocks

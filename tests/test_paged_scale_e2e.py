@@ -105,7 +105,8 @@ def test_blocks_free_pressure_scales_serving_one_to_three():
         ]
         pool._admit()  # 8/8 blocks live -> pressure 1.0
         assert metrics.gauge(
-            "kv_blocks_pressure", model="tiny", replica="0"
+            "kv_blocks_pressure", model="tiny", replica="0",
+            role="unified",
         ) == 1.0
 
         t0 = time.time()
@@ -134,7 +135,8 @@ def test_blocks_free_pressure_scales_serving_one_to_three():
         for rid in rids:
             assert pool.result(rid) is not None
         assert metrics.gauge(
-            "kv_blocks_pressure", model="tiny", replica="0"
+            "kv_blocks_pressure", model="tiny", replica="0",
+            role="unified",
         ) < 0.85 * pol.hysteresis_ratio
         assert autoscaler.evaluate_once(t0 + 12) == []  # quiet starts
         (down,) = autoscaler.evaluate_once(t0 + 40)
@@ -216,6 +218,174 @@ def test_preemption_rate_scales_serving_out():
     pool.alloc.check()
 
 
+def test_disaggregated_roles_scale_independently():
+    """ISSUE 13 acceptance: a phase-split fleet's two replica classes
+    scale INDEPENDENTLY off ``kv_blocks_pressure{role=}`` through the
+    stock disaggregated policy pair — prefill pressure scales only the
+    PS set, decode pressure only the WORKER set — against kubesim,
+    with both decisions visible on GET /autoscaler (operator API over
+    real HTTP)."""
+
+    import urllib.request as _rq
+
+    from tf_operator_tpu.controller.autoscaler import (
+        default_disaggregated_policies,
+    )
+    from tf_operator_tpu.models.prefix_cache import PrefixFabric
+    from tf_operator_tpu.server.api import ApiServer
+
+    sim = MiniApiServer().start()
+    store = KubeJobStore(sim.url)
+    backend = KubeBackend(sim.url)
+    metrics = Metrics()
+    engine = AlertEngine(
+        default_rules(), metrics=metrics, recorder=FlightRecorder()
+    )
+    autoscaler = Autoscaler(metrics=metrics, alerts=engine)
+    controller = TPUJobController(
+        store, backend, metrics=metrics, alerts=engine,
+        autoscaler=autoscaler,
+        config=ReconcilerConfig(resolver=backend.resolver),
+    )
+    controller.run(threadiness=2)
+    api = ApiServer(
+        store, backend, metrics, controller.recorder,
+        autoscaler=autoscaler, alerts=engine,
+    )
+    api.start()
+    try:
+        pols = default_disaggregated_policies(
+            min_replicas=1, max_replicas=3
+        )
+        for pol in pols:
+            pol.cooldown_seconds = 5.0
+            pol.stabilization_seconds = 60.0
+        job = new_job(
+            name="disagg", ps=1, worker=1,
+            command=[sys.executable, "-c",
+                     "import time; time.sleep(120)"],
+        )
+        job.spec.autoscaling = AutoscalingSpec(policies=pols)
+        store.create(job)
+
+        def pods(rtype):
+            return sorted(
+                p.metadata.name
+                for p in backend.list_pods(
+                    "default", {"tpujob.dist/job-name": "disagg"}
+                )
+                if f"-{rtype}-" in p.metadata.name
+            )
+
+        deadline = time.time() + 20
+        while time.time() < deadline and (
+            len(pods("ps")) < 1 or len(pods("worker")) < 1
+        ):
+            time.sleep(0.1)
+        assert pods("ps") == ["disagg-ps-0"]
+        assert pods("worker") == ["disagg-worker-0"]
+
+        # REAL role-labeled pressure from a real phase-split fleet:
+        # the prefill replica's arena fills (a long-prompt burst), the
+        # decode replica stays idle
+        model = llama_tiny(vocab_size=VOCAB, max_len=64)
+        init = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), init)["params"]
+        fabric = PrefixFabric(metrics=metrics, model_label="tiny")
+        pre = PagedContinuousBatchingDecoder(
+            model, params, slots=6, kv_block_size=16, kv_blocks=8,
+            metrics=metrics, model_label="tiny", replica_label="p0",
+            role="prefill", fabric=fabric,
+        )
+        dec = PagedContinuousBatchingDecoder(
+            model, params, slots=6, kv_block_size=16, kv_blocks=8,
+            metrics=metrics, model_label="tiny", replica_label="d0",
+            role="decode", fabric=fabric,
+        )
+        r = np.random.RandomState(1)
+
+        def fill(pool):
+            rids = [
+                pool.submit(
+                    r.randint(0, VOCAB, size=(6,)).astype(np.int32),
+                    max_new_tokens=26,  # 2 committed blocks each
+                )
+                for _ in range(4)
+            ]
+            pool._admit()  # 8/8 blocks live -> pressure 1.0
+            return rids
+
+        pre_rids = fill(pre)
+        assert metrics.gauge(
+            "kv_blocks_pressure", model="tiny", replica="p0",
+            role="prefill",
+        ) == 1.0
+        assert metrics.gauge(
+            "kv_blocks_pressure", model="tiny", replica="d0",
+            role="decode",
+        ) == 0.0
+
+        # ONLY the PS (prefill) policy breaches
+        t0 = time.time()
+        (d1,) = autoscaler.evaluate_once(t0)
+        assert d1.replica_type.value == "PS"
+        assert (d1.direction, d1.from_replicas, d1.to_replicas) == (
+            "up", 1, 2,
+        )
+        assert "kv_blocks_pressure{role=prefill}" in d1.reason
+        deadline = time.time() + 30
+        while time.time() < deadline and len(pods("ps")) < 2:
+            time.sleep(0.2)
+        assert pods("ps") == ["disagg-ps-0", "disagg-ps-1"]
+        assert pods("worker") == ["disagg-worker-0"]  # untouched
+
+        # relieve prefill, load decode: ONLY the WORKER policy acts
+        pre.run()
+        for rid in pre_rids:
+            assert pre.result(rid) is not None
+        assert metrics.gauge(
+            "kv_blocks_pressure", model="tiny", replica="p0",
+            role="prefill",
+        ) < 0.85 * pols[0].hysteresis_ratio
+        dec_rids = fill(dec)
+        (d2,) = autoscaler.evaluate_once(t0 + 6)
+        assert d2.replica_type.value == "Worker"
+        assert (d2.direction, d2.to_replicas) == ("up", 2)
+        assert "kv_blocks_pressure{role=decode}" in d2.reason
+        deadline = time.time() + 30
+        while time.time() < deadline and len(pods("worker")) < 2:
+            time.sleep(0.2)
+        assert pods("worker") == ["disagg-worker-0", "disagg-worker-1"]
+        assert pods("ps") == ["disagg-ps-0", "disagg-ps-1"]
+
+        # both decisions on GET /autoscaler over real HTTP
+        with _rq.urlopen(
+            f"http://127.0.0.1:{api.port}/autoscaler", timeout=10
+        ) as resp:
+            snap = json.loads(resp.read())
+        kinds = [
+            (d["replicaType"], d["direction"], d["to"])
+            for d in snap["decisions"]
+        ]
+        assert ("PS", "up", 2) in kinds
+        assert ("Worker", "up", 2) in kinds
+        assert {p["replicaType"] for p in snap["policies"]} == {
+            "PS", "Worker",
+        }
+
+        dec.run()
+        for rid in dec_rids:
+            assert dec.result(rid) is not None
+        pre.alloc.check()
+        dec.alloc.check()
+    finally:
+        api.stop()
+        controller.stop()
+        backend.close()
+        store.close()
+        sim.stop()
+
+
 def test_multi_replica_metrics_and_merged_slo_over_http():
     """The visibility half: N pool replicas behind one admission queue
     export per-replica serve_admission_queue_depth / kv_blocks_free on
@@ -267,8 +437,10 @@ def test_multi_replica_metrics_and_merged_slo_over_http():
         ) as resp:
             text = resp.read().decode()
         for rep in ("0", "1"):
-            assert f'kv_blocks_free{{model="unknown",replica="{rep}"}}' \
-                in text
+            assert (
+                f'kv_blocks_free{{model="unknown",replica="{rep}",'
+                'role="unified"}'
+            ) in text
             assert (
                 "serve_admission_queue_depth"
                 f'{{model="unknown",replica="{rep}"}}'

@@ -260,7 +260,8 @@ class TestProfileAndSurfaceEndpoints:
         code, body = self._get(server + "/requests")
         assert code == 200 and body == {"requests": []}
         code, body = self._get(server + "/debug/arena")
-        assert code == 200 and body == {"replicas": []}
+        # "fabric" is None outside disaggregated --roles (ISSUE 13)
+        assert code == 200 and body == {"replicas": [], "fabric": None}
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(server + "/requests/tmissing",
                                    timeout=30)

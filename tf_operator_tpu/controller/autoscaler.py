@@ -113,6 +113,52 @@ def default_serving_policy(
     )
 
 
+def default_disaggregated_policies(
+    min_replicas: int = 1, max_replicas: int = 4
+) -> List[AutoscalingPolicy]:
+    """The stock DISAGGREGATED serving policy pair (ISSUE 13): a
+    phase-split fleet runs two replica classes — prefill (mapped to
+    the PS replica set: the auxiliary compute tier, never decodes) and
+    decode (the WORKER set) — and each scales INDEPENDENTLY off its
+    own slice of the same gauge, ``kv_blocks_pressure{role=}``.  A
+    long-prompt burst saturates the prefill replicas' arenas without
+    touching decode residency, so only the PS policy breaches; a
+    residency pile-up (many long decodes) breaches only the WORKER
+    policy.  The decode class keeps the unified policy's queue-wait
+    burn + preemption-rate alert bindings (those SLOs are decode-side
+    by construction).  Role label keys and thresholds are pinned by
+    tests/test_autoscaling_lint.py like the unified stock policy."""
+
+    return [
+        AutoscalingPolicy(
+            replica_type=ReplicaType.PS,
+            mode="serving",
+            min_replicas=min_replicas,
+            max_replicas=max_replicas,
+            signals=[
+                SignalBinding(
+                    kind="gauge", name="kv_blocks_pressure",
+                    threshold=0.85, labels={"role": "prefill"},
+                ),
+            ],
+        ),
+        AutoscalingPolicy(
+            replica_type=ReplicaType.WORKER,
+            mode="serving",
+            min_replicas=min_replicas,
+            max_replicas=max_replicas,
+            signals=[
+                SignalBinding(
+                    kind="gauge", name="kv_blocks_pressure",
+                    threshold=0.85, labels={"role": "decode"},
+                ),
+                SignalBinding(kind="alert", name="serve-queue-wait-burn"),
+                SignalBinding(kind="alert", name="serve-preemption-rate"),
+            ],
+        ),
+    ]
+
+
 def default_training_policy(
     min_replicas: int = 1, max_replicas: int = 8
 ) -> AutoscalingPolicy:
@@ -643,9 +689,25 @@ class Autoscaler:
                 breach, meas = self._measure_alert(sig)
             else:
                 breach, meas = self._measure_gauge(sig, pol, st)
-            values[sig.name] = {**meas, "breaching": breach}
+            values[self._signal_key(sig)] = {**meas, "breaching": breach}
             any_breach = any_breach or breach
         return any_breach, values
+
+    @staticmethod
+    def _signal_key(sig: SignalBinding) -> str:
+        """The binding's identity in signal maps AND the hysteresis
+        latch (ISSUE 13): label-filtered gauge bindings (the
+        disaggregated policies slice one family by {role=}) carry the
+        filter — ``kv_blocks_pressure{role=prefill}`` — so the
+        decision reason and /autoscaler name WHICH slice breached,
+        and two filtered bindings on one family in one policy can
+        never collide in the values map or share a latch."""
+
+        if sig.kind == "alert" or not sig.labels:
+            return sig.name
+        return sig.name + "{" + ",".join(
+            f"{k}={v}" for k, v in sorted(sig.labels.items())
+        ) + "}"
 
     def _measure_alert(self, sig: SignalBinding) -> Tuple[bool, Dict[str, Any]]:
         if self.alerts is None:
@@ -667,13 +729,14 @@ class Autoscaler:
             d = dict(labels)
             if all(d.get(k) == str(val) for k, val in sig.labels.items()):
                 level = max(level, v)
-        latched = st.latched.get(sig.name, False)
+        key = self._signal_key(sig)
+        latched = st.latched.get(key, False)
         if level > sig.threshold:
             latched = True
         elif level <= sig.threshold * pol.hysteresis_ratio:
             latched = False
         # between the release level and the threshold: hold the latch
-        st.latched[sig.name] = latched
+        st.latched[key] = latched
         return latched, {
             "kind": "gauge",
             "level": round(level, 3),

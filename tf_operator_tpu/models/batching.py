@@ -127,6 +127,16 @@ TOP_K_MAX = 64
 SLO_TIERS = ("batch", "interactive")
 _TIER_RANK = {t: i for i, t in enumerate(SLO_TIERS)}
 
+#: replica phase roles (ISSUE 13, disaggregated serving): a "prefill"
+#: replica chunk-prefills prompts and PUBLISHES the finished blocks
+#: into the prefix-cache fabric; a "decode" replica admits by mapping
+#: published chains (pulling only the missing tail through the fabric)
+#: and runs the unchanged steady-state step loop; "unified" (the
+#: default) does both — the pre-ISSUE-13 pool.  The role labels every
+#: kv_blocks_* gauge so the autoscaler can scale the two replica
+#: classes independently off ``kv_blocks_pressure{role=}``.
+REPLICA_ROLES = ("unified", "prefill", "decode")
+
 
 def _pow2_class(n: int) -> int:
     """Smallest power of two >= max(n, 1) — the width-class trick
@@ -239,6 +249,16 @@ class RequestLog:
             # preemption
             "preempted": 0,
             "swapped_blocks": 0,
+            # ISSUE 13 (disaggregated serving): which replica ran each
+            # phase (the router annotates both; pre-split autopsies
+            # attributed only the one serving replica), how many prefix
+            # blocks arrived over the fabric instead of being computed
+            # here, and whether this is an internal fabric-publish
+            # prefill (excluded from user-facing SLO observations)
+            "prefill_replica": None,
+            "decode_replica": None,
+            "migrated_blocks": 0,
+            "internal": False,
         }
         entry.update(fields)
         with self._lock:
@@ -280,6 +300,25 @@ class RequestLog:
             entry["dispatches"][phase] = (
                 entry["dispatches"].get(phase, 0) + n
             )
+
+    def add_migrate(self, entry: Dict[str, Any], blocks: int) -> None:
+        """``blocks`` prefix blocks arrived through the fabric instead
+        of being prefilled locally (ISSUE 13) — the autopsy shows how
+        much of this request's prompt was migration, not compute."""
+
+        with self._lock:
+            entry["migrated_blocks"] += int(blocks)
+
+    def annotate(self, request_id: str, **fields) -> None:
+        """Update a live entry by id (the router's cross-replica
+        attribution hook — it learns the prefill/decode replica split
+        only after the pools have opened the entry).  No-op for
+        unknown ids."""
+
+        with self._lock:
+            entry = self._entries.get(request_id)
+            if entry is not None:
+                entry.update(fields)
 
     def add_swap(self, entry: Dict[str, Any], blocks: int) -> None:
         """More of this request's blocks moved host-side WITHOUT a
@@ -345,10 +384,11 @@ class _Request:
                  "tokens", "done", "slot", "staged_cache", "staged_tok",
                  "has_permit", "t_submit", "t_first", "trace_id", "entry",
                  "t_submit_mono", "queue_waited", "tier", "swapped",
-                 "tokens_since_seat")
+                 "tokens_since_seat", "internal", "t_local",
+                 "t_local_mono")
 
     def __init__(self, rid, prompt, budget, temperature, top_k, rng,
-                 tier: str = "batch"):
+                 tier: str = "batch", internal: bool = False):
         self.rid = rid
         self.prompt = prompt  # np.ndarray [P] int32
         self.budget = budget
@@ -368,12 +408,21 @@ class _Request:
         # queue-wait/TTFT/time-per-output-token derive from these
         self.t_submit = time.perf_counter()
         self.t_first = None
+        # POOL-LOCAL submit clocks (never backdated): queue-wait is a
+        # per-replica scheduling signal — under disaggregation the
+        # router backdates t_submit so TTFT spans the whole handshake,
+        # but the decode replica's queue-wait must measure ITS queue
+        # only, or prefill slowness would fire the decode-side
+        # queue-wait-burn alert and scale the wrong replica class
+        self.t_local = self.t_submit
+        self.t_local_mono = None  # set below with t_submit_mono
         # ISSUE 11: first-class request identity (= the trace id every
         # lifecycle span joins; serve_lm adopts the HTTP x-trace-id) +
         # this request's RequestLog autopsy entry
         self.trace_id: Optional[str] = None
         self.entry: Optional[Dict[str, Any]] = None
         self.t_submit_mono = time.monotonic()
+        self.t_local_mono = self.t_submit_mono
         self.queue_waited = False  # queue.wait span emitted once
         # ISSUE 12: SLO tier (admission priority, preemption policy,
         # the {tier} label on every SLO observation); swapped marks a
@@ -383,6 +432,11 @@ class _Request:
         self.tier = tier
         self.swapped = False
         self.tokens_since_seat = 0
+        # ISSUE 13: a prefill replica's fabric-publish prefills are
+        # INTERNAL requests — real pool traffic (they queue, admit, and
+        # count dispatches) but not user requests, so they are excluded
+        # from the user-facing SLO observations
+        self.internal = internal
 
 
 class ContinuousBatchingDecoder:
@@ -395,7 +449,16 @@ class ContinuousBatchingDecoder:
     def __init__(self, model, params, slots: int = 8, steps_per_sync: int = 8,
                  ledger: Optional[DispatchLedger] = None,
                  metrics=None, model_label: str = "",
-                 replica_label: str = ""):
+                 replica_label: str = "", role: str = "unified"):
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"role must be one of {REPLICA_ROLES}, got {role!r}"
+            )
+        #: ISSUE 13 phase role (REPLICA_ROLES): labels every kv_blocks_*
+        #: gauge and — for non-unified replicas — every SLO observation,
+        #: so the autoscaler and /metrics see the two replica classes
+        #: separately while /slo merges the role away
+        self.role = role
         #: device-dispatch accounting (phases: admission, step, and the
         #: legacy rolling-window path's prefill/scatter)
         self.ledger = ledger if ledger is not None else DispatchLedger()
@@ -502,6 +565,11 @@ class ContinuousBatchingDecoder:
         out = dict(model=self.model_label, **extra)
         if self.replica_label:
             out["replica"] = self.replica_label
+        if self.role != "unified":
+            # only disaggregated fleets split SLO series by role —
+            # unified pools keep the legacy label sets, and the /slo
+            # merge drops the key either way (histogram_family_merged)
+            out["role"] = self.role
         return out
 
     # -- request lifecycle (ISSUE 11) ------------------------------------
@@ -561,8 +629,9 @@ class ContinuousBatchingDecoder:
         if req.queue_waited:
             return
         req.queue_waited = True
+        # pool-local clock: the handshake phases have their own spans
         self._emit_span(
-            req, "queue.wait", req.t_submit_mono, time.monotonic(),
+            req, "queue.wait", req.t_local_mono, time.monotonic(),
         )
 
     def _finish_request(self, req: _Request, blocks_freed: int = 0) -> None:
@@ -602,18 +671,20 @@ class ContinuousBatchingDecoder:
             self.request_log.update(
                 req.entry,
                 queue_wait_seconds=round(
-                    max(0.0, work_start - req.t_submit), 6
+                    max(0.0, work_start - req.t_local), 6
                 ),
                 ttft_seconds=round(req.t_first - req.t_submit, 6),
             )
-        if self.metrics is None:
+        if self.metrics is None or req.internal:
+            # internal fabric-publish prefills are not user requests —
+            # observing them would pollute the user-facing quantiles
             return
         # {tier} on every pool SLO observation (ISSUE 12): /slo and
         # the dashboard report per-tier quantiles — "interactive p99
         # TTFT holds while batch degrades" is a query, not a guess
         self.metrics.observe_histogram(
             "serve_queue_wait_seconds",
-            max(0.0, work_start - req.t_submit),
+            max(0.0, work_start - req.t_local),
             exemplar=req.trace_id,
             tier=req.tier,
             **self._labels(mode="pool"),
@@ -630,7 +701,7 @@ class ContinuousBatchingDecoder:
         """Request retired: observe time-per-output-token (first token
         → done, over the tokens after the first)."""
 
-        if self.metrics is None:
+        if self.metrics is None or req.internal:
             return
         t_done = time.perf_counter()
         t_first = req.t_first if req.t_first is not None else t_done
@@ -826,6 +897,9 @@ class ContinuousBatchingDecoder:
         rng: Optional[jax.Array] = None,
         trace_id: Optional[str] = None,
         tier: str = "batch",
+        internal: bool = False,
+        t_submit: Optional[float] = None,
+        t_submit_mono: Optional[float] = None,
     ) -> int:
         """Queue a single request ([P] int32).  Returns a request id;
         collect the output with `result` after `step`s (or `run`).
@@ -843,7 +917,15 @@ class ContinuousBatchingDecoder:
         the caller's trace, and the autopsy lands in ``request_log``
         under that id.  Without one, the pool mints an id from its
         tracer (or a local fallback), so direct submitters get the
-        same lifecycle record."""
+        same lifecycle record.
+
+        ``internal`` marks a fabric-publish prefill (ISSUE 13): a real
+        pool request in every mechanical sense, but excluded from the
+        user-facing SLO observations.  ``t_submit``/``t_submit_mono``
+        backdate the request's SLO clocks to an EARLIER submit (the
+        disaggregated router passes its own entry time, so TTFT spans
+        the whole prefill→migrate→decode handshake, not just the
+        decode replica's slice)."""
 
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size < 1:
@@ -877,8 +959,12 @@ class ContinuousBatchingDecoder:
             self._rid += 1
         req = _Request(
             rid, prompt, max_new_tokens, float(temperature), top_k, rng,
-            tier=tier,
+            tier=tier, internal=internal,
         )
+        if t_submit is not None:
+            req.t_submit = float(t_submit)
+        if t_submit_mono is not None:
+            req.t_submit_mono = float(t_submit_mono)
         if trace_id is not None:
             req.trace_id = str(trace_id)
         elif self.tracer is not None:
@@ -890,7 +976,7 @@ class ContinuousBatchingDecoder:
             replica=self.replica_label or "0", model=self.model_label,
             prompt_tokens=int(prompt.size),
             max_new_tokens=int(max_new_tokens),
-            tier=tier,
+            tier=tier, internal=bool(internal),
         )
         # fused-eligible requests (non-rolling cache, pad width fits)
         # queue host-side untouched: their ENTIRE admission — prefill,
@@ -1119,8 +1205,22 @@ class ContinuousBatchingDecoder:
         paged subclass overrides with real memory pressure (blocks in
         use + queued block demand over arena size)."""
 
+        components = self.load_components()
+        return components["prefill"] + components["decode"]
+
+    def load_components(self) -> Dict[str, float]:
+        """``load_score`` split by PHASE (ISSUE 13): ``prefill`` is
+        pending admission work (queued requests — what a prefill
+        replica burns down), ``decode`` is resident work (active
+        seats).  The disaggregated router routes each phase to the
+        replica with the lowest matching component; their sum is the
+        legacy scalar ``load_score``."""
+
         with self._lock:
-            return float(len(self._active) + len(self._queue))
+            return {
+                "prefill": float(len(self._queue)),
+                "decode": float(len(self._active)),
+            }
 
     def step(self) -> int:
         """Admit waiting requests, run `steps_per_sync` decode steps
@@ -1334,12 +1434,27 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                  paged_kernel: str = "auto",
                  reserve: str = "lazy",
                  swap_blocks: Optional[int] = None,
-                 age_boost_seconds: float = 30.0):
+                 age_boost_seconds: float = 30.0,
+                 role: str = "unified",
+                 fabric=None):
         super().__init__(
             model, params, slots=slots, steps_per_sync=steps_per_sync,
             ledger=ledger, metrics=metrics, model_label=model_label,
-            replica_label=replica_label,
+            replica_label=replica_label, role=role,
         )
+        #: ISSUE 13: the shared prefix-cache FABRIC
+        #: (models/prefix_cache.PrefixFabric) — the migration transport
+        #: of disaggregated serving.  With one attached, admission
+        #: pulls missing prefix blocks from it (``migrate_in``) and
+        #: ``publish_to_fabric`` pushes finished prompt blocks into it
+        #: (``migrate_out``).  None = this replica neither publishes
+        #: nor pulls (the pre-split pool).
+        self.fabric = fabric
+        if role == "prefill" and fabric is None:
+            raise ValueError(
+                "a prefill-role replica is pointless without a "
+                "prefix-cache fabric to publish into — pass fabric="
+            )
         # -- paged_kernel mode validation FIRST (ISSUE 10 honesty): a
         # typo'd mode must fail even for models whose pageability
         # checks below raise NotPageableError — serve_lm's model-shape
@@ -1483,8 +1598,12 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
         #: time-series twin of the kv_blocks_pressure gauge
         self.timeline = ArenaTimeline(
             block_size=self.block_size, usable=self.alloc.usable,
-            replica=self.replica_label or "0",
+            replica=self.replica_label or "0", role=self.role,
         )
+        # ONE jitted fabric upload (shape-polymorphic like the swap
+        # pair); pow2 classes tracked only for compile_count honesty
+        self._migrate_scatter_fn = None
+        self._migrate_scatter_classes: set = set()
         self._update_kv_gauges()
 
     def _init_pool_cache(self, row0) -> None:
@@ -1536,31 +1655,39 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
         if self.metrics is None:
             return
         rep = self.replica_label or "0"
+        # {role=} on EVERY kv_blocks_* gauge (ISSUE 13): a
+        # disaggregated fleet's autoscaler scales the prefill and
+        # decode replica classes independently off
+        # kv_blocks_pressure{role=}; unified pools export
+        # role="unified" so the label key is always present (the lint
+        # collectors pin it at these literal sites)
         self.metrics.set(
-            "kv_blocks_free", free, model=self.model_label, replica=rep
+            "kv_blocks_free", free, model=self.model_label, replica=rep,
+            role=self.role,
         )
         self.metrics.set(
-            "kv_blocks_total", total, model=self.model_label, replica=rep
+            "kv_blocks_total", total, model=self.model_label, replica=rep,
+            role=self.role,
         )
         self.metrics.set(
             "kv_blocks_in_use", total - free,
-            model=self.model_label, replica=rep,
+            model=self.model_label, replica=rep, role=self.role,
         )
         self.metrics.set(
             "kv_blocks_committed", total - free,
-            model=self.model_label, replica=rep,
+            model=self.model_label, replica=rep, role=self.role,
         )
         self.metrics.set(
             "kv_blocks_reserved", reserved,
-            model=self.model_label, replica=rep,
+            model=self.model_label, replica=rep, role=self.role,
         )
         self.metrics.set(
             "kv_blocks_queued_demand", queued,
-            model=self.model_label, replica=rep,
+            model=self.model_label, replica=rep, role=self.role,
         )
         self.metrics.set(
             "kv_blocks_pressure", (total - free + queued) / total,
-            model=self.model_label, replica=rep,
+            model=self.model_label, replica=rep, role=self.role,
         )
 
     def _update_gauges_locked(self) -> None:
@@ -1616,9 +1743,24 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
         — the router sends the next request to real memory headroom,
         not just the shortest queue."""
 
+        components = self.load_components()
+        return components["prefill"] + components["decode"]
+
+    def load_components(self) -> Dict[str, float]:
+        """Phase split of the block-pressure score (ISSUE 13):
+        ``prefill`` = queued block demand (admission work still to
+        prefill) / usable, ``decode`` = blocks live in the arena
+        (resident decode state) / usable.  Sum == the legacy
+        ``load_score``; the disaggregated router picks the prefill
+        replica by the former and the decode replica by the latter."""
+
         with self._lock:
             queued = self._queued_blocks()
-        return (self.alloc.in_use + queued) / max(1, self.alloc.usable)
+        usable = max(1, self.alloc.usable)
+        return {
+            "prefill": queued / usable,
+            "decode": self.alloc.in_use / usable,
+        }
 
     # -- admission ---------------------------------------------------------
 
@@ -1679,6 +1821,14 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
             shared.pop()
         if shared:
             self.alloc.retain(shared)
+        # ISSUE 13: pull the missing chain tail through the fabric —
+        # blocks a prefill replica published arrive as ONE migrate_in
+        # upload into fresh local blocks (and join the LOCAL cache, so
+        # the next request maps them copy-free).  Runs after the local
+        # retain so an allocation-pressure eviction inside the pull can
+        # never reclaim a locally-hit block out from under this plan.
+        if self.fabric is not None:
+            self._migrate_in_locked(req, keys, shared, p_len)
         total_blocks = max(self._commit_blocks(p_len, req.budget),
                            len(shared))
         need = total_blocks - len(shared)
@@ -1928,6 +2078,208 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                 lambda al: pad(al, None), self._arena
             )
         return jax.tree_util.tree_map(pad, self._arena, host_tree)
+
+    # -- KV-block migration over the prefix-cache fabric (ISSUE 13) --------
+
+    def _count_migrate_bytes(self, direction: str, nbytes: int) -> None:
+        """kv_migrate_bytes_total{direction} — the fabric transport's
+        byte meter, split out of the linted migration paths like its
+        swap twin (``nbytes`` is host arithmetic over np buffers)."""
+
+        if self.metrics is not None and nbytes:
+            self.metrics.inc(
+                "kv_migrate_bytes_total", float(nbytes),
+                direction=direction,
+            )
+
+    def _migrate_scatter(self, u: int):
+        """The jitted fabric upload (scatter_blocks_by_id over the
+        arena) — one shape-polymorphic jit; ``u`` (the pow2 block-count
+        class) only feeds compile_count, mirroring _swap_gather."""
+
+        with self._compile_lock:
+            if self._migrate_scatter_fn is None:
+                self._migrate_scatter_fn = jax.jit(scatter_blocks_by_id)
+            if u not in self._migrate_scatter_classes:
+                self._migrate_scatter_classes.add(u)
+                self.compile_count += 1
+            return self._migrate_scatter_fn
+
+    def _migrate_in_locked(self, req: _Request, keys, shared: List[int],
+                           p_len: int) -> None:
+        """Pull the chain's missing tail from the fabric (caller holds
+        the pool lock; ``shared`` already holds the retained LOCAL
+        hits and is extended in place).  Fabric records stay PINNED
+        from lookup to upload (never reclaimed while a migration holds
+        a ref); each pulled block is uploaded in ONE ``migrate_in``
+        dispatch, published into the LOCAL prefix cache (the alloc ref
+        becomes the cache's own), and retained once more for the seat
+        — from here on it is indistinguishable from a local hit.
+        Allocation shortfall quietly skips the pull: the remainder
+        prefill recomputes those positions, which is also the failure
+        semantics when a prefill replica died mid-publish."""
+
+        bs = self.block_size
+        limit = (p_len - 1) // bs
+        fetch = []  # (key, fabric record), chain-consecutive
+        for i in range(len(shared), limit):
+            if self.prefix.peek(keys[i]) is not None:
+                # the LOCAL cache still holds this link (an evicted
+                # HEAD with a retained tail — chain walks refresh LRU
+                # head-first, so heads age out first).  Pulling it
+                # would prefix.put over the live entry and leak the
+                # old block's cache reference; stop the pull here and
+                # let the remainder prefill recompute from this point.
+                break
+            rec = self.fabric.get(keys[i], pin=True)
+            if rec is None:
+                break
+            fetch.append((keys[i], rec))
+        # the combined prefix must leave a representable padded
+        # remainder — drop trailing pulls first (local hits are free,
+        # pulled blocks cost an upload)
+        while fetch and \
+                (len(shared) + len(fetch)) * bs + self._paged_width(
+                    p_len - (len(shared) + len(fetch)) * bs
+                ) > self.max_len:
+            key, _ = fetch.pop()
+            self.fabric.unpin(key)
+        if limit > len(shared):
+            # request-level accounting: only consultations that could
+            # have pulled something count
+            self.fabric.record(bool(fetch))
+        if not fetch:
+            return
+        ids = self._alloc_blocks_locked(
+            len(fetch), max_victim_rank=_TIER_RANK[req.tier] - 1,
+        )
+        if ids is None:
+            for key, _ in fetch:
+                self.fabric.unpin(key)
+            return
+        n = len(fetch)
+        u = _pow2_class(n)
+        host = jax.tree_util.tree_map(
+            lambda *leaves: np.concatenate(leaves)
+            if getattr(leaves[0], "ndim", 0) == 4 else leaves[0],
+            *[rec["kv"] for _, rec in fetch],
+        )
+        bufs = self._upload_bufs(host, n, u)
+        ids_pad = np.full((u,), SCRATCH_BLOCK, np.int32)
+        ids_pad[:n] = ids
+        nbytes = sum(rec["nbytes"] for _, rec in fetch)
+        with self._request_span(req, "migrate", blocks=n, bytes=nbytes):
+            with self.dispatch("migrate_in", rid=req.rid, blocks=n):
+                self._arena = self._migrate_scatter(u)(
+                    self._arena, bufs, ids_pad
+                )
+        for (key, _), bid in zip(fetch, ids):
+            self.prefix.put(key, int(bid))     # cache owns the alloc ref
+            self.alloc.retain([int(bid)])      # +1 for this seat
+            shared.append(int(bid))
+            self.fabric.unpin(key)
+        self._count_migrate_bytes("in", nbytes)
+        if req.entry is not None:
+            self.request_log.add_migrate(req.entry, n)
+            self.request_log.count_dispatch(req.entry, "migrate_in")
+
+    def publish_to_fabric(self, prompt_ids, *, tier: str = "batch",
+                          trace_id: Optional[str] = None,
+                          timeout: Optional[float] = None) -> Dict[str, int]:
+        """The prefill-replica half of the migration transport (ISSUE
+        13): make every FULL block of ``prompt_ids`` available in the
+        shared fabric.  Blocks the fabric already holds cost nothing;
+        for the rest, an INTERNAL budget-1 request chunk-prefills the
+        prompt through the normal fused admission (publishing the full
+        blocks into the LOCAL prefix cache and retiring the seat
+        immediately), and one ``migrate_out`` dispatch gathers the
+        published blocks device→host into the fabric.  The throwaway
+        admission sample is greedy (no rng is consumed), so the decode
+        replica's own admission sample — the one the user sees — runs
+        the exact split chain of an undisturbed pool: disaggregated
+        serving stays token-identical.
+
+        BLOCKS until the internal prefill completes (a driver thread
+        must be stepping this pool); raises TimeoutError past
+        ``timeout``.  Returns {"publishable", "published", "computed"}.
+        """
+
+        if self.fabric is None:
+            raise ValueError(
+                "this replica has no prefix-cache fabric to publish into"
+            )
+        prompt = np.array(prompt_ids, np.int32).reshape(-1)
+        bs = self.block_size
+        n_pub = int(prompt.size) // bs
+        out = {"publishable": n_pub, "published": 0, "computed": 0}
+        if n_pub == 0:
+            return out
+        keys = chain_keys(prompt, bs)[:n_pub]
+        missing = [k for k in keys if k not in self.fabric]
+        self.fabric.record(hit=not missing)
+        if not missing:
+            return out
+        with self._lock:
+            have_local = all(
+                self.prefix.peek(k) is not None for k in missing
+            )
+        if not have_local:
+            # chunk-prefill through the pool's own admission path —
+            # one fused dispatch per pow2 remainder class, prefix hits
+            # (local or fabric) shrinking the computed remainder
+            rid = self.submit(
+                prompt, 1, tier=tier, trace_id=trace_id, internal=True,
+            )
+            if self.result_wait(rid, timeout=timeout) is None:
+                raise TimeoutError(
+                    f"fabric publish prefill timed out after {timeout}s "
+                    "(is this replica's driver thread running?)"
+                )
+            out["computed"] = 1
+        with self._lock:
+            publish = []
+            for k in missing:
+                if k in self.fabric:
+                    continue  # a concurrent publisher won the race
+                bid = self.prefix.peek(k)
+                if bid is None:
+                    # evicted between the admission and now (extreme
+                    # arena pressure): publish what survives — the
+                    # decode side recomputes the rest, never blocks
+                    continue
+                publish.append((k, int(bid)))
+            if not publish:
+                return out
+            bids = [b for _, b in publish]
+            # pinned against reclaim for the duration of the gather
+            self.alloc.retain(bids)
+            try:
+                nc = _pow2_class(len(bids))
+                ids_pad = np.full((nc,), SCRATCH_BLOCK, np.int32)
+                ids_pad[: len(bids)] = bids
+                with self.dispatch("migrate_out", blocks=len(bids)):
+                    fetched = jax.device_get(
+                        self._swap_gather(nc)(self._arena, ids_pad)
+                    )
+            finally:
+                self.alloc.release(bids)
+            nbytes_total = 0
+            for j, (k, _) in enumerate(publish):
+                rec_kv = jax.tree_util.tree_map(
+                    lambda l, j=j: l[j : j + 1]
+                    if getattr(l, "ndim", 0) == 4 else l,
+                    fetched,
+                )
+                nb = sum(
+                    l.nbytes
+                    for l in jax.tree_util.tree_leaves(rec_kv)
+                    if getattr(l, "ndim", 0) == 4
+                )
+                self.fabric.put(k, rec_kv, nb)
+                nbytes_total += nb
+            out["published"] = len(publish)
+            self._count_migrate_bytes("out", nbytes_total)
+        return out
 
     def _preempt_seat_locked(self, slot: int, reason: str) -> int:
         """Evict seat ``slot`` mid-decode (caller holds the pool

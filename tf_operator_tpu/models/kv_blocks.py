@@ -289,11 +289,15 @@ class ArenaTimeline:
     """
 
     def __init__(self, capacity: int = 512, block_size: int = 0,
-                 usable: int = 0, replica: str = ""):
+                 usable: int = 0, replica: str = "", role: str = "unified"):
         self.capacity = max(1, int(capacity))
         self.block_size = int(block_size)
         self.usable = int(usable)
         self.replica = str(replica)
+        #: ISSUE 13: the replica's phase role — a disaggregated fleet's
+        #: /debug/arena strips are read per role (a prefill replica's
+        #: occupancy is churn, a decode replica's is residency)
+        self.role = str(role)
         self._lock = threading.Lock()
         self._samples: deque = deque(maxlen=self.capacity)
         self.dropped = 0  # samples aged out of the ring
@@ -353,6 +357,7 @@ class ArenaTimeline:
             samples = samples[-limit:] if limit > 0 else []
         return {
             "replica": self.replica,
+            "role": self.role,
             "block_size": self.block_size,
             "usable": self.usable,
             "capacity": self.capacity,

@@ -28,7 +28,30 @@ pins.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class _NullSpan:
+    """No-op stand-in for a route span when the router is untraced —
+    the call sites keep one shape (enter, set_attribute, set_error)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attribute(self, *a, **k):
+        pass
+
+    def set_error(self, *a, **k):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
 
 
 class PoolRouter:
@@ -47,15 +70,60 @@ class PoolRouter:
     arena timelines for the /requests and /debug/arena endpoints.
     """
 
-    def __init__(self, pools: List, tracer=None):
+    #: ceiling on one prefill-publish handshake: a dead prefill driver
+    #: thread must degrade to the decode replica RECOMPUTING (the
+    #: documented failure semantics), not hang every multi-block
+    #: request's submit thread forever on result_wait(None)
+    PUBLISH_TIMEOUT_S = 120.0
+
+    def __init__(self, pools: List, tracer=None,
+                 publish_timeout: Optional[float] = None):
         if not pools:
             raise ValueError("router needs at least one pool replica")
         self.pools = list(pools)
         self.tracer = tracer
+        self.publish_timeout = (
+            self.PUBLISH_TIMEOUT_S if publish_timeout is None
+            else float(publish_timeout)
+        )
         self._lock = threading.Lock()
         self._rid = 0
         #: router rid -> (pool index, pool-local rid)
         self._route: Dict[int, Tuple[int, int]] = {}
+        # -- phase roles (ISSUE 13): a fleet with any "prefill" replica
+        # is DISAGGREGATED — prompts chunk-prefill on a prefill replica
+        # (publishing blocks into the shared fabric) and decode on a
+        # decode/unified replica that maps the published chain,
+        # pulling only the missing tail.  Roles are read off the pools
+        # themselves; the fleet must be able to serve both phases.
+        self.prefill_idx = [
+            i for i, p in enumerate(self.pools)
+            if getattr(p, "role", "unified") == "prefill"
+        ]
+        self.decode_idx = [
+            i for i, p in enumerate(self.pools)
+            if getattr(p, "role", "unified") != "prefill"
+        ]
+        self.disaggregated = bool(self.prefill_idx)
+        if self.disaggregated:
+            if not self.decode_idx:
+                raise ValueError(
+                    "a disaggregated fleet needs at least one decode/"
+                    "unified replica — prefill replicas never decode"
+                )
+            fabrics = {
+                id(getattr(self.pools[i], "fabric", None))
+                for i in self.prefill_idx + self.decode_idx
+            }
+            if None in {
+                getattr(self.pools[i], "fabric", None)
+                for i in self.prefill_idx
+            } or len(fabrics) != 1:
+                raise ValueError(
+                    "disaggregated replicas must share ONE prefix-cache "
+                    "fabric (the migration transport) — construct every "
+                    "replica with the same fabric="
+                )
 
     def __len__(self) -> int:
         return len(self.pools)
@@ -67,45 +135,151 @@ class PoolRouter:
     def load_scores(self) -> List[float]:
         return [p.load_score() for p in self.pools]
 
+    def _route_span(self, tid, **attrs):
+        """A ``route`` span on the request's trace (a no-op span when
+        untraced).  ISSUE 13: every route span carries ``phase`` and
+        ``role`` attributes — the waterfall answers not just "which
+        replica" but "which replica FOR WHICH PHASE"."""
+
+        if self.tracer is None:
+            return _NULL_SPAN
+        return self.tracer.start_span("route", trace_id=tid,
+                                      attributes=attrs)
+
+    def _replica_name(self, idx: int) -> str:
+        return self.pools[idx].replica_label or str(idx)
+
     def submit(self, prompt_ids, max_new_tokens: int, **kw) -> int:
         """Route to the least-loaded replica; returns a ROUTER rid
         (collect with this router's result/result_wait, not the
         pool's).  Validation failures raise before any routing state
-        is recorded."""
+        is recorded.
 
-        scores = self.load_scores()
-        idx = min(range(len(self.pools)), key=lambda i: (scores[i], i))
+        Disaggregated fleets (ISSUE 13) run the two-phase handshake:
+        the prompt chunk-prefills on the least-prefill-loaded PREFILL
+        replica, which publishes its finished blocks into the fabric
+        (this call BLOCKS until that prefill completes — the prefill
+        replica's driver thread must be running); the request then
+        submits to the least-decode-loaded DECODE replica, whose
+        admission maps the published chain copy-free on local hits and
+        pulls only the missing tail (``migrate_in``).  The decode
+        pool's SLO clocks are backdated to THIS call's entry, so TTFT
+        spans the whole handshake."""
+
         # the request's identity is settled HERE (adopted from the
-        # caller or minted) so the route span and the replica's
+        # caller or minted) so the route span and the replicas'
         # lifecycle spans share one trace id
         tid = kw.get("trace_id")
         if tid is None and self.tracer is not None:
             tid = self.tracer.mint_trace_id()
             kw["trace_id"] = tid
-        if self.tracer is not None:
-            span = self.tracer.start_span(
-                "route", trace_id=tid, attributes={
-                    "replica": str(idx),
-                    "load_score": round(scores[idx], 4),
-                    "scores": [round(s, 4) for s in scores],
-                    # ISSUE 12: the SLO tier is routing-relevant
-                    # context — a preempted batch request's waterfall
-                    # should show what class it competed in
-                    "tier": str(kw.get("tier", "batch")),
-                },
+        if self.disaggregated:
+            idx, prid = self._submit_disaggregated(
+                prompt_ids, max_new_tokens, kw
+            )
+        else:
+            scores = self.load_scores()
+            idx = min(range(len(self.pools)), key=lambda i: (scores[i], i))
+            span = self._route_span(
+                tid,
+                replica=str(idx),
+                load_score=round(scores[idx], 4),
+                scores=[round(s, 4) for s in scores],
+                # ISSUE 12: the SLO tier is routing-relevant context —
+                # a preempted batch request's waterfall should show
+                # what class it competed in
+                tier=str(kw.get("tier", "batch")),
+                phase="unified",
+                role=getattr(self.pools[idx], "role", "unified"),
             )
             with span:
                 prid = self.pools[idx].submit(
                     prompt_ids, max_new_tokens, **kw
                 )
                 span.set_attribute("rid", prid)
-        else:
-            prid = self.pools[idx].submit(prompt_ids, max_new_tokens, **kw)
+            if tid is not None:
+                # both phases ran on the one replica — attribute both
+                self.pools[idx].request_log.annotate(
+                    tid,
+                    prefill_replica=self._replica_name(idx),
+                    decode_replica=self._replica_name(idx),
+                )
         with self._lock:
             rid = self._rid
             self._rid += 1
             self._route[rid] = (idx, prid)
         return rid
+
+    def _submit_disaggregated(self, prompt_ids, max_new_tokens: int,
+                              kw) -> Tuple[int, int]:
+        """(decode pool index, pool-local rid) for one request through
+        the prefill→fabric→decode handshake."""
+
+        t0, t0m = time.perf_counter(), time.monotonic()
+        tid = kw.get("trace_id")
+        tier = str(kw.get("tier", "batch"))
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        pi = min(
+            self.prefill_idx,
+            key=lambda i: (self.pools[i].load_components()["prefill"], i),
+        )
+        di = min(
+            self.decode_idx,
+            key=lambda i: (self.pools[i].load_components()["decode"], i),
+        )
+        ppool, dpool = self.pools[pi], self.pools[di]
+        # prompts with no full block strictly before their final token
+        # have nothing publishable the decode side could map — they
+        # skip the prefill phase entirely (short prompts never pay the
+        # handshake)
+        usable = (int(prompt.size) - 1) // ppool.block_size
+        if usable > 0:
+            span = self._route_span(
+                tid, phase="prefill", role="prefill",
+                replica=self._replica_name(pi), tier=tier,
+                load_score=round(
+                    ppool.load_components()["prefill"], 4
+                ),
+            )
+            with span:
+                try:
+                    res = ppool.publish_to_fabric(
+                        prompt, tier=tier, trace_id=tid,
+                        timeout=self.publish_timeout,
+                    )
+                    span.set_attribute("published", res["published"])
+                except Exception as exc:
+                    # failure semantics (docs/ARCHITECTURE.md): a
+                    # prefill replica dying mid-publish must not fail
+                    # the request — the decode replica recomputes
+                    # whatever never reached the fabric.  Counted so a
+                    # sick prefill class is visible before it becomes
+                    # a latency regression.
+                    if ppool.metrics is not None:
+                        ppool.metrics.inc(
+                            "serve_fabric_publish_failures_total",
+                            model=ppool.model_label,
+                        )
+                    span.set_error(repr(exc))
+        span = self._route_span(
+            tid, phase="decode", role=getattr(dpool, "role", "unified"),
+            replica=self._replica_name(di), tier=tier,
+            load_score=round(dpool.load_components()["decode"], 4),
+        )
+        with span:
+            prid = dpool.submit(
+                prompt, max_new_tokens,
+                t_submit=t0, t_submit_mono=t0m, **kw,
+            )
+            span.set_attribute("rid", prid)
+        if tid is not None:
+            dpool.request_log.annotate(
+                tid,
+                prefill_replica=self._replica_name(pi) if usable > 0
+                else self._replica_name(di),
+                decode_replica=self._replica_name(di),
+            )
+        return di, prid
 
     # -- merged observability reads (ISSUE 11) ---------------------------
 

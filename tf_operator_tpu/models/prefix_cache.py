@@ -73,6 +73,161 @@ def exact_key(arr) -> bytes:
     return h.digest()
 
 
+class PrefixFabric:
+    """Cross-replica prefix-cache FABRIC (ISSUE 13): one
+    content-addressed HOST-side store of finished prompt blocks, keyed
+    by the same rolling hash-chain keys as every replica's local
+    :class:`PrefixCache` — the migration transport of disaggregated
+    serving.
+
+    Prefill replicas PUBLISH: after chunk-prefilling a prompt they
+    gather its full blocks device→host (one ``migrate_out`` ledger
+    dispatch) and ``put`` each block's KV content here under its chain
+    key.  Decode replicas PULL: admission walks the chain, maps local
+    cache hits copy-free, and for the missing tail ``get``s the host
+    copies and uploads them into freshly allocated arena blocks (one
+    ``migrate_in`` dispatch) — after which the blocks live in the
+    decode replica's LOCAL cache and every later request maps them
+    copy-free.  Two replicas never talk to each other directly; the
+    fabric IS the wire, and the chain keys make the transport
+    content-addressed: identical prompt prefixes on distinct replicas
+    produce identical keys (property-tested, tests/test_kv_blocks.py).
+
+    Values are opaque block records ``{"kv": <host tree, one block row
+    per ndim-4 leaf>, "nbytes": int}``.  ``capacity_blocks`` bounds the
+    host footprint (None = unbounded); eviction is LRU with a PIN
+    guard: an entry a migration currently holds a reference on
+    (``get(..., pin=True)`` → ``unpin``) is never reclaimed — the
+    allocator's never-reclaim-while-mapped rule, fabric edition
+    (property-tested).  Thread-safe: publishes and pulls race from
+    every replica's submit/driver threads.
+    """
+
+    def __init__(self, capacity_blocks: Optional[int] = None,
+                 metrics=None, model_label: str = ""):
+        self.capacity_blocks = (
+            None if capacity_blocks is None else int(capacity_blocks)
+        )
+        self.metrics = metrics
+        self.model_label = model_label or "unknown"
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[bytes, Any]" = OrderedDict()
+        self._pins: dict = {}  # key -> pin count (in-flight migrations)
+        self.hits = 0
+        self.misses = 0
+        self.publishes = 0
+        self.evictions = 0
+        self.bytes_published = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def get(self, key: bytes, pin: bool = False):
+        """The block record for ``key`` (refreshing LRU), or None.
+        ``pin=True`` takes a migration reference — the entry cannot be
+        evicted until the matching :meth:`unpin` — so the uploader can
+        read the record without racing an eviction."""
+
+        with self._lock:
+            rec = self._entries.get(key)
+            if rec is None:
+                return None
+            self._entries.move_to_end(key)
+            if pin:
+                self._pins[key] = self._pins.get(key, 0) + 1
+            return rec
+
+    def unpin(self, key: bytes) -> None:
+        with self._lock:
+            n = self._pins.get(key, 0)
+            if n <= 1:
+                self._pins.pop(key, None)
+            else:
+                self._pins[key] = n - 1
+
+    def record(self, hit: bool) -> None:
+        """Request-level hit/miss accounting (one increment per
+        request however many chain links matched — the PrefixCache
+        contract, mode="fabric")."""
+
+        with self._lock:
+            if hit:
+                self.hits += 1
+            else:
+                self.misses += 1
+        if self.metrics is not None:
+            if hit:
+                self.metrics.inc(
+                    "serve_prefix_cache_hits_total", mode="fabric"
+                )
+            else:
+                self.metrics.inc(
+                    "serve_prefix_cache_misses_total", mode="fabric"
+                )
+
+    def put(self, key: bytes, kv_tree: Any, nbytes: int) -> None:
+        """Publish one block's host KV copy under its chain key
+        (idempotent — a concurrent publisher of the same content just
+        refreshes LRU).  Evicts LRU unpinned entries past the block
+        cap; when every entry is pinned the fabric stays over capacity
+        rather than reclaim a record a migration is reading."""
+
+        with self._lock:
+            fresh = key not in self._entries
+            self._entries[key] = {"kv": kv_tree, "nbytes": int(nbytes)}
+            self._entries.move_to_end(key)
+            if fresh:
+                self.publishes += 1
+                self.bytes_published += int(nbytes)
+            evicted = 0
+            if self.capacity_blocks is not None:
+                for k in list(self._entries):
+                    if len(self._entries) <= self.capacity_blocks:
+                        break
+                    if self._pins.get(k):
+                        continue  # a migration holds it — never reclaim
+                    del self._entries[k]
+                    self.evictions += 1
+                    evicted += 1
+        if self.metrics is not None:
+            if fresh:
+                # idempotent re-publishes (two prefill replicas racing
+                # on a shared prefix) must not drift this counter away
+                # from snapshot()["publishes"]
+                self.metrics.inc(
+                    "kv_fabric_publishes_total", model=self.model_label
+                )
+            self.metrics.set(
+                "kv_fabric_blocks", float(len(self)),
+                model=self.model_label,
+            )
+            if evicted:
+                self.metrics.inc(
+                    "serve_prefix_cache_evictions_total", float(evicted),
+                    mode="fabric",
+                )
+
+    def snapshot(self) -> dict:
+        """The observability read (rides /debug/arena on serve_lm)."""
+
+        with self._lock:
+            return {
+                "blocks": len(self._entries),
+                "capacity_blocks": self.capacity_blocks,
+                "pinned": sum(1 for v in self._pins.values() if v),
+                "hits": self.hits,
+                "misses": self.misses,
+                "publishes": self.publishes,
+                "evictions": self.evictions,
+                "bytes_published": self.bytes_published,
+            }
+
+
 class PrefixCache:
     """Refcount-aware LRU keyed by chain keys.  Thread-safe.
 

@@ -265,8 +265,10 @@ async function refreshArena() {
     const last = samples[samples.length - 1];
     const label = document.createElement("div");
     label.className = "muted";
+    // ISSUE 13: a disaggregated fleet's strips are read per phase role
+    const roleTag = rep.role && rep.role !== "unified" ? ` [${rep.role}]` : "";
     label.textContent =
-      `replica ${rep.replica}: ${last.live}/${usable} blocks live ` +
+      `replica ${rep.replica}${roleTag}: ${last.live}/${usable} blocks live ` +
       `(${last.prefix_cached} prefix-cached), ` +
       `${last.queued_demand} queued demand, ` +
       `${last.swapped || 0} swapped, ` +
@@ -387,12 +389,14 @@ function refreshSLO(metricLines) {
     if (!m || !WANT.test(m[1])) continue;
     const le = (m[2].match(/le="([^"]+)"/) || [])[1];
     if (le === undefined) continue;
-    // merge across {replica=}: multi-replica serving must read as ONE
-    // user-facing quantile row (cumulative bucket counts at the same
-    // le sum across replicas); /metrics keeps the raw per-replica
-    // series for capacity eyes
+    // merge across {replica=} AND {role=}: multi-replica serving must
+    // read as ONE user-facing quantile row (cumulative bucket counts
+    // at the same le sum across replicas; a disaggregated fleet's
+    // phase roles merge away the same way); /metrics keeps the raw
+    // per-replica/per-role series for capacity eyes
     const rest = m[2].replace(/le="[^"]+",?/, "")
-      .replace(/replica="[^"]+",?/, "").replace(/,$/, "");
+      .replace(/replica="[^"]+",?/, "")
+      .replace(/role="[^"]+",?/, "").replace(/,$/, "");
     const key = m[1] + "|" + rest;
     const s = (series[key] = series[key] || { fam: m[1], labels: rest, sum: {} });
     const bound = le === "+Inf" ? Infinity : parseFloat(le);

@@ -45,6 +45,8 @@ DISPATCH_PHASES = (
     "retire",     # paged pool batched device-state reset
     "swap_out",   # preemption: victim block gather + rng fetch (ISSUE 12)
     "swap_in",    # resume: swapped-block upload + device-row restore
+    "migrate_out",  # prefill replica: prompt-block gather → fabric (ISSUE 13)
+    "migrate_in",   # decode replica: fabric-block upload into its arena
     "decode",     # chunked decoder budget loop
     "generate",   # speculative fused whole-generation program
     "round",      # speculative host-driven round loop
@@ -261,7 +263,7 @@ class Metrics:
         }
 
     def histogram_family_merged(
-        self, name: str, drop: Tuple[str, ...] = ("replica",)
+        self, name: str, drop: Tuple[str, ...] = ("replica", "role")
     ) -> Dict[Tuple[Tuple[str, str], ...], Dict[str, float]]:
         """``histogram_family`` with the ``drop`` label keys merged
         away: series differing only in those labels sum their bucket
@@ -269,6 +271,9 @@ class Metrics:
         multi-replica serving (ISSUE 8 bugfix): N per-replica
         ``serve_ttft_seconds{replica=...}`` series become ONE
         user-facing quantile summary instead of N disjoint ones.
+        ``role`` rides in the default drop set (ISSUE 13): a
+        disaggregated prefill/decode fleet splits its SLO series by
+        phase role on /metrics, but the user still sees ONE p99 TTFT.
         Bucket-boundary mismatches (same family observed with
         different explicit buckets) keep those series separate — a
         positional sum would be a lie."""
