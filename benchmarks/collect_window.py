@@ -3,9 +3,9 @@
     python benchmarks/collect_window.py [--out-dir benchmarks/window_out]
 
 Reads the per-step stdout files `tpu_window.py --out-dir` saved
-(bench.out, sweep.out, llama-sweep.out, flash.out, train.out),
-parses the numbers, and rewrites the `<!-- train:begin -->` …
-`<!-- train:end -->` table in BASELINE.md.  Rows with no fresh data
+(bench.out, sweep.out, llama-sweep.out, flash.out, train.out,
+multislice.out), parses the numbers, and rewrites the
+`<!-- train:begin -->` … `<!-- train:end -->` table in BASELINE.md.  Rows with no fresh data
 keep their previous cell text (so a partial window never erases a
 previously measured value), except the leading "pending — " prefix is
 preserved as-is until a real number replaces it.
@@ -129,6 +129,13 @@ def parse_artifacts(out_dir: str) -> dict:
         paged["_artifact"] = paged_src
         data["paged"] = paged
 
+    # ISSUE 14: the multi-slice grad-sync smoke (flat vs hierarchical
+    # bytes/step + step walls on the slice-aware sim mesh; real-DCN
+    # walls ride the chip window like paged-chip)
+    ms = _last_json_line(_read(out_dir, "multislice.out"))
+    if ms and "multislice_dcn_bytes_ratio" in ms:
+        data["multislice"] = ms
+
     flash = _read(out_dir, "flash.out")
     m = re.search(
         r"flash fwd\+bwd @4k: ([\d.]+)ms\s+xla: ([\d.]+)ms\s+speedup ([\d.]+)x",
@@ -188,13 +195,25 @@ def write_last_measured(data: dict, today: str) -> None:
     except (OSError, json.JSONDecodeError):
         ledger = {}
 
-    def put(key: str, value, artifact: str) -> None:
+    def put(key: str, value, artifact: str, backend: "str | None" = None) -> None:
         if value is not None:
-            ledger[key] = {
+            entry = {
                 "value": value,
                 "artifact": f"benchmarks/window_out/{artifact}",
                 "date": today,
             }
+            # backend-aware provenance (the PR 13 batching-row rule,
+            # generalized): a CPU smoke re-measure must not wear chip
+            # clothes in the machine-readable ledger — and it must not
+            # REPLACE a chip-measured value either (entries without a
+            # backend tag are chip-grade; bench.py's error fallback
+            # points humans at this file)
+            if backend and backend != "tpu":
+                prev = ledger.get(key)
+                if prev is not None and "backend" not in prev:
+                    return
+                entry["backend"] = backend
+            ledger[key] = entry
 
     b = data.get("bench", {})
     put("resnet50_examples_per_sec_per_chip", b.get("value"), "bench.out")
@@ -224,12 +243,16 @@ def write_last_measured(data: dict, today: str) -> None:
         b.get("llama_wide_decode_int8_speedup"), "bench.out",
     )
     t = data.get("train", {})
+    t_backend = t.get("train_backend")
     put("mnist_steps_per_sec_per_chip",
-        t.get("mnist_steps_per_sec_per_chip"), "train.out")
+        t.get("mnist_steps_per_sec_per_chip"), "train.out",
+        backend=t_backend)
     put("bert_base_steps_per_sec_per_chip",
-        t.get("bert_base_steps_per_sec_per_chip"), "train.out")
+        t.get("bert_base_steps_per_sec_per_chip"), "train.out",
+        backend=t_backend)
     put("bert_base_mfu_analytic",
-        t.get("bert_base_mfu_analytic"), "train.out")
+        t.get("bert_base_mfu_analytic"), "train.out",
+        backend=t_backend)
     # r7: the step-sync ledger sweep — the top-K fused step time is the
     # "sync-free" training number; steady syncs/step is the invariant
     # (0.0 when the windowed loop holds).  Read from the sweep dict
@@ -240,14 +263,41 @@ def write_last_measured(data: dict, today: str) -> None:
         k_top = max(ksw, key=int)
         put(
             f"train_k{k_top}_step_ms",
-            ksw[k_top].get("step_ms"), "train.out",
+            ksw[k_top].get("step_ms"), "train.out", backend=t_backend,
         )
     put("train_steady_syncs_per_step",
-        t.get("train_steady_syncs_per_step"), "train.out")
+        t.get("train_steady_syncs_per_step"), "train.out",
+        backend=t_backend)
     put("train_prefetch_best_depth",
-        t.get("train_prefetch_best_depth"), "train.out")
+        t.get("train_prefetch_best_depth"), "train.out",
+        backend=t_backend)
     put("train_prefetch_vs_resident",
-        t.get("train_prefetch_vs_resident"), "train.out")
+        t.get("train_prefetch_vs_resident"), "train.out",
+        backend=t_backend)
+    # ISSUE 14: the multi-slice grad-sync smoke.  Byte/collective
+    # accounting is platform-independent (same program structure on any
+    # backend — collectives.py docstring), so those keys stay UNtagged
+    # and any backend's window may refresh them; only the measured
+    # walls carry the backend tag and defer to chip-grade entries.
+    ms = data.get("multislice", {})
+    ms_backend = ms.get("multislice_backend")
+    for key in (
+        "multislice_dcn_bytes_ratio",
+        "multislice_dcn_bytes_ratio_vs_flat_mesh",
+        "multislice_flat_dcn_bytes_per_step",
+        "multislice_flat_mesh_dcn_bytes_per_step",
+        "multislice_hier_dcn_bytes_per_step",
+        "multislice_intra_slice_size",
+        "multislice_dcn_collectives_per_step",
+        "multislice_allclose_max_loss_err",
+    ):
+        put(key, ms.get(key), "multislice.out")
+    for key in (
+        "multislice_flat_step_ms",
+        "multislice_hierarchical_step_ms",
+        "multislice_step_wall_ratio",
+    ):
+        put(key, ms.get(key), "multislice.out", backend=ms_backend)
     bt = data.get("batching", {})
     put("batching_pool_tokens_per_sec",
         bt.get("batching_pool_tokens_per_sec"), "batching.out")
@@ -408,22 +458,34 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
             )
     t = data.get("train")
     if t:
-        bert_mfu = ""
-        if t.get("bert_base_mfu_analytic") is not None:
-            bert_mfu = (
-                f", **mfu_analytic {t['bert_base_mfu_analytic']}** / "
-                f"mfu_xla {t.get('bert_base_mfu_xla', '?')} "
-                "(accounting: `benchmarks/FLOPS.md` \"BERT\")"
-            )
-        rows["mnist / BERT-base steps/sec/chip"] = (
-            "| mnist / BERT-base steps/sec/chip | "
-            f"mnist **{t.get('mnist_steps_per_sec_per_chip', '?')} steps/s** "
-            f"({t.get('mnist_examples_per_sec_per_chip', '?')} ex/s); "
-            f"BERT-base **{t.get('bert_base_steps_per_sec_per_chip', '?')} "
-            f"steps/s** ({t.get('bert_base_examples_per_sec_per_chip', '?')} "
-            f"ex/s, seq 128, fsdp){bert_mfu} "
-            f"| 1× v5 lite, `measure.py --section train` → `window_out/train.out`, {today} |"
+        # provenance follows the artifact's backend (the paged/batching
+        # row rule): a CPU-smoke K-sweep must not wear chip clothes,
+        # and a smoke artifact without the chip-only BERT/llama legs
+        # (MEASURE_TRAIN_TINY) must not clobber the measured chip row
+        # with '?' cells
+        t_backend = t.get("train_backend", "tpu")
+        t_setup = (
+            "1× v5 lite" if t_backend == "tpu"
+            else f"{t_backend} smoke (sync/prefetch accounting; model "
+            "rates are chip-meaningful only)"
         )
+        if t.get("bert_base_steps_per_sec_per_chip") is not None:
+            bert_mfu = ""
+            if t.get("bert_base_mfu_analytic") is not None:
+                bert_mfu = (
+                    f", **mfu_analytic {t['bert_base_mfu_analytic']}** / "
+                    f"mfu_xla {t.get('bert_base_mfu_xla', '?')} "
+                    "(accounting: `benchmarks/FLOPS.md` \"BERT\")"
+                )
+            rows["mnist / BERT-base steps/sec/chip"] = (
+                "| mnist / BERT-base steps/sec/chip | "
+                f"mnist **{t.get('mnist_steps_per_sec_per_chip', '?')} steps/s** "
+                f"({t.get('mnist_examples_per_sec_per_chip', '?')} ex/s); "
+                f"BERT-base **{t.get('bert_base_steps_per_sec_per_chip', '?')} "
+                f"steps/s** ({t.get('bert_base_examples_per_sec_per_chip', '?')} "
+                f"ex/s, seq 128, fsdp){bert_mfu} "
+                f"| {t_setup}, `measure.py --section train` → `window_out/train.out`, {today} |"
+            )
         ksw = t.get("train_sync_k_sweep")
         if ksw:
             sweep_txt = ", ".join(
@@ -439,6 +501,12 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
                     f"{t.get('train_prefetch_vs_resident', '?')}× of "
                     "device-resident"
                 )
+            cpu_caveat = (
+                "" if t_backend == "tpu" else
+                " — CPU walls run AGAINST K (XLA:CPU scan-under-SPMD, "
+                "PROFILE.md r7 caveat); the ledger columns are the "
+                "transferable signal, the chip window owns the walls"
+            )
             rows["Training sync accounting"] = (
                 "| Training sync accounting (mnist CNN through the "
                 "harness train_loop, StepSyncLedger embedded — "
@@ -446,9 +514,53 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
                 f"{sweep_txt}; steady-state blocking syncs/step "
                 f"**{steady if steady is not None else '?'}** "
                 "(K=1 = legacy per-step resolve; K>1 = fused "
-                f"lax.scan windows, deferred metric resolve){prefetch_txt} "
-                f"| 1× v5 lite, `measure.py --section train` → `window_out/train.out`, {today} |"
+                f"lax.scan windows, deferred metric resolve)"
+                f"{cpu_caveat}{prefetch_txt} "
+                f"| {t_setup}, `measure.py --section train` → `window_out/train.out`, {today} |"
             )
+    ms = data.get("multislice")
+    if ms:
+        ms_backend = ms.get("multislice_backend", "?")
+        ms_setup = (
+            "multi-slice TPU" if ms_backend == "tpu"
+            else f"{ms_backend} smoke, simulated 2-slice mesh — byte "
+            "accounting/program structure are the signal; real-DCN "
+            "walls ride the queued chip window"
+        )
+        mesh_txt = ", ".join(
+            f"{ax}{n}" for ax, n in (ms.get("multislice_mesh") or {}).items()
+        )
+        probe = ms.get("multislice_sync_probe") or {}
+        rows["Multi-slice training"] = (
+            "| Multi-slice training (slice-aware mesh "
+            f"{mesh_txt or '?'}: dp across slices/DCN, fsdp within a "
+            "slice/ICI; hierarchical two-stage grad sync, "
+            "`parallel/collectives.py`) | cross-slice gradient bytes/"
+            f"step **{ms.get('multislice_hier_dcn_bytes_per_step', '?')} "
+            "B** hierarchical — "
+            f"**{ms.get('multislice_dcn_bytes_ratio', '?')}×** of the "
+            "topology-BLIND pre-slice-aware baseline "
+            f"({ms.get('multislice_flat_dcn_bytes_per_step', '?')} B "
+            "full width, = 1/intra_slice_size "
+            f"{ms.get('multislice_intra_slice_size', '?')}) and "
+            f"**{ms.get('multislice_dcn_bytes_ratio_vs_flat_mesh', '?')}×**"
+            " of the same-mesh flat program "
+            f"({ms.get('multislice_flat_mesh_dcn_bytes_per_step', '?')} B"
+            " — fsdp-sharded grads are already fragments there, so the "
+            "slice-aware layout itself carries most of the win); "
+            f"{ms.get('multislice_dcn_collectives_per_step', '?')} fused "
+            "cross-slice collective(s)/step; step wall "
+            f"{ms.get('multislice_hierarchical_step_ms', '?')} ms hier vs "
+            f"{ms.get('multislice_flat_step_ms', '?')} ms flat "
+            f"(**{ms.get('multislice_step_wall_ratio', '?')}×**); "
+            "loss-trajectory A/B max err "
+            f"{ms.get('multislice_allclose_max_loss_err', '?')}; sync "
+            f"probe dcn {probe.get('dcn_fragment_s', '?')} s / ici "
+            f"{probe.get('ici_reshard_s', '?')} s / flat "
+            f"{probe.get('flat_full_s', '?')} s "
+            f"| {ms_setup}, `measure.py --section multislice` → "
+            f"`window_out/multislice.out`, {today} |"
+        )
     bt = data.get("batching")
     if bt:
         n_new = bt.get("batching_new_tokens", "?")
@@ -727,7 +839,7 @@ def write_results(data: dict, today: str) -> None:
                  "(`benchmarks/window_out/`), collected by "
                  "`collect_window.py`.\n\n")
         for key in (
-            "bench", "train", "batching", "speculative",
+            "bench", "train", "batching", "speculative", "multislice",
             "flash_fwd_bwd", "window_fwd_bwd",
         ):
             if key in data:

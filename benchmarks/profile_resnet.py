@@ -21,6 +21,7 @@ import gzip
 import json
 import os
 import sys
+import time
 from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -201,12 +202,103 @@ def summarize_trace(trace_dir: str, top: int = 30):
         print(f"{dur/1e3:10.2f} ms  x{cnt_by_name[name]:<4d} {name[:110]}")
 
 
+def run_bnfold(batch_per_chip: int, steps: int, trace_dir: "str | None"):
+    """Eval-mode BN-fold A/B (ISSUE 14 satellite / ROADMAP item 2): the
+    inference forward pass with every BatchNorm folded into its conv
+    (models/resnet.fold_batchnorm) vs the stock eval pass — same
+    params, numerics-pinned, slope-timed.  Training CANNOT fold (live
+    batch statistics), so this measures the inference share of the
+    FLOPS.md elementwise/BN ceiling; the train-side note lives in
+    FLOPS.md "BN-fold"."""
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _peak_flops
+    from tf_operator_tpu.models import fold_batchnorm, resnet50
+    from tf_operator_tpu.parallel.trainer import hard_sync
+
+    n_dev = len(jax.devices())
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(
+        rng.rand(batch_per_chip * n_dev, 224, 224, 3).astype(np.float32),
+        dtype=jnp.bfloat16,
+    )
+    model = resnet50()
+    variables = model.init(jax.random.PRNGKey(0), x[:1], train=False)
+    folded_model = resnet50(bn_fold=True)
+    folded_vars = fold_batchnorm(variables)
+
+    ref_fn = jax.jit(lambda v, a: model.apply(v, a, train=False))
+    fold_fn = jax.jit(lambda v, a: folded_model.apply(v, a, train=False))
+    ref = ref_fn(variables, x)
+    out = fold_fn(folded_vars, x)
+    max_err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+
+    def slope_ms(fn, v) -> float:
+        # two-window slope (Trainer._slope_time protocol): fixed costs
+        # cancel, honest per-call device time on any platform
+        def window(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                r = fn(v, x)
+            hard_sync(r)
+            return time.perf_counter() - t0
+
+        window(1)  # warm
+        n1 = max(1, steps // 6)
+        n2 = max(n1 + 1, steps - n1)
+        t1, t2 = window(n1), window(n2)
+        dt = (t2 - t1) / (n2 - n1)
+        return 1e3 * (dt if dt > 0 else t2 / n2)
+
+    def fwd_flops(fn, v):
+        ca = fn.lower(v, x).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+
+    ms_ref = slope_ms(ref_fn, variables)
+    ms_fold = slope_ms(fold_fn, folded_vars)
+    peak = _peak_flops(jax.devices()[0])
+    out_row = {
+        "variant": "bnfold",
+        "batch_per_chip": batch_per_chip,
+        "eval_ms_unfolded": round(ms_ref, 2),
+        "eval_ms_folded": round(ms_fold, 2),
+        "bnfold_eval_speedup": round(ms_ref / ms_fold, 3) if ms_fold else None,
+        "max_abs_err": max_err,
+        "fwd_mfu_unfolded": round(
+            fwd_flops(ref_fn, variables) / (ms_ref / 1e3) / peak, 4
+        ),
+        "fwd_mfu_folded": round(
+            fwd_flops(fold_fn, folded_vars) / (ms_fold / 1e3) / peak, 4
+        ),
+    }
+    print(json.dumps(out_row), flush=True)
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            for _ in range(3):
+                fold_fn(folded_vars, x)
+            jax.effects_barrier()
+        summarize_xplane(trace_dir)
+        import trace_categories
+
+        tables = trace_categories.category_tables(trace_dir)
+        if tables:
+            print(trace_categories.format_text(tables))
+            print("\n--- markdown (FLOPS.md 'trace category table') ---")
+            print(trace_categories.format_markdown(tables))
+    return out_row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--variant",
         default="baseline",
-        choices=["baseline", "s2d", "noclip", "bnbf16", "pbf16"],
+        choices=["baseline", "s2d", "noclip", "bnbf16", "pbf16", "bnfold"],
     )
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--steps", type=int, default=20)
@@ -226,6 +318,9 @@ def main():
     if args.summarize_only:
         summarize_xplane(args.summarize_only)
         summarize_trace(args.summarize_only)
+        return
+    if args.variant == "bnfold":
+        run_bnfold(args.batch, args.steps, args.trace)
         return
     run_variant(args.variant, args.batch, args.steps, args.trace)
 

@@ -71,6 +71,21 @@ STEPS = [
         [sys.executable, os.path.join(HERE, "measure.py"), "--section", "train"],
         2700,
     ),
+    # ISSUE 14: flat vs hierarchical grad sync on the slice-aware mesh.
+    # This box has ONE chip, so the window runs the same 2-slice CPU
+    # sim as the committed smoke (byte ledger + program structure —
+    # platform-independent) to keep the row fresh; a real multi-slice
+    # world would run with MEASURE_PLATFORM=tpu and measure the
+    # DCN-vs-ICI walls this section exists for.
+    (
+        "multislice",
+        [
+            sys.executable, os.path.join(HERE, "measure.py"),
+            "--section", "multislice",
+        ],
+        1800,
+        {"MEASURE_MULTISLICE_BATCH": "16", "MEASURE_MULTISLICE_STEPS": "12"},
+    ),
     (
         "flash",
         [

@@ -179,6 +179,15 @@ def test_collector_sees_known_call_sites():
     assert "model" in families["kv_fabric_blocks"]
     assert "model" in families["kv_fabric_publishes_total"]
     assert "model" in families["serve_fabric_publish_failures_total"]
+    # ISSUE 14: the multi-slice grad-sync plane — per-fabric byte and
+    # collective counters (parallel/trainer.py host-side accounting),
+    # the probe-measured sync-seconds histogram (parallel/collectives),
+    # and the slice-loss signal the stock TPU_SLICE policy binds
+    # (controller/reconciler.py gang sync)
+    assert "fabric" in families["train_dcn_bytes_total"]
+    assert "fabric" in families["train_dcn_collectives_total"]
+    assert "fabric" in families["train_dcn_sync_seconds"]
+    assert "job" in families["tpujob_gang_waiting_replicas"]
 
 
 def collect_dispatch_phases():

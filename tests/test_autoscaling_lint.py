@@ -21,13 +21,18 @@ from tf_operator_tpu.api.types import AutoscalingSpec, ReplicaType
 from tf_operator_tpu.api.validation import validate
 from tf_operator_tpu.controller.autoscaler import (
     default_serving_policy,
+    default_slice_training_policy,
     default_training_policy,
 )
 from tf_operator_tpu.utils.alerts import default_rules
 
 
 def stock_policies():
-    return [default_serving_policy(), default_training_policy()]
+    return [
+        default_serving_policy(),
+        default_training_policy(),
+        default_slice_training_policy(),
+    ]
 
 
 def test_stock_policy_signals_resolve_to_live_rules_or_families():
@@ -61,7 +66,10 @@ def test_stock_policy_signals_resolve_to_live_rules_or_families():
 
 def test_stock_policies_pass_spec_validation():
     for pol in stock_policies():
-        job = new_job(name="lint", worker=2)
+        if pol.replica_type is ReplicaType.TPU_SLICE:
+            job = new_job(name="lint", tpu_slice=2, tpu_topology="v5e-4")
+        else:
+            job = new_job(name="lint", worker=2)
         job.spec.autoscaling = AutoscalingSpec(policies=[pol])
         validate(job)  # raises on a structurally bad template
 
@@ -208,6 +216,52 @@ def test_disaggregated_policies_bind_role_labeled_pressure():
     job = new_job(name="disagg-lint", ps=1, worker=2)
     job.spec.autoscaling = AutoscalingSpec(policies=pols)
     validate(job)  # raises on a structurally bad template
+
+
+def test_slice_training_policy_binds_gang_gauge_and_slice_set():
+    """ISSUE 14: the stock slice-topology policy scales the TPU_SLICE
+    replica set (whole slices are the shed unit) off the reconciler's
+    ``tpujob_gang_waiting_replicas`` gauge — the signal a capacity
+    shrink raises when the declared slice count no longer fits — plus
+    the watchdog-stall alert.  The gate pins: the gauge family is
+    emitted with the {job} key, the alert resolves in the default rule
+    set, the mode is training (checkpoint-gated resizes), and the
+    checkpoint gate is no looser than the stale alert."""
+
+    families = collect_emitted_families()
+    rule_names = {r.name for r in default_rules()}
+    pol = default_slice_training_policy()
+    assert pol.replica_type is ReplicaType.TPU_SLICE
+    assert pol.mode == "training"
+    gauge_sigs = [s for s in pol.signals if s.kind == "gauge"]
+    assert any(
+        s.name == "tpujob_gang_waiting_replicas" for s in gauge_sigs
+    )
+    assert "job" in families["tpujob_gang_waiting_replicas"]
+    for s in pol.signals:
+        if s.kind == "alert":
+            assert s.name in rule_names, s.name
+    stale_rule = next(
+        r for r in default_rules() if r.name == "checkpoint-stale"
+    )
+    assert pol.max_checkpoint_age_seconds <= stale_rule.threshold
+
+
+def test_train_dcn_families_are_emitted_with_fabric_label():
+    """ISSUE 14: the multi-slice grad-sync accounting families any
+    rule/policy/dashboard may bind — bytes, collective count, and
+    measured sync seconds, each split by {fabric=ici|dcn}.  The bytes
+    and collective counters are host-side per-dispatch writes in
+    parallel/trainer.py; the seconds histogram is observed by the
+    collectives sync probe (measure.py --section multislice)."""
+
+    families = collect_emitted_families()
+    for fam in (
+        "train_dcn_bytes_total",
+        "train_dcn_collectives_total",
+        "train_dcn_sync_seconds",
+    ):
+        assert "fabric" in families[fam], fam
 
 
 def test_stock_policy_checkpoint_gate_is_consistent_with_alert_rule():

@@ -157,6 +157,111 @@ def test_train_sync_keys_parse_into_row_and_ledger(tmp_path):
     assert led["train_prefetch_best_depth"]["value"] == 4
 
 
+def test_multislice_artifact_parses_into_row_and_ledger(tmp_path):
+    """ISSUE 14: the --section multislice smoke flows into the
+    'Multi-slice training' BASELINE row and the LAST_MEASURED ledger,
+    carrying the CPU-smoke backend tag (the byte ratio is the
+    platform-independent signal; walls are backend-qualified)."""
+
+    import json
+
+    d = tmp_path / "window_out"
+    d.mkdir()
+    ms = {
+        "multislice_backend": "cpu",
+        "multislice_slices": 2,
+        "multislice_mesh": {"dp": 2, "fsdp": 4},
+        "multislice_axis_fabric": {"dp": "dcn", "fsdp": "ici"},
+        "multislice_intra_slice_size": 4,
+        "multislice_flat_dcn_bytes_per_step": 13098536,
+        "multislice_flat_mesh_dcn_bytes_per_step": 3276768,
+        "multislice_hier_dcn_bytes_per_step": 3274636,
+        "multislice_dcn_bytes_ratio": 0.25,
+        "multislice_dcn_bytes_ratio_vs_flat_mesh": 0.999349,
+        "multislice_dcn_collectives_per_step": 4,
+        "multislice_allclose_max_loss_err": 0.00035,
+        "multislice_flat_step_ms": 585.8,
+        "multislice_hierarchical_step_ms": 611.6,
+        "multislice_step_wall_ratio": 1.044,
+        "multislice_sync_probe": {
+            "dcn_fragment_s": 0.002, "ici_reshard_s": 0.0017,
+            "flat_full_s": 0.005,
+        },
+    }
+    (d / "multislice.out").write_text(json.dumps(ms, indent=1) + "\n")
+    data = cw.parse_artifacts(str(d))
+    rows = cw.build_rows(data, "2026-08-04")
+    row = rows["Multi-slice training"]
+    assert "**0.25×**" in row and "dp2, fsdp4" in row
+    # BOTH baselines render: blind full-width (the acceptance number)
+    # and the same-mesh flat program (what the walls A/B)
+    assert "topology-BLIND" in row and "**0.999349×**" in row
+    assert "cpu smoke" in row
+
+    import unittest.mock as mock
+
+    with mock.patch.object(cw, "HERE", str(tmp_path)):
+        cw.write_last_measured(data, "2026-08-04")
+        led = json.load(open(tmp_path / "LAST_MEASURED.json"))
+    assert led["multislice_dcn_bytes_ratio"]["value"] == 0.25
+    # byte accounting is platform-independent — UNtagged, so any
+    # backend's window may refresh it; only walls carry the tag
+    assert "backend" not in led["multislice_dcn_bytes_ratio"]
+    assert led["multislice_hierarchical_step_ms"]["backend"] == "cpu"
+
+
+def test_cpu_smoke_train_artifact_does_not_clobber_chip_model_rows(tmp_path):
+    """The backend-aware rule (ISSUE 14 satellite, the PR 13 batching
+    precedent generalized): a MEASURE_TRAIN_TINY CPU smoke carries the
+    K-sweep/prefetch accounting but no BERT/llama legs — it must
+    refresh the 'Training sync accounting' row (cpu-smoke provenance)
+    WITHOUT emitting a '?'-riddled mnist/BERT row over the measured
+    chip one, and its ledger entries must be backend-tagged."""
+
+    import json
+
+    d = tmp_path / "window_out"
+    d.mkdir()
+    t = {
+        "train_backend": "cpu",
+        "mnist_steps_per_sec_per_chip": 12.2,
+        "mnist_examples_per_sec_per_chip": 390.4,
+        "train_sync_k_sweep": {
+            "1": {"step_ms": 70.0, "steady_step_syncs": 48},
+            "32": {"step_ms": 6.1, "steady_step_syncs": 0},
+        },
+        "train_k32_step_ms": 6.1,
+        "train_steady_syncs_per_step": 0.0,
+    }
+    (d / "train.out").write_text(json.dumps(t, indent=1) + "\n")
+    data = cw.parse_artifacts(str(d))
+    rows = cw.build_rows(data, "2026-08-04")
+    assert "mnist / BERT-base steps/sec/chip" not in rows
+    assert "cpu smoke" in rows["Training sync accounting"]
+
+    import unittest.mock as mock
+
+    # seed a chip-grade (untagged) mnist entry: the smoke must not
+    # replace it — bench.py's error fallback points humans here
+    (tmp_path / "LAST_MEASURED.json").write_text(
+        json.dumps(
+            {
+                "mnist_steps_per_sec_per_chip": {
+                    "value": 1388.4,
+                    "artifact": "benchmarks/window_out/train.out",
+                    "date": "2026-08-01",
+                }
+            }
+        )
+    )
+    with mock.patch.object(cw, "HERE", str(tmp_path)):
+        cw.write_last_measured(data, "2026-08-04")
+        led = json.load(open(tmp_path / "LAST_MEASURED.json"))
+    assert led["train_k32_step_ms"]["backend"] == "cpu"
+    assert led["mnist_steps_per_sec_per_chip"]["value"] == 1388.4
+    assert "backend" not in led["mnist_steps_per_sec_per_chip"]
+
+
 def test_error_bench_line_is_ignored(tmp_path):
     d = tmp_path / "w"
     d.mkdir()

@@ -173,6 +173,48 @@ def test_space_to_depth_stem_matches_conv7():
     np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_ref), atol=1e-5)
 
 
+def test_resnet_bn_fold_matches_eval_pass():
+    """ISSUE 14 satellite: the eval-mode BN-fold path.  A TRAINED
+    resnet's variables folded through fold_batchnorm produce the same
+    logits as the stock eval pass (running stats, train=False), at f32
+    exactly and at bf16 within rounding; the folded model refuses
+    train=True (no live statistics to fold)."""
+
+    import pytest as _pytest
+
+    from tf_operator_tpu.models import fold_batchnorm, resnet18
+    from tf_operator_tpu.parallel.trainer import batchnorm_cross_entropy_loss
+
+    r = np.random.RandomState(0)
+    batch = {
+        "image": jnp.asarray(r.rand(8, 32, 32, 3), jnp.float32),
+        "label": jnp.asarray(r.randint(0, 10, size=(8,))),
+    }
+    trainer = Trainer(
+        resnet18(num_classes=10, width=8, dtype=jnp.float32),
+        TrainerConfig(optimizer="sgd", learning_rate=0.1),
+        make_mesh({"dp": 1}, devices=jax.devices()[:1]),
+        batchnorm_cross_entropy_loss,
+        batch,
+    )
+    for _ in range(2):  # real running stats, not init zeros/ones
+        trainer.train_step(batch)
+    variables = {
+        "params": jax.device_get(trainer.state.params),
+        **jax.device_get(trainer.state.model_state),
+    }
+    model = trainer.model
+    ref = model.apply(variables, batch["image"], train=False)
+    folded = resnet18(num_classes=10, width=8, dtype=jnp.float32, bn_fold=True)
+    out = folded.apply(fold_batchnorm(variables), batch["image"], train=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # folded params really dropped the BN scopes and grew conv biases
+    fp = fold_batchnorm(variables)["params"]
+    assert "bn_init" not in fp and "bias" in fp["conv_init"]
+    with _pytest.raises(ValueError, match="eval-mode"):
+        folded.apply(fold_batchnorm(variables), batch["image"], train=True)
+
+
 def test_resnet_s2d_stem_trains():
     """resnet18(stem=space_to_depth) runs a train step (stem variant is
     exercised through the full Trainer path, not just the module)."""

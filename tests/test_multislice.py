@@ -1,0 +1,628 @@
+"""Multi-slice training (ISSUE 14): slice-aware mesh, hierarchical DCN
+gradient all-reduce, and slice-loss elastic re-shard.
+
+Fast tier: mesh axis→fabric mapping, DCN refusal, the 1-slice
+degenerate, grad-sync plan routing/byte accounting, and the static gate
+pinning that `parallel/trainer.py` routes multi-slice grad sync through
+`parallel/collectives.py` (no raw cross-slice psum reintroduced).
+
+Slow tier (compiles): hierarchical-vs-flat psum numerics property test
+inside shard_map, trainer A/B allclose on the 2-slice simulated mesh
+(fsdp auto-rule AND logical shardings, per-step and fused-scan paths),
+and the slice-loss chaos leg — a kubesim-semantics capacity shrink
+kills a whole slice's gang, the stock slice policy sheds to the
+survivor topology (checkpoint-gated), the trainer restores the 2-slice
+checkpoint onto the 1-slice survivor mesh and trains on, and the job
+ends Succeeded after capacity returns.
+"""
+
+import ast
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tf_operator_tpu.parallel import collectives
+from tf_operator_tpu.parallel.mesh import (
+    AXIS_DP,
+    FABRIC_DCN,
+    FABRIC_ICI,
+    make_mesh,
+    mesh_axis_links,
+    slice_count,
+)
+
+# ---------------------------------------------------------------- fast tier
+
+
+class TestSliceAwareMesh:
+    def test_axis_fabric_mapping_two_slices(self):
+        mesh = make_mesh({"dp": 2, "fsdp": 4}, slices=2)
+        links = mesh_axis_links(mesh)
+        assert links["dp"] == FABRIC_DCN
+        for ax in ("pp", "fsdp", "ep", "sp", "tp"):
+            assert links[ax] == FABRIC_ICI, (ax, links)
+        assert slice_count(mesh) == 2
+
+    def test_dp_coordinate_selects_the_slice(self):
+        """The layout contract itself: dp coordinate j lives on slice
+        j // (dp/S) — contiguous device groups on sim worlds (the
+        operator's pod numbering: pod index = slice*H + host)."""
+
+        mesh = make_mesh({"dp": 4, "fsdp": 2}, slices=2)
+        ids = np.array([d.id for d in mesh.devices.flat]).reshape(4, 2)
+        # slice 0 owns devices 0-3, slice 1 owns 4-7; fsdp neighbours
+        # stay inside one slice
+        assert set(ids[:2].ravel()) == {0, 1, 2, 3}
+        assert set(ids[2:].ravel()) == {4, 5, 6, 7}
+
+    def test_refuses_model_axis_across_dcn(self):
+        with pytest.raises(ValueError, match="model axis"):
+            make_mesh({"dp": 1, "fsdp": 8}, slices=2)
+        with pytest.raises(ValueError, match="tp"):
+            make_mesh({"dp": 2, "tp": 4}, slices=4)
+        with pytest.raises(ValueError, match="slices"):
+            make_mesh({"dp": 8}, slices=3)
+
+    def test_one_slice_degenerate_is_todays_mesh(self):
+        a = make_mesh({"dp": 2, "fsdp": 4}, slices=1)
+        b = make_mesh({"dp": 2, "fsdp": 4})
+        assert (a.devices == b.devices).all()
+        assert slice_count(a) == 1
+        assert set(mesh_axis_links(a).values()) == {FABRIC_ICI}
+
+    def test_env_detection(self, monkeypatch):
+        """MEGASCALE_NUM_SLICES (the operator-injected var,
+        bootstrap/tpu_env.gen_tpu_env) drives the default slices."""
+
+        from tf_operator_tpu.bootstrap.tpu_env import detected_slice_topology
+
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+        monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+        assert detected_slice_topology() == (2, 1)
+        mesh = make_mesh({"dp": 2, "fsdp": 4})  # slices auto-detected
+        assert slice_count(mesh) == 2
+        assert mesh_axis_links(mesh)["dp"] == FABRIC_DCN
+        monkeypatch.delenv("MEGASCALE_NUM_SLICES")
+        monkeypatch.delenv("MEGASCALE_SLICE_ID")
+        assert detected_slice_topology() == (1, None)
+
+
+class TestGradSyncPlan:
+    def _mesh(self):
+        return make_mesh({"dp": 2, "fsdp": 4}, slices=2)
+
+    def test_routing_and_byte_accounting(self):
+        mesh = self._mesh()
+        tree = {
+            "big": jnp.zeros((256, 128)),          # replicated -> bucket
+            "odd": jnp.zeros((7,)),                # padding case
+            "sharded": jnp.zeros((64, 16)),        # fsdp-sharded -> direct
+        }
+        shardings = {
+            "big": NamedSharding(mesh, P()),
+            "odd": NamedSharding(mesh, P()),
+            "sharded": NamedSharding(mesh, P("fsdp", None)),
+        }
+        plan = collectives.build_grad_sync_plan(tree, shardings, mesh)
+        led = plan.ledger()
+        assert led["intra_slice_size"] == 4
+        # acceptance: cross-slice bytes <= (1/intra_slice_size + eps)
+        # of the topology-blind full-width baseline
+        assert plan.dcn_bytes_ratio <= 1 / 4 + 1e-3, led
+        # the sharded leaf is its own fragment (no bucket), the two
+        # replicated leaves fuse into one bucket -> 2 cross-slice
+        # collectives, not 3
+        assert led["buckets"] == 1
+        assert led["dcn_collectives_per_step"] == 2
+        # blind baseline counts every gradient byte at full width
+        total = sum(v.size * 4 for v in tree.values())
+        assert led["flat_dcn_bytes_per_step"] == total
+        # same-mesh flat baseline: sharded leaves already move only
+        # their fragment there (ZeRO does the work), replicated leaves
+        # still cross at full width — so the hierarchy's win vs the
+        # flat program comes from the bucketed leaves alone
+        flat_mesh = (
+            tree["big"].size * 4
+            + tree["odd"].size * 4
+            + tree["sharded"].size * 4 // 4
+        )
+        assert led["flat_mesh_dcn_bytes_per_step"] == flat_mesh
+        assert plan.dcn_bytes_ratio_vs_flat_mesh <= 1.0 + 1e-6
+        assert plan.dcn_bytes_ratio_vs_flat_mesh >= plan.dcn_bytes_ratio
+
+    def test_bucket_capacity_splits(self):
+        mesh = self._mesh()
+        tree = {f"p{i}": jnp.zeros((1024,)) for i in range(8)}  # 4 KiB each
+        plan = collectives.build_grad_sync_plan(
+            tree, None, mesh, bucket_bytes=8192
+        )
+        assert len(plan.buckets) == 4  # two leaves per 8 KiB bucket
+        assert plan.dcn_bytes_ratio == pytest.approx(0.25, abs=1e-6)
+
+    def test_pure_dp_mesh_degenerates_to_flat_width(self):
+        """No intra-slice axes -> no fragment to scatter: hierarchical
+        == flat byte-wise (documented: the DCN win needs intra-slice
+        width)."""
+
+        mesh = make_mesh({"dp": 8}, slices=2)
+        plan = collectives.build_grad_sync_plan(
+            {"w": jnp.zeros((128,))}, None, mesh
+        )
+        assert plan.n_ici == 1
+        assert plan.dcn_bytes_ratio == 1.0
+
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "tf_operator_tpu"
+
+
+class TestTrainerRoutesThroughCollectives:
+    """Static gate (ISSUE 14 satellite): the trainer's multi-slice grad
+    sync must go through parallel/collectives.py — a raw full-width
+    cross-slice psum must not quietly come back."""
+
+    def _tree(self):
+        return ast.parse((PKG / "parallel" / "trainer.py").read_text())
+
+    def test_trainer_builds_and_applies_the_plan(self):
+        src = (PKG / "parallel" / "trainer.py").read_text()
+        assert "build_grad_sync_plan" in src, (
+            "trainer no longer builds a collectives.GradSyncPlan for "
+            "multi-slice meshes"
+        )
+        tree = self._tree()
+        hier = next(
+            (
+                n
+                for n in ast.walk(tree)
+                if isinstance(n, ast.FunctionDef)
+                and n.name == "_step_body_hierarchical"
+            ),
+            None,
+        )
+        assert hier is not None, (
+            "trainer lost its hierarchical step body — multi-slice "
+            "grad sync would ride a flat psum again"
+        )
+        applies = [
+            n
+            for n in ast.walk(hier)
+            if isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "apply"
+            and isinstance(n.func.value, ast.Name)
+            and n.func.value.id == "plan"
+        ]
+        assert applies, "hierarchical body does not call plan.apply(grads)"
+
+    def test_no_raw_gradient_psum_in_trainer(self):
+        """Gradient-width collectives (psum / psum_scatter) are
+        collectives.py's business.  pmean stays allowed in trainer.py —
+        it carries scalars and small BN statistics, not gradients."""
+
+        banned = []
+        for n in ast.walk(self._tree()):
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("psum", "psum_scatter", "all_reduce")
+            ):
+                banned.append(f"line {n.lineno}: {n.func.attr}")
+        assert not banned, (
+            "raw cross-slice reduction in parallel/trainer.py (route it "
+            "through parallel/collectives.py): " + ", ".join(banned)
+        )
+
+    def test_step_body_branches_on_the_plan(self):
+        src = (PKG / "parallel" / "trainer.py").read_text()
+        assert "self.grad_sync_plan is not None" in src
+
+
+# ---------------------------------------------------------------- slow tier
+
+
+@pytest.mark.slow
+class TestHierarchicalPsumNumerics:
+    def test_allclose_against_flat_psum_property(self):
+        """Property test: random trees (odd sizes, mixed sharded/
+        replicated leaves, several bucket capacities) reduced by
+        psum_hierarchical match jax.lax.psum exactly on the 2-slice
+        simulated mesh."""
+
+        from tf_operator_tpu.utils.jax_compat import shard_map_partial_auto
+
+        mesh = make_mesh({"dp": 2, "fsdp": 4}, slices=2)
+        auto = frozenset(set(mesh.axis_names) - {AXIS_DP})
+        rng = np.random.RandomState(0)
+        for seed, bucket_bytes in ((0, 256), (1, 4096), (2, 1 << 20)):
+            shapes = [(3,), (17,), (8, 8), (16, 5), (64,)][: 3 + seed]
+            tree = {
+                f"l{i}": jnp.asarray(rng.randn(*s), jnp.float32)
+                for i, s in enumerate(shapes)
+            }
+            shardings = {
+                k: NamedSharding(
+                    mesh,
+                    P("fsdp", None)
+                    if v.ndim == 2 and v.shape[0] % 4 == 0
+                    else P(),
+                )
+                for k, v in tree.items()
+            }
+            tree_s = jax.device_put(tree, shardings)
+
+            def hier(t):
+                return collectives.psum_hierarchical(
+                    t, mesh, shardings=shardings, bucket_bytes=bucket_bytes
+                )
+
+            def flat(t):
+                return jax.tree_util.tree_map(
+                    lambda v: jax.lax.psum(v, AXIS_DP), t
+                )
+
+            h = jax.jit(
+                shard_map_partial_auto(
+                    hier, mesh=mesh, in_specs=P(), out_specs=P(), auto=auto
+                )
+            )(tree_s)
+            f = jax.jit(
+                shard_map_partial_auto(
+                    flat, mesh=mesh, in_specs=P(), out_specs=P(), auto=auto
+                )
+            )(tree_s)
+            for k in tree:
+                np.testing.assert_allclose(
+                    np.asarray(h[k]), np.asarray(f[k]), rtol=1e-6, atol=1e-6,
+                    err_msg=f"leaf {k} bucket_bytes={bucket_bytes}",
+                )
+
+    def test_sync_probe_observes_fabric_labeled_seconds(self):
+        from tf_operator_tpu.utils.metrics import Metrics
+
+        mesh = make_mesh({"dp": 2, "fsdp": 4}, slices=2)
+        m = Metrics()
+        out = collectives.measure_sync_seconds(
+            mesh, nbytes=1 << 14, metrics=m, repeats=1
+        )
+        assert out["dcn_fragment_s"] > 0 and out["ici_reshard_s"] > 0
+        assert m.histogram("train_dcn_sync_seconds", fabric="dcn")["count"] == 1
+        assert m.histogram("train_dcn_sync_seconds", fabric="ici")["count"] == 1
+
+
+def _mnist_batch(n=16):
+    r = np.random.RandomState(0)
+    return {
+        "image": jnp.asarray(r.rand(n, 28, 28, 1), jnp.float32),
+        "label": jnp.asarray(r.randint(0, 10, size=(n,))),
+    }
+
+
+def _det_mnist_loss(params, state, batch, rng):
+    import optax
+
+    logits = state.apply_fn({"params": params}, batch["image"], train=False)
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["label"]
+    ).mean()
+    acc = (logits.argmax(-1) == batch["label"]).mean()
+    return loss, {"metrics": {"accuracy": acc}}
+
+
+@pytest.mark.slow
+class TestMultisliceTrainer:
+    def test_hierarchical_matches_flat_mnist(self):
+        """A/B at the trainer level: same mesh, same data, grad_sync
+        hierarchical vs flat — losses and params track to float
+        tolerance (deterministic loss; bf16 activations bound the
+        schedule-order drift)."""
+
+        from tf_operator_tpu.models import MnistCNN
+        from tf_operator_tpu.parallel import Trainer, TrainerConfig
+        from tf_operator_tpu.utils.metrics import Metrics, StepSyncLedger
+
+        mesh = make_mesh({"dp": 2, "fsdp": 4}, slices=2)
+        batch = _mnist_batch()
+        metrics_reg = Metrics()
+
+        def mk(gs, reg=None):
+            return Trainer(
+                MnistCNN(),
+                TrainerConfig(optimizer="sgd", learning_rate=0.05),
+                mesh,
+                _det_mnist_loss,
+                batch,
+                grad_sync=gs,
+                sync_ledger=StepSyncLedger(metrics=reg) if reg else None,
+            )
+
+        th = mk("auto", metrics_reg)
+        tf_ = mk("flat")
+        assert th.grad_sync == "hierarchical"  # auto picks it on 2 slices
+        assert tf_.grad_sync_plan is None
+        sb = th.shard_batch(batch)
+        sf = tf_.shard_batch(batch)
+        for i in range(5):
+            mh, mf = th.train_step(sb), tf_.train_step(sf)
+            np.testing.assert_allclose(
+                float(mh["loss"]), float(mf["loss"]), rtol=2e-3, atol=2e-3
+            )
+        diffs = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))),
+            th.state.params,
+            tf_.state.params,
+        )
+        assert max(jax.tree_util.tree_leaves(diffs)) < 5e-3
+        # the byte ledger flowed to /metrics: dcn bytes at 1/4 of flat
+        plan = th.grad_sync_plan
+        assert plan.dcn_bytes_ratio <= 0.25 + 1e-3
+        assert metrics_reg.counter(
+            "train_dcn_bytes_total", fabric="dcn"
+        ) == pytest.approx(plan.dcn_bytes * 5)
+        assert metrics_reg.counter(
+            "train_dcn_collectives_total", fabric="dcn"
+        ) == pytest.approx(plan.dcn_collectives * 5)
+
+    def test_hierarchical_fused_scan_and_logical_shardings(self):
+        """The fused K-step lax.scan path compiles with the shard_map
+        body, and logical-sharded transformers (gpt_tiny) ride the
+        same hierarchical sync — fsdp-sharded grads go direct (already
+        fragments), replicated ones bucket."""
+
+        from tf_operator_tpu.models import gpt_tiny, lm_loss
+        from tf_operator_tpu.parallel import Trainer, TrainerConfig
+
+        mesh = make_mesh({"dp": 2, "fsdp": 4}, slices=2)
+        r = np.random.RandomState(0)
+        ids = jnp.asarray(r.randint(0, 64, size=(8, 16)), jnp.int32)
+        batch = {"input_ids": ids}
+
+        def mk(gs):
+            return Trainer(
+                gpt_tiny(vocab_size=64, max_len=16, dropout=0.0),
+                TrainerConfig(learning_rate=1e-2, optimizer="sgd"),
+                mesh,
+                lm_loss,
+                batch,
+                init_args=(ids,),
+                shardings="logical",
+                grad_sync=gs,
+            )
+
+        th, tf_ = mk("hierarchical"), mk("flat")
+        plan = th.grad_sync_plan
+        assert plan.dcn_bytes_ratio <= 0.25 + 1e-3
+        # direct routes exist (fsdp-sharded kernels) AND a bucket
+        # (replicated norm scales/biases)
+        assert any(r_[0] == "direct" and r_[1] > 1 for r_ in plan.routes)
+        assert len(plan.buckets) >= 1
+        sb = th.shard_batch(batch)
+        sf = tf_.shard_batch(batch)
+        # fused window: one compiled scan, hierarchical sync inside
+        mh = th.train_steps(sb, 3)
+        for _ in range(3):
+            mf = tf_.train_step(sf)
+        np.testing.assert_allclose(
+            float(np.asarray(mh["loss"])[-1]), float(mf["loss"]),
+            rtol=5e-3, atol=5e-3,
+        )
+
+    def test_single_slice_auto_stays_flat(self):
+        from tf_operator_tpu.models import MnistCNN
+        from tf_operator_tpu.parallel import Trainer, TrainerConfig
+
+        mesh = make_mesh({"dp": 2, "fsdp": 4}, slices=1)
+        tr = Trainer(
+            MnistCNN(),
+            TrainerConfig(optimizer="sgd"),
+            mesh,
+            _det_mnist_loss,
+            _mnist_batch(),
+        )
+        assert tr.grad_sync == "flat"
+        assert tr.grad_sync_plan is None
+
+
+@pytest.mark.slow
+class TestSliceLossElastic:
+    """The chaos leg (ISSUE 14 acceptance): capacity shrink kills the
+    2-slice gang; the stock slice policy sheds to 1 slice gated on the
+    async checkpoint; the survivor world restores that checkpoint on a
+    1-slice mesh and trains on; capacity returns, the job grows back
+    and ends Succeeded."""
+
+    COOLDOWN = 0.05
+
+    def test_capacity_shrink_resharded_to_survivor_slice(self, tmp_path):
+        from tests.testutil import new_job
+        from tf_operator_tpu.api.types import (
+            AutoscalingSpec,
+            JobConditionType,
+            PodPhase,
+            ReplicaType,
+        )
+        from tf_operator_tpu.backend.fake import FakeCluster
+        from tf_operator_tpu.backend.jobstore import JobStore
+        from tf_operator_tpu.controller.autoscaler import (
+            Autoscaler,
+            default_slice_training_policy,
+        )
+        from tf_operator_tpu.controller.controller import TPUJobController
+        from tf_operator_tpu.models import gpt_tiny, lm_loss
+        from tf_operator_tpu.parallel import Trainer, TrainerConfig
+        from tf_operator_tpu.parallel.checkpoint import TrainerCheckpointer
+        from tf_operator_tpu.utils.metrics import Metrics, StepSyncLedger
+        from tf_operator_tpu.utils.summaries import (
+            ANNOTATION_SUMMARY_DIR,
+            SummaryWriter,
+        )
+
+        # ---- a REAL 2-slice trainer writes the checkpoint + summary
+        # stamp the resize gate reads (hierarchical grad sync live)
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(0, 128, size=(8, 32)), jnp.int32
+        )
+        batch = {"input_ids": ids}
+        metrics = Metrics()
+
+        def trainer_on(mesh, **kw):
+            return Trainer(
+                gpt_tiny(vocab_size=128, max_len=32, mesh=mesh),
+                TrainerConfig(learning_rate=1e-2, summary_every=1),
+                mesh,
+                lm_loss,
+                batch,
+                init_args=(ids,),
+                shardings="logical",
+                **kw,
+            )
+
+        sdir = str(tmp_path / "summaries")
+        writer = SummaryWriter(sdir)
+        mesh2 = make_mesh({"dp": 2, "fsdp": 4}, slices=2)
+        tr = trainer_on(
+            mesh2,
+            summary_writer=writer,
+            sync_ledger=StepSyncLedger(metrics=metrics),
+        )
+        assert tr.grad_sync == "hierarchical"
+        for _ in range(2):
+            tr.train_step(tr.shard_batch(batch))
+        ckpt = TrainerCheckpointer(str(tmp_path / "ckpt"), metrics=metrics)
+        saved_step = ckpt.save(tr, wait=True)
+        loss_before = float(tr.eval_step(tr.shard_batch(batch))["loss"])
+        tr.train_step(tr.shard_batch(batch))  # republishes the stamp
+        writer.close()
+        ckpt.close()
+
+        # ---- control plane: 2-slice gang job under the stock policy
+        store = JobStore()
+        backend = FakeCluster(delivery="sync")
+        autoscaler = Autoscaler(metrics=metrics, alerts=None)
+        controller = TPUJobController(
+            store, backend, metrics=metrics, autoscaler=autoscaler
+        )
+        try:
+            from tf_operator_tpu.api.types import RestartPolicy
+
+            # ExitCode policy: the capacity shrink kills gang pods with
+            # exit 137 (SIGKILL = preemption) — retryable, so the job
+            # survives the slice loss instead of going Failed
+            job = new_job(
+                name="msjob", tpu_slice=2, tpu_topology="v5e-4",
+                restart_policy=RestartPolicy.EXIT_CODE,
+            )
+            job.spec.enable_gang_scheduling = True
+            job.metadata.annotations[ANNOTATION_SUMMARY_DIR] = sdir
+            pol = default_slice_training_policy(min_slices=1, max_slices=2)
+            pol.cooldown_seconds = self.COOLDOWN
+            # the anti-flap dwell must dominate the breach-detection
+            # latency (~1 synthetic-second ticks here), or a shed would
+            # regrow into the still-shrunken pool and oscillate — the
+            # recovery leg jumps the clock past it instead
+            pol.stabilization_seconds = 30.0
+            pol.max_checkpoint_age_seconds = 3600.0
+            job.spec.autoscaling = AutoscalingSpec(policies=[pol])
+            store.create(job)
+
+            def pump(now):
+                autoscaler.evaluate_once(now)
+                backend.run_all("default")
+                controller.sync_until_quiet()
+
+            def live_slice_pods():
+                return [
+                    p
+                    for p in backend.list_pods(
+                        "default", {"tpujob.dist/job-name": "msjob"}
+                    )
+                    if p.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+                ]
+
+            import time as _time
+
+            t0 = _time.time()
+            pump(t0)
+            assert len(live_slice_pods()) == 2  # v5e-4 = 1 host/slice
+
+            # ---- slice loss: the pool shrinks to ONE slice's chips —
+            # the /_capacity semantics: the 2-slice gang is revoked and
+            # its pods are killed
+            revoked = backend.set_total_chips(4)
+            assert revoked == ["msjob"]
+            pump(t0 + 1)
+            # gang waits (2-slice topology no longer fits) -> gauge up
+            assert metrics.gauge(
+                "tpujob_gang_waiting_replicas", job="default/msjob"
+            ) > 0
+            # autoscaler sheds a slice, gated on the fresh checkpoint
+            for k in range(2, 30):
+                pump(t0 + k)
+                blk = (
+                    store.get("default", "msjob")
+                    .status.observed_health.get("autoscaler", {})
+                    .get("TPUSlice", {})
+                )
+                if blk.get("desiredReplicas") == 1 and len(live_slice_pods()) == 1:
+                    break
+            else:
+                pytest.fail(
+                    f"never resharded to 1 slice: {autoscaler.snapshot()}"
+                )
+            (down,) = [
+                d
+                for d in autoscaler.decisions()
+                if d.direction == "down"
+            ]
+            assert down.replica_type is ReplicaType.TPU_SLICE
+            assert down.reshard and "checkpoint" in down.reason
+            events = [
+                e.reason
+                for e in controller.recorder.for_object("default/msjob")
+            ]
+            assert "Resharding" in events and "ScaledDown" in events
+            # survivor world's bootstrap env: 1 slice -> no MEGASCALE
+            # (the degenerate contract bootstrap/tpu_env.py pins)
+
+            # ---- the REAL resume on the survivor topology: restore
+            # the 2-slice checkpoint onto a 1-slice mesh and train on
+            mesh1 = make_mesh({"fsdp": 8}, slices=1)
+            tr1 = trainer_on(mesh1)
+            assert tr1.grad_sync == "flat"  # survivor: no DCN anywhere
+            ckpt1 = TrainerCheckpointer(str(tmp_path / "ckpt"))
+            assert ckpt1.restore_latest(tr1) == saved_step
+            loss_after = float(tr1.eval_step(tr1.shard_batch(batch))["loss"])
+            np.testing.assert_allclose(loss_after, loss_before, rtol=2e-2)
+            m = tr1.train_step(tr1.shard_batch(batch))
+            assert np.isfinite(float(m["loss"]))
+            ckpt1.close()
+
+            # ---- capacity returns: quiet signals grow the job back to
+            # its declared 2 slices, then everything succeeds
+            backend.set_total_chips(8)
+            t1 = _time.time() + 60  # past cooldown/stabilization
+            for k in range(40):
+                pump(t1 + k)
+                if len(live_slice_pods()) == 2:
+                    break
+            else:
+                pytest.fail(
+                    f"never grew back to 2 slices: {autoscaler.snapshot()}"
+                )
+            for p in live_slice_pods():
+                backend.succeed_pod("default", p.metadata.name)
+            controller.sync_until_quiet()
+            st = store.get("default", "msjob").status
+            assert st.has_condition(JobConditionType.SUCCEEDED)
+            # terminal path cleared the gang gauge (per-object hygiene)
+            assert (
+                metrics.gauge(
+                    "tpujob_gang_waiting_replicas", job="default/msjob"
+                )
+                == 0.0
+            )
+        finally:
+            controller.stop()

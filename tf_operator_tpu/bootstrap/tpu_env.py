@@ -43,6 +43,32 @@ ENV_REPLICA_INDEX = "TPUJOB_REPLICA_INDEX"
 ENV_JOB_NAME = "TPUJOB_NAME"
 
 
+def detected_slice_topology() -> Tuple[int, "int | None"]:
+    """(num_slices, slice_id-or-None) from the MEGASCALE env THIS module
+    injects into multi-slice workers (``gen_tpu_env`` below) — the
+    worker-side read of the injection contract.  Single-slice worlds
+    (no MEGASCALE vars, or the 1-slice degenerate where gen_tpu_env
+    injects nothing) report ``(1, None)``.  ``parallel/mesh.make_mesh``
+    consults this when no explicit ``slices=`` is passed, so a trainer
+    launched by the operator builds a slice-aware mesh with zero
+    configuration."""
+
+    import os
+
+    try:
+        n = int(os.environ.get("MEGASCALE_NUM_SLICES", "1") or "1")
+    except ValueError:
+        n = 1
+    sid_raw = os.environ.get("MEGASCALE_SLICE_ID")
+    sid: "int | None" = None
+    if sid_raw not in (None, ""):
+        try:
+            sid = int(sid_raw)
+        except ValueError:
+            sid = None
+    return max(1, n), sid
+
+
 def _process_table(job: TPUJob) -> List[Tuple[ReplicaType, int]]:
     """Global process numbering: coordinator replica type first (its index
     0 must be process 0), then the remaining types in canonical order.

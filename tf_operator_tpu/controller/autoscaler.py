@@ -175,6 +175,40 @@ def default_training_policy(
     )
 
 
+def default_slice_training_policy(
+    min_slices: int = 1, max_slices: int = 4
+) -> AutoscalingPolicy:
+    """The stock SLICE-topology training policy (ISSUE 14): the scaled
+    unit is a whole TPU_SLICE replica — shedding one re-shards ``dp``
+    onto the survivor slices (the slice-aware mesh keeps model axes on
+    ICI at any slice count) and resumes from the async checkpoint, via
+    exactly the PR 7 bounce the WORKER policy uses (the reconciler's
+    ``_bounce_for_reshard`` is replica-type-generic, and the bounced
+    pods' regenerated bootstrap env carries the survivor
+    ``MEGASCALE_NUM_SLICES``).  Signals: the reconciler-set
+    ``tpujob_gang_waiting_replicas`` gauge — nonzero while the job's
+    gang group sits Pending, i.e. a capacity shrink revoked the grant
+    and the declared slice count no longer fits the pool (the
+    kubesim/fake ``/_capacity`` semantics) — plus the watchdog-stall
+    alert for the slice that dies without returning capacity.  Every
+    resize stays checkpoint-age gated.  Names pinned by
+    tests/test_autoscaling_lint.py like the other stock policies."""
+
+    return AutoscalingPolicy(
+        replica_type=ReplicaType.TPU_SLICE,
+        mode="training",
+        min_replicas=min_slices,
+        max_replicas=max_slices,
+        signals=[
+            SignalBinding(
+                kind="gauge", name="tpujob_gang_waiting_replicas",
+                threshold=0.0,
+            ),
+            SignalBinding(kind="alert", name="watchdog-stall"),
+        ],
+    )
+
+
 def job_checkpoint_age(
     job: TPUJob, now: float, metrics=None, series=None
 ) -> Optional[float]:

@@ -628,6 +628,20 @@ class Reconciler:
         min_member = sp.min_member if sp and sp.min_member else job.spec.total_pods()
         existing = self.backend.get_pod_group(job.metadata.namespace, job.metadata.name)
         if existing is not None:
+            # slice-loss signal (ISSUE 14): a gang stuck Pending means
+            # the declared topology no longer fits the pool (capacity
+            # shrink revoked it — kubesim/fake /_capacity semantics).
+            # The gauge is what default_slice_training_policy binds, so
+            # the autoscaler can shed whole slices and re-shard onto
+            # the survivors instead of waiting forever.
+            waiting = (
+                min_member
+                if existing.phase is PodGroupPhase.PENDING
+                else 0
+            )
+            self.metrics.set(
+                "tpujob_gang_waiting_replicas", float(waiting), job=job.key
+            )
             # dynamic scale: keep gang size/chip accounting in step
             if existing.min_member != min_member or existing.chip_request != chips:
                 self.backend.update_pod_group(
@@ -664,6 +678,10 @@ class Reconciler:
             "terminal state clears degraded",
         )
         job.status.observed_health = {}
+        # a finished job must not keep a gang-waiting level latched for
+        # the slice autoscaling policies (per-object gauge hygiene —
+        # the autoscaler_desired_replicas rule)
+        self.metrics.clear_gauge("tpujob_gang_waiting_replicas", job=job.key)
 
     def _fail_job(self, job: TPUJob, reason: str, message: str) -> None:
         job.status.completion_time = job.status.completion_time or time.time()

@@ -30,7 +30,12 @@ from tf_operator_tpu.models.llama import (
 from tf_operator_tpu.models.mnist import MnistCNN
 from tf_operator_tpu.models.pipelined_lm import PipelinedLM, lm_reference_apply
 from tf_operator_tpu.models.moe import MoeConfig, MoeLM, moe_lm_loss, moe_tiny
-from tf_operator_tpu.models.resnet import ResNet, resnet18, resnet50
+from tf_operator_tpu.models.resnet import (
+    ResNet,
+    fold_batchnorm,
+    resnet18,
+    resnet50,
+)
 from tf_operator_tpu.models.vit import ViT, vit_b16, vit_loss, vit_tiny
 from tf_operator_tpu.models.t5 import T5, seq2seq_loss, t5_base, t5_tiny
 from tf_operator_tpu.models.transformer import TransformerConfig
@@ -60,6 +65,7 @@ __all__ = [
     "moe_lm_loss",
     "moe_tiny",
     "ResNet",
+    "fold_batchnorm",
     "resnet18",
     "resnet50",
     "ViT",

@@ -122,6 +122,15 @@ class BasicBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+class _Identity(nn.Module):
+    """Stand-in for a folded-away BatchNorm (``ResNet.bn_fold``): the
+    normalization lives inside the preceding conv's kernel/bias."""
+
+    @nn.compact
+    def __call__(self, x):
+        return x
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block: Callable
@@ -134,18 +143,41 @@ class ResNet(nn.Module):
     #: "bnbf16") probing whether the f32 BN chains between bf16 convs
     #: are a material slice of the step (benchmarks/PROFILE.md)
     bn_param_dtype: jnp.dtype = jnp.float32
+    #: eval-mode BN-fold (ISSUE 14 satellite / ROADMAP item 2): the
+    #: inference graph with every BatchNorm folded into its conv's
+    #: kernel + bias (``fold_batchnorm`` maps trained variables onto
+    #: this variant) — the whole convert/reduce/elementwise BN chain
+    #: the FLOPS.md trace table shows dominating the step disappears
+    #: from the graph.  Inference-only by construction: training needs
+    #: live batch statistics, so train=True refuses.
+    bn_fold: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = partial(
-            nn.BatchNorm,
-            use_running_average=not train,
-            momentum=0.9,
-            epsilon=1e-5,
-            dtype=self.dtype,
-            param_dtype=self.bn_param_dtype,
-        )
+        if self.bn_fold:
+            if train:
+                raise ValueError(
+                    "bn_fold is an eval-mode (inference) path — training "
+                    "needs live batch statistics"
+                )
+            if self.stem == "space_to_depth":
+                raise ValueError("bn_fold supports the conv7 stem only")
+            # biased convs carry the folded affine; norms become no-ops
+            conv = partial(nn.Conv, use_bias=True, dtype=self.dtype)
+
+            def norm(name=None, **_kw):
+                return _Identity(name=name)
+
+        else:
+            conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+            norm = partial(
+                nn.BatchNorm,
+                use_running_average=not train,
+                momentum=0.9,
+                epsilon=1e-5,
+                dtype=self.dtype,
+                param_dtype=self.bn_param_dtype,
+            )
         x = x.astype(self.dtype)
         if self.stem == "space_to_depth":
             x = _SpaceToDepthStem(self.width, dtype=self.dtype, name="conv_init")(x)
@@ -163,6 +195,62 @@ class ResNet(nn.Module):
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
         return x.astype(jnp.float32)
+
+
+#: conv scope -> the norm scope folded into it (flax auto-naming is
+#: per-type, so Conv_i pairs with BatchNorm_i inside every block; the
+#: explicitly named projection/stem pairs are listed outright)
+def _norm_scope_for(conv_scope: str) -> "str | None":
+    if conv_scope.startswith("Conv_"):
+        return "BatchNorm_" + conv_scope[len("Conv_"):]
+    return {"conv_proj": "norm_proj", "conv_init": "bn_init"}.get(conv_scope)
+
+
+def _is_norm_scope(name: str) -> bool:
+    return name.startswith("BatchNorm_") or name in ("norm_proj", "bn_init")
+
+
+def fold_batchnorm(variables, eps: float = 1e-5):
+    """Map trained ``{params, batch_stats}`` onto the parameters of the
+    same architecture with ``bn_fold=True``.
+
+    The standard inference transform: ``BN(conv(x)) ==
+    conv'(x) + bias'`` with ``kernel' = kernel * gamma/sqrt(var+eps)``
+    (broadcast over the output-channel dim of HWIO) and ``bias' =
+    beta - mean * gamma/sqrt(var+eps)``.  Computed in f32 and stored at
+    the conv's original param dtype — the folded model's logits match
+    the unfolded eval pass up to reduction-order float noise (pinned in
+    tests/test_models.py).  ``eps`` must match the model's BatchNorm
+    epsilon."""
+
+    def fold_pair(conv_p, norm_p, norm_s):
+        kernel = jnp.asarray(conv_p["kernel"], jnp.float32)
+        gamma = jnp.asarray(norm_p["scale"], jnp.float32)
+        beta = jnp.asarray(norm_p["bias"], jnp.float32)
+        mean = jnp.asarray(norm_s["mean"], jnp.float32)
+        var = jnp.asarray(norm_s["var"], jnp.float32)
+        scale = gamma / jnp.sqrt(var + eps)
+        out_dtype = jnp.asarray(conv_p["kernel"]).dtype
+        return {
+            "kernel": (kernel * scale).astype(out_dtype),
+            "bias": (beta - mean * scale).astype(out_dtype),
+        }
+
+    def walk(p, s):
+        out = {}
+        for name, sub in p.items():
+            if _is_norm_scope(name):
+                continue  # folded into its conv below
+            norm_scope = _norm_scope_for(name)
+            if norm_scope is not None and norm_scope in p:
+                out[name] = fold_pair(sub, p[norm_scope], s[norm_scope])
+            elif hasattr(sub, "items") and "kernel" not in sub:
+                out[name] = walk(sub, s.get(name, {}))
+            else:
+                out[name] = sub  # Dense head and friends
+        return out
+
+    return {"params": walk(variables["params"], variables.get("batch_stats", {}))}
 
 
 def resnet18(num_classes: int = 1000, **kw) -> ResNet:

@@ -27,7 +27,14 @@ from tf_operator_tpu.parallel.mesh import (
     batch_sharding,
     batch_spec,
     make_mesh,
+    mesh_axis_links,
     replicated,
+    slice_count,
+)
+from tf_operator_tpu.parallel.collectives import (
+    GradSyncPlan,
+    build_grad_sync_plan,
+    psum_hierarchical,
 )
 from tf_operator_tpu.parallel.checkpoint import (
     TrainerCheckpointer,
@@ -59,7 +66,12 @@ __all__ = [
     "batch_sharding",
     "batch_spec",
     "make_mesh",
+    "mesh_axis_links",
     "replicated",
+    "slice_count",
+    "GradSyncPlan",
+    "build_grad_sync_plan",
+    "psum_hierarchical",
     "LOGICAL_RULES",
     "fsdp_shardings",
     "logical_shardings",

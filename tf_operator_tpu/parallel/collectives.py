@@ -1,0 +1,447 @@
+"""Hierarchical (slice-aware) gradient collectives — ISSUE 14 tentpole.
+
+A multi-slice job's gradient all-reduce is the one large collective
+forced across DCN, the fabric with orders of magnitude less bandwidth
+than intra-slice ICI.  A topology-flat psum moves every gradient byte
+across DCN; the standard fix (t5x/maxtext lineage) is the two-stage
+hierarchical reduction this module implements over the slice-aware mesh
+(`parallel/mesh.make_mesh(slices=)`, dp = the only DCN axis):
+
+1. **reduce-scatter over ICI**: each slice reduces its local gradient
+   and splits it into 1/n_ici fragments across the intra-slice axes
+   (for fsdp-sharded params the gradient already IS the fragment —
+   ZeRO sharding and hierarchy compose for free; for replicated params
+   the intra-slice reduction is XLA's automatic ICI all-reduce and the
+   split is a local slice under a sharding constraint);
+2. **cross-slice all-reduce over dp**: only the fragment crosses DCN —
+   1/n_ici of the bytes a flat psum would move;
+3. **all-gather over ICI**: replicated params get their full gradient
+   back (sharded params skip this — their optimizer shard only needs
+   the fragment it owns).
+
+`psum_hierarchical` / `GradSyncPlan.apply` run INSIDE a shard_map that
+is manual over the DCN axis and auto over the intra-slice axes
+(`utils/jax_compat.shard_map_partial_auto`) — `parallel/trainer.py`
+builds that region around its loss/grad computation whenever the mesh
+spans slices.  Replicated leaves are BUCKETED (flattened, concatenated,
+padded to the fragment divisor) so the cross-slice phase launches a
+handful of fused psums that overlap with backward compute instead of
+one collective per tensor; leaves already sharded over an ICI axis are
+reduced directly (they are their own fragments, and XLA fuses adjacent
+all-reduces on real hardware).
+
+Byte accounting convention (the `train_dcn_*` metric families and the
+`--section multislice` bench): PAYLOAD bytes per device per step — a
+stage-2 psum of an F-byte fragment counts F toward `fabric="dcn"`; a
+stage-3 gather counts (full − fragment) toward `fabric="ici"`.  The
+intra-slice reduction XLA inserts automatically is not counted (it is
+identical in the flat and hierarchical programs).  TWO baselines,
+reported separately because they answer different questions:
+
+- **topology-blind** (`flat_blind_dcn_bytes`, the headline
+  `dcn_bytes_ratio`): every gradient byte at full parameter width —
+  the pre-ISSUE-14 state, where the mesh knew no slice boundary, so
+  nothing guaranteed the (dp × fsdp) reduction ring kept fsdp hops on
+  ICI; full width crossing DCN is the upper bound that blind layout
+  permits and the motivation this module removes;
+- **same-mesh flat** (`flat_mesh_dcn_bytes`,
+  `dcn_bytes_ratio_vs_flat_mesh`): the `grad_sync="flat"` program on
+  the SAME slice-aware mesh — there XLA's dp-psum of an already
+  fsdp-sharded gradient moves only the shard, so sharded leaves tie
+  the hierarchy and only replicated leaves win.  This is the baseline
+  the measured A/B walls correspond to, and on fsdp-heavy models it
+  is close to 1.0: once the mesh itself is slice-aware, ZeRO sharding
+  already does most of the hierarchy's work for sharded params.
+
+Counts are platform-independent (the same program structure runs
+everywhere); the CPU smoke pins the ratios, the chip window measures
+the walls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tf_operator_tpu.parallel.mesh import (
+    AXIS_DP,
+    FABRIC_ICI,
+    mesh_axis_links,
+)
+
+#: bucket capacity for fused cross-slice psums: big enough to amortize
+#: per-collective latency, small enough that buckets finish (and their
+#: DCN transfer starts) while the backward is still producing later
+#: gradients
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+
+def ici_axes(mesh: Mesh, dcn_axis: str = AXIS_DP) -> Tuple[str, ...]:
+    """The mesh axes whose collectives stay intra-slice (size > 1 and
+    not the DCN axis) — the fragment dimension of stage 1/3."""
+
+    links = mesh_axis_links(mesh)
+    return tuple(
+        ax
+        for ax in mesh.axis_names
+        if ax != dcn_axis and mesh.shape[ax] > 1 and links[ax] == FABRIC_ICI
+    )
+
+
+def _spec_divisor(spec: Optional[PartitionSpec], mesh: Mesh, ici: Tuple[str, ...]) -> int:
+    """How many ways an already-sharded leaf's gradient is split across
+    intra-slice axes (1 = replicated: needs the bucket route)."""
+
+    if spec is None:
+        return 1
+    div = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        for ax in entry if isinstance(entry, tuple) else (entry,):
+            if ax in ici:
+                div *= mesh.shape[ax]
+    return div
+
+
+@dataclasses.dataclass
+class _Bucket:
+    indices: List[int]
+    sizes: List[int]
+    shapes: List[Tuple[int, ...]]
+    dtype: Any
+    padded: int  # total flattened length, padded to a multiple of n_ici
+
+
+@dataclasses.dataclass
+class GradSyncPlan:
+    """Host-side compilation of one gradient tree's hierarchical sync:
+    per-leaf routes, fused buckets, and the byte/collective ledger the
+    `train_dcn_*` families export.  Built once per trainer (shapes are
+    static); `apply` is called inside the manual-over-dcn shard_map."""
+
+    mesh: Mesh
+    dcn_axis: str
+    ici: Tuple[str, ...]
+    n_ici: int
+    #: per flattened leaf: ("direct", divisor) — already ici-sharded,
+    #: psum the fragment as-is; ("bucket", bucket_index, slot_index)
+    routes: List[Tuple]
+    buckets: List[_Bucket]
+    #: payload bytes per device per step, two baselines — see module
+    #: docstring ("Byte accounting convention")
+    flat_blind_dcn_bytes: int
+    flat_mesh_dcn_bytes: int
+    dcn_bytes: int
+    ici_bytes: int
+    dcn_collectives: int
+    ici_collectives: int
+
+    @property
+    def dcn_bytes_ratio(self) -> float:
+        """hierarchical / topology-blind full-width cross-slice payload
+        — the acceptance number (≤ 1/n_ici + padding epsilon) against
+        the pre-slice-aware state."""
+
+        return (
+            self.dcn_bytes / self.flat_blind_dcn_bytes
+            if self.flat_blind_dcn_bytes
+            else 0.0
+        )
+
+    @property
+    def dcn_bytes_ratio_vs_flat_mesh(self) -> float:
+        """hierarchical / same-mesh flat-program cross-slice payload —
+        what the measured grad_sync=flat A/B corresponds to (≈1.0 on
+        fsdp-heavy models: sharded grads are already fragments there)."""
+
+        return (
+            self.dcn_bytes / self.flat_mesh_dcn_bytes
+            if self.flat_mesh_dcn_bytes
+            else 0.0
+        )
+
+    def apply(self, grads: Any) -> Any:
+        """Sum `grads` across the DCN axis, two-stage.  Call inside a
+        shard_map manual over `dcn_axis` with the ici axes auto.  The
+        caller divides by the dcn extent if it wants the mean."""
+
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        if len(leaves) != len(self.routes):
+            raise ValueError(
+                f"grad tree has {len(leaves)} leaves, plan was built for "
+                f"{len(self.routes)}"
+            )
+        out: List[Any] = [None] * len(leaves)
+        for i, route in enumerate(self.routes):
+            if route[0] == "direct":
+                out[i] = jax.lax.psum(leaves[i], self.dcn_axis)
+        for b, bucket in enumerate(self.buckets):
+            pieces = [leaves[i].reshape(-1) for i in bucket.indices]
+            total = sum(bucket.sizes)
+            if bucket.padded > total:
+                # pad via an extra zeros piece — jnp.pad inside the
+                # partial-auto region trips an XLA sharding-propagation
+                # check on this jax (hard process abort, not an error)
+                pieces.append(
+                    jnp.zeros((bucket.padded - total,), bucket.dtype)
+                )
+            flat = jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
+            if self.n_ici > 1:
+                # stage 1: scatter the fragment across the ICI axes — a
+                # local slice (the value is replicated over them after
+                # XLA's automatic intra-slice reduction)
+                flat = jax.lax.with_sharding_constraint(
+                    flat, NamedSharding(self.mesh, PartitionSpec(self.ici))
+                )
+            # stage 2: only the fragment crosses DCN
+            flat = jax.lax.psum(flat, self.dcn_axis)
+            if self.n_ici > 1:
+                # stage 3: all-gather the full gradient back over ICI
+                flat = jax.lax.with_sharding_constraint(
+                    flat, NamedSharding(self.mesh, PartitionSpec(None))
+                )
+            offset = 0
+            for idx, size, shape in zip(
+                bucket.indices, bucket.sizes, bucket.shapes
+            ):
+                out[idx] = jax.lax.dynamic_slice_in_dim(
+                    flat, offset, size
+                ).reshape(shape)
+                offset += size
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def ledger(self) -> Dict[str, Any]:
+        """Machine-readable accounting — what measure.py embeds and
+        examples print in the MULTICHIP tail."""
+
+        return {
+            "dcn_axis": self.dcn_axis,
+            "ici_axes": list(self.ici),
+            "intra_slice_size": self.n_ici,
+            "flat_dcn_bytes_per_step": self.flat_blind_dcn_bytes,
+            "flat_mesh_dcn_bytes_per_step": self.flat_mesh_dcn_bytes,
+            "hier_dcn_bytes_per_step": self.dcn_bytes,
+            "hier_ici_bytes_per_step": self.ici_bytes,
+            "dcn_bytes_ratio": round(self.dcn_bytes_ratio, 6),
+            "dcn_bytes_ratio_vs_flat_mesh": round(
+                self.dcn_bytes_ratio_vs_flat_mesh, 6
+            ),
+            "dcn_collectives_per_step": self.dcn_collectives,
+            "ici_collectives_per_step": self.ici_collectives,
+            "buckets": len(self.buckets),
+        }
+
+
+def build_grad_sync_plan(
+    abstract_params: Any,
+    param_shardings: Any,
+    mesh: Mesh,
+    *,
+    dcn_axis: str = AXIS_DP,
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+) -> GradSyncPlan:
+    """Route every gradient leaf and precompute the byte ledger.
+
+    `abstract_params`: tree of shape/dtype carriers (possibly
+    flax-Partitioned-boxed — unboxed here, the clamp_overranked rule);
+    `param_shardings`: the matching NamedSharding tree (None = treat
+    every leaf as replicated)."""
+
+    ici = ici_axes(mesh, dcn_axis)
+    n_ici = 1
+    for ax in ici:
+        n_ici *= mesh.shape[ax]
+
+    ab_leaves = [
+        getattr(leaf, "value", leaf)
+        for leaf in jax.tree_util.tree_leaves(abstract_params)
+    ]
+    if param_shardings is None:
+        specs: List[Optional[PartitionSpec]] = [None] * len(ab_leaves)
+    else:
+        sh_leaves = jax.tree_util.tree_leaves(param_shardings)
+        if len(sh_leaves) != len(ab_leaves):
+            raise ValueError(
+                f"params/shardings leaf mismatch: {len(ab_leaves)} vs "
+                f"{len(sh_leaves)}"
+            )
+        specs = [getattr(s, "spec", None) for s in sh_leaves]
+
+    routes: List[Tuple] = [()] * len(ab_leaves)
+    flat_blind_bytes = 0
+    flat_mesh_bytes = 0
+    dcn_bytes = 0
+    ici_bytes = 0
+    direct = 0
+    # bucket replicated leaves by dtype (concatenation needs one dtype)
+    open_buckets: Dict[Any, _Bucket] = {}
+    buckets: List[_Bucket] = []
+
+    def close(dtype) -> None:
+        b = open_buckets.pop(dtype, None)
+        if b is not None:
+            total = sum(b.sizes)
+            b.padded = -(-total // n_ici) * n_ici
+            buckets.append(b)
+
+    for i, leaf in enumerate(ab_leaves):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        dtype = jnp.dtype(getattr(leaf, "dtype", jnp.float32))
+        size = int(np.prod(shape)) if shape else 1
+        nbytes = size * dtype.itemsize
+        flat_blind_bytes += nbytes
+        # non-float leaves (int counters in exotic states) never reach
+        # the grad tree; guard anyway by routing them direct
+        div = _spec_divisor(specs[i], mesh, ici)
+        # same-mesh flat program: an ici-sharded grad's dp-psum already
+        # moves only its shard over DCN, so flat ties the hierarchy on
+        # direct leaves (see module docstring, "same-mesh flat")
+        flat_mesh_bytes += nbytes // div
+        if div > 1 or not jnp.issubdtype(dtype, jnp.floating):
+            routes[i] = ("direct", div)
+            direct += 1
+            dcn_bytes += nbytes // div
+            continue
+        b = open_buckets.get(dtype)
+        if b is None:
+            b = open_buckets[dtype] = _Bucket([], [], [], dtype, 0)
+        b.indices.append(i)
+        b.sizes.append(size)
+        b.shapes.append(shape)
+        routes[i] = ("bucket", None, None)
+        if sum(s * dtype.itemsize for s in b.sizes) >= bucket_bytes:
+            close(dtype)
+    for dtype in list(open_buckets):
+        close(dtype)
+    for b_idx, b in enumerate(buckets):
+        for slot, leaf_idx in enumerate(b.indices):
+            routes[leaf_idx] = ("bucket", b_idx, slot)
+        frag = (b.padded // n_ici) * jnp.dtype(b.dtype).itemsize
+        dcn_bytes += frag
+        ici_bytes += b.padded * jnp.dtype(b.dtype).itemsize - frag
+
+    return GradSyncPlan(
+        mesh=mesh,
+        dcn_axis=dcn_axis,
+        ici=ici,
+        n_ici=n_ici,
+        routes=routes,
+        buckets=buckets,
+        flat_blind_dcn_bytes=flat_blind_bytes,
+        flat_mesh_dcn_bytes=flat_mesh_bytes,
+        dcn_bytes=dcn_bytes,
+        ici_bytes=ici_bytes,
+        dcn_collectives=len(buckets) + direct,
+        ici_collectives=len(buckets) if n_ici > 1 else 0,
+    )
+
+
+def psum_hierarchical(
+    x: Any,
+    mesh: Mesh,
+    *,
+    shardings: Any = None,
+    dcn_axis: str = AXIS_DP,
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+) -> Any:
+    """Drop-in two-stage psum over the DCN axis — sum semantics,
+    allclose-pinned against `jax.lax.psum(x, dcn_axis)`.
+
+    Call INSIDE a shard_map manual over `dcn_axis` (ici axes auto);
+    trace-time shapes build the plan, so the first call per shape pays
+    the routing walk and compiled programs reuse it for free."""
+
+    plan = build_grad_sync_plan(
+        x, shardings, mesh, dcn_axis=dcn_axis, bucket_bytes=bucket_bytes
+    )
+    return plan.apply(x)
+
+
+def measure_sync_seconds(
+    mesh: Mesh,
+    nbytes: int = DEFAULT_BUCKET_BYTES,
+    *,
+    dcn_axis: str = AXIS_DP,
+    metrics: Any = None,
+    repeats: int = 5,
+) -> Dict[str, float]:
+    """Time the hierarchical reduction's two phases as standalone
+    programs and observe them into the ``train_dcn_sync_seconds``
+    histogram with the ``fabric`` label — the measured-seconds half of
+    the byte ledger.  ``fabric="dcn"`` times the cross-slice psum of
+    one fragment; ``fabric="ici"`` times the scatter+gather reshard
+    pair.  Also times the FLAT full-width psum for the comparison row.
+    On CPU sim worlds both fabrics are shared memory, so the absolute
+    numbers are smoke-grade; the program structure (and the chip
+    window's walls) are the signal."""
+
+    from tf_operator_tpu.parallel.trainer import hard_sync
+    from tf_operator_tpu.utils.jax_compat import shard_map_partial_auto
+
+    ici = ici_axes(mesh, dcn_axis)
+    n_ici = 1
+    for ax in ici:
+        n_ici *= mesh.shape[ax]
+    n = max(n_ici, (nbytes // 4 // max(1, n_ici)) * max(1, n_ici))
+    auto = frozenset(set(mesh.axis_names) - {dcn_axis})
+
+    full = jax.device_put(
+        jnp.ones((n,), jnp.float32), NamedSharding(mesh, PartitionSpec())
+    )
+    frag_sharding = NamedSharding(
+        mesh, PartitionSpec(ici) if ici else PartitionSpec()
+    )
+    frag = jax.device_put(jnp.ones((n,), jnp.float32), frag_sharding)
+
+    # ONE jitted psum serves both timings — jit specializes per operand
+    # sharding, so psum_prog(frag) times the fragment-width DCN phase
+    # and psum_prog(full) the full-width flat reduction
+    psum_prog = jax.jit(
+        shard_map_partial_auto(
+            lambda v: jax.lax.psum(v, dcn_axis),
+            mesh=mesh,
+            in_specs=PartitionSpec(),
+            out_specs=PartitionSpec(),
+            auto=auto,
+        )
+    )
+
+    def ici_pair(v):
+        v = jax.lax.with_sharding_constraint(v, frag_sharding)
+        return jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, PartitionSpec())
+        )
+
+    ici_prog = jax.jit(ici_pair)
+
+    def timed(fn, arg) -> float:
+        hard_sync(fn(arg))  # compile outside the wall
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            hard_sync(fn(arg))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    out = {
+        "dcn_fragment_s": timed(psum_prog, frag),
+        "ici_reshard_s": timed(ici_prog, full),
+        "flat_full_s": timed(psum_prog, full),
+        "probe_bytes": n * 4,
+        "intra_slice_size": n_ici,
+    }
+    if metrics is not None:
+        metrics.observe_histogram(
+            "train_dcn_sync_seconds", out["dcn_fragment_s"], fabric="dcn"
+        )
+        metrics.observe_histogram(
+            "train_dcn_sync_seconds", out["ici_reshard_s"], fabric="ici"
+        )
+    return out

@@ -11,7 +11,7 @@ injection, bootstrap/tpu_env.py) multi-slice jobs unchanged.
 from __future__ import annotations
 
 import math
-from typing import Dict, Mapping, Optional, Sequence
+from typing import Dict, List, Mapping, Optional, Sequence
 
 import jax
 import numpy as np
@@ -35,11 +35,88 @@ AXIS_ORDER = (AXIS_PP, AXIS_DP, AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_TP)
 #: The global batch is sharded over every data-ish axis.
 BATCH_AXES = (AXIS_DP, AXIS_FSDP)
 
+#: Axes whose collectives are bandwidth-bound on the critical path —
+#: these must NEVER span a DCN (cross-slice) boundary.  dp may (the
+#: whole point of the hierarchical grad sync, parallel/collectives.py);
+#: pp moves one small activation per tick, so it tolerates DCN too, but
+#: the slice-aware layout below keeps it intra-slice anyway.
+MODEL_AXES = (AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_TP)
+
+#: Fabric names mesh_axis_links reports: ICI = intra-slice links, DCN =
+#: the data-center network between slices.
+FABRIC_ICI = "ici"
+FABRIC_DCN = "dcn"
+
+
+def _device_slice_id(dev) -> Optional[int]:
+    """The hardware slice this device belongs to, when the platform
+    reports a meaningful one.  TPU runtimes expose ``slice_index`` as
+    the real DCN topology; CPU/sim devices carry a vestigial
+    ``slice_index`` of 0 on multi-process worlds, which must NOT be
+    trusted (it would contradict the MEGASCALE env the operator
+    injected) — sim worlds group contiguously instead."""
+
+    if getattr(dev, "platform", None) != "tpu":
+        return None
+    v = getattr(dev, "slice_index", None)
+    try:
+        return int(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def _slice_groups(devices: Sequence[jax.Device], slices: int) -> List[List]:
+    """Partition ``devices`` into ``slices`` equal groups, one per
+    slice: by the hardware ``slice_index`` when every device reports
+    one, else (CPU/sim worlds) contiguously in the given order — which
+    matches the operator's pod numbering (pod index = slice*H + host,
+    bootstrap/tpu_env.py), so process-local devices land in their
+    MEGASCALE slice."""
+
+    ndev = len(devices)
+    if ndev % slices:
+        raise ValueError(f"{ndev} devices not divisible into {slices} slices")
+    per = ndev // slices
+    ids = [_device_slice_id(d) for d in devices]
+    if all(i is not None for i in ids):
+        by_id: Dict[int, List] = {}
+        for d, i in zip(devices, ids):
+            by_id.setdefault(i, []).append(d)
+        if len(by_id) != slices or any(len(g) != per for g in by_id.values()):
+            raise ValueError(
+                f"device slice_index topology {sorted((k, len(v)) for k, v in by_id.items())} "
+                f"does not form {slices} equal slices of {per}"
+            )
+        return [by_id[k] for k in sorted(by_id)]
+    return [list(devices[i * per : (i + 1) * per]) for i in range(slices)]
+
+
+def _sub_mesh_array(dims, group) -> np.ndarray:
+    """Device array for one slice's devices at ``dims`` (the intra-slice
+    mesh shape), topology-aware when mesh_utils can be."""
+
+    if len(group) == 1:
+        return np.array(group).reshape(dims)
+    try:
+        from jax.experimental import mesh_utils
+
+        return mesh_utils.create_device_mesh(
+            dims, devices=np.asarray(group, dtype=object)
+        )
+    except Exception:
+        # On TPU a topology-aware layout is correctness-adjacent
+        # (tp/sp collectives must ride neighbouring ICI links) —
+        # never silently degrade there.
+        if group[0].platform == "tpu":
+            raise
+        return np.array(group).reshape(dims)
+
 
 def make_mesh(
     shape: Optional[Mapping[str, int]] = None,
     *,
     devices: Optional[Sequence[jax.Device]] = None,
+    slices: Optional[int] = None,
 ) -> Mesh:
     """Build a Mesh with the canonical named axes.
 
@@ -47,6 +124,23 @@ def make_mesh(
     remaining devices").  Missing axes get size 1, so downstream
     PartitionSpecs can always name any canonical axis.  Default: all
     devices on `dp`.
+
+    ``slices`` makes the mesh SLICE-AWARE (ISSUE 14): the device array
+    is ordered so that ``dp`` is the only axis crossing a slice
+    boundary (DCN) while every other axis stays inside one slice (ICI).
+    Concretely: each slice's devices form an intra-slice sub-mesh of
+    shape (pp, dp/S, fsdp, ep, sp, tp) and the S sub-meshes are
+    concatenated along ``dp`` — so dp coordinate j lives on slice
+    ``j // (dp/S)``, and any collective over fsdp/tp/sp/ep/pp rides
+    intra-slice links only.  ``slices=None`` auto-detects: the
+    operator-injected ``MEGASCALE_NUM_SLICES`` (bootstrap/tpu_env.py)
+    first, else the devices' hardware ``slice_index``, else 1.
+    ``slices=1`` is the degenerate case and produces exactly the
+    topology-unaware mesh of old.  Shapes whose ``dp`` extent cannot
+    absorb the slice dimension (dp % slices != 0) are REFUSED — they
+    would force a model axis across DCN, where its bandwidth-bound
+    collectives do not belong (``mesh_axis_links`` reports the
+    axis→fabric mapping; parallel/collectives.py builds on it).
     """
 
     if devices is None:
@@ -56,6 +150,16 @@ def make_mesh(
     unknown = set(shape) - set(AXIS_ORDER)
     if unknown:
         raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {AXIS_ORDER}")
+
+    if slices is None:
+        from tf_operator_tpu.bootstrap.tpu_env import detected_slice_topology
+
+        slices, _ = detected_slice_topology()
+        if slices <= 1:
+            seen = {_device_slice_id(d) for d in devices}
+            if None not in seen and len(seen) > 1:
+                slices = len(seen)
+    slices = max(1, int(slices))
 
     sizes: Dict[str, int] = {ax: int(shape.get(ax, 1)) for ax in AXIS_ORDER}
     wild = [ax for ax, s in sizes.items() if s == -1]
@@ -70,23 +174,144 @@ def make_mesh(
         raise ValueError(f"mesh shape {sizes} != {ndev} devices")
 
     dims = tuple(sizes[ax] for ax in AXIS_ORDER)
-    if ndev == 1:
+    if slices > 1:
+        if ndev % slices:
+            raise ValueError(f"{ndev} devices do not divide into {slices} slices")
+        if sizes[AXIS_DP] % slices:
+            # which axes WOULD have to straddle DCN to make the shape
+            # fit?  Name them in the refusal so the error teaches the
+            # contract instead of just citing arithmetic.
+            would_cross = [
+                ax for ax in (AXIS_PP, *MODEL_AXES) if sizes[ax] > 1
+            ]
+            raise ValueError(
+                f"slice-aware mesh: dp={sizes[AXIS_DP]} is not divisible by "
+                f"slices={slices}, so the slice dimension would have to ride "
+                f"a model axis ({', '.join(would_cross) or 'none available'}) "
+                "across DCN — refused (bandwidth-bound collectives do not "
+                "belong on the cross-slice fabric).  Give dp an extent "
+                "divisible by the slice count (dp varies across slices; "
+                "fsdp/tp/sp/ep stay within a slice), or pass slices=1 to "
+                "explicitly opt into a topology-blind mesh."
+            )
+        groups = _slice_groups(devices, slices)
+        dp_axis = AXIS_ORDER.index(AXIS_DP)
+        intra_dims = list(dims)
+        intra_dims[dp_axis] = sizes[AXIS_DP] // slices
+        dev_array = np.concatenate(
+            [_sub_mesh_array(tuple(intra_dims), g) for g in groups],
+            axis=dp_axis,
+        )
+    elif ndev == 1:
         dev_array = np.array(devices).reshape(dims)
     else:
-        try:
-            from jax.experimental import mesh_utils
+        dev_array = _sub_mesh_array(dims, list(devices))
+    mesh = Mesh(dev_array, AXIS_ORDER)
+    _register_slice_assignment(mesh, dev_array, slices)
+    links = mesh_axis_links(mesh)
+    crossing = [ax for ax in MODEL_AXES if links[ax] == FABRIC_DCN]
+    if crossing:
+        raise ValueError(
+            f"model axes {crossing} span a slice boundary (DCN) — their "
+            "collectives are bandwidth-bound and must stay on ICI"
+        )
+    return mesh
 
-            dev_array = mesh_utils.create_device_mesh(
-                dims, devices=np.asarray(devices, dtype=object)
-            )
-        except Exception:
-            # On TPU a topology-aware layout is correctness-adjacent
-            # (tp/sp collectives must ride neighbouring ICI links) —
-            # never silently degrade there.
-            if devices[0].platform == "tpu":
-                raise
-            dev_array = np.array(devices).reshape(dims)
-    return Mesh(dev_array, AXIS_ORDER)
+
+#: mesh → per-device slice ids, for sim worlds whose devices carry no
+#: hardware slice_index.  Keyed by the mesh's device id layout — and
+#: jax INTERNS Mesh objects, so two make_mesh calls producing the same
+#: layout return the SAME object even when their ``slices=`` differ
+#: (the 2-slice and 1-slice {dp:2, fsdp:4} sim meshes are one object).
+#: The slice interpretation of a layout is therefore process-wide
+#: LAST-WRITE-WINS: re-registering an equal layout under a different
+#: slice count re-labels every live alias of that mesh, and
+#: ``_register_slice_assignment`` logs a warning so the flip is
+#: observable (a Trainer snapshots ``slice_count`` at construction, so
+#: already-built trainers keep their grad-sync choice).  Real-TPU
+#: worlds are immune — the hardware ``slice_index`` outranks this
+#: registry.  Bounded FIFO: the oldest layout is evicted, never the
+#: whole table (a wholesale clear would silently re-label every live
+#: mesh to 1 slice).
+_SLICE_ASSIGNMENTS: Dict[tuple, np.ndarray] = {}
+_MAX_SLICE_ASSIGNMENTS = 256
+
+
+def _mesh_key(mesh: Mesh) -> tuple:
+    return (
+        tuple(d.id for d in mesh.devices.flat),
+        mesh.devices.shape,
+        mesh.axis_names,
+    )
+
+
+def _register_slice_assignment(mesh: Mesh, dev_array: np.ndarray, slices: int) -> None:
+    dp_axis = AXIS_ORDER.index(AXIS_DP)
+    ids = np.zeros(dev_array.shape, dtype=np.int64)
+    if slices > 1:
+        # dp coordinate j -> slice j // (dp/S): the concatenation order
+        # make_mesh built the array in
+        dp_size = dev_array.shape[dp_axis]
+        dp_index = np.arange(dp_size) // (dp_size // slices)
+        ids += dp_index.reshape(
+            [1] * dp_axis + [dp_size] + [1] * (dev_array.ndim - dp_axis - 1)
+        )
+    key = _mesh_key(mesh)
+    prev = _SLICE_ASSIGNMENTS.get(key)
+    if prev is not None and len(np.unique(prev)) != max(1, slices):
+        # interned-Mesh aliasing (see _SLICE_ASSIGNMENTS note): the
+        # caller just re-interpreted an existing layout's slice
+        # topology — legal, but every live alias flips with it, so say
+        # so instead of flipping silently
+        from tf_operator_tpu.utils.logging import _root
+
+        _root.warning(
+            "make_mesh: re-registering device layout as %d slice(s) "
+            "(was %d) — jax interns equal meshes, so every live alias "
+            "of this mesh now reports the new topology",
+            max(1, slices), len(np.unique(prev)),
+        )
+    if prev is None:
+        while len(_SLICE_ASSIGNMENTS) >= _MAX_SLICE_ASSIGNMENTS:
+            _SLICE_ASSIGNMENTS.pop(next(iter(_SLICE_ASSIGNMENTS)))
+    _SLICE_ASSIGNMENTS[key] = ids
+
+
+def _slice_id_array(mesh: Mesh) -> np.ndarray:
+    """Per-position slice ids for the mesh's device array: hardware
+    ``slice_index`` when the devices report one (a Mesh built by hand
+    on real multi-slice TPU still maps correctly), else the assignment
+    recorded by make_mesh, else all-zero (single slice)."""
+
+    hw = [_device_slice_id(d) for d in mesh.devices.flat]
+    if all(i is not None for i in hw):
+        return np.array(hw, dtype=np.int64).reshape(mesh.devices.shape)
+    ids = _SLICE_ASSIGNMENTS.get(_mesh_key(mesh))
+    if ids is not None:
+        return ids
+    return np.zeros(mesh.devices.shape, dtype=np.int64)
+
+
+def slice_count(mesh: Mesh) -> int:
+    """Number of distinct slices the mesh spans (1 = single slice: no
+    DCN anywhere; the trainer's flat grad sync is then optimal)."""
+
+    return int(len(np.unique(_slice_id_array(mesh))))
+
+
+def mesh_axis_links(mesh: Mesh) -> Dict[str, str]:
+    """Which fabric each mesh axis's collectives ride: ``"ici"``
+    (intra-slice) or ``"dcn"`` (the axis crosses a slice boundary
+    somewhere).  An axis rides DCN iff, holding every other coordinate
+    fixed, moving along it can change the slice id.  Size-1 axes are
+    trivially ICI."""
+
+    ids = _slice_id_array(mesh)
+    out: Dict[str, str] = {}
+    for i, ax in enumerate(mesh.axis_names):
+        varies = bool(np.any(ids.max(axis=i) != ids.min(axis=i)))
+        out[ax] = FABRIC_DCN if varies else FABRIC_ICI
+    return out
 
 
 def batch_spec(extra: Sequence[Optional[str]] = ()) -> PartitionSpec:

@@ -25,3 +25,22 @@ def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
     return _shard_map(
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **_CHECK_KW
     )
+
+
+def shard_map_partial_auto(f, *, mesh, in_specs, out_specs, auto):
+    """Partial-auto shard_map: manual only over the axes the specs
+    name, ``auto`` axes keep global (GSPMD) semantics inside the body —
+    sharding constraints over auto axes are legal there, collectives
+    only over the manual ones.  The multi-slice grad sync
+    (parallel/collectives.py) is manual over the DCN axis and auto over
+    every intra-slice axis.  Replication checking off, like
+    ``shard_map_unchecked`` (psum outputs the checker cannot prove)."""
+
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        auto=frozenset(auto),
+        **_CHECK_KW,
+    )
