@@ -190,6 +190,82 @@ def test_collector_sees_known_call_sites():
     assert "job" in families["tpujob_gang_waiting_replicas"]
 
 
+def collect_federated_families():
+    """``collect_emitted_families`` plus the FEDERATION decoration
+    (ISSUE 15): at the operator, every pod-emitted family is ALSO
+    reachable with the scraper's ``{job, replica_type, replica_index,
+    slice}`` labels on top of its own — so rules/policies/dashboards
+    may filter on those keys without orphaning.  The decoration tuple
+    is imported from the scraper (single source of truth); its shape
+    is pinned below."""
+
+    from tf_operator_tpu.controller.telemetry import FEDERATED_LABELS
+
+    families = collect_emitted_families()
+    return {
+        name: keys | set(FEDERATED_LABELS)
+        for name, keys in families.items()
+    }
+
+
+def test_federated_label_decoration_is_pinned():
+    """ISSUE 15: the federated decoration the scraper stamps on every
+    merged series — the keys the /federate exposition, the fleet
+    dashboard panel, and any job-scoped rule filter key on.  Renaming
+    one fails tier-1 here before it silently orphans a consumer."""
+
+    from tf_operator_tpu.controller.telemetry import (
+        FEDERATED_LABELS,
+        ScrapeTarget,
+    )
+
+    assert FEDERATED_LABELS == (
+        "job", "replica_type", "replica_index", "slice"
+    )
+    # the decoration really is what targets produce (the merge sites
+    # spread ScrapeTarget.labels, so this pins the runtime shape)
+    t = ScrapeTarget(
+        job="default/j", replica_type="worker", replica_index=0,
+        slice_id="1", url="http://127.0.0.1:1",
+    )
+    assert set(t.labels) == set(FEDERATED_LABELS)
+
+
+def test_collector_sees_telemetry_call_sites():
+    """ISSUE 15 satellite: the scrape-honesty meta families are
+    emitted at literal call sites with the pinned label keys —
+    ``telemetry_scrape_failures_total{job,replica}`` and the
+    per-target ``telemetry_scrape_age_seconds`` carrying the full
+    federated identity."""
+
+    families = collect_emitted_families()
+    assert {"job", "replica"} <= families["telemetry_scrape_failures_total"]
+    assert {"job", "replica_type", "replica_index", "slice"} <= families[
+        "telemetry_scrape_age_seconds"
+    ]
+
+
+def test_checkpoint_stale_rule_matches_federated_series():
+    """ISSUE 15 satellite (the PR-6 process-scope gap, closed): the
+    stock checkpoint-age rule must keep matching the FEDERATED
+    ``checkpoint_last_success_unix{job=,...}`` series a subprocess
+    trainer pod's scrape mirrors into the operator registry.  The rule
+    matches by label-subset, so it may not grow a filter on keys the
+    federated decoration doesn't carry — and the family must stay
+    emitted pod-side."""
+
+    from tf_operator_tpu.controller.telemetry import FEDERATED_LABELS
+
+    families = collect_federated_families()
+    rule = next(r for r in default_rules() if r.name == "checkpoint-stale")
+    assert rule.metric == "checkpoint_last_success_unix"
+    assert rule.kind == "gauge_age"
+    assert rule.metric in families
+    # any filter must resolve against pod-side keys + the decoration
+    assert set(rule.labels) <= families[rule.metric]
+    assert set(FEDERATED_LABELS) <= families[rule.metric]
+
+
 def collect_dispatch_phases():
     """{phase literal: [site, ...]} for every literal first-arg
     ``<ledger>.dispatch("<phase>", ...)`` call in the package +

@@ -264,6 +264,41 @@ def test_train_dcn_families_are_emitted_with_fabric_label():
         assert "fabric" in families[fam], fam
 
 
+def test_resize_gate_reads_the_federated_checkpoint_family():
+    """ISSUE 15 satellite: the training resize gate's registry
+    fallback (``job_checkpoint_age``) must read the FEDERATED
+    ``checkpoint_last_success_unix{job=}`` series — a subprocess
+    trainer pod's stamp, scraped into the operator registry, gates the
+    resize; another job's stamp never does."""
+
+    from tests.test_alert_rules_lint import collect_federated_families
+    from tf_operator_tpu.controller.autoscaler import job_checkpoint_age
+    from tf_operator_tpu.utils.metrics import Metrics
+
+    families = collect_federated_families()
+    assert {"job", "replica_type", "replica_index"} <= families[
+        "checkpoint_last_success_unix"
+    ]
+
+    now = 1_700_000_000.0
+    job = new_job(name="fed-gate", worker=2)
+    m = Metrics()
+    # only ANOTHER job's federated stamp: age must stay unknown
+    m.set(
+        "checkpoint_last_success_unix", now - 5.0,
+        job="default/other", replica_type="worker", replica_index="0",
+        slice="",
+    )
+    assert job_checkpoint_age(job, now, metrics=m) is None
+    # this job's federated stamp: the age is its pod's
+    m.set(
+        "checkpoint_last_success_unix", now - 42.0,
+        job=job.key, replica_type="worker", replica_index="0", slice="",
+    )
+    age = job_checkpoint_age(job, now, metrics=m)
+    assert age is not None and abs(age - 42.0) < 1e-6
+
+
 def test_stock_policy_checkpoint_gate_is_consistent_with_alert_rule():
     """The training policy's resize gate and the checkpoint-stale alert
     read the same stamp: the gate threshold must not be LOOSER than the

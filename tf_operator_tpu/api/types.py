@@ -585,6 +585,12 @@ LABEL_REPLICA_INDEX = "tpujob.dist/replica-index"
 LABEL_GROUP_NAME = "tpujob.dist/group-name"
 #: Annotation marking gang membership (reference: scheduling.k8s.io/group-name)
 ANNOTATION_GANG_GROUP = "scheduling.tpujob.dist/group-name"
+#: Annotation the reconciler stamps on pods carrying a telemetry
+#: server (ISSUE 15): the port the pod's harness serves /metrics on.
+#: The operator-side TelemetryScraper discovers scrape targets from
+#: live pod records through this — the pod record IS the service
+#: discovery, no extra registry.
+ANNOTATION_TELEMETRY_PORT = "tpujob.dist/telemetry-port"
 
 
 def replica_name(job_name: str, rtype: ReplicaType, index: int) -> str:

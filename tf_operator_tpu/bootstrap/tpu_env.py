@@ -42,6 +42,20 @@ ENV_REPLICA_TYPE = "TPUJOB_REPLICA_TYPE"
 ENV_REPLICA_INDEX = "TPUJOB_REPLICA_INDEX"
 ENV_JOB_NAME = "TPUJOB_NAME"
 
+#: fleet-telemetry injection (ISSUE 15) — set per pod by the
+#: RECONCILER (not gen_tpu_env: the port is allocated at pod-create
+#: time, not derivable from the spec).  When present, the training
+#: harness boots a pod-side telemetry server on 127.0.0.1:<port>
+#: (/metrics, /traces, /debug/flightrecorder — runtime/telemetry.py);
+#: unset/0 = no server, the library-user default.
+ENV_TELEMETRY_PORT = "TPUJOB_TELEMETRY_PORT"
+#: trace-stitching context (ISSUE 15): the reconciler's ``pod.create``
+#: span rides the pod env, the harness roots its train-loop trace
+#: under it, and the operator-side scraper folds the pod's spans back
+#: into its own TraceStore — ONE id spans reconcile→boot→train.
+ENV_TRACE_ID = "TPUJOB_TRACE_ID"
+ENV_PARENT_SPAN_ID = "TPUJOB_PARENT_SPAN_ID"
+
 
 def detected_slice_topology() -> Tuple[int, "int | None"]:
     """(num_slices, slice_id-or-None) from the MEGASCALE env THIS module

@@ -308,9 +308,20 @@ def main(argv=None) -> int:
         default_autoscaler as autoscaler,
     )
 
+    # fleet telemetry scraper (controller/telemetry.py): discovers the
+    # pod-side exporters the reconciler injects ports for, federates
+    # their pod-scope families into this registry (so the alert engine,
+    # autoscaler and health rollup see the FLEET), and stitches pod
+    # traces into the operator store.  PROCESS-GLOBAL for the same
+    # reason the engine/autoscaler are: /federate must report the
+    # instance that actually runs.
+    from tf_operator_tpu.controller.telemetry import (
+        default_scraper as telemetry,
+    )
+
     controller = TPUJobController(
         store, backend, config=config, recorder=recorder,
-        alerts=alert_engine, autoscaler=autoscaler,
+        alerts=alert_engine, autoscaler=autoscaler, telemetry=telemetry,
     )
     api = ApiServer(
         store,
@@ -319,6 +330,7 @@ def main(argv=None) -> int:
         controller.recorder,
         alerts=alert_engine,
         autoscaler=autoscaler,
+        telemetry=telemetry,
         host=args.host,
         port=args.monitoring_port,
         namespace=args.namespace,
@@ -353,6 +365,7 @@ def main(argv=None) -> int:
     maybe_start_from_env(metrics=controller.metrics)
     alert_engine.start()
     autoscaler.start()
+    telemetry.start()
 
     # monitoring/API surface is up regardless of leadership (reference
     # parity: the monitoring port serves on standbys too); only the
@@ -382,6 +395,7 @@ def main(argv=None) -> int:
                 )
             stop.wait(0.5)
     finally:
+        telemetry.stop()
         autoscaler.stop()
         alert_engine.stop()
         if controller_started:

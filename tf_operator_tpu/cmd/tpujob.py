@@ -12,6 +12,7 @@ This client speaks the operator's HTTP job API instead:
     tpujob logs NAME POD [-n ns]         # kubectl logs (local backend)
     tpujob alerts [RULE]                 # alert-engine state (firing first)
     tpujob autoscaler [JOB]              # scale decisions + policy state
+    tpujob telemetry [JOB]               # fleet scrape targets (stale first)
     tpujob compile -f job.yaml           # TPUJob -> real Kubernetes YAML
                                          # (backend/gke.py; offline, no server)
 
@@ -162,6 +163,20 @@ def cmd_describe(args) -> int:
         ):
             if key in health:
                 print(f"  {label + ':':<18}{health[key]}")
+        for row in health.get("pods", []):
+            # fleet telemetry per-pod rows (ISSUE 15): scrape health
+            # and federated step rate, one line per pod
+            bits = []
+            if "scrapeAgeSeconds" in row:
+                bits.append(f"scraped {row['scrapeAgeSeconds']}s ago")
+            if "stepsPerSec" in row:
+                bits.append(f"{row['stepsPerSec']} steps/s")
+            if row.get("failures"):
+                bits.append(f"{row['failures']} scrape failures")
+            if row.get("stale"):
+                bits.append("STALE")
+            print(f"  {'pod/' + row.get('replica', '?') + ':':<18}"
+                  f"{', '.join(bits) if bits else 'no data'}")
         for rtype, blk in (health.get("autoscaler") or {}).items():
             line = (
                 f"{blk.get('desiredReplicas')} desired "
@@ -301,6 +316,41 @@ def cmd_autoscaler(args) -> int:
     return 0
 
 
+def cmd_telemetry(args) -> int:
+    """GET /federate/targets: per-pod scrape state, stale-first (the
+    server's ordering — what needs attention leads, the alerts /
+    autoscaler subcommand convention); with a JOB argument, filtered
+    to that job's targets."""
+
+    snap = _request("GET", f"{args.server}/federate/targets")
+    targets = snap.get("targets", [])
+    if args.job:
+        want = args.job if "/" in args.job else f"{args.namespace}/{args.job}"
+        targets = [t for t in targets if t["job"] == want]
+    fmt = "{:<24} {:<14} {:<8} {:<10} {:<10} {}"
+    print(fmt.format("JOB", "REPLICA", "SLICE", "AGE(S)", "FAILURES", "STATE"))
+    for t in targets:
+        age = t.get("lastScrapeAgeSeconds")
+        print(
+            fmt.format(
+                t["job"], t["replica"], t.get("slice") or "-",
+                "-" if age is None else f"{age:.1f}",
+                str(t.get("failures", 0)),
+                "stale" if t.get("stale") else "ok",
+            )
+        )
+    if not targets:
+        print("  (no scrape targets)")
+        return 0
+    stale = sum(1 for t in targets if t.get("stale"))
+    if stale:
+        print(f"\n{stale}/{len(targets)} targets stale")
+    fams = snap.get("families", [])
+    if fams and not args.job:
+        print(f"\nfederated families: {', '.join(fams)}")
+    return 0
+
+
 def cmd_compile(args) -> int:
     from tf_operator_tpu.backend.gke import compile_manifest
 
@@ -355,6 +405,13 @@ def build_parser() -> argparse.ArgumentParser:
     asp.add_argument("--limit", type=int, default=20,
                      help="decision-log rows shown")
     asp.set_defaults(fn=cmd_autoscaler)
+
+    tp = sub.add_parser(
+        "telemetry", help="fleet scrape targets + federated families"
+    )
+    tp.add_argument("job", nargs="?", default="")
+    tp.add_argument("-n", "--namespace", default="default")
+    tp.set_defaults(fn=cmd_telemetry)
 
     for name, fn, extra in (
         ("get", cmd_get, []),

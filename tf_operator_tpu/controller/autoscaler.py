@@ -213,13 +213,24 @@ def job_checkpoint_age(
     job: TPUJob, now: float, metrics=None, series=None
 ) -> Optional[float]:
     """Seconds since the job's newest durable checkpoint, or None
-    (unknown).  Prefers the POD-scope stamp in the job's summary
-    series (``checkpoint_time_unix`` — utils/summaries, crosses the
-    process boundary) and falls back to this process's
-    ``checkpoint_last_success_unix`` gauge (live for embedded
-    single-process runs).  Shared by the reconciler's health rollup
-    (which passes its already-read ``series`` tail to avoid a second
-    disk read) and the autoscaler's resize gate so the two can never
+    (unknown).  Three sources, freshest wins within each tier:
+
+    1. the POD-scope stamp in the job's summary series
+       (``checkpoint_time_unix`` — utils/summaries, crosses the
+       process boundary on disk);
+    2. the FEDERATED ``checkpoint_last_success_unix{job=}`` series the
+       telemetry scraper mirrors from each pod's /metrics (ISSUE 15 —
+       the network path that closed the PR-6 process-scope gap: a
+       wedged subprocess trainer's stale stamp now reaches the
+       operator registry and drives the stock checkpoint-age rule);
+    3. this process's own unlabeled gauge (embedded single-process
+       runs, where checkpointer and operator share a registry).
+
+    The job's newest stamp across its pods wins (the checkpoint is a
+    job-global artifact; any pod reporting a fresh durable save means
+    the job has one).  Shared by the reconciler's health rollup (which
+    passes its already-read ``series`` tail to avoid a second disk
+    read) and the autoscaler's resize gate so the two can never
     disagree."""
 
     from tf_operator_tpu.utils.summaries import (
@@ -236,9 +247,18 @@ def job_checkpoint_age(
         if t is not None:
             return max(0.0, now - t)
     if metrics is not None:
-        g = metrics.gauge("checkpoint_last_success_unix")
-        if g > 0:
-            return max(0.0, now - g)
+        best = 0.0
+        for labels, v in metrics.gauge_series(
+            "checkpoint_last_success_unix"
+        ).items():
+            d = dict(labels)
+            # unlabeled = this process's own checkpointer; job-labeled
+            # = federated from one of THIS job's pods (other jobs'
+            # series must never gate this job's resize)
+            if not d or d.get("job") == job.key:
+                best = max(best, v)
+        if best > 0:
+            return max(0.0, now - best)
     return None
 
 

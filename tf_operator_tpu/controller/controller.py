@@ -47,6 +47,7 @@ class TPUJobController:
         tracer: Optional[Tracer] = None,
         alerts=None,
         autoscaler=None,
+        telemetry=None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -102,6 +103,14 @@ class TPUJobController:
         self.autoscaler = autoscaler
         if autoscaler is not None:
             autoscaler.attach(self._list_cached_jobs, self._on_scale_decision)
+        #: controller/telemetry.TelemetryScraper (optional): we feed it
+        #: the informer cache's pod snapshot as its target source — it
+        #: scrapes on its OWN thread (a reconcile sync never waits on a
+        #: pod's HTTP server) and federates pod-scope families into the
+        #: shared registry the alert engine / autoscaler / rollup read
+        self.telemetry = telemetry
+        if telemetry is not None:
+            telemetry.attach(self._list_cached_pods)
         self.reconciler = Reconciler(
             job_store,
             backend,
@@ -115,6 +124,7 @@ class TPUJobController:
             tracer=self.tracer,
             alerts=alerts,
             autoscaler=autoscaler,
+            telemetry=telemetry,
         )
         self.max_sync_retries = max_sync_retries
         self.resync_period = resync_period
@@ -159,6 +169,14 @@ class TPUJobController:
 
         with self.cache._lock:
             return list(self.cache.jobs.values())
+
+    def _list_cached_pods(self):
+        """The telemetry scraper's target source: a snapshot of the
+        informer cache's pod objects (read-only, same contract as
+        ``_list_cached_jobs``)."""
+
+        with self.cache._lock:
+            return list(self.cache.pods.values())
 
     def _on_scale_decision(self, decision) -> None:
         """Autoscaler decision callback (runs on its evaluator thread):
@@ -335,6 +353,11 @@ class TPUJobController:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.telemetry is not None:
+            # same contract as the autoscaler/engine below: the
+            # (possibly process-global) scraper outlives this
+            # controller and must not pin its dead cache as a source
+            self.telemetry.detach(self._list_cached_pods)
         if self.autoscaler is not None:
             # same contract as the alert engine below: the (possibly
             # process-global) autoscaler outlives this controller

@@ -28,6 +28,7 @@ from typing import Callable, Dict, List, Optional
 
 from tf_operator_tpu.api.types import (
     ANNOTATION_GANG_GROUP,
+    ANNOTATION_TELEMETRY_PORT,
     LABEL_JOB_NAME,
     JobConditionType,
     PodPhase,
@@ -101,6 +102,12 @@ class ReconcilerConfig:
     #: wedged trainer must not keep reporting its historical rate
     #: under a fresh updatedAt
     throughput_stale_seconds: float = 300.0
+    #: fleet telemetry (ISSUE 15): inject a per-pod
+    #: TPUJOB_TELEMETRY_PORT (+ the tpujob.dist/telemetry-port
+    #: discovery annotation and the pod.create trace context) so every
+    #: worker boots a scrapable telemetry server.  Off = pods export
+    #: nothing, the pre-fleet behaviour.
+    pod_telemetry: bool = True
 
 
 class Reconciler:
@@ -118,6 +125,7 @@ class Reconciler:
         tracer: Optional[Tracer] = None,
         alerts=None,
         autoscaler=None,
+        telemetry=None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -140,6 +148,11 @@ class Reconciler:
         #: copy, training resizes bounce the replica set (re-shard +
         #: resume), and its per-job state joins observedHealth
         self.autoscaler = autoscaler
+        #: controller/telemetry.TelemetryScraper (None = no fleet
+        #: plane): per-pod scrape rows join observedHealth — reads
+        #: only, the scraper runs on its own thread and can never
+        #: block a sync
+        self.telemetry = telemetry
         #: job key -> unix of the last health-rollup refresh (throttle)
         self._health_refreshed: Dict[str, float] = {}
 
@@ -497,41 +510,74 @@ class Reconciler:
     def _create_pod(self, job: TPUJob, rtype: ReplicaType, index: int, gang: bool) -> None:
         key = job.key
         name = replica_name(job.metadata.name, rtype, index)
-        template = job.spec.replica_specs[rtype].template
-        containers = [c.clone() for c in template.containers]
-        env = worker_env(
-            job, rtype, index, self.config.resolver, tf_config=self.config.inject_tf_config
-        )
-        for c in containers:
-            merged = dict(env)
-            merged.update(c.env)  # user-specified env wins, like the reference
-            c.env = merged
-
-        pod = Pod(containers=containers)
-        pod.metadata.name = name
-        pod.metadata.namespace = job.metadata.namespace
-        pod.metadata.owner_uid = job.metadata.uid
-        pod.metadata.labels = {**template.labels, **replica_labels(job.metadata.name, rtype, index)}
-        pod.metadata.annotations = dict(template.annotations)
-        pod.scheduler_name = template.scheduler_name
-        pod.node_selector = dict(template.node_selector)
-        if rtype is ReplicaType.TPU_SLICE:
-            # per-POD chips = per-host share of the slice (a multi-host
-            # slice runs one pod per host VM); ceil so Σ per-pod chips
-            # never under-counts the gang group's whole-slice accounting
-            spec_ts = job.spec.replica_specs[rtype]
-            chips = parse_tpu_topology(spec_ts.tpu_topology)
-            hosts = spec_ts.slice_host_count()
-            pod.chip_request = max(1, -(-chips // hosts))
-        if gang:
-            pod.metadata.annotations[ANNOTATION_GANG_GROUP] = job.metadata.name
-            pod.scheduler_name = pod.scheduler_name or self.config.gang_scheduler_name
-
-        self.pod_exp.expect_creations(key, 1)
+        # the span opens BEFORE env construction: its (trace, span) ids
+        # ride the pod env as the trace-stitching context (ISSUE 15) —
+        # the harness roots the pod's train trace under this exact
+        # pod.create span, and the telemetry scraper folds the pod's
+        # spans back, so /traces/<trace-id> shows reconcile -> create
+        # -> train as ONE waterfall
         with self.tracer.span(
             f"pod.create {name}",
-            attributes={"replicaType": rtype.value, "index": index},
+            # the job attribute is the timeline endpoint's exact-match
+            # key — span-NAME prefix matching would leak job "train"
+            # into job "train-eval"'s timeline
+            attributes={
+                "replicaType": rtype.value, "index": index, "job": key,
+            },
         ) as sp:
+            template = job.spec.replica_specs[rtype].template
+            containers = [c.clone() for c in template.containers]
+            env = worker_env(
+                job, rtype, index, self.config.resolver, tf_config=self.config.inject_tf_config
+            )
+            telemetry_port = None
+            if self.config.pod_telemetry:
+                from tf_operator_tpu.bootstrap.tpu_env import (
+                    ENV_PARENT_SPAN_ID,
+                    ENV_TELEMETRY_PORT,
+                    ENV_TRACE_ID,
+                )
+                from tf_operator_tpu.controller.telemetry import (
+                    alloc_telemetry_port,
+                )
+
+                telemetry_port = alloc_telemetry_port()
+                env[ENV_TELEMETRY_PORT] = str(telemetry_port)
+                env[ENV_TRACE_ID] = sp.trace_id
+                env[ENV_PARENT_SPAN_ID] = sp.span_id
+                sp.set_attribute("telemetryPort", telemetry_port)
+            for c in containers:
+                merged = dict(env)
+                merged.update(c.env)  # user-specified env wins, like the reference
+                c.env = merged
+
+            pod = Pod(containers=containers)
+            pod.metadata.name = name
+            pod.metadata.namespace = job.metadata.namespace
+            pod.metadata.owner_uid = job.metadata.uid
+            pod.metadata.labels = {**template.labels, **replica_labels(job.metadata.name, rtype, index)}
+            pod.metadata.annotations = dict(template.annotations)
+            if telemetry_port is not None:
+                # the discovery half: the scraper reads targets off
+                # live pod records, so the pod record carries its port
+                pod.metadata.annotations[ANNOTATION_TELEMETRY_PORT] = str(
+                    telemetry_port
+                )
+            pod.scheduler_name = template.scheduler_name
+            pod.node_selector = dict(template.node_selector)
+            if rtype is ReplicaType.TPU_SLICE:
+                # per-POD chips = per-host share of the slice (a multi-host
+                # slice runs one pod per host VM); ceil so Σ per-pod chips
+                # never under-counts the gang group's whole-slice accounting
+                spec_ts = job.spec.replica_specs[rtype]
+                chips = parse_tpu_topology(spec_ts.tpu_topology)
+                hosts = spec_ts.slice_host_count()
+                pod.chip_request = max(1, -(-chips // hosts))
+            if gang:
+                pod.metadata.annotations[ANNOTATION_GANG_GROUP] = job.metadata.name
+                pod.scheduler_name = pod.scheduler_name or self.config.gang_scheduler_name
+
+            self.pod_exp.expect_creations(key, 1)
             try:
                 self.backend.create_pod(pod)
             except AlreadyExistsError:
@@ -800,7 +846,11 @@ class Reconciler:
         throttle so conditions land promptly.
         """
 
-        if self.alerts is None and self.autoscaler is None:
+        if (
+            self.alerts is None
+            and self.autoscaler is None
+            and self.telemetry is None
+        ):
             return
         if job.is_terminal():
             # the failed_fatal path reaches here AFTER _fail_job cleared
@@ -877,6 +927,13 @@ class Reconciler:
             health["throughputStepsPerSec"] = tput
         if auto_blk:
             health["autoscaler"] = auto_blk
+        # fleet telemetry (ISSUE 15): per-pod scrape rows — staleness,
+        # failure counts, federated step rate — so describe shows the
+        # FLEET's health, not just the operator's own aggregates
+        if self.telemetry is not None:
+            pod_rows = self.telemetry.job_rows(key, now=now)
+            if pod_rows:
+                health["pods"] = pod_rows
         job.status.observed_health = health
 
     def _read_series_tail(self, job: TPUJob) -> "Optional[List[dict]]":

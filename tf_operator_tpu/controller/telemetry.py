@@ -1,0 +1,691 @@
+"""Operator-side fleet telemetry: scrape pods, federate families,
+stitch traces (ISSUE 15, the plane's operator half).
+
+The pod-side exporter (runtime/telemetry.py) makes every
+reconciler-launched worker scrapable; this module makes the operator
+USE that: a :class:`TelemetryScraper` (own daemon thread, watchdog-
+patterned start/stop, synthetic-clock drivable like AlertEngine /
+Autoscaler) discovers scrape targets from live pod records (the
+``tpujob.dist/telemetry-port`` annotation the reconciler stamps), pulls
+each pod's exposition through ``backend/retry.RetryPolicy`` with
+bounded timeouts, and merges the samples into FEDERATED families in the
+shared registry, decorated ``{job, replica_type, replica_index,
+slice}`` (``FEDERATED_LABELS`` — the lint gates pin the tuple):
+
+- **gauges** are instantaneous — last scrape wins (``Metrics.set``);
+- **counters** accumulate deltas: the scraper tracks each series'
+  previous cumulative value and adds the increase since the last
+  scrape (a value DROP is a pod restart and contributes the new
+  absolute), keeping the operator counter MONOTONE — equal to the
+  pod's cumulative total until a restart, and to the sum of every
+  incarnation's contributions after one, which is exactly what the
+  ``counter_increase`` alert windows need;
+- **histograms** are bucket-summed: per-bucket deltas merge through
+  ``Metrics.merge_histogram`` into labeled series the existing
+  ``histogram_family_merged`` machinery then collapses into fleet
+  quantiles.
+
+Staleness honesty (the satellite contract): every scrape failure
+increments ``telemetry_scrape_failures_total{job,replica}``, every
+sweep refreshes the per-target ``telemetry_scrape_age_seconds`` gauge,
+and a target unreachable (or gone from the pod records) past
+``stale_after`` has its federated series SWEPT from the registry
+(``clear_gauge``-family forget semantics) instead of exporting frozen
+values.  Scraping runs on its own thread against the informer cache's
+pod snapshots — it never blocks a reconcile sync.
+
+Trace stitching: each scrape also pulls ``GET /traces`` (JSONL) and
+folds unseen spans into the operator TraceStore
+(``TraceStore.add_dict``).  Because the harness rooted the pod's train
+trace under the reconciler's ``pod.create`` span context (the env
+contract in bootstrap/tpu_env.py), ``GET /traces/<id>`` then shows ONE
+vertical reconcile→boot→train waterfall.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+import urllib.request
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from tf_operator_tpu.api.types import ANNOTATION_TELEMETRY_PORT
+from tf_operator_tpu.backend.retry import RetryPolicy
+from tf_operator_tpu.utils.logging import FieldLogger, _root
+
+#: the federated decoration, in exposition order — every series merged
+#: from a pod carries exactly these keys on top of its own labels.
+#: tests/test_alert_rules_lint.py pins this tuple against the merge
+#: call sites, so a renamed key fails tier-1 before it orphans a
+#: rule/policy/dashboard binding.
+FEDERATED_LABELS = ("job", "replica_type", "replica_index", "slice")
+
+#: per-target cap on remembered span ids (trace-folding dedup ring)
+MAX_SEEN_SPANS = 4096
+
+
+def alloc_telemetry_port(host: str = "127.0.0.1") -> int:
+    """One free TCP port, OS-assigned — the reconciler calls this at
+    pod create and injects the result as ``TPUJOB_TELEMETRY_PORT``.
+    (Tiny race window between close and the pod's bind; acceptable for
+    the sim/local backends this repo runs — a real cluster would use
+    the pod IP and a FIXED port instead.)"""
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def _unescape(value: str) -> str:
+    out, i = [], 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(raw: str) -> Dict[str, str]:
+    """``k="v",k2="v2"`` (text-exposition escaped) -> dict."""
+
+    labels: Dict[str, str] = {}
+    i = 0
+    while i < len(raw):
+        eq = raw.find("=", i)
+        if eq < 0:
+            break
+        key = raw[i:eq].strip().lstrip(",").strip()
+        j = eq + 2  # past ="
+        val = []
+        while j < len(raw):
+            c = raw[j]
+            if c == "\\" and j + 1 < len(raw):
+                val.append(raw[j:j + 2])
+                j += 2
+                continue
+            if c == '"':
+                break
+            val.append(c)
+            j += 1
+        labels[key] = _unescape("".join(val))
+        i = j + 1
+    return labels
+
+
+#: parsed exposition shape: {(family, labels-tuple): value} per kind,
+#: histograms as {(family, labels-tuple): (buckets, counts, sum, count)}
+#: with PER-BUCKET (de-cumulated) counts
+Parsed = Dict[str, Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Any]]
+
+
+def parse_exposition(text: str) -> Parsed:
+    """Parse the Prometheus text format ``utils/metrics.exposition``
+    emits back into structured samples — the scrape-side inverse.
+    Unknown/ill-formed lines are skipped (a half-written exposition
+    must degrade, not crash the sweep)."""
+
+    kinds: Dict[str, str] = {}
+    counters: Dict[Tuple[str, Tuple], float] = {}
+    gauges: Dict[Tuple[str, Tuple], float] = {}
+    #: (family, labels) -> {"buckets": [(le, cum)], "sum": x, "count": n}
+    hist_raw: Dict[Tuple[str, Tuple], Dict[str, Any]] = {}
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                kinds[parts[2]] = parts[3]
+            continue
+        brace = line.find("{")
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                continue
+            name = line[:brace]
+            labels = _parse_labels(line[brace + 1:close])
+            rest = line[close + 1:].strip()
+        else:
+            bits = line.split()
+            if len(bits) != 2:
+                continue
+            name, rest = bits[0], bits[1]
+            labels = {}
+        try:
+            value = float(rest)
+        except ValueError:
+            continue
+        base = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and kinds.get(name[: -len(suffix)]) == "histogram":
+                base = name[: -len(suffix)]
+                part = suffix[1:]
+                break
+        if base is not None:
+            le = labels.pop("le", None)
+            key = (base, tuple(sorted(labels.items())))
+            h = hist_raw.setdefault(
+                key, {"buckets": [], "sum": 0.0, "count": 0}
+            )
+            if part == "bucket":
+                if le is not None and le != "+Inf":
+                    try:
+                        h["buckets"].append((float(le), value))
+                    except ValueError:
+                        pass
+            elif part == "sum":
+                h["sum"] = value
+            else:
+                h["count"] = int(value)
+            continue
+        kind = kinds.get(name)
+        key = (name, tuple(sorted(labels.items())))
+        if kind == "counter":
+            counters[key] = value
+        elif kind == "gauge":
+            gauges[key] = value
+        # summaries (raw observe()) are not federated: unbounded
+        # per-observation lists don't survive a scrape contract
+
+    histograms: Dict[Tuple[str, Tuple], Tuple] = {}
+    for key, h in hist_raw.items():
+        bounds = [b for b, _ in sorted(h["buckets"])]
+        cums = [c for _, c in sorted(h["buckets"])]
+        counts: List[int] = []
+        prev = 0.0
+        for c in cums:
+            counts.append(int(c - prev))
+            prev = c
+        counts.append(int(h["count"] - prev))  # +Inf bucket
+        histograms[key] = (tuple(bounds), counts, h["sum"], h["count"])
+    return {"counters": counters, "gauges": gauges, "histograms": histograms}
+
+
+@dataclass
+class ScrapeTarget:
+    """One discovered pod exporter."""
+
+    job: str  # "<ns>/<name>" — the per-object gauge key convention
+    replica_type: str
+    replica_index: int
+    slice_id: str  # "" outside multi-slice topologies
+    url: str
+
+    @property
+    def key(self) -> Tuple[str, str, int]:
+        return (self.job, self.replica_type, self.replica_index)
+
+    @property
+    def replica(self) -> str:
+        return f"{self.replica_type}-{self.replica_index}"
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return {
+            "job": self.job,
+            "replica_type": self.replica_type,
+            "replica_index": str(self.replica_index),
+            "slice": self.slice_id,
+        }
+
+
+class _TargetState:
+    __slots__ = (
+        "target", "first_seen", "last_ok", "last_counters",
+        "last_histograms", "families", "failures", "seen_spans",
+        "seen_ring", "swept",
+    )
+
+    def __init__(self, target: ScrapeTarget, now: float):
+        self.target = target
+        self.first_seen = now
+        #: unix of the last successful scrape (0 = never reached)
+        self.last_ok = 0.0
+        #: previous cumulative counter values, for delta federation
+        self.last_counters: Dict[Tuple[str, Tuple], float] = {}
+        self.last_histograms: Dict[Tuple[str, Tuple], Tuple] = {}
+        #: every (kind, family) this target federated — the sweep list
+        self.families: Set[Tuple[str, str]] = set()
+        self.failures = 0
+        self.seen_spans: Set[str] = set()
+        self.seen_ring: deque = deque(maxlen=MAX_SEEN_SPANS)
+        self.swept = False
+
+
+def pods_to_targets(pods) -> List[ScrapeTarget]:
+    """Scrape targets from live pod records: a RUNNING pod stamped
+    with the telemetry-port annotation is scrapable.  The slice label
+    comes from the pod's own MEGASCALE_SLICE_ID env (the ISSUE-14
+    injection contract) so federated series carry the DCN topology."""
+
+    out: List[ScrapeTarget] = []
+    for pod in pods:
+        phase = getattr(pod.phase, "value", str(pod.phase))
+        if phase != "Running":
+            continue
+        port = (pod.metadata.annotations or {}).get(ANNOTATION_TELEMETRY_PORT)
+        if not port or not str(port).isdigit():
+            continue
+        rtype = pod.replica_type
+        idx = pod.replica_index
+        if rtype is None or idx is None:
+            continue
+        slice_id = ""
+        for c in pod.containers:
+            slice_id = (c.env or {}).get("MEGASCALE_SLICE_ID", "")
+            break
+        out.append(
+            ScrapeTarget(
+                job=f"{pod.metadata.namespace}/{pod.job_name}",
+                replica_type=rtype.lower_name,
+                replica_index=idx,
+                slice_id=slice_id,
+                url=f"http://127.0.0.1:{int(port)}",
+            )
+        )
+    return out
+
+
+class TelemetryScraper:
+    """Pull pod expositions, federate them into the shared registry.
+
+    ``scrape_once(now)`` is the whole engine (tests drive it with a
+    synthetic clock — the AlertEngine/Autoscaler pattern); ``start()``
+    runs it on a daemon thread every ``interval`` seconds.  The
+    controller ``attach()``es a pod lister (its informer cache);
+    nothing here ever runs inside a reconcile sync.
+    """
+
+    def __init__(
+        self,
+        metrics=None,
+        tracer=None,
+        interval: float = 2.0,
+        timeout: float = 2.0,
+        stale_after: float = 30.0,
+        retry: Optional[RetryPolicy] = None,
+    ):
+        if metrics is None:
+            from tf_operator_tpu.utils.metrics import default_metrics
+
+            metrics = default_metrics
+        if tracer is None:
+            from tf_operator_tpu.utils.trace import default_tracer
+
+            tracer = default_tracer
+        self.metrics = metrics
+        self.tracer = tracer
+        self.interval = float(interval)
+        self.timeout = float(timeout)
+        #: a target silent this long has its federated series swept
+        self.stale_after = float(stale_after)
+        #: bounded per-scrape budget: ONE quick retry, tight deadline —
+        #: a fleet sweep must stay cheap even when half the fleet died
+        self.retry = retry or RetryPolicy(
+            max_attempts=2, base_delay=0.05, max_delay=0.2, deadline=5.0
+        )
+        self._lock = threading.Lock()
+        self._targets: Dict[Tuple[str, str, int], _TargetState] = {}
+        self._list_pods: Optional[Callable[[], list]] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._log = FieldLogger(_root, component="telemetry")
+        #: every (kind, family) EVER federated — the /federate read set
+        self._federated: Set[Tuple[str, str]] = set()
+
+    # -- wiring -------------------------------------------------------------
+
+    def attach(self, list_pods: Callable[[], list]) -> None:
+        """Wire the pod source (the controller's informer cache
+        snapshot — read-only, never blocks a sync)."""
+
+        with self._lock:
+            self._list_pods = list_pods
+
+    def detach(self, list_pods: Optional[Callable[[], list]] = None) -> None:
+        with self._lock:
+            # == not `is`: bound methods are re-minted per access, so
+            # identity would never match the method attach() stored
+            if list_pods is None or self._list_pods == list_pods:
+                self._list_pods = None
+
+    # -- one sweep ----------------------------------------------------------
+
+    def scrape_once(self, now: Optional[float] = None) -> int:
+        """Discover targets, scrape each, federate, sweep staleness.
+        Returns the number of successful scrapes this sweep."""
+
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            lister = self._list_pods
+        pods = []
+        if lister is not None:
+            try:
+                pods = list(lister())
+            except Exception as e:  # noqa: BLE001 - outlives cache bugs
+                self._log.error(
+                    "pod lister failed: %s: %s", type(e).__name__, e
+                )
+        live = {}
+        for t in pods_to_targets(pods):
+            live[t.key] = t
+        replaced: List[_TargetState] = []
+        with self._lock:
+            for key, t in live.items():
+                st = self._targets.get(key)
+                if st is None or st.target.url != t.url:
+                    # new pod (or the index was recreated on a new
+                    # port): fresh state — counter baselines reset.
+                    # The OLD state's federated series must be cleared
+                    # first, or the recreated pod's counters (re-seeded
+                    # at their new absolute) would STACK onto the dead
+                    # pod's last-seen values under the same labels.
+                    if st is not None and not st.swept:
+                        replaced.append(st)
+                    self._targets[key] = _TargetState(t, now)
+                else:
+                    st.target = t
+            states = list(self._targets.values())
+        for st in replaced:
+            self._clear_target(st)
+
+        ok = 0
+        for st in states:
+            if st.target.key in live:
+                if self._scrape_target(st, now):
+                    ok += 1
+            self._refresh_age(st, now)
+        self._sweep_stale(now, live)
+        return ok
+
+    def _fetch(self, url: str) -> str:
+        timeout = self.timeout
+
+        def _do():
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return resp.read().decode("utf-8", errors="replace")
+
+        return self.retry.call(_do, client="telemetry", metrics=self.metrics)
+
+    def _scrape_target(self, st: _TargetState, now: float) -> bool:
+        t = st.target
+        try:
+            text = self._fetch(t.url + "/metrics")
+            parsed = parse_exposition(text)
+        except Exception as e:  # noqa: BLE001 - a dead pod is data, not a crash
+            st.failures += 1
+            # the literal call site the lint collectors pin: scrape
+            # failures are first-class observable, per job and replica
+            self.metrics.inc(
+                "telemetry_scrape_failures_total",
+                job=t.job, replica=t.replica,
+            )
+            self._log.debug(
+                "scrape failed for %s %s: %s: %s",
+                t.job, t.replica, type(e).__name__, e,
+            )
+            return False
+        self._merge(st, parsed, now)
+        # trace stitching is best-effort and separately fallible: a pod
+        # whose /traces hangs must not mark its metrics scrape failed —
+        # but the miss is counted, never silent
+        try:
+            self._fold_traces(st, self._fetch(t.url + "/traces"))
+        except Exception as e:  # noqa: BLE001 - stitching is optional
+            self.metrics.inc(
+                "telemetry_trace_fold_failures_total",
+                job=t.job, replica=t.replica,
+            )
+            self._log.debug(
+                "trace fold failed for %s %s: %s: %s",
+                t.job, t.replica, type(e).__name__, e,
+            )
+        st.last_ok = now
+        st.swept = False
+        return True
+
+    # -- federation ---------------------------------------------------------
+
+    def _merge(self, st: _TargetState, parsed: Parsed, now: float) -> None:
+        fed = st.target.labels
+        for (name, labels), value in parsed["gauges"].items():
+            merged = {**dict(labels), **fed}
+            self.metrics.set(name, value, **merged)
+            st.families.add(("gauge", name))
+        for (name, labels), value in parsed["counters"].items():
+            prev = st.last_counters.get((name, labels), 0.0)
+            delta = value - prev if value >= prev else value  # pod restart
+            if delta:
+                merged = {**dict(labels), **fed}
+                self.metrics.inc(name, delta, **merged)
+            st.last_counters[(name, labels)] = value
+            st.families.add(("counter", name))
+        for (name, labels), (bks, counts, total, n) in parsed[
+            "histograms"
+        ].items():
+            prev = st.last_histograms.get((name, labels))
+            if prev is not None and prev[0] == bks and prev[3] <= n:
+                d_counts = [a - b for a, b in zip(counts, prev[1])]
+                d_sum, d_n = total - prev[2], n - prev[3]
+            else:  # first scrape, pod restart, or re-bucketed family
+                d_counts, d_sum, d_n = list(counts), total, n
+            if d_n:
+                merged = {**dict(labels), **fed}
+                self.metrics.merge_histogram(
+                    name, bks, d_counts, d_sum, d_n, **merged
+                )
+            st.last_histograms[(name, labels)] = (bks, counts, total, n)
+            st.families.add(("histogram", name))
+        with self._lock:
+            self._federated |= st.families
+
+    def _fold_traces(self, st: _TargetState, jsonl: str) -> None:
+        import json
+
+        store = self.tracer.store
+        for line in jsonl.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            sid = d.get("spanId")
+            if not sid or sid in st.seen_spans:
+                continue
+            if len(st.seen_ring) == st.seen_ring.maxlen:
+                st.seen_spans.discard(st.seen_ring[0])
+            st.seen_ring.append(sid)
+            st.seen_spans.add(sid)
+            store.add_dict(d)
+
+    # -- staleness ----------------------------------------------------------
+
+    def _refresh_age(self, st: _TargetState, now: float) -> None:
+        t = st.target
+        # never-reached targets age from discovery: the gauge is
+        # "seconds since this pod last proved it was alive"
+        age = now - (st.last_ok or st.first_seen)
+        # the literal per-target age call site the lint collectors pin
+        self.metrics.set(
+            "telemetry_scrape_age_seconds",
+            round(max(age, 0.0), 3),
+            job=t.job, replica_type=t.replica_type,
+            replica_index=str(t.replica_index), slice=t.slice_id,
+        )
+
+    def _sweep_stale(self, now: float, live: Dict) -> None:
+        """TTL GC: a target unreachable (or no longer backed by a live
+        pod record) past ``stale_after`` has every federated series it
+        contributed cleared — frozen telemetry is worse than absent
+        telemetry."""
+
+        with self._lock:
+            states = list(self._targets.items())
+        for key, st in states:
+            gone = key not in live
+            last_sign = st.last_ok or st.first_seen
+            silent = now - last_sign > self.stale_after
+            if not silent:
+                continue
+            if not st.swept:
+                self._clear_target(st)
+                st.swept = True
+            if gone:
+                with self._lock:
+                    self._targets.pop(key, None)
+
+    def _clear_target(self, st: _TargetState) -> None:
+        t = st.target
+        fed = t.labels
+        for kind, name in sorted(st.families):
+            if kind == "gauge":
+                self.metrics.clear_gauge(name, **fed)
+            elif kind == "counter":
+                self.metrics.clear_counter(name, **fed)
+            else:
+                self.metrics.clear_histogram(name, **fed)
+        self.metrics.clear_gauge(
+            "telemetry_scrape_age_seconds",
+            job=t.job, replica_type=t.replica_type,
+            replica_index=str(t.replica_index),
+        )
+        st.last_counters.clear()
+        st.last_histograms.clear()
+        self._log.info(
+            "swept stale federated series for %s %s", t.job, t.replica
+        )
+
+    # -- reads --------------------------------------------------------------
+
+    def federate_text(self) -> str:
+        """The ``GET /federate`` body: every federated family (plus the
+        scrape meta families), rendered by the ONE exposition renderer
+        (``Metrics.exposition(families=...)``) restricted to the
+        federated name set — the Prometheus federation contract, with
+        no second format to drift."""
+
+        with self._lock:
+            names = {name for _, name in self._federated}
+        names.add("telemetry_scrape_failures_total")
+        names.add("telemetry_scrape_age_seconds")
+        return self.metrics.exposition(families=names)
+
+    def targets_snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The ``GET /federate/targets`` JSON body: per-target scrape
+        state, STALE-FIRST (the thing needing attention leads — the
+        alerts-panel convention), then by age descending."""
+
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            states = list(self._targets.values())
+            fams = sorted(n for _, n in self._federated)
+        rows = []
+        for st in states:
+            t = st.target
+            age = round(now - st.last_ok, 3) if st.last_ok else None
+            rows.append({
+                "job": t.job,
+                "replica": t.replica,
+                "replicaType": t.replica_type,
+                "replicaIndex": t.replica_index,
+                "slice": t.slice_id,
+                "url": t.url,
+                "lastScrapeAgeSeconds": age,
+                "failures": st.failures,
+                "stale": bool(
+                    st.swept
+                    or st.last_ok == 0.0
+                    or now - st.last_ok > self.stale_after
+                ),
+            })
+        rows.sort(
+            key=lambda r: (
+                not r["stale"],
+                -(r["lastScrapeAgeSeconds"] or float("inf")),
+                r["job"], r["replica"],
+            )
+        )
+        return {"targets": rows, "families": fams}
+
+    def job_rows(self, job_key: str, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Per-pod health rows for one job — the reconciler folds these
+        into ``observedHealth.pods`` so ``tpujob describe`` shows the
+        fleet, not just the operator's own aggregates."""
+
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            states = [
+                st for st in self._targets.values()
+                if st.target.job == job_key
+            ]
+        rows = []
+        for st in states:
+            t = st.target
+            row: Dict[str, Any] = {
+                "replica": t.replica,
+                "stale": bool(st.swept or st.last_ok == 0.0),
+                "failures": st.failures,
+            }
+            if st.last_ok:
+                row["scrapeAgeSeconds"] = round(now - st.last_ok, 1)
+            tput = self.metrics.gauge(
+                "train_window_steps_per_second", **t.labels
+            )
+            if tput:
+                row["stepsPerSec"] = round(tput, 3)
+            rows.append(row)
+        rows.sort(key=lambda r: r["replica"])
+        return rows
+
+    # -- scraper thread -----------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "TelemetryScraper":
+        if self.running:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="telemetry-scraper"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.scrape_once()
+            except Exception as e:  # noqa: BLE001 - must outlive bugs
+                self._log.error(
+                    "telemetry sweep failed: %s: %s", type(e).__name__, e
+                )
+
+
+#: process-global default (the metrics/tracer/alerts/autoscaler
+#: pattern): the operator binary and the API's /federate route share
+#: this instance.  NOT started, and inert until a controller
+#: attach()es its pod cache.
+default_scraper = TelemetryScraper()

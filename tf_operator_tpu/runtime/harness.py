@@ -145,9 +145,26 @@ def train_loop(
 
     import jax
 
+    from tf_operator_tpu.runtime.telemetry import (
+        maybe_start_from_env as _maybe_start_telemetry,
+        trace_context_from_env,
+    )
     from tf_operator_tpu.utils.metrics import StepSyncLedger, default_metrics
     from tf_operator_tpu.utils.trace import default_tracer
     from tf_operator_tpu.utils.watchdog import default_watchdog
+
+    # fleet telemetry (ISSUE 15): when the reconciler injected
+    # TPUJOB_TELEMETRY_PORT this worker serves /metrics, /traces and
+    # /debug/flightrecorder so the operator's scraper can federate its
+    # pod-scope signals; without the env this is a no-op (library
+    # users get no server and no port bind).  Host-side only — boots
+    # BEFORE the step loop, so the no-hot-sync gate is untouched.
+    _maybe_start_telemetry()
+    # trace stitching: root this run's trace under the reconciler's
+    # pod.create span context when it rode in on the env — the scraper
+    # folds our spans back, and /traces/<id> shows ONE vertical
+    # reconcile -> boot -> train waterfall
+    env_trace_id, env_parent_id = trace_context_from_env()
 
     tr = tracer if tracer is not None else default_tracer
     ledger = (
@@ -186,11 +203,12 @@ def train_loop(
     #: recent-throughput gauge (host-side wall arithmetic only — the
     #: no-hot-sync gate stays satisfied): steps dispatched per second
     #: since the previous window (per step when K=1), on the ledger's
-    #: registry — visible on THIS process's /metrics exposition only.
-    #: The health rollup's throughputStepsPerSec comes from the job
-    #: summary series instead (reconciler._recent_throughput): a
-    #: subprocess-pod trainer's gauge never reaches the operator
-    #: registry (see docs/ARCHITECTURE.md on checkpoint-gauge scope)
+    #: registry.  Served on THIS process's /metrics exposition — and,
+    #: under the operator, federated into the operator registry as
+    #: train_window_steps_per_second{job,replica_type,replica_index}
+    #: by the telemetry scraper (docs/ARCHITECTURE.md "Fleet
+    #: telemetry"); the health rollup's job-level throughput still
+    #: reads the summary series (reconciler._recent_throughput)
     mreg = getattr(ledger, "metrics", None)
     t_prev = time.perf_counter()
 
@@ -206,6 +224,8 @@ def train_loop(
     try:
         with tr.span(
             f"train {tag}",
+            trace_id=env_trace_id,
+            parent_id=env_parent_id,
             attributes={
                 "startStep": start_step, "steps": steps, "stepsPerSync": k,
             },

@@ -55,6 +55,73 @@ from tf_operator_tpu.utils.trace import (
 )
 
 
+def _job_timeline(tracer, ns: str, name: str) -> dict:
+    """Traces linked to one job (reconcile sync / pod.create / folded
+    pod spans), plus the newest one flattened chronologically — the
+    ``GET .../tpujobs/{name}/timeline`` body."""
+
+    key = f"{ns}/{name}"
+    store = tracer.store
+    matched = []
+    for summary in store.summaries(limit=0):
+        trace = store.trace(summary["traceId"])
+        if trace is None:
+            continue
+        hit = False
+        for s in trace["spans"]:
+            # exact matches only: a name-PREFIX match on pod.create
+            # would leak job "train" into "train-eval"'s timeline (and
+            # across namespaces — pod names carry neither).  The
+            # reconcile span name embeds <ns>/<name>; pod.create spans
+            # carry the job key as an attribute.
+            if (
+                s.get("name", "") == f"reconcile {key}"
+                or s.get("attributes", {}).get("job") == key
+            ):
+                hit = True
+                break
+        if hit:
+            matched.append((summary["startUnix"], trace))
+    matched.sort(key=lambda t: t[0])
+    out = {"job": key, "traceIds": [t["traceId"] for _, t in matched]}
+    if matched:
+        # the flattened timeline prefers the newest trace carrying the
+        # stitched vertical (a pod.create or folded pod-side train
+        # span) — a busy job's newest matching trace is usually a
+        # boring resync sync, which would bury the waterfall that
+        # matters
+        def vertical(trace) -> bool:
+            return any(
+                s.get("name", "").startswith(("pod.create ", "train "))
+                for s in trace["spans"]
+            )
+
+        newest = next(
+            (t for _, t in reversed(matched) if vertical(t)),
+            matched[-1][1],
+        )
+        spans = sorted(
+            newest["spans"], key=lambda s: s.get("startUnix", 0.0)
+        )
+        out["timeline"] = {
+            "traceId": newest["traceId"],
+            "droppedSpans": newest["droppedSpans"],
+            "spans": [
+                {
+                    "name": s.get("name"),
+                    "kind": s.get("kind"),
+                    "startUnix": s.get("startUnix"),
+                    "duration": s.get("duration"),
+                    "status": s.get("status"),
+                    "spanId": s.get("spanId"),
+                    "parentId": s.get("parentId"),
+                }
+                for s in spans
+            ],
+        }
+    return out
+
+
 def _pod_to_dict(pod) -> dict:
     return {
         "name": pod.metadata.name,
@@ -83,6 +150,7 @@ class ApiServer:
         tracer: Optional[Tracer] = None,
         alerts=None,
         autoscaler=None,
+        telemetry=None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -106,6 +174,14 @@ class ApiServer:
 
             autoscaler = default_autoscaler
         self.autoscaler = autoscaler
+        #: controller/telemetry.TelemetryScraper serving GET /federate;
+        #: defaults to the process-global instance (the /alerts
+        #: contract: the endpoint exists, empty, on every binary)
+        if telemetry is None:
+            from tf_operator_tpu.controller.telemetry import default_scraper
+
+            telemetry = default_scraper
+        self.telemetry = telemetry
         #: request spans + the /traces read surface; in-process the
         #: controller, backends and (kube-sim) the embedded apiserver
         #: all share this tracer's store, so /traces/<id> returns the
@@ -181,7 +257,7 @@ class ApiServer:
                 try:
                     untraced = (
                         "/healthz", "/metrics", "/slo", "/alerts",
-                        "/autoscaler", "/traces", "/debug",
+                        "/autoscaler", "/traces", "/debug", "/federate",
                     )
                     if method == "GET" and (
                         route == "/" or any(
@@ -324,6 +400,24 @@ class ApiServer:
                         # live state (breaching first) — the act half
                         # of the /alerts observe half
                         return self._send(200, outer.autoscaler.snapshot())
+                    if p == ["federate"]:
+                        # fleet telemetry (ISSUE 15): every federated
+                        # family — pod-scope series mirrored into the
+                        # operator registry, decorated {job,
+                        # replica_type, replica_index, slice} — in
+                        # Prometheus text, the federation contract
+                        return self._send(
+                            200,
+                            outer.telemetry.federate_text(),
+                            "text/plain",
+                        )
+                    if p == ["federate", "targets"]:
+                        # per-target scrape state, stale-first — the
+                        # `tpujob telemetry` read and the dashboard's
+                        # fleet panel
+                        return self._send(
+                            200, outer.telemetry.targets_snapshot()
+                        )
                     # trace read surface: served on every replica
                     # (leader or standby) like /metrics — its job is
                     # diagnosing whichever process you can reach
@@ -423,6 +517,16 @@ class ApiServer:
                                 return self._send(200, {"items": []})
                             return self._send(
                                 200, {"items": read_series(sdir, limit=500)}
+                            )
+                        if p[6] == "timeline":
+                            # the stitched reconcile→pod vertical
+                            # (ISSUE 15): traces touching this job —
+                            # a reconcile sync span, a pod.create, or
+                            # a folded pod-side span — newest first,
+                            # with the newest one's spans flattened
+                            # chronologically
+                            return self._send(
+                                200, _job_timeline(outer.tracer, ns, name)
                             )
                         if p[6] == "pods":
                             pods = outer.backend.list_pods(

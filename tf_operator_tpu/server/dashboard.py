@@ -115,6 +115,14 @@ DASHBOARD_HTML = """<!doctype html>
   <tbody><tr><td class="muted" colspan="5">no autoscaled jobs</td></tr></tbody>
 </table>
 <div id="autoscaler-decisions" class="muted"></div>
+<div id="fleet-panel" style="display:none">
+<h2>fleet</h2>
+<table id="fleet">
+  <thead><tr><th>job</th><th>pod</th><th>step/s</th>
+  <th>dcn sync</th><th>ckpt age</th><th>scrape age</th><th>state</th></tr></thead>
+  <tbody></tbody>
+</table>
+</div>
 <h2>api client health</h2>
 <div id="client-health" class="muted">no apiserver client traffic</div>
 <h2>workqueue</h2>
@@ -195,6 +203,68 @@ async function refresh() {
   refreshHealth();
   refreshTraces();
   refreshArena();
+  refreshFleet();
+}
+
+async function refreshFleet() {
+  // fleet telemetry panel (controller/telemetry.py): per-pod scrape
+  // state from /federate/targets (stale-first, the server's order)
+  // joined with the federated per-pod series parsed out of /federate —
+  // step rate, DCN-vs-ICI grad-sync seconds, checkpoint age.  Hidden
+  // until the scraper has targets (library/serving deployments).
+  let snap, text;
+  try {
+    snap = await (await fetch("/federate/targets")).json();
+    text = await (await fetch("/federate")).text();
+  } catch (e) { return; }
+  const panel = document.getElementById("fleet-panel");
+  const targets = snap.targets || [];
+  if (!targets.length) { panel.style.display = "none"; return; }
+  panel.style.display = "";
+  // one pass over the federated exposition: value per (family, labels)
+  const vals = {};
+  const re = /^([A-Za-z0-9_:]+)\\{(.*)\\} ([0-9.eE+-]+)$/;
+  for (const l of text.split("\\n")) {
+    const m = l.match(re);
+    if (m) vals[m[1] + "|" + m[2]] = parseFloat(m[3]);
+  }
+  const pick = (fam, t, extra) => {
+    // match on the federated decoration regardless of label order
+    const want = [`job="${t.job}"`, `replica_index="${t.replicaIndex}"`,
+                  `replica_type="${t.replicaType}"`].concat(extra || []);
+    for (const key of Object.keys(vals)) {
+      if (!key.startsWith(fam + "|")) continue;
+      if (want.every(w => key.includes(w))) return vals[key];
+    }
+    return undefined;
+  };
+  const tbody = document.querySelector("#fleet tbody");
+  tbody.innerHTML = "";
+  const now = Date.now() / 1000;
+  for (const t of targets) {
+    const steps = pick("train_window_steps_per_second", t);
+    const dcn = pick("train_dcn_sync_seconds_sum", t, ['fabric="dcn"']);
+    const ici = pick("train_dcn_sync_seconds_sum", t, ['fabric="ici"']);
+    const ckpt = pick("checkpoint_last_success_unix", t);
+    const cells = [
+      t.job, t.replica + (t.slice ? ` (slice ${t.slice})` : ""),
+      steps === undefined ? "-" : steps.toFixed(2),
+      dcn === undefined && ici === undefined ? "-" :
+        `${(dcn || 0).toFixed(3)}s dcn / ${(ici || 0).toFixed(3)}s ici`,
+      ckpt === undefined || !ckpt ? "-" : `${(now - ckpt).toFixed(0)}s`,
+      t.lastScrapeAgeSeconds == null ? "never"
+        : `${t.lastScrapeAgeSeconds.toFixed(1)}s`,
+      t.stale ? "stale" : "ok",
+    ];
+    const tr = document.createElement("tr");
+    for (const [i, c] of cells.entries()) {
+      const td = document.createElement("td");
+      td.textContent = c;
+      if (i === 6) td.className = t.stale ? "Failed" : "Succeeded";
+      tr.appendChild(td);
+    }
+    tbody.appendChild(tr);
+  }
 }
 
 async function refreshArena() {

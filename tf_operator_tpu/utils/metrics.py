@@ -161,6 +161,64 @@ class Metrics:
             ]:
                 del self._gauges[key]
 
+    def clear_counter(self, name: str, **labels: str) -> None:
+        """Drop every series of counter ``name`` whose labels contain
+        ``labels`` (subset match; no labels = the whole family) — the
+        counter twin of ``clear_gauge``.  Exists for FEDERATED series
+        (controller/telemetry.py): a counter mirrored from a pod that
+        died must be swept, not exported frozen forever."""
+
+        with self._lock:
+            for key in [
+                k
+                for k in self._counters
+                if k[0] == name
+                and all(dict(k[1]).get(n) == str(v) for n, v in labels.items())
+            ]:
+                del self._counters[key]
+
+    def clear_histogram(self, name: str, **labels: str) -> None:
+        """``clear_gauge`` semantics for histogram series (federated
+        staleness sweep)."""
+
+        with self._lock:
+            for key in [
+                k
+                for k in self._histograms
+                if k[0] == name
+                and all(dict(k[1]).get(n) == str(v) for n, v in labels.items())
+            ]:
+                del self._histograms[key]
+
+    def merge_histogram(
+        self,
+        name: str,
+        buckets: Tuple[float, ...],
+        counts: List[int],
+        sum_delta: float,
+        count_delta: int,
+        **labels: str,
+    ) -> None:
+        """Add pre-bucketed observations into one histogram series —
+        the federation write (``counts`` has len(buckets)+1 per-bucket
+        deltas, NOT cumulative).  Same-bucket series sum elementwise; a
+        bucket-boundary mismatch REPLACES the series (the source pod
+        restarted with a different config — summing positionally would
+        lie, exactly the ``histogram_family_merged`` rule)."""
+
+        key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+        bks = tuple(buckets)
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None or h[0] != bks:
+                self._histograms[key] = [
+                    bks, list(counts), float(sum_delta), int(count_delta),
+                ]
+                return
+            h[1] = [a + b for a, b in zip(h[1], counts)]
+            h[2] += float(sum_delta)
+            h[3] += int(count_delta)
+
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             self._observations[name].append(value)
@@ -381,29 +439,43 @@ class Metrics:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {kind}")
 
-    def exposition(self) -> str:
+    def exposition(self, families: "Optional[set]" = None) -> str:
         """Prometheus text format (label values escaped per the text
         exposition rules — see ``_escape_label``).  Every family is
-        preceded by its ``# HELP`` / ``# TYPE`` metadata lines."""
+        preceded by its ``# HELP`` / ``# TYPE`` metadata lines.
+        ``families`` restricts the output to that name set (the
+        /federate read) — ONE renderer serves both surfaces, so the
+        formats can never drift."""
+
+        def want(name: str) -> bool:
+            return families is None or name in families
 
         lines = []
         emitted: set = set()
         with self._lock:
             for (name, labels), v in sorted(self._counters.items()):
+                if not want(name):
+                    continue
                 self._header(lines, emitted, name, "counter")
                 label_s = _label_str(labels)
                 lines.append(f"{name}{{{label_s}}} {v}" if label_s else f"{name} {v}")
             for (name, labels), v in sorted(self._gauges.items()):
+                if not want(name):
+                    continue
                 self._header(lines, emitted, name, "gauge")
                 label_s = _label_str(labels)
                 lines.append(f"{name}{{{label_s}}} {v}" if label_s else f"{name} {v}")
             for name, vals in sorted(self._observations.items()):
+                if not want(name):
+                    continue
                 self._header(lines, emitted, name, "summary")
                 lines.append(f"{name}_count {len(vals)}")
                 lines.append(f"{name}_sum {sum(vals)}")
             for (name, labels), (bks, counts, total, n) in sorted(
                 self._histograms.items()
             ):
+                if not want(name):
+                    continue
                 self._header(lines, emitted, name, "histogram")
                 label_s = _label_str(labels)
                 suffix = f",{label_s}" if label_s else ""
@@ -426,6 +498,8 @@ class Metrics:
             # them, the dashboard reads them to deep-link error
             # counters to their trace waterfalls
             for name, tid in sorted(self._exemplars.items()):
+                if not want(name):
+                    continue
                 lines.append(f'# exemplar {name} trace_id="{tid}"')
         return "\n".join(lines) + "\n"
 

@@ -197,22 +197,36 @@ class TraceStore:
         self._traces: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
 
     def add(self, span: Span) -> None:
+        self.add_dict(span.to_dict())
+
+    def add_dict(self, d: Dict[str, Any]) -> None:
+        """Fold one FINISHED span in exported-dict form (the shape
+        ``Span.to_dict``/JSONL export emits) — the cross-process path:
+        the telemetry scraper stitches pod-side spans into the
+        operator's store through this, so ``/traces/<id>`` shows one
+        reconcile→boot→train waterfall even though the training spans
+        finished in another process."""
+
+        trace_id = d.get("traceId")
+        if not trace_id:
+            return
         with self._lock:
-            t = self._traces.get(span.trace_id)
+            t = self._traces.get(trace_id)
             if t is None:
                 t = {
                     "spans": [], "error": False, "slow": False,
-                    "dropped": 0, "first_unix": span.start_unix,
+                    "dropped": 0, "first_unix": d.get("startUnix", 0.0),
                 }
-                self._traces[span.trace_id] = t
-                self._evict_locked(keep=span.trace_id)
+                self._traces[trace_id] = t
+                self._evict_locked(keep=trace_id)
             if len(t["spans"]) >= self.max_spans_per_trace:
                 t["dropped"] += 1
             else:
-                t["spans"].append(span.to_dict())
-            if span.status == "error":
+                t["spans"].append(dict(d))
+            if d.get("status") == "error":
                 t["error"] = True
-            if span.duration is not None and span.duration >= self.slow_seconds:
+            duration = d.get("duration")
+            if duration is not None and duration >= self.slow_seconds:
                 t["slow"] = True
 
     def _evict_locked(self, keep: str) -> None:
