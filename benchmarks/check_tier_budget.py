@@ -62,6 +62,29 @@ def check(record: dict, budget_s: float = SLOW_TIER_BUDGET_S):
     slow = record.get("slow")
     if slow is None:
         return True, summary + "\nslow tier: no record yet (gate skipped)"
+    # scheduler contention soak (ISSUE 16): the soak records its
+    # decision counts into the slow-tier entry (tests/conftest.py
+    # record_suite_extra).  A wedged scheduler that admitted nothing or
+    # never exercised a cross-job preemption is a broken soak even if
+    # every assertion somehow passed — red the record rather than let
+    # the contention coverage rot silently.
+    sched = slow.get("schedulerSoak")
+    if sched is not None:
+        admitted = int(sched.get("admitted", 0) or 0)
+        preemptions = int(sched.get("preemptions", 0) or 0)
+        if admitted < 1 or preemptions < 1:
+            return False, (
+                summary
+                + f"\nSCHEDULER SOAK WEDGED: admitted={admitted}, "
+                f"preemptions={preemptions} — the contention soak ran "
+                "without exercising admission + cross-job preemption; "
+                "see tests/test_scheduler_soak.py"
+            )
+        summary += (
+            f"\nscheduler soak: {admitted} admissions, "
+            f"{preemptions} preemptions, "
+            f"{int(sched.get('sweeps', 0) or 0)} sweeps"
+        )
     if float(slow["wall_s"]) > budget_s:
         return False, (
             summary

@@ -123,6 +123,21 @@ def pytest_collection_modifyitems(config, items):
 
 _session_t0 = None
 
+#: extra key/value pairs tests merge into THIS session's tier entry in
+#: SUITE_RECORD.json (via record_suite_extra below) — how the scheduler
+#: contention soak publishes its decision counts so a silently-wedged
+#: soak (zero admissions, zero preemptions) reddens the tier record
+#: through benchmarks/check_tier_budget.py instead of passing quietly
+_suite_extras = {}
+
+
+def record_suite_extra(key: str, value) -> None:
+    """Merge ``key: value`` into this pytest session's SUITE_RECORD
+    tier entry (JSON-serialisable values only).  No-op effect when the
+    session's tier is not recorded (targeted runs, ``all`` tier)."""
+
+    _suite_extras[key] = value
+
 
 def _session_tier(config) -> str:
     """tier1 = the default `-m 'not slow'` run; slow = a `-m slow`
@@ -191,6 +206,7 @@ def pytest_sessionfinish(session, exitstatus):
         "exitstatus": int(exitstatus),
         "collected": collected,
         "when": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        **_suite_extras,
     }
     try:  # atomic-ish: a crashed writer must not corrupt the record
         tmp = f"{path}.tmp.{os.getpid()}"

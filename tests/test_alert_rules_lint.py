@@ -266,6 +266,66 @@ def test_checkpoint_stale_rule_matches_federated_series():
     assert set(FEDERATED_LABELS) <= families[rule.metric]
 
 
+#: ISSUE 16: the fleet scheduler's exposition contract — every family
+#: controller/scheduler.py emits, with its EXACT label keys.  The
+#: gang-queue-stall rule, the quota gauges the dashboard reads, and the
+#: soak's decision accounting all key on these names; the gate below
+#: pins them BOTH WAYS (a renamed family fails, and a new scheduler_*
+#: family must be declared here before it ships).
+SCHEDULER_FAMILIES = {
+    "scheduler_admitted_total": set(),
+    "scheduler_evaluations_total": set(),
+    "scheduler_preemptions_total": {"victim_priority", "reason"},
+    "scheduler_skipped_total": {"reason"},
+    "scheduler_queue_position": {"job"},
+    "scheduler_queued_since_unix": {"job"},
+    "scheduler_quota_used_chips": {"quota"},
+    "scheduler_quota_limit_chips": {"quota"},
+}
+
+
+def test_scheduler_families_pinned_both_ways():
+    """ISSUE 16 satellite: the scheduler metric families are pinned in
+    both directions — every declared family is emitted at a literal
+    call site with exactly the declared label keys (rename or label
+    drift fails tier-1), and no undeclared ``scheduler_*`` family can
+    ship (additions must extend the pin table, i.e. be deliberate)."""
+
+    families = collect_emitted_families()
+    problems = []
+    for name, keys in SCHEDULER_FAMILIES.items():
+        if name not in families:
+            problems.append(f"declared family {name!r} is never emitted")
+        elif families[name] != keys:
+            problems.append(
+                f"family {name!r} emitted with keys "
+                f"{sorted(families[name])}, pinned {sorted(keys)}"
+            )
+    undeclared = {
+        n for n in families if n.startswith("scheduler_")
+    } - set(SCHEDULER_FAMILIES)
+    if undeclared:
+        problems.append(
+            f"undeclared scheduler_* families emitted: {sorted(undeclared)}"
+        )
+    assert not problems, (
+        "scheduler exposition drift:\n  " + "\n  ".join(problems)
+    )
+
+
+def test_gang_queue_stall_rule_binds_the_queue_stamp():
+    """ISSUE 16 satellite: the stock starvation rule evaluates age over
+    the scheduler's stable queued-since stamp — gauge_age over
+    ``scheduler_queued_since_unix`` — so an empty queue (gauge cleared
+    on admit/forget) never breaches and the oldest parked gang drives
+    the measured age."""
+
+    rule = next(r for r in default_rules() if r.name == "gang-queue-stall")
+    assert rule.metric == "scheduler_queued_since_unix"
+    assert rule.kind == "gauge_age"
+    assert rule.metric in collect_emitted_families()
+
+
 def collect_dispatch_phases():
     """{phase literal: [site, ...]} for every literal first-arg
     ``<ledger>.dispatch("<phase>", ...)`` call in the package +

@@ -1,0 +1,926 @@
+"""Fleet scheduler (ISSUE 16): priority quota queues + cross-job gang
+preemption with graceful shed.
+
+Unit tier for controller/scheduler.py — queue ordering (priority × age
+with the anti-starvation boost), per-namespace quota accounting, victim
+policy (lowest class → youngest grant → smallest checkpoint debt), the
+checkpoint-freshness gate, shed-vs-revoke mechanics — plus the
+reconciler integration (Queued/Preempted/Resumed conditions, teardown
+and re-admission), backend victim routing (FakeCluster capacity shrink
+through ``choose_victims`` instead of blind LIFO), and the
+``GET /scheduler`` / ``tpujob queue`` read surface.  The contention
+soak lives in tests/test_scheduler_soak.py (slow tier).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tests.testutil import harness, new_job, run_and_succeed_all
+from tf_operator_tpu.api.defaults import set_defaults
+from tf_operator_tpu.api.serde import job_from_dict, job_to_dict
+from tf_operator_tpu.api.types import (
+    JobConditionType,
+    PodPhase,
+    PRIORITY_CLASSES,
+    ReplicaType,
+    SchedulingSpec,
+    priority_rank,
+)
+from tf_operator_tpu.api.validation import ValidationError, validate
+from tf_operator_tpu.controller.scheduler import (
+    Scheduler,
+    gang_demand,
+    slice_chips,
+)
+from tf_operator_tpu.utils.metrics import Metrics
+
+
+def sjob(
+    name="job",
+    prio="standard",
+    group="",
+    slices=1,
+    topo="v5e-8",
+    namespace="default",
+):
+    j = new_job(
+        name=name, namespace=namespace, tpu_slice=slices, tpu_topology=topo
+    )
+    j.spec.scheduling = SchedulingSpec(
+        priority_class=prio, quota_group=group
+    )
+    return j
+
+
+class Rig:
+    """Pure-scheduler rig: a mutable job list as the lister, a settable
+    capacity, a synthetic clock — no backend, no reconciler."""
+
+    def __init__(self, capacity=None, **kw):
+        self.metrics = Metrics()
+        kw.setdefault("preemption_cooldown_seconds", 0.0)
+        # rig tests simulate completion by dropping jobs from the
+        # lister, so the absent-job grace is off unless under test
+        kw.setdefault("missing_grace_seconds", 0.0)
+        self.sched = Scheduler(metrics=self.metrics, **kw)
+        self.jobs = []
+        self.capacity = capacity
+        self.decisions = []
+        self.sched.attach(
+            lambda: list(self.jobs),
+            self.decisions.append,
+            capacity=lambda: self.capacity,
+        )
+
+    def checkpoint(self, job, at):
+        self.metrics.set(
+            "checkpoint_last_success_unix", at, job=job.key
+        )
+
+
+# ---------------------------------------------------------------- api layer
+
+
+class TestSpecSurface:
+    def test_serde_round_trip_camel_case(self):
+        j = sjob(prio="high", group="ml-research")
+        d = job_to_dict(j)
+        blk = d["spec"]["scheduling"]
+        assert blk == {"priorityClass": "high", "quotaGroup": "ml-research"}
+        back = job_from_dict(d)
+        assert back.spec.scheduling.priority_class == "high"
+        assert back.spec.scheduling.quota_group == "ml-research"
+
+    def test_serde_omits_absent_scheduling_and_empty_fields(self):
+        j = new_job(worker=1)
+        assert "scheduling" not in job_to_dict(j)["spec"]
+        j2 = sjob(prio="", group="")
+        assert job_to_dict(j2)["spec"]["scheduling"] == {}
+        assert job_from_dict(job_to_dict(j2)).spec.scheduling is not None
+
+    def test_validation_rejects_unknown_class_and_bad_group(self):
+        j = sjob(prio="urgent")
+        with pytest.raises(ValidationError, match="priorityClass"):
+            validate(j)
+        j2 = sjob(group="Not_DNS")
+        with pytest.raises(ValidationError, match="quotaGroup"):
+            validate(j2)
+        validate(sjob(prio="critical", group="team-a"))  # ok
+
+    def test_defaults_scheduling_implies_gang(self):
+        j = sjob()
+        assert not j.spec.enable_gang_scheduling
+        set_defaults(j)
+        assert j.spec.enable_gang_scheduling  # whole-gang admission
+
+    def test_priority_rank_order_and_unknown(self):
+        ranks = [priority_rank(c) for c in PRIORITY_CLASSES]
+        assert ranks == sorted(ranks) and len(set(ranks)) == len(ranks)
+        assert priority_rank("bogus") == priority_rank("standard")
+
+    def test_gang_demand_units(self):
+        assert gang_demand(sjob(slices=2, topo="v5e-16")) == 32
+        assert slice_chips(sjob(topo="v5e-16")) == 16
+        j = new_job(worker=4)
+        j.spec.scheduling = SchedulingSpec()
+        assert gang_demand(j) == 0  # CPU-only gang: never contends
+
+
+# ------------------------------------------------------------- queue order
+
+
+class TestQueueOrder:
+    def test_priority_then_age(self):
+        r = Rig(capacity=0)  # nothing admits: pure ordering
+        t0 = 1000.0
+        r.jobs = [sjob("old-low", "low"), sjob("new-high", "high")]
+        r.sched.evaluate_once(t0)
+        q = [e["job"] for e in r.sched.snapshot()["queue"]]
+        assert q == ["default/new-high", "default/old-low"]
+
+    def test_age_boost_promotes_but_ties_break_by_age(self):
+        r = Rig(capacity=0, age_boost_seconds=300.0)
+        t0 = 1000.0
+        r.jobs = [sjob("low", "low")]
+        r.sched.evaluate_once(t0)
+        r.jobs.append(sjob("high", "high"))
+        # low has waited 700s -> boost 2, ties high's true rank 2;
+        # the tie breaks by queued_since (older first)
+        r.sched.evaluate_once(t0 + 700.0)
+        q = [e["job"] for e in r.sched.snapshot()["queue"]]
+        assert q == ["default/low", "default/high"]
+
+    def test_positions_published_as_gauges(self):
+        r = Rig(capacity=0)
+        r.jobs = [sjob("a", "low"), sjob("b", "critical")]
+        r.sched.evaluate_once(1000.0)
+        g = r.metrics
+        assert g.gauge("scheduler_queue_position", job="default/b") == 1.0
+        assert g.gauge("scheduler_queue_position", job="default/a") == 2.0
+        assert g.gauge(
+            "scheduler_queued_since_unix", job="default/a"
+        ) == 1000.0
+
+    def test_admit_clears_queue_gauges_and_counts(self):
+        r = Rig(capacity=16)
+        r.jobs = [sjob("a")]
+        r.sched.evaluate_once(1000.0)
+        assert r.metrics.counter("scheduler_admitted_total") == 1.0
+        assert (
+            r.metrics.gauge_series("scheduler_queue_position") == {}
+        )
+        assert [d.action for d in r.decisions] == ["admit"]
+
+    def test_lister_blip_does_not_forget_state(self):
+        """A broken-watch re-list can briefly return a snapshot missing
+        live jobs; the scheduler must ride it out (grace window) rather
+        than forget the gang — forgetting resets queue age and double
+        counts the re-admission (the contention soak caught this)."""
+
+        r = Rig(capacity=16, missing_grace_seconds=10.0)
+        r.jobs = [sjob("a")]
+        r.sched.evaluate_once(1000.0)
+        held = r.jobs
+        r.jobs = []  # the blip
+        r.sched.evaluate_once(1001.0)
+        assert [
+            e["job"] for e in r.sched.snapshot()["admitted"]
+        ] == ["default/a"]
+        r.jobs = held  # cache recovers
+        r.sched.evaluate_once(1002.0)
+        assert r.metrics.counter("scheduler_admitted_total") == 1.0
+        assert [d.action for d in r.decisions] == ["admit"]
+        # a REAL disappearance outlives the grace and is forgotten
+        r.jobs = []
+        r.sched.evaluate_once(1003.0)
+        r.sched.evaluate_once(1020.0)
+        assert r.sched.snapshot()["admitted"] == []
+
+    def test_observed_terminal_job_forgotten_immediately(self):
+        """The grace window only covers ABSENT jobs — one listed as
+        terminal frees its chips on the very next sweep."""
+
+        r = Rig(capacity=8, missing_grace_seconds=10.0)
+        from tf_operator_tpu.controller.status import set_condition
+
+        a = sjob("a")
+        r.jobs = [a, sjob("b")]
+        r.sched.evaluate_once(1000.0)
+        set_condition(a, JobConditionType.SUCCEEDED, "JobSucceeded", "m")
+        r.sched.evaluate_once(1001.0)
+        assert [
+            e["job"] for e in r.sched.snapshot()["admitted"]
+        ] == ["default/b"]
+
+    def test_stale_relist_cannot_resurrect_finished_job(self):
+        """Terminal is sticky per uid: a stale informer re-list handing
+        back an old pre-Succeeded copy of a finished job must not
+        re-register (and re-admit) it.  A genuine recreation — same
+        name, new uid — schedules normally."""
+
+        from tf_operator_tpu.controller.status import set_condition
+
+        r = Rig(capacity=8)
+        done = sjob("a")
+        done.metadata.uid = "uid-1"
+        stale = sjob("a")  # the pre-terminal cached copy
+        stale.metadata.uid = "uid-1"
+        r.jobs = [done]
+        r.sched.evaluate_once(1000.0)
+        set_condition(done, JobConditionType.SUCCEEDED, "JobSucceeded", "m")
+        r.sched.evaluate_once(1001.0)
+        r.jobs = [stale]
+        r.sched.evaluate_once(1002.0)
+        assert r.sched.snapshot()["admitted"] == []
+        assert r.metrics.counter("scheduler_admitted_total") == 1.0
+        recreated = sjob("a")
+        recreated.metadata.uid = "uid-2"
+        r.jobs = [recreated]
+        r.sched.evaluate_once(1003.0)
+        assert [
+            e["job"] for e in r.sched.snapshot()["admitted"]
+        ] == ["default/a"]
+
+    def test_decisions_only_on_transitions(self):
+        """Anti-flap: a parked gang re-evaluated every sweep emits ONE
+        queue decision, not one per sweep."""
+
+        r = Rig(capacity=0)
+        r.jobs = [sjob("a")]
+        for i in range(5):
+            r.sched.evaluate_once(1000.0 + i)
+        assert [d.action for d in r.decisions] == ["queue"]
+
+
+# ------------------------------------------------------------------- quota
+
+
+class TestQuota:
+    def test_group_at_limit_queues_with_reason(self):
+        r = Rig(capacity=64)
+        r.sched.set_quota("default", "team-a", 8)
+        r.jobs = [sjob("a", group="team-a"), sjob("b", group="team-a")]
+        r.sched.evaluate_once(1000.0)
+        snap = r.sched.snapshot()
+        assert [e["job"] for e in snap["admitted"]] == ["default/a"]
+        (q,) = snap["queue"]
+        assert q["reason"] == "QuotaExceeded"
+        assert snap["quotas"]["default/team-a"] == {
+            "limitChips": 8.0, "usedChips": 8.0,
+        }
+        assert r.metrics.gauge(
+            "scheduler_quota_used_chips", quota="default/team-a"
+        ) == 8.0
+        # anti-flap: further sweeps add no decisions
+        n = len(r.decisions)
+        r.sched.evaluate_once(1001.0)
+        assert len(r.decisions) == n
+
+    def test_quota_is_never_helped_by_preemption(self):
+        """A high-priority gang over ITS OWN quota must not evict
+        anyone — quota is a hard cap, not a priority."""
+
+        r = Rig(capacity=16)
+        r.sched.set_quota("default", "team-a", 8)
+        low = sjob("low", "low", group="team-a")
+        r.jobs = [low]
+        r.sched.evaluate_once(1000.0)
+        r.checkpoint(low, 999.0)
+        r.jobs.append(sjob("high", "high", group="team-a"))
+        r.sched.evaluate_once(1001.0)
+        snap = r.sched.snapshot()
+        assert [e["job"] for e in snap["admitted"]] == ["default/low"]
+        assert snap["queue"][0]["reason"] == "QuotaExceeded"
+        assert r.metrics.counter(
+            "scheduler_preemptions_total",
+            victim_priority="low", reason="revoke",
+        ) == 0.0
+
+    def test_quota_frees_when_member_finishes(self):
+        r = Rig(capacity=64)
+        r.sched.set_quota("default", "team-a", 8)
+        r.jobs = [sjob("a", group="team-a"), sjob("b", group="team-a")]
+        r.sched.evaluate_once(1000.0)
+        r.jobs = [r.jobs[1]]  # a finished (lister stops returning it)
+        r.sched.evaluate_once(1001.0)
+        assert [
+            e["job"] for e in r.sched.snapshot()["admitted"]
+        ] == ["default/b"]
+
+
+# ----------------------------------------------------------- victim policy
+
+
+class TestVictimPolicy:
+    def test_choose_victims_lowest_class_then_youngest_grant(self):
+        r = Rig(capacity=64)
+        r.jobs = [sjob("lo", "low"), sjob("hi", "critical")]
+        r.sched.evaluate_once(1000.0)
+        order = r.sched.choose_victims([
+            {"key": "default/hi", "chips": 8},      # oldest grant
+            {"key": "default/unmanaged", "chips": 8},
+            {"key": "default/lo", "chips": 8},      # newest grant
+        ])
+        # fleet "low" first, unmanaged ranks as the default class,
+        # fleet "critical" last
+        assert order == ["default/lo", "default/unmanaged", "default/hi"]
+
+    def test_elective_preemption_picks_youngest_low(self):
+        r = Rig(capacity=16)
+        a, b = sjob("a", "low"), sjob("b", "low")
+        r.jobs = [a]
+        r.sched.evaluate_once(1000.0)
+        r.jobs.append(b)
+        r.sched.evaluate_once(1010.0)  # b admitted later (younger)
+        r.checkpoint(a, 1010.0)
+        r.checkpoint(b, 1010.0)
+        r.jobs.append(sjob("h", "high"))
+        r.sched.evaluate_once(1020.0)
+        revoked = [d for d in r.decisions if d.action == "revoke"]
+        assert [d.job_key for d in revoked] == ["default/b"]
+
+    def test_checkpoint_gate_skips_stale_and_unknown(self):
+        r = Rig(capacity=8, max_victim_checkpoint_age_seconds=900.0)
+        low = sjob("low", "low")
+        r.jobs = [low]
+        r.sched.evaluate_once(1000.0)
+        r.jobs.append(sjob("h", "high"))
+        # no checkpoint at all -> skipped, high stays queued
+        r.sched.evaluate_once(1010.0)
+        assert r.metrics.counter(
+            "scheduler_skipped_total", reason="checkpoint_stale"
+        ) == 1.0
+        assert [
+            e["job"] for e in r.sched.snapshot()["queue"]
+        ] == ["default/h"]
+        # stale checkpoint -> still skipped
+        r.checkpoint(low, 10_000.0)
+        r.sched.evaluate_once(12_000.0)
+        assert [
+            e["job"] for e in r.sched.snapshot()["queue"]
+        ] == ["default/h"]
+        # fresh checkpoint -> gate opens, victim revoked
+        r.checkpoint(low, 12_100.0)
+        r.sched.evaluate_once(12_110.0)
+        assert [
+            e["job"] for e in r.sched.snapshot()["admitted"]
+        ] == ["default/h"]
+
+    def test_boosted_rank_never_evicts_higher_true_class(self):
+        """The age boost reorders the QUEUE; it must never let a "low"
+        evict an admitted "standard" (elective preemption compares
+        TRUE class rank only)."""
+
+        r = Rig(capacity=8, age_boost_seconds=100.0)
+        std = sjob("std", "standard")
+        r.jobs = [std]
+        r.sched.evaluate_once(1000.0)
+        r.checkpoint(std, 1000.0)
+        r.jobs.append(sjob("low", "low"))
+        # low has boost rank 5 >> standard's 1, but true rank 0 < 1
+        r.sched.evaluate_once(1500.0)
+        snap = r.sched.snapshot()
+        assert [e["job"] for e in snap["admitted"]] == ["default/std"]
+        assert [e["job"] for e in snap["queue"]] == ["default/low"]
+
+    def test_all_or_nothing_preemption(self):
+        """Victims that cannot cover the need free nothing — a
+        half-preemption would kill work without admitting anyone."""
+
+        r = Rig(capacity=8)
+        low = sjob("low", "low", slices=1)  # 8 chips
+        r.jobs = [low]
+        r.sched.evaluate_once(1000.0)
+        r.checkpoint(low, 1000.0)
+        r.jobs.append(sjob("big", "high", slices=2))  # needs 16
+        r.sched.evaluate_once(1010.0)
+        snap = r.sched.snapshot()
+        assert [e["job"] for e in snap["admitted"]] == ["default/low"]
+        assert not [d for d in r.decisions if d.action == "revoke"]
+
+    def test_preemption_cooldown_and_admit_grace(self):
+        r = Rig(capacity=8, preemption_cooldown_seconds=30.0)
+        low = sjob("low", "low")
+        r.jobs = [low]
+        r.sched.evaluate_once(1000.0)
+        r.checkpoint(low, 1005.0)
+        r.jobs.append(sjob("h", "high"))
+        # within the fresh-admit grace: low may not be victimised yet
+        r.sched.evaluate_once(1010.0)
+        assert [
+            e["job"] for e in r.sched.snapshot()["queue"]
+        ] == ["default/h"]
+        r.checkpoint(low, 1030.0)
+        r.sched.evaluate_once(1031.0)  # grace over
+        assert [
+            e["job"] for e in r.sched.snapshot()["admitted"]
+        ] == ["default/h"]
+
+
+# ------------------------------------------------------------ shed/revoke
+
+
+class TestShedAndRevoke:
+    def _rig_with_big_low(self):
+        r = Rig(capacity=24)
+        big = sjob("big", "low", slices=2)  # 16 chips, 8/slice
+        r.jobs = [big]
+        r.sched.evaluate_once(1000.0)
+        r.checkpoint(big, 1000.0)
+        return r, big
+
+    def test_multi_slice_victim_sheds_only_what_is_needed(self):
+        r, big = self._rig_with_big_low()
+        r.jobs.append(sjob("h1", "high"))  # 8 free of 24: admits clean
+        r.sched.evaluate_once(1010.0)
+        r.jobs.append(sjob("h2", "high"))  # full: big sheds one slice
+        r.sched.evaluate_once(1020.0)
+        (shed,) = [d for d in r.decisions if d.action == "shed"]
+        assert shed.job_key == "default/big"
+        assert shed.details["toSlices"] == 1
+        assert r.sched.take_preemption("default/big") == 1
+        blk = next(
+            a for a in r.sched.snapshot()["admitted"]
+            if a["job"] == "default/big"
+        )
+        assert blk["shedTo"] == 1 and blk["demandChips"] == 8
+        assert r.metrics.counter(
+            "scheduler_preemptions_total",
+            victim_priority="low", reason="shed",
+        ) == 1.0
+
+    def test_apply_clamps_working_copy_to_shed_target(self):
+        r, big = self._rig_with_big_low()
+        r.jobs += [sjob("h1", "high"), sjob("h2", "high")]
+        r.sched.evaluate_once(1010.0)
+        r.sched.evaluate_once(1045.0)  # past h1's admit grace
+        clone = big.clone()
+        r.sched.apply(clone)
+        assert clone.spec.replica_specs[
+            ReplicaType.TPU_SLICE
+        ].replicas == 1
+        # the cached object is untouched
+        assert big.spec.replica_specs[ReplicaType.TPU_SLICE].replicas == 2
+
+    def test_single_slice_victim_revoked_whole(self):
+        r = Rig(capacity=8)
+        low = sjob("low", "low", slices=1)
+        r.jobs = [low]
+        r.sched.evaluate_once(1000.0)
+        r.checkpoint(low, 1000.0)
+        r.jobs.append(sjob("h", "high", slices=1))
+        r.sched.evaluate_once(1010.0)
+        (rev,) = [d for d in r.decisions if d.action == "revoke"]
+        assert rev.job_key == "default/low"
+        assert r.sched.take_revocation("default/low")["mode"] == "revoke"
+        (q,) = r.sched.snapshot()["queue"]
+        assert q["job"] == "default/low" and q["reason"] == "Preempted"
+
+    def test_note_revoked_parks_synchronously(self):
+        r = Rig(capacity=16)
+        low = sjob("low", "low")
+        r.jobs = [low]
+        r.sched.evaluate_once(1000.0)
+        r.sched.note_revoked("default/low", by="capacity-shrink")
+        # parked immediately — no sweep needed
+        assert r.sched.take_revocation("default/low") is not None
+        (q,) = r.sched.snapshot()["queue"]
+        assert q["reason"] == "Preempted"
+        (rev,) = [d for d in r.decisions if d.action == "revoke"]
+        assert "capacity-shrink" in rev.reason
+
+    def test_health_block_is_stable_while_parked(self):
+        """Throttle safety: the queued block carries the STABLE
+        queuedSinceUnix stamp, so identical state compares equal across
+        sweeps and cannot livelock the status-write throttle."""
+
+        r = Rig(capacity=0)
+        j = sjob("a")
+        r.jobs = [j]
+        r.sched.evaluate_once(1000.0)
+        b1 = r.sched.health_block(j)
+        r.sched.evaluate_once(1250.0)
+        b2 = r.sched.health_block(j)
+        assert b1 == b2
+        assert b1["queuedSinceUnix"] == 1000.0
+
+
+# ----------------------------------------------------- anti-starvation
+
+
+class TestAntiStarvation:
+    def test_low_priority_gang_admits_under_high_churn(self):
+        """Satellite: sustained high-priority churn — a fresh high
+        arrival every round, each finishing before the next — must not
+        starve a parked low gang; the age boost eventually wins the
+        tie and the low gang admits."""
+
+        r = Rig(capacity=8, age_boost_seconds=300.0)
+        low = sjob("low", "low")
+        r.jobs = [low]
+        t, admitted_at_round = 1000.0, None
+        high = None
+        for round_no in range(12):
+            if high is not None:
+                r.jobs.remove(high)  # previous high finished
+            high = sjob(f"h{round_no}", "high")
+            r.jobs.append(high)
+            r.sched.evaluate_once(t)
+            admitted = [
+                e["job"] for e in r.sched.snapshot()["admitted"]
+            ]
+            if "default/low" in admitted:
+                admitted_at_round = round_no
+                break
+            # high outranked low this round and took the pool
+            assert admitted == [high.key]
+            t += 120.0
+        assert admitted_at_round is not None, "low gang starved"
+        # and the boost needed real waiting: not the first rounds
+        assert admitted_at_round >= 3
+        # the displaced high queues behind the fact — visible, not lost
+        assert [
+            e["job"] for e in r.sched.snapshot()["queue"]
+        ] == [high.key]
+
+
+# ------------------------------------------------- reconciler integration
+
+
+def sweep(c, sched, n=2):
+    for _ in range(n):
+        sched.evaluate_once()
+        c.sync_until_quiet()
+
+
+class TestReconcilerIntegration:
+    def rig(self, total_chips=16, **kw):
+        kw.setdefault("preemption_cooldown_seconds", 0.0)
+        m = Metrics()
+        sched = Scheduler(metrics=m, **kw)
+        store, backend, c = harness(
+            total_chips=total_chips, scheduler=sched
+        )
+        return store, backend, c, sched, m
+
+    def test_queued_job_creates_nothing_and_shows_queued(self):
+        store, backend, c, sched, m = self.rig(total_chips=0)
+        store.create(sjob("a"))
+        c.sync_until_quiet()
+        sweep(c, sched)
+        assert backend.created_pods == []
+        st = store.get("default", "a").status
+        cond = next(
+            cd for cd in st.conditions
+            if cd.type is JobConditionType.QUEUED
+        )
+        assert cond.status and cond.reason == "WaitingForCapacity"
+        blk = st.observed_health["scheduler"]
+        assert blk["phase"] == "queued" and blk["queuePosition"] == 1
+        assert c.metrics.gauge(
+            "tpujob_gang_waiting_replicas", job="default/a"
+        ) == 2.0
+
+    def test_admission_creates_pods_and_clears_queued(self):
+        store, backend, c, sched, m = self.rig()
+        store.create(sjob("a"))
+        c.sync_until_quiet()
+        sweep(c, sched)
+        assert len(backend.created_pods) == 2  # v5e-8: 2 hosts/slice
+        st = store.get("default", "a").status
+        cond = next(
+            cd for cd in st.conditions
+            if cd.type is JobConditionType.QUEUED
+        )
+        assert not cond.status and cond.reason == "Admitted"
+        # the controller relayed the decision as an event
+        reasons = [
+            e.reason for e in c.recorder.for_object("default/a")
+        ]
+        assert "Admitted" in reasons
+
+    def test_elective_revoke_tears_down_and_resumes(self):
+        store, backend, c, sched, m = self.rig()
+        store.create(sjob("low", "low"))
+        c.sync_until_quiet()
+        sweep(c, sched)
+        m.set(
+            "checkpoint_last_success_unix", time.time(),
+            job="default/low",
+        )
+        store.create(sjob("hi", "high", slices=2))  # needs whole pool
+        c.sync_until_quiet()
+        sweep(c, sched)
+        st = store.get("default", "low").status
+        assert any(
+            cd.type is JobConditionType.PREEMPTED and cd.status
+            and cd.reason == "GangRevoked"
+            for cd in st.conditions
+        )
+        assert not [
+            p for p in backend._pods.values()
+            if p.metadata.name.startswith("low")
+        ]
+        events = c.recorder.for_object("default/low")
+        assert any(
+            e.reason == "Preempted" and e.type == "Warning"
+            for e in events
+        )
+        # chips actually freed: hi runs
+        assert len([
+            p for p in backend._pods.values()
+            if p.metadata.name.startswith("hi")
+        ]) == 4
+        # hi finishes -> low re-admits and resumes from checkpoint
+        backend.run_all("default")
+        for p in list(backend._pods.values()):
+            backend.succeed_pod("default", p.metadata.name)
+        c.sync_until_quiet()
+        sweep(c, sched)
+        backend.run_all("default")
+        c.sync_until_quiet()
+        st = store.get("default", "low").status
+        assert any(
+            cd.type is JobConditionType.RESUMED and cd.status
+            and cd.reason == "ResumedFromCheckpoint"
+            for cd in st.conditions
+        )
+        run_and_succeed_all(backend)
+        c.sync_until_quiet()
+        st = store.get("default", "low").status
+        assert st.has_condition(JobConditionType.SUCCEEDED)
+
+    def test_shed_bounces_slice_set_to_smaller_world(self):
+        store, backend, c, sched, m = self.rig(total_chips=24)
+        store.create(sjob("big", "low", slices=2))
+        c.sync_until_quiet()
+        sweep(c, sched)
+        m.set(
+            "checkpoint_last_success_unix", time.time(),
+            job="default/big",
+        )
+        store.create(sjob("h1", "high"))
+        c.sync_until_quiet()
+        sweep(c, sched)
+        store.create(sjob("h2", "high"))
+        c.sync_until_quiet()
+        sweep(c, sched)
+        big_pods = [
+            p.metadata.name
+            for p in backend._pods.values()
+            if p.metadata.name.startswith("big")
+        ]
+        assert sorted(big_pods) == ["big-tpuslice-0", "big-tpuslice-1"]
+        st = store.get("default", "big").status
+        assert any(
+            cd.type is JobConditionType.PREEMPTED
+            and cd.reason == "SliceShed"
+            for cd in st.conditions
+        )
+        assert c.metrics.counter("tpujob_reshards_total") >= 1.0
+        assert st.observed_health["scheduler"]["shedTo"] == 1
+
+    def test_terminal_job_forgotten_and_gauges_cleared(self):
+        store, backend, c, sched, m = self.rig()
+        store.create(sjob("a"))
+        c.sync_until_quiet()
+        sweep(c, sched)
+        run_and_succeed_all(backend)
+        c.sync_until_quiet()
+        sched.evaluate_once()
+        assert sched.snapshot()["admitted"] == []
+        assert m.gauge_series("scheduler_queue_position") == {}
+        assert m.gauge_series("scheduler_queued_since_unix") == {}
+
+    def test_unmanaged_jobs_bypass_the_queue_entirely(self):
+        store, backend, c, sched, m = self.rig(total_chips=0)
+        store.create(new_job("plain", worker=2))
+        c.sync_until_quiet()
+        assert len(backend.created_pods) == 2
+        assert sched.snapshot()["queue"] == []
+
+
+# ------------------------------------------------------ backend routing
+
+
+class TestBackendVictimRouting:
+    def test_capacity_shrink_revokes_by_class_not_lifo(self):
+        """FakeCluster shrink with the scheduler attached revokes the
+        LOWEST class even when it was granted first — blind LIFO would
+        have killed the newest (high) gang."""
+
+        m = Metrics()
+        sched = Scheduler(metrics=m, preemption_cooldown_seconds=0.0)
+        store, backend, c = harness(total_chips=16, scheduler=sched)
+        store.create(sjob("low", "low"))   # granted FIRST (oldest)
+        c.sync_until_quiet()
+        sweep(c, sched)
+        store.create(sjob("hi", "high"))   # granted second
+        c.sync_until_quiet()
+        sweep(c, sched)
+        revoked = backend.set_total_chips(8)
+        assert revoked == ["low"]
+        c.sync_until_quiet()
+        st = store.get("default", "low").status
+        assert any(
+            cd.type is JobConditionType.QUEUED and cd.status
+            for cd in st.conditions
+        )
+        # the high gang never noticed
+        st = store.get("default", "hi").status
+        assert not any(
+            cd.type is JobConditionType.PREEMPTED for cd in st.conditions
+        )
+        # attributed audit trail names the victim and the change
+        events = c.recorder.for_object("default/low")
+        assert any(
+            e.reason == "Preempted" and "shrunk to 8" in e.message
+            for e in events
+        )
+        assert m.counter(
+            "scheduler_preemptions_total",
+            victim_priority="low", reason="revoke",
+        ) == 1.0
+
+    def test_shrink_race_does_not_fail_the_victim(self):
+        """The corpse race: syncs run between the backend's kill and
+        the next scheduler sweep.  The synchronous note_revoked park
+        means the victim reads Queued, never Failed."""
+
+        m = Metrics()
+        sched = Scheduler(metrics=m, preemption_cooldown_seconds=0.0)
+        store, backend, c = harness(total_chips=16, scheduler=sched)
+        store.create(sjob("a", "low"))
+        store.create(sjob("b", "high"))
+        c.sync_until_quiet()
+        sweep(c, sched)
+        backend.set_total_chips(8)
+        c.sync_until_quiet()  # NO evaluate_once first — the race
+        st = store.get("default", "a").status
+        assert not st.has_condition(JobConditionType.FAILED)
+        assert any(
+            cd.type is JobConditionType.QUEUED and cd.status
+            for cd in st.conditions
+        )
+        # capacity returns: the victim re-admits and succeeds
+        backend.set_total_chips(16)
+        sweep(c, sched)
+        run_and_succeed_all(backend)
+        c.sync_until_quiet()
+        for name in ("a", "b"):
+            st = store.get("default", name).status
+            assert st.has_condition(JobConditionType.SUCCEEDED), name
+
+
+# ------------------------------------------------------- read surfaces
+
+
+class TestReadSurfaces:
+    def test_get_scheduler_route(self):
+        from tf_operator_tpu.server.api import ApiServer
+
+        m = Metrics()
+        sched = Scheduler(metrics=m)
+        store, backend, c = harness(total_chips=0, scheduler=sched)
+        server = ApiServer(
+            store, backend, c.metrics, c.recorder, scheduler=sched
+        )
+        server.start()
+        try:
+            store.create(sjob("a", "high"))
+            c.sync_until_quiet()
+            sched.evaluate_once()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/scheduler", timeout=10
+            ) as r:
+                snap = json.loads(r.read().decode())
+            assert snap["queue"][0]["job"] == "default/a"
+            assert snap["queue"][0]["priorityClass"] == "high"
+            assert snap["decisions"][0]["action"] == "queue"
+        finally:
+            server.stop()
+
+    def test_kubesim_debug_route(self):
+        from tf_operator_tpu.backend.kubesim import MiniApiServer
+
+        sim = MiniApiServer().start()
+        try:
+            with urllib.request.urlopen(
+                f"{sim.url}/scheduler", timeout=10
+            ) as r:
+                snap = json.loads(r.read().decode())
+            assert set(snap) >= {"queue", "admitted", "quotas", "decisions"}
+        finally:
+            sim.stop()
+
+    def test_kubesim_admission_validates_scheduling(self):
+        """Server-side admission covers the new block: an unknown
+        priorityClass is rejected at POST time."""
+
+        from tf_operator_tpu.backend.kubesim import MiniApiServer
+
+        sim = MiniApiServer().start()
+        try:
+            bad = job_to_dict(sjob("bad", prio="urgent"))
+            req = urllib.request.Request(
+                f"{sim.url}/apis/tpujob.dist/v1/namespaces/default/tpujobs",
+                data=json.dumps(bad).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code in (400, 422)
+        finally:
+            sim.stop()
+
+    def test_cli_queue_renders_snapshot(self, capsys, monkeypatch):
+        from tf_operator_tpu.cmd import tpujob as cli
+
+        snap = {
+            "queue": [{
+                "job": "default/low", "priorityClass": "low",
+                "quotaGroup": "default/default", "position": 1,
+                "waitSeconds": 42.0, "demandChips": 8,
+                "reason": "WaitingForCapacity",
+            }],
+            "admitted": [{
+                "job": "default/hi", "priorityClass": "high",
+                "quotaGroup": "default/default", "demandChips": 8,
+                "admittedAt": 1.0, "shedTo": 1,
+            }],
+            "quotas": {"default/default": {
+                "limitChips": None, "usedChips": 8.0,
+            }},
+            "decisions": [{
+                "time": 1.0, "job": "default/hi", "action": "admit",
+                "priorityClass": "high", "quotaGroup": "default/default",
+                "reason": "rank 2 (high), waited 0s", "details": {},
+            }],
+        }
+        monkeypatch.setattr(cli, "_request", lambda m, u, payload=None: snap)
+        rc = cli.main(["queue"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "default/low" in out and "WaitingForCapacity" in out
+        assert "shed to 1 replicas" in out or "shed to 1" in out
+        assert "admit" in out
+
+    def test_cli_describe_shows_scheduling_block(self, capsys, monkeypatch):
+        from tf_operator_tpu.cmd import tpujob as cli
+
+        job = job_to_dict(sjob("a", "low"))
+        job["status"] = {
+            "conditions": [],
+            "replicaStatuses": {},
+            "observedHealth": {
+                "scheduler": {
+                    "phase": "queued", "priorityClass": "low",
+                    "quotaGroup": "default/default", "queuePosition": 2,
+                    "queuedSinceUnix": time.time() - 30,
+                    "reason": "WaitingForCapacity", "preemptions": 1,
+                    "lastPreemption": {
+                        "mode": "revoke", "by": "default/hi",
+                        "action": "revoke", "reason": "gang revoked",
+                    },
+                },
+            },
+        }
+
+        def fake_request(method, url, payload=None):
+            if url.endswith("/events"):
+                return {"items": []}
+            if url.endswith("/metrics"):
+                return {"items": []}
+            return job
+
+        monkeypatch.setattr(cli, "_request", fake_request)
+        rc = cli.main(["describe", "a"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Scheduling:" in out
+        assert "position 2" in out
+        assert "preemptions:      1" in out
+
+
+# ------------------------------------------------------- serde of status
+
+
+class TestConditionSerde:
+    def test_new_condition_types_round_trip(self):
+        from tf_operator_tpu.controller.status import set_condition
+
+        j = sjob("a")
+        set_condition(j, JobConditionType.QUEUED, "WaitingForCapacity", "m")
+        set_condition(j, JobConditionType.PREEMPTED, "GangRevoked", "m")
+        set_condition(j, JobConditionType.RESUMED, "ResumedFromCheckpoint", "m")
+        back = job_from_dict(job_to_dict(j))
+        types = {c.type for c in back.status.conditions}
+        assert {
+            JobConditionType.QUEUED,
+            JobConditionType.PREEMPTED,
+            JobConditionType.RESUMED,
+        } <= types

@@ -1,0 +1,331 @@
+"""Contention chaos soak (ISSUE 16 acceptance): the fleet scheduler
+under mixed-priority load, shrinking-then-returning capacity, and PR 1
+apiserver fault injection — nothing wedges.
+
+The scenario, over the production-shaped path (controller → retrying
+HTTP clients → kubesim MiniApiServer with a FaultInjector throwing
+5xx/429/resets at every route):
+
+1. Two low-priority bulk trainers admit and fill the 24-chip pool,
+   stamping fresh async-checkpoint ages as they run.
+2. A critical and a high gang arrive into a full pool: the scheduler
+   preempts across jobs — checkpoint-gated shed-to-smaller-world or
+   whole-gang revoke — until both bursts run.  Victims park VISIBLY
+   (Queued condition, queue gauges), not as dead pods.
+3. Capacity shrinks under everyone's feet: kubesim revokes through the
+   scheduler's victim choice (lowest class first, never LIFO), and the
+   synchronous ``note_revoked`` park means no victim is ever misread
+   as a replica failure.
+4. Capacity returns: every parked gang re-admits by priority × age,
+   resumes from its checkpoint, and runs to completion.
+
+Pinned acceptance: 4 jobs × 3 priority classes all end Succeeded, at
+least one cross-job preemption whose victim carries
+Preempted → Resumed(ResumedFromCheckpoint) → Succeeded, monotone
+per-job decision sequences (zero flapping), bounded sync count (no hot
+requeue loops), and non-zero injected faults.  The decision counts are
+published into SUITE_RECORD via record_suite_extra so a silently
+wedged soak reddens benchmarks/check_tier_budget.py.
+"""
+
+import random
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from tests.conftest import record_suite_extra
+from tests.testutil import new_job
+from tf_operator_tpu.api.types import (
+    JobConditionType,
+    PodPhase,
+    SchedulingSpec,
+)
+from tf_operator_tpu.backend.kube import KubeBackend
+from tf_operator_tpu.backend.kubejobs import KubeEventRecorder, KubeJobStore
+from tf_operator_tpu.backend.kubesim import MiniApiServer
+from tf_operator_tpu.backend.retry import RetryPolicy
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.controller.reconciler import ReconcilerConfig
+from tf_operator_tpu.controller.scheduler import Scheduler
+from tf_operator_tpu.utils.metrics import Metrics
+
+POOL = 24  # three v5e-8 slices
+BULK_SLEEP = [sys.executable, "-c", "import time; time.sleep(5.0)"]
+BURST_SLEEP = [sys.executable, "-c", "import time; time.sleep(1.2)"]
+
+#: decision-sequence automaton: every per-job action must extend the
+#: previous one along these edges — anything else is flapping (an
+#: admit/queue oscillation) or a phantom transition (shed of a parked
+#: gang).  ``queue`` appears at most once per job by construction (the
+#: scheduler dedups queue decisions), revoke must come from a held
+#: grant, and a revoked gang either re-admits directly or waits
+#: visibly (one queue decision) before re-admitting.
+MONOTONE = {
+    None: {"queue", "admit"},
+    "queue": {"admit"},
+    "admit": {"shed", "revoke"},
+    "shed": {"shed", "revoke"},
+    "revoke": {"admit", "queue"},
+}
+
+
+def fast_policy(seed, **kw):
+    kw.setdefault("max_attempts", 6)
+    kw.setdefault("base_delay", 0.02)
+    kw.setdefault("max_delay", 0.2)
+    kw.setdefault("deadline", 5.0)
+    return RetryPolicy(rng=random.Random(seed), **kw)
+
+
+class CapacityBackend(KubeBackend):
+    """KubeBackend + the ``total_chips`` probe the scheduler's capacity
+    callable expects (kubesim owns the pool server-side; same process,
+    so read it directly — the control traffic still rides faulty HTTP)."""
+
+    def __init__(self, sim, **kw):
+        self._sim = sim
+        super().__init__(sim.url, **kw)
+
+    @property
+    def total_chips(self):
+        return self._sim.total_chips
+
+
+class SoakRig:
+    def __init__(self):
+        self.sim = MiniApiServer(total_chips=POOL, fault_seed=77).start()
+        # ~10% combined fault probability on ALL routes — the PR 1
+        # injector: 503+Retry-After, naked 429s, connection resets
+        self.sim.faults.add(mode="error", status=503, retry_after=0.02,
+                            probability=0.04)
+        self.sim.faults.add(mode="error", status=429, probability=0.03)
+        self.sim.faults.add(mode="reset", probability=0.03)
+
+        self.metrics = Metrics()
+        self.sched = Scheduler(
+            metrics=self.metrics, preemption_cooldown_seconds=0.3
+        )
+        self.store = KubeJobStore(
+            self.sim.url, retry=fast_policy(1), metrics=self.metrics
+        )
+        self.backend = CapacityBackend(
+            self.sim, retry=fast_policy(2), metrics=self.metrics
+        )
+        self.recorder = KubeEventRecorder(self.sim.url, metrics=self.metrics)
+        self.controller = TPUJobController(
+            self.store, self.backend,
+            config=ReconcilerConfig(resolver=self.backend.resolver),
+            metrics=self.metrics, recorder=self.recorder,
+            scheduler=self.sched,
+            resync_period=0.3, expectations_timeout=0.3,
+        )
+        # capacity-shrink revocation routes through the scheduler's
+        # victim choice + synchronous park (satellite 1)
+        self.sim.scheduler = self.sched
+        self.sweeps = 0
+        self.controller.run(threadiness=2)
+
+    def add_job(self, name, prio, slices, command):
+        j = new_job(
+            name=name, tpu_slice=slices, tpu_topology="v5e-8",
+            command=command,
+        )
+        j.spec.scheduling = SchedulingSpec(priority_class=prio)
+        self.store.create(j)
+
+    def stamp_checkpoints(self, names):
+        """The trainers' async-checkpoint heartbeat: a fresh durability
+        stamp per tick, which is what opens the elective-preemption
+        checkpoint gate for these victims."""
+
+        now = time.time()
+        for name in names:
+            self.metrics.set(
+                "checkpoint_last_success_unix", now, job=f"default/{name}"
+            )
+
+    def pump(self, until, timeout, what, checkpoint=()):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            self.stamp_checkpoints(checkpoint)
+            self.sched.evaluate_once()
+            self.sweeps += 1
+            if until():
+                return
+            time.sleep(0.05)
+        raise TimeoutError(what)
+
+    def running_pods(self, name):
+        return sum(
+            1
+            for p in self.backend.list_pods(
+                "default", {"tpujob.dist/job-name": name}
+            )
+            if p.phase is PodPhase.RUNNING
+        )
+
+    def status(self, name):
+        job = self.store.get("default", name)
+        return None if job is None else job.status
+
+    def succeeded(self, name):
+        st = self.status(name)
+        return st is not None and st.has_condition(JobConditionType.SUCCEEDED)
+
+    def decision_actions(self, name):
+        """Oldest-first action sequence for one job from the decision
+        log (the same log GET /scheduler serves)."""
+
+        newest_first = self.sched.snapshot()["decisions"]
+        return [
+            d["action"]
+            for d in reversed(newest_first)
+            if d["job"] == f"default/{name}"
+        ]
+
+    def stop(self):
+        self.controller.stop()
+        self.recorder.close()
+        self.backend.close()
+        self.store.close()
+        self.sim.stop()
+
+
+class TestContentionSoak:
+    def test_mixed_priority_contention_shrink_and_return(self):
+        rig = SoakRig()
+        t0 = time.time()
+        try:
+            self._run(rig)
+        finally:
+            rig.stop()
+        # no hot requeue loop: syncs stay proportional to the soak's
+        # wall clock (a wedged job hot-loops hundreds of syncs/second)
+        elapsed = time.time() - t0
+        syncs = rig.metrics.total("tpujob_syncs_total")
+        assert syncs < 40.0 * elapsed + 400.0, (
+            f"sync storm: {syncs:.0f} syncs in {elapsed:.1f}s"
+        )
+
+    def _run(self, rig):
+        bulks = ("bulk-a", "bulk-b")
+        jobs = ("bulk-a", "bulk-b", "burst-crit", "burst-hi")
+
+        # ---- phase 1: bulk load fills the pool ---------------------
+        rig.add_job("bulk-a", "low", slices=2, command=BULK_SLEEP)
+        rig.add_job("bulk-b", "low", slices=1, command=BULK_SLEEP)
+        rig.pump(
+            lambda: all(rig.running_pods(n) > 0 for n in bulks),
+            timeout=20.0, what="bulk jobs running", checkpoint=bulks,
+        )
+        snap = rig.sched.snapshot()
+        assert {e["job"] for e in snap["admitted"]} == {
+            "default/bulk-a", "default/bulk-b",
+        }
+
+        # ---- phase 2: burst arrivals into a full pool --------------
+        # critical + high arrive; the pool is full, so BOTH admissions
+        # require cross-job preemption of the (checkpoint-fresh) lows
+        rig.add_job("burst-crit", "critical", slices=1, command=BURST_SLEEP)
+        rig.add_job("burst-hi", "high", slices=1, command=BURST_SLEEP)
+
+        def bursts_admitted():
+            admitted = {
+                e["job"] for e in rig.sched.snapshot()["admitted"]
+            }
+            return {"default/burst-crit", "default/burst-hi"} <= admitted
+
+        rig.pump(
+            bursts_admitted, timeout=20.0,
+            what="bursts admitted via preemption", checkpoint=bulks,
+        )
+        assert rig.metrics.total("scheduler_preemptions_total") >= 1.0
+        # the parked victims are VISIBLE, not dead: Queued condition or
+        # shed marker, never Failed
+        for name in bulks:
+            st = rig.status(name)
+            assert not st.has_condition(JobConditionType.FAILED), name
+
+        # ---- phase 3: capacity shrinks under everyone --------------
+        revoked = rig.sim.set_total_chips(8)
+        assert revoked, "shrink to 8 chips must revoke someone"
+        # victim choice went through the scheduler: the critical gang
+        # survives a shrink that still fits it (never LIFO)
+        assert "burst-crit" not in revoked
+
+        def victims_parked():
+            for name in revoked:
+                st = rig.status(name)
+                if st is None or st.has_condition(JobConditionType.FAILED):
+                    return False
+                done = st.has_condition(JobConditionType.SUCCEEDED)
+                queued = any(
+                    c.type is JobConditionType.QUEUED and c.status
+                    for c in st.conditions
+                )
+                if not (done or queued):
+                    return False
+            return True
+
+        rig.pump(
+            victims_parked, timeout=20.0,
+            what="shrink victims visibly parked", checkpoint=bulks,
+        )
+
+        # ---- phase 4: capacity returns — everyone completes --------
+        rig.sim.set_total_chips(POOL)
+        rig.pump(
+            lambda: all(rig.succeeded(n) for n in jobs),
+            timeout=40.0, what="all jobs Succeeded after capacity return",
+            checkpoint=bulks,
+        )
+
+        # ---- the pinned contract -----------------------------------
+        admitted_total = int(rig.metrics.counter("scheduler_admitted_total"))
+        preempt_total = int(rig.metrics.total("scheduler_preemptions_total"))
+        record_suite_extra("schedulerSoak", {
+            "admitted": admitted_total,
+            "preemptions": preempt_total,
+            "sweeps": rig.sweeps,
+        })
+        assert admitted_total >= 4
+        assert preempt_total >= 1
+
+        # at least one cross-job preemption victim resumed from its
+        # checkpoint and ran to completion
+        resumed_and_done = []
+        for name in jobs:
+            st = rig.status(name)
+            assert st.has_condition(JobConditionType.SUCCEEDED), (
+                f"{name} did not finish: "
+                f"{[(c.type.value, c.status, c.reason) for c in st.conditions]}"
+            )
+            preempted = any(
+                c.type is JobConditionType.PREEMPTED for c in st.conditions
+            )
+            resumed = any(
+                c.type is JobConditionType.RESUMED
+                and c.reason == "ResumedFromCheckpoint"
+                for c in st.conditions
+            )
+            if preempted and resumed:
+                resumed_and_done.append(name)
+        assert resumed_and_done, "no victim resumed from checkpoint"
+
+        # monotone per-job decision sequences: zero flapping
+        for name in jobs:
+            seq = rig.decision_actions(name)
+            assert seq, f"{name} has no decisions"
+            prev = None
+            for action in seq:
+                assert action in MONOTONE[prev], (
+                    f"{name}: {prev} -> {action} flap in {seq}"
+                )
+                prev = action
+
+        # the faults actually fired and the clients actually retried
+        assert rig.sim.faults.total_injected() > 0
+        assert rig.metrics.total("api_client_retries_total") > 0

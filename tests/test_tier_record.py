@@ -73,6 +73,55 @@ class TestBudgetGate:
                                       "exitstatus": 2, "when": "x"}})
         assert not ok and "RED TIER RECORD" in msg
 
+    def test_scheduler_soak_counts_gate(self):
+        """ISSUE 16 satellite: when the slow record carries the
+        contention soak's decision counts, zero admissions or zero
+        preemptions reddens the gate — a soak that wedged silently must
+        not pass on wall clock."""
+
+        mod = _load_checker()
+        base = {"wall_s": 900.0, "collected": 200, "exitstatus": 0,
+                "when": "x"}
+        ok, msg = mod.check({"slow": {
+            **base,
+            "schedulerSoak": {"admitted": 0, "preemptions": 0, "sweeps": 40},
+        }})
+        assert not ok and "SCHEDULER SOAK WEDGED" in msg
+        ok, msg = mod.check({"slow": {
+            **base,
+            "schedulerSoak": {"admitted": 7, "preemptions": 0, "sweeps": 40},
+        }})
+        assert not ok and "SCHEDULER SOAK WEDGED" in msg
+        ok, msg = mod.check({"slow": {
+            **base,
+            "schedulerSoak": {"admitted": 7, "preemptions": 3, "sweeps": 40},
+        }})
+        assert ok and "scheduler soak: 7 admissions" in msg
+        # no soak key (older records, soak-less subsets): gate silent
+        ok, msg = mod.check({"slow": base})
+        assert ok and "scheduler soak" not in msg
+
+    def test_record_suite_extra_merges_into_entry(self):
+        """The conftest extras hook: record_suite_extra keys land in
+        the tier entry dict shape sessionfinish writes."""
+
+        from tests import conftest
+
+        saved = dict(conftest._suite_extras)
+        try:
+            conftest._suite_extras.clear()
+            conftest.record_suite_extra(
+                "schedulerSoak", {"admitted": 3, "preemptions": 1}
+            )
+            entry = {"wall_s": 1.0, "exitstatus": 0, "collected": 1,
+                     "when": "t", **conftest._suite_extras}
+            assert entry["schedulerSoak"] == {
+                "admitted": 3, "preemptions": 1
+            }
+        finally:
+            conftest._suite_extras.clear()
+            conftest._suite_extras.update(saved)
+
     def test_cli_exit_codes(self, tmp_path):
         """The gate as tooling: exit 0 without a record file."""
 

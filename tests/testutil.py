@@ -64,6 +64,7 @@ def harness(
     delivery: str = "sync",
     total_chips: Optional[int] = None,
     config: Optional[ReconcilerConfig] = None,
+    scheduler=None,
 ) -> Tuple[JobStore, FakeCluster, TPUJobController]:
     store = JobStore()
     backend = FakeCluster(delivery=delivery, total_chips=total_chips)
@@ -71,7 +72,9 @@ def harness(
     # default_metrics would be test-order-dependent
     from tf_operator_tpu.utils.metrics import Metrics
 
-    controller = TPUJobController(store, backend, config=config, metrics=Metrics())
+    controller = TPUJobController(
+        store, backend, config=config, metrics=Metrics(), scheduler=scheduler
+    )
     return store, backend, controller
 
 

@@ -77,6 +77,12 @@ def set_defaults(job: TPUJob) -> TPUJob:
         # on it see the truth
         spec.enable_dynamic_worker = True
 
+    if spec.scheduling is not None:
+        # the fleet scheduler admits WHOLE gangs (controller/scheduler.py)
+        # — a fleet-queued job without gang semantics could be partially
+        # placed, which is exactly the state the queue exists to prevent
+        spec.enable_gang_scheduling = True
+
     if spec.enable_gang_scheduling and rp.scheduling_policy is None:
         # min_member stays None unless the user pinned it: the reconciler
         # resolves None to the job's *current* total replicas each sync,

@@ -27,6 +27,7 @@ from tf_operator_tpu.api.types import (
     RestartPolicy,
     RunPolicy,
     SchedulingPolicy,
+    SchedulingSpec,
     SignalBinding,
     SuccessPolicy,
     TPUJob,
@@ -168,6 +169,22 @@ def _autoscaling_to_dict(a: AutoscalingSpec) -> Dict[str, Any]:
     return {"policies": out}
 
 
+def _scheduling_from_dict(d: Dict[str, Any]) -> SchedulingSpec:
+    return SchedulingSpec(
+        priority_class=d.get("priorityClass", ""),
+        quota_group=d.get("quotaGroup", ""),
+    )
+
+
+def _scheduling_to_dict(s: SchedulingSpec) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    if s.priority_class:
+        out["priorityClass"] = s.priority_class
+    if s.quota_group:
+        out["quotaGroup"] = s.quota_group
+    return out
+
+
 def job_from_dict(d: Dict[str, Any]) -> TPUJob:
     meta_d = d.get("metadata", {})
     spec_d = d.get("spec", {})
@@ -218,6 +235,11 @@ def job_from_dict(d: Dict[str, Any]) -> TPUJob:
                 if spec_d.get("autoscaling")
                 else None
             ),
+            scheduling=(
+                _scheduling_from_dict(spec_d["scheduling"])
+                if spec_d.get("scheduling") is not None
+                else None
+            ),
         ),
         status=status_from_dict(d["status"]) if "status" in d else TPUJobStatus(),
     )
@@ -260,6 +282,8 @@ def job_to_dict(job: TPUJob) -> Dict[str, Any]:
         spec_d["enableDynamicWorker"] = True
     if spec.autoscaling is not None:
         spec_d["autoscaling"] = _autoscaling_to_dict(spec.autoscaling)
+    if spec.scheduling is not None:
+        spec_d["scheduling"] = _scheduling_to_dict(spec.scheduling)
 
     out: Dict[str, Any] = {
         "apiVersion": API_VERSION,

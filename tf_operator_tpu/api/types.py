@@ -112,6 +112,16 @@ class JobConditionType(str, enum.Enum):
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
     DEGRADED = "Degraded"
+    # Fleet-scheduler conditions (controller/scheduler.py) — like
+    # DEGRADED these are NOT phases: QUEUED marks a gang waiting for
+    # capacity/quota, PREEMPTED marks a job whose slices were reclaimed
+    # for a higher-priority gang, RESUMED marks a previously-preempted
+    # job running again from its checkpoint.  All three coexist with
+    # the phase conditions and are set/cleared by the reconciler's
+    # scheduling gate.
+    QUEUED = "Queued"
+    PREEMPTED = "Preempted"
+    RESUMED = "Resumed"
 
 
 class PodPhase(str, enum.Enum):
@@ -382,6 +392,55 @@ class AutoscalingSpec:
         return AutoscalingSpec(policies=[p.clone() for p in self.policies])
 
 
+#: SchedulingSpec.priority_class values, rank order — index IS the rank
+#: (Kueue/Volcano-shaped fleet scheduling, ROADMAP item 4).  The fleet
+#: scheduler (controller/scheduler.py) admits queued gangs highest
+#: effective rank first and only preempts strictly-lower classes.
+PRIORITY_CLASSES = ("low", "standard", "high", "critical")
+
+#: Default class for jobs that declare ``spec.scheduling`` without a
+#: ``priorityClass``.
+DEFAULT_PRIORITY_CLASS = "standard"
+
+
+def priority_rank(priority_class: str) -> int:
+    """Numeric rank for a priority class (higher = more important).
+    Unknown/empty names rank as the default class — validation rejects
+    unknown names at admission, so this is a belt for stale objects."""
+
+    try:
+        return PRIORITY_CLASSES.index(priority_class)
+    except ValueError:
+        return PRIORITY_CLASSES.index(DEFAULT_PRIORITY_CLASS)
+
+
+@dataclass
+class SchedulingSpec:
+    """Fleet-scheduling declaration (controller/scheduler.py): opting
+    in routes the job through the cluster-level queue — whole-gang
+    admission by priority × age with per-namespace quota accounting,
+    and eligibility for (or exposure to) cross-job preemption.
+
+    Jobs WITHOUT this block bypass the fleet queue entirely (single-job
+    admission, the pre-scheduler behaviour)."""
+
+    #: one of PRIORITY_CLASSES; "" defaults to DEFAULT_PRIORITY_CLASS
+    priority_class: str = ""
+    #: quota-group name, namespaced — chips admitted under the key
+    #: "<namespace>/<quotaGroup>" count against any limit registered
+    #: for it via Scheduler.set_quota; "" = the namespace default group
+    quota_group: str = ""
+
+    def effective_priority_class(self) -> str:
+        return self.priority_class or DEFAULT_PRIORITY_CLASS
+
+    def clone(self) -> "SchedulingSpec":
+        return SchedulingSpec(
+            priority_class=self.priority_class,
+            quota_group=self.quota_group,
+        )
+
+
 @dataclass
 class TPUJobSpec:
     replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
@@ -395,6 +454,9 @@ class TPUJobSpec:
     #: elastic autoscaling policies (controller/autoscaler.py); None =
     #: the operator never touches this job's replica counts
     autoscaling: Optional[AutoscalingSpec] = None
+    #: fleet-scheduling declaration (controller/scheduler.py); None =
+    #: the job bypasses the cluster queue (single-job admission)
+    scheduling: Optional[SchedulingSpec] = None
 
     def total_replicas(self) -> int:
         return sum(int(rs.replicas or 0) for rs in self.replica_specs.values())
@@ -426,6 +488,7 @@ class TPUJobSpec:
             enable_gang_scheduling=self.enable_gang_scheduling,
             enable_dynamic_worker=self.enable_dynamic_worker,
             autoscaling=self.autoscaling.clone() if self.autoscaling else None,
+            scheduling=self.scheduling.clone() if self.scheduling else None,
         )
 
 

@@ -20,6 +20,7 @@ from tf_operator_tpu.api.types import (
     AUTOSCALING_MODES,
     CHIEF_LIKE,
     DEFAULT_CONTAINER_NAME,
+    PRIORITY_CLASSES,
     SIGNAL_KINDS,
     ReplicaType,
     TPUJob,
@@ -177,6 +178,9 @@ def validate(job: TPUJob) -> None:
     if spec.autoscaling is not None:
         _validate_autoscaling(spec, problems)
 
+    if spec.scheduling is not None:
+        _validate_scheduling(spec, problems)
+
     if problems:
         raise ValidationError(problems)
 
@@ -264,3 +268,25 @@ def _validate_autoscaling(spec, problems: List[str]) -> None:
                 and math.isfinite(sig.threshold)
             ):
                 problems.append(f"{spre}.threshold must be finite")
+
+
+def _validate_scheduling(spec, problems: List[str]) -> None:
+    """Structural checks on ``spec.scheduling`` — the fleet scheduler
+    (controller/scheduler.py) keys its queue/quota accounting on these
+    fields, so admission must reject shapes the queue cannot rank.
+    Quota *limits* are cluster operator config (Scheduler.set_quota),
+    not part of the job manifest, so there is nothing numeric here."""
+
+    sched = spec.scheduling
+    if sched.priority_class and sched.priority_class not in PRIORITY_CLASSES:
+        problems.append(
+            "scheduling.priorityClass must be one of "
+            f"{PRIORITY_CLASSES}, got {sched.priority_class!r}"
+        )
+    if sched.quota_group and not _DNS1123.match(sched.quota_group):
+        # the group name joins the namespace in the quota key and is
+        # exported as a metric label — same DNS-1123 hygiene as names
+        problems.append(
+            "scheduling.quotaGroup must be a DNS-1123 label, got "
+            f"{sched.quota_group!r}"
+        )

@@ -39,6 +39,7 @@ from tf_operator_tpu.backend.objects import (
     WatchEventType,
     WatchHandler,
 )
+from tf_operator_tpu.utils.logging import logger_for_job
 
 
 class FakeCluster(ClusterBackend):
@@ -58,6 +59,28 @@ class FakeCluster(ClusterBackend):
         self.deleted_pods: List[str] = []
         self.created_services: List[str] = []
         self.deleted_services: List[str] = []
+        #: fleet-scheduler victim routing (controller/scheduler.py):
+        #: when attached, capacity-shrink revocation asks it to order
+        #: the victims instead of blind LIFO, and every revocation
+        #: emits an attributed Preempted Warning event
+        self._sched_chooser = None
+        self._sched_recorder = None
+
+    def attach_scheduler(self, chooser, recorder=None) -> None:
+        """Route capacity-shrink victim choice through ``chooser``
+        (anything with ``choose_victims(candidates) -> [keys]``) and,
+        when ``recorder`` is given, emit a ``Preempted`` Warning event
+        naming each revoked gang and the capacity change."""
+
+        with self._lock:
+            self._sched_chooser = chooser
+            self._sched_recorder = recorder
+
+    def detach_scheduler(self, chooser) -> None:
+        with self._lock:
+            if self._sched_chooser is chooser:
+                self._sched_chooser = None
+                self._sched_recorder = None
 
     # -- event plumbing -----------------------------------------------------
 
@@ -263,13 +286,57 @@ class FakeCluster(ClusterBackend):
                     if g.phase is PodGroupPhase.GRANTED
                 ]
                 in_use = sum(g.chip_request for g in granted)
-                for g in reversed(granted):
+                # victim order: the attached fleet scheduler's policy
+                # (lowest priority class first — controller/scheduler
+                # .choose_victims) when one is attached, else LIFO
+                # (most-recently granted first; the oldest work keeps
+                # its grant, the volcano-ish convention)
+                victims = list(reversed(granted))
+                if self._sched_chooser is not None:
+                    by_key = {g.key: g for g in granted}
+                    try:
+                        order = self._sched_chooser.choose_victims(
+                            [
+                                {"key": g.key, "chips": g.chip_request}
+                                for g in granted
+                            ]
+                        )
+                        victims = [by_key[k] for k in order if k in by_key]
+                    except Exception as e:  # noqa: BLE001 - fall back to LIFO
+                        logger_for_job("-", "fake-cluster").warning(
+                            "victim chooser failed, using LIFO: %s", e
+                        )
+                for g in victims:
                     if in_use <= total_chips:
                         break
                     g.phase = PodGroupPhase.PENDING
                     in_use -= g.chip_request
                     revoked.append(g.metadata.name)
                     self._emit(WatchEventType.MODIFIED, "PodGroup", g)
+                    if self._sched_chooser is not None:
+                        # synchronous park: the scheduler must know the
+                        # grant is gone BEFORE any sync observes the
+                        # killed pods, or the corpse reads as replica
+                        # failure instead of preemption
+                        try:
+                            self._sched_chooser.note_revoked(
+                                g.key, by="capacity-shrink"
+                            )
+                        except Exception as e:  # noqa: BLE001 - advisory
+                            logger_for_job("-", "fake-cluster").warning(
+                                "note_revoked(%s) failed: %s", g.key, e
+                            )
+                    if self._sched_recorder is not None:
+                        # attribution (no more anonymous exit-137): the
+                        # audit trail names the revoked gang AND why
+                        self._sched_recorder.event(
+                            g.key,
+                            "Warning",
+                            "Preempted",
+                            f"gang {g.metadata.name} revoked: capacity "
+                            f"shrunk to {total_chips} chips "
+                            f"(gang held {g.chip_request})",
+                        )
                 gone = set(revoked)
                 for pod in self._pods.values():
                     gname = pod.metadata.annotations.get(ANNOTATION_GANG_GROUP)

@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from tf_operator_tpu.api.types import ANNOTATION_GANG_GROUP
 from tf_operator_tpu.backend.base import match_selector
 from tf_operator_tpu.backend.kube import parse_selector
+from tf_operator_tpu.utils.logging import logger_for_job
 from tf_operator_tpu.utils.trace import TRACE_HEADER, extract_headers
 
 _REPO_ROOT = os.path.dirname(
@@ -301,6 +302,12 @@ class MiniApiServer:
         #: so /traces/<id> shows client AND server halves of each call
         self.tracer = tracer if tracer is not None else default_tracer
         self.total_chips = total_chips
+        #: optional controller/scheduler.Scheduler: capacity-shrink
+        #: revocation routes victim choice through it (instead of
+        #: blind LIFO) and GET /scheduler serves its snapshot; None
+        #: falls back to the process-global default_scheduler for the
+        #: route and to LIFO for revocation order
+        self.scheduler = None
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="tpujob-kubesim-")
         self.kubelet_interval = kubelet_interval
         self._procs: Dict[Tuple[str, str, str], subprocess.Popen] = {}
@@ -527,6 +534,19 @@ class MiniApiServer:
             )
 
             return self._reply(h, 200, default_autoscaler.snapshot())
+        if u.path == "/scheduler" and method == "GET":
+            # the fleet scheduler's queue/quota/decision log
+            # (controller/scheduler.py) — debug surface, never
+            # injected: the route that explains who took your chips
+            # must survive the chaos that took them
+            sched = self.scheduler
+            if sched is None:
+                from tf_operator_tpu.controller.scheduler import (
+                    default_scheduler,
+                )
+
+                sched = default_scheduler
+            return self._reply(h, 200, sched.snapshot())
         if u.path == "/_capacity":
             return self._admin_capacity(h, method)
         act = self.faults.decide(method, h.path)
@@ -687,9 +707,13 @@ class MiniApiServer:
         with self.store.lock:
             self.total_chips = total_chips
             if total_chips is not None:
-                # revoke most-recently granted gangs until the rest fit
-                # (LIFO preemption — deterministic, and the oldest work
-                # keeps its grant, the volcano-ish convention)
+                # revoke gangs until the rest fit — victim order comes
+                # from the attached fleet scheduler's policy (lowest
+                # priority class first, controller/scheduler
+                # .choose_victims) when one is attached, else LIFO
+                # (most-recently granted first — deterministic, and the
+                # oldest work keeps its grant, the volcano-ish
+                # convention)
                 granted = [
                     (key, o)
                     for key, o in self.store.objects.items()
@@ -697,13 +721,75 @@ class MiniApiServer:
                     and o.get("status", {}).get("phase") == "Granted"
                 ]
                 in_use = sum(self._group_chips(o) for _, o in granted)
-                for key, o in reversed(granted):
+                victims = list(reversed(granted))
+                if self.scheduler is not None:
+                    by_key = {f"{k[1]}/{k[2]}": (k, o) for k, o in granted}
+                    try:
+                        order = self.scheduler.choose_victims(
+                            [
+                                {
+                                    "key": f"{k[1]}/{k[2]}",
+                                    "chips": self._group_chips(o),
+                                }
+                                for k, o in granted
+                            ]
+                        )
+                        victims = [by_key[j] for j in order if j in by_key]
+                    except Exception as e:  # noqa: BLE001 - fall back to LIFO
+                        logger_for_job("-", "kubesim").warning(
+                            "victim chooser failed, using LIFO: %s", e
+                        )
+                for key, o in victims:
                     if in_use <= total_chips:
                         break
                     o["status"]["phase"] = "Pending"
                     in_use -= self._group_chips(o)
                     revoked.append(key[2])
                     self.store.bump("PodGroup", "MODIFIED", o)
+                    if self.scheduler is not None:
+                        # synchronous park (see backend/fake.py): the
+                        # scheduler learns the grant is gone before any
+                        # sync observes the SIGTERM'd pods
+                        try:
+                            self.scheduler.note_revoked(
+                                f"{key[1]}/{key[2]}", by="capacity-shrink"
+                            )
+                        except Exception as e:  # noqa: BLE001 - advisory
+                            logger_for_job("-", "kubesim").warning(
+                                "note_revoked(%s/%s) failed: %s",
+                                key[1], key[2], e,
+                            )
+                    # attributed audit trail (no more anonymous exit
+                    # 137): a v1 Event names the revoked gang and the
+                    # capacity change, exactly what kubectl would show
+                    now = time.time()
+                    ev_ns, ev_name = key[1], key[2]
+                    ev = {
+                        "apiVersion": "v1",
+                        "kind": "Event",
+                        "metadata": {
+                            "name": (
+                                f"{ev_name}.preempted."
+                                f"{int(now * 1e6):016x}"
+                            ),
+                            "namespace": ev_ns,
+                        },
+                        "type": "Warning",
+                        "reason": "Preempted",
+                        "message": (
+                            f"gang {ev_name} revoked: capacity shrunk "
+                            f"to {total_chips} chips (gang held "
+                            f"{self._group_chips(o)})"
+                        ),
+                        "involvedObject": {
+                            "apiVersion": "tpujob.dist/v1",
+                            "kind": "TPUJob",
+                            "name": ev_name,
+                            "namespace": ev_ns,
+                        },
+                    }
+                    self.store.objects[("Event", ev_ns, ev["metadata"]["name"])] = ev
+                    self.store.bump("Event", "ADDED", ev)
                     # preempt the gang's pods: kill their processes so
                     # the kubelet reap marks them Failed with a signal
                     # exit — exactly what losing the slice looks like

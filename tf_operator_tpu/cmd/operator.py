@@ -225,6 +225,13 @@ def main(argv=None) -> int:
             sim = MiniApiServer(
                 total_chips=args.total_chips, log_dir=args.log_dir
             ).start()
+            # capacity revocations route through the fleet scheduler's
+            # victim policy (lowest priority first) instead of LIFO
+            from tf_operator_tpu.controller.scheduler import (
+                default_scheduler as _sched,
+            )
+
+            sim.scheduler = _sched
             url = sim.url
             log.info("embedded mini apiserver listening on %s", url)
         else:
@@ -234,7 +241,19 @@ def main(argv=None) -> int:
         # jobs live IN the apiserver (the reference's TFJob-CRD tier):
         # operator restarts and leader failover resume them from there
         store = KubeJobStore(url)
-        backend = KubeBackend(url)
+        if args.backend == "kube-sim":
+            # the embedded sim owns the chip pool server-side; surface
+            # it as the backend's total_chips so the controller's
+            # capacity probe — and with it fleet queueing/preemption —
+            # tracks set_total_chips shrink/return live
+            class _SimCapacityBackend(KubeBackend):
+                @property
+                def total_chips(self):
+                    return sim.total_chips
+
+            backend = _SimCapacityBackend(url)
+        else:
+            backend = KubeBackend(url)
         config = ReconcilerConfig(
             enable_gang_scheduling=args.enable_gang_scheduling,
             resolver=backend.resolver,
@@ -319,9 +338,19 @@ def main(argv=None) -> int:
         default_scraper as telemetry,
     )
 
+    # fleet scheduler (controller/scheduler.py): priority quota queues +
+    # cross-job gang preemption for jobs that declare spec.scheduling.
+    # PROCESS-GLOBAL for the same reason the autoscaler is: kubesim's
+    # /scheduler debug route and the operator's GET /scheduler must
+    # report the instance that actually runs.
+    from tf_operator_tpu.controller.scheduler import (
+        default_scheduler as scheduler,
+    )
+
     controller = TPUJobController(
         store, backend, config=config, recorder=recorder,
         alerts=alert_engine, autoscaler=autoscaler, telemetry=telemetry,
+        scheduler=scheduler,
     )
     api = ApiServer(
         store,
@@ -331,6 +360,7 @@ def main(argv=None) -> int:
         alerts=alert_engine,
         autoscaler=autoscaler,
         telemetry=telemetry,
+        scheduler=scheduler,
         host=args.host,
         port=args.monitoring_port,
         namespace=args.namespace,
@@ -365,6 +395,7 @@ def main(argv=None) -> int:
     maybe_start_from_env(metrics=controller.metrics)
     alert_engine.start()
     autoscaler.start()
+    scheduler.start()
     telemetry.start()
 
     # monitoring/API surface is up regardless of leadership (reference
@@ -396,6 +427,7 @@ def main(argv=None) -> int:
             stop.wait(0.5)
     finally:
         telemetry.stop()
+        scheduler.stop()
         autoscaler.stop()
         alert_engine.stop()
         if controller_started:

@@ -12,6 +12,7 @@ This client speaks the operator's HTTP job API instead:
     tpujob logs NAME POD [-n ns]         # kubectl logs (local backend)
     tpujob alerts [RULE]                 # alert-engine state (firing first)
     tpujob autoscaler [JOB]              # scale decisions + policy state
+    tpujob queue [JOB]                   # fleet queue + scheduling decisions
     tpujob telemetry [JOB]               # fleet scrape targets (stale first)
     tpujob compile -f job.yaml           # TPUJob -> real Kubernetes YAML
                                          # (backend/gke.py; offline, no server)
@@ -189,6 +190,29 @@ def cmd_describe(args) -> int:
                 d = blk["lastDecision"]
                 line += f"  last: {d.get('direction')} -> {d.get('to')}"
             print(f"  {'autoscale/' + rtype + ':':<18}{line}")
+        sched = health.get("scheduler")
+        if sched:
+            # fleet-scheduler state (ISSUE 16): class/quota always,
+            # queue position + wait while parked, preemption history
+            print("Scheduling:")
+            print(f"  class:            {sched.get('priorityClass', '')}"
+                  f"  quota: {sched.get('quotaGroup', '')}")
+            if sched.get("phase") == "queued":
+                line = f"position {sched.get('queuePosition', '?')}"
+                since = sched.get("queuedSinceUnix")
+                if since is not None:
+                    line += f", waiting {max(0, time.time() - since):.0f}s"
+                if sched.get("reason"):
+                    line += f" ({sched['reason']})"
+                print(f"  queued:           {line}")
+            if sched.get("shedTo") is not None:
+                print(f"  shedTo:           {sched['shedTo']} replicas")
+            if sched.get("preemptions"):
+                print(f"  preemptions:      {sched['preemptions']}")
+            lp = sched.get("lastPreemption")
+            if lp:
+                print(f"  lastPreemption:   {lp.get('action', '')} "
+                      f"({lp.get('reason', '')})")
     events = _request(
         "GET", _jobs_url(args.server, args.namespace, args.name, "events")
     )["items"]
@@ -316,6 +340,63 @@ def cmd_autoscaler(args) -> int:
     return 0
 
 
+def cmd_queue(args) -> int:
+    """GET /scheduler: the fleet queue priority-then-age (the server's
+    ordering — position 1 admits next), admitted gangs below it, and
+    the decision log newest first; with a JOB argument, filtered to
+    that job's queue entry and decisions."""
+
+    snap = _request("GET", f"{args.server}/scheduler")
+    queue = snap.get("queue", [])
+    admitted = snap.get("admitted", [])
+    decisions = snap.get("decisions", [])
+    if args.job:
+        want = args.job if "/" in args.job else f"{args.namespace}/{args.job}"
+        queue = [q for q in queue if q["job"] == want]
+        admitted = [a for a in admitted if a["job"] == want]
+        decisions = [d for d in decisions if d["job"] == want]
+    fmt = "{:<4} {:<24} {:<10} {:<16} {:<7} {:<9} {}"
+    print(fmt.format("POS", "JOB", "CLASS", "QUOTA", "CHIPS", "WAIT(S)",
+                     "REASON"))
+    for q in queue:
+        print(
+            fmt.format(
+                str(q["position"]), q["job"], q["priorityClass"],
+                q["quotaGroup"], str(q["demandChips"]),
+                f"{q['waitSeconds']:.0f}", q.get("reason", ""),
+            )
+        )
+    if not queue:
+        print("  (queue empty)")
+    print("\nADMITTED:")
+    for a in admitted:
+        line = (
+            f"  {a['job']:<24} {a['priorityClass']:<10} "
+            f"{a['quotaGroup']:<16} {a['demandChips']} chips"
+        )
+        if a.get("shedTo") is not None:
+            line += f"  shed to {a['shedTo']} replicas"
+        print(line)
+    if not admitted:
+        print("  (none)")
+    quotas = snap.get("quotas", {})
+    if quotas and not args.job:
+        print("\nQUOTAS:")
+        for key, q in sorted(quotas.items()):
+            limit = q.get("limitChips")
+            print(f"  {key:<24} {q.get('usedChips', 0)}"
+                  f"/{'-' if limit is None else limit} chips")
+    print("\nDECISIONS (newest first):")
+    for d in decisions[: args.limit]:
+        print(
+            f"  {d['job']:<24} {d['action']:<7} [{d['priorityClass']}]  "
+            f"{d['reason']}"
+        )
+    if not decisions:
+        print("  (none)")
+    return 0
+
+
 def cmd_telemetry(args) -> int:
     """GET /federate/targets: per-pod scrape state, stale-first (the
     server's ordering — what needs attention leads, the alerts /
@@ -405,6 +486,15 @@ def build_parser() -> argparse.ArgumentParser:
     asp.add_argument("--limit", type=int, default=20,
                      help="decision-log rows shown")
     asp.set_defaults(fn=cmd_autoscaler)
+
+    qp = sub.add_parser(
+        "queue", help="fleet scheduler queue + decisions"
+    )
+    qp.add_argument("job", nargs="?", default="")
+    qp.add_argument("-n", "--namespace", default="default")
+    qp.add_argument("--limit", type=int, default=20,
+                    help="decision-log rows shown")
+    qp.set_defaults(fn=cmd_queue)
 
     tp = sub.add_parser(
         "telemetry", help="fleet scrape targets + federated families"

@@ -48,6 +48,7 @@ class TPUJobController:
         alerts=None,
         autoscaler=None,
         telemetry=None,
+        scheduler=None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -111,6 +112,20 @@ class TPUJobController:
         self.telemetry = telemetry
         if telemetry is not None:
             telemetry.attach(self._list_cached_pods)
+        #: controller/scheduler.Scheduler (optional): we feed it the
+        #: informer cache as its job source and the backend's chip pool
+        #: as its capacity probe; each decision emits an event and
+        #: re-enqueues the job, and capacity-shrink revocation in the
+        #: backend routes through its victim policy instead of LIFO
+        self.scheduler = scheduler
+        if scheduler is not None:
+            scheduler.attach(
+                self._list_cached_jobs,
+                self._on_sched_decision,
+                capacity=lambda: getattr(backend, "total_chips", None),
+            )
+            if hasattr(backend, "attach_scheduler"):
+                backend.attach_scheduler(scheduler, recorder=self.recorder)
         self.reconciler = Reconciler(
             job_store,
             backend,
@@ -125,6 +140,7 @@ class TPUJobController:
             alerts=alerts,
             autoscaler=autoscaler,
             telemetry=telemetry,
+            scheduler=scheduler,
         )
         self.max_sync_retries = max_sync_retries
         self.resync_period = resync_period
@@ -191,6 +207,21 @@ class TPUJobController:
             f"{decision.replica_type.value} replicas "
             f"{decision.from_replicas} -> {decision.to_replicas}: "
             f"{decision.reason}",
+        )
+        self._enqueue(decision.job_key)
+
+    def _on_sched_decision(self, decision) -> None:
+        """Fleet-scheduler decision callback (runs on its evaluator
+        thread): one event per decision — Normal for queue/admit,
+        Warning for shed/revoke, so a preempted job's audit trail names
+        who took its chips — plus a prompt re-enqueue so the reconciler
+        acts on the new fleet phase without waiting for a watch event."""
+
+        self.recorder.event(
+            decision.job_key,
+            decision.event_type,
+            decision.event_reason,
+            f"fleet scheduler: {decision.action} — {decision.reason}",
         )
         self._enqueue(decision.job_key)
 
@@ -364,6 +395,12 @@ class TPUJobController:
             self.autoscaler.detach(
                 self._list_cached_jobs, self._on_scale_decision
             )
+        if self.scheduler is not None:
+            # same contract: the (possibly process-global) scheduler
+            # outlives this controller and must drop its dead sources
+            self.scheduler.detach(self._list_cached_jobs)
+            if hasattr(self.backend, "detach_scheduler"):
+                self.backend.detach_scheduler(self.scheduler)
         if self.alerts is not None:
             # detach from the (possibly process-global) engine — it
             # outlives this controller and would otherwise pin it and

@@ -126,6 +126,7 @@ class Reconciler:
         alerts=None,
         autoscaler=None,
         telemetry=None,
+        scheduler=None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -153,6 +154,12 @@ class Reconciler:
         #: only, the scraper runs on its own thread and can never
         #: block a sync
         self.telemetry = telemetry
+        #: controller/scheduler.Scheduler (None = no fleet queue):
+        #: jobs declaring spec.scheduling create nothing until their
+        #: gang is admitted; revocations park the job Queued, sheds
+        #: bounce the slice set through the same re-shard path as the
+        #: autoscaler, and the per-job block joins observedHealth
+        self.scheduler = scheduler
         #: job key -> unix of the last health-rollup refresh (throttle)
         self._health_refreshed: Dict[str, float] = {}
 
@@ -203,6 +210,8 @@ class Reconciler:
             self._health_refreshed.pop(key, None)
             if self.autoscaler is not None:
                 self.autoscaler.forget(key)
+            if self.scheduler is not None:
+                self.scheduler.forget(key)
             self._gc_orphans(key)
             return
         log = logger_for_job(job.metadata.namespace, job.metadata.name)
@@ -267,6 +276,15 @@ class Reconciler:
                 "claimed", sum(len(v) for v in pods_by_type.values())
             )
 
+        # fleet-scheduling gate (controller/scheduler.py): a job that
+        # declared spec.scheduling creates NOTHING until the fleet
+        # queue admits its whole gang, and a revoked gang is torn down
+        # and parked Queued until capacity returns — the graceful half
+        # of cross-job preemption
+        if self.scheduler is not None and self.scheduler.manages(job):
+            if not self._sync_scheduling(job, pods_by_type, old_status):
+                return
+
         # elastic training resize: a decided re-shard bounces the whole
         # replica set — the world size is baked into every pod's
         # bootstrap env, so survivors must restart to form the new
@@ -274,6 +292,13 @@ class Reconciler:
         # (parallel/checkpoint.restore_latest redistributes the
         # artifact onto whatever mesh the survivors form)
         if self.autoscaler is not None and self._bounce_for_reshard(
+            job, pods_by_type
+        ):
+            self._update_status(job, old_status)
+            return
+
+        # fleet-preemption shed: same bounce mechanics, scheduler-decided
+        if self.scheduler is not None and self._bounce_for_preemption(
             job, pods_by_type
         ):
             self._update_status(job, old_status)
@@ -452,6 +477,134 @@ class Reconciler:
             self.autoscaler.consume_reshard(key, rtype)
             bounced = True
         return bounced
+
+    # --------------------------------------------------- fleet scheduling
+
+    def _sync_scheduling(self, job: TPUJob, pods_by_type, old_status) -> bool:
+        """Admission gate for fleet-managed jobs.  Returns True when
+        the sync may proceed (gang admitted); False when the job was
+        parked Queued (status written, sync over).
+
+        Queued teardown is the GRACEFUL half of revocation: live pods
+        are deleted (the trainer's async checkpoint survives on disk),
+        the gang group is released so the chips actually free, and the
+        job waits visibly — Queued condition, queue-position gauge,
+        `tpujob_gang_waiting_replicas` — until the scheduler re-admits
+        it, at which point the normal create path rebuilds the world
+        and the trainer restores from its latest checkpoint."""
+
+        key = job.key
+        phase = self.scheduler.admission(job)
+        if phase == "admitted":
+            # the shed ceiling rides this sync's working copy, AFTER
+            # the autoscaler's overlay — the scheduler only clamps, so
+            # the two subsystems cannot fight (coexistence contract,
+            # see controller/scheduler.py docstring)
+            self.scheduler.apply(job)
+            clear_condition(
+                job, JobConditionType.QUEUED, "Admitted",
+                "gang admitted by fleet scheduler",
+            )
+            if self.scheduler.take_resume(key):
+                live = [
+                    p
+                    for pods in pods_by_type.values()
+                    for p in pods
+                    if p.phase is PodPhase.RUNNING
+                ]
+                if live:
+                    msg = (
+                        "resumed from latest checkpoint after preemption "
+                        f"({len(live)} pods running)"
+                    )
+                    set_condition(
+                        job, JobConditionType.RESUMED,
+                        "ResumedFromCheckpoint", msg,
+                    )
+                    self.recorder.event(key, "Normal", "Resumed", msg)
+                    self.scheduler.consume_resume(key)
+            return True
+
+        # ---- queued: tear down, park, wait
+        reason = self.scheduler.queue_reason(key)
+        rev = self.scheduler.take_revocation(key)
+        if rev is not None:
+            msg = (
+                f"gang revoked by fleet scheduler (for {rev.get('by', 'capacity')}); "
+                "queued for re-admission, will resume from checkpoint"
+            )
+            set_condition(job, JobConditionType.PREEMPTED, "GangRevoked", msg)
+            self.recorder.event(key, "Warning", "Preempted", msg)
+            self.scheduler.consume_revocation(key)
+        # delete EVERY claimed pod, not just live ones: a backend
+        # revocation fails its victims' pods (exit 137), and a corpse
+        # left behind would be read as a replica failure at
+        # re-admission — the parked gang must leave nothing to misread
+        for pods in pods_by_type.values():
+            for p in pods:
+                self._delete_pod(key, p)
+        # release the gang grant so the freed chips are really free
+        # (the group is recreated by the normal path on re-admission)
+        try:
+            if self.backend.get_pod_group(
+                job.metadata.namespace, job.metadata.name
+            ):
+                self.backend.delete_pod_group(
+                    job.metadata.namespace, job.metadata.name
+                )
+        except NotFoundError:
+            pass
+        # Running is a live-state marker; a parked gang is not running
+        clear_condition(
+            job, JobConditionType.RUNNING, "GangQueued",
+            "gang parked by fleet scheduler",
+        )
+        set_condition(
+            job, JobConditionType.QUEUED, reason,
+            f"gang waiting in fleet queue ({reason})",
+        )
+        # the whole gang is waiting — same gauge a Pending pod-group
+        # drives, so the slice autoscaling policy and the queue agree
+        self.metrics.set(
+            "tpujob_gang_waiting_replicas",
+            float(job.spec.total_pods()),
+            job=key,
+        )
+        self._rollup_health(job)
+        self._update_status(job, old_status)
+        return False
+
+    def _bounce_for_preemption(self, job: TPUJob, pods_by_type) -> bool:
+        """Execute a scheduler-decided slice shed: delete the TPU_SLICE
+        pods so the next sync recreates the set at the shed-to world
+        size (same mechanics as _bounce_for_reshard — re-shard + resume
+        from the latest async checkpoint, `dp`-only-over-DCN intact)."""
+
+        key = job.key
+        target = self.scheduler.take_preemption(key)
+        if target is None:
+            return False
+        pods = pods_by_type.get(ReplicaType.TPU_SLICE, [])
+        live = [
+            p for p in pods if p.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+        ]
+        if not live:
+            # the set already finished — shedding a completed set would
+            # re-run the job (same guard as the autoscaler bounce)
+            self.scheduler.consume_preemption(key)
+            return False
+        want = job.spec.pod_count(ReplicaType.TPU_SLICE)
+        msg = (
+            f"fleet preemption: shedding to {target} slice(s) "
+            f"(world size {want}; re-shard + resume from checkpoint)"
+        )
+        set_condition(job, JobConditionType.PREEMPTED, "SliceShed", msg)
+        self.recorder.event(key, "Warning", "Preempted", msg)
+        self.metrics.inc("tpujob_reshards_total")
+        for p in pods:
+            self._delete_pod(key, p)
+        self.scheduler.consume_preemption(key)
+        return True
 
     # ------------------------------------------------------- pod reconcile
 
@@ -728,6 +881,10 @@ class Reconciler:
         # the slice autoscaling policies (per-object gauge hygiene —
         # the autoscaler_desired_replicas rule)
         self.metrics.clear_gauge("tpujob_gang_waiting_replicas", job=job.key)
+        # same hygiene for the fleet queue: a finished job must not
+        # hold a queue position, a stall stamp, or quota chips
+        if self.scheduler is not None:
+            self.scheduler.forget(job.key)
 
     def _fail_job(self, job: TPUJob, reason: str, message: str) -> None:
         job.status.completion_time = job.status.completion_time or time.time()
@@ -850,6 +1007,7 @@ class Reconciler:
             self.alerts is None
             and self.autoscaler is None
             and self.telemetry is None
+            and self.scheduler is None
         ):
             return
         if job.is_terminal():
@@ -868,6 +1026,11 @@ class Reconciler:
             if self.autoscaler is not None
             else None
         )
+        sched_blk = (
+            self.scheduler.health_block(job)
+            if self.scheduler is not None
+            else None
+        )
         now = time.time()
         throttled = now - self._health_refreshed.get(key, 0.0) < max(
             self.config.health_refresh_seconds,
@@ -878,6 +1041,8 @@ class Reconciler:
             and firing == job.status.observed_health.get("firingAlerts", [])
             # a scale decision must land promptly, like a firing change
             and auto_blk == job.status.observed_health.get("autoscaler")
+            # so must a queue/preemption transition
+            and sched_blk == job.status.observed_health.get("scheduler")
         ):
             return
         self._health_refreshed[key] = now
@@ -927,6 +1092,8 @@ class Reconciler:
             health["throughputStepsPerSec"] = tput
         if auto_blk:
             health["autoscaler"] = auto_blk
+        if sched_blk:
+            health["scheduler"] = sched_blk
         # fleet telemetry (ISSUE 15): per-pod scrape rows — staleness,
         # failure counts, federated step rate — so describe shows the
         # FLEET's health, not just the operator's own aggregates

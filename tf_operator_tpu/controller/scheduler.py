@@ -1,0 +1,971 @@
+"""Fleet scheduler — priority quota queues + cross-job gang preemption.
+
+The cluster-level half of gang scheduling (ROADMAP item 4, the
+Kueue/Volcano shape; SURVEY.md's gang/pod-group inventory is the
+grounding reference).  Single-job admission (backend/fake.py's
+PodGroup grant, kubesim's scheduler sim) answers "do these chips
+exist"; this subsystem answers "who deserves them":
+
+- **queue** — TPUJobs that declare ``spec.scheduling`` enter a fleet
+  queue and are admitted WHOLE-GANG in priority × age order: effective
+  rank = class rank + ``wait // age_boost_seconds``, so a starved
+  low-priority gang eventually outranks fresh high-priority arrivals
+  (anti-starvation; the ``gang-queue-stall`` alert rule watches the
+  same ``scheduler_queued_since_unix`` stamp).
+- **quota** — admitted chips are accounted per ``<namespace>/<group>``
+  key; a group at its registered limit queues with reason
+  ``QuotaExceeded`` and is NEVER helped by preemption (quota is a hard
+  cap, not a priority).
+- **preemption** — when a queued gang outranks the running fleet but
+  no free chips remain, the scheduler picks victims (lowest class →
+  youngest grant → smallest checkpoint debt) and reclaims just enough:
+  a multi-slice victim SHEDS whole slices (the reconciler routes the
+  resize through the same checkpoint-freshness-gated bounce as PR 14's
+  autoscaler resharding, so ``dp``-only-over-DCN survives), a
+  single-slice victim is REVOKED back to the queue whole.  Elective
+  preemption is gated on victim checkpoint freshness — a victim whose
+  latest async checkpoint is unknown or stale is skipped
+  (``scheduler_skipped_total{reason="checkpoint_stale"}``) rather than
+  robbed of unbounded work.  Capacity-shrink reclaim (the pool itself
+  shrank underneath admitted demand) bypasses the gate: those chips
+  are already gone, holding the grant would just wedge the queue.
+
+Autoscaler coexistence (PR 7): the scheduler only ever LOWERS a
+TPU_SLICE replica count via the same working-clone overlay mechanism
+(``apply``), applied after the autoscaler's, and never touches jobs
+without ``spec.scheduling`` — the two subsystems converge because both
+express desires as overlays the reconciler resolves on every sync, and
+a shed ceiling simply clamps whatever the autoscaler wants.
+
+Deliberately NOT here: pod placement (the backends own bin-packing;
+slice alignment is preserved because the unit of everything above is a
+whole slice) and replica surgery (the reconciler owns pods — this
+class only publishes decisions and overlays).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from tf_operator_tpu.api.types import (
+    DEFAULT_PRIORITY_CLASS,
+    ReplicaType,
+    TPUJob,
+    priority_rank,
+)
+from tf_operator_tpu.api.validation import parse_tpu_topology
+from tf_operator_tpu.controller.autoscaler import job_checkpoint_age
+from tf_operator_tpu.utils.logging import logger_for_job
+
+#: decision-log ring size (mirrors controller/autoscaler.py)
+MAX_DECISIONS = 256
+
+#: seconds of queue wait per +1 effective priority rank — the
+#: anti-starvation age boost.  At the default, a "low" gang that has
+#: waited 3 × 300 s ranks even with a fresh "critical" arrival.
+AGE_BOOST_SECONDS = 300.0
+
+#: floor between preemptions touching the same victim, and the grace a
+#: fresh admission enjoys before it may be victimised — half of the
+#: zero-decision-flapping story (the other half: decisions are only
+#: emitted on state TRANSITIONS, never re-emitted per sweep)
+PREEMPTION_COOLDOWN_SECONDS = 30.0
+
+#: elective-preemption checkpoint gate: a victim's newest async
+#: checkpoint must be at most this old, else it is skipped (mirrors
+#: the autoscaler's max_checkpoint_age_seconds resize gate)
+MAX_VICTIM_CHECKPOINT_AGE_SECONDS = 900.0
+
+#: how long a gang may be ABSENT from the lister snapshot before its
+#: state is dropped.  The lister is an informer cache: a broken watch
+#: re-listing under apiserver faults can briefly return a snapshot
+#: missing live jobs, and forgetting on one blip would reset queue age,
+#: shed ceilings, and cooldowns — then double-count the re-admission
+#: (the contention soak caught exactly this flap).  Jobs OBSERVED
+#: terminal/unmanaged, and explicit forget() from the reconciler's
+#: deletion path, still drop state immediately.
+MISSING_GRACE_SECONDS = 10.0
+
+
+def gang_demand(job: TPUJob) -> int:
+    """Chips this job's gang occupies when fully placed: Σ over
+    TPU_SLICE replica sets of replicas × slice topology chips.  Jobs
+    with no TPU_SLICE replicas demand 0 chips — they queue (and rank,
+    and count in the decision log) but never contend for the pool,
+    exactly like a CPU-only gang on an accelerator cluster."""
+
+    chips = 0
+    for rtype, rspec in job.spec.replica_specs.items():
+        if rtype is not ReplicaType.TPU_SLICE:
+            continue
+        try:
+            per_slice = parse_tpu_topology(rspec.tpu_topology)
+        except ValueError:
+            continue  # validation rejects this at admission
+        chips += int(rspec.replicas or 0) * per_slice
+    return chips
+
+
+def slice_chips(job: TPUJob) -> int:
+    """Chips of ONE slice replica (0 when the job has none)."""
+
+    rspec = job.spec.replica_specs.get(ReplicaType.TPU_SLICE)
+    if rspec is None:
+        return 0
+    try:
+        return parse_tpu_topology(rspec.tpu_topology)
+    except ValueError:
+        return 0
+
+
+@dataclass
+class SchedulerDecision:
+    """One scheduling decision — what the event, the ``GET /scheduler``
+    log entry, and the observedHealth block all describe."""
+
+    time: float
+    job_key: str
+    #: "queue" | "admit" | "shed" | "revoke"
+    action: str
+    priority_class: str
+    quota_group: str
+    reason: str
+    details: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def event_reason(self) -> str:
+        return {
+            "queue": "Queued",
+            "admit": "Admitted",
+            "shed": "Preempted",
+            "revoke": "Preempted",
+        }.get(self.action, "Scheduled")
+
+    @property
+    def event_type(self) -> str:
+        return "Warning" if self.action in ("shed", "revoke") else "Normal"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "time": round(self.time, 3),
+            "job": self.job_key,
+            "action": self.action,
+            "priorityClass": self.priority_class,
+            "quotaGroup": self.quota_group,
+            "reason": self.reason,
+            "details": dict(self.details),
+        }
+
+
+class _GangState:
+    """Runtime state of one fleet-managed job."""
+
+    __slots__ = (
+        "job", "phase", "priority_class", "rank", "quota_key", "demand",
+        "queued_since", "queue_reason", "position", "admitted_at",
+        "shed_target", "preempt_pending", "revoke_pending",
+        "preempted_at", "preemptions", "was_preempted", "resume_pending",
+        "last_preemption", "missing_since",
+    )
+
+    def __init__(self, job: TPUJob, now: float):
+        self.job = job
+        self.phase = "queued"
+        sched = job.spec.scheduling
+        self.priority_class = (
+            sched.effective_priority_class() if sched else DEFAULT_PRIORITY_CLASS
+        )
+        self.rank = priority_rank(self.priority_class)
+        group = (sched.quota_group if sched else "") or "default"
+        self.quota_key = f"{job.metadata.namespace}/{group}"
+        self.demand = gang_demand(job)
+        self.queued_since = now
+        self.queue_reason = "WaitingForCapacity"
+        self.position = 0
+        self.admitted_at = 0.0
+        #: admitted-but-shed ceiling on TPU_SLICE replicas (overlay)
+        self.shed_target: Optional[int] = None
+        #: shed handshake — the reconciler bounces the slice set once
+        self.preempt_pending = False
+        #: revoke handshake — the reconciler stamps Preempted once
+        self.revoke_pending = False
+        self.preempted_at = 0.0
+        self.preemptions = 0
+        self.was_preempted = False
+        #: set at re-admission of a preempted gang; the reconciler
+        #: consumes it into the Resumed condition once Running again
+        self.resume_pending = False
+        self.last_preemption: Optional[Dict[str, Any]] = None
+        #: first sweep the job was ABSENT from the lister snapshot (0 =
+        #: currently listed); see the forget-grace note in _evaluate_locked
+        self.missing_since = 0.0
+
+
+class Scheduler:
+    """The fleet queue controller.  Sharing model mirrors
+    controller/autoscaler.Autoscaler: one instance per operator
+    process (``default_scheduler``), attached to a controller's cached
+    job lister + event callback + backend capacity probe, evaluated
+    either by its own ticker thread or explicitly (tests, soaks)."""
+
+    def __init__(
+        self,
+        metrics=None,
+        interval: float = 5.0,
+        max_decisions: int = MAX_DECISIONS,
+        age_boost_seconds: float = AGE_BOOST_SECONDS,
+        preemption_cooldown_seconds: float = PREEMPTION_COOLDOWN_SECONDS,
+        max_victim_checkpoint_age_seconds: float = (
+            MAX_VICTIM_CHECKPOINT_AGE_SECONDS
+        ),
+        missing_grace_seconds: float = MISSING_GRACE_SECONDS,
+    ):
+        if metrics is None:
+            from tf_operator_tpu.utils.metrics import default_metrics
+
+            metrics = default_metrics
+        self.metrics = metrics
+        self.interval = interval
+        self.age_boost_seconds = age_boost_seconds
+        self.preemption_cooldown_seconds = preemption_cooldown_seconds
+        self.max_victim_checkpoint_age_seconds = max_victim_checkpoint_age_seconds
+        self.missing_grace_seconds = missing_grace_seconds
+        self._lock = threading.Lock()
+        self._states: Dict[str, _GangState] = {}
+        #: job key -> uid of an incarnation OBSERVED terminal.  Terminal
+        #: is forever for one uid: a stale informer re-list can hand a
+        #: sweep an old copy of a finished job without its Succeeded
+        #: condition, and re-registering it would re-admit (and
+        #: double-count) a job that already completed.  A recreated job
+        #: (same name, new uid) registers normally.
+        self._terminal_uids: Dict[str, str] = {}
+        self._decisions: deque = deque(maxlen=max_decisions)
+        self._quotas: Dict[str, float] = {}
+        self._quota_gauge_keys: set = set()
+        self._list_jobs: Optional[Callable[[], List[TPUJob]]] = None
+        self._on_decision: Optional[Callable[[SchedulerDecision], None]] = None
+        self._capacity: Optional[Callable[[], Optional[int]]] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- wiring -------------------------------------------------------------
+
+    def attach(
+        self,
+        list_jobs: Callable[[], List[TPUJob]],
+        on_decision: Optional[Callable[[SchedulerDecision], None]] = None,
+        capacity: Optional[Callable[[], Optional[int]]] = None,
+    ) -> None:
+        with self._lock:
+            self._list_jobs = list_jobs
+            self._on_decision = on_decision
+            self._capacity = capacity
+
+    def detach(self, list_jobs: Callable[[], List[TPUJob]]) -> None:
+        with self._lock:
+            if self._list_jobs is list_jobs:
+                self._list_jobs = None
+                self._on_decision = None
+                self._capacity = None
+
+    def set_quota(
+        self, namespace: str, group: str, chips: Optional[float]
+    ) -> None:
+        """Register (chips) or delete (None) the limit for one
+        ``<namespace>/<group>`` quota key — cluster-operator config,
+        deliberately NOT part of the job manifest."""
+
+        key = f"{namespace}/{group or 'default'}"
+        with self._lock:
+            if chips is None:
+                self._quotas.pop(key, None)
+                self.metrics.clear_gauge(
+                    "scheduler_quota_limit_chips", quota=key
+                )
+            else:
+                self._quotas[key] = float(chips)
+                self.metrics.set(
+                    "scheduler_quota_limit_chips", float(chips), quota=key
+                )
+
+    # -- reconciler surface -------------------------------------------------
+
+    def manages(self, job: TPUJob) -> bool:
+        return job.spec.scheduling is not None
+
+    def admission(self, job: TPUJob) -> str:
+        """Register the job on first sight and return its fleet phase
+        ("queued" | "admitted").  Registration is silent — the next
+        ``evaluate_once`` sweep emits the queue/admit decision — but
+        the queue-wait stamp starts NOW, so the stall rule and the age
+        boost measure from arrival, not from the first sweep."""
+
+        with self._lock:
+            st = self._ensure_locked(job, time.time())
+            return st.phase
+
+    def apply(self, job: TPUJob) -> None:
+        """Overlay the shed ceiling onto a WORKING CLONE of the job
+        (the reconciler's in-sync copy — never the cached object),
+        after the autoscaler's overlay: the scheduler's ceiling clamps
+        whatever the autoscaler wanted, so the two cannot flap."""
+
+        with self._lock:
+            st = self._states.get(job.key)
+            if st is None or st.phase != "admitted" or st.shed_target is None:
+                return
+            rspec = job.spec.replica_specs.get(ReplicaType.TPU_SLICE)
+            if rspec is None:
+                return
+            current = int(rspec.replicas or 0)
+            if current > st.shed_target:
+                rspec.replicas = st.shed_target
+
+    def take_preemption(self, job_key: str) -> Optional[int]:
+        """Peek the pending shed bounce for this job: the TPU_SLICE
+        replica target, or None.  Mirrors Autoscaler.take_reshard —
+        peek here, act, then ``consume_preemption`` only after the
+        pods are actually gone, so a crash between the two replays the
+        bounce instead of losing it."""
+
+        with self._lock:
+            st = self._states.get(job_key)
+            if st is None or not st.preempt_pending:
+                return None
+            return st.shed_target
+
+    def consume_preemption(self, job_key: str) -> None:
+        with self._lock:
+            st = self._states.get(job_key)
+            if st is not None:
+                st.preempt_pending = False
+
+    def take_revocation(self, job_key: str) -> Optional[Dict[str, Any]]:
+        """Peek the pending whole-gang revocation (the reconciler
+        stamps the Preempted condition + event from it, deletes the
+        pods, then ``consume_revocation``)."""
+
+        with self._lock:
+            st = self._states.get(job_key)
+            if st is None or not st.revoke_pending:
+                return None
+            return dict(st.last_preemption or {"mode": "revoke"})
+
+    def consume_revocation(self, job_key: str) -> None:
+        with self._lock:
+            st = self._states.get(job_key)
+            if st is not None:
+                st.revoke_pending = False
+
+    def take_resume(self, job_key: str) -> bool:
+        with self._lock:
+            st = self._states.get(job_key)
+            return bool(st is not None and st.resume_pending)
+
+    def consume_resume(self, job_key: str) -> None:
+        with self._lock:
+            st = self._states.get(job_key)
+            if st is not None:
+                st.resume_pending = False
+
+    def queue_reason(self, job_key: str) -> str:
+        with self._lock:
+            st = self._states.get(job_key)
+            return st.queue_reason if st is not None else "WaitingForCapacity"
+
+    def health_block(self, job: TPUJob) -> Optional[Dict[str, Any]]:
+        """The ``observedHealth["scheduler"]`` sub-block (camelCase,
+        like the autoscaler's)."""
+
+        with self._lock:
+            st = self._states.get(job.key)
+            if st is None:
+                return None
+            block: Dict[str, Any] = {
+                "phase": st.phase,
+                "priorityClass": st.priority_class,
+                "quotaGroup": st.quota_key,
+            }
+            if st.phase == "queued":
+                block["queuePosition"] = st.position
+                # the STABLE stamp, not a wait age: this block is
+                # compared by the health rollup's write throttle, and
+                # an ever-changing age would turn every sync into a
+                # status write (readers derive the age)
+                block["queuedSinceUnix"] = round(st.queued_since, 3)
+                block["reason"] = st.queue_reason
+            if st.shed_target is not None:
+                block["shedTo"] = st.shed_target
+            if st.preemptions:
+                block["preemptions"] = st.preemptions
+            if st.last_preemption is not None:
+                block["lastPreemption"] = dict(st.last_preemption)
+            return block
+
+    def forget(self, job_key: str) -> None:
+        """Mark a deleted/terminal job for removal.
+
+        Soft on purpose: the reconciler calls this when the job is gone
+        from ITS informer cache, and under apiserver faults a broken
+        watch's re-list can make a live job vanish for one sync.  The
+        mark starts the same missing-grace clock the evaluator uses —
+        a real deletion stays absent from the lister and is dropped
+        when the grace expires, a cache blip re-lists the job and the
+        next sweep clears the mark; a job observed terminal is dropped
+        (and tombstoned) by the sweep itself, immediately."""
+
+        with self._lock:
+            st = self._states.get(job_key)
+            if st is None:
+                return
+            if self.missing_grace_seconds <= 0:
+                self._forget_locked(job_key)
+            elif st.missing_since == 0.0:
+                st.missing_since = time.time()
+
+    def _forget_locked(self, job_key: str) -> None:
+        self._states.pop(job_key, None)
+        self.metrics.clear_gauge("scheduler_queue_position", job=job_key)
+        self.metrics.clear_gauge("scheduler_queued_since_unix", job=job_key)
+
+    # -- backend victim routing (satellite: no more blind LIFO) -------------
+
+    def choose_victims(self, candidates: List[Dict[str, Any]]) -> List[str]:
+        """Order revocation candidates for a backend capacity shrink.
+
+        ``candidates`` are granted gangs in GRANT ORDER, each
+        ``{"key": "<ns>/<name>", "chips": int}``.  Returns ALL
+        candidate keys in victim order (the backend revokes a prefix
+        until the rest fit): lowest priority class first, then
+        latest-granted first within a class.  Gangs the fleet queue
+        does not manage rank as the default class — so a fleet "low"
+        job is sacrificed before unmanaged work, and unmanaged work
+        before fleet "high", keeping one coherent policy across both
+        admission paths."""
+
+        default_rank = priority_rank(DEFAULT_PRIORITY_CLASS)
+        with self._lock:
+
+            def key(item):
+                idx, cand = item
+                st = self._states.get(cand.get("key", ""))
+                rank = st.rank if st is not None else default_rank
+                return (rank, -idx)
+
+            ordered = sorted(enumerate(candidates), key=key)
+        return [cand.get("key", "") for _, cand in ordered]
+
+    def note_revoked(self, job_key: str, by: str = "capacity-shrink") -> None:
+        """Backend-side revocation report: the backend already pulled
+        the grant (capacity shrank underneath it) and killed the pods —
+        park the gang NOW, synchronously, so a reconciler sync that
+        lands between the backend's kill and the next scheduler sweep
+        reads "queued" and tears down gracefully instead of reading the
+        exit-137 corpses as replica failures and failing the job.  The
+        demand==need call forces the revoke branch (the whole grant is
+        gone; there is nothing left to shed)."""
+
+        now = time.time()
+        emitted: List[SchedulerDecision] = []
+        with self._lock:
+            st = self._states.get(job_key)
+            if st is None or st.phase != "admitted":
+                return
+
+            def decide(stx, action, reason, **details):
+                d = SchedulerDecision(
+                    time=now,
+                    job_key=stx.job.key,
+                    action=action,
+                    priority_class=stx.priority_class,
+                    quota_group=stx.quota_key,
+                    reason=reason,
+                    details=details,
+                )
+                self._decisions.append(d)
+                emitted.append(d)
+
+            self._preempt_locked(
+                st, need=max(1, st.demand), now=now, by=by,
+                reason_label="capacity", decide=decide,
+            )
+            cb = self._on_decision
+        for d in emitted:
+            if cb is not None:
+                try:
+                    cb(d)
+                except Exception as e:  # noqa: BLE001 - observer must not wedge
+                    logger_for_job("-", "scheduler").warning(
+                        "decision observer failed: %s", e
+                    )
+
+    # -- introspection ------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """``GET /scheduler``: pending queue (priority-then-age order
+        with positions), admitted set, quota accounting, and the
+        decision log newest-first."""
+
+        now = time.time()
+        with self._lock:
+            pending = [
+                st for st in self._states.values() if st.phase == "queued"
+            ]
+            pending.sort(key=lambda st: self._queue_sort_key(st, now))
+            queue = [
+                {
+                    "job": st.job.key,
+                    "priorityClass": st.priority_class,
+                    "quotaGroup": st.quota_key,
+                    "position": i + 1,
+                    "waitSeconds": round(max(0.0, now - st.queued_since), 1),
+                    "demandChips": st.demand,
+                    "reason": st.queue_reason,
+                }
+                for i, st in enumerate(pending)
+            ]
+            admitted = [
+                {
+                    "job": st.job.key,
+                    "priorityClass": st.priority_class,
+                    "quotaGroup": st.quota_key,
+                    "demandChips": st.demand,
+                    "admittedAt": round(st.admitted_at, 3),
+                    **(
+                        {"shedTo": st.shed_target}
+                        if st.shed_target is not None
+                        else {}
+                    ),
+                }
+                for st in self._states.values()
+                if st.phase == "admitted"
+            ]
+            admitted.sort(key=lambda a: a["job"])
+            used: Dict[str, float] = {}
+            for st in self._states.values():
+                if st.phase == "admitted":
+                    used[st.quota_key] = used.get(st.quota_key, 0.0) + st.demand
+            quotas = {
+                k: {
+                    "limitChips": self._quotas.get(k),
+                    "usedChips": used.get(k, 0.0),
+                }
+                for k in sorted(set(self._quotas) | set(used))
+            }
+            decisions = [d.to_dict() for d in reversed(self._decisions)]
+        return {
+            "queue": queue,
+            "admitted": admitted,
+            "quotas": quotas,
+            "decisions": decisions,
+        }
+
+    # -- evaluation ---------------------------------------------------------
+
+    def evaluate_once(self, now: Optional[float] = None) -> None:
+        """One scheduling sweep.  Decision callbacks run OUTSIDE the
+        lock (they enqueue reconciler syncs / record events)."""
+
+        if now is None:
+            now = time.time()
+        with self._lock:
+            lister = self._list_jobs
+        if lister is None:
+            return
+        try:
+            jobs = list(lister())
+        except Exception:  # noqa: BLE001 - lister races job deletion
+            return
+        with self._lock:
+            decisions = self._evaluate_locked(jobs, now)
+            cb = self._on_decision
+        self.metrics.inc("scheduler_evaluations_total")
+        for d in decisions:
+            if cb is not None:
+                try:
+                    cb(d)
+                except Exception as e:  # noqa: BLE001 - observer must not wedge
+                    logger_for_job("-", "scheduler").warning(
+                        "decision observer failed: %s", e
+                    )
+
+    def _ensure_locked(self, job: TPUJob, now: float) -> _GangState:
+        st = self._states.get(job.key)
+        if st is not None and (
+            (job.metadata.uid or "") != (st.job.metadata.uid or "")
+        ):
+            # same name, new incarnation (deleted + recreated inside
+            # the forget grace): the old grant must not carry over
+            self._forget_locked(job.key)
+            st = None
+        if st is None:
+            st = _GangState(job, now)
+            self._states[job.key] = st
+            self.metrics.set(
+                "scheduler_queued_since_unix", st.queued_since, job=job.key
+            )
+        else:
+            st.job = job
+        return st
+
+    def _queue_sort_key(self, st: _GangState, now: float):
+        boost = 0
+        if self.age_boost_seconds > 0:
+            boost = int(max(0.0, now - st.queued_since) // self.age_boost_seconds)
+        return (-(st.rank + boost), st.queued_since, st.job.key)
+
+    def _victim_sort_key(self, st: _GangState, now: float):
+        age = job_checkpoint_age(st.job, now, self.metrics)
+        return (
+            st.rank,
+            -st.admitted_at,
+            age if age is not None else float("inf"),
+        )
+
+    def _evaluate_locked(
+        self, jobs: List[TPUJob], now: float
+    ) -> List[SchedulerDecision]:
+        decisions: List[SchedulerDecision] = []
+
+        def decide(
+            st: _GangState, action: str, reason: str, **details
+        ) -> None:
+            d = SchedulerDecision(
+                time=now,
+                job_key=st.job.key,
+                action=action,
+                priority_class=st.priority_class,
+                quota_group=st.quota_key,
+                reason=reason,
+                details=details,
+            )
+            self._decisions.append(d)
+            decisions.append(d)
+
+        # 1. refresh the managed set from the lister snapshot.  Jobs
+        # OBSERVED terminal/unmanaged drop immediately; jobs merely
+        # ABSENT get a grace window before their state is forgotten —
+        # the lister is an informer cache, and a watch re-list under
+        # apiserver faults can briefly hand us a snapshot missing live
+        # jobs (see MISSING_GRACE_SECONDS)
+        live: Dict[str, _GangState] = {}
+        dropped: set = set()
+        for job in jobs:
+            uid = job.metadata.uid or ""
+            if (
+                not self.manages(job)
+                or job.invalid_reason is not None
+                or job.is_terminal()
+            ):
+                if self.manages(job) and job.is_terminal():
+                    self._terminal_uids[job.key] = uid
+                    while len(self._terminal_uids) > 1024:
+                        self._terminal_uids.pop(
+                            next(iter(self._terminal_uids))
+                        )
+                dropped.add(job.key)
+                continue
+            if self._terminal_uids.get(job.key) == uid:
+                # stale re-list resurrecting a finished incarnation
+                dropped.add(job.key)
+                continue
+            st = self._ensure_locked(job, now)
+            st.missing_since = 0.0
+            # spec may have changed underneath us (user edit); demand
+            # follows the spec, clamped by any standing shed ceiling
+            st.demand = self._effective_demand(st)
+            live[job.key] = st
+        for key in [k for k in self._states if k not in live]:
+            st = self._states[key]
+            if key not in dropped:
+                if st.missing_since == 0.0:
+                    st.missing_since = now
+                if now - st.missing_since < self.missing_grace_seconds:
+                    # lister blip: keep the gang (cached job object)
+                    # so queue age, grants, and cooldowns survive
+                    live[key] = st
+                    continue
+            self._forget_locked(key)
+
+        # 2. capacity + usage
+        capacity: Optional[int] = None
+        if self._capacity is not None:
+            try:
+                capacity = self._capacity()
+            except Exception:  # noqa: BLE001 - backend probe is advisory
+                capacity = None
+        used = sum(
+            st.demand for st in live.values() if st.phase == "admitted"
+        )
+
+        # 3. capacity-shrink reclaim: the pool shrank beneath admitted
+        # demand — reclaim by victim policy, NO checkpoint gate (the
+        # chips are already gone; see module docstring)
+        if capacity is not None and used > capacity:
+            victims = sorted(
+                (st for st in live.values() if st.phase == "admitted"),
+                key=lambda st: self._victim_sort_key(st, now),
+            )
+            for v in victims:
+                if used <= capacity:
+                    break
+                used -= self._preempt_locked(
+                    v, need=used - capacity, now=now, by="capacity-shrink",
+                    reason_label="capacity", decide=decide,
+                )
+
+        # 4. queue pass: admit in priority × age order, electively
+        # preempting strictly-lower classes when the pool is full
+        pending = sorted(
+            (st for st in live.values() if st.phase == "queued"),
+            key=lambda st: self._queue_sort_key(st, now),
+        )
+        for st in pending:
+            limit = self._quotas.get(st.quota_key)
+            if limit is not None:
+                quota_used = sum(
+                    o.demand
+                    for o in live.values()
+                    if o.phase == "admitted" and o.quota_key == st.quota_key
+                )
+                if quota_used + st.demand > limit:
+                    if st.queue_reason != "QuotaExceeded":
+                        st.queue_reason = "QuotaExceeded"
+                        decide(
+                            st, "queue",
+                            f"quota {st.quota_key} at "
+                            f"{quota_used:g}/{limit:g} chips",
+                            demandChips=st.demand,
+                        )
+                    continue
+            if capacity is not None and used + st.demand > capacity:
+                freed = self._elective_preemption_locked(
+                    st, need=used + st.demand - capacity, now=now,
+                    live=live, decide=decide,
+                )
+                used -= freed
+                if used + st.demand > capacity:
+                    if st.queue_reason != "WaitingForCapacity":
+                        st.queue_reason = "WaitingForCapacity"
+                    if not any(
+                        d.job_key == st.job.key and d.action == "queue"
+                        for d in self._decisions
+                    ):
+                        decide(
+                            st, "queue",
+                            f"needs {st.demand} chips, "
+                            f"{max(0, (capacity or 0) - used)} free",
+                            demandChips=st.demand,
+                        )
+                    continue
+            # admit
+            st.phase = "admitted"
+            st.admitted_at = now
+            wait = max(0.0, now - st.queued_since)
+            st.position = 0
+            self.metrics.clear_gauge(
+                "scheduler_queue_position", job=st.job.key
+            )
+            self.metrics.clear_gauge(
+                "scheduler_queued_since_unix", job=st.job.key
+            )
+            self.metrics.inc("scheduler_admitted_total")
+            used += st.demand
+            reason = f"rank {st.rank} ({st.priority_class}), waited {wait:.0f}s"
+            if st.was_preempted:
+                st.resume_pending = True
+                reason += "; resuming from checkpoint after preemption"
+            decide(st, "admit", reason, demandChips=st.demand,
+                   waitSeconds=round(wait, 1))
+
+        # 5. gauges: queue positions + quota usage
+        still_pending = [
+            st for st in live.values() if st.phase == "queued"
+        ]
+        still_pending.sort(key=lambda st: self._queue_sort_key(st, now))
+        for i, st in enumerate(still_pending):
+            st.position = i + 1
+            self.metrics.set(
+                "scheduler_queue_position", float(i + 1), job=st.job.key
+            )
+            self.metrics.set(
+                "scheduler_queued_since_unix", st.queued_since, job=st.job.key
+            )
+        quota_used: Dict[str, float] = {k: 0.0 for k in self._quota_gauge_keys}
+        for st in live.values():
+            if st.phase == "admitted":
+                quota_used[st.quota_key] = (
+                    quota_used.get(st.quota_key, 0.0) + st.demand
+                )
+        for k, v in quota_used.items():
+            if v <= 0 and k not in self._quotas:
+                self._quota_gauge_keys.discard(k)
+                self.metrics.clear_gauge("scheduler_quota_used_chips", quota=k)
+            else:
+                self._quota_gauge_keys.add(k)
+                self.metrics.set("scheduler_quota_used_chips", v, quota=k)
+        return decisions
+
+    def _effective_demand(self, st: _GangState) -> int:
+        demand = gang_demand(st.job)
+        if st.phase == "admitted" and st.shed_target is not None:
+            per = slice_chips(st.job)
+            rspec = st.job.spec.replica_specs.get(ReplicaType.TPU_SLICE)
+            declared = int(rspec.replicas or 0) if rspec is not None else 0
+            demand -= max(0, declared - st.shed_target) * per
+        return max(0, demand)
+
+    def _elective_preemption_locked(
+        self, st: _GangState, need: int, now: float,
+        live: Dict[str, _GangState], decide,
+    ) -> int:
+        """Free >= ``need`` chips for ``st`` by preempting admitted
+        gangs of STRICTLY lower class rank (true class, never the
+        age-boosted rank — a boosted "low" may outrank "high" for
+        admission order, but may never evict it).  Returns chips
+        actually freed (0 when no eligible victim set covers the
+        need — all-or-nothing, a half-preemption helps nobody)."""
+
+        victims = [
+            v
+            for v in live.values()
+            if v.phase == "admitted"
+            and v.rank < st.rank
+            and now - v.admitted_at >= self.preemption_cooldown_seconds
+            and (
+                v.preempted_at == 0.0
+                or now - v.preempted_at >= self.preemption_cooldown_seconds
+            )
+        ]
+        victims.sort(key=lambda v: self._victim_sort_key(v, now))
+        plan: List[_GangState] = []
+        plannable = 0
+        for v in victims:
+            if plannable >= need:
+                break
+            age = job_checkpoint_age(v.job, now, self.metrics)
+            if age is None or age > self.max_victim_checkpoint_age_seconds:
+                self.metrics.inc(
+                    "scheduler_skipped_total", reason="checkpoint_stale"
+                )
+                continue
+            plan.append(v)
+            # counted in full — _preempt_locked sheds only what the
+            # need requires and revokes whole otherwise
+            plannable += v.demand
+        if plannable < need:
+            return 0
+        freed = 0
+        for v in plan:
+            if freed >= need:
+                break
+            freed += self._preempt_locked(
+                v, need=need - freed, now=now, by=st.job.key,
+                reason_label=st.priority_class, decide=decide,
+            )
+        return freed
+
+    def _preempt_locked(
+        self, v: _GangState, need: int, now: float, by: str,
+        reason_label: str, decide,
+    ) -> int:
+        """Reclaim chips from one admitted victim: SHED whole slices
+        when that covers the need and leaves >= 1 slice, else REVOKE
+        the gang back to the queue.  Returns chips freed."""
+
+        per = slice_chips(v.job)
+        current = v.demand // per if per > 0 else 0
+        shed_by = -(-need // per) if per > 0 else 0  # ceil
+        if per > 0 and 0 < shed_by < current:
+            target = current - shed_by
+            v.shed_target = target
+            v.preempt_pending = True
+            v.demand = target * per
+            v.preempted_at = now
+            v.preemptions += 1
+            v.was_preempted = True
+            v.last_preemption = {
+                "time": round(now, 3),
+                "mode": "shed",
+                "by": by,
+                "fromSlices": current,
+                "toSlices": target,
+            }
+            self.metrics.inc(
+                "scheduler_preemptions_total",
+                victim_priority=v.priority_class,
+                reason="shed",
+            )
+            decide(
+                v, "shed",
+                f"shed {shed_by} slice(s) for {by}",
+                by=by, fromSlices=current, toSlices=target,
+                freedChips=shed_by * per,
+            )
+            return shed_by * per
+        # whole-gang revoke
+        freed = v.demand
+        v.phase = "queued"
+        v.queued_since = now
+        v.queue_reason = "Preempted"
+        v.shed_target = None
+        v.preempt_pending = False
+        v.revoke_pending = True
+        v.preempted_at = now
+        v.preemptions += 1
+        v.was_preempted = True
+        v.last_preemption = {
+            "time": round(now, 3),
+            "mode": "revoke",
+            "by": by,
+        }
+        v.demand = gang_demand(v.job)
+        self.metrics.set(
+            "scheduler_queued_since_unix", v.queued_since, job=v.job.key
+        )
+        self.metrics.inc(
+            "scheduler_preemptions_total",
+            victim_priority=v.priority_class,
+            reason="revoke",
+        )
+        decide(
+            v, "revoke", f"gang revoked for {by}", by=by, freedChips=freed,
+        )
+        return freed
+
+    # -- ticker -------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.evaluate_once()
+            except Exception as e:  # noqa: BLE001 - the ticker must survive
+                logger_for_job("-", "scheduler").error(
+                    "evaluation sweep failed: %s", e
+                )
+
+
+#: process-global instance (the sharing model of default_metrics /
+#: default_engine / default_autoscaler): kubesim's debug route and the
+#: operator API serve this one unless handed another
+default_scheduler = Scheduler()

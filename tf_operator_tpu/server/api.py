@@ -11,6 +11,7 @@ the operator binary carries the equivalent surface itself:
     GET  /slo                                         control-plane SLO quantiles
     GET  /alerts                                      alert-engine state (firing first)
     GET  /autoscaler                                  scale decisions + policy state
+    GET  /scheduler                                   fleet queue + decision log
     GET  /traces                                      recent trace summaries
     GET  /traces/{id}                                 one trace's span waterfall
     GET  /debug/stacks                                all-thread stack dump
@@ -151,6 +152,7 @@ class ApiServer:
         alerts=None,
         autoscaler=None,
         telemetry=None,
+        scheduler=None,
     ):
         self.jobs = job_store
         self.backend = backend
@@ -174,6 +176,16 @@ class ApiServer:
 
             autoscaler = default_autoscaler
         self.autoscaler = autoscaler
+        #: controller/scheduler.Scheduler serving GET /scheduler; same
+        #: contract as /autoscaler — the endpoint exists (empty queue)
+        #: on every binary, populated only where a fleet scheduler runs
+        if scheduler is None:
+            from tf_operator_tpu.controller.scheduler import (
+                default_scheduler,
+            )
+
+            scheduler = default_scheduler
+        self.scheduler = scheduler
         #: controller/telemetry.TelemetryScraper serving GET /federate;
         #: defaults to the process-global instance (the /alerts
         #: contract: the endpoint exists, empty, on every binary)
@@ -257,7 +269,8 @@ class ApiServer:
                 try:
                     untraced = (
                         "/healthz", "/metrics", "/slo", "/alerts",
-                        "/autoscaler", "/traces", "/debug", "/federate",
+                        "/autoscaler", "/scheduler", "/traces",
+                        "/debug", "/federate",
                     )
                     if method == "GET" and (
                         route == "/" or any(
@@ -400,6 +413,12 @@ class ApiServer:
                         # live state (breaching first) — the act half
                         # of the /alerts observe half
                         return self._send(200, outer.autoscaler.snapshot())
+                    if p == ["scheduler"]:
+                        # the fleet scheduler's pending queue (priority
+                        # then age), admitted gangs, quota accounting
+                        # and newest-first decision log — the `tpujob
+                        # queue` read and the dashboard's queue panel
+                        return self._send(200, outer.scheduler.snapshot())
                     if p == ["federate"]:
                         # fleet telemetry (ISSUE 15): every federated
                         # family — pod-scope series mirrored into the

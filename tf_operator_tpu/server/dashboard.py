@@ -18,6 +18,11 @@ subsystem's /traces endpoints, utils/trace.py):
 - **autoscaler** — per-policy live state (controller/autoscaler.py,
   breaching first) + the scale-decision tail from GET /autoscaler:
   the act half next to the alerts panel's observe half;
+- **fleet queue** (ISSUE 16) — the fleet scheduler's pending queue
+  (priority then age, with quota group and wait age), admitted gangs
+  (including shed-to-smaller-world state) and the admit/shed/revoke
+  decision tail from GET /scheduler; self-hides when no job declares
+  spec.scheduling;
 
 - **api client health** — retry/circuit/watch-recovery counters, with
   exemplar trace links (`# exemplar` comment lines in the exposition)
@@ -115,6 +120,15 @@ DASHBOARD_HTML = """<!doctype html>
   <tbody><tr><td class="muted" colspan="5">no autoscaled jobs</td></tr></tbody>
 </table>
 <div id="autoscaler-decisions" class="muted"></div>
+<div id="scheduler-panel" style="display:none">
+<h2>fleet queue</h2>
+<table id="scheduler">
+  <thead><tr><th>pos</th><th>job</th><th>class</th><th>quota</th>
+  <th>chips</th><th>waiting</th><th>reason</th></tr></thead>
+  <tbody></tbody>
+</table>
+<div id="scheduler-decisions" class="muted"></div>
+</div>
 <div id="fleet-panel" style="display:none">
 <h2>fleet</h2>
 <table id="fleet">
@@ -200,6 +214,7 @@ async function refresh() {
   if (selected) detail();
   refreshAlerts();
   refreshAutoscaler();
+  refreshScheduler();
   refreshHealth();
   refreshTraces();
   refreshArena();
@@ -386,6 +401,62 @@ async function refreshAutoscaler() {
         `${d.replicaType} ${d.direction} ${d.from}->${d.to}: ${d.reason}`
       ).join("\\n")
     : "no scale decisions yet";
+}
+
+async function refreshScheduler() {
+  // fleet scheduler panel (controller/scheduler.py): the pending queue
+  // priority-then-age from GET /scheduler, admitted gangs below it as
+  // context, plus the decision tail (admit/shed/revoke).  Hidden until
+  // the scheduler manages at least one gang — most deployments never
+  // declare spec.scheduling and should not see an empty panel.
+  let snap;
+  try { snap = await (await fetch("/scheduler")).json(); }
+  catch (e) { return; }
+  const queue = snap.queue || [];
+  const admitted = snap.admitted || [];
+  const decisions = snap.decisions || [];
+  const panel = document.getElementById("scheduler-panel");
+  if (!queue.length && !admitted.length && !decisions.length) {
+    panel.style.display = "none"; return;
+  }
+  panel.style.display = "";
+  const tbody = document.querySelector("#scheduler tbody");
+  tbody.innerHTML = "";
+  for (const q of queue) {
+    const tr = document.createElement("tr");
+    tr.classList.add("alert-pending");
+    const cells = [
+      String(q.position), q.job, q.priorityClass, q.quotaGroup,
+      String(q.demandChips), `${Math.round(q.waitSeconds)}s`, q.reason,
+    ];
+    for (const text of cells) {
+      const td = document.createElement("td");
+      td.textContent = text;  // job names are user input
+      tr.appendChild(td);
+    }
+    tbody.appendChild(tr);
+  }
+  for (const a of admitted) {
+    const tr = document.createElement("tr");
+    const cells = [
+      "-", a.job, a.priorityClass, a.quotaGroup,
+      String(a.demandChips),
+      a.shedTo != null ? `shed to ${a.shedTo}` : "admitted", "",
+    ];
+    for (const text of cells) {
+      const td = document.createElement("td");
+      td.textContent = text;
+      tr.appendChild(td);
+    }
+    tbody.appendChild(tr);
+  }
+  const dec = decisions.slice(0, 8);
+  document.getElementById("scheduler-decisions").textContent = dec.length
+    ? dec.map(d =>
+        `${new Date(d.time * 1000).toLocaleTimeString()} ${d.job} ` +
+        `${d.action} [${d.priorityClass}]: ${d.reason}`
+      ).join("\\n")
+    : "no scheduling decisions yet";
 }
 
 async function refreshAlerts() {

@@ -282,6 +282,22 @@ def default_rules(
             kind="gauge_age", threshold=1800.0,
             severity="ticket",
         ),
+        ThresholdRule(
+            # fleet-queue starvation (ISSUE 16): some gang has been
+            # parked in the scheduler queue longer than the threshold.
+            # The gauge holds the STABLE queued-since stamp per queued
+            # job (controller/scheduler.py clears it on admit), so
+            # gauge_age measures the oldest wait directly; an empty
+            # queue never breaches.  This is the observe half whose act
+            # half is the scheduler's own age-boost — if this fires,
+            # the boost isn't winning against the high-priority churn
+            # and a human (or the autoscaler shrinking someone) has to
+            # make room.
+            "gang-queue-stall",
+            metric="scheduler_queued_since_unix",
+            kind="gauge_age", threshold=900.0,
+            severity="ticket",
+        ),
     ]
 
 
