@@ -136,6 +136,13 @@ def parse_artifacts(out_dir: str) -> dict:
     if ms and "multislice_dcn_bytes_ratio" in ms:
         data["multislice"] = ms
 
+    # ISSUE 17: the cross-pod prefix-fabric smoke (2 pools over the
+    # real FabricServer wire — remote hit rate, pulled bytes, p99 TTFT
+    # local-only vs fleet)
+    fab = _last_json_line(_read(out_dir, "fabric.out"))
+    if fab and "fabric_remote_hit_rate" in fab:
+        data["fabric"] = fab
+
     flash = _read(out_dir, "flash.out")
     m = re.search(
         r"flash fwd\+bwd @4k: ([\d.]+)ms\s+xla: ([\d.]+)ms\s+speedup ([\d.]+)x",
@@ -352,6 +359,23 @@ def write_last_measured(data: dict, today: str) -> None:
             pg[key], (int, float)
         ):
             put(key, pg[key], pg_src)
+    # ISSUE 17: the cross-pod fabric smoke — every fabric_* measurement
+    # (hit rate, pulled bytes, migrate_in count, local-vs-fleet TTFT
+    # quantiles), keyed dynamically like the paged legs.  Walls and
+    # TTFTs carry the backend tag; the wire/dispatch ACCOUNTING is
+    # platform-independent and stays untagged so any backend's window
+    # may refresh it.
+    fab = data.get("fabric", {})
+    fab_backend = fab.get("fabric_backend")
+    _FABRIC_WALL_KEYS = ("_ttft_", "_tokens_per_sec")
+    for key in sorted(fab):
+        if key == "fabric_backend" or not isinstance(
+            fab[key], (int, float)
+        ):
+            continue
+        tagged = any(s in key for s in _FABRIC_WALL_KEYS)
+        put(key, fab[key], "fabric.out",
+            backend=fab_backend if tagged else None)
     sp = data.get("speculative", {})
     put("speculative_speedup", sp.get("speculative_speedup"),
         "speculative.out")
@@ -734,6 +758,42 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
                 f"{'on-chip' if on_chip else 'CPU smoke — tok/s gap inflated by multi-core prefill/decode overlap; the p99 ordering is the transferable signal'}) "
                 f"| {provenance}, {today} |"
             )
+    # ISSUE 17: cross-pod prefix fabric — 2 pools over the real wire
+    fab = data.get("fabric")
+    if fab:
+        fab_backend = fab.get("fabric_backend", "?")
+        fab_on_chip = fab_backend == "tpu"
+        rows["Cross-pod prefix fabric"] = (
+            "| Cross-pod prefix fabric (2-pod shared-system-prompt "
+            f"smoke over the REAL FabricServer wire, "
+            f"{fab.get('fabric_trace_requests', '?')} requests sharing "
+            f"{fab.get('fabric_prefixes', '?')} prefixes of "
+            f"{fab.get('fabric_prefix_blocks', '?')} blocks) | remote "
+            f"hit rate **{fab.get('fabric_remote_hit_rate', '?')}** "
+            f"({fab.get('fabric_pull_hits', '?')} block pulls, "
+            f"{fab.get('fabric_pull_bytes', '?')} B over HTTP, "
+            f"{fab.get('fabric_pull_failures', '?')} failures), "
+            f"{fab.get('fabric_migrate_in_dispatches', '?')} migrate_in "
+            "dispatch(es) — one per cold prefix; p99 TTFT fleet "
+            f"**{fab.get('fabric_fleet_p99_ttft_s', '?')} s** vs "
+            f"{fab.get('fabric_local_p99_ttft_s', '?')} s local-only "
+            f"(**{fab.get('fabric_ttft_p99_speedup', '?')}×**; cold "
+            f"class {fab.get('fabric_fleet_cold_p99_ttft_s', '?')} vs "
+            f"{fab.get('fabric_local_cold_p99_ttft_s', '?')} s) "
+            "(`models/fabric_service.py` content-addressed chain pull "
+            "→ one migrate_in; "
+            + (
+                "on-chip"
+                if fab_on_chip
+                else "CPU smoke — the pull is host HTTP while the "
+                "avoided prefill is CPU compute, so the TTFT delta's "
+                "sign is box-dependent; the hit-rate/bytes/dispatch "
+                "accounting is the transferable signal"
+            )
+            + ") "
+            f"| {fab_backend} smoke, `measure.py --section fabric` → "
+            f"`window_out/fabric.out`, {today} |"
+        )
     sp = data.get("speculative")
     if sp:
         wide_txt = (

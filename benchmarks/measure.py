@@ -9,9 +9,11 @@ Measures, on this box:
      backend (the real chip when present; bench.py owns ResNet-50).
 
 Usage: python benchmarks/measure.py
-           [--section all|reconcile|startup|train|batching|speculative]
+           [--section all|reconcile|startup|train|batching|speculative
+                      |paged|multislice|fabric]
 (batching and speculative are chip-minutes heavy and run only when
-named explicitly)
+named explicitly; fabric is the cross-pod prefix-fabric CPU smoke —
+two pools over the real FabricServer wire)
 Prints one JSON object; paste results into BASELINE.md.
 """
 
@@ -1277,6 +1279,197 @@ def _bench_disaggregated(model, params, vocab, *, seq, block, slots_base,
     return out
 
 
+def bench_fabric() -> dict:
+    """Cross-pod prefix fabric (ISSUE 17): a 2-pod shared-system-prompt
+    smoke over the REAL wire.  Pod A prefills + publishes the shared
+    prefixes into its local fabric and serves them on a FabricServer;
+    pod B then replays a request stream whose prompts share those
+    prefixes TWICE — once LOCAL-ONLY (no fabric: every cold prefix pays
+    a full prefill) and once FLEET (peered at pod A: each cold prefix
+    arrives as a chain-tail HTTP pull + ONE migrate_in dispatch).
+    Records the remote hit rate, pulled bytes by transport, migrate_in
+    dispatch count, and the p99 TTFT delta local-only vs fleet — the
+    cold class (first request per prefix) is where the wire actually
+    substitutes for prefill work.
+
+    CPU-smoke caveats: the pull is host HTTP + host scatter while the
+    avoided prefill is CPU compute, so the TTFT delta's SIGN depends on
+    the box — the accounting (hit rate, bytes, exactly one migrate_in
+    per cold prefix) is the transferable signal; on chips the avoided
+    prefill is the dominant term."""
+
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.models import llama_tiny
+    from tf_operator_tpu.models.batching import (
+        PagedContinuousBatchingDecoder,
+    )
+    from tf_operator_tpu.models.fabric_service import (
+        FabricServer,
+        FleetFabric,
+    )
+    from tf_operator_tpu.models.prefix_cache import PrefixFabric
+    from tf_operator_tpu.utils.metrics import Metrics
+
+    _apply_platform_override(jax)
+    out = {"fabric_backend": jax.default_backend()}
+    vocab, seq, block = 96, 128, 16
+    n_prefix = int(os.environ.get("MEASURE_FABRIC_PREFIXES", "4"))
+    n_req = int(os.environ.get("MEASURE_FABRIC_REQUESTS", "16"))
+    prefix_blocks = 3
+    model = llama_tiny(vocab_size=vocab, max_len=seq)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    # one SHAPE plan, two content realizations (the leg-F warmup rule):
+    # the warmup compiles every admission/pull width class while the
+    # timed run's prefix CONTENT stays cold in pod B's local cache
+    shape_r = np.random.RandomState(7)
+    plan = [
+        (i % n_prefix, int(shape_r.randint(4, 13)), 8)
+        for i in range(n_req)
+    ]
+
+    def make_trace(seed):
+        r = np.random.RandomState(seed)
+        pre = [
+            r.randint(
+                0, vocab, size=(prefix_blocks * block,)
+            ).astype(np.int32)
+            for _ in range(n_prefix)
+        ]
+        return pre, [
+            (
+                np.concatenate([
+                    pre[pi],
+                    r.randint(0, vocab, size=(t,)).astype(np.int32),
+                ]),
+                b,
+            )
+            for pi, t, b in plan
+        ]
+
+    warm_prefixes, warm_trace = make_trace(77)
+    prefixes, trace = make_trace(1234)
+
+    # pod A: publisher — local fabric + its wire server
+    mA = Metrics()
+    fabA = FleetFabric(
+        PrefixFabric(metrics=mA, model_label="fabric-bench"),
+        metrics=mA, model_label="fabric-bench",
+    )
+    poolA = PagedContinuousBatchingDecoder(
+        model, params, slots=4, kv_block_size=block, metrics=mA,
+        model_label="fabric-bench", fabric=fabA,
+    )
+    srvA = FabricServer(fabA).start()
+    stopA = threading.Event()
+
+    def _driveA():
+        while not stopA.is_set():
+            if poolA.step() == 0:
+                time.sleep(0.001)
+
+    tA = threading.Thread(target=_driveA, daemon=True)
+    tA.start()
+
+    def replay(tag, make_fabric):
+        m = Metrics()
+        fab = make_fabric(m)
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=block, metrics=m,
+            model_label="fabric-bench", fabric=fab,
+        )
+        stop = threading.Event()
+
+        def drive():
+            while not stop.is_set():
+                if pool.step() == 0:
+                    time.sleep(0.001)
+
+        t = threading.Thread(target=drive, daemon=True)
+        t.start()
+
+        def run(run_tag, replay_trace):
+            rids = [
+                pool.submit(p, b, trace_id=f"{tag}-{run_tag}-{j}")
+                for j, (p, b) in enumerate(replay_trace)
+            ]
+            for rid in rids:
+                assert pool.result_wait(rid, timeout=600) is not None
+
+        try:
+            run("warm", warm_trace)
+            t0 = time.perf_counter()
+            run("timed", trace)
+            wall = time.perf_counter() - t0
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        ttfts = [
+            pool.request_log.get(f"{tag}-timed-{j}")["ttft_seconds"]
+            for j in range(len(trace))
+        ]
+        return wall, ttfts, pool, fab
+
+    try:
+        # publish BOTH realizations on A (warmup pulls must cross the
+        # wire too, or the fleet leg's timed run compiles on the clock)
+        for p in warm_prefixes + prefixes:
+            pub = poolA.publish_to_fabric(p, timeout=600.0)
+            assert pub["published"] == prefix_blocks
+        wall_l, ttft_l, _, _ = replay("loc", lambda m: None)
+        wall_f, ttft_f, pool_f, fab_f = replay(
+            "fleet",
+            lambda m: FleetFabric(
+                PrefixFabric(metrics=m, model_label="fabric-bench"),
+                peers=[srvA.addr], metrics=m,
+                model_label="fabric-bench",
+            ),
+        )
+    finally:
+        stopA.set()
+        tA.join(timeout=30)
+        fabA.stop()
+        srvA.stop()
+
+    p99 = lambda xs: round(float(np.percentile(np.asarray(xs), 99)), 4)
+    cold = list(range(n_prefix))  # plan is i % n_prefix: first per prefix
+    total_new = sum(b for _, b in trace)
+    out["fabric_trace_requests"] = n_req
+    out["fabric_prefixes"] = n_prefix
+    out["fabric_prefix_blocks"] = prefix_blocks
+    out["fabric_local_tokens_per_sec"] = round(total_new / wall_l, 1)
+    out["fabric_fleet_tokens_per_sec"] = round(total_new / wall_f, 1)
+    out["fabric_local_p99_ttft_s"] = p99(ttft_l)
+    out["fabric_fleet_p99_ttft_s"] = p99(ttft_f)
+    out["fabric_local_cold_p99_ttft_s"] = p99([ttft_l[j] for j in cold])
+    out["fabric_fleet_cold_p99_ttft_s"] = p99([ttft_f[j] for j in cold])
+    # > 1.0 = the remote pull BEATS recomputing the prefix locally
+    out["fabric_ttft_p99_speedup"] = round(
+        p99(ttft_l) / max(1e-9, p99(ttft_f)), 2
+    )
+    fab_f.stop()
+    snap = fab_f.snapshot()
+    pulls = snap["pulls"]
+    out["fabric_pull_hits"] = pulls.get("hit", 0)
+    out["fabric_remote_hit_rate"] = round(
+        pulls.get("hit", 0) / max(1, sum(pulls.values())), 3
+    )
+    out["fabric_pull_bytes"] = snap["bytes_pulled"]
+    out["fabric_pull_failures"] = sum(snap["pull_failures"].values())
+    out["fabric_migrate_in_dispatches"] = pool_f.ledger.snapshot().get(
+        "migrate_in", {}
+    ).get("count", 0)
+    out["fabric_publishes"] = fabA.snapshot()["publishes"]
+    return out
+
+
 def _spec_pair(model, params, qparams, prompt, n_new, prefix, out) -> None:
     """Measure plain greedy generate vs SpeculativeDecoder (int8
     self-draft) for one model; writes `{prefix}_*` rows + the decoder's
@@ -1439,7 +1632,7 @@ def main() -> int:
         "--section",
         choices=[
             "all", "reconcile", "startup", "train", "batching",
-            "speculative", "paged", "multislice",
+            "speculative", "paged", "multislice", "fabric",
         ],
         default="all",
     )
@@ -1488,6 +1681,8 @@ def main() -> int:
         out.update(bench_paged())
     if args.section == "multislice":  # not in "all": needs its own jax env
         out.update(bench_multislice())
+    if args.section == "fabric":  # not in "all": spins pools + wire
+        out.update(bench_fabric())
     print(json.dumps(out, indent=1))
     return 0
 

@@ -188,6 +188,7 @@ def build_handler(
     kv_blocks: "int | None" = None, kv_block_size: int = 16,
     paged_kernel: str = "auto", kv_swap_blocks: "int | None" = None,
     roles: "list[str] | None" = None,
+    fabric_peers: "list[str] | None" = None,
 ):
     """batching_slots > 0 serves through the continuous-batching pool
     (models/batching.py): concurrent requests share one decode loop,
@@ -343,8 +344,21 @@ def build_handler(
         # replica publishes into / pulls from
         fabric = (
             PrefixFabric(metrics=metrics, model_label=model_label)
-            if "prefill" in role_list else None
+            if ("prefill" in role_list or fabric_peers is not None)
+            else None
         )
+        if fabric is not None and fabric_peers is not None:
+            # ISSUE 17: fleet mode — wrap the local store with the
+            # cross-pod client tier: local misses pull from peers that
+            # advertise the chain key, local publishes announce to
+            # them, and __contains__ answers fleet-wide so a prompt
+            # another pod already published is never recomputed here
+            from tf_operator_tpu.models.fabric_service import FleetFabric
+
+            fabric = FleetFabric(
+                fabric, peers=fabric_peers, metrics=metrics,
+                model_label=model_label,
+            )
         pool_replicas = []
         for i in range(n_replicas):
             # replica labels only under the router: single-replica
@@ -574,6 +588,18 @@ def build_handler(
                     "fabric": pool_fabric.snapshot()
                     if pool_fabric is not None else None,
                 })
+            if self.path == "/debug/fabric":
+                # the fleet-fabric panel/CLI read (ISSUE 17): peer
+                # liveness + hit/pull/failure counts + bytes by
+                # transport, merged out of the fabric snapshot
+                if pool_fabric is None:
+                    return self._reply(404, {
+                        "error": "no prefix fabric (start with --roles "
+                                 "prefill=... or --fabric-peers)"})
+                return self._reply(200, {
+                    "model": model_label,
+                    "fabric": pool_fabric.snapshot(),
+                })
             if self.path == "/debug/profile" or \
                     self.path.startswith("/debug/profile?"):
                 # exact-or-query match only: a typo'd /debug/profileX
@@ -793,6 +819,34 @@ def build_handler(
                     # admission, decode.window, retire — and the
                     # /requests/<id> autopsy key on it (ISSUE 11)
                     span.set_attribute("tier", tier)
+                    if pool_fabric is not None and hasattr(
+                        pool, "publish_to_fabric"
+                    ):
+                        # fleet mode, unified single replica (ISSUE
+                        # 17): make this prompt's full blocks
+                        # fleet-visible BEFORE admission.  First pod to
+                        # see a prefix pays the prefill and publishes;
+                        # every other pod's publish early-returns (the
+                        # fleet-wide contains check) and its admission
+                        # pulls the chain from the publisher instead of
+                        # recomputing.  A failed publish never fails
+                        # the request — admission just recomputes.
+                        try:
+                            pub = pool.publish_to_fabric(
+                                ids.astype(np.int32), tier=tier,
+                                trace_id=span.trace_id, timeout=120.0,
+                            )
+                            span.set_attribute(
+                                "fabric_published", pub["published"]
+                            )
+                        except Exception as exc:
+                            metrics.inc(
+                                "serve_fabric_publish_failures_total",
+                                model=model_label,
+                            )
+                            span.set_attribute(
+                                "fabric_publish_error", repr(exc)
+                            )
                     rid = pool.submit(
                         ids.astype(np.int32), n_new,
                         temperature=temperature, top_k=top_k,
@@ -870,6 +924,9 @@ def build_handler(
     #: the engine this handler's /alerts serves — main() starts/stops
     #: its evaluator; tests can drive evaluate_once() synthetically
     Handler.alert_engine = alert_engine
+    #: the pool's prefix fabric (None outside pool modes) — main()
+    #: boots the FabricServer over it and stamps the advertise addr
+    Handler.pool_fabric = pool_fabric
     return Handler
 
 
@@ -931,6 +988,25 @@ def main() -> int:
              "unchanged 1-dispatch/step loop).  Implies --replicas = "
              "the declared total; requires --batching and a pageable "
              "model.  Default: every replica 'unified' (both phases)",
+    )
+    ap.add_argument(
+        "--fabric-port", type=int, default=None, metavar="PORT",
+        help="export this pod's prefix-fabric store on "
+             "127.0.0.1:PORT (GET /fabric/index, /fabric/blocks/<key>, "
+             "POST /fabric/publish — models/fabric_service.py).  "
+             "Default: the reconciler-injected TPUJOB_FABRIC_PORT when "
+             "set (the tpujob.dist/fabric-port discovery contract), "
+             "else no fabric server.  Requires --batching",
+    )
+    ap.add_argument(
+        "--fabric-peers", default=None, metavar="HOST:PORT,...",
+        help="static peer list for the cross-pod KV fabric (ISSUE 17): "
+             "local prefix-cache misses pull published blocks from "
+             "these peers over HTTP (one migrate_in dispatch, "
+             "content-hash verified, recompute on any failure), and "
+             "local publishes announce to them.  May be empty ('') to "
+             "enter fleet mode with announcement-only discovery.  "
+             "Requires --batching",
     )
     ap.add_argument(
         "--kv-blocks", type=int, default=None, metavar="N",
@@ -1058,6 +1134,30 @@ def main() -> int:
             )
         args.replicas = len(role_list)
         print(f"disaggregated roles: {','.join(role_list)}", flush=True)
+    # fleet fabric (ISSUE 17): explicit flags are hard requirements;
+    # the reconciler-injected env port is soft (every pod gets one —
+    # a non-fleet invocation must not die on it)
+    fabric_port = args.fabric_port
+    if fabric_port is None:
+        from tf_operator_tpu.bootstrap.tpu_env import ENV_FABRIC_PORT
+
+        try:
+            env_port = int(os.environ.get(ENV_FABRIC_PORT, "0") or "0")
+        except ValueError:
+            env_port = 0
+        if env_port > 0 and args.batching:
+            fabric_port = env_port
+    fabric_peers = None
+    if args.fabric_peers is not None:
+        if not args.batching:
+            raise SystemExit("--fabric-peers requires --batching SLOTS")
+        fabric_peers = [
+            p.strip() for p in args.fabric_peers.split(",") if p.strip()
+        ]
+    if args.fabric_port is not None and not args.batching:
+        raise SystemExit("--fabric-port requires --batching SLOTS")
+    if fabric_port is not None and fabric_peers is None:
+        fabric_peers = []  # fleet mode: discovery by announcement
     handler = build_handler(
         model, params, max_len,
         batching_slots=args.batching, speculative=args.speculative,
@@ -1065,9 +1165,20 @@ def main() -> int:
         metrics=serve_metrics, replicas=args.replicas,
         kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size,
         paged_kernel=args.paged_kernel, kv_swap_blocks=args.kv_swap_blocks,
-        roles=role_list,
+        roles=role_list, fabric_peers=fabric_peers,
     )
     server = ThreadingHTTPServer(("127.0.0.1", args.port), handler)
+    fabric_server = None
+    if handler.pool_fabric is not None and fabric_peers is not None:
+        from tf_operator_tpu.models.fabric_service import FabricServer
+
+        fabric_server = FabricServer(
+            handler.pool_fabric, port=fabric_port or 0
+        ).start()
+        handler.pool_fabric.set_advertise(fabric_server.addr)
+        print(f"fabric server on {fabric_server.addr} "
+              f"(peers: {','.join(fabric_peers) or 'announce-only'})",
+              flush=True)
     # the serving binary boots the SLO evaluator (build_handler only
     # constructs it — see the leak note there)
     handler.alert_engine.start()
@@ -1076,6 +1187,8 @@ def main() -> int:
         server.serve_forever()
     finally:
         handler.alert_engine.stop()
+        if fabric_server is not None:
+            fabric_server.stop()
     return 0
 
 

@@ -179,6 +179,14 @@ def test_collector_sees_known_call_sites():
     assert "model" in families["kv_fabric_blocks"]
     assert "model" in families["kv_fabric_publishes_total"]
     assert "model" in families["serve_fabric_publish_failures_total"]
+    # ISSUE 17: the cross-pod fabric wire — migrate bytes split by
+    # transport (local store vs HTTP pull), remote-pull outcomes and
+    # failure reasons, per-peer liveness.  The fabric-peer-unreachable
+    # rule and the dashboard fabric panel bind these literal sites.
+    assert {"direction", "transport"} <= families["kv_migrate_bytes_total"]
+    assert {"model", "outcome"} <= families["kv_fabric_pulls_total"]
+    assert {"model", "reason"} <= families["kv_fabric_pull_failures_total"]
+    assert "peer" in families["kv_fabric_peer_up"]
     # ISSUE 14: the multi-slice grad-sync plane — per-fabric byte and
     # collective counters (parallel/trainer.py host-side accounting),
     # the probe-measured sync-seconds histogram (parallel/collectives),
@@ -311,6 +319,66 @@ def test_scheduler_families_pinned_both_ways():
     assert not problems, (
         "scheduler exposition drift:\n  " + "\n  ".join(problems)
     )
+
+
+#: ISSUE 17: the cross-pod KV fabric's exposition contract — every
+#: ``kv_fabric_*`` family the fabric tier emits (prefix_cache.py
+#: publish-side + models/fabric_service.py pull-side), with its EXACT
+#: label keys.  The fabric-peer-unreachable rule, the dashboard fabric
+#: panel, and the soak's decision accounting key on these names; the
+#: gate below pins them BOTH WAYS.
+FABRIC_FAMILIES = {
+    "kv_fabric_blocks": {"model"},
+    "kv_fabric_publishes_total": {"model"},
+    "kv_fabric_pulls_total": {"model", "outcome"},
+    "kv_fabric_pull_failures_total": {"model", "reason"},
+    "kv_fabric_peer_up": {"peer"},
+}
+
+
+def test_fabric_families_pinned_both_ways():
+    """ISSUE 17 satellite: the fabric metric families are pinned in both
+    directions — every declared family is emitted at a literal call site
+    with exactly the declared label keys (rename or label drift fails
+    tier-1), and no undeclared ``kv_fabric_*`` family can ship
+    (additions must extend the pin table, i.e. be deliberate)."""
+
+    families = collect_emitted_families()
+    problems = []
+    for name, keys in FABRIC_FAMILIES.items():
+        if name not in families:
+            problems.append(f"declared family {name!r} is never emitted")
+        elif families[name] != keys:
+            problems.append(
+                f"family {name!r} emitted with keys "
+                f"{sorted(families[name])}, pinned {sorted(keys)}"
+            )
+    undeclared = {
+        n for n in families if n.startswith("kv_fabric_")
+    } - set(FABRIC_FAMILIES)
+    if undeclared:
+        problems.append(
+            f"undeclared kv_fabric_* families emitted: {sorted(undeclared)}"
+        )
+    assert not problems, (
+        "fabric exposition drift:\n  " + "\n  ".join(problems)
+    )
+
+
+def test_fabric_peer_unreachable_rule_binds_the_failure_counter():
+    """ISSUE 17 satellite: the stock peer-health rule fires on any
+    ``peer_dead`` pull failure — counter_increase over
+    ``kv_fabric_pull_failures_total{reason="peer_dead"}`` — so a pod
+    that keeps recomputing because its peer's socket resets pages a
+    ticket instead of silently eating the latency."""
+
+    rule = next(
+        r for r in default_rules() if r.name == "fabric-peer-unreachable"
+    )
+    assert rule.metric == "kv_fabric_pull_failures_total"
+    assert rule.kind == "counter_increase"
+    assert rule.labels == {"reason": "peer_dead"}
+    assert rule.metric in collect_emitted_families()
 
 
 def test_gang_queue_stall_rule_binds_the_queue_stamp():

@@ -264,6 +264,29 @@ def test_train_dcn_families_are_emitted_with_fabric_label():
         assert "fabric" in families[fam], fam
 
 
+def test_fabric_families_are_emitted_with_expected_labels():
+    """ISSUE 17: the cross-pod KV fabric families any rule/policy/
+    dashboard may bind — publish-side catalog gauges/counters
+    ({model}), pull-side outcomes ({model, outcome}) and failure
+    reasons ({model, reason}), per-peer liveness ({peer}), and the
+    migrate-bytes split by {direction, transport} that separates local
+    arena traffic from wire pulls.  A rename fails tier-1 here before
+    the fabric-peer-unreachable rule or a fabric panel orphans."""
+
+    families = collect_emitted_families()
+    assert "model" in families["kv_fabric_blocks"]
+    assert "model" in families["kv_fabric_publishes_total"]
+    assert {"model", "outcome"} <= families["kv_fabric_pulls_total"]
+    assert {"model", "reason"} <= families["kv_fabric_pull_failures_total"]
+    assert "peer" in families["kv_fabric_peer_up"]
+    assert {"direction", "transport"} <= families["kv_migrate_bytes_total"]
+    rule = next(
+        r for r in default_rules() if r.name == "fabric-peer-unreachable"
+    )
+    assert rule.metric in families
+    assert set(rule.labels) <= families[rule.metric]
+
+
 def test_resize_gate_reads_the_federated_checkpoint_family():
     """ISSUE 15 satellite: the training resize gate's registry
     fallback (``job_checkpoint_age``) must read the FEDERATED

@@ -210,6 +210,64 @@ def test_multislice_artifact_parses_into_row_and_ledger(tmp_path):
     assert led["multislice_hierarchical_step_ms"]["backend"] == "cpu"
 
 
+def test_fabric_artifact_parses_into_row_and_ledger(tmp_path):
+    """ISSUE 17: the --section fabric smoke flows into the 'Cross-pod
+    prefix fabric' BASELINE row and the LAST_MEASURED ledger — wire
+    accounting (hit rate, bytes, migrate_in count) untagged so any
+    backend refreshes it; TTFT quantiles and tok/s carry the backend
+    tag and defer to chip-grade entries."""
+
+    import json
+
+    d = tmp_path / "window_out"
+    d.mkdir()
+    fab = {
+        "fabric_backend": "cpu",
+        "fabric_trace_requests": 16,
+        "fabric_prefixes": 4,
+        "fabric_prefix_blocks": 3,
+        "fabric_local_tokens_per_sec": 1767.7,
+        "fabric_fleet_tokens_per_sec": 1743.6,
+        "fabric_local_p99_ttft_s": 0.0658,
+        "fabric_fleet_p99_ttft_s": 0.0678,
+        "fabric_local_cold_p99_ttft_s": 0.0191,
+        "fabric_fleet_cold_p99_ttft_s": 0.025,
+        "fabric_ttft_p99_speedup": 0.97,
+        "fabric_pull_hits": 24,
+        "fabric_remote_hit_rate": 1.0,
+        "fabric_pull_bytes": 196608,
+        "fabric_pull_failures": 0,
+        "fabric_migrate_in_dispatches": 8,
+        "fabric_publishes": 24,
+    }
+    (d / "fabric.out").write_text(json.dumps(fab, indent=1) + "\n")
+    data = cw.parse_artifacts(str(d))
+    rows = cw.build_rows(data, "2026-08-06")
+    row = rows["Cross-pod prefix fabric"]
+    assert "remote hit rate **1.0**" in row
+    assert "24 block pulls" in row and "196608 B over HTTP" in row
+    assert "8 migrate_in" in row
+    assert "**0.0678 s**" in row and "0.0658 s local-only" in row
+    assert "CPU smoke" in row and "box-dependent" in row
+
+    import unittest.mock as mock
+
+    with mock.patch.object(cw, "HERE", str(tmp_path)):
+        cw.write_last_measured(data, "2026-08-06")
+        led = json.load(open(tmp_path / "LAST_MEASURED.json"))
+    # wire/dispatch accounting: platform-independent, UNtagged
+    assert led["fabric_remote_hit_rate"]["value"] == 1.0
+    assert "backend" not in led["fabric_remote_hit_rate"]
+    assert "backend" not in led["fabric_pull_bytes"]
+    assert led["fabric_migrate_in_dispatches"]["value"] == 8
+    # walls/quantiles: backend-qualified (the paged-row rule)
+    assert led["fabric_fleet_p99_ttft_s"]["backend"] == "cpu"
+    assert led["fabric_ttft_p99_speedup"]["backend"] == "cpu"
+    assert led["fabric_local_tokens_per_sec"]["backend"] == "cpu"
+    # config echoes never enter the measured-keys ledger
+    assert "fabric_backend" not in led
+
+
 def test_cpu_smoke_train_artifact_does_not_clobber_chip_model_rows(tmp_path):
     """The backend-aware rule (ISSUE 14 satellite, the PR 13 batching
     precedent generalized): a MEASURE_TRAIN_TINY CPU smoke carries the

@@ -497,7 +497,9 @@ class TestServeLmRoles:
                 'role="decode"}'
             ) in text
             assert "kv_fabric_blocks" in text
-            assert 'kv_migrate_bytes_total{direction="in"}' in text
+            assert (
+                'kv_migrate_bytes_total{direction="in",transport="local"}'
+            ) in text
 
             # /slo still reports ONE user-facing TTFT row (role and
             # replica merged away), counting only the USER request

@@ -1,0 +1,568 @@
+"""Cross-pod KV fabric service (ISSUE 17 tentpole) — unit tier.
+
+The load-bearing pins, none of which compile a model (the arena
+template is a synthetic pytree, so this file stays tier-1 fast):
+
+- WIRE TAXONOMY: every way a /fabric/blocks body can be wrong maps to
+  exactly one PULL_FAILURE_REASONS entry — bit-flip/version/leaf-count
+  → corrupt, lying length prefix → short_read, no arena yet →
+  no_template — and the content hash is checked BEFORE the tree is
+  rebuilt.
+- FLEET RESOLVE: a pull hit lands the block in the LOCAL fabric
+  (later gets are local, no transport key), carries transport="http" +
+  peer, and meters kv_fabric_pulls_total / kv_fabric_peer_up /
+  bytes_pulled; a fleet-wide miss counts miss; a local-only fabric
+  counts nothing.
+- CHAOS LEGS: a FaultInjector socket reset mid-pull degrades to
+  recompute with reason=peer_dead and kv_fabric_peer_up=0 — and the
+  same pull succeeds once chaos clears; a stale index (peer evicted
+  between index and pull) 404s into reason=not_found WITHOUT marking
+  the peer dead, and prunes the cached index.
+- DISCOVERY: put() announces to peers (push), handle_publish merges
+  unknown senders (discovery) and drops malformed keys.
+- PIN LEASES: get(pin=True) leases expire after pin_ttl_seconds — a
+  crashed puller can only block eviction for the TTL, never forever.
+- CLI: ``tpujob fabric`` renders the pull ledger down-peers-first, and
+  ``tpujob fabric JOB`` probes reconciler-stamped fabric-port
+  annotations.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tf_operator_tpu.backend.kubesim import FaultInjector
+from tf_operator_tpu.backend.retry import fabric_pull_policy
+from tf_operator_tpu.models.fabric_service import (
+    PULL_FAILURE_REASONS,
+    WIRE_VERSION,
+    FabricServer,
+    FleetFabric,
+    PullError,
+    decode_block,
+    encode_block,
+)
+from tf_operator_tpu.models.prefix_cache import PrefixFabric, chain_keys
+from tf_operator_tpu.utils.metrics import Metrics
+
+KEY = chain_keys(np.arange(16), 16)[0]
+KEY2 = chain_keys(np.arange(32), 16)[1]
+KEY3 = chain_keys(np.arange(48), 16)[2]
+
+#: two (1, 2, 4, 4) float32 block-row leaves
+NBYTES = 2 * 2 * 4 * 4 * 4
+
+
+def _arena(num_blocks=8):
+    """A synthetic paged arena: two block-row (ndim-4) leaves plus a
+    scalar bookkeeping leaf the wire must skip/zero-fill."""
+
+    return {
+        "k": np.zeros((num_blocks, 2, 4, 4), np.float32),
+        "v": np.zeros((num_blocks, 2, 4, 4), np.float32),
+        "step": np.zeros((), np.int32),
+    }
+
+
+def _block(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "k": rng.standard_normal((1, 2, 4, 4)).astype(np.float32),
+        "v": rng.standard_normal((1, 2, 4, 4)).astype(np.float32),
+        "step": np.zeros((), np.int32),
+    }
+
+
+def _fleet(local=None, peers=(), metrics=None, **kw):
+    kw.setdefault("request_timeout", 5.0)
+    fab = FleetFabric(
+        local if local is not None else PrefixFabric(model_label="t"),
+        peers=peers,
+        metrics=metrics if metrics is not None else Metrics(),
+        model_label="t",
+        **kw,
+    )
+    fab.register_template(_arena())
+    return fab
+
+
+def _fast_policy():
+    """Zero-backoff pull policy so chaos legs exhaust the retry budget
+    instantly."""
+
+    return fabric_pull_policy(base_delay=0.0, max_delay=0.0)
+
+
+# ---------------------------------------------------------------- wire codec
+
+
+class TestWireCodec:
+    def test_roundtrip_header_and_payload(self):
+        fleet = _fleet()
+        body = encode_block(KEY, {"kv": _block(1), "nbytes": NBYTES})
+        header = json.loads(body[: body.index(b"\n")])
+        assert header["v"] == WIRE_VERSION
+        assert header["key"] == KEY.hex()
+        assert header["nbytes"] == NBYTES
+        assert len(header["leaves"]) == 2  # the scalar leaf rides free
+        tree, nbytes = decode_block(body, fleet._template)
+        want = _block(1)
+        np.testing.assert_array_equal(tree["k"], want["k"])
+        np.testing.assert_array_equal(tree["v"], want["v"])
+        assert tree["step"].shape == () and nbytes == NBYTES
+
+    def test_bit_flip_is_corrupt(self):
+        fleet = _fleet()
+        body = bytearray(
+            encode_block(KEY, {"kv": _block(1), "nbytes": NBYTES})
+        )
+        body[-1] ^= 0x40  # one payload bit
+        with pytest.raises(PullError) as ei:
+            decode_block(bytes(body), fleet._template)
+        assert ei.value.reason == "corrupt"
+
+    def test_wire_version_mismatch_is_corrupt(self):
+        fleet = _fleet()
+        body = encode_block(KEY, {"kv": _block(1), "nbytes": NBYTES})
+        nl = body.index(b"\n")
+        header = json.loads(body[:nl])
+        header["v"] = WIRE_VERSION + 1
+        body = json.dumps(header).encode() + body[nl:]
+        with pytest.raises(PullError) as ei:
+            decode_block(body, fleet._template)
+        assert ei.value.reason == "corrupt"
+
+    def test_lying_length_prefix_is_short_read(self):
+        # truncate the payload but keep the hash HONEST (recomputed):
+        # the hash passes, the second leaf's length prefix lies
+        fleet = _fleet()
+        body = encode_block(KEY, {"kv": _block(1), "nbytes": NBYTES})
+        nl = body.index(b"\n")
+        header = json.loads(body[:nl])
+        payload = body[nl + 1 :][:200]  # mid-second-leaf
+        import hashlib
+
+        header["sha256"] = hashlib.sha256(payload).hexdigest()
+        with pytest.raises(PullError) as ei:
+            decode_block(
+                json.dumps(header).encode() + b"\n" + payload,
+                fleet._template,
+            )
+        assert ei.value.reason == "short_read"
+
+    def test_bad_dtype_is_corrupt_not_a_crash(self):
+        fleet = _fleet()
+        body = encode_block(KEY, {"kv": _block(1), "nbytes": NBYTES})
+        nl = body.index(b"\n")
+        header = json.loads(body[:nl])
+        header["leaves"][0]["dtype"] = "not-a-dtype!!"
+        with pytest.raises(PullError) as ei:
+            decode_block(json.dumps(header).encode() + body[nl:],
+                         fleet._template)
+        assert ei.value.reason == "corrupt"
+
+    def test_leaf_count_mismatch_is_corrupt(self):
+        fleet = _fleet()
+        body = encode_block(KEY, {"kv": _block(1), "nbytes": NBYTES})
+        nl = body.index(b"\n")
+        header = json.loads(body[:nl])
+        header["leaves"] = header["leaves"][:1]
+        with pytest.raises(PullError) as ei:
+            decode_block(json.dumps(header).encode() + body[nl:],
+                         fleet._template)
+        assert ei.value.reason == "corrupt"
+
+    def test_no_template_is_its_own_reason(self):
+        with pytest.raises(PullError) as ei:
+            decode_block(b"{}\n", None)
+        assert ei.value.reason == "no_template"
+
+    def test_taxonomy_is_closed(self):
+        # every reason the codec/client can raise is a declared label
+        # value — the alert rule and dashboards key off these literals
+        for reason in ("corrupt", "short_read", "no_template"):
+            assert reason in PULL_FAILURE_REASONS
+        assert len(set(PULL_FAILURE_REASONS)) == len(PULL_FAILURE_REASONS)
+
+
+# ------------------------------------------------------------- fleet resolve
+
+
+class TestFleetPull:
+    def test_remote_pull_hit_lands_locally(self):
+        A = _fleet()
+        A.local.put(KEY, _block(1), NBYTES)
+        srv = FabricServer(A).start()
+        try:
+            mB = Metrics()
+            B = _fleet(peers=[srv.addr], metrics=mB)
+            # fleet-wide membership sees the peer's catalog...
+            assert KEY in B
+            # ...but nothing is local until a pull
+            assert KEY not in B.local
+            rec = B.get(KEY, pin=True)
+            assert rec is not None
+            assert rec["transport"] == "http"
+            assert rec["peer"] == srv.addr
+            assert rec["nbytes"] == NBYTES
+            np.testing.assert_array_equal(rec["kv"]["k"], _block(1)["k"])
+            assert B.pulls == {"hit": 1, "miss": 0, "failed": 0}
+            assert B.bytes_pulled == NBYTES
+            assert mB.counter(
+                "kv_fabric_pulls_total", model="t", outcome="hit"
+            ) == 1
+            assert mB.gauge("kv_fabric_peer_up", peer=srv.addr) == 1.0
+            # the pull landed in the LOCAL fabric: the next get is a
+            # local hit — no transport key, no second pull counted
+            B.unpin(KEY)
+            again = B.get(KEY)
+            assert again is not None and "transport" not in again
+            assert B.pulls["hit"] == 1
+            snap = B.snapshot()
+            assert snap["pulls"]["hit"] == 1
+            assert snap["bytes_pulled"] == NBYTES
+            assert snap["peers"][0]["up"] is True
+        finally:
+            srv.stop()
+
+    def test_fleet_wide_miss_counts_miss(self):
+        A = _fleet()
+        srv = FabricServer(A).start()
+        try:
+            B = _fleet(peers=[srv.addr])
+            assert B.get(KEY) is None
+            assert B.pulls == {"hit": 0, "miss": 1, "failed": 0}
+        finally:
+            srv.stop()
+
+    def test_local_only_fabric_counts_nothing(self):
+        B = _fleet()
+        assert B.get(KEY) is None
+        assert B.pulls == {"hit": 0, "miss": 0, "failed": 0}
+
+    def test_pull_before_template_counts_no_template(self):
+        A = _fleet()
+        A.local.put(KEY, _block(1), NBYTES)
+        srv = FabricServer(A).start()
+        try:
+            B = FleetFabric(
+                PrefixFabric(model_label="t"),
+                peers=[srv.addr], metrics=Metrics(), model_label="t",
+            )  # pool still booting: no register_template yet
+            assert B.get(KEY) is None
+            assert B.pull_failures == {"no_template": 1}
+            assert B.pulls["failed"] == 1
+        finally:
+            srv.stop()
+
+
+# --------------------------------------------------------------- chaos legs
+
+
+class TestChaosLegs:
+    def test_stale_index_404_counts_not_found(self):
+        local = PrefixFabric(capacity_blocks=1, model_label="t")
+        A = _fleet(local=local)
+        A.local.put(KEY, _block(1), NBYTES)
+        srv = FabricServer(A).start()
+        try:
+            mB = Metrics()
+            B = _fleet(
+                peers=[srv.addr], metrics=mB, index_ttl_seconds=3600.0
+            )
+            B.refresh_peers()  # cached catalog: peer holds KEY
+            # peer evicts KEY between index and pull (stale catalog)
+            A.local.put(KEY2, _block(2), NBYTES)
+            assert KEY not in A.local
+            assert B.get(KEY) is None
+            assert B.pulls == {"hit": 0, "miss": 0, "failed": 1}
+            assert B.pull_failures == {"not_found": 1}
+            assert mB.counter(
+                "kv_fabric_pull_failures_total",
+                model="t", reason="not_found",
+            ) == 1
+            snap = B.snapshot()
+            # the 404 pruned the stale key from the cached index...
+            assert snap["peers"][0]["keys"] == 0
+            # ...and a 404 is normal churn, NOT a dead peer
+            assert snap["peers"][0]["up"] is True
+        finally:
+            srv.stop()
+
+    def test_peer_reset_mid_pull_counts_peer_dead_then_recovers(self):
+        A = _fleet()
+        A.local.put(KEY, _block(1), NBYTES)
+        faults = FaultInjector(seed=7)
+        srv = FabricServer(A, faults=faults).start()
+        try:
+            mB = Metrics()
+            B = _fleet(
+                peers=[srv.addr], metrics=mB, policy=_fast_policy()
+            )
+            B.refresh_peers()  # index read lands before chaos arms
+            faults.add(path="^/fabric/blocks/", mode="reset")
+            assert B.get(KEY) is None
+            assert B.pulls["failed"] == 1
+            assert B.pull_failures == {"peer_dead": 1}
+            assert mB.counter(
+                "kv_fabric_pull_failures_total",
+                model="t", reason="peer_dead",
+            ) == 1
+            assert mB.gauge("kv_fabric_peer_up", peer=srv.addr) == 0.0
+            assert faults.total_injected() >= 1
+            # chaos clears → the SAME pull succeeds: degrade, not wedge
+            faults.clear()
+            rec = B.get(KEY)
+            assert rec is not None and rec["transport"] == "http"
+            assert mB.gauge("kv_fabric_peer_up", peer=srv.addr) == 1.0
+        finally:
+            srv.stop()
+
+    def test_http_500_counts_http_error(self):
+        A = _fleet()
+        A.local.put(KEY, _block(1), NBYTES)
+        faults = FaultInjector(seed=7)
+        srv = FabricServer(A, faults=faults).start()
+        try:
+            B = _fleet(peers=[srv.addr], policy=_fast_policy())
+            B.refresh_peers()
+            faults.add(path="^/fabric/blocks/", mode="error", status=500)
+            assert B.get(KEY) is None
+            assert B.pull_failures == {"http_error": 1}
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------- discovery
+
+
+class TestDiscovery:
+    def test_handle_publish_merges_and_discovers(self):
+        B = _fleet()
+        B.set_advertise("127.0.0.1:1")
+        B.handle_publish({
+            "advertise": "127.0.0.1:2",
+            "keys": [KEY.hex(), "zz-not-hex"],  # malformed keys drop
+            "generation": 3,
+        })
+        snap = B.snapshot()
+        assert snap["peers"] == [{
+            "peer": "127.0.0.1:2", "up": True, "keys": 1, "generation": 3,
+        }]
+        # own advertise and anonymous senders are ignored
+        B.handle_publish({"advertise": "127.0.0.1:1", "keys": [KEY2.hex()]})
+        B.handle_publish({"keys": [KEY2.hex()]})
+        assert len(B.snapshot()["peers"]) == 1
+
+    def test_put_announces_to_peers_over_the_wire(self):
+        B = _fleet()
+        srvB = FabricServer(B).start()
+        B.set_advertise(srvB.addr)
+        A = _fleet(peers=[srvB.addr])
+        srvA = FabricServer(A).start()
+        A.set_advertise(srvA.addr)
+        try:
+            A.put(KEY, _block(1), NBYTES)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                peers = {
+                    p["peer"]: p for p in B.snapshot()["peers"]
+                }
+                if peers.get(srvA.addr, {}).get("keys"):
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail("announcement never reached the peer")
+            # B pulls straight off the announced catalog (no index read)
+            rec = B.get(KEY)
+            assert rec is not None and rec["peer"] == srvA.addr
+        finally:
+            A.stop()
+            srvA.stop()
+            srvB.stop()
+
+
+# ------------------------------------------------------------- fabric server
+
+
+class TestFabricServer:
+    def test_index_block_and_health_routes(self):
+        A = _fleet()
+        A.local.put(KEY, _block(1), NBYTES)
+        srv = FabricServer(A).start()
+        A.set_advertise(srv.addr)
+        try:
+            with urllib.request.urlopen(f"{srv.url}/fabric/index") as r:
+                idx = json.loads(r.read())
+            assert idx["v"] == WIRE_VERSION
+            assert idx["model"] == "t"
+            assert idx["advertise"] == srv.addr
+            assert idx["keys"] == [KEY.hex()]
+            assert idx["generation"] == 1
+            with urllib.request.urlopen(
+                f"{srv.url}/fabric/blocks/{KEY.hex()}"
+            ) as r:
+                body = r.read()
+            tree, nb = decode_block(body, A._template)
+            assert nb == NBYTES
+            np.testing.assert_array_equal(tree["k"], _block(1)["k"])
+            # the encode-time pin was released (no leaked lease)
+            assert A.local.snapshot()["pinned"] == 0
+            with urllib.request.urlopen(f"{srv.url}/healthz") as r:
+                assert r.read() == b"ok\n"
+        finally:
+            srv.stop()
+
+    def test_error_statuses(self):
+        A = _fleet()
+        srv = FabricServer(A).start()
+        try:
+            for path, code in [
+                (f"/fabric/blocks/{KEY.hex()}", 404),  # unknown key
+                ("/fabric/blocks/zz", 400),            # bad hex
+                ("/nope", 404),
+            ]:
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(srv.url + path)
+                assert ei.value.code == code
+            req = urllib.request.Request(
+                f"{srv.url}/fabric/publish", data=b"not json",
+                method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 400
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------- pin leases
+
+
+class TestPinLeases:
+    def test_live_lease_blocks_eviction_until_ttl(self):
+        now = [0.0]
+        fab = PrefixFabric(
+            capacity_blocks=1, model_label="t",
+            pin_ttl_seconds=10.0, clock=lambda: now[0],
+        )
+        fab.put(KEY, _block(1), NBYTES)
+        assert fab.get(KEY, pin=True) is not None
+        # live lease: pressure reclaims around the pinned block
+        fab.put(KEY2, _block(2), NBYTES)
+        assert KEY in fab
+        assert fab.snapshot()["pin_expiries"] == 0
+        # lease expires → the next pressure pass reclaims it
+        now[0] = 11.0
+        fab.put(KEY3, _block(3), NBYTES)
+        assert KEY not in fab
+        snap = fab.snapshot()
+        assert snap["pin_expiries"] == 1
+        assert snap["pinned"] == 0
+        assert snap["blocks"] == 1
+
+    def test_unpin_releases_before_ttl(self):
+        now = [0.0]
+        fab = PrefixFabric(
+            capacity_blocks=1, model_label="t",
+            pin_ttl_seconds=10.0, clock=lambda: now[0],
+        )
+        fab.put(KEY, _block(1), NBYTES)
+        fab.get(KEY, pin=True)
+        fab.unpin(KEY)
+        fab.put(KEY2, _block(2), NBYTES)
+        assert KEY not in fab and KEY2 in fab
+        assert fab.snapshot()["pin_expiries"] == 0
+
+    def test_index_keys_generation_stamp(self):
+        fab = PrefixFabric(model_label="t")
+        assert fab.index_keys() == ([], 0)
+        fab.put(KEY, _block(1), NBYTES)
+        keys, gen = fab.index_keys()
+        assert keys == [KEY] and gen == 1
+        # idempotent re-publish: no generation bump, no double count
+        fab.put(KEY, _block(1), NBYTES)
+        assert fab.index_keys()[1] == 1
+        assert fab.snapshot()["publishes"] == 1
+
+
+# ---------------------------------------------------------------------- CLI
+
+
+class TestFabricCLI:
+    def test_cli_fabric_renders_pull_ledger_down_first(
+        self, capsys, monkeypatch
+    ):
+        from tf_operator_tpu.cmd import tpujob as cli
+
+        snap = {
+            "model": "t",
+            "fabric": {
+                "advertise": "127.0.0.1:9",
+                "blocks": 3, "generation": 5, "publishes": 4,
+                "evictions": 1, "pin_expiries": 0,
+                "pulls": {"hit": 2, "miss": 1, "failed": 1},
+                "pull_failures": {"peer_dead": 1},
+                "bytes_pulled": 512,
+                "peers": [
+                    {"peer": "127.0.0.1:7", "up": True,
+                     "keys": 3, "generation": 5},
+                    {"peer": "127.0.0.1:8", "up": False,
+                     "keys": 0, "generation": 0},
+                ],
+            },
+        }
+        seen = {}
+
+        def fake(method, url, payload=None):
+            seen["url"] = url
+            return snap
+
+        monkeypatch.setattr(cli, "_request", fake)
+        rc = cli.main(["fabric"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert seen["url"].endswith("/debug/fabric")
+        assert "hit=2" in out and "peer_dead=1" in out
+        assert "512 bytes" in out
+        assert "DOWN" in out
+        # the down peer leads — what-needs-acting-on-first
+        assert out.index("127.0.0.1:8") < out.index("127.0.0.1:7")
+
+    def test_cli_fabric_job_probes_annotated_ports(
+        self, capsys, monkeypatch
+    ):
+        from tf_operator_tpu.cmd import tpujob as cli
+
+        A = _fleet()
+        A.local.put(KEY, _block(1), NBYTES)
+        srv = FabricServer(A).start()
+        A.set_advertise(srv.addr)
+        try:
+            pods = {"items": [
+                {"name": "j-0", "annotations": {
+                    "tpujob.dist/fabric-port": str(srv.port)}},
+                {"name": "j-1", "annotations": {
+                    "tpujob.dist/fabric-port": "1"}},  # nothing listens
+                {"name": "j-2", "annotations": {}},    # not a fabric pod
+            ]}
+            seen = {}
+
+            def fake(method, url, payload=None):
+                seen["url"] = url
+                return pods
+
+            monkeypatch.setattr(cli, "_request", fake)
+            rc = cli.main(["fabric", "prod/j"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert seen["url"].endswith("/namespaces/prod/tpujobs/j/pods")
+            assert "j-0" in out and srv.addr in out
+            assert "j-1" in out and "DOWN" in out
+            assert "j-2" not in out
+        finally:
+            srv.stop()

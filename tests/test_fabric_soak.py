@@ -1,0 +1,406 @@
+"""Cross-pod KV fabric — slow tier (ISSUE 17 acceptance + chaos soak).
+
+Two legs:
+
+- CHAOS SOAK: a publisher pool's fabric server flakes (deterministic
+  FaultInjector schedule: a burst of socket resets, then probabilistic
+  resets/500s/index 503s) under a shared-prefix request stream on a
+  puller pool.  Nothing wedges: every request completes, tokens stay
+  byte-identical to a fabric-less reference pool (every failed pull
+  degrades to recompute), the allocator balances, and the decision
+  counts (pulls by outcome, failures by reason, bytes, injected
+  faults) publish into SUITE_RECORD.
+- LIVE E2E: two REAL serve_lm pods as kubesim subprocesses.  Pod A is
+  fleet-entered by the reconciler-injected TPUJOB_FABRIC_PORT; its
+  fabric address is discovered off the ``tpujob.dist/fabric-port``
+  pod annotation (the PR 15 telemetry-port mechanics); pod B joins
+  with --fabric-peers.  A prompt prefilled on pod A admits on pod B
+  with a remote fabric pull: ZERO local prefill for the pulled prefix
+  (ledger-pinned — migrated_blocks covers every full prefix block,
+  exactly one migrate_in dispatch), steady-state decode exactly 1
+  dispatch/step, and the tokens byte-identical to pod A's.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # pool compiles + subprocess pods
+
+import jax
+import jax.numpy as jnp
+
+from tests.conftest import record_suite_extra
+from tests.testutil import new_job
+from tf_operator_tpu.backend.kube import KubeBackend
+from tf_operator_tpu.backend.kubejobs import KubeJobStore
+from tf_operator_tpu.backend.kubesim import FaultInjector, MiniApiServer
+from tf_operator_tpu.backend.retry import fabric_pull_policy
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.controller.reconciler import (
+    ANNOTATION_FABRIC_PORT,
+    ReconcilerConfig,
+)
+from tf_operator_tpu.models import llama_tiny
+from tf_operator_tpu.models.batching import PagedContinuousBatchingDecoder
+from tf_operator_tpu.models.fabric_service import (
+    PULL_FAILURE_REASONS,
+    FabricServer,
+    FleetFabric,
+)
+from tf_operator_tpu.models.prefix_cache import PrefixFabric
+from tf_operator_tpu.utils.metrics import Metrics
+
+VOCAB = 96
+
+
+def _setup(max_len=64):
+    model = llama_tiny(vocab_size=VOCAB, max_len=max_len)
+    init = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), init)["params"]
+    return model, params
+
+
+class _Drivers:
+    """Step threads for pools whose submit/publish paths block."""
+
+    def __init__(self, *pools):
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._drive, args=(p,), daemon=True)
+            for p in pools
+        ]
+
+    def _drive(self, pool):
+        while not self._stop.is_set():
+            if pool.step() == 0:
+                time.sleep(0.002)
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+        return False
+
+
+def test_chaos_soak_flaky_peer_never_wedges():
+    model, params = _setup()
+    r = np.random.RandomState(17)
+
+    # publisher pod: local fabric + its wire server, chaos-injected
+    mA = Metrics()
+    fabA = FleetFabric(
+        PrefixFabric(metrics=mA, model_label="t"),
+        metrics=mA, model_label="t",
+    )
+    poolA = PagedContinuousBatchingDecoder(
+        model, params, slots=4, kv_block_size=16, paged_kernel="off",
+        metrics=mA, model_label="t", replica_label="a", fabric=fabA,
+    )
+    faults = FaultInjector(seed=23)
+    srvA = FabricServer(fabA, faults=faults).start()
+
+    # puller pod: knows the prefixes only through the wire
+    mB = Metrics()
+    fabB = FleetFabric(
+        PrefixFabric(metrics=mB, model_label="t"),
+        peers=[srvA.addr], metrics=mB, model_label="t",
+        policy=fabric_pull_policy(base_delay=0.0, max_delay=0.0),
+    )
+    poolB = PagedContinuousBatchingDecoder(
+        model, params, slots=4, kv_block_size=16, paged_kernel="off",
+        metrics=mB, model_label="t", replica_label="b", fabric=fabB,
+    )
+    # fabric-less reference: the token-identity oracle under chaos
+    poolC = PagedContinuousBatchingDecoder(
+        model, params, slots=4, kv_block_size=16, paged_kernel="off",
+        metrics=Metrics(), model_label="t", replica_label="c",
+    )
+
+    prefixes = [
+        r.randint(0, VOCAB, size=(32,)).astype(np.int32)  # 2 blocks
+        for _ in range(4)
+    ]
+    trace = []
+    for i in range(16):
+        tail = r.randint(0, VOCAB, size=(int(r.randint(3, 9)),))
+        trace.append((
+            np.concatenate([prefixes[i % 4], tail.astype(np.int32)]),
+            int(r.choice([4, 8])),
+        ))
+
+    try:
+        with _Drivers(poolA, poolB, poolC):
+            # publish every prefix on A (internal prefill + migrate_out)
+            for p in prefixes:
+                pub = poolA.publish_to_fabric(p, timeout=300.0)
+                assert pub["published"] == 2
+            # chaos schedule: a deterministic reset burst first (one
+            # whole retry budget dies → reason=peer_dead, guaranteed),
+            # then seeded probabilistic flakiness for the stream
+            faults.add(path="^/fabric/blocks/", mode="reset", times=3)
+            faults.add(path="^/fabric/blocks/", mode="reset",
+                       probability=0.25)
+            faults.add(path="^/fabric/blocks/", mode="error",
+                       status=500, probability=0.2)
+            faults.add(path="^/fabric/index", mode="error",
+                       status=503, probability=0.3)
+
+            rids = []
+            for j, (prompt, budget) in enumerate(trace):
+                rids.append((
+                    poolB.submit(prompt, budget, trace_id=f"soak-{j}"),
+                    poolC.submit(prompt, budget),
+                ))
+            outs = [
+                (poolB.result_wait(rb, timeout=300),
+                 poolC.result_wait(rc, timeout=300))
+                for rb, rc in rids
+            ]
+    finally:
+        fabB.stop()
+        fabA.stop()
+        srvA.stop()
+
+    # nothing wedged, nothing diverged
+    for j, (ob, oc) in enumerate(outs):
+        assert ob is not None and oc is not None, f"request {j} wedged"
+        np.testing.assert_array_equal(
+            np.asarray(ob), np.asarray(oc),
+            err_msg=f"request {j}: chaos changed tokens",
+        )
+    poolB.alloc.check()
+    poolA.alloc.check()
+
+    snap = fabB.snapshot()
+    assert snap["pulls"]["hit"] >= 1, "no pull ever landed"
+    # the deterministic reset burst consumed one full retry budget
+    assert snap["pull_failures"].get("peer_dead", 0) >= 1
+    assert set(snap["pull_failures"]) <= set(PULL_FAILURE_REASONS)
+    assert faults.total_injected() >= 4
+    # remote-pulled bytes really crossed the wire meter
+    assert mB.counter(
+        "kv_migrate_bytes_total", direction="in", transport="http"
+    ) > 0
+
+    record_suite_extra("fabricChaosSoak", {
+        "requests": len(trace),
+        "pulls": snap["pulls"],
+        "pullFailures": snap["pull_failures"],
+        "bytesPulled": snap["bytes_pulled"],
+        "faultsInjected": faults.total_injected(),
+    })
+
+
+# ---------------------------------------------------------------- live e2e
+
+
+def _export_artifact(tmp_path):
+    """Train one step of the byte-level tiny llama and export — a real
+    artifact for the serve_lm subprocesses."""
+
+    from tf_operator_tpu.models import llama_loss
+    from tf_operator_tpu.parallel import (
+        Trainer, TrainerConfig, export_params, make_mesh,
+    )
+
+    mesh = make_mesh({"dp": 8})  # conftest's 8-device CPU mesh
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(0, 256, size=(8, 16)), jnp.int32
+    )
+    tr = Trainer(
+        llama_tiny(vocab_size=256, max_len=128, mesh=mesh),
+        TrainerConfig(optimizer="sgd", learning_rate=1e-2),
+        mesh,
+        llama_loss,
+        {"input_ids": ids},
+        init_args=(ids,),
+        shardings="logical",
+    )
+    tr.train_step(tr.shard_batch({"input_ids": ids}))
+    art = str(tmp_path / "artifact")
+    export_params(tr, art)
+    return art
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(port, payload, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(payload).encode(),
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait_healthz(port, backend, pod, deadline_s=240.0):
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            if _get(f"http://127.0.0.1:{port}/healthz", timeout=2)["ok"]:
+                return
+        except Exception:
+            if time.time() > deadline:
+                raise AssertionError(
+                    f"{pod}: healthz never came up; log tail: "
+                    + backend.pod_log("default", pod)[-800:]
+                )
+            time.sleep(1.0)
+
+
+def test_two_pod_fleet_remote_pull_e2e(tmp_path):
+    """The acceptance path over a REAL wire: serve_lm pod A prefills
+    and publishes, serve_lm pod B (peered via the reconciler-stamped
+    fabric-port annotation) serves the same prompt with a remote pull
+    instead of a local prefill — byte-identical tokens, ledger-pinned
+    dispatch accounting."""
+
+    art = _export_artifact(tmp_path)
+    port_a, port_b = _free_port(), _free_port()
+    serve = [
+        sys.executable,
+        str(__import__("pathlib").Path(__file__).resolve().parent.parent
+            / "examples" / "serve_lm.py"),
+        "--artifact", art, "--platform", "cpu", "--batching", "2",
+    ]
+
+    sim = MiniApiServer().start()
+    store = KubeJobStore(sim.url)
+    backend = KubeBackend(sim.url)
+    controller = TPUJobController(
+        store, backend, config=ReconcilerConfig(resolver=backend.resolver)
+    )
+    controller.run(threadiness=2)
+
+    def pods(job):
+        return backend.list_pods(
+            "default", {"tpujob.dist/job-name": job}
+        )
+
+    try:
+        # pod A: fleet-entered by the reconciler-injected
+        # TPUJOB_FABRIC_PORT env (announce-only — no peers yet)
+        store.create(new_job(
+            name="fab-a", worker=1,
+            command=serve + ["--port", str(port_a)],
+        ))
+
+        deadline = time.time() + 30
+        while time.time() < deadline and len(pods("fab-a")) < 1:
+            time.sleep(0.1)
+        (pod_a,) = pods("fab-a")
+        fabric_port = pod_a.metadata.annotations[ANNOTATION_FABRIC_PORT]
+        _wait_healthz(port_a, backend, "fab-a-worker-0")
+
+        # 65 tokens: 4 FULL publishable blocks + the always-computed
+        # final token (the (len-1)//16 rule)
+        prompt = ("the fleet-wide shared system prompt rides the kv "
+                  "fabric wire" + "!" * 65)[:65]
+        assert len(prompt) == 65
+        out_a = _post(port_a, {"prompt": prompt, "max_new_tokens": 8})
+        assert len(out_a["sample"]) == 8
+
+        # the annotation is truthful: pod A's fabric server answers on
+        # the stamped port with the published chain
+        idx = _get(f"http://127.0.0.1:{fabric_port}/fabric/index")
+        assert len(idx["keys"]) >= 4
+        assert idx["generation"] >= 4
+
+        # pod B: same artifact, peered at pod A's DISCOVERED address
+        store.create(new_job(
+            name="fab-b", worker=1,
+            command=serve + [
+                "--port", str(port_b),
+                "--fabric-peers", f"127.0.0.1:{fabric_port}",
+            ],
+        ))
+        deadline = time.time() + 30
+        while time.time() < deadline and len(pods("fab-b")) < 1:
+            time.sleep(0.1)
+        _wait_healthz(port_b, backend, "fab-b-worker-0")
+
+        out_b = _post(port_b, {"prompt": prompt, "max_new_tokens": 8})
+        # TOKEN IDENTITY: the pulled prefix decodes byte-identically
+        assert out_b["sample"] == out_a["sample"]
+
+        # DISPATCH ACCOUNTING (ledger-pinned): every full prefix block
+        # arrived via ONE migrate_in — zero local prefill for it — and
+        # steady-state decode stayed exactly 1 dispatch/step
+        a = _get(
+            f"http://127.0.0.1:{port_b}/requests/{out_b['request_id']}"
+        )
+        assert a["migrated_blocks"] == (len(prompt) - 1) // 16 == 4
+        assert a["pulled_blocks"] == 4
+        assert a["fabric_peer"] == f"127.0.0.1:{fabric_port}"
+        assert a["dispatches"].get("migrate_in") == 1
+        assert a["dispatches"].get("admission", 0) <= 1  # tail token only
+        assert "prefill" not in a["dispatches"]
+        assert a["windows"] == a["dispatches"]["step"]
+
+        # METRICS: remote hits + bytes by transport on pod B's /metrics
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port_b}/metrics", timeout=10
+        ) as r:
+            text = r.read().decode()
+        assert 'kv_fabric_pulls_total{model="llama",outcome="hit"} 4.0' \
+            in text
+        assert 'kv_migrate_bytes_total{direction="in",transport="http"}' \
+            in text
+        assert 'kv_fabric_peer_up{peer="127.0.0.1:' in text
+
+        # /debug/fabric: the CLI/dashboard read shows the peer up and
+        # the pull ledger
+        fab = _get(f"http://127.0.0.1:{port_b}/debug/fabric")["fabric"]
+        assert fab["pulls"]["hit"] == 4
+        assert fab["bytes_pulled"] > 0
+        assert [p["up"] for p in fab["peers"]] == [True]
+
+        # pod A never pulled anything — it is the publisher
+        fab_a = _get(f"http://127.0.0.1:{port_a}/debug/fabric")["fabric"]
+        assert fab_a["pulls"]["hit"] == 0
+        assert fab_a["publishes"] >= 4
+    finally:
+        for job in ("fab-a", "fab-b"):
+            try:
+                store.delete("default", job)
+            except Exception:
+                pass
+        deadline = time.time() + 20
+        while time.time() < deadline and (
+            pods("fab-a") or pods("fab-b")
+        ):
+            time.sleep(0.2)
+        controller.stop()
+        backend.close()
+        store.close()
+        sim.stop()
+        # belt and braces: a leaked serving subprocess would outlive
+        # the suite
+        for port in (port_a, port_b):
+            subprocess.run(
+                ["pkill", "-9", "-f", f"serve_lm.py.*--port {port}"],
+                check=False,
+            )

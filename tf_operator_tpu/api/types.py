@@ -654,6 +654,10 @@ ANNOTATION_GANG_GROUP = "scheduling.tpujob.dist/group-name"
 #: live pod records through this — the pod record IS the service
 #: discovery, no extra registry.
 ANNOTATION_TELEMETRY_PORT = "tpujob.dist/telemetry-port"
+#: Annotation for the cross-pod KV fabric (ISSUE 17): the port a
+#: serving pod's FabricServer exports /fabric/* on.  Same discovery
+#: contract as the telemetry port — live pod records ARE the registry.
+ANNOTATION_FABRIC_PORT = "tpujob.dist/fabric-port"
 
 
 def replica_name(job_name: str, rtype: ReplicaType, index: int) -> str:

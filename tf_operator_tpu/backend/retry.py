@@ -377,3 +377,17 @@ def default_policy(**overrides) -> RetryPolicy:
     args = dict(DEFAULT_POLICY_ARGS)
     args.update(overrides)
     return RetryPolicy(**args)
+
+
+#: tight budget for serving-plane fabric pulls (ISSUE 17): admission
+#: blocks on the pull and recompute is always the fallback, so give a
+#: flaky peer a couple of quick chances and then get out of the way
+FABRIC_PULL_POLICY_ARGS = dict(
+    max_attempts=3, base_delay=0.02, max_delay=0.2, deadline=2.0
+)
+
+
+def fabric_pull_policy(**overrides) -> RetryPolicy:
+    args = dict(FABRIC_PULL_POLICY_ARGS)
+    args.update(overrides)
+    return RetryPolicy(**args)

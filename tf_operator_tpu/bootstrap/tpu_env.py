@@ -55,6 +55,12 @@ ENV_TELEMETRY_PORT = "TPUJOB_TELEMETRY_PORT"
 #: into its own TraceStore — ONE id spans reconcile→boot→train.
 ENV_TRACE_ID = "TPUJOB_TRACE_ID"
 ENV_PARENT_SPAN_ID = "TPUJOB_PARENT_SPAN_ID"
+#: cross-pod KV fabric injection (ISSUE 17) — set per pod by the
+#: reconciler exactly like the telemetry port.  A serving pod
+#: (examples/serve_lm.py) boots its FabricServer on 127.0.0.1:<port>
+#: so peers can pull published prefix blocks; unset/0 = no fabric
+#: server, the single-pod default.
+ENV_FABRIC_PORT = "TPUJOB_FABRIC_PORT"
 
 
 def detected_slice_topology() -> Tuple[int, "int | None"]:

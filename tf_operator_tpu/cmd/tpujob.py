@@ -14,6 +14,7 @@ This client speaks the operator's HTTP job API instead:
     tpujob autoscaler [JOB]              # scale decisions + policy state
     tpujob queue [JOB]                   # fleet queue + scheduling decisions
     tpujob telemetry [JOB]               # fleet scrape targets (stale first)
+    tpujob fabric [JOB]                  # cross-pod KV fabric catalogs
     tpujob compile -f job.yaml           # TPUJob -> real Kubernetes YAML
                                          # (backend/gke.py; offline, no server)
 
@@ -432,6 +433,88 @@ def cmd_telemetry(args) -> int:
     return 0
 
 
+def cmd_fabric(args) -> int:
+    """Cross-pod KV fabric state (ISSUE 17).
+
+    Without a JOB argument, reads the serving pod's own
+    ``GET /debug/fabric`` off ``--server`` (point it at a serve_lm
+    address): peer table liveness-first plus the pull ledger.  With a
+    JOB argument, resolves the job's pods through the operator API,
+    reads each pod's reconciler-stamped ``tpujob.dist/fabric-port``
+    annotation, and probes every fabric server's ``/fabric/index``
+    directly — one catalog row per pod, unreachable servers flagged.
+    """
+
+    if not args.job:
+        snap = _request("GET", f"{args.server}/debug/fabric")
+        fab = snap.get("fabric", {})
+        print(f"Model:      {snap.get('model', '')}")
+        print(f"Advertise:  {fab.get('advertise', '') or '(not serving)'}")
+        print(
+            f"Catalog:    {fab.get('blocks', 0)} blocks "
+            f"(generation {fab.get('generation', 0)}, "
+            f"{fab.get('publishes', 0)} publishes, "
+            f"{fab.get('evictions', 0)} evictions, "
+            f"{fab.get('pin_expiries', 0)} pin expiries)"
+        )
+        pulls = fab.get("pulls", {})
+        print(
+            f"Pulls:      hit={pulls.get('hit', 0)} "
+            f"miss={pulls.get('miss', 0)} failed={pulls.get('failed', 0)} "
+            f"({fab.get('bytes_pulled', 0)} bytes over the wire)"
+        )
+        fails = fab.get("pull_failures", {})
+        if fails:
+            print("Failures:   " + " ".join(
+                f"{r}={n}" for r, n in sorted(fails.items())
+            ))
+        peers = fab.get("peers", [])
+        fmt = "{:<24} {:<8} {:<8} {}"
+        print("\n" + fmt.format("PEER", "STATE", "KEYS", "GENERATION"))
+        # down peers first — the what-needs-acting-on-leads convention
+        for p in sorted(peers, key=lambda p: p.get("up") is not False):
+            up = p.get("up")
+            print(fmt.format(
+                p.get("peer", ""),
+                "unknown" if up is None else ("up" if up else "DOWN"),
+                str(p.get("keys", 0)), str(p.get("generation", 0)),
+            ))
+        if not peers:
+            print("  (no peers — local-only fabric)")
+        return 0
+
+    want_ns = args.namespace
+    name = args.job
+    if "/" in name:
+        want_ns, name = name.split("/", 1)
+    pods = _request(
+        "GET", _jobs_url(args.server, want_ns, name, "pods")
+    )["items"]
+    fmt = "{:<24} {:<8} {:<8} {:<8} {:<12} {}"
+    print(fmt.format("POD", "PORT", "STATE", "KEYS", "GENERATION",
+                     "ADVERTISE"))
+    rows = 0
+    for pod in pods:
+        port = (pod.get("annotations") or {}).get("tpujob.dist/fabric-port")
+        if not port:
+            continue
+        rows += 1
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fabric/index", timeout=5
+            ) as resp:
+                idx = json.loads(resp.read())
+            print(fmt.format(
+                pod["name"], port, "up", str(len(idx.get("keys", []))),
+                str(idx.get("generation", 0)), idx.get("advertise", ""),
+            ))
+        except (OSError, ValueError) as e:
+            print(fmt.format(pod["name"], port, "DOWN", "-", "-", str(e)))
+    if not rows:
+        print("  (no pods carry a tpujob.dist/fabric-port annotation)")
+    return 0
+
+
 def cmd_compile(args) -> int:
     from tf_operator_tpu.backend.gke import compile_manifest
 
@@ -502,6 +585,13 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("job", nargs="?", default="")
     tp.add_argument("-n", "--namespace", default="default")
     tp.set_defaults(fn=cmd_telemetry)
+
+    fp = sub.add_parser(
+        "fabric", help="cross-pod KV fabric catalogs + pull ledger"
+    )
+    fp.add_argument("job", nargs="?", default="")
+    fp.add_argument("-n", "--namespace", default="default")
+    fp.set_defaults(fn=cmd_fabric)
 
     for name, fn, extra in (
         ("get", cmd_get, []),

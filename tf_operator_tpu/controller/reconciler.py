@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from tf_operator_tpu.api.types import (
+    ANNOTATION_FABRIC_PORT,
     ANNOTATION_GANG_GROUP,
     ANNOTATION_TELEMETRY_PORT,
     LABEL_JOB_NAME,
@@ -108,6 +109,13 @@ class ReconcilerConfig:
     #: worker boots a scrapable telemetry server.  Off = pods export
     #: nothing, the pre-fleet behaviour.
     pod_telemetry: bool = True
+    #: cross-pod KV fabric (ISSUE 17): allocate a per-pod
+    #: TPUJOB_FABRIC_PORT (+ the tpujob.dist/fabric-port discovery
+    #: annotation) so serving pods can export their prefix-fabric
+    #: store and discover each other off live pod records — the
+    #: telemetry-port mechanics, serving edition.  Off = no fabric
+    #: port, pods serve standalone.
+    pod_fabric: bool = True
 
 
 class Reconciler:
@@ -699,6 +707,18 @@ class Reconciler:
                 env[ENV_TRACE_ID] = sp.trace_id
                 env[ENV_PARENT_SPAN_ID] = sp.span_id
                 sp.set_attribute("telemetryPort", telemetry_port)
+            fabric_port = None
+            if self.config.pod_fabric:
+                from tf_operator_tpu.bootstrap.tpu_env import ENV_FABRIC_PORT
+                from tf_operator_tpu.controller.telemetry import (
+                    alloc_telemetry_port,
+                )
+
+                # same allocator as telemetry: bind port 0, let the OS
+                # pick a free one, hand it to the pod by env + annotation
+                fabric_port = alloc_telemetry_port()
+                env[ENV_FABRIC_PORT] = str(fabric_port)
+                sp.set_attribute("fabricPort", fabric_port)
             for c in containers:
                 merged = dict(env)
                 merged.update(c.env)  # user-specified env wins, like the reference
@@ -715,6 +735,10 @@ class Reconciler:
                 # live pod records, so the pod record carries its port
                 pod.metadata.annotations[ANNOTATION_TELEMETRY_PORT] = str(
                     telemetry_port
+                )
+            if fabric_port is not None:
+                pod.metadata.annotations[ANNOTATION_FABRIC_PORT] = str(
+                    fabric_port
                 )
             pod.scheduler_name = template.scheduler_name
             pod.node_selector = dict(template.node_selector)

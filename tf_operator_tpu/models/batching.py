@@ -1499,6 +1499,10 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
             self.num_blocks = int(kv_blocks) + 1
             self.alloc = BlockAllocator(self.num_blocks, bs)
             self._arena = paged_arena(self.dmodel, self.num_blocks, bs)
+            if fabric is not None and hasattr(fabric, "register_template"):
+                # fleet fabric (ISSUE 17): the wire decoder rebuilds
+                # pulled block records against this arena's treedef
+                fabric.register_template(self._arena)
         except NotPageableError as exc:
             if mode in ("on", "interpret"):
                 # an EXPLICIT kernel request on a model that cannot
@@ -2081,15 +2085,19 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
 
     # -- KV-block migration over the prefix-cache fabric (ISSUE 13) --------
 
-    def _count_migrate_bytes(self, direction: str, nbytes: int) -> None:
-        """kv_migrate_bytes_total{direction} — the fabric transport's
-        byte meter, split out of the linted migration paths like its
-        swap twin (``nbytes`` is host arithmetic over np buffers)."""
+    def _count_migrate_bytes(self, direction: str, nbytes: int,
+                             transport: str = "local") -> None:
+        """kv_migrate_bytes_total{direction,transport} — the fabric
+        transport's byte meter, split out of the linted migration paths
+        like its swap twin (``nbytes`` is host arithmetic over np
+        buffers).  ``transport="http"`` marks bytes that crossed the
+        cross-pod fleet fabric wire (ISSUE 17) rather than the
+        in-process store."""
 
         if self.metrics is not None and nbytes:
             self.metrics.inc(
                 "kv_migrate_bytes_total", float(nbytes),
-                direction=direction,
+                direction=direction, transport=transport,
             )
 
     def _migrate_scatter(self, u: int):
@@ -2178,10 +2186,22 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
             self.alloc.retain([int(bid)])      # +1 for this seat
             shared.append(int(bid))
             self.fabric.unpin(key)
-        self._count_migrate_bytes("in", nbytes)
+        # bytes metered per transport: blocks a FleetFabric pulled over
+        # the wire carry transport="http" on their records (ISSUE 17)
+        pulled = [rec for _, rec in fetch if rec.get("transport") == "http"]
+        nbytes_http = sum(rec["nbytes"] for rec in pulled)
+        self._count_migrate_bytes("in", nbytes - nbytes_http)
+        if pulled:
+            self._count_migrate_bytes("in", nbytes_http, transport="http")
         if req.entry is not None:
             self.request_log.add_migrate(req.entry, n)
             self.request_log.count_dispatch(req.entry, "migrate_in")
+            if pulled:
+                self.request_log.update(
+                    req.entry,
+                    fabric_peer=pulled[0].get("peer", ""),
+                    pulled_blocks=len(pulled),
+                )
 
     def publish_to_fabric(self, prompt_ids, *, tier: str = "batch",
                           trace_id: Optional[str] = None,

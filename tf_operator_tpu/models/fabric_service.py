@@ -1,0 +1,774 @@
+"""Cross-pod KV fabric service (ISSUE 17) — the networked half of the
+prefix-cache fabric.
+
+PR 13's :class:`~tf_operator_tpu.models.prefix_cache.PrefixFabric` is
+the migration transport of disaggregated serving, but it is an
+in-process object: across real pods it transports nothing.  This module
+makes the shared prompt cache a FLEET property:
+
+- :class:`FabricServer` — every serving pod exports its local fabric
+  over HTTP (the ``runtime/telemetry.PodTelemetryServer`` pattern):
+
+      GET  /fabric/index            chain-key catalog + generation stamp
+      GET  /fabric/blocks/<hexkey>  one block record on the wire
+      POST /fabric/publish          push-style key announcements
+      GET  /healthz                 liveness
+
+- :class:`FleetFabric` — the client tier, duck-type compatible with
+  ``PrefixFabric`` so the paged pool, the pool router and serve_lm use
+  it unchanged.  ``get`` resolves local-first, then pulls the block
+  from a peer that advertises its chain key; ``__contains__`` answers
+  fleet-wide (local OR any peer's announced index), which is what lets
+  a prefill replica skip recomputing a prompt some other pod already
+  published; ``put`` publishes locally and announces the key to peers.
+
+Wire format (``/fabric/blocks``): one JSON header line —
+``{"v", "key", "nbytes", "leaves": [{"shape", "dtype"}...], "sha256"}``
+— then the payload: each block-row (ndim-4) leaf as an 8-byte
+big-endian length prefix + raw bytes, in arena flatten order.  The
+header's sha256 covers the whole payload END-TO-END: a corrupt or
+short read is detected before anything touches the arena, counts
+``kv_fabric_pull_failures_total{reason}`` and degrades to a miss (the
+admission path recomputes the tail) — never a 500, never a poisoned
+block.  Coherence is free: chain keys are content addresses, so a key
+either names exactly the bytes it hashes or it does not exist.
+
+Peer discovery is the PR 15 telemetry-port mechanics — the reconciler
+allocates a port per pod and stamps it into the
+``tpujob.dist/fabric-port`` annotation (``controller/reconciler.py``)
+— or static ``serve_lm --fabric-peers host:port,...``.  Pulls ride
+``backend/retry.fabric_pull_policy`` (tight budget: admission blocks
+on this; recompute is always the fallback).
+
+Host-side only: sockets + numpy; jax is imported lazily for pytree
+flatten/unflatten of block records.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: bump when the /fabric/blocks header or payload layout changes — a
+#: version-mismatched peer reads as corrupt and degrades to recompute
+WIRE_VERSION = 1
+
+#: pull-failure taxonomy (the {reason} label): every way a remote pull
+#: can fail maps to exactly one of these, and every one of them means
+#: "recompute the tail", never an error surfaced to the request
+PULL_FAILURE_REASONS = (
+    "peer_dead",    # connection refused/reset, retry budget exhausted
+    "not_found",    # stale index: peer evicted between index and pull
+    "http_error",   # non-404 HTTP failure the retry policy gave up on
+    "corrupt",      # content hash / header / template mismatch
+    "short_read",   # payload shorter than its header claims
+    "no_template",  # no arena template registered yet (pool still booting)
+)
+
+
+class PullError(Exception):
+    """A classified remote-pull failure (``reason`` ∈
+    :data:`PULL_FAILURE_REASONS`)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+        self.reason = reason
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+
+def encode_block(key: bytes, rec: Dict[str, Any]) -> bytes:
+    """Serialise one fabric block record for the wire: JSON header
+    line + length-prefixed raw bytes of every block-row (ndim-4) leaf
+    in flatten order.  The header's sha256 covers the payload."""
+
+    import jax
+
+    parts: List[bytes] = []
+    metas: List[Dict[str, Any]] = []
+    for leaf in jax.tree_util.tree_leaves(rec["kv"]):
+        if getattr(leaf, "ndim", 0) != 4:
+            continue
+        arr = np.ascontiguousarray(leaf)
+        raw = arr.tobytes()
+        parts.append(struct.pack(">Q", len(raw)))
+        parts.append(raw)
+        metas.append({"shape": list(arr.shape), "dtype": str(arr.dtype)})
+    payload = b"".join(parts)
+    header = {
+        "v": WIRE_VERSION,
+        "key": key.hex(),
+        "nbytes": int(rec["nbytes"]),
+        "leaves": metas,
+        "sha256": hashlib.sha256(payload).hexdigest(),
+    }
+    return json.dumps(header, sort_keys=True).encode() + b"\n" + payload
+
+
+def decode_block(body: bytes, template) -> Tuple[Any, int]:
+    """Parse a ``/fabric/blocks`` response against the registered
+    arena ``template`` (treedef + per-leaf meta); returns
+    ``(kv_tree, nbytes)``.  Raises :class:`PullError` with the right
+    reason on any mismatch — the hash check runs BEFORE the tree is
+    rebuilt, so a corrupt payload never reaches the caller."""
+
+    import jax
+
+    if template is None:
+        raise PullError("no_template")
+    treedef, leaf_meta = template
+    try:
+        nl = body.index(b"\n")
+        header = json.loads(body[:nl])
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise PullError("corrupt", f"unparseable header: {exc}")
+    payload = body[nl + 1:]
+    if int(header.get("v", 0)) != WIRE_VERSION:
+        raise PullError("corrupt", f"wire version {header.get('v')!r}")
+    if hashlib.sha256(payload).hexdigest() != header.get("sha256"):
+        raise PullError("corrupt", "content hash mismatch")
+    raws: List[Tuple[Dict[str, Any], bytes]] = []
+    off = 0
+    for meta in header.get("leaves", []):
+        if off + 8 > len(payload):
+            raise PullError("short_read", "truncated length prefix")
+        (n,) = struct.unpack(">Q", payload[off: off + 8])
+        off += 8
+        if off + n > len(payload):
+            raise PullError("short_read", f"leaf needs {n} bytes")
+        raws.append((meta, payload[off: off + n]))
+        off += n
+    n_rows = sum(1 for is_row, _, _ in leaf_meta if is_row)
+    if len(raws) != n_rows:
+        raise PullError(
+            "corrupt", f"{len(raws)} wire leaves, template has {n_rows}"
+        )
+    leaves: List[Any] = []
+    it = iter(raws)
+    for is_row, shape, dtype in leaf_meta:
+        if not is_row:
+            leaves.append(np.zeros((), dtype))
+            continue
+        meta, raw = next(it)
+        want_shape = (1,) + tuple(shape[1:])
+        want_dtype = np.dtype(dtype)
+        try:
+            got_dtype = np.dtype(meta.get("dtype", "V"))
+        except TypeError:
+            raise PullError("corrupt", f"bad dtype {meta.get('dtype')!r}")
+        if tuple(meta.get("shape", ())) != want_shape or \
+                got_dtype != want_dtype:
+            raise PullError(
+                "corrupt",
+                f"leaf {meta} does not match template "
+                f"{(want_shape, str(want_dtype))}",
+            )
+        if len(raw) != want_dtype.itemsize * int(np.prod(want_shape)):
+            raise PullError("short_read", "leaf byte count mismatch")
+        leaves.append(np.frombuffer(raw, want_dtype).reshape(want_shape))
+    return (
+        jax.tree_util.tree_unflatten(treedef, leaves),
+        int(header.get("nbytes", 0)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the client tier
+# ---------------------------------------------------------------------------
+
+
+class _Peer:
+    """One peer's announced state (mutated under the fabric lock)."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.keys: set = set()
+        self.generation = 0
+        self.up: Optional[bool] = None  # None = never contacted
+        self.last_index = 0.0  # monotonic stamp of the last index read
+
+
+class FleetFabric:
+    """Fleet-wide prefix fabric: a local ``PrefixFabric`` plus the HTTP
+    client half of the cross-pod tier.  Duck-type compatible with
+    ``PrefixFabric`` (``get``/``unpin``/``record``/``put``/
+    ``__contains__``/``snapshot``), so the paged pool and pool router
+    need no special casing — ``get`` just reaches further on a local
+    miss, and the record it returns carries ``transport="http"`` +
+    ``peer`` so the migration path can meter bytes by transport.
+
+    Remote pulls need the arena pytree template to rebuild records
+    (:meth:`register_template`, called by the pool once its arena
+    exists); until then pulls degrade to misses (``reason=no_template``).
+    """
+
+    def __init__(
+        self,
+        local,
+        peers=(),
+        metrics=None,
+        model_label: str = "",
+        policy=None,
+        request_timeout: float = 1.0,
+        index_ttl_seconds: float = 2.0,
+        announce_timeout: float = 1.0,
+    ):
+        from tf_operator_tpu.backend.retry import fabric_pull_policy
+
+        self.local = local
+        self.metrics = metrics if metrics is not None else local.metrics
+        self.model_label = model_label or local.model_label
+        self.policy = policy if policy is not None else fabric_pull_policy()
+        self.request_timeout = float(request_timeout)
+        self.index_ttl_seconds = float(index_ttl_seconds)
+        self.announce_timeout = float(announce_timeout)
+        self.advertise = ""  # host:port peers pull from (set after bind)
+        self._lock = threading.Lock()
+        self._peers: "Dict[str, _Peer]" = {
+            str(a): _Peer(str(a)) for a in peers if str(a)
+        }
+        self._template = None  # (treedef, [(is_row, shape, dtype)...])
+        self.pulls = {"hit": 0, "miss": 0, "failed": 0}
+        self.pull_failures: Dict[str, int] = {}
+        self.bytes_pulled = 0
+        # -- push announcements: a daemon thread drains the queue so
+        # put() (called under the pool lock) never blocks on a socket
+        self._ann_cv = threading.Condition()
+        self._ann_pending: List[bytes] = []
+        self._ann_thread: Optional[threading.Thread] = None
+        self._ann_stop = False
+
+    # -- PrefixFabric surface ----------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.local)
+
+    def __contains__(self, key: bytes) -> bool:
+        """FLEET-wide membership: local, or advertised by any peer
+        (refreshing stale peer indexes on a miss).  This is the
+        zero-recompute lever — a prefill replica's publish pass sees a
+        prompt some other pod already published as fully present and
+        skips the local prefill entirely."""
+
+        if key in self.local:
+            return True
+        return bool(self._peers_with(key))
+
+    def unpin(self, key: bytes) -> None:
+        self.local.unpin(key)
+
+    def record(self, hit: bool) -> None:
+        self.local.record(hit)
+
+    def put(self, key: bytes, kv_tree: Any, nbytes: int) -> None:
+        self.local.put(key, kv_tree, nbytes)
+        self._announce([key])
+
+    def get(self, key: bytes, pin: bool = False):
+        """Local-first resolve; on a miss, pull from a peer whose index
+        advertises the key.  A successful pull lands in the LOCAL
+        fabric (so every later request is a local hit) and the returned
+        record — a shallow copy — carries ``transport="http"`` +
+        ``peer``.  Any failure returns None: the admission path
+        recomputes, never errors."""
+
+        rec = self.local.get(key, pin=pin)
+        if rec is not None:
+            return rec
+        with self._lock:
+            have_peers = bool(self._peers)
+        if not have_peers:
+            return None
+        candidates = self._peers_with(key)
+        if not candidates:
+            self._count_pull("miss")
+            return None
+        for peer in candidates:
+            try:
+                tree, nbytes = self._pull_block(peer, key)
+            except PullError as exc:
+                self._count_failure(exc.reason, peer)
+                if exc.reason == "not_found":
+                    with self._lock:
+                        peer.keys.discard(key)
+                continue
+            self._mark_up(peer)
+            self.local.put(key, tree, nbytes)
+            stored = self.local.get(key, pin=pin)
+            if stored is None:  # pathological capacity: serve transient
+                stored = {"kv": tree, "nbytes": int(nbytes)}
+            self._count_pull("hit")
+            with self._lock:
+                self.bytes_pulled += int(nbytes)
+            return dict(stored, transport="http", peer=peer.addr)
+        self._count_pull("failed")
+        return None
+
+    def snapshot(self) -> dict:
+        snap = self.local.snapshot()
+        with self._lock:
+            snap["advertise"] = self.advertise
+            snap["peers"] = [
+                {
+                    "peer": p.addr,
+                    "up": p.up,
+                    "keys": len(p.keys),
+                    "generation": p.generation,
+                }
+                for p in self._peers.values()
+            ]
+            snap["pulls"] = dict(self.pulls)
+            snap["pull_failures"] = dict(self.pull_failures)
+            snap["bytes_pulled"] = self.bytes_pulled
+        return snap
+
+    # -- fleet plumbing ------------------------------------------------------
+
+    def register_template(self, arena) -> None:
+        """Record the arena pytree template remote pulls decode
+        against (treedef + per-leaf block-row flag/shape/dtype).
+        Called by the paged pool right after its arena is built."""
+
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(arena)
+        meta = [
+            (
+                getattr(leaf, "ndim", 0) == 4,
+                tuple(getattr(leaf, "shape", ())),
+                str(np.dtype(leaf.dtype))
+                if hasattr(leaf, "dtype") else "float32",
+            )
+            for leaf in leaves
+        ]
+        with self._lock:
+            self._template = (treedef, meta)
+
+    def set_advertise(self, addr: str) -> None:
+        """The ``host:port`` this pod's :class:`FabricServer` serves
+        on — stamped into announcements so peers learn where to pull
+        from (announcement-based discovery for statically-configured
+        fleets)."""
+
+        self.advertise = str(addr)
+
+    def add_peer(self, addr: str) -> None:
+        addr = str(addr)
+        if not addr or addr == self.advertise:
+            return
+        with self._lock:
+            self._peers.setdefault(addr, _Peer(addr))
+
+    def handle_publish(self, payload: dict) -> None:
+        """Server-side merge of a peer's ``POST /fabric/publish``
+        announcement — unknown senders are added (discovery), known
+        senders' key sets grow.  Malformed keys are dropped, never
+        raised: announcements are best-effort."""
+
+        addr = str(payload.get("advertise") or "")
+        if not addr or addr == self.advertise:
+            return
+        keys = []
+        for k in payload.get("keys", []) or []:
+            try:
+                keys.append(bytes.fromhex(str(k)))
+            except ValueError:
+                continue
+        with self._lock:
+            peer = self._peers.get(addr)
+            if peer is None:
+                peer = self._peers[addr] = _Peer(addr)
+            peer.keys.update(keys)
+            try:
+                peer.generation = int(payload.get("generation") or 0)
+            except (TypeError, ValueError):
+                pass
+        self._mark_up(peer)
+
+    def refresh_peers(self) -> None:
+        """Force an index read of every peer (tests / CLI warmup)."""
+
+        with self._lock:
+            peers = list(self._peers.values())
+        for p in peers:
+            self._refresh_index(p)
+
+    def stop(self) -> None:
+        """Join the announcer thread (serve_lm shutdown)."""
+
+        with self._ann_cv:
+            self._ann_stop = True
+            self._ann_cv.notify()
+        t = self._ann_thread
+        if t is not None:
+            t.join(timeout=2.0)
+
+    # -- internals -----------------------------------------------------------
+
+    def _peers_with(self, key: bytes) -> List[_Peer]:
+        """Peers whose advertised index holds ``key`` — consulting the
+        cached indexes first, then re-reading any index older than
+        ``index_ttl_seconds`` (at most one HTTP round per peer per TTL
+        window, so a miss storm cannot turn into an index storm)."""
+
+        with self._lock:
+            peers = list(self._peers.values())
+        found = [p for p in peers if key in p.keys]
+        if found:
+            return found
+        now = time.monotonic()
+        for p in peers:
+            if now - p.last_index > self.index_ttl_seconds:
+                self._refresh_index(p)
+        return [p for p in peers if key in p.keys]
+
+    def _refresh_index(self, peer: _Peer) -> None:
+        url = f"http://{peer.addr}/fabric/index"
+        try:
+            with urllib.request.urlopen(
+                url, timeout=self.request_timeout
+            ) as resp:
+                idx = json.loads(resp.read())
+        except (OSError, ValueError) as exc:
+            peer.up = False
+            peer.last_index = time.monotonic()
+            if self.metrics is not None:
+                self.metrics.set(
+                    "kv_fabric_peer_up", 0.0, peer=peer.addr
+                )
+            del exc
+            return
+        keys = set()
+        for k in idx.get("keys", []) or []:
+            try:
+                keys.add(bytes.fromhex(str(k)))
+            except ValueError:
+                continue
+        with self._lock:
+            peer.keys = keys
+            try:
+                peer.generation = int(idx.get("generation") or 0)
+            except (TypeError, ValueError):
+                pass
+            peer.last_index = time.monotonic()
+        self._mark_up(peer)
+
+    def _pull_block(self, peer: _Peer, key: bytes):
+        with self._lock:
+            template = self._template
+        if template is None:
+            raise PullError("no_template")
+        url = f"http://{peer.addr}/fabric/blocks/{key.hex()}"
+
+        def attempt():
+            with urllib.request.urlopen(
+                url, timeout=self.request_timeout
+            ) as resp:
+                return resp.read()
+
+        try:
+            body = self.policy.call(
+                attempt, client="fabric", metrics=self.metrics
+            )
+        except urllib.error.HTTPError as exc:
+            raise PullError(
+                "not_found" if exc.code == 404 else "http_error",
+                f"HTTP {exc.code}",
+            )
+        except OSError as exc:
+            raise PullError("peer_dead", str(exc))
+        return decode_block(body, template)
+
+    def _count_pull(self, outcome: str) -> None:
+        with self._lock:
+            self.pulls[outcome] = self.pulls.get(outcome, 0) + 1
+        if self.metrics is not None:
+            self.metrics.inc(
+                "kv_fabric_pulls_total",
+                model=self.model_label, outcome=outcome,
+            )
+
+    def _count_failure(self, reason: str, peer: _Peer) -> None:
+        with self._lock:
+            self.pull_failures[reason] = (
+                self.pull_failures.get(reason, 0) + 1
+            )
+        if self.metrics is not None:
+            self.metrics.inc(
+                "kv_fabric_pull_failures_total",
+                model=self.model_label, reason=reason,
+            )
+        if reason == "peer_dead":
+            peer.up = False
+            if self.metrics is not None:
+                self.metrics.set(
+                    "kv_fabric_peer_up", 0.0, peer=peer.addr
+                )
+
+    def _mark_up(self, peer: _Peer) -> None:
+        peer.up = True
+        if self.metrics is not None:
+            self.metrics.set("kv_fabric_peer_up", 1.0, peer=peer.addr)
+
+    def _announce(self, keys: List[bytes]) -> None:
+        with self._lock:
+            have_peers = bool(self._peers)
+        if not have_peers or not self.advertise:
+            return
+        with self._ann_cv:
+            self._ann_pending.extend(keys)
+            if self._ann_thread is None or not self._ann_thread.is_alive():
+                self._ann_thread = threading.Thread(
+                    target=self._announce_loop,
+                    daemon=True,
+                    name="fabric-announce",
+                )
+                self._ann_thread.start()
+            self._ann_cv.notify()
+
+    def _announce_loop(self) -> None:
+        while True:
+            with self._ann_cv:
+                while not self._ann_pending and not self._ann_stop:
+                    self._ann_cv.wait(timeout=1.0)
+                if self._ann_stop and not self._ann_pending:
+                    return
+                batch, self._ann_pending = self._ann_pending, []
+            generation = getattr(self.local, "generation", 0)
+            body = json.dumps({
+                "advertise": self.advertise,
+                "keys": [k.hex() for k in batch],
+                "generation": generation,
+            }).encode()
+            with self._lock:
+                addrs = list(self._peers)
+            for addr in addrs:
+                req = urllib.request.Request(
+                    f"http://{addr}/fabric/publish",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                try:
+                    with urllib.request.urlopen(
+                        req, timeout=self.announce_timeout
+                    ):
+                        pass
+                except OSError:
+                    pass  # best effort: pull-side index reads recover
+
+
+# ---------------------------------------------------------------------------
+# the server tier
+# ---------------------------------------------------------------------------
+
+
+class FabricServer:
+    """Per-pod HTTP exporter of the local prefix fabric (the
+    ``PodTelemetryServer`` pattern: threaded stdlib server, silenced
+    logs, ``port``/``url`` properties, ``start``/``stop``).
+
+    ``fabric`` may be a bare ``PrefixFabric`` or a :class:`FleetFabric`
+    (whose ``.local`` store is served, and whose ``handle_publish``
+    receives announcements).  ``faults`` is an optional chaos hook
+    duck-typed on ``decide(method, raw_path)`` — the PR 1
+    ``backend/kubesim.FaultInjector`` plugs in directly, so chaos tests
+    can reset the socket mid-pull or 404 a block on schedule."""
+
+    def __init__(
+        self,
+        fabric,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        faults=None,
+    ):
+        self.fabric = fabric
+        self.local = getattr(fabric, "local", fabric)
+        self.faults = faults
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            server_version = "tpu-kv-fabric/1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def _send_json(self, code: int, obj) -> None:
+                self._send(
+                    code, json.dumps(obj).encode(), "application/json"
+                )
+
+            def _inject(self) -> bool:
+                """True = a fault consumed the request (chaos leg)."""
+
+                if outer.faults is None:
+                    return False
+                decision = outer.faults.decide(self.command, self.path)
+                if decision is None:
+                    return False
+                if decision[0] == "latency":
+                    time.sleep(decision[1])
+                    return False
+                if decision[0] == "error":
+                    _, status, retry_after = decision
+                    self.send_response(int(status))
+                    if retry_after is not None:
+                        self.send_header("Retry-After", str(retry_after))
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return True
+                # "reset": SO_LINGER(1, 0) + hard shutdown → the client
+                # sees ECONNRESET mid-read, the peer-died-mid-pull case
+                try:
+                    self.connection.setsockopt(
+                        socket.SOL_SOCKET,
+                        socket.SO_LINGER,
+                        struct.pack("ii", 1, 0),
+                    )
+                except OSError:
+                    pass
+                self.close_connection = True
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                return True
+
+            def do_GET(self):
+                if self._inject():
+                    return
+                route = self.path.split("?")[0]
+                try:
+                    if route == "/healthz":
+                        return self._send(200, b"ok\n", "text/plain")
+                    if route == "/fabric/index":
+                        return self._send_json(200, outer.index())
+                    if route.startswith("/fabric/blocks/"):
+                        hexkey = route[len("/fabric/blocks/"):]
+                        try:
+                            key = bytes.fromhex(hexkey)
+                        except ValueError:
+                            return self._send_json(
+                                400, {"error": "bad chain key"}
+                            )
+                        # pinned across the encode so eviction can't
+                        # race the serialisation (the PIN guard, wire
+                        # edition)
+                        rec = outer.local.get(key, pin=True)
+                        if rec is None:
+                            return self._send_json(
+                                404, {"error": "unknown chain key"}
+                            )
+                        try:
+                            body = encode_block(key, rec)
+                        finally:
+                            outer.local.unpin(key)
+                        return self._send(
+                            200, body, "application/octet-stream"
+                        )
+                    return self._send_json(404, {"error": "not found"})
+                except Exception as exc:  # noqa: BLE001 - HTTP boundary
+                    return self._send_json(
+                        500, {"error": f"{type(exc).__name__}: {exc}"}
+                    )
+
+            def do_POST(self):
+                if self._inject():
+                    return
+                route = self.path.split("?")[0]
+                try:
+                    if route == "/fabric/publish":
+                        n = int(self.headers.get("Content-Length") or 0)
+                        raw = self.rfile.read(n) if n else b"{}"
+                        try:
+                            payload = json.loads(raw or b"{}")
+                        except ValueError:
+                            return self._send_json(
+                                400, {"error": "bad announcement"}
+                            )
+                        handle = getattr(
+                            outer.fabric, "handle_publish", None
+                        )
+                        if handle is not None:
+                            handle(payload)
+                        return self._send_json(200, {"ok": True})
+                    return self._send_json(404, {"error": "not found"})
+                except Exception as exc:  # noqa: BLE001 - HTTP boundary
+                    return self._send_json(
+                        500, {"error": f"{type(exc).__name__}: {exc}"}
+                    )
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    def index(self) -> dict:
+        """The /fabric/index document: every local chain key (hex) +
+        the store's generation stamp, so clients can cheap-poll for
+        change."""
+
+        if hasattr(self.local, "index_keys"):
+            keys, generation = self.local.index_keys()
+        else:  # a duck-typed store without the stamp
+            keys, generation = list(getattr(self.local, "_entries", {})), 0
+        return {
+            "v": WIRE_VERSION,
+            "model": getattr(self.local, "model_label", ""),
+            "advertise": getattr(self.fabric, "advertise", ""),
+            "generation": int(generation),
+            "keys": [k.hex() for k in keys],
+        }
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    @property
+    def addr(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> "FabricServer":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                daemon=True,
+                name="kv-fabric",
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -104,20 +104,32 @@ class PrefixFabric:
     """
 
     def __init__(self, capacity_blocks: Optional[int] = None,
-                 metrics=None, model_label: str = ""):
+                 metrics=None, model_label: str = "",
+                 pin_ttl_seconds: float = 120.0, clock=None):
+        import time
+
         self.capacity_blocks = (
             None if capacity_blocks is None else int(capacity_blocks)
         )
         self.metrics = metrics
         self.model_label = model_label or "unknown"
+        #: ISSUE 17 small fix: pins are LEASES, not counts — a puller
+        #: that crashes between get(pin=True) and unpin can only block
+        #: eviction for this long, never forever
+        self.pin_ttl_seconds = float(pin_ttl_seconds)
+        self._clock = clock if clock is not None else time.monotonic
         self._lock = threading.Lock()
         self._entries: "OrderedDict[bytes, Any]" = OrderedDict()
-        self._pins: dict = {}  # key -> pin count (in-flight migrations)
+        self._pins: dict = {}  # key -> [lease deadline, ...] (monotonic)
         self.hits = 0
         self.misses = 0
         self.publishes = 0
         self.evictions = 0
         self.bytes_published = 0
+        self.pin_expiries = 0
+        #: bumped on every key-set change (fresh publish, eviction) —
+        #: the /fabric/index change stamp peers cheap-poll against
+        self.generation = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -139,16 +151,34 @@ class PrefixFabric:
                 return None
             self._entries.move_to_end(key)
             if pin:
-                self._pins[key] = self._pins.get(key, 0) + 1
+                self._pins.setdefault(key, []).append(
+                    self._clock() + self.pin_ttl_seconds
+                )
             return rec
 
     def unpin(self, key: bytes) -> None:
         with self._lock:
-            n = self._pins.get(key, 0)
-            if n <= 1:
+            leases = self._pins.get(key)
+            if leases:
+                leases.pop(0)
+            if not leases:
                 self._pins.pop(key, None)
+
+    def _expire_pins_locked(self, now: float) -> int:
+        """Drop pin leases past their TTL (caller holds the lock) —
+        the crashed-puller escape hatch: an entry whose every lease
+        expired is evictable again."""
+
+        expired = 0
+        for k in list(self._pins):
+            live = [d for d in self._pins[k] if d > now]
+            expired += len(self._pins[k]) - len(live)
+            if live:
+                self._pins[k] = live
             else:
-                self._pins[key] = n - 1
+                del self._pins[k]
+        self.pin_expiries += expired
+        return expired
 
     def record(self, hit: bool) -> None:
         """Request-level hit/miss accounting (one increment per
@@ -184,15 +214,18 @@ class PrefixFabric:
             if fresh:
                 self.publishes += 1
                 self.bytes_published += int(nbytes)
+                self.generation += 1
             evicted = 0
             if self.capacity_blocks is not None:
+                self._expire_pins_locked(self._clock())
                 for k in list(self._entries):
                     if len(self._entries) <= self.capacity_blocks:
                         break
                     if self._pins.get(k):
-                        continue  # a migration holds it — never reclaim
+                        continue  # a LIVE lease holds it — never reclaim
                     del self._entries[k]
                     self.evictions += 1
+                    self.generation += 1
                     evicted += 1
         if self.metrics is not None:
             if fresh:
@@ -212,14 +245,24 @@ class PrefixFabric:
                     mode="fabric",
                 )
 
+    def index_keys(self):
+        """``(chain keys, generation)`` — the /fabric/index read
+        (models/fabric_service.FabricServer)."""
+
+        with self._lock:
+            return list(self._entries.keys()), self.generation
+
     def snapshot(self) -> dict:
         """The observability read (rides /debug/arena on serve_lm)."""
 
         with self._lock:
+            self._expire_pins_locked(self._clock())
             return {
                 "blocks": len(self._entries),
                 "capacity_blocks": self.capacity_blocks,
                 "pinned": sum(1 for v in self._pins.values() if v),
+                "pin_expiries": self.pin_expiries,
+                "generation": self.generation,
                 "hits": self.hits,
                 "misses": self.misses,
                 "publishes": self.publishes,

@@ -128,6 +128,9 @@ def _pod_to_dict(pod) -> dict:
         "name": pod.metadata.name,
         "namespace": pod.metadata.namespace,
         "labels": dict(pod.metadata.labels),
+        # reconciler-stamped discovery (telemetry/fabric ports ride
+        # tpujob.dist/* annotations — the tpujob CLI resolves them here)
+        "annotations": dict(pod.metadata.annotations),
         "phase": pod.phase.value,
         "exitCode": pod.exit_code,
         "replicaType": pod.replica_type.value if pod.replica_type else None,

@@ -41,7 +41,11 @@ subsystem's /traces endpoints, utils/trace.py):
   paged-pool data: the operator API has no `/debug/arena` route (the
   fetch 404s), and serve_lm without a paged pool answers 200 with an
   empty `replicas` list — both paths leave the panel hidden, so the
-  operator dashboard and an embedded serving dashboard share one page.
+  operator dashboard and an embedded serving dashboard share one page;
+- **kv fabric** (ISSUE 17) — the cross-pod prefix fabric's peer table
+  (liveness, advertised key count, catalog generation) and pull ledger
+  (hit/miss/failed + wire bytes) from serve_lm's `/debug/fabric`;
+  self-hides by the same 404 convention as the arena panel.
 """
 
 DASHBOARD_HTML = """<!doctype html>
@@ -151,6 +155,15 @@ DASHBOARD_HTML = """<!doctype html>
 <h2>kv arena</h2>
 <div id="arena"></div>
 </div>
+<div id="fabric-panel" style="display:none">
+<h2>kv fabric</h2>
+<table id="fabric">
+  <thead><tr><th>peer</th><th>state</th><th>keys</th>
+  <th>generation</th></tr></thead>
+  <tbody></tbody>
+</table>
+<div id="fabric-summary" class="muted"></div>
+</div>
 <h2>traces</h2>
 <table id="traces">
   <thead><tr><th>trace</th><th>root</th><th>spans</th><th>duration</th>
@@ -218,6 +231,7 @@ async function refresh() {
   refreshHealth();
   refreshTraces();
   refreshArena();
+  refreshFabric();
   refreshFleet();
 }
 
@@ -360,6 +374,59 @@ async function refreshArena() {
       `${last.seats_active} seats — ${samples.length} samples`;
     el.appendChild(svg); el.appendChild(label);
   }
+}
+
+async function refreshFabric() {
+  // cross-pod KV fabric panel (ISSUE 17): this pod's catalog + peer
+  // table from /debug/fabric — liveness per peer, advertised key
+  // counts, and the pull ledger (hit/miss/failed + bytes over the
+  // wire).  Hidden when there is no fabric: the operator API has no
+  // /debug/fabric route (fetch 404s), and serve_lm without a prefix
+  // fabric answers 404 too — both leave the panel dark.
+  let snap;
+  try {
+    const res = await fetch("/debug/fabric");
+    if (!res.ok) throw new Error("no fabric");
+    snap = await res.json();
+  } catch (e) {
+    document.getElementById("fabric-panel").style.display = "none";
+    return;
+  }
+  const fab = snap.fabric || {};
+  document.getElementById("fabric-panel").style.display = "";
+  const tbody = document.querySelector("#fabric tbody");
+  tbody.innerHTML = "";
+  const peers = fab.peers || [];
+  if (!peers.length) {
+    const tr = document.createElement("tr");
+    const td = document.createElement("td");
+    td.textContent = "no peers (local-only fabric)"; td.className = "muted";
+    td.colSpan = 4; tr.appendChild(td); tbody.appendChild(tr);
+  }
+  for (const p of peers) {
+    const tr = document.createElement("tr");
+    if (p.up === false) tr.classList.add("alert-firing");
+    const cells = [
+      p.peer,
+      p.up === null ? "unknown" : (p.up ? "up" : "down"),
+      String(p.keys), String(p.generation),
+    ];
+    for (const text of cells) {
+      const td = document.createElement("td");
+      td.textContent = text;  // peer addrs ride pod annotations
+      tr.appendChild(td);
+    }
+    tbody.appendChild(tr);
+  }
+  const pulls = fab.pulls || {};
+  const fails = Object.entries(fab.pull_failures || {})
+    .map(([r, n]) => `${r}:${n}`).join(" ");
+  document.getElementById("fabric-summary").textContent =
+    `${fab.blocks || 0} blocks published (gen ${fab.generation || 0}), ` +
+    `pulls hit=${pulls.hit || 0} miss=${pulls.miss || 0} ` +
+    `failed=${pulls.failed || 0}, ` +
+    `${fab.bytes_pulled || 0} bytes pulled` +
+    (fails ? ` — failures ${fails}` : "");
 }
 
 async function refreshAutoscaler() {

@@ -277,6 +277,22 @@ def default_rules(
             severity="ticket",
         ),
         ThresholdRule(
+            # cross-pod fabric peer health (ISSUE 17): a remote prefix
+            # pull died at the socket (connect refused / mid-body
+            # reset).  The pull path already fell back to recompute —
+            # requests still succeed — so this tickets rather than
+            # pages, but a peer that stays dead means every shared
+            # prefix is being recomputed and the fleet hit rate is
+            # quietly zero.  Scoped to reason="peer_dead": index 404s
+            # (stale catalog) and corrupt payloads are normal churn the
+            # content hash absorbs.
+            "fabric-peer-unreachable",
+            metric="kv_fabric_pull_failures_total",
+            kind="counter_increase", threshold=0.0, window=short,
+            labels={"reason": "peer_dead"},
+            severity="ticket",
+        ),
+        ThresholdRule(
             "checkpoint-stale",
             metric="checkpoint_last_success_unix",
             kind="gauge_age", threshold=1800.0,
