@@ -1551,8 +1551,19 @@ def bench_speculative() -> dict:
 
     # the draft!=target weight-bound configuration.  ~700M init is
     # chip-minutes on its own; skipped on the tiny CPU smoke and
-    # gate-able via MEASURE_SPEC_WIDE=0.
-    if not tiny and os.environ.get("MEASURE_SPEC_WIDE", "1") != "0":
+    # gate-able via MEASURE_SPEC_WIDE=0.  A skipped leg records a
+    # PARSEABLE reason pointing at the paged plane's ledger phases
+    # (draft/verify — the row serving actually reads since ISSUE 18),
+    # not the dead pre-paged prefill/generate key names.
+    if tiny or os.environ.get("MEASURE_SPEC_WIDE", "1") == "0":
+        out["speculative_wide_skipped"] = (
+            ("tiny CPU smoke" if tiny else "MEASURE_SPEC_WIDE=0")
+            + " — no wide draft!=target row this run; the serving-"
+            "facing speculative measurement is the paged-plane row "
+            "(spec_paged_*, ledger phases draft+verify, --section "
+            "speculative-paged)"
+        )
+    else:
         try:
             wcfg = llama_wide_config(
                 int(os.environ.get("MEASURE_SPEC_WIDE_MAXLEN", "512"))
@@ -1576,6 +1587,145 @@ def bench_speculative() -> dict:
             )
         except Exception as exc:  # additive, never fatal to the mini row
             out["speculative_wide_error"] = repr(exc)[:200]
+    return out
+
+
+def bench_speculative_paged() -> dict:
+    """Speculative decoding ON THE PAGED PLANE (ISSUE 18): the serving
+    row serve_lm's ``--speculative`` guard reads.  An int8 self-draft
+    (the target weights quantized — no second model to train) pages
+    its KV through the SAME BlockAllocator arena, verification of all
+    K draft tokens is ONE fused multi-query dispatch, and
+    accept/rollback happen in-graph — steady state is exactly one
+    ``draft`` + one ``verify`` ledger dispatch per window.  Measured
+    against the NON-speculative paged pool at the SAME arena and seat
+    count over an interactive trace:
+
+    - ``spec_paged_speedup``: wall-clock tokens/sec ratio — the
+      guard's >1x lift criterion;
+    - ``spec_paged_dispatches_per_token``: the CPU-honest acceptance
+      metric — 2 dispatches/window over tokens actually emitted;
+      < 1.0 means speculation beats one-dispatch-per-token in
+      DISPATCH COUNT regardless of this box's walls;
+    - ``spec_paged_acceptance`` + per-tier p99 TTFT for both pools.
+
+    CPU smoke: MEASURE_SPEC_TINY=1 swaps in llama_tiny (the
+    tpu_window step runs this every round)."""
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.models.batching import (
+        PagedContinuousBatchingDecoder,
+    )
+    from tf_operator_tpu.ops.quant import quantize_tree
+    from tf_operator_tpu.utils.metrics import SLO_BUCKETS, Metrics
+
+    _apply_platform_override(jax)
+    out = {"spec_paged_backend": jax.default_backend()}
+    tiny = bool(os.environ.get("MEASURE_SPEC_TINY"))
+    seq = int(os.environ.get(
+        "MEASURE_SPEC_PAGED_MAXLEN", "192" if tiny else "512"
+    ))
+    block = int(os.environ.get("MEASURE_SPEC_PAGED_BLOCK", "16"))
+    slots = int(os.environ.get("MEASURE_SPEC_PAGED_SLOTS", "4"))
+    n_req = int(os.environ.get("MEASURE_SPEC_PAGED_REQUESTS", "8"))
+    # long enough that steady-state windows, not admission prefill,
+    # carry the wall — the ratio is meaningless otherwise
+    n_new = int(os.environ.get("MEASURE_SPEC_PAGED_NEW", "96"))
+    spec_k = int(os.environ.get("MEASURE_SPEC_K", "4"))
+    if tiny:
+        from tf_operator_tpu.models import llama_tiny
+
+        model = llama_tiny(vocab_size=256, max_len=seq)
+        cfg_name = "llama-tiny"
+    else:
+        from bench import llama_mini_config
+        from tf_operator_tpu.models import LlamaLM
+
+        model = LlamaLM(llama_mini_config(seq))
+        cfg_name = "llama-mini"
+    vocab = model.cfg.vocab_size
+    r = np.random.RandomState(0)
+    init_ids = jnp.asarray(r.randint(0, vocab, size=(1, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), init_ids)["params"]
+    qparams = quantize_tree(params)
+
+    # all-interactive trace (speculation is tier-gated to interactive:
+    # the latency class it exists for); mixed prompt lengths
+    trace = []
+    for _ in range(n_req):
+        p_len = int(r.randint(4, max(5, seq // 4)))
+        budget = min(n_new, seq - p_len)
+        prompt = r.randint(0, vocab, size=(p_len,)).astype(np.int32)
+        trace.append((prompt, budget))
+    total_new = sum(b for _, b in trace)
+    arena = slots * (seq // block)
+    out["spec_paged_requests"] = n_req
+    out["spec_paged_new_tokens"] = total_new
+    out["spec_paged_arena_blocks"] = arena
+    out["spec_paged_k"] = spec_k
+    out["spec_paged_config"] = (
+        f"{cfg_name} target + int8 self-draft, k={spec_k}, "
+        "tier=interactive, shared block arena"
+    )
+
+    def replay(speculative: bool):
+        kw = (
+            dict(draft_model=model, draft_params=qparams, spec_k=spec_k)
+            if speculative else {}
+        )
+        metrics = Metrics()
+        metrics.set_buckets("serve_ttft_seconds", SLO_BUCKETS)
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=slots, kv_blocks=arena,
+            kv_block_size=block, metrics=metrics,
+            model_label="spec-paged-bench", **kw,
+        )
+        # warmup compiles the width classes (admission + draft
+        # prefill) off the clock
+        for p, budget in trace:
+            pool.submit(p, budget, tier="interactive")
+        pool.run()
+        pool.ledger.reset()
+        metrics2 = Metrics()
+        metrics2.set_buckets("serve_ttft_seconds", SLO_BUCKETS)
+        pool.metrics = metrics2
+        if speculative:
+            pool.spec_windows = pool.spec_proposed = 0
+            pool.spec_accepted = pool.spec_rollbacks = 0
+            pool.spec_emitted = 0
+        t0 = time.perf_counter()
+        for p, budget in trace:
+            pool.submit(p, budget, tier="interactive")
+        pool.run()
+        wall = time.perf_counter() - t0
+        pool.alloc.check()
+        return wall, pool, metrics2
+
+    wall_p, pool_p, m_p = replay(False)
+    out["spec_paged_plain_tokens_per_sec"] = round(total_new / wall_p, 1)
+    out["spec_paged_plain_p99_ttft_s"] = m_p.histogram(
+        "serve_ttft_seconds", model="spec-paged-bench", mode="pool",
+        tier="interactive",
+    ).get("p99_le")
+
+    wall_s, pool_s, m_s = replay(True)
+    out["spec_paged_tokens_per_sec"] = round(total_new / wall_s, 1)
+    out["spec_paged_p99_ttft_s"] = m_s.histogram(
+        "serve_ttft_seconds", model="spec-paged-bench", mode="pool",
+        tier="interactive",
+    ).get("p99_le")
+    out["spec_paged_speedup"] = round(wall_p / wall_s, 2)
+    snap = pool_s.spec_snapshot()
+    out["spec_paged_acceptance"] = round(snap["acceptance_rate"], 3)
+    out["spec_paged_dispatches_per_token"] = round(
+        snap["dispatches_per_token"], 3
+    )
+    out["spec_paged_windows"] = int(snap["spec_windows"])
+    out["spec_paged_rollbacks"] = int(snap["spec_rollbacks"])
+    out["spec_paged_dispatches"] = pool_s.ledger.snapshot()
     return out
 
 
@@ -1632,7 +1782,8 @@ def main() -> int:
         "--section",
         choices=[
             "all", "reconcile", "startup", "train", "batching",
-            "speculative", "paged", "multislice", "fabric",
+            "speculative", "speculative-paged", "paged", "multislice",
+            "fabric",
         ],
         default="all",
     )
@@ -1677,6 +1828,8 @@ def main() -> int:
         out.update(bench_batching())
     if args.section == "speculative":  # not in "all": needs chip minutes
         out.update(bench_speculative())
+    if args.section == "speculative-paged":  # not in "all": chip minutes
+        out.update(bench_speculative_paged())
     if args.section == "paged":  # not in "all": needs chip minutes
         out.update(bench_paged())
     if args.section == "multislice":  # not in "all": needs its own jax env

@@ -15,6 +15,9 @@ if earlier ones prove the chip is answering):
   6. batching     — continuous-batching pool vs sequential serving
   6b. paged       — paged-KV pool vs slot pool at equal arena (CPU smoke)
   7. speculative  — int8 self-draft speculation vs plain greedy
+  7b. speculative-paged — spec decoding on the paged plane (chip + CPU
+      smoke): draft KV in the shared block arena, fused K-token
+      verify, vs the non-speculative pool at the same arena
   8. trace        — xplane trace of the hot step + top-op summary
   9. sweep        — the ResNet MFU variant x flag matrix
  10. llama-sweep  — the transformer variant/autotune matrix
@@ -161,6 +164,34 @@ STEPS = [
         [sys.executable, os.path.join(HERE, "measure.py"),
          "--section", "speculative"],
         2700,
+    ),
+    # speculative decoding ON THE PAGED PLANE (ISSUE 18): int8
+    # self-draft in the shared block arena, one fused K-token verify
+    # dispatch per window, vs the non-speculative paged pool at the
+    # same arena — the spec_paged_* row serve_lm's --speculative
+    # guard reads.  Run ON CHIP when the window has one...
+    (
+        "speculative-paged-chip",
+        [sys.executable, os.path.join(HERE, "measure.py"),
+         "--section", "speculative-paged"],
+        2700,
+        {
+            "MEASURE_SPEC_PAGED_MAXLEN": "512",
+            "MEASURE_SPEC_PAGED_NEW": "128",
+        },
+    ),
+    # ...and as a CPU smoke every round (acceptance + the ledger-pinned
+    # dispatches-per-token arithmetic are platform-independent; the
+    # walls come back backend-tagged so they never displace chip rows)
+    (
+        "speculative-paged",
+        [sys.executable, os.path.join(HERE, "measure.py"),
+         "--section", "speculative-paged"],
+        1500,
+        {
+            "MEASURE_PLATFORM": "cpu",
+            "MEASURE_SPEC_TINY": "1",
+        },
     ),
     # the >=0.40-MFU existence proof at serious width (~700M d_model
     # 2048, VERDICT r4 next #3) — before the long sweeps so a dying

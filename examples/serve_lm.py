@@ -30,10 +30,13 @@ missing tail — migrate_in) and run the unchanged 1-dispatch/step
 loop, and the two replica classes scale independently off
 kv_blocks_pressure{role=}; `--quantize int8` halves HBM
 weight traffic per decoded token (ops/quant.py); `--speculative`
-serves greedy requests through the int8 self-draft speculative
-decoder (models/speculative.py — batch-1 latency mode).  `--quantize`
-composes with either; `--batching` and `--speculative` are mutually
-exclusive (throughput vs latency optimizations).
+(r18, ISSUE 18) speculates ON THE PAGED POOL: an int8 self-draft
+pages its KV through the same block arena, K draft tokens verify in
+one fused multi-query dispatch, accept/rollback happen in-graph, and
+speculation is gated per SLO tier (interactive by default — see
+--spec-tiers).  `--quantize` composes with either; `--speculative`
+composes with `--batching` (and defaults to 4 slots when given
+alone).
 
 The jit-compile cache is bounded BY DESIGN (VERDICT r3 weak #5/next #9):
 prompts prefill through the KV cache in power-of-2 chunks (binary
@@ -103,12 +106,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def speculative_slowdown(ledger_path: "str | None" = None):
     """The measured speculative verdict from the last-measured ledger:
-    ``(best_speedup, row)`` over every measured speculative config
-    (self-draft mini ``speculative_speedup``, int8-draft wide target
-    ``speculative_wide_speedup``), or ``(None, None)`` when nothing has
-    been measured.  main() refuses --speculative when the best measured
-    config is a slowdown — the 0.1x row must not be the feature's
-    silent default face."""
+    ``(best_speedup, row)``, or ``(None, None)`` when nothing has been
+    measured.  Since ISSUE 18 this reads the PAGED-PLANE row
+    (``spec_paged_speedup`` — int8 self-draft in the shared block
+    arena vs the non-speculative paged pool at the same arena, the
+    configuration ``--speculative`` actually serves), NOT the dead
+    pre-paged ``speculative_speedup``/``speculative_wide_speedup``
+    rows: those measured the orphaned batch-1 SpeculativeDecoder and
+    must not unfence (or fence) the pool path.  main() refuses
+    --speculative when the best measured row is a slowdown — the 0.1x
+    era must not be the feature's silent default face."""
 
     if ledger_path is None:
         ledger_path = os.path.join(
@@ -122,7 +129,7 @@ def speculative_slowdown(ledger_path: "str | None" = None):
         return None, None
     rows = {
         key: ledger[key]
-        for key in ("speculative_speedup", "speculative_wide_speedup")
+        for key in ("spec_paged_speedup",)
         if isinstance(ledger.get(key), dict) and "value" in ledger[key]
     }
     if not rows:
@@ -189,17 +196,20 @@ def build_handler(
     paged_kernel: str = "auto", kv_swap_blocks: "int | None" = None,
     roles: "list[str] | None" = None,
     fabric_peers: "list[str] | None" = None,
+    spec_k: int = 4, spec_tiers: "tuple[str, ...] | None" = None,
 ):
     """batching_slots > 0 serves through the continuous-batching pool
     (models/batching.py): concurrent requests share one decode loop,
     joining at step granularity, driven by a single background thread;
     per-slot temperature and top_k (<= batching.TOP_K_MAX — the pool's
     static top-k width; larger values get a 400 rather than silently
-    differing).  speculative=True serves greedy AND temperature
-    requests through the int8 self-draft SpeculativeDecoder (batch-1
-    latency mode; both are exact — greedy by verification, temperature
-    by the rejection rule); top_k requests fall back to the chunked
-    decoder.
+    differing).  speculative=True (ISSUE 18) serves through the SAME
+    paged pool with an int8 self-draft speculating in the shared block
+    arena: K draft tokens verified in one fused dispatch, in-graph
+    accept/rollback, exact for greedy (verification) and temperature
+    (rejection rule).  Speculation is gated per SLO tier (default
+    interactive only — batch throughput doesn't want the draft FLOPs);
+    batching_slots defaults to 4 when --speculative is given alone.
     """
 
     import threading
@@ -269,8 +279,8 @@ def build_handler(
 
     def observe_slo(mode: str, queue_wait: float, ttft: float,
                     tpot: float, exemplar: "str | None" = None) -> None:
-        """Single-dispatch modes (chunked/speculative) produce their
-        whole output in one program: the first token is host-visible
+        """The single-dispatch chunked mode produces its whole output
+        in one program: the first token is host-visible
         only when every token is, so TTFT is honestly the full
         generate wall and time-per-output-token is wall/n (docs/
         SERVING.md "SLO definitions").  The pool observes its own
@@ -291,33 +301,30 @@ def build_handler(
             exemplar=exemplar, model=model_label, mode=mode,
         )
 
+    spec_pool_kw = {}
     if speculative:
-        if batching_slots > 0:
-            raise ValueError(
-                "--speculative and --batching are mutually exclusive: "
-                "speculation is a batch-1 latency optimization, the pool "
-                "is a throughput one"
-            )
-        from tf_operator_tpu.models.speculative import SpeculativeDecoder
         from tf_operator_tpu.ops.quant import is_quantized, quantize_tree
 
-        # self-speculation: the draft is the SAME weights int8-quantized
-        # (half the HBM bytes per draft step, near-total agreement).
-        # If serving already quantized (--quantize int8), target and
-        # draft share the int8 tree — still exact, just less speedup.
+        # ISSUE 18: speculation IS a paged-pool mode now — the draft's
+        # KV pages through the same block arena, verify is one fused
+        # multi-query dispatch, accept/rollback happen in-graph.  The
+        # draft is the SAME weights int8-quantized (half the HBM bytes
+        # per draft step, near-total agreement).  If serving already
+        # quantized (--quantize int8), target and draft share the int8
+        # tree — still exact, just less speedup.
+        if batching_slots <= 0:
+            batching_slots = 4  # spec serving rides the pool
         dparams = params if is_quantized(params) else quantize_tree(params)
-        spec = SpeculativeDecoder(model, params, model, dparams, k=4,
-                                  ledger=ledger)
-        spec_lock = threading.Lock()  # generate mutates decoder telemetry
-        pool = None
-        pool_replicas = []
-        pool_fatal = []
-        pool_fabric = None
-        # top_k fallback path; prompt-KV reuse helps it too
-        decoder = ChunkedServingDecoder(
-            model, params, prompt_cache=prompt_cache, ledger=ledger,
+        spec_pool_kw = dict(
+            draft_model=model, draft_params=dparams, spec_k=spec_k,
         )
-    elif batching_slots > 0:
+        if spec_tiers is not None:
+            # passed through UNVALIDATED on purpose: the pool's
+            # constructor raises on a typo'd tier, so a bad
+            # --spec-tiers fails startup instead of silently serving
+            # non-speculatively (the PR 10 honesty rule)
+            spec_pool_kw["spec_tiers"] = tuple(spec_tiers)
+    if batching_slots > 0:
         if prompt_cache:
             raise ValueError(
                 "--prompt-cache applies to the chunked decoder; the "
@@ -381,6 +388,7 @@ def build_handler(
                     paged_kernel=paged_kernel,
                     swap_blocks=kv_swap_blocks,
                     role=role_list[i], fabric=fabric,
+                    **spec_pool_kw,
                 )
                 if i == 0:
                     print(
@@ -389,6 +397,14 @@ def build_handler(
                         flush=True,
                     )
             except NotPageableError as exc:
+                if spec_pool_kw:
+                    # speculation exists ONLY on the paged plane (the
+                    # draft's KV lives in the block arena) — a model
+                    # the paged pool refuses must fail --speculative
+                    # startup, never silently serve non-speculatively
+                    raise ValueError(
+                        f"--speculative requires the paged pool: {exc}"
+                    ) from exc
                 if fabric is not None:
                     # the fabric transport is block-granular: a model
                     # the paged pool refuses cannot be disaggregated —
@@ -445,11 +461,9 @@ def build_handler(
             threading.Thread(
                 target=_drive, args=(p, name), daemon=True
             ).start()
-        spec = None
         pool_fabric = fabric
     else:
         pool = None
-        spec = None
         pool_replicas = []
         pool_fatal = []
         pool_fabric = None
@@ -538,11 +552,21 @@ def build_handler(
                 extra = []
                 if pool is not None:
                     extra.append(f"serve_pool_compiles {pool.compile_count}")
-                if spec is not None:
+                if pool is not None and getattr(pool, "spec_enabled", False):
+                    # paged-plane speculation gauges (ISSUE 18): the
+                    # counter families (serve_spec_*_total{model,tier})
+                    # ride the registry; acceptance and the CPU-honest
+                    # dispatches-per-token ratio are derived here
+                    snap = pool.spec_snapshot()
                     extra.append(
-                        f"serve_spec_acceptance_rate {spec.acceptance_rate:.4f}"
+                        "serve_spec_acceptance_rate "
+                        f"{snap['acceptance_rate']:.4f}"
                     )
-                    extra.append(f"serve_spec_compiles {spec.compile_count}")
+                    dpt = snap["dispatches_per_token"]
+                    if dpt != float("inf"):
+                        extra.append(
+                            f"serve_spec_dispatches_per_token {dpt:.4f}"
+                        )
                 if pool is None:  # chunked decoder serves (or backstops)
                     extra.append(
                         f"serve_prompt_cache_hits {decoder.prompt_cache_hits}"
@@ -725,8 +749,8 @@ def build_handler(
                 return self._reply(404, {"error": "unknown path"})
             # every request is a server span: adopt an incoming trace
             # id (x-trace-id/x-parent-span-id) or root a fresh one;
-            # request-thread decoder dispatches (chunked + speculative
-            # paths) nest under it as dispatch.<phase> children.  Pool
+            # request-thread decoder dispatches (chunked path) nest
+            # under it as dispatch.<phase> children.  Pool
             # dispatches run on the driver thread — they link by the
             # rid attribute instead (docs/ARCHITECTURE.md "serving
             # dispatch accounting").
@@ -873,31 +897,6 @@ def build_handler(
                               "request_id": span.trace_id}
                     )
                 prompt = jnp.asarray(ids, jnp.int32)[None]
-                if spec is not None and top_k is None:
-                    # greedy AND temperature requests: speculative
-                    # sampling is exact for both (rejection rule);
-                    # only top_k falls back to the chunked decoder
-                    span.set_attribute("mode", "speculative")
-                    t_q = _time.perf_counter()
-                    with spec_lock:
-                        # lock wait IS this mode's admission queue
-                        t_gen = _time.perf_counter()
-                        out = spec.generate(
-                            prompt, n_new, temperature=temperature,
-                            rng=jax.random.PRNGKey(seed)
-                            if temperature > 0.0 else None,
-                        )
-                    done = _time.perf_counter()
-                    # TTFT from SUBMIT (t_q): the lock wait is queueing
-                    # the user experiences, same clock as pool TTFT
-                    observe_slo(
-                        "speculative", t_gen - t_q, done - t_q,
-                        (done - t_gen) / n_new, exemplar=span.trace_id,
-                    )
-                    sample = finish(decode_bytes(np.asarray(out[0, prompt.shape[1]:])))
-                    return self._reply(
-                        200, {"prompt": text, "sample": sample, "seed": seed}
-                    )
                 span.set_attribute("mode", "chunked")
                 t_gen = _time.perf_counter()
                 out = decoder.generate(
@@ -927,6 +926,9 @@ def build_handler(
     #: the pool's prefix fabric (None outside pool modes) — main()
     #: boots the FabricServer over it and stamps the advertise addr
     Handler.pool_fabric = pool_fabric
+    #: the serving pool (None in chunked mode) — tests assert the
+    #: speculative config actually landed on it (ISSUE 18)
+    Handler.pool = pool
     return Handler
 
 
@@ -948,18 +950,34 @@ def main() -> int:
     )
     ap.add_argument(
         "--speculative", action="store_true",
-        help="serve greedy requests through the int8 self-draft "
-             "speculative decoder (batch-1 latency mode; sampling "
-             "requests fall back to the chunked decoder); mutually "
-             "exclusive with --batching.  REFUSES to start when every "
-             "measured speculative config in "
-             "benchmarks/LAST_MEASURED.json is a slowdown on this box",
+        help="speculate on the paged pool (ISSUE 18): an int8 "
+             "self-draft pages its KV through the same block arena, K "
+             "draft tokens verify in one fused dispatch, accept/"
+             "rollback happen in-graph.  Composes with --batching "
+             "(defaults to 4 slots when given alone); gated per SLO "
+             "tier (interactive by default — see --spec-tiers).  "
+             "REFUSES to start when the measured spec_paged_speedup "
+             "row in benchmarks/LAST_MEASURED.json is a slowdown on "
+             "this box",
     )
     ap.add_argument(
         "--speculative-force", action="store_true",
         help="serve --speculative even though the measured ledger says "
-             "it is a slowdown here (for real-RTT deployments where "
-             "the dispatch economics differ)",
+             "it is a slowdown here (for deployments whose dispatch "
+             "economics differ from the measured box)",
+    )
+    ap.add_argument(
+        "--spec-k", type=int, default=4, metavar="K",
+        help="draft tokens proposed per speculative window (validated "
+             "by the pool: K < 1 fails startup)",
+    )
+    ap.add_argument(
+        "--spec-tiers", default=None, metavar="T1[,T2]",
+        help="comma-separated SLO tiers that speculate (default: "
+             "interactive only — batch throughput doesn't want the "
+             "draft FLOPs).  A typo'd tier FAILS STARTUP (the pool "
+             "validates against its SLO tier set) — never a silent "
+             "non-speculative downgrade",
     )
     ap.add_argument(
         "--batching", type=int, default=0, metavar="SLOTS",
@@ -1049,15 +1067,19 @@ def main() -> int:
     if args.speculative and not args.speculative_force:
         best, row = speculative_slowdown()
         if best is not None and best < 1.0:
+            cfg = row.get(
+                "config", "int8 self-draft on the paged pool"
+            )
             raise SystemExit(
                 f"--speculative refused: the best MEASURED speculative "
-                f"config on this box is {best}x of plain decode "
-                f"({row['metric']}, {row['artifact']}, {row['date']}) — "
-                "serving it would be a measured slowdown, not a feature. "
-                "Re-measure with `python benchmarks/measure.py --section "
-                "speculative` (the draft!=target wide config included), "
-                "or pass --speculative-force on a deployment whose "
-                "dispatch RTT is not this box's ~66 ms tunnel."
+                f"config on this box is {best}x of the non-speculative "
+                f"paged pool at the same arena ({cfg}; {row['metric']}, "
+                f"{row['artifact']}, {row['date']}) — serving it would "
+                "be a measured slowdown, not a feature.  Re-measure "
+                "with `python benchmarks/measure.py --section "
+                "speculative-paged`, or pass --speculative-force on a "
+                "deployment whose dispatch economics differ from the "
+                "measured box."
             )
 
     if args.platform:
@@ -1166,6 +1188,13 @@ def main() -> int:
         kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size,
         paged_kernel=args.paged_kernel, kv_swap_blocks=args.kv_swap_blocks,
         roles=role_list, fabric_peers=fabric_peers,
+        spec_k=args.spec_k,
+        spec_tiers=(
+            tuple(
+                t.strip() for t in args.spec_tiers.split(",") if t.strip()
+            )
+            if args.spec_tiers is not None else None
+        ),
     )
     server = ThreadingHTTPServer(("127.0.0.1", args.port), handler)
     fabric_server = None

@@ -287,6 +287,23 @@ def test_fabric_families_are_emitted_with_expected_labels():
     assert set(rule.labels) <= families[rule.metric]
 
 
+def test_speculative_families_are_emitted_with_expected_labels():
+    """ISSUE 18: the speculative paged serving counters any rule/
+    policy/dashboard may bind — proposed draft tokens, accepted draft
+    tokens, and rollback windows, each split by {model, tier} (the
+    tier key is how a dashboard shows acceptance per SLO class, since
+    speculation is tier-gated).  A rename fails tier-1 here before an
+    acceptance-rate panel orphans silently."""
+
+    families = collect_emitted_families()
+    for fam in (
+        "serve_spec_proposed_total",
+        "serve_spec_accepted_total",
+        "serve_spec_rollbacks_total",
+    ):
+        assert {"model", "tier"} <= families[fam], fam
+
+
 def test_resize_gate_reads_the_federated_checkpoint_family():
     """ISSUE 15 satellite: the training resize gate's registry
     fallback (``job_checkpoint_age``) must read the FEDERATED

@@ -245,6 +245,119 @@ class TestSwapArena:
         alloc.check()
         assert alloc.in_use == 0 and swap.swapped_blocks == 0
 
+    def test_draft_blocks_ride_the_same_conservation(self):
+        """ISSUE 18 conservation property: speculating seats hold a
+        SECOND committed set (the draft model's pages) out of the SAME
+        allocator — draft blocks are just blocks.  Across random
+        admit/grow/preempt/resume/retire sequences using the pool's
+        exact discipline (draft blocks always private, preemption swaps
+        target+draft all-or-nothing, resume re-allocs both), free +
+        live + swapped still covers every logical block exactly once
+        and the swap arena's count equals the sum over records of
+        target AND draft swapped blocks."""
+
+        r = np.random.RandomState(18)
+        alloc = BlockAllocator(33, 16)  # 32 usable
+        swap = SwapArena()
+        seats = {}    # rid -> ([target bids], [draft bids])
+        records = {}  # rid -> swap record with draft_* keys
+        rid_next = 0
+
+        def check_world():
+            alloc.check()
+            held = set()
+            for refs, drefs in seats.values():
+                held |= set(refs) | set(drefs)
+            for rec in records.values():
+                held |= {b for _, b in rec["live"]}
+            assert alloc.in_use == len(held)
+            assert alloc.free_count == alloc.usable - len(held)
+            assert swap.swapped_blocks == sum(
+                rec["n_blocks"] for rec in records.values()
+            )
+            for rec in records.values():
+                # the record's own split accounting stays coherent
+                assert rec["n_blocks"] == rec["target_n"] + rec["draft_n"]
+
+        for _ in range(500):
+            op = r.randint(4)
+            if op == 0:  # admit a speculating seat: target + draft
+                n = int(r.randint(1, 4))
+                ids = alloc.alloc(n)
+                if ids is not None:
+                    dids = alloc.alloc(n)  # draft commit mirrors target
+                    if dids is None:
+                        alloc.release(ids)  # all-or-nothing rollback
+                    else:
+                        seats[rid_next] = (list(ids), list(dids))
+                        rid_next += 1
+            elif op == 1 and seats:  # grow both sets together
+                rid = list(seats)[r.randint(len(seats))]
+                ids = alloc.alloc(2)
+                if ids is not None:
+                    seats[rid][0].append(ids[0])
+                    seats[rid][1].append(ids[1])
+            elif op == 2 and seats:  # preempt: swap target AND draft
+                rid = list(seats)[r.randint(len(seats))]
+                refs, drefs = seats.pop(rid)
+                alloc.release(refs)
+                alloc.release(drefs)
+                swap.put(
+                    rid,
+                    {"live": [], "target_n": len(refs),
+                     "draft_n": len(drefs)},
+                    n_blocks=len(refs) + len(drefs),
+                    nbytes=(len(refs) + len(drefs)) * 10,
+                )
+            elif op == 3 and seats:  # retire frees both sets
+                rid = list(seats)[r.randint(len(seats))]
+                refs, drefs = seats.pop(rid)
+                alloc.release(refs)
+                alloc.release(drefs)
+            for rid in list(swap._records):
+                if rid not in records:
+                    records[rid] = swap._records[rid]
+            # resume at most one record per tick
+            if records:
+                rid = list(records)[r.randint(len(records))]
+                rec = records[rid]
+                ids = alloc.alloc(rec["n_blocks"])
+                if ids is not None:
+                    swap.pop(rid, nbytes=rec["n_blocks"] * 10)
+                    del records[rid]
+                    seats[rid] = (
+                        list(ids[: rec["target_n"]]),
+                        list(ids[rec["target_n"]:]),
+                    )
+            check_world()
+        # drain and verify a clean world
+        guard = 0
+        while records and guard < 1000:
+            guard += 1
+            for rid in list(records):
+                rec = records[rid]
+                ids = alloc.alloc(rec["n_blocks"])
+                if ids is None:
+                    if seats:
+                        refs, drefs = seats.pop(list(seats)[0])
+                        alloc.release(refs)
+                        alloc.release(drefs)
+                    continue
+                swap.pop(rid)
+                del records[rid]
+                seats[rid] = (
+                    list(ids[: rec["target_n"]]),
+                    list(ids[rec["target_n"]:]),
+                )
+            check_world()
+        assert not records, "swap arena failed to drain"
+        for rid in list(seats):
+            refs, drefs = seats.pop(rid)
+            alloc.release(refs)
+            alloc.release(drefs)
+        alloc.check()
+        assert alloc.in_use == 0 and swap.swapped_blocks == 0
+
 
 class TestChainKeys:
     def test_chain_addresses_the_whole_prefix(self):

@@ -64,6 +64,11 @@ HOT_FUNCTIONS = {
 #: sanctioned ``with ...dispatch(...)`` window (migrate_out /
 #: migrate_in) so disaggregation can never smuggle an uncounted sync
 #: into admission planning.
+#: ISSUE 18 extends the set to the SPECULATIVE step paths: the draft
+#: scan, the fused verify, and the draft prefill all run inside the
+#: per-window dispatch budget (1 draft + 1 verify per window is the
+#: whole point) — a raw host fetch in any of them would hide an extra
+#: round trip the draft/verify ledger phases exist to count.
 HOT_CLASS_FUNCTIONS = {
     "models/batching.py": {
         "PagedContinuousBatchingDecoder": {
@@ -72,6 +77,8 @@ HOT_CLASS_FUNCTIONS = {
             "_plan_resume_locked", "_pick_victim_locked",
             "_demote_queued_locked",
             "_plan_admission", "_migrate_in_locked", "publish_to_fabric",
+            "_spec_draft", "_spec_verify", "_draft_prefill_seat",
+            "_draft_admission",
         },
     },
 }

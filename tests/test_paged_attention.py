@@ -29,6 +29,7 @@ from tf_operator_tpu.ops.attention import dot_product_attention
 from tf_operator_tpu.ops.paged_attention import (
     _resolve_paged_tile,
     paged_attention,
+    paged_attention_multi,
     paged_kernel_available,
 )
 
@@ -202,6 +203,129 @@ class TestRandomizedAgainstReference:
                 np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5,
                 err_msg=f"trial {trial} lengths {np.asarray(lengths)}",
             )
+
+
+def _multi_band_reference(q, ka, va, tables, lengths):
+    """Row-by-row anchor for the verify window: query row t of seat s
+    is EXACTLY the single-query math at the truncated length
+    lengths[s] - (K-1-t) — the band mask is nothing but K staggered
+    single-query calls fused into one dispatch."""
+
+    k_new = q.shape[1]
+    rows = []
+    for t in range(k_new):
+        trunc = lengths - (k_new - 1 - t)
+        rows.append(
+            paged_attention(q[:, t], ka, va, tables, trunc, impl="xla")
+        )
+    return jnp.stack(rows, axis=1)  # [S, K, H, D]
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+class TestPagedAttentionMulti:
+    """ISSUE 18: the K-token verify primitive.  lengths INCLUDE all K
+    appended tokens; row t sees p < lengths[s]-(K-1-t)."""
+
+    def test_k1_slice_is_single_query(self, impl):
+        """K=1 reproduces the single-query entry point bit for bit —
+        same grid, same block shapes, same mask."""
+
+        q, ka, va, tables = _rig(seed=21)
+        lengths = jnp.asarray([7, 16, 25], jnp.int32)
+        got = paged_attention_multi(
+            q[:, None], ka, va, tables, lengths, impl=impl
+        )
+        want = paged_attention(q, ka, va, tables, lengths, impl=impl)
+        np.testing.assert_array_equal(
+            np.asarray(got[:, 0]), np.asarray(want)
+        )
+
+    def test_band_rows_match_truncated_single_query(self, impl):
+        """Each of the K rows agrees with a single-query call at the
+        truncated length — the in-window causal band, pinned per row
+        across block straddles (lengths land on bs±1 boundaries)."""
+
+        k_new = 4
+        r = np.random.RandomState(22)
+        q1, ka, va, tables = _rig(seed=22)
+        s, h, d = q1.shape
+        q = jnp.asarray(r.randn(s, k_new, h, d), jnp.float32)
+        bs = ka.shape[2]
+        lengths = jnp.asarray([bs + 1, bs + k_new, 3 * bs - 1], jnp.int32)
+        got = paged_attention_multi(q, ka, va, tables, lengths, impl=impl)
+        want = _multi_band_reference(q, ka, va, tables, lengths)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
+
+    def test_scratch_and_beyond_band_masking(self, impl):
+        """Poisoning scratch block 0 and every position at or beyond
+        the LAST row's horizon (p >= lengths[s]) moves nothing: the
+        rejected-append scratch-routing story depends on this."""
+
+        k_new = 3
+        r = np.random.RandomState(23)
+        q1, ka, va, tables = _rig(seed=23)
+        s, h, d = q1.shape
+        q = jnp.asarray(r.randn(s, k_new, h, d), jnp.float32)
+        bs = ka.shape[2]
+        lengths = jnp.asarray([k_new, bs + 2, 2 * bs + k_new], jnp.int32)
+        base = paged_attention_multi(q, ka, va, tables, lengths, impl=impl)
+        tb, ln = np.asarray(tables), np.asarray(lengths)
+        pk = np.array(ka, copy=True)
+        pv = np.array(va, copy=True)
+        pk[0], pv[0] = 1e9, -1e9
+        for si in range(tb.shape[0]):
+            for j in range(tb.shape[1]):
+                start = j * bs
+                if start >= ln[si]:
+                    pk[tb[si, j]], pv[tb[si, j]] = 1e9, -1e9
+                elif start + bs > ln[si]:
+                    pk[tb[si, j], :, ln[si] - start:] = 1e9
+                    pv[tb[si, j], :, ln[si] - start:] = -1e9
+        got = paged_attention_multi(
+            q, jnp.asarray(pk), jnp.asarray(pv), tables, lengths, impl=impl
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(base))
+
+    def test_gqa_window_fuzz(self, impl):
+        """Seeded fuzz over K, GQA group width and straddle lengths:
+        one fused dispatch vs the K staggered single-query calls."""
+
+        r = np.random.RandomState(24)
+        for trial in range(3):
+            k_new = 2 + trial
+            hkv, group = 2, 1 + trial % 2
+            d, bs, mb, s = 16, 8, 3, 2
+            nb = 1 + s * mb
+            q = jnp.asarray(
+                r.randn(s, k_new, hkv * group, d), jnp.float32
+            )
+            ka = jnp.asarray(r.randn(nb, hkv, bs, d), jnp.float32)
+            va = jnp.asarray(r.randn(nb, hkv, bs, d), jnp.float32)
+            tables = jnp.asarray(
+                r.permutation(np.arange(1, nb))[: s * mb].reshape(s, mb),
+                jnp.int32,
+            )
+            lengths = jnp.asarray(
+                [r.randint(k_new, mb * bs + 1) for _ in range(s)],
+                jnp.int32,
+            )
+            got = paged_attention_multi(
+                q, ka, va, tables, lengths, impl=impl
+            )
+            want = _multi_band_reference(q, ka, va, tables, lengths)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5,
+                err_msg=f"trial {trial} K={k_new} "
+                        f"lengths {np.asarray(lengths)}",
+            )
+
+    def test_bad_layout_raises(self, impl):
+        q, ka, va, tables = _rig()
+        lengths = jnp.asarray([1, 1, 1], jnp.int32)
+        with pytest.raises(ValueError):
+            paged_attention_multi(q, ka, va, tables, lengths, impl=impl)
 
 
 class TestTileAndHonesty:

@@ -99,6 +99,63 @@ class TestTokenIdentity:
         pool.alloc.check()
         assert len(pool.swap) == 0 and pool.swap.swapped_blocks == 0
 
+    @pytest.mark.parametrize("kernel", ["off", "interpret"])
+    @pytest.mark.parametrize("temp", [0.0, 0.9])
+    def test_preempted_speculating_seat_resumes_token_identical(
+        self, kernel, temp
+    ):
+        """ISSUE 18: preemption of a SPECULATING seat swaps the draft
+        state too — draft blocks ride the same swap_out dispatch, the
+        draft rng chain is snapshotted, and the resumed request decodes
+        byte-identically to an undisturbed speculative run (greedy and
+        temperature, both step paths).  The draft arena must come back
+        exactly: a lost draft page would desync the draft model's
+        proposals and (under temperature) the acceptance pattern."""
+
+        model, params = _setup()
+        draft = llama_tiny(vocab_size=VOCAB, max_len=64)
+        dparams = draft.init(
+            jax.random.PRNGKey(2), jnp.zeros((1, 4), jnp.int32)
+        )["params"]
+        r = np.random.RandomState(3)
+        prompt_a = _prompt(r, 6)
+        prompt_i = _prompt(r, 33)
+        kw = (
+            dict(temperature=temp, rng=jax.random.PRNGKey(5))
+            if temp else {}
+        )
+        spec = dict(
+            draft_model=draft, draft_params=dparams, spec_k=3,
+            spec_tiers=("batch", "interactive"),
+        )
+
+        solo = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, steps_per_sync=8,
+            paged_kernel=kernel, **spec,
+        )
+        rid = solo.submit(prompt_a, max_new_tokens=24, **kw)
+        solo.run()
+        want = solo.result(rid)
+
+        # 8-block arena: A (batch, speculating) commits 2 target + 2
+        # draft blocks; the interactive admission needs 3 + 3 ->
+        # preempts A, moving BOTH committed sets host-side
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, kv_blocks=8,
+            steps_per_sync=8, paged_kernel=kernel, **spec,
+        )
+        a = pool.submit(prompt_a, max_new_tokens=24, **kw)
+        pool.step()  # admit A (draft prefill) + window 1
+        pool.step()  # window 2
+        i = pool.submit(prompt_i, max_new_tokens=8, tier="interactive")
+        pool.run()
+        assert pool.preemptions >= 1, "scenario failed to preempt"
+        assert pool.result(i).shape == (41,)
+        np.testing.assert_array_equal(pool.result(a), want)
+        pool.alloc.check()
+        assert len(pool.swap) == 0 and pool.swap.swapped_blocks == 0
+        assert not pool._draft_refs  # every draft page released
+
     def test_lazy_and_worst_case_modes_are_token_identical(self):
         """Reservation policy must never change tokens: the same
         request set decodes identically under lazy and worst-case

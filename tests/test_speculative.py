@@ -250,7 +250,12 @@ class TestPerRowRollback:
 
 
 class TestServeLmSpeculativeMode:
-    def test_greedy_via_spec_sampling_falls_back(self):
+    def test_speculative_serves_through_the_paged_pool(self):
+        """ISSUE 18: --speculative IS a paged-pool mode — greedy,
+        sampling, and top_k requests all serve through the pool;
+        interactive-tier requests speculate (the default gate), batch
+        ones decode plainly, and the draft lives in the SAME arena."""
+
         import json
         import threading
         import urllib.request
@@ -265,16 +270,21 @@ class TestServeLmSpeculativeMode:
         handler = serve_lm.build_handler(
             model, params, max_len=64, speculative=True
         )
+        assert handler.pool is not None and handler.pool.spec_enabled
         server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
         port = server.server_address[1]
         threading.Thread(target=server.serve_forever, daemon=True).start()
         try:
             for payload in (
-                {"prompt": "abc", "max_new_tokens": 6},  # greedy -> spec
+                # greedy interactive -> speculates (tier-gated default)
                 {"prompt": "abc", "max_new_tokens": 6,
-                 "temperature": 0.8},  # sampling -> spec rejection rule
-                {"prompt": "abc", "max_new_tokens": 6, "temperature": 0.8,
-                 "top_k": 4},  # top_k -> chunked fallback
+                 "tier": "interactive"},
+                # sampling -> exact via the in-graph rejection rule
+                {"prompt": "abc", "max_new_tokens": 6,
+                 "temperature": 0.8, "tier": "interactive"},
+                # top_k + default batch tier -> plain pool decode
+                {"prompt": "abc", "max_new_tokens": 6,
+                 "temperature": 0.8, "top_k": 4},
             ):
                 req = urllib.request.Request(
                     f"http://127.0.0.1:{port}/generate",
@@ -286,43 +296,79 @@ class TestServeLmSpeculativeMode:
                 assert len(out["sample"]) == 6
         finally:
             server.shutdown()
+        snap = handler.pool.spec_snapshot()
+        assert snap["spec_windows"] >= 1, (
+            "interactive requests never took the speculative path"
+        )
 
-    def test_batching_and_speculative_mutually_exclusive(self):
+    def test_batching_composes_and_typod_tier_fails_startup(self):
+        """--speculative composes with --batching (it rides the pool),
+        and a typo'd --spec-tiers fails handler construction instead
+        of silently serving non-speculatively (PR 10 honesty rule)."""
+
         from tests.testutil import load_serve_lm
 
         serve_lm = load_serve_lm()
         model = llama_tiny(vocab_size=256, max_len=64)
         prompt = jnp.zeros((1, 4), jnp.int32)
         params = model.init(jax.random.PRNGKey(0), prompt)["params"]
-        with pytest.raises(ValueError):
+        handler = serve_lm.build_handler(
+            model, params, max_len=64, batching_slots=2, speculative=True
+        )
+        assert handler.pool.spec_enabled
+        assert handler.pool.slots == 2
+        with pytest.raises(ValueError, match="not SLO tiers"):
             serve_lm.build_handler(
-                model, params, max_len=64, batching_slots=2, speculative=True
+                model, params, max_len=64, speculative=True,
+                spec_tiers=("interactiv",),
+            )
+        with pytest.raises(ValueError, match="spec_k"):
+            serve_lm.build_handler(
+                model, params, max_len=64, speculative=True, spec_k=0,
             )
 
     def test_speculative_guard_reads_measured_ledger(self, tmp_path):
-        """serve_lm --speculative refuses while the BEST measured
-        speculative config is a slowdown; a >=1x row (either the
-        self-draft key or the draft!=target wide key) unfences it, and
-        an unmeasured box stays permissive (no claim to enforce)."""
+        """serve_lm --speculative reads the PAGED-PLANE row (ISSUE 18:
+        spec_paged_speedup — the configuration it actually serves) and
+        refuses while it is a slowdown; the dead pre-paged rows
+        (speculative_speedup / speculative_wide_speedup) must neither
+        fence NOR unfence it; an unmeasured box stays permissive (no
+        claim to enforce)."""
 
         import json as _json
 
         from tests.testutil import load_serve_lm
 
         serve_lm = load_serve_lm()
-        row = {"artifact": "a.out", "date": "2026-08-03"}
+        row = {"artifact": "a.out", "date": "2026-08-07"}
         p = tmp_path / "LAST_MEASURED.json"
+        p.write_text(_json.dumps(
+            {"spec_paged_speedup": {"value": 0.8, **row}}
+        ))
+        best, meta = serve_lm.speculative_slowdown(str(p))
+        assert best == 0.8 and meta["metric"] == "spec_paged_speedup"
+        # the dead pre-paged rows are ignored in BOTH directions: a
+        # 1.2x legacy row can't unfence the paged path...
+        p.write_text(_json.dumps({
+            "speculative_wide_speedup": {"value": 1.2, **row},
+            "spec_paged_speedup": {"value": 0.8, **row},
+        }))
+        best, meta = serve_lm.speculative_slowdown(str(p))
+        assert best == 0.8 and meta["metric"] == "spec_paged_speedup"
+        # ...and a 0.1x legacy row can't fence a measured paged win
+        p.write_text(_json.dumps({
+            "speculative_speedup": {"value": 0.1, **row},
+            "spec_paged_speedup": {
+                "value": 7.4, "config": "int8 self-draft, k=4", **row
+            },
+        }))
+        best, meta = serve_lm.speculative_slowdown(str(p))
+        assert best == 7.4 and meta["config"] == "int8 self-draft, k=4"
+        # legacy-only ledger = the paged config is UNMEASURED -> permissive
         p.write_text(_json.dumps(
             {"speculative_speedup": {"value": 0.1, **row}}
         ))
-        best, meta = serve_lm.speculative_slowdown(str(p))
-        assert best == 0.1 and meta["metric"] == "speculative_speedup"
-        p.write_text(_json.dumps({
-            "speculative_speedup": {"value": 0.1, **row},
-            "speculative_wide_speedup": {"value": 1.2, **row},
-        }))
-        best, meta = serve_lm.speculative_slowdown(str(p))
-        assert best == 1.2 and meta["metric"] == "speculative_wide_speedup"
+        assert serve_lm.speculative_slowdown(str(p)) == (None, None)
         assert serve_lm.speculative_slowdown(
             str(tmp_path / "missing.json")
         ) == (None, None)

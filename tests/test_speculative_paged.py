@@ -1,0 +1,376 @@
+"""Speculative decoding on the paged plane (ISSUE 18 tentpole).
+
+The load-bearing pins:
+
+- TOKEN IDENTITY: a speculating pool emits byte-identical greedy
+  tokens to the non-speculative paged pool — across accept AND
+  rollback boundaries, under prefix-hit admission, and for mixed
+  windows where speculating and plain seats share the arena.  On BOTH
+  step paths (gather emulation and the interpret-mode Pallas kernel).
+- LEDGER PIN: the speculative steady state is exactly ONE ``draft``
+  plus ONE ``verify`` dispatch per window (a mixed window adds the
+  plain seats' single ``step``), and with a perfect draft the
+  dispatches-per-emitted-token falls below 1.0 — the CPU-honest
+  speculation win the serve_lm refusal guard requires measured.
+- ARENA SHARING: draft pages come from the SAME BlockAllocator; the
+  allocator conserves through speculative admit/decode/retire and the
+  draft refs drain with the seats.
+- HONESTY: a typo'd tier, an unusable spec_k, or missing draft params
+  fail construction loudly — never a silent downgrade to
+  non-speculative serving (the PR 10 rule).
+
+Accept/reject boundary behavior is fuzzed with seeded divergent-draft
+configs on both kernel paths; preemption/resume of a speculating seat
+lives in tests/test_preemption.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models import llama_tiny
+from tf_operator_tpu.models.batching import PagedContinuousBatchingDecoder
+
+VOCAB = 96
+
+
+def _setup(max_len=64):
+    model = llama_tiny(vocab_size=VOCAB, max_len=max_len)
+    init = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), init)["params"]
+    # a second tiny init IS a different model: divergent proposals
+    # exercise the reject path without a second architecture
+    draft = llama_tiny(vocab_size=VOCAB, max_len=max_len)
+    dparams = draft.init(jax.random.PRNGKey(2), init)["params"]
+    return model, params, draft, dparams
+
+
+def _prompt(r, n):
+    return r.randint(0, VOCAB, size=(n,)).astype(np.int32)
+
+
+@pytest.mark.slow
+class TestSpecTokenIdentity:
+    @pytest.mark.parametrize("kernel", ["off", "interpret"])
+    def test_greedy_identity_across_accept_and_rollback(self, kernel):
+        """The acceptance pin: greedy output of the speculating pool is
+        byte-identical to the non-speculative paged pool.  The
+        divergent draft guarantees both full-accept and mid-window
+        rollback boundaries occur; identical bytes across them means
+        rollback rewinds EXACTLY (a stale rejected append leaking into
+        the next window would change tokens)."""
+
+        model, params, draft, dparams = _setup()
+        r = np.random.RandomState(4)
+        reqs = [(_prompt(r, n), b) for n, b in [(6, 24), (11, 17), (3, 9)]]
+
+        plain = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, steps_per_sync=8,
+            paged_kernel=kernel,
+        )
+        want = {}
+        for p, b in reqs:
+            want[len(want)] = (p, b)
+        rids = [plain.submit(p, max_new_tokens=b, tier="interactive")
+                for p, b in reqs]
+        plain.run()
+        outs = [plain.result(rid) for rid in rids]
+
+        spec = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, steps_per_sync=8,
+            paged_kernel=kernel, draft_model=draft, draft_params=dparams,
+            spec_k=3,
+        )
+        srids = [spec.submit(p, max_new_tokens=b, tier="interactive")
+                 for p, b in reqs]
+        spec.run()
+        for rid, out in zip(srids, outs):
+            np.testing.assert_array_equal(spec.result(rid), out)
+        snap = spec.spec_snapshot()
+        assert snap["spec_rollbacks"] >= 1, (
+            "divergent draft never rejected — rollback boundary unexercised"
+        )
+        assert snap["spec_accepted"] >= 1, (
+            "divergent draft never accepted — accept boundary unexercised"
+        )
+        spec.alloc.check()
+        assert not spec._draft_refs
+
+    def test_mixed_tier_window_and_tier_gating(self):
+        """Speculation is tier-gated (interactive only by default):
+        batch seats in the SAME window step through the plain program,
+        and both tiers' tokens match the non-speculative pool — the
+        enabled-mask never bleeds one path into the other."""
+
+        model, params, draft, dparams = _setup()
+        r = np.random.RandomState(7)
+        pi, pb = _prompt(r, 6), _prompt(r, 9)
+
+        plain = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, steps_per_sync=4,
+        )
+        ri = plain.submit(pi, max_new_tokens=16, tier="interactive")
+        rb = plain.submit(pb, max_new_tokens=16)
+        plain.run()
+        want_i, want_b = plain.result(ri), plain.result(rb)
+
+        spec = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, steps_per_sync=4,
+            draft_model=draft, draft_params=dparams, spec_k=3,
+        )
+        si = spec.submit(pi, max_new_tokens=16, tier="interactive")
+        sb = spec.submit(pb, max_new_tokens=16)
+        spec.run()
+        np.testing.assert_array_equal(spec.result(si), want_i)
+        np.testing.assert_array_equal(spec.result(sb), want_b)
+        # only the interactive seat speculated
+        snap = spec.spec_snapshot()
+        assert snap["spec_windows"] >= 1
+        assert snap["spec_emitted"] <= 16
+        spec.alloc.check()
+
+    @pytest.mark.parametrize("kernel", ["off", "interpret"])
+    def test_prefix_hit_admission_identity(self, kernel):
+        """A speculating seat admitted THROUGH a prefix-cache hit (its
+        target prompt KV partly served from published blocks, its draft
+        prefill always computed fresh — the draft never prefix-shares)
+        still decodes byte-identically to the non-speculative pool's
+        prefix-hit run."""
+
+        model, params, draft, dparams = _setup()
+        r = np.random.RandomState(9)
+        head = _prompt(r, 32)  # two publishable full blocks
+        tail_a, tail_b = _prompt(r, 5), _prompt(r, 7)
+        pa = np.concatenate([head, tail_a])
+        pb = np.concatenate([head, tail_b])
+
+        outs = {}
+        for speculate in (False, True):
+            kw = (
+                dict(draft_model=draft, draft_params=dparams, spec_k=3)
+                if speculate else {}
+            )
+            pool = PagedContinuousBatchingDecoder(
+                model, params, slots=4, kv_block_size=16,
+                steps_per_sync=8, paged_kernel=kernel, **kw,
+            )
+            ra = pool.submit(pa, max_new_tokens=12, tier="interactive")
+            pool.run()  # A publishes the shared head blocks
+            rb = pool.submit(pb, max_new_tokens=12, tier="interactive")
+            pool.run()
+            assert pool.prefix.hits >= 1, "scenario failed to prefix-hit"
+            outs[speculate] = (pool.result(ra), pool.result(rb))
+            pool.alloc.check()
+        np.testing.assert_array_equal(outs[True][0], outs[False][0])
+        np.testing.assert_array_equal(outs[True][1], outs[False][1])
+
+
+@pytest.mark.slow
+class TestLedgerPins:
+    @pytest.mark.parametrize("kernel", ["off", "interpret"])
+    def test_steady_state_is_one_draft_one_verify(self, kernel):
+        """The dispatch-budget pin on BOTH kernel paths: once admitted,
+        every speculative window is exactly ONE ``draft`` + ONE
+        ``verify`` dispatch — growth deltas ride the verify dispatch,
+        accept/rollback never add a fixup dispatch, and the plain
+        ``step`` phase never fires for an all-speculating pool."""
+
+        model, params, draft, dparams = _setup()
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=16,
+            paged_kernel=kernel, draft_model=draft,
+            draft_params=dparams, spec_k=3,
+        )
+        rid = pool.submit(
+            np.arange(6, dtype=np.int32) % VOCAB, max_new_tokens=40,
+            tier="interactive",
+        )
+        pool.step()  # admission (incl. draft prefill) + window 1
+
+        def _done():  # result() evicts on first read — don't re-read
+            with pool._lock:
+                return pool._results[rid].done
+
+        grew = False
+        for _ in range(40):
+            if _done():
+                break  # the final window retires in the same step()
+            with pool._lock:
+                committed0 = len(pool._seat_refs.get(0, ()))
+            base = pool.ledger.count()
+            drafts0 = pool.ledger.count("draft")
+            verifies0 = pool.ledger.count("verify")
+            steps0 = pool.ledger.count("step")
+            pool.step()
+            with pool._lock:
+                if 0 in pool._seat_refs and \
+                        len(pool._seat_refs[0]) > committed0:
+                    grew = True
+            if _done():
+                break
+            assert pool.ledger.count() == base + 2
+            assert pool.ledger.count("draft") == drafts0 + 1
+            assert pool.ledger.count("verify") == verifies0 + 1
+            assert pool.ledger.count("step") == steps0
+        assert grew, "scenario never crossed a block boundary"
+        pool.run()
+        assert pool.result(rid) is not None
+        snap = pool.ledger.snapshot()
+        assert set(snap) <= {"admission", "draft", "verify", "retire"}, snap
+        pool.alloc.check()
+
+    def test_self_draft_beats_one_dispatch_per_token(self):
+        """The CPU-honest win: with a perfect draft (draft == target)
+        every window accepts all K, so dispatches-per-emitted-token =
+        2/(K+1) < 1.0 — the number the refusal guard requires measured
+        above parity before --speculative serves."""
+
+        model, params, _, _ = _setup()
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=2, kv_block_size=16,
+            draft_model=llama_tiny(vocab_size=VOCAB, max_len=64),
+            draft_params=params, spec_k=3,
+        )
+        rid = pool.submit(
+            np.arange(6, dtype=np.int32) % VOCAB, max_new_tokens=24,
+            tier="interactive",
+        )
+        pool.run()
+        assert pool.result(rid) is not None
+        snap = pool.spec_snapshot()
+        assert snap["acceptance_rate"] == 1.0
+        assert snap["dispatches_per_token"] < 1.0
+        assert snap["spec_rollbacks"] == 0
+
+    def test_mixed_window_is_three_dispatches(self):
+        """A window holding BOTH a plain seat and a speculating seat
+        costs step + draft + verify — never more (no per-seat
+        dispatches, no accept fixups)."""
+
+        model, params, draft, dparams = _setup()
+        pool = PagedContinuousBatchingDecoder(
+            model, params, slots=4, kv_block_size=16, steps_per_sync=4,
+            draft_model=draft, draft_params=dparams, spec_k=3,
+        )
+        pool.submit(np.arange(6, dtype=np.int32) % VOCAB,
+                    max_new_tokens=48, tier="interactive")
+        pool.submit(np.arange(9, dtype=np.int32) % VOCAB,
+                    max_new_tokens=48)
+        pool.step()  # admissions + window 1
+        for _ in range(3):
+            base = pool.ledger.count()
+            pool.step()
+            assert pool.ledger.count() == base + 3
+        counts = {p: pool.ledger.count(p)
+                  for p in ("step", "draft", "verify")}
+        assert counts["step"] >= 3
+        # every speculative window paired its draft with its verify
+        # (admission prefill adds one unpaired draft per spec seat)
+        assert counts["draft"] == counts["verify"] + 1
+
+
+@pytest.mark.slow
+class TestAcceptRejectFuzz:
+    @pytest.mark.parametrize("kernel", ["off", "interpret"])
+    def test_seeded_boundary_fuzz(self, kernel):
+        """Seeded fuzz over accept/reject boundaries on both step
+        paths: random prompts, budgets, temperatures and top_ks
+        against the divergent draft.  Every request completes at its
+        exact budget, the sampled rng chain never desyncs (same seed
+        -> same bytes on a rerun pool), accounting stays coherent
+        (accepted <= proposed, emitted == windows + accepted when one
+        seat runs), and the allocator conserves."""
+
+        model, params, draft, dparams = _setup()
+        r = np.random.RandomState(31 + (kernel == "interpret"))
+        for trial in range(3):
+            n = int(r.randint(3, 20))
+            budget = int(r.randint(5, 22))
+            temp = float(r.choice([0.0, 0.7, 1.3]))
+            top_k = None if r.rand() < 0.5 else 8
+            kw = {}
+            if temp:
+                kw = dict(temperature=temp, top_k=top_k,
+                          rng=jax.random.PRNGKey(trial))
+            prompt = _prompt(r, n)
+            outs = []
+            for _rerun in range(2):
+                pool = PagedContinuousBatchingDecoder(
+                    model, params, slots=2, kv_block_size=16,
+                    paged_kernel=kernel, draft_model=draft,
+                    draft_params=dparams, spec_k=3,
+                )
+                rid = pool.submit(prompt, max_new_tokens=budget,
+                                  tier="interactive", **kw)
+                pool.run()
+                out = pool.result(rid)
+                assert out.shape == (n + budget,)
+                snap = pool.spec_snapshot()
+                assert snap["spec_accepted"] <= snap["spec_proposed"]
+                # admission prefill emits token 1 outside the spec
+                # counters; the budget clip only ever lands on the
+                # final window, so for a lone seat emitted is exactly
+                # windows + accepted capped at budget - 1
+                assert snap["spec_emitted"] == min(
+                    budget - 1,
+                    snap["spec_windows"] + snap["spec_accepted"],
+                )
+                pool.alloc.check()
+                assert not pool._draft_refs
+                outs.append(out)
+            np.testing.assert_array_equal(
+                outs[0], outs[1],
+                err_msg=f"trial {trial} temp={temp} top_k={top_k} "
+                        "rng chain desynced across identical runs",
+            )
+
+
+class TestSpecConfigHonesty:
+    """Construction-time failures (cheap: nothing compiles) — the
+    fail-don't-downgrade contract."""
+
+    def _base(self):
+        model = llama_tiny(vocab_size=VOCAB, max_len=64)
+        params = model.init(
+            jax.random.PRNGKey(1), jnp.zeros((1, 4), jnp.int32)
+        )["params"]
+        return model, params
+
+    def test_typod_tier_fails_loudly(self):
+        model, params = self._base()
+        with pytest.raises(ValueError, match="not SLO tiers"):
+            PagedContinuousBatchingDecoder(
+                model, params, slots=2, kv_block_size=16,
+                draft_model=model, draft_params=params,
+                spec_tiers=("interactiv",),
+            )
+
+    def test_bad_spec_k_fails_loudly(self):
+        model, params = self._base()
+        with pytest.raises(ValueError, match="spec_k"):
+            PagedContinuousBatchingDecoder(
+                model, params, slots=2, kv_block_size=16,
+                draft_model=model, draft_params=params, spec_k=0,
+            )
+
+    def test_missing_draft_params_fails_loudly(self):
+        model, params = self._base()
+        with pytest.raises(ValueError, match="draft_params"):
+            PagedContinuousBatchingDecoder(
+                model, params, slots=2, kv_block_size=16,
+                draft_model=model,
+            )
+
+    def test_mismatched_geometry_fails_loudly(self):
+        model, params = self._base()
+        short = llama_tiny(vocab_size=VOCAB, max_len=32)
+        sparams = short.init(
+            jax.random.PRNGKey(2), jnp.zeros((1, 4), jnp.int32)
+        )["params"]
+        with pytest.raises(ValueError, match="max_len"):
+            PagedContinuousBatchingDecoder(
+                model, params, slots=2, kv_block_size=16,
+                draft_model=short, draft_params=sparams,
+            )

@@ -27,6 +27,13 @@ its garbage is never observable.  The allocator therefore manages ids
 Conservation invariant (test-pinned, tests/test_kv_blocks.py): at all
 times ``free + live == usable`` with no id both free and referenced —
 no double-free, no aliasing across live holders.
+
+Speculative decoding (ISSUE 18) allocates its DRAFT model's KV chains
+from this same arena: a speculating seat holds a target chain and a
+draft chain, both visible to admission pressure and both released on
+retire/preempt, so speculation costs blocks the allocator can account
+for — never a hidden second cache.  The conservation invariant covers
+draft chains too (tests/test_speculative_paged.py).
 """
 
 from __future__ import annotations

@@ -54,6 +54,16 @@ beyond-reference.  Parity: `tests/test_speculative.py` pins
 speculative == plain greedy for BOTH a perfect draft (the target
 itself) and an adversarial draft (random weights — worst case, still
 exact, just slow).
+
+STATUS since ISSUE 18: this is the LEGACY batch-1 path, kept as the
+rejection-rule reference and for `measure.py --section speculative`
+history.  `serve_lm --speculative` no longer routes here — serving
+speculation is a mode of the paged pool
+(`models/batching.PagedContinuousBatchingDecoder(draft_model=...)`)
+with draft KV in the shared block arena, a fused multi-query verify
+(`ops/paged_attention.paged_attention_multi`), and in-graph
+accept/rollback; see docs/ARCHITECTURE.md "Speculative paged
+decoding".
 """
 
 from __future__ import annotations

@@ -292,20 +292,22 @@ class MultiHeadAttention(nn.Module):
             # collection is built externally (decode.paged_arena +
             # decode.paged_cache_tree) — batch-1 init shapes would be
             # wrong here, so missing leaves raise.
-            from tf_operator_tpu.ops.paged_attention import paged_attention
+            from tf_operator_tpu.ops.paged_attention import (
+                paged_attention,
+                paged_attention_multi,
+            )
 
             if mask is not None or bias is not None:
                 raise ValueError(
                     "paged decode builds its own masks; caller-supplied "
                     "mask/bias is not supported"
                 )
+            # s_new == 1 is the plain decode step; s_new == K > 1 is the
+            # speculative VERIFY window (ISSUE 18): K draft tokens are
+            # appended per seat and scored in ONE multi-query dispatch.
+            # Prefill still runs through the gathered-view admission
+            # path (models/batching.py) — this branch never sees it.
             seats, _, s_new, _ = q.shape
-            if s_new != 1:
-                raise ValueError(
-                    f"paged decode is single-token (s_new == 1, got "
-                    f"{s_new}); prefill runs through the gathered-view "
-                    "admission path (models/batching.py)"
-                )
 
             def _missing(name):
                 def init(*a):
@@ -324,39 +326,52 @@ class MultiHeadAttention(nn.Module):
             tables = tbl_var.value  # [S, MB] int32
             bs = arena_k.value.shape[2]
             mb = tables.shape[1]
-            pos = lengths  # each seat's new token position
+            pos = lengths  # each seat's FIRST new token position
             if cfg.rope:
-                # per-seat absolute positions ([S,1,1] broadcasts over
-                # heads and the single query row) — same rotation the
-                # contiguous branch applies per slot
+                # per-seat absolute positions ([S,1,K] broadcasts over
+                # heads) — same rotation the contiguous branch applies
+                # per slot; token t of the window sits at pos+t
                 q, k = apply_rope(
-                    q, k, positions=pos[:, None, None], theta=cfg.rope_theta
+                    q, k,
+                    positions=pos[:, None, None]
+                    + jnp.arange(s_new, dtype=pos.dtype)[None, None, :],
+                    theta=cfg.rope_theta,
                 )
-            # in-place append: seat s writes its K/V row into physical
-            # block tables[s, pos//bs] at offset pos%bs.  Seats own
-            # their tail blocks exclusively (admission reserves
-            # prompt+budget; shared prefix blocks are all strictly
-            # before the first write position), so only SCRATCH ids can
-            # collide across seats — and drifted/overshot positions
-            # (retired seats between windows, post-budget steps) are
-            # routed to scratch explicitly, whose content is never
-            # observable (length-masked).
-            li = jnp.clip(pos // bs, 0, mb - 1)
-            bids = jnp.take_along_axis(tables, li[:, None], axis=1)[:, 0]
-            bids = jnp.where(pos < mb * bs, bids, 0)  # SCRATCH_BLOCK
-            offs = pos % bs
+            # in-place append: seat s writes token t's K/V row into
+            # physical block tables[s, (pos+t)//bs] at offset
+            # (pos+t)%bs.  Seats own their tail blocks exclusively
+            # (admission reserves prompt+budget; shared prefix blocks
+            # are all strictly before the first write position), so
+            # only SCRATCH ids can collide across seats — and drifted/
+            # overshot positions (retired seats between windows,
+            # post-budget steps, rejected speculative appends past the
+            # table) are routed to scratch explicitly, whose content is
+            # never observable (length-masked).
+            poss = pos[:, None] + jnp.arange(s_new, dtype=pos.dtype)[None, :]
+            li = jnp.clip(poss // bs, 0, mb - 1)
+            bids = jnp.take_along_axis(tables, li, axis=1)  # [S, K]
+            bids = jnp.where(poss < mb * bs, bids, 0)  # SCRATCH_BLOCK
+            offs = poss % bs
+            # k/v are [S, Hkv, K, D] -> [S, K, Hkv, D] rows; advanced
+            # indexing over (bids, offs) scatters all K appends at once
             arena_k.value = arena_k.value.at[bids, :, offs, :].set(
-                k[:, :, 0, :].astype(arena_k.value.dtype)
+                jnp.transpose(k, (0, 2, 1, 3)).astype(arena_k.value.dtype)
             )
             arena_v.value = arena_v.value.at[bids, :, offs, :].set(
-                v[:, :, 0, :].astype(arena_v.value.dtype)
+                jnp.transpose(v, (0, 2, 1, 3)).astype(arena_v.value.dtype)
             )
-            idx_var.value = pos + 1
-            out = paged_attention(
-                q[:, :, 0, :], arena_k.value, arena_v.value, tables,
-                pos + 1, impl=cfg.paged,
-            )  # [S, H, D]
-            return self._project_out(out[:, None, :, :], train)
+            idx_var.value = pos + s_new
+            if s_new == 1:
+                out = paged_attention(
+                    q[:, :, 0, :], arena_k.value, arena_v.value, tables,
+                    pos + 1, impl=cfg.paged,
+                )  # [S, H, D]
+                return self._project_out(out[:, None, :, :], train)
+            out = paged_attention_multi(
+                jnp.transpose(q, (0, 2, 1, 3)), arena_k.value,
+                arena_v.value, tables, pos + s_new, impl=cfg.paged,
+            )  # [S, K, H, D]
+            return self._project_out(out, train)
 
         if cfg.decode and is_self:
             if mask is not None or bias is not None:

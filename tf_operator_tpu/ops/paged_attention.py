@@ -26,12 +26,34 @@ Layout contract:
                                 positions 0 .. lengths[s]-1)
 - returns      [S, H, D] in v_arena.dtype
 
+Multi-query verify (ISSUE 18 — speculative decoding): the same grid
+also serves K queries per seat in ONE dispatch via
+``paged_attention_multi``:
+
+- ``q``        [S, K, H, D]     the K draft tokens' queries, oldest
+                                first
+- ``lengths``  [S] int32        INCLUDING all K just-appended tokens
+                                (so query row t sits at absolute
+                                position lengths[s]-K+t and attends to
+                                positions 0 .. lengths[s]-K+t — the
+                                causal band falls out of the length
+                                convention, no second mask input)
+- returns      [S, K, H, D]
+
+With K == 1 the band collapses to the single-query rule exactly; the
+single-query entry point is the K == 1 slice of the same code path, so
+PR 10's bit-identity pins carry over unchanged.
+
 Masking rules (the kernel contract, docs/ARCHITECTURE.md):
 
-- per-seat length mask: position p contributes iff p < lengths[s];
+- per-seat length mask: position p contributes to query row t iff
+  p < lengths[s] - (K-1-t)  (K == 1: p < lengths[s]);
 - scratch-block-0: unused table entries point at the scratch block —
   they sit at logical positions >= lengths[s], so the length mask IS
-  the scratch mask (one rule, not two);
+  the scratch mask (one rule, not two) — and speculative rollback
+  relies on exactly this: rejected appends stay in the arena but sit
+  past the rewound length, so they are unobservable garbage, identical
+  in status to scratch;
 - tiles fully past the length skip their compute via @pl.when (their
   DMA still lands — the table clamps them to scratch/reserved blocks,
   never to another seat's live data).
@@ -141,10 +163,39 @@ def _paged_attention_xla(q, k_arena, v_arena, tables, lengths):
     return out[:, :, 0, :]
 
 
+def _paged_attention_multi_xla(q, k_arena, v_arena, tables, lengths):
+    """Multi-query reference: the same gathered view, with the causal
+    band mask derived from the length convention (module docstring) —
+    query row t of seat s sees position p iff p < lengths[s]-(K-1-t)."""
+
+    s, k_new, h, d = q.shape
+    nb, hkv, bs, _ = k_arena.shape
+    mb = tables.shape[1]
+
+    def view(a):
+        g = jnp.take(a, tables, axis=0)  # [S, MB, Hkv, bs, D]
+        g = jnp.transpose(g, (0, 2, 1, 3, 4))
+        return g.reshape(s, hkv, mb * bs, d)
+
+    # qend[s, t] = lengths[s] - (K-1-t): one more visible position per
+    # later query row — the in-window causal band
+    qend = lengths[:, None] - (
+        k_new - 1 - jnp.arange(k_new, dtype=lengths.dtype)
+    )[None, :]  # [S, K]
+    mask = (
+        jnp.arange(mb * bs)[None, None, :] < qend[:, :, None]
+    )[:, None, :, :]  # [S, 1, K, MB*bs]
+    out = dot_product_attention(
+        jnp.transpose(q, (0, 2, 1, 3)), view(k_arena), view(v_arena),
+        mask=mask,
+    )  # [S, H, K, D]
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
 def _paged_attn_kernel(
     tables_ref,  # scalar-prefetch [S, MB]
     lengths_ref,  # scalar-prefetch [S]
-    q_ref,  # [1, G, D]
+    q_ref,  # [1, G, D]   (G = K*group: query rows ordered (K, group))
     k_ref,  # [1, 1, tile, D]
     v_ref,
     o_ref,  # [1, G, D]
@@ -155,6 +206,8 @@ def _paged_attn_kernel(
     block_size: int,
     tile: int,
     scale: float,
+    k_new: int,
+    group: int,
 ):
     s = pl.program_id(0)
     j = pl.program_id(2)
@@ -182,9 +235,16 @@ def _paged_attn_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [G, tile]
-        # per-seat length mask == scratch mask (module docstring)
+        # per-seat length mask == scratch mask (module docstring);
+        # multi-query (k_new > 1): query row r belongs to draft token
+        # t = r // group and sees one fewer trailing position per
+        # earlier t — the causal band.  k_new == 1 collapses qend to
+        # `length` exactly, so the single-query math is the K == 1
+        # slice of this code, not a separate path.
         kpos = base + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-        logits = jnp.where(kpos < length, logits, _NEG_INF)
+        row = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+        qend = length - (k_new - 1 - row // group)
+        logits = jnp.where(kpos < qend, logits, _NEG_INF)
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(logits, -1, keepdims=True))
@@ -207,10 +267,17 @@ def _paged_attn_kernel(
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
-def _paged_attention_pallas(
+def _paged_attention_multi_pallas(
     q, k_arena, v_arena, tables, lengths, *, interpret: bool
 ):
-    s, h, d = q.shape
+    """The kernel path for q [S, K, H, D].  K query rows ride the same
+    grid as PR 10's single-query kernel: per (seat, kv-head) the block
+    carries G = K*group rows (ordered K-major within the head group) so
+    the whole verify window is ONE dispatch — the online-softmax
+    carries just grow G rows tall.  K == 1 reproduces the single-query
+    kernel bit for bit (same grid, same block shapes, same mask)."""
+
+    s, k_new, h, d = q.shape
     nb, hkv, bs, _ = k_arena.shape
     mb = tables.shape[1]
     if h % hkv:
@@ -218,10 +285,17 @@ def _paged_attention_pallas(
             f"q heads ({h}) must be a multiple of kv heads ({hkv})"
         )
     group = h // hkv
+    g = k_new * group
+    # rows ordered (hkv, K, group): each kv head's G rows are
+    # contiguous, so one BlockSpec slice feeds the whole head group
+    qr = jnp.transpose(
+        q.reshape(s, k_new, hkv, group, d), (0, 2, 1, 3, 4)
+    ).reshape(s, hkv * g, d)
     tile = _resolve_paged_tile(bs, d)
     scale = 1.0 / (d**0.5)
     kernel = functools.partial(
-        _paged_attn_kernel, block_size=bs, tile=tile, scale=scale
+        _paged_attn_kernel, block_size=bs, tile=tile, scale=scale,
+        k_new=k_new, group=group,
     )
 
     def kv_idx(si, hi, j, c, tables_ref, lengths_ref):
@@ -235,20 +309,20 @@ def _paged_attention_pallas(
         grid=(s, hkv, mb, bs // tile),
         in_specs=[
             pl.BlockSpec(
-                (1, group, d), lambda si, hi, j, c, t, L: (si, hi, 0)
+                (1, g, d), lambda si, hi, j, c, t, L: (si, hi, 0)
             ),
             pl.BlockSpec((1, 1, tile, d), kv_idx),
             pl.BlockSpec((1, 1, tile, d), kv_idx),
         ],
         out_specs=pl.BlockSpec(
-            (1, group, d), lambda si, hi, j, c, t, L: (si, hi, 0)
+            (1, g, d), lambda si, hi, j, c, t, L: (si, hi, 0)
         ),
         scratch_shapes=[
             # carries persist across the two innermost (sequential)
             # grid dims — the flash-kernel pattern
-            pltpu.VMEM((group, _LANES), jnp.float32),
-            pltpu.VMEM((group, _LANES), jnp.float32),
-            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((g, _LANES), jnp.float32),
+            pltpu.VMEM((g, _LANES), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
         ],
     )
     compiler_params = None
@@ -258,13 +332,28 @@ def _paged_attention_pallas(
                 "parallel", "parallel", "arbitrary", "arbitrary",
             )
         )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((s, h, d), v_arena.dtype),
+        out_shape=jax.ShapeDtypeStruct((s, hkv * g, d), v_arena.dtype),
         grid_spec=grid_spec,
         compiler_params=compiler_params,
         interpret=interpret,
-    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), q, k_arena, v_arena)
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), qr,
+      k_arena, v_arena)
+    return jnp.transpose(
+        out.reshape(s, hkv, k_new, group, d), (0, 2, 1, 3, 4)
+    ).reshape(s, k_new, h, d)
+
+
+def _paged_attention_pallas(
+    q, k_arena, v_arena, tables, lengths, *, interpret: bool
+):
+    # single-query == the K = 1 slice of the multi-query kernel (the
+    # reshapes are no-ops at K = 1, so PR 10 bit-identity is preserved)
+    return _paged_attention_multi_pallas(
+        q[:, None], k_arena, v_arena, tables, lengths,
+        interpret=interpret,
+    )[:, 0]
 
 
 def paged_attention(
@@ -295,6 +384,44 @@ def paged_attention(
     if impl == "xla":
         return _paged_attention_xla(q, k_arena, v_arena, tables, lengths)
     return _paged_attention_pallas(
+        q, k_arena, v_arena, tables, lengths,
+        interpret=(impl == "pallas-interpret"),
+    )
+
+
+def paged_attention_multi(
+    q: jax.Array,
+    k_arena: jax.Array,
+    v_arena: jax.Array,
+    tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    impl: str = "xla",
+) -> jax.Array:
+    """K-query-per-seat attention against the block arena — the
+    speculative VERIFY primitive (ISSUE 18).  ``q`` is [S, K, H, D]
+    (K draft tokens, oldest first), ``lengths`` INCLUDES all K
+    appended tokens, and the in-window causal band is derived from
+    that convention (module docstring) — no extra mask input.  One
+    dispatch scores the whole window; ``impl`` semantics are identical
+    to :func:`paged_attention` (the caller resolves "auto" so explicit
+    requests can fail instead of silently downgrading)."""
+
+    if impl not in PAGED_IMPLS:
+        raise ValueError(
+            f"impl must be one of {PAGED_IMPLS}, got {impl!r}"
+        )
+    if q.ndim != 4 or k_arena.ndim != 4 or tables.ndim != 2:
+        raise ValueError(
+            f"paged_attention_multi layout: q [S,K,H,D], arena "
+            f"[NB,Hkv,bs,D], tables [S,MB]; got q{q.shape}, "
+            f"k{k_arena.shape}, tables{tables.shape}"
+        )
+    if impl == "xla":
+        return _paged_attention_multi_xla(
+            q, k_arena, v_arena, tables, lengths
+        )
+    return _paged_attention_multi_pallas(
         q, k_arena, v_arena, tables, lengths,
         interpret=(impl == "pallas-interpret"),
     )

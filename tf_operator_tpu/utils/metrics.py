@@ -51,6 +51,8 @@ DISPATCH_PHASES = (
     "generate",   # speculative fused whole-generation program
     "round",      # speculative host-driven round loop
     "chunk",      # speculative scan driver
+    "draft",      # paged speculative: draft prefill + K+1-step draft scan
+    "verify",     # paged speculative: one multi-query target dispatch
 )
 
 
