@@ -169,6 +169,38 @@ def parse_artifacts(out_dir: str) -> dict:
         spc["_artifact"] = spc_src
         data["speculative_paged"] = spc
 
+    # ISSUE 19: fused train-mode BatchNorm A/B.  Two possible sources:
+    # the dedicated chip step (profile_resnet --variant fusedbn --trace
+    # → resnet-fused-chip.out, carries the trace-category chain diff)
+    # and the measure.py train leg (train.out, always present).  The
+    # chip artifact wins under the same 24h freshness rule as paged —
+    # anchored to train.out, which runs after it in a healthy window.
+    fbn_chip = _last_json_line(_read(out_dir, "resnet-fused-chip.out"))
+    if fbn_chip and "resnet_fusedbn_step_ms_fused" in fbn_chip:
+        try:
+            fbn_mt = os.path.getmtime(
+                os.path.join(out_dir, "resnet-fused-chip.out")
+            )
+        except OSError:
+            fbn_mt = 0.0
+        try:
+            fbn_anchor = os.path.getmtime(os.path.join(out_dir, "train.out"))
+        except OSError:
+            fbn_anchor = time.time()
+        if fbn_anchor - fbn_mt > _PAGED_CHIP_STALE_S:
+            fbn_chip = None
+    if fbn_chip:
+        fbn_chip["_artifact"] = "resnet-fused-chip.out"
+        data["fusedbn"] = fbn_chip
+    elif train and "resnet_fusedbn_step_ms_fused" in train:
+        fbn = {
+            k: v for k, v in train.items() if k.startswith(
+                ("resnet_fusedbn_", "fusedbn_trace_")
+            )
+        }
+        fbn["_artifact"] = "train.out"
+        data["fusedbn"] = fbn
+
     flash = _read(out_dir, "flash.out")
     m = re.search(
         r"flash fwd\+bwd @4k: ([\d.]+)ms\s+xla: ([\d.]+)ms\s+speedup ([\d.]+)x",
@@ -438,6 +470,28 @@ def write_last_measured(data: dict, today: str) -> None:
         # only stamp it when THIS run's row actually landed (a cpu
         # smoke blocked by a chip-grade entry must not relabel it)
         ledger["spec_paged_speedup"]["config"] = spc["spec_paged_config"]
+    # ISSUE 19: fused train-mode BN — walls/MFU/ratio and the trace
+    # chain shares carry the backend tag (a CPU smoke's numbers must
+    # never displace a chip-grade cell; CPU chain shares are client-
+    # thread aggregates, chip shares are the critical path); the
+    # interpret-numerics probe is platform-independent and untagged.
+    fbn = data.get("fusedbn", {})
+    fbn_backend = fbn.get("resnet_fusedbn_backend")
+    fbn_src = fbn.get("_artifact", "train.out")
+    _FUSEDBN_UNTAGGED = (
+        "resnet_fusedbn_interpret_fwd_err",
+        "resnet_fusedbn_interpret_grad_err",
+    )
+    for key in sorted(fbn):
+        if (
+            not key.startswith(("resnet_fusedbn_", "fusedbn_trace_"))
+            or key in ("resnet_fusedbn_backend", "resnet_fusedbn_impl")
+            or not isinstance(fbn[key], (int, float))
+        ):
+            continue
+        put(key, fbn[key], fbn_src,
+            backend=None if key in _FUSEDBN_UNTAGGED else fbn_backend)
+
     wd = data.get("wide")
     if wd:
         best = max(wd, key=lambda r: r["mfu_analytic"])
@@ -597,6 +651,54 @@ def build_rows(data: dict, today: str) -> dict[str, str]:
                 f"{cpu_caveat}{prefetch_txt} "
                 f"| {t_setup}, `measure.py --section train` → `window_out/train.out`, {today} |"
             )
+    # ISSUE 19: fused train-mode BatchNorm(+ReLU+residual) A/B
+    fbn = data.get("fusedbn")
+    if fbn:
+        fbn_backend = fbn.get("resnet_fusedbn_backend", "?")
+        fbn_on_chip = fbn_backend == "tpu"
+        fbn_art = fbn.get("_artifact", "train.out")
+        fbn_cmd = (
+            "`profile_resnet.py --variant fusedbn`"
+            if fbn_art == "resnet-fused-chip.out"
+            else "`measure.py --section train`"
+        )
+        trace_txt = ""
+        if fbn.get("fusedbn_trace_chain_share_drop") is not None:
+            trace_txt = (
+                "; traced reduce+elementwise+convert chain share "
+                f"{fbn.get('fusedbn_trace_chain_share_stock', '?')} stock "
+                f"→ {fbn.get('fusedbn_trace_chain_share_fused', '?')} "
+                "fused (drop "
+                f"**{fbn['fusedbn_trace_chain_share_drop']}**, "
+                "`trace_categories.py`)"
+            )
+        caveat = (
+            "" if fbn_on_chip else
+            " — CPU smoke: walls/MFU are chip-meaningful only (the "
+            "pallas kernel needs the TPU backend; this row carries the "
+            "accounting + interpret-kernel numerics until the queued "
+            "chip window lands)"
+        )
+        rows["ResNet train fusion"] = (
+            "| ResNet train fusion (ISSUE 19: train-mode "
+            "BN+ReLU(+residual) as ONE fused custom_vjp op, "
+            f"`ops/fused_batchnorm.py`, impl "
+            f"{fbn.get('resnet_fusedbn_impl', '?')}) | step "
+            f"**{fbn.get('resnet_fusedbn_step_ms_fused', '?')} ms** "
+            "fused vs "
+            f"{fbn.get('resnet_fusedbn_step_ms_stock', '?')} ms stock — "
+            f"**{fbn.get('resnet_fusedbn_step_wall_ratio', '?')}×**; "
+            f"MFU {fbn.get('resnet_fusedbn_mfu_fused', '?')} vs "
+            f"{fbn.get('resnet_fusedbn_mfu_stock', '?')}; loss max rel "
+            f"err {fbn.get('resnet_fusedbn_loss_max_rel_err', '?')}; "
+            "interpret-kernel probe fwd/grad err "
+            f"{fbn.get('resnet_fusedbn_interpret_fwd_err', '?')}/"
+            f"{fbn.get('resnet_fusedbn_interpret_grad_err', '?')}"
+            f"{trace_txt}{caveat} "
+            f"| {fbn_backend}, {fbn_cmd} → `window_out/{fbn_art}`, "
+            f"{today} |"
+        )
+
     ms = data.get("multislice")
     if ms:
         ms_backend = ms.get("multislice_backend", "?")
@@ -993,7 +1095,7 @@ def write_results(data: dict, today: str) -> None:
                  "(`benchmarks/window_out/`), collected by "
                  "`collect_window.py`.\n\n")
         for key in (
-            "bench", "train", "batching", "speculative",
+            "bench", "train", "fusedbn", "batching", "speculative",
             "speculative_paged", "paged", "fabric", "multislice",
             "flash_fwd_bwd", "window_fwd_bwd",
         ):

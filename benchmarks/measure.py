@@ -330,6 +330,121 @@ def bench_training() -> dict:
             3,
         ) if out["mnist_examples_per_sec_per_chip"] else None
 
+    # ---- ISSUE 19 tentpole: fused train-mode BatchNorm A/B.  Stock
+    # nn.BatchNorm vs norm="fused" on the same ResNet train step —
+    # identical init (scope/path parity), identical batch.  Tiny runs
+    # use resnet18(width=8) @ 32px so the CPU smoke commits the
+    # accounting without burning the window; the chip default is the
+    # profile_resnet config (resnet50 @ 224).  The full A/B with the
+    # trace-category diff lives in profile_resnet --variant fusedbn;
+    # this leg carries the measure.py-side cells for collect_window.
+    if os.environ.get("MEASURE_RESNET_FUSEDBN", "1") != "0":
+        from bench import _peak_flops as _pk, _step_flops as _sf
+        from tf_operator_tpu.models import resnet18, resnet50
+        from tf_operator_tpu.ops import fused_batchnorm
+        from tf_operator_tpu.parallel.trainer import (
+            batchnorm_cross_entropy_loss,
+        )
+
+        tiny = bool(os.environ.get("MEASURE_TRAIN_TINY"))
+        fb_batch = int(
+            os.environ.get("MEASURE_FUSEDBN_BATCH", "2" if tiny else "64")
+        )
+        fb_img = int(
+            os.environ.get("MEASURE_FUSEDBN_IMAGE", "32" if tiny else "224")
+        )
+        fb_steps = int(
+            os.environ.get("MEASURE_FUSEDBN_STEPS", "4" if tiny else "10")
+        )
+
+        def _fb_model(**kw):
+            if tiny:
+                return resnet18(num_classes=10, width=8, **kw)
+            return resnet50(**kw)
+
+        out["resnet_fusedbn_backend"] = jax.default_backend()
+        out["resnet_fusedbn_impl"] = _fb_model(norm="fused")._resolve_norm()
+        fb_batch_d = {
+            "image": jnp.asarray(
+                r.rand(fb_batch * n_dev, fb_img, fb_img, 3).astype(
+                    np.float32
+                ),
+                dtype=jnp.bfloat16,
+            ),
+            "label": jnp.asarray(
+                r.randint(0, 10 if tiny else 1000, size=(fb_batch * n_dev,))
+            ),
+        }
+        fb_cfg = TrainerConfig(
+            optimizer="sgd", learning_rate=0.1, momentum=0.9
+        )
+        fb_stock = Trainer(
+            _fb_model(), fb_cfg, mesh, batchnorm_cross_entropy_loss,
+            fb_batch_d,
+        )
+        fb_fused = Trainer(
+            _fb_model(norm="fused"), fb_cfg, mesh,
+            batchnorm_cross_entropy_loss, fb_batch_d,
+        )
+        loss_s = [
+            float(fb_stock.train_step(fb_batch_d)["loss"]) for _ in range(3)
+        ]
+        loss_f = [
+            float(fb_fused.train_step(fb_batch_d)["loss"]) for _ in range(3)
+        ]
+        out["resnet_fusedbn_loss_max_rel_err"] = float(
+            np.max(
+                np.abs(np.array(loss_s) - np.array(loss_f))
+                / np.maximum(np.abs(np.array(loss_s)), 1e-12)
+            )
+        )
+        fb_peak = _pk(jax.devices()[0])
+        fb_sharded = fb_stock.shard_batch(fb_batch_d)
+        fb_ms = {}
+        for fb_tag, fb_tr in (("stock", fb_stock), ("fused", fb_fused)):
+            fb_flops = _sf(fb_tr, fb_sharded)
+            fb_stats = fb_tr.benchmark(fb_batch_d, steps=fb_steps, warmup=2)
+            fb_ms[fb_tag] = fb_stats["step_ms"]
+            out[f"resnet_fusedbn_step_ms_{fb_tag}"] = round(
+                fb_stats["step_ms"], 2
+            )
+            if fb_flops:
+                out[f"resnet_fusedbn_mfu_{fb_tag}"] = round(
+                    fb_flops * fb_stats["steps_per_sec"] / fb_peak, 4
+                )
+        out["resnet_fusedbn_step_wall_ratio"] = (
+            round(fb_ms["stock"] / fb_ms["fused"], 3)
+            if fb_ms["fused"]
+            else None
+        )
+        # interpret-numerics probe: the real kernel body through the
+        # pallas interpreter, fwd + grad vs the xla reference — always
+        # committed so even a CPU artifact carries kernel evidence
+        fb_x = jnp.asarray(
+            np.random.RandomState(1).rand(4, 9, 9, 24), jnp.float32
+        )
+        fb_g = jnp.full((24,), 1.3, jnp.float32)
+        fb_b = jnp.full((24,), 0.2, jnp.float32)
+
+        def _fb_probe(impl):
+            def f(x):
+                y, _, _ = fused_batchnorm(
+                    x, fb_g, fb_b, relu=True, impl=impl
+                )
+                return jnp.sum(y * y)
+
+            y, _, _ = fused_batchnorm(fb_x, fb_g, fb_b, relu=True, impl=impl)
+            return y, jax.grad(f)(fb_x)
+
+        fb_yr, fb_dr = _fb_probe("xla")
+        fb_yi, fb_di = _fb_probe("pallas-interpret")
+        out["resnet_fusedbn_interpret_fwd_err"] = float(
+            jnp.max(jnp.abs(fb_yi - fb_yr))
+        )
+        out["resnet_fusedbn_interpret_grad_err"] = float(
+            jnp.max(jnp.abs(fb_di - fb_dr))
+        )
+
     if os.environ.get("MEASURE_TRAIN_TINY"):
         # CPU smoke of the mnist + K-sweep + prefetch accounting only:
         # BERT-base/llama-mini steps are chip work (a CPU run would

@@ -27,6 +27,12 @@ from collections import defaultdict
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+# every runnable variant; argparse choices and build_trainer validate
+# against this single tuple so an unknown variant fails the same way
+# from the CLI and from a programmatic caller (measure.py, tpu_window)
+VARIANTS = ("baseline", "s2d", "noclip", "bnbf16", "pbf16", "bnfold", "fusedbn")
+
+
 def build_trainer(variant: str, batch_per_chip: int):
     import jax
     import jax.numpy as jnp
@@ -36,6 +42,10 @@ def build_trainer(variant: str, batch_per_chip: int):
     from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
     from tf_operator_tpu.parallel.trainer import batchnorm_cross_entropy_loss
 
+    if variant not in VARIANTS:
+        raise ValueError(
+            f"unknown variant {variant!r}: expected one of {VARIANTS}"
+        )
     n_dev = len(jax.devices())
     mesh = make_mesh({"dp": n_dev})
     rng = np.random.RandomState(0)
@@ -53,6 +63,11 @@ def build_trainer(variant: str, batch_per_chip: int):
         # PROFILE.md: stem and batch scaling are exhausted; the rest is
         # bwd convs + BN chains — this probes the BN half
         kw["bn_param_dtype"] = jnp.bfloat16
+    if variant == "fusedbn":
+        # ISSUE 19 tentpole: train-mode BN+ReLU(+residual) as one fused
+        # custom_vjp op ("auto" picks the pallas kernel on a single
+        # TPU chip, the xla composition elsewhere — never silently)
+        kw["norm"] = "fused"
     model = resnet50(**kw)
     cfg = TrainerConfig(optimizer="sgd", learning_rate=0.1, momentum=0.9)
     if variant == "noclip":
@@ -293,12 +308,122 @@ def run_bnfold(batch_per_chip: int, steps: int, trace_dir: "str | None"):
     return out_row
 
 
+def run_fusedbn(batch_per_chip: int, steps: int, trace_dir: "str | None"):
+    """Train-mode fused-BN A/B (ISSUE 19 tentpole measurement): the
+    same ResNet-50 train step with ``norm="fused"`` vs stock
+    ``nn.BatchNorm`` — identical init (scope/path parity), identical
+    batch, numerics-probed, slope-timed.  The trace leg captures BOTH
+    variants and diffs the reduce+elementwise+convert chain share, the
+    category-level proof that the fusion killed the chains FLOPS.md
+    blames for the ~0.32 train-MFU ceiling."""
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _peak_flops
+    from tf_operator_tpu.models import resnet50
+    from tf_operator_tpu.ops import fused_batchnorm
+
+    out_row = {
+        "variant": "fusedbn",
+        "batch_per_chip": batch_per_chip,
+        "resnet_fusedbn_backend": jax.default_backend(),
+        # what "auto" resolves to here — chip: pallas, CPU smoke: xla
+        "resnet_fusedbn_impl": resnet50(norm="fused")._resolve_norm(),
+    }
+
+    # interpret-numerics probe: the REAL kernel body via the pallas
+    # interpreter on a small tensor, fwd+grad vs the xla reference —
+    # committed even from a CPU smoke so the window artifact always
+    # carries kernel-body evidence, not just composition timings
+    xk = jnp.asarray(np.random.RandomState(1).rand(4, 9, 9, 24), jnp.float32)
+    g = jnp.ones((24,), jnp.float32) * 1.3
+    b = jnp.ones((24,), jnp.float32) * 0.2
+
+    def probe(impl):
+        def f(x):
+            y, _, _ = fused_batchnorm(x, g, b, relu=True, impl=impl)
+            return jnp.sum(y * y)
+
+        y, _, _ = fused_batchnorm(xk, g, b, relu=True, impl=impl)
+        return y, jax.grad(f)(xk)
+
+    y_ref, dx_ref = probe("xla")
+    y_int, dx_int = probe("pallas-interpret")
+    out_row["resnet_fusedbn_interpret_fwd_err"] = float(
+        jnp.max(jnp.abs(y_int - y_ref))
+    )
+    out_row["resnet_fusedbn_interpret_grad_err"] = float(
+        jnp.max(jnp.abs(dx_int - dx_ref))
+    )
+
+    stock, batch = build_trainer("baseline", batch_per_chip)
+    fused, _ = build_trainer("fusedbn", batch_per_chip)
+
+    # loss probe BEFORE timing: 3 real train steps per variant from the
+    # path-parity-identical init, max relative loss divergence
+    loss_s = [float(stock.train_step(batch)["loss"]) for _ in range(3)]
+    loss_f = [float(fused.train_step(batch)["loss"]) for _ in range(3)]
+    out_row["resnet_fusedbn_loss_max_rel_err"] = float(
+        np.max(np.abs(np.array(loss_s) - np.array(loss_f))
+               / np.maximum(np.abs(np.array(loss_s)), 1e-12))
+    )
+
+    peak = _peak_flops(jax.devices()[0])
+    sharded = stock.shard_batch(batch)
+    rows = {}
+    for tag, tr in (("stock", stock), ("fused", fused)):
+        flops = step_flops(tr, sharded)
+        stats = tr.benchmark(batch, steps=steps, warmup=5)
+        rows[tag] = stats["step_ms"]
+        out_row[f"resnet_fusedbn_step_ms_{tag}"] = round(stats["step_ms"], 2)
+        out_row[f"resnet_fusedbn_mfu_{tag}"] = round(
+            flops * stats["steps_per_sec"] / peak, 4
+        )
+    out_row["resnet_fusedbn_step_wall_ratio"] = (
+        round(rows["stock"] / rows["fused"], 3) if rows["fused"] else None
+    )
+
+    if trace_dir:
+        import trace_categories
+
+        shares = {}
+        for tag, tr in (("stock", stock), ("fused", fused)):
+            tdir = f"{trace_dir}-{tag}"
+            with jax.profiler.trace(tdir):
+                for _ in range(3):
+                    tr.train_step(batch)
+                jax.effects_barrier()
+            tables = trace_categories.category_tables(tdir)
+            if not tables:
+                print("no xplane found under", tdir)
+                continue
+            print(f"\n#### {tag} ({tdir})")
+            print(trace_categories.format_text(tables))
+            print("\n--- markdown (FLOPS.md 'trace category table') ---")
+            print(trace_categories.format_markdown(tables))
+            shares[tag] = trace_categories.chain_share(tables)
+        if "stock" in shares and "fused" in shares:
+            out_row["fusedbn_trace_chain_share_stock"] = round(
+                shares["stock"], 4
+            )
+            out_row["fusedbn_trace_chain_share_fused"] = round(
+                shares["fused"], 4
+            )
+            out_row["fusedbn_trace_chain_share_drop"] = round(
+                shares["stock"] - shares["fused"], 4
+            )
+    print(json.dumps(out_row), flush=True)
+    return out_row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--variant",
         default="baseline",
-        choices=["baseline", "s2d", "noclip", "bnbf16", "pbf16", "bnfold"],
+        choices=list(VARIANTS),
     )
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--steps", type=int, default=20)
@@ -321,6 +446,9 @@ def main():
         return
     if args.variant == "bnfold":
         run_bnfold(args.batch, args.steps, args.trace)
+        return
+    if args.variant == "fusedbn":
+        run_fusedbn(args.batch, args.steps, args.trace)
         return
     run_variant(args.variant, args.batch, args.steps, args.trace)
 

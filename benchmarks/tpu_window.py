@@ -18,6 +18,8 @@ if earlier ones prove the chip is answering):
   7b. speculative-paged — spec decoding on the paged plane (chip + CPU
       smoke): draft KV in the shared block arena, fused K-token
       verify, vs the non-speculative pool at the same arena
+  7c. resnet-fused-chip — fused train-mode BN A/B (stock vs
+      norm="fused" pallas kernel) + the traced chain-share drop
   8. trace        — xplane trace of the hot step + top-op summary
   9. sweep        — the ResNet MFU variant x flag matrix
  10. llama-sweep  — the transformer variant/autotune matrix
@@ -68,11 +70,13 @@ STEPS = [
     ("flops", [sys.executable, os.path.join(HERE, "flops_audit.py")], 600),
     # r7: the section now also runs the steps_per_sync K sweep (one
     # lax.scan compile per K on this 1-core host) and the prefetch
-    # depth sweep — budget raised from 1800 accordingly
+    # depth sweep — budget raised from 1800 accordingly.  ISSUE 19:
+    # now ALSO carries the fused-BN A/B leg (two resnet50 train-step
+    # compiles + 3 probe steps each) — raised again from 2700.
     (
         "train",
         [sys.executable, os.path.join(HERE, "measure.py"), "--section", "train"],
-        2700,
+        3600,
     ),
     # ISSUE 14: flat vs hierarchical grad sync on the slice-aware mesh.
     # This box has ONE chip, so the window runs the same 2-slice CPU
@@ -192,6 +196,32 @@ STEPS = [
             "MEASURE_PLATFORM": "cpu",
             "MEASURE_SPEC_TINY": "1",
         },
+    ),
+    # ISSUE 19 tentpole measurement: the fused train-mode BatchNorm
+    # A/B on chip — stock nn.BatchNorm vs norm="fused" (auto → the
+    # pallas kernel here), slope-timed + MFU + loss probe, tracing
+    # BOTH variants so the reduce/elementwise/convert chain-share drop
+    # lands as evidence (fusedbn_trace_* keys).  Budget: two resnet50
+    # fwd+bwd+opt compiles on the 1-core host (~the bench step's
+    # dominant cost) plus 2x traced steps.
+    (
+        "resnet-fused-chip",
+        [
+            sys.executable, os.path.join(HERE, "profile_resnet.py"),
+            "--variant", "fusedbn", "--batch", "256", "--steps", "10",
+            "--trace", "/tmp/rn50-fusedbn",
+        ],
+        3300,
+    ),
+    # the A/B pair of category tables, standalone (same rationale as
+    # trace-categories below: survive a truncated chip-step stdout) —
+    # multi-dir mode prints the per-variant tables AND the chain-share
+    # drop line
+    (
+        "resnet-fused-trace",
+        [sys.executable, os.path.join(HERE, "trace_categories.py"),
+         "/tmp/rn50-fusedbn-stock", "/tmp/rn50-fusedbn-fused", "--md"],
+        300,
     ),
     # the >=0.40-MFU existence proof at serious width (~700M d_model
     # 2048, VERDICT r4 next #3) — before the long sweeps so a dying

@@ -131,6 +131,37 @@ def category_tables(trace_dir: str) -> List[Dict[str, Any]]:
     return tables
 
 
+def category_shares(tables: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Per-category share-of-total for the critical-path table (chip:
+    the "XLA Ops" sync line; CPU smoke: the aggregated client line).
+    This is what the fusedbn A/B (ISSUE 19) diffs between variants:
+    the killed chain is ``reductions + elementwise + converts``, so the
+    drop in that sum is the category-level proof of the fusion."""
+
+    main = next(
+        (t for t in tables if t["line"] == "XLA Ops"),
+        next((t for t in tables if t["line"] == "XLA client ops"), None),
+    )
+    if main is None or not main["total_s"]:
+        return {}
+    return {cat: dur / main["total_s"] for cat, dur, _ in main["rows"]}
+
+
+def chain_share(tables: List[Dict[str, Any]]) -> float:
+    """The BN-chain share: reductions + elementwise fusions + dtype
+    converts as a fraction of critical-path device time."""
+
+    shares = category_shares(tables)
+    return sum(
+        shares.get(k, 0.0)
+        for k in (
+            "reductions (BN stats etc.)",
+            "elementwise fusions",
+            "dtype converts",
+        )
+    )
+
+
 def format_text(tables: List[Dict[str, Any]]) -> str:
     out = []
     for t in tables:
@@ -167,17 +198,33 @@ def format_markdown(tables: List[Dict[str, Any]]) -> str:
 
 
 def main() -> int:
+    # accepts multiple trace dirs (ISSUE 19: the fusedbn window step
+    # passes the A/B pair ``…-stock …-fused``); with 2+ dirs the
+    # chain-share diff across them is printed last
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
-    trace_dir = args[0] if args else "/tmp/rn50-xplane"
-    tables = category_tables(trace_dir)
-    if not tables:
-        print("no xplane found under", trace_dir)
-        return 1
-    print(format_text(tables))
-    if "--md" in sys.argv[1:]:
-        print("\n--- markdown (FLOPS.md 'trace category table') ---")
-        print(format_markdown(tables))
-    return 0
+    trace_dirs = args if args else ["/tmp/rn50-xplane"]
+    shares = {}
+    missing = 0
+    for trace_dir in trace_dirs:
+        tables = category_tables(trace_dir)
+        if not tables:
+            print("no xplane found under", trace_dir)
+            missing += 1
+            continue
+        if len(trace_dirs) > 1:
+            print(f"\n#### {trace_dir}")
+        print(format_text(tables))
+        if "--md" in sys.argv[1:]:
+            print("\n--- markdown (FLOPS.md 'trace category table') ---")
+            print(format_markdown(tables))
+        shares[trace_dir] = chain_share(tables)
+    if len(shares) > 1:
+        print("\n== reduce+elementwise+convert chain share by trace ==")
+        for d, s in shares.items():
+            print(f"{s * 100:6.1f}%  {d}")
+        vals = list(shares.values())
+        print(f"drop (first - last): {(vals[0] - vals[-1]) * 100:.1f} pts")
+    return 1 if missing else 0
 
 
 if __name__ == "__main__":

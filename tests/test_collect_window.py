@@ -320,6 +320,107 @@ def test_cpu_smoke_train_artifact_does_not_clobber_chip_model_rows(tmp_path):
     assert "backend" not in led["mnist_steps_per_sec_per_chip"]
 
 
+def test_fusedbn_artifact_parses_into_row_and_ledger(tmp_path):
+    """ISSUE 19: the fused train-mode BN A/B flows into the 'ResNet
+    train fusion' BASELINE row and the LAST_MEASURED ledger.  The
+    dedicated chip artifact (resnet-fused-chip.out) wins over the
+    train.out smoke keys when fresh; walls/MFU/trace-chain shares are
+    backend-tagged (a CPU smoke must never displace a chip-grade
+    cell), the interpret-kernel numerics probe stays untagged."""
+
+    import json
+
+    d = tmp_path / "window_out"
+    d.mkdir()
+    # CPU-smoke train.out carrying the measure.py leg's fusedbn keys
+    t = dict(json.loads(TRAIN_LINE))
+    t["train_backend"] = "cpu"
+    t.update(
+        {
+            "resnet_fusedbn_backend": "cpu",
+            "resnet_fusedbn_impl": "xla",
+            "resnet_fusedbn_step_ms_stock": 2205.78,
+            "resnet_fusedbn_step_ms_fused": 2099.91,
+            "resnet_fusedbn_step_wall_ratio": 1.05,
+            "resnet_fusedbn_mfu_stock": 0.0001,
+            "resnet_fusedbn_mfu_fused": 0.0001,
+            "resnet_fusedbn_loss_max_rel_err": 1.04e-05,
+            "resnet_fusedbn_interpret_fwd_err": 3.34e-06,
+            "resnet_fusedbn_interpret_grad_err": 5.48e-05,
+        }
+    )
+    (d / "train.out").write_text(json.dumps(t, indent=1) + "\n")
+    data = cw.parse_artifacts(str(d))
+    assert data["fusedbn"]["_artifact"] == "train.out"
+    rows = cw.build_rows(data, "2026-08-07")
+    row = rows["ResNet train fusion"]
+    assert "**2099.91 ms** fused" in row and "**1.05×**" in row
+    assert "CPU smoke" in row and "chip-meaningful only" in row
+
+    import unittest.mock as mock
+
+    # seed a chip-grade (untagged) wall: the CPU smoke must not
+    # replace it, but the untagged interpret probe may refresh
+    (tmp_path / "LAST_MEASURED.json").write_text(
+        json.dumps(
+            {
+                "resnet_fusedbn_step_ms_fused": {
+                    "value": 88.1,
+                    "artifact": "benchmarks/window_out/resnet-fused-chip.out",
+                    "date": "2026-08-01",
+                }
+            }
+        )
+    )
+    with mock.patch.object(cw, "HERE", str(tmp_path)):
+        cw.write_last_measured(data, "2026-08-07")
+        led = json.load(open(tmp_path / "LAST_MEASURED.json"))
+    assert led["resnet_fusedbn_step_ms_fused"]["value"] == 88.1
+    assert "backend" not in led["resnet_fusedbn_step_ms_fused"]
+    assert led["resnet_fusedbn_step_wall_ratio"]["backend"] == "cpu"
+    assert led["resnet_fusedbn_interpret_fwd_err"]["value"] == 3.34e-06
+    assert "backend" not in led["resnet_fusedbn_interpret_fwd_err"]
+    # config echoes never enter the measured-keys ledger
+    assert "resnet_fusedbn_backend" not in led
+    assert "resnet_fusedbn_impl" not in led
+
+    # the dedicated chip artifact (fresh: same window as train.out)
+    # shadows the train.out keys and carries the trace-chain diff
+    chip = {
+        "variant": "fusedbn",
+        "batch_per_chip": 256,
+        "resnet_fusedbn_backend": "tpu",
+        "resnet_fusedbn_impl": "pallas",
+        "resnet_fusedbn_step_ms_stock": 106.0,
+        "resnet_fusedbn_step_ms_fused": 88.1,
+        "resnet_fusedbn_step_wall_ratio": 1.203,
+        "resnet_fusedbn_mfu_stock": 0.31,
+        "resnet_fusedbn_mfu_fused": 0.37,
+        "resnet_fusedbn_loss_max_rel_err": 2.0e-05,
+        "resnet_fusedbn_interpret_fwd_err": 3.34e-06,
+        "resnet_fusedbn_interpret_grad_err": 5.48e-05,
+        "fusedbn_trace_chain_share_stock": 0.55,
+        "fusedbn_trace_chain_share_fused": 0.31,
+        "fusedbn_trace_chain_share_drop": 0.24,
+    }
+    (d / "resnet-fused-chip.out").write_text(json.dumps(chip) + "\n")
+    data = cw.parse_artifacts(str(d))
+    assert data["fusedbn"]["_artifact"] == "resnet-fused-chip.out"
+    row = cw.build_rows(data, "2026-08-07")["ResNet train fusion"]
+    assert "**88.1 ms** fused" in row and "**1.203×**" in row
+    assert "0.37" in row and "drop **0.24**" in row
+    assert "CPU smoke" not in row
+    with mock.patch.object(cw, "HERE", str(tmp_path)):
+        cw.write_last_measured(data, "2026-08-07")
+        led = json.load(open(tmp_path / "LAST_MEASURED.json"))
+    # tpu rows land untagged (chip-grade) and shadow nothing
+    assert led["resnet_fusedbn_step_ms_fused"]["value"] == 88.1
+    assert led["resnet_fusedbn_mfu_fused"]["value"] == 0.37
+    assert led["fusedbn_trace_chain_share_drop"]["value"] == 0.24
+    # artifact-echo keys from the chip JSON stay out of the ledger
+    assert "batch_per_chip" not in led
+
+
 def test_error_bench_line_is_ignored(tmp_path):
     d = tmp_path / "w"
     d.mkdir()

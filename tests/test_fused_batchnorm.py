@@ -1,0 +1,374 @@
+"""Fused train-mode BatchNorm (ISSUE 19): numerics pinned vs the flax
+reference on the xla AND pallas-interpret impls, gradients via
+jax.grad, running-stats identity, scope-name parity, and the fail-loud
+config matrix (the paged_kernel validation-order contract extended to
+``ResNet.norm`` / ``norm_impl``).
+
+Tolerances: the xla impl mirrors ``nn.BatchNorm``'s exact op order and
+is asserted BITWISE; the interpret impl runs the real kernel whose
+tile-sequential f32 accumulation differs from XLA's reduction order —
+f32 inputs pin at 1e-5 absolute, bf16 activations at one bf16 ulp of
+the O(1) normalized outputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import flax.linen as nn
+
+from tf_operator_tpu.ops.fused_batchnorm import (
+    FUSEDBN_IMPLS,
+    fused_batchnorm,
+    fusedbn_available,
+)
+from tf_operator_tpu.models.resnet import BatchNorm as FusedBN
+from tf_operator_tpu.models.resnet import resnet18
+
+#: NHWC shapes including tile-straddling channel counts (C=5 pads to
+#: one lane tile, 130/192 straddle the 128 lane boundary) and a
+#: row-count (34·1·1) that straddles the 16-sublane tile
+SHAPES = [(2, 3, 3, 5), (2, 4, 4, 128), (3, 5, 5, 192), (34, 1, 1, 7), (1, 9, 5, 130)]
+
+
+def _inputs(shape, dtype, seed=0):
+    r = np.random.RandomState(seed)
+    c = shape[-1]
+    return (
+        jnp.asarray(r.randn(*shape) * 2 + 0.3, dtype),
+        jnp.asarray(r.randn(c), jnp.float32),
+        jnp.asarray(r.randn(c), jnp.float32),
+        jnp.asarray(r.randn(*shape), dtype),
+    )
+
+
+def test_xla_impl_bitwise_matches_flax():
+    """impl='xla' IS nn.BatchNorm's train-mode op order: outputs and
+    batch moments bit-identical on bf16 activations / f32 params."""
+
+    x, gamma, beta, _ = _inputs((2, 4, 4, 5), jnp.bfloat16)
+    bn = nn.BatchNorm(
+        use_running_average=False, momentum=0.9, epsilon=1e-5,
+        dtype=jnp.bfloat16, param_dtype=jnp.float32,
+    )
+    v = bn.init(jax.random.PRNGKey(0), x)
+    v = {"params": {"scale": gamma, "bias": beta}, "batch_stats": v["batch_stats"]}
+    y_ref, upd = bn.apply(v, x, mutable=["batch_stats"])
+    y, mean, var = fused_batchnorm(x, gamma, beta, eps=1e-5, impl="xla")
+    assert jnp.array_equal(y_ref, y)
+    # the moments feed the running-stats update — flax's exact values
+    assert jnp.array_equal(
+        upd["batch_stats"]["mean"], 0.9 * v["batch_stats"]["mean"] + 0.1 * mean
+    )
+    assert jnp.array_equal(
+        upd["batch_stats"]["var"], 0.9 * v["batch_stats"]["var"] + 0.1 * var
+    )
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_interpret_forward_matches_xla(shape):
+    """The real kernel (interpreted), jitted, across tile-straddling
+    shapes and every epilogue combo."""
+
+    x, gamma, beta, res = _inputs(shape, jnp.float32)
+    for relu in (False, True):
+        for use_res in (False, True):
+            r = res if use_res else None
+            y_ref, m_ref, v_ref = fused_batchnorm(
+                x, gamma, beta, relu=relu, residual=r, impl="xla"
+            )
+            f = jax.jit(
+                lambda x, g, b, r=r, relu=relu: fused_batchnorm(
+                    x, g, b, relu=relu, residual=r, impl="pallas-interpret"
+                )
+            )
+            y, m, v = f(x, gamma, beta)
+            np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+            np.testing.assert_allclose(np.asarray(m), np.asarray(m_ref), atol=1e-5)
+            np.testing.assert_allclose(np.asarray(v), np.asarray(v_ref), atol=1e-5)
+
+
+def test_interpret_mixed_precision_bf16():
+    """bf16 activations, f32 stats: y comes back bf16 within one ulp of
+    the reference; the moments stay f32 and match the f32-accumulated
+    reference (NOT a bf16 accumulation — the convert lives in-register
+    before the reduce)."""
+
+    x, gamma, beta, res = _inputs((3, 5, 5, 192), jnp.bfloat16)
+    y, mean, var = fused_batchnorm(
+        x, gamma, beta, relu=True, residual=res, impl="pallas-interpret"
+    )
+    y_ref, m_ref, v_ref = fused_batchnorm(
+        x, gamma, beta, relu=True, residual=res, impl="xla"
+    )
+    assert y.dtype == jnp.bfloat16 and mean.dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(y_ref, np.float32), atol=0.0625
+    )
+    # f32-accumulation proof: the true f32 moments, tight
+    xf = np.asarray(x, np.float32).reshape(-1, 192)
+    np.testing.assert_allclose(np.asarray(mean), xf.mean(0), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(v_ref), np.asarray(var), atol=1e-5)
+
+
+@pytest.mark.parametrize("relu", [False, True])
+@pytest.mark.parametrize("use_res", [False, True])
+def test_interpret_grads_match_reference(relu, use_res):
+    """jax.grad through the custom_vjp: dx, dγ, dβ — and the residual-
+    branch dy split — match autodiff of the reference composition."""
+
+    x, gamma, beta, res = _inputs((3, 3, 3, 7), jnp.float32, seed=3)
+    w = jnp.asarray(np.random.RandomState(9).randn(*x.shape), jnp.float32)
+
+    def loss(impl):
+        def f(x, g, b, r):
+            y, _, _ = fused_batchnorm(
+                x, g, b, relu=relu, residual=(r if use_res else None), impl=impl
+            )
+            return jnp.sum(y * w)
+
+        return f
+
+    g_ref = jax.grad(loss("xla"), argnums=(0, 1, 2, 3))(x, gamma, beta, res)
+    g_ker = jax.grad(loss("pallas-interpret"), argnums=(0, 1, 2, 3))(x, gamma, beta, res)
+    for a, b in zip(g_ref, g_ker):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    if use_res:
+        # the residual branch must see dy post-ReLU-mask (non-trivial)
+        assert bool(jnp.any(g_ker[3] != 0))
+    else:
+        assert not bool(jnp.any(g_ker[3] != 0))
+
+
+def test_relu_mask_uses_relu_subgradient_convention():
+    """The kernel's y>0 mask matches jax.nn.relu's custom JVP (zero at
+    the kink), not jnp.maximum's half-split."""
+
+    x = jnp.asarray([[0.0, -1.0, 2.0, 0.0]] * 8, jnp.float32)
+    gamma = jnp.ones((4,), jnp.float32)
+    beta = jnp.zeros((4,), jnp.float32)
+    # constant columns: var=0, y = beta = 0 -> at the kink everywhere
+    for impl in ("xla", "pallas-interpret"):
+        dx = jax.grad(
+            lambda x: jnp.sum(fused_batchnorm(x, gamma, beta, relu=True, impl=impl)[0])
+        )(x)
+        assert not bool(jnp.any(dx != 0)), impl
+
+
+def test_module_running_stats_and_scope_parity():
+    """The fused module face: same scope/variable tree as nn.BatchNorm
+    (class-name trick), identical running-stats update on xla, allclose
+    on interpret."""
+
+    x, gamma, beta, _ = _inputs((2, 4, 4, 6), jnp.bfloat16)
+    stock = nn.BatchNorm(
+        use_running_average=False, momentum=0.9, epsilon=1e-5,
+        dtype=jnp.bfloat16, param_dtype=jnp.float32,
+    )
+    v = stock.init(jax.random.PRNGKey(0), x)
+    v = {"params": {"scale": gamma, "bias": beta}, "batch_stats": v["batch_stats"]}
+    _, upd_ref = stock.apply(v, x, mutable=["batch_stats"])
+
+    fused = FusedBN(dtype=jnp.bfloat16, impl="xla")
+    v_f = fused.init(jax.random.PRNGKey(0), x)
+    assert jax.tree_util.tree_structure(v_f) == jax.tree_util.tree_structure(v)
+    y, upd = fused.apply(v, x, mutable=["batch_stats"])
+    assert jnp.array_equal(upd["batch_stats"]["mean"], upd_ref["batch_stats"]["mean"])
+    assert jnp.array_equal(upd["batch_stats"]["var"], upd_ref["batch_stats"]["var"])
+
+    interp = FusedBN(dtype=jnp.bfloat16, impl="pallas-interpret")
+    _, upd_i = interp.apply(v, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(
+        np.asarray(upd_i["batch_stats"]["mean"]),
+        np.asarray(upd_ref["batch_stats"]["mean"]),
+        atol=1e-6,
+    )
+
+    # eval mode: running-stats affine, bitwise vs nn.BatchNorm
+    ev_ref = nn.BatchNorm(
+        use_running_average=True, momentum=0.9, epsilon=1e-5,
+        dtype=jnp.bfloat16, param_dtype=jnp.float32,
+    ).apply(v, x)
+    ev = FusedBN(use_running_average=True, dtype=jnp.bfloat16, impl="pallas-interpret").apply(v, x)
+    assert jnp.array_equal(ev_ref, ev)
+
+
+def test_resnet_fused_xla_is_bitwise_stock():
+    """norm='fused' + impl xla through a whole resnet18: identical init
+    trees, bitwise train logits + batch_stats, bitwise eval logits."""
+
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.rand(2, 32, 32, 3), jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    stock = resnet18(num_classes=10, width=8)
+    fused = resnet18(num_classes=10, width=8, norm="fused", norm_impl="xla")
+    vs = stock.init(rng, x, train=False)
+    vf = fused.init(rng, x, train=False)
+    assert jax.tree_util.tree_all(
+        jax.tree_util.tree_map(lambda a, b: bool(jnp.array_equal(a, b)), vs, vf)
+    )
+    ys, us = stock.apply(vs, x, train=True, mutable=["batch_stats"])
+    yf, uf = fused.apply(vs, x, train=True, mutable=["batch_stats"])
+    assert jnp.array_equal(ys, yf)
+    assert jax.tree_util.tree_all(
+        jax.tree_util.tree_map(lambda a, b: bool(jnp.array_equal(a, b)), us, uf)
+    )
+    assert jnp.array_equal(stock.apply(vs, x, train=False), fused.apply(vs, x, train=False))
+
+
+def test_resnet_fused_interpret_forward_and_grad():
+    """The real kernel through every resnet18 BN call site (stem ReLU,
+    mid-block ReLU, zero-init + residual epilogue, norm_proj plain):
+    forward and full-model grads allclose vs stock at f32."""
+
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.rand(2, 32, 32, 3), jnp.float32)
+    stock = resnet18(num_classes=10, width=8, dtype=jnp.float32)
+    interp = resnet18(
+        num_classes=10, width=8, dtype=jnp.float32, norm="fused", norm_impl="interpret"
+    )
+    v = stock.init(jax.random.PRNGKey(0), x, train=False)
+
+    def gradof(model):
+        def f(p):
+            y, _ = model.apply(
+                {"params": p, "batch_stats": v["batch_stats"]},
+                x, train=True, mutable=["batch_stats"],
+            )
+            return jnp.mean(y**2)
+
+        return jax.grad(f)(v["params"])
+
+    ys, _ = stock.apply(v, x, train=True, mutable=["batch_stats"])
+    yi, _ = interp.apply(v, x, train=True, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(yi), np.asarray(ys), atol=1e-3)
+    gs, gi = gradof(stock), gradof(interp)
+    flat_s = jnp.concatenate([a.ravel() for a in jax.tree_util.tree_leaves(gs)])
+    flat_i = jnp.concatenate([a.ravel() for a in jax.tree_util.tree_leaves(gi)])
+    # relative l2 over all params: reduction-order noise compounds
+    # through 18 layers; 1e-3 still catches any wrong VJP term
+    assert float(jnp.linalg.norm(flat_s - flat_i)) <= 1e-3 * float(
+        jnp.linalg.norm(flat_s)
+    )
+
+
+# ---------------------------------------------------------------------------
+# trainer composition (the PR 4 fused-scan trainer; slow tier like the
+# other full-model train-step compiles in tests/test_models.py)
+
+
+def _trainer(model, batch):
+    from tf_operator_tpu.parallel import Trainer, TrainerConfig, make_mesh
+    from tf_operator_tpu.parallel.trainer import batchnorm_cross_entropy_loss
+
+    return Trainer(
+        model,
+        TrainerConfig(optimizer="sgd", learning_rate=0.05),
+        make_mesh({"dp": 1}, devices=jax.devices()[:1]),
+        batchnorm_cross_entropy_loss,
+        batch,
+    )
+
+
+@pytest.mark.slow
+def test_fused_trains_allclose_vs_stock_per_step_and_scanned():
+    """ISSUE 19 acceptance: norm='fused' trains through the fused-scan
+    trainer — per-step AND train_steps (lax.scan) paths — allclose vs
+    the stock flax graph (fwd+grad land in the loss trajectory)."""
+
+    r = np.random.RandomState(0)
+    batch = {
+        "image": jnp.asarray(r.rand(8, 32, 32, 3), jnp.float32),
+        "label": jnp.asarray(r.randint(0, 10, size=(8,))),
+    }
+    kw = dict(num_classes=10, width=8, dtype=jnp.float32)
+    stock = _trainer(resnet18(**kw), batch)
+    fused = _trainer(resnet18(norm="fused", norm_impl="xla", **kw), batch)
+    losses = {}
+    for name, tr in (("stock", stock), ("fused", fused)):
+        losses[name] = [float(tr.train_step(batch)["loss"]) for _ in range(3)]
+    # impl='xla' is bit-comparable per layer; whole-graph jit fusion
+    # differences leave only float noise in the trajectory
+    np.testing.assert_allclose(losses["fused"], losses["stock"], rtol=1e-5)
+    # the scanned multi-step path (PR 4): its own compiled program,
+    # allclose within the documented per-step-vs-scan drift
+    m = np.asarray(fused.train_steps(batch, 3)["loss"])
+    m2 = np.asarray(stock.train_steps(batch, 3)["loss"])
+    np.testing.assert_allclose(m, m2, rtol=1e-3)
+    assert np.isfinite(m).all()
+
+
+@pytest.mark.slow
+def test_fused_interpret_trains_through_trainer():
+    """The real kernel (interpreted) survives the full Trainer path —
+    value_and_grad + optimizer + mutable batch_stats — and tracks the
+    stock loss."""
+
+    r = np.random.RandomState(1)
+    batch = {
+        "image": jnp.asarray(r.rand(4, 32, 32, 3), jnp.float32),
+        "label": jnp.asarray(r.randint(0, 10, size=(4,))),
+    }
+    kw = dict(num_classes=10, width=8, dtype=jnp.float32)
+    stock = _trainer(resnet18(**kw), batch)
+    interp = _trainer(resnet18(norm="fused", norm_impl="interpret", **kw), batch)
+    l_stock = [float(stock.train_step(batch)["loss"]) for _ in range(2)]
+    l_interp = [float(interp.train_step(batch)["loss"]) for _ in range(2)]
+    np.testing.assert_allclose(l_interp, l_stock, rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# fail-loud config matrix (the paged_kernel honesty contract)
+
+
+def test_functional_fail_loud_matrix():
+    x, gamma, beta, res = _inputs((2, 2, 2, 3), jnp.float32)
+    with pytest.raises(ValueError, match="impl must be one of"):
+        fused_batchnorm(x, gamma, beta, impl="bogus")
+    if jax.default_backend() != "tpu":
+        ok, why = fusedbn_available()
+        assert not ok and "TPU backend" in why
+        with pytest.raises(ValueError, match="refused"):
+            fused_batchnorm(x, gamma, beta, impl="pallas")
+    ok, why = fusedbn_available(interpret=True)
+    assert ok and why == ""
+    with pytest.raises(ValueError, match="gamma/beta"):
+        fused_batchnorm(x, gamma[:2], beta, impl="xla")
+    with pytest.raises(ValueError, match="residual shape"):
+        fused_batchnorm(x, gamma, beta, residual=res[:1], impl="xla")
+    assert FUSEDBN_IMPLS == ("xla", "pallas", "pallas-interpret")
+
+
+def test_resnet_norm_validation_order_pinned():
+    """The paged_kernel contract carried over: (1) a bad norm NAME
+    fails as a bad name even when the impl is also unservable, (2) a
+    bad impl spelling fails as a bad spelling, (3) semantic conflicts
+    (bn_fold, impl-on-stock-norm), (4) availability — and an explicit
+    pallas request on CPU REFUSES instead of downgrading to xla."""
+
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.rand(1, 32, 32, 3), jnp.float32)
+    rng = jax.random.PRNGKey(0)
+
+    def init(**kw):
+        resnet18(num_classes=10, width=8, **kw).init(rng, x, train=False)
+
+    # (1) bad name first, even with an unservable impl alongside
+    with pytest.raises(ValueError, match="norm must be"):
+        init(norm="bogus", norm_impl="pallas")
+    # (2) bad impl spelling
+    with pytest.raises(ValueError, match="norm_impl must be"):
+        init(norm="fused", norm_impl="bogus")
+    # (3) semantic conflicts
+    with pytest.raises(ValueError, match="bn_fold"):
+        init(norm="fused", bn_fold=True)
+    with pytest.raises(ValueError, match="silent downgrade"):
+        init(norm="batchnorm", norm_impl="pallas")
+    # (4) availability: explicit pallas on a non-TPU backend refuses
+    if jax.default_backend() != "tpu":
+        with pytest.raises(ValueError, match="refused"):
+            init(norm="fused", norm_impl="pallas")
+        # ... while auto resolves to the xla composition and runs
+        init(norm="fused", norm_impl="auto")
+    # interpret is servable everywhere (the CI path)
+    init(norm="fused", norm_impl="interpret")

@@ -39,6 +39,15 @@ import tf_operator_tpu
 PKG_ROOT = pathlib.Path(tf_operator_tpu.__file__).parent
 
 #: (file, function names that constitute its step-loop hot path)
+#: ISSUE 19 extends the gate to the fused-BatchNorm train path: the
+#: pallas dispatch wrappers + custom_vjp fwd/bwd + the xla reference
+#: (ops/fused_batchnorm.py) and the ResNet forward/validation
+#: (models/resnet.py) all run inside the compiled train step — a raw
+#: host fetch in any of them would serialize every ResNet step the
+#: fusion exists to speed up.  The pallas kernel BODIES (_fwd_kernel /
+#: _bwd_kernel) are deliberately out of scope: they execute on-device
+#: where a host sync is structurally impossible, and their
+#: ``float(n_rows)`` is a static Python grid int, not an array fetch.
 HOT_FUNCTIONS = {
     "runtime/harness.py": {"train_loop"},
     "parallel/trainer.py": {
@@ -48,6 +57,14 @@ HOT_FUNCTIONS = {
         "_build_step",
         "_build_multi_step",
     },
+    "ops/fused_batchnorm.py": {
+        "_fwd_pallas",
+        "_bwd_pallas",
+        "_fusedbn_fwd",
+        "_fusedbn_bwd",
+        "_fusedbn_xla",
+    },
+    "models/resnet.py": {"__call__", "_resolve_norm"},
 }
 
 #: file -> {class name -> step-loop functions} (serving hot paths are

@@ -31,6 +31,7 @@ from tf_operator_tpu.models.mnist import MnistCNN
 from tf_operator_tpu.models.pipelined_lm import PipelinedLM, lm_reference_apply
 from tf_operator_tpu.models.moe import MoeConfig, MoeLM, moe_lm_loss, moe_tiny
 from tf_operator_tpu.models.resnet import (
+    FusedBatchNorm,
     ResNet,
     fold_batchnorm,
     resnet18,
@@ -64,6 +65,7 @@ __all__ = [
     "MoeLM",
     "moe_lm_loss",
     "moe_tiny",
+    "FusedBatchNorm",
     "ResNet",
     "fold_batchnorm",
     "resnet18",

@@ -12,13 +12,104 @@ no data-dependent control flow.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tf_operator_tpu.ops.fused_batchnorm import (
+    FUSEDBN_IMPLS,
+    fused_batchnorm,
+    fusedbn_available,
+)
+
 ModuleDef = Any
+
+#: ``ResNet.norm_impl`` spellings (``interpret`` is sugar for the
+#: ladder name, same aliasing serve_lm uses for ``--paged-kernel``)
+_NORM_IMPL_ALIASES = {"interpret": "pallas-interpret"}
+
+
+class BatchNorm(nn.Module):
+    """Train-mode BatchNorm with the block epilogue (ReLU / residual
+    add) fused in — the module face of ``ops.fused_batchnorm`` (ISSUE
+    19 tentpole).
+
+    Deliberately named ``BatchNorm``: flax auto-naming derives scopes
+    from the class name, so instances land in the same ``BatchNorm_i``
+    scopes as ``flax.linen.BatchNorm`` — param/stat trees stay
+    isomorphic between ``norm="batchnorm"`` and ``norm="fused"``
+    models (checkpoints interchange, ``fold_batchnorm``'s scope map
+    keeps working, and stock-vs-fused trainer comparisons need no
+    tree surgery).  Same variables, same shapes, same initializers:
+    ``params/{scale,bias}`` and ``batch_stats/{mean,var}`` at
+    ``param_dtype`` / f32.
+
+    Train mode routes through ``fused_batchnorm`` with the module's
+    ``impl`` (already RESOLVED by the caller — "auto" never reaches
+    here, the PR 10 fail-don't-downgrade rule lives in ``ResNet``).
+    Eval mode is the running-stats affine composition regardless of
+    ``impl`` — a documented contract, not a downgrade: with no batch
+    reductions there is no stats pass to fuse, and the real eval-mode
+    answer is ``bn_fold`` (PR 14), which removes the BN entirely.
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    impl: str = "xla"
+    scale_init: Callable = nn.initializers.ones
+    bias_init: Callable = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x, relu: bool = False, residual: Optional[jax.Array] = None):
+        c = x.shape[-1]
+        scale = self.param("scale", self.scale_init, (c,), self.param_dtype)
+        bias = self.param("bias", self.bias_init, (c,), self.param_dtype)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda s: jnp.zeros(s, jnp.float32), (c,)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda s: jnp.ones(s, jnp.float32), (c,)
+        )
+        if self.use_running_average:
+            # eval: nn.BatchNorm's exact _normalize op order on the
+            # running stats, epilogue appended
+            y = x - ra_mean.value
+            mul = jax.lax.rsqrt(ra_var.value + self.epsilon)
+            mul = mul * scale
+            y = y * mul
+            y = y + bias
+            y = y.astype(self.dtype)
+            if residual is not None:
+                y = residual + y
+            if relu:
+                y = nn.relu(y)
+            return y
+        y, mean, var = fused_batchnorm(
+            x,
+            scale,
+            bias,
+            eps=self.epsilon,
+            relu=relu,
+            residual=residual,
+            impl=self.impl,
+        )
+        if not self.is_initializing():
+            # flax's exact running-stats update; mean/var are the
+            # primitive's bookkeeping outputs (cotangent-free by the
+            # VJP contract — batch_stats is mutable state, jax.grad
+            # never sees this)
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1 - m) * mean
+            ra_var.value = m * ra_var.value + (1 - m) * var
+        return y
+
+
+FusedBatchNorm = BatchNorm
 
 
 def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
@@ -76,6 +167,14 @@ class _SpaceToDepthStem(nn.Module):
 
 
 class BottleneckBlock(nn.Module):
+    """Blocks hand the whole epilogue to the norm factory: every norm
+    call site passes ``relu=`` / ``residual=`` so ``norm="fused"`` can
+    run BN+ReLU(+add) as ONE kernel while ``norm="batchnorm"`` expands
+    to the identical stock op sequence (``bn → [+residual] → relu``).
+    The projection branch is computed BEFORE the last norm call (the
+    epilogue consumes it); flax param rngs are path-keyed, so the
+    creation-order shift changes no initial values or scope names."""
+
     filters: int
     conv: ModuleDef
     norm: ModuleDef
@@ -85,21 +184,20 @@ class BottleneckBlock(nn.Module):
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (1, 1))(x)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        y = self.norm()(y, relu=True)
         y = self.conv(self.filters, (3, 3), self.strides)(y)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        y = self.norm()(y, relu=True)
         y = self.conv(self.filters * 4, (1, 1))(y)
-        # zero-init the last norm's scale: residual branch starts as
-        # identity, the standard trick for large-batch ResNet training
-        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
             residual = self.conv(self.filters * 4, (1, 1), self.strides, name="conv_proj")(
                 residual
             )
             residual = self.norm(name="norm_proj")(residual)
-        return nn.relu(residual + y)
+        # zero-init the last norm's scale: residual branch starts as
+        # identity, the standard trick for large-batch ResNet training
+        return self.norm(scale_init=nn.initializers.zeros_init())(
+            y, relu=True, residual=residual
+        )
 
 
 class BasicBlock(nn.Module):
@@ -112,14 +210,14 @@ class BasicBlock(nn.Module):
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (3, 3), self.strides)(x)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        y = self.norm()(y, relu=True)
         y = self.conv(self.filters, (3, 3))(y)
-        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
             residual = self.conv(self.filters, (1, 1), self.strides, name="conv_proj")(residual)
             residual = self.norm(name="norm_proj")(residual)
-        return nn.relu(residual + y)
+        return self.norm(scale_init=nn.initializers.zeros_init())(
+            y, relu=True, residual=residual
+        )
 
 
 class _Identity(nn.Module):
@@ -151,9 +249,82 @@ class ResNet(nn.Module):
     #: from the graph.  Inference-only by construction: training needs
     #: live batch statistics, so train=True refuses.
     bn_fold: bool = False
+    #: train-mode norm flavor (ISSUE 19 tentpole): ``"batchnorm"`` is
+    #: the stock ``nn.BatchNorm`` + separate ReLU/add graph;
+    #: ``"fused"`` routes every BN call site (+its ReLU/residual
+    #: epilogue) through ``ops.fused_batchnorm`` — one two-sweep kernel
+    #: per layer instead of the reduce/convert/elementwise chains the
+    #: FLOPS.md trace table shows carrying ~83% of the train step
+    norm: str = "batchnorm"
+    #: fused-norm impl: ``auto`` resolves to the pallas kernel on a
+    #: single-device TPU backend and the (bit-comparable) xla
+    #: composition elsewhere; explicit ``xla`` | ``pallas`` |
+    #: ``interpret``/``pallas-interpret`` are honored or REFUSED with a
+    #: config-class ValueError — never silently downgraded (PR 10 rule,
+    #: pinned like batching's ``paged_kernel`` validation order)
+    norm_impl: str = "auto"
+
+    def _resolve_norm(self) -> "str | None":
+        """Validate ``norm``/``norm_impl`` and resolve the fused impl.
+
+        Validation order is the ``paged_kernel`` contract (ISSUE 10
+        honesty, pinned in tests/test_fused_batchnorm.py): a bad NAME
+        fails as a bad name even when the config is also unservable —
+        (1) norm flavor, (2) impl spelling, (3) semantic conflicts,
+        (4) kernel availability.  Returns the resolved impl for
+        ``norm="fused"``, else None."""
+
+        kind = str(self.norm or "batchnorm").lower()
+        if kind not in ("batchnorm", "fused"):
+            raise ValueError(
+                f"norm must be 'batchnorm'|'fused', got {self.norm!r}"
+            )
+        req = str(self.norm_impl or "auto").lower()
+        req = _NORM_IMPL_ALIASES.get(req, req)
+        if req not in ("auto",) + FUSEDBN_IMPLS:
+            raise ValueError(
+                "norm_impl must be auto|xla|pallas|interpret"
+                f"|pallas-interpret, got {self.norm_impl!r}"
+            )
+        if kind == "batchnorm":
+            if req != "auto":
+                raise ValueError(
+                    f"norm_impl={self.norm_impl!r} applies to "
+                    "norm='fused' only — an ignored impl request is a "
+                    "silent downgrade"
+                )
+            return None
+        if self.bn_fold:
+            raise ValueError(
+                "norm='fused' conflicts with bn_fold=True — the fold "
+                "removes every BatchNorm from the eval graph; the fused "
+                "kernel is the TRAIN-side story"
+            )
+        if req == "auto":
+            ok, _why = fusedbn_available()
+            return "pallas" if ok and jax.device_count() == 1 else "xla"
+        if req != "xla":
+            ok, why = fusedbn_available(interpret=req == "pallas-interpret")
+            if not ok:
+                raise ValueError(
+                    f"norm='fused' norm_impl={self.norm_impl!r} refused: "
+                    f"{why} — failing loudly instead of silently "
+                    "downgrading to the xla composition"
+                )
+            if req == "pallas" and jax.device_count() > 1:
+                raise ValueError(
+                    "norm='fused' norm_impl='pallas' refused: the kernel "
+                    f"reduces per shard, but {jax.device_count()} devices "
+                    "are visible and train-mode BatchNorm must see batch-"
+                    "GLOBAL statistics under pjit — use norm_impl='xla' "
+                    "(XLA inserts the cross-device reduction) on "
+                    "multi-device meshes"
+                )
+        return req
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        fused_impl = self._resolve_norm()
         if self.bn_fold:
             if train:
                 raise ValueError(
@@ -163,28 +334,68 @@ class ResNet(nn.Module):
             if self.stem == "space_to_depth":
                 raise ValueError("bn_fold supports the conv7 stem only")
             # biased convs carry the folded affine; norms become no-ops
+            # (the epilogue — residual add + relu — is block semantics,
+            # not BN, and stays)
             conv = partial(nn.Conv, use_bias=True, dtype=self.dtype)
 
             def norm(name=None, **_kw):
-                return _Identity(name=name)
+                def apply(y, relu=False, residual=None):
+                    y = _Identity(name=name)(y)
+                    if residual is not None:
+                        y = residual + y
+                    if relu:
+                        y = nn.relu(y)
+                    return y
+
+                return apply
+
+        elif fused_impl is not None:
+            conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+
+            def norm(name=None, **kw):
+                def apply(y, relu=False, residual=None):
+                    return BatchNorm(
+                        use_running_average=not train,
+                        momentum=0.9,
+                        epsilon=1e-5,
+                        dtype=self.dtype,
+                        param_dtype=self.bn_param_dtype,
+                        impl=fused_impl,
+                        name=name,
+                        **kw,
+                    )(y, relu=relu, residual=residual)
+
+                return apply
 
         else:
             conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-            norm = partial(
-                nn.BatchNorm,
-                use_running_average=not train,
-                momentum=0.9,
-                epsilon=1e-5,
-                dtype=self.dtype,
-                param_dtype=self.bn_param_dtype,
-            )
+
+            def norm(name=None, **kw):
+                def apply(y, relu=False, residual=None):
+                    # the stock graph, op for op: bn → +residual → relu
+                    y = nn.BatchNorm(
+                        use_running_average=not train,
+                        momentum=0.9,
+                        epsilon=1e-5,
+                        dtype=self.dtype,
+                        param_dtype=self.bn_param_dtype,
+                        name=name,
+                        **kw,
+                    )(y)
+                    if residual is not None:
+                        y = residual + y
+                    if relu:
+                        y = nn.relu(y)
+                    return y
+
+                return apply
+
         x = x.astype(self.dtype)
         if self.stem == "space_to_depth":
             x = _SpaceToDepthStem(self.width, dtype=self.dtype, name="conv_init")(x)
         else:
             x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
-        x = norm(name="bn_init")(x)
-        x = nn.relu(x)
+        x = norm(name="bn_init")(x, relu=True)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):

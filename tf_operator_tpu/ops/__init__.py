@@ -9,6 +9,7 @@ the XLA-fused reference both fall back to.
 
 from tf_operator_tpu.ops.attention import dot_product_attention
 from tf_operator_tpu.ops.flash_attention import attention, flash_attention
+from tf_operator_tpu.ops.fused_batchnorm import fused_batchnorm, fusedbn_available
 from tf_operator_tpu.ops.paged_attention import paged_attention
 from tf_operator_tpu.ops.quant import materialize_tree, quantize_tree
 from tf_operator_tpu.ops.ring_attention import ring_attention
@@ -18,6 +19,8 @@ __all__ = [
     "attention",
     "dot_product_attention",
     "flash_attention",
+    "fused_batchnorm",
+    "fusedbn_available",
     "materialize_tree",
     "paged_attention",
     "quantize_tree",

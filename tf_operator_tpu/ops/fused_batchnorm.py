@@ -1,0 +1,483 @@
+"""Fused train-mode BatchNorm(+ReLU, +residual epilogue) — ISSUE 19
+tentpole.
+
+FLOPS.md's committed trace table indicts the ResNet TRAIN step: BN-stat
+reductions (18.9%) + elementwise fusions (55.8%) + dtype converts
+(8.7%) carry the wall while convolution is 5.7% — the documented
+~0.32-MFU ceiling.  Per BatchNorm layer the stock graph emits a
+reduce pass for the moments, a second elementwise pass for
+normalize/affine/ReLU, bf16↔f32 converts on both, and the backward
+adds two MORE reductions (Σg, Σg·x̂) plus the dx chain.  Eval-mode
+BN-fold (PR 14) cannot touch any of this: training needs live batch
+statistics.
+
+This module is the training-side answer: one primitive that computes
+the whole BN(+ReLU, +residual-add) epilogue — statistics, normalize,
+affine, activation, and every dtype convert — as a two-sweep Pallas
+pass over VMEM-resident tiles, with a hand-written VJP whose backward
+fuses BN-grad's two reductions with dγ/dβ and the elementwise dx
+chain (ReLU mask and residual-branch dy split included) into a single
+kernel.
+
+Layout contract (the kernel view):
+
+- input is any ``[..., C]`` array; statistics reduce over every axis
+  but the last (NHWC feature norm).  Internally the kernel sees the
+  collapsed ``[R, C]`` view (R = prod(leading)), zero-padded up to
+  tile multiples — zero rows add nothing to Σx/Σx² while the TRUE row
+  count divides the moments, so padding never skews statistics, and
+  padded outputs are sliced off;
+- forward grid ``(C_tiles, 2, R_tiles)``: for each channel tile,
+  sweep 0 accumulates Σx/Σx² into f32 VMEM scratch (x read as bf16
+  tiles, converted in-register — the convert never exists in HBM),
+  sweep 1 turns the moments into mean/rstd once and streams
+  normalize → affine → (+residual) → ReLU → store, all in f32
+  registers with ONE final cast to the activation dtype;
+- backward grid is the same shape: sweep 0 re-derives the ReLU mask
+  from the saved output (``y > 0`` — ``jax.nn.relu``'s subgradient
+  convention), accumulates Σg and Σg·x̂ (which ARE dβ/dγ), sweep 1
+  streams the dx chain ``(γ·rstd)·(g − Σg/R − x̂·Σg·x̂/R)`` and the
+  residual-branch cotangent (= g) in one pass.
+
+VJP contract: the primitive returns ``(y, mean, var)``.  ``mean`` /
+``var`` are bookkeeping outputs for the running-statistics update
+(flax semantics) — their cotangents are dropped by the backward rule,
+so they must never appear in a differentiated objective.  The module
+wrapper in models/resnet.py uses them only inside the mutable
+``batch_stats`` update, which jax.grad never sees.
+
+Impls (the ``impl`` arg — callers resolve "auto" THEMSELVES so an
+explicit request can FAIL instead of silently downgrading, the PR 10
+rule):
+
+- ``"xla"``              reference composition mirroring
+                         ``flax.linen.BatchNorm``'s exact op order
+                         (f32 fast-variance stats, f32 normalize, one
+                         final cast) + ``jax.nn.relu`` — bit-
+                         comparable to the stock graph, differentiated
+                         by autodiff, the CPU/fallback path;
+- ``"pallas"``           the TPU kernel (custom_vjp, both directions
+                         fused);
+- ``"pallas-interpret"`` the same kernel through the interpreter —
+                         how CI (JAX_PLATFORMS=cpu) exercises the real
+                         kernel path end to end.
+
+Sharding caveat (documented, checked by the resnet wrapper): the
+kernel reduces over the rows IT SEES.  Under multi-device pjit the
+stock composition computes batch-GLOBAL statistics via XLA collectives;
+the Pallas kernel cannot, so ``models/resnet.py`` refuses
+``impl="pallas"`` when more than one device is visible instead of
+silently switching to per-shard statistics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+FUSEDBN_IMPLS = ("xla", "pallas", "pallas-interpret")
+
+#: lane width — channel tiles are full lanes
+_LANES = 128
+#: row-tile ceiling; shrunk (at sublane granularity) for small inputs
+_BLOCK_R = 256
+#: sublane granularity — bf16 tiles pack (16, 128)
+_SUBLANES = 16
+
+
+def fusedbn_available(*, interpret: bool = False) -> Tuple[bool, str]:
+    """(ok, why_not) — can the Pallas fused-BN kernel run HERE?
+
+    The honesty contract (ISSUE 10/19): ``norm_impl="pallas"`` callers
+    must FAIL on (False, why) rather than silently run the xla
+    composition.  ``interpret=True`` waives the backend requirement
+    (the interpreter runs the real kernel anywhere — the CI path)."""
+
+    if not interpret and jax.default_backend() != "tpu":
+        return (
+            False,
+            "the fused-BatchNorm kernel needs the TPU backend (got "
+            f"{jax.default_backend()!r}); the xla composition serves "
+            "CPU, or pass impl='pallas-interpret' for kernel-path tests",
+        )
+    return True, ""
+
+
+class _Cfg(NamedTuple):
+    """Static kernel config — hashable, rides custom_vjp's
+    nondiff_argnums."""
+
+    eps: float
+    relu: bool
+    has_residual: bool
+    interpret: bool
+    #: residual dtype NAME (str keeps the tuple hashable; None = no
+    #: residual input)
+    res_dtype: Optional[str]
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _compiler_params(interpret: bool):
+    if interpret:
+        return None
+    # channel tiles are independent; the two-sweep + row dims carry the
+    # scratch accumulators and must stay sequential
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary", "arbitrary")
+    )
+
+
+def _tiles(r: int, c: int) -> Tuple[int, int, int, int]:
+    """(block_r, block_c, r_padded, c_padded) for an [r, c] view."""
+
+    block_r = min(_BLOCK_R, _round_up(max(r, 1), _SUBLANES))
+    return block_r, _LANES, _round_up(r, block_r), _round_up(c, _LANES)
+
+
+def _pad2d(a: jax.Array, rp: int, cp: int, value: float = 0.0) -> jax.Array:
+    r, c = a.shape
+    if (r, c) == (rp, cp):
+        return a
+    return jnp.pad(a, ((0, rp - r), (0, cp - c)), constant_values=value)
+
+
+def _pad_param(v: jax.Array, cp: int, value: float) -> jax.Array:
+    """[C] f32 param -> [1, cp] (padding value keeps padded channels
+    inert: gamma pads with 1 so rstd·γ stays finite, beta with 0)."""
+
+    c = v.shape[0]
+    if c != cp:
+        v = jnp.pad(v, (0, cp - c), constant_values=value)
+    return v.reshape(1, cp)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+
+
+def _fwd_kernel(cfg: _Cfg, n_rows: int, *refs):
+    if cfg.has_residual:
+        (x_ref, res_ref, gamma_ref, beta_ref,
+         y_ref, mean_ref, var_ref,
+         s_sum, s_sq, s_mu, s_rs) = refs
+    else:
+        res_ref = None
+        (x_ref, gamma_ref, beta_ref,
+         y_ref, mean_ref, var_ref,
+         s_sum, s_sq, s_mu, s_rs) = refs
+
+    p = pl.program_id(1)
+    r = pl.program_id(2)
+
+    @pl.when((p == 0) & (r == 0))
+    def _init():
+        s_sum[...] = jnp.zeros_like(s_sum)
+        s_sq[...] = jnp.zeros_like(s_sq)
+
+    @pl.when(p == 0)
+    def _accumulate():
+        xf = x_ref[...].astype(jnp.float32)
+        s_sum[...] += jnp.sum(xf, axis=0, keepdims=True)
+        s_sq[...] += jnp.sum(xf * xf, axis=0, keepdims=True)
+
+    @pl.when((p == 1) & (r == 0))
+    def _finalize():
+        inv_n = 1.0 / float(n_rows)  # TRUE row count — padded rows are
+        mu = s_sum[...] * inv_n      # zeros, so Σ is already exact
+        var = jnp.maximum(s_sq[...] * inv_n - mu * mu, 0.0)
+        s_mu[...] = mu
+        s_rs[...] = jax.lax.rsqrt(var + cfg.eps)
+        mean_ref[...] = mu
+        var_ref[...] = var
+
+    @pl.when(p == 1)
+    def _normalize():
+        xf = x_ref[...].astype(jnp.float32)
+        mul = s_rs[...] * gamma_ref[...]
+        y = (xf - s_mu[...]) * mul + beta_ref[...]
+        if cfg.has_residual:
+            y = y + res_ref[...].astype(jnp.float32)
+        if cfg.relu:
+            y = jnp.maximum(y, 0.0)
+        y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _fwd_pallas(cfg: _Cfg, x2d, gamma32, beta32, residual2d):
+    r, c = x2d.shape
+    block_r, block_c, rp, cp = _tiles(r, c)
+    grid = (cp // block_c, 2, rp // block_r)
+
+    tile = pl.BlockSpec((block_r, block_c), lambda ci, p, ri: (ri, ci))
+    chan = pl.BlockSpec((1, block_c), lambda ci, p, ri: (0, ci))
+
+    inputs = [_pad2d(x2d, rp, cp)]
+    in_specs = [tile]
+    if cfg.has_residual:
+        inputs.append(_pad2d(residual2d, rp, cp))
+        in_specs.append(tile)
+    inputs += [_pad_param(gamma32, cp, 1.0), _pad_param(beta32, cp, 0.0)]
+    in_specs += [chan, chan]
+
+    y, mean, var = pl.pallas_call(
+        functools.partial(_fwd_kernel, cfg, r),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[tile, chan, chan],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, cp), x2d.dtype),
+            jax.ShapeDtypeStruct((1, cp), jnp.float32),
+            jax.ShapeDtypeStruct((1, cp), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, block_c), jnp.float32)] * 4,
+        compiler_params=_compiler_params(cfg.interpret),
+        interpret=cfg.interpret,
+    )(*inputs)
+    return y[:r, :c], mean[0, :c], var[0, :c]
+
+
+# ---------------------------------------------------------------------------
+# backward kernel
+
+
+def _bwd_kernel(cfg: _Cfg, n_rows: int, *refs):
+    i = 0
+
+    def nxt():
+        nonlocal i
+        ref = refs[i]
+        i += 1
+        return ref
+
+    dy_ref, x_ref = nxt(), nxt()
+    y_ref = nxt() if cfg.relu else None
+    gamma_ref, mean_ref, rstd_ref = nxt(), nxt(), nxt()
+    dx_ref = nxt()
+    dres_ref = nxt() if cfg.has_residual else None
+    dgamma_ref, dbeta_ref = nxt(), nxt()
+    s_sg, s_sgx, s_c1, s_c2 = nxt(), nxt(), nxt(), nxt()
+
+    p = pl.program_id(1)
+    r = pl.program_id(2)
+
+    def masked_g():
+        g = dy_ref[...].astype(jnp.float32)
+        if cfg.relu:
+            # jax.nn.relu's subgradient convention: 0 at the kink
+            g = jnp.where(y_ref[...] > 0, g, 0.0)
+        return g
+
+    @pl.when((p == 0) & (r == 0))
+    def _init():
+        s_sg[...] = jnp.zeros_like(s_sg)
+        s_sgx[...] = jnp.zeros_like(s_sgx)
+
+    @pl.when(p == 0)
+    def _reduce():
+        g = masked_g()
+        xhat = (x_ref[...].astype(jnp.float32) - mean_ref[...]) * rstd_ref[...]
+        s_sg[...] += jnp.sum(g, axis=0, keepdims=True)
+        s_sgx[...] += jnp.sum(g * xhat, axis=0, keepdims=True)
+
+    @pl.when((p == 1) & (r == 0))
+    def _finalize():
+        # the two reductions ARE the param grads — no extra pass
+        dbeta_ref[...] = s_sg[...]
+        dgamma_ref[...] = s_sgx[...]
+        inv_n = 1.0 / float(n_rows)
+        s_c1[...] = s_sg[...] * inv_n
+        s_c2[...] = s_sgx[...] * inv_n
+
+    @pl.when(p == 1)
+    def _dx():
+        g = masked_g()
+        xhat = (x_ref[...].astype(jnp.float32) - mean_ref[...]) * rstd_ref[...]
+        k = gamma_ref[...] * rstd_ref[...]
+        dx = k * (g - s_c1[...] - xhat * s_c2[...])
+        dx_ref[...] = dx.astype(dx_ref.dtype)
+        if cfg.has_residual:
+            # the residual branch sees dy post-ReLU-mask, pre-BN-chain
+            dres_ref[...] = g.astype(dres_ref.dtype)
+
+
+def _bwd_pallas(cfg: _Cfg, x2d, gamma32, y2d, mean, var, dy2d):
+    r, c = x2d.shape
+    block_r, block_c, rp, cp = _tiles(r, c)
+    grid = (cp // block_c, 2, rp // block_r)
+
+    tile = pl.BlockSpec((block_r, block_c), lambda ci, p, ri: (ri, ci))
+    chan = pl.BlockSpec((1, block_c), lambda ci, p, ri: (0, ci))
+
+    # identical to the forward's finalize: rstd = rsqrt(var+eps) on the
+    # same f32 var, so x̂ in the backward is bitwise the forward's
+    rstd = jax.lax.rsqrt(var + cfg.eps)
+
+    inputs = [_pad2d(dy2d, rp, cp), _pad2d(x2d, rp, cp)]
+    in_specs = [tile, tile]
+    if cfg.relu:
+        inputs.append(_pad2d(y2d, rp, cp))
+        in_specs.append(tile)
+    inputs += [
+        _pad_param(gamma32, cp, 1.0),
+        _pad_param(mean, cp, 0.0),
+        _pad_param(rstd, cp, 1.0),
+    ]
+    in_specs += [chan, chan, chan]
+
+    out_specs = [tile]
+    out_shape = [jax.ShapeDtypeStruct((rp, cp), x2d.dtype)]
+    if cfg.has_residual:
+        out_specs.append(tile)
+        out_shape.append(jax.ShapeDtypeStruct((rp, cp), jnp.dtype(cfg.res_dtype)))
+    out_specs += [chan, chan]
+    out_shape += [jax.ShapeDtypeStruct((1, cp), jnp.float32)] * 2
+
+    outs = pl.pallas_call(
+        functools.partial(_bwd_kernel, cfg, r),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((1, block_c), jnp.float32)] * 4,
+        compiler_params=_compiler_params(cfg.interpret),
+        interpret=cfg.interpret,
+    )(*inputs)
+    if cfg.has_residual:
+        dx, dres, dgamma, dbeta = outs
+        dres = dres[:r, :c]
+    else:
+        dx, dgamma, dbeta = outs
+        dres = None
+    return dx[:r, :c], dgamma[0, :c], dbeta[0, :c], dres
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _fusedbn_kernel(cfg: _Cfg, x2d, gamma32, beta32, residual2d):
+    return _fwd_pallas(cfg, x2d, gamma32, beta32, residual2d)
+
+
+def _fusedbn_fwd(cfg: _Cfg, x2d, gamma32, beta32, residual2d):
+    y, mean, var = _fwd_pallas(cfg, x2d, gamma32, beta32, residual2d)
+    return (y, mean, var), (x2d, gamma32, y, mean, var)
+
+
+def _fusedbn_bwd(cfg: _Cfg, saved, cots):
+    # mean/var are bookkeeping outputs (running-stats update); their
+    # cotangents are dropped by contract — see module docstring
+    dy, _dmean, _dvar = cots
+    x2d, gamma32, y2d, mean, var = saved
+    dx, dgamma, dbeta, dres = _bwd_pallas(cfg, x2d, gamma32, y2d, mean, var, dy)
+    return dx, dgamma, dbeta, (dres if cfg.has_residual else None)
+
+
+_fusedbn_kernel.defvjp(_fusedbn_fwd, _fusedbn_bwd)
+
+
+# ---------------------------------------------------------------------------
+# reference composition (impl="xla")
+
+
+def _fusedbn_xla(x, gamma, beta, eps, relu, residual):
+    """flax.linen.BatchNorm's exact train-mode op order (f32 fast-
+    variance stats, f32 normalize, single trailing cast) + the stock
+    block epilogue — bit-comparable to ``nn.BatchNorm`` + ``nn.relu``;
+    differentiated by autodiff."""
+
+    red = tuple(range(x.ndim - 1))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=red)
+    mean2 = jnp.mean(xf * xf, axis=red)
+    var = jnp.maximum(mean2 - mean * mean, 0.0)
+    y = x - mean
+    mul = jax.lax.rsqrt(var + eps)
+    mul = mul * gamma
+    y = y * mul
+    y = y + beta
+    # flax casts to the module dtype here; the functional contract is
+    # "activation dtype in, activation dtype out"
+    y = y.astype(x.dtype)
+    if residual is not None:
+        y = residual + y
+    if relu:
+        y = jax.nn.relu(y)
+    return y, mean, var
+
+
+# ---------------------------------------------------------------------------
+# public entry point
+
+
+def fused_batchnorm(
+    x: jax.Array,
+    gamma: jax.Array,
+    beta: jax.Array,
+    *,
+    eps: float = 1e-5,
+    relu: bool = False,
+    residual: Optional[jax.Array] = None,
+    impl: str = "xla",
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Train-mode BatchNorm over the last axis, with the block epilogue
+    fused in: ``y = [relu]( [residual +] (x − μ)·rsqrt(σ²+eps)·γ + β )``.
+
+    Returns ``(y, mean, var)`` — ``y`` in ``x.dtype``; ``mean``/``var``
+    are the f32 batch moments for the caller's running-stats update
+    and must stay OUT of differentiated objectives (their cotangents
+    are dropped; see module docstring).
+
+    ``impl`` is resolved by the CALLER (models/resnet.py maps "auto");
+    an explicit "pallas"/"pallas-interpret" raises ValueError when the
+    kernel cannot serve, never downgrades.
+    """
+
+    if impl not in FUSEDBN_IMPLS:
+        raise ValueError(
+            f"impl must be one of {FUSEDBN_IMPLS}, got {impl!r}"
+        )
+    if x.ndim < 2:
+        raise ValueError(f"fused_batchnorm needs [..., C] input, got {x.shape}")
+    c = x.shape[-1]
+    if gamma.shape != (c,) or beta.shape != (c,):
+        raise ValueError(
+            f"gamma/beta must be [{c}] to match x {x.shape}, got "
+            f"{gamma.shape}/{beta.shape}"
+        )
+    if residual is not None and residual.shape != x.shape:
+        raise ValueError(
+            f"residual shape {residual.shape} != x shape {x.shape}"
+        )
+
+    if impl == "xla":
+        return _fusedbn_xla(x, gamma, beta, eps, relu, residual)
+
+    interpret = impl == "pallas-interpret"
+    ok, why = fusedbn_available(interpret=interpret)
+    if not ok:
+        raise ValueError(f"fused_batchnorm impl={impl!r} refused: {why}")
+
+    cfg = _Cfg(
+        eps=float(eps),
+        relu=bool(relu),
+        has_residual=residual is not None,
+        interpret=interpret,
+        res_dtype=None if residual is None else jnp.dtype(residual.dtype).name,
+    )
+    x2d = x.reshape(-1, c)
+    res2d = residual.reshape(-1, c) if residual is not None else None
+    # params go through the kernel in f32 (stats dtype); the cast is
+    # outside custom_vjp so autodiff transposes it back to param dtype
+    y2d, mean, var = _fusedbn_kernel(
+        cfg, x2d, gamma.astype(jnp.float32), beta.astype(jnp.float32), res2d
+    )
+    return y2d.reshape(x.shape), mean, var
