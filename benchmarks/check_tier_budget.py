@@ -24,6 +24,20 @@ import sys
 #: for a 42-test sample, so the full suite has headroom to stay under)
 SLOW_TIER_BUDGET_S = 1800.0
 
+#: device cost plane (ISSUE 20): the tier-1 session's process compile
+#: count, recorded by tests/conftest.py from
+#: utils/costplane.process_compile_count().  The baseline is pinned
+#: from the committed SUITE_RECORD.json of the round that introduced
+#: the ledger; a run exceeding baseline * (1 + slack) means width-class
+#: fragmentation (or a new unclassed hot path) crept in — red, don't
+#: drift.  Re-pin deliberately when a round legitimately adds programs.
+#: (Pinned from the ISSUE 20 introduction round: 88 registrations over
+#: the full tier-1 set — wrap() counts per-instance first calls and
+#: note() counts classes, so the number is deterministic per test set,
+#: independent of XLA cache warmth.)
+TIER1_COMPILE_BASELINE = 88
+TIER1_COMPILE_SLACK = 0.25
+
 RECORD_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "SUITE_RECORD.json"
 )
@@ -58,6 +72,30 @@ def check(record: dict, budget_s: float = SLOW_TIER_BUDGET_S):
                 f"{t} exited {record[t]['exitstatus']}" for t in red
             )
             + " — fix the failures and re-run the tier before gating"
+        )
+    # compile-count regression gate (ISSUE 20): the tier-1 record
+    # carries the session's CompileLedger total; >25% over the pinned
+    # baseline reds the round.  Records predating the ledger (no
+    # `compiles` key) skip the gate rather than invent a number.
+    tier1 = record.get("tier1")
+    compiles = (tier1 or {}).get("compiles")
+    if compiles is not None:
+        ceiling = TIER1_COMPILE_BASELINE * (1.0 + TIER1_COMPILE_SLACK)
+        if float(compiles) > ceiling:
+            return False, (
+                summary
+                + f"\nTIER1 COMPILE REGRESSION: {int(compiles)} compiles"
+                f" > {ceiling:.0f} (baseline {TIER1_COMPILE_BASELINE}"
+                f" +{TIER1_COMPILE_SLACK:.0%}) — a hot path is"
+                " fragmenting into new width/K classes; read GET"
+                " /debug/compiles (or the costplane ledger in the"
+                " failing test) for the trigger attribution, fix the"
+                " classing, or re-pin TIER1_COMPILE_BASELINE with a"
+                " justification here"
+            )
+        summary += (
+            f"\ntier1 compiles: {int(compiles)} <= {ceiling:.0f}"
+            f" (baseline {TIER1_COMPILE_BASELINE})"
         )
     slow = record.get("slow")
     if slow is None:

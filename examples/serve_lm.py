@@ -276,6 +276,18 @@ def build_handler(
     alert_engine = AlertEngine(
         default_rules(), metrics=metrics, recorder=recorder
     )
+    #: device cost plane (ISSUE 20): ONE CompileLedger + HBM accountant
+    #: + step-time sentinel shared by every pool replica in the process,
+    #: on THIS registry — compile_total/hbm_*/step_time_* land in
+    #: /metrics where the compile-storm and step-time-regression rules
+    #: (started by main()) bind, and GET /debug/compiles +
+    #: /debug/memory serve the ledgers below
+    from tf_operator_tpu.utils.costplane import CostPlane, default_costplane
+
+    costplane = CostPlane(metrics=metrics)
+    # the weights are device bytes regardless of serving mode; pools
+    # add their KV arenas (and swap staging) as they construct
+    costplane.hbm.register_tree("weights", params)
 
     def observe_slo(mode: str, queue_wait: float, ttft: float,
                     tpot: float, exemplar: "str | None" = None) -> None:
@@ -388,6 +400,7 @@ def build_handler(
                     paged_kernel=paged_kernel,
                     swap_blocks=kv_swap_blocks,
                     role=role_list[i], fabric=fabric,
+                    costplane=costplane,
                     **spec_pool_kw,
                 )
                 if i == 0:
@@ -422,7 +435,7 @@ def build_handler(
                 p = ContinuousBatchingDecoder(
                     model, params, slots=batching_slots, ledger=ledger,
                     metrics=metrics, model_label=model_label,
-                    replica_label=rep,
+                    replica_label=rep, costplane=costplane,
                 )
             pool_replicas.append(p)
         pool = (
@@ -624,6 +637,32 @@ def build_handler(
                     "model": model_label,
                     "fabric": pool_fabric.snapshot(),
                 })
+            if self.path == "/debug/compiles":
+                # the compile ledger (ISSUE 20): every jit/pallas entry
+                # point in the serving hot paths registers its compiles
+                # with program, trigger class, wall and owning trace —
+                # the "why is the fleet recompiling" read behind the
+                # compile-storm rule.  The chunked decoder registers on
+                # the process-default ledger (it has no registry of its
+                # own) — serve that one when no pool is running.
+                src = (
+                    costplane.compiles if pool is not None
+                    else default_costplane.compiles
+                )
+                return self._reply(200, {
+                    "model": model_label,
+                    **src.snapshot(),
+                })
+            if self.path == "/debug/memory":
+                # the HBM accountant (ISSUE 20): per-device bytes by
+                # component (weights / kv_arena / swap staging /
+                # program temp peak), headroom-worst-first, with the
+                # accounted-vs-live coverage ratio so a leak shows as
+                # falling coverage, not silence
+                return self._reply(200, {
+                    "model": model_label,
+                    **costplane.hbm.snapshot(),
+                })
             if self.path == "/debug/profile" or \
                     self.path.startswith("/debug/profile?"):
                 # exact-or-query match only: a typo'd /debug/profileX
@@ -714,6 +753,22 @@ def build_handler(
                 return self._reply(
                     400, {"error": "seconds must be in (0, 30]"}
                 )
+            if pool is not None:
+                # an idle decode loop produces an empty trace after a
+                # full `seconds` of wall — refuse up front instead of
+                # making the operator wait for a useless artifact
+                # (ISSUE 20 satellite).  Host-side queue/seat counts
+                # only; no device fetch.
+                load = sum(
+                    sum(p.load_components().values())
+                    for p in pool_replicas
+                )
+                if load == 0:
+                    return self._reply(503, {
+                        "error": "decode loop idle: no active seats or "
+                                 "queued requests to profile — send "
+                                 "traffic first, then re-request",
+                    })
             if not profile_lock.acquire(blocking=False):
                 return self._reply(
                     409, {"error": "a profile is already running "
@@ -725,8 +780,17 @@ def build_handler(
                 base = os.environ.get("TPUJOB_PROFILE_DIR")
                 if base:
                     os.makedirs(base, exist_ok=True)
+                # the artifact name carries the compile-ledger count at
+                # capture: two profiles of the same job disambiguate
+                # "before/after the recompile storm" from the filename
+                cost_compiles = (
+                    costplane.compiles if pool is not None
+                    else default_costplane.compiles
+                )
+                compiles0 = cost_compiles.total()
                 out_dir = tempfile.mkdtemp(
-                    prefix="serve-profile-", dir=base or None
+                    prefix=f"serve-profile-c{compiles0}-",
+                    dir=base or None,
                 )
                 t0 = _time.perf_counter()
                 jax.profiler.start_trace(out_dir)
@@ -734,10 +798,30 @@ def build_handler(
                     _time.sleep(seconds)
                 finally:
                     jax.profiler.stop_trace()
+                # cost-plane autopsy rides the artifact (COSTPLANE.json
+                # next to the trace) AND the response: what compiled
+                # during the window and what the step-time sentinel saw
+                context = {
+                    "compiles_at_start": compiles0,
+                    "compiles_during_window":
+                        cost_compiles.total() - compiles0,
+                    "compile_programs": cost_compiles.snapshot(
+                        limit=8
+                    )["byProgram"],
+                    "step_time": costplane.sentinel.snapshot(),
+                }
+                try:
+                    with open(
+                        os.path.join(out_dir, "COSTPLANE.json"), "w"
+                    ) as f:
+                        json.dump(context, f, indent=2, sort_keys=True)
+                except OSError:
+                    pass  # the trace is the artifact; context is extra
                 return self._reply(200, {
                     "artifact": out_dir,
                     "seconds": seconds,
                     "wall_seconds": round(_time.perf_counter() - t0, 3),
+                    "costplane": context,
                 })
             except Exception as exc:  # profiler quirks must not 500 loop
                 return self._reply(500, {"error": repr(exc)})
@@ -929,6 +1013,9 @@ def build_handler(
     #: the serving pool (None in chunked mode) — tests assert the
     #: speculative config actually landed on it (ISSUE 18)
     Handler.pool = pool
+    #: the process cost plane this handler's /debug/compiles +
+    #: /debug/memory serve (ISSUE 20) — tests read the ledgers directly
+    Handler.costplane = costplane
     return Handler
 
 

@@ -201,6 +201,16 @@ def pytest_sessionfinish(session, exitstatus):
         # not overwrite the full-tier record — a 2s partial would mask
         # a budget violation the gate exists to catch
         return
+    # the device cost plane's process compile counter (ISSUE 20):
+    # every CompileLedger registration this session lands here, and
+    # check_tier_budget.py reddens on a >25% regression against the
+    # committed baseline — width-class fragmentation can't creep in
+    try:
+        from tf_operator_tpu.utils.costplane import process_compile_count
+
+        _suite_extras.setdefault("compiles", process_compile_count())
+    except Exception:
+        pass
     record[tier] = {
         "wall_s": round(time.time() - _session_t0, 1),
         "exitstatus": int(exitstatus),

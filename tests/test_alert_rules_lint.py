@@ -394,6 +394,83 @@ def test_gang_queue_stall_rule_binds_the_queue_stamp():
     assert rule.metric in collect_emitted_families()
 
 
+#: ISSUE 20: the device cost plane's exposition contract — every
+#: family utils/costplane.py emits (compile ledger, HBM accountant,
+#: step-time sentinel), with its EXACT label keys.  The compile-storm
+#: and step-time-regression stock rules, the dashboard cost-plane
+#: panel, `tpujob top`, and the autoscaler's cost-plane veto all key
+#: on these names; the gate below pins them BOTH WAYS across the
+#: ``compile_* `` / ``hbm_*`` / ``step_time_*`` prefixes.
+COSTPLANE_FAMILIES = {
+    "compile_total": {"program", "trigger"},
+    "compile_seconds": {"program"},
+    "hbm_component_bytes": {"device", "component"},
+    "hbm_device_limit_bytes": {"device"},
+    "hbm_headroom_bytes": {"device"},
+    "step_time_p50_seconds": {"signal"},
+    "step_time_p99_seconds": {"signal"},
+    "step_time_drift_ratio": {"signal"},
+}
+
+
+def test_costplane_families_pinned_both_ways():
+    """ISSUE 20 satellite: the cost-plane metric families are pinned in
+    both directions — every declared family is emitted at a literal
+    call site with exactly the declared label keys (rename or label
+    drift fails tier-1), and no undeclared ``compile_*`` / ``hbm_*`` /
+    ``step_time_*`` family can ship (additions must extend the pin
+    table, i.e. be deliberate)."""
+
+    families = collect_emitted_families()
+    problems = []
+    for name, keys in COSTPLANE_FAMILIES.items():
+        if name not in families:
+            problems.append(f"declared family {name!r} is never emitted")
+        elif families[name] != keys:
+            problems.append(
+                f"family {name!r} emitted with keys "
+                f"{sorted(families[name])}, pinned {sorted(keys)}"
+            )
+    undeclared = {
+        n for n in families
+        if n.startswith(("compile_", "hbm_", "step_time_"))
+    } - set(COSTPLANE_FAMILIES)
+    if undeclared:
+        problems.append(
+            f"undeclared cost-plane families emitted: {sorted(undeclared)}"
+        )
+    assert not problems, (
+        "cost-plane exposition drift:\n  " + "\n  ".join(problems)
+    )
+
+
+def test_compile_storm_rule_binds_the_compile_counter():
+    """ISSUE 20 satellite: the stock recompile-storm rule is
+    counter_increase over ``compile_total`` — a fleet fragmenting into
+    new width/K classes pages before the latency cliff does, and the
+    autoscaler refuses to scale on the churn (COST_PLANE_VETO_RULES)."""
+
+    rule = next(r for r in default_rules() if r.name == "compile-storm")
+    assert rule.metric == "compile_total"
+    assert rule.kind == "counter_increase"
+    assert rule.severity == "page"
+    assert rule.metric in collect_emitted_families()
+
+
+def test_step_time_regression_rule_binds_the_drift_gauge():
+    """ISSUE 20 satellite: the stock regression rule evaluates the
+    sentinel's p50 drift RATIO gauge (rolling median over the frozen
+    reference median) — the median, not the tail, so CI-box p99 jitter
+    cannot false-positive it."""
+
+    rule = next(
+        r for r in default_rules() if r.name == "step-time-regression"
+    )
+    assert rule.metric == "step_time_drift_ratio"
+    assert rule.kind == "gauge"
+    assert rule.metric in collect_emitted_families()
+
+
 def collect_dispatch_phases():
     """{phase literal: [site, ...]} for every literal first-arg
     ``<ledger>.dispatch("<phase>", ...)`` call in the package +

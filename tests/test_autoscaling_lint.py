@@ -339,6 +339,22 @@ def test_resize_gate_reads_the_federated_checkpoint_family():
     assert age is not None and abs(age - 42.0) < 1e-6
 
 
+def test_cost_plane_veto_rules_resolve_in_default_rule_set():
+    """ISSUE 20: the autoscaler refuses to scale — both directions —
+    while a cost-plane rule fires (a recompiling or step-time-regressed
+    fleet gives garbage signals; scaling on them thrashes).  The veto
+    names rules by string, so each name must resolve in the default
+    rule set or the veto silently never engages."""
+
+    from tf_operator_tpu.controller.autoscaler import COST_PLANE_VETO_RULES
+
+    rule_names = {r.name for r in default_rules()}
+    assert set(COST_PLANE_VETO_RULES) <= rule_names
+    assert set(COST_PLANE_VETO_RULES) == {
+        "compile-storm", "step-time-regression",
+    }
+
+
 def test_stock_policy_checkpoint_gate_is_consistent_with_alert_rule():
     """The training policy's resize gate and the checkpoint-stale alert
     read the same stamp: the gate threshold must not be LOOSER than the

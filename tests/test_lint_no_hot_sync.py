@@ -65,6 +65,13 @@ HOT_FUNCTIONS = {
         "_fusedbn_xla",
     },
     "models/resnet.py": {"__call__", "_resolve_norm"},
+    # ISSUE 20 extends the gate to the step-time sentinel's sampling
+    # path (utils/costplane.py): observe() runs inside the decode
+    # window and the train loop with a wall-clock delta the callers
+    # computed host-side — a float()/asarray() coercion here would let
+    # a device scalar smuggle a blocking fetch into every single
+    # steady-state window under the guise of "just recording a gauge"
+    "utils/costplane.py": {"observe", "_quantiles"},
 }
 
 #: file -> {class name -> step-loop functions} (serving hot paths are

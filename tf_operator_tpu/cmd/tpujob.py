@@ -15,6 +15,8 @@ This client speaks the operator's HTTP job API instead:
     tpujob queue [JOB]                   # fleet queue + scheduling decisions
     tpujob telemetry [JOB]               # fleet scrape targets (stale first)
     tpujob fabric [JOB]                  # cross-pod KV fabric catalogs
+    tpujob top [JOB]                     # device cost plane: HBM headroom
+                                         # (worst first) + compile ledger
     tpujob compile -f job.yaml           # TPUJob -> real Kubernetes YAML
                                          # (backend/gke.py; offline, no server)
 
@@ -515,6 +517,98 @@ def cmd_fabric(args) -> int:
     return 0
 
 
+def _gib(nbytes) -> str:
+    return "?" if nbytes is None else f"{nbytes / (1 << 30):.2f}Gi"
+
+
+def _print_costplane(mem: dict, comp: dict, indent: str = "") -> None:
+    """One process's cost-plane read: the HBM device table (the wire
+    is already headroom-worst-first) then the compile-ledger digest."""
+
+    devices = (mem or {}).get("devices", [])
+    fmt = indent + "{:<28} {:<10} {:<10} {:<9} {}"
+    print(fmt.format("DEVICE", "ACCOUNTED", "HEADROOM", "COVERAGE",
+                     "COMPONENTS"))
+    for d in devices:
+        comps = " ".join(
+            f"{c}={_gib(b)}"
+            for c, b in sorted(
+                (d.get("components") or {}).items(),
+                key=lambda kv: -kv[1],
+            )
+            if b > 0
+        )
+        cov = d.get("coverage")
+        print(fmt.format(
+            d.get("device", "?"),
+            _gib(d.get("accounted_bytes")),
+            _gib(d.get("headroom_bytes")),
+            "?" if cov is None else f"{100 * cov:.1f}%",
+            comps or "-",
+        ))
+    if not devices:
+        print(indent + "  (nothing accounted yet)")
+    total = (comp or {}).get("total", 0)
+    progs = sorted(
+        ((comp or {}).get("byProgram") or {}).items(),
+        key=lambda kv: -kv[1]["total"],
+    )
+    digest = " ".join(f"{p}:{s['total']}" for p, s in progs[:6])
+    print(indent + f"compiles: {total}" + (f"  ({digest})" if digest else ""))
+
+
+def cmd_top(args) -> int:
+    """Device cost plane (ISSUE 20) — the fleet's HBM headroom and
+    compile churn at a glance.
+
+    Without a JOB argument, reads ``--server``'s own ``GET
+    /debug/memory`` + ``GET /debug/compiles`` (the operator API and
+    serve_lm both serve them).  With a JOB argument, resolves the
+    job's pods through the operator API and probes every pod's
+    reconciler-stamped ``tpujob.dist/telemetry-port`` — one section
+    per pod, devices headroom-worst-first within each (the server's
+    ordering), unreachable pods flagged rather than skipped."""
+
+    if not args.job:
+        mem = _request("GET", f"{args.server}/debug/memory")
+        comp = _request("GET", f"{args.server}/debug/compiles")
+        _print_costplane(mem, comp)
+        return 0
+
+    want_ns = args.namespace
+    name = args.job
+    if "/" in name:
+        want_ns, name = name.split("/", 1)
+    pods = _request(
+        "GET", _jobs_url(args.server, want_ns, name, "pods")
+    )["items"]
+    rows = 0
+    for pod in pods:
+        port = (pod.get("annotations") or {}).get(
+            "tpujob.dist/telemetry-port"
+        )
+        if not port:
+            continue
+        rows += 1
+        print(f"{pod['name']} (telemetry :{port})")
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/memory", timeout=5
+            ) as resp:
+                mem = json.loads(resp.read())
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/compiles", timeout=5
+            ) as resp:
+                comp = json.loads(resp.read())
+        except (OSError, ValueError) as e:
+            print(f"  UNREACHABLE: {e}")
+            continue
+        _print_costplane(mem, comp, indent="  ")
+    if not rows:
+        print("  (no pods carry a tpujob.dist/telemetry-port annotation)")
+    return 0
+
+
 def cmd_compile(args) -> int:
     from tf_operator_tpu.backend.gke import compile_manifest
 
@@ -592,6 +686,14 @@ def build_parser() -> argparse.ArgumentParser:
     fp.add_argument("job", nargs="?", default="")
     fp.add_argument("-n", "--namespace", default="default")
     fp.set_defaults(fn=cmd_fabric)
+
+    top = sub.add_parser(
+        "top", help="device cost plane: HBM headroom (worst first) "
+                    "+ compile ledger"
+    )
+    top.add_argument("job", nargs="?", default="")
+    top.add_argument("-n", "--namespace", default="default")
+    top.set_defaults(fn=cmd_top)
 
     for name, fn, extra in (
         ("get", cmd_get, []),

@@ -75,6 +75,14 @@ from tf_operator_tpu.utils.logging import FieldLogger, _root
 #: decision log length — GET /autoscaler serves the tail, newest first
 MAX_DECISIONS = 256
 
+#: ISSUE 20: stock rules whose FIRING state vetoes every scale
+#: decision (both modes, both directions) — scaling a recompiling or
+#: regressing fleet treats a software problem with hardware.  Names
+#: are pinned against utils/alerts.default_rules by
+#: tests/test_autoscaling_lint.py; the refusal lands in last_skip +
+#: autoscaler_skipped_total{reason="cost_plane"}.
+COST_PLANE_VETO_RULES = ("compile-storm", "step-time-regression")
+
 
 def default_serving_policy(
     min_replicas: int = 1, max_replicas: int = 4
@@ -609,6 +617,35 @@ class Autoscaler:
         st.breaching = breach
         st.signals = values
 
+        # ISSUE 20 cost-plane gate: NO scale decision, either
+        # direction, while the fleet is recompiling or regressing.
+        # Scaling up a width-thrashing fleet multiplies the recompiles
+        # onto fresh replicas (every new pod cold-compiles the same
+        # thrash); scaling down during a step-time regression removes
+        # capacity exactly when each replica delivers less of it.  Act
+        # on the cause first — the refusal is recorded, never silent.
+        veto = self._cost_plane_veto()
+        if veto is not None:
+            skip = {
+                "time": round(now, 3),
+                "wanted": None,
+                "reason": f"scaling refused: {veto} firing (cost plane)",
+            }
+            if (
+                st.last_skip is None
+                or st.last_skip["reason"] != skip["reason"]
+                or now - st.last_skip["time"] >= pol.cooldown_seconds
+            ):
+                self.metrics.inc(
+                    "autoscaler_skipped_total", reason="cost_plane"
+                )
+                self._log.warning(
+                    "autoscaler %s/%s: %s", job.key,
+                    pol.replica_type.value, skip["reason"],
+                )
+                st.last_skip = skip
+            return None
+
         decision: Optional[ScalingDecision] = None
         cooled = now - st.last_scale >= pol.cooldown_seconds
         if breach:
@@ -762,6 +799,20 @@ class Autoscaler:
         return sig.name + "{" + ",".join(
             f"{k}={v}" for k, v in sorted(sig.labels.items())
         ) + "}"
+
+    def _cost_plane_veto(self) -> Optional[str]:
+        """The name of a firing COST_PLANE_VETO_RULES alert, or None.
+        No engine attached = no veto (a metrics-only autoscaler keeps
+        its legacy behavior; the stock operator wiring always attaches
+        one)."""
+
+        if self.alerts is None:
+            return None
+        for name in COST_PLANE_VETO_RULES:
+            alert = self.alerts.alert(name)
+            if alert is not None and alert.state == "firing":
+                return name
+        return None
 
     def _measure_alert(self, sig: SignalBinding) -> Tuple[bool, Dict[str, Any]]:
         if self.alerts is None:

@@ -477,7 +477,8 @@ class ContinuousBatchingDecoder:
     def __init__(self, model, params, slots: int = 8, steps_per_sync: int = 8,
                  ledger: Optional[DispatchLedger] = None,
                  metrics=None, model_label: str = "",
-                 replica_label: str = "", role: str = "unified"):
+                 replica_label: str = "", role: str = "unified",
+                 costplane=None):
         if role not in REPLICA_ROLES:
             raise ValueError(
                 f"role must be one of {REPLICA_ROLES}, got {role!r}"
@@ -497,6 +498,18 @@ class ContinuousBatchingDecoder:
         #: gauges — the user-facing latency layer over the ledger's
         #: per-dispatch accounting
         self.metrics = metrics if metrics is not None else self.ledger.metrics
+        #: ISSUE 20 device cost plane: every jit cache miss below
+        #: registers in the CompileLedger with its trigger (the
+        #: width/K/pow2 class), the paged subclass accounts its arena
+        #: in the HBM ledger, and the decode-window wall feeds the
+        #: step-time sentinel.  serve_lm shares ONE CostPlane across
+        #: all replicas so /debug/compiles and /debug/memory merge;
+        #: a bare pool gets its own over the pool's metrics registry.
+        if costplane is None:
+            from tf_operator_tpu.utils.costplane import CostPlane
+
+            costplane = CostPlane(metrics=self.metrics)
+        self.costplane = costplane
         self.model_label = model_label or "unknown"
         #: set by the multi-replica router (models/pool_router.py):
         #: non-empty adds a {replica=} label to every SLO observation
@@ -777,7 +790,10 @@ class ContinuousBatchingDecoder:
                     )
                     return vars_["cache"], logits[0, -1]
 
-                self._prefill_fns[width] = jax.jit(prefill)
+                self._prefill_fns[width] = self.costplane.compiles.wrap(
+                    jax.jit(prefill), "pool.prefill",
+                    trigger=f"width={width}",
+                )
                 self.compile_count += 1
             return self._prefill_fns[width]
 
@@ -797,7 +813,9 @@ class ContinuousBatchingDecoder:
                     )
                     return stack, toks.at[i].set(last_tok)
 
-                self._scatter_fn = jax.jit(scatter)
+                self._scatter_fn = self.costplane.compiles.wrap(
+                    jax.jit(scatter), "pool.scatter", trigger="singleton"
+                )
                 self.compile_count += 1
             return self._scatter_fn
 
@@ -856,7 +874,10 @@ class ContinuousBatchingDecoder:
                     )
                     return stack, toks.at[slot].set(tok), tok, rng_next
 
-                self._admit_fns[width] = jax.jit(admit)
+                self._admit_fns[width] = self.costplane.compiles.wrap(
+                    jax.jit(admit), "pool.admit",
+                    trigger=f"width={width}",
+                )
                 self.compile_count += 1
             return self._admit_fns[width]
 
@@ -909,7 +930,10 @@ class ContinuousBatchingDecoder:
                 )
                 return stack, toks, toks_k  # toks_k: [K, slots]
 
-            self._step_fn = jax.jit(step)
+            self._step_fn = self.costplane.compiles.wrap(
+                jax.jit(step), "pool.step",
+                trigger=f"K={self.steps_per_sync}",
+            )
             self.compile_count += 1
         return self._step_fn
 
@@ -1284,6 +1308,12 @@ class ContinuousBatchingDecoder:
                 )
                 host_toks = np.asarray(toks_k)  # [K, slots]
             t_window1 = time.monotonic()
+            # ISSUE 20 step-time sentinel: the window wall is already a
+            # host monotonic difference — one observation per window,
+            # zero extra device traffic
+            self.costplane.sentinel.observe(
+                "decode.window", t_window1 - t_window0
+            )
             finished = False
             for slot in list(self._active):
                 req = self._active[slot]
@@ -1467,11 +1497,12 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                  fabric=None,
                  draft_model=None, draft_params=None,
                  spec_k: int = 4,
-                 spec_tiers=("interactive",)):
+                 spec_tiers=("interactive",),
+                 costplane=None):
         super().__init__(
             model, params, slots=slots, steps_per_sync=steps_per_sync,
             ledger=ledger, metrics=metrics, model_label=model_label,
-            replica_label=replica_label, role=role,
+            replica_label=replica_label, role=role, costplane=costplane,
         )
         #: ISSUE 13: the shared prefix-cache FABRIC
         #: (models/prefix_cache.PrefixFabric) — the migration transport
@@ -1530,6 +1561,16 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
             self.num_blocks = int(kv_blocks) + 1
             self.alloc = BlockAllocator(self.num_blocks, bs)
             self._arena = paged_arena(self.dmodel, self.num_blocks, bs)
+            # ISSUE 20 HBM accounting: the arena is this pool's big
+            # device allocation — register it (add: replicas sharing
+            # one CostPlane each contribute theirs), and keep the
+            # per-block host byte size for the swap-staging gauge
+            self.costplane.hbm.register_tree("kv_arena", self._arena)
+            self._block_host_bytes = sum(
+                int(leaf.nbytes)
+                for leaf in jax.tree_util.tree_leaves(self._arena)
+                if hasattr(leaf, "nbytes")
+            ) // max(1, self.num_blocks)
             if fabric is not None and hasattr(fabric, "register_template"):
                 # fleet fabric (ISSUE 17): the wire decoder rebuilds
                 # pulled block records against this arena's treedef
@@ -1643,6 +1684,8 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                     f"draft model cannot page: {exc} — failing instead "
                     "of silently serving non-speculatively"
                 ) from exc
+            # the draft-cache twin is arena memory too (ISSUE 20)
+            self.costplane.hbm.register_tree("kv_arena", self._draft_arena)
             self._draft_pmodel = (
                 paged_decode_variant(draft_model, self._kernel_impl)
                 if self._kernel_impl is not None
@@ -1766,6 +1809,14 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
             queued_demand=int(queued),
             seats_active=len(self._active),
             swapped=int(self.swap.swapped_blocks),
+        )
+        # ISSUE 20: swap staging is host RAM pinned by preempted seats'
+        # private blocks — the cost plane accounts it per replica
+        # (pure host arithmetic: block count x per-block bytes)
+        self.costplane.hbm.set_component(
+            "swap_staging",
+            self.swap.swapped_blocks * self._block_host_bytes,
+            device=f"host:{self.replica_label or '0'}",
         )
         if self.metrics is None:
             return
@@ -2223,6 +2274,12 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
             if nc not in self._swap_gather_classes:
                 self._swap_gather_classes.add(nc)
                 self.compile_count += 1
+                # shape-polymorphic fn, so the wrap()-on-cache-miss
+                # pattern can't see retraces — each new pow2 class IS
+                # one retrace; register it directly (wall unmeasured)
+                self.costplane.compiles.note(
+                    "paged.swap_gather", trigger=f"ids={nc}"
+                )
             return self._swap_gather_fn
 
     def _swap_in(self, u: int):
@@ -2252,6 +2309,9 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
             if u not in self._swap_in_classes:
                 self._swap_in_classes.add(u)
                 self.compile_count += 1
+                self.costplane.compiles.note(
+                    "paged.swap_in", trigger=f"upload={u}"
+                )
             return self._swap_in_fn
 
     def _upload_bufs(self, host_tree, n: int, u: int, arena=None):
@@ -2303,6 +2363,9 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
             if u not in self._migrate_scatter_classes:
                 self._migrate_scatter_classes.add(u)
                 self.compile_count += 1
+                self.costplane.compiles.note(
+                    "paged.migrate_scatter", trigger=f"upload={u}"
+                )
             return self._migrate_scatter_fn
 
     def _migrate_in_locked(self, req: _Request, keys, shared: List[int],
@@ -2968,7 +3031,10 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                             lengths_dev, temps_dev, topks_dev, rngs_dev,
                             tok, rng_next)
 
-                self._admit_fns[width] = jax.jit(admit)
+                self._admit_fns[width] = self.costplane.compiles.wrap(
+                    jax.jit(admit), "paged.admit",
+                    trigger=f"width={width}",
+                )
                 self.compile_count += 1
             return self._admit_fns[width]
 
@@ -3037,7 +3103,10 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                     )
                     return darena, dtables, drngs
 
-                self._draft_admit_fns[width] = jax.jit(dadmit)
+                self._draft_admit_fns[width] = self.costplane.compiles.wrap(
+                    jax.jit(dadmit), "paged.draft_admit",
+                    trigger=f"width={width}",
+                )
                 self.compile_count += 1
             return self._draft_admit_fns[width]
 
@@ -3061,7 +3130,9 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                     topks = jnp.where(mask, 0, topks)
                     return tables, lengths, temps, topks
 
-                self._retire_fn = jax.jit(retire)
+                self._retire_fn = self.costplane.compiles.wrap(
+                    jax.jit(retire), "paged.retire", trigger="singleton"
+                )
                 self.compile_count += 1
             return self._retire_fn
 
@@ -3214,7 +3285,10 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                     return (arena2, tables, lengths2, rngs_next,
                             toks_out, toks_k)
 
-            self._step_fn = jax.jit(step)
+            self._step_fn = self.costplane.compiles.wrap(
+                jax.jit(step), "paged.step",
+                trigger=f"K={self.steps_per_sync}",
+            )
             self.compile_count += 1
         return self._step_fn
 
@@ -3344,7 +3418,10 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                         return (darena2, dtables, drngs_next, d_toks,
                                 d_dists)
 
-                self._spec_draft_fn = jax.jit(draft)
+                self._spec_draft_fn = self.costplane.compiles.wrap(
+                    jax.jit(draft), "paged.spec_draft",
+                    trigger=f"k={self.spec_k}",
+                )
                 self.compile_count += 1
             return self._spec_draft_fn
 
@@ -3517,7 +3594,10 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                     return (arena2, tables, lengths2, rngs_next,
                             toks_out, win_toks, counts)
 
-                self._spec_verify_fn = jax.jit(verify)
+                self._spec_verify_fn = self.costplane.compiles.wrap(
+                    jax.jit(verify), "paged.spec_verify",
+                    trigger=f"k={self.spec_k}",
+                )
                 self.compile_count += 1
             return self._spec_verify_fn
 
@@ -3708,6 +3788,12 @@ class PagedContinuousBatchingDecoder(ContinuousBatchingDecoder):
                 self._lengths_dev, self._rngs_dev = lengths_dev, rngs_dev
                 self.spec_windows += 1
             t_window1 = time.monotonic()
+            # ISSUE 20 step-time sentinel: same host wall the
+            # decode.window spans carry — regression shows up here as
+            # a drift ratio long before an offline bench window runs
+            self.costplane.sentinel.observe(
+                "decode.window", t_window1 - t_window0
+            )
             finished = []
             finished_reqs = []
             for slot in list(self._active):

@@ -626,7 +626,16 @@ class ChunkedServingDecoder:
                     )
                     return vars_["cache"], logits[:, -1]
 
-                self._prefill[width] = jax.jit(prefill)
+                # ISSUE 20: chunked-decoder compiles register in the
+                # process cost plane (this decoder has no metrics
+                # registry of its own — the default ledger is the
+                # process view /debug/compiles merges anyway)
+                from tf_operator_tpu.utils.costplane import default_costplane
+
+                self._prefill[width] = default_costplane.compiles.wrap(
+                    jax.jit(prefill), "chunked.prefill",
+                    trigger=f"width={width}",
+                )
                 self.compile_count += 1
             return self._prefill[width]
 
@@ -677,7 +686,16 @@ class ChunkedServingDecoder:
                     [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1
                 )
 
-            self._loops[key] = jax.jit(loop)
+            from tf_operator_tpu.utils.costplane import default_costplane
+
+            # trigger carries only the pow2 budget class: temperature/
+            # top_k are CLIENT-influenced — folding them into a metric
+            # label would hand clients unbounded label cardinality
+            # (the LRU bounds compiled programs, not counter series)
+            self._loops[key] = default_costplane.compiles.wrap(
+                jax.jit(loop), "chunked.loop",
+                trigger=f"budget={n_new}",
+            )
             self.compile_count += 1
         return self._loops[key]
 

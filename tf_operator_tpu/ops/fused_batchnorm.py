@@ -418,6 +418,32 @@ def _fusedbn_xla(x, gamma, beta, eps, relu, residual):
 # public entry point
 
 
+#: (cfg, shape) classes already registered in the compile ledger —
+#: one note per distinct Pallas lowering, however many times the
+#: enclosing train step retraces
+_noted_classes: set = set()
+
+
+def _note_compile_class(cfg: _Cfg, shape, c: int) -> None:
+    key = (cfg, tuple(int(s) for s in shape))
+    if key in _noted_classes:
+        return
+    _noted_classes.add(key)
+    from tf_operator_tpu.utils.costplane import default_costplane
+
+    variant = "bn"
+    if cfg.relu:
+        variant += "+relu"
+    if cfg.has_residual:
+        variant += "+res"
+    if cfg.interpret:
+        variant += ",interpret"
+    default_costplane.compiles.note(
+        "ops.fused_batchnorm", trigger=variant,
+        shapes=[f"x[{','.join(str(int(s)) for s in shape)}]", f"c={c}"],
+    )
+
+
 def fused_batchnorm(
     x: jax.Array,
     gamma: jax.Array,
@@ -473,6 +499,12 @@ def fused_batchnorm(
         interpret=interpret,
         res_dtype=None if residual is None else jnp.dtype(residual.dtype).name,
     )
+    # ISSUE 20: each distinct (variant, 2D shape) class is one Pallas
+    # lowering of the forward/backward pair.  The pallas_call compiles
+    # inside whatever jit encloses this, so there is no call boundary
+    # to time — register the class once (wall honestly 0.0) instead of
+    # double-compiling to measure
+    _note_compile_class(cfg, x.shape, c)
     x2d = x.reshape(-1, c)
     res2d = residual.reshape(-1, c) if residual is not None else None
     # params go through the kernel in f32 (stats dtype); the cast is

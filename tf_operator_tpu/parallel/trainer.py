@@ -257,6 +257,18 @@ class Trainer:
         with mesh, nn.logical_axis_rules(self._rules):
             self.state: TrainState = jax.jit(init_state, out_shardings=self.state_sharding)()
 
+        # ISSUE 20 device cost plane: the trainer's compiles register
+        # with their K/eval/gen trigger classes, and the two standing
+        # HBM components — weights and optimizer state — are accounted
+        # per device the moment they exist (pure metadata: nbytes over
+        # the sharded leaves, never a transfer)
+        from tf_operator_tpu.utils.costplane import default_costplane
+
+        self.costplane = default_costplane
+        self.costplane.compiles.note("train.init_state", trigger="init")
+        self.costplane.hbm.register_tree("weights", self.state.params)
+        self.costplane.hbm.register_tree("optimizer", self.state.opt_state)
+
         self._step = self._build_step()
 
     # -- the hot path -------------------------------------------------------
@@ -399,11 +411,14 @@ class Trainer:
         )
 
     def _build_step(self):
-        return jax.jit(
-            self._step_body,
-            in_shardings=(self.state_sharding, self.batch_sharding),
-            out_shardings=(self.state_sharding, None),
-            donate_argnums=(0,),
+        return self.costplane.compiles.wrap(
+            jax.jit(
+                self._step_body,
+                in_shardings=(self.state_sharding, self.batch_sharding),
+                out_shardings=(self.state_sharding, None),
+                donate_argnums=(0,),
+            ),
+            "train.step", trigger="K=1",
         )
 
     def _build_multi_step(self, k: int):
@@ -429,11 +444,18 @@ class Trainer:
 
             return jax.lax.scan(scan_body, state, None, length=k)
 
-        return jax.jit(
-            multi,
-            in_shardings=(self.state_sharding, self.batch_sharding),
-            out_shardings=(self.state_sharding, None),
-            donate_argnums=(0,),
+        # each K class is its own compiled scan — exactly the
+        # recompile family the compile-storm rule exists to catch
+        # (a K-sweep harness bug would show up as train.multi_step
+        # compiles with marching triggers)
+        return self.costplane.compiles.wrap(
+            jax.jit(
+                multi,
+                in_shardings=(self.state_sharding, self.batch_sharding),
+                out_shardings=(self.state_sharding, None),
+                donate_argnums=(0,),
+            ),
+            "train.multi_step", trigger=f"K={k}",
         )
 
     def train_step(self, batch: Batch) -> Dict[str, jax.Array]:
@@ -521,10 +543,13 @@ class Trainer:
             metrics["loss"] = loss
             return metrics
 
-        return jax.jit(
-            step,
-            in_shardings=(self.state_sharding, self.batch_sharding),
-            out_shardings=None,
+        return self.costplane.compiles.wrap(
+            jax.jit(
+                step,
+                in_shardings=(self.state_sharding, self.batch_sharding),
+                out_shardings=None,
+            ),
+            "train.eval_step", trigger="resharded",
         )
 
     def eval_step(self, batch: Batch) -> Dict[str, jax.Array]:
@@ -589,11 +614,18 @@ class Trainer:
             # program count by construction).
             while len(self._gen_cache) >= 16:
                 self._gen_cache.popitem(last=False)
-            self._gen_cache[key] = jax.jit(
-                lambda params, prompt, r: generate(
-                    self.model, params, prompt, max_new_tokens,
-                    temperature=temperature, top_k=top_k, rng=r,
-                )
+            # trigger is the prompt-shape class only: sampling config
+            # is caller-influenced and stays out of the label set (the
+            # ring event's shapes carry the rest)
+            self._gen_cache[key] = self.costplane.compiles.wrap(
+                jax.jit(
+                    lambda params, prompt, r: generate(
+                        self.model, params, prompt, max_new_tokens,
+                        temperature=temperature, top_k=top_k, rng=r,
+                    )
+                ),
+                "train.generate",
+                trigger=f"shape={'x'.join(str(int(s)) for s in prompt_ids.shape)}",
             )
         else:
             self._gen_cache.move_to_end(key)

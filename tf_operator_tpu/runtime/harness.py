@@ -211,6 +211,20 @@ def train_loop(
     #: reads the summary series (reconciler._recent_throughput)
     mreg = getattr(ledger, "metrics", None)
     t_prev = time.perf_counter()
+    # ISSUE 20 step-time sentinel: the per-step wall of each window
+    # (same host clock delta as the throughput gauge, normalized per
+    # step so K=1 and K=8 runs share one reference) feeds the
+    # step_time_* drift gauges the step-time-regression rule binds.
+    # A sentinel bound to the ledger's registry when one exists, so a
+    # harness under test sees its own gauges, not the process global's.
+    from tf_operator_tpu.utils.costplane import (
+        StepTimeSentinel, default_costplane,
+    )
+
+    sentinel = (
+        StepTimeSentinel(metrics=mreg)
+        if mreg is not None else default_costplane.sentinel
+    )
 
     def _observe_throughput(n_steps: int) -> None:
         nonlocal t_prev
@@ -219,6 +233,8 @@ def train_loop(
             mreg.set(
                 "train_window_steps_per_second", n_steps / (now_t - t_prev)
             )
+        if now_t > t_prev:
+            sentinel.observe("train_sync", (now_t - t_prev) / n_steps)
         t_prev = now_t
 
     try:

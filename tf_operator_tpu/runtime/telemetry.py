@@ -13,6 +13,8 @@ process-scope gap).  This module is the export side of closing it:
       GET /metrics               Prometheus text (utils/metrics)
       GET /traces                finished spans as JSONL (utils/trace)
       GET /debug/flightrecorder  black-box rings (utils/flight)
+      GET /debug/compiles        compile ledger (utils/costplane)
+      GET /debug/memory          HBM accountant (utils/costplane)
       GET /healthz               liveness
 
 - :func:`maybe_start_from_env` — boots the server exactly once when
@@ -126,6 +128,38 @@ class PodTelemetryServer:
                             200,
                             outer.recorder.dump_text(),
                             "application/x-ndjson",
+                        )
+                    if route == "/debug/compiles":
+                        # device cost plane (ISSUE 20): this pod's
+                        # compile ledger — `tpujob top JOB` probes
+                        # every pod's telemetry port for these two
+                        import json
+
+                        from tf_operator_tpu.utils.costplane import (
+                            default_costplane,
+                        )
+
+                        return self._send(
+                            200,
+                            json.dumps(
+                                default_costplane.compiles.snapshot()
+                            ),
+                            "application/json",
+                        )
+                    if route == "/debug/memory":
+                        # lazy jax import at request time (host-side
+                        # metadata reads only) — the module itself
+                        # still never imports jax
+                        import json
+
+                        from tf_operator_tpu.utils.costplane import (
+                            default_costplane,
+                        )
+
+                        return self._send(
+                            200,
+                            json.dumps(default_costplane.hbm.snapshot()),
+                            "application/json",
                         )
                     return self._send(404, "not found\n", "text/plain")
                 except Exception as e:  # noqa: BLE001 - HTTP boundary

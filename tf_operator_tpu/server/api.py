@@ -474,6 +474,31 @@ class ApiServer:
                             default_recorder.dump_text(),
                             "application/x-ndjson",
                         )
+                    if p == ["debug", "compiles"]:
+                        # the device cost plane's compile ledger
+                        # (ISSUE 20): this PROCESS's view — compiles
+                        # attributed to trigger classes with walls and
+                        # trace ids; the serving twin lives on
+                        # serve_lm's /debug/compiles, and `tpujob top`
+                        # reads both
+                        from tf_operator_tpu.utils.costplane import (
+                            default_costplane,
+                        )
+
+                        return self._send(
+                            200, default_costplane.compiles.snapshot()
+                        )
+                    if p == ["debug", "memory"]:
+                        # the HBM accountant's per-device component
+                        # table, headroom-worst-first, with the
+                        # accounted-vs-live coverage ratio (ISSUE 20)
+                        from tf_operator_tpu.utils.costplane import (
+                            default_costplane,
+                        )
+
+                        return self._send(
+                            200, default_costplane.hbm.snapshot()
+                        )
                     if p[0] == "apis" and self._not_leader():
                         return None
                     if p == ["apis", "v1", "tpujobs"]:

@@ -164,6 +164,15 @@ DASHBOARD_HTML = """<!doctype html>
 </table>
 <div id="fabric-summary" class="muted"></div>
 </div>
+<div id="costplane-panel" style="display:none">
+<h2>device cost plane</h2>
+<table id="costplane-memory">
+  <thead><tr><th>device</th><th>accounted</th><th>headroom</th>
+  <th>coverage</th><th>components</th></tr></thead>
+  <tbody></tbody>
+</table>
+<div id="costplane-compiles" class="muted"></div>
+</div>
 <h2>traces</h2>
 <table id="traces">
   <thead><tr><th>trace</th><th>root</th><th>spans</th><th>duration</th>
@@ -232,7 +241,64 @@ async function refresh() {
   refreshTraces();
   refreshArena();
   refreshFabric();
+  refreshCostPlane();
   refreshFleet();
+}
+
+async function refreshCostPlane() {
+  // device cost plane (ISSUE 20): the HBM accountant's per-device
+  // table (headroom-worst-first — the wire's sort order) plus a
+  // one-line compile-ledger digest.  Hidden when the process serves
+  // neither route (older builds 404) or the accountant is empty.
+  let mem = null, comp = null;
+  try {
+    const res = await fetch("/debug/memory");
+    if (res.ok) mem = await res.json();
+  } catch (e) {}
+  try {
+    const res = await fetch("/debug/compiles");
+    if (res.ok) comp = await res.json();
+  } catch (e) {}
+  const panel = document.getElementById("costplane-panel");
+  const devices = (mem && mem.devices) || [];
+  const haveMem = devices.some(d => d.accounted_bytes > 0);
+  const haveComp = comp && comp.total > 0;
+  if (!haveMem && !haveComp) { panel.style.display = "none"; return; }
+  panel.style.display = "";
+  const gb = b => b == null ? "?" : (b / 1073741824).toFixed(2) + " GiB";
+  const tbody = document.querySelector("#costplane-memory tbody");
+  tbody.innerHTML = "";
+  for (const d of devices) {
+    const tr = document.createElement("tr");
+    // a device past 90% of its known limit renders like a firing alert
+    if (d.limit_bytes && d.headroom_bytes != null &&
+        d.headroom_bytes < 0.1 * d.limit_bytes)
+      tr.classList.add("alert-firing");
+    const comps = Object.entries(d.components || {})
+      .filter(([, b]) => b > 0).sort((a, b) => b[1] - a[1])
+      .map(([c, b]) => `${c}=${gb(b)}`).join(" ");
+    const cells = [
+      d.device, gb(d.accounted_bytes), gb(d.headroom_bytes),
+      d.coverage == null ? "?" : (100 * d.coverage).toFixed(1) + "%",
+      comps || "none",
+    ];
+    for (const text of cells) {
+      const td = document.createElement("td");
+      td.textContent = text;
+      tr.appendChild(td);
+    }
+    tbody.appendChild(tr);
+  }
+  const line = [];
+  if (comp) {
+    line.push(`compiles: ${comp.total}`);
+    const progs = Object.entries(comp.byProgram || {})
+      .sort((a, b) => b[1].total - a[1].total).slice(0, 5)
+      .map(([p, s]) => `${p}:${s.total}`).join(" ");
+    if (progs) line.push(progs);
+  }
+  document.getElementById("costplane-compiles").textContent =
+    line.join(" — ");
 }
 
 async function refreshFleet() {

@@ -314,6 +314,37 @@ def default_rules(
             kind="gauge_age", threshold=900.0,
             severity="ticket",
         ),
+        ThresholdRule(
+            # device cost plane (ISSUE 20): the compile ledger is
+            # registering compiles faster than any healthy steady
+            # state explains — a width-class/K-class thrash is
+            # recompiling the fleet and every cache miss stalls its
+            # serving window for the full trace+compile wall.  The
+            # threshold clears a normal pool boot (admission widths +
+            # step + retire ≈ a handful) so only a SUSTAINED storm
+            # inside the short window fires; the autoscaler refuses
+            # to scale while this fires (scaling a recompiling fleet
+            # just multiplies the recompiles).
+            "compile-storm",
+            metric="compile_total",
+            kind="counter_increase", threshold=8.0, window=short,
+            severity="page",
+        ),
+        ThresholdRule(
+            # device cost plane (ISSUE 20): the step-time sentinel's
+            # drift ratio — rolling p50 of the decode.window /
+            # train_sync wall over the warmup-frozen reference p50.
+            # 1.5 means the median window is 50% slower than the
+            # baseline this process established at startup: a real
+            # regression (new code path, chip contention, silent
+            # de-fusion), not tail jitter — the p50, unlike the p99,
+            # does not false-positive on a noisy CI box (the clean
+            # soak pins that).  Gauge kind takes the worst signal.
+            "step-time-regression",
+            metric="step_time_drift_ratio",
+            kind="gauge", threshold=1.5,
+            severity="ticket",
+        ),
     ]
 
 

@@ -1,0 +1,687 @@
+"""The device cost plane (ISSUE 20): compile ledger, HBM accountant,
+step-time sentinel.
+
+The observability stack watches every dispatch (DispatchLedger), every
+request (autopsy) and every pod (telemetry federation) — this module
+lights up the DEVICE plane underneath them:
+
+``CompileLedger``
+  Every ``jax.jit``/``pallas_call`` entry point in the hot paths
+  (batching admission width classes, paged step/retire/swap/migrate/
+  draft/verify programs, ``train_steps`` K classes, fused-BN variants)
+  registers its compiles here with the TRIGGER that caused them (the
+  width/K/pow2 class string), the abstract input shapes, the observed
+  compile wall and the owning trace id.  Exported as
+  ``compile_total{program,trigger}`` / ``compile_seconds{program}``
+  plus a bounded event ring (``GET /debug/compiles``).
+
+  Honesty note on the wall: ``wrap()`` times the FIRST call of each
+  wrapped program instance — trace + compile + the first execution —
+  because jax gives no portable hook between trace and execute.  That
+  is the wall the serving loop actually stalls for on a cache miss, so
+  it is the number an operator cares about; it is labeled
+  ``first_call_seconds`` in the ring to keep the claim exact.
+  ``note()`` registers a compile class with no wall at all (used where
+  the callee compiles internally, e.g. the fused-BN ``pallas_call``
+  variants, and re-measuring would mean double-compiling).
+
+``HBMAccountant``
+  A per-device ledger of the big allocations — weights, optimizer
+  state, KV arena, swap staging, compiled-program temp peak (via
+  ``compiled.memory_analysis()`` where a backend provides it) —
+  exported as ``hbm_component_bytes{device,component}`` with
+  ``hbm_device_limit_bytes{device}`` / ``hbm_headroom_bytes{device}``
+  and a ``GET /debug/memory`` snapshot that also reports COVERAGE:
+  accounted bytes vs what the backend says is live
+  (``device.memory_stats()`` where available, the ``jax.live_arrays``
+  sum as the CPU fallback).  The CPU-smoke acceptance pin is
+  coverage >= 0.95 — an accountant that loses track of memory is
+  worse than none.
+
+``StepTimeSentinel``
+  Rolling p50/p99 over the last ``window`` observations of each
+  wall-clock signal (``decode.window``, ``train_sync``), with the
+  reference quantiles FROZEN from the first ``warmup`` observations.
+  The drift gauge is ``rolling_p50 / reference_p50`` — the median, not
+  the tail, so CI-box p99 jitter cannot false-positive the
+  ``step-time-regression`` stock rule (the p99 gauges are exported for
+  humans; the rule binds the drift ratio).  Pure host arithmetic:
+  ``observe()`` is on the no-hot-sync lint's scanned set
+  (tests/test_lint_no_hot_sync.py) because it runs inside the decode
+  window and the train loop.
+
+``CostPlane`` bundles the three over ONE metrics registry; the process
+global ``default_costplane`` rides ``utils.metrics.default_metrics``
+like every other default_* singleton.  Independently of any instance,
+a module-level process counter sums EVERY recorded compile —
+tests/conftest.py writes it into benchmarks/SUITE_RECORD.json at
+session end and benchmarks/check_tier_budget.py reddens on a >25%
+regression, so width-class fragmentation can never creep in silently.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "CompileLedger",
+    "HBMAccountant",
+    "StepTimeSentinel",
+    "CostPlane",
+    "default_costplane",
+    "process_compile_count",
+    "abstract_shapes",
+    "tree_device_bytes",
+]
+
+# -- process-wide compile counter (conftest / check_tier_budget) ----------
+
+_process_lock = threading.Lock()
+_process_compiles = 0
+
+
+def _count_process_compile() -> None:
+    global _process_compiles
+    with _process_lock:
+        _process_compiles += 1
+
+
+def process_compile_count() -> int:
+    """Total compiles recorded by EVERY CompileLedger instance in this
+    process since import — the suite-record number."""
+
+    with _process_lock:
+        return _process_compiles
+
+
+# -- shape / byte helpers -------------------------------------------------
+
+_SHORT_DTYPE = {
+    "float32": "f32", "float16": "f16", "bfloat16": "bf16",
+    "float64": "f64", "int32": "i32", "int64": "i64", "int8": "i8",
+    "uint32": "u32", "uint8": "u8", "bool": "pred",
+}
+
+
+def _describe_leaf(leaf) -> Optional[str]:
+    """'f32[4,128]' for an array-ish leaf, None for scalars/None."""
+
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    name = _SHORT_DTYPE.get(str(dtype), str(dtype))
+    return f"{name}[{','.join(str(int(s)) for s in shape)}]"
+
+
+def abstract_shapes(args, kwargs=None, limit: int = 12) -> List[str]:
+    """The abstract input signature of a call: the first ``limit``
+    array leaves as dtype[shape] strings (+ an elision marker).  Pure
+    metadata — never touches device values."""
+
+    import jax
+
+    leaves = jax.tree_util.tree_leaves((args, kwargs or {}))
+    out: List[str] = []
+    for leaf in leaves:
+        desc = _describe_leaf(leaf)
+        if desc is not None:
+            out.append(desc)
+        if len(out) >= limit:
+            out.append(f"...+{max(0, len(leaves) - limit)} leaves")
+            break
+    return out
+
+
+def tree_device_bytes(tree) -> Dict[str, int]:
+    """Per-device byte footprint of a pytree of jax arrays (host
+    metadata only: ``nbytes`` / ``devices()``, never a transfer).
+    Sharded leaves split their bytes evenly across their device set —
+    exact for the even shardings the mesh builders produce."""
+
+    import jax
+
+    out: Dict[str, int] = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            continue
+        try:
+            devs = list(leaf.devices())
+        except Exception:
+            devs = []
+        if not devs:
+            out["host"] = out.get("host", 0) + int(nbytes)
+            continue
+        share = int(nbytes) // len(devs)
+        for d in devs:
+            key = str(d)
+            out[key] = out.get(key, 0) + share
+    return out
+
+
+# -- (a) the compile ledger -----------------------------------------------
+
+
+class CompileLedger:
+    """Attributed compile registry + ``compile_total{program,trigger}``
+    / ``compile_seconds{program}`` emission + the bounded event ring
+    behind ``GET /debug/compiles``."""
+
+    def __init__(self, metrics=None, ring: int = 256):
+        self._lock = threading.Lock()
+        self._metrics = metrics
+        self._ring: collections.deque = collections.deque(maxlen=int(ring))
+        self._total = 0
+        self._seq = 0
+
+    @property
+    def metrics(self):
+        if self._metrics is None:
+            from tf_operator_tpu.utils.metrics import default_metrics
+
+            self._metrics = default_metrics
+        return self._metrics
+
+    def record(self, program: str, trigger: str = "",
+               seconds: float = 0.0, shapes: Optional[List[str]] = None,
+               trace_id: Optional[str] = None) -> Dict[str, Any]:
+        """Register ONE compile.  ``seconds`` is the first-call wall
+        (0.0 for ``note()``-style registrations where no honest wall
+        exists)."""
+
+        if trace_id is None:
+            try:
+                from tf_operator_tpu.utils.trace import current_trace_id
+
+                trace_id = current_trace_id() or ""
+            except Exception:
+                trace_id = ""
+        with self._lock:
+            self._seq += 1
+            event = {
+                "seq": self._seq,
+                "program": program,
+                "trigger": trigger,
+                "shapes": list(shapes or []),
+                "first_call_seconds": round(float(seconds), 6),
+                "trace_id": trace_id,
+                "when": time.time(),
+            }
+            self._ring.append(event)
+            self._total += 1
+        _count_process_compile()
+        m = self.metrics
+        if m is not None:
+            m.inc("compile_total", program=program, trigger=trigger)
+            m.observe_histogram("compile_seconds", seconds, program=program)
+        return event
+
+    def note(self, program: str, trigger: str = "", **kw) -> Dict[str, Any]:
+        """Register a compile class whose wall cannot be measured
+        without double-compiling (internal ``pallas_call`` lowerings):
+        counted and attributed, wall honestly absent (0.0)."""
+
+        return self.record(program, trigger, seconds=0.0, **kw)
+
+    def wrap(self, fn, program: str, trigger: str = ""):
+        """Return ``fn`` instrumented so its FIRST call registers one
+        compile (wall = trace+compile+first execution; see module
+        docstring).  One wrap per jit-cache entry: the caller's cache
+        miss IS the compile event."""
+
+        state = {"done": False}
+        lock = threading.Lock()
+
+        def timed(*args, **kwargs):
+            if state["done"]:
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            dt = time.perf_counter() - t0
+            with lock:
+                first, state["done"] = (not state["done"]), True
+            if first:
+                self.record(
+                    program, trigger, seconds=dt,
+                    shapes=abstract_shapes(args, kwargs),
+                )
+            return out
+
+        timed.__wrapped__ = fn
+        return timed
+
+    def total(self) -> int:
+        with self._lock:
+            return self._total
+
+    def snapshot(self, limit: Optional[int] = None) -> Dict[str, Any]:
+        """The /debug/compiles payload: totals by program+trigger and
+        the newest-first event ring (bounded)."""
+
+        with self._lock:
+            events = list(self._ring)
+        events.reverse()
+        if limit is not None:
+            events = events[: max(0, int(limit))]
+        by_program: Dict[str, Dict[str, Any]] = {}
+        m = self.metrics
+        if m is not None:
+            for labels, v in m.counter_series("compile_total").items():
+                lab = dict(labels)
+                prog = lab.get("program", "?")
+                slot = by_program.setdefault(
+                    prog, {"total": 0, "byTrigger": {}}
+                )
+                slot["total"] += int(v)
+                trig = lab.get("trigger", "")
+                slot["byTrigger"][trig] = (
+                    slot["byTrigger"].get(trig, 0) + int(v)
+                )
+        return {
+            "total": self._total,
+            "processTotal": process_compile_count(),
+            "byProgram": by_program,
+            "events": events,
+        }
+
+
+# -- (b) the HBM accountant -----------------------------------------------
+
+#: the closed component taxonomy — tests/test_costplane.py and the
+#: lint both-ways pin key off this tuple; an unknown component string
+#: is a programming error, not a new category
+HBM_COMPONENTS = (
+    "weights",
+    "optimizer",
+    "kv_arena",
+    "swap_staging",
+    "program_tmp",
+    "other",
+)
+
+
+class HBMAccountant:
+    """Per-device byte ledger of the big allocations, with coverage
+    against backend-reported live bytes (see module docstring)."""
+
+    def __init__(self, metrics=None, limit_bytes: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._metrics = metrics
+        #: (device, component) -> bytes
+        self._components: Dict[tuple, int] = {}
+        env = os.environ.get("TPUJOB_DEVICE_LIMIT_BYTES", "")
+        self._limit_override = (
+            int(limit_bytes) if limit_bytes is not None
+            else (int(env) if env.isdigit() else None)
+        )
+
+    @property
+    def metrics(self):
+        if self._metrics is None:
+            from tf_operator_tpu.utils.metrics import default_metrics
+
+            self._metrics = default_metrics
+        return self._metrics
+
+    @staticmethod
+    def _default_device() -> str:
+        try:
+            import jax
+
+            return str(jax.devices()[0])
+        except Exception:
+            return "host"
+
+    def set_component(self, component: str, nbytes: int,
+                      device: str = "") -> None:
+        """Set (not add) one component's bytes on one device.  Callers
+        on hot paths pass host-computed ints only."""
+
+        if component not in HBM_COMPONENTS:
+            raise ValueError(
+                f"unknown HBM component {component!r} "
+                f"(taxonomy: {HBM_COMPONENTS})"
+            )
+        dev = device or self._default_device()
+        with self._lock:
+            self._components[(dev, component)] = int(nbytes)
+        self._emit(dev)
+
+    def add_component(self, component: str, nbytes: int,
+                      device: str = "") -> None:
+        """Accumulate into a component (several pools sharing one
+        accountant each add their arena)."""
+
+        if component not in HBM_COMPONENTS:
+            raise ValueError(f"unknown HBM component {component!r}")
+        dev = device or self._default_device()
+        with self._lock:
+            key = (dev, component)
+            self._components[key] = self._components.get(key, 0) + int(nbytes)
+        self._emit(dev)
+
+    def register_tree(self, component: str, tree) -> None:
+        """Account a pytree of device arrays (weights, optimizer state,
+        KV arena) under ``component``, split per device."""
+
+        per_dev = tree_device_bytes(tree)
+        if not per_dev:
+            per_dev = {self._default_device(): 0}
+        for dev, nbytes in per_dev.items():
+            self.add_component(component, nbytes, device=dev)
+
+    def note_compiled(self, program: str, compiled) -> Optional[int]:
+        """Fold a compiled program's temp peak into ``program_tmp``
+        via ``compiled.memory_analysis()`` — best-effort: the CPU
+        backend has no analysis and returns None (the component then
+        reads 0 and the coverage contract doesn't include temps)."""
+
+        try:
+            ana = compiled.memory_analysis()
+            tmp = int(getattr(ana, "temp_size_in_bytes", 0) or 0)
+        except Exception:
+            return None
+        with self._lock:
+            dev = self._default_device()
+            key = (dev, "program_tmp")
+            # temp buffers are not cumulative: programs reuse the same
+            # scratch HBM, so the ledger keeps the PEAK across programs
+            self._components[key] = max(self._components.get(key, 0), tmp)
+        self._emit(dev)
+        return tmp
+
+    # -- backend truth ----------------------------------------------------
+
+    @staticmethod
+    def backend_bytes() -> Dict[str, Optional[int]]:
+        """What the backend says is live per device:
+        ``memory_stats()['bytes_in_use']`` where supported, else the
+        ``jax.live_arrays`` sum (CPU), else None (unknown)."""
+
+        out: Dict[str, Optional[int]] = {}
+        try:
+            import jax
+
+            devices = list(jax.devices())
+        except Exception:
+            return out
+        fallback = [d for d in devices]
+        for d in devices:
+            stats = None
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if stats and "bytes_in_use" in stats:
+                out[str(d)] = int(stats["bytes_in_use"])
+                fallback.remove(d)
+        if fallback:
+            live: Dict[str, int] = {}
+            try:
+                import jax
+
+                arrays = list(jax.live_arrays())
+            except Exception:
+                # backend can't enumerate live arrays: the fallback
+                # devices read None below — unknown stays unknown
+                arrays = []
+            # live_arrays() enumerates every ArrayImpl, including the
+            # constituent single-device arrays a composite built via
+            # make_array_from_single_device_arrays keeps alive (orbax
+            # restores look like this) — same buffer, counted twice.
+            # Dedupe by backing buffer pointer so the fallback measures
+            # memory, not object count.
+            seen_bufs: set = set()
+            for arr in arrays:
+                try:
+                    devs = list(arr.devices())
+                except Exception:
+                    continue
+                try:
+                    ptr = arr.unsafe_buffer_pointer()
+                except Exception:
+                    ptr = id(arr)
+                if ptr in seen_bufs:
+                    continue
+                seen_bufs.add(ptr)
+                nb = getattr(arr, "nbytes", 0) or 0
+                for dv in devs:
+                    live[str(dv)] = (
+                        live.get(str(dv), 0) + int(nb) // len(devs)
+                    )
+            for d in fallback:
+                out[str(d)] = live.get(str(d))
+        return out
+
+    def device_limit(self, device: str) -> Optional[int]:
+        """The device's byte capacity: the explicit override (ctor or
+        TPUJOB_DEVICE_LIMIT_BYTES) wins, else the backend's
+        ``bytes_limit``, else None (CPU: unknown is unknown — the
+        headroom gauge is simply not emitted rather than invented)."""
+
+        if self._limit_override is not None:
+            return self._limit_override
+        try:
+            import jax
+
+            for d in jax.devices():
+                if str(d) == device:
+                    stats = d.memory_stats() or {}
+                    lim = stats.get("bytes_limit")
+                    return int(lim) if lim else None
+        except Exception:
+            # no backend / no memory_stats on this platform: unknown
+            # is unknown — the headroom gauge is simply not emitted
+            return None
+        return None
+
+    def _emit(self, device: str) -> None:
+        m = self.metrics
+        if m is None:
+            return
+        with self._lock:
+            comps = {
+                c: b for (d, c), b in self._components.items()
+                if d == device
+            }
+        accounted = 0
+        for comp, nbytes in sorted(comps.items()):
+            accounted += nbytes
+            m.set(
+                "hbm_component_bytes", float(nbytes),
+                device=device, component=comp,
+            )
+        limit = self.device_limit(device)
+        if limit is not None:
+            m.set("hbm_device_limit_bytes", float(limit), device=device)
+            m.set(
+                "hbm_headroom_bytes", float(limit - accounted),
+                device=device,
+            )
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The /debug/memory payload: per-device component table,
+        accounted total, backend-reported live bytes, limit/headroom
+        and the coverage ratio the CPU smoke pins at >= 0.95."""
+
+        with self._lock:
+            comps = dict(self._components)
+        backend = self.backend_bytes()
+        devices = sorted(
+            {d for d, _ in comps} | set(backend.keys())
+        )
+        out_devices = []
+        for dev in devices:
+            table = {
+                c: b for (d, c), b in comps.items() if d == dev
+            }
+            accounted = sum(table.values())
+            live = backend.get(dev)
+            limit = self.device_limit(dev)
+            coverage = (
+                round(accounted / live, 4) if live else None
+            )
+            out_devices.append({
+                "device": dev,
+                "components": {
+                    c: table.get(c, 0) for c in HBM_COMPONENTS
+                },
+                "accounted_bytes": accounted,
+                "backend_bytes": live,
+                "limit_bytes": limit,
+                "headroom_bytes": (
+                    limit - accounted if limit is not None else None
+                ),
+                "coverage": coverage,
+            })
+        # worst headroom first (unknown-limit devices sink to the end):
+        # the `tpujob top` sort order is the wire's sort order
+        out_devices.sort(
+            key=lambda d: (
+                d["headroom_bytes"] is None,
+                d["headroom_bytes"] if d["headroom_bytes"] is not None
+                else -d["accounted_bytes"],
+            )
+        )
+        return {
+            "devices": out_devices,
+            "accounted_bytes": sum(
+                d["accounted_bytes"] for d in out_devices
+            ),
+        }
+
+
+# -- (c) the step-time sentinel -------------------------------------------
+
+
+class StepTimeSentinel:
+    """Rolling-quantile drift detector over wall-clock signals (see
+    module docstring).  ``observe`` / ``_quantiles`` are scanned by the
+    no-hot-sync lint: pure host arithmetic, no device traffic, no
+    ``float()`` coercion of anything that could be a device value."""
+
+    def __init__(self, metrics=None, window: int = 128, warmup: int = 16):
+        self._lock = threading.Lock()
+        self._metrics = metrics
+        self.window = max(8, int(window))
+        self.warmup = max(4, int(warmup))
+        self._samples: Dict[str, collections.deque] = {}
+        self._reference: Dict[str, tuple] = {}  # signal -> (p50, p99)
+        self._count: Dict[str, int] = {}
+
+    @property
+    def metrics(self):
+        if self._metrics is None:
+            from tf_operator_tpu.utils.metrics import default_metrics
+
+            self._metrics = default_metrics
+        return self._metrics
+
+    @staticmethod
+    def _quantiles(ordered) -> tuple:
+        n = len(ordered)
+        p50 = ordered[min(n - 1, (n - 1) // 2)]
+        p99 = ordered[min(n - 1, (99 * (n - 1)) // 100)]
+        return p50, p99
+
+    def observe(self, signal: str, seconds) -> None:
+        """One window wall.  Freezes the reference quantiles at the
+        ``warmup``-th observation; after that every call refreshes the
+        p50/p99 gauges and the drift ratio (rolling_p50 / ref_p50)."""
+
+        with self._lock:
+            dq = self._samples.get(signal)
+            if dq is None:
+                dq = collections.deque(maxlen=self.window)
+                self._samples[signal] = dq
+                self._count[signal] = 0
+            dq.append(seconds)
+            self._count[signal] += 1
+            n = self._count[signal]
+            ordered = sorted(dq)
+            p50, p99 = self._quantiles(ordered)
+            if n == self.warmup and signal not in self._reference:
+                eps = 1e-9
+                self._reference[signal] = (
+                    p50 if p50 > eps else eps,
+                    p99 if p99 > eps else eps,
+                )
+            ref = self._reference.get(signal)
+        m = self.metrics
+        if m is not None:
+            m.set("step_time_p50_seconds", p50, signal=signal)
+            m.set("step_time_p99_seconds", p99, signal=signal)
+            if ref is not None:
+                m.set(
+                    "step_time_drift_ratio", p50 / ref[0], signal=signal
+                )
+
+    def reference(self, signal: str) -> Optional[tuple]:
+        with self._lock:
+            return self._reference.get(signal)
+
+    def reset(self, signal: Optional[str] = None) -> None:
+        """Drop state (all signals, or one) — the re-baseline hook a
+        deliberate fleet change (new model, new K) uses so the drift
+        gauge compares against the NEW steady state."""
+
+        with self._lock:
+            if signal is None:
+                self._samples.clear()
+                self._reference.clear()
+                self._count.clear()
+                return
+            self._samples.pop(signal, None)
+            self._reference.pop(signal, None)
+            self._count.pop(signal, None)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {}
+            for sig, dq in self._samples.items():
+                ordered = sorted(dq)
+                p50, p99 = self._quantiles(ordered) if ordered else (0, 0)
+                ref = self._reference.get(sig)
+                out[sig] = {
+                    "observations": self._count.get(sig, 0),
+                    "p50_seconds": p50,
+                    "p99_seconds": p99,
+                    "reference_p50_seconds": ref[0] if ref else None,
+                    "reference_p99_seconds": ref[1] if ref else None,
+                    "drift_ratio": (
+                        round(p50 / ref[0], 4) if ref else None
+                    ),
+                }
+            return out
+
+
+# -- the bundle + process global ------------------------------------------
+
+
+class CostPlane:
+    """One metrics registry, three ledgers — what a serving process or
+    the operator wires through its planes."""
+
+    def __init__(self, metrics=None, ring: int = 256,
+                 sentinel_window: int = 128, sentinel_warmup: int = 16,
+                 limit_bytes: Optional[int] = None):
+        self.compiles = CompileLedger(metrics=metrics, ring=ring)
+        self.hbm = HBMAccountant(metrics=metrics, limit_bytes=limit_bytes)
+        self.sentinel = StepTimeSentinel(
+            metrics=metrics, window=sentinel_window, warmup=sentinel_warmup
+        )
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "compiles": self.compiles.snapshot(limit=32),
+            "memory": self.hbm.snapshot(),
+            "stepTime": self.sentinel.snapshot(),
+        }
+
+
+default_costplane = CostPlane()
